@@ -1,0 +1,39 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+A ground-up rebuild of the capabilities of Deeplearning4j (reference:
+EronWright/deeplearning4j) designed for TPU hardware: every training step is a
+single jitted XLA program over a donated state pytree; parallelism is expressed
+with `jax.sharding` meshes instead of parameter servers; hot ops beyond XLA's
+fusions are Pallas kernels.
+
+Top-level layout (mirrors SURVEY.md §2's component inventory):
+
+- ``runtime``   — device/mesh discovery, dtype policy, RNG, runtime config
+                  facade, profiling hooks (reference: nd4j runtime config +
+                  ``OpProfiler``).
+- ``ops``       — activations, losses, initializers, and Pallas TPU kernels
+                  (reference: libnd4j loops + declarable ops; cuDNN helpers).
+- ``nn``        — config-as-data layer DSL with ``InputType`` shape inference
+                  (reference: ``org.deeplearning4j.nn.conf``).
+- ``models``    — ``MultiLayerNetwork`` / ``ComputationGraph`` equivalents plus
+                  ``ModelSerializer`` (reference: ``org.deeplearning4j.nn``).
+- ``train``     — updaters, LR schedules, listeners, the jitted training engine
+                  (reference: ``org.deeplearning4j.optimize`` + nd4j updaters).
+- ``evaluation``— ``Evaluation`` / ``ROC`` / ``RegressionEvaluation``
+                  (reference: ``org.nd4j.evaluation``).
+- ``data``      — DataSet/iterators/normalizers + DataVec-style ETL
+                  (reference: datavec + dl4j-data).
+- ``autodiff``  — SameDiff-equivalent declarative graph API
+                  (reference: ``org.nd4j.autodiff.samediff``).
+- ``imports``   — Keras-H5 / TF-GraphDef model import (reference:
+                  ``org.deeplearning4j.nn.modelimport``, ``org.nd4j.imports``).
+- ``parallel``  — mesh sharding (DP/TP/FSDP/SP), ParallelInference, multi-host
+                  (reference: ParallelWrapper, dl4j-spark, nd4j-parameter-server).
+- ``zoo``       — model zoo (reference: ``org.deeplearning4j.zoo``).
+- ``nlp``       — Word2Vec & friends (reference: deeplearning4j-nlp).
+- ``ui``        — stats collection/serving (reference: deeplearning4j-ui).
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.runtime import environment as _environment  # noqa: F401

@@ -1,0 +1,31 @@
+"""Data layer: DataSet, iterators, normalizers, dataset fetchers, ETL.
+
+Rebuild of the reference's data stack: ``org.nd4j.linalg.dataset``
+(``DataSet``/``MultiDataSet``), the ``DataSetIterator`` SPI + async prefetch
+(``AsyncDataSetIterator``), normalizers
+(``org.nd4j.linalg.dataset.api.preprocessor``), built-in dataset
+iterators (``org.deeplearning4j.datasets``), and a DataVec-style declarative
+ETL pipeline (``records`` module: RecordReader / Schema / TransformProcess).
+"""
+
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.data.iterators import (
+    AsyncDataSetIterator,
+    DataSetIterator,
+    ExistingDataSetIterator,
+    ListDataSetIterator,
+    NumpyDataSetIterator,
+)
+from deeplearning4j_tpu.data.normalizers import (
+    ImagePreProcessingScaler,
+    NormalizerMinMaxScaler,
+    NormalizerStandardize,
+)
+from deeplearning4j_tpu.data.mnist import MnistDataSetIterator
+
+__all__ = [
+    "DataSet", "MultiDataSet", "DataSetIterator", "ListDataSetIterator",
+    "NumpyDataSetIterator", "ExistingDataSetIterator", "AsyncDataSetIterator",
+    "NormalizerStandardize", "NormalizerMinMaxScaler", "ImagePreProcessingScaler",
+    "MnistDataSetIterator",
+]

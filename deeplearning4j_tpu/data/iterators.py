@@ -1,0 +1,233 @@
+"""DataSetIterator SPI + implementations.
+
+Rebuild of the reference's iterator stack
+(``org.nd4j.linalg.dataset.api.iterator.DataSetIterator``,
+``org.deeplearning4j.datasets.iterator.*``): list/numpy-backed iterators and
+the async prefetch wrapper (``AsyncDataSetIterator``) that overlaps host ETL
+with device compute — on TPU this is host thread + ``jax.device_put``
+double-buffering rather than the reference's workspace ring.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+
+
+class DataSetIterator:
+    """SPI: iterable of DataSet minibatches with reset + preprocessor hook."""
+
+    pre_processor = None  # a Normalizer; applied to each batch if set
+
+    def __iter__(self) -> Iterator[DataSet]:
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        ds = self.next()
+        if self.pre_processor is not None:
+            ds = self.pre_processor.transform_dataset(ds)
+        return ds
+
+    # -- SPI --
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def next(self) -> DataSet:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def batch(self) -> int:
+        raise NotImplementedError
+
+    def set_pre_processor(self, p) -> None:
+        self.pre_processor = p
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterate pre-built DataSets, optionally re-batched (reference
+    ``ListDataSetIterator``)."""
+
+    def __init__(self, datasets: Sequence[DataSet], batch_size: Optional[int] = None):
+        if batch_size is not None:
+            merged = DataSet.merge(list(datasets))
+            self._batches = merged.batch_by(batch_size)
+            self._batch_size = batch_size
+        else:
+            self._batches = list(datasets)
+            self._batch_size = len(self._batches[0]) if self._batches else 0
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._batches)
+
+    def next(self) -> DataSet:
+        ds = self._batches[self._pos]
+        self._pos += 1
+        return ds
+
+    def reset(self) -> None:
+        self._pos = 0
+
+    def batch(self) -> int:
+        return self._batch_size
+
+
+class NumpyDataSetIterator(DataSetIterator):
+    """Batch over in-memory arrays with optional shuffling each epoch."""
+
+    def __init__(self, features: np.ndarray, labels: np.ndarray, batch_size: int,
+                 shuffle: bool = False, seed: int = 0, drop_last: bool = False,
+                 features_mask: Optional[np.ndarray] = None,
+                 labels_mask: Optional[np.ndarray] = None):
+        self.features = np.asarray(features)
+        self.labels = np.asarray(labels)
+        self.features_mask = features_mask
+        self.labels_mask = labels_mask
+        self._batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self._rng = np.random.default_rng(seed)
+        self._order = np.arange(len(self.features))
+        self._pos = 0
+        if shuffle:
+            self._rng.shuffle(self._order)
+
+    def has_next(self) -> bool:
+        remaining = len(self._order) - self._pos
+        return remaining >= (self._batch_size if self.drop_last else 1)
+
+    def next(self) -> DataSet:
+        idx = self._order[self._pos:self._pos + self._batch_size]
+        self._pos += len(idx)
+        return DataSet(
+            self.features[idx], self.labels[idx],
+            None if self.features_mask is None else self.features_mask[idx],
+            None if self.labels_mask is None else self.labels_mask[idx])
+
+    def reset(self) -> None:
+        self._pos = 0
+        if self.shuffle:
+            self._rng.shuffle(self._order)
+
+    def batch(self) -> int:
+        return self._batch_size
+
+
+class ExistingDataSetIterator(DataSetIterator):
+    """Wrap any python iterable of DataSets (reference
+    ``ExistingDataSetIterator``)."""
+
+    def __init__(self, iterable):
+        self._iterable = iterable
+        self._iter = None
+        self._peek = None
+
+    def reset(self) -> None:
+        self._iter = iter(self._iterable)
+        self._peek = None
+
+    def has_next(self) -> bool:
+        if self._iter is None:
+            self.reset()
+        if self._peek is None:
+            try:
+                self._peek = next(self._iter)
+            except StopIteration:
+                return False
+        return True
+
+    def next(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        ds, self._peek = self._peek, None
+        return ds
+
+    def batch(self) -> int:
+        return -1
+
+
+_SENTINEL = object()
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch (reference ``AsyncDataSetIterator``):
+    decouples host-side ETL from the training loop so the device never waits
+    on data. ``queue_size`` is the prefetch depth (reference default 8)."""
+
+    def __init__(self, base: DataSetIterator, queue_size: int = 8):
+        self.base = base
+        self.queue_size = max(1, int(queue_size))
+        self._queue: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._peek = None
+        self._error: Optional[BaseException] = None
+        self._exhausted = False  # sentinel already consumed by has_next
+
+    def _start(self):
+        self._queue = queue.Queue(maxsize=self.queue_size)
+        self._error = None
+        self._exhausted = False
+
+        def worker():
+            try:
+                self.base.reset()
+                while self.base.has_next():
+                    self._queue.put(self.base.next())
+            except BaseException as e:  # surfaced on the consumer side
+                self._error = e
+            finally:
+                self._queue.put(_SENTINEL)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def reset(self) -> None:
+        if self._thread is not None and not self._exhausted:
+            # Drain until the sentinel so the worker can exit. Poll with a
+            # timeout: if the sentinel was already consumed elsewhere and the
+            # worker has exited, an unconditional get() would block forever.
+            while True:
+                try:
+                    item = self._queue.get(timeout=0.1)
+                except queue.Empty:
+                    if not self._thread.is_alive():
+                        break
+                    continue
+                if item is _SENTINEL:
+                    break
+        self._start()
+        self._peek = None
+
+    def has_next(self) -> bool:
+        if self._queue is None:
+            self.reset()
+        if self._peek is None:
+            if self._exhausted:
+                return False
+            item = self._queue.get()
+            if item is _SENTINEL:
+                self._exhausted = True
+                if self._error is not None:
+                    raise self._error
+                return False
+            self._peek = item
+        return True
+
+    def next(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        ds, self._peek = self._peek, None
+        return ds
+
+    def batch(self) -> int:
+        return self.base.batch()

@@ -1,0 +1,19 @@
+"""Evaluation suite.
+
+Rebuild of upstream ``org.nd4j.evaluation`` (moved from DL4J to nd4j in
+beta4): ``Evaluation`` (confusion/precision/recall/F1/top-N), ``ROC`` /
+``ROCBinary`` / ``ROCMultiClass`` (exact + thresholded AUC),
+``RegressionEvaluation`` (MSE/MAE/RMSE/R²), ``EvaluationBinary``,
+``EvaluationCalibration`` (reliability diagrams). Accumulation is
+numpy-on-host: evaluation runs between jitted inference calls, off the
+device's critical path.
+"""
+
+from deeplearning4j_tpu.evaluation.evaluation import Evaluation
+from deeplearning4j_tpu.evaluation.regression import RegressionEvaluation
+from deeplearning4j_tpu.evaluation.roc import ROC, ROCBinary, ROCMultiClass
+from deeplearning4j_tpu.evaluation.binary import EvaluationBinary
+from deeplearning4j_tpu.evaluation.calibration import EvaluationCalibration
+
+__all__ = ["Evaluation", "RegressionEvaluation", "ROC", "ROCBinary", "ROCMultiClass",
+           "EvaluationBinary", "EvaluationCalibration"]

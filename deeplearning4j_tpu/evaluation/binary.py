@@ -1,0 +1,72 @@
+"""Per-output binary evaluation (reference
+``org.nd4j.evaluation.classification.EvaluationBinary``): independent
+TP/FP/TN/FN + accuracy/precision/recall/F1 per output column at a 0.5 (or
+custom) decision threshold."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class EvaluationBinary:
+    def __init__(self, n_columns: Optional[int] = None, decision_threshold: float = 0.5):
+        self.threshold = float(decision_threshold)
+        self.n_columns = n_columns
+        self.tp = self.fp = self.tn = self.fn = None
+        if n_columns:
+            self._init(n_columns)
+
+    def _init(self, c):
+        self.n_columns = c
+        self.tp = np.zeros(c, np.int64)
+        self.fp = np.zeros(c, np.int64)
+        self.tn = np.zeros(c, np.int64)
+        self.fn = np.zeros(c, np.int64)
+
+    def eval(self, labels: np.ndarray, predictions: np.ndarray,
+             mask: Optional[np.ndarray] = None) -> None:
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 1:
+            labels, predictions = labels[:, None], predictions[:, None]
+        if self.tp is None:
+            self._init(labels.shape[1])
+        pred = predictions >= self.threshold
+        lab = labels > 0.5
+        if mask is not None:
+            m = np.asarray(mask).astype(bool)
+            if m.ndim == 1:
+                m = m[:, None]
+            valid = np.broadcast_to(m, lab.shape)
+        else:
+            valid = np.ones_like(lab, bool)
+        self.tp += (pred & lab & valid).sum(0)
+        self.fp += (pred & ~lab & valid).sum(0)
+        self.tn += (~pred & ~lab & valid).sum(0)
+        self.fn += (~pred & lab & valid).sum(0)
+
+    def accuracy(self, col: int = 0) -> float:
+        tot = self.tp[col] + self.fp[col] + self.tn[col] + self.fn[col]
+        return float((self.tp[col] + self.tn[col]) / tot) if tot else float("nan")
+
+    def precision(self, col: int = 0) -> float:
+        d = self.tp[col] + self.fp[col]
+        return float(self.tp[col] / d) if d else 0.0
+
+    def recall(self, col: int = 0) -> float:
+        d = self.tp[col] + self.fn[col]
+        return float(self.tp[col] / d) if d else 0.0
+
+    def f1(self, col: int = 0) -> float:
+        p, r = self.precision(col), self.recall(col)
+        return 2 * p * r / (p + r) if p + r > 0 else 0.0
+
+    def stats(self) -> str:
+        lines = ["================Binary Evaluation================",
+                 f"{'col':>5}{'acc':>10}{'prec':>10}{'recall':>10}{'F1':>10}"]
+        for c in range(self.n_columns or 0):
+            lines.append(f"{c:>5}{self.accuracy(c):>10.4f}{self.precision(c):>10.4f}"
+                         f"{self.recall(c):>10.4f}{self.f1(c):>10.4f}")
+        return "\n".join(lines)

@@ -1,0 +1,51 @@
+"""Probability-calibration evaluation (reference
+``org.nd4j.evaluation.classification.EvaluationCalibration``): reliability
+diagram bins, expected calibration error, residual-probability histogram."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class EvaluationCalibration:
+    def __init__(self, reliability_bins: int = 10):
+        self.bins = int(reliability_bins)
+        self.bin_counts = np.zeros(self.bins, np.int64)
+        self.bin_correct = np.zeros(self.bins, np.int64)
+        self.bin_prob_sum = np.zeros(self.bins, np.float64)
+
+    def eval(self, labels: np.ndarray, predictions: np.ndarray) -> None:
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        true_idx = labels.argmax(-1) if labels.ndim == 2 else labels.astype(np.int64)
+        pred_idx = predictions.argmax(-1)
+        conf = predictions.max(-1)
+        idx = np.clip((conf * self.bins).astype(np.int64), 0, self.bins - 1)
+        np.add.at(self.bin_counts, idx, 1)
+        np.add.at(self.bin_correct, idx, (pred_idx == true_idx).astype(np.int64))
+        np.add.at(self.bin_prob_sum, idx, conf)
+
+    def reliability_diagram(self):
+        """Returns (mean_confidence, accuracy, count) per bin."""
+        with np.errstate(invalid="ignore"):
+            mean_conf = np.divide(self.bin_prob_sum, self.bin_counts,
+                                  out=np.zeros(self.bins), where=self.bin_counts > 0)
+            acc = np.divide(self.bin_correct, self.bin_counts,
+                            out=np.zeros(self.bins), where=self.bin_counts > 0)
+        return mean_conf, acc, self.bin_counts.copy()
+
+    def expected_calibration_error(self) -> float:
+        mean_conf, acc, counts = self.reliability_diagram()
+        total = counts.sum()
+        if total == 0:
+            return float("nan")
+        return float(np.sum(counts / total * np.abs(acc - mean_conf)))
+
+    def stats(self) -> str:
+        mean_conf, acc, counts = self.reliability_diagram()
+        lines = ["============Calibration Evaluation============",
+                 f" ECE: {self.expected_calibration_error():.4f}",
+                 f"{'bin':>5}{'conf':>10}{'acc':>10}{'count':>10}"]
+        for b in range(self.bins):
+            lines.append(f"{b:>5}{mean_conf[b]:>10.4f}{acc[b]:>10.4f}{counts[b]:>10}")
+        return "\n".join(lines)

@@ -1,0 +1,15 @@
+"""Networks: MultiLayerNetwork (linear stack), ComputationGraph (DAG),
+ModelSerializer (single-file archives).
+
+Rebuild of upstream ``org.deeplearning4j.nn.multilayer.MultiLayerNetwork``,
+``org.deeplearning4j.nn.graph.ComputationGraph`` and
+``org.deeplearning4j.util.ModelSerializer`` — re-architected graph-first: the
+network composes all layers into ONE jitted XLA program per (train / inference)
+entry point instead of dispatching per-op like the reference.
+"""
+
+from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork, TrainState
+from deeplearning4j_tpu.models.computation_graph import ComputationGraph, GraphBuilder
+from deeplearning4j_tpu.models.serializer import ModelSerializer
+
+__all__ = ["MultiLayerNetwork", "TrainState", "ComputationGraph", "GraphBuilder", "ModelSerializer"]

@@ -1,0 +1,124 @@
+"""Single-file model archives.
+
+Rebuild of upstream ``org.deeplearning4j.util.ModelSerializer``: a zip holding
+``configuration.json`` (full config tree), ``coefficients.npz`` (params),
+``updaterState.npz`` (optimizer moments — Adam m/v etc.), optional
+``normalizer.npz``; ``restore_*(path, load_updater)`` resumes training exactly,
+as in the reference. Pytree leaves are stored in deterministic
+``tree_flatten`` order and restored against a freshly-initialised structure
+(the flat-buffer analog of the reference's ``coefficients.bin``).
+
+For sharded/async checkpoint-during-training use ``train.checkpoint`` (orbax)
+instead; this format is the portable interchange artifact.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_CONF = "configuration.json"
+_COEFF = "coefficients.npz"
+_UPDATER = "updaterState.npz"
+_NORM = "normalizer.npz"
+_META = "metadata.json"
+
+
+def _save_pytree_npz(tree) -> bytes:
+    leaves = jax.tree.leaves(tree)
+    buf = io.BytesIO()
+    np.savez(buf, **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)})
+    return buf.getvalue()
+
+
+def _load_pytree_npz(data: bytes, like):
+    z = np.load(io.BytesIO(data))
+    leaves = [z[f"leaf_{i}"] for i in range(len(z.files))]
+    treedef = jax.tree.structure(like)
+    like_leaves = jax.tree.leaves(like)
+    if len(leaves) != len(like_leaves):
+        raise ValueError(f"Archive has {len(leaves)} arrays; model expects {len(like_leaves)}")
+    coerced = [jnp.asarray(l, dtype=ll.dtype) for l, ll in zip(leaves, like_leaves)]
+    return jax.tree.unflatten(treedef, coerced)
+
+
+class ModelSerializer:
+    @staticmethod
+    def write_model(net, path: str, save_updater: bool = True,
+                    normalizer=None) -> None:
+        import dataclasses
+        kind = type(net).__name__
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+            zf.writestr(_CONF, net.conf.to_json())
+            zf.writestr(_META, json.dumps({
+                "model_type": kind,
+                "iteration": net._iteration,
+                "epoch": net._epoch,
+                "framework": "deeplearning4j_tpu",
+            }))
+            ts = net.train_state
+            zf.writestr(_COEFF, _save_pytree_npz({"params": ts.params,
+                                                  "model_state": ts.model_state}))
+            if save_updater:
+                zf.writestr(_UPDATER, _save_pytree_npz(ts.opt_state))
+            if normalizer is not None:
+                buf = io.BytesIO()
+                np.savez(buf, kind=type(normalizer).__name__, **normalizer._state())
+                zf.writestr(_NORM, buf.getvalue())
+
+    @staticmethod
+    def restore_multi_layer_network(path: str, load_updater: bool = True):
+        from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
+        with zipfile.ZipFile(path) as zf:
+            conf = MultiLayerConfiguration.from_json(zf.read(_CONF).decode())
+            net = MultiLayerNetwork(conf).init()
+            ModelSerializer._restore_state(zf, net, load_updater)
+        return net
+
+    @staticmethod
+    def restore_computation_graph(path: str, load_updater: bool = True):
+        from deeplearning4j_tpu.models.computation_graph import (
+            ComputationGraph, ComputationGraphConfiguration)
+        with zipfile.ZipFile(path) as zf:
+            conf = ComputationGraphConfiguration.from_json(zf.read(_CONF).decode())
+            net = ComputationGraph(conf).init()
+            ModelSerializer._restore_state(zf, net, load_updater)
+        return net
+
+    @staticmethod
+    def _restore_state(zf: zipfile.ZipFile, net, load_updater: bool):
+        import dataclasses
+        ts = net.train_state
+        coeff = _load_pytree_npz(zf.read(_COEFF),
+                                 {"params": ts.params, "model_state": ts.model_state})
+        new_ts = dataclasses.replace(ts, params=coeff["params"],
+                                     model_state=coeff["model_state"])
+        if load_updater and _UPDATER in zf.namelist():
+            new_ts = dataclasses.replace(
+                new_ts, opt_state=_load_pytree_npz(zf.read(_UPDATER), ts.opt_state))
+        meta = json.loads(zf.read(_META).decode()) if _META in zf.namelist() else {}
+        net._iteration = int(meta.get("iteration", 0))
+        net._epoch = int(meta.get("epoch", 0))
+        net.train_state = new_ts
+
+    @staticmethod
+    def restore_normalizer(path: str):
+        from deeplearning4j_tpu.data.normalizers import Normalizer
+        with zipfile.ZipFile(path) as zf:
+            if _NORM not in zf.namelist():
+                return None
+            import tempfile, os
+            with tempfile.NamedTemporaryFile(suffix=".npz", delete=False) as f:
+                f.write(zf.read(_NORM))
+                tmp = f.name
+            try:
+                return Normalizer.load(tmp)
+            finally:
+                os.unlink(tmp)

@@ -1,0 +1,92 @@
+"""Neural-network configuration DSL: config-as-data layers + shape inference.
+
+TPU-native rebuild of the reference's ``org.deeplearning4j.nn.conf`` package:
+builder-style, JSON-round-trippable layer configs with an ``InputType`` shape
+inference system and automatic ``InputPreProcessor`` insertion. Unlike the
+reference there are no separate conf/impl class pairs — a layer config *is*
+the implementation (pure ``init``/``forward`` functions), and the whole
+network forward composes into one XLA program.
+"""
+
+from deeplearning4j_tpu.nn.base import GlobalConfig, Layer, get_layer_class, register_layer
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.config import (
+    ListBuilder,
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.core_layers import (
+    ActivationLayer,
+    DenseLayer,
+    DropoutLayer,
+    EmbeddingLayer,
+    EmbeddingSequenceLayer,
+    LossLayer,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.conv_layers import (
+    BatchNormalization,
+    ConvolutionLayer,
+    Deconvolution2D,
+    GlobalPoolingLayer,
+    LocalResponseNormalization,
+    PoolingType,
+    SeparableConvolution2D,
+    SpaceToDepthLayer,
+    SubsamplingLayer,
+    Upsampling2D,
+    ZeroPaddingLayer,
+)
+from deeplearning4j_tpu.nn.recurrent_layers import (
+    GRU,
+    LSTM,
+    Bidirectional,
+    GravesLSTM,
+    LastTimeStep,
+    RnnOutputLayer,
+    SimpleRnn,
+)
+from deeplearning4j_tpu.nn.attention_layers import (
+    LearnedPositionalEmbeddingLayer,
+    SelfAttentionLayer,
+    TransformerEncoderBlock,
+)
+
+__all__ = [
+    "GlobalConfig",
+    "Layer",
+    "register_layer",
+    "get_layer_class",
+    "InputType",
+    "NeuralNetConfiguration",
+    "MultiLayerConfiguration",
+    "ListBuilder",
+    "DenseLayer",
+    "OutputLayer",
+    "LossLayer",
+    "ActivationLayer",
+    "DropoutLayer",
+    "EmbeddingLayer",
+    "EmbeddingSequenceLayer",
+    "ConvolutionLayer",
+    "SubsamplingLayer",
+    "PoolingType",
+    "BatchNormalization",
+    "LocalResponseNormalization",
+    "Upsampling2D",
+    "ZeroPaddingLayer",
+    "SeparableConvolution2D",
+    "Deconvolution2D",
+    "SpaceToDepthLayer",
+    "GlobalPoolingLayer",
+    "LSTM",
+    "GravesLSTM",
+    "GRU",
+    "SimpleRnn",
+    "Bidirectional",
+    "LastTimeStep",
+    "RnnOutputLayer",
+    "SelfAttentionLayer",
+    "TransformerEncoderBlock",
+    "LearnedPositionalEmbeddingLayer",
+]

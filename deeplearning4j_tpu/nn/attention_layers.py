@@ -1,0 +1,178 @@
+"""Attention / transformer layers.
+
+The reference's attention surface is the SameDiff op
+``multiHeadDotProductAttention`` (upstream
+``org.nd4j.linalg.api.ops.impl.transforms.custom.MultiHeadDotProductAttention``,
+used by imported BERT) plus the DL4J layers ``SelfAttentionLayer`` /
+``LearnedSelfAttentionLayer`` (beta4+). Here attention is first-class: a
+layer-API multi-head self-attention whose inner product can route through the
+Pallas flash-attention kernel (``ops.pallas.flash_attention``) when shapes
+warrant, and a full pre/post-LN transformer encoder block used by the zoo's
+BERT.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.base import GlobalConfig, Layer, register_layer
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.ops.activations import get_activation
+from deeplearning4j_tpu.ops.initializers import init_weights
+
+
+def layer_norm(x, gamma, beta, eps=1e-12):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + eps) * gamma + beta
+
+
+def dot_product_attention(q, k, v, mask=None, use_flash: bool = True):
+    """(batch, heads, time, d) attention. Uses the Pallas flash kernel on TPU
+    when available/shapes allow, else the XLA softmax form."""
+    if use_flash:
+        try:
+            from deeplearning4j_tpu.ops.pallas.flash_attention import flash_attention_compatible, flash_attention
+            if flash_attention_compatible(q, k, v, mask):
+                return flash_attention(q, k, v, mask)
+        except Exception:
+            pass
+    d = q.shape[-1]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(jnp.asarray(d, q.dtype))
+    if mask is not None:
+        scores = jnp.where(mask, scores, jnp.asarray(-1e9, scores.dtype))
+    weights = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+
+
+@register_layer
+@dataclasses.dataclass
+class SelfAttentionLayer(Layer):
+    """Multi-head self-attention over (batch, time, size) (reference
+    ``SelfAttentionLayer`` / ``multiHeadDotProductAttention``)."""
+
+    n_heads: int = 8
+    head_size: Optional[int] = None  # default size/n_heads
+    n_out: Optional[int] = None  # projection output, default = input size
+    with_projection: bool = True
+
+    def output_type(self, input_type: InputType) -> InputType:
+        out = self.n_out or input_type.size
+        return InputType.recurrent(out, input_type.timesteps)
+
+    def init(self, key, input_type, g: GlobalConfig):
+        d_model = input_type.size
+        hs = self.head_size or d_model // self.n_heads
+        inner = self.n_heads * hs
+        out = self.n_out or d_model
+        ks = jax.random.split(key, 4)
+        params = {
+            "W_q": init_weights(ks[0], (d_model, inner), self._winit(g), fan=(d_model, inner), dtype=g.dtype),
+            "W_k": init_weights(ks[1], (d_model, inner), self._winit(g), fan=(d_model, inner), dtype=g.dtype),
+            "W_v": init_weights(ks[2], (d_model, inner), self._winit(g), fan=(d_model, inner), dtype=g.dtype),
+            "b_q": jnp.zeros((inner,), g.dtype or jnp.float32),
+            "b_k": jnp.zeros((inner,), g.dtype or jnp.float32),
+            "b_v": jnp.zeros((inner,), g.dtype or jnp.float32),
+        }
+        if self.with_projection:
+            params["W_o"] = init_weights(ks[3], (inner, out), self._winit(g), fan=(inner, out), dtype=g.dtype)
+            params["b_o"] = jnp.zeros((out,), g.dtype or jnp.float32)
+        return params, {}
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        b, t, _ = x.shape
+        h = self.n_heads
+        q = (x @ params["W_q"] + params["b_q"]).reshape(b, t, h, -1).transpose(0, 2, 1, 3)
+        k = (x @ params["W_k"] + params["b_k"]).reshape(b, t, h, -1).transpose(0, 2, 1, 3)
+        v = (x @ params["W_v"] + params["b_v"]).reshape(b, t, h, -1).transpose(0, 2, 1, 3)
+        attn_mask = None
+        if mask is not None:
+            attn_mask = mask[:, None, None, :].astype(bool)  # key-side padding mask
+        y = dot_product_attention(q, k, v, attn_mask)
+        y = y.transpose(0, 2, 1, 3).reshape(b, t, -1)
+        if self.with_projection:
+            y = y @ params["W_o"] + params["b_o"]
+        return y, state
+
+
+@register_layer
+@dataclasses.dataclass
+class TransformerEncoderBlock(Layer):
+    """Post-LN transformer encoder block (BERT-style): MHA + residual + LN,
+    FFN(gelu) + residual + LN."""
+
+    n_heads: int = 12
+    ffn_size: int = 3072
+    dropout_rate: float = 0.1  # drop probability (transformer convention)
+    layer_norm_eps: float = 1e-12
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def init(self, key, input_type, g: GlobalConfig):
+        d = input_type.size
+        attn = SelfAttentionLayer(n_heads=self.n_heads)
+        attn._g = g
+        ks = jax.random.split(key, 3)
+        attn_params, _ = attn.init(ks[0], input_type, g)
+        f = jnp.float32 if g.dtype is None else g.dtype
+        params = {
+            "attn": attn_params,
+            "ln1_gamma": jnp.ones((d,), f), "ln1_beta": jnp.zeros((d,), f),
+            "ln2_gamma": jnp.ones((d,), f), "ln2_beta": jnp.zeros((d,), f),
+            "W_ff1": init_weights(ks[1], (d, self.ffn_size), self._winit(g), fan=(d, self.ffn_size), dtype=g.dtype),
+            "b_ff1": jnp.zeros((self.ffn_size,), f),
+            "W_ff2": init_weights(ks[2], (self.ffn_size, d), self._winit(g), fan=(self.ffn_size, d), dtype=g.dtype),
+            "b_ff2": jnp.zeros((d,), f),
+        }
+        self._attn = attn
+        return params, {}
+
+    def _dropout_fn(self, x, training, rng):
+        if not training or rng is None or self.dropout_rate <= 0.0:
+            return x
+        keep = 1.0 - self.dropout_rate
+        mask = jax.random.bernoulli(rng, keep, shape=x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        attn = getattr(self, "_attn", None)
+        if attn is None:
+            attn = SelfAttentionLayer(n_heads=self.n_heads)
+            self._attn = attn
+        attn._g = self._g
+        r1, r2 = (jax.random.split(rng) if rng is not None else (None, None))
+        a, _ = attn.forward(params["attn"], {}, x, training=training, rng=None, mask=mask)
+        x = layer_norm(x + self._dropout_fn(a, training, r1),
+                       params["ln1_gamma"], params["ln1_beta"], self.layer_norm_eps)
+        h = get_activation("gelu")(x @ params["W_ff1"] + params["b_ff1"])
+        h = h @ params["W_ff2"] + params["b_ff2"]
+        x = layer_norm(x + self._dropout_fn(h, training, r2),
+                       params["ln2_gamma"], params["ln2_beta"], self.layer_norm_eps)
+        return x, state
+
+    def regularizable_params(self):
+        return ("W_ff1", "W_ff2")
+
+
+@register_layer
+@dataclasses.dataclass
+class LearnedPositionalEmbeddingLayer(Layer):
+    """Adds learned positional embeddings (BERT position table)."""
+
+    max_len: int = 512
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def init(self, key, input_type, g: GlobalConfig):
+        d = input_type.size
+        return {"P": init_weights(key, (self.max_len, d), self._winit(g), fan=(self.max_len, d), dtype=g.dtype)}, {}
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        t = x.shape[1]
+        return x + params["P"][None, :t, :], state

@@ -1,0 +1,176 @@
+"""Layer base class and serde registry.
+
+In the reference every layer is a *pair*: a Jackson-serializable conf class
+(``org.deeplearning4j.nn.conf.layers.*``) and a runtime impl
+(``org.deeplearning4j.nn.layers.*``) with ``activate()`` /
+``backpropGradient()``. Here a layer is ONE dataclass that is both the
+serializable config (``to_dict``/``from_dict`` via a name registry, the
+Jackson-polymorphism analog) and the pure-functional implementation
+(``init``/``forward``); backprop comes from ``jax.grad`` of the composed
+forward, so no hand-written backward passes exist anywhere.
+
+Forward contract (uniform across layers so the network can compose them into
+one traced program):
+
+    y, new_state = layer.forward(params, state, x, training=..., rng=..., mask=...)
+
+- ``params``: dict of trainable arrays ("W", "b", "gamma", ...). Keys starting
+  with "W" or "gamma"-free weight keys are subject to l1/l2 (see
+  ``regularizable_params``).
+- ``state``:  dict of non-trainable arrays (batch-norm running stats).
+- ``rng``:    PRNG key, only consumed when the layer is stochastic + training.
+- ``mask``:   optional (batch, time) validity mask for sequence data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple, Type
+
+import jax
+
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.initializers import WeightInit
+
+_LAYER_REGISTRY: Dict[str, Type["Layer"]] = {}
+
+
+def register_layer(cls: Type["Layer"]) -> Type["Layer"]:
+    """Class decorator: registers the layer under its class name for serde
+    (the Jackson-polymorphic-type analog)."""
+    _LAYER_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def get_layer_class(name: str) -> Type["Layer"]:
+    if name not in _LAYER_REGISTRY:
+        raise KeyError(f"Unknown layer type {name!r}; registered: {sorted(_LAYER_REGISTRY)}")
+    return _LAYER_REGISTRY[name]
+
+
+@dataclasses.dataclass
+class GlobalConfig:
+    """Network-wide defaults that layers inherit when their own field is None.
+
+    Mirrors the fields configured on the outer ``NeuralNetConfiguration.Builder``
+    in the reference (seed, weightInit, activation, l1/l2, dropout, ...).
+    """
+
+    seed: int = 0
+    weight_init: WeightInit = WeightInit.XAVIER
+    activation: Any = Activation.IDENTITY
+    l1: float = 0.0
+    l2: float = 0.0
+    weight_decay: float = 0.0
+    dropout: Optional[float] = None  # retain probability, DL4J convention
+    bias_init: float = 0.0
+    updater: Any = None  # train.updaters.Updater; resolved by the training engine
+    gradient_normalization: Optional[str] = None
+    gradient_normalization_threshold: float = 1.0
+    dtype: Any = None  # resolved against runtime Environment
+
+
+@dataclasses.dataclass
+class Layer:
+    """Base layer config. Subclasses add fields and override the four methods.
+
+    Fields that default to ``None`` inherit from :class:`GlobalConfig` at
+    build time (the reference's conf-inheritance or "layer overrides global
+    builder" behaviour).
+    """
+
+    name: Optional[str] = None
+    activation: Any = None
+    weight_init: Any = None
+    bias_init: Optional[float] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    weight_decay: Optional[float] = None
+    dropout: Optional[float] = None  # retain probability applied to layer INPUT
+    updater: Any = None
+    frozen: bool = False  # transfer-learning: exclude params from training
+    # GlobalConfig attached by the network at build time (not serialized) so
+    # forward() needs no extra argument.
+    _g: Any = dataclasses.field(default=None, repr=False, compare=False)
+
+    # ---- shape inference ----
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    # ---- parameters ----
+    def init(self, key: jax.Array, input_type: InputType, g: GlobalConfig
+             ) -> Tuple[Dict[str, jax.Array], Dict[str, jax.Array]]:
+        """Return (params, state). Default: parameterless layer."""
+        return {}, {}
+
+    def forward(self, params: Dict, state: Dict, x, *, training: bool = False,
+                rng: Optional[jax.Array] = None, mask=None) -> Tuple[Any, Dict]:
+        raise NotImplementedError
+
+    # ---- regularization ----
+    def regularizable_params(self) -> Tuple[str, ...]:
+        """Param keys subject to l1/l2/weight-decay (weights, not biases —
+        the reference's default regularization split)."""
+        return ("W", "W_rec", "W_point", "W_depth", "W_q", "W_k", "W_v", "W_o")
+
+    # ---- inherited-field resolution ----
+    def _act(self, g: GlobalConfig):
+        return self.activation if self.activation is not None else g.activation
+
+    def _winit(self, g: GlobalConfig):
+        return self.weight_init if self.weight_init is not None else g.weight_init
+
+    def _binit(self, g: GlobalConfig) -> float:
+        return self.bias_init if self.bias_init is not None else g.bias_init
+
+    def _dropout(self, g: GlobalConfig):
+        return self.dropout if self.dropout is not None else g.dropout
+
+    def _apply_input_dropout(self, x, g: GlobalConfig, training: bool, rng):
+        """DL4J semantics: ``dropOut(p)`` on a layer drops the layer's INPUT
+        with retain probability p, inverted scaling."""
+        p = self._dropout(g)
+        if not training or p is None or p >= 1.0 or rng is None:
+            return x
+        keep = jax.random.bernoulli(rng, p, shape=x.shape)
+        return jax.numpy.where(keep, x / p, 0.0).astype(x.dtype)
+
+    # ---- serde ----
+    def to_dict(self) -> dict:
+        d = {"@type": type(self).__name__}
+        for f in dataclasses.fields(self):
+            if f.name.startswith("_"):
+                continue
+            v = getattr(self, f.name)
+            if v is None or v == f.default:
+                continue
+            if isinstance(v, (Activation, WeightInit)):
+                v = v.value
+            elif dataclasses.is_dataclass(v) and not isinstance(v, type):
+                v = v.to_dict() if hasattr(v, "to_dict") else dataclasses.asdict(v)
+            elif hasattr(v, "to_dict"):
+                v = v.to_dict()
+            d[f.name] = v
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Layer":
+        d = dict(d)
+        typ = d.pop("@type", cls.__name__)
+        target = get_layer_class(typ)
+        field_names = {f.name for f in dataclasses.fields(target)}
+        kwargs = {}
+        for k, v in d.items():
+            if k not in field_names:
+                continue
+            if k == "updater" and isinstance(v, dict):
+                from deeplearning4j_tpu.train.updaters import Updater
+                v = Updater.from_dict(v)
+            kwargs[k] = v
+        return target(**kwargs)
+
+
+def spectral_key(key: jax.Array, i: int) -> jax.Array:
+    """Deterministic per-index subkey (used to give each layer its own stream)."""
+    return jax.random.fold_in(key, i)

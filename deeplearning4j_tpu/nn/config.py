@@ -1,0 +1,292 @@
+"""Network configuration builder.
+
+Rebuild of upstream ``org.deeplearning4j.nn.conf.NeuralNetConfiguration`` /
+``MultiLayerConfiguration``: fluent builder DSL producing a JSON-serializable
+config tree ("configs are data" — the property that powers serialization,
+hyperparameter search spaces, and the UI in the reference). Usage::
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(123)
+            .updater(Adam(1e-3))
+            .weight_init(WeightInit.XAVIER)
+            .list()
+            .layer(ConvolutionLayer(n_out=20, kernel_size=(5, 5), activation="relu"))
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=500, activation="relu"))
+            .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(28, 28, 1))
+            .build())
+
+Shape inference + automatic ``InputPreProcessor`` insertion happen at
+``build()``, as in the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional
+
+from deeplearning4j_tpu.nn.base import GlobalConfig, Layer
+from deeplearning4j_tpu.nn.conv_layers import (
+    BatchNormalization,
+    ConvolutionLayer,
+    Convolution1DLayer,
+    Deconvolution2D,
+    GlobalPoolingLayer,
+    LocalResponseNormalization,
+    SeparableConvolution2D,
+    SpaceToDepthLayer,
+    SubsamplingLayer,
+    Upsampling2D,
+    ZeroPaddingLayer,
+)
+from deeplearning4j_tpu.nn.core_layers import (
+    ActivationLayer,
+    DenseLayer,
+    DropoutLayer,
+    EmbeddingLayer,
+    EmbeddingSequenceLayer,
+)
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.preprocessors import (
+    CnnToFeedForwardPreProcessor,
+    FeedForwardToCnnPreProcessor,
+    InputPreProcessor,
+)
+from deeplearning4j_tpu.nn.recurrent_layers import BaseRecurrentLayer, Bidirectional, RnnOutputLayer
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.initializers import WeightInit
+
+_CONV_LAYERS = (ConvolutionLayer, SubsamplingLayer, Upsampling2D, ZeroPaddingLayer,
+                SpaceToDepthLayer, LocalResponseNormalization, Deconvolution2D,
+                SeparableConvolution2D)
+_ANY_LAYERS = (BatchNormalization, ActivationLayer, DropoutLayer, GlobalPoolingLayer)
+
+
+def _expects(layer: Layer) -> Optional[str]:
+    """What input kind a layer needs; None = accepts anything as-is."""
+    if isinstance(layer, (Convolution1DLayer,)):
+        return "recurrent"
+    if isinstance(layer, _CONV_LAYERS):
+        return "convolutional"
+    if isinstance(layer, _ANY_LAYERS):
+        return None
+    if isinstance(layer, (BaseRecurrentLayer, Bidirectional, RnnOutputLayer)):
+        return "recurrent"
+    if isinstance(layer, (EmbeddingLayer, EmbeddingSequenceLayer)):
+        return None  # integer index inputs; no reshape applies
+    if isinstance(layer, DenseLayer):
+        return "feedforward_or_recurrent"
+    return None
+
+
+class NeuralNetConfiguration:
+    """Entry point: ``NeuralNetConfiguration.builder()``."""
+
+    @staticmethod
+    def builder() -> "Builder":
+        return Builder()
+
+
+class Builder:
+    def __init__(self):
+        self._g = GlobalConfig()
+
+    # fluent global defaults (names mirror the reference builder)
+    def seed(self, s: int) -> "Builder":
+        self._g.seed = int(s)
+        return self
+
+    def weight_init(self, wi) -> "Builder":
+        self._g.weight_init = WeightInit(wi) if not isinstance(wi, WeightInit) else wi
+        return self
+
+    def activation(self, a) -> "Builder":
+        self._g.activation = a
+        return self
+
+    def updater(self, u) -> "Builder":
+        self._g.updater = u
+        return self
+
+    def l1(self, v: float) -> "Builder":
+        self._g.l1 = float(v)
+        return self
+
+    def l2(self, v: float) -> "Builder":
+        self._g.l2 = float(v)
+        return self
+
+    def weight_decay(self, v: float) -> "Builder":
+        self._g.weight_decay = float(v)
+        return self
+
+    def dropout(self, retain_prob: float) -> "Builder":
+        self._g.dropout = float(retain_prob)
+        return self
+
+    def bias_init(self, v: float) -> "Builder":
+        self._g.bias_init = float(v)
+        return self
+
+    def gradient_normalization(self, kind: str, threshold: float = 1.0) -> "Builder":
+        self._g.gradient_normalization = kind
+        self._g.gradient_normalization_threshold = float(threshold)
+        return self
+
+    def dtype(self, dt) -> "Builder":
+        self._g.dtype = dt
+        return self
+
+    def list(self) -> "ListBuilder":
+        return ListBuilder(self._g)
+
+    def graph_builder(self):
+        from deeplearning4j_tpu.models.computation_graph import GraphBuilder
+        return GraphBuilder(self._g)
+
+
+class ListBuilder:
+    def __init__(self, g: GlobalConfig):
+        self._g = g
+        self._layers: List[Layer] = []
+        self._input_type: Optional[InputType] = None
+        self._preprocessors: Dict[int, InputPreProcessor] = {}
+        self._tbptt_fwd: Optional[int] = None
+        self._tbptt_back: Optional[int] = None
+
+    def layer(self, *args) -> "ListBuilder":
+        """``layer(l)`` appends; ``layer(i, l)`` sets index i (reference API)."""
+        if len(args) == 1:
+            self._layers.append(args[0])
+        else:
+            i, l = args
+            while len(self._layers) <= i:
+                self._layers.append(None)
+            self._layers[i] = l
+        return self
+
+    def set_input_type(self, it: InputType) -> "ListBuilder":
+        self._input_type = it
+        return self
+
+    def input_pre_processor(self, index: int, pp: InputPreProcessor) -> "ListBuilder":
+        self._preprocessors[int(index)] = pp
+        return self
+
+    def tbptt_fwd_length(self, n: int) -> "ListBuilder":
+        self._tbptt_fwd = int(n)
+        return self
+
+    def tbptt_back_length(self, n: int) -> "ListBuilder":
+        self._tbptt_back = int(n)
+        return self
+
+    def build(self) -> "MultiLayerConfiguration":
+        layers = [l for l in self._layers if l is not None]
+        if not layers:
+            raise ValueError("No layers configured")
+        conf = MultiLayerConfiguration(
+            global_conf=self._g, layers=layers, input_type=self._input_type,
+            preprocessors=dict(self._preprocessors),
+            tbptt_fwd_length=self._tbptt_fwd, tbptt_back_length=self._tbptt_back)
+        conf._infer_shapes()
+        return conf
+
+
+@dataclasses.dataclass
+class MultiLayerConfiguration:
+    global_conf: GlobalConfig
+    layers: List[Layer]
+    input_type: Optional[InputType] = None
+    preprocessors: Dict[int, InputPreProcessor] = dataclasses.field(default_factory=dict)
+    tbptt_fwd_length: Optional[int] = None
+    tbptt_back_length: Optional[int] = None
+    # computed by _infer_shapes: input type FED TO each layer (post-preprocessor)
+    layer_input_types: List[InputType] = dataclasses.field(default_factory=list)
+
+    def _infer_shapes(self) -> None:
+        """Walk the stack once: auto-insert preprocessors on InputType
+        mismatches and record each layer's input type (reference:
+        ``MultiLayerConfiguration`` + ``InputType.getPreProcessorForInputType``)."""
+        self.layer_input_types = []
+        cur = self.input_type
+        for i, layer in enumerate(self.layers):
+            if cur is not None and i not in self.preprocessors:
+                pp = self._auto_preprocessor(cur, layer)
+                if pp is not None:
+                    self.preprocessors[i] = pp
+            if i in self.preprocessors and cur is not None:
+                cur = self.preprocessors[i].output_type(cur)
+            self.layer_input_types.append(cur)
+            if cur is not None:
+                cur = layer.output_type(cur)
+        self.output_type = cur
+
+    @staticmethod
+    def _auto_preprocessor(cur: InputType, layer: Layer) -> Optional[InputPreProcessor]:
+        need = _expects(layer)
+        if need is None:
+            return None
+        if need == "convolutional" and cur.kind == "convolutional_flat":
+            return FeedForwardToCnnPreProcessor(cur.height, cur.width, cur.channels)
+        if need in ("feedforward_or_recurrent",) and cur.kind == "convolutional":
+            return CnnToFeedForwardPreProcessor(cur.height, cur.width, cur.channels)
+        if need == "convolutional" and cur.kind == "feedforward":
+            raise ValueError(
+                "Cannot infer image shape for conv layer from flat feed-forward input; "
+                "use InputType.convolutional_flat(h, w, c)")
+        return None
+
+    # ---- serde (reference: MultiLayerConfiguration.toJson/fromJson) ----
+    def to_dict(self) -> dict:
+        g = dataclasses.asdict(self.global_conf)
+        if self.global_conf.updater is not None and hasattr(self.global_conf.updater, "to_dict"):
+            g["updater"] = self.global_conf.updater.to_dict()
+        for k in ("weight_init", "activation"):
+            if isinstance(g.get(k), (WeightInit, Activation)):
+                g[k] = g[k].value
+        if g.get("dtype") is not None:
+            import jax.numpy as jnp
+            g["dtype"] = jnp.dtype(g["dtype"]).name
+        return {
+            "global_conf": g,
+            "layers": [l.to_dict() for l in self.layers],
+            "input_type": self.input_type.to_dict() if self.input_type else None,
+            "preprocessors": {str(k): v.to_dict() for k, v in self.preprocessors.items()},
+            "tbptt_fwd_length": self.tbptt_fwd_length,
+            "tbptt_back_length": self.tbptt_back_length,
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    @staticmethod
+    def from_dict(d: dict) -> "MultiLayerConfiguration":
+        g_d = dict(d["global_conf"])
+        if isinstance(g_d.get("updater"), dict):
+            from deeplearning4j_tpu.train.updaters import Updater
+            g_d["updater"] = Updater.from_dict(g_d["updater"])
+        if g_d.get("weight_init"):
+            g_d["weight_init"] = WeightInit(g_d["weight_init"])
+        if isinstance(g_d.get("dtype"), str):
+            import jax.numpy as jnp
+            g_d["dtype"] = jnp.dtype(g_d["dtype"]).type
+        g = GlobalConfig(**{k: v for k, v in g_d.items()
+                            if k in {f.name for f in dataclasses.fields(GlobalConfig)}})
+        conf = MultiLayerConfiguration(
+            global_conf=g,
+            layers=[Layer.from_dict(ld) for ld in d["layers"]],
+            input_type=InputType.from_dict(d["input_type"]) if d.get("input_type") else None,
+            preprocessors={int(k): InputPreProcessor.from_dict(v)
+                           for k, v in (d.get("preprocessors") or {}).items()},
+            tbptt_fwd_length=d.get("tbptt_fwd_length"),
+            tbptt_back_length=d.get("tbptt_back_length"),
+        )
+        conf._infer_shapes()
+        return conf
+
+    @staticmethod
+    def from_json(s: str) -> "MultiLayerConfiguration":
+        return MultiLayerConfiguration.from_dict(json.loads(s))

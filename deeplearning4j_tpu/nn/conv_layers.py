@@ -1,0 +1,409 @@
+"""Convolutional / normalization / pooling layers.
+
+Rebuild of upstream ``org.deeplearning4j.nn.conf.layers`` CNN set
+(``ConvolutionLayer``, ``SubsamplingLayer``, ``BatchNormalization``,
+``LocalResponseNormalization``, ``Upsampling2D``, ``ZeroPaddingLayer``,
+``SeparableConvolution2D``, ``Deconvolution2D``, ``SpaceToDepthLayer``,
+``GlobalPoolingLayer``) on XLA's native conv emitters — the TPU replacement
+for the reference's cuDNN helper classes (``CudnnConvolutionHelper`` etc.).
+
+Layout: NHWC activations, HWIO kernels (TPU-native; reference is NCHW).
+Convolution mode: DL4J's ``ConvolutionMode.Truncate`` ≙ padding "VALID" with
+explicit pad, ``Same`` ≙ "SAME".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.base import GlobalConfig, Layer, register_layer
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.ops.activations import get_activation
+from deeplearning4j_tpu.ops.initializers import init_weights
+
+_DIMNUMS = ("NHWC", "HWIO", "NHWC")
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+def _out_size(size: int, k: int, s: int, p: int, same: bool, dilation: int = 1) -> int:
+    if same:
+        return -(-size // s)  # ceil
+    eff = (k - 1) * dilation + 1
+    return (size + 2 * p - eff) // s + 1
+
+
+class PoolingType(str, enum.Enum):
+    MAX = "max"
+    AVG = "avg"
+    SUM = "sum"
+    PNORM = "pnorm"
+
+
+@register_layer
+@dataclasses.dataclass
+class ConvolutionLayer(Layer):
+    """2-D convolution. Kernel HWIO (kh, kw, in, out)."""
+
+    n_out: int = 0
+    kernel_size: Any = (3, 3)
+    stride: Any = (1, 1)
+    padding: Any = (0, 0)
+    dilation: Any = (1, 1)
+    convolution_mode: str = "truncate"  # "truncate" | "same"
+    has_bias: bool = True
+
+    def _geom(self):
+        return (_pair(self.kernel_size), _pair(self.stride), _pair(self.padding),
+                _pair(self.dilation), self.convolution_mode.lower() == "same")
+
+    def output_type(self, input_type: InputType) -> InputType:
+        (kh, kw), (sh, sw), (ph, pw), (dh, dw), same = self._geom()
+        h = _out_size(input_type.height, kh, sh, ph, same, dh)
+        w = _out_size(input_type.width, kw, sw, pw, same, dw)
+        return InputType.convolutional(h, w, self.n_out)
+
+    def init(self, key, input_type, g: GlobalConfig):
+        (kh, kw), _, _, _, _ = self._geom()
+        c_in = input_type.channels
+        fan_in = kh * kw * c_in
+        fan_out = kh * kw * self.n_out
+        params = {"W": init_weights(key, (kh, kw, c_in, self.n_out), self._winit(g),
+                                    fan=(fan_in, fan_out), dtype=g.dtype)}
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self._binit(g), dtype=g.dtype)
+        return params, {}
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        x = self._apply_input_dropout(x, self._g, training, rng)
+        (kh, kw), (sh, sw), (ph, pw), (dh, dw), same = self._geom()
+        pad = "SAME" if same else [(ph, ph), (pw, pw)]
+        y = lax.conv_general_dilated(
+            x, params["W"], window_strides=(sh, sw), padding=pad,
+            rhs_dilation=(dh, dw), dimension_numbers=_DIMNUMS)
+        if self.has_bias:
+            y = y + params["b"]
+        return get_activation(self._act(self._g))(y), state
+
+
+@register_layer
+@dataclasses.dataclass
+class Convolution1DLayer(ConvolutionLayer):
+    """1-D convolution over (batch, time, features) via a width-1 2-D conv."""
+
+    kernel_size: Any = 3
+    stride: Any = 1
+    padding: Any = 0
+    dilation: Any = 1
+
+    def _geom1d(self):
+        k = self.kernel_size[0] if isinstance(self.kernel_size, (tuple, list)) else self.kernel_size
+        s = self.stride[0] if isinstance(self.stride, (tuple, list)) else self.stride
+        p = self.padding[0] if isinstance(self.padding, (tuple, list)) else self.padding
+        d = self.dilation[0] if isinstance(self.dilation, (tuple, list)) else self.dilation
+        return int(k), int(s), int(p), int(d), self.convolution_mode.lower() == "same"
+
+    def output_type(self, input_type: InputType) -> InputType:
+        k, s, p, d, same = self._geom1d()
+        t = input_type.timesteps
+        t_out = None if t is None else _out_size(t, k, s, p, same, d)
+        return InputType.recurrent(self.n_out, t_out)
+
+    def init(self, key, input_type, g: GlobalConfig):
+        k, _, _, _, _ = self._geom1d()
+        c_in = input_type.size
+        params = {"W": init_weights(key, (k, 1, c_in, self.n_out), self._winit(g),
+                                    fan=(k * c_in, k * self.n_out), dtype=g.dtype)}
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self._binit(g), dtype=g.dtype)
+        return params, {}
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        x = self._apply_input_dropout(x, self._g, training, rng)
+        k, s, p, d, same = self._geom1d()
+        pad = "SAME" if same else [(p, p), (0, 0)]
+        y = lax.conv_general_dilated(
+            x[:, :, None, :], params["W"], window_strides=(s, 1), padding=pad,
+            rhs_dilation=(d, 1), dimension_numbers=_DIMNUMS)[:, :, 0, :]
+        if self.has_bias:
+            y = y + params["b"]
+        return get_activation(self._act(self._g))(y), state
+
+
+@register_layer
+@dataclasses.dataclass
+class SubsamplingLayer(Layer):
+    """Pooling (reference ``SubsamplingLayer``): max / avg / sum / p-norm."""
+
+    pooling_type: Any = PoolingType.MAX
+    kernel_size: Any = (2, 2)
+    stride: Any = (2, 2)
+    padding: Any = (0, 0)
+    convolution_mode: str = "truncate"
+    pnorm: int = 2
+
+    def output_type(self, input_type: InputType) -> InputType:
+        (kh, kw), (sh, sw), (ph, pw) = _pair(self.kernel_size), _pair(self.stride), _pair(self.padding)
+        same = self.convolution_mode.lower() == "same"
+        h = _out_size(input_type.height, kh, sh, ph, same)
+        w = _out_size(input_type.width, kw, sw, pw, same)
+        return InputType.convolutional(h, w, input_type.channels)
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        (kh, kw), (sh, sw), (ph, pw) = _pair(self.kernel_size), _pair(self.stride), _pair(self.padding)
+        same = self.convolution_mode.lower() == "same"
+        dims, strides = (1, kh, kw, 1), (1, sh, sw, 1)
+        pad = "SAME" if same else [(0, 0), (ph, ph), (pw, pw), (0, 0)]
+        pt = PoolingType(self.pooling_type)
+        if pt == PoolingType.MAX:
+            y = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pad)
+        elif pt == PoolingType.SUM:
+            y = lax.reduce_window(x, 0.0, lax.add, dims, strides, pad)
+        elif pt == PoolingType.AVG:
+            y = lax.reduce_window(x, 0.0, lax.add, dims, strides, pad)
+            ones = jnp.ones_like(x)
+            counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pad)
+            y = y / counts
+        else:  # PNORM
+            p = float(self.pnorm)
+            y = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, dims, strides, pad) ** (1.0 / p)
+        return y, state
+
+
+@register_layer
+@dataclasses.dataclass
+class BatchNormalization(Layer):
+    """Batch norm (reference ``BatchNormalization``): per-channel (last axis)
+    stats; running stats in ``state`` updated with ``decay`` momentum."""
+
+    decay: float = 0.9
+    eps: float = 1e-5
+    lock_gamma_beta: bool = False
+    use_gamma_beta: bool = True
+
+    def _nchan(self, input_type: InputType) -> int:
+        return input_type.channels if input_type.kind == "convolutional" else input_type.flat_size()
+
+    def init(self, key, input_type, g: GlobalConfig):
+        n = self._nchan(input_type)
+        params = {}
+        if self.use_gamma_beta and not self.lock_gamma_beta:
+            params = {"gamma": jnp.ones((n,), g.dtype or jnp.float32),
+                      "beta": jnp.zeros((n,), g.dtype or jnp.float32)}
+        state = {"mean": jnp.zeros((n,), jnp.float32), "var": jnp.ones((n,), jnp.float32)}
+        return params, state
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        axes = tuple(range(x.ndim - 1))
+        if training:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            new_state = {
+                "mean": self.decay * state["mean"] + (1 - self.decay) * mean.astype(jnp.float32),
+                "var": self.decay * state["var"] + (1 - self.decay) * var.astype(jnp.float32),
+            }
+        else:
+            mean, var = state["mean"].astype(x.dtype), state["var"].astype(x.dtype)
+            new_state = state
+        y = (x - mean) * lax.rsqrt(var.astype(x.dtype) + self.eps)
+        if "gamma" in params:
+            y = y * params["gamma"] + params["beta"]
+        return get_activation(self._act(self._g))(y), new_state
+
+    def regularizable_params(self):
+        return ()  # gamma/beta are never l1/l2-regularized in the reference
+
+
+@register_layer
+@dataclasses.dataclass
+class LocalResponseNormalization(Layer):
+    """LRN across channels (reference ``LocalResponseNormalization``)."""
+
+    k: float = 2.0
+    n: int = 5
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        half = self.n // 2
+        sq = x * x
+        # sum over a window of channels via padded cumulative trick
+        pads = [(0, 0)] * (x.ndim - 1) + [(half, half)]
+        padded = jnp.pad(sq, pads)
+        win = sum(lax.slice_in_dim(padded, i, i + x.shape[-1], axis=x.ndim - 1)
+                  for i in range(self.n))
+        return x / ((self.k + self.alpha * win) ** self.beta), state
+
+
+@register_layer
+@dataclasses.dataclass
+class Upsampling2D(Layer):
+    """Nearest-neighbour upsampling (reference ``Upsampling2D``)."""
+
+    size: Any = (2, 2)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        sh, sw = _pair(self.size)
+        return InputType.convolutional(input_type.height * sh, input_type.width * sw,
+                                       input_type.channels)
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        sh, sw = _pair(self.size)
+        return jnp.repeat(jnp.repeat(x, sh, axis=1), sw, axis=2), state
+
+
+@register_layer
+@dataclasses.dataclass
+class ZeroPaddingLayer(Layer):
+    """Spatial zero padding (reference ``ZeroPaddingLayer``)."""
+
+    padding: Any = (1, 1)  # (ph, pw) or ((top,bottom),(left,right))
+
+    def _pads(self):
+        p = self.padding
+        if isinstance(p, (tuple, list)) and len(p) == 2 and isinstance(p[0], (tuple, list)):
+            return tuple(p[0]), tuple(p[1])
+        ph, pw = _pair(p)
+        return (ph, ph), (pw, pw)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        (pt, pb), (pl, pr) = self._pads()
+        return InputType.convolutional(input_type.height + pt + pb,
+                                       input_type.width + pl + pr, input_type.channels)
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        (pt, pb), (pl, pr) = self._pads()
+        return jnp.pad(x, [(0, 0), (pt, pb), (pl, pr), (0, 0)]), state
+
+
+@register_layer
+@dataclasses.dataclass
+class SeparableConvolution2D(ConvolutionLayer):
+    """Depthwise-separable conv (reference ``SeparableConvolution2D``):
+    depthwise (feature_group_count) then 1x1 pointwise."""
+
+    depth_multiplier: int = 1
+
+    def init(self, key, input_type, g: GlobalConfig):
+        (kh, kw), _, _, _, _ = self._geom()
+        c_in = input_type.channels
+        k1, k2 = jax.random.split(key)
+        dm = self.depth_multiplier
+        params = {
+            "W_depth": init_weights(k1, (kh, kw, 1, c_in * dm), self._winit(g),
+                                    fan=(kh * kw, kh * kw * dm), dtype=g.dtype),
+            "W_point": init_weights(k2, (1, 1, c_in * dm, self.n_out), self._winit(g),
+                                    fan=(c_in * dm, self.n_out), dtype=g.dtype),
+        }
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self._binit(g), dtype=g.dtype)
+        return params, {}
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        x = self._apply_input_dropout(x, self._g, training, rng)
+        (kh, kw), (sh, sw), (ph, pw), (dh, dw), same = self._geom()
+        pad = "SAME" if same else [(ph, ph), (pw, pw)]
+        c_in = x.shape[-1]
+        y = lax.conv_general_dilated(
+            x, params["W_depth"], window_strides=(sh, sw), padding=pad,
+            rhs_dilation=(dh, dw), dimension_numbers=_DIMNUMS,
+            feature_group_count=c_in)
+        y = lax.conv_general_dilated(
+            y, params["W_point"], window_strides=(1, 1), padding="VALID",
+            dimension_numbers=_DIMNUMS)
+        if self.has_bias:
+            y = y + params["b"]
+        return get_activation(self._act(self._g))(y), state
+
+
+@register_layer
+@dataclasses.dataclass
+class Deconvolution2D(ConvolutionLayer):
+    """Transposed convolution (reference ``Deconvolution2D``)."""
+
+    def output_type(self, input_type: InputType) -> InputType:
+        (kh, kw), (sh, sw), (ph, pw), (dh, dw), same = self._geom()
+        if same:
+            h, w = input_type.height * sh, input_type.width * sw
+        else:
+            h = sh * (input_type.height - 1) + kh - 2 * ph
+            w = sw * (input_type.width - 1) + kw - 2 * pw
+        return InputType.convolutional(h, w, self.n_out)
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        x = self._apply_input_dropout(x, self._g, training, rng)
+        (kh, kw), (sh, sw), (ph, pw), _, same = self._geom()
+        pad = "SAME" if same else [(kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw)]
+        y = lax.conv_transpose(x, params["W"], strides=(sh, sw), padding=pad,
+                               dimension_numbers=_DIMNUMS)
+        if self.has_bias:
+            y = y + params["b"]
+        return get_activation(self._act(self._g))(y), state
+
+
+@register_layer
+@dataclasses.dataclass
+class SpaceToDepthLayer(Layer):
+    """Space-to-depth (reference ``SpaceToDepthLayer``)."""
+
+    block_size: int = 2
+
+    def output_type(self, input_type: InputType) -> InputType:
+        b = self.block_size
+        return InputType.convolutional(input_type.height // b, input_type.width // b,
+                                       input_type.channels * b * b)
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        n, h, w, c = x.shape
+        b = self.block_size
+        y = x.reshape(n, h // b, b, w // b, b, c).transpose(0, 1, 3, 2, 4, 5)
+        return y.reshape(n, h // b, w // b, c * b * b), state
+
+
+@register_layer
+@dataclasses.dataclass
+class GlobalPoolingLayer(Layer):
+    """Global pooling over spatial or time dims (reference
+    ``GlobalPoolingLayer``); mask-aware for sequences."""
+
+    pooling_type: Any = PoolingType.MAX
+    pnorm: int = 2
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if input_type.kind == "recurrent":
+            return InputType.feed_forward(input_type.size)
+        return InputType.feed_forward(input_type.channels)
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        axes = (1,) if x.ndim == 3 else (1, 2)
+        pt = PoolingType(self.pooling_type)
+        if x.ndim == 3 and mask is not None:
+            m = mask[..., None].astype(x.dtype)
+            if pt == PoolingType.MAX:
+                y = jnp.max(jnp.where(m > 0, x, -jnp.inf), axis=1)
+            elif pt == PoolingType.SUM:
+                y = jnp.sum(x * m, axis=1)
+            elif pt == PoolingType.AVG:
+                y = jnp.sum(x * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)
+            else:
+                p = float(self.pnorm)
+                y = jnp.sum((jnp.abs(x) ** p) * m, axis=1) ** (1.0 / p)
+            return y, state
+        if pt == PoolingType.MAX:
+            return jnp.max(x, axis=axes), state
+        if pt == PoolingType.SUM:
+            return jnp.sum(x, axis=axes), state
+        if pt == PoolingType.AVG:
+            return jnp.mean(x, axis=axes), state
+        p = float(self.pnorm)
+        return jnp.sum(jnp.abs(x) ** p, axis=axes) ** (1.0 / p), state

@@ -1,0 +1,191 @@
+"""Graph vertices for ComputationGraph.
+
+Rebuild of upstream ``org.deeplearning4j.nn.conf.graph.*``: ``MergeVertex``,
+``ElementWiseVertex`` (Add/Product/Subtract/Average/Max), ``SubsetVertex``,
+``StackVertex``/``UnstackVertex``, ``ScaleVertex``/``ShiftVertex``,
+``L2NormalizeVertex``, ``PreprocessorVertex``, ``ReshapeVertex``. Pure
+functions of their inputs; XLA fuses them into the surrounding program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple, Type
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.preprocessors import InputPreProcessor
+
+_VERTEX_REGISTRY: Dict[str, Type["GraphVertex"]] = {}
+
+
+def register_vertex(cls):
+    _VERTEX_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+@dataclasses.dataclass
+class GraphVertex:
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def output_type(self, *input_types: InputType) -> InputType:
+        return input_types[0]
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["@type"] = type(self).__name__
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "GraphVertex":
+        d = dict(d)
+        cls = _VERTEX_REGISTRY[d.pop("@type")]
+        if cls is PreprocessorVertex and isinstance(d.get("preprocessor"), dict):
+            d["preprocessor"] = InputPreProcessor.from_dict(d["preprocessor"])
+        return cls(**d)
+
+
+@register_vertex
+@dataclasses.dataclass
+class MergeVertex(GraphVertex):
+    """Concatenate along the feature (last) axis."""
+
+    def forward(self, *inputs):
+        return jnp.concatenate(inputs, axis=-1)
+
+    def output_type(self, *its: InputType) -> InputType:
+        it = its[0]
+        if it.kind == "convolutional":
+            return InputType.convolutional(it.height, it.width,
+                                           sum(i.channels for i in its))
+        if it.kind == "recurrent":
+            return InputType.recurrent(sum(i.size for i in its), it.timesteps)
+        return InputType.feed_forward(sum(i.flat_size() for i in its))
+
+
+@register_vertex
+@dataclasses.dataclass
+class ElementWiseVertex(GraphVertex):
+    """Pointwise combine: Add / Product / Subtract / Average / Max."""
+
+    op: str = "add"
+
+    def forward(self, *inputs):
+        op = self.op.lower()
+        if op == "add":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out + x
+            return out
+        if op in ("product", "mul"):
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out * x
+            return out
+        if op == "subtract":
+            return inputs[0] - inputs[1]
+        if op in ("average", "avg"):
+            return sum(inputs) / len(inputs)
+        if op == "max":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = jnp.maximum(out, x)
+            return out
+        raise ValueError(f"Unknown elementwise op {self.op!r}")
+
+
+@register_vertex
+@dataclasses.dataclass
+class SubsetVertex(GraphVertex):
+    """Feature-axis slice [from_idx, to_idx] inclusive (reference semantics)."""
+
+    from_idx: int = 0
+    to_idx: int = 0
+
+    def forward(self, *inputs):
+        return inputs[0][..., self.from_idx:self.to_idx + 1]
+
+    def output_type(self, *its: InputType) -> InputType:
+        n = self.to_idx - self.from_idx + 1
+        it = its[0]
+        if it.kind == "recurrent":
+            return InputType.recurrent(n, it.timesteps)
+        return InputType.feed_forward(n)
+
+
+@register_vertex
+@dataclasses.dataclass
+class StackVertex(GraphVertex):
+    """Stack along batch axis (reference ``StackVertex``)."""
+
+    def forward(self, *inputs):
+        return jnp.concatenate(inputs, axis=0)
+
+
+@register_vertex
+@dataclasses.dataclass
+class UnstackVertex(GraphVertex):
+    """Take the i-th of n equal batch-axis chunks."""
+
+    from_idx: int = 0
+    stack_size: int = 1
+
+    def forward(self, *inputs):
+        x = inputs[0]
+        n = x.shape[0] // self.stack_size
+        return x[self.from_idx * n:(self.from_idx + 1) * n]
+
+
+@register_vertex
+@dataclasses.dataclass
+class ScaleVertex(GraphVertex):
+    scale: float = 1.0
+
+    def forward(self, *inputs):
+        return inputs[0] * self.scale
+
+
+@register_vertex
+@dataclasses.dataclass
+class ShiftVertex(GraphVertex):
+    shift: float = 0.0
+
+    def forward(self, *inputs):
+        return inputs[0] + self.shift
+
+
+@register_vertex
+@dataclasses.dataclass
+class L2NormalizeVertex(GraphVertex):
+    eps: float = 1e-8
+
+    def forward(self, *inputs):
+        x = inputs[0]
+        norm = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+        return x / (norm + self.eps)
+
+
+@register_vertex
+@dataclasses.dataclass
+class PreprocessorVertex(GraphVertex):
+    preprocessor: InputPreProcessor = None
+
+    def forward(self, *inputs):
+        return self.preprocessor.pre_process(inputs[0])
+
+    def output_type(self, *its: InputType) -> InputType:
+        return self.preprocessor.output_type(its[0])
+
+    def to_dict(self) -> dict:
+        return {"@type": "PreprocessorVertex", "preprocessor": self.preprocessor.to_dict()}
+
+
+@register_vertex
+@dataclasses.dataclass
+class ReshapeVertex(GraphVertex):
+    shape: Tuple[int, ...] = ()
+
+    def forward(self, *inputs):
+        return inputs[0].reshape((inputs[0].shape[0],) + tuple(self.shape))

@@ -1,0 +1,131 @@
+"""Input pre-processors: shape adapters auto-inserted between layers.
+
+Rebuild of upstream ``org.deeplearning4j.nn.conf.preprocessor`` —
+``CnnToFeedForwardPreProcessor``, ``FeedForwardToCnnPreProcessor``,
+``RnnToFeedForwardPreProcessor``, ``FeedForwardToRnnPreProcessor``,
+``CnnToRnnPreProcessor``, ``RnnToCnnPreProcessor``. As in the reference,
+``ListBuilder.build()`` inserts these automatically from ``InputType``
+mismatches; they are pure reshapes that XLA folds away.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Type
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.inputs import InputType
+
+_PREPROC_REGISTRY: Dict[str, Type["InputPreProcessor"]] = {}
+
+
+def register_preproc(cls):
+    _PREPROC_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+@dataclasses.dataclass
+class InputPreProcessor:
+    def pre_process(self, x, mask=None):
+        raise NotImplementedError
+
+    def output_type(self, input_type: InputType) -> InputType:
+        raise NotImplementedError
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["@type"] = type(self).__name__
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "InputPreProcessor":
+        d = dict(d)
+        cls = _PREPROC_REGISTRY[d.pop("@type")]
+        return cls(**d)
+
+
+@register_preproc
+@dataclasses.dataclass
+class CnnToFeedForwardPreProcessor(InputPreProcessor):
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def pre_process(self, x, mask=None):
+        return x.reshape(x.shape[0], -1)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(input_type.flat_size())
+
+
+@register_preproc
+@dataclasses.dataclass
+class FeedForwardToCnnPreProcessor(InputPreProcessor):
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def pre_process(self, x, mask=None):
+        if x.ndim == 2:
+            return x.reshape(x.shape[0], self.height, self.width, self.channels)
+        return x
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@register_preproc
+@dataclasses.dataclass
+class RnnToFeedForwardPreProcessor(InputPreProcessor):
+    """(batch, time, size) -> (batch*time, size). With our time-distributed
+    dense layers this is rarely needed, but kept for reference parity."""
+
+    def pre_process(self, x, mask=None):
+        return x.reshape(-1, x.shape[-1])
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(input_type.size)
+
+
+@register_preproc
+@dataclasses.dataclass
+class FeedForwardToRnnPreProcessor(InputPreProcessor):
+    timesteps: Optional[int] = None
+
+    def pre_process(self, x, mask=None):
+        if x.ndim == 2 and self.timesteps:
+            return x.reshape(-1, self.timesteps, x.shape[-1])
+        return x
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(input_type.size, self.timesteps)
+
+
+@register_preproc
+@dataclasses.dataclass
+class CnnToRnnPreProcessor(InputPreProcessor):
+    """(batch, h, w, c) -> (batch, h, w*c) treating height as time."""
+
+    def pre_process(self, x, mask=None):
+        b, h, w, c = x.shape
+        return x.reshape(b, h, w * c)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(input_type.width * input_type.channels,
+                                   input_type.height)
+
+
+@register_preproc
+@dataclasses.dataclass
+class RnnToCnnPreProcessor(InputPreProcessor):
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def pre_process(self, x, mask=None):
+        b, t, _ = x.shape
+        return x.reshape(b * t, self.height, self.width, self.channels)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.convolutional(self.height, self.width, self.channels)

@@ -1,0 +1,304 @@
+"""Recurrent layers.
+
+Rebuild of upstream ``org.deeplearning4j.nn.conf.layers`` recurrent set:
+``LSTM``, ``GravesLSTM`` (peepholes), ``SimpleRnn``, ``GRU``-equivalent,
+``Bidirectional`` wrapper, ``LastTimeStep``, ``RnnOutputLayer``.
+
+TPU-first design: the whole sequence runs as ONE ``lax.scan`` inside the
+jitted step (the reference needed ``CudnnLSTMHelper`` to fuse the sequence;
+under XLA the scan body — a single (batch, 4H) matmul pair per step — is
+already the fused form). Gate weights are packed ``(nIn, 4H)`` so each step
+is one MXU matmul. Sequence layout is (batch, time, features); masks are
+(batch, time) and masked steps carry state through unchanged (matches the
+reference's masking semantics).
+
+Stateful inference (reference ``rnnTimeStep``/``rnnClearPreviousState``) is
+supported through the explicit carry API: ``init_carry`` +
+``forward_with_carry``; ``MultiLayerNetwork`` owns the stored carries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.base import GlobalConfig, Layer, register_layer
+from deeplearning4j_tpu.nn.core_layers import OutputLayer
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.ops.activations import get_activation
+from deeplearning4j_tpu.ops.initializers import init_weights
+from deeplearning4j_tpu.ops.losses import LossFunction
+
+
+@dataclasses.dataclass
+class BaseRecurrentLayer(Layer):
+    n_out: int = 0
+    n_in: Optional[int] = None
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, input_type.timesteps)
+
+    def _nin(self, input_type: InputType) -> int:
+        return self.n_in if self.n_in is not None else input_type.size
+
+    def init_carry(self, batch: int, dtype=jnp.float32):
+        raise NotImplementedError
+
+    def forward_with_carry(self, params, carry, x, *, training=False, rng=None, mask=None):
+        raise NotImplementedError
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        x = self._apply_input_dropout(x, self._g, training, rng)
+        carry = self.init_carry(x.shape[0], x.dtype)
+        y, _ = self.forward_with_carry(params, carry, x, training=training, rng=rng, mask=mask)
+        return y, state
+
+
+@register_layer
+@dataclasses.dataclass
+class LSTM(BaseRecurrentLayer):
+    """LSTM with packed gates [i, f, g, o]; forget-gate bias init (reference
+    ``LSTM.forgetGateBiasInit``, default 1.0)."""
+
+    forget_gate_bias_init: float = 1.0
+    gate_activation: Any = "sigmoid"
+
+    def init(self, key, input_type, g: GlobalConfig):
+        n_in, H = self._nin(input_type), self.n_out
+        k1, k2 = jax.random.split(key)
+        b = jnp.zeros((4 * H,), g.dtype or jnp.float32)
+        b = b.at[H:2 * H].set(self.forget_gate_bias_init)
+        return {
+            "W": init_weights(k1, (n_in, 4 * H), self._winit(g), fan=(n_in, H), dtype=g.dtype),
+            "W_rec": init_weights(k2, (H, 4 * H), self._winit(g), fan=(H, H), dtype=g.dtype),
+            "b": b,
+        }, {}
+
+    def init_carry(self, batch: int, dtype=jnp.float32):
+        H = self.n_out
+        return (jnp.zeros((batch, H), dtype), jnp.zeros((batch, H), dtype))
+
+    def _step(self, params, h, c, x_t):
+        H = self.n_out
+        act = get_activation(self._act(self._g) if self._act(self._g) is not None else "tanh")
+        gate = get_activation(self.gate_activation)
+        z = x_t @ params["W"] + h @ params["W_rec"] + params["b"]
+        i = gate(z[:, :H])
+        f = gate(z[:, H:2 * H])
+        g_ = jnp.tanh(z[:, 2 * H:3 * H])
+        o = gate(z[:, 3 * H:])
+        c_new = f * c + i * g_
+        h_new = o * act(c_new)
+        return h_new, c_new
+
+    def forward_with_carry(self, params, carry, x, *, training=False, rng=None, mask=None):
+        xs = jnp.swapaxes(x, 0, 1)  # (time, batch, nIn)
+        ms = None if mask is None else jnp.swapaxes(mask, 0, 1)
+
+        def step(hc, inp):
+            h, c = hc
+            x_t = inp[0] if ms is not None else inp
+            h_new, c_new = self._step(params, h, c, x_t)
+            if ms is not None:
+                m = inp[1][:, None].astype(h.dtype)
+                h_new = m * h_new + (1 - m) * h
+                c_new = m * c_new + (1 - m) * c
+            return (h_new, c_new), h_new
+
+        inputs = (xs, ms) if ms is not None else xs
+        (h, c), ys = lax.scan(step, carry, inputs)
+        return jnp.swapaxes(ys, 0, 1), (h, c)
+
+
+@register_layer
+@dataclasses.dataclass
+class GravesLSTM(LSTM):
+    """LSTM with peephole connections (reference ``GravesLSTM``)."""
+
+    def init(self, key, input_type, g: GlobalConfig):
+        params, state = super().init(key, input_type, g)
+        H = self.n_out
+        params["peephole"] = jnp.zeros((3 * H,), g.dtype or jnp.float32)
+        return params, state
+
+    def _step(self, params, h, c, x_t):
+        H = self.n_out
+        act = get_activation(self._act(self._g) if self._act(self._g) is not None else "tanh")
+        gate = get_activation(self.gate_activation)
+        p = params["peephole"]
+        z = x_t @ params["W"] + h @ params["W_rec"] + params["b"]
+        i = gate(z[:, :H] + c * p[:H])
+        f = gate(z[:, H:2 * H] + c * p[H:2 * H])
+        g_ = jnp.tanh(z[:, 2 * H:3 * H])
+        c_new = f * c + i * g_
+        o = gate(z[:, 3 * H:] + c_new * p[2 * H:])
+        h_new = o * act(c_new)
+        return h_new, c_new
+
+
+@register_layer
+@dataclasses.dataclass
+class SimpleRnn(BaseRecurrentLayer):
+    """Vanilla RNN: h' = act(x W + h W_rec + b) (reference ``SimpleRnn``)."""
+
+    def init(self, key, input_type, g: GlobalConfig):
+        n_in, H = self._nin(input_type), self.n_out
+        k1, k2 = jax.random.split(key)
+        return {
+            "W": init_weights(k1, (n_in, H), self._winit(g), fan=(n_in, H), dtype=g.dtype),
+            "W_rec": init_weights(k2, (H, H), self._winit(g), fan=(H, H), dtype=g.dtype),
+            "b": jnp.full((H,), self._binit(g), g.dtype or jnp.float32),
+        }, {}
+
+    def init_carry(self, batch: int, dtype=jnp.float32):
+        return (jnp.zeros((batch, self.n_out), dtype),)
+
+    def forward_with_carry(self, params, carry, x, *, training=False, rng=None, mask=None):
+        act = get_activation(self._act(self._g) if self._act(self._g) is not None else "tanh")
+        xs = jnp.swapaxes(x, 0, 1)
+        ms = None if mask is None else jnp.swapaxes(mask, 0, 1)
+
+        def step(hs, inp):
+            (h,) = hs
+            x_t = inp[0] if ms is not None else inp
+            h_new = act(x_t @ params["W"] + h @ params["W_rec"] + params["b"])
+            if ms is not None:
+                m = inp[1][:, None].astype(h.dtype)
+                h_new = m * h_new + (1 - m) * h
+            return (h_new,), h_new
+
+        inputs = (xs, ms) if ms is not None else xs
+        (h,), ys = lax.scan(step, carry, inputs)
+        return jnp.swapaxes(ys, 0, 1), (h,)
+
+
+@register_layer
+@dataclasses.dataclass
+class GRU(BaseRecurrentLayer):
+    """GRU with packed gates [r, u, n]."""
+
+    def init(self, key, input_type, g: GlobalConfig):
+        n_in, H = self._nin(input_type), self.n_out
+        k1, k2 = jax.random.split(key)
+        return {
+            "W": init_weights(k1, (n_in, 3 * H), self._winit(g), fan=(n_in, H), dtype=g.dtype),
+            "W_rec": init_weights(k2, (H, 3 * H), self._winit(g), fan=(H, H), dtype=g.dtype),
+            "b": jnp.zeros((3 * H,), g.dtype or jnp.float32),
+        }, {}
+
+    def init_carry(self, batch: int, dtype=jnp.float32):
+        return (jnp.zeros((batch, self.n_out), dtype),)
+
+    def forward_with_carry(self, params, carry, x, *, training=False, rng=None, mask=None):
+        H = self.n_out
+        xs = jnp.swapaxes(x, 0, 1)
+        ms = None if mask is None else jnp.swapaxes(mask, 0, 1)
+
+        def step(hs, inp):
+            (h,) = hs
+            x_t = inp[0] if ms is not None else inp
+            zx = x_t @ params["W"] + params["b"]
+            zh = h @ params["W_rec"]
+            r = jax.nn.sigmoid(zx[:, :H] + zh[:, :H])
+            u = jax.nn.sigmoid(zx[:, H:2 * H] + zh[:, H:2 * H])
+            n = jnp.tanh(zx[:, 2 * H:] + r * zh[:, 2 * H:])
+            h_new = (1 - u) * n + u * h
+            if ms is not None:
+                m = inp[1][:, None].astype(h.dtype)
+                h_new = m * h_new + (1 - m) * h
+            return (h_new,), h_new
+
+        inputs = (xs, ms) if ms is not None else xs
+        (h,), ys = lax.scan(step, carry, inputs)
+        return jnp.swapaxes(ys, 0, 1), (h,)
+
+
+@register_layer
+@dataclasses.dataclass
+class Bidirectional(Layer):
+    """Bidirectional wrapper (reference ``Bidirectional``): runs the wrapped
+    recurrent layer forward and on the time-reversed sequence; merge modes
+    CONCAT / ADD / MUL / AVERAGE."""
+
+    layer: Any = None  # a BaseRecurrentLayer (or dict after deserialization)
+    mode: str = "concat"
+
+    def __post_init__(self):
+        if isinstance(self.layer, dict):
+            self.layer = Layer.from_dict(self.layer)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        inner = self.layer.output_type(input_type)
+        if self.mode.lower() == "concat":
+            return InputType.recurrent(inner.size * 2, inner.timesteps)
+        return inner
+
+    def init(self, key, input_type, g: GlobalConfig):
+        self.layer._g = g
+        k1, k2 = jax.random.split(key)
+        fwd, _ = self.layer.init(k1, input_type, g)
+        bwd, _ = self.layer.init(k2, input_type, g)
+        return {"fwd": fwd, "bwd": bwd}, {}
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        self.layer._g = self._g
+        y_f, _ = self.layer.forward(params["fwd"], {}, x, training=training, rng=rng, mask=mask)
+        x_rev = jnp.flip(x, axis=1)
+        m_rev = None if mask is None else jnp.flip(mask, axis=1)
+        y_b, _ = self.layer.forward(params["bwd"], {}, x_rev, training=training, rng=rng, mask=m_rev)
+        y_b = jnp.flip(y_b, axis=1)
+        mode = self.mode.lower()
+        if mode == "concat":
+            return jnp.concatenate([y_f, y_b], axis=-1), state
+        if mode == "add":
+            return y_f + y_b, state
+        if mode == "mul":
+            return y_f * y_b, state
+        return 0.5 * (y_f + y_b), state
+
+
+@register_layer
+@dataclasses.dataclass
+class LastTimeStep(Layer):
+    """Extract the last (mask-aware) timestep (reference ``LastTimeStep``)."""
+
+    layer: Any = None
+
+    def __post_init__(self):
+        if isinstance(self.layer, dict):
+            self.layer = Layer.from_dict(self.layer)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        inner = self.layer.output_type(input_type) if self.layer else input_type
+        return InputType.feed_forward(inner.size)
+
+    def init(self, key, input_type, g: GlobalConfig):
+        if self.layer is None:
+            return {}, {}
+        self.layer._g = g
+        return self.layer.init(key, input_type, g)
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        if self.layer is not None:
+            self.layer._g = self._g
+            x, state = self.layer.forward(params, state, x, training=training, rng=rng, mask=mask)
+        if mask is not None:
+            idx = jnp.maximum(jnp.sum(mask.astype(jnp.int32), axis=1) - 1, 0)
+            return x[jnp.arange(x.shape[0]), idx], state
+        return x[:, -1], state
+
+
+@register_layer
+@dataclasses.dataclass
+class RnnOutputLayer(OutputLayer):
+    """Time-distributed output head (reference ``RnnOutputLayer``): dense +
+    loss applied at every timestep of (batch, time, nIn)."""
+
+    loss: Any = LossFunction.MCXENT
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, input_type.timesteps)

@@ -1,0 +1,20 @@
+"""Op library: activations, losses, initializers, and Pallas TPU kernels.
+
+TPU-native replacement for the reference's op stack: libnd4j's enumerated
+transform/reduce loops and ~500 declarable ops become jax.numpy/lax programs
+fused by XLA; the cuDNN/oneDNN platform helpers become XLA conv/rnn emitters;
+ops XLA fuses poorly get hand-written Pallas kernels under ``ops.pallas``.
+"""
+
+from deeplearning4j_tpu.ops.activations import Activation, get_activation
+from deeplearning4j_tpu.ops.initializers import WeightInit, init_weights
+from deeplearning4j_tpu.ops.losses import LossFunction, get_loss
+
+__all__ = [
+    "Activation",
+    "get_activation",
+    "WeightInit",
+    "init_weights",
+    "LossFunction",
+    "get_loss",
+]

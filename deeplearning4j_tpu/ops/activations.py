@@ -1,0 +1,84 @@
+"""Activation functions.
+
+Covers the reference's activation set (upstream
+``org.nd4j.linalg.activations.Activation`` enum — IDENTITY..THRESHOLDEDRELU).
+All are plain jnp functions: XLA fuses them into the surrounding matmul, which
+is exactly the "cuDNN fused activation" fast path the reference needed helper
+classes for.
+
+Names are matched case-insensitively so configs serialized with DL4J-style
+UPPERCASE names round-trip.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+
+class Activation(str, enum.Enum):
+    IDENTITY = "identity"
+    RELU = "relu"
+    RELU6 = "relu6"
+    LEAKYRELU = "leakyrelu"
+    ELU = "elu"
+    SELU = "selu"
+    GELU = "gelu"
+    TANH = "tanh"
+    SIGMOID = "sigmoid"
+    HARDSIGMOID = "hardsigmoid"
+    HARDTANH = "hardtanh"
+    SOFTMAX = "softmax"
+    SOFTPLUS = "softplus"
+    SOFTSIGN = "softsign"
+    SWISH = "swish"
+    MISH = "mish"
+    CUBE = "cube"
+    RATIONALTANH = "rationaltanh"
+    RECTIFIEDTANH = "rectifiedtanh"
+    THRESHOLDEDRELU = "thresholdedrelu"
+
+    def __call__(self, x):
+        return get_activation(self)(x)
+
+
+def _rationaltanh(x):
+    # DL4J's rational tanh approximation: 1.7159 * tanh(2x/3) (fast tanh family).
+    return 1.7159 * jnp.tanh((2.0 / 3.0) * x)
+
+
+_FNS: dict[str, Callable] = {
+    "identity": lambda x: x,
+    "relu": jax.nn.relu,
+    "relu6": jax.nn.relu6,
+    "leakyrelu": lambda x: jax.nn.leaky_relu(x, negative_slope=0.01),
+    "elu": jax.nn.elu,
+    "selu": jax.nn.selu,
+    "gelu": jax.nn.gelu,
+    "tanh": jnp.tanh,
+    "sigmoid": jax.nn.sigmoid,
+    "hardsigmoid": jax.nn.hard_sigmoid,
+    "hardtanh": lambda x: jnp.clip(x, -1.0, 1.0),
+    "softmax": lambda x: jax.nn.softmax(x, axis=-1),
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "swish": jax.nn.swish,
+    "mish": jax.nn.mish,
+    "cube": lambda x: x**3,
+    "rationaltanh": _rationaltanh,
+    "rectifiedtanh": lambda x: jnp.maximum(0.0, jnp.tanh(x)),
+    "thresholdedrelu": lambda x: jnp.where(x > 1.0, x, 0.0),
+}
+
+
+def get_activation(name: Union[str, Activation, Callable]) -> Callable:
+    """Resolve an activation by enum, name (any case), or pass a callable through."""
+    if callable(name) and not isinstance(name, (str, Activation)):
+        return name
+    key = (name.value if isinstance(name, Activation) else str(name)).lower()
+    if key not in _FNS:
+        raise ValueError(f"Unknown activation {name!r}; known: {sorted(_FNS)}")
+    return _FNS[key]

@@ -1,0 +1,146 @@
+"""Weight initialisation schemes.
+
+Matches the reference's ``WeightInit`` enum semantics (upstream
+``org.deeplearning4j.nn.weights.WeightInit`` + ``WeightInitUtil``) so that loss
+curves are comparable layer-for-layer:
+
+- XAVIER            N(0, 2/(fanIn+fanOut))
+- XAVIER_UNIFORM    U(-a, a), a = sqrt(6/(fanIn+fanOut))  (Glorot uniform)
+- XAVIER_FAN_IN     N(0, 1/fanIn)
+- RELU              N(0, 2/fanIn)  (He)
+- RELU_UNIFORM      U(-a, a), a = sqrt(6/fanIn)
+- LECUN_NORMAL      N(0, 1/fanIn)
+- LECUN_UNIFORM     U(-a, a), a = sqrt(3/fanIn)
+- SIGMOID_UNIFORM   U(-a, a), a = 4*sqrt(6/(fanIn+fanOut))
+- NORMAL            N(0, 1/fanIn)  (DL4J 'NORMAL' is fan-in scaled)
+- UNIFORM           U(-a, a), a = 1/sqrt(fanIn)
+- ZERO / ONES / IDENTITY / DISTRIBUTION / VAR_SCALING_*
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class WeightInit(str, enum.Enum):
+    XAVIER = "xavier"
+    XAVIER_UNIFORM = "xavier_uniform"
+    XAVIER_FAN_IN = "xavier_fan_in"
+    RELU = "relu"
+    RELU_UNIFORM = "relu_uniform"
+    LECUN_NORMAL = "lecun_normal"
+    LECUN_UNIFORM = "lecun_uniform"
+    SIGMOID_UNIFORM = "sigmoid_uniform"
+    NORMAL = "normal"
+    UNIFORM = "uniform"
+    ZERO = "zero"
+    ONES = "ones"
+    IDENTITY = "identity"
+    VAR_SCALING_NORMAL_FAN_IN = "var_scaling_normal_fan_in"
+    VAR_SCALING_NORMAL_FAN_OUT = "var_scaling_normal_fan_out"
+    VAR_SCALING_NORMAL_FAN_AVG = "var_scaling_normal_fan_avg"
+    VAR_SCALING_UNIFORM_FAN_IN = "var_scaling_uniform_fan_in"
+    VAR_SCALING_UNIFORM_FAN_OUT = "var_scaling_uniform_fan_out"
+    VAR_SCALING_UNIFORM_FAN_AVG = "var_scaling_uniform_fan_avg"
+    DISTRIBUTION = "distribution"
+
+
+def init_weights(
+    key: jax.Array,
+    shape: Sequence[int],
+    scheme: WeightInit | str = WeightInit.XAVIER,
+    fan: Optional[Tuple[int, int]] = None,
+    dtype=jnp.float32,
+    distribution: Optional[dict] = None,
+) -> jax.Array:
+    """Draw a weight tensor.
+
+    ``fan`` is (fan_in, fan_out); if omitted it is inferred from ``shape``
+    with the convention used throughout this framework: last dim = fan_out,
+    product of the rest = fan_in (correct for dense ``(in, out)`` and for
+    HWIO conv kernels ``(kh, kw, in, out)`` where receptive field multiplies
+    fan_in, matching the reference's conv fan computation).
+    """
+    scheme = WeightInit(scheme) if not isinstance(scheme, WeightInit) else scheme
+    shape = tuple(int(s) for s in shape)
+    if fan is None:
+        fan_out = shape[-1] if len(shape) >= 1 else 1
+        fan_in = 1
+        for s in shape[:-1]:
+            fan_in *= s
+        if len(shape) == 1:
+            fan_in = shape[0]
+    else:
+        fan_in, fan_out = fan
+    fan_in = max(1, int(fan_in))
+    fan_out = max(1, int(fan_out))
+
+    def normal(std):
+        return jax.random.normal(key, shape, dtype) * jnp.asarray(std, dtype)
+
+    def uniform(limit):
+        return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+    s = scheme
+    W = WeightInit
+    if s == W.XAVIER:
+        return normal(jnp.sqrt(2.0 / (fan_in + fan_out)))
+    if s == W.XAVIER_UNIFORM:
+        return uniform(jnp.sqrt(6.0 / (fan_in + fan_out)))
+    if s == W.XAVIER_FAN_IN:
+        return normal(jnp.sqrt(1.0 / fan_in))
+    if s == W.RELU:
+        return normal(jnp.sqrt(2.0 / fan_in))
+    if s == W.RELU_UNIFORM:
+        return uniform(jnp.sqrt(6.0 / fan_in))
+    if s == W.LECUN_NORMAL:
+        return normal(jnp.sqrt(1.0 / fan_in))
+    if s == W.LECUN_UNIFORM:
+        return uniform(jnp.sqrt(3.0 / fan_in))
+    if s == W.SIGMOID_UNIFORM:
+        return uniform(4.0 * jnp.sqrt(6.0 / (fan_in + fan_out)))
+    if s == W.NORMAL:
+        return normal(jnp.sqrt(1.0 / fan_in))
+    if s == W.UNIFORM:
+        return uniform(1.0 / jnp.sqrt(fan_in))
+    if s == W.ZERO:
+        return jnp.zeros(shape, dtype)
+    if s == W.ONES:
+        return jnp.ones(shape, dtype)
+    if s == W.IDENTITY:
+        if len(shape) != 2 or shape[0] != shape[1]:
+            raise ValueError("IDENTITY init requires a square 2-D shape")
+        return jnp.eye(shape[0], dtype=dtype)
+    if s in (W.VAR_SCALING_NORMAL_FAN_IN, W.VAR_SCALING_UNIFORM_FAN_IN):
+        n = fan_in
+    elif s in (W.VAR_SCALING_NORMAL_FAN_OUT, W.VAR_SCALING_UNIFORM_FAN_OUT):
+        n = fan_out
+    else:
+        n = (fan_in + fan_out) / 2.0
+    if s == W.DISTRIBUTION:
+        return _from_distribution(key, shape, dtype, distribution or {})
+    if "uniform" in s.value:
+        return uniform(jnp.sqrt(3.0 / n))
+    return normal(jnp.sqrt(1.0 / n))
+
+
+def _from_distribution(key, shape, dtype, dist: dict):
+    """DL4J ``Distribution`` configs: {"type": "normal"|"uniform"|"truncated_normal"|
+    "constant"|"orthogonal", ...params}."""
+    kind = dist.get("type", "normal").lower()
+    if kind == "normal":
+        return dist.get("mean", 0.0) + jax.random.normal(key, shape, dtype) * dist.get("std", 1.0)
+    if kind == "truncated_normal":
+        std = dist.get("std", 1.0)
+        return dist.get("mean", 0.0) + jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype) * std
+    if kind == "uniform":
+        return jax.random.uniform(key, shape, dtype, dist.get("lower", -1.0), dist.get("upper", 1.0))
+    if kind == "constant":
+        return jnp.full(shape, dist.get("value", 0.0), dtype)
+    if kind == "orthogonal":
+        return jax.nn.initializers.orthogonal(scale=dist.get("gain", 1.0))(key, shape, dtype)
+    raise ValueError(f"Unknown distribution type {kind!r}")

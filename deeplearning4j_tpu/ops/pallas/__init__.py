@@ -1,0 +1,9 @@
+"""Pallas TPU kernels — the framework's hand-written-kernel layer.
+
+The reference implements its hot ops as C++/CUDA in libnd4j
+(``libnd4j/include/ops/declarable/helpers/cuda/*``) with cuDNN fast paths.
+The TPU equivalent: XLA emits fused code for almost everything; for the ops
+where hand-scheduling beats XLA (flash attention's blockwise softmax, fused
+dropout RNG), kernels live here, written with ``jax.experimental.pallas``
+against the MXU/VMEM model (see /opt/skills/guides/pallas_guide.md).
+"""

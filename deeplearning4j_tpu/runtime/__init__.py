@@ -1,0 +1,34 @@
+"""Runtime substrate: environment/config facade, mesh discovery, RNG, profiling.
+
+TPU-native replacement for the reference's runtime plumbing:
+``org.nd4j.config.ND4JSystemProperties`` / ``ND4JEnvironmentVars`` (flag
+facade), ``CudaEnvironment`` (device runtime tuning), ``Nd4j.getRandom()``
+(global RNG), and ``OpProfiler`` (profiling hooks).
+"""
+
+from deeplearning4j_tpu.runtime.environment import Environment, get_environment
+from deeplearning4j_tpu.runtime.mesh import (
+    MeshSpec,
+    create_mesh,
+    device_count,
+    devices,
+    local_mesh,
+)
+from deeplearning4j_tpu.runtime.rng import RngManager, get_default_rng, set_default_seed
+from deeplearning4j_tpu.runtime.profiler import OpProfiler, ProfilerConfig, trace
+
+__all__ = [
+    "Environment",
+    "get_environment",
+    "MeshSpec",
+    "create_mesh",
+    "device_count",
+    "devices",
+    "local_mesh",
+    "RngManager",
+    "get_default_rng",
+    "set_default_seed",
+    "OpProfiler",
+    "ProfilerConfig",
+    "trace",
+]

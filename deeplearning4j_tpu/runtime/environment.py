@@ -1,0 +1,128 @@
+"""Runtime configuration facade.
+
+TPU-native equivalent of the reference's flag system (upstream
+``org.nd4j.config.ND4JSystemProperties`` / ``ND4JEnvironmentVars`` and the
+libnd4j ``Environment`` singleton; see SURVEY.md §5.6): a single process-wide
+configuration object, settable programmatically or through ``DL4J_TPU_*``
+environment variables, controlling dtype policy, debug modes, and defaults.
+
+Unlike the reference there is no backend switch to manage — JAX/PJRT selects
+the platform — but the same knobs (default float dtype, NaN panic, verbose op
+logging, workspace-debug analog) are exposed so user code ports cleanly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+_ENV_PREFIX = "DL4J_TPU_"
+
+_DTYPES = {
+    "float32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+    "float64": jnp.float64,
+}
+
+
+@dataclasses.dataclass
+class Environment:
+    """Process-wide runtime configuration.
+
+    Attributes mirror the reference's runtime flags where a TPU analog exists:
+
+    - ``default_dtype``: dtype of freshly initialised parameters (reference:
+      ``Nd4j.setDefaultDataTypes``). ``float32`` by default.
+    - ``compute_dtype``: dtype activations/matmuls are cast to inside the
+      jitted step. ``bfloat16`` keeps the MXU fed; params stay
+      ``default_dtype`` (mixed precision policy).
+    - ``nan_panic``: throw on first NaN/Inf produced by a jitted step
+      (reference: OpProfiler ``ANY_PANIC``); implemented via
+      ``jax.config.debug_nans`` plus explicit checks in the fit loop.
+    - ``verbose`` / ``debug``: op-level logging analogs of libnd4j
+      ``Environment::setVerbose/setDebug``.
+    - ``cache_compiled``: persistent XLA compilation cache directory.
+    """
+
+    default_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.float32
+    nan_panic: bool = False
+    verbose: bool = False
+    debug: bool = False
+    cache_compiled: Optional[str] = None
+    # Analog of org.nd4j.memory.limit: fraction of HBM jax may pre-allocate.
+    memory_fraction: Optional[float] = None
+
+    def set_default_dtype(self, dtype) -> "Environment":
+        self.default_dtype = _coerce_dtype(dtype)
+        return self
+
+    def set_compute_dtype(self, dtype) -> "Environment":
+        self.compute_dtype = _coerce_dtype(dtype)
+        return self
+
+    def allow_bfloat16(self) -> "Environment":
+        """Enable the standard TPU mixed-precision policy (bf16 compute)."""
+        self.compute_dtype = jnp.bfloat16
+        return self
+
+    def set_nan_panic(self, enabled: bool) -> "Environment":
+        self.nan_panic = enabled
+        jax.config.update("jax_debug_nans", bool(enabled))
+        return self
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "default_dtype": jnp.dtype(self.default_dtype).name,
+            "compute_dtype": jnp.dtype(self.compute_dtype).name,
+            "nan_panic": self.nan_panic,
+            "verbose": self.verbose,
+            "debug": self.debug,
+            "cache_compiled": self.cache_compiled,
+            "memory_fraction": self.memory_fraction,
+        }
+
+
+def _coerce_dtype(dtype):
+    if isinstance(dtype, str):
+        if dtype not in _DTYPES:
+            raise ValueError(f"Unknown dtype {dtype!r}; expected one of {sorted(_DTYPES)}")
+        return _DTYPES[dtype]
+    return jnp.dtype(dtype).type
+
+
+_lock = threading.Lock()
+_instance: Optional[Environment] = None
+
+
+def get_environment() -> Environment:
+    """Return the process-wide :class:`Environment` singleton.
+
+    First call reads ``DL4J_TPU_*`` environment variables:
+    ``DL4J_TPU_DTYPE``, ``DL4J_TPU_COMPUTE_DTYPE``, ``DL4J_TPU_NAN_PANIC``,
+    ``DL4J_TPU_VERBOSE``, ``DL4J_TPU_DEBUG``, ``DL4J_TPU_COMPILE_CACHE``.
+    """
+    global _instance
+    with _lock:
+        if _instance is None:
+            env = Environment()
+            if os.environ.get(_ENV_PREFIX + "DTYPE"):
+                env.set_default_dtype(os.environ[_ENV_PREFIX + "DTYPE"])
+            if os.environ.get(_ENV_PREFIX + "COMPUTE_DTYPE"):
+                env.set_compute_dtype(os.environ[_ENV_PREFIX + "COMPUTE_DTYPE"])
+            if os.environ.get(_ENV_PREFIX + "NAN_PANIC", "").lower() in ("1", "true"):
+                env.set_nan_panic(True)
+            env.verbose = os.environ.get(_ENV_PREFIX + "VERBOSE", "").lower() in ("1", "true")
+            env.debug = os.environ.get(_ENV_PREFIX + "DEBUG", "").lower() in ("1", "true")
+            cache = os.environ.get(_ENV_PREFIX + "COMPILE_CACHE")
+            if cache:
+                env.cache_compiled = cache
+                jax.config.update("jax_compilation_cache_dir", cache)
+            _instance = env
+        return _instance

@@ -1,0 +1,106 @@
+"""Profiling and debugging hooks.
+
+TPU-native equivalent of the reference's tracing stack (SURVEY.md §5.1):
+
+- ``OpProfiler`` / ``ProfilerConfig`` (upstream
+  ``org.nd4j.linalg.profiler.OpProfiler``): section timing + NaN panic modes.
+  Per-op hooks make no sense under XLA (ops are fused into one program), so the
+  unit of timing here is a *section* (a jitted step, an epoch, an ETL stage).
+- SameDiff ``ProfilingListener`` Chrome-trace output → `jax.profiler` traces
+  (viewable in TensorBoard/Perfetto), exposed via :func:`trace`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from collections import defaultdict
+from typing import Dict, Iterator, Optional
+
+import jax
+
+
+@dataclasses.dataclass
+class ProfilerConfig:
+    """Modes mirror the reference's enum where meaningful on TPU."""
+
+    enabled: bool = False
+    check_for_nan: bool = False  # reference NAN_PANIC
+    check_for_inf: bool = False  # reference INF_PANIC
+
+
+class OpProfiler:
+    """Section timer with aggregate stats.
+
+    Usage::
+
+        prof = OpProfiler()
+        with prof.section("train_step"):
+            state = step(state, batch)
+        prof.summary()
+    """
+
+    def __init__(self, config: Optional[ProfilerConfig] = None):
+        self.config = config or ProfilerConfig(enabled=True)
+        self._totals: Dict[str, float] = defaultdict(float)
+        self._counts: Dict[str, int] = defaultdict(int)
+
+    @contextlib.contextmanager
+    def section(self, name: str) -> Iterator[None]:
+        if not self.config.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self._totals[name] += dt
+            self._counts[name] += 1
+
+    def timings(self) -> Dict[str, Dict[str, float]]:
+        return {
+            name: {
+                "total_s": self._totals[name],
+                "count": self._counts[name],
+                "mean_s": self._totals[name] / max(1, self._counts[name]),
+            }
+            for name in self._totals
+        }
+
+    def summary(self) -> str:
+        lines = ["OpProfiler summary:"]
+        for name, t in sorted(self.timings().items(), key=lambda kv: -kv[1]["total_s"]):
+            lines.append(
+                f"  {name:30s} total={t['total_s'] * 1e3:9.2f}ms "
+                f"n={t['count']:6d} mean={t['mean_s'] * 1e3:9.3f}ms"
+            )
+        return "\n".join(lines)
+
+    def reset(self) -> None:
+        self._totals.clear()
+        self._counts.clear()
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture a device trace (Chrome-trace analog of ``ProfilingListener``).
+
+    View with TensorBoard's profile plugin or Perfetto.
+    """
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def device_memory_stats() -> Dict[str, Dict[str, int]]:
+    """Per-device memory stats — feeds the HBM crash report (§5.5 parity)."""
+    out = {}
+    for d in jax.devices():
+        stats = getattr(d, "memory_stats", lambda: None)()
+        if stats:
+            out[str(d)] = {k: int(v) for k, v in stats.items()}
+    return out

@@ -1,0 +1,62 @@
+"""Random number management.
+
+The reference exposes a global, stateful ``Nd4j.getRandom()`` seeded from
+``NeuralNetConfiguration.seed`` (upstream ``org.nd4j.linalg.factory.Nd4j`` +
+``DefaultRandom``). Stateful global RNG is hostile to XLA (trace-once
+semantics), so the TPU design threads `jax.random` keys explicitly through
+init/forward; this module provides the seeded key *manager* that owns the root
+key and hands out fresh subkeys — the ergonomic equivalent of the global RNG
+with functional semantics underneath.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+
+
+class RngManager:
+    """Owns a root PRNG key; ``next_key()`` splits deterministically.
+
+    One manager per network instance (seeded from the config seed, like the
+    reference seeds its global RNG per-conf), so runs are reproducible and
+    independent networks don't perturb each other's streams.
+    """
+
+    def __init__(self, seed: int = 0):
+        self._seed = int(seed)
+        self._key = jax.random.PRNGKey(self._seed)
+        self._lock = threading.Lock()
+
+    @property
+    def seed(self) -> int:
+        return self._seed
+
+    def next_key(self, n: Optional[int] = None):
+        """Return one fresh subkey (or a batch of ``n``)."""
+        with self._lock:
+            if n is None:
+                self._key, sub = jax.random.split(self._key)
+                return sub
+            self._key, *subs = jax.random.split(self._key, n + 1)
+            return subs
+
+    def reset(self, seed: Optional[int] = None) -> None:
+        with self._lock:
+            if seed is not None:
+                self._seed = int(seed)
+            self._key = jax.random.PRNGKey(self._seed)
+
+
+_default = RngManager(0)
+
+
+def get_default_rng() -> RngManager:
+    """Process default manager — analog of ``Nd4j.getRandom()``."""
+    return _default
+
+
+def set_default_seed(seed: int) -> None:
+    _default.reset(seed)

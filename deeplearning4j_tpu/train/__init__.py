@@ -1,0 +1,49 @@
+"""Training engine: updaters, LR schedules, listeners, gradient processing.
+
+Rebuild of the reference's training stack: nd4j updaters
+(``org.nd4j.linalg.learning``), LR schedules (``org.nd4j.linalg.schedule``),
+the Solver/optimizer (``org.deeplearning4j.optimize.solvers``), and the
+``TrainingListener`` SPI — re-architected so the whole optimizer update runs
+inside the jitted train step (the reference's ``UpdaterBlock`` flat-view trick
+becomes "one optax update over one pytree").
+"""
+
+from deeplearning4j_tpu.train.updaters import (
+    AMSGrad,
+    AdaDelta,
+    AdaGrad,
+    AdaMax,
+    Adam,
+    Nadam,
+    Nesterovs,
+    NoOp,
+    RmsProp,
+    Sgd,
+    Updater,
+)
+from deeplearning4j_tpu.train.schedules import (
+    CycleSchedule,
+    ExponentialSchedule,
+    InverseSchedule,
+    MapSchedule,
+    PolySchedule,
+    Schedule,
+    SigmoidSchedule,
+    StepSchedule,
+)
+from deeplearning4j_tpu.train.listeners import (
+    BaseTrainingListener,
+    EvaluativeListener,
+    PerformanceListener,
+    ScoreIterationListener,
+    TrainingListener,
+)
+
+__all__ = [
+    "Updater", "Sgd", "Adam", "AdaMax", "AMSGrad", "Nadam", "Nesterovs",
+    "RmsProp", "AdaGrad", "AdaDelta", "NoOp",
+    "Schedule", "StepSchedule", "ExponentialSchedule", "InverseSchedule",
+    "PolySchedule", "SigmoidSchedule", "MapSchedule", "CycleSchedule",
+    "TrainingListener", "BaseTrainingListener", "ScoreIterationListener",
+    "PerformanceListener", "EvaluativeListener",
+]

@@ -1,0 +1,110 @@
+"""Training listener SPI.
+
+Rebuild of upstream ``org.deeplearning4j.optimize.api.TrainingListener`` and
+the stock listeners (``ScoreIterationListener``, ``PerformanceListener``,
+``EvaluativeListener``). Listeners run on the host between jitted steps; to
+keep the device busy, score values arrive as (possibly not-yet-ready) jax
+arrays and are only synced when a listener actually reads them.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Optional
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class TrainingListener:
+    """SPI — subclass and override what you need (reference interface)."""
+
+    def iteration_done(self, model, iteration: int, epoch: int, score) -> None:
+        pass
+
+    def on_epoch_start(self, model, epoch: int) -> None:
+        pass
+
+    def on_epoch_end(self, model, epoch: int) -> None:
+        pass
+
+    def on_forward_pass(self, model, activations=None) -> None:
+        pass
+
+    def on_backward_pass(self, model) -> None:
+        pass
+
+    def on_gradient_calculation(self, model) -> None:
+        pass
+
+
+BaseTrainingListener = TrainingListener  # reference has an adapter base class
+
+
+class ScoreIterationListener(TrainingListener):
+    """Log score every N iterations (reference ``ScoreIterationListener``)."""
+
+    def __init__(self, print_iterations: int = 10):
+        self.print_iterations = max(1, int(print_iterations))
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if iteration % self.print_iterations == 0:
+            logger.info("Score at iteration %d (epoch %d) is %s", iteration, epoch, float(score))
+
+
+class PerformanceListener(TrainingListener):
+    """Throughput reporting (reference ``PerformanceListener``): batches/sec,
+    samples/sec, ETL fraction."""
+
+    def __init__(self, frequency: int = 10, report_samples: bool = True):
+        self.frequency = max(1, int(frequency))
+        self.report_samples = report_samples
+        self._last_time: Optional[float] = None
+        self._last_iter = 0
+        self._samples = 0
+
+    def record_batch(self, n_examples: int) -> None:
+        self._samples += int(n_examples)
+
+    def iteration_done(self, model, iteration, epoch, score):
+        now = time.perf_counter()
+        if self._last_time is None:
+            self._last_time, self._last_iter, self._samples = now, iteration, 0
+            return
+        if iteration - self._last_iter >= self.frequency:
+            dt = now - self._last_time
+            it_s = (iteration - self._last_iter) / dt
+            msg = f"iteration {iteration} (epoch {epoch}): {it_s:.1f} it/s"
+            if self.report_samples and self._samples:
+                msg += f", {self._samples / dt:.1f} samples/s"
+            msg += f", score={float(score):.5f}"
+            logger.info(msg)
+            self._last_time, self._last_iter, self._samples = now, iteration, 0
+
+
+class EvaluativeListener(TrainingListener):
+    """Periodically evaluate on a held-out iterator (reference
+    ``EvaluativeListener``)."""
+
+    def __init__(self, iterator, frequency: int = 100, evaluation_factory=None):
+        self.iterator = iterator
+        self.frequency = max(1, int(frequency))
+        self.evaluation_factory = evaluation_factory
+        self.last_evaluation = None
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if iteration > 0 and iteration % self.frequency == 0:
+            self.iterator.reset()
+            self.last_evaluation = model.evaluate(self.iterator)
+            logger.info("Evaluation at iteration %d:\n%s", iteration, self.last_evaluation.stats())
+
+
+class CollectScoresListener(TrainingListener):
+    """Collect (iteration, score) pairs in memory (reference
+    ``CollectScoresIterationListener``) — used by tests and loss-curve goldens."""
+
+    def __init__(self):
+        self.scores: list[tuple[int, float]] = []
+
+    def iteration_done(self, model, iteration, epoch, score):
+        self.scores.append((iteration, float(score)))

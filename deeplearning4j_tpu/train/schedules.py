@@ -1,0 +1,136 @@
+"""Learning-rate schedules.
+
+Rebuild of upstream ``org.nd4j.linalg.schedule.*`` (``StepSchedule``,
+``ExponentialSchedule``, ``InverseSchedule``, ``PolySchedule``,
+``SigmoidSchedule``, ``MapSchedule``, ``CycleSchedule``). A schedule is a
+dataclass with ``value_at(step)`` usable directly as an optax schedule
+(callable on a jnp step counter inside jit).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Type
+
+import jax.numpy as jnp
+
+_SCHED_REGISTRY: Dict[str, Type["Schedule"]] = {}
+
+
+def register_schedule(cls):
+    _SCHED_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+@dataclasses.dataclass
+class Schedule:
+    initial_value: float = 1e-3
+
+    def value_at(self, step):
+        return jnp.asarray(self.initial_value, jnp.float32)
+
+    def __call__(self, step):
+        return self.value_at(step)
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["@type"] = type(self).__name__
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "Schedule":
+        d = dict(d)
+        cls = _SCHED_REGISTRY[d.pop("@type")]
+        if cls is MapSchedule and "values" in d:
+            d["values"] = {int(k): float(v) for k, v in d["values"].items()}
+        return cls(**d)
+
+
+@register_schedule
+@dataclasses.dataclass
+class StepSchedule(Schedule):
+    """value * decay_rate ^ floor(step / step_size)"""
+
+    decay_rate: float = 0.1
+    step_size: int = 1000
+
+    def value_at(self, step):
+        return self.initial_value * self.decay_rate ** jnp.floor(step / self.step_size)
+
+
+@register_schedule
+@dataclasses.dataclass
+class ExponentialSchedule(Schedule):
+    gamma: float = 0.99
+
+    def value_at(self, step):
+        return self.initial_value * self.gamma ** jnp.asarray(step, jnp.float32)
+
+
+@register_schedule
+@dataclasses.dataclass
+class InverseSchedule(Schedule):
+    gamma: float = 0.01
+    power: float = 1.0
+
+    def value_at(self, step):
+        return self.initial_value / (1.0 + self.gamma * step) ** self.power
+
+
+@register_schedule
+@dataclasses.dataclass
+class PolySchedule(Schedule):
+    power: float = 2.0
+    max_iter: int = 10000
+
+    def value_at(self, step):
+        frac = jnp.clip(step / self.max_iter, 0.0, 1.0)
+        return self.initial_value * (1.0 - frac) ** self.power
+
+
+@register_schedule
+@dataclasses.dataclass
+class SigmoidSchedule(Schedule):
+    gamma: float = 0.01
+    step_size: int = 1000
+
+    def value_at(self, step):
+        return self.initial_value / (1.0 + jnp.exp(self.gamma * (step - self.step_size)))
+
+
+@register_schedule
+@dataclasses.dataclass
+class MapSchedule(Schedule):
+    """Piecewise-constant: {step: value}, holds last value."""
+
+    values: Dict[int, float] = dataclasses.field(default_factory=dict)
+
+    def value_at(self, step):
+        keys = sorted(self.values)
+        out = jnp.asarray(self.initial_value, jnp.float32)
+        for k in keys:
+            out = jnp.where(step >= k, self.values[k], out)
+        return out
+
+
+@register_schedule
+@dataclasses.dataclass
+class CycleSchedule(Schedule):
+    """1cycle policy (reference ``CycleSchedule``): ramp up, ramp down, then
+    annihilate over the final fraction."""
+
+    max_value: float = 1e-2
+    cycle_length: int = 1000
+    annealing_length: int = 100
+    annealing_decay: float = 0.1
+
+    def value_at(self, step):
+        up = self.cycle_length // 2
+        pos = jnp.mod(step, self.cycle_length + self.annealing_length)
+        ramp_up = self.initial_value + (self.max_value - self.initial_value) * (pos / jnp.maximum(up, 1))
+        ramp_down = self.max_value - (self.max_value - self.initial_value) * ((pos - up) / jnp.maximum(up, 1))
+        anneal = self.initial_value * (
+            1.0 - (1.0 - self.annealing_decay) *
+            jnp.clip((pos - self.cycle_length) / jnp.maximum(self.annealing_length, 1), 0.0, 1.0))
+        v = jnp.where(pos < up, ramp_up, jnp.where(pos < self.cycle_length, ramp_down, anneal))
+        return v

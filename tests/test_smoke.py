@@ -1,0 +1,85 @@
+"""End-to-end smoke tests: the M0–M4 minimum slice (SURVEY.md §7.4)."""
+
+import os
+import tempfile
+
+import numpy as np
+
+from deeplearning4j_tpu.data import NumpyDataSetIterator
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn import (
+    DenseLayer,
+    InputType,
+    NeuralNetConfiguration,
+    OutputLayer,
+)
+from deeplearning4j_tpu.train import Adam
+from deeplearning4j_tpu.train.listeners import CollectScoresListener
+
+
+def _toy_classification(n=256, d=20, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(0, 3.0, (classes, d))
+    y = rng.integers(0, classes, n)
+    x = centers[y] + rng.normal(0, 1.0, (n, d))
+    onehot = np.eye(classes, dtype=np.float32)[y]
+    return x.astype(np.float32), onehot
+
+
+def _mlp_conf(d=20, classes=3, seed=42):
+    return (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=32, activation="relu"))
+            .layer(OutputLayer(n_out=classes, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(d))
+            .build())
+
+
+def test_mlp_learns():
+    x, y = _toy_classification()
+    it = NumpyDataSetIterator(x, y, batch_size=64, shuffle=True, seed=1)
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    scores = CollectScoresListener()
+    net.set_listeners(scores)
+    net.fit(it, epochs=10)
+    first = scores.scores[0][1]
+    last = scores.scores[-1][1]
+    assert last < first * 0.5, f"loss did not drop: {first} -> {last}"
+    ev = net.evaluate(it)
+    assert ev.accuracy() > 0.9
+
+
+def test_config_json_roundtrip():
+    conf = _mlp_conf()
+    js = conf.to_json()
+    from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
+    conf2 = MultiLayerConfiguration.from_json(js)
+    assert len(conf2.layers) == 2
+    assert conf2.layers[0].n_out == 32
+    assert conf2.global_conf.seed == 42
+    assert conf2.to_json() == js
+
+
+def test_model_serializer_roundtrip():
+    x, y = _toy_classification(n=64)
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    net.fit(x, y, epochs=2)
+    out_before = np.asarray(net.output(x[:8]))
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "model.zip")
+        net.save(path)
+        net2 = MultiLayerNetwork.load(path)
+    out_after = np.asarray(net2.output(x[:8]))
+    np.testing.assert_allclose(out_before, out_after, rtol=1e-6)
+    # resume training works (updater state restored)
+    net2.fit(x, y, epochs=1)
+
+
+def test_deterministic_init():
+    net1 = MultiLayerNetwork(_mlp_conf()).init()
+    net2 = MultiLayerNetwork(_mlp_conf()).init()
+    w1 = np.asarray(net1.params()["layer_0"]["W"])
+    w2 = np.asarray(net2.params()["layer_0"]["W"])
+    np.testing.assert_array_equal(w1, w2)
