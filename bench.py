@@ -1,0 +1,75 @@
+"""Benchmark: ResNet-50 training throughput (images/sec/chip), bf16 compute.
+
+BASELINE config #2's headline metric (`BASELINE.json.metric`). Runs on
+whatever accelerator jax selects (the driver provides the real TPU). Prints
+ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+``vs_baseline`` compares against BASELINE.json's published reference number
+when present (it is empty in this environment — SURVEY.md §6), else reports
+the ratio vs our own recorded-best to track regressions (1.0 on first run).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.runtime.environment import get_environment
+    from deeplearning4j_tpu.zoo import ResNet50
+    from deeplearning4j_tpu.train.updaters import Nesterovs
+
+    get_environment().allow_bfloat16()  # bf16 activations on the MXU
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    batch = 8 if on_cpu else 128
+    size = 64 if on_cpu else 224
+    steps = 3 if on_cpu else 20
+
+    net = ResNet50(num_classes=1000, height=size, width=size,
+                   updater=Nesterovs(0.1, momentum=0.9)).init()
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(0, 1, (batch, size, size, 3)), jnp.bfloat16)
+    y = jnp.asarray(np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)])
+
+    step_fn = net._jitted("train_step", net._make_train_step)
+    key = jax.random.PRNGKey(0)
+    ts = net.train_state
+
+    # warmup / compile
+    ts, loss = step_fn(ts, {"input": x}, [y], key, None)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        ts, loss = step_fn(ts, {"input": x}, [y], jax.random.fold_in(key, i), None)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = batch * steps / dt
+    baseline = None
+    try:
+        with open(os.path.join(os.path.dirname(__file__), "BASELINE.json")) as f:
+            published = json.load(f).get("published") or {}
+        baseline = published.get("resnet50_imgs_per_sec_per_chip")
+    except Exception:
+        pass
+    vs = (imgs_per_sec / baseline) if baseline else None
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(imgs_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(vs, 3) if vs else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -237,19 +237,22 @@ class ComputationGraph:
         g = self.conf.global_conf
         if g.dtype is None:
             g = dataclasses.replace(g, dtype=get_environment().default_dtype)
-        key = jax.random.PRNGKey(g.seed)
-        new_params: Dict[str, Dict] = {}
-        model_state: Dict[str, Dict] = {}
-        for i, name in enumerate(self.conf.topo_order):
-            node = self.conf.node(name)
-            if node.kind != "layer":
-                continue
-            it = self.conf.node_input_types.get(name)
-            p, s = node.obj.init(jax.random.fold_in(key, i), it, g)
-            if p:
-                new_params[name] = p
-            if s:
-                model_state[name] = s
+        def init_all(key):
+            ps: Dict[str, Dict] = {}
+            ss: Dict[str, Dict] = {}
+            for i, name in enumerate(self.conf.topo_order):
+                node = self.conf.node(name)
+                if node.kind != "layer":
+                    continue
+                it = self.conf.node_input_types.get(name)
+                p, s = node.obj.init(jax.random.fold_in(key, i), it, g)
+                if p:
+                    ps[name] = p
+                if s:
+                    ss[name] = s
+            return ps, ss
+
+        new_params, model_state = jax.jit(init_all)(jax.random.PRNGKey(g.seed))
         if params is not None:
             new_params = params
         self._tx = self._build_tx(new_params)

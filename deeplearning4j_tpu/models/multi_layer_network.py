@@ -74,17 +74,22 @@ class MultiLayerNetwork:
         g = self.conf.global_conf
         if g.dtype is None:
             g = dataclasses.replace(g, dtype=get_environment().default_dtype)
-        key = jax.random.PRNGKey(g.seed)
-        new_params: Dict[str, Dict] = {}
-        model_state: Dict[str, Dict] = {}
-        for i, layer in enumerate(self.layers):
-            it = self.conf.layer_input_types[i] if self.conf.layer_input_types else None
-            p, s = layer.init(jax.random.fold_in(key, i), it, g)
-            k = _layer_key(i, layer)
-            if p:
-                new_params[k] = p
-            if s:
-                model_state[k] = s
+        def init_all(key):
+            # one jitted program for ALL param draws — per-param eager init
+            # would emit hundreds of tiny kernels (slow under remote compile)
+            ps: Dict[str, Dict] = {}
+            ss: Dict[str, Dict] = {}
+            for i, layer in enumerate(self.layers):
+                it = self.conf.layer_input_types[i] if self.conf.layer_input_types else None
+                p, s = layer.init(jax.random.fold_in(key, i), it, g)
+                k = _layer_key(i, layer)
+                if p:
+                    ps[k] = p
+                if s:
+                    ss[k] = s
+            return ps, ss
+
+        new_params, model_state = jax.jit(init_all)(jax.random.PRNGKey(g.seed))
         if params is not None:
             new_params = params
         self._tx = self._build_tx(new_params)
@@ -261,7 +266,10 @@ class MultiLayerNetwork:
             for batch in iterator:
                 x, y = jnp.asarray(batch.features), jnp.asarray(batch.labels)
                 fm = None if batch.features_mask is None else jnp.asarray(batch.features_mask)
-                lm = jnp.asarray(batch.labels_mask) if batch.labels_mask is not None else fm
+                # labels mask defaults to the features mask only for
+                # per-timestep labels (reference tBPTT/masking semantics)
+                lm = jnp.asarray(batch.labels_mask) if batch.labels_mask is not None \
+                    else (fm if y.ndim == 3 else None)
                 if self.conf.tbptt_fwd_length and x.ndim == 3:
                     self._fit_tbptt(x, y, fm, lm)
                     continue
@@ -344,7 +352,8 @@ class MultiLayerNetwork:
             return float(self._score)
         x, y = jnp.asarray(dataset.features), jnp.asarray(dataset.labels)
         fm = None if dataset.features_mask is None else jnp.asarray(dataset.features_mask)
-        lm = jnp.asarray(dataset.labels_mask) if dataset.labels_mask is not None else fm
+        lm = jnp.asarray(dataset.labels_mask) if dataset.labels_mask is not None \
+            else (fm if y.ndim == 3 else None)
 
         def score_fn(params, model_state, x_, y_, fm_, lm_):
             loss, _ = self._loss(params, model_state, x_, y_, None, fm_, lm_,

@@ -1,0 +1,116 @@
+"""Transfer learning.
+
+Rebuild of upstream
+``org.deeplearning4j.nn.transferlearning.{TransferLearning, FineTuneConfiguration}``:
+take a trained network, freeze a prefix, replace/append head layers, keep the
+pretrained weights for retained layers. Frozen layers stay in the params
+pytree but receive zero updates (``optax.set_to_zero`` via ``Layer.frozen``) —
+the functional analog of the reference's ``FrozenLayer`` wrapper.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import List, Optional
+
+import jax
+
+from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork, _layer_key
+from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
+
+
+@dataclasses.dataclass
+class FineTuneConfiguration:
+    """Global overrides applied to all non-frozen layers (reference
+    ``FineTuneConfiguration``)."""
+
+    updater: object = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    dropout: Optional[float] = None
+    seed: Optional[int] = None
+
+    def apply(self, conf: MultiLayerConfiguration) -> None:
+        g = conf.global_conf
+        if self.updater is not None:
+            g.updater = self.updater
+        if self.l1 is not None:
+            g.l1 = self.l1
+        if self.l2 is not None:
+            g.l2 = self.l2
+        if self.dropout is not None:
+            g.dropout = self.dropout
+        if self.seed is not None:
+            g.seed = self.seed
+
+
+class TransferLearning:
+    """Builder (reference ``TransferLearning.Builder``)::
+
+        net2 = (TransferLearning.builder(net)
+                .fine_tune_configuration(FineTuneConfiguration(updater=Adam(1e-4)))
+                .set_feature_extractor(3)        # freeze layers 0..3
+                .remove_output_layer()
+                .add_layer(OutputLayer(n_out=5, activation="softmax"))
+                .build())
+    """
+
+    @staticmethod
+    def builder(net: MultiLayerNetwork) -> "TransferLearning.Builder":
+        return TransferLearning.Builder(net)
+
+    class Builder:
+        def __init__(self, net: MultiLayerNetwork):
+            self._net = net
+            self._conf = MultiLayerConfiguration.from_dict(net.conf.to_dict())
+            self._old_params = net.train_state.params if net.train_state else {}
+            self._freeze_until: Optional[int] = None
+            self._fine_tune: Optional[FineTuneConfiguration] = None
+            self._removed_from: Optional[int] = None
+            self._added: List = []
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._fine_tune = ftc
+            return self
+
+        def set_feature_extractor(self, layer_index: int):
+            """Freeze layers [0..layer_index] inclusive (reference semantics)."""
+            self._freeze_until = int(layer_index)
+            return self
+
+        def remove_output_layer(self):
+            return self.remove_layers_from_output(1)
+
+        def remove_layers_from_output(self, n: int):
+            self._removed_from = len(self._conf.layers) - int(n)
+            return self
+
+        def add_layer(self, layer):
+            self._added.append(layer)
+            return self
+
+        def build(self) -> MultiLayerNetwork:
+            conf = self._conf
+            if self._fine_tune:
+                self._fine_tune.apply(conf)
+            keep = conf.layers[: self._removed_from] if self._removed_from is not None \
+                else list(conf.layers)
+            kept_n = len(keep)
+            layers = keep + list(self._added)
+            if self._freeze_until is not None:
+                for i in range(min(self._freeze_until + 1, len(layers))):
+                    layers[i].frozen = True
+            conf.layers = layers
+            conf.preprocessors = {i: pp for i, pp in conf.preprocessors.items()
+                                  if i < kept_n}
+            conf._infer_shapes()
+            net = MultiLayerNetwork(conf).init()
+            # graft pretrained params for kept layers (new layers keep fresh init)
+            new_params = dict(net.train_state.params)
+            for i, layer in enumerate(conf.layers[:kept_n]):
+                k = _layer_key(i, layer)
+                if k in self._old_params:
+                    new_params[k] = jax.tree.map(lambda a: a, self._old_params[k])
+            net.set_params(new_params)
+            return net

@@ -47,6 +47,8 @@ from deeplearning4j_tpu.nn.recurrent_layers import (
     SimpleRnn,
 )
 from deeplearning4j_tpu.nn.attention_layers import (
+    BertEmbeddingLayer,
+    ClsPoolingLayer,
     LearnedPositionalEmbeddingLayer,
     SelfAttentionLayer,
     TransformerEncoderBlock,
@@ -89,4 +91,6 @@ __all__ = [
     "SelfAttentionLayer",
     "TransformerEncoderBlock",
     "LearnedPositionalEmbeddingLayer",
+    "BertEmbeddingLayer",
+    "ClsPoolingLayer",
 ]

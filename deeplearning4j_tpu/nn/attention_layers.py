@@ -161,6 +161,70 @@ class TransformerEncoderBlock(Layer):
 
 @register_layer
 @dataclasses.dataclass
+class BertEmbeddingLayer(Layer):
+    """BERT input embeddings: token + learned position + segment embeddings,
+    LayerNorm, dropout. Input: (batch, time) int32 token ids (single-segment;
+    pair tasks feed segment ids via ComputationGraph with a second
+    EmbeddingSequenceLayer). Reference path: TF-imported BERT's embedding
+    lookup subgraph (SURVEY.md §3.3)."""
+
+    vocab_size: int = 30522
+    d_model: int = 768
+    max_len: int = 512
+    type_vocab_size: int = 2
+    dropout_rate: float = 0.1
+    layer_norm_eps: float = 1e-12
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t = input_type.timesteps if input_type is not None else None
+        return InputType.recurrent(self.d_model, t)
+
+    def init(self, key, input_type, g: GlobalConfig):
+        ks = jax.random.split(key, 3)
+        f = jnp.float32 if g.dtype is None else g.dtype
+        return {
+            "tok": init_weights(ks[0], (self.vocab_size, self.d_model), self._winit(g),
+                                fan=(self.vocab_size, self.d_model), dtype=g.dtype),
+            "pos": init_weights(ks[1], (self.max_len, self.d_model), self._winit(g),
+                                fan=(self.max_len, self.d_model), dtype=g.dtype),
+            "seg": init_weights(ks[2], (self.type_vocab_size, self.d_model), self._winit(g),
+                                fan=(self.type_vocab_size, self.d_model), dtype=g.dtype),
+            "ln_gamma": jnp.ones((self.d_model,), f),
+            "ln_beta": jnp.zeros((self.d_model,), f),
+        }, {}
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        ids = x.astype(jnp.int32)
+        t = ids.shape[1]
+        y = jnp.take(params["tok"], ids, axis=0)
+        y = y + params["pos"][None, :t, :] + params["seg"][0][None, None, :]
+        y = layer_norm(y, params["ln_gamma"], params["ln_beta"], self.layer_norm_eps)
+        if training and rng is not None and self.dropout_rate > 0:
+            keep = 1.0 - self.dropout_rate
+            keep_mask = jax.random.bernoulli(rng, keep, shape=y.shape)
+            y = jnp.where(keep_mask, y / keep, 0.0).astype(y.dtype)
+        return y, state
+
+    def regularizable_params(self):
+        return ()
+
+
+@register_layer
+@dataclasses.dataclass
+class ClsPoolingLayer(Layer):
+    """Extract one timestep (default 0 — BERT's [CLS]) from (batch, time, d)."""
+
+    index: int = 0
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(input_type.size)
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        return x[:, self.index], state
+
+
+@register_layer
+@dataclasses.dataclass
 class LearnedPositionalEmbeddingLayer(Layer):
     """Adds learned positional embeddings (BERT position table)."""
 

@@ -1,0 +1,35 @@
+"""Parallel / distributed training and serving.
+
+TPU-native replacement for the reference's entire scale-out stack (SURVEY.md
+§2.3–2.4): ``ParallelWrapper`` (single-node multi-device DP),
+``ParallelInference`` (multi-replica serving), Spark
+``ParameterAveragingTrainingMaster`` / ``SharedTrainingMaster`` + the Aeron
+``VoidParameterServer`` mesh (multi-node DP with threshold-encoded gradient
+compression).
+
+Design (SURVEY.md §7.1): parallelism is *sharding*, not frameworks. One SPMD
+train step over a ``jax.sharding.Mesh``; XLA inserts fused allreduces over
+ICI/DCN. The reference's four DP flavors collapse into one mechanism — and
+tensor/FSDP/sequence parallelism, which the reference lacks entirely, come
+from the same mechanism with different PartitionSpecs (see
+``docs/parity.md``). Gradient compression (threshold encoding) is an explicit
+non-goal on ICI-class interconnects.
+"""
+
+from deeplearning4j_tpu.parallel.sharding import (
+    ShardingStrategy,
+    shard_batch,
+    shard_train_state,
+)
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+from deeplearning4j_tpu.parallel.inference import ParallelInference
+from deeplearning4j_tpu.parallel.ring_attention import ring_attention
+
+__all__ = [
+    "ShardingStrategy",
+    "shard_batch",
+    "shard_train_state",
+    "ParallelWrapper",
+    "ParallelInference",
+    "ring_attention",
+]

@@ -1,0 +1,142 @@
+"""Sharding strategies: how params/optimizer state/batches map onto a Mesh.
+
+This module is where the reference's parallelism *configuration* surface
+(``ParallelWrapper.Builder``, ``SharedTrainingMaster.Builder``) becomes
+TPU-native: a :class:`ShardingStrategy` names the mesh axes and produces
+`jax.sharding.NamedSharding`s for every leaf of the train state and batch.
+
+Strategies (reference → here):
+
+- ``data_parallel``   — replicate params, shard batch on ``data``: the analog
+  of every DP mode the reference has (param averaging, shared gradients,
+  Spark masters). XLA emits the gradient psum over ICI.
+- ``fsdp``            — additionally shard params/updater state on ``data``
+  (ZeRO-3-style; the reference has nothing comparable — parity-plus).
+- ``tensor_parallel`` — shard weight matrices on ``model`` (Megatron-style
+  alternating column/row split for attention+FFN; parity-plus).
+
+All strategies produce plain NamedShardings consumed by ``jax.jit`` /
+``jax.device_put``; the same code path runs on a simulated CPU mesh and a
+real TPU pod slice (SURVEY.md §7.5 item 5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.runtime.mesh import DATA_AXIS, MODEL_AXIS
+
+
+@dataclasses.dataclass
+class ShardingStrategy:
+    """Produces shardings for state/batch pytrees over a mesh.
+
+    ``param_rule(path, shape) -> PartitionSpec`` decides weight placement;
+    the default replicates everything (pure DP).
+    """
+
+    mesh: Mesh
+    param_rule: Optional[Callable[[Tuple[str, ...], Tuple[int, ...]], P]] = None
+    batch_axis: str = DATA_AXIS
+
+    # ---- factories ----
+    @staticmethod
+    def data_parallel(mesh: Mesh) -> "ShardingStrategy":
+        return ShardingStrategy(mesh=mesh, param_rule=None)
+
+    @staticmethod
+    def fsdp(mesh: Mesh, min_size: int = 1024) -> "ShardingStrategy":
+        """Shard every large param's first divisible axis over the data axis
+        (ZeRO-3 style). Small params stay replicated."""
+        axis_size = mesh.shape[DATA_AXIS]
+
+        def rule(path, shape):
+            if int(np.prod(shape)) < min_size:
+                return P()
+            for dim, s in enumerate(shape):
+                if s % axis_size == 0 and s >= axis_size:
+                    spec = [None] * len(shape)
+                    spec[dim] = DATA_AXIS
+                    return P(*spec)
+            return P()
+
+        return ShardingStrategy(mesh=mesh, param_rule=rule)
+
+    @staticmethod
+    def tensor_parallel(mesh: Mesh) -> "ShardingStrategy":
+        """Megatron-style TP over the ``model`` axis: column-split the
+        first/expanding matmul of a block (W_q/W_k/W_v, FFN in), row-split the
+        contracting one (W_o, FFN out); embedding tables split on vocab."""
+        tp = mesh.shape[MODEL_AXIS]
+
+        COL = ("W_q", "W_k", "W_v", "b_q", "b_k", "b_v", "W_ff1", "b_ff1")
+        ROW = ("W_o", "W_ff2")
+
+        def rule(path, shape):
+            keys = [getattr(p, "key", None) for p in path]
+            leaf = keys[-1] if keys else None
+            if leaf in COL:
+                if shape[-1] % tp == 0:
+                    return P(*([None] * (len(shape) - 1) + [MODEL_AXIS]))
+            if leaf in ROW and len(shape) >= 2:
+                if shape[-2] % tp == 0:
+                    return P(*([None] * (len(shape) - 2) + [MODEL_AXIS, None]))
+            return P()
+
+        return ShardingStrategy(mesh=mesh, param_rule=rule)
+
+    # ---- application ----
+    def param_sharding(self, tree) -> Any:
+        """NamedSharding pytree for params/updater state."""
+        def leaf_sharding(path, leaf):
+            shape = getattr(leaf, "shape", ())
+            spec = self.param_rule(path, tuple(shape)) if self.param_rule else P()
+            # never shard scalars / axes that don't exist
+            if len(spec) > len(shape):
+                spec = P()
+            return NamedSharding(self.mesh, spec)
+
+        return jax.tree_util.tree_map_with_path(leaf_sharding, tree)
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def batch_sharding(self, ndim: int) -> NamedSharding:
+        return NamedSharding(self.mesh, P(self.batch_axis, *([None] * (ndim - 1))))
+
+
+def shard_train_state(state, strategy: ShardingStrategy):
+    """Place a TrainState onto the mesh. Params/opt state follow the param
+    rule; scalars (step counters) replicate."""
+    import dataclasses as dc
+    from deeplearning4j_tpu.models.multi_layer_network import TrainState
+
+    params_sh = strategy.param_sharding(state.params)
+    params = jax.tree.map(jax.device_put, state.params, params_sh)
+    opt_sh = strategy.param_sharding(state.opt_state)
+    opt_state = jax.tree.map(jax.device_put, state.opt_state, opt_sh)
+    model_state = jax.device_put(state.model_state, strategy.replicated())
+    step = jax.device_put(state.step, strategy.replicated())
+    return TrainState(params=params, model_state=model_state,
+                      opt_state=opt_state, step=step)
+
+
+def shard_batch(strategy: ShardingStrategy, *arrays):
+    """Shard batch arrays along the data axis (pad-free: batch must divide
+    by the data-axis size, as in the reference's even data distribution)."""
+    out = []
+    n = strategy.mesh.shape[strategy.batch_axis]
+    for a in arrays:
+        if a is None:
+            out.append(None)
+            continue
+        if a.shape[0] % n:
+            raise ValueError(
+                f"Batch size {a.shape[0]} not divisible by data-parallel size {n}")
+        out.append(jax.device_put(a, strategy.batch_sharding(a.ndim)))
+    return out if len(out) > 1 else out[0]

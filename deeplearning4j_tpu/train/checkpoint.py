@@ -1,0 +1,135 @@
+"""Checkpointing.
+
+Rebuild of upstream ``org.deeplearning4j.optimize.listeners.CheckpointListener``
+(periodic save every N iterations/epochs/minutes with keep-last-K retention)
+plus a TPU-native addition the reference lacks: async, sharded checkpoints via
+orbax (``OrbaxCheckpointer``) so multi-host state saves without stalling the
+device. ``ModelSerializer`` zips remain the portable interchange format;
+orbax is the training-loop format (SURVEY.md §5.4).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional
+
+from deeplearning4j_tpu.train.listeners import TrainingListener, logger
+
+
+class CheckpointListener(TrainingListener):
+    """Save the model periodically (reference semantics + retention).
+
+    Usage::
+
+        net.set_listeners(CheckpointListener(
+            dir="checkpoints", every_n_iterations=500, keep_last=3))
+    """
+
+    def __init__(self, dir: str, every_n_iterations: Optional[int] = None,
+                 every_n_epochs: Optional[int] = None,
+                 every_n_minutes: Optional[float] = None,
+                 keep_last: Optional[int] = None, keep_every: int = 1,
+                 save_updater: bool = True):
+        if not (every_n_iterations or every_n_epochs or every_n_minutes):
+            raise ValueError("Configure at least one of every_n_iterations / "
+                             "every_n_epochs / every_n_minutes")
+        self.dir = dir
+        self.every_n_iterations = every_n_iterations
+        self.every_n_epochs = every_n_epochs
+        self.every_n_minutes = every_n_minutes
+        self.keep_last = keep_last
+        self.keep_every = max(1, int(keep_every))
+        self.save_updater = save_updater
+        self._last_time = time.time()
+        self._saved: List[str] = []
+        self._count = 0
+        os.makedirs(dir, exist_ok=True)
+
+    def _save(self, model, tag: str) -> None:
+        path = os.path.join(self.dir, f"checkpoint_{self._count}_{tag}.zip")
+        model.save(path, save_updater=self.save_updater)
+        self._count += 1
+        if self._count % self.keep_every == 0:
+            self._saved.append(path)
+        else:
+            os.unlink(path)
+            return
+        logger.info("Saved checkpoint: %s", path)
+        if self.keep_last:
+            while len(self._saved) > self.keep_last:
+                old = self._saved.pop(0)
+                if os.path.exists(old):
+                    os.unlink(old)
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if self.every_n_iterations and iteration % self.every_n_iterations == 0:
+            self._save(model, f"iter{iteration}")
+        if self.every_n_minutes and (time.time() - self._last_time) >= 60 * self.every_n_minutes:
+            self._save(model, f"iter{iteration}")
+            self._last_time = time.time()
+
+    def on_epoch_end(self, model, epoch):
+        if self.every_n_epochs and (epoch + 1) % self.every_n_epochs == 0:
+            self._save(model, f"epoch{epoch}")
+
+    def last_checkpoint(self) -> Optional[str]:
+        return self._saved[-1] if self._saved else None
+
+    @staticmethod
+    def last_checkpoint_in(dir: str) -> Optional[str]:
+        files = [f for f in os.listdir(dir)
+                 if f.startswith("checkpoint_") and f.endswith(".zip")]
+        if not files:
+            return None
+        files.sort(key=lambda f: int(f.split("_")[1]))
+        return os.path.join(dir, files[-1])
+
+
+class OrbaxCheckpointer:
+    """Async sharded checkpointing of the raw TrainState (TPU-native path;
+    no reference equivalent — the analog of its role is ModelSerializer).
+
+    Saves params/opt_state/model_state with their shardings preserved;
+    ``restore(net)`` loads back into an initialised network.
+    """
+
+    def __init__(self, dir: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+        self._ocp = ocp
+        self.dir = os.path.abspath(dir)
+        self.mngr = ocp.CheckpointManager(
+            self.dir, options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, enable_async_checkpointing=True))
+
+    def save(self, net, step: Optional[int] = None) -> None:
+        ts = net.train_state
+        step = int(ts.step) if step is None else int(step)
+        self.mngr.save(step, args=self._ocp.args.StandardSave({
+            "params": ts.params, "opt_state": ts.opt_state,
+            "model_state": ts.model_state, "step": ts.step,
+            "iteration": net._iteration, "epoch": net._epoch,
+        }))
+
+    def restore(self, net, step: Optional[int] = None):
+        import dataclasses
+        if net.train_state is None:
+            net.init()
+        ts = net.train_state
+        step = self.mngr.latest_step() if step is None else step
+        target = {"params": ts.params, "opt_state": ts.opt_state,
+                  "model_state": ts.model_state, "step": ts.step,
+                  "iteration": 0, "epoch": 0}
+        restored = self.mngr.restore(step, args=self._ocp.args.StandardRestore(target))
+        net.train_state = dataclasses.replace(
+            ts, params=restored["params"], opt_state=restored["opt_state"],
+            model_state=restored["model_state"], step=restored["step"])
+        net._iteration = int(restored.get("iteration", 0))
+        net._epoch = int(restored.get("epoch", 0))
+        return net
+
+    def wait(self) -> None:
+        self.mngr.wait_until_finished()
+
+    def close(self) -> None:
+        self.mngr.close()

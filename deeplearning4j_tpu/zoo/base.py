@@ -1,0 +1,48 @@
+"""ZooModel SPI (reference ``org.deeplearning4j.zoo.ZooModel``)."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+class ZooModel:
+    """Subclasses implement ``conf()`` (and optionally ``graph_conf()``) and
+    set ``input_shape``/``num_classes``."""
+
+    def __init__(self, num_classes: int = 1000, seed: int = 123, **kwargs):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.kwargs = kwargs
+
+    def conf(self):
+        raise NotImplementedError
+
+    def init(self):
+        """Build + init the network."""
+        conf = self.conf()
+        from deeplearning4j_tpu.models.computation_graph import ComputationGraphConfiguration
+        if isinstance(conf, ComputationGraphConfiguration):
+            from deeplearning4j_tpu.models.computation_graph import ComputationGraph
+            return ComputationGraph(conf).init()
+        from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
+        return MultiLayerNetwork(conf).init()
+
+    # -- pretrained weights: offline-first (reference downloads; we load local)
+    def pretrained_path(self) -> Optional[str]:
+        root = os.environ.get("DL4J_TPU_ZOO_DIR",
+                              os.path.expanduser("~/.deeplearning4j_tpu/zoo"))
+        p = os.path.join(root, f"{type(self).__name__.lower()}.zip")
+        return p if os.path.exists(p) else None
+
+    def init_pretrained(self):
+        path = self.pretrained_path()
+        if path is None:
+            raise FileNotFoundError(
+                f"No pretrained archive for {type(self).__name__}; place a model zip "
+                "under $DL4J_TPU_ZOO_DIR (offline environment — no download mirror)")
+        from deeplearning4j_tpu.models.serializer import ModelSerializer
+        try:
+            return ModelSerializer.restore_computation_graph(path)
+        except Exception:
+            return ModelSerializer.restore_multi_layer_network(path)

@@ -2,13 +2,23 @@
 
 This is the JAX analog of the reference's ``local[N]`` Spark master trick
 (SURVEY.md §4): multi-chip sharding paths are exercised on one host by
-multiplying CPU devices. Must run before the first ``import jax``.
+multiplying CPU devices.
+
+Note: this environment's ``sitecustomize`` registers the axon TPU PJRT
+plugin at interpreter start and forces ``jax_platforms="axon,cpu"`` via
+``jax.config.update`` — which overrides the ``JAX_PLATFORMS`` env var. So we
+must update the config AFTER importing jax (backends initialize lazily, so
+this is safe as long as no ``jax.devices()`` call happened yet).
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

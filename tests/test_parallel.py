@@ -1,0 +1,105 @@
+"""Parallelism tests on the virtual 8-device CPU mesh (SURVEY.md §4 item 3)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import NumpyDataSetIterator
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn import DenseLayer, InputType, NeuralNetConfiguration, OutputLayer
+from deeplearning4j_tpu.parallel import ParallelInference, ParallelWrapper, ShardingStrategy
+from deeplearning4j_tpu.parallel.ring_attention import sequence_parallel_attention
+from deeplearning4j_tpu.runtime.mesh import SEQ_AXIS, create_mesh
+from deeplearning4j_tpu.train import Sgd
+
+
+def _conf(seed=7):
+    return (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .updater(Sgd(0.1))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=4, activation="softmax"))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+
+
+def _data(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0, 1, (n, 8)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, n)]
+    return x, y
+
+
+def test_devices_available():
+    assert len(jax.devices()) == 8, "conftest must provide 8 virtual devices"
+
+
+def test_dp_matches_single_device():
+    """Sharded DP training must be numerically equivalent to single-device
+    training (sync allreduce == the same global batch gradient)."""
+    x, y = _data()
+    it1 = NumpyDataSetIterator(x, y, batch_size=32)
+    it2 = NumpyDataSetIterator(x, y, batch_size=32)
+
+    net1 = MultiLayerNetwork(_conf()).init()
+    net1.fit(it1, epochs=3)
+
+    net2 = MultiLayerNetwork(_conf()).init()
+    pw = ParallelWrapper.builder(net2).strategy("data_parallel").build()
+    pw.fit(it2, epochs=3)
+
+    w1 = np.asarray(net1.params()["layer_0"]["W"])
+    w2 = np.asarray(net2.params()["layer_0"]["W"])
+    np.testing.assert_allclose(w1, w2, rtol=2e-5, atol=2e-6)
+
+
+def test_fsdp_trains():
+    x, y = _data()
+    it = NumpyDataSetIterator(x, y, batch_size=32)
+    net = MultiLayerNetwork(_conf()).init()
+    pw = ParallelWrapper.builder(net).strategy("fsdp").build()
+    pw.fit(it, epochs=2)
+    assert np.isfinite(net.score())
+
+
+def test_batch_not_divisible_raises():
+    from deeplearning4j_tpu.parallel.sharding import shard_batch
+    strat = ShardingStrategy.data_parallel(create_mesh())
+    with pytest.raises(ValueError, match="not divisible"):
+        shard_batch(strat, np.zeros((5, 3), np.float32))
+
+
+def test_parallel_inference_batches():
+    net = MultiLayerNetwork(_conf()).init()
+    pi = ParallelInference(net, max_batch_size=16)
+    x, _ = _data(24)
+    direct = np.asarray(net.output(x[:8]))
+    via_pi = pi.output(x[:8])
+    np.testing.assert_allclose(direct, via_pi, rtol=1e-5)
+    pi.shutdown()
+
+
+def test_ring_attention_matches_full_softmax():
+    mesh = create_mesh({SEQ_AXIS: 8})
+    B, H, T, D = 2, 4, 64, 16
+    rng = np.random.default_rng(3)
+    q = rng.normal(0, 1, (B, H, T, D)).astype(np.float32)
+    k = rng.normal(0, 1, (B, H, T, D)).astype(np.float32)
+    v = rng.normal(0, 1, (B, H, T, D)).astype(np.float32)
+
+    def reference(q, k, v, causal):
+        s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        if causal:
+            mask = np.tril(np.ones((T, T), bool))
+            s = np.where(mask, s, -1e30)
+        w = np.exp(s - s.max(-1, keepdims=True))
+        w = w / w.sum(-1, keepdims=True)
+        return np.einsum("bhqk,bhkd->bhqd", w, v)
+
+    out = np.asarray(sequence_parallel_attention(q, k, v, mesh))
+    np.testing.assert_allclose(out, reference(q, k, v, False), rtol=2e-4, atol=2e-5)
+
+    out_c = np.asarray(sequence_parallel_attention(q, k, v, mesh, causal=True))
+    np.testing.assert_allclose(out_c, reference(q, k, v, True), rtol=2e-4, atol=2e-5)
