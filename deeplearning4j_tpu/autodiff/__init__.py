@@ -1,0 +1,16 @@
+"""Declarative autodiff graph API (SameDiff equivalent).
+
+Rebuild of upstream ``org.nd4j.autodiff.samediff``: symbolic variables
+(VARIABLE / PLACEHOLDER / CONSTANT / ARRAY), op namespaces (``sd.math``,
+``sd.nn``, ``sd.cnn``, ``sd.loss``), training via ``sd.fit()``, and
+save/load. The execution design is the part the reference could only
+approximate: where SameDiff topo-walks its op DAG dispatching one native call
+per op (with a FlatBuffers whole-graph handoff as the fast path — SURVEY.md
+§3.2), here the recorded graph IS a jax-traceable function, so every
+``output()``/``fit()`` call executes one fused XLA program, and autodiff is
+``jax.grad`` of the whole graph instead of per-op ``doDiff`` rules.
+"""
+
+from deeplearning4j_tpu.autodiff.samediff import SDVariable, SameDiff, TrainingConfig
+
+__all__ = ["SameDiff", "SDVariable", "TrainingConfig"]

@@ -1,0 +1,325 @@
+"""Named op registry for the declarative graph.
+
+Every graph op is registered by name so graphs serialize as data (the
+FlatBuffers-schema analog of the reference: op nodes store op NAME + attrs,
+never code). The callables take jnp arrays (+ static attrs) and are traceable
+under jit. Covers the reference's op namespaces used by SameDiff programs and
+the TF importer's op set (upstream ``org.nd4j.autodiff.samediff.ops.*``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+OPS: Dict[str, Callable] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        OPS[name] = fn
+        return fn
+    return deco
+
+
+def get_op(name: str) -> Callable:
+    if name not in OPS:
+        raise KeyError(f"Unknown op {name!r}; registered: {sorted(OPS)[:40]}...")
+    return OPS[name]
+
+
+# ---- elementwise binary ----
+register("add")(lambda a, b: a + b)
+register("sub")(lambda a, b: a - b)
+register("mul")(lambda a, b: a * b)
+register("div")(lambda a, b: a / b)
+register("pow")(lambda a, b: a ** b)
+register("mod")(lambda a, b: jnp.mod(a, b))
+register("maximum")(jnp.maximum)
+register("minimum")(jnp.minimum)
+register("squared_difference")(lambda a, b: (a - b) ** 2)
+register("floordiv")(lambda a, b: jnp.floor_divide(a, b))
+
+# comparisons (float outputs, like the reference)
+register("gt")(lambda a, b: (a > b))
+register("gte")(lambda a, b: (a >= b))
+register("lt")(lambda a, b: (a < b))
+register("lte")(lambda a, b: (a <= b))
+register("eq")(lambda a, b: (a == b))
+register("neq")(lambda a, b: (a != b))
+register("logical_and")(jnp.logical_and)
+register("logical_or")(jnp.logical_or)
+register("logical_not")(jnp.logical_not)
+register("where")(jnp.where)
+
+# ---- elementwise unary ----
+register("neg")(lambda a: -a)
+register("abs")(jnp.abs)
+register("exp")(jnp.exp)
+register("log")(jnp.log)
+register("log1p")(jnp.log1p)
+register("sqrt")(jnp.sqrt)
+register("rsqrt")(lax.rsqrt)
+register("square")(jnp.square)
+register("sign")(jnp.sign)
+register("floor")(jnp.floor)
+register("ceil")(jnp.ceil)
+register("round")(jnp.round)
+register("sin")(jnp.sin)
+register("cos")(jnp.cos)
+register("tan")(jnp.tan)
+register("asin")(jnp.arcsin)
+register("acos")(jnp.arccos)
+register("atan")(jnp.arctan)
+register("sinh")(jnp.sinh)
+register("cosh")(jnp.cosh)
+register("tanh")(jnp.tanh)
+register("erf")(jax.scipy.special.erf)
+register("sigmoid")(jax.nn.sigmoid)
+register("relu")(jax.nn.relu)
+register("relu6")(jax.nn.relu6)
+register("leaky_relu")(lambda a, alpha=0.01: jax.nn.leaky_relu(a, alpha))
+register("elu")(jax.nn.elu)
+register("selu")(jax.nn.selu)
+register("gelu")(jax.nn.gelu)
+register("softplus")(jax.nn.softplus)
+register("softsign")(jax.nn.soft_sign)
+register("swish")(jax.nn.swish)
+register("mish")(jax.nn.mish)
+register("hard_sigmoid")(jax.nn.hard_sigmoid)
+register("reciprocal")(lambda a: 1.0 / a)
+register("clip_by_value")(lambda a, lo=0.0, hi=1.0: jnp.clip(a, lo, hi))
+register("cast")(lambda a, dtype="float32": a.astype(jnp.dtype(dtype)))
+register("identity")(lambda a: a)
+register("stop_gradient")(lax.stop_gradient)
+register("dropout")(lambda a, key=None, rate=0.5: a)  # inference no-op; fit wires rng
+
+
+# ---- matmul / linalg ----
+@register("matmul")
+def _matmul(a, b, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return a @ b
+
+
+register("batch_matmul")(lambda a, b, transpose_a=False, transpose_b=False:
+                         _matmul(a, b, transpose_a, transpose_b))
+register("tensordot")(lambda a, b, axes=2: jnp.tensordot(a, b, axes))
+register("outer")(jnp.outer)
+register("dot")(jnp.dot)
+register("norm2")(lambda a, axis=None: jnp.sqrt(jnp.sum(a * a, axis=axis)))
+register("l2_normalize")(lambda a, axis=-1, eps=1e-12:
+                         a / jnp.sqrt(jnp.maximum(jnp.sum(a * a, axis=axis, keepdims=True), eps)))
+
+# ---- reductions ----
+register("reduce_sum")(lambda a, axis=None, keepdims=False: jnp.sum(a, axis=_ax(axis), keepdims=keepdims))
+register("reduce_mean")(lambda a, axis=None, keepdims=False: jnp.mean(a, axis=_ax(axis), keepdims=keepdims))
+register("reduce_max")(lambda a, axis=None, keepdims=False: jnp.max(a, axis=_ax(axis), keepdims=keepdims))
+register("reduce_min")(lambda a, axis=None, keepdims=False: jnp.min(a, axis=_ax(axis), keepdims=keepdims))
+register("reduce_prod")(lambda a, axis=None, keepdims=False: jnp.prod(a, axis=_ax(axis), keepdims=keepdims))
+register("reduce_var")(lambda a, axis=None, keepdims=False: jnp.var(a, axis=_ax(axis), keepdims=keepdims))
+register("reduce_std")(lambda a, axis=None, keepdims=False: jnp.std(a, axis=_ax(axis), keepdims=keepdims))
+register("argmax")(lambda a, axis=-1: jnp.argmax(a, axis=axis))
+register("argmin")(lambda a, axis=-1: jnp.argmin(a, axis=axis))
+register("cumsum")(lambda a, axis=0: jnp.cumsum(a, axis=axis))
+register("logsumexp")(lambda a, axis=None, keepdims=False:
+                      jax.scipy.special.logsumexp(a, axis=_ax(axis), keepdims=keepdims))
+
+
+def _ax(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+# ---- shape ----
+register("reshape")(lambda a, shape=(): jnp.reshape(a, tuple(int(s) for s in shape)))
+register("transpose")(lambda a, perm=None: jnp.transpose(a, perm))
+register("expand_dims")(lambda a, axis=0: jnp.expand_dims(a, axis))
+register("squeeze")(lambda a, axis=None: jnp.squeeze(a, axis))
+register("concat")(lambda *arrays, axis=0: jnp.concatenate(arrays, axis=axis))
+register("stack")(lambda *arrays, axis=0: jnp.stack(arrays, axis=axis))
+
+
+@register("unstack")
+def _unstack(a, axis=0, num=None):
+    n = num if num is not None else a.shape[axis]
+    return tuple(jnp.squeeze(s, axis) for s in jnp.split(a, n, axis=axis))
+
+
+@register("split")
+def _split(a, num_splits=2, axis=0):
+    return tuple(jnp.split(a, num_splits, axis=axis))
+
+
+register("tile")(lambda a, multiples=(): jnp.tile(a, tuple(int(m) for m in multiples)))
+register("slice")(lambda a, begin=(), size=():
+                  lax.slice(a, tuple(int(b) for b in begin),
+                            tuple(int(b) + int(s) for b, s in zip(begin, size))))
+
+
+@register("strided_slice")
+def _strided_slice(a, begin=(), end=(), strides=None, begin_mask=0, end_mask=0,
+                   shrink_axis_mask=0, new_axis_mask=0, ellipsis_mask=0):
+    # numpy-style basic indexing reconstruction (TF StridedSlice semantics)
+    strides = strides or [1] * len(begin)
+    idx = []
+    in_dim = 0
+    for i in range(len(begin)):
+        if ellipsis_mask & (1 << i):
+            idx.append(Ellipsis)
+            in_dim = a.ndim - (len(begin) - i - 1)
+            continue
+        if new_axis_mask & (1 << i):
+            idx.append(None)
+            continue
+        b = None if (begin_mask & (1 << i)) else int(begin[i])
+        e = None if (end_mask & (1 << i)) else int(end[i])
+        s = int(strides[i])
+        if shrink_axis_mask & (1 << i):
+            idx.append(int(begin[i]))
+        else:
+            idx.append(slice(b, e, s))
+        in_dim += 1
+    return a[tuple(idx)]
+
+
+register("gather")(lambda a, indices, axis=0: jnp.take(a, indices.astype(jnp.int32), axis=axis))
+
+
+@register("gather_nd")
+def _gather_nd(a, indices):
+    idx = tuple(jnp.moveaxis(indices.astype(jnp.int32), -1, 0))
+    return a[idx]
+
+
+@register("scatter_update")
+def _scatter_update(a, indices, updates):
+    return a.at[indices.astype(jnp.int32)].set(updates)
+
+
+register("one_hot")(lambda a, depth=2, on_value=1.0, off_value=0.0, axis=-1:
+                    jax.nn.one_hot(a.astype(jnp.int32), depth, axis=axis) * (on_value - off_value) + off_value)
+register("pad")(lambda a, paddings=(), constant_value=0.0:
+                jnp.pad(a, tuple(tuple(int(x) for x in p) for p in paddings),
+                        constant_values=constant_value))
+register("reverse")(lambda a, axis=0: jnp.flip(a, axis))
+register("shape_of")(lambda a: jnp.asarray(a.shape, jnp.int32))
+register("size")(lambda a: jnp.asarray(a.size, jnp.int32))
+register("rank")(lambda a: jnp.asarray(a.ndim, jnp.int32))
+register("fill")(lambda shape, value=0.0: jnp.full(tuple(int(s) for s in shape), value))
+register("zeros_like")(jnp.zeros_like)
+register("ones_like")(jnp.ones_like)
+register("linspace")(lambda start=0.0, stop=1.0, num=10: jnp.linspace(start, stop, int(num)))
+register("range")(lambda start=0, limit=10, delta=1: jnp.arange(start, limit, delta))
+
+# ---- nn ----
+register("softmax")(lambda a, axis=-1: jax.nn.softmax(a, axis=axis))
+register("log_softmax")(lambda a, axis=-1: jax.nn.log_softmax(a, axis=axis))
+
+
+@register("layer_norm")
+def _layer_norm(x, gain, bias=None, axis=-1, eps=1e-5):
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + eps) * gain
+    return out + bias if bias is not None else out
+
+
+@register("batch_norm")
+def _batch_norm(x, mean, variance, gamma=None, beta=None, eps=1e-5):
+    out = (x - mean) * lax.rsqrt(variance + eps)
+    if gamma is not None:
+        out = out * gamma
+    if beta is not None:
+        out = out + beta
+    return out
+
+
+@register("bias_add")
+def _bias_add(x, bias):
+    return x + bias
+
+
+@register("linear")
+def _linear(x, w, b=None):
+    y = x @ w
+    return y + b if b is not None else y
+
+
+@register("conv2d")
+def _conv2d(x, w, b=None, stride=(1, 1), padding="SAME", dilation=(1, 1)):
+    y = lax.conv_general_dilated(x, w, window_strides=tuple(stride), padding=padding,
+                                 rhs_dilation=tuple(dilation),
+                                 dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return y + b if b is not None else y
+
+
+@register("max_pool2d")
+def _max_pool2d(x, kernel=(2, 2), stride=(2, 2), padding="VALID"):
+    return lax.reduce_window(x, -jnp.inf, lax.max, (1, *kernel, 1), (1, *stride, 1), padding)
+
+
+@register("avg_pool2d")
+def _avg_pool2d(x, kernel=(2, 2), stride=(2, 2), padding="VALID"):
+    s = lax.reduce_window(x, 0.0, lax.add, (1, *kernel, 1), (1, *stride, 1), padding)
+    c = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, (1, *kernel, 1), (1, *stride, 1), padding)
+    return s / c
+
+
+@register("multi_head_dot_product_attention")
+def _mhdpa(q, k, v, mask=None, scaled=True):
+    """(batch, heads, time, d) attention — the reference's
+    ``multiHeadDotProductAttention`` op."""
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k)
+    if scaled:
+        s = s / jnp.sqrt(jnp.asarray(d, s.dtype))
+    if mask is not None:
+        s = jnp.where(mask.astype(bool), s, -1e9)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
+
+
+# ---- losses (fused stable forms) ----
+@register("softmax_cross_entropy")
+def _sce(labels, logits, axis=-1):
+    return jnp.mean(-jnp.sum(labels * jax.nn.log_softmax(logits, axis=axis), axis=axis))
+
+
+@register("sparse_softmax_cross_entropy")
+def _ssce(labels, logits):
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(lp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+@register("sigmoid_cross_entropy")
+def _sigce(labels, logits):
+    per = jnp.maximum(logits, 0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    return jnp.mean(jnp.sum(per, axis=-1))
+
+
+register("mean_squared_error")(lambda labels, pred: jnp.mean(jnp.sum((pred - labels) ** 2, axis=-1)))
+register("mean_absolute_error")(lambda labels, pred: jnp.mean(jnp.sum(jnp.abs(pred - labels), axis=-1)))
+register("l2_loss")(lambda a: 0.5 * jnp.sum(a * a))
+register("log_loss")(lambda labels, pred, eps=1e-7:
+                     -jnp.mean(jnp.sum(labels * jnp.log(pred + eps)
+                                       + (1 - labels) * jnp.log(1 - pred + eps), axis=-1)))
+register("cosine_distance")(lambda labels, pred, axis=-1:
+                            jnp.mean(1.0 - jnp.sum(labels * pred, axis=axis)
+                                     / jnp.maximum(jnp.linalg.norm(labels, axis=axis)
+                                                   * jnp.linalg.norm(pred, axis=axis), 1e-12)))
+register("hinge_loss")(lambda labels, pred:
+                       jnp.mean(jnp.sum(jnp.maximum(0.0, 1.0 - jnp.where(labels > 0, 1.0, -1.0) * pred), axis=-1)))
+register("huber_loss")(lambda labels, pred, delta=1.0:
+                       jnp.mean(jnp.sum(jnp.where(jnp.abs(pred - labels) <= delta,
+                                                  0.5 * (pred - labels) ** 2,
+                                                  delta * (jnp.abs(pred - labels) - 0.5 * delta)), axis=-1)))

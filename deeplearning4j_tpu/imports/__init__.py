@@ -1,0 +1,16 @@
+"""Model import.
+
+Rebuild of the reference's import stack:
+
+- ``TFGraphMapper`` (upstream ``org.nd4j.imports.graphmapper.tf``): frozen TF
+  GraphDef protobuf → declarative graph. Parsing uses the local tensorflow
+  (CPU) wheel as the protobuf/tensor decoder; execution is entirely this
+  framework's (SameDiff-equivalent → XLA).
+- ``KerasModelImport`` (upstream ``org.deeplearning4j.nn.modelimport.keras``):
+  Keras H5/SavedModel → MultiLayerNetwork / ComputationGraph with weights.
+"""
+
+from deeplearning4j_tpu.imports.tf_import import TFGraphMapper
+from deeplearning4j_tpu.imports.keras_import import KerasModelImport
+
+__all__ = ["TFGraphMapper", "KerasModelImport"]

@@ -1,0 +1,340 @@
+"""TF GraphDef import.
+
+Rebuild of upstream ``org.nd4j.imports.graphmapper.tf.TFGraphMapper``
+(SURVEY.md §3.3): parse a frozen GraphDef, constant-fold ``Const`` nodes,
+map each node to a registry op on a SameDiff-equivalent graph. The op set
+covers the BERT-base inference/fine-tune graph (matmul/batched-matmul,
+gather, strided-slice, layernorm building blocks, softmax, gelu-via-erf,
+reshape/transpose family) plus the common CNN ops.
+
+Static-attr folding: TF passes shapes/axes as Const *tensor inputs*; the
+importer resolves those at import time into op attrs (the reference does the
+same in each op's ``initFromTensorFlow``), so the resulting graph is
+shape-static and jit-compiles cleanly.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff, VariableType
+
+
+def _tf():
+    import tensorflow as tf
+    return tf
+
+
+class TFGraphMapper:
+    @staticmethod
+    def import_graph(path_or_graphdef, input_shapes: Optional[Dict[str, tuple]] = None
+                     ) -> SameDiff:
+        """Import a frozen .pb file (or a GraphDef proto) into a SameDiff."""
+        tf = _tf()
+        if isinstance(path_or_graphdef, (str, bytes)):
+            gd = tf.compat.v1.GraphDef()
+            with open(path_or_graphdef, "rb") as f:
+                gd.ParseFromString(f.read())
+        else:
+            gd = path_or_graphdef
+        return _GraphImporter(gd, input_shapes or {}).run()
+
+
+class _GraphImporter:
+    def __init__(self, graph_def, input_shapes: Dict[str, tuple]):
+        self.gd = graph_def
+        self.input_shapes = input_shapes
+        self.sd = SameDiff.create()
+        self.const_values: Dict[str, np.ndarray] = {}
+        self.node_by_name = {n.name: n for n in self.gd.node}
+
+    # --- helpers ---
+    @staticmethod
+    def _clean(name: str) -> str:
+        name = name.split(":")[0]
+        return name[1:] if name.startswith("^") else name
+
+    def _const(self, name: str) -> np.ndarray:
+        """Resolve a (possibly Identity-wrapped) constant input's value."""
+        name = self._clean(name)
+        if name in self.const_values:
+            return self.const_values[name]
+        node = self.node_by_name.get(name)
+        if node is not None and node.op in ("Identity", "Cast", "StopGradient"):
+            return self._const(node.input[0])
+        raise ValueError(f"Input {name!r} is not a constant (op="
+                         f"{node.op if node else '?'}) — cannot fold statically")
+
+    def _attr(self, node, key, default=None):
+        if key not in node.attr:
+            return default
+        a = node.attr[key]
+        kind = a.WhichOneof("value")
+        if kind == "i":
+            return int(a.i)
+        if kind == "f":
+            return float(a.f)
+        if kind == "b":
+            return bool(a.b)
+        if kind == "s":
+            return a.s.decode()
+        if kind == "type":
+            return _tf().dtypes.as_dtype(a.type).name
+        if kind == "shape":
+            return tuple(d.size for d in a.shape.dim)
+        if kind == "list":
+            return list(a.list.i) or list(a.list.f) or [s.decode() for s in a.list.s]
+        return default
+
+    def _ensure_var(self, name: str) -> str:
+        """Map a TF input ref to an sd variable name (materialising consts)."""
+        name = self._clean(name)
+        if name in self.sd.vars:
+            return name
+        if name in self.const_values:
+            arr = self.const_values[name]
+            v = self.sd.constant(name, arr)
+            # constant() may uniquify; force exact name mapping
+            if v.name != name:
+                v.rename(name)
+            return name
+        raise ValueError(f"Unresolved input {name!r}")
+
+    def _emit(self, node, op: str, inputs: List[str], **attrs):
+        vars_ = [self.sd.vars[self._ensure_var(i)] for i in inputs]
+        out = self.sd._apply(op, vars_, attrs=attrs or None, name=node.name)
+        if out.name != node.name:
+            out.rename(node.name)
+        return out
+
+    # --- main loop ---
+    def run(self) -> SameDiff:
+        tf = _tf()
+        from tensorflow.python.framework import tensor_util
+
+        for node in self.gd.node:
+            if node.op == "Const":
+                self.const_values[node.name] = tensor_util.MakeNdarray(
+                    node.attr["value"].tensor)
+        for node in self.gd.node:
+            self._map_node(node)
+        return self.sd
+
+    def _inputs(self, node) -> List[str]:
+        return [i for i in node.input if not i.startswith("^")]
+
+    def _map_node(self, node) -> None:
+        op = node.op
+        ins = self._inputs(node)
+        sd = self.sd
+
+        if op == "Const":
+            return  # materialised lazily on first use
+        if op in ("Placeholder", "PlaceholderWithDefault"):
+            shape = self.input_shapes.get(node.name) or self._attr(node, "shape")
+            if shape is not None:
+                shape = tuple(None if s in (-1, 0) else s for s in shape)
+            v = sd.placeholder(node.name, shape)
+            if v.name != node.name:
+                v.rename(node.name)
+            return
+        if op in ("Identity", "StopGradient", "PreventGradient", "CheckNumerics",
+                  "NoOp", "IdentityN"):
+            if not ins:
+                return
+            src = self._clean(ins[0])
+            if src in self.const_values and src not in sd.vars:
+                self.const_values[node.name] = self.const_values[src]
+                return
+            self._emit(node, "identity", [ins[0]])
+            return
+        if op == "VariableV2" or op == "VarHandleOp":
+            raise ValueError("Graph contains un-frozen variables; freeze it first "
+                             "(reference requires frozen graphs too)")
+
+        simple = {
+            "Add": "add", "AddV2": "add", "Sub": "sub", "Mul": "mul",
+            "RealDiv": "div", "Div": "div", "Maximum": "maximum",
+            "Minimum": "minimum", "Pow": "pow", "SquaredDifference": "squared_difference",
+            "FloorDiv": "floordiv", "FloorMod": "mod",
+            "Sqrt": "sqrt", "Rsqrt": "rsqrt", "Square": "square", "Exp": "exp",
+            "Log": "log", "Log1p": "log1p", "Neg": "neg", "Abs": "abs", "Sign": "sign",
+            "Floor": "floor", "Ceil": "ceil", "Round": "round", "Erf": "erf",
+            "Tanh": "tanh", "Sigmoid": "sigmoid", "Relu": "relu", "Relu6": "relu6",
+            "Elu": "elu", "Selu": "selu", "Softplus": "softplus", "Softsign": "softsign",
+            "Sin": "sin", "Cos": "cos", "Tan": "tan",
+            "Greater": "gt", "GreaterEqual": "gte", "Less": "lt", "LessEqual": "lte",
+            "Equal": "eq", "NotEqual": "neq", "LogicalAnd": "logical_and",
+            "LogicalOr": "logical_or", "LogicalNot": "logical_not",
+            "Softmax": "softmax", "LogSoftmax": "log_softmax",
+            "BiasAdd": "bias_add", "Reciprocal": "reciprocal",
+            "ZerosLike": "zeros_like", "OnesLike": "ones_like",
+            "L2Loss": "l2_loss", "Tile": None, "Select": "where", "SelectV2": "where",
+        }
+        if op in simple and simple[op]:
+            self._emit(node, simple[op], ins)
+            return
+
+        if op == "MatMul":
+            self._emit(node, "matmul", ins,
+                       transpose_a=self._attr(node, "transpose_a", False),
+                       transpose_b=self._attr(node, "transpose_b", False))
+            return
+        if op in ("BatchMatMul", "BatchMatMulV2", "BatchMatMulV3"):
+            self._emit(node, "batch_matmul", ins,
+                       transpose_a=self._attr(node, "adj_x", False),
+                       transpose_b=self._attr(node, "adj_y", False))
+            return
+        if op == "Reshape":
+            shape = self._const(ins[1]).astype(np.int64)
+            self._emit(node, "reshape", ins[:1], shape=[int(s) for s in shape])
+            return
+        if op == "Transpose":
+            perm = [int(p) for p in self._const(ins[1])]
+            self._emit(node, "transpose", ins[:1], perm=perm)
+            return
+        if op == "ExpandDims":
+            axis = int(self._const(ins[1]))
+            self._emit(node, "expand_dims", ins[:1], axis=axis)
+            return
+        if op == "Squeeze":
+            dims = self._attr(node, "squeeze_dims") or None
+            self._emit(node, "squeeze", ins,
+                       axis=tuple(dims) if dims else None)
+            return
+        if op in ("ConcatV2", "Concat"):
+            if op == "ConcatV2":
+                axis = int(self._const(ins[-1]))
+                data = ins[:-1]
+            else:
+                axis = int(self._const(ins[0]))
+                data = ins[1:]
+            self._emit(node, "concat", data, axis=axis)
+            return
+        if op == "Pack":
+            self._emit(node, "stack", ins, axis=self._attr(node, "axis", 0))
+            return
+        if op == "Unpack":
+            n = self._attr(node, "num")
+            vars_ = [sd.vars[self._ensure_var(ins[0])]]
+            outs = sd._apply("unstack", vars_,
+                             attrs={"axis": self._attr(node, "axis", 0), "num": n},
+                             name=node.name, n_outputs=n)
+            outs = outs if isinstance(outs, tuple) else (outs,)
+            for i, o in enumerate(outs):
+                want = node.name if i == 0 else f"{node.name}:{i}"
+                if o.name != want:
+                    o.rename(want)
+            return
+        if op == "Split":
+            n = self._attr(node, "num_split")
+            axis = int(self._const(ins[0]))
+            vars_ = [sd.vars[self._ensure_var(ins[1])]]
+            outs = sd._apply("split", vars_, attrs={"num_splits": n, "axis": axis},
+                             name=node.name, n_outputs=n)
+            outs = outs if isinstance(outs, tuple) else (outs,)
+            for i, o in enumerate(outs):
+                want = node.name if i == 0 else f"{node.name}:{i}"
+                if o.name != want:
+                    o.rename(want)
+            return
+        if op == "Tile":
+            mult = [int(m) for m in self._const(ins[1])]
+            self._emit(node, "tile", ins[:1], multiples=mult)
+            return
+        if op == "Slice":
+            begin = [int(b) for b in self._const(ins[1])]
+            size = [int(s) for s in self._const(ins[2])]
+            self._emit(node, "slice", ins[:1], begin=begin, size=size)
+            return
+        if op == "StridedSlice":
+            self._emit(node, "strided_slice", ins[:1],
+                       begin=[int(b) for b in self._const(ins[1])],
+                       end=[int(e) for e in self._const(ins[2])],
+                       strides=[int(s) for s in self._const(ins[3])],
+                       begin_mask=self._attr(node, "begin_mask", 0),
+                       end_mask=self._attr(node, "end_mask", 0),
+                       shrink_axis_mask=self._attr(node, "shrink_axis_mask", 0),
+                       new_axis_mask=self._attr(node, "new_axis_mask", 0),
+                       ellipsis_mask=self._attr(node, "ellipsis_mask", 0))
+            return
+        if op in ("GatherV2", "Gather"):
+            axis = int(self._const(ins[2])) if len(ins) > 2 else 0
+            self._emit(node, "gather", ins[:2], axis=axis)
+            return
+        if op == "GatherNd":
+            self._emit(node, "gather_nd", ins[:2])
+            return
+        if op == "OneHot":
+            depth = int(self._const(ins[1]))
+            on = float(self._const(ins[2])) if len(ins) > 2 else 1.0
+            off = float(self._const(ins[3])) if len(ins) > 3 else 0.0
+            self._emit(node, "one_hot", ins[:1], depth=depth, on_value=on,
+                       off_value=off, axis=self._attr(node, "axis", -1))
+            return
+        if op == "Cast":
+            self._emit(node, "cast", ins, dtype=_np_dtype(self._attr(node, "DstT")))
+            return
+        if op in ("Mean", "Sum", "Max", "Min", "Prod"):
+            axis = self._const(ins[1])
+            axis = [int(a) for a in np.atleast_1d(axis)]
+            red = {"Mean": "reduce_mean", "Sum": "reduce_sum", "Max": "reduce_max",
+                   "Min": "reduce_min", "Prod": "reduce_prod"}[op]
+            self._emit(node, red, ins[:1], axis=axis,
+                       keepdims=self._attr(node, "keep_dims", False))
+            return
+        if op in ("ArgMax", "ArgMin"):
+            axis = int(self._const(ins[1])) if len(ins) > 1 else -1
+            self._emit(node, "argmax" if op == "ArgMax" else "argmin", ins[:1], axis=axis)
+            return
+        if op == "Pad" or op == "PadV2":
+            pads = [[int(a), int(b)] for a, b in self._const(ins[1])]
+            cv = float(self._const(ins[2])) if op == "PadV2" else 0.0
+            self._emit(node, "pad", ins[:1], paddings=pads, constant_value=cv)
+            return
+        if op == "Shape":
+            # static fold if the producer's shape is known at import time
+            self._emit(node, "shape_of", ins[:1])
+            return
+        if op == "Fill":
+            shape = [int(s) for s in self._const(ins[0])]
+            value = float(self._const(ins[1]))
+            arr = np.full(shape, value, np.float32)
+            self.const_values[node.name] = arr
+            return
+        if op == "Range":
+            start, limit, delta = (self._const(i) for i in ins[:3])
+            self.const_values[node.name] = np.arange(start, limit, delta)
+            return
+        if op == "Conv2D":
+            strides = self._attr(node, "strides", [1, 1, 1, 1])
+            self._emit(node, "conv2d", ins[:2],
+                       stride=[int(strides[1]), int(strides[2])],
+                       padding=self._attr(node, "padding", "SAME"))
+            return
+        if op in ("MaxPool", "AvgPool"):
+            k = self._attr(node, "ksize", [1, 2, 2, 1])
+            s = self._attr(node, "strides", [1, 2, 2, 1])
+            self._emit(node, "max_pool2d" if op == "MaxPool" else "avg_pool2d",
+                       ins[:1], kernel=[int(k[1]), int(k[2])],
+                       stride=[int(s[1]), int(s[2])],
+                       padding=self._attr(node, "padding", "VALID"))
+            return
+        if op in ("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3"):
+            # inference form: (x, gamma, beta, mean, var)
+            x, gamma, beta, mean, var = ins[:5]
+            self._emit(node, "batch_norm", [x, mean, var, gamma, beta],
+                       eps=self._attr(node, "epsilon", 1e-3))
+            return
+
+        raise NotImplementedError(
+            f"TF op {op!r} (node {node.name!r}) is not mapped; "
+            f"extend deeplearning4j_tpu/imports/tf_import.py")
+
+
+def _np_dtype(tf_name: str) -> str:
+    return {"float": "float32", "double": "float64", "int32": "int32",
+            "int64": "int32", "bool": "bool", "half": "float16",
+            "bfloat16": "bfloat16"}.get(tf_name, tf_name or "float32")

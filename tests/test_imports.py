@@ -1,0 +1,127 @@
+"""Model-import tests: golden-file pattern (SURVEY.md §4) with the local TF
+as the oracle — build a graph/model with TF, record its output, import into
+this framework, compare."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+
+def _frozen_graphdef(fn, input_specs):
+    """Trace fn to a frozen (constant-folded) GraphDef."""
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+    conc = tf.function(fn).get_concrete_function(*input_specs)
+    frozen = convert_variables_to_constants_v2(conc)
+    return frozen.graph.as_graph_def(), [t.name.split(":")[0] for t in frozen.inputs], \
+        [t.name.split(":")[0] for t in frozen.outputs]
+
+
+def test_tf_import_mlp():
+    from deeplearning4j_tpu.imports import TFGraphMapper
+    w1 = tf.constant(np.random.default_rng(0).normal(0, 1, (8, 16)).astype(np.float32))
+    b1 = tf.constant(np.zeros(16, np.float32))
+    w2 = tf.constant(np.random.default_rng(1).normal(0, 1, (16, 3)).astype(np.float32))
+
+    def model(x):
+        h = tf.nn.relu(tf.matmul(x, w1) + b1)
+        return tf.nn.softmax(tf.matmul(h, w2))
+
+    gd, inputs, outputs = _frozen_graphdef(
+        model, [tf.TensorSpec((None, 8), tf.float32, name="x")])
+    sd = TFGraphMapper.import_graph(gd)
+    x = np.random.default_rng(2).normal(0, 1, (4, 8)).astype(np.float32)
+    expected = model(tf.constant(x)).numpy()
+    got = np.asarray(sd.output({inputs[0]: x}, outputs[0]))
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_tf_import_attention_block():
+    """Mini transformer block — the BERT-shaped op set (batched matmul,
+    layernorm primitives, gelu-via-erf, reshape/transpose/softmax)."""
+    from deeplearning4j_tpu.imports import TFGraphMapper
+    rng = np.random.default_rng(0)
+    D, H = 16, 4
+    wq = tf.constant(rng.normal(0, 0.1, (D, D)).astype(np.float32))
+    wk = tf.constant(rng.normal(0, 0.1, (D, D)).astype(np.float32))
+    wv = tf.constant(rng.normal(0, 0.1, (D, D)).astype(np.float32))
+    gamma = tf.constant(np.ones(D, np.float32))
+    beta = tf.constant(np.zeros(D, np.float32))
+
+    def block(x):  # x: (B, T, D)
+        B, T = tf.shape(x)[0], tf.shape(x)[1]
+        q = tf.reshape(x @ wq, (2, 8, H, D // H))
+        k = tf.reshape(x @ wk, (2, 8, H, D // H))
+        v = tf.reshape(x @ wv, (2, 8, H, D // H))
+        q = tf.transpose(q, (0, 2, 1, 3))
+        k = tf.transpose(k, (0, 2, 1, 3))
+        v = tf.transpose(v, (0, 2, 1, 3))
+        s = tf.matmul(q, k, transpose_b=True) / tf.sqrt(float(D // H))
+        a = tf.matmul(tf.nn.softmax(s, axis=-1), v)
+        a = tf.reshape(tf.transpose(a, (0, 2, 1, 3)), (2, 8, D))
+        y = x + a
+        mean, var = tf.nn.moments(y, axes=[-1], keepdims=True)
+        y = (y - mean) * tf.math.rsqrt(var + 1e-6) * gamma + beta
+        # gelu via erf (BERT's formulation)
+        return 0.5 * y * (1.0 + tf.math.erf(y / np.sqrt(2.0).astype(np.float32)))
+
+    gd, inputs, outputs = _frozen_graphdef(
+        block, [tf.TensorSpec((2, 8, D), tf.float32, name="x")])
+    sd = TFGraphMapper.import_graph(gd)
+    x = np.random.default_rng(3).normal(0, 1, (2, 8, D)).astype(np.float32)
+    expected = block(tf.constant(x)).numpy()
+    got = np.asarray(sd.output({inputs[0]: x}, outputs[0]))
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_keras_sequential_import(tmp_path):
+    from deeplearning4j_tpu.imports import KerasModelImport
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((12,)),
+        tf.keras.layers.Dense(24, activation="relu"),
+        tf.keras.layers.Dense(5, activation="softmax"),
+    ])
+    path = str(tmp_path / "model.keras")
+    km.save(path)
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    x = np.random.default_rng(0).normal(0, 1, (6, 12)).astype(np.float32)
+    expected = km(x).numpy()
+    got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_keras_cnn_import(tmp_path):
+    from deeplearning4j_tpu.imports import KerasModelImport
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((16, 16, 3)),
+        tf.keras.layers.Conv2D(8, 3, padding="same", activation="relu"),
+        tf.keras.layers.MaxPooling2D(2),
+        tf.keras.layers.Conv2D(16, 3, padding="valid", activation="relu"),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(4, activation="softmax"),
+    ])
+    path = str(tmp_path / "cnn.keras")
+    km.save(path)
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    x = np.random.default_rng(0).normal(0, 1, (2, 16, 16, 3)).astype(np.float32)
+    expected = km(x).numpy()
+    got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_keras_functional_import(tmp_path):
+    from deeplearning4j_tpu.imports import KerasModelImport
+    inp = tf.keras.layers.Input((10,), name="in0")
+    a = tf.keras.layers.Dense(16, activation="relu")(inp)
+    b = tf.keras.layers.Dense(16, activation="tanh")(inp)
+    merged = tf.keras.layers.Add()([a, b])
+    out = tf.keras.layers.Dense(3, activation="softmax")(merged)
+    km = tf.keras.Model(inp, out)
+    path = str(tmp_path / "func.keras")
+    km.save(path)
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    x = np.random.default_rng(0).normal(0, 1, (5, 10)).astype(np.float32)
+    expected = km(x).numpy()
+    got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
