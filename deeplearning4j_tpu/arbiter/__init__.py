@@ -1,0 +1,46 @@
+"""Hyperparameter optimization (Arbiter equivalent).
+
+Rebuild of upstream ``arbiter`` (``org.deeplearning4j.arbiter``): parameter
+spaces, random/grid candidate generation, a local optimization runner with
+score functions and result tracking. The reference parameterises its conf
+builders with ``ParameterSpace<T>`` fields; here a candidate is a plain dict
+sampled from named spaces and handed to a user config factory — same
+search loop, configs stay data.
+
+Usage::
+
+    space = {
+        "lr": ContinuousParameterSpace(1e-4, 1e-1, log_scale=True),
+        "hidden": DiscreteParameterSpace(32, 64, 128),
+    }
+    def factory(c):
+        return (NeuralNetConfiguration.builder().updater(Adam(c["lr"])).list()
+                .layer(DenseLayer(n_out=c["hidden"], activation="relu"))
+                .layer(OutputLayer(n_out=3, activation="softmax"))
+                .set_input_type(InputType.feed_forward(4)).build())
+    runner = LocalOptimizationRunner(
+        factory, space, RandomSearchGenerator(16, seed=1),
+        score_function=EvaluationScoreFunction("accuracy"),
+        train_iterator=train_it, eval_iterator=test_it, epochs=3)
+    best = runner.execute()
+"""
+
+from deeplearning4j_tpu.arbiter.spaces import (
+    ContinuousParameterSpace,
+    DiscreteParameterSpace,
+    IntegerParameterSpace,
+)
+from deeplearning4j_tpu.arbiter.runner import (
+    EvaluationScoreFunction,
+    GridSearchGenerator,
+    LocalOptimizationRunner,
+    LossScoreFunction,
+    OptimizationResult,
+    RandomSearchGenerator,
+)
+
+__all__ = [
+    "ContinuousParameterSpace", "DiscreteParameterSpace", "IntegerParameterSpace",
+    "RandomSearchGenerator", "GridSearchGenerator", "LocalOptimizationRunner",
+    "EvaluationScoreFunction", "LossScoreFunction", "OptimizationResult",
+]

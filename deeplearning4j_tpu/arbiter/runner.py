@@ -1,0 +1,138 @@
+"""Optimization runner (reference
+``org.deeplearning4j.arbiter.optimize.runner.LocalOptimizationRunner`` +
+candidate generators + score functions)."""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.arbiter.spaces import ParameterSpace
+
+
+class CandidateGenerator:
+    def candidates(self, spaces: Dict[str, ParameterSpace]):
+        raise NotImplementedError
+
+
+class RandomSearchGenerator(CandidateGenerator):
+    def __init__(self, num_candidates: int, seed: int = 0):
+        self.num_candidates = int(num_candidates)
+        self.seed = seed
+
+    def candidates(self, spaces):
+        rng = np.random.default_rng(self.seed)
+        for _ in range(self.num_candidates):
+            yield {k: s.sample(rng) for k, s in spaces.items()}
+
+
+class GridSearchGenerator(CandidateGenerator):
+    def __init__(self, discretization: int = 4):
+        self.discretization = discretization
+
+    def candidates(self, spaces):
+        keys = list(spaces.keys())
+        grids = [spaces[k].grid_values(self.discretization) for k in keys]
+        for combo in itertools.product(*grids):
+            yield dict(zip(keys, combo))
+
+
+class ScoreFunction:
+    minimize = True
+
+    def score(self, model, eval_iterator) -> float:
+        raise NotImplementedError
+
+
+class EvaluationScoreFunction(ScoreFunction):
+    """Score = classification metric on the eval iterator (maximized)."""
+
+    minimize = False
+
+    def __init__(self, metric: str = "accuracy"):
+        self.metric = metric
+
+    def score(self, model, eval_iterator):
+        ev = model.evaluate(eval_iterator)
+        return float(getattr(ev, self.metric)())
+
+
+class LossScoreFunction(ScoreFunction):
+    """Score = average loss over the eval iterator (minimized)."""
+
+    minimize = True
+
+    def score(self, model, eval_iterator):
+        eval_iterator.reset()
+        losses = [model.score(b) for b in eval_iterator]
+        return float(np.mean(losses))
+
+
+@dataclasses.dataclass
+class OptimizationResult:
+    index: int
+    candidate: Dict[str, Any]
+    score: float
+    duration_s: float
+    model: Any = None
+
+
+class LocalOptimizationRunner:
+    def __init__(self, config_factory: Callable[[Dict[str, Any]], Any],
+                 spaces: Dict[str, ParameterSpace],
+                 generator: CandidateGenerator,
+                 score_function: ScoreFunction,
+                 train_iterator, eval_iterator,
+                 epochs: int = 1, keep_models: bool = False,
+                 listeners: Optional[List[Callable]] = None):
+        self.config_factory = config_factory
+        self.spaces = spaces
+        self.generator = generator
+        self.score_function = score_function
+        self.train_iterator = train_iterator
+        self.eval_iterator = eval_iterator
+        self.epochs = epochs
+        self.keep_models = keep_models
+        self.listeners = listeners or []
+        self.results: List[OptimizationResult] = []
+
+    def execute(self) -> OptimizationResult:
+        """Run all candidates; returns the best result (all results in
+        ``self.results``)."""
+        from deeplearning4j_tpu.models.computation_graph import (
+            ComputationGraph, ComputationGraphConfiguration)
+        from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
+        best: Optional[OptimizationResult] = None
+        for i, candidate in enumerate(self.generator.candidates(self.spaces)):
+            t0 = time.perf_counter()
+            conf = self.config_factory(candidate)
+            if isinstance(conf, ComputationGraphConfiguration):
+                model = ComputationGraph(conf).init()
+            else:
+                model = MultiLayerNetwork(conf).init()
+            self.train_iterator.reset()
+            model.fit(self.train_iterator, epochs=self.epochs)
+            score = self.score_function.score(model, self.eval_iterator)
+            res = OptimizationResult(
+                index=i, candidate=candidate, score=score,
+                duration_s=time.perf_counter() - t0,
+                model=model if self.keep_models else None)
+            self.results.append(res)
+            for lst in self.listeners:
+                lst(res)
+            better = (best is None
+                      or (score < best.score if self.score_function.minimize
+                          else score > best.score))
+            if better:
+                best = res
+        return best
+
+    def best_result(self) -> Optional[OptimizationResult]:
+        if not self.results:
+            return None
+        key = (min if self.score_function.minimize else max)
+        return key(self.results, key=lambda r: r.score)

@@ -1,0 +1,54 @@
+"""Parameter spaces (reference
+``org.deeplearning4j.arbiter.optimize.parameter.*``)."""
+
+from __future__ import annotations
+
+import math
+from typing import Any, List, Sequence
+
+import numpy as np
+
+
+class ParameterSpace:
+    def sample(self, rng: np.random.Generator) -> Any:
+        raise NotImplementedError
+
+    def grid_values(self, n: int) -> List[Any]:
+        raise NotImplementedError
+
+
+class ContinuousParameterSpace(ParameterSpace):
+    def __init__(self, low: float, high: float, log_scale: bool = False):
+        self.low, self.high, self.log_scale = float(low), float(high), log_scale
+
+    def sample(self, rng):
+        if self.log_scale:
+            return float(math.exp(rng.uniform(math.log(self.low), math.log(self.high))))
+        return float(rng.uniform(self.low, self.high))
+
+    def grid_values(self, n):
+        if self.log_scale:
+            return [float(v) for v in np.geomspace(self.low, self.high, n)]
+        return [float(v) for v in np.linspace(self.low, self.high, n)]
+
+
+class IntegerParameterSpace(ParameterSpace):
+    def __init__(self, low: int, high: int):
+        self.low, self.high = int(low), int(high)
+
+    def sample(self, rng):
+        return int(rng.integers(self.low, self.high + 1))
+
+    def grid_values(self, n):
+        return sorted({int(v) for v in np.linspace(self.low, self.high, n)})
+
+
+class DiscreteParameterSpace(ParameterSpace):
+    def __init__(self, *values: Any):
+        self.values = list(values)
+
+    def sample(self, rng):
+        return self.values[int(rng.integers(0, len(self.values)))]
+
+    def grid_values(self, n):
+        return list(self.values)

@@ -1,0 +1,568 @@
+"""DataVec-style declarative ETL: record readers, schema, transform process.
+
+Rebuild of the reference's datavec-api (upstream
+``org.datavec.api.records.reader.*``, ``org.datavec.api.transform.*``):
+``RecordReader`` SPI (CSV/line/collection/sequence), typed ``Schema``,
+declarative ``TransformProcess`` (column ops, filters, conditional
+replacement, math ops, categorical encodings), a local executor, and the
+``RecordReaderDataSetIterator`` bridge into training.
+
+Records are python lists of primitive values (the Writable type system
+collapses to python scalars — same information, no boxing); heavy numeric
+batching happens in numpy at the iterator bridge, which is where the TPU
+feed path begins.
+"""
+
+from __future__ import annotations
+
+import csv
+import dataclasses
+import enum
+import io
+import math
+import os
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import DataSetIterator
+
+
+# --------------------------------------------------------------- record readers
+class RecordReader:
+    """SPI (reference ``RecordReader``): iterate records = lists of values."""
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self) -> List[Any]:
+        if not self.has_next():
+            raise StopIteration
+        return self.next()
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def next(self) -> List[Any]:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+
+class CollectionRecordReader(RecordReader):
+    def __init__(self, records: Sequence[List[Any]]):
+        self.records = list(records)
+        self._pos = 0
+
+    def has_next(self):
+        return self._pos < len(self.records)
+
+    def next(self):
+        r = self.records[self._pos]
+        self._pos += 1
+        return list(r)
+
+    def reset(self):
+        self._pos = 0
+
+
+class CSVRecordReader(RecordReader):
+    """Reference ``CSVRecordReader``: delimiter/quote handling, skip lines,
+    numeric auto-parse."""
+
+    def __init__(self, skip_num_lines: int = 0, delimiter: str = ",",
+                 quote: str = '"', parse_numbers: bool = True):
+        self.skip = skip_num_lines
+        self.delimiter = delimiter
+        self.quote = quote
+        self.parse_numbers = parse_numbers
+        self._rows: List[List[Any]] = []
+        self._pos = 0
+
+    def initialize(self, source: Union[str, io.TextIOBase, Sequence[str]]) -> "CSVRecordReader":
+        if isinstance(source, str):
+            with open(source, newline="") as f:
+                rows = list(csv.reader(f, delimiter=self.delimiter, quotechar=self.quote))
+        elif isinstance(source, io.TextIOBase):
+            rows = list(csv.reader(source, delimiter=self.delimiter, quotechar=self.quote))
+        else:
+            rows = list(csv.reader(list(source), delimiter=self.delimiter,
+                                   quotechar=self.quote))
+        rows = rows[self.skip:]
+        if self.parse_numbers:
+            rows = [[_maybe_num(v) for v in r] for r in rows]
+        self._rows = rows
+        self._pos = 0
+        return self
+
+    def has_next(self):
+        return self._pos < len(self._rows)
+
+    def next(self):
+        r = self._rows[self._pos]
+        self._pos += 1
+        return list(r)
+
+    def reset(self):
+        self._pos = 0
+
+
+class LineRecordReader(RecordReader):
+    def __init__(self):
+        self._lines: List[str] = []
+        self._pos = 0
+
+    def initialize(self, source: Union[str, Sequence[str]]) -> "LineRecordReader":
+        if isinstance(source, str):
+            with open(source) as f:
+                self._lines = [l.rstrip("\n") for l in f]
+        else:
+            self._lines = list(source)
+        self._pos = 0
+        return self
+
+    def has_next(self):
+        return self._pos < len(self._lines)
+
+    def next(self):
+        l = self._lines[self._pos]
+        self._pos += 1
+        return [l]
+
+    def reset(self):
+        self._pos = 0
+
+
+class CSVSequenceRecordReader(RecordReader):
+    """One CSV file per sequence (reference ``CSVSequenceRecordReader``).
+    ``next()`` returns a list of timestep records."""
+
+    def __init__(self, skip_num_lines: int = 0, delimiter: str = ","):
+        self.skip = skip_num_lines
+        self.delimiter = delimiter
+        self._seqs: List[List[List[Any]]] = []
+        self._pos = 0
+
+    def initialize(self, paths: Sequence[str]) -> "CSVSequenceRecordReader":
+        self._seqs = []
+        for p in paths:
+            rr = CSVRecordReader(self.skip, self.delimiter).initialize(p)
+            self._seqs.append([r for r in rr])
+        self._pos = 0
+        return self
+
+    def has_next(self):
+        return self._pos < len(self._seqs)
+
+    def next(self):
+        s = self._seqs[self._pos]
+        self._pos += 1
+        return s
+
+    def reset(self):
+        self._pos = 0
+
+
+def _maybe_num(v: str):
+    try:
+        f = float(v)
+        return int(f) if f.is_integer() and "." not in v and "e" not in v.lower() else f
+    except (ValueError, TypeError):
+        return v
+
+
+# ----------------------------------------------------------------------- schema
+class ColumnType(str, enum.Enum):
+    STRING = "string"
+    INTEGER = "integer"
+    DOUBLE = "double"
+    CATEGORICAL = "categorical"
+    LONG = "long"
+    TIME = "time"
+
+
+@dataclasses.dataclass
+class ColumnMeta:
+    name: str
+    type: ColumnType
+    categories: Optional[List[str]] = None
+
+
+class Schema:
+    """Typed column schema (reference ``org.datavec.api.transform.schema.Schema``)."""
+
+    def __init__(self, columns: List[ColumnMeta]):
+        self.columns = columns
+
+    @property
+    def names(self) -> List[str]:
+        return [c.name for c in self.columns]
+
+    def index_of(self, name: str) -> int:
+        return self.names.index(name)
+
+    def column(self, name: str) -> ColumnMeta:
+        return self.columns[self.index_of(name)]
+
+    class Builder:
+        def __init__(self):
+            self._cols: List[ColumnMeta] = []
+
+        def add_column_string(self, *names):
+            for n in names:
+                self._cols.append(ColumnMeta(n, ColumnType.STRING))
+            return self
+
+        def add_column_integer(self, *names):
+            for n in names:
+                self._cols.append(ColumnMeta(n, ColumnType.INTEGER))
+            return self
+
+        def add_column_double(self, *names):
+            for n in names:
+                self._cols.append(ColumnMeta(n, ColumnType.DOUBLE))
+            return self
+
+        def add_column_categorical(self, name, categories):
+            self._cols.append(ColumnMeta(name, ColumnType.CATEGORICAL, list(categories)))
+            return self
+
+        def build(self) -> "Schema":
+            return Schema(list(self._cols))
+
+    @staticmethod
+    def builder() -> "Schema.Builder":
+        return Schema.Builder()
+
+    def to_dict(self):
+        return {"columns": [{"name": c.name, "type": c.type.value,
+                             "categories": c.categories} for c in self.columns]}
+
+    @staticmethod
+    def from_dict(d):
+        return Schema([ColumnMeta(c["name"], ColumnType(c["type"]), c.get("categories"))
+                       for c in d["columns"]])
+
+
+# -------------------------------------------------------------------- transforms
+@dataclasses.dataclass
+class _Step:
+    kind: str
+    args: Dict[str, Any]
+
+    def apply_schema(self, schema: Schema) -> Schema:
+        return _SCHEMA_FNS[self.kind](schema, **self.args)
+
+    def apply_records(self, schema: Schema, records: List[List[Any]]) -> List[List[Any]]:
+        return _RECORD_FNS[self.kind](schema, records, **self.args)
+
+
+_SCHEMA_FNS: Dict[str, Callable] = {}
+_RECORD_FNS: Dict[str, Callable] = {}
+
+
+def _step(kind):
+    def deco_schema(fn):
+        _SCHEMA_FNS[kind] = fn
+        return fn
+    return deco_schema
+
+
+def _rec(kind):
+    def deco(fn):
+        _RECORD_FNS[kind] = fn
+        return fn
+    return deco
+
+
+# remove columns
+@_step("remove_columns")
+def _s_remove(schema, names):
+    return Schema([c for c in schema.columns if c.name not in names])
+
+
+@_rec("remove_columns")
+def _r_remove(schema, records, names):
+    idx = [i for i, c in enumerate(schema.columns) if c.name not in names]
+    return [[r[i] for i in idx] for r in records]
+
+
+# keep only
+@_step("remove_all_columns_except")
+def _s_keep(schema, names):
+    return Schema([c for c in schema.columns if c.name in names])
+
+
+@_rec("remove_all_columns_except")
+def _r_keep(schema, records, names):
+    idx = [i for i, c in enumerate(schema.columns) if c.name in names]
+    return [[r[i] for i in idx] for r in records]
+
+
+# rename
+@_step("rename_column")
+def _s_rename(schema, old, new):
+    return Schema([dataclasses.replace(c, name=new) if c.name == old else c
+                   for c in schema.columns])
+
+
+@_rec("rename_column")
+def _r_rename(schema, records, old, new):
+    return records
+
+
+# categorical -> integer
+@_step("categorical_to_integer")
+def _s_cat2int(schema, name):
+    return Schema([dataclasses.replace(c, type=ColumnType.INTEGER, categories=None)
+                   if c.name == name else c for c in schema.columns])
+
+
+@_rec("categorical_to_integer")
+def _r_cat2int(schema, records, name):
+    i = schema.index_of(name)
+    cats = schema.columns[i].categories
+    lut = {c: j for j, c in enumerate(cats)}
+    out = []
+    for r in records:
+        r = list(r)
+        r[i] = lut[r[i]]
+        out.append(r)
+    return out
+
+
+# categorical -> one-hot
+@_step("categorical_to_one_hot")
+def _s_cat2oh(schema, name):
+    cols = []
+    for c in schema.columns:
+        if c.name == name:
+            for cat in c.categories:
+                cols.append(ColumnMeta(f"{name}[{cat}]", ColumnType.INTEGER))
+        else:
+            cols.append(c)
+    return Schema(cols)
+
+
+@_rec("categorical_to_one_hot")
+def _r_cat2oh(schema, records, name):
+    i = schema.index_of(name)
+    cats = schema.columns[i].categories
+    out = []
+    for r in records:
+        oh = [1 if r[i] == c else 0 for c in cats]
+        out.append(r[:i] + oh + r[i + 1:])
+    return out
+
+
+# filter rows
+@_step("filter")
+def _s_filter(schema, predicate):
+    return schema
+
+
+@_rec("filter")
+def _r_filter(schema, records, predicate):
+    names = schema.names
+    return [r for r in records if not predicate(dict(zip(names, r)))]
+
+
+# math op on a double/int column
+@_step("double_math_op")
+def _s_math(schema, name, op, value):
+    return schema
+
+
+@_rec("double_math_op")
+def _r_math(schema, records, name, op, value):
+    i = schema.index_of(name)
+    fn = {"add": lambda x: x + value, "subtract": lambda x: x - value,
+          "multiply": lambda x: x * value, "divide": lambda x: x / value,
+          "power": lambda x: x ** value, "min": lambda x: min(x, value),
+          "max": lambda x: max(x, value)}[op]
+    out = []
+    for r in records:
+        r = list(r)
+        r[i] = fn(r[i])
+        out.append(r)
+    return out
+
+
+# conditional replace
+@_step("conditional_replace")
+def _s_cond(schema, name, predicate, replacement):
+    return schema
+
+
+@_rec("conditional_replace")
+def _r_cond(schema, records, name, predicate, replacement):
+    i = schema.index_of(name)
+    names = schema.names
+    out = []
+    for r in records:
+        r = list(r)
+        if predicate(dict(zip(names, r))):
+            r[i] = replacement
+        out.append(r)
+    return out
+
+
+# normalize (min-max or standardize) — computed over the dataset at execute time
+@_step("normalize")
+def _s_norm(schema, name, kind):
+    return Schema([dataclasses.replace(c, type=ColumnType.DOUBLE)
+                   if c.name == name else c for c in schema.columns])
+
+
+@_rec("normalize")
+def _r_norm(schema, records, name, kind):
+    i = schema.index_of(name)
+    vals = np.asarray([float(r[i]) for r in records])
+    if kind == "minmax":
+        lo, hi = vals.min(), vals.max()
+        scaled = (vals - lo) / max(hi - lo, 1e-12)
+    else:
+        scaled = (vals - vals.mean()) / max(vals.std(), 1e-12)
+    out = []
+    for r, v in zip(records, scaled):
+        r = list(r)
+        r[i] = float(v)
+        out.append(r)
+    return out
+
+
+# custom per-record function (escape hatch)
+@_step("map_records")
+def _s_map(schema, fn, new_schema=None):
+    return new_schema or schema
+
+
+@_rec("map_records")
+def _r_map(schema, records, fn, new_schema=None):
+    return [fn(list(r)) for r in records]
+
+
+class TransformProcess:
+    """Declarative transform pipeline (reference ``TransformProcess``)."""
+
+    def __init__(self, initial_schema: Schema, steps: List[_Step]):
+        self.initial_schema = initial_schema
+        self.steps = steps
+
+    def final_schema(self) -> Schema:
+        s = self.initial_schema
+        for st in self.steps:
+            s = st.apply_schema(s)
+        return s
+
+    class Builder:
+        def __init__(self, schema: Schema):
+            self._schema = schema
+            self._steps: List[_Step] = []
+
+        def _add(self, kind, **args):
+            self._steps.append(_Step(kind, args))
+            return self
+
+        def remove_columns(self, *names):
+            return self._add("remove_columns", names=list(names))
+
+        def remove_all_columns_except(self, *names):
+            return self._add("remove_all_columns_except", names=list(names))
+
+        def rename_column(self, old, new):
+            return self._add("rename_column", old=old, new=new)
+
+        def categorical_to_integer(self, name):
+            return self._add("categorical_to_integer", name=name)
+
+        def categorical_to_one_hot(self, name):
+            return self._add("categorical_to_one_hot", name=name)
+
+        def filter(self, predicate):
+            """Remove rows where predicate(row_dict) is True."""
+            return self._add("filter", predicate=predicate)
+
+        def double_math_op(self, name, op, value):
+            return self._add("double_math_op", name=name, op=op, value=value)
+
+        def conditional_replace_value_transform(self, name, predicate, replacement):
+            return self._add("conditional_replace", name=name, predicate=predicate,
+                             replacement=replacement)
+
+        def normalize(self, name, kind="standardize"):
+            return self._add("normalize", name=name, kind=kind)
+
+        def map_records(self, fn, new_schema=None):
+            return self._add("map_records", fn=fn, new_schema=new_schema)
+
+        def build(self) -> "TransformProcess":
+            return TransformProcess(self._schema, list(self._steps))
+
+    @staticmethod
+    def builder(schema: Schema) -> "TransformProcess.Builder":
+        return TransformProcess.Builder(schema)
+
+
+class LocalTransformExecutor:
+    """Reference ``org.datavec.local.transforms.LocalTransformExecutor``."""
+
+    @staticmethod
+    def execute(records: Iterable[List[Any]], tp: TransformProcess) -> List[List[Any]]:
+        recs = [list(r) for r in records]
+        schema = tp.initial_schema
+        for st in tp.steps:
+            recs = st.apply_records(schema, recs)
+            schema = st.apply_schema(schema)
+        return recs
+
+
+# -------------------------------------------------- iterator bridge to training
+class RecordReaderDataSetIterator(DataSetIterator):
+    """Bridge records -> DataSet minibatches (reference
+    ``RecordReaderDataSetIterator``): label column index + number of classes
+    (classification, one-hot) or regression mode."""
+
+    def __init__(self, reader: RecordReader, batch_size: int,
+                 label_index: int = -1, num_classes: Optional[int] = None,
+                 regression: bool = False,
+                 transform_process: Optional[TransformProcess] = None):
+        records = [r for r in reader]
+        if transform_process is not None:
+            records = LocalTransformExecutor.execute(records, transform_process)
+        self._features = []
+        self._labels = []
+        for r in records:
+            li = label_index if label_index >= 0 else len(r) + label_index
+            feats = [float(v) for i, v in enumerate(r) if i != li]
+            self._features.append(feats)
+            if regression:
+                self._labels.append([float(r[li])])
+            else:
+                oh = [0.0] * num_classes
+                oh[int(r[li])] = 1.0
+                self._labels.append(oh)
+        self._x = np.asarray(self._features, np.float32)
+        self._y = np.asarray(self._labels, np.float32)
+        self._batch = int(batch_size)
+        self._pos = 0
+
+    def has_next(self):
+        return self._pos < len(self._x)
+
+    def next(self) -> DataSet:
+        sl = slice(self._pos, self._pos + self._batch)
+        self._pos += self._batch
+        return DataSet(self._x[sl], self._y[sl])
+
+    def reset(self):
+        self._pos = 0
+
+    def batch(self):
+        return self._batch
+
+    def total_examples(self):
+        return len(self._x)

@@ -1,0 +1,17 @@
+"""NLP: word embeddings + text pipeline.
+
+Rebuild of the reference's deeplearning4j-nlp (upstream
+``org.deeplearning4j.models.word2vec`` etc.): Word2Vec (skip-gram & CBOW with
+negative sampling — the hot loops that are native nd4j ops ``SkipGram``/
+``CBOW`` in the reference run here as one jitted minibatch update),
+ParagraphVectors (PV-DBOW), tokenizer SPI, vocab cache,
+``WordVectorSerializer``.
+"""
+
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory, TokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import VocabCache
+from deeplearning4j_tpu.nlp.word2vec import ParagraphVectors, Word2Vec
+from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+
+__all__ = ["Word2Vec", "ParagraphVectors", "VocabCache", "TokenizerFactory",
+           "DefaultTokenizerFactory", "WordVectorSerializer"]

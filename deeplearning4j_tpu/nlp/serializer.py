@@ -1,0 +1,51 @@
+"""Word-vector serialization (reference
+``org.deeplearning4j.models.embeddings.loader.WordVectorSerializer``):
+classic word2vec text format (one line per word: token + floats) read/write,
+so vectors interchange with gensim/word2vec tooling."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class WordVectorSerializer:
+    @staticmethod
+    def write_word_vectors(w2v, path: str) -> None:
+        emb = np.asarray(w2v.emb_in)
+        with open(path, "w") as f:
+            f.write(f"{emb.shape[0]} {emb.shape[1]}\n")
+            for i in range(emb.shape[0]):
+                word = w2v.vocab.word_at_index(i)
+                vec = " ".join(f"{x:.6f}" for x in emb[i])
+                f.write(f"{word} {vec}\n")
+
+    @staticmethod
+    def read_word_vectors(path: str):
+        """Returns (vocab_list, matrix)."""
+        with open(path) as f:
+            header = f.readline().split()
+            n, d = int(header[0]), int(header[1])
+            words, rows = [], np.empty((n, d), np.float32)
+            for i in range(n):
+                parts = f.readline().rstrip("\n").split(" ")
+                words.append(parts[0])
+                rows[i] = [float(x) for x in parts[1:d + 1]]
+        return words, rows
+
+    @staticmethod
+    def load_txt(path: str):
+        """Reference ``loadTxt``: returns a queryable Word2Vec-like object."""
+        from deeplearning4j_tpu.nlp.vocab import VocabCache
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+        import jax.numpy as jnp
+        words, rows = WordVectorSerializer.read_word_vectors(path)
+        w2v = Word2Vec(layer_size=rows.shape[1], min_word_frequency=1)
+        vocab = VocabCache(1)
+        for w in words:
+            vocab.counts[w] = 1
+            vocab.word2idx[w] = len(vocab.idx2word)
+            vocab.idx2word.append(w)
+        w2v.vocab = vocab
+        w2v.emb_in = jnp.asarray(rows)
+        w2v.emb_out = jnp.zeros_like(w2v.emb_in)
+        return w2v

@@ -1,0 +1,67 @@
+"""Tokenizer SPI (reference
+``org.deeplearning4j.text.tokenization.tokenizerfactory``)."""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional
+
+
+class TokenPreProcess:
+    """Reference ``CommonPreprocessor``: lowercase + strip punctuation."""
+
+    _PUNCT = re.compile(r"[\.,!?;:\"'\(\)\[\]{}<>]")
+
+    def pre_process(self, token: str) -> str:
+        return self._PUNCT.sub("", token.lower())
+
+
+class Tokenizer:
+    def __init__(self, tokens: List[str]):
+        self._tokens = tokens
+
+    def get_tokens(self) -> List[str]:
+        return list(self._tokens)
+
+    def count_tokens(self) -> int:
+        return len(self._tokens)
+
+
+class TokenizerFactory:
+    def create(self, text: str) -> Tokenizer:
+        raise NotImplementedError
+
+    def set_token_pre_processor(self, p: TokenPreProcess) -> None:
+        self._pre = p
+
+
+class DefaultTokenizerFactory(TokenizerFactory):
+    """Whitespace/word tokenizer (reference ``DefaultTokenizerFactory``)."""
+
+    _WORD = re.compile(r"\S+")
+
+    def __init__(self):
+        self._pre: Optional[TokenPreProcess] = None
+
+    def create(self, text: str) -> Tokenizer:
+        toks = self._WORD.findall(text)
+        if self._pre is not None:
+            toks = [self._pre.pre_process(t) for t in toks]
+        return Tokenizer([t for t in toks if t])
+
+
+class NGramTokenizerFactory(TokenizerFactory):
+    def __init__(self, n_min: int = 1, n_max: int = 2):
+        self.n_min, self.n_max = n_min, n_max
+        self._base = DefaultTokenizerFactory()
+        self._pre = None
+
+    def create(self, text: str) -> Tokenizer:
+        base = self._base.create(text).get_tokens()
+        if self._pre:
+            base = [self._pre.pre_process(t) for t in base]
+        out = []
+        for n in range(self.n_min, self.n_max + 1):
+            for i in range(len(base) - n + 1):
+                out.append(" ".join(base[i:i + n]))
+        return Tokenizer(out)

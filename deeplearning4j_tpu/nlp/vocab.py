@@ -1,0 +1,58 @@
+"""Vocabulary cache (reference
+``org.deeplearning4j.models.word2vec.wordstore.inmemory.AbstractCache``):
+word -> index, frequency counts, min-frequency pruning, and the unigram^0.75
+negative-sampling table."""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+
+class VocabCache:
+    def __init__(self, min_word_frequency: int = 1):
+        self.min_word_frequency = min_word_frequency
+        self.word2idx: Dict[str, int] = {}
+        self.idx2word: List[str] = []
+        self.counts: Counter = Counter()
+        self._sampling_probs: Optional[np.ndarray] = None
+
+    def fit(self, token_stream: Iterable[List[str]]) -> "VocabCache":
+        for tokens in token_stream:
+            self.counts.update(tokens)
+        for w, c in self.counts.most_common():
+            if c >= self.min_word_frequency:
+                self.word2idx[w] = len(self.idx2word)
+                self.idx2word.append(w)
+        return self
+
+    def __len__(self) -> int:
+        return len(self.idx2word)
+
+    def num_words(self) -> int:
+        return len(self.idx2word)
+
+    def contains_word(self, w: str) -> bool:
+        return w in self.word2idx
+
+    def index_of(self, w: str) -> int:
+        return self.word2idx.get(w, -1)
+
+    def word_at_index(self, i: int) -> str:
+        return self.idx2word[i]
+
+    def word_frequency(self, w: str) -> int:
+        return self.counts.get(w, 0)
+
+    def encode(self, tokens: List[str]) -> List[int]:
+        return [self.word2idx[t] for t in tokens if t in self.word2idx]
+
+    def negative_sampling_probs(self) -> np.ndarray:
+        """Unigram^0.75 distribution (word2vec's negative-sampling table)."""
+        if self._sampling_probs is None:
+            freqs = np.asarray([self.counts[w] for w in self.idx2word], np.float64)
+            p = freqs ** 0.75
+            self._sampling_probs = (p / p.sum()).astype(np.float64)
+        return self._sampling_probs

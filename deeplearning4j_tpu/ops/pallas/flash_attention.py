@@ -1,0 +1,140 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The hand-written-kernel layer of the framework (the role cuDNN's fused
+attention / libnd4j's CUDA helpers play in the reference — SURVEY.md §7.2):
+blockwise softmax with running max/denominator so the (T, T) score matrix is
+never materialised in HBM. Q is tiled over the grid; K/V stream through VMEM
+in BLOCK_K chunks with the classic flash update:
+
+    m' = max(m, rowmax(S_blk))
+    l' = l * e^{m-m'} + rowsum(e^{S_blk - m'})
+    acc' = acc * e^{m-m'} + e^{S_blk - m'} @ V_blk
+
+Backward is jax.custom_vjp with XLA recompute (standard softmax form) —
+correct everywhere; a fused Pallas backward is a future optimisation.
+
+Used automatically by ``nn.attention_layers.dot_product_attention`` when
+shapes/platform allow; fall back is the XLA softmax form. Set
+``DL4J_TPU_PALLAS_INTERPRET=1`` to run the kernel in interpreter mode on CPU
+(test path).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+BLOCK_Q = 128
+BLOCK_K = 128
+
+
+def _interpret() -> bool:
+    return os.environ.get("DL4J_TPU_PALLAS_INTERPRET", "") == "1"
+
+
+def flash_attention_compatible(q, k, v, mask=None) -> bool:
+    """Kernel applicability: no mask (padding masks fall back to XLA),
+    block-divisible sequence, head dim that tiles onto the MXU lanes."""
+    if mask is not None:
+        return False
+    if q.ndim != 4:
+        return False
+    t_q, d = q.shape[2], q.shape[3]
+    t_k = k.shape[2]
+    if t_q % BLOCK_Q or t_k % BLOCK_K:
+        return False
+    if d > 256:
+        return False
+    if q.dtype not in (jnp.float32, jnp.bfloat16):
+        return False
+    platform = jax.devices()[0].platform
+    if platform in ("tpu", "axon") or _interpret():
+        return True
+    return False
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, block_k: int):
+    q = q_ref[0].astype(jnp.float32)  # (BLOCK_Q, D)
+    t_k = k_ref.shape[1]
+    n_blocks = t_k // block_k
+
+    def body(i, carry):
+        acc, m, l = carry
+        k_blk = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ()))) * scale
+        m_blk = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=1)
+        acc_new = acc * corr[:, None] + jax.lax.dot(p, v_blk)
+        return acc_new, m_new, l_new
+
+    bq, d_v = q.shape[0], v_ref.shape[2]
+    acc = jnp.zeros((bq, d_v), jnp.float32)
+    m = jnp.full((bq,), -jnp.inf, jnp.float32)
+    l = jnp.zeros((bq,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, n_blocks, body, (acc, m, l))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-20)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, scale):
+    b, h, t_q, d = q.shape
+    t_k = k.shape[2]
+    qf = q.reshape(b * h, t_q, d)
+    kf = k.reshape(b * h, t_k, d)
+    vf = v.reshape(b * h, t_k, v.shape[-1])
+    grid = (b * h, t_q // BLOCK_Q)
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, block_k=BLOCK_K),
+        out_shape=jax.ShapeDtypeStruct((b * h, t_q, vf.shape[-1]), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, BLOCK_Q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, t_k, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, t_k, vf.shape[-1]), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, BLOCK_Q, vf.shape[-1]), lambda bh, qi: (bh, qi, 0)),
+        interpret=_interpret(),
+    )(qf, kf, vf)
+    return out.reshape(b, h, t_q, vf.shape[-1])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash(q, k, v, scale):
+    return _flash_fwd(q, k, v, scale)
+
+
+def _flash_vjp_fwd(q, k, v, scale):
+    return _flash_fwd(q, k, v, scale), (q, k, v)
+
+
+def _flash_vjp_bwd(scale, res, g):
+    q, k, v = res
+
+    def ref_attn(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * scale
+        w = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", w, v.astype(jnp.float32)).astype(q.dtype)
+
+    _, vjp = jax.vjp(ref_attn, q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, mask=None):
+    """(batch, heads, time, d) flash attention. ``mask`` must be None (check
+    :func:`flash_attention_compatible` first)."""
+    if mask is not None:
+        raise ValueError("flash_attention kernel does not take a mask; "
+                         "use the XLA fallback for masked attention")
+    scale = 1.0 / float(q.shape[-1]) ** 0.5
+    return _flash(q, k, v, scale)

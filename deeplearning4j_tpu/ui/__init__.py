@@ -1,0 +1,15 @@
+"""Training UI / stats pipeline.
+
+Rebuild of the reference's UI stack (upstream ``deeplearning4j-ui-parent``):
+``StatsListener`` -> ``StatsStorage`` (in-memory / file) -> ``UIServer``
+rendering overview/model charts. The storage format here is JSONL (one
+record per iteration) and the server is a dependency-free stdlib HTTP server
+with an inline-JS chart page — same overview diagnostics the reference's
+Play/Vert.x UI ships: score curve, update:parameter mean-magnitude ratios
+(the marquee diagnostic), per-layer param stats, memory.
+"""
+
+from deeplearning4j_tpu.ui.stats import FileStatsStorage, InMemoryStatsStorage, StatsListener
+from deeplearning4j_tpu.ui.server import UIServer
+
+__all__ = ["StatsListener", "InMemoryStatsStorage", "FileStatsStorage", "UIServer"]

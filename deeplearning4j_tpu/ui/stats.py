@@ -1,0 +1,125 @@
+"""Stats collection (reference ``org.deeplearning4j.ui.stats.StatsListener``
++ ``org.deeplearning4j.api.storage.StatsStorage``).
+
+Per-iteration records: score, per-layer parameter/update mean magnitudes and
+stddevs, update:param ratios, throughput, device memory. Collection reads
+happen on host between steps; heavy reductions are jitted and batched into
+ONE device program per sampled iteration (the reference pulls every array to
+the host per iteration — on TPU that would stall the pipeline).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.train.listeners import TrainingListener
+
+
+class StatsStorage:
+    def put_record(self, record: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def records(self) -> List[Dict[str, Any]]:
+        raise NotImplementedError
+
+
+class InMemoryStatsStorage(StatsStorage):
+    def __init__(self):
+        self._records: List[Dict[str, Any]] = []
+
+    def put_record(self, record):
+        self._records.append(record)
+
+    def records(self):
+        return list(self._records)
+
+
+class FileStatsStorage(StatsStorage):
+    """JSONL file storage (reference's MapDB ``FileStatsStorage`` analog)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def put_record(self, record):
+        with open(self.path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+
+    def records(self):
+        out = []
+        try:
+            with open(self.path) as f:
+                for line in f:
+                    out.append(json.loads(line))
+        except FileNotFoundError:
+            pass
+        return out
+
+
+@jax.jit
+def _param_stats(params):
+    """One fused program: mean |w|, std, l2 per leaf."""
+    def leaf(w):
+        wf = w.astype(jnp.float32)
+        return {"mean_mag": jnp.mean(jnp.abs(wf)), "std": jnp.std(wf),
+                "l2": jnp.sqrt(jnp.sum(wf * wf))}
+    return jax.tree.map(leaf, params, is_leaf=lambda x: isinstance(x, jax.Array))
+
+
+class StatsListener(TrainingListener):
+    def __init__(self, storage: Optional[StatsStorage] = None, frequency: int = 10):
+        self.storage = storage or InMemoryStatsStorage()
+        self.frequency = max(1, int(frequency))
+        self._last_time = None
+        self._prev_params = None
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if iteration % self.frequency:
+            return
+        now = time.time()
+        record: Dict[str, Any] = {
+            "iteration": iteration,
+            "epoch": epoch,
+            "timestamp": now,
+            "score": float(score),
+        }
+        if self._last_time is not None:
+            record["iterations_per_second"] = self.frequency / max(1e-9, now - self._last_time)
+        self._last_time = now
+        ts = getattr(model, "train_state", None)
+        if ts is not None:
+            stats = jax.device_get(_param_stats(ts.params))
+            layers = {}
+            flat = jax.tree_util.tree_flatten_with_path(stats)[0]
+            # group leaves: path like ('layer_0', 'W', 'mean_mag')
+            grouped: Dict[str, Dict[str, Dict[str, float]]] = {}
+            for path, val in flat:
+                keys = [str(getattr(p, "key", p)) for p in path]
+                layer, stat = keys[0], keys[-1]
+                pname = "/".join(keys[1:-1])
+                grouped.setdefault(layer, {}).setdefault(pname, {})[stat] = float(val)
+            record["params"] = grouped
+            if self._prev_params is not None:
+                ratios = {}
+                for layer, pstats in grouped.items():
+                    prev = self._prev_params.get(layer, {})
+                    for pname, s in pstats.items():
+                        if pname in prev and s["mean_mag"] > 0:
+                            delta = abs(prev[pname]["mean_mag"] - s["mean_mag"])
+                            ratios[f"{layer}/{pname}"] = delta / s["mean_mag"]
+                record["update_param_ratios"] = ratios
+            self._prev_params = grouped
+        try:
+            from deeplearning4j_tpu.runtime.profiler import device_memory_stats
+            mem = device_memory_stats()
+            if mem:
+                record["device_memory"] = mem
+        except Exception:
+            pass
+        self.storage.put_record(record)

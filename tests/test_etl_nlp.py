@@ -1,0 +1,133 @@
+"""ETL (DataVec-equivalent) and NLP tests."""
+
+import io
+
+import numpy as np
+import pytest
+
+
+# ------------------------------------------------------------------- ETL
+def test_csv_reader_and_transform_process():
+    from deeplearning4j_tpu.data.records import (
+        CSVRecordReader, LocalTransformExecutor, Schema, TransformProcess)
+    csv_data = [
+        "5.1,3.5,setosa",
+        "6.2,2.9,versicolor",
+        "7.1,3.0,virginica",
+        "4.9,3.1,setosa",
+    ]
+    rr = CSVRecordReader().initialize(csv_data)
+    schema = (Schema.builder()
+              .add_column_double("sepal_len", "sepal_wid")
+              .add_column_categorical("species", ["setosa", "versicolor", "virginica"])
+              .build())
+    tp = (TransformProcess.builder(schema)
+          .categorical_to_integer("species")
+          .double_math_op("sepal_len", "subtract", 5.0)
+          .filter(lambda row: row["sepal_wid"] < 3.0)
+          .build())
+    out = LocalTransformExecutor.execute(list(rr), tp)
+    assert out == [[pytest.approx(0.1), 3.5, 0],
+                   [pytest.approx(2.1), 3.0, 2],
+                   [pytest.approx(-0.1), 3.1, 0]]
+    final = tp.final_schema()
+    assert final.names == ["sepal_len", "sepal_wid", "species"]
+    assert final.column("species").type.value == "integer"
+
+
+def test_one_hot_and_iterator_bridge():
+    from deeplearning4j_tpu.data.records import (
+        CollectionRecordReader, RecordReaderDataSetIterator)
+    records = [[0.5, 1.5, 0], [0.1, 0.2, 1], [0.9, 0.8, 2], [0.4, 0.3, 1]]
+    it = RecordReaderDataSetIterator(CollectionRecordReader(records),
+                                     batch_size=2, label_index=2, num_classes=3)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].features.shape == (2, 2)
+    assert batches[0].labels.shape == (2, 3)
+    np.testing.assert_allclose(batches[0].labels[0], [1, 0, 0])
+
+
+def test_training_from_csv_end_to_end():
+    """CSV -> TransformProcess -> iterator -> fit (the DataVec bridge path)."""
+    from deeplearning4j_tpu.data.records import (
+        CollectionRecordReader, RecordReaderDataSetIterator)
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.train import Adam
+    rng = np.random.default_rng(0)
+    records = []
+    for _ in range(120):
+        cls = int(rng.integers(0, 2))
+        x = rng.normal(cls * 2.0, 0.5, 2)
+        records.append([float(x[0]), float(x[1]), cls])
+    it = RecordReaderDataSetIterator(CollectionRecordReader(records),
+                                     batch_size=32, label_index=2, num_classes=2)
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(5e-2)).list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax"))
+            .set_input_type(InputType.feed_forward(2)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(it, epochs=20)
+    ev = net.evaluate(it)
+    assert ev.accuracy() > 0.9
+
+
+# ------------------------------------------------------------------- NLP
+_CORPUS = [
+    "the king rules the castle",
+    "the queen rules the castle",
+    "the king and the queen sit on thrones",
+    "dogs chase cats around the garden",
+    "cats chase mice around the garden",
+    "the dog and the cat play in the garden",
+] * 30
+
+
+def test_word2vec_learns_cooccurrence():
+    from deeplearning4j_tpu.nlp import Word2Vec
+    w2v = (Word2Vec.builder()
+           .layer_size(32).window_size(3).min_word_frequency(2)
+           .negative(4).epochs(12).seed(7).learning_rate(0.05)
+           .build())
+    w2v.fit(_CORPUS)
+    assert w2v.has_word("king") and w2v.has_word("garden")
+    # words from the same topical cluster should be closer than cross-cluster
+    royal = w2v.similarity("king", "queen")
+    cross = w2v.similarity("king", "garden")
+    assert royal > cross, f"king~queen {royal} vs king~garden {cross}"
+    assert len(w2v.words_nearest("king", 3)) == 3
+
+
+def test_word_vector_serializer_roundtrip(tmp_path):
+    from deeplearning4j_tpu.nlp import Word2Vec, WordVectorSerializer
+    w2v = Word2Vec(layer_size=16, min_word_frequency=1, epochs=2, seed=3)
+    w2v.fit(_CORPUS[:20])
+    path = str(tmp_path / "vectors.txt")
+    w2v.save(path)
+    loaded = WordVectorSerializer.load_txt(path)
+    v1 = w2v.get_word_vector("castle")
+    v2 = loaded.get_word_vector("castle")
+    np.testing.assert_allclose(v1, v2, atol=1e-5)
+
+
+def test_paragraph_vectors():
+    from deeplearning4j_tpu.nlp import ParagraphVectors
+    docs = (["the cat sat on the mat the cat purred"] * 5
+            + ["stock markets rallied as shares rose sharply"] * 5)
+    pv = ParagraphVectors(layer_size=16, min_word_frequency=1, epochs=150,
+                          learning_rate=0.1, seed=5)
+    pv.fit(docs)
+    # nearest docs to doc0 should be the other cat docs (indices 1-4)
+    near = pv.docs_nearest(0, 3)
+    assert all(j < 5 for j in near), near
+
+
+def test_tokenizer_preprocess():
+    from deeplearning4j_tpu.nlp.tokenization import (DefaultTokenizerFactory,
+                                                     TokenPreProcess)
+    tf = DefaultTokenizerFactory()
+    tf.set_token_pre_processor(TokenPreProcess())
+    toks = tf.create("Hello, World! (test)").get_tokens()
+    assert toks == ["hello", "world", "test"]

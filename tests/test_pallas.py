@@ -1,0 +1,65 @@
+"""Pallas kernel tests (interpreter mode on CPU; real compilation exercised
+on TPU by the benchmarks)."""
+
+import os
+
+import numpy as np
+import pytest
+
+os.environ["DL4J_TPU_PALLAS_INTERPRET"] = "1"
+
+
+def _ref_attention(q, k, v):
+    d = q.shape[-1]
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+    w = np.exp(s - s.max(-1, keepdims=True))
+    w = w / w.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bhkd->bhqd", w, v)
+
+
+def test_flash_attention_matches_reference():
+    from deeplearning4j_tpu.ops.pallas.flash_attention import (
+        flash_attention, flash_attention_compatible)
+    rng = np.random.default_rng(0)
+    B, H, T, D = 2, 2, 256, 64
+    q = rng.normal(0, 1, (B, H, T, D)).astype(np.float32)
+    k = rng.normal(0, 1, (B, H, T, D)).astype(np.float32)
+    v = rng.normal(0, 1, (B, H, T, D)).astype(np.float32)
+    import jax.numpy as jnp
+    assert flash_attention_compatible(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    out = np.asarray(flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+    np.testing.assert_allclose(out, _ref_attention(q, k, v), rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_gradients():
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops.pallas.flash_attention import flash_attention
+    rng = np.random.default_rng(1)
+    B, H, T, D = 1, 1, 128, 64
+    q = jnp.asarray(rng.normal(0, 1, (B, H, T, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(0, 1, (B, H, T, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(0, 1, (B, H, T, D)).astype(np.float32))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v) ** 2)
+
+    def loss_ref(q, k, v):
+        d = q.shape[-1]
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(jnp.asarray(d, jnp.float32))
+        w = jax.nn.softmax(s, axis=-1)
+        return jnp.sum(jnp.einsum("bhqk,bhkd->bhqd", w, v) ** 2)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-4)
+
+
+def test_incompatible_shapes_fall_back():
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops.pallas.flash_attention import flash_attention_compatible
+    q = jnp.zeros((1, 1, 100, 64))  # T not block-divisible
+    assert not flash_attention_compatible(q, q, q)
+    q2 = jnp.zeros((1, 1, 128, 64))
+    assert not flash_attention_compatible(q2, q2, q2, mask=jnp.ones((1, 1, 1, 128)))

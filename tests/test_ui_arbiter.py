@@ -1,0 +1,95 @@
+"""UI stats pipeline + hyperparameter search tests (reference TestVertxUI /
+arbiter test patterns)."""
+
+import json
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu.arbiter import (ContinuousParameterSpace,
+                                        DiscreteParameterSpace,
+                                        EvaluationScoreFunction,
+                                        GridSearchGenerator,
+                                        LocalOptimizationRunner,
+                                        RandomSearchGenerator)
+from deeplearning4j_tpu.data import NumpyDataSetIterator
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn import (DenseLayer, InputType, NeuralNetConfiguration,
+                                   OutputLayer)
+from deeplearning4j_tpu.train import Adam
+from deeplearning4j_tpu.ui import InMemoryStatsStorage, StatsListener, UIServer
+
+
+def _data(n=96, seed=0):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 2, n)
+    x = (np.stack([y * 2.0, -y * 1.5], -1) + rng.normal(0, 0.4, (n, 2))).astype(np.float32)
+    return x, np.eye(2, dtype=np.float32)[y]
+
+
+def _conf(lr=1e-2, hidden=8):
+    return (NeuralNetConfiguration.builder().seed(3).updater(Adam(lr)).list()
+            .layer(DenseLayer(n_out=hidden, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax"))
+            .set_input_type(InputType.feed_forward(2)).build())
+
+
+def test_stats_listener_and_ui_server():
+    x, y = _data()
+    it = NumpyDataSetIterator(x, y, batch_size=32)
+    net = MultiLayerNetwork(_conf()).init()
+    storage = InMemoryStatsStorage()
+    net.set_listeners(StatsListener(storage, frequency=1))
+    net.fit(it, epochs=3)
+    recs = storage.records()
+    assert len(recs) >= 9
+    assert "score" in recs[0] and "params" in recs[0]
+    assert "layer_0" in recs[0]["params"]
+    assert recs[-1]["score"] < recs[0]["score"]
+
+    server = UIServer.get_instance()
+    server.attach(storage)
+    port = server.start(port=0)
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/api/records") as r:
+            data = json.loads(r.read())
+        assert len(data) == len(recs)
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/") as r:
+            page = r.read().decode()
+        assert "Training overview" in page
+    finally:
+        server.stop()
+
+
+def test_random_search_finds_good_config():
+    x, y = _data(128)
+    train = NumpyDataSetIterator(x[:96], y[:96], batch_size=32)
+    test = NumpyDataSetIterator(x[96:], y[96:], batch_size=32)
+    space = {
+        "lr": ContinuousParameterSpace(1e-4, 1e-1, log_scale=True),
+        "hidden": DiscreteParameterSpace(4, 8, 16),
+    }
+    runner = LocalOptimizationRunner(
+        lambda c: _conf(lr=c["lr"], hidden=c["hidden"]), space,
+        RandomSearchGenerator(4, seed=2),
+        score_function=EvaluationScoreFunction("accuracy"),
+        train_iterator=train, eval_iterator=test, epochs=8)
+    best = runner.execute()
+    assert len(runner.results) == 4
+    assert best.score >= 0.8
+    assert runner.best_result().index == best.index
+
+
+def test_grid_generator_covers_product():
+    space = {"a": DiscreteParameterSpace(1, 2), "b": DiscreteParameterSpace("x", "y")}
+    combos = list(GridSearchGenerator().candidates(space))
+    assert len(combos) == 4
+    assert {"a": 1, "b": "x"} in combos
+
+
+def test_crash_report_contents():
+    from deeplearning4j_tpu.runtime.crash_reporting import CrashReportingUtil
+    net = MultiLayerNetwork(_conf()).init()
+    report = CrashReportingUtil.memory_report(net)
+    assert "parameter memory breakdown" in report
+    assert "layer_0" in report and "TOTAL" in report
