@@ -1,0 +1,231 @@
+"""Native host runtime components (C++, ctypes-bound).
+
+Where the reference's host runtime is native (libnd4j compression kernels,
+OpenCV image loader — SURVEY.md §2.1), this package builds the equivalents
+as a C++ shared library at first use (g++ -O3, cached next to the sources)
+and binds via ctypes:
+
+- ``ThresholdCodec`` — sparse sign-indexed + bitmap gradient compression
+  with residual accumulation (the reference's distributed wire format;
+  relevant on the DCN path, a documented non-goal over ICI).
+- ``ImagePipeline`` — multithreaded uint8→float conversion, per-channel
+  normalization, batched random crop/flip augmentation (everything after
+  JPEG entropy decode, which TF's native op already covers).
+
+Pure-numpy fallbacks keep the package usable if no compiler is present.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libdl4jtpu_host.so")
+_SOURCES = ["threshold_codec.cpp", "image_pipeline.cpp"]
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> Optional[str]:
+    srcs = [os.path.join(_DIR, s) for s in _SOURCES]
+    newest_src = max(os.path.getmtime(s) for s in srcs)
+    if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= newest_src:
+        return _LIB_PATH
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+           "-pthread", "-o", _LIB_PATH] + srcs
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return _LIB_PATH
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        # -march=native can fail on exotic hosts; retry generic
+        try:
+            cmd.remove("-march=native")
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            return _LIB_PATH
+        except Exception:
+            return None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Compile-on-first-use loader; None if no toolchain (fallback mode)."""
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        path = _build()
+        if path is None:
+            _build_failed = True
+            return None
+        lib = ctypes.CDLL(path)
+        c_f32p = ctypes.POINTER(ctypes.c_float)
+        c_i32p = ctypes.POINTER(ctypes.c_int32)
+        c_u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.threshold_encode.restype = ctypes.c_int64
+        lib.threshold_encode.argtypes = [c_f32p, c_f32p, ctypes.c_int64,
+                                         ctypes.c_float, c_i32p, ctypes.c_int64]
+        lib.threshold_decode.restype = None
+        lib.threshold_decode.argtypes = [c_i32p, ctypes.c_int64, ctypes.c_float,
+                                         c_f32p, ctypes.c_int64]
+        lib.bitmap_encode.restype = ctypes.c_int64
+        lib.bitmap_encode.argtypes = [c_f32p, c_f32p, ctypes.c_int64,
+                                      ctypes.c_float, c_u8p]
+        lib.bitmap_decode.restype = None
+        lib.bitmap_decode.argtypes = [c_u8p, ctypes.c_int64, ctypes.c_float, c_f32p]
+        lib.u8_to_f32.restype = None
+        lib.u8_to_f32.argtypes = [c_u8p, c_f32p, ctypes.c_int64, ctypes.c_float,
+                                  ctypes.c_float, ctypes.c_int32]
+        lib.normalize_nhwc.restype = None
+        lib.normalize_nhwc.argtypes = [c_u8p, c_f32p, ctypes.c_int64,
+                                       ctypes.c_int32, c_f32p, c_f32p]
+        lib.random_crop_flip_batch.restype = None
+        lib.random_crop_flip_batch.argtypes = [
+            c_u8p, c_u8p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_uint64,
+            ctypes.c_int32, ctypes.c_int32]
+        _lib = lib
+        return _lib
+
+
+def _fp(a: np.ndarray, typ):
+    return a.ctypes.data_as(typ)
+
+
+class ThresholdCodec:
+    """Sparse threshold gradient codec with residual state (reference
+    ``EncodedGradientsAccumulator`` wire format)."""
+
+    def __init__(self, size: int, threshold: float = 1e-3):
+        self.size = int(size)
+        self.threshold = float(threshold)
+        self.residual = np.zeros(self.size, np.float32)
+
+    def encode(self, grad: np.ndarray) -> np.ndarray:
+        grad = np.ascontiguousarray(grad.reshape(-1), np.float32)
+        lib = get_lib()
+        if lib is not None:
+            out = np.empty(self.size, np.int32)
+            n = lib.threshold_encode(
+                _fp(grad, ctypes.POINTER(ctypes.c_float)),
+                _fp(self.residual, ctypes.POINTER(ctypes.c_float)),
+                self.size, self.threshold,
+                _fp(out, ctypes.POINTER(ctypes.c_int32)), self.size)
+            return out[:n].copy()
+        # numpy fallback
+        acc = grad + self.residual
+        pos = acc >= self.threshold
+        neg = acc <= -self.threshold
+        idx = np.nonzero(pos | neg)[0]
+        encoded = np.where(acc[idx] > 0, idx + 1, -(idx + 1)).astype(np.int32)
+        self.residual = acc
+        self.residual[idx] -= np.sign(acc[idx]) * self.threshold
+        return encoded
+
+    def decode(self, encoded: np.ndarray, target: Optional[np.ndarray] = None
+               ) -> np.ndarray:
+        if target is None:
+            target = np.zeros(self.size, np.float32)
+        encoded = np.ascontiguousarray(encoded, np.int32)
+        lib = get_lib()
+        if lib is not None:
+            lib.threshold_decode(
+                _fp(encoded, ctypes.POINTER(ctypes.c_int32)), len(encoded),
+                self.threshold, _fp(target, ctypes.POINTER(ctypes.c_float)),
+                self.size)
+            return target
+        idx = np.abs(encoded) - 1
+        target[idx] += np.sign(encoded) * self.threshold
+        return target
+
+    def encode_bitmap(self, grad: np.ndarray) -> np.ndarray:
+        grad = np.ascontiguousarray(grad.reshape(-1), np.float32)
+        lib = get_lib()
+        nbytes = (self.size + 3) // 4
+        if lib is not None:
+            out = np.empty(nbytes, np.uint8)
+            lib.bitmap_encode(
+                _fp(grad, ctypes.POINTER(ctypes.c_float)),
+                _fp(self.residual, ctypes.POINTER(ctypes.c_float)),
+                self.size, self.threshold, _fp(out, ctypes.POINTER(ctypes.c_uint8)))
+            return out
+        raise RuntimeError("bitmap encoding requires the native library")
+
+    def decode_bitmap(self, encoded: np.ndarray,
+                      target: Optional[np.ndarray] = None) -> np.ndarray:
+        if target is None:
+            target = np.zeros(self.size, np.float32)
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("bitmap decoding requires the native library")
+        lib.bitmap_decode(_fp(np.ascontiguousarray(encoded, np.uint8),
+                              ctypes.POINTER(ctypes.c_uint8)),
+                          self.size, self.threshold,
+                          _fp(target, ctypes.POINTER(ctypes.c_float)))
+        return target
+
+
+class ImagePipeline:
+    """Multithreaded post-decode image batch ops."""
+
+    def __init__(self, n_threads: Optional[int] = None):
+        self.n_threads = n_threads or min(8, os.cpu_count() or 1)
+
+    def to_float(self, batch_u8: np.ndarray, scale: float = 1.0 / 255.0,
+                 shift: float = 0.0) -> np.ndarray:
+        batch_u8 = np.ascontiguousarray(batch_u8, np.uint8)
+        out = np.empty(batch_u8.shape, np.float32)
+        lib = get_lib()
+        if lib is not None:
+            lib.u8_to_f32(_fp(batch_u8, ctypes.POINTER(ctypes.c_uint8)),
+                          _fp(out, ctypes.POINTER(ctypes.c_float)),
+                          batch_u8.size, scale, shift, self.n_threads)
+            return out
+        return batch_u8.astype(np.float32) * scale + shift
+
+    def normalize(self, batch_u8: np.ndarray, mean, std) -> np.ndarray:
+        """(..., C) uint8 -> float32 (x/255 - mean)/std per channel."""
+        batch_u8 = np.ascontiguousarray(batch_u8, np.uint8)
+        c = batch_u8.shape[-1]
+        mean = np.ascontiguousarray(mean, np.float32)
+        std = np.ascontiguousarray(std, np.float32)
+        out = np.empty(batch_u8.shape, np.float32)
+        lib = get_lib()
+        if lib is not None:
+            lib.normalize_nhwc(_fp(batch_u8, ctypes.POINTER(ctypes.c_uint8)),
+                               _fp(out, ctypes.POINTER(ctypes.c_float)),
+                               batch_u8.size // c, c,
+                               _fp(mean, ctypes.POINTER(ctypes.c_float)),
+                               _fp(std, ctypes.POINTER(ctypes.c_float)))
+            return out
+        return (batch_u8.astype(np.float32) / 255.0 - mean) / std
+
+    def random_crop_flip(self, batch_u8: np.ndarray, out_h: int, out_w: int,
+                         seed: int = 0, flip: bool = True) -> np.ndarray:
+        """(B, H, W, C) uint8 -> (B, out_h, out_w, C) uint8, deterministic
+        per (seed, image-index)."""
+        batch_u8 = np.ascontiguousarray(batch_u8, np.uint8)
+        b, h, w, c = batch_u8.shape
+        out = np.empty((b, out_h, out_w, c), np.uint8)
+        lib = get_lib()
+        if lib is not None:
+            lib.random_crop_flip_batch(
+                _fp(batch_u8, ctypes.POINTER(ctypes.c_uint8)),
+                _fp(out, ctypes.POINTER(ctypes.c_uint8)),
+                b, h, w, out_h, out_w, c, seed, int(flip), self.n_threads)
+            return out
+        rng = np.random.default_rng(seed)
+        for i in range(b):
+            oy = rng.integers(0, h - out_h + 1) if h > out_h else 0
+            ox = rng.integers(0, w - out_w + 1) if w > out_w else 0
+            img = batch_u8[i, oy:oy + out_h, ox:ox + out_w]
+            if flip and rng.integers(0, 2):
+                img = img[:, ::-1]
+            out[i] = img
+        return out

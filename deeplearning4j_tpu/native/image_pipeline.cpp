@@ -1,0 +1,119 @@
+// Host-side image batch pipeline — native data-loader component.
+//
+// The role the reference fills with NativeImageLoader + OpenCV (SURVEY.md
+// §2.1 "Native image loader"): the host-bound inner loops of the input
+// pipeline — uint8 -> float conversion with normalization, random
+// crop + horizontal flip augmentation, NHWC assembly — multithreaded C++ so
+// the TPU feed path is not bottlenecked on Python byte shuffling. JPEG
+// entropy decode itself is delegated to the bundled TF op (already native);
+// this library covers everything after decode.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+inline uint64_t next_rand(uint64_t* state) {
+  // xorshift64* — deterministic per-seed augmentation
+  uint64_t x = *state;
+  x ^= x >> 12;
+  x ^= x << 25;
+  x ^= x >> 27;
+  *state = x;
+  return x * 0x2545F4914F6CDD1DULL;
+}
+
+void convert_range(const uint8_t* in, float* out, int64_t start, int64_t end,
+                   float scale, float shift) {
+  for (int64_t i = start; i < end; ++i) {
+    out[i] = static_cast<float>(in[i]) * scale + shift;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// uint8 -> float32 with y = x * scale + shift, multithreaded.
+void u8_to_f32(const uint8_t* in, float* out, int64_t n, float scale,
+               float shift, int32_t n_threads) {
+  if (n_threads <= 1 || n < (1 << 16)) {
+    convert_range(in, out, 0, n, scale, shift);
+    return;
+  }
+  std::vector<std::thread> threads;
+  int64_t chunk = (n + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    int64_t s = t * chunk;
+    int64_t e = std::min(n, s + chunk);
+    if (s >= e) break;
+    threads.emplace_back(convert_range, in, out, s, e, scale, shift);
+  }
+  for (auto& th : threads) th.join();
+}
+
+// Per-channel mean/std normalize: out = (in*(1/255) - mean[c]) / std[c].
+// NHWC layout; in is uint8.
+void normalize_nhwc(const uint8_t* in, float* out, int64_t n_pixels,
+                    int32_t channels, const float* mean, const float* stddev) {
+  for (int64_t p = 0; p < n_pixels; ++p) {
+    const uint8_t* src = in + p * channels;
+    float* dst = out + p * channels;
+    for (int32_t c = 0; c < channels; ++c) {
+      dst[c] = (static_cast<float>(src[c]) / 255.0f - mean[c]) / stddev[c];
+    }
+  }
+}
+
+// Random crop + optional horizontal flip for a whole batch.
+// in:  (batch, in_h, in_w, c) uint8; out: (batch, out_h, out_w, c) uint8.
+// One xorshift stream per image derived from seed + index (deterministic,
+// order-independent — reproducible under any loader threading).
+void random_crop_flip_batch(const uint8_t* in, uint8_t* out, int32_t batch,
+                            int32_t in_h, int32_t in_w, int32_t out_h,
+                            int32_t out_w, int32_t c, uint64_t seed,
+                            int32_t do_flip, int32_t n_threads) {
+  auto work = [&](int32_t b0, int32_t b1) {
+    for (int32_t b = b0; b < b1; ++b) {
+      uint64_t state = seed + 0x9E3779B97F4A7C15ULL * (b + 1);
+      next_rand(&state);
+      int32_t max_y = in_h - out_h;
+      int32_t max_x = in_w - out_w;
+      int32_t oy = max_y > 0 ? static_cast<int32_t>(next_rand(&state) % (max_y + 1)) : 0;
+      int32_t ox = max_x > 0 ? static_cast<int32_t>(next_rand(&state) % (max_x + 1)) : 0;
+      bool flip = do_flip && (next_rand(&state) & 1);
+      const uint8_t* src_img = in + static_cast<int64_t>(b) * in_h * in_w * c;
+      uint8_t* dst_img = out + static_cast<int64_t>(b) * out_h * out_w * c;
+      for (int32_t y = 0; y < out_h; ++y) {
+        const uint8_t* src_row = src_img + (static_cast<int64_t>(y + oy) * in_w + ox) * c;
+        uint8_t* dst_row = dst_img + static_cast<int64_t>(y) * out_w * c;
+        if (!flip) {
+          std::memcpy(dst_row, src_row, static_cast<size_t>(out_w) * c);
+        } else {
+          for (int32_t x = 0; x < out_w; ++x) {
+            std::memcpy(dst_row + static_cast<int64_t>(x) * c,
+                        src_row + static_cast<int64_t>(out_w - 1 - x) * c, c);
+          }
+        }
+      }
+    }
+  };
+  if (n_threads <= 1 || batch < 4) {
+    work(0, batch);
+    return;
+  }
+  std::vector<std::thread> threads;
+  int32_t chunk = (batch + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    int32_t s = t * chunk;
+    int32_t e = std::min(batch, s + chunk);
+    if (s >= e) break;
+    threads.emplace_back(work, s, e);
+  }
+  for (auto& th : threads) th.join();
+}
+
+}  // extern "C"

@@ -1,0 +1,92 @@
+// Threshold gradient codec — native host component.
+//
+// Re-implementation of the reference's threshold-encoding wire format
+// (libnd4j compression kernels: encodeThreshold/decodeThreshold — SURVEY.md
+// §2.1 "Threshold encoding kernels"): values with |v| >= threshold are
+// encoded as sign-tagged int32 indices, the un-sent remainder accumulates in
+// a residual buffer. On-TPU DP uses dense psum over ICI (compression is a
+// non-goal there), but the codec stays relevant for the DCN/multi-slice path
+// and for parity with the reference's SharedTrainingMaster format.
+//
+// Encoding: out[0] = count; out[1..count] = (index + 1) with sign bit from
+// the value's sign (negative index => negative value), matching the
+// sparse-sign scheme. Residual update is fused into the encode pass.
+
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Encode with residual accumulation. Returns number of encoded indices
+// (capped at max_elements). grad is left untouched; residual is updated:
+//   acc = grad + residual
+//   if |acc| >= t: emit sign(acc)*t, residual = acc - sign(acc)*t
+//   else:          residual = acc
+int64_t threshold_encode(const float* grad, float* residual, int64_t n,
+                         float threshold, int32_t* out, int64_t max_elements) {
+  int64_t count = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    float acc = grad[i] + residual[i];
+    if (acc >= threshold && count < max_elements) {
+      out[count++] = static_cast<int32_t>(i + 1);
+      residual[i] = acc - threshold;
+    } else if (acc <= -threshold && count < max_elements) {
+      out[count++] = -static_cast<int32_t>(i + 1);
+      residual[i] = acc + threshold;
+    } else {
+      residual[i] = acc;
+    }
+  }
+  return count;
+}
+
+// Decode: target[|idx|-1] += sign(idx) * threshold
+void threshold_decode(const int32_t* encoded, int64_t count, float threshold,
+                      float* target, int64_t n) {
+  for (int64_t i = 0; i < count; ++i) {
+    int32_t idx = encoded[i];
+    if (idx > 0 && idx <= n) {
+      target[idx - 1] += threshold;
+    } else if (idx < 0 && -idx <= n) {
+      target[-idx - 1] -= threshold;
+    }
+  }
+}
+
+// Bitmap encoding (reference encodeBitmap): 2 bits per element
+// (0 = skip, 1 = +threshold, 2 = -threshold). Returns bytes written.
+int64_t bitmap_encode(const float* grad, float* residual, int64_t n,
+                      float threshold, uint8_t* out) {
+  int64_t nbytes = (n + 3) / 4;
+  std::memset(out, 0, nbytes);
+  for (int64_t i = 0; i < n; ++i) {
+    float acc = grad[i] + residual[i];
+    uint8_t code = 0;
+    if (acc >= threshold) {
+      code = 1;
+      residual[i] = acc - threshold;
+    } else if (acc <= -threshold) {
+      code = 2;
+      residual[i] = acc + threshold;
+    } else {
+      residual[i] = acc;
+    }
+    out[i >> 2] |= code << ((i & 3) * 2);
+  }
+  return nbytes;
+}
+
+void bitmap_decode(const uint8_t* encoded, int64_t n, float threshold,
+                   float* target) {
+  for (int64_t i = 0; i < n; ++i) {
+    uint8_t code = (encoded[i >> 2] >> ((i & 3) * 2)) & 3;
+    if (code == 1) target[i] += threshold;
+    else if (code == 2) target[i] -= threshold;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,129 @@
+"""Numerical gradient checking.
+
+Rebuild of upstream ``org.deeplearning4j.gradientcheck.GradientCheckUtil`` /
+``org.nd4j.autodiff.validation.GradCheckUtil`` (SURVEY.md §4): compare the
+training loss's analytic gradients (``jax.grad`` of the composed network)
+against central finite differences, parameter-by-parameter, in float64.
+
+Because backprop here is autodiff of the same forward that computes the loss
+(not hand-written per-layer backward like the reference), this check
+validates the *forward* semantics: masking, preprocessors, regularization
+terms, and loss fusion — the places where a framework bug can hide.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class GradientCheckUtil:
+    @staticmethod
+    def check_gradients(net, features, labels, *, epsilon: float = 1e-4,
+                        max_rel_error: float = 1e-2, abs_error_floor: float = 1e-6,
+                        max_per_param: int = 5, fmask=None, lmask=None,
+                        seed: int = 0, print_results: bool = False) -> bool:
+        """Sample up to ``max_per_param`` coordinates of every parameter
+        tensor; returns True iff all pass. ``net`` must be initialised.
+
+        Runs in float64 (like the reference, which checks in double): x64 is
+        enabled for the duration and params/inputs/compute dtype are upcast,
+        since float32 FD noise at eps=1e-4 swamps a 1e-2 tolerance."""
+        from deeplearning4j_tpu.runtime.environment import get_environment
+        x64_was = jax.config.jax_enable_x64
+        env = get_environment()
+        cdt_was = env.compute_dtype
+        jax.config.update("jax_enable_x64", True)
+        env.compute_dtype = jnp.float64
+        try:
+            return GradientCheckUtil._check_f64(
+                net, features, labels, epsilon=epsilon,
+                max_rel_error=max_rel_error, abs_error_floor=abs_error_floor,
+                max_per_param=max_per_param, fmask=fmask, lmask=lmask,
+                seed=seed, print_results=print_results)
+        finally:
+            env.compute_dtype = cdt_was
+            jax.config.update("jax_enable_x64", x64_was)
+            net._jit_cache.clear()  # drop f64-traced functions
+
+    @staticmethod
+    def _check_f64(net, features, labels, *, epsilon, max_rel_error,
+                   abs_error_floor, max_per_param, fmask, lmask, seed,
+                   print_results) -> bool:
+        def up(a):
+            a = jnp.asarray(a)
+            return a.astype(jnp.float64) if jnp.issubdtype(a.dtype, jnp.floating) else a
+
+        x = up(features)
+        y = up(labels)
+        fmask = None if fmask is None else up(fmask)
+        lmask = None if lmask is None else up(lmask)
+        params = jax.tree.map(up, net.train_state.params)
+        model_state = jax.tree.map(up, net.train_state.model_state)
+
+        def loss_fn(p):
+            # dropout off / deterministic path for checkable gradients
+            loss, _ = net._loss(p, model_state, x, y, None,
+                                fmask, lmask, training=False)
+            return loss
+
+        analytic = jax.grad(loss_fn)(params)
+        flat, _ = jax.tree_util.tree_flatten_with_path(params)
+        rng = np.random.default_rng(seed)
+        ok = True
+        for path, leaf in flat:
+            keys = tuple(str(getattr(p, "key", p)) for p in path)
+            a_leaf = np.asarray(_get_path(analytic, path), np.float64)
+            leaf_np = np.asarray(leaf, np.float64)
+            n = leaf_np.size
+            picks = rng.choice(n, size=min(max_per_param, n), replace=False)
+            for flat_idx in picks:
+                idx = np.unravel_index(flat_idx, leaf_np.shape)
+                fd = GradientCheckUtil._fd(loss_fn, params, path, idx, epsilon)
+                an = a_leaf[idx]
+                denom = max(abs(fd), abs(an), 1e-10)
+                rel = abs(fd - an) / denom
+                passed = rel < max_rel_error or abs(fd - an) < abs_error_floor
+                if print_results or not passed:
+                    print(f"  {'/'.join(keys)}[{idx}]: analytic={an:.6g} "
+                          f"fd={fd:.6g} rel={rel:.3g} {'OK' if passed else 'FAIL'}")
+                ok = ok and passed
+        return ok
+
+    @staticmethod
+    def _fd(loss_fn, params, path, idx, eps):
+        def perturbed(delta):
+            leaf = _get_path(params, path)
+            new_leaf = jnp.asarray(leaf).at[idx].add(delta)
+            return _set_path(params, path, new_leaf)
+
+        lp = float(loss_fn(perturbed(+eps)))
+        lm = float(loss_fn(perturbed(-eps)))
+        return (lp - lm) / (2 * eps)
+
+
+def _get_path(tree, path):
+    cur = tree
+    for p in path:
+        key = getattr(p, "key", getattr(p, "idx", None))
+        cur = cur[key]
+    return cur
+
+
+def _set_path(tree, path, value):
+    if not path:
+        return value
+    p, rest = path[0], path[1:]
+    key = getattr(p, "key", getattr(p, "idx", None))
+    if isinstance(tree, dict):
+        out = dict(tree)
+        out[key] = _set_path(tree[key], rest, value)
+        return out
+    if isinstance(tree, (list, tuple)):
+        out = list(tree)
+        out[key] = _set_path(tree[key], rest, value)
+        return type(tree)(out)
+    raise TypeError(f"Cannot set path into {type(tree)}")

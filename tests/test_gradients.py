@@ -1,0 +1,140 @@
+"""Layer-by-layer numerical gradient checks (reference
+``GradientCheckTests`` / ``CNNGradientCheckTest`` / ``LSTMGradientCheckTests``
+— SURVEY.md §4). Each net uses tanh/identity activations and double-checkable
+losses, as the reference does for FD stability."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn import (BatchNormalization, ConvolutionLayer, DenseLayer,
+                                   EmbeddingSequenceLayer, GlobalPoolingLayer, GRU,
+                                   InputType, LSTM, NeuralNetConfiguration,
+                                   OutputLayer, PoolingType, RnnOutputLayer,
+                                   SelfAttentionLayer, SimpleRnn, SubsamplingLayer,
+                                   Bidirectional)
+from deeplearning4j_tpu.train import Sgd
+from deeplearning4j_tpu.train.gradient_check import GradientCheckUtil
+
+
+def _check(conf, x, y, fmask=None, lmask=None):
+    net = MultiLayerNetwork(conf).init()
+    assert GradientCheckUtil.check_gradients(
+        net, x, y, fmask=fmask, lmask=lmask, max_per_param=4), "gradient check failed"
+
+
+def _base():
+    return NeuralNetConfiguration.builder().seed(12345).updater(Sgd(0.1))
+
+
+def test_dense_mlp_gradients():
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (6, 5)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 6)]
+    conf = (_base().l2(1e-3).list()
+            .layer(DenseLayer(n_out=7, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(5)).build())
+    _check(conf, x, y)
+
+
+def test_cnn_gradients():
+    rng = np.random.default_rng(1)
+    x = rng.normal(0, 1, (4, 8, 8, 2)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 4)]
+    conf = (_base().list()
+            .layer(ConvolutionLayer(n_out=3, kernel_size=(3, 3),
+                                    convolution_mode="same", activation="tanh"))
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(8, 8, 2)).build())
+    _check(conf, x, y)
+
+
+def test_batchnorm_gradients():
+    rng = np.random.default_rng(2)
+    x = rng.normal(0, 1, (6, 6, 6, 2)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 6)]
+    conf = (_base().list()
+            .layer(ConvolutionLayer(n_out=3, kernel_size=(3, 3),
+                                    convolution_mode="same", activation="identity"))
+            .layer(BatchNormalization(activation="tanh"))
+            .layer(GlobalPoolingLayer(pooling_type=PoolingType.AVG))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(6, 6, 2)).build())
+    _check(conf, x, y)
+
+
+@pytest.mark.parametrize("cell", [LSTM, GRU, SimpleRnn])
+def test_rnn_gradients(cell):
+    rng = np.random.default_rng(3)
+    x = rng.normal(0, 1, (3, 5, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (3, 5))]
+    conf = (_base().list()
+            .layer(cell(n_out=6, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(4)).build())
+    _check(conf, x, y)
+
+
+def test_rnn_gradients_with_mask():
+    rng = np.random.default_rng(4)
+    x = rng.normal(0, 1, (3, 6, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (3, 6))]
+    mask = np.ones((3, 6), np.float32)
+    mask[0, 4:] = 0
+    mask[2, 2:] = 0
+    conf = (_base().list()
+            .layer(LSTM(n_out=5, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(4)).build())
+    _check(conf, x, y, fmask=mask, lmask=mask)
+
+
+def test_bidirectional_gradients():
+    rng = np.random.default_rng(5)
+    x = rng.normal(0, 1, (3, 4, 3)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (3, 4))]
+    conf = (_base().list()
+            .layer(Bidirectional(layer=LSTM(n_out=4, activation="tanh"), mode="concat"))
+            .layer(RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(3)).build())
+    _check(conf, x, y)
+
+
+def test_attention_gradients():
+    rng = np.random.default_rng(6)
+    x = rng.normal(0, 1, (2, 6, 8)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (2, 6))]
+    conf = (_base().list()
+            .layer(SelfAttentionLayer(n_heads=2))
+            .layer(RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(8)).build())
+    _check(conf, x, y)
+
+
+def test_embedding_gradients():
+    rng = np.random.default_rng(7)
+    ids = rng.integers(0, 11, (4, 5)).astype(np.int32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (4, 5))]
+    conf = (_base().list()
+            .layer(EmbeddingSequenceLayer(n_in=11, n_out=6))
+            .layer(RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(1)).build())
+    _check(conf, ids, y)
+
+
+@pytest.mark.parametrize("loss,act", [("mse", "identity"), ("xent", "sigmoid"),
+                                      ("mae", "identity")])
+def test_loss_function_gradients(loss, act):
+    rng = np.random.default_rng(8)
+    x = rng.normal(0, 1, (5, 4)).astype(np.float32)
+    if loss == "xent":
+        y = rng.integers(0, 2, (5, 2)).astype(np.float32)
+    else:
+        y = rng.normal(0, 1, (5, 2)).astype(np.float32)
+    conf = (_base().list()
+            .layer(DenseLayer(n_out=6, activation="tanh"))
+            .layer(OutputLayer(n_out=2, activation=act, loss=loss))
+            .set_input_type(InputType.feed_forward(4)).build())
+    _check(conf, x, y)

@@ -1,0 +1,90 @@
+"""Native C++ host component tests (threshold codec, image pipeline)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.native import ImagePipeline, ThresholdCodec, get_lib
+
+
+def test_native_lib_builds():
+    assert get_lib() is not None, "g++ toolchain expected in this environment"
+
+
+def test_threshold_codec_roundtrip_and_residual():
+    n = 1000
+    codec = ThresholdCodec(n, threshold=0.1)
+    rng = np.random.default_rng(0)
+    grad = rng.normal(0, 0.05, n).astype(np.float32)  # mostly below threshold
+    grad[:10] = 0.5
+    grad[10:20] = -0.5
+    encoded = codec.encode(grad)
+    assert 20 <= len(encoded) <= n
+    decoded = codec.decode(encoded)
+    # every encoded position contributes exactly ±threshold
+    assert set(np.unique(np.abs(decoded[decoded != 0]))) == {np.float32(0.1)}
+    np.testing.assert_allclose(decoded[:10], 0.1)
+    np.testing.assert_allclose(decoded[10:20], -0.1)
+    # residual carries the remainder: 0.5 - 0.1 = 0.4
+    np.testing.assert_allclose(codec.residual[:10], 0.4, rtol=1e-6)
+    # repeated encoding of zeros drains the residual
+    drained = decoded.copy()
+    for _ in range(4):
+        enc = codec.encode(np.zeros(n, np.float32))
+        codec.decode(enc, drained)
+    np.testing.assert_allclose(drained[:10], 0.5, rtol=1e-5)
+
+
+def test_threshold_codec_matches_numpy_fallback():
+    n = 512
+    rng = np.random.default_rng(1)
+    grad = rng.normal(0, 0.2, n).astype(np.float32)
+    c_native = ThresholdCodec(n, 0.15)
+    enc_native = c_native.encode(grad)
+    # manual expected
+    pos = grad >= 0.15
+    neg = grad <= -0.15
+    expected_idx = np.nonzero(pos | neg)[0]
+    got_idx = np.abs(enc_native) - 1
+    np.testing.assert_array_equal(np.sort(got_idx), expected_idx)
+
+
+def test_bitmap_codec():
+    n = 100
+    codec = ThresholdCodec(n, 0.2)
+    grad = np.zeros(n, np.float32)
+    grad[3] = 1.0
+    grad[7] = -1.0
+    bm = codec.encode_bitmap(grad)
+    assert bm.dtype == np.uint8 and len(bm) == 25
+    out = codec.decode_bitmap(bm)
+    assert out[3] == np.float32(0.2) and out[7] == np.float32(-0.2)
+    assert np.count_nonzero(out) == 2
+
+
+def test_image_pipeline_matches_numpy():
+    pipe = ImagePipeline(n_threads=4)
+    rng = np.random.default_rng(2)
+    batch = rng.integers(0, 256, (8, 40, 40, 3), dtype=np.uint8)
+    f = pipe.to_float(batch)
+    np.testing.assert_allclose(f, batch.astype(np.float32) / 255.0, rtol=1e-6)
+
+    mean = np.array([0.5, 0.4, 0.3], np.float32)
+    std = np.array([0.2, 0.25, 0.3], np.float32)
+    norm = pipe.normalize(batch, mean, std)
+    expected = (batch.astype(np.float32) / 255.0 - mean) / std
+    np.testing.assert_allclose(norm, expected, rtol=1e-5)
+
+
+def test_random_crop_flip_deterministic():
+    pipe = ImagePipeline(n_threads=2)
+    rng = np.random.default_rng(3)
+    batch = rng.integers(0, 256, (6, 36, 36, 3), dtype=np.uint8)
+    a = pipe.random_crop_flip(batch, 32, 32, seed=42)
+    b = pipe.random_crop_flip(batch, 32, 32, seed=42)
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (6, 32, 32, 3)
+    c = pipe.random_crop_flip(batch, 32, 32, seed=43)
+    assert not np.array_equal(a, c)
+    # each output row must appear somewhere in the source image (crop of it)
+    src_rows = {bytes(r) for r in batch[0].reshape(-1, 3 * 36)[:, :]}  # loose check
+    assert a[0].shape == (32, 32, 3)
