@@ -317,6 +317,43 @@ class SameDiff:
         return self._apply(op, [self._lift(a) for a in args], attrs=attrs,
                            name=name, n_outputs=n_outputs)
 
+    # ---- control flow (reference: TF-style Switch/Merge/Enter/Exit frames;
+    # here structured lax primitives, which is what XLA wants) ----
+    def _apply_callable(self, fn, inputs: List[SDVariable], name: str,
+                        n_outputs: int = 1):
+        outs = []
+        for j in range(n_outputs):
+            base = name if n_outputs == 1 else f"{name}_{j}"
+            out = SDVariable(self, self._unique(base), VariableType.ARRAY)
+            self.vars[out.name] = out
+            outs.append(out)
+        self.ops.append(OpNode(op="__callable__", inputs=[v.name for v in inputs],
+                               outputs=[o.name for o in outs], attrs={"fn": fn}))
+        self._jit_cache.clear()
+        return outs[0] if n_outputs == 1 else tuple(outs)
+
+    def cond(self, pred, true_fn, false_fn, *operands, name: str = "cond"):
+        """``lax.cond`` over graph values: ``true_fn``/``false_fn`` take the
+        operand arrays and return one array (reference: If/Switch-Merge)."""
+        def fn(p, *xs):
+            return jax.lax.cond(jnp.reshape(p, ()).astype(bool), true_fn, false_fn, *xs)
+
+        return self._apply_callable(
+            fn, [self._lift(pred)] + [self._lift(o) for o in operands], name)
+
+    def while_loop(self, cond_fn, body_fn, *init, name: str = "while"):
+        """``lax.while_loop`` with an N-array carry (reference: While/Enter-
+        Exit frames). ``cond_fn(*carry) -> bool``, ``body_fn(*carry) -> carry``."""
+        n = len(init)
+
+        def fn(*xs):
+            out = jax.lax.while_loop(lambda c: jnp.reshape(cond_fn(*c), ()).astype(bool),
+                                     lambda c: tuple(body_fn(*c)), tuple(xs))
+            return out if n > 1 else out[0]
+
+        return self._apply_callable(fn, [self._lift(i) for i in init], name,
+                                    n_outputs=n)
+
     # --------------------------------------------------------------- execute
     def _needed_ops(self, outputs: Sequence[str]) -> List[OpNode]:
         """Ancestor subgraph of ``outputs`` (so executing 'probs' never
@@ -347,9 +384,10 @@ class SameDiff:
         for node in self._needed_ops(outputs):
             if all(o in env for o in node.outputs):
                 continue
-            fn = get_op(node.op)
+            fn = node.attrs["fn"] if node.op == "__callable__" else get_op(node.op)
             args = [env[i] for i in node.inputs]
-            res = fn(*args, **node.attrs)
+            attrs = {} if node.op == "__callable__" else node.attrs
+            res = fn(*args, **attrs)
             if len(node.outputs) == 1:
                 env[node.outputs[0]] = res
             else:
@@ -476,6 +514,10 @@ class SameDiff:
 
     # ----------------------------------------------------------------- serde
     def to_dict(self) -> dict:
+        if any(n.op == "__callable__" for n in self.ops):
+            raise ValueError(
+                "Graphs containing python control-flow callables (cond/"
+                "while_loop) are not serializable; export StableHLO instead")
         return {
             "vars": [{"name": v.name, "type": v.vtype.value,
                       "shape": list(v.shape) if v.shape else None}

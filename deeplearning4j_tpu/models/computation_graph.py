@@ -318,7 +318,7 @@ class ComputationGraph:
                 x = pp.pre_process(x)
             mask = None if masks is None else masks.get(name)
             lrng = jax.random.fold_in(rng, i) if rng is not None else None
-            if name in output_set and isinstance(node.obj, (OutputLayer, LossLayer)):
+            if name in output_set and hasattr(node.obj, "compute_loss"):
                 # apply input dropout ONCE; loss and forward share the result
                 x = node.obj._apply_input_dropout(x, node.obj._g, training, lrng)
                 last_inputs[name] = x
@@ -340,7 +340,7 @@ class ComputationGraph:
         for out_name, y in zip(self.conf.outputs, labels):
             node = self.conf.node(out_name)
             layer = node.obj
-            if not isinstance(layer, (OutputLayer, LossLayer)):
+            if not hasattr(layer, "compute_loss"):
                 raise ValueError(f"Output node {out_name!r} is not an output layer")
             mask = None if masks is None else masks.get(out_name)
             total = total + layer.compute_loss(

@@ -160,9 +160,10 @@ class MultiLayerNetwork:
             p = params.get(k, {})
             s = model_state.get(k, {})
             lrng = jax.random.fold_in(rng, i) if rng is not None else None
-            if i == n - 1 and isinstance(layer, (OutputLayer, LossLayer)):
+            if i == n - 1 and hasattr(layer, "compute_loss"):
                 x = layer._apply_input_dropout(x, layer._g, training, lrng)
                 last_input = x
+                layer._state_ref = s  # e.g. center-loss centers
                 x = layer.activate(p, x)
             elif carries is not None and isinstance(layer, BaseRecurrentLayer):
                 x = layer._apply_input_dropout(x, layer._g, training, lrng)
@@ -182,11 +183,15 @@ class MultiLayerNetwork:
             params, model_state, x, training=training, rng=rng, fmask=fmask,
             carries=carries)
         final = self.layers[-1]
-        if not isinstance(final, (OutputLayer, LossLayer)):
-            raise ValueError("Last layer must be an OutputLayer/LossLayer to compute loss")
+        if not hasattr(final, "compute_loss"):
+            raise ValueError("Last layer must be an output/loss layer to compute loss")
         k = _layer_key(len(self.layers) - 1, final)
         loss = final.compute_loss(params.get(k, {}), last_in, y, mask=lmask)
         loss = loss + self._reg_score(params)
+        if training and hasattr(final, "update_state_with_labels"):
+            new_state = dict(new_state)
+            new_state[k] = final.update_state_with_labels(
+                model_state.get(k, {}), jax.lax.stop_gradient(last_in), y)
         return loss, (new_state, new_carries)
 
     def _reg_score(self, params):

@@ -11,7 +11,8 @@ ParagraphVectors (PV-DBOW), tokenizer SPI, vocab cache,
 from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory, TokenizerFactory
 from deeplearning4j_tpu.nlp.vocab import VocabCache
 from deeplearning4j_tpu.nlp.word2vec import ParagraphVectors, Word2Vec
+from deeplearning4j_tpu.nlp.glove import Glove
 from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
 
-__all__ = ["Word2Vec", "ParagraphVectors", "VocabCache", "TokenizerFactory",
-           "DefaultTokenizerFactory", "WordVectorSerializer"]
+__all__ = ["Word2Vec", "ParagraphVectors", "Glove", "VocabCache",
+           "TokenizerFactory", "DefaultTokenizerFactory", "WordVectorSerializer"]

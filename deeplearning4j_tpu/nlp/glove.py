@@ -1,0 +1,125 @@
+"""GloVe embeddings (reference ``org.deeplearning4j.models.glove.Glove``).
+
+Co-occurrence statistics are accumulated on host (sparse dict over window
+pairs with 1/distance weighting, as in GloVe); training minimises
+``f(X_ij) (w_i·w~_j + b_i + b~_j - log X_ij)^2`` with AdaGrad, where the
+whole COO minibatch update runs as one jitted donated program.
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import defaultdict
+from typing import Iterable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory, TokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import VocabCache
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+def _glove_step(w, wt, b, bt, gw, gwt, gb, gbt, rows, cols, logx, weight, lr):
+    wi = jnp.take(w, rows, axis=0)
+    wj = jnp.take(wt, cols, axis=0)
+    bi = jnp.take(b, rows)
+    bj = jnp.take(bt, cols)
+    diff = jnp.sum(wi * wj, axis=-1) + bi + bj - logx
+    fdiff = weight * diff
+    loss = jnp.mean(fdiff * diff)
+
+    g_wi = fdiff[:, None] * wj
+    g_wj = fdiff[:, None] * wi
+    g_b = fdiff
+
+    def adagrad_update(table, gtable, idx, grads):
+        acc = jnp.zeros_like(gtable).at[idx].add(grads * grads)
+        gtable = gtable + acc
+        denom = jnp.sqrt(jnp.take(gtable, idx, axis=0)) + 1e-8
+        upd = jnp.zeros_like(table).at[idx].add(grads / denom)
+        return table - lr * upd, gtable
+
+    w, gw = adagrad_update(w, gw, rows, g_wi)
+    wt, gwt = adagrad_update(wt, gwt, cols, g_wj)
+    b2, gb = adagrad_update(b[:, None], gb[:, None], rows, g_b[:, None])
+    bt2, gbt = adagrad_update(bt[:, None], gbt[:, None], cols, g_b[:, None])
+    return w, wt, b2[:, 0], bt2[:, 0], gw, gwt, gb[:, 0], gbt[:, 0], loss
+
+
+class Glove:
+    def __init__(self, layer_size: int = 100, window_size: int = 5,
+                 min_word_frequency: int = 1, epochs: int = 25,
+                 learning_rate: float = 0.05, x_max: float = 100.0,
+                 alpha: float = 0.75, batch_size: int = 4096, seed: int = 42,
+                 tokenizer_factory: Optional[TokenizerFactory] = None):
+        self.layer_size = layer_size
+        self.window_size = window_size
+        self.min_word_frequency = min_word_frequency
+        self.epochs = epochs
+        self.learning_rate = learning_rate
+        self.x_max = x_max
+        self.alpha = alpha
+        self.batch_size = batch_size
+        self.seed = seed
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+        self.vocab: Optional[VocabCache] = None
+        self.emb: Optional[np.ndarray] = None
+
+    def fit(self, sentences: Iterable[str]) -> "Glove":
+        token_lists = [self.tokenizer_factory.create(s).get_tokens() for s in sentences]
+        self.vocab = VocabCache(self.min_word_frequency).fit(token_lists)
+        cooc = defaultdict(float)
+        for toks in token_lists:
+            ids = self.vocab.encode(toks)
+            for i, wi in enumerate(ids):
+                for j in range(max(0, i - self.window_size), i):
+                    cooc[(wi, ids[j])] += 1.0 / (i - j)
+                    cooc[(ids[j], wi)] += 1.0 / (i - j)
+        rows = np.asarray([k[0] for k in cooc], np.int32)
+        cols = np.asarray([k[1] for k in cooc], np.int32)
+        vals = np.asarray(list(cooc.values()), np.float32)
+        logx = np.log(vals)
+        weight = np.minimum((vals / self.x_max) ** self.alpha, 1.0).astype(np.float32)
+
+        V, D = len(self.vocab), self.layer_size
+        rng = np.random.default_rng(self.seed)
+        w = jnp.asarray(rng.uniform(-0.5 / D, 0.5 / D, (V, D)).astype(np.float32))
+        wt = jnp.asarray(rng.uniform(-0.5 / D, 0.5 / D, (V, D)).astype(np.float32))
+        b = jnp.zeros((V,), jnp.float32)
+        bt = jnp.zeros((V,), jnp.float32)
+        gw = jnp.full((V, D), 1e-8, jnp.float32)
+        gwt = jnp.full((V, D), 1e-8, jnp.float32)
+        gb = jnp.full((V,), 1e-8, jnp.float32)
+        gbt = jnp.full((V,), 1e-8, jnp.float32)
+        n = len(rows)
+        for _ in range(self.epochs):
+            order = rng.permutation(n)
+            for s in range(0, n, self.batch_size):
+                idx = order[s:s + self.batch_size]
+                (w, wt, b, bt, gw, gwt, gb, gbt, _) = _glove_step(
+                    w, wt, b, bt, gw, gwt, gb, gbt,
+                    jnp.asarray(rows[idx]), jnp.asarray(cols[idx]),
+                    jnp.asarray(logx[idx]), jnp.asarray(weight[idx]),
+                    jnp.float32(self.learning_rate))
+        self.emb = np.asarray(w) + np.asarray(wt)  # GloVe: sum of both tables
+        return self
+
+    def get_word_vector(self, word: str) -> Optional[np.ndarray]:
+        i = self.vocab.index_of(word)
+        return None if i < 0 else self.emb[i]
+
+    def similarity(self, w1: str, w2: str) -> float:
+        a, b = self.get_word_vector(w1), self.get_word_vector(w2)
+        if a is None or b is None:
+            return float("nan")
+        return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
+
+    def words_nearest(self, word: str, n: int = 10) -> List[str]:
+        i = self.vocab.index_of(word)
+        if i < 0:
+            return []
+        norms = self.emb / (np.linalg.norm(self.emb, axis=1, keepdims=True) + 1e-12)
+        sims = norms @ norms[i]
+        return [self.vocab.word_at_index(j) for j in np.argsort(-sims) if j != i][:n]

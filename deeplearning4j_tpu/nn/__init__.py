@@ -53,6 +53,16 @@ from deeplearning4j_tpu.nn.attention_layers import (
     SelfAttentionLayer,
     TransformerEncoderBlock,
 )
+from deeplearning4j_tpu.nn.extra_layers import (
+    CenterLossOutputLayer,
+    Convolution3D,
+    Cropping2D,
+    LocallyConnected2D,
+    Subsampling3DLayer,
+    Upsampling1D,
+    Upsampling3D,
+    Yolo2OutputLayer,
+)
 
 __all__ = [
     "GlobalConfig",
@@ -93,4 +103,12 @@ __all__ = [
     "LearnedPositionalEmbeddingLayer",
     "BertEmbeddingLayer",
     "ClsPoolingLayer",
+    "Convolution3D",
+    "Subsampling3DLayer",
+    "Upsampling1D",
+    "Upsampling3D",
+    "Cropping2D",
+    "LocallyConnected2D",
+    "CenterLossOutputLayer",
+    "Yolo2OutputLayer",
 ]

@@ -385,7 +385,7 @@ class GlobalPoolingLayer(Layer):
         return InputType.feed_forward(input_type.channels)
 
     def forward(self, params, state, x, *, training=False, rng=None, mask=None):
-        axes = (1,) if x.ndim == 3 else (1, 2)
+        axes = tuple(range(1, x.ndim - 1))  # all dims between batch and channels
         pt = PoolingType(self.pooling_type)
         if x.ndim == 3 and mask is not None:
             m = mask[..., None].astype(x.dtype)

@@ -1,0 +1,321 @@
+"""Remaining reference layer types: 3-D convolution family, cropping,
+locally-connected, center-loss output, YOLOv2 detection output.
+
+Reference classes: ``Convolution3D``, ``Subsampling3DLayer``,
+``Upsampling1D/3D``, ``Cropping2D``, ``LocallyConnected2D``,
+``CenterLossOutputLayer``, ``Yolo2OutputLayer``
+(upstream ``org.deeplearning4j.nn.conf.layers`` + ``...layers.objdetect``).
+
+Layouts: 3-D convs use NDHWC (channels-last, TPU-native).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.base import GlobalConfig, Layer, register_layer
+from deeplearning4j_tpu.nn.core_layers import OutputLayer
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.ops.activations import get_activation
+from deeplearning4j_tpu.ops.initializers import init_weights
+from deeplearning4j_tpu.ops.losses import compute_loss
+
+
+def _triple(v) -> Tuple[int, int, int]:
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * 3
+
+
+@register_layer
+@dataclasses.dataclass
+class Convolution3D(Layer):
+    """3-D conv over (batch, depth, height, width, channels), DHWIO kernel."""
+
+    n_out: int = 0
+    kernel_size: Any = (3, 3, 3)
+    stride: Any = (1, 1, 1)
+    convolution_mode: str = "same"
+    has_bias: bool = True
+
+    def output_type(self, input_type: InputType) -> InputType:
+        kd, kh, kw = _triple(self.kernel_size)
+        sd, sh, sw = _triple(self.stride)
+        same = self.convolution_mode.lower() == "same"
+
+        def osz(size, k, s):
+            return -(-size // s) if same else (size - k) // s + 1
+
+        return InputType.convolutional3d(osz(input_type.depth, kd, sd),
+                                         osz(input_type.height, kh, sh),
+                                         osz(input_type.width, kw, sw), self.n_out)
+
+    def init(self, key, input_type, g: GlobalConfig):
+        kd, kh, kw = _triple(self.kernel_size)
+        c_in = input_type.channels
+        fan_in = kd * kh * kw * c_in
+        params = {"W": init_weights(key, (kd, kh, kw, c_in, self.n_out), self._winit(g),
+                                    fan=(fan_in, kd * kh * kw * self.n_out), dtype=g.dtype)}
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self._binit(g), g.dtype or jnp.float32)
+        return params, {}
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        x = self._apply_input_dropout(x, self._g, training, rng)
+        same = self.convolution_mode.lower() == "same"
+        y = lax.conv_general_dilated(
+            x, params["W"], window_strides=_triple(self.stride),
+            padding="SAME" if same else "VALID",
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        if self.has_bias:
+            y = y + params["b"]
+        return get_activation(self._act(self._g))(y), state
+
+
+@register_layer
+@dataclasses.dataclass
+class Subsampling3DLayer(Layer):
+    pooling_type: str = "max"
+    kernel_size: Any = (2, 2, 2)
+    stride: Any = (2, 2, 2)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        kd, kh, kw = _triple(self.kernel_size)
+        sd, sh, sw = _triple(self.stride)
+        return InputType.convolutional3d((input_type.depth - kd) // sd + 1,
+                                         (input_type.height - kh) // sh + 1,
+                                         (input_type.width - kw) // sw + 1,
+                                         input_type.channels)
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        dims = (1, *_triple(self.kernel_size), 1)
+        strides = (1, *_triple(self.stride), 1)
+        if self.pooling_type.lower() == "max":
+            return lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, "VALID"), state
+        s = lax.reduce_window(x, 0.0, lax.add, dims, strides, "VALID")
+        n = 1
+        for k in _triple(self.kernel_size):
+            n *= k
+        return s / n, state
+
+
+@register_layer
+@dataclasses.dataclass
+class Upsampling1D(Layer):
+    size: int = 2
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t = None if input_type.timesteps is None else input_type.timesteps * self.size
+        return InputType.recurrent(input_type.size, t)
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        return jnp.repeat(x, self.size, axis=1), state
+
+
+@register_layer
+@dataclasses.dataclass
+class Upsampling3D(Layer):
+    size: Any = (2, 2, 2)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        sd, sh, sw = _triple(self.size)
+        return InputType.convolutional3d(input_type.depth * sd, input_type.height * sh,
+                                         input_type.width * sw, input_type.channels)
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        sd, sh, sw = _triple(self.size)
+        x = jnp.repeat(x, sd, axis=1)
+        x = jnp.repeat(x, sh, axis=2)
+        return jnp.repeat(x, sw, axis=3), state
+
+
+@register_layer
+@dataclasses.dataclass
+class Cropping2D(Layer):
+    """Crop spatial borders (reference ``Cropping2D``)."""
+
+    crop: Any = (0, 0)  # (top/bottom, left/right) or ((t,b),(l,r))
+
+    def _crops(self):
+        c = self.crop
+        if isinstance(c, (tuple, list)) and len(c) == 2 and isinstance(c[0], (tuple, list)):
+            return tuple(c[0]), tuple(c[1])
+        a, b = (c, c) if isinstance(c, int) else c
+        return (a, a), (b, b)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        (t, b), (l, r) = self._crops()
+        return InputType.convolutional(input_type.height - t - b,
+                                       input_type.width - l - r, input_type.channels)
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        (t, b), (l, r) = self._crops()
+        h, w = x.shape[1], x.shape[2]
+        return x[:, t:h - b or None, l:w - r or None, :], state
+
+
+@register_layer
+@dataclasses.dataclass
+class LocallyConnected2D(Layer):
+    """Conv with UNSHARED weights per output position (reference
+    ``LocallyConnected2D``) via ``lax.conv_general_dilated_local``."""
+
+    n_out: int = 0
+    kernel_size: Any = (3, 3)
+    stride: Any = (1, 1)
+    has_bias: bool = True
+
+    def _geom(self, it: InputType):
+        kh, kw = (self.kernel_size if isinstance(self.kernel_size, (tuple, list))
+                  else (self.kernel_size,) * 2)
+        sh, sw = (self.stride if isinstance(self.stride, (tuple, list))
+                  else (self.stride,) * 2)
+        oh = (it.height - kh) // sh + 1
+        ow = (it.width - kw) // sw + 1
+        return int(kh), int(kw), int(sh), int(sw), oh, ow
+
+    def output_type(self, input_type: InputType) -> InputType:
+        *_, oh, ow = self._geom(input_type)
+        return InputType.convolutional(oh, ow, self.n_out)
+
+    def init(self, key, input_type, g: GlobalConfig):
+        kh, kw, _, _, oh, ow = self._geom(input_type)
+        c_in = input_type.channels
+        # filter shape for conv_general_dilated_local (spatial..., c_in*kh*kw, c_out)
+        params = {"W": init_weights(key, (oh, ow, c_in * kh * kw, self.n_out),
+                                    self._winit(g), fan=(c_in * kh * kw, self.n_out),
+                                    dtype=g.dtype)}
+        if self.has_bias:
+            params["b"] = jnp.full((oh, ow, self.n_out), self._binit(g),
+                                   g.dtype or jnp.float32)
+        return params, {}
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        kh, kw, sh, sw, _, _ = self._geom(
+            InputType.convolutional(x.shape[1], x.shape[2], x.shape[3]))
+        y = lax.conv_general_dilated_local(
+            x, params["W"], window_strides=(sh, sw), padding="VALID",
+            filter_shape=(kh, kw), dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.has_bias:
+            y = y + params["b"]
+        return get_activation(self._act(self._g))(y), state
+
+
+@register_layer
+@dataclasses.dataclass
+class CenterLossOutputLayer(OutputLayer):
+    """Softmax + center loss (reference ``CenterLossOutputLayer``):
+    L = CE + (lambda/2)·||f - c_y||²; per-class centers kept in layer state
+    and updated with rate ``alpha`` toward the batch features."""
+
+    alpha: float = 0.05
+    lambda_: float = 2e-4
+
+    def init(self, key, input_type, g: GlobalConfig):
+        params, state = super().init(key, input_type, g)
+        n_in = self._nin(input_type)
+        state = dict(state)
+        state["centers"] = jnp.zeros((self.n_out, n_in), jnp.float32)
+        return params, state
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        y = get_activation(self._act(self._g))(self.preoutput(params, x))
+        return y, state
+
+    def update_state_with_labels(self, state, x, labels):
+        """EMA center update toward the batch's class means (the reference's
+        center update rule); called by the network's loss path where labels
+        are available."""
+        centers = state["centers"]
+        onehot = labels.astype(jnp.float32)
+        counts = jnp.sum(onehot, axis=0)  # (C,)
+        sums = onehot.T @ x.astype(jnp.float32)  # (C, n_in)
+        means = sums / jnp.maximum(counts, 1.0)[:, None]
+        updated = jnp.where(counts[:, None] > 0,
+                            centers + self.alpha * (means - centers), centers)
+        return {**state, "centers": updated}
+
+    def compute_loss(self, params, x, labels, mask=None):
+        ce = compute_loss(self.loss, labels, self.preoutput(params, x),
+                          activation=self._act(self._g), mask=mask)
+        centers = self._centers_for(labels)
+        if centers is None:
+            return ce
+        diff = x - centers
+        center_term = 0.5 * self.lambda_ * jnp.mean(jnp.sum(diff * diff, axis=-1))
+        return ce + center_term
+
+    def _centers_for(self, labels):
+        # centers live in model_state; fetched through the closure set by the
+        # network during forward. When unavailable (e.g. standalone call),
+        # the center term is skipped.
+        st = getattr(self, "_state_ref", None)
+        if st is None or "centers" not in st:
+            return None
+        idx = jnp.argmax(labels, axis=-1)
+        return jax.lax.stop_gradient(jnp.take(st["centers"], idx, axis=0))
+
+
+@register_layer
+@dataclasses.dataclass
+class Yolo2OutputLayer(Layer):
+    """YOLOv2 detection loss (reference
+    ``org.deeplearning4j.nn.layers.objdetect.Yolo2OutputLayer``).
+
+    Input: (batch, H, W, A*(5+C)) raw predictions with A anchor boxes.
+    Labels: same-shaped tensor where, per assigned anchor cell,
+    channels are [tx, ty, tw, th, objectness(0/1), class one-hot...].
+    Loss = coord (MSE on xy via sigmoid, wh via raw) * lambda_coord
+         + objectness BCE (obj + lambda_noobj * noobj) + class CE on
+    responsible cells. Simplified from the reference: IoU-based anchor
+    assignment is expected to be done by the label encoder.
+    """
+
+    anchors: Any = ((1.0, 1.0),)
+    n_classes: int = 0
+    lambda_coord: float = 5.0
+    lambda_noobj: float = 0.5
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        return x, state
+
+    def activate(self, params, x):
+        return x  # raw predictions; use activate_boxes() to decode
+
+    def activate_boxes(self, x):
+        b, h, w, _ = x.shape
+        a = len(self.anchors)
+        p = x.reshape(b, h, w, a, 5 + self.n_classes)
+        xy = jax.nn.sigmoid(p[..., 0:2])
+        wh = p[..., 2:4]
+        obj = jax.nn.sigmoid(p[..., 4:5])
+        cls = jax.nn.softmax(p[..., 5:], axis=-1) if self.n_classes else p[..., 5:]
+        return xy, wh, obj, cls
+
+    def compute_loss(self, params, x, labels, mask=None):
+        b, h, w, _ = x.shape
+        a = len(self.anchors)
+        p = x.reshape(b, h, w, a, 5 + self.n_classes)
+        t = labels.reshape(b, h, w, a, 5 + self.n_classes)
+        resp = t[..., 4]  # 1 where an object is assigned to this anchor
+        xy_pred = jax.nn.sigmoid(p[..., 0:2])
+        coord = jnp.sum(resp[..., None] * ((xy_pred - t[..., 0:2]) ** 2
+                                           + (p[..., 2:4] - t[..., 2:4]) ** 2))
+        obj_logit = p[..., 4]
+        bce = jnp.maximum(obj_logit, 0) - obj_logit * resp + jnp.log1p(
+            jnp.exp(-jnp.abs(obj_logit)))
+        obj_loss = jnp.sum(resp * bce) + self.lambda_noobj * jnp.sum((1 - resp) * bce)
+        cls_loss = 0.0
+        if self.n_classes:
+            logp = jax.nn.log_softmax(p[..., 5:], axis=-1)
+            cls_loss = -jnp.sum(resp[..., None] * t[..., 5:] * logp)
+        n = jnp.maximum(jnp.sum(resp), 1.0)
+        return (self.lambda_coord * coord + obj_loss + cls_loss) / (b * 1.0)

@@ -1,0 +1,94 @@
+"""Tests for the extended layer set (3-D conv family, locally connected,
+center loss, YOLOv2 output)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn import (CenterLossOutputLayer, Convolution3D, Cropping2D,
+                                   DenseLayer, GlobalPoolingLayer, InputType,
+                                   LocallyConnected2D, NeuralNetConfiguration,
+                                   OutputLayer, PoolingType, Subsampling3DLayer,
+                                   Upsampling1D, Yolo2OutputLayer)
+from deeplearning4j_tpu.train import Adam
+
+
+def test_conv3d_stack():
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3)).list()
+            .layer(Convolution3D(n_out=4, kernel_size=(3, 3, 3), activation="relu"))
+            .layer(Subsampling3DLayer(kernel_size=(2, 2, 2), stride=(2, 2, 2)))
+            .layer(GlobalPoolingLayer(pooling_type=PoolingType.AVG))
+            .layer(OutputLayer(n_out=2, activation="softmax"))
+            .set_input_type(InputType.convolutional3d(8, 8, 8, 1)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(0).normal(0, 1, (2, 8, 8, 8, 1)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[[0, 1]]
+    net.fit(x, y, epochs=1)
+    out = np.asarray(net.output(x))
+    assert out.shape == (2, 2)
+
+
+def test_global_pooling_3d():
+    from deeplearning4j_tpu.nn import GlobalPoolingLayer
+    import jax.numpy as jnp
+    layer = GlobalPoolingLayer(pooling_type=PoolingType.AVG)
+    x = jnp.ones((2, 3, 4, 5, 6))
+    # 5-D input: pool over all spatial dims
+    y, _ = layer.forward({}, {}, x)
+    assert y.shape[0] == 2
+
+
+def test_cropping_and_locally_connected():
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3)).list()
+            .layer(Cropping2D(crop=(1, 1)))
+            .layer(LocallyConnected2D(n_out=3, kernel_size=(3, 3), activation="tanh"))
+            .layer(GlobalPoolingLayer(pooling_type=PoolingType.MAX))
+            .layer(OutputLayer(n_out=2, activation="softmax"))
+            .set_input_type(InputType.convolutional(10, 10, 2)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(1).normal(0, 1, (3, 10, 10, 2)).astype(np.float32)
+    out = np.asarray(net.output(x))
+    assert out.shape == (3, 2)
+    # unshared weights: W has one filter bank per output position (6x6)
+    w = net.params()["layer_1"]["W"]
+    assert w.shape[:2] == (6, 6)
+
+
+def test_center_loss_updates_centers():
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(5e-2)).list()
+            .layer(DenseLayer(n_out=4, activation="tanh"))
+            .layer(CenterLossOutputLayer(n_out=2, activation="softmax",
+                                         alpha=0.5, lambda_=0.1))
+            .set_input_type(InputType.feed_forward(3)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(2).normal(0, 1, (8, 3)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[np.random.default_rng(3).integers(0, 2, 8)]
+    centers_before = np.asarray(net.train_state.model_state["layer_1"]["centers"])
+    net.fit(x, y, epochs=2)
+    centers_after = np.asarray(net.train_state.model_state["layer_1"]["centers"])
+    assert not np.allclose(centers_before, centers_after), "centers did not move"
+    assert np.isfinite(net.score())
+
+
+def test_yolo2_loss_decreases():
+    H = W = 4
+    A, C = 2, 3
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(5e-3)).list()
+            .layer(LocallyConnected2D(n_out=A * (5 + C), kernel_size=(1, 1),
+                                      activation="identity"))
+            .layer(Yolo2OutputLayer(anchors=((1, 1), (2, 2)), n_classes=C))
+            .set_input_type(InputType.convolutional(H, W, 8)).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(4)
+    x = rng.normal(0, 1, (2, H, W, 8)).astype(np.float32)
+    labels = np.zeros((2, H, W, A, 5 + C), np.float32)
+    labels[0, 1, 1, 0] = [0.5, 0.5, 0.2, 0.2, 1.0, 1, 0, 0]
+    labels[1, 2, 3, 1] = [0.3, 0.7, 0.1, 0.4, 1.0, 0, 0, 1]
+    labels = labels.reshape(2, H, W, A * (5 + C))
+    from deeplearning4j_tpu.data import DataSet
+    from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+    it = ListDataSetIterator([DataSet(x, labels)])
+    net.fit(it, epochs=1)
+    first = net.score()
+    net.fit(it, epochs=30)
+    assert net.score() < first * 0.7, f"{first} -> {net.score()}"

@@ -1,0 +1,57 @@
+"""GloVe + SameDiff control-flow tests."""
+
+import numpy as np
+import pytest
+
+_CORPUS = [
+    "the king rules the castle",
+    "the queen rules the castle",
+    "the king and the queen sit on thrones",
+    "dogs chase cats around the garden",
+    "cats chase mice around the garden",
+    "the dog and the cat play in the garden",
+] * 20
+
+
+def test_glove_learns_cooccurrence():
+    from deeplearning4j_tpu.nlp import Glove
+    g = Glove(layer_size=24, window_size=4, min_word_frequency=2,
+              epochs=40, learning_rate=0.05, seed=11)
+    g.fit(_CORPUS)
+    royal = g.similarity("king", "queen")
+    cross = g.similarity("king", "mice")
+    assert np.isfinite(royal) and np.isfinite(cross)
+    assert royal > cross, f"king~queen {royal} vs king~mice {cross}"
+
+
+def test_samediff_cond():
+    from deeplearning4j_tpu.autodiff import SameDiff
+    sd = SameDiff.create()
+    x = sd.placeholder("x", (3,))
+    pred = sd.placeholder("p", ())
+    out = sd.cond(pred, lambda a: a * 2.0, lambda a: a - 1.0, x, name="branch")
+    r_true = np.asarray(sd.output({"x": np.ones(3, np.float32), "p": True}, out.name))
+    r_false = np.asarray(sd.output({"x": np.ones(3, np.float32), "p": False}, out.name))
+    np.testing.assert_allclose(r_true, [2, 2, 2])
+    np.testing.assert_allclose(r_false, [0, 0, 0])
+
+
+def test_samediff_while_loop():
+    from deeplearning4j_tpu.autodiff import SameDiff
+    sd = SameDiff.create()
+    i0 = sd.constant("i0", np.float32(0))
+    acc0 = sd.constant("acc0", np.float32(0))
+    i_out, acc_out = sd.while_loop(
+        lambda i, acc: i < 5, lambda i, acc: (i + 1, acc + i), i0, acc0,
+        name="loop")
+    assert float(np.asarray(i_out.eval())) == 5.0
+    assert float(np.asarray(acc_out.eval())) == 10.0  # 0+1+2+3+4
+
+
+def test_control_flow_graphs_refuse_serialization(tmp_path):
+    from deeplearning4j_tpu.autodiff import SameDiff
+    sd = SameDiff.create()
+    a = sd.constant("a", np.float32(1))
+    sd.cond(a > 0.0, lambda: a, lambda: a)
+    with pytest.raises(ValueError, match="not serializable"):
+        sd.save(str(tmp_path / "x.sdz"))
