@@ -341,14 +341,33 @@ class SameDiff:
         return self._apply_callable(
             fn, [self._lift(pred)] + [self._lift(o) for o in operands], name)
 
-    def while_loop(self, cond_fn, body_fn, *init, name: str = "while"):
+    def while_loop(self, cond_fn, body_fn, *init, name: str = "while",
+                   max_iterations: Optional[int] = None):
         """``lax.while_loop`` with an N-array carry (reference: While/Enter-
-        Exit frames). ``cond_fn(*carry) -> bool``, ``body_fn(*carry) -> carry``."""
+        Exit frames). ``cond_fn(*carry) -> bool``, ``body_fn(*carry) -> carry``.
+
+        Without ``max_iterations`` this lowers to ``lax.while_loop``, which
+        supports forward execution only — reverse-mode AD
+        (``calculate_gradients`` through the loop) raises, as in JAX. Pass
+        ``max_iterations`` (TF's ``maximum_iterations``) to lower to a
+        fixed-length ``lax.scan`` with predicate masking, which is fully
+        differentiable."""
         n = len(init)
 
         def fn(*xs):
-            out = jax.lax.while_loop(lambda c: jnp.reshape(cond_fn(*c), ()).astype(bool),
-                                     lambda c: tuple(body_fn(*c)), tuple(xs))
+            if max_iterations is None:
+                out = jax.lax.while_loop(
+                    lambda c: jnp.reshape(cond_fn(*c), ()).astype(bool),
+                    lambda c: tuple(body_fn(*c)), tuple(xs))
+            else:
+                def step(c, _):
+                    pred = jnp.reshape(cond_fn(*c), ()).astype(bool)
+                    new = tuple(body_fn(*c))
+                    c2 = tuple(jnp.where(pred, b, a) for a, b in zip(c, new))
+                    return c2, None
+
+                out, _ = jax.lax.scan(step, tuple(xs), None,
+                                      length=max_iterations)
             return out if n > 1 else out[0]
 
         return self._apply_callable(fn, [self._lift(i) for i in init], name,

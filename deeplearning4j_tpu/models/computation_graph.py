@@ -298,6 +298,8 @@ class ComputationGraph:
         new model state)."""
         env = get_environment()
         cdt = env.compute_dtype
+        from deeplearning4j_tpu.nn.base import cast_floating
+        params = cast_floating(params, cdt)
         acts: Dict[str, Any] = {}
         for name, x in inputs.items():
             if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != cdt:
@@ -343,8 +345,18 @@ class ComputationGraph:
             if not hasattr(layer, "compute_loss"):
                 raise ValueError(f"Output node {out_name!r} is not an output layer")
             mask = None if masks is None else masks.get(out_name)
+            from deeplearning4j_tpu.nn.base import cast_floating
+            from deeplearning4j_tpu.runtime.environment import get_environment
+            out_p = cast_floating(params.get(out_name, {}),
+                                  get_environment().compute_dtype)
             total = total + layer.compute_loss(
-                params.get(out_name, {}), last_inputs[out_name], y, mask=mask)
+                out_p, last_inputs[out_name], y, mask=mask,
+                state=model_state.get(out_name, {}))
+            if training and hasattr(layer, "update_state_with_labels"):
+                new_state = dict(new_state)
+                new_state[out_name] = layer.update_state_with_labels(
+                    model_state.get(out_name, {}),
+                    jax.lax.stop_gradient(last_inputs[out_name]), y)
         total = total + self._reg_score(params)
         return total, new_state
 

@@ -149,6 +149,8 @@ class MultiLayerNetwork:
         cdt = env.compute_dtype
         if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != cdt:
             x = x.astype(cdt)
+        from deeplearning4j_tpu.nn.base import cast_floating
+        params = cast_floating(params, cdt)
         new_state = dict(model_state)
         new_carries = {} if carries is not None else None
         last_input = x
@@ -163,7 +165,6 @@ class MultiLayerNetwork:
             if i == n - 1 and hasattr(layer, "compute_loss"):
                 x = layer._apply_input_dropout(x, layer._g, training, lrng)
                 last_input = x
-                layer._state_ref = s  # e.g. center-loss centers
                 x = layer.activate(p, x)
             elif carries is not None and isinstance(layer, BaseRecurrentLayer):
                 x = layer._apply_input_dropout(x, layer._g, training, lrng)
@@ -186,7 +187,10 @@ class MultiLayerNetwork:
         if not hasattr(final, "compute_loss"):
             raise ValueError("Last layer must be an output/loss layer to compute loss")
         k = _layer_key(len(self.layers) - 1, final)
-        loss = final.compute_loss(params.get(k, {}), last_in, y, mask=lmask)
+        from deeplearning4j_tpu.nn.base import cast_floating
+        final_p = cast_floating(params.get(k, {}), get_environment().compute_dtype)
+        loss = final.compute_loss(final_p, last_in, y, mask=lmask,
+                                  state=model_state.get(k, {}))
         loss = loss + self._reg_score(params)
         if training and hasattr(final, "update_state_with_labels"):
             new_state = dict(new_state)

@@ -49,6 +49,24 @@ def get_layer_class(name: str) -> Type["Layer"]:
     return _LAYER_REGISTRY[name]
 
 
+def cast_floating(tree, dtype):
+    """Cast floating-point leaves of a pytree to ``dtype``.
+
+    The mixed-precision policy: master params stay in ``default_dtype``
+    (float32); the jitted step casts them to ``compute_dtype`` (bfloat16 on
+    TPU) here, right before use. Autodiff transposes the cast, so gradients
+    land back in the master dtype and the optimizer update stays full
+    precision."""
+    import jax.numpy as jnp
+
+    def _c(a):
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating) and a.dtype != dtype:
+            return a.astype(dtype)
+        return a
+
+    return jax.tree.map(_c, tree)
+
+
 @dataclasses.dataclass
 class GlobalConfig:
     """Network-wide defaults that layers inherit when their own field is None.

@@ -87,7 +87,7 @@ class OutputLayer(DenseLayer):
         the forward output see the same dropped input)."""
         return get_activation(self._act(self._g))(self.preoutput(params, x))
 
-    def compute_loss(self, params, x, labels, mask=None):
+    def compute_loss(self, params, x, labels, mask=None, state=None):
         return compute_loss(self.loss, labels, self.preoutput(params, x),
                             activation=self._act(self._g), mask=mask)
 
@@ -106,7 +106,7 @@ class LossLayer(Layer):
     def activate(self, params, x):
         return get_activation(self._act(self._g))(x)
 
-    def compute_loss(self, params, x, labels, mask=None):
+    def compute_loss(self, params, x, labels, mask=None, state=None):
         return compute_loss(self.loss, labels, x, activation=self._act(self._g), mask=mask)
 
 

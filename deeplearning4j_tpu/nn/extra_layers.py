@@ -223,10 +223,6 @@ class CenterLossOutputLayer(OutputLayer):
         state["centers"] = jnp.zeros((self.n_out, n_in), jnp.float32)
         return params, state
 
-    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
-        y = get_activation(self._act(self._g))(self.preoutput(params, x))
-        return y, state
-
     def update_state_with_labels(self, state, x, labels):
         """EMA center update toward the batch's class means (the reference's
         center update rule); called by the network's loss path where labels
@@ -240,25 +236,19 @@ class CenterLossOutputLayer(OutputLayer):
                             centers + self.alpha * (means - centers), centers)
         return {**state, "centers": updated}
 
-    def compute_loss(self, params, x, labels, mask=None):
+    def compute_loss(self, params, x, labels, mask=None, state=None):
         ce = compute_loss(self.loss, labels, self.preoutput(params, x),
                           activation=self._act(self._g), mask=mask)
-        centers = self._centers_for(labels)
-        if centers is None:
+        if not state or "centers" not in state:
+            # centers live in model_state, passed by the network's loss path;
+            # standalone calls without state skip the center term.
             return ce
+        idx = jnp.argmax(labels, axis=-1)
+        centers = jax.lax.stop_gradient(
+            jnp.take(state["centers"], idx, axis=0).astype(x.dtype))
         diff = x - centers
         center_term = 0.5 * self.lambda_ * jnp.mean(jnp.sum(diff * diff, axis=-1))
         return ce + center_term
-
-    def _centers_for(self, labels):
-        # centers live in model_state; fetched through the closure set by the
-        # network during forward. When unavailable (e.g. standalone call),
-        # the center term is skipped.
-        st = getattr(self, "_state_ref", None)
-        if st is None or "centers" not in st:
-            return None
-        idx = jnp.argmax(labels, axis=-1)
-        return jax.lax.stop_gradient(jnp.take(st["centers"], idx, axis=0))
 
 
 @register_layer
@@ -300,7 +290,7 @@ class Yolo2OutputLayer(Layer):
         cls = jax.nn.softmax(p[..., 5:], axis=-1) if self.n_classes else p[..., 5:]
         return xy, wh, obj, cls
 
-    def compute_loss(self, params, x, labels, mask=None):
+    def compute_loss(self, params, x, labels, mask=None, state=None):
         b, h, w, _ = x.shape
         a = len(self.anchors)
         p = x.reshape(b, h, w, a, 5 + self.n_classes)
@@ -317,5 +307,6 @@ class Yolo2OutputLayer(Layer):
         if self.n_classes:
             logp = jax.nn.log_softmax(p[..., 5:], axis=-1)
             cls_loss = -jnp.sum(resp[..., None] * t[..., 5:] * logp)
-        n = jnp.maximum(jnp.sum(resp), 1.0)
+        # Loss is averaged over the minibatch only (the reference's score
+        # convention); per-object normalisation is deliberately not applied.
         return (self.lambda_coord * coord + obj_loss + cls_loss) / (b * 1.0)

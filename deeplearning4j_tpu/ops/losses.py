@@ -79,6 +79,13 @@ def compute_loss(
     """
     if callable(loss) and not isinstance(loss, (str, LossFunction)):
         return loss(labels, preoutput, mask)
+    # Losses compute in >= float32 even under a bfloat16 compute policy
+    # (softmax/log terms are unstable in bf16); float64 grad-checks keep f64.
+    if jnp.issubdtype(preoutput.dtype, jnp.floating):
+        ldt = jnp.promote_types(preoutput.dtype, jnp.float32)
+        preoutput = preoutput.astype(ldt)
+        if jnp.issubdtype(jnp.asarray(labels).dtype, jnp.floating):
+            labels = jnp.asarray(labels).astype(ldt)
     fn = _LOSSES[_coerce(loss)]
     if preoutput.ndim == 3:  # (batch, time, out) -> fold time into batch
         b, t = preoutput.shape[0], preoutput.shape[1]

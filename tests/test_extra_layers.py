@@ -92,3 +92,24 @@ def test_yolo2_loss_decreases():
     first = net.score()
     net.fit(it, epochs=30)
     assert net.score() < first * 0.7, f"{first} -> {net.score()}"
+
+
+def test_center_loss_updates_centers_in_computation_graph():
+    # the ComputationGraph loss path must update centers too, not just MLN
+    from deeplearning4j_tpu.models import ComputationGraph
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(5e-2))
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("dense", DenseLayer(n_out=4, activation="tanh"), "in")
+            .add_layer("out", CenterLossOutputLayer(n_out=2, activation="softmax",
+                                                    alpha=0.5, lambda_=0.1), "dense")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(3))
+            .build())
+    net = ComputationGraph(conf).init()
+    x = np.random.default_rng(2).normal(0, 1, (8, 3)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[np.random.default_rng(3).integers(0, 2, 8)]
+    before = np.asarray(net.train_state.model_state["out"]["centers"])
+    net.fit(x, y, epochs=2)
+    after = np.asarray(net.train_state.model_state["out"]["centers"])
+    assert not np.allclose(before, after), "CG center-loss centers did not move"

@@ -55,3 +55,18 @@ def test_control_flow_graphs_refuse_serialization(tmp_path):
     sd.cond(a > 0.0, lambda: a, lambda: a)
     with pytest.raises(ValueError, match="not serializable"):
         sd.save(str(tmp_path / "x.sdz"))
+
+
+def test_samediff_while_loop_max_iterations_differentiable():
+    # bounded while lowers to scan -> reverse-mode AD works
+    from deeplearning4j_tpu.autodiff import SameDiff
+    sd = SameDiff.create()
+    x = sd.var("x", array=np.float32(2.0))
+    i0 = sd.constant("i0", np.float32(0))
+    i_out, y, _ = sd.while_loop(
+        lambda i, v, xv: i < 3, lambda i, v, xv: (i + 1, v * xv, xv), i0, x, x,
+        name="loop", max_iterations=8)
+    sd.set_loss_variables(y.name)
+    g = sd.calculate_gradients({}, "x")
+    # y = x * x^3 = x^4 -> dy/dx = 4x^3 = 32 at x=2
+    np.testing.assert_allclose(float(np.asarray(g["x"])), 32.0, rtol=1e-5)
