@@ -36,6 +36,7 @@ from deeplearning4j_tpu.nn.core_layers import LossLayer, OutputLayer
 from deeplearning4j_tpu.nn.graph_vertices import GraphVertex
 from deeplearning4j_tpu.nn.inputs import InputType
 from deeplearning4j_tpu.models.multi_layer_network import TrainState, _mask_keys
+from deeplearning4j_tpu.nn.base import cast_floating
 from deeplearning4j_tpu.runtime.environment import get_environment
 from deeplearning4j_tpu.runtime.rng import RngManager
 from deeplearning4j_tpu.train.listeners import TrainingListener
@@ -298,7 +299,6 @@ class ComputationGraph:
         new model state)."""
         env = get_environment()
         cdt = env.compute_dtype
-        from deeplearning4j_tpu.nn.base import cast_floating
         params = cast_floating(params, cdt)
         acts: Dict[str, Any] = {}
         for name, x in inputs.items():
@@ -345,8 +345,6 @@ class ComputationGraph:
             if not hasattr(layer, "compute_loss"):
                 raise ValueError(f"Output node {out_name!r} is not an output layer")
             mask = None if masks is None else masks.get(out_name)
-            from deeplearning4j_tpu.nn.base import cast_floating
-            from deeplearning4j_tpu.runtime.environment import get_environment
             out_p = cast_floating(params.get(out_name, {}),
                                   get_environment().compute_dtype)
             total = total + layer.compute_loss(
