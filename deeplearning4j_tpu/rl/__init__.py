@@ -1,0 +1,30 @@
+"""Reinforcement learning (rebuild of the reference's RL4J module).
+
+Upstream RL4J (``rl4j/``, merged into the deeplearning4j monorepo ~beta7)
+provides DQN (``QLearningDiscreteDense``), async actor-critic (``A3CDiscrete``),
+async n-step Q-learning, experience replay, epsilon-greedy policies, and an
+``MDP`` environment SPI (gym/ALE/malmo adapters).
+
+TPU-native redesign (SURVEY.md §7.1 — capability, not translation):
+
+- Environments run on host (numpy); the learner is ONE jitted update step
+  (TD/actor-critic loss, grads, optimizer) over batched transitions.
+- A3C's async worker threads are an artifact of per-op CPU/GPU dispatch; the
+  TPU equivalent is synchronous advantage actor-critic over a *batch of
+  vectorized environments* (same estimator, better hardware fit) —
+  ``AdvantageActorCritic``.
+- n-step returns are computed with a scan inside the jitted update.
+"""
+
+from deeplearning4j_tpu.rl.mdp import MDP, CartPole, DiscreteSpace, GridWorld, ObservationSpace
+from deeplearning4j_tpu.rl.replay import ExpReplay, Transition
+from deeplearning4j_tpu.rl.policy import BoltzmannPolicy, EpsGreedy, GreedyPolicy
+from deeplearning4j_tpu.rl.qlearning import QLearningConfiguration, QLearningDiscreteDense
+from deeplearning4j_tpu.rl.a2c import A2CConfiguration, AdvantageActorCritic
+
+__all__ = [
+    "MDP", "CartPole", "GridWorld", "DiscreteSpace", "ObservationSpace",
+    "ExpReplay", "Transition", "EpsGreedy", "GreedyPolicy", "BoltzmannPolicy",
+    "QLearningConfiguration", "QLearningDiscreteDense",
+    "A2CConfiguration", "AdvantageActorCritic",
+]

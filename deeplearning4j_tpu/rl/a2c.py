@@ -1,0 +1,193 @@
+"""Advantage actor-critic (reference ``org.deeplearning4j.rl4j.learning.async.
+a3c.discrete.A3CDiscreteDense`` + ``AsyncNStepQLearning``).
+
+RL4J runs asynchronous worker threads because its per-op dispatch engine
+cannot batch across actors; on TPU the same estimator is computed
+synchronously over a *vector of environments* — one jitted update per n-step
+rollout (policy gradient with n-step advantage, entropy bonus, value MSE).
+The trunk/policy-head/value-head network is a two-output ``ComputationGraph``
+from the standard DSL, exactly how a user would build it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deeplearning4j_tpu.models.computation_graph import ComputationGraph, TrainState
+from deeplearning4j_tpu.nn import DenseLayer, InputType, OutputLayer
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+from deeplearning4j_tpu.rl.mdp import MDP
+from deeplearning4j_tpu.train.updaters import Adam
+
+
+@dataclasses.dataclass
+class A2CConfiguration:
+    """Reference ``A3CConfiguration``, plus the env-batch width that replaces
+    the thread count (``num_threads`` -> ``num_envs``)."""
+
+    seed: int = 123
+    max_epoch_step: int = 200
+    max_step: int = 20000
+    num_envs: int = 8                  # reference: numThread
+    n_step: int = 5                    # reference: nstep (t_max)
+    gamma: float = 0.99
+    entropy_coef: float = 0.01
+    value_coef: float = 0.5
+    reward_factor: float = 1.0
+
+
+class AdvantageActorCritic:
+    def __init__(self, mdp_factory, conf: Optional[A2CConfiguration] = None,
+                 hidden: tuple = (64,), updater=None):
+        self.conf = conf or A2CConfiguration()
+        self.envs: List[MDP] = [mdp_factory(i) for i in range(self.conf.num_envs)]
+        proto = self.envs[0]
+        self.n_actions = proto.action_space.n
+        self.obs_dim = int(np.prod(proto.observation_space.shape))
+        self.net = self._build_net(hidden, updater)
+        self.net.init()
+        self._rng = np.random.default_rng(self.conf.seed)
+        self._key = jax.random.PRNGKey(self.conf.seed)
+        self._update = None
+        self._pi_v = None
+        self.episode_rewards: List[float] = []
+
+    def _build_net(self, hidden: tuple, updater) -> ComputationGraph:
+        g = (NeuralNetConfiguration.builder()
+             .seed(self.conf.seed)
+             .updater(updater or Adam(7e-4))
+             .weight_init("xavier")
+             .graph_builder()
+             .add_inputs("obs"))
+        prev = "obs"
+        for i, h in enumerate(hidden):
+            g.add_layer(f"trunk{i}", DenseLayer(n_out=h, activation="tanh"), prev)
+            prev = f"trunk{i}"
+        g.add_layer("pi", OutputLayer(n_out=self.n_actions, activation="softmax",
+                                      loss="mcxent"), prev)
+        g.add_layer("v", OutputLayer(n_out=1, activation="identity", loss="mse"),
+                    prev)
+        g.set_outputs("pi", "v")
+        g.set_input_types(InputType.feed_forward(self.obs_dim))
+        return ComputationGraph(g.build())
+
+    # ------------------------------------------------------------- jitted ops
+    def _make_pi_v(self):
+        net = self.net
+
+        def pi_v(params, model_state, obs):
+            acts, _, _ = net._forward_all(params, model_state, {"obs": obs},
+                                          training=False, rng=None)
+            return acts["pi"], acts["v"][:, 0]
+
+        return jax.jit(pi_v)
+
+    def _make_update(self):
+        net, c = self.net, self.conf
+
+        def update(ts: TrainState, obs, actions, returns, rng):
+            """obs (T*B, D), actions (T*B,), returns (T*B,) n-step targets."""
+            def loss_fn(params):
+                acts, _, _ = net._forward_all(params, ts.model_state,
+                                              {"obs": obs}, training=True,
+                                              rng=rng)
+                pi, v = acts["pi"], acts["v"][:, 0]
+                logp = jnp.log(jnp.clip(pi, 1e-8))
+                logp_a = jnp.take_along_axis(logp, actions[:, None], -1)[:, 0]
+                adv = jax.lax.stop_gradient(returns - v)
+                policy_loss = -jnp.mean(logp_a * adv)
+                value_loss = jnp.mean((returns - v) ** 2)
+                entropy = -jnp.mean(jnp.sum(pi * logp, axis=-1))
+                total = (policy_loss + c.value_coef * value_loss
+                         - c.entropy_coef * entropy)
+                return total, (policy_loss, value_loss, entropy)
+
+            (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(ts.params)
+            updates, new_opt = net._tx.update(grads, ts.opt_state, ts.params)
+            new_params = optax.apply_updates(ts.params, updates)
+            return TrainState(params=new_params, model_state=ts.model_state,
+                              opt_state=new_opt, step=ts.step + 1), loss
+
+        return jax.jit(update, donate_argnums=(0,))
+
+    # ---------------------------------------------------------------- train
+    def train(self) -> "AdvantageActorCritic":
+        c = self.conf
+        if self._update is None:
+            self._update = self._make_update()
+            self._pi_v = self._make_pi_v()
+        B = c.num_envs
+        obs = np.stack([e.reset() for e in self.envs]).reshape(B, -1)
+        ep_rewards = np.zeros(B)
+        ep_steps = np.zeros(B, np.int64)
+        total_steps = 0
+        while total_steps < c.max_step:
+            ts = self.net.train_state
+            tr_obs, tr_act, tr_rew, tr_done = [], [], [], []
+            for _ in range(c.n_step):
+                pi, v = self._pi_v(ts.params, ts.model_state,
+                                   obs.astype(np.float32))
+                pi = np.asarray(pi, np.float64)
+                pi /= pi.sum(-1, keepdims=True)
+                acts = np.array([self._rng.choice(self.n_actions, p=pi[i])
+                                 for i in range(B)], np.int32)
+                step_out = [self.envs[i].step(int(acts[i])) for i in range(B)]
+                next_obs = np.stack([o for o, _, _, _ in step_out]).reshape(B, -1)
+                rewards = np.array([r for _, r, _, _ in step_out], np.float32)
+                dones = np.array([d for _, _, d, _ in step_out], np.float32)
+                tr_obs.append(obs.copy())
+                tr_act.append(acts)
+                tr_rew.append(rewards * c.reward_factor)
+                tr_done.append(dones)
+                ep_rewards += rewards
+                ep_steps += 1
+                for i in range(B):
+                    if dones[i] or ep_steps[i] >= c.max_epoch_step:
+                        self.episode_rewards.append(float(ep_rewards[i]))
+                        next_obs[i] = self.envs[i].reset().reshape(-1)
+                        ep_rewards[i], ep_steps[i] = 0.0, 0
+                        dones[i] = 1.0  # truncation bootstraps like termination
+                obs = next_obs
+                total_steps += B
+            # n-step discounted returns, bootstrapped with V(s_T)
+            _, v_last = self._pi_v(ts.params, ts.model_state,
+                                   obs.astype(np.float32))
+            ret = np.asarray(v_last, np.float32)
+            returns = np.zeros((c.n_step, B), np.float32)
+            for t in reversed(range(c.n_step)):
+                ret = tr_rew[t] + c.gamma * ret * (1.0 - tr_done[t])
+                returns[t] = ret
+            self._key, sub = jax.random.split(self._key)
+            self.net.train_state, loss = self._update(
+                self.net.train_state,
+                np.concatenate(tr_obs).astype(np.float32),
+                np.concatenate(tr_act),
+                returns.reshape(-1), sub)
+            self.net._score = loss
+        return self
+
+    # ---------------------------------------------------------------- play
+    def play(self, max_steps: Optional[int] = None) -> float:
+        """One greedy (argmax-policy) episode on env 0."""
+        if self._pi_v is None:
+            self._pi_v = self._make_pi_v()
+        env = self.envs[0]
+        obs = env.reset().reshape(1, -1)
+        total, steps = 0.0, 0
+        limit = max_steps or self.conf.max_epoch_step
+        ts = self.net.train_state
+        while steps < limit:
+            pi, _ = self._pi_v(ts.params, ts.model_state, obs.astype(np.float32))
+            o, reward, done, _ = env.step(int(np.argmax(np.asarray(pi)[0])))
+            obs = o.reshape(1, -1)
+            total += reward
+            steps += 1
+            if done:
+                break
+        return total

@@ -1,0 +1,126 @@
+"""MDP environment SPI (reference ``org.deeplearning4j.rl4j.mdp.MDP``) with
+built-in environments.
+
+The reference wraps gym-java-client / ALE / Malmo; offline here, so the
+built-ins are self-contained numpy environments: classic-control CartPole
+(standard published dynamics) and a small deterministic GridWorld whose
+optimal return is known in closed form (test oracle, like RL4J's toy MDPs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class ObservationSpace:
+    shape: Tuple[int, ...]
+    low: Optional[float] = None
+    high: Optional[float] = None
+
+
+@dataclasses.dataclass
+class DiscreteSpace:
+    n: int
+
+    def random_action(self, rng: np.random.Generator) -> int:
+        return int(rng.integers(0, self.n))
+
+
+class MDP:
+    """reset() -> obs; step(a) -> (obs, reward, done, info); close()."""
+
+    observation_space: ObservationSpace
+    action_space: DiscreteSpace
+
+    def reset(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, action: int) -> Tuple[np.ndarray, float, bool, Any]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    def is_done(self) -> bool:
+        return getattr(self, "_done", False)
+
+
+class CartPole(MDP):
+    """Cart-pole balancing (the classic control benchmark RL4J targets via
+    gym). Euler-integrated pole-on-cart dynamics; reward +1 per step; episode
+    ends on |x|>2.4, |theta|>12deg, or 500 steps."""
+
+    GRAVITY, CART_M, POLE_M, POLE_HALF_L = 9.8, 1.0, 0.1, 0.5
+    FORCE, TAU, MAX_STEPS = 10.0, 0.02, 500
+
+    def __init__(self, seed: int = 0):
+        self.observation_space = ObservationSpace((4,), -4.8, 4.8)
+        self.action_space = DiscreteSpace(2)
+        self._rng = np.random.default_rng(seed)
+        self._state = np.zeros(4, np.float32)
+        self._steps = 0
+        self._done = True
+
+    def reset(self) -> np.ndarray:
+        self._state = self._rng.uniform(-0.05, 0.05, 4).astype(np.float32)
+        self._steps = 0
+        self._done = False
+        return self._state.copy()
+
+    def step(self, action: int):
+        x, x_dot, th, th_dot = self._state
+        force = self.FORCE if action == 1 else -self.FORCE
+        total_m = self.CART_M + self.POLE_M
+        ml = self.POLE_M * self.POLE_HALF_L
+        cos_t, sin_t = np.cos(th), np.sin(th)
+        temp = (force + ml * th_dot**2 * sin_t) / total_m
+        th_acc = (self.GRAVITY * sin_t - cos_t * temp) / (
+            self.POLE_HALF_L * (4.0 / 3.0 - self.POLE_M * cos_t**2 / total_m))
+        x_acc = temp - ml * th_acc * cos_t / total_m
+        self._state = np.array([x + self.TAU * x_dot, x_dot + self.TAU * x_acc,
+                                th + self.TAU * th_dot, th_dot + self.TAU * th_acc],
+                               np.float32)
+        self._steps += 1
+        self._done = bool(abs(self._state[0]) > 2.4
+                          or abs(self._state[2]) > 12 * np.pi / 180
+                          or self._steps >= self.MAX_STEPS)
+        return self._state.copy(), 1.0, self._done, {}
+
+
+class GridWorld(MDP):
+    """Deterministic 1-D corridor of ``n`` cells; actions left/right; reward
+    +1 at the right end, -0.01 per step, episode cap 4n. Optimal policy is
+    'always right' with known return — the convergence oracle for tests
+    (RL4J's SimpleToy plays this role)."""
+
+    def __init__(self, n: int = 6):
+        self.n = n
+        self.observation_space = ObservationSpace((n,))
+        self.action_space = DiscreteSpace(2)
+        self._pos = 0
+        self._steps = 0
+        self._done = True
+
+    def _obs(self) -> np.ndarray:
+        v = np.zeros(self.n, np.float32)
+        v[self._pos] = 1.0
+        return v
+
+    def reset(self) -> np.ndarray:
+        self._pos, self._steps, self._done = 0, 0, False
+        return self._obs()
+
+    def step(self, action: int):
+        self._pos = min(self.n - 1, self._pos + 1) if action == 1 else max(0, self._pos - 1)
+        self._steps += 1
+        at_goal = self._pos == self.n - 1
+        self._done = bool(at_goal or self._steps >= 4 * self.n)
+        reward = 1.0 if at_goal else -0.01
+        return self._obs(), reward, self._done, {}
+
+    def optimal_return(self) -> float:
+        return 1.0 - 0.01 * (self.n - 2)

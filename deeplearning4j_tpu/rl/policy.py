@@ -1,0 +1,55 @@
+"""Action-selection policies (reference ``org.deeplearning4j.rl4j.policy.*``:
+``EpsGreedy``, ``DQNPolicy`` (greedy), ``BoltzmannQ``)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class GreedyPolicy:
+    """argmax over Q-values (reference ``DQNPolicy``)."""
+
+    def select(self, q_values: np.ndarray, rng: np.random.Generator) -> int:
+        return int(np.argmax(q_values))
+
+
+class EpsGreedy:
+    """Annealed epsilon-greedy (reference ``EpsGreedy``): epsilon decays
+    linearly from 1.0 to ``min_epsilon`` over ``epsilon_nb_step`` calls,
+    starting after ``update_start`` warmup steps."""
+
+    def __init__(self, n_actions: int, min_epsilon: float = 0.1,
+                 epsilon_nb_step: int = 10000, update_start: int = 0):
+        self.n_actions = n_actions
+        self.min_epsilon = min_epsilon
+        self.epsilon_nb_step = max(1, epsilon_nb_step)
+        self.update_start = update_start
+        self._calls = 0
+
+    @property
+    def epsilon(self) -> float:
+        t = max(0, self._calls - self.update_start)
+        return max(self.min_epsilon, 1.0 - t * (1.0 - self.min_epsilon)
+                   / self.epsilon_nb_step)
+
+    def select(self, q_values: np.ndarray, rng: np.random.Generator) -> int:
+        eps = self.epsilon
+        self._calls += 1
+        if rng.random() < eps:
+            return int(rng.integers(0, self.n_actions))
+        return int(np.argmax(q_values))
+
+
+class BoltzmannPolicy:
+    """Softmax sampling over Q-values at ``temperature`` (reference
+    ``BoltzmannQ``)."""
+
+    def __init__(self, temperature: float = 1.0):
+        self.temperature = temperature
+
+    def select(self, q_values: np.ndarray, rng: np.random.Generator) -> int:
+        z = np.asarray(q_values, np.float64) / self.temperature
+        z -= z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(rng.choice(len(p), p=p))

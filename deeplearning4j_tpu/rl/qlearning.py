@@ -1,0 +1,182 @@
+"""Deep Q-learning (reference ``org.deeplearning4j.rl4j.learning.sync.qlearning.
+discrete.QLearningDiscreteDense``).
+
+The Q-network is an ordinary ``MultiLayerNetwork`` built from the same config
+DSL users write; the learner compiles ONE jitted TD-update step (target
+computation, double-DQN action selection, Huber/MSE loss, grads, optimizer —
+the reference instead sets Q-labels host-side and calls ``fit`` per batch).
+Target-network sync is a pytree copy every ``target_dqn_update_freq`` steps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork, TrainState
+from deeplearning4j_tpu.nn import DenseLayer, InputType, NeuralNetConfiguration, OutputLayer
+from deeplearning4j_tpu.rl.mdp import MDP
+from deeplearning4j_tpu.rl.policy import EpsGreedy, GreedyPolicy
+from deeplearning4j_tpu.rl.replay import ExpReplay, Transition
+from deeplearning4j_tpu.train.updaters import Adam
+
+
+@dataclasses.dataclass
+class QLearningConfiguration:
+    """Reference ``QLearning.QLConfiguration`` fields, same semantics."""
+
+    seed: int = 123
+    max_epoch_step: int = 200          # max steps per episode
+    max_step: int = 15000              # total env steps
+    exp_rep_max_size: int = 150000
+    batch_size: int = 32
+    target_dqn_update_freq: int = 500
+    update_start: int = 10             # steps before learning starts
+    reward_factor: float = 1.0         # reward scaling
+    gamma: float = 0.99
+    error_clamp: float = 1.0           # TD-error clamp -> Huber delta (0 = MSE)
+    min_epsilon: float = 0.1
+    epsilon_nb_step: int = 1000
+    double_dqn: bool = True
+
+
+class QLearningDiscreteDense:
+    def __init__(self, mdp: MDP, conf: Optional[QLearningConfiguration] = None,
+                 hidden: tuple = (64, 64), network: Optional[MultiLayerNetwork] = None,
+                 updater=None):
+        self.mdp = mdp
+        self.conf = conf or QLearningConfiguration()
+        self.n_actions = mdp.action_space.n
+        obs_dim = int(np.prod(mdp.observation_space.shape))
+        self.net = network or self._build_net(obs_dim, hidden, updater)
+        if self.net.train_state is None:
+            self.net.init()
+        self.target_params = jax.tree.map(jnp.copy, self.net.train_state.params)
+        self.policy = EpsGreedy(self.n_actions, self.conf.min_epsilon,
+                                self.conf.epsilon_nb_step, self.conf.update_start)
+        self._rng = np.random.default_rng(self.conf.seed)
+        self._key = jax.random.PRNGKey(self.conf.seed)
+        self._update_step = None
+        self._q_fn = None
+        self.episode_rewards: List[float] = []
+
+    def _build_net(self, obs_dim: int, hidden: tuple, updater) -> MultiLayerNetwork:
+        b = (NeuralNetConfiguration.builder()
+             .seed(self.conf.seed)
+             .updater(updater or Adam(1e-3))
+             .weight_init("relu")
+             .list())
+        for h in hidden:
+            b.layer(DenseLayer(n_out=h, activation="relu"))
+        b.layer(OutputLayer(n_out=self.n_actions, activation="identity",
+                            loss="mse"))
+        return MultiLayerNetwork(
+            b.set_input_type(InputType.feed_forward(obs_dim)).build())
+
+    # ------------------------------------------------------------- jitted ops
+    def _make_update(self) -> Callable:
+        net, c = self.net, self.conf
+
+        def update(ts: TrainState, target_params, s, a, r, s2, done, rng):
+            q_next_t, _, _, _ = net._forward(target_params, ts.model_state, s2,
+                                             training=False, rng=None)
+            if c.double_dqn:
+                q_next_o, _, _, _ = net._forward(ts.params, ts.model_state, s2,
+                                                 training=False, rng=None)
+                a2 = jnp.argmax(q_next_o, axis=-1)
+                q_next = jnp.take_along_axis(q_next_t, a2[:, None], -1)[:, 0]
+            else:
+                q_next = q_next_t.max(axis=-1)
+            target = r + c.gamma * q_next * (1.0 - done)
+
+            def loss_fn(params):
+                q, _, _, _ = net._forward(params, ts.model_state, s,
+                                          training=True, rng=rng)
+                qa = jnp.take_along_axis(q, a[:, None], -1)[:, 0]
+                err = qa - jax.lax.stop_gradient(target)
+                if c.error_clamp and c.error_clamp > 0:
+                    return jnp.mean(optax.huber_loss(err, delta=c.error_clamp))
+                return jnp.mean(err * err)
+
+            loss, grads = jax.value_and_grad(loss_fn)(ts.params)
+            updates, new_opt = net._tx.update(grads, ts.opt_state, ts.params)
+            new_params = optax.apply_updates(ts.params, updates)
+            return TrainState(params=new_params, model_state=ts.model_state,
+                              opt_state=new_opt, step=ts.step + 1), loss
+
+        return jax.jit(update, donate_argnums=(0,))
+
+    def q_values(self, obs: np.ndarray) -> np.ndarray:
+        if self._q_fn is None:
+            net = self.net
+
+            def q_fn(params, model_state, x):
+                q, _, _, _ = net._forward(params, model_state, x,
+                                          training=False, rng=None)
+                return q
+
+            self._q_fn = jax.jit(q_fn)
+        ts = self.net.train_state
+        flat = np.asarray(obs, np.float32).reshape(1, -1)
+        return np.asarray(self._q_fn(ts.params, ts.model_state, flat)[0])
+
+    # ---------------------------------------------------------------- train
+    def train(self, listeners: Optional[list] = None) -> "QLearningDiscreteDense":
+        c = self.conf
+        replay = ExpReplay(c.exp_rep_max_size, self.mdp.observation_space.shape,
+                           seed=c.seed)
+        if self._update_step is None:
+            self._update_step = self._make_update()
+        step_count, ep_reward, ep_steps = 0, 0.0, 0
+        obs = self.mdp.reset()
+        while step_count < c.max_step:
+            action = self.policy.select(self.q_values(obs), self._rng)
+            next_obs, reward, done, _ = self.mdp.step(action)
+            ep_reward += reward
+            ep_steps += 1
+            replay.store(Transition(obs, action, reward * c.reward_factor,
+                                    next_obs, done))
+            obs = next_obs
+            step_count += 1
+            if len(replay) >= max(c.batch_size, c.update_start):
+                s, a, r, s2, d = replay.sample(c.batch_size)
+                s = s.reshape(len(s), -1)
+                s2 = s2.reshape(len(s2), -1)
+                self._key, sub = jax.random.split(self._key)
+                self.net.train_state, loss = self._update_step(
+                    self.net.train_state, self.target_params, s, a, r, s2, d, sub)
+                self.net._score = loss
+            if step_count % c.target_dqn_update_freq == 0:
+                self.target_params = jax.tree.map(
+                    jnp.copy, self.net.train_state.params)
+            if done or ep_steps >= c.max_epoch_step:
+                self.episode_rewards.append(ep_reward)
+                for lst in (listeners or []):
+                    lst.on_epoch_end(self, len(self.episode_rewards))
+                obs, ep_reward, ep_steps = self.mdp.reset(), 0.0, 0
+        return self
+
+    # ---------------------------------------------------------------- play
+    def play(self, max_steps: Optional[int] = None) -> float:
+        """One greedy episode; returns total reward (reference
+        ``Policy.play``)."""
+        greedy = GreedyPolicy()
+        obs = self.mdp.reset()
+        total, steps = 0.0, 0
+        limit = max_steps or self.conf.max_epoch_step
+        while steps < limit:
+            action = greedy.select(self.q_values(obs), self._rng)
+            obs, reward, done, _ = self.mdp.step(action)
+            total += reward
+            steps += 1
+            if done:
+                break
+        return total
+
+    def get_policy(self) -> GreedyPolicy:
+        return GreedyPolicy()

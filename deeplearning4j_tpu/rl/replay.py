@@ -1,0 +1,50 @@
+"""Experience replay (reference ``org.deeplearning4j.rl4j.learning.sync.ExpReplay``):
+uniform-sampling circular buffer, preallocated numpy storage so sampling a
+batch is a single fancy-index (no per-transition object churn)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Transition:
+    obs: np.ndarray
+    action: int
+    reward: float
+    next_obs: np.ndarray
+    done: bool
+
+
+class ExpReplay:
+    def __init__(self, max_size: int, obs_shape: Tuple[int, ...], seed: int = 0):
+        self.max_size = int(max_size)
+        self._obs = np.zeros((max_size,) + tuple(obs_shape), np.float32)
+        self._next_obs = np.zeros_like(self._obs)
+        self._actions = np.zeros(max_size, np.int32)
+        self._rewards = np.zeros(max_size, np.float32)
+        self._dones = np.zeros(max_size, np.float32)
+        self._rng = np.random.default_rng(seed)
+        self._size = 0
+        self._head = 0
+
+    def __len__(self) -> int:
+        return self._size
+
+    def store(self, t: Transition) -> None:
+        i = self._head
+        self._obs[i] = t.obs
+        self._next_obs[i] = t.next_obs
+        self._actions[i] = t.action
+        self._rewards[i] = t.reward
+        self._dones[i] = 1.0 if t.done else 0.0
+        self._head = (i + 1) % self.max_size
+        self._size = min(self._size + 1, self.max_size)
+
+    def sample(self, batch_size: int):
+        idx = self._rng.integers(0, self._size, batch_size)
+        return (self._obs[idx], self._actions[idx], self._rewards[idx],
+                self._next_obs[idx], self._dones[idx])

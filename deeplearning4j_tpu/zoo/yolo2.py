@@ -12,6 +12,7 @@ from deeplearning4j_tpu.nn import (BatchNormalization, ConvolutionLayer, InputTy
 from deeplearning4j_tpu.nn.graph_vertices import MergeVertex
 from deeplearning4j_tpu.train.updaters import Nesterovs
 from deeplearning4j_tpu.zoo.base import ZooModel
+from deeplearning4j_tpu.zoo.darknet19 import _conv_bn as _dn_conv_bn
 
 # default anchor priors (reference uses the VOC-trained priors)
 _TINY_ANCHORS = ((1.08, 1.19), (3.42, 4.41), (6.63, 11.38), (9.42, 5.11),
@@ -21,10 +22,7 @@ _YOLO2_ANCHORS = ((0.57273, 0.677385), (1.87446, 2.06253), (3.33843, 5.47434),
 
 
 def _conv_bn(b, n_out, k=3):
-    b.layer(ConvolutionLayer(n_out=n_out, kernel_size=(k, k),
-                             convolution_mode="same", activation="identity",
-                             has_bias=False))
-    b.layer(BatchNormalization(activation="leakyrelu"))
+    _dn_conv_bn(b, n_out, k)
 
 
 class TinyYOLO(ZooModel):
