@@ -11,6 +11,8 @@ from __future__ import annotations
 
 from typing import Callable, Dict
 
+import math
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -141,7 +143,9 @@ def _ax(axis):
 
 
 # ---- shape ----
-register("reshape")(lambda a, shape=(): jnp.reshape(a, tuple(int(s) for s in shape)))
+register("reshape")(lambda a, shape=(): jnp.reshape(
+    a, tuple(a.shape[i] if int(s) == 0 else int(s)  # 0 = copy dim (ONNX/TF)
+             for i, s in enumerate(shape))))
 register("transpose")(lambda a, perm=None: jnp.transpose(a, perm))
 register("expand_dims")(lambda a, axis=0: jnp.expand_dims(a, axis))
 register("squeeze")(lambda a, axis=None: jnp.squeeze(a, axis))
@@ -208,9 +212,18 @@ def _scatter_update(a, indices, updates):
 
 register("one_hot")(lambda a, depth=2, on_value=1.0, off_value=0.0, axis=-1:
                     jax.nn.one_hot(a.astype(jnp.int32), depth, axis=axis) * (on_value - off_value) + off_value)
-register("pad")(lambda a, paddings=(), constant_value=0.0:
-                jnp.pad(a, tuple(tuple(int(x) for x in p) for p in paddings),
-                        constant_values=constant_value))
+def _pad(a, paddings=(), constant_value=0.0, mode="constant"):
+    pads = tuple(tuple(int(x) for x in p) for p in paddings)
+    if mode == "constant":
+        return jnp.pad(a, pads, constant_values=constant_value)
+    return jnp.pad(a, pads, mode=mode)  # 'reflect' / 'edge' / 'wrap'
+
+
+register("pad")(_pad)
+
+
+register("flatten2d")(lambda a, axis=1: jnp.reshape(
+    a, (math.prod(a.shape[:axis]) if axis else 1, -1)))
 register("reverse")(lambda a, axis=0: jnp.flip(a, axis))
 register("shape_of")(lambda a: jnp.asarray(a.shape, jnp.int32))
 register("size")(lambda a: jnp.asarray(a.size, jnp.int32))
@@ -256,10 +269,12 @@ def _linear(x, w, b=None):
 
 
 @register("conv2d")
-def _conv2d(x, w, b=None, stride=(1, 1), padding="SAME", dilation=(1, 1)):
+def _conv2d(x, w, b=None, stride=(1, 1), padding="SAME", dilation=(1, 1),
+            groups=1):
     y = lax.conv_general_dilated(x, w, window_strides=tuple(stride), padding=padding,
                                  rhs_dilation=tuple(dilation),
-                                 dimension_numbers=("NHWC", "HWIO", "NHWC"))
+                                 dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                                 feature_group_count=groups)
     return y + b if b is not None else y
 
 
@@ -269,8 +284,11 @@ def _max_pool2d(x, kernel=(2, 2), stride=(2, 2), padding="VALID"):
 
 
 @register("avg_pool2d")
-def _avg_pool2d(x, kernel=(2, 2), stride=(2, 2), padding="VALID"):
+def _avg_pool2d(x, kernel=(2, 2), stride=(2, 2), padding="VALID",
+                count_include_pad=False):
     s = lax.reduce_window(x, 0.0, lax.add, (1, *kernel, 1), (1, *stride, 1), padding)
+    if count_include_pad:  # ONNX AveragePool count_include_pad=1
+        return s / (kernel[0] * kernel[1])
     c = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, (1, *kernel, 1), (1, *stride, 1), padding)
     return s / c
 

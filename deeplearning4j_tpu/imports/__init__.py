@@ -8,9 +8,13 @@ Rebuild of the reference's import stack:
   framework's (SameDiff-equivalent → XLA).
 - ``KerasModelImport`` (upstream ``org.deeplearning4j.nn.modelimport.keras``):
   Keras H5/SavedModel → MultiLayerNetwork / ComputationGraph with weights.
+- ``OnnxGraphMapper`` (upstream ``org.nd4j.imports.graphmapper.onnx``,
+  partial there): ONNX ModelProto → declarative graph, via an in-repo
+  protobuf wire decoder (no onnx package offline).
 """
 
 from deeplearning4j_tpu.imports.tf_import import TFGraphMapper
 from deeplearning4j_tpu.imports.keras_import import KerasModelImport
+from deeplearning4j_tpu.imports.onnx_import import OnnxGraphMapper
 
-__all__ = ["TFGraphMapper", "KerasModelImport"]
+__all__ = ["TFGraphMapper", "KerasModelImport", "OnnxGraphMapper"]

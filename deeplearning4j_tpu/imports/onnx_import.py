@@ -1,0 +1,476 @@
+"""ONNX model import.
+
+Rebuild of upstream ``org.nd4j.imports.graphmapper.onnx.OnnxGraphMapper``
+(partial in the reference — SURVEY.md §2.2): parse a ``ModelProto`` with the
+in-repo wire decoder (``onnx_proto.py``; no ``onnx`` package offline), then
+map each node onto the SameDiff graph through the op registry.
+
+Covers the common inference op set (conv/pool/gemm/matmul, batchnorm,
+activations, reshape family, reductions, elementwise) — a superset of what
+the reference's partial mapper handled. ONNX is NCHW; compute ops here are
+NHWC (TPU-native), so convs/pools transpose in and out — XLA cancels
+adjacent transposes, so imported graphs stay fusion-friendly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+from deeplearning4j_tpu.imports import onnx_proto
+
+
+class OnnxGraphMapper:
+    @staticmethod
+    def import_graph(path_or_bytes,
+                     input_shapes: Optional[Dict[str, tuple]] = None) -> SameDiff:
+        model = onnx_proto.load_model(path_or_bytes)
+        return _OnnxImporter(model["graph"], input_shapes or {}).run()
+
+
+# ONNX AttributeProto.type -> dict field holding the value
+_ATTR_FIELDS = {1: "f", 2: "i", 3: "s", 4: "t", 6: "floats", 7: "ints",
+                8: "strings"}
+
+
+def _attrs(node: Dict[str, Any]) -> Dict[str, Any]:
+    out = {}
+    for a in node.get("attribute", []):
+        field = _ATTR_FIELDS.get(a.get("type"))
+        val = a.get(field) if field else None
+        if val is None:  # fall back to whichever field is populated
+            for f in ("i", "f", "s", "t", "ints", "floats", "strings"):
+                if f in a:
+                    val = a[f]
+                    break
+        if isinstance(val, bytes):
+            val = val.decode("utf-8", "replace")
+        out[a["name"]] = val
+    return out
+
+
+def _fold_slice(a):
+    """numpy Slice over constants: data, starts, ends[, axes[, steps]]."""
+    data, starts, ends = a[0], a[1], a[2]
+    axes = a[3] if len(a) > 3 else np.arange(len(starts))
+    steps = a[4] if len(a) > 4 else np.ones(len(starts), np.int64)
+    idx = [slice(None)] * data.ndim
+    for st, en, ax, sp in zip(starts, ends, axes, steps):
+        idx[int(ax)] = slice(int(st), int(en), int(sp))
+    return data[tuple(idx)]
+
+
+class _OnnxImporter:
+    def __init__(self, graph: Dict[str, Any], input_shapes: Dict[str, tuple]):
+        self.g = graph
+        self.sd = SameDiff.create()
+        self.const_values: Dict[str, np.ndarray] = {}
+        self.rank: Dict[str, int] = {}
+        self.input_shapes = input_shapes
+
+    # ------------------------------------------------------------- plumbing
+    def _ensure_var(self, name: str) -> Any:
+        if name in self.sd.vars:
+            return self.sd.vars[name]
+        if name in self.const_values:
+            v = self.sd.constant(name, self.const_values[name])
+            return v
+        raise KeyError(f"ONNX input {name!r} not found (not a node output, "
+                       "graph input, or initializer)")
+
+    def _emit(self, op: str, inputs: List[Any], out_name: str, **attrs) -> Any:
+        vars_ = [self._ensure_var(i) if isinstance(i, str) else i for i in inputs]
+        return self._name_as(
+            self.sd._apply(op, vars_, attrs=attrs or None, name=out_name),
+            out_name)
+
+    @staticmethod
+    def _name_as(var, out_name: str):
+        if var.name != out_name:
+            var.rename(out_name)
+        return var
+
+    def _const_of(self, name: str) -> np.ndarray:
+        if name in self.const_values:
+            return self.const_values[name]
+        raise ValueError(f"expected static initializer for {name!r}")
+
+    # ------------------------------------------------------------------ run
+    def run(self) -> SameDiff:
+        for init in self.g.get("initializer", []):
+            arr = onnx_proto.tensor_to_numpy(init)
+            self.const_values[init["name"]] = arr
+            self.rank[init["name"]] = arr.ndim
+        init_names = set(self.const_values)
+        for vi in self.g.get("input", []):
+            name = vi["name"]
+            if name in init_names:
+                continue
+            shape = self.input_shapes.get(name) or self._vi_shape(vi)
+            self.sd.placeholder(name, shape=tuple(shape) if shape else None)
+            if shape:
+                self.rank[name] = len(shape)
+        for node in self.g.get("node", []):
+            self._map_node(node)
+        return self.sd
+
+    @staticmethod
+    def _vi_shape(vi: Dict[str, Any]) -> Optional[tuple]:
+        try:
+            dims = vi["type"]["tensor_type"]["shape"]["dim"]
+            shape = tuple(d.get("dim_value", 1) for d in dims)
+            return shape if all(s > 0 for s in shape) else None
+        except KeyError:
+            return None
+
+    # ------------------------------------------------------ constant folding
+    # Shape-carrying values (pads/axes/shapes) often arrive through small
+    # Cast/Concat/Slice subgraphs over constants; fold those to numpy at
+    # import time so downstream attrs stay static (the TF importer and the
+    # reference's initFromTensorFlow do the same).
+    _FOLD = {
+        "Cast": lambda a, ins, attrs: a[0].astype(
+            onnx_proto._DTYPES.get(attrs.get("to", 1), np.float32)),
+        "Concat": lambda a, ins, attrs: np.concatenate(a, axis=attrs.get("axis", 0)),
+        "Unsqueeze": lambda a, ins, attrs: np.expand_dims(
+            a[0], tuple(int(x) for x in (a[1] if len(a) > 1 else attrs.get("axes", (0,))))),
+        "Squeeze": lambda a, ins, attrs: np.squeeze(
+            a[0], tuple(int(x) for x in (a[1] if len(a) > 1 else attrs.get("axes", ()))) or None),
+        "Reshape": lambda a, ins, attrs: a[0].reshape(tuple(int(x) for x in a[1])),
+        "Transpose": lambda a, ins, attrs: np.transpose(a[0], attrs.get("perm")),
+        "Gather": lambda a, ins, attrs: np.take(a[0], a[1].astype(np.int64),
+                                                axis=attrs.get("axis", 0)),
+        "Identity": lambda a, ins, attrs: a[0],
+        "Add": lambda a, ins, attrs: a[0] + a[1],
+        "Sub": lambda a, ins, attrs: a[0] - a[1],
+        "Mul": lambda a, ins, attrs: a[0] * a[1],
+        "Div": lambda a, ins, attrs: a[0] // a[1]
+            if np.issubdtype(a[0].dtype, np.integer) else a[0] / a[1],
+        "Slice": lambda a, ins, attrs: _fold_slice(a),
+        "Range": lambda a, ins, attrs: np.arange(
+            a[0].ravel()[0], a[1].ravel()[0], a[2].ravel()[0]),
+    }
+
+    def _try_fold(self, node: Dict[str, Any]) -> bool:
+        op = node.get("op_type", "")
+        fn = self._FOLD.get(op)
+        ins = [i for i in node.get("input", []) if i]
+        if fn is None or not ins or not all(i in self.const_values for i in ins):
+            return False
+        args = [np.asarray(self.const_values[i]) for i in ins]
+        try:
+            val = fn(args, ins, _attrs(node))
+        except Exception:
+            return False
+        out = node["output"][0]
+        self.const_values[out] = np.asarray(val)
+        self.rank[out] = self.const_values[out].ndim
+        return True
+
+    # ---------------------------------------------------------- op mappings
+    def _map_node(self, node: Dict[str, Any]) -> None:
+        op = node.get("op_type", "")
+        if op not in ("Constant", "ConstantOfShape") and self._try_fold(node):
+            return
+        # ONNX marks omitted optional inputs with "": keep slots positional
+        ins: List[str] = list(node.get("input", []))
+        outs: List[str] = node.get("output", [])
+        out = outs[0]
+        a = _attrs(node)
+        sd = self.sd
+
+        def rank_of(name: str, default: int = 4) -> int:
+            return self.rank.get(name, default)
+
+        def setr(r: int, name: str = out) -> None:
+            self.rank[name] = r
+
+        simple = {
+            "Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
+            "Exp": "exp", "Log": "log", "Neg": "neg", "Abs": "abs",
+            "Sqrt": "sqrt", "Erf": "erf", "Floor": "floor", "Ceil": "ceil",
+            "Sign": "sign", "Softplus": "softplus", "Softsign": "softsign",
+            "Reciprocal": "reciprocal", "Sin": "sin", "Cos": "cos",
+            "Not": "logical_not", "Identity": "identity",
+        }
+        binary = {"Add": "add", "Sub": "sub", "Mul": "mul", "Div": "div",
+                  "Pow": "pow", "Greater": "gt", "Less": "lt", "Equal": "eq",
+                  "And": "logical_and", "Or": "logical_or",
+                  "Max": "maximum", "Min": "minimum"}
+
+        if op in simple:
+            self._emit(simple[op], [ins[0]], out)
+            setr(rank_of(ins[0]))
+        elif op in binary and len(ins) == 2:
+            self._emit(binary[op], ins, out)
+            setr(max(rank_of(ins[0]), rank_of(ins[1])))
+        elif op == "Sum":
+            acc = self._ensure_var(ins[0])
+            for extra in [i for i in ins[1:] if i]:
+                acc = sd._apply("add", [acc, self._ensure_var(extra)])
+            self._name_as(acc, out)
+            setr(rank_of(ins[0]))
+        elif op == "Constant":
+            val = a.get("value")
+            arr = (onnx_proto.tensor_to_numpy(val) if isinstance(val, dict)
+                   else np.asarray(val))
+            self.const_values[out] = arr
+            setr(arr.ndim)
+        elif op == "ConstantOfShape":
+            shape = tuple(int(s) for s in self._const_of(ins[0]))
+            val = a.get("value")
+            fill = (onnx_proto.tensor_to_numpy(val).ravel()[0]
+                    if isinstance(val, dict) else 0.0)
+            self.const_values[out] = np.full(shape, fill)
+            setr(len(shape))
+        elif op == "LeakyRelu":
+            self._emit("leaky_relu", [ins[0]], out, alpha=a.get("alpha", 0.01))
+            setr(rank_of(ins[0]))
+        elif op == "Elu":
+            self._emit("elu", [ins[0]], out)
+            setr(rank_of(ins[0]))
+        elif op == "Selu":
+            self._emit("selu", [ins[0]], out)
+            setr(rank_of(ins[0]))
+        elif op == "Clip":
+            lo = (float(self._const_of(ins[1]).ravel()[0])
+                  if len(ins) > 1 and ins[1] else a.get("min", -np.inf))
+            hi = (float(self._const_of(ins[2]).ravel()[0])
+                  if len(ins) > 2 and ins[2] else a.get("max", np.inf))
+            self._emit("clip_by_value", [ins[0]], out, lo=lo, hi=hi)
+            setr(rank_of(ins[0]))
+        elif op in ("Softmax", "LogSoftmax"):
+            axis = a.get("axis", -1)
+            self._emit("softmax" if op == "Softmax" else "log_softmax",
+                       [ins[0]], out, axis=axis)
+            setr(rank_of(ins[0]))
+        elif op == "Gelu":
+            self._emit("gelu", [ins[0]], out)
+            setr(rank_of(ins[0]))
+        elif op == "MatMul":
+            self._emit("matmul", ins, out)
+            setr(max(rank_of(ins[0], 2), rank_of(ins[1], 2)))
+        elif op == "Gemm":
+            self._map_gemm(ins, out, a)
+        elif op == "Conv":
+            self._map_conv(ins, out, a)
+        elif op in ("MaxPool", "AveragePool"):
+            self._map_pool(op, ins, out, a)
+        elif op in ("GlobalAveragePool", "GlobalMaxPool"):
+            red = "reduce_mean" if op == "GlobalAveragePool" else "reduce_max"
+            self._emit(red, [ins[0]], out, axis=(2, 3), keepdims=True)
+            setr(4)
+        elif op == "BatchNormalization":
+            self._map_batchnorm(ins, out, a)
+        elif op == "LayerNormalization":
+            axis = a.get("axis", -1)
+            args = [ins[0], ins[1]] + ([ins[2]] if len(ins) > 2 and ins[2] else [])
+            self._emit("layer_norm", args, out, axis=axis,
+                       eps=a.get("epsilon", 1e-5))
+            setr(rank_of(ins[0]))
+        elif op == "Flatten":
+            self._emit("flatten2d", [ins[0]], out, axis=a.get("axis", 1))
+            setr(2)
+        elif op == "Reshape":
+            shape = tuple(int(s) for s in self._const_of(ins[1]))
+            self._emit("reshape", [ins[0]], out, shape=shape)
+            setr(len(shape))
+        elif op == "Transpose":
+            perm = tuple(a.get("perm") or reversed(range(rank_of(ins[0]))))
+            self._emit("transpose", [ins[0]], out, perm=perm)
+            setr(len(perm))
+        elif op == "Concat":
+            vars_ = [self._ensure_var(i) for i in ins]
+            self._name_as(sd._apply("concat", vars_,
+                                    attrs={"axis": a.get("axis", 0)},
+                                    name=out), out)
+            setr(rank_of(ins[0]))
+        elif op == "Squeeze":
+            axes = (tuple(int(s) for s in self._const_of(ins[1]))
+                    if len(ins) > 1 else tuple(a.get("axes", ())))
+            self._emit("squeeze", [ins[0]], out, axis=axes or None)
+            setr(rank_of(ins[0]) - max(1, len(axes)))
+        elif op == "Unsqueeze":
+            axes = (tuple(int(s) for s in self._const_of(ins[1]))
+                    if len(ins) > 1 else tuple(a.get("axes", ())))
+            v = self._ensure_var(ins[0])
+            for ax in sorted(axes):
+                v = sd._apply("expand_dims", [v], attrs={"axis": int(ax)})
+            self._name_as(v, out)
+            setr(rank_of(ins[0]) + len(axes))
+        elif op == "Gather":
+            self._emit("gather", [ins[0], ins[1]], out, axis=a.get("axis", 0))
+            setr(rank_of(ins[0]) + rank_of(ins[1], 1) - 1)
+        elif op == "Slice":
+            self._map_slice(ins, out, a)
+        elif op in ("ReduceMean", "ReduceSum", "ReduceMax", "ReduceMin",
+                    "ReduceProd"):
+            axes = (tuple(int(s) for s in self._const_of(ins[1]))
+                    if len(ins) > 1 else tuple(a.get("axes", ())))
+            keep = bool(a.get("keepdims", 1))
+            red = {"ReduceMean": "reduce_mean", "ReduceSum": "reduce_sum",
+                   "ReduceMax": "reduce_max", "ReduceMin": "reduce_min",
+                   "ReduceProd": "reduce_prod"}[op]
+            self._emit(red, [ins[0]], out, axis=axes or None, keepdims=keep)
+            setr(rank_of(ins[0]) if keep else rank_of(ins[0]) - max(1, len(axes)))
+        elif op == "Cast":
+            self._emit("cast", [ins[0]], out,
+                       dtype=str(onnx_proto._DTYPES[a.get("to", 1)].__name__))
+            setr(rank_of(ins[0]))
+        elif op == "Dropout":
+            self._emit("identity", [ins[0]], out)  # inference-mode import
+            setr(rank_of(ins[0]))
+        elif op == "Shape":
+            # static by construction (importer resolves to a constant)
+            src = ins[0]
+            if src in self.const_values:
+                self.const_values[out] = np.asarray(
+                    self.const_values[src].shape, np.int64)
+            else:
+                self._emit("shape_of", [src], out)
+            setr(1)
+        elif op == "Where":
+            self._emit("where", ins, out)
+            setr(max(rank_of(i) for i in ins))
+        elif op == "Tile":
+            reps = tuple(int(r) for r in self._const_of(ins[1]))
+            self._emit("tile", [ins[0]], out, multiples=reps)
+            setr(rank_of(ins[0]))
+        elif op == "Pad":
+            pads = (tuple(int(p) for p in self._const_of(ins[1]))
+                    if len(ins) > 1 else tuple(a.get("pads", ())))
+            r = len(pads) // 2
+            pairs = tuple((pads[i], pads[i + r]) for i in range(r))
+            mode = {"constant": "constant", "reflect": "reflect",
+                    "edge": "edge", "wrap": "wrap"}[a.get("mode", "constant")]
+            self._emit("pad", [ins[0]], out, paddings=pairs, mode=mode)
+            setr(rank_of(ins[0]))
+        elif op == "ArgMax":
+            axis = a.get("axis", 0)
+            if a.get("keepdims", 1):
+                v = sd._apply("argmax", [self._ensure_var(ins[0])],
+                              attrs={"axis": axis})
+                self._name_as(sd._apply("expand_dims", [v],
+                                        attrs={"axis": axis}), out)
+                setr(rank_of(ins[0]))
+            else:
+                self._emit("argmax", [ins[0]], out, axis=axis)
+                setr(max(1, rank_of(ins[0]) - 1))
+        else:
+            raise NotImplementedError(
+                f"ONNX op {op!r} not mapped (node {node.get('name')!r})")
+
+    # --------------------------------------------------------- composite ops
+    def _map_gemm(self, ins, out, a):
+        sd = self.sd
+        x = self._ensure_var(ins[0])
+        w = self._ensure_var(ins[1])
+        if a.get("transA"):
+            x = sd._apply("transpose", [x], attrs={"perm": (1, 0)})
+        if a.get("transB"):
+            w = sd._apply("transpose", [w], attrs={"perm": (1, 0)})
+        y = sd._apply("matmul", [x, w])
+        alpha, beta = a.get("alpha", 1.0), a.get("beta", 1.0)
+        if alpha != 1.0:
+            y = sd._apply("mul", [y, sd.constant(np.float32(alpha))])
+        if len(ins) > 2 and ins[2]:
+            b = self._ensure_var(ins[2])
+            if beta != 1.0:
+                b = sd._apply("mul", [b, sd.constant(np.float32(beta))])
+            y = sd._apply("add", [y, b])
+        self._name_as(y, out)
+        self.rank[out] = 2
+
+    def _conv_padding(self, a):
+        auto = a.get("auto_pad", "NOTSET") or "NOTSET"
+        if auto == "SAME_UPPER":
+            return "SAME"
+        if auto == "SAME_LOWER":
+            return "SAME_LOWER"  # XLA convs take it; pools reject it below
+        pads = a.get("pads")
+        if not pads:
+            return "VALID"
+        r = len(pads) // 2
+        return tuple((int(pads[i]), int(pads[i + r])) for i in range(r))
+
+    def _map_conv(self, ins, out, a):
+        sd = self.sd
+        w = self._const_of(ins[1])  # OIHW
+        groups = int(a.get("group", 1))
+        if w.ndim != 4:
+            raise NotImplementedError("only 2-D Conv is mapped")
+        w_hwio = np.transpose(w, (2, 3, 1, 0))  # -> HWIO (I = C_in/groups)
+        x = sd._apply("transpose", [self._ensure_var(ins[0])],
+                      attrs={"perm": (0, 2, 3, 1)})
+        stride = tuple(a.get("strides") or (1, 1))
+        dilation = tuple(a.get("dilations") or (1, 1))
+        pad = self._conv_padding(a)
+        args = [x, sd.constant(w_hwio)]
+        if len(ins) > 2 and ins[2]:
+            args.append(self._ensure_var(ins[2]))
+        y = sd._apply("conv2d", args,
+                      attrs={"stride": stride, "padding": pad,
+                             "dilation": dilation,
+                             **({"groups": groups} if groups != 1 else {})})
+        self._name_as(sd._apply("transpose", [y],
+                                attrs={"perm": (0, 3, 1, 2)}, name=out), out)
+        self.rank[out] = 4
+
+    def _map_pool(self, op, ins, out, a):
+        sd = self.sd
+        kernel = tuple(a.get("kernel_shape") or (2, 2))
+        stride = tuple(a.get("strides") or kernel)
+        pad = self._conv_padding(a)
+        if pad == "SAME_LOWER":
+            raise NotImplementedError("auto_pad=SAME_LOWER on pooling")
+        if isinstance(pad, tuple):  # reduce_window pads every dim
+            pad = ((0, 0), *pad, (0, 0))
+        x = sd._apply("transpose", [self._ensure_var(ins[0])],
+                      attrs={"perm": (0, 2, 3, 1)})
+        extra = ({"count_include_pad": True}
+                 if op == "AveragePool" and a.get("count_include_pad") else {})
+        y = sd._apply("max_pool2d" if op == "MaxPool" else "avg_pool2d", [x],
+                      attrs={"kernel": kernel, "stride": stride,
+                             "padding": pad, **extra})
+        self._name_as(sd._apply("transpose", [y],
+                                attrs={"perm": (0, 3, 1, 2)}, name=out), out)
+        self.rank[out] = 4
+
+    def _map_batchnorm(self, ins, out, a):
+        """BN over NCHW: reshape (C,) stats to broadcast over axis 1."""
+        x_rank = self.rank.get(ins[0], 4)
+        sd = self.sd
+        eps = a.get("epsilon", 1e-5)
+
+        def shaped(name):
+            arr = self._const_of(name)
+            if x_rank > 2:
+                arr = arr.reshape(arr.shape[0], *([1] * (x_rank - 2)))
+            return sd.constant(arr)
+
+        scale, bias, mean, var = (shaped(ins[1]), shaped(ins[2]),
+                                  shaped(ins[3]), shaped(ins[4]))
+        self._name_as(sd._apply(
+            "batch_norm", [self._ensure_var(ins[0]), mean, var, scale, bias],
+            attrs={"eps": eps}, name=out), out)
+        self.rank[out] = x_rank
+
+    def _map_slice(self, ins, out, a):
+        starts = (tuple(int(s) for s in self._const_of(ins[1]))
+                  if len(ins) > 1 else tuple(a.get("starts", ())))
+        ends = (tuple(int(s) for s in self._const_of(ins[2]))
+                if len(ins) > 2 else tuple(a.get("ends", ())))
+        axes = (tuple(int(s) for s in self._const_of(ins[3]))
+                if len(ins) > 3 else tuple(a.get("axes", range(len(starts)))))
+        steps = (tuple(int(s) for s in self._const_of(ins[4]))
+                 if len(ins) > 4 else (1,) * len(starts))
+        # expand the (starts, ends, axes, steps) form to full rank
+        r = self.rank.get(ins[0], max(axes) + 1 if axes else len(starts))
+        begin, end, strides = [0] * r, [2**31 - 1] * r, [1] * r
+        for i, ax in enumerate(axes):
+            begin[ax], end[ax], strides[ax] = starts[i], ends[i], steps[i]
+        self._emit("strided_slice", [ins[0]], out, begin=tuple(begin),
+                   end=tuple(end), strides=tuple(strides))
+        self.rank[out] = r

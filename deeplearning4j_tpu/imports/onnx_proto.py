@@ -1,0 +1,221 @@
+"""Minimal ONNX protobuf reader — no ``onnx`` package in this environment.
+
+Implements the protobuf wire format (varint / fixed32 / fixed64 /
+length-delimited) plus schema tables for the ONNX message subset an importer
+needs (ModelProto, GraphProto, NodeProto, AttributeProto, TensorProto,
+ValueInfoProto). Field numbers follow the public, frozen ``onnx.proto3``
+schema. Parsed messages are plain dicts; tensors decode to numpy arrays.
+
+The reference reads ONNX through protobuf-generated Java classes
+(``org.nd4j.imports.graphmapper.onnx.OnnxGraphMapper``); here the schema is
+small enough that a table-driven decoder is simpler than shipping generated
+code.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+# ---------------------------------------------------------------- wire format
+
+_WIRE_VARINT, _WIRE_FIXED64, _WIRE_LEN, _WIRE_FIXED32 = 0, 1, 2, 5
+
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _skip(buf: bytes, pos: int, wire: int) -> int:
+    if wire == _WIRE_VARINT:
+        return _read_varint(buf, pos)[1]
+    if wire == _WIRE_FIXED64:
+        return pos + 8
+    if wire == _WIRE_LEN:
+        n, pos = _read_varint(buf, pos)
+        return pos + n
+    if wire == _WIRE_FIXED32:
+        return pos + 4
+    raise ValueError(f"unsupported wire type {wire}")
+
+
+# ------------------------------------------------------------------- schemas
+# field_no -> (name, kind); kind: 'int' | 'float32' | 'double' | 'str' |
+# 'bytes' | 'msg:<Schema>' ; repeated fields get list-append semantics
+# (packed scalar arrays are handled for 'int'/'float32'/'double').
+
+_SCHEMAS: Dict[str, Dict[int, Tuple[str, str, bool]]] = {
+    "ModelProto": {
+        1: ("ir_version", "int", False),
+        2: ("producer_name", "str", False),
+        7: ("graph", "msg:GraphProto", False),
+        8: ("opset_import", "msg:OperatorSetIdProto", True),
+    },
+    "OperatorSetIdProto": {
+        1: ("domain", "str", False),
+        2: ("version", "int", False),
+    },
+    "GraphProto": {
+        1: ("node", "msg:NodeProto", True),
+        2: ("name", "str", False),
+        5: ("initializer", "msg:TensorProto", True),
+        11: ("input", "msg:ValueInfoProto", True),
+        12: ("output", "msg:ValueInfoProto", True),
+        13: ("value_info", "msg:ValueInfoProto", True),
+    },
+    "NodeProto": {
+        1: ("input", "str", True),
+        2: ("output", "str", True),
+        3: ("name", "str", False),
+        4: ("op_type", "str", False),
+        5: ("attribute", "msg:AttributeProto", True),
+        7: ("domain", "str", False),
+    },
+    "AttributeProto": {
+        1: ("name", "str", False),
+        2: ("f", "float32", False),
+        3: ("i", "int", False),
+        4: ("s", "bytes", False),
+        5: ("t", "msg:TensorProto", False),
+        6: ("g", "msg:GraphProto", False),
+        7: ("floats", "float32", True),
+        8: ("ints", "int", True),
+        9: ("strings", "bytes", True),
+        10: ("tensors", "msg:TensorProto", True),
+        20: ("type", "int", False),
+    },
+    "TensorProto": {
+        1: ("dims", "int", True),
+        2: ("data_type", "int", False),
+        4: ("float_data", "float32", True),
+        5: ("int32_data", "int", True),
+        6: ("string_data", "bytes", True),
+        7: ("int64_data", "int", True),
+        8: ("name", "str", False),
+        9: ("raw_data", "bytes", False),
+        10: ("double_data", "double", True),
+        11: ("uint64_data", "int", True),
+    },
+    "ValueInfoProto": {
+        1: ("name", "str", False),
+        2: ("type", "msg:TypeProto", False),
+    },
+    "TypeProto": {
+        1: ("tensor_type", "msg:TypeProto.Tensor", False),
+    },
+    "TypeProto.Tensor": {
+        1: ("elem_type", "int", False),
+        2: ("shape", "msg:TensorShapeProto", False),
+    },
+    "TensorShapeProto": {
+        1: ("dim", "msg:TensorShapeProto.Dimension", True),
+    },
+    "TensorShapeProto.Dimension": {
+        1: ("dim_value", "int", False),
+        2: ("dim_param", "str", False),
+    },
+}
+
+
+def parse(buf: bytes, schema_name: str) -> Dict[str, Any]:
+    """Decode one message of ``schema_name`` into a dict (repeated -> list)."""
+    schema = _SCHEMAS[schema_name]
+    out: Dict[str, Any] = {}
+    pos, end = 0, len(buf)
+    while pos < end:
+        tag, pos = _read_varint(buf, pos)
+        field_no, wire = tag >> 3, tag & 7
+        spec = schema.get(field_no)
+        if spec is None:
+            pos = _skip(buf, pos, wire)
+            continue
+        name, kind, repeated = spec
+        if kind.startswith("msg:"):
+            n, pos = _read_varint(buf, pos)
+            val = parse(buf[pos:pos + n], kind[4:])
+            pos += n
+        elif wire == _WIRE_LEN and kind in ("int", "float32", "double"):
+            # packed repeated scalars
+            n, pos = _read_varint(buf, pos)
+            chunk, pos = buf[pos:pos + n], pos + n
+            if kind == "int":
+                vals, p = [], 0
+                while p < len(chunk):
+                    v, p = _read_varint(chunk, p)
+                    vals.append(_to_signed(v))
+                out.setdefault(name, []).extend(vals)
+                continue
+            fmt, width = ("<f", 4) if kind == "float32" else ("<d", 8)
+            vals = [struct.unpack_from(fmt, chunk, i)[0]
+                    for i in range(0, len(chunk), width)]
+            out.setdefault(name, []).extend(vals)
+            continue
+        elif kind == "int":
+            v, pos = _read_varint(buf, pos)
+            val = _to_signed(v)
+        elif kind == "float32":
+            val = struct.unpack_from("<f", buf, pos)[0]
+            pos += 4
+        elif kind == "double":
+            val = struct.unpack_from("<d", buf, pos)[0]
+            pos += 8
+        elif kind in ("str", "bytes"):
+            n, pos = _read_varint(buf, pos)
+            raw = buf[pos:pos + n]
+            pos += n
+            val = raw.decode("utf-8", "replace") if kind == "str" else raw
+        else:
+            raise ValueError(f"bad kind {kind}")
+        if repeated:
+            out.setdefault(name, []).append(val)
+        else:
+            out[name] = val
+    return out
+
+
+def _to_signed(v: int) -> int:
+    """int64 fields arrive as two's-complement varints."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+# ---------------------------------------------------------------- tensor load
+
+_DTYPES = {
+    1: np.float32, 2: np.uint8, 3: np.int8, 4: np.uint16, 5: np.int16,
+    6: np.int32, 7: np.int64, 9: np.bool_, 10: np.float16, 11: np.float64,
+    12: np.uint32, 13: np.uint64,
+}
+
+
+def tensor_to_numpy(t: Dict[str, Any]) -> np.ndarray:
+    dims = tuple(t.get("dims", []))
+    dt = _DTYPES.get(t.get("data_type", 1))
+    if dt is None:
+        raise ValueError(f"unsupported ONNX tensor dtype {t.get('data_type')}")
+    raw = t.get("raw_data")
+    if raw is not None:
+        return np.frombuffer(raw, dtype=dt).reshape(dims).copy()
+    for field, cast in (("float_data", np.float32), ("int64_data", np.int64),
+                        ("int32_data", np.int32), ("double_data", np.float64),
+                        ("uint64_data", np.uint64)):
+        if field in t:
+            return np.asarray(t[field], dtype=cast).astype(dt).reshape(dims)
+    return np.zeros(dims, dtype=dt)
+
+
+def load_model(path_or_bytes) -> Dict[str, Any]:
+    if isinstance(path_or_bytes, bytes):
+        data = path_or_bytes
+    else:
+        with open(path_or_bytes, "rb") as f:
+            data = f.read()
+    return parse(data, "ModelProto")

@@ -1,0 +1,122 @@
+"""ONNX import tests — golden-file pattern (SURVEY.md §4): torch (CPU) is the
+local oracle; its C++ exporter serializes real ONNX protos which we decode
+with the in-repo wire reader and execute, comparing against torch outputs."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from deeplearning4j_tpu.imports.onnx_import import OnnxGraphMapper
+from deeplearning4j_tpu.imports import onnx_proto
+
+
+def _export(model, args, path):
+    """torch.onnx.export without the onnx package (stub the onnxscript hook,
+    which only post-processes custom functions we don't use)."""
+    from torch.onnx._internal.torchscript_exporter import onnx_proto_utils
+    orig = onnx_proto_utils._add_onnxscript_fn
+    onnx_proto_utils._add_onnxscript_fn = lambda model_bytes, custom_opsets: model_bytes
+    try:
+        torch.onnx.export(model, args, path, opset_version=13, dynamo=False)
+    finally:
+        onnx_proto_utils._add_onnxscript_fn = orig
+
+
+def _roundtrip(model, x, tmp_path, rtol=1e-4, atol=1e-5):
+    model.eval()
+    path = str(tmp_path / "m.onnx")
+    _export(model, (torch.from_numpy(x),), path)
+    with torch.no_grad():
+        expected = model(torch.from_numpy(x)).numpy()
+    sd = OnnxGraphMapper.import_graph(path)
+    # find the placeholder + output names from the graph
+    model_proto = onnx_proto.load_model(path)
+    in_name = [vi["name"] for vi in model_proto["graph"]["input"]
+               if vi["name"] not in {t["name"] for t in model_proto["graph"].get("initializer", [])}][0]
+    out_name = model_proto["graph"]["output"][0]["name"]
+    got = np.asarray(sd.output({in_name: x}, out_name))
+    np.testing.assert_allclose(got, expected, rtol=rtol, atol=atol)
+    return sd
+
+
+def test_wire_decoder_parses_model(tmp_path):
+    m = torch.nn.Linear(4, 3)
+    path = str(tmp_path / "lin.onnx")
+    _export(m, (torch.randn(2, 4),), path)
+    proto = onnx_proto.load_model(path)
+    g = proto["graph"]
+    assert any(n.get("op_type") == "Gemm" for n in g["node"])
+    inits = {t["name"]: onnx_proto.tensor_to_numpy(t) for t in g["initializer"]}
+    shapes = sorted(a.shape for a in inits.values())
+    assert shapes == [(3,), (3, 4)]
+
+
+def test_mlp_roundtrip(tmp_path):
+    m = torch.nn.Sequential(
+        torch.nn.Linear(8, 16), torch.nn.ReLU(),
+        torch.nn.Linear(16, 5), torch.nn.Softmax(dim=-1))
+    _roundtrip(m, np.random.default_rng(0).normal(0, 1, (3, 8)).astype(np.float32),
+               tmp_path)
+
+
+def test_cnn_roundtrip(tmp_path):
+    m = torch.nn.Sequential(
+        torch.nn.Conv2d(3, 8, 3, padding=1), torch.nn.BatchNorm2d(8),
+        torch.nn.ReLU(), torch.nn.MaxPool2d(2),
+        torch.nn.Conv2d(8, 4, 3, stride=2), torch.nn.Sigmoid(),
+        torch.nn.AdaptiveAvgPool2d(1), torch.nn.Flatten(),
+        torch.nn.Linear(4, 2))
+    _roundtrip(m, np.random.default_rng(1).normal(0, 1, (2, 3, 16, 16)).astype(np.float32),
+               tmp_path)
+
+
+def test_elementwise_and_reduce_roundtrip(tmp_path):
+    class M(torch.nn.Module):
+        def forward(self, x):
+            y = torch.tanh(x) * 2.0 + x.clamp(-1.0, 1.0)
+            return (y ** 2).mean(dim=1)
+
+    _roundtrip(M(), np.random.default_rng(2).normal(0, 1, (4, 6)).astype(np.float32),
+               tmp_path)
+
+
+def test_transpose_concat_slice_roundtrip(tmp_path):
+    class M(torch.nn.Module):
+        def forward(self, x):
+            a = x.transpose(1, 2)
+            b = torch.cat([a, a], dim=-1)
+            return b[:, 1:3, :5]
+
+    _roundtrip(M(), np.random.default_rng(3).normal(0, 1, (2, 4, 6)).astype(np.float32),
+               tmp_path)
+
+
+def test_clip_max_only_and_avgpool_pad_and_reflectpad(tmp_path):
+    class M(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.pad = torch.nn.ReflectionPad2d(1)
+            self.pool = torch.nn.AvgPool2d(3, stride=1, padding=1)  # count_include_pad=True
+
+        def forward(self, x):
+            y = x.clamp(max=0.5)          # Clip with omitted min input
+            y = self.pad(y)
+            return self.pool(y)
+
+    _roundtrip(M(), np.random.default_rng(4).normal(0, 1, (1, 2, 8, 8)).astype(np.float32),
+               tmp_path)
+
+
+def test_flatten_nondefault_axis(tmp_path):
+    class M(torch.nn.Module):
+        def forward(self, x):
+            return torch.flatten(x, start_dim=2)
+
+    class M2(torch.nn.Module):
+        def forward(self, x):
+            # Flatten(axis=2) via reshape to 2-D: (prod(d0,d1), prod(rest))
+            return x.reshape(x.shape[0] * x.shape[1], -1)
+
+    x = np.random.default_rng(5).normal(0, 1, (2, 3, 4, 5)).astype(np.float32)
+    _roundtrip(M2(), x, tmp_path)
