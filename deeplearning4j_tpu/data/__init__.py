@@ -22,10 +22,37 @@ from deeplearning4j_tpu.data.normalizers import (
     NormalizerStandardize,
 )
 from deeplearning4j_tpu.data.mnist import MnistDataSetIterator
+from deeplearning4j_tpu.data.fetchers import (
+    Cifar10DataSetIterator,
+    EmnistDataSetIterator,
+    IrisDataSetIterator,
+    SvhnDataSetIterator,
+    TinyImageNetDataSetIterator,
+    UciSequenceDataSetIterator,
+)
+from deeplearning4j_tpu.data.image import (
+    CropImageTransform,
+    FlipImageTransform,
+    ImageRecordReader,
+    ImageRecordReaderDataSetIterator,
+    ImageTransform,
+    PipelineImageTransform,
+    RandomCropTransform,
+    ResizeImageTransform,
+    RotateImageTransform,
+    ScaleImageTransform,
+    WarpImageTransform,
+)
 
 __all__ = [
     "DataSet", "MultiDataSet", "DataSetIterator", "ListDataSetIterator",
     "NumpyDataSetIterator", "ExistingDataSetIterator", "AsyncDataSetIterator",
     "NormalizerStandardize", "NormalizerMinMaxScaler", "ImagePreProcessingScaler",
-    "MnistDataSetIterator",
+    "MnistDataSetIterator", "IrisDataSetIterator", "Cifar10DataSetIterator",
+    "SvhnDataSetIterator", "EmnistDataSetIterator", "TinyImageNetDataSetIterator",
+    "UciSequenceDataSetIterator", "ImageRecordReader",
+    "ImageRecordReaderDataSetIterator", "ImageTransform", "CropImageTransform",
+    "RandomCropTransform", "FlipImageTransform", "RotateImageTransform",
+    "ScaleImageTransform", "ResizeImageTransform", "WarpImageTransform",
+    "PipelineImageTransform",
 ]

@@ -1,0 +1,277 @@
+"""Image loading + augmentation pipeline.
+
+Rebuild of datavec-data-image: ``ImageRecordReader`` (directory tree ->
+labelled image records, label = parent directory, the reference's
+``ParentPathLabelGenerator`` convention) and the ``ImageTransform``
+augmentation SPI (``org.datavec.image.transform.*``: crop, flip, rotate,
+warp, scale, resize, random crop, pipeline-with-probabilities).
+
+The reference decodes via OpenCV JavaCPP presets (``NativeImageLoader``);
+here decode is TF's native JPEG/PNG ops (CPU, offline) with the C++ host
+pipeline (``native/image_pipeline.cpp``) available for the u8->f32
+normalize/crop hot path. Transforms operate on NHWC float numpy arrays —
+host-side ETL, overlapped with device compute by ``AsyncDataSetIterator``.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import DataSetIterator
+
+
+# --------------------------------------------------------------- transforms
+class ImageTransform:
+    """SPI: ``transform(image, rng) -> image`` on one HWC float array."""
+
+    def transform(self, image: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+        raise NotImplementedError
+
+    def __call__(self, image, rng=None):
+        return self.transform(image, rng or np.random.default_rng(0))
+
+
+class CropImageTransform(ImageTransform):
+    """Crop fixed margins (reference ``CropImageTransform``)."""
+
+    def __init__(self, top: int, left: int = None, bottom: int = None, right: int = None):
+        self.top = top
+        self.left = top if left is None else left
+        self.bottom = top if bottom is None else bottom
+        self.right = top if right is None else right
+
+    def transform(self, image, rng):
+        h, w = image.shape[:2]
+        return image[self.top:h - self.bottom, self.left:w - self.right]
+
+
+class RandomCropTransform(ImageTransform):
+    """Random crop to (height, width) (reference ``RandomCropTransform``)."""
+
+    def __init__(self, height: int, width: int):
+        self.height, self.width = height, width
+
+    def transform(self, image, rng):
+        h, w = image.shape[:2]
+        if h < self.height or w < self.width:
+            pad_h, pad_w = max(0, self.height - h), max(0, self.width - w)
+            image = np.pad(image, ((0, pad_h), (0, pad_w), (0, 0)))
+            h, w = image.shape[:2]
+        y = int(rng.integers(0, h - self.height + 1))
+        x = int(rng.integers(0, w - self.width + 1))
+        return image[y:y + self.height, x:x + self.width]
+
+
+class FlipImageTransform(ImageTransform):
+    """Flip (reference ``FlipImageTransform``): mode 0 = vertical,
+    1 = horizontal, -1 = both, None = random horizontal."""
+
+    def __init__(self, mode: Optional[int] = None):
+        self.mode = mode
+
+    def transform(self, image, rng):
+        mode = self.mode
+        if mode is None:
+            if rng.random() < 0.5:
+                return image
+            mode = 1
+        if mode in (1, -1):
+            image = image[:, ::-1]
+        if mode in (0, -1):
+            image = image[::-1]
+        return np.ascontiguousarray(image)
+
+
+class RotateImageTransform(ImageTransform):
+    """Rotate by ``angle`` degrees (± ``random_angle`` jitter if given)
+    about the centre (reference ``RotateImageTransform``)."""
+
+    def __init__(self, angle: float, random_angle: float = 0.0):
+        self.angle, self.random_angle = angle, random_angle
+
+    def transform(self, image, rng):
+        from scipy.ndimage import rotate
+        a = self.angle
+        if self.random_angle:
+            a = a + rng.uniform(-self.random_angle, self.random_angle)
+        return rotate(image, a, axes=(1, 0), reshape=False, order=1,
+                      mode="nearest").astype(image.dtype)
+
+
+class ScaleImageTransform(ImageTransform):
+    """Scale height/width by a (possibly jittered) factor (reference
+    ``ScaleImageTransform``)."""
+
+    def __init__(self, scale: float, random_delta: float = 0.0):
+        self.scale, self.random_delta = scale, random_delta
+
+    def transform(self, image, rng):
+        s = self.scale
+        if self.random_delta:
+            s = s + rng.uniform(-self.random_delta, self.random_delta)
+        h, w = image.shape[:2]
+        return _resize(image, max(1, int(round(h * s))), max(1, int(round(w * s))))
+
+
+class ResizeImageTransform(ImageTransform):
+    """Resize to fixed (height, width) (reference ``ResizeImageTransform``)."""
+
+    def __init__(self, height: int, width: int):
+        self.height, self.width = height, width
+
+    def transform(self, image, rng):
+        return _resize(image, self.height, self.width)
+
+
+class WarpImageTransform(ImageTransform):
+    """Random perspective-ish warp: jitter the 4 corners by up to ``delta``
+    pixels and resample (reference ``WarpImageTransform``)."""
+
+    def __init__(self, delta: float):
+        self.delta = delta
+
+    def transform(self, image, rng):
+        from scipy.ndimage import map_coordinates
+        h, w = image.shape[:2]
+        d = self.delta
+        # corner displacements
+        dy = rng.uniform(-d, d, 4)
+        dx = rng.uniform(-d, d, 4)
+        yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+        fy, fx = yy / max(h - 1, 1), xx / max(w - 1, 1)
+        # bilinear blend of corner offsets
+        off_y = (1 - fy) * (1 - fx) * dy[0] + (1 - fy) * fx * dy[1] \
+            + fy * (1 - fx) * dy[2] + fy * fx * dy[3]
+        off_x = (1 - fy) * (1 - fx) * dx[0] + (1 - fy) * fx * dx[1] \
+            + fy * (1 - fx) * dx[2] + fy * fx * dx[3]
+        out = np.empty_like(image)
+        for c in range(image.shape[2]):
+            out[..., c] = map_coordinates(image[..., c], [yy + off_y, xx + off_x],
+                                          order=1, mode="nearest")
+        return out
+
+
+class PipelineImageTransform(ImageTransform):
+    """Sequence of (transform, probability) pairs, optionally shuffled
+    (reference ``PipelineImageTransform``)."""
+
+    def __init__(self, transforms: Sequence, shuffle: bool = False):
+        self.entries: List[Tuple[ImageTransform, float]] = [
+            t if isinstance(t, tuple) else (t, 1.0) for t in transforms]
+        self.shuffle = shuffle
+
+    def transform(self, image, rng):
+        entries = list(self.entries)
+        if self.shuffle:
+            rng.shuffle(entries)
+        for t, p in entries:
+            if p >= 1.0 or rng.random() < p:
+                image = t.transform(image, rng)
+        return image
+
+
+def _resize(image: np.ndarray, h: int, w: int) -> np.ndarray:
+    """Bilinear resize via scipy zoom (OpenCV-free)."""
+    from scipy.ndimage import zoom
+    zh, zw = h / image.shape[0], w / image.shape[1]
+    out = zoom(image, (zh, zw, 1), order=1)
+    # zoom rounding can be off by one; crop/pad to exact
+    out = out[:h, :w]
+    if out.shape[0] < h or out.shape[1] < w:
+        out = np.pad(out, ((0, h - out.shape[0]), (0, w - out.shape[1]), (0, 0)),
+                     mode="edge")
+    return out.astype(image.dtype)
+
+
+# ------------------------------------------------------------ record reader
+class ImageRecordReader:
+    """Reads a directory tree of images; label = parent directory name
+    (reference ``ImageRecordReader`` + ``ParentPathLabelGenerator``).
+    Yields (image HWC float32 in [0,255], label index)."""
+
+    EXTENSIONS = (".jpg", ".jpeg", ".png", ".bmp", ".JPEG", ".JPG", ".PNG", ".npy")
+
+    def __init__(self, height: int, width: int, channels: int = 3,
+                 transform: Optional[ImageTransform] = None, seed: int = 0):
+        self.height, self.width, self.channels = height, width, channels
+        self.transform = transform
+        self.labels: List[str] = []
+        self._files: List[Tuple[str, int]] = []
+        self._pos = 0
+        self._rng = np.random.default_rng(seed)
+
+    def initialize(self, root: str) -> "ImageRecordReader":
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.labels = classes
+        self._files = []
+        for ci, c in enumerate(classes):
+            for f in sorted(glob.glob(os.path.join(root, c, "**", "*"),
+                                      recursive=True)):
+                if f.endswith(self.EXTENSIONS):
+                    self._files.append((f, ci))
+        self._pos = 0
+        return self
+
+    def _decode(self, path: str) -> np.ndarray:
+        if path.endswith((".npy",)):
+            img = np.load(path)
+        else:
+            import tensorflow as tf
+            img = tf.io.decode_image(tf.io.read_file(path),
+                                     channels=self.channels).numpy()
+        return img.astype(np.float32)
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._files)
+
+    def next(self) -> Tuple[np.ndarray, int]:
+        path, label = self._files[self._pos]
+        self._pos += 1
+        img = self._decode(path)
+        if img.ndim == 2:
+            img = img[..., None]
+        if self.transform is not None:
+            img = self.transform.transform(img, self._rng)
+        if img.shape[:2] != (self.height, self.width):
+            img = _resize(img, self.height, self.width)
+        return img, label
+
+    def reset(self) -> None:
+        self._pos = 0
+
+
+class ImageRecordReaderDataSetIterator(DataSetIterator):
+    """Bridge ImageRecordReader -> DataSet minibatches (the reference's
+    ``RecordReaderDataSetIterator`` specialized for images)."""
+
+    def __init__(self, reader: ImageRecordReader, batch_size: int,
+                 num_classes: Optional[int] = None, scale: float = 1.0 / 255.0):
+        self.reader = reader
+        self.batch_size = batch_size
+        self.num_classes = num_classes or len(reader.labels)
+        self.scale = scale
+
+    def has_next(self) -> bool:
+        return self.reader.has_next()
+
+    def next(self) -> DataSet:
+        xs, ys = [], []
+        while self.reader.has_next() and len(xs) < self.batch_size:
+            img, lab = self.reader.next()
+            xs.append(img * self.scale)
+            ys.append(lab)
+        onehot = np.zeros((len(ys), self.num_classes), np.float32)
+        onehot[np.arange(len(ys)), ys] = 1.0
+        return DataSet(np.stack(xs).astype(np.float32), onehot)
+
+    def reset(self) -> None:
+        self.reader.reset()
+
+    def batch(self) -> int:
+        return self.batch_size

@@ -507,17 +507,307 @@ class TransformProcess:
         return TransformProcess.Builder(schema)
 
 
+# ---------------------------------------------------------------- reductions
+class ReduceOp(str, enum.Enum):
+    """Reference ``org.datavec.api.transform.ops.ReduceOp``."""
+
+    SUM = "sum"
+    MEAN = "mean"
+    MIN = "min"
+    MAX = "max"
+    RANGE = "range"
+    COUNT = "count"
+    COUNT_UNIQUE = "count_unique"
+    STDEV = "stdev"
+    FIRST = "first"
+    LAST = "last"
+
+
+_REDUCE_FNS = {
+    ReduceOp.SUM: lambda vs: float(np.sum(vs)),
+    ReduceOp.MEAN: lambda vs: float(np.mean(vs)),
+    ReduceOp.MIN: lambda vs: min(vs),
+    ReduceOp.MAX: lambda vs: max(vs),
+    ReduceOp.RANGE: lambda vs: float(max(vs)) - float(min(vs)),
+    ReduceOp.COUNT: lambda vs: len(vs),
+    ReduceOp.COUNT_UNIQUE: lambda vs: len(set(vs)),
+    ReduceOp.STDEV: lambda vs: float(np.std(np.asarray(vs, np.float64), ddof=1))
+    if len(vs) > 1 else 0.0,
+    ReduceOp.FIRST: lambda vs: vs[0],
+    ReduceOp.LAST: lambda vs: vs[-1],
+}
+
+# ops whose output keeps the input column type (others become DOUBLE/INTEGER)
+_TYPE_PRESERVING = {ReduceOp.MIN, ReduceOp.MAX, ReduceOp.FIRST, ReduceOp.LAST}
+
+
+class Reducer:
+    """Group-by + per-column aggregation (reference
+    ``org.datavec.api.transform.reduce.Reducer``)::
+
+        r = (Reducer.builder("user")
+             .sum_columns("amount").count_columns("txn").build())
+    """
+
+    def __init__(self, key_columns: List[str], ops: List[tuple]):
+        self.key_columns = list(key_columns)
+        self.ops = ops  # [(column, ReduceOp)]
+
+    class Builder:
+        def __init__(self, *key_columns: str):
+            self._keys = list(key_columns)
+            self._ops: List[tuple] = []
+
+        def _add(self, op, names):
+            self._ops.extend((n, op) for n in names)
+            return self
+
+        def sum_columns(self, *names):
+            return self._add(ReduceOp.SUM, names)
+
+        def mean_columns(self, *names):
+            return self._add(ReduceOp.MEAN, names)
+
+        def min_columns(self, *names):
+            return self._add(ReduceOp.MIN, names)
+
+        def max_columns(self, *names):
+            return self._add(ReduceOp.MAX, names)
+
+        def range_columns(self, *names):
+            return self._add(ReduceOp.RANGE, names)
+
+        def count_columns(self, *names):
+            return self._add(ReduceOp.COUNT, names)
+
+        def count_unique_columns(self, *names):
+            return self._add(ReduceOp.COUNT_UNIQUE, names)
+
+        def stdev_columns(self, *names):
+            return self._add(ReduceOp.STDEV, names)
+
+        def first_columns(self, *names):
+            return self._add(ReduceOp.FIRST, names)
+
+        def last_columns(self, *names):
+            return self._add(ReduceOp.LAST, names)
+
+        def build(self) -> "Reducer":
+            return Reducer(self._keys, list(self._ops))
+
+    @staticmethod
+    def builder(*key_columns: str) -> "Reducer.Builder":
+        return Reducer.Builder(*key_columns)
+
+    def output_schema(self, schema: Schema) -> Schema:
+        cols = [dataclasses.replace(schema.column(k)) for k in self.key_columns]
+        for name, op in self.ops:
+            src = schema.column(name)
+            if op in _TYPE_PRESERVING:
+                typ, cats = src.type, src.categories
+            elif op in (ReduceOp.COUNT, ReduceOp.COUNT_UNIQUE):
+                typ, cats = ColumnType.INTEGER, None
+            else:
+                typ, cats = ColumnType.DOUBLE, None
+            cols.append(ColumnMeta(f"{op.value}({name})", typ, cats))
+        return Schema(cols)
+
+    def reduce(self, schema: Schema, records: List[List[Any]]) -> List[List[Any]]:
+        key_idx = [schema.index_of(k) for k in self.key_columns]
+        op_idx = [(schema.index_of(n), op) for n, op in self.ops]
+        groups: Dict[tuple, List[List[Any]]] = {}
+        order: List[tuple] = []
+        for r in records:
+            k = tuple(r[i] for i in key_idx)
+            if k not in groups:
+                groups[k] = []
+                order.append(k)
+            groups[k].append(r)
+        out = []
+        for k in order:
+            rows = groups[k]
+            rec = list(k)
+            for ci, op in op_idx:
+                rec.append(_REDUCE_FNS[op]([r[ci] for r in rows]))
+            out.append(rec)
+        return out
+
+
+@_step("reduce")
+def _s_reduce(schema, reducer):
+    return reducer.output_schema(schema)
+
+
+@_rec("reduce")
+def _r_reduce(schema, records, reducer):
+    return reducer.reduce(schema, records)
+
+
+TransformProcess.Builder.reduce = lambda self, reducer: self._add(
+    "reduce", reducer=reducer)
+
+
+# --------------------------------------------------------------------- joins
+class Join:
+    """Join two record sets on key columns (reference
+    ``org.datavec.api.transform.join.Join``): Inner / LeftOuter / RightOuter /
+    FullOuter. Right-side key columns are not duplicated in the output."""
+
+    TYPES = ("Inner", "LeftOuter", "RightOuter", "FullOuter")
+
+    def __init__(self, join_type: str, left_schema: Schema, right_schema: Schema,
+                 join_columns: List[str]):
+        if join_type not in self.TYPES:
+            raise ValueError(f"join_type must be one of {self.TYPES}")
+        self.join_type = join_type
+        self.left_schema = left_schema
+        self.right_schema = right_schema
+        self.join_columns = list(join_columns)
+
+    class Builder:
+        def __init__(self, join_type: str = "Inner"):
+            self._type = join_type
+            self._left = self._right = None
+            self._cols: List[str] = []
+
+        def set_schemas(self, left: Schema, right: Schema):
+            self._left, self._right = left, right
+            return self
+
+        def set_join_columns(self, *names: str):
+            self._cols = list(names)
+            return self
+
+        def build(self) -> "Join":
+            return Join(self._type, self._left, self._right, self._cols)
+
+    @staticmethod
+    def builder(join_type: str = "Inner") -> "Join.Builder":
+        return Join.Builder(join_type)
+
+    def output_schema(self) -> Schema:
+        cols = [dataclasses.replace(c) for c in self.left_schema.columns]
+        cols += [dataclasses.replace(c) for c in self.right_schema.columns
+                 if c.name not in self.join_columns]
+        return Schema(cols)
+
+    def execute(self, left: List[List[Any]], right: List[List[Any]]
+                ) -> List[List[Any]]:
+        lk = [self.left_schema.index_of(c) for c in self.join_columns]
+        rk = [self.right_schema.index_of(c) for c in self.join_columns]
+        r_other = [i for i in range(len(self.right_schema.columns)) if i not in rk]
+        l_width, r_width = len(self.left_schema.columns), len(r_other)
+
+        rmap: Dict[tuple, List[List[Any]]] = {}
+        for r in right:
+            rmap.setdefault(tuple(r[i] for i in rk), []).append(r)
+        out, matched_right = [], set()
+        for l in left:
+            key = tuple(l[i] for i in lk)
+            matches = rmap.get(key, [])
+            if matches:
+                matched_right.add(key)
+                for r in matches:
+                    out.append(list(l) + [r[i] for i in r_other])
+            elif self.join_type in ("LeftOuter", "FullOuter"):
+                out.append(list(l) + [None] * r_width)
+        if self.join_type in ("RightOuter", "FullOuter"):
+            key_pos = dict(zip(self.join_columns, lk))
+            for key, rows in rmap.items():
+                if key in matched_right:
+                    continue
+                for r in rows:
+                    rec: List[Any] = [None] * l_width
+                    for c, v in zip(self.join_columns, key):
+                        rec[key_pos[c]] = v
+                    out.append(rec + [r[i] for i in r_other])
+        return out
+
+
+# ----------------------------------------------------------------- sequences
+@_step("convert_to_sequence")
+def _s_to_seq(schema, key_column, sort_column):
+    return schema
+
+
+@_rec("convert_to_sequence")
+def _r_to_seq(schema, records, key_column, sort_column):
+    """Group rows by ``key_column`` into sequences ordered by ``sort_column``
+    (reference ``convertToSequence(keyColumn, comparator)``). Output records
+    are sequences: lists of rows."""
+    ki, si = schema.index_of(key_column), schema.index_of(sort_column)
+    groups: Dict[Any, List[List[Any]]] = {}
+    order = []
+    for r in records:
+        k = r[ki]
+        if k not in groups:
+            groups[k] = []
+            order.append(k)
+        groups[k].append(r)
+    return [sorted(groups[k], key=lambda r: r[si]) for k in order]
+
+
+@_step("offset_sequence")
+def _s_offset_seq(schema, columns, offset):
+    return schema
+
+
+@_rec("offset_sequence")
+def _r_offset_seq(schema, records, columns, offset):
+    """Shift ``columns`` by ``offset`` steps within each sequence, trimming
+    rows without a counterpart (reference ``offsetSequence`` — the standard
+    next-step-prediction label construction)."""
+    idx = [schema.index_of(c) for c in columns]
+    out = []
+    for seq in records:
+        n = len(seq)
+        new_seq = []
+        for t in range(n):
+            src = t + offset
+            if src < 0 or src >= n:
+                continue
+            row = list(seq[t])
+            for i in idx:
+                row[i] = seq[src][i]
+            new_seq.append(row)
+        out.append(new_seq)
+    return out
+
+
+TransformProcess.Builder.convert_to_sequence = lambda self, key_column, sort_column: \
+    self._add("convert_to_sequence", key_column=key_column, sort_column=sort_column)
+TransformProcess.Builder.offset_sequence = lambda self, columns, offset: \
+    self._add("offset_sequence", columns=columns, offset=offset)
+
+_SEQUENCE_STEPS = {"convert_to_sequence", "offset_sequence"}
+
+
 class LocalTransformExecutor:
-    """Reference ``org.datavec.local.transforms.LocalTransformExecutor``."""
+    """Reference ``org.datavec.local.transforms.LocalTransformExecutor``.
+
+    Handles both flat records and (after ``convert_to_sequence``) sequence
+    records: flat column steps are applied inside each sequence."""
 
     @staticmethod
     def execute(records: Iterable[List[Any]], tp: TransformProcess) -> List[List[Any]]:
         recs = [list(r) for r in records]
         schema = tp.initial_schema
+        is_seq = False
         for st in tp.steps:
-            recs = st.apply_records(schema, recs)
+            if st.kind == "convert_to_sequence":
+                recs = st.apply_records(schema, recs)
+                is_seq = True
+            elif is_seq and st.kind not in _SEQUENCE_STEPS:
+                recs = [st.apply_records(schema, seq) for seq in recs]
+            else:
+                recs = st.apply_records(schema, recs)
             schema = st.apply_schema(schema)
         return recs
+
+    @staticmethod
+    def execute_join(left: Iterable[List[Any]], right: Iterable[List[Any]],
+                     join: Join) -> List[List[Any]]:
+        return join.execute([list(r) for r in left], [list(r) for r in right])
 
 
 # -------------------------------------------------- iterator bridge to training

@@ -315,6 +315,72 @@ class MultiLayerNetwork:
             for lst in self._listeners:
                 lst.iteration_done(self, self._iteration, self._epoch, loss)
 
+    # -------------------------------------------------------------- pretrain
+    def pretrain(self, iterator, epochs: int = 1) -> "MultiLayerNetwork":
+        """Greedy layer-wise unsupervised pretraining (reference
+        ``MultiLayerNetwork.pretrain(DataSetIterator)``): every layer exposing
+        a ``pretrain_loss`` (VAE, AutoEncoder) is trained in order on the
+        unsupervised objective, with the layers below it frozen as a feature
+        extractor."""
+        for i, layer in enumerate(self.layers):
+            if hasattr(layer, "pretrain_loss"):
+                self.pretrain_layer(i, iterator, epochs=epochs)
+        return self
+
+    def pretrain_layer(self, i: int, iterator, epochs: int = 1) -> "MultiLayerNetwork":
+        """Pretrain layer ``i`` only (reference ``pretrainLayer``). One jitted
+        donated step: stop-gradient sub-forward through layers < i, then a
+        gradient step on layer i's unsupervised loss."""
+        if self.train_state is None:
+            self.init()
+        layer = self.layers[i]
+        if not hasattr(layer, "pretrain_loss"):
+            return self
+        k = _layer_key(i, layer)
+        g = self.conf.global_conf
+        upd: Updater = layer.updater if layer.updater is not None else (
+            g.updater if g.updater is not None else Sgd(0.1))
+        tx = upd.make()
+
+        def sub_input(params, model_state, x):
+            cur = x
+            for j in range(i):
+                lay = self.layers[j]
+                if j in self.conf.preprocessors:
+                    cur = self.conf.preprocessors[j].pre_process(cur, None)
+                cur, _ = lay.forward(params.get(_layer_key(j, lay), {}),
+                                     model_state.get(_layer_key(j, lay), {}),
+                                     cur, training=False, rng=None)
+            if i in self.conf.preprocessors:
+                cur = self.conf.preprocessors[i].pre_process(cur, None)
+            return cur
+
+        def step(layer_params, opt_state, below_params, model_state, x, rng):
+            inp = jax.lax.stop_gradient(sub_input(below_params, model_state, x))
+            loss, grads = jax.value_and_grad(
+                lambda p: layer.pretrain_loss(p, inp, rng))(layer_params)
+            updates, opt_state = tx.update(grads, opt_state, layer_params)
+            return optax.apply_updates(layer_params, updates), opt_state, loss
+
+        step_fn = self._jitted(f"pretrain_{i}", lambda: jax.jit(step, donate_argnums=(0, 1)))
+        layer_params = self.train_state.params[k]
+        # layer_params is donated; it must NOT also alias in via below_params
+        # (donation frees the buffer — the aliased copy would be deleted)
+        below_params = {kk: v for kk, v in self.train_state.params.items() if kk != k}
+        opt_state = tx.init(layer_params)
+        for _ in range(int(epochs)):
+            iterator.reset()
+            for batch in iterator:
+                x = jnp.asarray(batch.features)
+                layer_params, opt_state, loss = step_fn(
+                    layer_params, opt_state, below_params,
+                    self.train_state.model_state, x, self.rng.next_key())
+                self._score = loss
+        new_params = dict(self.train_state.params)
+        new_params[k] = layer_params
+        self.train_state = dataclasses.replace(self.train_state, params=new_params)
+        return self
+
     def _zero_carries(self, batch: int, dtype) -> Dict[str, Any]:
         carries = {}
         for i, layer in enumerate(self.layers):

@@ -1,0 +1,527 @@
+"""INDArray / Nd4j facade: the reference's user-facing tensor API.
+
+Rebuild of ``org.nd4j.linalg.api.ndarray.INDArray`` + the ``Nd4j`` static
+factory (upstream ``org.nd4j.linalg.factory.Nd4j``) as a thin facade over
+jax.numpy. The reference's INDArray is a mutable buffer with views; on TPU
+the idiomatic contract is immutability inside compiled programs, so:
+
+- "in-place" methods (``addi``, ``muli``, ``assign`` …) mutate the *wrapper*
+  (rebind its buffer), giving the reference's call-site ergonomics while the
+  underlying arrays stay functional — safe to pass into jit;
+- slices/views are copies (functional semantics). Code that mutated a DL4J
+  view must use ``put``/``put_scalar``, which rebind via lax scatter.
+
+Every op stays a jax op, so INDArray code composes with jit/grad/vmap — the
+facade never forces a host sync except explicit ``.item()``/``.numpy()``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ----------------------------------------------------------------- indexing
+class NDArrayIndex:
+    """Reference ``org.nd4j.linalg.indexing.NDArrayIndex``."""
+
+    def __init__(self, sel):
+        self.sel = sel
+
+    @staticmethod
+    def all() -> "NDArrayIndex":
+        return NDArrayIndex(slice(None))
+
+    @staticmethod
+    def point(i: int) -> "NDArrayIndex":
+        return NDArrayIndex(int(i))
+
+    @staticmethod
+    def interval(start: int, end: int, step: int = 1) -> "NDArrayIndex":
+        return NDArrayIndex(slice(int(start), int(end), int(step)))
+
+    @staticmethod
+    def indices(*idx: int) -> "NDArrayIndex":
+        return NDArrayIndex(np.asarray(idx, np.int32))
+
+
+def _unwrap(x):
+    return x.array if isinstance(x, INDArray) else x
+
+
+def _sel_tuple(indices) -> tuple:
+    return tuple(i.sel if isinstance(i, NDArrayIndex) else i for i in indices)
+
+
+class INDArray:
+    """Wrapper around a jax array with the reference's method surface."""
+
+    __slots__ = ("array",)
+    __array_priority__ = 100  # numpy defers binary ops to us
+
+    def __init__(self, array):
+        self.array = jnp.asarray(array)
+
+    # ---- structure ----
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self.array.shape)
+
+    def rank(self) -> int:
+        return self.array.ndim
+
+    def length(self) -> int:
+        return int(self.array.size)
+
+    def size(self, dim: int) -> int:
+        return int(self.array.shape[dim])
+
+    def data_type(self):
+        return self.array.dtype
+
+    def rows(self) -> int:
+        return self.size(0)
+
+    def columns(self) -> int:
+        return self.size(1)
+
+    def is_vector(self) -> bool:
+        return self.array.ndim == 1 or (
+            self.array.ndim == 2 and 1 in self.array.shape)
+
+    def is_matrix(self) -> bool:
+        return self.array.ndim == 2
+
+    def is_scalar(self) -> bool:
+        return self.array.ndim == 0 or self.array.size == 1
+
+    # ---- reshape family (functional: return new INDArray) ----
+    def reshape(self, *shape) -> "INDArray":
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return INDArray(self.array.reshape(shape))
+
+    def ravel(self) -> "INDArray":
+        return INDArray(self.array.reshape(-1))
+
+    def transpose(self) -> "INDArray":
+        return INDArray(self.array.T)
+
+    def permute(self, *axes) -> "INDArray":
+        return INDArray(jnp.transpose(self.array, axes))
+
+    def swap_axes(self, a: int, b: int) -> "INDArray":
+        return INDArray(jnp.swapaxes(self.array, a, b))
+
+    def broadcast(self, *shape) -> "INDArray":
+        return INDArray(jnp.broadcast_to(self.array, shape))
+
+    def repeat(self, dim: int, n: int) -> "INDArray":
+        return INDArray(jnp.repeat(self.array, n, axis=dim))
+
+    def dup(self) -> "INDArray":
+        return INDArray(self.array)  # immutable: sharing IS a copy
+
+    def cast_to(self, dtype) -> "INDArray":
+        return INDArray(self.array.astype(dtype))
+
+    # ---- elementwise arithmetic: pure + "in-place" (rebind) variants ----
+    def _bin(self, other, fn) -> "INDArray":
+        return INDArray(fn(self.array, _unwrap(other)))
+
+    def add(self, o) -> "INDArray":
+        return self._bin(o, jnp.add)
+
+    def sub(self, o) -> "INDArray":
+        return self._bin(o, jnp.subtract)
+
+    def mul(self, o) -> "INDArray":
+        return self._bin(o, jnp.multiply)
+
+    def div(self, o) -> "INDArray":
+        return self._bin(o, jnp.divide)
+
+    def rsub(self, o) -> "INDArray":
+        return INDArray(_unwrap(o) - self.array)
+
+    def rdiv(self, o) -> "INDArray":
+        return INDArray(_unwrap(o) / self.array)
+
+    def neg(self) -> "INDArray":
+        return INDArray(-self.array)
+
+    def _i(self, result: "INDArray") -> "INDArray":
+        self.array = result.array
+        return self
+
+    def addi(self, o) -> "INDArray":
+        return self._i(self.add(o))
+
+    def subi(self, o) -> "INDArray":
+        return self._i(self.sub(o))
+
+    def muli(self, o) -> "INDArray":
+        return self._i(self.mul(o))
+
+    def divi(self, o) -> "INDArray":
+        return self._i(self.div(o))
+
+    def rsubi(self, o) -> "INDArray":
+        return self._i(self.rsub(o))
+
+    def rdivi(self, o) -> "INDArray":
+        return self._i(self.rdiv(o))
+
+    def negi(self) -> "INDArray":
+        return self._i(self.neg())
+
+    def assign(self, o) -> "INDArray":
+        self.array = jnp.broadcast_to(jnp.asarray(_unwrap(o)), self.array.shape)
+        return self
+
+    # python operators
+    __add__ = add
+    __sub__ = sub
+    __mul__ = mul
+    __truediv__ = div
+    __radd__ = add
+    __rmul__ = mul
+    __rsub__ = rsub
+    __rtruediv__ = rdiv
+    __neg__ = neg
+
+    def __matmul__(self, o) -> "INDArray":
+        return self.mmul(o)
+
+    # ---- matrix ops ----
+    def mmul(self, o) -> "INDArray":
+        return INDArray(self.array @ _unwrap(o))
+
+    def mmuli(self, o) -> "INDArray":
+        return self._i(self.mmul(o))
+
+    # row/column vector broadcasting (reference addRowVector etc.)
+    def _rowv(self, o, fn) -> "INDArray":
+        return INDArray(fn(self.array, jnp.asarray(_unwrap(o)).reshape(1, -1)))
+
+    def _colv(self, o, fn) -> "INDArray":
+        return INDArray(fn(self.array, jnp.asarray(_unwrap(o)).reshape(-1, 1)))
+
+    def add_row_vector(self, o):
+        return self._rowv(o, jnp.add)
+
+    def sub_row_vector(self, o):
+        return self._rowv(o, jnp.subtract)
+
+    def mul_row_vector(self, o):
+        return self._rowv(o, jnp.multiply)
+
+    def div_row_vector(self, o):
+        return self._rowv(o, jnp.divide)
+
+    def add_column_vector(self, o):
+        return self._colv(o, jnp.add)
+
+    def sub_column_vector(self, o):
+        return self._colv(o, jnp.subtract)
+
+    def mul_column_vector(self, o):
+        return self._colv(o, jnp.multiply)
+
+    def div_column_vector(self, o):
+        return self._colv(o, jnp.divide)
+
+    def addi_row_vector(self, o):
+        return self._i(self.add_row_vector(o))
+
+    def muli_row_vector(self, o):
+        return self._i(self.mul_row_vector(o))
+
+    # ---- reductions ----
+    def _red(self, fn, dims) -> Union["INDArray", float]:
+        if not dims:
+            return INDArray(fn(self.array))
+        return INDArray(fn(self.array, axis=tuple(int(d) for d in dims)))
+
+    def sum(self, *dims):
+        return self._red(jnp.sum, dims)
+
+    def mean(self, *dims):
+        return self._red(jnp.mean, dims)
+
+    def max(self, *dims):
+        return self._red(jnp.max, dims)
+
+    def min(self, *dims):
+        return self._red(jnp.min, dims)
+
+    def prod(self, *dims):
+        return self._red(jnp.prod, dims)
+
+    def std(self, *dims):
+        if not dims:
+            n = self.array.size
+            return INDArray(jnp.std(self.array, ddof=1 if n > 1 else 0))
+        return INDArray(jnp.std(self.array, axis=tuple(dims), ddof=1))
+
+    def var(self, *dims):
+        if not dims:
+            n = self.array.size
+            return INDArray(jnp.var(self.array, ddof=1 if n > 1 else 0))
+        return INDArray(jnp.var(self.array, axis=tuple(dims), ddof=1))
+
+    def norm1(self, *dims):
+        return self._red(lambda a, **k: jnp.sum(jnp.abs(a), **k), dims)
+
+    def norm2(self, *dims):
+        return self._red(lambda a, **k: jnp.sqrt(jnp.sum(a * a, **k)), dims)
+
+    def arg_max(self, *dims) -> "INDArray":
+        if not dims:
+            return INDArray(jnp.argmax(self.array))
+        return INDArray(jnp.argmax(self.array, axis=int(dims[0])))
+
+    def cumsum(self, dim: int) -> "INDArray":
+        return INDArray(jnp.cumsum(self.array, axis=dim))
+
+    # ---- comparisons ----
+    def lt(self, o):
+        return self._bin(o, jnp.less)
+
+    def gt(self, o):
+        return self._bin(o, jnp.greater)
+
+    def lte(self, o):
+        return self._bin(o, jnp.less_equal)
+
+    def gte(self, o):
+        return self._bin(o, jnp.greater_equal)
+
+    def eq(self, o):
+        return self._bin(o, jnp.equal)
+
+    def neq(self, o):
+        return self._bin(o, jnp.not_equal)
+
+    def equals(self, o) -> bool:
+        o = _unwrap(o)
+        return bool(self.array.shape == o.shape
+                    and jnp.allclose(self.array, o, atol=1e-5))
+
+    # ---- get/put ----
+    def get(self, *indices) -> "INDArray":
+        return INDArray(self.array[_sel_tuple(indices)])
+
+    def put(self, indices, value) -> "INDArray":
+        if not isinstance(indices, (tuple, list)):
+            indices = (indices,)
+        self.array = self.array.at[_sel_tuple(indices)].set(_unwrap(value))
+        return self
+
+    def get_row(self, i: int) -> "INDArray":
+        return INDArray(self.array[i])
+
+    def get_column(self, i: int) -> "INDArray":
+        return INDArray(self.array[:, i])
+
+    def put_row(self, i: int, row) -> "INDArray":
+        self.array = self.array.at[i].set(jnp.asarray(_unwrap(row)).reshape(-1))
+        return self
+
+    def put_column(self, i: int, col) -> "INDArray":
+        self.array = self.array.at[:, i].set(jnp.asarray(_unwrap(col)).reshape(-1))
+        return self
+
+    def get_scalar(self, *idx) -> "INDArray":
+        return INDArray(self.array[tuple(int(i) for i in idx)])
+
+    def put_scalar(self, idx, value) -> "INDArray":
+        if not isinstance(idx, (tuple, list)):
+            idx = (idx,)
+        self.array = self.array.at[tuple(int(i) for i in idx)].set(value)
+        return self
+
+    def get_double(self, *idx) -> float:
+        return float(self.array[tuple(int(i) for i in idx)])
+
+    def slice(self, i: int, dim: int = 0) -> "INDArray":
+        return INDArray(jnp.take(self.array, i, axis=dim))
+
+    def tensor_along_dimension(self, index: int, *dims) -> "INDArray":
+        """Reference ``tensorAlongDimension``: the ``index``-th sub-tensor
+        spanning ``dims``."""
+        dims = sorted(d % self.array.ndim for d in dims)
+        other = [d for d in range(self.array.ndim) if d not in dims]
+        moved = jnp.transpose(self.array, other + dims)
+        flat = moved.reshape((-1,) + tuple(self.array.shape[d] for d in dims))
+        return INDArray(flat[index])
+
+    # ---- host access ----
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self.array)
+
+    def item(self) -> float:
+        return self.array.item()
+
+    def to_double_vector(self):
+        return self.numpy().astype(np.float64).reshape(-1).tolist()
+
+    def __repr__(self):
+        return f"INDArray{self.shape()}\n{np.asarray(self.array)}"
+
+    def __len__(self):
+        return self.array.shape[0]
+
+    def __jax_array__(self):
+        return self.array
+
+
+class Nd4j:
+    """Static factory (reference ``org.nd4j.linalg.factory.Nd4j``)."""
+
+    _rng_key = jax.random.PRNGKey(0)
+
+    @classmethod
+    def _next_key(cls):
+        cls._rng_key, k = jax.random.split(cls._rng_key)
+        return k
+
+    @classmethod
+    def set_seed(cls, seed: int) -> None:
+        cls._rng_key = jax.random.PRNGKey(int(seed))
+
+    # -- creation --
+    @staticmethod
+    def create(data=None, *shape) -> INDArray:
+        if data is None:
+            raise ValueError("Nd4j.create needs data or a shape")
+        if isinstance(data, (int,)) or (isinstance(data, (tuple, list))
+                                        and shape == ()
+                                        and all(isinstance(d, int) for d in data)
+                                        and len(data) <= 8
+                                        and not any(isinstance(d, (list, tuple, np.ndarray)) for d in data)):
+            # create(rows, cols) / create([2, 3]) ambiguity: the reference
+            # treats ints as a shape -> zeros
+            dims = (data,) + shape if isinstance(data, int) else tuple(data)
+            return INDArray(jnp.zeros(dims, jnp.float32))
+        arr = jnp.asarray(data, dtype=jnp.float32)
+        if shape:
+            arr = arr.reshape(shape)
+        return INDArray(arr)
+
+    @staticmethod
+    def zeros(*shape) -> INDArray:
+        return INDArray(jnp.zeros(shape, jnp.float32))
+
+    @staticmethod
+    def ones(*shape) -> INDArray:
+        return INDArray(jnp.ones(shape, jnp.float32))
+
+    @staticmethod
+    def value_array_of(shape, value) -> INDArray:
+        return INDArray(jnp.full(tuple(shape), value, jnp.float32))
+
+    @staticmethod
+    def eye(n: int) -> INDArray:
+        return INDArray(jnp.eye(n, dtype=jnp.float32))
+
+    @staticmethod
+    def scalar(v) -> INDArray:
+        return INDArray(jnp.asarray(v, jnp.float32))
+
+    @staticmethod
+    def arange(*args) -> INDArray:
+        return INDArray(jnp.arange(*args, dtype=jnp.float32))
+
+    @staticmethod
+    def linspace(start, stop, num) -> INDArray:
+        return INDArray(jnp.linspace(start, stop, int(num), dtype=jnp.float32))
+
+    @classmethod
+    def rand(cls, *shape) -> INDArray:
+        return INDArray(jax.random.uniform(cls._next_key(), shape, jnp.float32))
+
+    @classmethod
+    def randn(cls, *shape) -> INDArray:
+        return INDArray(jax.random.normal(cls._next_key(), shape, jnp.float32))
+
+    # -- combination --
+    @staticmethod
+    def vstack(*arrs) -> INDArray:
+        return INDArray(jnp.vstack([_unwrap(a) for a in arrs]))
+
+    @staticmethod
+    def hstack(*arrs) -> INDArray:
+        return INDArray(jnp.hstack([_unwrap(a) for a in arrs]))
+
+    @staticmethod
+    def concat(dim: int, *arrs) -> INDArray:
+        return INDArray(jnp.concatenate([_unwrap(a) for a in arrs], axis=dim))
+
+    @staticmethod
+    def stack(dim: int, *arrs) -> INDArray:
+        return INDArray(jnp.stack([_unwrap(a) for a in arrs], axis=dim))
+
+    @staticmethod
+    def to_flattened(*arrs) -> INDArray:
+        return INDArray(jnp.concatenate([_unwrap(a).reshape(-1) for a in arrs]))
+
+    # -- linalg --
+    @staticmethod
+    def gemm(a, b, transpose_a: bool = False, transpose_b: bool = False,
+             alpha: float = 1.0, beta: float = 0.0, c=None) -> INDArray:
+        A, B = _unwrap(a), _unwrap(b)
+        if transpose_a:
+            A = A.T
+        if transpose_b:
+            B = B.T
+        out = alpha * (A @ B)
+        if c is not None and beta != 0.0:
+            out = out + beta * _unwrap(c)
+        return INDArray(out)
+
+    @staticmethod
+    def dot(a, b) -> INDArray:
+        return INDArray(jnp.dot(_unwrap(a), _unwrap(b)))
+
+    # -- sorting --
+    @staticmethod
+    def sort(a, dim: int = -1, ascending: bool = True) -> INDArray:
+        out = jnp.sort(_unwrap(a), axis=dim)
+        return INDArray(out if ascending else jnp.flip(out, axis=dim))
+
+    @staticmethod
+    def arg_sort(a, dim: int = -1) -> INDArray:
+        return INDArray(jnp.argsort(_unwrap(a), axis=dim))
+
+    # -- io (reference Nd4j.write/read binary) --
+    @staticmethod
+    def write(arr, path: str) -> None:
+        np.save(path if path.endswith(".npy") else path + ".npy",
+                np.asarray(_unwrap(arr)))
+
+    @staticmethod
+    def read(path: str) -> INDArray:
+        return INDArray(np.load(path if path.endswith(".npy") else path + ".npy"))
+
+    # -- expand --
+    @staticmethod
+    def expand_dims(a, dim: int) -> INDArray:
+        return INDArray(jnp.expand_dims(_unwrap(a), dim))
+
+    @staticmethod
+    def squeeze(a, dim: int) -> INDArray:
+        return INDArray(jnp.squeeze(_unwrap(a), axis=dim))
+
+    @staticmethod
+    def where(cond, x, y) -> INDArray:
+        return INDArray(jnp.where(_unwrap(cond), _unwrap(x), _unwrap(y)))
+
+    @staticmethod
+    def exec(op_name: str, *arrs, **kwargs) -> INDArray:
+        """Named-op dispatch into the op registry (the ``Nd4j.exec`` analog;
+        ops come from ``autodiff.ops_registry`` — same names SameDiff uses)."""
+        from deeplearning4j_tpu.autodiff.ops_registry import get_op
+        return INDArray(get_op(op_name)(*[_unwrap(a) for a in arrs], **kwargs))

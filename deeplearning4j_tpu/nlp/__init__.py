@@ -12,7 +12,8 @@ from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory, Tokeniz
 from deeplearning4j_tpu.nlp.vocab import VocabCache
 from deeplearning4j_tpu.nlp.word2vec import ParagraphVectors, Word2Vec
 from deeplearning4j_tpu.nlp.glove import Glove
+from deeplearning4j_tpu.nlp.fasttext import FastText
 from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
 
-__all__ = ["Word2Vec", "ParagraphVectors", "Glove", "VocabCache",
+__all__ = ["Word2Vec", "ParagraphVectors", "Glove", "FastText", "VocabCache",
            "TokenizerFactory", "DefaultTokenizerFactory", "WordVectorSerializer"]

@@ -1,0 +1,274 @@
+"""FastText: subword-aware embeddings + supervised text classification.
+
+Rebuild of upstream ``org.deeplearning4j.models.fasttext.FastText`` (a JNI
+wrapper over Facebook's fastText in the reference). Here the model itself is
+TPU-native: a single embedding table holds word rows and hashed character
+n-gram bucket rows; a word's vector is the MEAN of its word row and its
+n-gram rows (so out-of-vocabulary words still get vectors — the defining
+fastText capability). Both training modes are one jitted donated update:
+
+- unsupervised: skip-gram with negative sampling over subword-composed inputs
+- supervised: mean-of-features bag → linear softmax over labels
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory, TokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import VocabCache
+
+_FNV_PRIME = 16777619
+_FNV_OFFSET = 2166136261
+
+
+def _fnv1a(s: str) -> int:
+    h = _FNV_OFFSET
+    for ch in s.encode("utf-8"):
+        h = ((h ^ ch) * _FNV_PRIME) & 0xFFFFFFFF
+    return h
+
+
+def char_ngrams(word: str, min_n: int, max_n: int) -> List[str]:
+    """fastText-style n-grams of ``<word>`` with boundary markers."""
+    w = f"<{word}>"
+    out = []
+    for n in range(min_n, max_n + 1):
+        if n > len(w):
+            continue
+        out.extend(w[i:i + n] for i in range(len(w) - n + 1))
+    return out
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _sg_subword_step(table, emb_out, feat_ids, feat_mask, target, negatives, lr):
+    """Skip-gram NS step where the input vector is the mean of ``feat_ids``
+    rows (word + its n-gram buckets). feat_ids: (B, F) into table,
+    feat_mask: (B, F) 0/1, target: (B,), negatives: (B, K) into emb_out."""
+    denom = jnp.maximum(feat_mask.sum(axis=1, keepdims=True), 1.0)
+    v = jnp.einsum("bfd,bf->bd", jnp.take(table, feat_ids, axis=0), feat_mask) / denom
+    u_pos = jnp.take(emb_out, target, axis=0)
+    u_neg = jnp.take(emb_out, negatives, axis=0)
+    pos_logit = jnp.sum(v * u_pos, axis=-1)
+    neg_logit = jnp.einsum("bd,bkd->bk", v, u_neg)
+    g_pos = jax.nn.sigmoid(pos_logit) - 1.0
+    g_neg = jax.nn.sigmoid(neg_logit)
+    grad_v = g_pos[:, None] * u_pos + jnp.einsum("bk,bkd->bd", g_neg, u_neg)
+    loss = jnp.mean(-jax.nn.log_sigmoid(pos_logit)
+                    - jnp.sum(jax.nn.log_sigmoid(-neg_logit), axis=-1))
+
+    def mean_scatter(tbl, idx, grads, w=None):
+        V = tbl.shape[0]
+        wts = jnp.ones(idx.shape, grads.dtype) if w is None else w
+        counts = jnp.zeros((V,), grads.dtype).at[idx.reshape(-1)].add(wts.reshape(-1))
+        acc = jnp.zeros_like(tbl).at[idx.reshape(-1)].add(
+            grads.reshape(-1, grads.shape[-1]) * wts.reshape(-1)[:, None])
+        return tbl - lr * acc / jnp.maximum(counts, 1.0)[:, None]
+
+    emb_out = mean_scatter(emb_out, target, g_pos[:, None] * v)
+    emb_out = mean_scatter(emb_out, negatives, g_neg[..., None] * v[:, None, :])
+    # each feature row receives grad_v / n_features(example)
+    feat_grads = jnp.broadcast_to((grad_v / denom)[:, None, :],
+                                  feat_ids.shape + (grad_v.shape[-1],))
+    table = mean_scatter(table, feat_ids, feat_grads, w=feat_mask)
+    return table, emb_out, loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def _supervised_step(table, W, b, feat_ids, feat_mask, labels, lr):
+    """Mean-of-features → linear softmax; SGD on table, W, b."""
+    def loss_fn(tbl, W_, b_):
+        denom = jnp.maximum(feat_mask.sum(axis=1, keepdims=True), 1.0)
+        v = jnp.einsum("bfd,bf->bd", jnp.take(tbl, feat_ids, axis=0), feat_mask) / denom
+        logits = v @ W_ + b_
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.sum(labels * logp, axis=-1))
+
+    loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(table, W, b)
+    return table - lr * grads[0], W - lr * grads[1], b - lr * grads[2], loss
+
+
+class FastText:
+    """Mirrors the reference builder surface::
+
+        ft = FastText(supervised=False, dim=64, min_n=3, max_n=6, bucket=20000)
+        ft.fit(sentences)                       # unsupervised skip-gram
+        ft.get_word_vector("unseenword")        # works OOV via n-grams
+
+        clf = FastText(supervised=True, dim=32)
+        clf.fit(texts, labels)
+        clf.predict("some text"); clf.predict_probability("some text")
+    """
+
+    def __init__(self, supervised: bool = False, dim: int = 100,
+                 window_size: int = 5, min_word_frequency: int = 1,
+                 min_n: int = 3, max_n: int = 6, bucket: int = 100_000,
+                 negative: int = 5, epochs: int = 5, batch_size: int = 512,
+                 learning_rate: float = 0.05, seed: int = 42,
+                 max_features: int = 64, doc_max_features: int = 1024,
+                 tokenizer_factory: Optional[TokenizerFactory] = None):
+        self.supervised = supervised
+        self.dim = dim
+        self.window_size = window_size
+        self.min_word_frequency = min_word_frequency
+        self.min_n, self.max_n, self.bucket = min_n, max_n, bucket
+        self.negative = negative
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.learning_rate = learning_rate
+        self.seed = seed
+        self.max_features = max_features
+        self.doc_max_features = doc_max_features
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+        self.vocab: Optional[VocabCache] = None
+        self.table: Optional[jax.Array] = None  # (V + bucket, dim)
+        self.emb_out: Optional[jax.Array] = None
+        self.W: Optional[jax.Array] = None
+        self.b: Optional[jax.Array] = None
+        self.labels_: List[str] = []
+        self._modelmtype = "sup" if supervised else "skipgram"
+
+    # ---- feature extraction ----
+    def _ngram_ids(self, word: str) -> List[int]:
+        V = len(self.vocab)
+        return [V + (_fnv1a(g) % self.bucket)
+                for g in char_ngrams(word, self.min_n, self.max_n)]
+
+    def _word_feature_ids(self, word: str) -> List[int]:
+        ids = []
+        wi = self.vocab.index_of(word)
+        if wi >= 0:
+            ids.append(wi)
+        ids.extend(self._ngram_ids(word))
+        return ids or [len(self.vocab)]  # degenerate: first bucket row
+
+    def _pad_features(self, feats: Sequence[Sequence[int]],
+                      width: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
+        F = width if width is not None else self.max_features
+        ids = np.zeros((len(feats), F), np.int32)
+        mask = np.zeros((len(feats), F), np.float32)
+        for i, f in enumerate(feats):
+            f = list(f)
+            if len(f) > F:
+                # even-stride subsample: keep whole-document coverage rather
+                # than classifying by the opening tokens only
+                f = [f[int(j * len(f) / F)] for j in range(F)]
+            ids[i, :len(f)] = f
+            mask[i, :len(f)] = 1.0
+        return ids, mask
+
+    def _tokens(self, texts: Iterable[str]) -> List[List[str]]:
+        return [self.tokenizer_factory.create(t).get_tokens() for t in texts]
+
+    # ---- training ----
+    def fit(self, texts: Iterable[str], labels: Optional[Sequence[str]] = None
+            ) -> "FastText":
+        token_lists = self._tokens(texts)
+        self.vocab = VocabCache(self.min_word_frequency).fit(token_lists)
+        V, D = len(self.vocab), self.dim
+        rng = np.random.default_rng(self.seed)
+        self.table = jnp.asarray(
+            rng.uniform(-0.5 / D, 0.5 / D, (V + self.bucket, D)).astype(np.float32))
+        if self.supervised:
+            if labels is None:
+                raise ValueError("supervised FastText needs labels")
+            return self._fit_supervised(token_lists, list(labels), rng)
+        return self._fit_skipgram(token_lists, rng)
+
+    def _fit_skipgram(self, token_lists, rng) -> "FastText":
+        V, D = len(self.vocab), self.dim
+        self.emb_out = jnp.zeros((V, D), jnp.float32)
+        probs = self.vocab.negative_sampling_probs()
+        # Precompute per-word subword feature lists once.
+        feat_cache: Dict[int, List[int]] = {}
+        for tl in token_lists:
+            for w in tl:
+                i = self.vocab.index_of(w)
+                if i >= 0 and i not in feat_cache:
+                    feat_cache[i] = [i] + self._ngram_ids(w)
+        for epoch in range(self.epochs):
+            lr = self.learning_rate * (1 - epoch / max(1, self.epochs))
+            centers, targets = [], []
+            for tl in token_lists:
+                enc = [self.vocab.index_of(w) for w in tl]
+                enc = [i for i in enc if i >= 0]
+                for i, w in enumerate(enc):
+                    win = rng.integers(1, self.window_size + 1)
+                    for j in range(max(0, i - win), min(len(enc), i + win + 1)):
+                        if j != i:
+                            centers.append(w)
+                            targets.append(enc[j])
+            order = rng.permutation(len(centers))
+            centers = np.asarray(centers, np.int32)[order]
+            targets = np.asarray(targets, np.int32)[order]
+            for s in range(0, len(centers), self.batch_size):
+                sl = slice(s, s + self.batch_size)
+                ids, mask = self._pad_features([feat_cache[c] for c in centers[sl]])
+                negs = rng.choice(len(probs), size=(ids.shape[0], self.negative),
+                                  p=probs).astype(np.int32)
+                self.table, self.emb_out, _ = _sg_subword_step(
+                    self.table, self.emb_out, jnp.asarray(ids), jnp.asarray(mask),
+                    jnp.asarray(targets[sl]), jnp.asarray(negs), jnp.float32(lr))
+        return self
+
+    def _fit_supervised(self, token_lists, labels: List[str], rng) -> "FastText":
+        self.labels_ = sorted(set(labels))
+        lab_idx = {l: i for i, l in enumerate(self.labels_)}
+        n_lab, D = len(self.labels_), self.dim
+        self.W = jnp.zeros((D, n_lab), jnp.float32)
+        self.b = jnp.zeros((n_lab,), jnp.float32)
+        feats = [self._doc_feature_ids(tl) for tl in token_lists]
+        y = np.eye(n_lab, dtype=np.float32)[[lab_idx[l] for l in labels]]
+        for epoch in range(self.epochs):
+            lr = self.learning_rate * (1 - epoch / max(1, self.epochs))
+            order = rng.permutation(len(feats))
+            for s in range(0, len(order), self.batch_size):
+                sel = order[s:s + self.batch_size]
+                ids, mask = self._pad_features([feats[i] for i in sel],
+                                               width=self.doc_max_features)
+                self.table, self.W, self.b, _ = _supervised_step(
+                    self.table, self.W, self.b, jnp.asarray(ids),
+                    jnp.asarray(mask), jnp.asarray(y[sel]), jnp.float32(lr))
+        return self
+
+    def _doc_feature_ids(self, tokens: List[str]) -> List[int]:
+        ids: List[int] = []
+        for w in tokens:
+            ids.extend(self._word_feature_ids(w))
+        return ids
+
+    # ---- queries (reference FastText API names) ----
+    def get_word_vector(self, word: str) -> np.ndarray:
+        """Subword-composed vector; defined for OOV words too."""
+        ids = self._word_feature_ids(word)
+        return np.asarray(jnp.mean(jnp.take(self.table, jnp.asarray(ids), axis=0),
+                                   axis=0))
+
+    def similarity(self, w1: str, w2: str) -> float:
+        a, b = self.get_word_vector(w1), self.get_word_vector(w2)
+        return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
+
+    def _predict_logits(self, text: str) -> np.ndarray:
+        ids, mask = self._pad_features(
+            [self._doc_feature_ids(self.tokenizer_factory.create(text).get_tokens())],
+            width=self.doc_max_features)
+        denom = max(mask.sum(), 1.0)
+        v = (np.asarray(self.table)[ids[0]] * mask[0][:, None]).sum(0) / denom
+        return v @ np.asarray(self.W) + np.asarray(self.b)
+
+    def predict(self, text: str) -> str:
+        return self.labels_[int(np.argmax(self._predict_logits(text)))]
+
+    def predict_probability(self, text: str) -> Dict[str, float]:
+        logits = self._predict_logits(text)
+        p = np.exp(logits - logits.max())
+        p /= p.sum()
+        return dict(zip(self.labels_, p.tolist()))
+
+    def word_vectors_for(self, words: Sequence[str]) -> np.ndarray:
+        return np.stack([self.get_word_vector(w) for w in words])

@@ -63,6 +63,19 @@ from deeplearning4j_tpu.nn.extra_layers import (
     Upsampling3D,
     Yolo2OutputLayer,
 )
+from deeplearning4j_tpu.nn.autoencoder_layers import (
+    AutoEncoder,
+    VariationalAutoencoder,
+)
+from deeplearning4j_tpu.nn.misc_layers import (
+    Cropping1D,
+    ElementWiseMultiplicationLayer,
+    MaskZeroLayer,
+    PReLULayer,
+    RepeatVector,
+    TimeDistributed,
+    ZeroPadding1DLayer,
+)
 
 __all__ = [
     "GlobalConfig",
@@ -111,4 +124,13 @@ __all__ = [
     "LocallyConnected2D",
     "CenterLossOutputLayer",
     "Yolo2OutputLayer",
+    "AutoEncoder",
+    "VariationalAutoencoder",
+    "PReLULayer",
+    "ElementWiseMultiplicationLayer",
+    "RepeatVector",
+    "MaskZeroLayer",
+    "TimeDistributed",
+    "Cropping1D",
+    "ZeroPadding1DLayer",
 ]

@@ -1,0 +1,231 @@
+"""Unsupervised / pretraining layers.
+
+Rebuild of upstream ``org.deeplearning4j.nn.conf.layers.variational.
+VariationalAutoencoder`` and ``org.deeplearning4j.nn.conf.layers.AutoEncoder``
+(denoising autoencoder). In the reference these are "pretrainable" layers:
+``MultiLayerNetwork.pretrain(iter)`` trains them greedily layer-by-layer on an
+unsupervised objective, and in supervised training they act as plain
+feed-forward encoders. Same contract here — the unsupervised objective is
+exposed as ``pretrain_loss`` and consumed by
+``MultiLayerNetwork.pretrain_layer``, which jits one donated update step per
+pretrained layer (no per-op dispatch, unlike the reference's pretraining path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.base import GlobalConfig, Layer, register_layer
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.ops.activations import Activation, get_activation
+from deeplearning4j_tpu.ops.initializers import init_weights
+from deeplearning4j_tpu.ops.losses import LossFunction, compute_loss
+
+_LOG2PI = 1.8378770664093453
+
+
+def _mlp_init(key, sizes, winit, bias_init, dtype, prefix):
+    params = {}
+    for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+        k = jax.random.fold_in(key, i)
+        params[f"W_{prefix}{i}"] = init_weights(k, (a, b), winit, fan=(a, b), dtype=dtype)
+        params[f"b_{prefix}{i}"] = jnp.full((b,), bias_init, dtype=dtype)
+    return params
+
+
+def _mlp_forward(params, x, n, act, prefix):
+    for i in range(n):
+        x = act(x @ params[f"W_{prefix}{i}"] + params[f"b_{prefix}{i}"])
+    return x
+
+
+@register_layer
+@dataclasses.dataclass
+class VariationalAutoencoder(Layer):
+    """VAE (Kingma & Welling) as a layer, matching the reference's semantics:
+
+    - supervised forward = encoder MLP -> mean of q(z|x) (``pzx_activation``
+      applied), so the layer is a drop-in feed-forward encoder of width
+      ``n_out`` (the latent size).
+    - ``pretrain_loss`` = negative ELBO: reconstruction negative
+      log-likelihood under ``reconstruction_distribution`` plus analytic
+      KL(q(z|x) || N(0, I)), averaged over the minibatch, estimated with
+      ``num_samples`` reparameterized draws.
+
+    ``reconstruction_distribution``: "gaussian" (decoder emits mean and
+    log-variance per visible unit — 2*nIn outputs) or "bernoulli" (decoder
+    emits logits — nIn outputs; use for binary/binarized data).
+    """
+
+    n_out: int = 0  # latent size
+    n_in: Optional[int] = None
+    encoder_layer_sizes: Tuple[int, ...] = (100,)
+    decoder_layer_sizes: Tuple[int, ...] = (100,)
+    reconstruction_distribution: str = "gaussian"
+    pzx_activation: Any = Activation.IDENTITY
+    num_samples: int = 1
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def _nin(self, input_type: InputType) -> int:
+        return self.n_in if self.n_in is not None else input_type.flat_size()
+
+    def _vis_out(self, n_in: int) -> int:
+        if self.reconstruction_distribution == "gaussian":
+            return 2 * n_in
+        if self.reconstruction_distribution == "bernoulli":
+            return n_in
+        raise ValueError(f"Unknown reconstruction distribution "
+                         f"{self.reconstruction_distribution!r}")
+
+    def init(self, key, input_type, g: GlobalConfig):
+        n_in = self._nin(input_type)
+        winit, binit, dt = self._winit(g), self._binit(g), g.dtype
+        enc = (n_in,) + tuple(self.encoder_layer_sizes)
+        dec = (self.n_out,) + tuple(self.decoder_layer_sizes)
+        k_e, k_d, k_m, k_v, k_x = jax.random.split(key, 5)
+        params = {}
+        params.update(_mlp_init(k_e, enc, winit, binit, dt, "enc"))
+        params.update(_mlp_init(k_d, dec, winit, binit, dt, "dec"))
+        h = enc[-1]
+        params["W_zmean"] = init_weights(k_m, (h, self.n_out), winit,
+                                         fan=(h, self.n_out), dtype=dt)
+        params["b_zmean"] = jnp.full((self.n_out,), binit, dtype=dt)
+        params["W_zvar"] = init_weights(k_v, (h, self.n_out), winit,
+                                        fan=(h, self.n_out), dtype=dt)
+        params["b_zvar"] = jnp.full((self.n_out,), binit, dtype=dt)
+        d_h, vis = dec[-1], self._vis_out(n_in)
+        params["W_pxz"] = init_weights(k_x, (d_h, vis), winit, fan=(d_h, vis), dtype=dt)
+        params["b_pxz"] = jnp.full((vis,), binit, dtype=dt)
+        return params, {}
+
+    def regularizable_params(self):
+        return tuple(f"W_enc{i}" for i in range(len(self.encoder_layer_sizes))) + \
+            tuple(f"W_dec{i}" for i in range(len(self.decoder_layer_sizes))) + \
+            ("W_zmean", "W_zvar", "W_pxz")
+
+    # ---- pieces ----
+    def _encode(self, params, x):
+        act = get_activation(self._act(self._g))
+        h = _mlp_forward(params, x, len(self.encoder_layer_sizes), act, "enc")
+        mean = h @ params["W_zmean"] + params["b_zmean"]
+        logvar = h @ params["W_zvar"] + params["b_zvar"]
+        return mean, logvar
+
+    def _decode(self, params, z):
+        act = get_activation(self._act(self._g))
+        h = _mlp_forward(params, z, len(self.decoder_layer_sizes), act, "dec")
+        return h @ params["W_pxz"] + params["b_pxz"]
+
+    def _recon_logp(self, vis_out, x):
+        """log p(x|z), summed over visible units, per example."""
+        if self.reconstruction_distribution == "gaussian":
+            mean, logvar = jnp.split(vis_out, 2, axis=-1)
+            lp = -0.5 * (_LOG2PI + logvar + jnp.square(x - mean) / jnp.exp(logvar))
+        else:  # bernoulli logits
+            lp = x * jax.nn.log_sigmoid(vis_out) + (1.0 - x) * jax.nn.log_sigmoid(-vis_out)
+        return jnp.sum(lp, axis=-1)
+
+    # ---- supervised path: encoder as a feed-forward layer ----
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        x = self._apply_input_dropout(x, self._g, training, rng)
+        mean, _ = self._encode(params, x)
+        return get_activation(self.pzx_activation)(mean), state
+
+    # ---- unsupervised objective ----
+    def pretrain_loss(self, params, x, rng):
+        """Negative ELBO, minibatch mean."""
+        mean, logvar = self._encode(params, x)
+        kl = -0.5 * jnp.sum(1.0 + logvar - jnp.square(mean) - jnp.exp(logvar), axis=-1)
+        recon = jnp.zeros(x.shape[0], dtype=mean.dtype)
+        for s in range(self.num_samples):
+            eps = jax.random.normal(jax.random.fold_in(rng, s), mean.shape, mean.dtype)
+            z = mean + jnp.exp(0.5 * logvar) * eps
+            recon = recon + self._recon_logp(self._decode(params, z), x)
+        recon = recon / self.num_samples
+        return jnp.mean(kl - recon)
+
+    # ---- reference utility API ----
+    def reconstruction_log_probability(self, params, x, num_samples: int = 1,
+                                       rng=None):
+        """Importance-weighted estimate of log p(x) per example
+        (reference ``reconstructionLogProbability``)."""
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        mean, logvar = self._encode(params, x)
+        std = jnp.exp(0.5 * logvar)
+        ws = []
+        for s in range(num_samples):
+            eps = jax.random.normal(jax.random.fold_in(rng, s), mean.shape, mean.dtype)
+            z = mean + std * eps
+            logp_xz = self._recon_logp(self._decode(params, z), x)
+            logp_z = jnp.sum(-0.5 * (_LOG2PI + jnp.square(z)), axis=-1)
+            logq = jnp.sum(-0.5 * (_LOG2PI + logvar + jnp.square(eps)), axis=-1)
+            ws.append(logp_xz + logp_z - logq)
+        return jax.scipy.special.logsumexp(jnp.stack(ws), axis=0) - jnp.log(
+            float(num_samples))
+
+    def generate_at_mean_given_z(self, params, z):
+        """Decoder mean output for latent ``z`` (reference
+        ``generateAtMeanGivenZ``)."""
+        out = self._decode(params, z)
+        if self.reconstruction_distribution == "gaussian":
+            return jnp.split(out, 2, axis=-1)[0]
+        return jax.nn.sigmoid(out)
+
+    def reconstruction_error(self, params, x):
+        """Deterministic round-trip error ||x - dec(enc_mean(x))||^2 mean."""
+        mean, _ = self._encode(params, x)
+        rec = self.generate_at_mean_given_z(params, mean)
+        return jnp.mean(jnp.sum(jnp.square(x - rec), axis=-1))
+
+
+@register_layer
+@dataclasses.dataclass
+class AutoEncoder(Layer):
+    """Denoising autoencoder layer (reference ``AutoEncoder``): tied-weight
+    encode/decode with input corruption. Supervised forward = encoder only;
+    ``pretrain_loss`` corrupts the input (zeroing with probability
+    ``corruption_level``), encodes with (W, b), decodes with (W^T, vb), and
+    scores reconstruction against the clean input with ``loss``."""
+
+    n_out: int = 0
+    n_in: Optional[int] = None
+    corruption_level: float = 0.3
+    sparsity: float = 0.0
+    loss: Any = LossFunction.MSE
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def init(self, key, input_type, g: GlobalConfig):
+        n_in = self.n_in if self.n_in is not None else input_type.flat_size()
+        params = {
+            "W": init_weights(key, (n_in, self.n_out), self._winit(g),
+                              fan=(n_in, self.n_out), dtype=g.dtype),
+            "b": jnp.full((self.n_out,), self._binit(g), dtype=g.dtype),
+            "vb": jnp.zeros((n_in,), dtype=g.dtype),
+        }
+        return params, {}
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        x = self._apply_input_dropout(x, self._g, training, rng)
+        act = get_activation(self._act(self._g))
+        return act(x @ params["W"] + params["b"]), state
+
+    def pretrain_loss(self, params, x, rng):
+        act = get_activation(self._act(self._g))
+        corrupted = x
+        if self.corruption_level > 0.0:
+            keep = jax.random.bernoulli(rng, 1.0 - self.corruption_level, x.shape)
+            corrupted = jnp.where(keep, x, 0.0).astype(x.dtype)
+        h = act(corrupted @ params["W"] + params["b"])
+        recon_pre = h @ params["W"].T + params["vb"]
+        l = compute_loss(self.loss, x, recon_pre, activation=self._act(self._g))
+        if self.sparsity > 0.0:
+            l = l + self.sparsity * jnp.mean(jnp.abs(h))
+        return l

@@ -177,6 +177,10 @@ class Layer:
         d = dict(d)
         typ = d.pop("@type", cls.__name__)
         target = get_layer_class(typ)
+        # Delegate to a subclass's overridden from_dict (e.g. wrapper layers
+        # that must revive their nested ``underlying`` layer).
+        if target.from_dict.__func__ is not cls.from_dict.__func__:
+            return target.from_dict({**d, "@type": typ})
         field_names = {f.name for f in dataclasses.fields(target)}
         kwargs = {}
         for k, v in d.items():

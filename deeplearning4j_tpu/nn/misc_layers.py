@@ -1,0 +1,196 @@
+"""Misc parity layers.
+
+Rebuild of upstream layers not covered elsewhere:
+``PReLULayer``, ``ElementWiseMultiplicationLayer``
+(``org.deeplearning4j.nn.conf.layers.misc``), ``RepeatVector``,
+``MaskZeroLayer`` + ``TimeDistributed`` wrappers
+(``org.deeplearning4j.nn.conf.layers.{util,recurrent}``), and 1-D
+cropping/padding (``Cropping1D``, ``ZeroPadding1DLayer``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.base import GlobalConfig, Layer, register_layer
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.ops.activations import get_activation
+from deeplearning4j_tpu.ops.initializers import init_weights
+
+
+@register_layer
+@dataclasses.dataclass
+class PReLULayer(Layer):
+    """Parametric ReLU: y = max(0, x) + alpha * min(0, x) with learned
+    ``alpha`` (reference ``PReLULayer``). ``alpha`` has the input's feature
+    shape except axes listed in ``shared_axes`` (1-based over non-batch dims,
+    matching the reference), which are broadcast."""
+
+    shared_axes: Tuple[int, ...] = ()
+
+    def _alpha_shape(self, input_type: InputType) -> Tuple[int, ...]:
+        shape = list(input_type.array_shape(batch=1)[1:])
+        for ax in self.shared_axes:
+            shape[ax - 1] = 1
+        return tuple(shape)
+
+    def init(self, key, input_type, g: GlobalConfig):
+        return {"alpha": jnp.zeros(self._alpha_shape(input_type), dtype=g.dtype)}, {}
+
+    def regularizable_params(self):
+        return ()
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        a = params["alpha"]
+        return jnp.maximum(x, 0) + a * jnp.minimum(x, 0), state
+
+
+@register_layer
+@dataclasses.dataclass
+class ElementWiseMultiplicationLayer(Layer):
+    """y = act(x * w + b) with a per-feature weight vector (reference
+    ``ElementWiseMultiplicationLayer``; nIn == nOut)."""
+
+    n_out: int = 0
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def init(self, key, input_type, g: GlobalConfig):
+        n = self.n_out or input_type.size
+        return {"W": init_weights(key, (n,), self._winit(g), fan=(n, n),
+                                  dtype=g.dtype),
+                "b": jnp.full((n,), self._binit(g), dtype=g.dtype)}, {}
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        x = self._apply_input_dropout(x, self._g, training, rng)
+        return get_activation(self._act(self._g))(x * params["W"] + params["b"]), state
+
+
+@register_layer
+@dataclasses.dataclass
+class RepeatVector(Layer):
+    """(batch, size) -> (batch, n, size) (reference ``RepeatVector``)."""
+
+    n: int = 1
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(input_type.size, self.n)
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        return jnp.repeat(x[:, None, :], self.n, axis=1), state
+
+
+def _wrap_serde(cls):
+    """from_dict support for wrapper layers holding an ``underlying`` layer."""
+    orig = cls.from_dict.__func__
+
+    def from_dict(kls, d):
+        layer = orig(kls, d)
+        if isinstance(layer.underlying, dict):
+            layer.underlying = Layer.from_dict(layer.underlying)
+        return layer
+
+    cls.from_dict = classmethod(from_dict)
+    return cls
+
+
+@register_layer
+@_wrap_serde
+@dataclasses.dataclass
+class MaskZeroLayer(Layer):
+    """Wrapper: where the sequence mask is 0, replace the wrapped layer's
+    input with ``masking_value`` (reference ``MaskZeroLayer``)."""
+
+    underlying: Any = None
+    masking_value: float = 0.0
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return self.underlying.output_type(input_type)
+
+    def init(self, key, input_type, g: GlobalConfig):
+        self.underlying._g = g
+        return self.underlying.init(key, input_type, g)
+
+    def regularizable_params(self):
+        return self.underlying.regularizable_params()
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        if mask is not None:
+            m = mask[..., None].astype(x.dtype)
+            x = x * m + self.masking_value * (1.0 - m)
+        self.underlying._g = self._g
+        return self.underlying.forward(params, state, x, training=training,
+                                       rng=rng, mask=mask)
+
+
+@register_layer
+@_wrap_serde
+@dataclasses.dataclass
+class TimeDistributed(Layer):
+    """Apply a feed-forward layer independently at every timestep of a
+    (batch, time, size) input by folding time into batch (reference
+    ``TimeDistributed``). XLA sees one big batched matmul, not a time loop."""
+
+    underlying: Any = None
+
+    def output_type(self, input_type: InputType) -> InputType:
+        inner = self.underlying.output_type(InputType.feed_forward(input_type.size))
+        return InputType.recurrent(inner.size, input_type.timesteps)
+
+    def init(self, key, input_type, g: GlobalConfig):
+        self.underlying._g = g
+        return self.underlying.init(key, InputType.feed_forward(input_type.size), g)
+
+    def regularizable_params(self):
+        return self.underlying.regularizable_params()
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        b, t = x.shape[0], x.shape[1]
+        self.underlying._g = self._g
+        y, s = self.underlying.forward(params, state, x.reshape(b * t, -1),
+                                       training=training, rng=rng, mask=None)
+        return y.reshape(b, t, -1), s
+
+
+@register_layer
+@dataclasses.dataclass
+class Cropping1D(Layer):
+    """Crop timesteps from a (batch, time, size) input (reference
+    ``Cropping1D``)."""
+
+    crop_left: int = 0
+    crop_right: int = 0
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t = input_type.timesteps
+        return InputType.recurrent(
+            input_type.size,
+            None if t is None else t - self.crop_left - self.crop_right)
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        end = x.shape[1] - self.crop_right
+        return x[:, self.crop_left:end, :], state
+
+
+@register_layer
+@dataclasses.dataclass
+class ZeroPadding1DLayer(Layer):
+    """Zero-pad timesteps of a (batch, time, size) input (reference
+    ``ZeroPadding1DLayer``)."""
+
+    pad_left: int = 0
+    pad_right: int = 0
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t = input_type.timesteps
+        return InputType.recurrent(
+            input_type.size,
+            None if t is None else t + self.pad_left + self.pad_right)
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        return jnp.pad(x, ((0, 0), (self.pad_left, self.pad_right), (0, 0))), state

@@ -1,0 +1,107 @@
+"""TransformProcess reductions, joins, and sequence conversion."""
+
+import numpy as np
+
+from deeplearning4j_tpu.data.records import (Join, LocalTransformExecutor,
+                                             Reducer, ReduceOp, Schema,
+                                             TransformProcess)
+
+
+def _txn_schema():
+    return (Schema.builder()
+            .add_column_string("user")
+            .add_column_double("amount")
+            .add_column_integer("ts")
+            .build())
+
+
+_TXNS = [
+    ["alice", 10.0, 3],
+    ["bob", 5.0, 1],
+    ["alice", 20.0, 1],
+    ["bob", 7.0, 2],
+    ["alice", 30.0, 2],
+]
+
+
+def test_reducer_groupby():
+    schema = _txn_schema()
+    reducer = (Reducer.builder("user")
+               .sum_columns("amount").count_columns("ts")
+               .stdev_columns("amount").build())
+    tp = TransformProcess.builder(schema).reduce(reducer).build()
+    out = LocalTransformExecutor.execute(_TXNS, tp)
+    fs = tp.final_schema()
+    assert fs.names == ["user", "sum(amount)", "count(ts)", "stdev(amount)"]
+    rows = {r[0]: r for r in out}
+    assert rows["alice"][1] == 60.0
+    assert rows["alice"][2] == 3
+    assert rows["bob"][1] == 12.0
+    np.testing.assert_allclose(rows["alice"][3], np.std([10, 20, 30], ddof=1))
+
+
+def test_reduce_ops_first_last_range():
+    schema = _txn_schema()
+    reducer = (Reducer.builder("user")
+               .first_columns("amount").last_columns("amount")
+               .range_columns("amount").build())
+    out = Reducer.reduce(reducer, schema, _TXNS)
+    rows = {r[0]: r for r in out}
+    assert rows["alice"] == ["alice", 10.0, 30.0, 20.0]
+
+
+def test_join_inner_and_outer():
+    left_schema = (Schema.builder().add_column_string("user")
+                   .add_column_double("amount").build())
+    right_schema = (Schema.builder().add_column_string("user")
+                    .add_column_string("country").build())
+    left = [["alice", 10.0], ["bob", 5.0], ["carol", 7.0]]
+    right = [["alice", "US"], ["bob", "DE"], ["dave", "FR"]]
+
+    inner = (Join.builder("Inner").set_schemas(left_schema, right_schema)
+             .set_join_columns("user").build())
+    out = LocalTransformExecutor.execute_join(left, right, inner)
+    assert sorted(r[0] for r in out) == ["alice", "bob"]
+    assert inner.output_schema().names == ["user", "amount", "country"]
+
+    louter = (Join.builder("LeftOuter").set_schemas(left_schema, right_schema)
+              .set_join_columns("user").build())
+    out = LocalTransformExecutor.execute_join(left, right, louter)
+    rows = {r[0]: r for r in out}
+    assert rows["carol"][2] is None
+
+    fouter = (Join.builder("FullOuter").set_schemas(left_schema, right_schema)
+              .set_join_columns("user").build())
+    out = LocalTransformExecutor.execute_join(left, right, fouter)
+    users = sorted(r[0] for r in out)
+    assert users == ["alice", "bob", "carol", "dave"]
+    dave = [r for r in out if r[0] == "dave"][0]
+    assert dave[1] is None and dave[2] == "FR"
+
+
+def test_convert_to_sequence_and_offset():
+    schema = _txn_schema()
+    tp = (TransformProcess.builder(schema)
+          .convert_to_sequence("user", "ts")
+          .build())
+    seqs = LocalTransformExecutor.execute(_TXNS, tp)
+    assert len(seqs) == 2
+    alice = seqs[0]
+    assert [r[2] for r in alice] == [1, 2, 3]  # sorted by ts
+    assert [r[1] for r in alice] == [20.0, 30.0, 10.0]
+
+    # flat transform applied inside sequences after conversion
+    tp2 = (TransformProcess.builder(schema)
+           .convert_to_sequence("user", "ts")
+           .double_math_op("amount", "multiply", 2.0)
+           .build())
+    seqs2 = LocalTransformExecutor.execute(_TXNS, tp2)
+    assert [r[1] for r in seqs2[0]] == [40.0, 60.0, 20.0]
+
+    # offset: labels = next step's amount
+    tp3 = (TransformProcess.builder(schema)
+           .convert_to_sequence("user", "ts")
+           .offset_sequence(["amount"], 1)
+           .build())
+    seqs3 = LocalTransformExecutor.execute(_TXNS, tp3)
+    assert [r[1] for r in seqs3[0]] == [30.0, 10.0]  # shifted by one, trimmed

@@ -1,0 +1,50 @@
+"""FastText: subword embeddings (OOV composition) + supervised classifier."""
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp import FastText
+from deeplearning4j_tpu.nlp.fasttext import char_ngrams
+
+
+def test_char_ngrams_boundaries():
+    grams = char_ngrams("cat", 3, 4)
+    assert "<ca" in grams and "at>" in grams and "cat>" in grams
+    # whole-word gram "<cat>" excluded at n=5 (n >= len("<cat>"))
+    assert "<cat>" not in grams
+
+
+_CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "the quick red fox runs over the sleepy cat",
+    "a quick brown dog jumps over a lazy fox",
+    "cats and dogs run quick over the brown field",
+    "the lazy dog sleeps while the quick fox runs",
+] * 6
+
+
+def test_skipgram_subword_training_and_oov():
+    ft = FastText(dim=16, epochs=3, bucket=2000, seed=0, min_word_frequency=1,
+                  batch_size=256)
+    ft.fit(_CORPUS)
+    v = ft.get_word_vector("fox")
+    assert v.shape == (16,) and np.isfinite(v).all()
+    # OOV word gets a vector purely from n-gram buckets
+    oov = ft.get_word_vector("foxes")
+    assert oov.shape == (16,) and np.isfinite(oov).all()
+    # shared subwords make morphological neighbors similar
+    assert ft.similarity("fox", "foxes") > ft.similarity("fox", "sleeps")
+
+
+def test_supervised_classification():
+    texts = (["good great excellent wonderful amazing product"] * 10
+             + ["bad terrible awful horrible poor product"] * 10)
+    labels = ["pos"] * 10 + ["neg"] * 10
+    clf = FastText(supervised=True, dim=12, epochs=40, bucket=1000, seed=1,
+                   learning_rate=0.5)
+    clf.fit(texts, labels)
+    assert clf.predict("great wonderful amazing") == "pos"
+    assert clf.predict("terrible awful poor") == "neg"
+    probs = clf.predict_probability("good excellent product")
+    assert set(probs) == {"pos", "neg"}
+    assert abs(sum(probs.values()) - 1.0) < 1e-5
+    assert probs["pos"] > 0.5

@@ -1,0 +1,137 @@
+"""INDArray / Nd4j facade: factory, arithmetic, in-place rebind semantics,
+indexing, reductions, and jit composability."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ndarray import INDArray, Nd4j, NDArrayIndex
+
+
+def test_factories():
+    assert Nd4j.zeros(2, 3).shape() == (2, 3)
+    assert Nd4j.ones(4).sum().item() == 4.0
+    assert Nd4j.eye(3).get_double(1, 1) == 1.0
+    a = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+    assert a.shape() == (2, 2) and a.get_double(1, 0) == 3.0
+    # ints are a shape
+    assert Nd4j.create(2, 5).shape() == (2, 5)
+    assert Nd4j.linspace(0, 1, 5).length() == 5
+    assert Nd4j.value_array_of((2, 2), 7.0).mean().item() == 7.0
+    Nd4j.set_seed(12345)
+    r1 = Nd4j.rand(3, 3).numpy()
+    Nd4j.set_seed(12345)
+    r2 = Nd4j.rand(3, 3).numpy()
+    np.testing.assert_array_equal(r1, r2)
+
+
+def test_arithmetic_and_inplace_rebind():
+    a = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+    b = a.add(1.0)
+    assert b.get_double(0, 0) == 2.0
+    assert a.get_double(0, 0) == 1.0  # pure op didn't touch a
+    a.addi(10.0)
+    assert a.get_double(0, 0) == 11.0  # in-place rebinds the wrapper
+    a.subi(10.0).muli(2.0).divi(2.0)
+    assert a.get_double(0, 0) == 1.0
+    c = a.rsub(5.0)
+    assert c.get_double(0, 0) == 4.0
+    # operators
+    d = (a * 2.0 + 1.0 - a) / 1.0
+    assert d.get_double(0, 0) == 2.0
+    assert (-a).get_double(0, 1) == -2.0
+
+
+def test_mmul_gemm():
+    a = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+    b = Nd4j.eye(2)
+    np.testing.assert_allclose(a.mmul(b).numpy(), a.numpy())
+    g = Nd4j.gemm(a, a, transpose_b=True)
+    np.testing.assert_allclose(g.numpy(), a.numpy() @ a.numpy().T)
+    assert (a @ b).equals(a)
+
+
+def test_row_column_vectors():
+    a = Nd4j.zeros(3, 4)
+    out = a.add_row_vector(Nd4j.create([1.0, 2.0, 3.0, 4.0]))
+    np.testing.assert_allclose(out.numpy()[2], [1, 2, 3, 4])
+    out2 = a.add_column_vector(Nd4j.create([1.0, 2.0, 3.0]))
+    np.testing.assert_allclose(out2.numpy()[:, 0], [1, 2, 3])
+
+
+def test_reductions():
+    a = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+    assert a.sum().item() == 10.0
+    np.testing.assert_allclose(a.sum(0).numpy(), [4.0, 6.0])
+    np.testing.assert_allclose(a.mean(1).numpy(), [1.5, 3.5])
+    assert a.max().item() == 4.0
+    assert a.arg_max(1).numpy().tolist() == [1, 1]
+    assert abs(a.norm2().item() - np.sqrt(30)) < 1e-5
+    assert a.std().item() == pytest.approx(np.std([1, 2, 3, 4], ddof=1))
+
+
+def test_indexing_get_put():
+    a = Nd4j.arange(12).reshape(3, 4)
+    sub = a.get(NDArrayIndex.interval(0, 2), NDArrayIndex.point(1))
+    np.testing.assert_allclose(sub.numpy(), [1.0, 5.0])
+    a.put_scalar((0, 0), 99.0)
+    assert a.get_double(0, 0) == 99.0
+    a.put_row(1, Nd4j.create([9.0, 9.0, 9.0, 9.0]))
+    np.testing.assert_allclose(a.get_row(1).numpy(), [9, 9, 9, 9])
+    a.put((NDArrayIndex.all(), NDArrayIndex.point(3)), Nd4j.create([7.0, 7.0, 7.0]))
+    np.testing.assert_allclose(a.get_column(3).numpy(), [7, 7, 7])
+    # functional: slices are copies, mutating the copy leaves parent intact
+    row = a.get_row(0)
+    row.addi(100.0)
+    assert a.get_double(0, 1) != row.get_double(1)
+
+
+def test_shape_ops():
+    a = Nd4j.arange(24).reshape(2, 3, 4)
+    assert a.permute(2, 0, 1).shape() == (4, 2, 3)
+    assert a.swap_axes(0, 2).shape() == (4, 3, 2)
+    assert a.ravel().shape() == (24,)
+    assert a.slice(1).shape() == (3, 4)
+    t = a.tensor_along_dimension(0, 1, 2)
+    assert t.shape() == (3, 4)
+    np.testing.assert_allclose(t.numpy(), a.numpy()[0])
+
+
+def test_concat_stack_io(tmp_path):
+    a, b = Nd4j.ones(2, 2), Nd4j.zeros(2, 2)
+    assert Nd4j.vstack(a, b).shape() == (4, 2)
+    assert Nd4j.hstack(a, b).shape() == (2, 4)
+    assert Nd4j.concat(1, a, b).shape() == (2, 4)
+    assert Nd4j.stack(0, a, b).shape() == (2, 2, 2)
+    assert Nd4j.to_flattened(a, b).length() == 8
+    p = str(tmp_path / "arr")
+    Nd4j.write(a, p)
+    back = Nd4j.read(p)
+    assert back.equals(a)
+
+
+def test_comparisons_where_sort():
+    a = Nd4j.create([3.0, 1.0, 2.0])
+    assert a.gt(1.5).numpy().tolist() == [True, False, True]
+    w = Nd4j.where(a.gt(1.5), a, Nd4j.zeros(3))
+    np.testing.assert_allclose(w.numpy(), [3.0, 0.0, 2.0])
+    np.testing.assert_allclose(Nd4j.sort(a).numpy(), [1.0, 2.0, 3.0])
+    np.testing.assert_allclose(Nd4j.sort(a, ascending=False).numpy(), [3.0, 2.0, 1.0])
+
+
+def test_jit_composability():
+    """INDArray methods trace under jit — the facade never blocks compile."""
+    import jax
+
+    @jax.jit
+    def f(x):
+        a = INDArray(x)
+        return a.mul(2.0).add(1.0).sum().array
+
+    out = f(np.ones((4, 4), np.float32))
+    assert float(out) == 4 * 4 * 2 + 16
+
+
+def test_exec_named_op():
+    a = Nd4j.create([[1.0, -2.0]])
+    out = Nd4j.exec("relu", a)
+    np.testing.assert_allclose(out.numpy(), [[1.0, 0.0]])

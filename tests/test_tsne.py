@@ -1,0 +1,40 @@
+"""Exact t-SNE (BarnesHutTsne API parity): cluster separation + file output."""
+
+import numpy as np
+
+from deeplearning4j_tpu.plot import BarnesHutTsne
+
+
+def _three_clusters(n_per=25, d=10, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = np.array([[8.0] + [0] * (d - 1),
+                        [0] * (d - 1) + [8.0],
+                        [-8.0] + [0] * (d - 1)])
+    x = np.concatenate([c + rng.normal(0, 0.5, (n_per, d)) for c in centers])
+    labels = np.repeat([0, 1, 2], n_per)
+    return x.astype(np.float32), labels
+
+
+def test_tsne_separates_clusters(tmp_path):
+    x, labels = _three_clusters()
+    tsne = (BarnesHutTsne.builder().set_max_iter(300).perplexity(15.0)
+            .learning_rate(100.0).num_dimension(2).seed(3).build())
+    y = tsne.fit(x)
+    assert y.shape == (75, 2)
+    assert np.isfinite(y).all()
+
+    # intra-cluster distances should be much smaller than inter-cluster
+    def mean_dist(a, b):
+        return np.linalg.norm(a[:, None] - b[None, :], axis=-1).mean()
+
+    intra = np.mean([mean_dist(y[labels == k], y[labels == k]) for k in range(3)])
+    inter = np.mean([mean_dist(y[labels == 0], y[labels == 1]),
+                     mean_dist(y[labels == 1], y[labels == 2]),
+                     mean_dist(y[labels == 0], y[labels == 2])])
+    assert inter > 2.0 * intra
+
+    out = tmp_path / "tsne.csv"
+    tsne.save_as_file(labels, str(out))
+    lines = out.read_text().strip().splitlines()
+    assert len(lines) == 75
+    assert lines[0].count(",") == 2  # x,y,label
