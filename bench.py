@@ -29,7 +29,9 @@ def main():
     get_environment().allow_bfloat16()  # bf16 activations on the MXU
 
     on_cpu = jax.devices()[0].platform == "cpu"
-    batch = 8 if on_cpu else 128
+    # batch 256 is the v5e sweet spot (measured: 992 img/s @128, 2347 @256,
+    # 1611 @512 — HBM pressure past 256)
+    batch = 8 if on_cpu else 256
     size = 64 if on_cpu else 224
     steps = 3 if on_cpu else 20
 
@@ -44,15 +46,24 @@ def main():
     key = jax.random.PRNGKey(0)
     ts = net.train_state
 
-    # warmup / compile
-    ts, loss = step_fn(ts, {"input": x}, [y], key, None)
-    jax.block_until_ready(loss)
+    # warmup / compile, then DRAIN via host readback: through remote-device
+    # tunnels (axon) block_until_ready can return before execution finishes,
+    # so only a value transfer is a true synchronization point. The first few
+    # post-compile executions are slow (device-side warmup) — run several.
+    for i in range(6):
+        ts, loss = step_fn(ts, {"input": x}, [y], jax.random.fold_in(key, 1000 + i), None)
+        _ = float(loss)
+
+    _ = float(jnp.zeros(()))  # warm the readback program (first call compiles)
+    t0 = time.perf_counter()
+    _ = float(jnp.zeros(()))
+    latency = time.perf_counter() - t0  # host->device->host round trip
 
     t0 = time.perf_counter()
     for i in range(steps):
         ts, loss = step_fn(ts, {"input": x}, [y], jax.random.fold_in(key, i), None)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
+    _ = float(loss)  # drain the queue
+    dt = max(time.perf_counter() - t0 - latency, 1e-9)
 
     imgs_per_sec = batch * steps / dt
     baseline = None

@@ -26,6 +26,9 @@ from deeplearning4j_tpu.data.iterators import DataSetIterator
 
 
 # --------------------------------------------------------------- transforms
+_DEFAULT_RNG = np.random.default_rng(0)
+
+
 class ImageTransform:
     """SPI: ``transform(image, rng) -> image`` on one HWC float array."""
 
@@ -33,7 +36,10 @@ class ImageTransform:
         raise NotImplementedError
 
     def __call__(self, image, rng=None):
-        return self.transform(image, rng or np.random.default_rng(0))
+        # shared stateful generator: deterministic across runs, but DIFFERENT
+        # per call (a fresh default_rng(0) per call would repeat the same
+        # "random" decision for every image)
+        return self.transform(image, rng if rng is not None else _DEFAULT_RNG)
 
 
 class CropImageTransform(ImageTransform):

@@ -687,8 +687,16 @@ class Join:
 
     def output_schema(self) -> Schema:
         cols = [dataclasses.replace(c) for c in self.left_schema.columns]
-        cols += [dataclasses.replace(c) for c in self.right_schema.columns
-                 if c.name not in self.join_columns]
+        left_names = {c.name for c in cols}
+        for c in self.right_schema.columns:
+            if c.name in self.join_columns:
+                continue
+            if c.name in left_names:
+                raise ValueError(
+                    f"Join would produce duplicate column {c.name!r}; rename "
+                    f"it on one side first (name-based addressing would "
+                    f"silently resolve to the left column)")
+            cols.append(dataclasses.replace(c))
         return Schema(cols)
 
     def execute(self, left: List[List[Any]], right: List[List[Any]]

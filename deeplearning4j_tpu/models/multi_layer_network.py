@@ -176,6 +176,9 @@ class MultiLayerNetwork:
                 x, s_new = layer.forward(p, s, x, training=training, rng=lrng, mask=fmask)
                 if s:
                     new_state[k] = s_new
+            if fmask is not None and hasattr(layer, "transform_mask"):
+                # layers that change the time axis (crop/pad) realign the mask
+                fmask = layer.transform_mask(fmask)
         return x, last_input, new_state, new_carries
 
     def _loss(self, params, model_state, x, y, rng, fmask=None, lmask=None,

@@ -61,7 +61,11 @@ class ElementWiseMultiplicationLayer(Layer):
         return input_type
 
     def init(self, key, input_type, g: GlobalConfig):
-        n = self.n_out or input_type.size
+        n = input_type.size
+        if self.n_out and self.n_out != n:
+            raise ValueError(
+                f"ElementWiseMultiplicationLayer requires nIn == nOut "
+                f"(got input size {n}, n_out {self.n_out})")
         return {"W": init_weights(key, (n,), self._winit(g), fan=(n, n),
                                   dtype=g.dtype),
                 "b": jnp.full((n,), self._binit(g), dtype=g.dtype)}, {}
@@ -176,12 +180,20 @@ class Cropping1D(Layer):
         end = x.shape[1] - self.crop_right
         return x[:, self.crop_left:end, :], state
 
+    def transform_mask(self, mask):
+        if mask is None:
+            return None
+        end = mask.shape[1] - self.crop_right
+        return mask[:, self.crop_left:end]
+
 
 @register_layer
 @dataclasses.dataclass
 class ZeroPadding1DLayer(Layer):
     """Zero-pad timesteps of a (batch, time, size) input (reference
-    ``ZeroPadding1DLayer``)."""
+    ``ZeroPadding1DLayer``). Padded timesteps count as valid data (zeros),
+    so the mask is padded with ones — matching the reference, where padding
+    layers extend the data, not the invalid region."""
 
     pad_left: int = 0
     pad_right: int = 0
@@ -194,3 +206,9 @@ class ZeroPadding1DLayer(Layer):
 
     def forward(self, params, state, x, *, training=False, rng=None, mask=None):
         return jnp.pad(x, ((0, 0), (self.pad_left, self.pad_right), (0, 0))), state
+
+    def transform_mask(self, mask):
+        if mask is None:
+            return None
+        return jnp.pad(mask, ((0, 0), (self.pad_left, self.pad_right)),
+                       constant_values=1.0)

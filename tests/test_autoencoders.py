@@ -143,6 +143,22 @@ def test_mask_zero_and_time_distributed():
     np.testing.assert_allclose(out[0, 3], expect, atol=1e-5)
 
 
+def test_pad_crop_realign_mask_for_recurrent():
+    """Crop/pad layers that change the time axis must realign the feature
+    mask before it reaches a downstream recurrent layer."""
+    from deeplearning4j_tpu.nn import LSTM, RnnOutputLayer
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2)).list()
+            .layer(ZeroPadding1DLayer(pad_left=2, pad_right=1))
+            .layer(LSTM(n_out=6))
+            .layer(RnnOutputLayer(n_out=2, activation="softmax"))
+            .set_input_type(InputType.recurrent(3, 4)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(0).normal(0, 1, (2, 4, 3)).astype(np.float32)
+    mask = np.array([[1, 1, 0, 0], [1, 1, 1, 1]], np.float32)
+    out = np.asarray(net.output(x, mask=mask))  # must not raise scan-shape error
+    assert out.shape == (2, 7, 2)
+
+
 def test_repeat_vector():
     import jax.numpy as jnp
     layer = RepeatVector(n=4)
