@@ -257,16 +257,20 @@ class MultiLayerNetwork:
         return self._jit_cache[name]
 
     # ------------------------------------------------------------------- fit
-    def fit(self, data, labels=None, epochs: int = 1, mask=None) -> "MultiLayerNetwork":
-        """``fit(iterator)``, ``fit(iterator, epochs=N)`` or ``fit(x, y)``
-        (reference overloads)."""
+    def fit(self, data, labels=None, epochs: int = 1, mask=None,
+            labels_mask=None) -> "MultiLayerNetwork":
+        """``fit(iterator)``, ``fit(iterator, epochs=N)`` or
+        ``fit(x, y[, mask, labels_mask])`` (reference overloads —
+        ``fit(features, labels, featuresMask, labelsMask)``). ``mask`` is the
+        FEATURES mask; the labels mask defaults to it propagated through any
+        time-axis-changing layers."""
         if self.train_state is None:
             self.init()
         if labels is not None:
             from deeplearning4j_tpu.data.dataset import DataSet
             from deeplearning4j_tpu.data.iterators import ListDataSetIterator
-            ds = DataSet(np.asarray(data), np.asarray(labels), features_mask=None,
-                         labels_mask=mask)
+            ds = DataSet(np.asarray(data), np.asarray(labels), features_mask=mask,
+                         labels_mask=labels_mask)
             iterator = ListDataSetIterator([ds], batch_size=len(ds))
         else:
             iterator = data
@@ -281,7 +285,7 @@ class MultiLayerNetwork:
                 # labels mask defaults to the features mask only for
                 # per-timestep labels (reference tBPTT/masking semantics)
                 lm = jnp.asarray(batch.labels_mask) if batch.labels_mask is not None \
-                    else (fm if y.ndim == 3 else None)
+                    else (self._output_time_mask(fm) if y.ndim == 3 else None)
                 if self.conf.tbptt_fwd_length and x.ndim == 3:
                     self._fit_tbptt(x, y, fm, lm)
                     continue
@@ -384,6 +388,18 @@ class MultiLayerNetwork:
         self.train_state = dataclasses.replace(self.train_state, params=new_params)
         return self
 
+    def _output_time_mask(self, fmask):
+        """Features mask propagated through every time-axis-changing layer
+        (crop/pad/upsample/strided conv): the default LABELS mask must align
+        with the network OUTPUT's time axis, not the input's."""
+        if fmask is None:
+            return None
+        m = fmask
+        for layer in self.layers:
+            if hasattr(layer, "transform_mask"):
+                m = layer.transform_mask(m)
+        return m
+
     def _zero_carries(self, batch: int, dtype) -> Dict[str, Any]:
         carries = {}
         for i, layer in enumerate(self.layers):
@@ -431,7 +447,7 @@ class MultiLayerNetwork:
         x, y = jnp.asarray(dataset.features), jnp.asarray(dataset.labels)
         fm = None if dataset.features_mask is None else jnp.asarray(dataset.features_mask)
         lm = jnp.asarray(dataset.labels_mask) if dataset.labels_mask is not None \
-            else (fm if y.ndim == 3 else None)
+            else (self._output_time_mask(fm) if y.ndim == 3 else None)
 
         def score_fn(params, model_state, x_, y_, fm_, lm_):
             loss, _ = self._loss(params, model_state, x_, y_, None, fm_, lm_,
@@ -450,7 +466,9 @@ class MultiLayerNetwork:
         iterator.reset()
         for batch in iterator:
             out = self.output(batch.features, mask=batch.features_mask)
-            m = batch.labels_mask if batch.labels_mask is not None else batch.features_mask
+            m = batch.labels_mask if batch.labels_mask is not None else (
+                None if batch.features_mask is None
+                else np.asarray(self._output_time_mask(jnp.asarray(batch.features_mask))))
             ev.eval(np.asarray(batch.labels), np.asarray(out),
                     mask=None if m is None else np.asarray(m))
         return ev

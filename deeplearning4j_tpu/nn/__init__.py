@@ -26,6 +26,7 @@ from deeplearning4j_tpu.nn.core_layers import (
 )
 from deeplearning4j_tpu.nn.conv_layers import (
     BatchNormalization,
+    Convolution1DLayer,
     ConvolutionLayer,
     Deconvolution2D,
     GlobalPoolingLayer,
@@ -94,6 +95,7 @@ __all__ = [
     "EmbeddingLayer",
     "EmbeddingSequenceLayer",
     "ConvolutionLayer",
+    "Convolution1DLayer",
     "SubsamplingLayer",
     "PoolingType",
     "BatchNormalization",

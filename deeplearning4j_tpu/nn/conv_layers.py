@@ -139,6 +139,18 @@ class Convolution1DLayer(ConvolutionLayer):
             y = y + params["b"]
         return get_activation(self._act(self._g))(y), state
 
+    def transform_mask(self, mask):
+        """Reduce the (batch, time) mask with the conv's own geometry: an
+        output step is valid if ANY input step in its window is (the
+        reference's cnn1d mask reduction — max-pool with identical k/s/p)."""
+        if mask is None:
+            return None
+        k, s, p, d, same = self._geom1d()
+        eff = (k - 1) * d + 1
+        padding = "SAME" if same else [(0, 0), (p, p)]
+        return lax.reduce_window(mask.astype(jnp.float32), 0.0, lax.max,
+                                 (1, eff), (1, s), padding)
+
 
 @register_layer
 @dataclasses.dataclass

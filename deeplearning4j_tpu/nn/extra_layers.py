@@ -116,6 +116,9 @@ class Upsampling1D(Layer):
     def forward(self, params, state, x, *, training=False, rng=None, mask=None):
         return jnp.repeat(x, self.size, axis=1), state
 
+    def transform_mask(self, mask):
+        return None if mask is None else jnp.repeat(mask, self.size, axis=1)
+
 
 @register_layer
 @dataclasses.dataclass

@@ -157,6 +157,20 @@ def test_pad_crop_realign_mask_for_recurrent():
     mask = np.array([[1, 1, 0, 0], [1, 1, 1, 1]], np.float32)
     out = np.asarray(net.output(x, mask=mask))  # must not raise scan-shape error
     assert out.shape == (2, 7, 2)
+    # training path: default labels mask must align with the OUTPUT time axis
+    y = np.tile(np.eye(2, dtype=np.float32)[[0, 1]][:, None, :], (1, 7, 1))
+    net.fit(x, y, mask=mask, epochs=1)
+
+    # upsampling also realigns the mask
+    from deeplearning4j_tpu.nn import Upsampling1D
+    conf2 = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2)).list()
+             .layer(Upsampling1D(size=2))
+             .layer(LSTM(n_out=4))
+             .layer(RnnOutputLayer(n_out=2, activation="softmax"))
+             .set_input_type(InputType.recurrent(3, 4)).build())
+    net2 = MultiLayerNetwork(conf2).init()
+    out2 = np.asarray(net2.output(x, mask=mask))
+    assert out2.shape == (2, 8, 2)
 
 
 def test_repeat_vector():

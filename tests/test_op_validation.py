@@ -1,0 +1,234 @@
+"""OpValidation harness (reference ``org.nd4j.autodiff.validation.OpValidation``):
+
+every op in the registry gets a forward check against a numpy/jax oracle on a
+concrete input, and — for floating-point-differentiable ops — a gradient
+check against central finite differences. The final test FAILS if an op is
+registered but has no validation case, so coverage is enforced the same way
+the reference tracks op-test coverage.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.autodiff.ops_registry import OPS, get_op
+
+_R = np.random.default_rng(0)
+_A = _R.normal(0, 1, (3, 4)).astype(np.float32)
+_B = _R.normal(0, 1, (3, 4)).astype(np.float32)
+_P = np.abs(_A) + 0.5  # strictly positive
+_U = _R.uniform(0.05, 0.95, (3, 4)).astype(np.float32)  # in (0,1)
+_M = _R.normal(0, 1, (4, 5)).astype(np.float32)
+_IMG = _R.normal(0, 1, (2, 8, 8, 3)).astype(np.float32)
+_KER = _R.normal(0, 0.3, (3, 3, 3, 5)).astype(np.float32)
+_IDX = np.array([2, 0, 1], np.int32)
+_LOGITS = _R.normal(0, 1, (4, 6)).astype(np.float32)
+_LABELS = np.eye(6, dtype=np.float32)[[1, 3, 0, 5]]
+
+
+def _np(fn):
+    """Tag: oracle is a plain callable on the same args."""
+    return fn
+
+
+# op name -> (args, kwargs, oracle or None, grad_args_indices)
+# oracle None = structural check only (shape/dtype/finite)
+CASES = {
+    # elementwise binary
+    "add": ((_A, _B), {}, lambda a, b: a + b, (0, 1)),
+    "sub": ((_A, _B), {}, lambda a, b: a - b, (0, 1)),
+    "mul": ((_A, _B), {}, lambda a, b: a * b, (0, 1)),
+    "div": ((_A, _P), {}, lambda a, b: a / b, (0, 1)),
+    "pow": ((_P, 2.0), {}, lambda a, b: a ** b, (0,)),
+    "mod": ((_A, _P), {}, lambda a, b: np.mod(a, b), ()),
+    "floordiv": ((_A, _P), {}, lambda a, b: np.floor_divide(a, b), ()),
+    "maximum": ((_A, _B), {}, np.maximum, (0, 1)),
+    "minimum": ((_A, _B), {}, np.minimum, (0, 1)),
+    "squared_difference": ((_A, _B), {}, lambda a, b: (a - b) ** 2, (0, 1)),
+    # elementwise unary
+    "abs": ((_A,), {}, np.abs, ()),
+    "neg": ((_A,), {}, lambda a: -a, (0,)),
+    "exp": ((_A,), {}, np.exp, (0,)),
+    "log": ((_P,), {}, np.log, (0,)),
+    "log1p": ((_P,), {}, np.log1p, (0,)),
+    "sqrt": ((_P,), {}, np.sqrt, (0,)),
+    "rsqrt": ((_P,), {}, lambda a: 1 / np.sqrt(a), (0,)),
+    "square": ((_A,), {}, np.square, (0,)),
+    "reciprocal": ((_P,), {}, lambda a: 1 / a, (0,)),
+    "sign": ((_A,), {}, np.sign, ()),
+    "floor": ((_A,), {}, np.floor, ()),
+    "ceil": ((_A,), {}, np.ceil, ()),
+    "round": ((_A,), {}, np.round, ()),
+    "sin": ((_A,), {}, np.sin, (0,)),
+    "cos": ((_A,), {}, np.cos, (0,)),
+    "tan": ((_A,), {}, np.tan, (0,)),
+    "asin": ((_U,), {}, np.arcsin, (0,)),
+    "acos": ((_U,), {}, np.arccos, (0,)),
+    "atan": ((_A,), {}, np.arctan, (0,)),
+    "sinh": ((_A,), {}, np.sinh, (0,)),
+    "cosh": ((_A,), {}, np.cosh, (0,)),
+    "tanh": ((_A,), {}, np.tanh, (0,)),
+    "erf": ((_A,), {}, None, (0,)),
+    "identity": ((_A,), {}, lambda a: a, (0,)),
+    "stop_gradient": ((_A,), {}, lambda a: a, ()),
+    "clip_by_value": ((_A, -0.5, 0.5), {}, lambda a, lo, hi: np.clip(a, lo, hi), ()),
+    # activations
+    "relu": ((_A,), {}, lambda a: np.maximum(a, 0), ()),
+    "relu6": ((_A,), {}, lambda a: np.clip(a, 0, 6), ()),
+    "leaky_relu": ((_A,), {}, None, ()),
+    "elu": ((_A,), {}, None, (0,)),
+    "selu": ((_A,), {}, None, (0,)),
+    "gelu": ((_A,), {}, None, (0,)),
+    "swish": ((_A,), {}, lambda a: a / (1 + np.exp(-a)), (0,)),
+    "mish": ((_A,), {}, None, (0,)),
+    "sigmoid": ((_A,), {}, lambda a: 1 / (1 + np.exp(-a)), (0,)),
+    "hard_sigmoid": ((_A,), {}, None, ()),
+    "softplus": ((_A,), {}, lambda a: np.log1p(np.exp(a)), (0,)),
+    "softsign": ((_A,), {}, lambda a: a / (1 + np.abs(a)), (0,)),
+    "softmax": ((_LOGITS,), {}, lambda a: np.exp(a) / np.exp(a).sum(-1, keepdims=True), (0,)),
+    "log_softmax": ((_LOGITS,), {}, None, (0,)),
+    "logsumexp": ((_LOGITS,), {"axis": -1}, None, (0,)),
+    # comparisons / logical
+    "eq": ((_A, _A), {}, lambda a, b: a == b, ()),
+    "neq": ((_A, _B), {}, lambda a, b: a != b, ()),
+    "lt": ((_A, _B), {}, lambda a, b: a < b, ()),
+    "lte": ((_A, _B), {}, lambda a, b: a <= b, ()),
+    "gt": ((_A, _B), {}, lambda a, b: a > b, ()),
+    "gte": ((_A, _B), {}, lambda a, b: a >= b, ()),
+    "logical_and": ((_A > 0, _B > 0), {}, np.logical_and, ()),
+    "logical_or": ((_A > 0, _B > 0), {}, np.logical_or, ()),
+    "logical_not": ((_A > 0,), {}, np.logical_not, ()),
+    "where": ((_A > 0, _A, _B), {}, np.where, ()),
+    # reductions
+    "reduce_sum": ((_A,), {"axis": 1}, lambda a: a.sum(1), (0,)),
+    "reduce_mean": ((_A,), {"axis": 0}, lambda a: a.mean(0), (0,)),
+    "reduce_max": ((_A,), {"axis": 1}, lambda a: a.max(1), ()),
+    "reduce_min": ((_A,), {"axis": 1}, lambda a: a.min(1), ()),
+    "reduce_prod": ((_A,), {"axis": 1}, lambda a: a.prod(1), (0,)),
+    "reduce_std": ((_A,), {"axis": 1}, None, ()),
+    "reduce_var": ((_A,), {"axis": 1}, None, ()),
+    "norm2": ((_A,), {}, lambda a: np.sqrt((a * a).sum()), (0,)),
+    "argmax": ((_A,), {"axis": 1}, lambda a: a.argmax(1), ()),
+    "argmin": ((_A,), {"axis": 1}, lambda a: a.argmin(1), ()),
+    "cumsum": ((_A,), {"axis": 1}, lambda a: a.cumsum(1), (0,)),
+    # linalg
+    "matmul": ((_A, _M), {}, lambda a, b: a @ b, (0, 1)),
+    "dot": ((_A[0], _B[0]), {}, np.dot, (0, 1)),
+    "batch_matmul": ((np.stack([_A, _B]), np.stack([_M, _M])), {},
+                     lambda a, b: a @ b, (0, 1)),
+    "tensordot": ((_A, _M), {"axes": 1}, lambda a, b: np.tensordot(a, b, 1), ()),
+    "outer": ((_A[0], _B[0]), {}, np.outer, (0, 1)),
+    "linear": ((_A, _M, np.zeros(5, np.float32)), {}, lambda x, w, b: x @ w + b, (0, 1)),
+    "bias_add": ((_A, np.ones(4, np.float32)), {}, lambda a, b: a + b, (0, 1)),
+    "l2_normalize": ((_A,), {"axis": None}, lambda a: a / np.linalg.norm(a.ravel()), ()),
+    # shape ops
+    "reshape": ((_A, (4, 3)), {}, lambda a, s: a.reshape(s), ()),
+    "transpose": ((_A,), {}, lambda a: a.T, ()),
+    "expand_dims": ((_A,), {"axis": 0}, lambda a: a[None], ()),
+    "squeeze": ((_A[None],), {"axis": 0}, lambda a: a[0], ()),
+    "flatten2d": ((_IMG,), {}, lambda a: a.reshape(2, -1), ()),
+    "concat": ((_A, _B), {"axis": 0}, lambda *xs: np.concatenate(xs, 0), ()),
+    "stack": ((_A, _B), {"axis": 0}, lambda *xs: np.stack(xs, 0), ()),
+    "unstack": ((_A,), {"axis": 0}, None, ()),
+    "split": ((_A,), {"num_splits": 2, "axis": 1}, None, ()),
+    "tile": ((_A, (2, 1)), {}, lambda a, r: np.tile(a, r), ()),
+    "reverse": ((_A,), {"axis": 1}, lambda a: a[:, ::-1], ()),
+    "slice": ((_A, (0, 1), (2, 3)), {}, None, ()),
+    "strided_slice": ((_A,), {"begin": (0, 0), "end": (2, 4), "strides": (1, 2)}, None, ()),
+    "pad": ((_A,), {"paddings": ((1, 1), (0, 0))}, lambda a: np.pad(a, ((1, 1), (0, 0))), ()),
+    "gather": ((_A, _IDX), {"axis": 0}, lambda a, i: a[i], ()),
+    "gather_nd": ((_A, np.array([[0, 1], [2, 3]], np.int32)), {}, None, ()),
+    "scatter_update": ((_A, np.array([0], np.int32), _B[:1]), {}, None, ()),
+    "one_hot": ((_IDX, 4), {}, lambda i, n: np.eye(n, dtype=np.float32)[i], ()),
+    # structural / creation
+    "shape_of": ((_A,), {}, lambda a: np.asarray(a.shape), ()),
+    "size": ((_A,), {}, lambda a: np.asarray(a.size), ()),
+    "rank": ((_A,), {}, lambda a: np.asarray(a.ndim), ()),
+    "zeros_like": ((_A,), {}, np.zeros_like, ()),
+    "ones_like": ((_A,), {}, np.ones_like, ()),
+    "fill": (((2, 3), 7.0), {}, lambda s, v: np.full(s, v, np.float32), ()),
+    "range": ((0, 10, 2), {}, lambda a, b, s: np.arange(a, b, s), ()),
+    "linspace": ((0.0, 1.0, 5), {}, lambda a, b, n: np.linspace(a, b, n), ()),
+    "cast": ((_A,), {"dtype": "int32"}, lambda a: a.astype(np.int32), ()),
+    # nn
+    "conv2d": ((_IMG, _KER), {"stride": (1, 1), "padding": "SAME"}, None, (0, 1)),
+    "max_pool2d": ((_IMG,), {"kernel": (2, 2), "stride": (2, 2)}, None, ()),
+    "avg_pool2d": ((_IMG,), {"kernel": (2, 2), "stride": (2, 2)}, None, (0,)),
+    "batch_norm": ((_IMG, np.zeros(3, np.float32), np.ones(3, np.float32),
+                    np.ones(3, np.float32), np.zeros(3, np.float32)), {}, None, ()),
+    "layer_norm": ((_A, np.ones(4, np.float32), np.zeros(4, np.float32)), {}, None, (0,)),
+    "dropout": ((_A,), {"key": jax.random.PRNGKey(0), "rate": 0.5}, None, ()),
+    "multi_head_dot_product_attention": (
+        (_R.normal(0, 1, (2, 4, 6, 8)).astype(np.float32),) * 3,
+        {}, None, (0, 1, 2)),
+    # losses
+    "mean_squared_error": ((_LABELS, _LOGITS), {}, None, (1,)),
+    "mean_absolute_error": ((_LABELS, _LOGITS), {}, None, ()),
+    "softmax_cross_entropy": ((_LABELS, _LOGITS), {}, None, (1,)),
+    "sparse_softmax_cross_entropy": ((np.array([1, 3, 0, 5], np.int32), _LOGITS),
+                                     {}, None, (1,)),
+    "sigmoid_cross_entropy": (((_LABELS > 0).astype(np.float32), _LOGITS), {}, None, (1,)),
+    "log_loss": ((_U, _U), {}, None, ()),
+    "hinge_loss": (((_LABELS * 2 - 1), _LOGITS), {}, None, (1,)),
+    "huber_loss": ((_LABELS, _LOGITS), {}, None, (1,)),
+    "l2_loss": ((_A,), {}, lambda a: 0.5 * (a * a).sum(), (0,)),
+    "cosine_distance": ((_A, _B), {}, None, (0, 1)),
+}
+
+
+def test_registry_fully_covered():
+    """Every registered op must have a validation case (coverage tracking,
+    the reference OpValidation's core feature)."""
+    missing = sorted(set(OPS) - set(CASES))
+    extra = sorted(set(CASES) - set(OPS))
+    assert not missing, f"ops registered but not validated: {missing}"
+    assert not extra, f"validation cases for unregistered ops: {extra}"
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_op_forward(name):
+    args, kwargs, oracle, _ = CASES[name]
+    out = get_op(name)(*[jnp.asarray(a) if isinstance(a, np.ndarray) else a
+                         for a in args], **kwargs)
+    outs = out if isinstance(out, (tuple, list)) else (out,)
+    for o in outs:
+        o = np.asarray(o)
+        assert np.isfinite(o.astype(np.float64)).all() if o.dtype.kind == "f" else True
+    if oracle is not None:
+        expect = oracle(*args)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                                   rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("name", sorted(n for n, c in CASES.items() if c[3]))
+def test_op_gradient(name):
+    """Analytic gradient vs central finite differences (eps=1e-3 on f32),
+    the reference GradCheckUtil contract."""
+    args, kwargs, _, grad_idx = CASES[name]
+
+    def scalar_fn(*diff_args):
+        full = list(args)
+        for i, a in zip(grad_idx, diff_args):
+            full[i] = a
+        out = get_op(name)(*[jnp.asarray(a) if isinstance(a, np.ndarray) else a
+                             for a in full], **kwargs)
+        return jnp.sum(jnp.asarray(out, jnp.float32) ** 2 / 2)
+
+    diff_args = [jnp.asarray(args[i]) for i in grad_idx]
+    grads = jax.grad(scalar_fn, argnums=tuple(range(len(diff_args))))(*diff_args)
+    eps = 1e-2
+    for gi, (arr, g) in enumerate(zip(diff_args, grads)):
+        flat = np.asarray(arr).ravel()
+        g = np.asarray(g).ravel()
+        # spot-check a few coordinates (full FD over every element is slow)
+        for j in np.linspace(0, flat.size - 1, min(4, flat.size)).astype(int):
+            e = np.zeros_like(flat)
+            e[j] = eps
+            up = [a if k != gi else jnp.asarray((flat + e).reshape(np.asarray(arr).shape))
+                  for k, a in enumerate(diff_args)]
+            dn = [a if k != gi else jnp.asarray((flat - e).reshape(np.asarray(arr).shape))
+                  for k, a in enumerate(diff_args)]
+            fd = (float(scalar_fn(*up)) - float(scalar_fn(*dn))) / (2 * eps)
+            assert abs(fd - g[j]) <= 2e-2 * max(1.0, abs(fd), abs(g[j])), \
+                f"{name} grad arg{gi}[{j}]: analytic {g[j]:.5f} vs fd {fd:.5f}"
