@@ -195,6 +195,14 @@ class MultiLayerNetwork:
         loss = final.compute_loss(final_p, last_in, y, mask=lmask,
                                   state=model_state.get(k, {}))
         loss = loss + self._reg_score(params)
+        # differentiable auxiliary losses surfaced by layers through the
+        # state channel (e.g. MoE load balancing) — same trace, so grads
+        # flow. Training-only: score() reports the data loss, not training
+        # regularizers.
+        if training:
+            for s2 in new_state.values():
+                if isinstance(s2, dict) and "_aux_loss" in s2:
+                    loss = loss + s2["_aux_loss"]
         if training and hasattr(final, "update_state_with_labels"):
             new_state = dict(new_state)
             new_state[k] = final.update_state_with_labels(

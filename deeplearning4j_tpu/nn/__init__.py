@@ -68,6 +68,7 @@ from deeplearning4j_tpu.nn.autoencoder_layers import (
     AutoEncoder,
     VariationalAutoencoder,
 )
+from deeplearning4j_tpu.nn.moe_layers import MixtureOfExperts
 from deeplearning4j_tpu.nn.misc_layers import (
     Cropping1D,
     ElementWiseMultiplicationLayer,
@@ -127,6 +128,7 @@ __all__ = [
     "CenterLossOutputLayer",
     "Yolo2OutputLayer",
     "AutoEncoder",
+    "MixtureOfExperts",
     "VariationalAutoencoder",
     "PReLULayer",
     "ElementWiseMultiplicationLayer",

@@ -1,0 +1,121 @@
+"""Mixture-of-Experts layer with expert parallelism.
+
+The reference has NO MoE (SURVEY.md §2.3: expert parallelism absent) — this
+is parity-plus, built because EP is a first-class axis of the TPU design.
+Routing follows the Switch/GShard recipe: a linear router, top-k gating,
+and a differentiable load-balancing auxiliary loss. Dispatch is DENSE
+(every expert runs on every token, combined by gate weights): on TPU this
+is einsum-friendly, has no dynamic shapes, and under a ``NamedSharding``
+that shards the expert dimension over the ``expert`` mesh axis GSPMD
+partitions the expert computation across devices — expert parallelism
+without any hand-written all-to-all.
+
+The aux loss rides the model-state channel: forward returns it under
+``_aux_loss`` and ``MultiLayerNetwork._loss`` adds every such entry to the
+training loss (in-trace, so gradients flow to the router).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.base import GlobalConfig, Layer, register_layer
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.ops.activations import get_activation
+from deeplearning4j_tpu.ops.initializers import init_weights
+
+
+@register_layer
+@dataclasses.dataclass
+class MixtureOfExperts(Layer):
+    """Top-k routed MoE FFN block: ``y = Σ_e gate_e(x) · FFN_e(x)``.
+
+    Parameters carry a leading expert dimension — ``W_e1 (E, nIn, hidden)``,
+    ``W_e2 (E, hidden, nOut)`` — which :meth:`ShardingStrategy.expert_parallel
+    <deeplearning4j_tpu.parallel.sharding.ShardingStrategy.expert_parallel>`
+    shards over the ``expert`` mesh axis."""
+
+    n_out: int = 0
+    n_experts: int = 4
+    hidden_size: Optional[int] = None  # default 4 * n_out
+    top_k: int = 2
+    aux_loss_coef: float = 0.01
+    router_noise: float = 0.0  # stddev of train-time router logit jitter
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if input_type.kind == "recurrent":
+            return InputType.recurrent(self.n_out, input_type.timesteps)
+        return InputType.feed_forward(self.n_out)
+
+    def init(self, key, input_type, g: GlobalConfig):
+        n_in = input_type.size
+        h = self.hidden_size or 4 * self.n_out
+        E = self.n_experts
+        kr, k1, k2 = jax.random.split(key, 3)
+        winit = self._winit(g)
+        params = {
+            "W_router": init_weights(kr, (n_in, E), winit, fan=(n_in, E), dtype=g.dtype),
+            "W_e1": init_weights(k1, (E, n_in, h), winit, fan=(n_in, h), dtype=g.dtype),
+            "b_e1": jnp.zeros((E, h), dtype=g.dtype),
+            "W_e2": init_weights(k2, (E, h, self.n_out), winit, fan=(h, self.n_out),
+                                 dtype=g.dtype),
+            "b_e2": jnp.zeros((E, self.n_out), dtype=g.dtype),
+        }
+        return params, {"_aux_loss": jnp.zeros((), jnp.float32)}
+
+    def regularizable_params(self):
+        return ("W_router", "W_e1", "W_e2")
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        x = self._apply_input_dropout(x, self._g, training, rng)
+        shape = x.shape
+        tokens = x.reshape(-1, shape[-1])  # (N, nIn)
+        E, k = self.n_experts, min(self.top_k, self.n_experts)
+
+        logits = tokens @ params["W_router"]  # (N, E)
+        if training and self.router_noise > 0.0 and rng is not None:
+            # distinct subkey: rng was already consumed by input dropout
+            logits = logits + self.router_noise * jax.random.normal(
+                jax.random.fold_in(rng, 1), logits.shape, logits.dtype)
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        # top-k gates, renormalized over the selected experts
+        top_vals, top_idx = jax.lax.top_k(probs, k)  # (N, k)
+        gates = top_vals / jnp.maximum(top_vals.sum(-1, keepdims=True), 1e-9)
+        combine = jnp.zeros_like(probs)  # (N, E) sparse gate matrix
+        combine = combine.at[jnp.arange(tokens.shape[0])[:, None], top_idx].set(gates)
+
+        act = get_activation(self._act(self._g) if self._act(self._g) is not None
+                             else "relu")
+        # dense expert compute: (N, E, h) -> (N, E, out), gate-combined.
+        h = act(jnp.einsum("nf,efh->neh", tokens, params["W_e1"]) + params["b_e1"])
+        y_e = jnp.einsum("neh,eho->neo", h, params["W_e2"]) + params["b_e2"]
+        y = jnp.einsum("neo,ne->no", y_e, combine.astype(y_e.dtype))
+
+        # Switch-style load balancing: fraction routed (top-1) x mean prob.
+        # Masked (padding) tokens are excluded — balancing garbage tokens
+        # would bias the router against real ones.
+        top1 = jax.nn.one_hot(top_idx[:, 0], E, dtype=probs.dtype)
+        if mask is not None and len(shape) == 3:
+            w = mask.reshape(-1, 1).astype(probs.dtype)
+            denom = jnp.maximum(w.sum(), 1.0)
+            frac = jnp.sum(top1 * w, axis=0) / denom
+            mean_prob = jnp.sum(probs * w, axis=0) / denom
+        else:
+            frac = jnp.mean(top1, axis=0)
+            mean_prob = jnp.mean(probs, axis=0)
+        aux = self.aux_loss_coef * E * jnp.sum(frac * mean_prob)
+
+        new_state = dict(state)
+        new_state["_aux_loss"] = aux.astype(jnp.float32)
+        return y.reshape(*shape[:-1], self.n_out), new_state
+
+    def expert_load(self, params, x) -> jnp.ndarray:
+        """Fraction of tokens whose top-1 expert is e (diagnostic)."""
+        tokens = jnp.asarray(x).reshape(-1, x.shape[-1])
+        top1 = jnp.argmax(tokens @ params["W_router"], axis=-1)
+        return jnp.mean(jax.nn.one_hot(top1, self.n_experts), axis=0)

@@ -24,6 +24,11 @@ from deeplearning4j_tpu.parallel.sharding import (
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
 from deeplearning4j_tpu.parallel.inference import ParallelInference
 from deeplearning4j_tpu.parallel.ring_attention import ring_attention
+from deeplearning4j_tpu.parallel.pipeline import (
+    gpipe,
+    sequential_reference,
+    stack_stage_params,
+)
 
 __all__ = [
     "ShardingStrategy",
@@ -32,4 +37,7 @@ __all__ = [
     "ParallelWrapper",
     "ParallelInference",
     "ring_attention",
+    "gpipe",
+    "stack_stage_params",
+    "sequential_reference",
 ]

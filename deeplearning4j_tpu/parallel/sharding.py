@@ -29,7 +29,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from deeplearning4j_tpu.runtime.mesh import DATA_AXIS, MODEL_AXIS
+from deeplearning4j_tpu.runtime.mesh import DATA_AXIS, EXPERT_AXIS, MODEL_AXIS
 
 
 @dataclasses.dataclass
@@ -86,6 +86,23 @@ class ShardingStrategy:
             if leaf in ROW and len(shape) >= 2:
                 if shape[-2] % tp == 0:
                     return P(*([None] * (len(shape) - 2) + [MODEL_AXIS, None]))
+            return P()
+
+        return ShardingStrategy(mesh=mesh, param_rule=rule)
+
+    @staticmethod
+    def expert_parallel(mesh: Mesh) -> "ShardingStrategy":
+        """Shard MoE expert tables (leading expert dim: ``W_e1``, ``W_e2``,
+        ``b_e1``, ``b_e2``) over the ``expert`` axis; GSPMD partitions the
+        per-expert einsums across devices (no hand-written all-to-all)."""
+        ep = mesh.shape[EXPERT_AXIS]
+        EXPERT_KEYS = ("W_e1", "W_e2", "b_e1", "b_e2")
+
+        def rule(path, shape):
+            keys = [getattr(p, "key", None) for p in path]
+            leaf = keys[-1] if keys else None
+            if leaf in EXPERT_KEYS and shape and shape[0] % ep == 0:
+                return P(*([EXPERT_AXIS] + [None] * (len(shape) - 1)))
             return P()
 
         return ShardingStrategy(mesh=mesh, param_rule=rule)
