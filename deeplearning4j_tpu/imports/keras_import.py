@@ -116,7 +116,9 @@ def _map_layer(kl) -> Optional[object]:
     if cls == "SimpleRNN":
         return SimpleRnn(n_out=cfg["units"], activation=_act_name(kl.activation))
     if cls == "Bidirectional":
-        inner = _map_layer(kl.layer)
+        # keras 3 exposes forward_layer/backward_layer; keras 2 had .layer
+        inner_k = getattr(kl, "layer", None) or kl.forward_layer
+        inner = _map_layer(inner_k)
         mode = {"concat": "concat", "sum": "add", "ave": "average", "mul": "mul"}[
             cfg.get("merge_mode", "concat")]
         return Bidirectional(layer=inner, mode=mode)
@@ -124,8 +126,72 @@ def _map_layer(kl) -> Optional[object]:
         return ZeroPaddingLayer(padding=cfg["padding"])
     if cls == "UpSampling2D":
         return Upsampling2D(size=_pair(cfg["size"]))
-    if cls in ("Flatten", "InputLayer", "Reshape"):
-        return None  # handled structurally (shape inference / preprocessors)
+    if cls == "Conv1D":
+        from deeplearning4j_tpu.nn import Convolution1DLayer
+        mode = {"same": "same", "causal": "causal", "valid": "truncate"}[cfg["padding"]]
+        return Convolution1DLayer(
+            n_out=cfg["filters"], kernel_size=cfg["kernel_size"][0],
+            stride=cfg["strides"][0], convolution_mode=mode,
+            dilation=cfg.get("dilation_rate", [1])[0],
+            activation=_act_name(kl.activation), has_bias=cfg.get("use_bias", True))
+    if cls == "Conv3D":
+        from deeplearning4j_tpu.nn import Convolution3D
+        return Convolution3D(
+            n_out=cfg["filters"], kernel_size=tuple(cfg["kernel_size"]),
+            stride=tuple(cfg["strides"]),
+            convolution_mode="same" if cfg["padding"] == "same" else "truncate",
+            activation=_act_name(kl.activation), has_bias=cfg.get("use_bias", True))
+    if cls in ("MaxPooling3D", "AveragePooling3D"):
+        from deeplearning4j_tpu.nn import Subsampling3DLayer
+        if cfg["padding"] == "same":
+            raise NotImplementedError("MaxPooling3D padding='same' not supported")
+        return Subsampling3DLayer(
+            pooling_type="max" if cls.startswith("Max") else "avg",
+            kernel_size=tuple(cfg["pool_size"]),
+            stride=tuple(cfg["strides"] or cfg["pool_size"]))
+    if cls == "Cropping1D":
+        from deeplearning4j_tpu.nn import Cropping1D
+        c = cfg["cropping"]
+        c = (c, c) if isinstance(c, int) else tuple(c)
+        return Cropping1D(crop_left=c[0], crop_right=c[1])
+    if cls == "Cropping2D":
+        from deeplearning4j_tpu.nn import Cropping2D
+        cr = cfg["cropping"]
+        return Cropping2D(crop=cr)
+    if cls == "ZeroPadding1D":
+        from deeplearning4j_tpu.nn import ZeroPadding1DLayer
+        p = cfg["padding"]
+        p = (p, p) if isinstance(p, int) else tuple(p)
+        return ZeroPadding1DLayer(pad_left=p[0], pad_right=p[1])
+    if cls == "UpSampling1D":
+        from deeplearning4j_tpu.nn import Upsampling1D
+        return Upsampling1D(size=cfg["size"])
+    if cls == "UpSampling3D":
+        from deeplearning4j_tpu.nn import Upsampling3D
+        return Upsampling3D(size=tuple(cfg["size"]))
+    if cls == "PReLU":
+        from deeplearning4j_tpu.nn import PReLULayer
+        shared = cfg.get("shared_axes") or ()
+        return PReLULayer(shared_axes=tuple(shared))
+    if cls == "ELU":
+        return ActivationLayer(activation="elu")
+    if cls == "RepeatVector":
+        from deeplearning4j_tpu.nn import RepeatVector
+        return RepeatVector(n=cfg["n"])
+    if cls == "TimeDistributed":
+        from deeplearning4j_tpu.nn import TimeDistributed
+        return TimeDistributed(underlying=_map_layer(kl.layer))
+    if cls in ("SpatialDropout1D", "SpatialDropout2D", "GaussianDropout",
+               "AlphaDropout"):
+        # train-time-only stochastic layers; retain-prob dropout is the
+        # closest training analog and all are identity at inference
+        return DropoutLayer(dropout=1.0 - cfg.get("rate", 0.0))
+    if cls in ("Flatten", "InputLayer", "Reshape", "GaussianNoise",
+               "ActivityRegularization", "Masking"):
+        # structural no-ops here: Flatten/Reshape via shape inference;
+        # noise/regularization are identity at inference; Masking becomes an
+        # explicit mask argument in this framework
+        return None
     raise NotImplementedError(
         f"Keras layer {cls!r} not mapped; extend keras_import.py")
 
@@ -193,6 +259,18 @@ def _copy_weights(kl, layer, params: Dict[str, np.ndarray]) -> Dict:
         _assign_rnn(fwd, w[:half])
         _assign_rnn(bwd, w[half:])
         out["fwd"], out["bwd"] = fwd, bwd
+    elif cls == "Conv1D":
+        out["W"] = jnp.asarray(w[0][:, None, :, :])  # (k, in, out) -> (k, 1, in, out)
+        if len(w) > 1:
+            out["b"] = jnp.asarray(w[1])
+    elif cls == "Conv3D":
+        out["W"] = jnp.asarray(w[0])  # keras DHWIO == ours
+        if len(w) > 1:
+            out["b"] = jnp.asarray(w[1])
+    elif cls == "PReLU":
+        out["alpha"] = jnp.asarray(w[0])
+    elif cls == "TimeDistributed":
+        out = _copy_weights(kl.layer, layer.underlying, out)
     return out
 
 
@@ -207,6 +285,8 @@ def _assign_rnn(d, w):
 def _input_type_of(km) -> InputType:
     shape = km.input_shape if not isinstance(km.input_shape, list) else km.input_shape[0]
     dims = [d for d in shape[1:]]
+    if len(dims) == 4:
+        return InputType.convolutional3d(dims[0], dims[1], dims[2], dims[3])
     if len(dims) == 3:
         return InputType.convolutional(dims[0], dims[1], dims[2])
     if len(dims) == 2:
@@ -289,6 +369,10 @@ def _import_functional(km):
             g.add_vertex(kl.name, ElementWiseVertex(op="mul"), *srcs)
         elif cls == "Average":
             g.add_vertex(kl.name, ElementWiseVertex(op="average"), *srcs)
+        elif cls == "Subtract":
+            g.add_vertex(kl.name, ElementWiseVertex(op="subtract"), *srcs)
+        elif cls == "Maximum":
+            g.add_vertex(kl.name, ElementWiseVertex(op="max"), *srcs)
         elif cls == "Concatenate":
             g.add_vertex(kl.name, MergeVertex(), *srcs)
         elif cls == "Flatten":

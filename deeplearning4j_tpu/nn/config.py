@@ -233,6 +233,9 @@ class MultiLayerConfiguration:
             return FeedForwardToCnnPreProcessor(cur.height, cur.width, cur.channels)
         if need in ("feedforward_or_recurrent",) and cur.kind == "convolutional":
             return CnnToFeedForwardPreProcessor(cur.height, cur.width, cur.channels)
+        if need in ("feedforward_or_recurrent",) and cur.kind == "convolutional3d":
+            # same flatten; Cnn3DToFeedForward in the reference
+            return CnnToFeedForwardPreProcessor(cur.height, cur.width, cur.channels)
         if need == "convolutional" and cur.kind == "feedforward":
             raise ValueError(
                 "Cannot infer image shape for conv layer from flat feed-forward input; "

@@ -113,10 +113,16 @@ class Convolution1DLayer(ConvolutionLayer):
         d = self.dilation[0] if isinstance(self.dilation, (tuple, list)) else self.dilation
         return int(k), int(s), int(p), int(d), self.convolution_mode.lower() == "same"
 
+    def _is_causal(self) -> bool:
+        return self.convolution_mode.lower() == "causal"
+
     def output_type(self, input_type: InputType) -> InputType:
         k, s, p, d, same = self._geom1d()
         t = input_type.timesteps
-        t_out = None if t is None else _out_size(t, k, s, p, same, d)
+        if self._is_causal():
+            t_out = None if t is None else -(-t // s)  # left-pad keeps ceil(t/s)
+        else:
+            t_out = None if t is None else _out_size(t, k, s, p, same, d)
         return InputType.recurrent(self.n_out, t_out)
 
     def init(self, key, input_type, g: GlobalConfig):
@@ -131,7 +137,10 @@ class Convolution1DLayer(ConvolutionLayer):
     def forward(self, params, state, x, *, training=False, rng=None, mask=None):
         x = self._apply_input_dropout(x, self._g, training, rng)
         k, s, p, d, same = self._geom1d()
-        pad = "SAME" if same else [(p, p), (0, 0)]
+        if self._is_causal():
+            pad = [((k - 1) * d, 0), (0, 0)]  # left-only: y[t] sees x[<=t]
+        else:
+            pad = "SAME" if same else [(p, p), (0, 0)]
         y = lax.conv_general_dilated(
             x[:, :, None, :], params["W"], window_strides=(s, 1), padding=pad,
             rhs_dilation=(d, 1), dimension_numbers=_DIMNUMS)[:, :, 0, :]
@@ -147,7 +156,10 @@ class Convolution1DLayer(ConvolutionLayer):
             return None
         k, s, p, d, same = self._geom1d()
         eff = (k - 1) * d + 1
-        padding = "SAME" if same else [(0, 0), (p, p)]
+        if self._is_causal():
+            padding = [(0, 0), (eff - 1, 0)]
+        else:
+            padding = "SAME" if same else [(0, 0), (p, p)]
         return lax.reduce_window(mask.astype(jnp.float32), 0.0, lax.max,
                                  (1, eff), (1, s), padding)
 

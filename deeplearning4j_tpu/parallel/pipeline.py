@@ -20,7 +20,10 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.experimental.shard_map import shard_map
+try:
+    from jax import shard_map  # stable location (jax >= 0.7)
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.runtime.mesh import PIPE_AXIS
@@ -86,9 +89,16 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array],
         return lax.psum(jnp.where(idx == S - 1, outputs, 0.0), axis_name)
 
     spec_params = jax.tree.map(lambda _: P(axis_name), stacked_params)
-    fn = shard_map(per_device, mesh=mesh,
-                   in_specs=(spec_params, P()), out_specs=P(),
-                   check_rep=False)
+    # jax.shard_map (>=0.7) spells the replication check check_vma; the
+    # experimental one spelled it check_rep
+    try:
+        fn = shard_map(per_device, mesh=mesh,
+                       in_specs=(spec_params, P()), out_specs=P(),
+                       check_vma=False)
+    except TypeError:
+        fn = shard_map(per_device, mesh=mesh,
+                       in_specs=(spec_params, P()), out_specs=P(),
+                       check_rep=False)
     out = fn(stacked_params, mbs)
     return out.reshape((B,) + out.shape[2:])
 

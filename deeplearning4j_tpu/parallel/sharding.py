@@ -101,7 +101,12 @@ class ShardingStrategy:
         def rule(path, shape):
             keys = [getattr(p, "key", None) for p in path]
             leaf = keys[-1] if keys else None
-            if leaf in EXPERT_KEYS and shape and shape[0] % ep == 0:
+            if leaf in EXPERT_KEYS:
+                if not shape or shape[0] % ep:
+                    raise ValueError(
+                        f"expert table {leaf} has {shape[0] if shape else 0} "
+                        f"experts, not divisible by expert-axis size {ep} — "
+                        f"replicating would silently disable expert parallelism")
                 return P(*([EXPERT_AXIS] + [None] * (len(shape) - 1)))
             return P()
 

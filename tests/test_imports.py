@@ -125,3 +125,96 @@ def test_keras_functional_import(tmp_path):
     expected = km(x).numpy()
     got = np.asarray(net.output(x))
     np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_keras_conv1d_prelu_import(tmp_path):
+    from deeplearning4j_tpu.imports import KerasModelImport
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((10, 6)),
+        tf.keras.layers.Conv1D(8, 3, padding="same", activation="relu"),
+        tf.keras.layers.Conv1D(8, 3, strides=2, padding="valid"),
+        tf.keras.layers.PReLU(shared_axes=[1]),
+        tf.keras.layers.GlobalAveragePooling1D(),
+        tf.keras.layers.Dense(3, activation="softmax"),
+    ])
+    # non-zero PReLU alphas so the mapping is actually exercised
+    prelu = km.layers[2]
+    prelu.set_weights([np.full_like(prelu.get_weights()[0], 0.25)])
+    path = str(tmp_path / "c1d.keras")
+    km.save(path)
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    x = np.random.default_rng(0).normal(0, 1, (4, 10, 6)).astype(np.float32)
+    expected = km(x).numpy()
+    got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_keras_crop_pad_upsample_import(tmp_path):
+    from deeplearning4j_tpu.imports import KerasModelImport
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((9, 4)),
+        tf.keras.layers.ZeroPadding1D(2),
+        tf.keras.layers.Cropping1D((1, 1)),
+        tf.keras.layers.UpSampling1D(2),
+        tf.keras.layers.Conv1D(5, 3, padding="same", activation="tanh"),
+        tf.keras.layers.GlobalMaxPooling1D(),
+        tf.keras.layers.Dense(2),
+    ])
+    path = str(tmp_path / "cpu1d.keras")
+    km.save(path)
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    x = np.random.default_rng(1).normal(0, 1, (3, 9, 4)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(net.output(x)), km(x).numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_keras_conv3d_import(tmp_path):
+    from deeplearning4j_tpu.imports import KerasModelImport
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((6, 6, 6, 2)),
+        tf.keras.layers.Conv3D(4, 3, padding="same", activation="relu"),
+        tf.keras.layers.MaxPooling3D(2),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(3, activation="softmax"),
+    ])
+    path = str(tmp_path / "c3d.keras")
+    km.save(path)
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    x = np.random.default_rng(2).normal(0, 1, (2, 6, 6, 6, 2)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(net.output(x)), km(x).numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_keras_functional_subtract_maximum(tmp_path):
+    from deeplearning4j_tpu.imports import KerasModelImport
+    inp = tf.keras.layers.Input((8,))
+    a = tf.keras.layers.Dense(8, activation="relu")(inp)
+    b = tf.keras.layers.Dense(8, activation="relu")(inp)
+    sub = tf.keras.layers.Subtract()([a, b])
+    mx = tf.keras.layers.Maximum()([a, b])
+    cat = tf.keras.layers.Concatenate()([sub, mx])
+    out = tf.keras.layers.Dense(2)(cat)
+    km = tf.keras.Model(inp, out)
+    path = str(tmp_path / "fn.keras")
+    km.save(path)
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    x = np.random.default_rng(3).normal(0, 1, (5, 8)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(net.output(x)), km(x).numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_keras_causal_conv1d_import(tmp_path):
+    from deeplearning4j_tpu.imports import KerasModelImport
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((12, 3)),
+        tf.keras.layers.Conv1D(6, 3, padding="causal", dilation_rate=2,
+                               activation="tanh"),
+        tf.keras.layers.GlobalAveragePooling1D(),
+        tf.keras.layers.Dense(2),
+    ])
+    path = str(tmp_path / "causal.keras")
+    km.save(path)
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    x = np.random.default_rng(4).normal(0, 1, (3, 12, 3)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(net.output(x)), km(x).numpy(),
+                               rtol=1e-4, atol=1e-5)

@@ -23,14 +23,13 @@ def test_moe_trains_and_reports_aux_loss():
     rng = np.random.default_rng(0)
     x = rng.normal(0, 1, (64, 8)).astype(np.float32)
     y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
-    s0 = None
     net.fit(x, y, epochs=30)
     out = np.asarray(net.output(x))
     assert out.shape == (64, 3)
     np.testing.assert_allclose(out.sum(-1), 1.0, atol=1e-4)
     # training reduced the loss
-    assert net.score(__import__("deeplearning4j_tpu.data.dataset",
-                                fromlist=["DataSet"]).DataSet(x, y)) < 1.2
+    from deeplearning4j_tpu.data.dataset import DataSet
+    assert net.score(DataSet(x, y)) < 1.2
     # router balance diagnostic exists and sums to 1
     moe = net.layers[1]
     h = np.asarray(net.feed_forward(x)[1])  # MoE input = dense activations
@@ -151,3 +150,16 @@ def test_gpipe_stage_count_mismatch_rejected():
     with pytest.raises(ValueError, match="stages"):
         gpipe(lambda p, x: x @ p["W"], stacked, jnp.ones((8, 3)), mesh=mesh,
               n_microbatches=2)
+
+
+def test_expert_parallel_indivisible_rejected():
+    from deeplearning4j_tpu.parallel import ShardingStrategy
+    from deeplearning4j_tpu.runtime.mesh import EXPERT_AXIS, MeshSpec, create_mesh
+    mesh = create_mesh(MeshSpec({EXPERT_AXIS: 4}), devices_=jax.devices()[:4])
+    strat = ShardingStrategy.expert_parallel(mesh)
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2)).list()
+            .layer(MixtureOfExperts(n_out=4, n_experts=6, top_k=1))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    with pytest.raises(ValueError, match="divisible"):
+        strat.param_sharding(net.train_state.params)
