@@ -38,6 +38,17 @@ from deeplearning4j_tpu.train.listeners import (
     ScoreIterationListener,
     TrainingListener,
 )
+from deeplearning4j_tpu.train.early_stopping import (
+    BestScoreEpochTerminationCondition,
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    EarlyStoppingResult,
+    EarlyStoppingTrainer,
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)
 
 __all__ = [
     "Updater", "Sgd", "Adam", "AdaMax", "AMSGrad", "Nadam", "Nesterovs",
@@ -46,4 +57,9 @@ __all__ = [
     "PolySchedule", "SigmoidSchedule", "MapSchedule", "CycleSchedule",
     "TrainingListener", "BaseTrainingListener", "ScoreIterationListener",
     "PerformanceListener", "EvaluativeListener",
+    "EarlyStoppingConfiguration", "EarlyStoppingTrainer", "EarlyStoppingResult",
+    "DataSetLossCalculator", "MaxEpochsTerminationCondition",
+    "ScoreImprovementEpochTerminationCondition",
+    "BestScoreEpochTerminationCondition", "MaxTimeIterationTerminationCondition",
+    "MaxScoreIterationTerminationCondition",
 ]

@@ -1,0 +1,89 @@
+"""Early stopping: termination conditions, best-model retention."""
+
+import numpy as np
+
+from deeplearning4j_tpu.data import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn import (DenseLayer, InputType, NeuralNetConfiguration,
+                                   OutputLayer)
+from deeplearning4j_tpu.train import (Adam, BestScoreEpochTerminationCondition,
+                                      DataSetLossCalculator,
+                                      EarlyStoppingConfiguration,
+                                      EarlyStoppingTrainer,
+                                      MaxEpochsTerminationCondition,
+                                      MaxScoreIterationTerminationCondition,
+                                      ScoreImprovementEpochTerminationCondition)
+
+
+def _net_and_data(lr=5e-2, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0, 1, (64, 6)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(lr)).list()
+            .layer(DenseLayer(n_out=12, activation="tanh"))
+            .layer(OutputLayer(n_out=2, activation="softmax"))
+            .set_input_type(InputType.feed_forward(6)).build())
+    net = MultiLayerNetwork(conf).init()
+    it = ListDataSetIterator([DataSet(x, y)], batch_size=32)
+    return net, it, ListDataSetIterator([DataSet(x, y)], batch_size=64)
+
+
+def test_max_epochs_and_best_model():
+    net, train_it, val_it = _net_and_data()
+    cfg = (EarlyStoppingConfiguration.builder()
+           .score_calculator(DataSetLossCalculator(val_it))
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(8))
+           .build())
+    result = EarlyStoppingTrainer(cfg, net, train_it).fit()
+    assert result.termination_reason == "EpochTerminationCondition"
+    assert result.termination_details == "MaxEpochsTerminationCondition"
+    assert result.total_epochs == 8
+    assert 0 <= result.best_model_epoch < 8
+    assert len(result.score_vs_epoch) == 8
+    # best model scores at least as well as the final epoch's score
+    final_epoch_score = result.score_vs_epoch[max(result.score_vs_epoch)]
+    assert result.best_model_score <= final_epoch_score + 1e-9
+    # best model is USABLE after training continued (buffers not donated away)
+    out = np.asarray(result.best_model.output(np.zeros((2, 6), np.float32)))
+    assert np.isfinite(out).all()
+
+
+def test_score_improvement_patience():
+    net, train_it, val_it = _net_and_data(lr=0.0)  # no learning -> no improvement
+    cfg = (EarlyStoppingConfiguration.builder()
+           .score_calculator(DataSetLossCalculator(val_it))
+           .epoch_termination_conditions(
+               ScoreImprovementEpochTerminationCondition(2),
+               MaxEpochsTerminationCondition(50))
+           .build())
+    result = EarlyStoppingTrainer(cfg, net, train_it).fit()
+    assert result.termination_details == "ScoreImprovementEpochTerminationCondition"
+    assert result.total_epochs <= 5  # 1 best + patience 2 + margin
+
+
+def test_best_score_target():
+    net, train_it, val_it = _net_and_data()
+    cfg = (EarlyStoppingConfiguration.builder()
+           .score_calculator(DataSetLossCalculator(val_it))
+           .epoch_termination_conditions(
+               BestScoreEpochTerminationCondition(10.0),  # trivially reached
+               MaxEpochsTerminationCondition(50))
+           .build())
+    result = EarlyStoppingTrainer(cfg, net, train_it).fit()
+    assert result.termination_details == "BestScoreEpochTerminationCondition"
+    assert result.total_epochs == 1
+
+
+def test_iteration_divergence_guard():
+    net, train_it, val_it = _net_and_data()
+    cfg = (EarlyStoppingConfiguration.builder()
+           .score_calculator(DataSetLossCalculator(val_it))
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(50))
+           .iteration_termination_conditions(
+               MaxScoreIterationTerminationCondition(1e-9))  # trips immediately
+           .build())
+    result = EarlyStoppingTrainer(cfg, net, train_it).fit()
+    assert result.termination_reason == "IterationTerminationCondition"
+    assert result.termination_details == "MaxScoreIterationTerminationCondition"
+    # listeners restored
+    assert all(type(l).__name__ != "_IterGuard" for l in net.get_listeners())
