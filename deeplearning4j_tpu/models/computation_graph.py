@@ -488,6 +488,23 @@ class ComputationGraph:
     def set_listeners(self, *listeners: TrainingListener) -> None:
         self._listeners = list(listeners)
 
+    def get_listeners(self):
+        return list(self._listeners)
+
+    def add_listeners(self, *listeners: TrainingListener) -> None:
+        self._listeners.extend(listeners)
+
+    def clone(self) -> "ComputationGraph":
+        net = ComputationGraph(
+            ComputationGraphConfiguration.from_dict(self.conf.to_dict()))
+        if self.train_state is not None:
+            net.init(params=jax.tree.map(jnp.copy, self.train_state.params))
+            import dataclasses as _dc
+            net.train_state = _dc.replace(
+                net.train_state,
+                model_state=jax.tree.map(jnp.copy, self.train_state.model_state))
+        return net
+
     def params(self):
         return self.train_state.params if self.train_state else None
 

@@ -33,6 +33,7 @@ from deeplearning4j_tpu.train.schedules import (
 )
 from deeplearning4j_tpu.train.listeners import (
     BaseTrainingListener,
+    CollectScoresListener,
     EvaluativeListener,
     PerformanceListener,
     ScoreIterationListener,
@@ -56,7 +57,7 @@ __all__ = [
     "Schedule", "StepSchedule", "ExponentialSchedule", "InverseSchedule",
     "PolySchedule", "SigmoidSchedule", "MapSchedule", "CycleSchedule",
     "TrainingListener", "BaseTrainingListener", "ScoreIterationListener",
-    "PerformanceListener", "EvaluativeListener",
+    "PerformanceListener", "EvaluativeListener", "CollectScoresListener",
     "EarlyStoppingConfiguration", "EarlyStoppingTrainer", "EarlyStoppingResult",
     "DataSetLossCalculator", "MaxEpochsTerminationCondition",
     "ScoreImprovementEpochTerminationCondition",

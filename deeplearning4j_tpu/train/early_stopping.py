@@ -57,6 +57,12 @@ class ScoreImprovementEpochTerminationCondition:
         self._best = float("inf")
         self._stale = 0
 
+    def initialize(self) -> None:
+        """Reset run-scoped state (called by the trainer at fit start so a
+        condition instance can be reused across runs)."""
+        self._best = float("inf")
+        self._stale = 0
+
     def terminate(self, epoch: int, score: float, best_score: float) -> bool:
         if score < self._best - self.min_improvement:
             self._best = score
@@ -134,6 +140,10 @@ class EarlyStoppingConfiguration:
             self._kw["evaluate_every_n_epochs"] = int(n)
             return self
 
+        def save_last_model(self, save: bool = True):
+            self._kw["save_last_model"] = bool(save)
+            return self
+
         def build(self) -> "EarlyStoppingConfiguration":
             return EarlyStoppingConfiguration(**self._kw)
 
@@ -151,6 +161,7 @@ class EarlyStoppingResult:
     best_model_score: float
     score_vs_epoch: dict
     best_model: Any
+    last_model: Any = None  # populated when config.save_last_model
 
 
 class EarlyStoppingTrainer:
@@ -173,7 +184,6 @@ class EarlyStoppingTrainer:
         class _IterGuard:
             """Listener checking iteration conditions on every minibatch."""
 
-            stop = False
             details = ""
 
             def __init__(self, conds):
@@ -188,15 +198,20 @@ class EarlyStoppingTrainer:
             def iteration_done(self, net, iteration, epoch, score):
                 for c in self.conds:
                     if c.terminate(float(score)):
-                        self.stop = True
                         self.details = type(c).__name__
                         raise StopIteration(self.details)
+
+        for c in cfg.epoch_termination_conditions:
+            if hasattr(c, "initialize"):
+                c.initialize()
 
         guard = _IterGuard(cfg.iteration_termination_conditions)
         epoch = 0
         reason, details = "EpochTerminationCondition", ""
-        old_listeners = list(self.net.get_listeners())
+        old_listeners = list(self.net.get_listeners()) \
+            if hasattr(self.net, "get_listeners") else []
         self.net.set_listeners(*(old_listeners + [guard]))
+        last_score = float("nan")
         try:
             while True:
                 try:
@@ -205,38 +220,50 @@ class EarlyStoppingTrainer:
                     reason = "IterationTerminationCondition"
                     details = guard.details
                     break
-                if (epoch + 1) % cfg.evaluate_every_n_epochs == 0:
-                    score = float(cfg.score_calculator(self.net))
-                    scores[epoch] = score
-                    if score < best_score:
-                        best_score, best_epoch = score, epoch
+                if cfg.score_calculator is not None and \
+                        (epoch + 1) % cfg.evaluate_every_n_epochs == 0:
+                    last_score = float(cfg.score_calculator(self.net))
+                    scores[epoch] = last_score
+                    if last_score < best_score:
+                        best_score, best_epoch = last_score, epoch
                         # deep-copy the buffers: the live train_state is
                         # DONATED at the next step, which would delete a
                         # shallow snapshot's arrays
-                        import jax
-                        import jax.numpy as jnp
-                        best_params = jax.tree.map(
-                            lambda a: jnp.array(a, copy=True)
-                            if hasattr(a, "dtype") else a,
-                            self.net.train_state)
-                    stop = False
-                    for c in cfg.epoch_termination_conditions:
-                        if c.terminate(epoch, score, best_score):
-                            details = type(c).__name__
-                            stop = True
-                            break
-                    if stop:
+                        best_params = self._snapshot_state()
+                # epoch conditions run EVERY epoch (with the latest score),
+                # not only on evaluation epochs — MaxEpochs must not overshoot
+                stop = False
+                for c in cfg.epoch_termination_conditions:
+                    if c.terminate(epoch, last_score, best_score):
+                        details = type(c).__name__
+                        stop = True
                         break
+                if stop:
+                    break
                 epoch += 1
         finally:
             self.net.set_listeners(*old_listeners)
 
+        last_model = None
+        if cfg.save_last_model:
+            last_model = self._clone_with(self._snapshot_state())
         best_model = self.net
         if best_params is not None:
-            best_model = self.net.clone() if hasattr(self.net, "clone") else self.net
-            best_model.train_state = best_params
+            best_model = self._clone_with(best_params)
         return EarlyStoppingResult(
             termination_reason=reason, termination_details=details,
             total_epochs=epoch + 1, best_model_epoch=best_epoch,
             best_model_score=best_score, score_vs_epoch=scores,
-            best_model=best_model)
+            best_model=best_model, last_model=last_model)
+
+    def _snapshot_state(self):
+        import jax
+        import jax.numpy as jnp
+        return jax.tree.map(
+            lambda a: jnp.array(a, copy=True) if hasattr(a, "dtype") else a,
+            self.net.train_state)
+
+    def _clone_with(self, state):
+        model = self.net.clone() if hasattr(self.net, "clone") else self.net
+        model.train_state = state
+        return model

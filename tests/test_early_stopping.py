@@ -87,3 +87,64 @@ def test_iteration_divergence_guard():
     assert result.termination_details == "MaxScoreIterationTerminationCondition"
     # listeners restored
     assert all(type(l).__name__ != "_IterGuard" for l in net.get_listeners())
+
+
+def test_no_score_calculator_and_reuse():
+    """MaxEpochs-only config (no score calculator) works, and a reused
+    ScoreImprovement condition resets between runs."""
+    net, train_it, _ = _net_and_data()
+    cond = ScoreImprovementEpochTerminationCondition(1)
+    cfg = (EarlyStoppingConfiguration.builder()
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(3))
+           .build())
+    result = EarlyStoppingTrainer(cfg, net, train_it).fit()
+    assert result.total_epochs == 3  # no overshoot, no crash without scorer
+
+    # reuse: same condition instance across two runs
+    net2, train_it2, val_it2 = _net_and_data(seed=1)
+    cfg2 = (EarlyStoppingConfiguration.builder()
+            .score_calculator(DataSetLossCalculator(val_it2))
+            .epoch_termination_conditions(cond, MaxEpochsTerminationCondition(10))
+            .build())
+    r1 = EarlyStoppingTrainer(cfg2, net2, train_it2).fit()
+    net3, train_it3, _ = _net_and_data(seed=2)
+    r2 = EarlyStoppingTrainer(cfg2, net3, train_it3).fit()
+    assert r2.total_epochs >= 2  # state was reset, not carried over
+
+
+def test_evaluate_every_n_no_overshoot():
+    net, train_it, val_it = _net_and_data()
+    cfg = (EarlyStoppingConfiguration.builder()
+           .score_calculator(DataSetLossCalculator(val_it))
+           .evaluate_every_n_epochs(3)
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(5))
+           .build())
+    result = EarlyStoppingTrainer(cfg, net, train_it).fit()
+    assert result.total_epochs == 5
+
+
+def test_save_last_model_and_computation_graph():
+    from deeplearning4j_tpu.models import ComputationGraph
+    from deeplearning4j_tpu.nn import NeuralNetConfiguration as NNC
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (32, 6)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+    g = (NNC.builder().seed(0).updater(Adam(5e-2)).graph_builder()
+         .add_inputs("in")
+         .add_layer("d", DenseLayer(n_out=8, activation="tanh"), "in")
+         .add_layer("out", OutputLayer(n_out=2, activation="softmax"), "d")
+         .set_outputs("out"))
+    g.set_input_types(InputType.feed_forward(6))
+    net = ComputationGraph(g.build()).init()
+    it = ListDataSetIterator([DataSet(x, y)], batch_size=32)
+    val = ListDataSetIterator([DataSet(x, y)], batch_size=32)
+    cfg = (EarlyStoppingConfiguration.builder()
+           .score_calculator(DataSetLossCalculator(val))
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(4))
+           .save_last_model()
+           .build())
+    result = EarlyStoppingTrainer(cfg, net, it).fit()
+    assert result.total_epochs == 4
+    assert result.last_model is not None
+    out = np.asarray(result.best_model.output(x))
+    assert out.shape == (32, 2)
