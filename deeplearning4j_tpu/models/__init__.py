@@ -11,5 +11,10 @@ entry point instead of dispatching per-op like the reference.
 from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork, TrainState
 from deeplearning4j_tpu.models.computation_graph import ComputationGraph, GraphBuilder
 from deeplearning4j_tpu.models.serializer import ModelSerializer
+from deeplearning4j_tpu.models.transfer_learning import (
+    FineTuneConfiguration,
+    TransferLearning,
+)
 
-__all__ = ["MultiLayerNetwork", "TrainState", "ComputationGraph", "GraphBuilder", "ModelSerializer"]
+__all__ = ["MultiLayerNetwork", "TrainState", "ComputationGraph", "GraphBuilder",
+           "ModelSerializer", "TransferLearning", "FineTuneConfiguration"]

@@ -253,9 +253,14 @@ class ComputationGraph:
                     ss[name] = s
             return ps, ss
 
-        new_params, model_state = jax.jit(init_all)(jax.random.PRNGKey(g.seed))
         if params is not None:
+            # only the non-trainable state is needed; returning just it lets
+            # XLA dead-code-eliminate the (discarded) param initialization
             new_params = params
+            model_state = jax.jit(lambda key: init_all(key)[1])(
+                jax.random.PRNGKey(g.seed))
+        else:
+            new_params, model_state = jax.jit(init_all)(jax.random.PRNGKey(g.seed))
         self._tx = self._build_tx(new_params)
         self.train_state = TrainState(
             params=new_params, model_state=model_state,
@@ -507,6 +512,12 @@ class ComputationGraph:
 
     def params(self):
         return self.train_state.params if self.train_state else None
+
+    def set_params(self, params) -> None:
+        if self.train_state is None:
+            self.init(params=params)
+        else:
+            self.train_state = dataclasses.replace(self.train_state, params=params)
 
     def num_params(self) -> int:
         if self.train_state is None:

@@ -65,6 +65,7 @@ class TransferLearning:
             self._net = net
             self._conf = MultiLayerConfiguration.from_dict(net.conf.to_dict())
             self._old_params = net.train_state.params if net.train_state else {}
+            self._old_state = net.train_state.model_state if net.train_state else {}
             self._freeze_until: Optional[int] = None
             self._fine_tune: Optional[FineTuneConfiguration] = None
             self._removed_from: Optional[int] = None
@@ -106,11 +107,148 @@ class TransferLearning:
                                   if i < kept_n}
             conf._infer_shapes()
             net = MultiLayerNetwork(conf).init()
-            # graft pretrained params for kept layers (new layers keep fresh init)
+            # graft pretrained params AND model state (batch-norm running
+            # stats!) for kept layers; new layers keep fresh init
+            import jax.numpy as jnp
             new_params = dict(net.train_state.params)
+            new_state = dict(net.train_state.model_state)
             for i, layer in enumerate(conf.layers[:kept_n]):
                 k = _layer_key(i, layer)
                 if k in self._old_params:
-                    new_params[k] = jax.tree.map(lambda a: a, self._old_params[k])
+                    # real copies: both nets run donated train steps, and a
+                    # shared buffer would be deleted by whichever fits first
+                    new_params[k] = jax.tree.map(jnp.copy, self._old_params[k])
+                if k in self._old_state:
+                    new_state[k] = jax.tree.map(jnp.copy, self._old_state[k])
             net.set_params(new_params)
+            net.train_state = dataclasses.replace(net.train_state,
+                                                  model_state=new_state)
             return net
+
+
+class TransferLearningGraph:
+    """Transfer learning on a ComputationGraph (reference
+    ``TransferLearning.GraphBuilder``)::
+
+        net2 = (TransferLearning.graph_builder(net)
+                .fine_tune_configuration(FineTuneConfiguration(updater=Adam(1e-4)))
+                .set_feature_extractor("pool")     # freeze "pool" + ancestors
+                .remove_vertex_and_connections("out")
+                .add_layer("out2", OutputLayer(n_out=5, activation="softmax"), "pool")
+                .set_outputs("out2")
+                .build())
+    """
+
+    class Builder:
+        def __init__(self, net):
+            from deeplearning4j_tpu.models.computation_graph import (
+                ComputationGraphConfiguration)
+            self._net = net
+            self._conf = ComputationGraphConfiguration.from_dict(net.conf.to_dict())
+            self._old_params = net.train_state.params if net.train_state else {}
+            self._old_state = net.train_state.model_state if net.train_state else {}
+            self._fine_tune: Optional[FineTuneConfiguration] = None
+            self._freeze_at: List[str] = []
+            self._removed: set = set()
+            self._added_names: List[str] = []
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._fine_tune = ftc
+            return self
+
+        def set_feature_extractor(self, *vertex_names: str):
+            """Freeze the named vertices and every ancestor (reference
+            semantics: everything up to and including these is a fixed
+            feature extractor)."""
+            self._freeze_at = list(vertex_names)
+            return self
+
+        def remove_vertex_and_connections(self, name: str):
+            """Remove a vertex and everything downstream of it."""
+            doomed = {name}
+            changed = True
+            while changed:
+                changed = False
+                for n in self._conf.nodes:
+                    if n.name not in doomed and any(i in doomed for i in n.inputs):
+                        doomed.add(n.name)
+                        changed = True
+            self._removed |= doomed
+            return self
+
+        def add_layer(self, name: str, layer, *inputs: str):
+            from deeplearning4j_tpu.models.computation_graph import GraphNode
+            layer.name = name
+            self._conf.nodes.append(GraphNode(name, "layer", layer, list(inputs)))
+            self._added_names.append(name)
+            return self
+
+        def add_vertex(self, name: str, vertex, *inputs: str):
+            from deeplearning4j_tpu.models.computation_graph import GraphNode
+            self._conf.nodes.append(GraphNode(name, "vertex", vertex, list(inputs)))
+            self._added_names.append(name)
+            return self
+
+        def set_outputs(self, *names: str):
+            self._conf.outputs = list(names)
+            return self
+
+        def _ancestors(self, names: List[str]) -> set:
+            by_name = {n.name: n for n in self._conf.nodes}
+            seen = set()
+
+            def walk(n):
+                if n in seen or n in self._conf.inputs:
+                    return
+                if n not in by_name:
+                    raise ValueError(
+                        f"set_feature_extractor target {n!r} is not a graph "
+                        f"vertex (typo, or removed by "
+                        f"remove_vertex_and_connections)")
+                seen.add(n)
+                for dep in by_name[n].inputs:
+                    walk(dep)
+
+            for n in names:
+                walk(n)
+            return seen
+
+        def build(self):
+            from deeplearning4j_tpu.models.computation_graph import ComputationGraph
+            conf = self._conf
+            if self._fine_tune:
+                self._fine_tune.apply(conf)  # acts on global_conf only
+            conf.nodes = [n for n in conf.nodes if n.name not in self._removed]
+            missing = [o for o in conf.outputs if o in self._removed]
+            if missing:
+                raise ValueError(
+                    f"outputs {missing} were removed; call set_outputs(...)")
+            if self._freeze_at:
+                for name in self._ancestors(self._freeze_at):
+                    node = conf.node(name)
+                    if node.kind == "layer":
+                        node.obj.frozen = True
+            conf._toposort_and_infer()
+            net = ComputationGraph(conf).init()
+            import jax.numpy as jnp
+            new_params = dict(net.train_state.params)
+            new_state = dict(net.train_state.model_state)
+            for n in conf.nodes:
+                if n.name in self._added_names:
+                    continue
+                if n.name in self._old_params:
+                    new_params[n.name] = jax.tree.map(
+                        jnp.copy, self._old_params[n.name])
+                if n.name in self._old_state:
+                    # batch-norm running stats etc. belong to the pretrained
+                    # feature extractor as much as its weights do
+                    new_state[n.name] = jax.tree.map(
+                        jnp.copy, self._old_state[n.name])
+            net.set_params(new_params)
+            net.train_state = dataclasses.replace(net.train_state,
+                                                  model_state=new_state)
+            return net
+
+
+TransferLearning.graph_builder = staticmethod(
+    lambda net: TransferLearningGraph.Builder(net))

@@ -53,6 +53,45 @@ class ParallelInference:
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
 
+    class Builder:
+        """Reference ``ParallelInference.Builder`` surface."""
+
+        def __init__(self, model):
+            self._model = model
+            self._kw = {}
+
+        def max_batch_size(self, n: int):
+            self._kw["max_batch_size"] = int(n)
+            return self
+
+        def batch_timeout_ms(self, ms: float):
+            self._kw["batch_timeout_ms"] = float(ms)
+            return self
+
+        def queue_limit(self, n: int):
+            self._kw["queue_limit"] = int(n)
+            return self
+
+        def inference_mode(self, mode: str):
+            mode = str(mode).lower()
+            if mode not in ("batched", "sequential"):
+                raise ValueError(f"unknown inference mode {mode!r}; "
+                                 f"'BATCHED' or 'SEQUENTIAL'")
+            self._mode = mode
+            return self
+
+        def build(self) -> "ParallelInference":
+            # resolve the mode LAST so call order doesn't matter:
+            # SEQUENTIAL == batch size 1 regardless of max_batch_size()
+            kw = dict(self._kw)
+            if getattr(self, "_mode", "batched") == "sequential":
+                kw["max_batch_size"] = 1
+            return ParallelInference(self._model, **kw)
+
+    @staticmethod
+    def builder(model) -> "ParallelInference.Builder":
+        return ParallelInference.Builder(model)
+
     def output(self, x) -> np.ndarray:
         """Blocking inference; safe from many threads at once."""
         req = _Request(np.asarray(x))

@@ -39,6 +39,8 @@ class DataSetLossCalculator:
 
 # ---- epoch termination conditions ----
 class MaxEpochsTerminationCondition:
+    requires_score = False  # checked every epoch, scored or not
+
     def __init__(self, max_epochs: int):
         self.max_epochs = max_epochs
 
@@ -49,6 +51,8 @@ class MaxEpochsTerminationCondition:
 class ScoreImprovementEpochTerminationCondition:
     """Stop after ``max_epochs_without_improvement`` non-improving epochs
     (optionally requiring at least ``min_improvement``)."""
+
+    requires_score = True
 
     def __init__(self, max_epochs_without_improvement: int,
                  min_improvement: float = 0.0):
@@ -75,6 +79,8 @@ class ScoreImprovementEpochTerminationCondition:
 class BestScoreEpochTerminationCondition:
     """Stop once the score is at/below a target (reference semantics:
     'good enough')."""
+
+    requires_score = True
 
     def __init__(self, target_score: float):
         self.target_score = target_score
@@ -175,6 +181,15 @@ class EarlyStoppingTrainer:
 
     def fit(self) -> EarlyStoppingResult:
         cfg = self.config
+        needs_clone = cfg.save_last_model or cfg.score_calculator is not None
+        if needs_clone and not hasattr(self.net, "clone"):
+            raise ValueError(
+                "best/last-model retention needs net.clone(); implement it, "
+                "or drop the score calculator / save_last_model")
+        if not hasattr(self.net, "set_listeners"):
+            raise ValueError(
+                "EarlyStoppingTrainer needs the TrainingListener API "
+                "(set_listeners/get_listeners) on the network")
         best_score, best_epoch = float("inf"), -1
         best_params = None
         scores = {}
@@ -210,7 +225,7 @@ class EarlyStoppingTrainer:
         reason, details = "EpochTerminationCondition", ""
         old_listeners = list(self.net.get_listeners()) \
             if hasattr(self.net, "get_listeners") else []
-        self.net.set_listeners(*(old_listeners + [guard]))
+        self.net.set_listeners(*(old_listeners + [guard]))  # checked above
         last_score = float("nan")
         try:
             while True:
@@ -230,10 +245,16 @@ class EarlyStoppingTrainer:
                         # DONATED at the next step, which would delete a
                         # shallow snapshot's arrays
                         best_params = self._snapshot_state()
-                # epoch conditions run EVERY epoch (with the latest score),
-                # not only on evaluation epochs — MaxEpochs must not overshoot
+                # Score-free conditions (requires_score=False, e.g.
+                # MaxEpochs) run EVERY epoch so they never overshoot; all
+                # others — including user-defined ones — keep the original
+                # contract of running only on fresh-score epochs (a stale/
+                # NaN score would count as non-improvement).
+                fresh = epoch in scores
                 stop = False
                 for c in cfg.epoch_termination_conditions:
+                    if getattr(c, "requires_score", True) and not fresh:
+                        continue
                     if c.terminate(epoch, last_score, best_score):
                         details = type(c).__name__
                         stop = True
@@ -264,6 +285,6 @@ class EarlyStoppingTrainer:
             self.net.train_state)
 
     def _clone_with(self, state):
-        model = self.net.clone() if hasattr(self.net, "clone") else self.net
+        model = self.net.clone()  # presence validated at fit() start
         model.train_state = state
         return model

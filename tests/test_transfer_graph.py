@@ -1,0 +1,112 @@
+"""Transfer learning on ComputationGraph: freeze ancestors, swap the head,
+keep pretrained weights."""
+
+import numpy as np
+
+from deeplearning4j_tpu.models import ComputationGraph, FineTuneConfiguration, TransferLearning
+from deeplearning4j_tpu.nn import (DenseLayer, InputType, NeuralNetConfiguration,
+                                   OutputLayer)
+from deeplearning4j_tpu.train import Adam
+
+
+def _trained_graph():
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (48, 5)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 48)]
+    g = (NeuralNetConfiguration.builder().seed(0).updater(Adam(2e-2)).graph_builder()
+         .add_inputs("in")
+         .add_layer("feat1", DenseLayer(n_out=16, activation="tanh"), "in")
+         .add_layer("feat2", DenseLayer(n_out=8, activation="tanh"), "feat1")
+         .add_layer("out", OutputLayer(n_out=3, activation="softmax"), "feat2")
+         .set_outputs("out"))
+    g.set_input_types(InputType.feed_forward(5))
+    net = ComputationGraph(g.build()).init()
+    net.fit(x, y, epochs=5)
+    return net, x
+
+
+def test_graph_transfer_swap_head_keeps_features():
+    net, x = _trained_graph()
+    w_feat1 = np.asarray(net.params()["feat1"]["W"])
+
+    net2 = (TransferLearning.graph_builder(net)
+            .fine_tune_configuration(FineTuneConfiguration(updater=Adam(1e-3)))
+            .set_feature_extractor("feat2")
+            .remove_vertex_and_connections("out")
+            .add_layer("out2", OutputLayer(n_out=5, activation="softmax"), "feat2")
+            .set_outputs("out2")
+            .build())
+
+    # pretrained feature weights carried over
+    np.testing.assert_array_equal(np.asarray(net2.params()["feat1"]["W"]), w_feat1)
+    # new head has the new width
+    assert net2.params()["out2"]["W"].shape == (8, 5)
+    # frozen flags on the feature extractor
+    assert net2.conf.node("feat1").obj.frozen
+    assert net2.conf.node("feat2").obj.frozen
+    assert not net2.conf.node("out2").obj.frozen
+
+    out = np.asarray(net2.output(x))
+    assert out.shape == (48, 5)
+
+    # training updates only the head
+    y2 = np.eye(5, dtype=np.float32)[np.random.default_rng(1).integers(0, 5, 48)]
+    net2.fit(x, y2, epochs=3)
+    np.testing.assert_array_equal(np.asarray(net2.params()["feat1"]["W"]), w_feat1)
+    assert not np.allclose(np.asarray(net2.params()["out2"]["W"]),
+                           np.zeros((8, 5)))
+
+
+def test_graph_transfer_removed_output_must_be_replaced():
+    import pytest
+    net, _ = _trained_graph()
+    builder = (TransferLearning.graph_builder(net)
+               .remove_vertex_and_connections("out"))
+    with pytest.raises(ValueError, match="set_outputs"):
+        builder.build()
+
+
+def test_graph_transfer_downstream_removal():
+    net, _ = _trained_graph()
+    # removing feat2 also removes its dependent "out"
+    b = TransferLearning.graph_builder(net).remove_vertex_and_connections("feat2")
+    assert "out" in b._removed and "feat2" in b._removed and "feat1" not in b._removed
+
+
+def test_transfer_keeps_batchnorm_running_stats():
+    """Frozen feature extractors must carry their BN running stats, not
+    reset to init (zeros/ones)."""
+    from deeplearning4j_tpu.nn import BatchNormalization
+    rng = np.random.default_rng(0)
+    x = (rng.normal(3.0, 2.0, (64, 6))).astype(np.float32)  # non-unit stats
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 64)]
+    g = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2)).graph_builder()
+         .add_inputs("in")
+         .add_layer("bn", BatchNormalization(), "in")
+         .add_layer("out", OutputLayer(n_out=2, activation="softmax"), "bn")
+         .set_outputs("out"))
+    g.set_input_types(InputType.feed_forward(6))
+    from deeplearning4j_tpu.models import ComputationGraph
+    net = ComputationGraph(g.build()).init()
+    net.fit(x, y, epochs=10)
+    trained_mean = np.asarray(net.train_state.model_state["bn"]["mean"])
+    # stats moved well away from init 0 toward the data mean 3.0
+    # (running average with decay 0.9 over 10 updates ≈ (1-0.9^10)*3)
+    assert trained_mean.mean() > 1.0
+
+    net2 = (TransferLearning.graph_builder(net)
+            .set_feature_extractor("bn")
+            .remove_vertex_and_connections("out")
+            .add_layer("out2", OutputLayer(n_out=4, activation="softmax"), "bn")
+            .set_outputs("out2")
+            .build())
+    np.testing.assert_array_equal(
+        np.asarray(net2.train_state.model_state["bn"]["mean"]), trained_mean)
+
+
+def test_feature_extractor_typo_raises():
+    import pytest
+    net, _ = _trained_graph()
+    b = TransferLearning.graph_builder(net).set_feature_extractor("nope")
+    with pytest.raises(ValueError, match="nope"):
+        b.build()
