@@ -37,6 +37,7 @@ from deeplearning4j_tpu.nn.graph_vertices import GraphVertex
 from deeplearning4j_tpu.nn.inputs import InputType
 from deeplearning4j_tpu.models.multi_layer_network import TrainState, _mask_keys
 from deeplearning4j_tpu.nn.base import cast_floating
+from deeplearning4j_tpu.nn.recurrent_layers import BaseRecurrentLayer
 from deeplearning4j_tpu.runtime.environment import get_environment
 from deeplearning4j_tpu.runtime.rng import RngManager
 from deeplearning4j_tpu.train.listeners import TrainingListener
@@ -266,6 +267,7 @@ class ComputationGraph:
             params=new_params, model_state=model_state,
             opt_state=self._tx.init(new_params), step=jnp.zeros((), jnp.int32))
         self._jit_cache.clear()
+        self._rnn_carries = None  # stale hidden state must not cross inits
         return self
 
     def _build_tx(self, params) -> optax.GradientTransformation:
@@ -299,9 +301,12 @@ class ComputationGraph:
 
     # --------------------------------------------------------------- forward
     def _forward_all(self, params, model_state, inputs: Dict[str, jax.Array], *,
-                     training: bool, rng, masks: Optional[Dict[str, Any]] = None):
+                     training: bool, rng, masks: Optional[Dict[str, Any]] = None,
+                     carries: Optional[Dict[str, Any]] = None):
         """Execute the DAG; returns (activations dict incl. pre-output inputs,
-        new model state)."""
+        new model state[, new carries when ``carries`` given]) — the carry
+        path is the graph analog of the reference's ``rnnTimeStep`` stateful
+        inference on ``ComputationGraph``."""
         env = get_environment()
         cdt = env.compute_dtype
         params = cast_floating(params, cdt)
@@ -332,11 +337,21 @@ class ComputationGraph:
                 acts[name] = node.obj.activate(params.get(name, {}), x)
                 continue
             last_inputs[name] = x
-            y, s_new = node.obj.forward(params.get(name, {}), model_state.get(name, {}),
-                                        x, training=training, rng=lrng, mask=mask)
-            if model_state.get(name):
-                new_state[name] = s_new
+            if carries is not None and isinstance(node.obj, BaseRecurrentLayer):
+                y, c_new = node.obj.forward_with_carry(
+                    params.get(name, {}), carries[name], x,
+                    training=training, rng=lrng, mask=mask)
+                carries = dict(carries)
+                carries[name] = c_new
+            else:
+                y, s_new = node.obj.forward(params.get(name, {}),
+                                            model_state.get(name, {}),
+                                            x, training=training, rng=lrng, mask=mask)
+                if model_state.get(name):
+                    new_state[name] = s_new
             acts[name] = y
+        if carries is not None:
+            return acts, last_inputs, new_state, carries
         return acts, last_inputs, new_state
 
     def _loss(self, params, model_state, inputs, labels, rng, masks=None,
@@ -461,6 +476,35 @@ class ComputationGraph:
         fn = self._jitted("output", lambda: jax.jit(fwd))
         outs = fn(self.train_state.params, self.train_state.model_state, inputs)
         return outs[0] if len(outs) == 1 else outs
+
+    def rnn_time_step(self, *xs):
+        """Stateful step-by-step inference (reference
+        ``ComputationGraph.rnnTimeStep``): hidden state carries across calls
+        until :meth:`rnn_clear_previous_state`."""
+        if self.train_state is None:
+            self.init()
+        inputs = {n: jnp.asarray(x) for n, x in zip(self.conf.inputs, xs)}
+        first = next(iter(inputs.values()))
+        if getattr(self, "_rnn_carries", None) is None:
+            self._rnn_carries = {
+                n.name: n.obj.init_carry(first.shape[0], jnp.float32)
+                for n in self.conf.nodes
+                if n.kind == "layer" and isinstance(n.obj, BaseRecurrentLayer)}
+
+        def fwd(params, model_state, inputs_, carries):
+            acts, _, _, new_carries = self._forward_all(
+                params, model_state, inputs_, training=False, rng=None,
+                carries=carries)
+            return [acts[o] for o in self.conf.outputs], new_carries
+
+        fn = self._jitted("rnn_time_step", lambda: jax.jit(fwd))
+        outs, self._rnn_carries = fn(self.train_state.params,
+                                     self.train_state.model_state, inputs,
+                                     self._rnn_carries)
+        return outs[0] if len(outs) == 1 else outs
+
+    def rnn_clear_previous_state(self) -> None:
+        self._rnn_carries = None
 
     def score(self, dataset=None) -> float:
         if dataset is None:

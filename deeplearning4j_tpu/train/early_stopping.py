@@ -190,6 +190,14 @@ class EarlyStoppingTrainer:
             raise ValueError(
                 "EarlyStoppingTrainer needs the TrainingListener API "
                 "(set_listeners/get_listeners) on the network")
+        if cfg.score_calculator is None:
+            scored = [type(c).__name__ for c in cfg.epoch_termination_conditions
+                      if getattr(c, "requires_score", True)]
+            if scored:
+                # score-gated conditions would be skipped every epoch -> the
+                # loop could never terminate
+                raise ValueError(
+                    f"conditions {scored} need a score_calculator")
         best_score, best_epoch = float("inf"), -1
         best_params = None
         scores = {}

@@ -110,3 +110,25 @@ def test_feature_extractor_typo_raises():
     b = TransferLearning.graph_builder(net).set_feature_extractor("nope")
     with pytest.raises(ValueError, match="nope"):
         b.build()
+
+
+def test_graph_rnn_time_step_matches_full_forward():
+    from deeplearning4j_tpu.models import ComputationGraph
+    from deeplearning4j_tpu.nn import LSTM, RnnOutputLayer
+    B, T, F = 2, 6, 4
+    g = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2)).graph_builder()
+         .add_inputs("in")
+         .add_layer("lstm", LSTM(n_out=8), "in")
+         .add_layer("out", RnnOutputLayer(n_out=3, activation="softmax"), "lstm")
+         .set_outputs("out"))
+    g.set_input_types(InputType.recurrent(F, None))
+    net = ComputationGraph(g.build()).init()
+    x = np.random.default_rng(0).normal(0, 1, (B, T, F)).astype(np.float32)
+    full = np.asarray(net.output(x))
+    net.rnn_clear_previous_state()
+    steps = [np.asarray(net.rnn_time_step(x[:, t:t + 1])) for t in range(T)]
+    np.testing.assert_allclose(full[:, -1], steps[-1][:, -1], atol=2e-3)
+    # clearing state restarts the sequence
+    net.rnn_clear_previous_state()
+    again = np.asarray(net.rnn_time_step(x[:, 0:1]))
+    np.testing.assert_allclose(again, steps[0], atol=1e-5)
