@@ -341,3 +341,56 @@ register("huber_loss")(lambda labels, pred, delta=1.0:
                        jnp.mean(jnp.sum(jnp.where(jnp.abs(pred - labels) <= delta,
                                                   0.5 * (pred - labels) ** 2,
                                                   delta * (jnp.abs(pred - labels) - 0.5 * delta)), axis=-1)))
+
+
+# ---- fused recurrent ops (reference sd.rnn() namespace: lstmLayer, gru) ----
+# Thin wrappers over the nn layer implementations — ONE copy of the gate math
+# (deliberate: a recurrence fix in nn/recurrent_layers.py reaches sd.rnn too).
+def _rnn_layer(kind, n_out):
+    from deeplearning4j_tpu.nn import recurrent_layers as rl
+    from deeplearning4j_tpu.nn.base import GlobalConfig
+    layer = {"lstm": rl.LSTM, "gru": rl.GRU}[kind](n_out=n_out)
+    layer._g = GlobalConfig()
+    return layer
+
+
+@register("lstm_layer")
+def _lstm_layer(x, W, W_rec, b, h0=None, c0=None):
+    """Whole-sequence LSTM (reference ``sd.rnn().lstmLayer`` / libnd4j
+    ``lstmLayer``). x: (B, T, F); W: (F, 4H) packed [i,f,g,o]; W_rec:
+    (H, 4H); b: (4H,). Returns (ys, h_T, c_T)."""
+    H = W_rec.shape[0]
+    layer = _rnn_layer("lstm", H)
+    B = x.shape[0]
+    h = jnp.zeros((B, H), x.dtype) if h0 is None else h0
+    c = jnp.zeros((B, H), x.dtype) if c0 is None else c0
+    ys, (h, c) = layer.forward_with_carry(
+        {"W": W, "W_rec": W_rec, "b": b}, (h, c), x)
+    return ys, h, c
+
+
+@register("gru")
+def _gru_op(x, W, W_rec, b, h0=None):
+    """Whole-sequence GRU (reference ``sd.rnn().gru``), packed gates
+    [r, u, n]. Returns (ys, h_T)."""
+    H = W_rec.shape[0]
+    layer = _rnn_layer("gru", H)
+    B = x.shape[0]
+    h = jnp.zeros((B, H), x.dtype) if h0 is None else h0
+    ys, (h,) = layer.forward_with_carry(
+        {"W": W, "W_rec": W_rec, "b": b}, (h,), x)
+    return ys, h
+
+
+@register("lstm_cell")
+def _lstm_cell(x_t, h, c, W, W_rec, b):
+    """Single LSTM step (reference ``sd.rnn().lstmCell``): returns (h', c')."""
+    layer = _rnn_layer("lstm", W_rec.shape[0])
+    return layer._step({"W_rec": W_rec}, h, c, x_t @ W + b)
+
+
+@register("gru_cell")
+def _gru_cell(x_t, h, W, W_rec, b):
+    """Single GRU step (reference ``sd.rnn().gruCell``)."""
+    _, h_n = _gru_op(x_t[:, None, :], W, W_rec, b, h0=h)
+    return h_n

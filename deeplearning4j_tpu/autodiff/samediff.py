@@ -171,7 +171,9 @@ class _Namespace:
             if self._loss_style and args and isinstance(args[0], str) and name is None:
                 name, args = args[0], args[1:]
             vars_ = [self._sd._lift(a) for a in args]
-            return self._sd._apply(op, vars_, attrs=attrs, name=name)
+            n_out = _MULTI_OUTPUT_OPS.get(op, 1)
+            return self._sd._apply(op, vars_, attrs=attrs, name=name,
+                                   n_outputs=n_out)
 
         return call
 
@@ -182,6 +184,9 @@ _NN_OPS = ["relu", "relu6", "leaky_relu", "elu", "selu", "gelu", "sigmoid", "tan
            "hard_sigmoid", "layer_norm", "batch_norm", "bias_add", "linear",
            "dropout", "multi_head_dot_product_attention", "pad", "one_hot"]
 _CNN_OPS = ["conv2d", "max_pool2d", "avg_pool2d", "batch_norm"]
+_RNN_OPS = ["lstm_layer", "gru", "lstm_cell", "gru_cell"]
+# ops whose registry callable returns a tuple (namespace calls unpack them)
+_MULTI_OUTPUT_OPS = {"lstm_layer": 3, "gru": 2, "lstm_cell": 2}
 _LOSS_OPS = ["softmax_cross_entropy", "sparse_softmax_cross_entropy",
              "sigmoid_cross_entropy", "mean_squared_error", "mean_absolute_error",
              "l2_loss", "log_loss", "cosine_distance", "hinge_loss", "huber_loss"]
@@ -227,6 +232,7 @@ class SameDiff:
         self.math = _Namespace(self, _MATH_OPS)
         self.nn = _Namespace(self, _NN_OPS)
         self.cnn = _Namespace(self, _CNN_OPS)
+        self.rnn = _Namespace(self, _RNN_OPS)
         self.loss = _Namespace(self, _LOSS_OPS, loss_style=True)
 
     @staticmethod

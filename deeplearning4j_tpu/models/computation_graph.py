@@ -338,6 +338,7 @@ class ComputationGraph:
                 continue
             last_inputs[name] = x
             if carries is not None and isinstance(node.obj, BaseRecurrentLayer):
+                x = node.obj._apply_input_dropout(x, node.obj._g, training, lrng)
                 y, c_new = node.obj.forward_with_carry(
                     params.get(name, {}), carries[name], x,
                     training=training, rng=lrng, mask=mask)
@@ -485,9 +486,11 @@ class ComputationGraph:
             self.init()
         inputs = {n: jnp.asarray(x) for n, x in zip(self.conf.inputs, xs)}
         first = next(iter(inputs.values()))
+        carry_dt = first.dtype if jnp.issubdtype(first.dtype, jnp.floating) \
+            else get_environment().compute_dtype
         if getattr(self, "_rnn_carries", None) is None:
             self._rnn_carries = {
-                n.name: n.obj.init_carry(first.shape[0], jnp.float32)
+                n.name: n.obj.init_carry(first.shape[0], carry_dt)
                 for n in self.conf.nodes
                 if n.kind == "layer" and isinstance(n.obj, BaseRecurrentLayer)}
 

@@ -45,6 +45,19 @@ class BaseRecurrentLayer(Layer):
     n_out: int = 0
     n_in: Optional[int] = None
 
+    def _cell_act(self):
+        """Cell-output activation: the layer's own setting wins; an explicit
+        non-identity GLOBAL activation is honored; otherwise tanh — the
+        reference's recurrent default (the global default identity would
+        silently change the cell to h = o*c)."""
+        from deeplearning4j_tpu.ops.activations import Activation
+        if self.activation is not None:
+            return get_activation(self.activation)
+        g_act = self._g.activation if self._g is not None else None
+        if g_act not in (None, Activation.IDENTITY, "identity"):
+            return get_activation(g_act)
+        return get_activation("tanh")
+
     def output_type(self, input_type: InputType) -> InputType:
         return InputType.recurrent(self.n_out, input_type.timesteps)
 
@@ -95,7 +108,7 @@ class LSTM(BaseRecurrentLayer):
         restructuring cuDNN's fused LSTM does), leaving only the unavoidable
         sequential ``h @ W_rec`` inside the loop."""
         H = self.n_out
-        act = get_activation(self._act(self._g) if self._act(self._g) is not None else "tanh")
+        act = self._cell_act()
         gate = get_activation(self.gate_activation)
         z = zx_t + h @ params["W_rec"]
         i = gate(z[:, :H])
@@ -139,7 +152,7 @@ class GravesLSTM(LSTM):
 
     def _step(self, params, h, c, zx_t):
         H = self.n_out
-        act = get_activation(self._act(self._g) if self._act(self._g) is not None else "tanh")
+        act = self._cell_act()
         gate = get_activation(self.gate_activation)
         p = params["peephole"]
         z = zx_t + h @ params["W_rec"]
@@ -155,7 +168,8 @@ class GravesLSTM(LSTM):
 @register_layer
 @dataclasses.dataclass
 class SimpleRnn(BaseRecurrentLayer):
-    """Vanilla RNN: h' = act(x W + h W_rec + b) (reference ``SimpleRnn``)."""
+    """Vanilla RNN: h' = act(x W + h W_rec + b) (reference ``SimpleRnn``,
+    default activation tanh)."""
 
     def init(self, key, input_type, g: GlobalConfig):
         n_in, H = self._nin(input_type), self.n_out
@@ -170,7 +184,7 @@ class SimpleRnn(BaseRecurrentLayer):
         return (jnp.zeros((batch, self.n_out), dtype),)
 
     def forward_with_carry(self, params, carry, x, *, training=False, rng=None, mask=None):
-        act = get_activation(self._act(self._g) if self._act(self._g) is not None else "tanh")
+        act = self._cell_act()
         zxs = jnp.swapaxes(x @ params["W"] + params["b"], 0, 1)  # hoisted
         ms = None if mask is None else jnp.swapaxes(mask, 0, 1)
 

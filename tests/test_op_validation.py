@@ -174,6 +174,25 @@ CASES = {
     "huber_loss": ((_LABELS, _LOGITS), {}, None, (1,)),
     "l2_loss": ((_A,), {}, lambda a: 0.5 * (a * a).sum(), (0,)),
     "cosine_distance": ((_A, _B), {}, None, (0, 1)),
+    # fused recurrent ops (sd.rnn namespace)
+    "lstm_layer": ((_R.normal(0, 1, (2, 5, 3)).astype(np.float32),
+                    _R.normal(0, 0.4, (3, 16)).astype(np.float32),
+                    _R.normal(0, 0.4, (4, 16)).astype(np.float32),
+                    np.zeros(16, np.float32)), {}, None, (0, 1, 2)),
+    "gru": ((_R.normal(0, 1, (2, 5, 3)).astype(np.float32),
+             _R.normal(0, 0.4, (3, 12)).astype(np.float32),
+             _R.normal(0, 0.4, (4, 12)).astype(np.float32),
+             np.zeros(12, np.float32)), {}, None, (0, 1, 2)),
+    "lstm_cell": ((_R.normal(0, 1, (2, 3)).astype(np.float32),
+                   np.zeros((2, 4), np.float32), np.zeros((2, 4), np.float32),
+                   _R.normal(0, 0.4, (3, 16)).astype(np.float32),
+                   _R.normal(0, 0.4, (4, 16)).astype(np.float32),
+                   np.zeros(16, np.float32)), {}, None, (0, 3, 4)),
+    "gru_cell": ((_R.normal(0, 1, (2, 3)).astype(np.float32),
+                  np.zeros((2, 4), np.float32),
+                  _R.normal(0, 0.4, (3, 12)).astype(np.float32),
+                  _R.normal(0, 0.4, (4, 12)).astype(np.float32),
+                  np.zeros(12, np.float32)), {}, None, (0, 2, 3)),
 }
 
 
@@ -213,7 +232,8 @@ def test_op_gradient(name):
             full[i] = a
         out = get_op(name)(*[jnp.asarray(a) if isinstance(a, np.ndarray) else a
                              for a in full], **kwargs)
-        return jnp.sum(jnp.asarray(out, jnp.float32) ** 2 / 2)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        return sum(jnp.sum(jnp.asarray(o, jnp.float32) ** 2 / 2) for o in outs)
 
     diff_args = [jnp.asarray(args[i]) for i in grad_idx]
     grads = jax.grad(scalar_fn, argnums=tuple(range(len(diff_args))))(*diff_args)

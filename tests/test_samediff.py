@@ -118,3 +118,62 @@ def test_multi_output_ops():
     parts = sd.invoke("split", a, num_splits=2, axis=0, n_outputs=2)
     p0 = np.asarray(parts[0].eval())
     np.testing.assert_allclose(p0, np.arange(6, dtype=np.float32).reshape(2, 3))
+
+
+def test_rnn_namespace_lstm_layer():
+    """sd.rnn.lstm_layer matches the nn LSTM layer on the same weights."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.autodiff import SameDiff
+    from deeplearning4j_tpu.nn import LSTM, InputType
+    from deeplearning4j_tpu.nn.base import GlobalConfig
+    import jax
+
+    B, T, F, H = 2, 5, 3, 4
+    layer = LSTM(n_out=H)
+    layer._g = GlobalConfig()
+    params, _ = layer.init(jax.random.PRNGKey(0), InputType.recurrent(F, T),
+                           GlobalConfig())
+    x = np.random.default_rng(0).normal(0, 1, (B, T, F)).astype(np.float32)
+    ref, (h_ref, c_ref) = layer.forward_with_carry(
+        params, layer.init_carry(B), jnp.asarray(x))
+
+    sd = SameDiff.create()
+    xin = sd.placeholder("x", shape=(None, T, F))
+    ys, h, c = sd.rnn.lstm_layer(xin, sd.constant("W", np.asarray(params["W"])),
+                                 sd.constant("Wr", np.asarray(params["W_rec"])),
+                                 sd.constant("b", np.asarray(params["b"])))
+    out = sd.output({"x": x}, ys.name, h.name, c.name)
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(ref), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out[1]), np.asarray(h_ref), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out[2]), np.asarray(c_ref), atol=1e-5)
+
+
+def test_rnn_namespace_gru_and_cells():
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.autodiff import SameDiff
+    rng = np.random.default_rng(1)
+    B, T, F, H = 2, 4, 3, 5
+    x = rng.normal(0, 1, (B, T, F)).astype(np.float32)
+    W = rng.normal(0, 0.4, (F, 3 * H)).astype(np.float32)
+    Wr = rng.normal(0, 0.4, (H, 3 * H)).astype(np.float32)
+    b = np.zeros(3 * H, np.float32)
+
+    sd = SameDiff.create()
+    xin = sd.placeholder("x", shape=(None, T, F))
+    ys, h = sd.rnn.gru(xin, sd.constant("W", W), sd.constant("Wr", Wr),
+                       sd.constant("b", b))
+    out = sd.output({"x": x}, ys.name, h.name)
+    assert out[0].shape == (B, T, H)
+    np.testing.assert_allclose(np.asarray(out[0][:, -1]), np.asarray(out[1]),
+                               atol=1e-6)
+
+    # stepping gru_cell through time reproduces the fused op
+    sd2 = SameDiff.create()
+    xt = sd2.placeholder("xt", shape=(None, F))
+    hin = sd2.placeholder("h", shape=(None, H))
+    hout = sd2.rnn.gru_cell(xt, hin, sd2.constant("W", W),
+                            sd2.constant("Wr", Wr), sd2.constant("b", b))
+    hcur = np.zeros((B, H), np.float32)
+    for t in range(T):
+        hcur = np.asarray(sd2.output({"xt": x[:, t], "h": hcur}, hout.name))
+    np.testing.assert_allclose(hcur, np.asarray(out[1]), atol=1e-5)
