@@ -864,3 +864,87 @@ class RecordReaderDataSetIterator(DataSetIterator):
 
     def total_examples(self):
         return len(self._x)
+
+
+# ------------------------------------------------------------------ analysis
+@dataclasses.dataclass
+class NumericalColumnAnalysis:
+    """Reference ``org.datavec.api.transform.analysis.columns.*Analysis``."""
+
+    count: int = 0
+    count_missing: int = 0
+    min: float = float("inf")
+    max: float = float("-inf")
+    mean: float = 0.0
+    stdev: float = 0.0
+
+
+@dataclasses.dataclass
+class CategoricalColumnAnalysis:
+    count: int = 0
+    count_missing: int = 0
+    category_counts: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class StringColumnAnalysis:
+    count: int = 0
+    count_missing: int = 0
+    min_length: int = 0
+    max_length: int = 0
+    count_unique: int = 0
+
+
+class DataAnalysis:
+    """Per-column statistics (reference
+    ``org.datavec.api.transform.analysis.DataAnalysis``)."""
+
+    def __init__(self, schema: Schema, analyses: Dict[str, Any]):
+        self.schema = schema
+        self._analyses = analyses
+
+    def column_analysis(self, name: str):
+        return self._analyses[name]
+
+    def __str__(self):
+        lines = []
+        for c in self.schema.columns:
+            lines.append(f"{c.name} ({c.type.value}): {self._analyses[c.name]}")
+        return "\n".join(lines)
+
+
+class AnalyzeLocal:
+    """Reference ``org.datavec.local.transforms.AnalyzeLocal.analyze``."""
+
+    @staticmethod
+    def analyze(schema: Schema, records: Iterable[List[Any]]) -> DataAnalysis:
+        recs = [list(r) for r in records]
+        analyses: Dict[str, Any] = {}
+        for idx, col in enumerate(schema.columns):
+            values = [r[idx] for r in recs]
+            missing = sum(1 for v in values if v is None or v == "")
+            present = [v for v in values if v is not None and v != ""]
+            if col.type in (ColumnType.INTEGER, ColumnType.DOUBLE,
+                            ColumnType.LONG, ColumnType.TIME):
+                nums = np.asarray([float(v) for v in present], np.float64)
+                analyses[col.name] = NumericalColumnAnalysis(
+                    count=len(present), count_missing=missing,
+                    min=float(nums.min()) if len(nums) else float("nan"),
+                    max=float(nums.max()) if len(nums) else float("nan"),
+                    mean=float(nums.mean()) if len(nums) else float("nan"),
+                    stdev=float(nums.std(ddof=1)) if len(nums) > 1 else 0.0)
+            elif col.type == ColumnType.CATEGORICAL:
+                counts: Dict[str, int] = {}
+                for v in present:
+                    counts[str(v)] = counts.get(str(v), 0) + 1
+                analyses[col.name] = CategoricalColumnAnalysis(
+                    count=len(present), count_missing=missing,
+                    category_counts=counts)
+            else:
+                lens = [len(str(v)) for v in present]
+                analyses[col.name] = StringColumnAnalysis(
+                    count=len(present), count_missing=missing,
+                    min_length=min(lens) if lens else 0,
+                    max_length=max(lens) if lens else 0,
+                    count_unique=len(set(map(str, present))))
+        return DataAnalysis(schema, analyses)

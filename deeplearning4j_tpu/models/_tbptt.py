@@ -1,0 +1,38 @@
+"""Shared truncated-BPTT helpers for MultiLayerNetwork and ComputationGraph.
+
+One implementation of the chunking rules so the two network classes cannot
+drift: what counts as a sequence array, how a time window is sliced, and
+which dtype recurrent carries start in.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def is_sequence_array(v) -> bool:
+    """(B, T, F) float features OR (B, T) integer token ids."""
+    if not hasattr(v, "ndim"):
+        return False
+    return v.ndim == 3 or (v.ndim == 2 and jnp.issubdtype(v.dtype, jnp.integer))
+
+
+def seq_length(v) -> int:
+    return v.shape[1]
+
+
+def slice_time(v, t0: int, length: int):
+    """Window [t0, t0+length) of a sequence array; non-sequence arrays pass
+    through unchanged."""
+    if is_sequence_array(v):
+        return v[:, t0:t0 + length]
+    return v
+
+
+def carry_dtype(sample, compute_dtype):
+    """Recurrent carries start in the input dtype when it is floating (so
+    bf16 stays bf16 through the scan), else the environment compute dtype."""
+    dt = getattr(sample, "dtype", None)
+    if dt is not None and jnp.issubdtype(dt, jnp.floating):
+        return dt
+    return compute_dtype

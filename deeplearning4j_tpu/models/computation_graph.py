@@ -37,6 +37,8 @@ from deeplearning4j_tpu.nn.graph_vertices import GraphVertex
 from deeplearning4j_tpu.nn.inputs import InputType
 from deeplearning4j_tpu.models.multi_layer_network import TrainState, _mask_keys
 from deeplearning4j_tpu.nn.base import cast_floating
+from deeplearning4j_tpu.models._tbptt import (carry_dtype, is_sequence_array,
+                                               seq_length, slice_time)
 from deeplearning4j_tpu.nn.recurrent_layers import BaseRecurrentLayer
 from deeplearning4j_tpu.runtime.environment import get_environment
 from deeplearning4j_tpu.runtime.rng import RngManager
@@ -81,10 +83,15 @@ class GraphBuilder:
         self._input_types = list(types)
         return self
 
+    def tbptt_fwd_length(self, n: int) -> "GraphBuilder":
+        self._tbptt_fwd = int(n)
+        return self
+
     def build(self) -> "ComputationGraphConfiguration":
         conf = ComputationGraphConfiguration(
             global_conf=self._g, inputs=self._inputs, nodes=self._nodes,
-            outputs=self._outputs, input_types=self._input_types)
+            outputs=self._outputs, input_types=self._input_types,
+            tbptt_fwd_length=getattr(self, "_tbptt_fwd", None))
         conf._toposort_and_infer()
         return conf
 
@@ -96,6 +103,7 @@ class ComputationGraphConfiguration:
     nodes: List[GraphNode]
     outputs: List[str]
     input_types: List[InputType] = dataclasses.field(default_factory=list)
+    tbptt_fwd_length: Optional[int] = None
     topo_order: List[str] = dataclasses.field(default_factory=list)
     node_input_types: Dict[str, InputType] = dataclasses.field(default_factory=dict)
 
@@ -178,6 +186,7 @@ class ComputationGraphConfiguration:
             "inputs": self.inputs,
             "outputs": self.outputs,
             "input_types": [t.to_dict() for t in self.input_types],
+            "tbptt_fwd_length": self.tbptt_fwd_length,
             "nodes": [{"name": n.name, "kind": n.kind, "inputs": n.inputs,
                        "obj": n.obj.to_dict()} for n in self.nodes],
         }
@@ -206,7 +215,8 @@ class ComputationGraphConfiguration:
         conf = ComputationGraphConfiguration(
             global_conf=g, inputs=list(d["inputs"]), nodes=nodes,
             outputs=list(d["outputs"]),
-            input_types=[InputType.from_dict(t) for t in d.get("input_types", [])])
+            input_types=[InputType.from_dict(t) for t in d.get("input_types", [])],
+            tbptt_fwd_length=d.get("tbptt_fwd_length"))
         conf._toposort_and_infer()
         return conf
 
@@ -356,9 +366,16 @@ class ComputationGraph:
         return acts, last_inputs, new_state
 
     def _loss(self, params, model_state, inputs, labels, rng, masks=None,
-              training: bool = True):
-        acts, last_inputs, new_state = self._forward_all(
-            params, model_state, inputs, training=training, rng=rng, masks=masks)
+              training: bool = True, carries=None):
+        if carries is not None:
+            acts, last_inputs, new_state, new_carries = self._forward_all(
+                params, model_state, inputs, training=training, rng=rng,
+                masks=masks, carries=carries)
+        else:
+            acts, last_inputs, new_state = self._forward_all(
+                params, model_state, inputs, training=training, rng=rng,
+                masks=masks)
+            new_carries = None
         total = jnp.zeros((), jnp.float32)
         for out_name, y in zip(self.conf.outputs, labels):
             node = self.conf.node(out_name)
@@ -377,7 +394,12 @@ class ComputationGraph:
                     model_state.get(out_name, {}),
                     jax.lax.stop_gradient(last_inputs[out_name]), y)
         total = total + self._reg_score(params)
-        return total, new_state
+        # layer auxiliary losses (e.g. MoE load balancing) — training only
+        if training:
+            for s2 in new_state.values():
+                if isinstance(s2, dict) and "_aux_loss" in s2:
+                    total = total + s2["_aux_loss"]
+        return total, (new_state, new_carries)
 
     def _reg_score(self, params):
         g = self.conf.global_conf
@@ -402,7 +424,8 @@ class ComputationGraph:
     # ------------------------------------------------------------ train/fit
     def _make_train_step(self):
         def step(ts: TrainState, inputs, labels, rng, masks):
-            (loss, new_state), grads = jax.value_and_grad(self._loss, has_aux=True)(
+            (loss, (new_state, _)), grads = jax.value_and_grad(
+                self._loss, has_aux=True)(
                 ts.params, ts.model_state, inputs, labels, rng, masks)
             updates, new_opt = self._tx.update(grads, ts.opt_state, ts.params)
             new_params = optax.apply_updates(ts.params, updates)
@@ -410,6 +433,23 @@ class ComputationGraph:
                               opt_state=new_opt, step=ts.step + 1), loss
 
         return jax.jit(step, donate_argnums=(0,))
+
+    def _make_tbptt_step(self):
+        """Train step carrying recurrent state across truncated chunks
+        (reference: tBPTT on ComputationGraph)."""
+        def step(ts: TrainState, carries, inputs, labels, rng, masks):
+            (loss, (new_state, new_carries)), grads = jax.value_and_grad(
+                self._loss, has_aux=True)(
+                ts.params, ts.model_state, inputs, labels, rng, masks,
+                True, carries)
+            updates, new_opt = self._tx.update(grads, ts.opt_state, ts.params)
+            new_params = optax.apply_updates(ts.params, updates)
+            new_carries = jax.tree.map(jax.lax.stop_gradient, new_carries)
+            return (TrainState(params=new_params, model_state=new_state,
+                               opt_state=new_opt, step=ts.step + 1),
+                    new_carries, loss)
+
+        return jax.jit(step, donate_argnums=(0, 1))
 
     def _jitted(self, name, factory):
         if name not in self._jit_cache:
@@ -451,6 +491,10 @@ class ComputationGraph:
             iterator.reset()
             for batch in iterator:
                 inputs, labels_, masks = self._coerce_batch(batch)
+                if self.conf.tbptt_fwd_length and any(
+                        is_sequence_array(v) for v in inputs.values()):
+                    self._fit_tbptt(inputs, labels_, masks)
+                    continue
                 rng = self.rng.next_key()
                 self.train_state, loss = step_fn(self.train_state, inputs, labels_, rng, masks)
                 self._score = loss
@@ -461,6 +505,34 @@ class ComputationGraph:
                 lst.on_epoch_end(self, self._epoch)
             self._epoch += 1
         return self
+
+    def _fit_tbptt(self, inputs, labels_, masks):
+        """Chunk the time axis into tbptt-length windows, carrying hidden
+        state between them (reference: tBPTT on ComputationGraph)."""
+        L = int(self.conf.tbptt_fwd_length)
+        T = max(seq_length(v) for v in inputs.values() if is_sequence_array(v))
+        first = next(iter(inputs.values()))
+        dt = carry_dtype(first, get_environment().compute_dtype)
+        carries = {
+            n.name: n.obj.init_carry(first.shape[0], dt)
+            for n in self.conf.nodes
+            if n.kind == "layer" and isinstance(n.obj, BaseRecurrentLayer)}
+        step_fn = self._jitted("tbptt_step", self._make_tbptt_step)
+        for t0 in range(0, T, L):
+            ci = {k: slice_time(v, t0, L) for k, v in inputs.items()}
+            cl = [y[:, t0:t0 + L] if hasattr(y, "ndim") and y.ndim == 3 else y
+                  for y in labels_]
+            cm = None if masks is None else {
+                k: (m[:, t0:t0 + L] if hasattr(m, "ndim") and m.ndim >= 2
+                    and m.shape[1] == T else m)
+                for k, m in masks.items()}
+            rng = self.rng.next_key()
+            self.train_state, carries, loss = step_fn(
+                self.train_state, carries, ci, cl, rng, cm)
+            self._score = loss
+            self._iteration += 1
+            for lst in self._listeners:
+                lst.iteration_done(self, self._iteration, self._epoch, loss)
 
     # ------------------------------------------------------------- inference
     def output(self, *xs, training: bool = False):
@@ -486,8 +558,7 @@ class ComputationGraph:
             self.init()
         inputs = {n: jnp.asarray(x) for n, x in zip(self.conf.inputs, xs)}
         first = next(iter(inputs.values()))
-        carry_dt = first.dtype if jnp.issubdtype(first.dtype, jnp.floating) \
-            else get_environment().compute_dtype
+        carry_dt = carry_dtype(first, get_environment().compute_dtype)
         if getattr(self, "_rnn_carries", None) is None:
             self._rnn_carries = {
                 n.name: n.obj.init_carry(first.shape[0], carry_dt)
@@ -515,7 +586,8 @@ class ComputationGraph:
         inputs, labels, masks = self._coerce_batch(dataset)
 
         def score_fn(params, model_state, i_, l_, m_):
-            loss, _ = self._loss(params, model_state, i_, l_, None, m_, training=False)
+            loss, _ = self._loss(params, model_state, i_, l_, None, m_,
+                                 training=False)
             return loss
 
         fn = self._jitted("score", lambda: jax.jit(score_fn))

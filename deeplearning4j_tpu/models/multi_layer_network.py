@@ -30,6 +30,8 @@ import optax
 from deeplearning4j_tpu.nn.base import GlobalConfig, Layer
 from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.core_layers import LossLayer, OutputLayer
+from deeplearning4j_tpu.models._tbptt import (carry_dtype, is_sequence_array,
+                                               slice_time)
 from deeplearning4j_tpu.nn.recurrent_layers import BaseRecurrentLayer
 from deeplearning4j_tpu.runtime.environment import get_environment
 from deeplearning4j_tpu.runtime.rng import RngManager
@@ -294,7 +296,7 @@ class MultiLayerNetwork:
                 # per-timestep labels (reference tBPTT/masking semantics)
                 lm = jnp.asarray(batch.labels_mask) if batch.labels_mask is not None \
                     else (self._output_time_mask(fm) if y.ndim == 3 else None)
-                if self.conf.tbptt_fwd_length and x.ndim == 3:
+                if self.conf.tbptt_fwd_length and is_sequence_array(x):
                     self._fit_tbptt(x, y, fm, lm)
                     continue
                 rng = self.rng.next_key()
@@ -315,10 +317,11 @@ class MultiLayerNetwork:
         (reference: truncated BPTT in ``MultiLayerNetwork.fitHelper``)."""
         T = x.shape[1]
         L = int(self.conf.tbptt_fwd_length)
-        carries = self._zero_carries(x.shape[0], x.dtype)
+        carries = self._zero_carries(
+            x.shape[0], carry_dtype(x, get_environment().compute_dtype))
         step_fn = self._jitted("tbptt_step", self._make_tbptt_step)
         for t0 in range(0, T, L):
-            xs = x[:, t0:t0 + L]
+            xs = slice_time(x, t0, L)
             ys = y[:, t0:t0 + L] if y.ndim >= 3 else y
             fms = fmask[:, t0:t0 + L] if fmask is not None else None
             lms = lmask[:, t0:t0 + L] if lmask is not None else None

@@ -105,3 +105,23 @@ def test_convert_to_sequence_and_offset():
            .build())
     seqs3 = LocalTransformExecutor.execute(_TXNS, tp3)
     assert [r[1] for r in seqs3[0]] == [30.0, 10.0]  # shifted by one, trimmed
+
+
+def test_analyze_local():
+    from deeplearning4j_tpu.data.records import AnalyzeLocal
+    schema = (Schema.builder()
+              .add_column_string("name")
+              .add_column_double("amount")
+              .add_column_categorical("kind", ["a", "b"])
+              .build())
+    recs = [["alice", 10.0, "a"], ["bob", 20.0, "b"], ["", 30.0, "a"],
+            ["carol", None, "a"]]
+    an = AnalyzeLocal.analyze(schema, recs)
+    num = an.column_analysis("amount")
+    assert num.count == 3 and num.count_missing == 1
+    assert num.min == 10.0 and num.max == 30.0 and abs(num.mean - 20.0) < 1e-9
+    cat = an.column_analysis("kind")
+    assert cat.category_counts == {"a": 3, "b": 1}
+    st = an.column_analysis("name")
+    assert st.count_missing == 1 and st.count_unique == 3
+    assert "amount" in str(an)

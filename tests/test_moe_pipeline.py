@@ -163,3 +163,28 @@ def test_expert_parallel_indivisible_rejected():
     net = MultiLayerNetwork(conf).init()
     with pytest.raises(ValueError, match="divisible"):
         strat.param_sharding(net.train_state.params)
+
+
+def test_moe_aux_loss_in_computation_graph():
+    """CG training loss includes the MoE load-balancing aux term (training
+    only), mirroring MultiLayerNetwork."""
+    from deeplearning4j_tpu.models import ComputationGraph
+    from deeplearning4j_tpu.nn import NeuralNetConfiguration as NNC, OutputLayer
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (16, 6)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+    g = (NNC.builder().seed(0).updater(Adam(1e-2)).graph_builder()
+         .add_inputs("in")
+         .add_layer("moe", MixtureOfExperts(n_out=8, n_experts=4, top_k=2,
+                                            activation="relu",
+                                            aux_loss_coef=10.0), "in")
+         .add_layer("out", OutputLayer(n_out=2, activation="softmax"), "moe")
+         .set_outputs("out"))
+    g.set_input_types(InputType.feed_forward(6))
+    net = ComputationGraph(g.build()).init()
+    from deeplearning4j_tpu.data.dataset import DataSet
+    eval_score = net.score(DataSet(x, y))          # training=False: no aux
+    net.fit(x, y, epochs=1)
+    train_score = float(net._score)                # training=True: + aux
+    # huge coefficient makes the aux term visible in the training loss
+    assert train_score > eval_score + 1.0

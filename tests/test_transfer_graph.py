@@ -132,3 +132,53 @@ def test_graph_rnn_time_step_matches_full_forward():
     net.rnn_clear_previous_state()
     again = np.asarray(net.rnn_time_step(x[:, 0:1]))
     np.testing.assert_allclose(again, steps[0], atol=1e-5)
+
+
+def test_graph_tbptt_training():
+    """tBPTT on ComputationGraph: long sequence trained in carried chunks."""
+    from deeplearning4j_tpu.models import ComputationGraph
+    from deeplearning4j_tpu.nn import LSTM, RnnOutputLayer
+    B, T, V = 4, 24, 6
+    seq = np.tile(np.arange(V), (B, T // V + 2))[:, :T + 1]
+    x = np.eye(V, dtype=np.float32)[seq[:, :-1]]
+    y = np.eye(V, dtype=np.float32)[seq[:, 1:]]
+    g = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2)).graph_builder()
+         .add_inputs("in")
+         .add_layer("lstm", LSTM(n_out=24), "in")
+         .add_layer("out", RnnOutputLayer(n_out=V, activation="softmax"), "lstm")
+         .set_outputs("out")
+         .tbptt_fwd_length(8))
+    g.set_input_types(InputType.recurrent(V, None))
+    conf = g.build()
+    assert conf.tbptt_fwd_length == 8
+    net = ComputationGraph(conf).init()
+    it0 = net._iteration
+    net.fit(x, y, epochs=30)
+    # 3 chunks per minibatch: iteration counter advanced accordingly
+    assert (net._iteration - it0) == 30 * 3
+    acc = (np.asarray(net.output(x)).argmax(-1) == seq[:, 1:]).mean()
+    assert acc > 0.9
+    # serde keeps the tbptt setting
+    from deeplearning4j_tpu.models.computation_graph import ComputationGraphConfiguration
+    back = ComputationGraphConfiguration.from_dict(conf.to_dict())
+    assert back.tbptt_fwd_length == 8
+
+
+def test_tbptt_with_integer_token_inputs():
+    """(B, T) int token sequences must take the tBPTT path too, not silently
+    full-BPTT."""
+    from deeplearning4j_tpu.nn import EmbeddingSequenceLayer, LSTM, RnnOutputLayer
+    B, T, V = 4, 20, 6
+    seq = np.tile(np.arange(V), (B, T // V + 2))[:, :T + 1]
+    toks = seq[:, :-1].astype(np.int32)
+    y = np.eye(V, dtype=np.float32)[seq[:, 1:]]
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2)).list()
+            .layer(EmbeddingSequenceLayer(n_in=V, n_out=8))
+            .layer(LSTM(n_out=16))
+            .layer(RnnOutputLayer(n_out=V, activation="softmax"))
+            .tbptt_fwd_length(5)
+            .set_input_type(InputType.recurrent(V, None)).build())
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    net = MultiLayerNetwork(conf).init()
+    net.fit(toks, y, epochs=2)
+    assert net._iteration == 2 * 4  # 4 chunks of length 5 per epoch
