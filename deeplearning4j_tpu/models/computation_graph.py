@@ -61,6 +61,7 @@ class GraphBuilder:
         self._nodes: List[GraphNode] = []
         self._outputs: List[str] = []
         self._input_types: List[InputType] = []
+        self._tbptt_fwd: Optional[int] = None
 
     def add_inputs(self, *names: str) -> "GraphBuilder":
         self._inputs.extend(names)
@@ -91,7 +92,7 @@ class GraphBuilder:
         conf = ComputationGraphConfiguration(
             global_conf=self._g, inputs=self._inputs, nodes=self._nodes,
             outputs=self._outputs, input_types=self._input_types,
-            tbptt_fwd_length=getattr(self, "_tbptt_fwd", None))
+            tbptt_fwd_length=self._tbptt_fwd)
         conf._toposort_and_infer()
         return conf
 
