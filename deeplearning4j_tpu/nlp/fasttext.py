@@ -272,3 +272,58 @@ class FastText:
 
     def word_vectors_for(self, words: Sequence[str]) -> np.ndarray:
         return np.stack([self.get_word_vector(w) for w in words])
+
+
+    # ---- persistence (reference FastText model save/load) ----
+    def save(self, path: str) -> None:
+        """Save the full model (config + vocab incl. counts + tables) to one
+        .npz. The tokenizer factory is NOT serialized (it may be arbitrary
+        code) — pass the same one to :meth:`load`."""
+        import json
+        if self.vocab is None or self.table is None:
+            raise ValueError("fit() before save()")
+        cfg = dict(supervised=self.supervised, dim=self.dim,
+                   window_size=self.window_size,
+                   min_word_frequency=self.min_word_frequency,
+                   min_n=self.min_n, max_n=self.max_n, bucket=self.bucket,
+                   negative=self.negative, epochs=self.epochs,
+                   batch_size=self.batch_size, learning_rate=self.learning_rate,
+                   seed=self.seed, max_features=self.max_features,
+                   doc_max_features=self.doc_max_features)
+        words = [self.vocab.word_at_index(i) for i in range(len(self.vocab))]
+        meta = dict(config=cfg, labels=self.labels_, words=words,
+                    counts={w: int(self.vocab.counts[w]) for w in words})
+        arrays = {"meta": np.frombuffer(json.dumps(meta).encode(), np.uint8),
+                  "table": np.asarray(self.table)}
+        if self.emb_out is not None:
+            arrays["emb_out"] = np.asarray(self.emb_out)
+        if self.W is not None:
+            arrays["W"] = np.asarray(self.W)
+            arrays["b"] = np.asarray(self.b)
+        np.savez(path if path.endswith(".npz") else path + ".npz", **arrays)
+
+    @classmethod
+    def load(cls, path: str,
+             tokenizer_factory: Optional[TokenizerFactory] = None) -> "FastText":
+        """Load a saved model. Supply the SAME ``tokenizer_factory`` used at
+        training time if it was customized."""
+        import json
+        with np.load(path if path.endswith(".npz") else path + ".npz") as data:
+            meta = json.loads(bytes(data["meta"]).decode())
+            table = jnp.asarray(data["table"])
+            emb_out = jnp.asarray(data["emb_out"]) if "emb_out" in data else None
+            W = jnp.asarray(data["W"]) if "W" in data else None
+            b = jnp.asarray(data["b"]) if "b" in data else None
+        ft = cls(tokenizer_factory=tokenizer_factory, **meta["config"])
+        # rebuild the vocab DIRECTLY in the saved index order with the true
+        # frequency counts (refitting would re-apply min_word_frequency to
+        # count-1 words and would lose the unigram sampling distribution)
+        vocab = VocabCache(ft.min_word_frequency)
+        for i, w in enumerate(meta["words"]):
+            vocab.word2idx[w] = i
+            vocab.idx2word.append(w)
+            vocab.counts[w] = meta["counts"][w]
+        ft.vocab = vocab
+        ft.table, ft.emb_out, ft.W, ft.b = table, emb_out, W, b
+        ft.labels_ = list(meta["labels"])
+        return ft

@@ -48,3 +48,32 @@ def test_supervised_classification():
     assert set(probs) == {"pos", "neg"}
     assert abs(sum(probs.values()) - 1.0) < 1e-5
     assert probs["pos"] > 0.5
+
+
+def test_save_load_roundtrip(tmp_path):
+    # min_word_frequency=2 exercises the direct vocab rebuild (a refit would
+    # prune every word back to count 1 and crash)
+    ft = FastText(dim=12, epochs=2, bucket=500, seed=0, min_word_frequency=2)
+    ft.fit(_CORPUS)
+    p = str(tmp_path / "ft_model")
+    ft.save(p)
+    back = FastText.load(p)
+    v1, v2 = ft.get_word_vector("fox"), back.get_word_vector("fox")
+    np.testing.assert_allclose(v1, v2, atol=1e-6)
+    # OOV composition identical (same hashed buckets)
+    np.testing.assert_allclose(ft.get_word_vector("foxish"),
+                               back.get_word_vector("foxish"), atol=1e-6)
+
+    clf = FastText(supervised=True, dim=8, epochs=20, bucket=300,
+                   learning_rate=0.5, seed=1)
+    clf.fit(["good great"] * 6 + ["bad awful"] * 6, ["pos"] * 6 + ["neg"] * 6)
+    p2 = str(tmp_path / "ft_clf")
+    clf.save(p2)
+    back2 = FastText.load(p2)
+    assert back2.predict("good great") == clf.predict("good great") == "pos"
+    np.testing.assert_allclose(clf.predict_probability("bad")["neg"],
+                               back2.predict_probability("bad")["neg"], atol=1e-6)
+    # frequencies and sampling distribution survive the round trip
+    assert back.vocab.counts["fox"] == ft.vocab.counts["fox"] > 1
+    np.testing.assert_allclose(ft.vocab.negative_sampling_probs(),
+                               back.vocab.negative_sampling_probs(), atol=1e-9)
