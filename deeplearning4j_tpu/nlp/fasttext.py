@@ -292,7 +292,7 @@ class FastText:
                    doc_max_features=self.doc_max_features)
         words = [self.vocab.word_at_index(i) for i in range(len(self.vocab))]
         meta = dict(config=cfg, labels=self.labels_, words=words,
-                    counts={w: int(self.vocab.counts[w]) for w in words})
+                    counts={w: int(c) for w, c in self.vocab.counts.items()})
         arrays = {"meta": np.frombuffer(json.dumps(meta).encode(), np.uint8),
                   "table": np.asarray(self.table)}
         if self.emb_out is not None:
@@ -315,15 +315,8 @@ class FastText:
             W = jnp.asarray(data["W"]) if "W" in data else None
             b = jnp.asarray(data["b"]) if "b" in data else None
         ft = cls(tokenizer_factory=tokenizer_factory, **meta["config"])
-        # rebuild the vocab DIRECTLY in the saved index order with the true
-        # frequency counts (refitting would re-apply min_word_frequency to
-        # count-1 words and would lose the unigram sampling distribution)
-        vocab = VocabCache(ft.min_word_frequency)
-        for i, w in enumerate(meta["words"]):
-            vocab.word2idx[w] = i
-            vocab.idx2word.append(w)
-            vocab.counts[w] = meta["counts"][w]
-        ft.vocab = vocab
+        ft.vocab = VocabCache.restore(meta["words"], meta["counts"],
+                                      ft.min_word_frequency)
         ft.table, ft.emb_out, ft.W, ft.b = table, emb_out, W, b
         ft.labels_ = list(meta["labels"])
         return ft

@@ -28,6 +28,21 @@ class VocabCache:
                 self.idx2word.append(w)
         return self
 
+    @classmethod
+    def restore(cls, words: List[str], counts: Dict[str, int],
+                min_word_frequency: int = 1) -> "VocabCache":
+        """Rebuild a cache with an EXACT retained-word index order (``words``)
+        and the full frequency table (``counts`` may include words below
+        ``min_word_frequency`` that were pruned from the index). Used by model
+        deserialization — refitting would reorder ties and drop count-1 words'
+        frequencies."""
+        vocab = cls(min_word_frequency)
+        vocab.counts.update(counts)
+        for i, w in enumerate(words):
+            vocab.word2idx[w] = i
+            vocab.idx2word.append(w)
+        return vocab
+
     def __len__(self) -> int:
         return len(self.idx2word)
 

@@ -1,4 +1,4 @@
-"""Flash attention as a Pallas TPU kernel.
+"""Flash attention as Pallas TPU kernels — forward AND fused backward.
 
 The hand-written-kernel layer of the framework (the role cuDNN's fused
 attention / libnd4j's CUDA helpers play in the reference — SURVEY.md §7.2):
@@ -10,13 +10,21 @@ in BLOCK_K chunks with the classic flash update:
     l' = l * e^{m-m'} + rowsum(e^{S_blk - m'})
     acc' = acc * e^{m-m'} + e^{S_blk - m'} @ V_blk
 
-Backward is jax.custom_vjp with XLA recompute (standard softmax form) —
-correct everywhere; a fused Pallas backward is a future optimisation.
+The forward additionally emits the per-row logsumexp L = m + log(l), which
+the backward uses to recompute P = exp(S - L) blockwise (never storing the
+(T, T) matrix):
+
+    D   = rowsum(dO * O)                  (precomputed, fused by XLA)
+    dV += P^T @ dO
+    dP  = dO @ V^T
+    dS  = P * (dP - D) * scale
+    dQ += dS @ K        (dq kernel: grid over query blocks)
+    dK += dS^T @ Q      (dkv kernel: grid over key blocks)
 
 Used automatically by ``nn.attention_layers.dot_product_attention`` when
 shapes/platform allow; fall back is the XLA softmax form. Set
-``DL4J_TPU_PALLAS_INTERPRET=1`` to run the kernel in interpreter mode on CPU
-(test path).
+``DL4J_TPU_PALLAS_INTERPRET=1`` to run the kernels in interpreter mode on
+CPU (test path).
 """
 
 from __future__ import annotations
@@ -57,7 +65,11 @@ def flash_attention_compatible(q, k, v, mask=None) -> bool:
     return False
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, block_k: int):
+# ---------------------------------------------------------------- forward
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
+                block_k: int):
     q = q_ref[0].astype(jnp.float32)  # (BLOCK_Q, D)
     t_k = k_ref.shape[1]
     n_blocks = t_k // block_k
@@ -80,51 +92,167 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, block_k: int):
     m = jnp.full((bq,), -jnp.inf, jnp.float32)
     l = jnp.zeros((bq,), jnp.float32)
     acc, m, l = jax.lax.fori_loop(0, n_blocks, body, (acc, m, l))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-20)[:, None]).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-20)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)
 
 
 def _flash_fwd(q, k, v, scale):
     b, h, t_q, d = q.shape
     t_k = k.shape[2]
+    d_v = v.shape[-1]
     qf = q.reshape(b * h, t_q, d)
     kf = k.reshape(b * h, t_k, d)
-    vf = v.reshape(b * h, t_k, v.shape[-1])
+    vf = v.reshape(b * h, t_k, d_v)
     grid = (b * h, t_q // BLOCK_Q)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, block_k=BLOCK_K),
-        out_shape=jax.ShapeDtypeStruct((b * h, t_q, vf.shape[-1]), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t_q, d_v), q.dtype),
+            jax.ShapeDtypeStruct((b * h, t_q), jnp.float32),
+        ],
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, BLOCK_Q, d), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((1, t_k, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, t_k, vf.shape[-1]), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, t_k, d_v), lambda bh, qi: (bh, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, BLOCK_Q, vf.shape[-1]), lambda bh, qi: (bh, qi, 0)),
+        out_specs=[
+            pl.BlockSpec((1, BLOCK_Q, d_v), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, BLOCK_Q), lambda bh, qi: (bh, qi)),
+        ],
         interpret=_interpret(),
     )(qf, kf, vf)
-    return out.reshape(b, h, t_q, vf.shape[-1])
+    return (out.reshape(b, h, t_q, d_v),
+            lse.reshape(b, h, t_q))
+
+
+# ---------------------------------------------------------------- backward
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, scale: float, block_k: int):
+    q = q_ref[0].astype(jnp.float32)          # (BQ, D)
+    do = do_ref[0].astype(jnp.float32)        # (BQ, Dv)
+    lse = lse_ref[0]                          # (BQ,)
+    delta = delta_ref[0]                      # (BQ,)
+    t_k = k_ref.shape[1]
+    n_blocks = t_k // block_k
+
+    def body(i, dq_acc):
+        k_blk = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ()))) * scale
+        p = jnp.exp(s - lse[:, None])                       # (BQ, BK)
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())))
+        ds = p * (dp - delta[:, None]) * scale
+        return dq_acc + jax.lax.dot(ds, k_blk)
+
+    dq = jax.lax.fori_loop(0, n_blocks,
+                           body, jnp.zeros(q.shape, jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale: float, block_q: int):
+    k = k_ref[0].astype(jnp.float32)          # (BK, D)
+    v = v_ref[0].astype(jnp.float32)          # (BK, Dv)
+    t_q = q_ref.shape[1]
+    n_blocks = t_q // block_q
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse_blk = lse_ref[0, pl.ds(i * block_q, block_q)]
+        delta_blk = delta_ref[0, pl.ds(i * block_q, block_q)]
+        s = jax.lax.dot_general(q_blk, k, (((1,), (1,)), ((), ()))) * scale
+        p = jnp.exp(s - lse_blk[:, None])                   # (BQ, BK)
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())))            # (BK, Dv)
+        dp = jax.lax.dot_general(do_blk, v, (((1,), (1,)), ((), ())))
+        ds = p * (dp - delta_blk[:, None]) * scale          # (BQ, BK)
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())))            # (BK, D)
+        return dk_acc, dv_acc
+
+    dk, dv = jax.lax.fori_loop(
+        0, n_blocks, body,
+        (jnp.zeros(k.shape, jnp.float32), jnp.zeros(v.shape, jnp.float32)))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, g, scale):
+    b, h, t_q, d = q.shape
+    t_k = k.shape[2]
+    d_v = v.shape[-1]
+    # D = rowsum(dO * O): cheap elementwise-reduce, fused by XLA.
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    qf = q.reshape(b * h, t_q, d)
+    kf = k.reshape(b * h, t_k, d)
+    vf = v.reshape(b * h, t_k, d_v)
+    dof = g.reshape(b * h, t_q, d_v)
+    lsef = lse.reshape(b * h, t_q)
+    deltaf = delta.reshape(b * h, t_q)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, block_k=BLOCK_K),
+        out_shape=jax.ShapeDtypeStruct((b * h, t_q, d), q.dtype),
+        grid=(b * h, t_q // BLOCK_Q),
+        in_specs=[
+            pl.BlockSpec((1, BLOCK_Q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, t_k, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, t_k, d_v), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, BLOCK_Q, d_v), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, BLOCK_Q), lambda bh, qi: (bh, qi)),
+            pl.BlockSpec((1, BLOCK_Q), lambda bh, qi: (bh, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, BLOCK_Q, d), lambda bh, qi: (bh, qi, 0)),
+        interpret=_interpret(),
+    )(qf, kf, vf, dof, lsef, deltaf)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, block_q=BLOCK_Q),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t_k, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, t_k, d_v), v.dtype),
+        ],
+        grid=(b * h, t_k // BLOCK_K),
+        in_specs=[
+            pl.BlockSpec((1, t_q, d), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, BLOCK_K, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, BLOCK_K, d_v), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, t_q, d_v), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, t_q), lambda bh, ki: (bh, 0)),
+            pl.BlockSpec((1, t_q), lambda bh, ki: (bh, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, BLOCK_K, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, BLOCK_K, d_v), lambda bh, ki: (bh, ki, 0)),
+        ],
+        interpret=_interpret(),
+    )(qf, kf, vf, dof, lsef, deltaf)
+
+    return (dq.reshape(b, h, t_q, d), dk.reshape(b, h, t_k, d),
+            dv.reshape(b, h, t_k, d_v))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def _flash(q, k, v, scale):
-    return _flash_fwd(q, k, v, scale)
+    out, _ = _flash_fwd(q, k, v, scale)
+    return out
 
 
 def _flash_vjp_fwd(q, k, v, scale):
-    return _flash_fwd(q, k, v, scale), (q, k, v)
+    out, lse = _flash_fwd(q, k, v, scale)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(scale, res, g):
-    q, k, v = res
-
-    def ref_attn(q, k, v):
-        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                       k.astype(jnp.float32)) * scale
-        w = jax.nn.softmax(s, axis=-1)
-        return jnp.einsum("bhqk,bhkd->bhqd", w, v.astype(jnp.float32)).astype(q.dtype)
-
-    _, vjp = jax.vjp(ref_attn, q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_bwd(q, k, v, out, lse, g, scale)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

@@ -63,3 +63,45 @@ def test_incompatible_shapes_fall_back():
     assert not flash_attention_compatible(q, q, q)
     q2 = jnp.zeros((1, 1, 128, 64))
     assert not flash_attention_compatible(q2, q2, q2, mask=jnp.ones((1, 1, 1, 128)))
+
+
+def test_flash_attention_fused_backward_cross_and_bf16():
+    """The backward is now its own pair of Pallas kernels (dq / dkv) — check
+    them against the XLA softmax form for cross-attention shapes and bf16."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops.pallas.flash_attention import flash_attention
+    rng = np.random.default_rng(2)
+    B, H, TQ, TK, D = 1, 2, 128, 256, 64
+
+    def make(dtype):
+        q = jnp.asarray(rng.normal(0, 1, (B, H, TQ, D)), dtype)
+        k = jnp.asarray(rng.normal(0, 1, (B, H, TK, D)), dtype)
+        v = jnp.asarray(rng.normal(0, 1, (B, H, TK, D)), dtype)
+        return q, k, v
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v).astype(jnp.float32) ** 2)
+
+    def loss_ref(q, k, v):
+        d = q.shape[-1]
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) / np.sqrt(d)
+        w = jax.nn.softmax(s, axis=-1)
+        return jnp.sum(jnp.einsum("bhqk,bhkd->bhqd", w,
+                                  v.astype(jnp.float32)) ** 2)
+
+    q, k, v = make(jnp.float32)
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4)
+
+    qb, kb, vb = make(jnp.bfloat16)
+    gb = jax.grad(loss_flash, argnums=(0, 1, 2))(qb, kb, vb)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(
+        qb.astype(jnp.float32), kb.astype(jnp.float32), vb.astype(jnp.float32))
+    for a, b in zip(gb, gr):
+        np.testing.assert_allclose(np.asarray(a).astype(np.float32),
+                                   np.asarray(b), rtol=0.1, atol=0.5)
