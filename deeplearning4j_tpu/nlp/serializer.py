@@ -40,12 +40,7 @@ class WordVectorSerializer:
         import jax.numpy as jnp
         words, rows = WordVectorSerializer.read_word_vectors(path)
         w2v = Word2Vec(layer_size=rows.shape[1], min_word_frequency=1)
-        vocab = VocabCache(1)
-        for w in words:
-            vocab.counts[w] = 1
-            vocab.word2idx[w] = len(vocab.idx2word)
-            vocab.idx2word.append(w)
-        w2v.vocab = vocab
+        w2v.vocab = VocabCache.restore(words, {w: 1 for w in words}, 1)
         w2v.emb_in = jnp.asarray(rows)
         w2v.emb_out = jnp.zeros_like(w2v.emb_in)
         return w2v
