@@ -28,12 +28,84 @@ from deeplearning4j_tpu.nn import (
 from deeplearning4j_tpu.nn.preprocessors import CnnToFeedForwardPreProcessor
 
 
+# Custom-layer SPI (reference ``KerasLayer.registerCustomLayer``): maps a
+# Keras class name to a factory ``(keras_layer, config_dict) -> Layer``.
+_CUSTOM_LAYER_REGISTRY: Dict[str, object] = {}
+
+
+def register_custom_layer(keras_class_name: str, factory) -> None:
+    """Register a mapper for a custom Keras layer class. ``factory`` is
+    called with ``(keras_layer, get_config() dict)`` and returns one of our
+    layer configs (or None for a structural no-op)."""
+    _CUSTOM_LAYER_REGISTRY[keras_class_name] = factory
+
+
+def register_lambda_layer(name: str, fn) -> None:
+    """Reference ``KerasLayer.registerLambdaLayer``: Keras never serializes
+    Lambda code, so imports resolve Lambda layers BY NAME from this registry
+    (``fn`` is any jax-traceable ``x -> y``)."""
+    from deeplearning4j_tpu.nn.misc_layers import register_lambda
+    register_lambda(name, fn)
+
+
+def _archive_lambda_names(path: str) -> List[str]:
+    """Names of every Lambda layer in a ``.keras``/``.h5`` archive, read from
+    the config JSON WITHOUT deserializing any layer (no code can run)."""
+    import json
+    import zipfile
+
+    def walk(node, out):
+        if isinstance(node, dict):
+            if node.get("class_name") == "Lambda":
+                out.append(node.get("config", {}).get("name", ""))
+            for v in node.values():
+                walk(v, out)
+        elif isinstance(node, list):
+            for v in node:
+                walk(v, out)
+
+    names: List[str] = []
+    if zipfile.is_zipfile(path):
+        with zipfile.ZipFile(path) as z:
+            cfg = json.loads(z.read("config.json"))
+        walk(cfg, names)
+    else:  # legacy HDF5: model_config attr
+        import h5py  # bundled with tensorflow
+        with h5py.File(path, "r") as f:
+            raw = f.attrs.get("model_config")
+            if raw is not None:
+                if isinstance(raw, bytes):
+                    raw = raw.decode()
+                walk(json.loads(raw), names)
+    return names
+
+
 class KerasModelImport:
     @staticmethod
     def import_keras_model_and_weights(path: str):
         """Returns a MultiLayerNetwork (Sequential) or ComputationGraph."""
         import tensorflow as tf
-        km = tf.keras.models.load_model(path, compile=False)
+        from deeplearning4j_tpu.nn.misc_layers import _LAMBDA_REGISTRY
+        try:
+            km = tf.keras.models.load_model(path, compile=False)
+        except ValueError as e:
+            if "Lambda" not in str(e):
+                raise
+            # Disabling Keras safe mode runs the archive's pickled lambda
+            # code at load time, so require EVERY Lambda in the archive to
+            # have a registered replacement first — registering each name is
+            # the user's per-layer trust decision (and the registered fn is
+            # what actually runs after mapping).
+            missing = [n for n in _archive_lambda_names(path)
+                       if n not in _LAMBDA_REGISTRY]
+            if missing or not _LAMBDA_REGISTRY:
+                raise NotImplementedError(
+                    f"model contains Keras Lambda layers {missing or '?'} "
+                    f"without registered functions; call "
+                    f"KerasModelImport.register_lambda_layer(name, fn) for "
+                    f"each before import") from e
+            km = tf.keras.models.load_model(path, compile=False,
+                                            safe_mode=False)
         if isinstance(km, tf.keras.Sequential):
             return _import_sequential(km)
         return _import_functional(km)
@@ -41,6 +113,8 @@ class KerasModelImport:
     # reference aliases
     import_keras_sequential_model_and_weights = import_keras_model_and_weights
     import_keras_model = import_keras_model_and_weights
+    register_custom_layer = staticmethod(register_custom_layer)
+    register_lambda_layer = staticmethod(register_lambda_layer)
 
 
 def _act_name(act) -> str:
@@ -57,6 +131,25 @@ def _map_layer(kl) -> Optional[object]:
     import tensorflow as tf
     cls = type(kl).__name__
     cfg = kl.get_config()
+    if cls in _CUSTOM_LAYER_REGISTRY:
+        return _CUSTOM_LAYER_REGISTRY[cls](kl, cfg)
+    if cls == "Lambda":
+        from deeplearning4j_tpu.nn.misc_layers import LambdaLayer, get_lambda
+        name = cfg.get("name", "")
+        try:
+            fn = get_lambda(name)
+        except KeyError as e:
+            raise NotImplementedError(
+                f"Keras Lambda layer {name!r} has no registered function; "
+                f"call KerasModelImport.register_lambda_layer({name!r}, fn) "
+                f"before import") from e
+        out_shape = cfg.get("output_shape")
+        # output_shape may be a callable serialized as a dict (or a legacy
+        # tuple of function parts) — only trust a plain int sequence.
+        out_size = (out_shape[-1]
+                    if isinstance(out_shape, (list, tuple)) and out_shape
+                    and isinstance(out_shape[-1], int) else None)
+        return LambdaLayer(fn=fn, fn_name=name, out_size=out_size)
     if cls == "Dense":
         return DenseLayer(n_out=cfg["units"], activation=_act_name(kl.activation),
                           has_bias=cfg.get("use_bias", True))

@@ -212,3 +212,67 @@ class ZeroPadding1DLayer(Layer):
             return None
         return jnp.pad(mask, ((0, 0), (self.pad_left, self.pad_right)),
                        constant_values=1.0)
+
+
+# ---------------------------------------------------------------- Lambda
+
+# Named registry for user-defined lambda functions (the reference's
+# ``KerasLayer.registerLambdaLayer(name, SameDiffLambdaLayer)``: Keras never
+# serializes Lambda code, so imports resolve them by layer NAME from a
+# registry the user populates before loading).
+_LAMBDA_REGISTRY: dict = {}
+
+
+def register_lambda(name: str, fn) -> None:
+    """Register ``fn(x) -> y`` under ``name`` for :class:`LambdaLayer`
+    revival (model import and config deserialization)."""
+    _LAMBDA_REGISTRY[name] = fn
+
+
+def get_lambda(name: str):
+    if name not in _LAMBDA_REGISTRY:
+        raise KeyError(
+            f"Lambda {name!r} not registered; call "
+            f"register_lambda({name!r}, fn) before loading this model. "
+            f"Registered: {sorted(_LAMBDA_REGISTRY)}")
+    return _LAMBDA_REGISTRY[name]
+
+
+@register_layer
+@dataclasses.dataclass
+class LambdaLayer(Layer):
+    """Parameter-free layer wrapping an arbitrary jax-traceable function
+    (reference ``SameDiffLambdaLayer`` / Keras ``Lambda`` import target).
+
+    ``fn`` is code and is never serialized: configs round-trip ``fn_name``,
+    and deserialization resolves it from :func:`register_lambda`'s registry
+    — the reference's lambda-registry semantics."""
+
+    fn: Any = None
+    fn_name: Optional[str] = None
+    out_size: Optional[int] = None  # output feature size if fn changes it
+
+    def _fn(self):
+        if self.fn is None:
+            if self.fn_name is None:
+                raise ValueError("LambdaLayer needs fn or a registered fn_name")
+            self.fn = get_lambda(self.fn_name)
+        return self.fn
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if self.out_size is None:
+            return input_type
+        if input_type.kind == "recurrent":
+            return InputType.recurrent(self.out_size, input_type.timesteps)
+        return InputType.feed_forward(self.out_size)
+
+    def init(self, key, input_type, g: GlobalConfig):
+        return {}, {}
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        return self._fn()(x), state
+
+    def to_dict(self) -> dict:
+        d = super().to_dict()
+        d.pop("fn", None)  # code is not data
+        return d

@@ -119,10 +119,31 @@ class LSTM(BaseRecurrentLayer):
         h_new = o * act(c_new)
         return h_new, c_new
 
+    def _kernel_eligible(self, mask) -> bool:
+        """The Pallas persistent-LSTM kernel implements the default cell
+        (sigmoid gates, tanh cell, no peepholes, unmasked). Anything else
+        falls back to the scan path. Subclasses with extra parameters
+        (GravesLSTM) override this to False."""
+        return (mask is None
+                and type(self) is LSTM
+                and get_activation(self.gate_activation)
+                is get_activation("sigmoid")
+                and self._cell_act() is get_activation("tanh"))
+
     def forward_with_carry(self, params, carry, x, *, training=False, rng=None, mask=None):
         zx = x @ params["W"] + params["b"]  # (batch, time, 4H): one big matmul
         zxs = jnp.swapaxes(zx, 0, 1)  # (time, batch, 4H)
         ms = None if mask is None else jnp.swapaxes(mask, 0, 1)
+
+        if self._kernel_eligible(mask):
+            from deeplearning4j_tpu.ops.pallas.fused_lstm import (
+                fused_lstm, fused_lstm_compatible)
+            h0, c0 = carry
+            if fused_lstm_compatible(zxs, h0):
+                ys, h, c = fused_lstm(zxs, params["W_rec"],
+                                      h0.astype(zxs.dtype),
+                                      c0.astype(zxs.dtype))
+                return jnp.swapaxes(ys, 0, 1), (h, c)
 
         def step(hc, inp):
             h, c = hc

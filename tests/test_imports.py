@@ -218,3 +218,83 @@ def test_keras_causal_conv1d_import(tmp_path):
     x = np.random.default_rng(4).normal(0, 1, (3, 12, 3)).astype(np.float32)
     np.testing.assert_allclose(np.asarray(net.output(x)), km(x).numpy(),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_keras_lambda_layer_registry(tmp_path):
+    """Reference KerasLayer.registerLambdaLayer: Lambda code is not in the
+    .h5, so imports resolve the function by layer name from the registry."""
+    from deeplearning4j_tpu.imports import KerasModelImport
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((8,)),
+        tf.keras.layers.Dense(6, activation="relu"),
+        tf.keras.layers.Lambda(lambda t: t * 2.0 + 1.0, name="affine2x"),
+        tf.keras.layers.Dense(3),
+    ])
+    path = str(tmp_path / "lam.keras")
+    km.save(path)
+
+    # without registration: a helpful error (keras safe-mode refusal is
+    # translated into the register_lambda_layer guidance)
+    import pytest as _pytest
+    from deeplearning4j_tpu.nn.misc_layers import _LAMBDA_REGISTRY
+    saved = dict(_LAMBDA_REGISTRY); _LAMBDA_REGISTRY.clear()
+    try:
+        with _pytest.raises(NotImplementedError, match="register_lambda_layer"):
+            KerasModelImport.import_keras_model_and_weights(path)
+    finally:
+        _LAMBDA_REGISTRY.update(saved)
+
+    import jax.numpy as jnp
+    KerasModelImport.register_lambda_layer("affine2x", lambda t: t * 2.0 + 1.0)
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    x = np.random.default_rng(5).normal(0, 1, (4, 8)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(net.output(x)), km(x).numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_keras_custom_layer_spi(tmp_path):
+    """Reference KerasLayer.registerCustomLayer: a user-defined Keras class
+    maps through a registered factory."""
+    from deeplearning4j_tpu.imports import KerasModelImport
+    from deeplearning4j_tpu.nn.misc_layers import LambdaLayer
+
+    @tf.keras.utils.register_keras_serializable(package="test")
+    class Scale3(tf.keras.layers.Layer):
+        def call(self, t):
+            return t * 3.0
+
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((5,)),
+        tf.keras.layers.Dense(4),
+        Scale3(),
+    ])
+    path = str(tmp_path / "custom.keras")
+    km.save(path)
+
+    KerasModelImport.register_custom_layer(
+        "Scale3", lambda kl, cfg: LambdaLayer(fn=lambda t: t * 3.0,
+                                              fn_name="scale3"))
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    x = np.random.default_rng(6).normal(0, 1, (4, 5)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(net.output(x)), km(x).numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_keras_lambda_unsafe_load_requires_all_names_registered(tmp_path):
+    """Registering ONE lambda must not unlock unsafe deserialization of an
+    archive whose Lambda names are NOT all registered."""
+    from deeplearning4j_tpu.imports import KerasModelImport
+    from deeplearning4j_tpu.imports.keras_import import _archive_lambda_names
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((4,)),
+        tf.keras.layers.Lambda(lambda t: t + 1.0, name="unregistered_fn"),
+        tf.keras.layers.Dense(2),
+    ])
+    path = str(tmp_path / "evil.keras")
+    km.save(path)
+    assert _archive_lambda_names(path) == ["unregistered_fn"]
+
+    import pytest as _pytest
+    KerasModelImport.register_lambda_layer("some_other_fn", lambda t: t)
+    with _pytest.raises(NotImplementedError, match="unregistered_fn"):
+        KerasModelImport.import_keras_model_and_weights(path)

@@ -105,3 +105,87 @@ def test_flash_attention_fused_backward_cross_and_bf16():
     for a, b in zip(gb, gr):
         np.testing.assert_allclose(np.asarray(a).astype(np.float32),
                                    np.asarray(b), rtol=0.1, atol=0.5)
+
+
+def test_fused_lstm_matches_scan():
+    """Persistent-LSTM kernel (fwd + reverse-time bwd) vs the pure-scan
+    reference recurrence: outputs, final carries, and ALL gradients."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops.pallas.fused_lstm import (
+        fused_lstm, fused_lstm_compatible)
+
+    T, B, H = 12, 8, 128
+    rng = np.random.default_rng(3)
+    zx = jnp.asarray(rng.normal(0, 1, (T, B, 4 * H)), jnp.float32)
+    w_rec = jnp.asarray(rng.normal(0, 0.3, (H, 4 * H)), jnp.float32)
+    h0 = jnp.asarray(rng.normal(0, 1, (B, H)), jnp.float32)
+    c0 = jnp.asarray(rng.normal(0, 1, (B, H)), jnp.float32)
+    assert fused_lstm_compatible(zx, h0)
+
+    def scan_lstm(zx, w_rec, h0, c0):
+        def step(hc, zx_t):
+            h, c = hc
+            z = zx_t + h @ w_rec
+            i = jax.nn.sigmoid(z[:, :H])
+            f = jax.nn.sigmoid(z[:, H:2 * H])
+            g = jnp.tanh(z[:, 2 * H:3 * H])
+            o = jax.nn.sigmoid(z[:, 3 * H:])
+            c_new = f * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+        (h, c), ys = jax.lax.scan(step, (h0, c0), zx)
+        return ys, h, c
+
+    ys1, h1, c1 = fused_lstm(zx, w_rec, h0, c0)
+    ys2, h2, c2 = scan_lstm(zx, w_rec, h0, c0)
+    np.testing.assert_allclose(np.asarray(ys1), np.asarray(ys2), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h1), np.asarray(h2), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(c1), np.asarray(c2), rtol=1e-5, atol=1e-5)
+
+    tgt = jnp.asarray(rng.normal(0, 1, (T, B, H)), jnp.float32)
+
+    def loss(fn):
+        def f(zx, w_rec, h0, c0):
+            ys, hT, cT = fn(zx, w_rec, h0, c0)
+            return (jnp.sum(ys * tgt) + jnp.sum(hT ** 2) + 0.5 * jnp.sum(cT ** 2))
+        return f
+
+    g1 = jax.grad(loss(fused_lstm), argnums=(0, 1, 2, 3))(zx, w_rec, h0, c0)
+    g2 = jax.grad(loss(scan_lstm), argnums=(0, 1, 2, 3))(zx, w_rec, h0, c0)
+    for name, a, b in zip(["dzx", "dw_rec", "dh0", "dc0"], g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4, err_msg=name)
+
+
+def test_lstm_layer_routes_through_fused_kernel():
+    """The LSTM layer picks the Pallas kernel when eligible and must produce
+    the same outputs/gradients as the scan path (GravesLSTM keeps scan)."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.recurrent_layers import LSTM, GravesLSTM
+    from deeplearning4j_tpu.nn.base import GlobalConfig
+    from deeplearning4j_tpu.nn.inputs import InputType
+
+    B, T, NIN, H = 8, 6, 16, 128
+    layer = LSTM(n_out=H)
+    g = GlobalConfig()
+    layer._g = g
+    params, state = layer.init(jax.random.PRNGKey(0), InputType.recurrent(NIN, T), g)
+    x = jnp.asarray(np.random.default_rng(0).normal(0, 1, (B, T, NIN)), jnp.float32)
+
+    assert layer._kernel_eligible(None)
+    assert not GravesLSTM(n_out=H)._kernel_eligible(None)
+
+    y_kernel, _ = layer.forward(params, state, x)
+
+    # force the scan path by pretending the kernel is unavailable
+    import deeplearning4j_tpu.ops.pallas.fused_lstm as fl
+    orig = fl.fused_lstm_compatible
+    try:
+        fl.fused_lstm_compatible = lambda *a, **k: False
+        y_scan, _ = layer.forward(params, state, x)
+    finally:
+        fl.fused_lstm_compatible = orig
+    np.testing.assert_allclose(np.asarray(y_kernel), np.asarray(y_scan),
+                               rtol=1e-5, atol=1e-5)
