@@ -80,32 +80,69 @@ def _archive_lambda_names(path: str) -> List[str]:
     return names
 
 
+def _make_subst_lambda():
+    """A stand-in deserialization target for Keras ``Lambda``: it keeps the
+    layer's config (name, output_shape) and NEVER deserializes the archive's
+    marshaled lambda bytecode — Keras safe mode therefore stays ON and no
+    archive-controlled code can run. ``_map_layer`` later substitutes the
+    function the user registered under the layer's name."""
+    import tensorflow as tf
+
+    class _SubstLambda(tf.keras.layers.Layer):
+        def __init__(self, dl4j_cfg=None, **kw):
+            kw.pop("function", None)
+            kw.pop("output_shape", None)
+            kw.pop("arguments", None)
+            super().__init__(name=(dl4j_cfg or {}).get("name"))
+            self._dl4j_cfg = dl4j_cfg or {}
+
+        @classmethod
+        def from_config(cls, config, custom_objects=None):
+            return cls(dl4j_cfg=config)
+
+        def build(self, input_shape):
+            self.built = True
+
+        def call(self, x):  # structural placeholder; never the real fn
+            return x
+
+        def compute_output_shape(self, input_shape):
+            return input_shape
+
+    return _SubstLambda
+
+
 class KerasModelImport:
     @staticmethod
     def import_keras_model_and_weights(path: str):
         """Returns a MultiLayerNetwork (Sequential) or ComputationGraph."""
         import tensorflow as tf
         from deeplearning4j_tpu.nn.misc_layers import _LAMBDA_REGISTRY
-        try:
-            km = tf.keras.models.load_model(path, compile=False)
-        except ValueError as e:
-            if "Lambda" not in str(e):
-                raise
-            # Disabling Keras safe mode runs the archive's pickled lambda
-            # code at load time, so require EVERY Lambda in the archive to
-            # have a registered replacement first — registering each name is
-            # the user's per-layer trust decision (and the registered fn is
-            # what actually runs after mapping).
-            missing = [n for n in _archive_lambda_names(path)
-                       if n not in _LAMBDA_REGISTRY]
-            if missing or not _LAMBDA_REGISTRY:
+        lambda_names = _archive_lambda_names(path)
+        if lambda_names:
+            missing = [n for n in lambda_names if n not in _LAMBDA_REGISTRY]
+            if missing:
                 raise NotImplementedError(
-                    f"model contains Keras Lambda layers {missing or '?'} "
-                    f"without registered functions; call "
+                    f"model contains Keras Lambda layers {missing} without "
+                    f"registered functions; call "
                     f"KerasModelImport.register_lambda_layer(name, fn) for "
-                    f"each before import") from e
-            km = tf.keras.models.load_model(path, compile=False,
-                                            safe_mode=False)
+                    f"each before import")
+            # Swap the Lambda deserializer for a stand-in that ignores the
+            # archive's marshaled code entirely (safe mode stays ON; the
+            # registered functions are what run). Scoped patch: Keras ignores
+            # custom_objects for its own module path, so from_config is
+            # replaced for the duration of this load only.
+            lam_cls = tf.keras.layers.Lambda
+            subst = _make_subst_lambda()
+            orig_from_config = lam_cls.from_config.__func__
+            lam_cls.from_config = classmethod(
+                lambda cls, config, **kw: subst(dl4j_cfg=config))
+            try:
+                km = tf.keras.models.load_model(path, compile=False)
+            finally:
+                lam_cls.from_config = classmethod(orig_from_config)
+        else:
+            km = tf.keras.models.load_model(path, compile=False)
         if isinstance(km, tf.keras.Sequential):
             return _import_sequential(km)
         return _import_functional(km)
@@ -133,8 +170,9 @@ def _map_layer(kl) -> Optional[object]:
     cfg = kl.get_config()
     if cls in _CUSTOM_LAYER_REGISTRY:
         return _CUSTOM_LAYER_REGISTRY[cls](kl, cfg)
-    if cls == "Lambda":
+    if cls == "Lambda" or hasattr(kl, "_dl4j_cfg"):  # _SubstLambda stand-in
         from deeplearning4j_tpu.nn.misc_layers import LambdaLayer, get_lambda
+        cfg = getattr(kl, "_dl4j_cfg", None) or cfg
         name = cfg.get("name", "")
         try:
             fn = get_lambda(name)

@@ -240,6 +240,7 @@ class ComputationGraph:
         self._score = float("nan")
         self._tx: Optional[optax.GradientTransformation] = None
         self._jit_cache: Dict[str, Any] = {}
+        self._remat_segs: Optional[List[List[str]]] = None
 
     @property
     def layers(self):
@@ -311,6 +312,81 @@ class ComputationGraph:
         return optax.multi_transform(transforms, labels)
 
     # --------------------------------------------------------------- forward
+    def _exec_node(self, i: int, name: str, acts, last_inputs, new_state,
+                   params, model_state, *, training, rng, masks, carries,
+                   output_set):
+        """Execute one topo node, mutating acts/last_inputs/new_state.
+        Returns the (possibly replaced) carries dict."""
+        node = self.conf.node(name)
+        ins = [acts[k] for k in node.inputs]
+        if node.kind == "vertex":
+            acts[name] = node.obj.forward(*ins)
+            return carries
+        x = ins[0]
+        pp = getattr(node, "inputs_preprocessor", None)
+        if pp is not None:
+            x = pp.pre_process(x)
+        mask = None if masks is None else masks.get(name)
+        lrng = jax.random.fold_in(rng, i) if rng is not None else None
+        if name in output_set and hasattr(node.obj, "compute_loss"):
+            # apply input dropout ONCE; loss and forward share the result
+            x = node.obj._apply_input_dropout(x, node.obj._g, training, lrng)
+            last_inputs[name] = x
+            acts[name] = node.obj.activate(params.get(name, {}), x)
+            return carries
+        last_inputs[name] = x
+        if carries is not None and isinstance(node.obj, BaseRecurrentLayer):
+            x = node.obj._apply_input_dropout(x, node.obj._g, training, lrng)
+            y, c_new = node.obj.forward_with_carry(
+                params.get(name, {}), carries[name], x,
+                training=training, rng=lrng, mask=mask)
+            carries = dict(carries)
+            carries[name] = c_new
+        else:
+            y, s_new = node.obj.forward(params.get(name, {}),
+                                        model_state.get(name, {}),
+                                        x, training=training, rng=lrng, mask=mask)
+            if model_state.get(name):
+                new_state[name] = s_new
+        acts[name] = y
+        return carries
+
+    def _remat_segments(self) -> List[List[str]]:
+        """Partition ``topo_order`` into segments at single-tensor cut points
+        (DAG articulations: the only value still live is the node itself).
+        For ResNet-style graphs the cuts land exactly on the residual-block
+        outputs, so ``jax.checkpoint`` around a segment saves ONE boundary
+        activation instead of every intra-block tensor. The tail segment
+        (containing the output/loss layers) is never rematerialized."""
+        if self._remat_segs is not None:
+            return self._remat_segs
+        topo = self.conf.topo_order
+        node_inputs = {n: list(self.conf.node(n).inputs) for n in topo}
+        last_use: Dict[str, int] = {}
+        for idx, n in enumerate(topo):
+            for t in node_inputs[n]:
+                last_use[t] = idx
+        inf = len(topo) + 1
+        for o in self.conf.outputs:  # outputs + their inputs feed the loss
+            last_use[o] = inf
+            for t in node_inputs.get(o, []):
+                last_use[t] = inf
+        live: set = {t for t in self.conf.inputs if last_use.get(t, -1) >= 0}
+        segs: List[List[str]] = []
+        cur: List[str] = []
+        for idx, n in enumerate(topo):
+            cur.append(n)
+            live = {t for t in live if last_use.get(t, -1) > idx}
+            if last_use.get(n, -1) > idx:
+                live.add(n)
+            if live == {n} and idx < len(topo) - 1:
+                segs.append(cur)
+                cur = []
+        if cur:
+            segs.append(cur)
+        self._remat_segs = segs
+        return segs
+
     def _forward_all(self, params, model_state, inputs: Dict[str, jax.Array], *,
                      training: bool, rng, masks: Optional[Dict[str, Any]] = None,
                      carries: Optional[Dict[str, Any]] = None):
@@ -329,41 +405,66 @@ class ComputationGraph:
         last_inputs: Dict[str, Any] = {}
         new_state = dict(model_state)
         output_set = set(self.conf.outputs)
+
+        use_remat = (env.remat_segments and training and carries is None
+                     and masks is None)
+        if use_remat:
+            return self._forward_remat(params, model_state, acts, last_inputs,
+                                       new_state, rng, output_set)
+
         for i, name in enumerate(self.conf.topo_order):
-            node = self.conf.node(name)
-            ins = [acts[k] for k in node.inputs]
-            if node.kind == "vertex":
-                acts[name] = node.obj.forward(*ins)
-                continue
-            x = ins[0]
-            pp = getattr(node, "inputs_preprocessor", None)
-            if pp is not None:
-                x = pp.pre_process(x)
-            mask = None if masks is None else masks.get(name)
-            lrng = jax.random.fold_in(rng, i) if rng is not None else None
-            if name in output_set and hasattr(node.obj, "compute_loss"):
-                # apply input dropout ONCE; loss and forward share the result
-                x = node.obj._apply_input_dropout(x, node.obj._g, training, lrng)
-                last_inputs[name] = x
-                acts[name] = node.obj.activate(params.get(name, {}), x)
-                continue
-            last_inputs[name] = x
-            if carries is not None and isinstance(node.obj, BaseRecurrentLayer):
-                x = node.obj._apply_input_dropout(x, node.obj._g, training, lrng)
-                y, c_new = node.obj.forward_with_carry(
-                    params.get(name, {}), carries[name], x,
-                    training=training, rng=lrng, mask=mask)
-                carries = dict(carries)
-                carries[name] = c_new
-            else:
-                y, s_new = node.obj.forward(params.get(name, {}),
-                                            model_state.get(name, {}),
-                                            x, training=training, rng=lrng, mask=mask)
-                if model_state.get(name):
-                    new_state[name] = s_new
-            acts[name] = y
+            carries = self._exec_node(
+                i, name, acts, last_inputs, new_state, params, model_state,
+                training=training, rng=rng, masks=masks, carries=carries,
+                output_set=output_set)
         if carries is not None:
             return acts, last_inputs, new_state, carries
+        return acts, last_inputs, new_state
+
+    def _forward_remat(self, params, model_state, acts, last_inputs,
+                       new_state, rng, output_set):
+        """Training forward with per-segment rematerialization (see
+        :meth:`_remat_segments`; the HBM-vs-FLOPs trade the reference's
+        workspace system makes by hand, made by the compiler here)."""
+        topo = self.conf.topo_order
+        base = {n: i for i, n in enumerate(topo)}
+        segs = self._remat_segments()
+        for k, seg in enumerate(segs):
+            is_tail = (k == len(segs) - 1)
+            seg_set = set(seg)
+            ext = sorted({t for n in seg for t in
+                          (self.conf.node(n).inputs or [])
+                          if t not in seg_set})
+            if is_tail or len(seg) < 2:
+                for n in seg:
+                    self._exec_node(
+                        base[n], n, acts, last_inputs, new_state, params,
+                        model_state, training=True, rng=rng, masks=None,
+                        carries=None, output_set=output_set)
+                continue
+
+            seg_params = {n: params[n] for n in seg if n in params}
+            seg_mstate = {n: model_state[n] for n in seg if n in model_state}
+            out_name = seg[-1]
+
+            def seg_fn(seg_params, seg_mstate, ext_acts, rng, _seg=seg,
+                       _ext=ext, _out=out_name):
+                a = dict(zip(_ext, ext_acts))
+                li: Dict[str, Any] = {}
+                ns = dict(seg_mstate)
+                for n in _seg:
+                    self._exec_node(
+                        base[n], n, a, li, ns, seg_params, seg_mstate,
+                        training=True, rng=rng, masks=None, carries=None,
+                        output_set=output_set)
+                return a[_out], ns
+
+            y, seg_new_state = jax.checkpoint(seg_fn)(
+                seg_params, seg_mstate, tuple(acts[t] for t in ext), rng)
+            acts[out_name] = y
+            for n, s in seg_new_state.items():
+                if model_state.get(n):
+                    new_state[n] = s
         return acts, last_inputs, new_state
 
     def _loss(self, params, model_state, inputs, labels, rng, masks=None,

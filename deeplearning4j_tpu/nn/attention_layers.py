@@ -26,9 +26,13 @@ from deeplearning4j_tpu.ops.initializers import init_weights
 
 
 def layer_norm(x, gamma, beta, eps=1e-12):
-    mean = jnp.mean(x, axis=-1, keepdims=True)
-    var = jnp.var(x, axis=-1, keepdims=True)
-    return (x - mean) * jax.lax.rsqrt(var + eps) * gamma + beta
+    # single-pass E[x^2]-E[x]^2 stats in f32 (see BatchNormalization.forward)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.maximum(jnp.mean(xf * xf, axis=-1, keepdims=True) - mean * mean,
+                      0.0)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (y.astype(x.dtype)) * gamma + beta
 
 
 def dot_product_attention(q, k, v, mask=None, use_flash: bool = True):

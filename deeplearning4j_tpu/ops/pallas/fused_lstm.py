@@ -51,12 +51,16 @@ def _interpret() -> bool:
 _VMEM_BUDGET = 15 * 1024 * 1024
 
 
-def _vmem_bytes(t: int, b: int, h: int, itemsize: int) -> int:
+def _vmem_bytes(b: int, h: int, itemsize: int) -> int:
+    """Worst-case kernel VMEM footprint — the BACKWARD kernel is the larger
+    one: pinned W_rec^T plus double-buffered per-step streams (dys, gates,
+    c_prev, ds) plus the boundary blocks (dhT/dcT/dh0/dc0) and f32 dh/dc
+    scratch."""
     w_rec = h * 4 * h * itemsize
-    # double-buffered streams: zx_t + ys_t + gates_t + cseq_t
-    streams = 2 * (b * 4 * h + b * h + b * 4 * h + b * h) * itemsize
-    scratch = 2 * b * h * 4  # f32 h/c carries
-    return w_rec + streams + scratch
+    streams = 2 * (b * h + b * 4 * h + b * h + b * 4 * h) * itemsize
+    boundary = 4 * b * h * itemsize
+    scratch = 2 * b * h * 4
+    return w_rec + streams + boundary + scratch
 
 
 def fused_lstm_compatible(zx, h0) -> bool:
@@ -78,7 +82,7 @@ def fused_lstm_compatible(zx, h0) -> bool:
         return False
     if zx.dtype not in (jnp.float32, jnp.bfloat16):
         return False
-    if _vmem_bytes(t, b, h, jnp.dtype(zx.dtype).itemsize) > _VMEM_BUDGET:
+    if _vmem_bytes(b, h, jnp.dtype(zx.dtype).itemsize) > _VMEM_BUDGET:
         return False
     if _interpret():
         return True

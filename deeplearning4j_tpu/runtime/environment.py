@@ -58,6 +58,15 @@ class Environment:
     cache_compiled: Optional[str] = None
     # Analog of org.nd4j.memory.limit: fraction of HBM jax may pre-allocate.
     memory_fraction: Optional[float] = None
+    # Rematerialization (jax.checkpoint) of single-entry DAG segments during
+    # training: trades recompute FLOPs for HBM traffic — the winning trade
+    # when a model is bandwidth-bound (ResNet-50 measured 87 GB/step vs the
+    # v5e's 819 GB/s). The workspace-memory knob of this framework.
+    remat_segments: bool = False
+
+    def set_remat(self, enabled: bool = True) -> "Environment":
+        self.remat_segments = bool(enabled)
+        return self
 
     def set_default_dtype(self, dtype) -> "Environment":
         self.default_dtype = _coerce_dtype(dtype)
@@ -86,6 +95,7 @@ class Environment:
             "debug": self.debug,
             "cache_compiled": self.cache_compiled,
             "memory_fraction": self.memory_fraction,
+            "remat_segments": self.remat_segments,
         }
 
 
@@ -120,6 +130,8 @@ def get_environment() -> Environment:
                 env.set_nan_panic(True)
             env.verbose = os.environ.get(_ENV_PREFIX + "VERBOSE", "").lower() in ("1", "true")
             env.debug = os.environ.get(_ENV_PREFIX + "DEBUG", "").lower() in ("1", "true")
+            env.remat_segments = os.environ.get(
+                _ENV_PREFIX + "REMAT", "").lower() in ("1", "true")
             cache = os.environ.get(_ENV_PREFIX + "COMPILE_CACHE")
             if cache:
                 env.cache_compiled = cache

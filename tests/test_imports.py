@@ -8,6 +8,18 @@ import pytest
 tf = pytest.importorskip("tensorflow")
 
 
+@pytest.fixture(autouse=True)
+def _isolate_lambda_registry():
+    """The lambda registry is process-global; snapshot/restore around every
+    test so registrations cannot leak across tests (and cannot silently
+    satisfy another archive's Lambda names)."""
+    from deeplearning4j_tpu.nn.misc_layers import _LAMBDA_REGISTRY
+    saved = dict(_LAMBDA_REGISTRY)
+    yield
+    _LAMBDA_REGISTRY.clear()
+    _LAMBDA_REGISTRY.update(saved)
+
+
 def _frozen_graphdef(fn, input_specs):
     """Trace fn to a frozen (constant-folded) GraphDef."""
     from tensorflow.python.framework.convert_to_constants import (
@@ -233,16 +245,9 @@ def test_keras_lambda_layer_registry(tmp_path):
     path = str(tmp_path / "lam.keras")
     km.save(path)
 
-    # without registration: a helpful error (keras safe-mode refusal is
-    # translated into the register_lambda_layer guidance)
-    import pytest as _pytest
-    from deeplearning4j_tpu.nn.misc_layers import _LAMBDA_REGISTRY
-    saved = dict(_LAMBDA_REGISTRY); _LAMBDA_REGISTRY.clear()
-    try:
-        with _pytest.raises(NotImplementedError, match="register_lambda_layer"):
-            KerasModelImport.import_keras_model_and_weights(path)
-    finally:
-        _LAMBDA_REGISTRY.update(saved)
+    # without registration: a helpful error naming the missing lambdas
+    with pytest.raises(NotImplementedError, match="affine2x"):
+        KerasModelImport.import_keras_model_and_weights(path)
 
     import jax.numpy as jnp
     KerasModelImport.register_lambda_layer("affine2x", lambda t: t * 2.0 + 1.0)

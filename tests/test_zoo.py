@@ -132,3 +132,61 @@ def test_tiny_yolo_and_yolo2():
     labels = np.zeros_like(out2)
     y2.fit(x, labels, epochs=1)
     assert np.isfinite(y2.score())
+
+
+def test_remat_segments_match_plain_training_step():
+    """env.remat_segments wraps single-cut DAG segments in jax.checkpoint;
+    one training step must produce identical loss and parameters."""
+    import jax.numpy as jnp
+    import jax.random as jr
+    from deeplearning4j_tpu.nn import (ActivationLayer, BatchNormalization,
+                                       ConvolutionLayer, GlobalPoolingLayer,
+                                       InputType, OutputLayer, PoolingType)
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.graph_vertices import ElementWiseVertex
+    from deeplearning4j_tpu.models import ComputationGraph
+    from deeplearning4j_tpu.runtime.environment import get_environment
+
+    def build():
+        g = (NeuralNetConfiguration.builder().seed(3).graph_builder()
+             .add_inputs("in"))
+        g.add_layer("c1", ConvolutionLayer(n_out=8, kernel_size=(3, 3),
+                                           convolution_mode="same",
+                                           activation="identity"), "in")
+        g.add_layer("b1", BatchNormalization(activation="relu"), "c1")
+        g.add_layer("c2", ConvolutionLayer(n_out=8, kernel_size=(3, 3),
+                                           convolution_mode="same",
+                                           activation="identity"), "b1")
+        g.add_vertex("add", ElementWiseVertex(op="add"), "c2", "b1")
+        g.add_layer("relu", ActivationLayer(activation="relu"), "add")
+        g.add_layer("pool", GlobalPoolingLayer(pooling_type=PoolingType.AVG), "relu")
+        g.add_layer("out", OutputLayer(n_out=3, activation="softmax"), "pool")
+        conf = (g.set_outputs("out")
+                 .set_input_types(InputType.convolutional(8, 8, 4)).build())
+        return ComputationGraph(conf).init()
+
+    x = np.random.default_rng(0).normal(0, 1, (2, 8, 8, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[np.random.default_rng(1).integers(0, 3, 2)]
+    env = get_environment()
+
+    def one_step():
+        net = build()
+        # the residual 'b1' edge crosses the add, so cuts land after 'relu'
+        assert any(len(s) > 1 for s in net._remat_segments())
+        step = net._make_train_step()
+        ts, loss = step(net.train_state, {"in": jnp.asarray(x)},
+                        [jnp.asarray(y)], jr.PRNGKey(0), None)
+        return float(loss), ts.params
+
+    env.set_remat(False)
+    l0, p0 = one_step()
+    try:
+        env.set_remat(True)
+        l1, p1 = one_step()
+    finally:
+        env.set_remat(False)
+    np.testing.assert_allclose(l0, l1, rtol=1e-6)
+    import jax
+    for a, b in zip(jax.tree_util.tree_leaves(p0), jax.tree_util.tree_leaves(p1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
