@@ -103,11 +103,30 @@ def _make_subst_lambda():
         def build(self, input_shape):
             self.built = True
 
+        def _static_out_shape(self):
+            """The archive's declared output_shape (per-sample, no batch dim)
+            when it is a plain int sequence — shape-changing Lambdas must
+            declare it for the downstream layers to rebuild correctly."""
+            s = self._dl4j_cfg.get("output_shape")
+            if (isinstance(s, (list, tuple)) and s
+                    and all(isinstance(v, int) for v in s)):
+                return tuple(s)
+            return None
+
         def call(self, x):  # structural placeholder; never the real fn
-            return x
+            s = self._static_out_shape()
+            if s is None:
+                return x
+            import tensorflow as _tf
+            batch = _tf.shape(x)[0]
+            return _tf.zeros(_tf.concat([[batch], list(s)], axis=0),
+                             dtype=x.dtype)
 
         def compute_output_shape(self, input_shape):
-            return input_shape
+            s = self._static_out_shape()
+            if s is None:
+                return input_shape
+            return (input_shape[0],) + s
 
     return _SubstLambda
 

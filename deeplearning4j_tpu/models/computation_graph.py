@@ -554,9 +554,12 @@ class ComputationGraph:
         return jax.jit(step, donate_argnums=(0, 1))
 
     def _jitted(self, name, factory):
-        if name not in self._jit_cache:
-            self._jit_cache[name] = factory()
-        return self._jit_cache[name]
+        # remat is read at TRACE time, so flipping env.set_remat() must
+        # invalidate previously jitted steps — key the cache on the flag.
+        key = f"{name}@remat={get_environment().remat_segments}"
+        if key not in self._jit_cache:
+            self._jit_cache[key] = factory()
+        return self._jit_cache[key]
 
     def _coerce_batch(self, batch) -> Tuple[Dict[str, Any], List[Any], Optional[Dict]]:
         from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet

@@ -9,7 +9,9 @@ Play/Vert.x UI ships: score curve, update:parameter mean-magnitude ratios
 (the marquee diagnostic), per-layer param stats, memory.
 """
 
-from deeplearning4j_tpu.ui.stats import FileStatsStorage, InMemoryStatsStorage, StatsListener
+from deeplearning4j_tpu.ui.stats import (FileStatsStorage, InMemoryStatsStorage,
+                                         RemoteUIStatsStorage, StatsListener)
 from deeplearning4j_tpu.ui.server import UIServer
 
-__all__ = ["StatsListener", "InMemoryStatsStorage", "FileStatsStorage", "UIServer"]
+__all__ = ["StatsListener", "InMemoryStatsStorage", "FileStatsStorage",
+           "RemoteUIStatsStorage", "UIServer"]

@@ -1,8 +1,14 @@
-"""UI server (reference ``UIServer.getInstance().attach(storage)``).
+"""UI server (reference ``UIServer.getInstance().attach(storage)``; the
+Play/Vert.x web UI of ``deeplearning4j-ui-parent`` rebuilt as a
+dependency-free stdlib HTTP server — the environment is offline, so the page
+is inline JS with canvas charts, no external assets).
 
-Dependency-free stdlib HTTP server: ``/`` serves an inline-JS dashboard
-(score curve + update:param ratio chart, canvas-drawn, no external assets —
-the environment is offline), ``/api/records`` serves the raw JSONL records.
+Tabs mirror the reference UI: **overview** (score curve, throughput),
+**model** (per-layer update:parameter ratios — the marquee diagnostic),
+**arbiter** (hyperparameter-search results table/chart), **tsne** (embedding
+scatter), **system** (device memory). ``POST /api/post`` ingests remote
+records (reference ``RemoteUIStatsStorage``): a trainer in another process
+posts its stats here with :class:`~deeplearning4j_tpu.ui.stats.RemoteUIStatsStorage`.
 """
 
 from __future__ import annotations
@@ -10,32 +16,30 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Any, Dict, List, Optional
 
 _PAGE = """<!DOCTYPE html>
 <html><head><title>deeplearning4j_tpu training UI</title>
 <style>body{font-family:sans-serif;margin:24px;background:#fafafa}
-h2{margin:8px 0}canvas{background:#fff;border:1px solid #ddd;margin-bottom:24px}</style>
-</head><body>
-<h1>Training overview</h1>
-<h2>Score vs iteration</h2><canvas id="score" width="900" height="260"></canvas>
-<h2>Iterations / second</h2><canvas id="speed" width="900" height="160"></canvas>
+h2{margin:8px 0}canvas{background:#fff;border:1px solid #ddd;margin-bottom:24px}
+nav a{margin-right:16px;font-weight:bold;text-decoration:none;color:#1a73e8}
+table{border-collapse:collapse;background:#fff}td,th{border:1px solid #ddd;padding:4px 10px}
+</style></head><body>
+<nav><a href="/">overview</a><a href="/model">model</a>
+<a href="/arbiter">arbiter</a><a href="/tsne">t-SNE</a>
+<a href="/system">system</a></nav>
+<div id="content"></div>
 <script>
-async function draw() {
-  const res = await fetch('/api/records');
-  const recs = await res.json();
-  plot('score', recs.map(r => [r.iteration, r.score]));
-  plot('speed', recs.filter(r => r.iterations_per_second)
-                    .map(r => [r.iteration, r.iterations_per_second]));
-}
-function plot(id, pts) {
+const TAB = location.pathname === '/' ? 'overview' : location.pathname.slice(1);
+function el(html){document.getElementById('content').insertAdjacentHTML('beforeend', html)}
+function plot(id, pts, color) {
   const c = document.getElementById(id), g = c.getContext('2d');
   g.clearRect(0, 0, c.width, c.height);
   if (!pts.length) return;
   const xs = pts.map(p => p[0]), ys = pts.map(p => p[1]);
   const x0 = Math.min(...xs), x1 = Math.max(...xs) || 1;
   const y0 = Math.min(...ys), y1 = Math.max(...ys) || 1;
-  g.strokeStyle = '#1a73e8'; g.beginPath();
+  g.strokeStyle = color || '#1a73e8'; g.beginPath();
   pts.forEach((p, i) => {
     const x = 40 + (p[0] - x0) / (x1 - x0 || 1) * (c.width - 60);
     const y = c.height - 20 - (p[1] - y0) / (y1 - y0 || 1) * (c.height - 40);
@@ -46,7 +50,80 @@ function plot(id, pts) {
   g.fillText(y1.toPrecision(4), 2, 14);
   g.fillText(y0.toPrecision(4), 2, c.height - 8);
 }
-draw(); setInterval(draw, 3000);
+async function overview() {
+  el('<h1>Training overview</h1><h2>Score vs iteration</h2>' +
+     '<canvas id="score" width="900" height="260"></canvas>' +
+     '<h2>Iterations / second</h2><canvas id="speed" width="900" height="160"></canvas>');
+  async function draw() {
+    const recs = await (await fetch('/api/records')).json();
+    plot('score', recs.map(r => [r.iteration, r.score]));
+    plot('speed', recs.filter(r => r.iterations_per_second)
+                      .map(r => [r.iteration, r.iterations_per_second]));
+  }
+  draw(); setInterval(draw, 3000);
+}
+async function model() {
+  el('<h1>Model: update : parameter ratios (log10)</h1><div id="charts"></div>');
+  async function draw() {
+    const recs = await (await fetch('/api/records')).json();
+    const layers = {};
+    recs.forEach(r => Object.entries(r.update_param_ratios || {}).forEach(
+      ([k, v]) => { (layers[k] = layers[k] || []).push([r.iteration, Math.log10(v + 1e-12)]); }));
+    const div = document.getElementById('charts');
+    Object.keys(layers).sort().forEach(k => {
+      const id = 'c_' + k.replace(/[^a-zA-Z0-9]/g, '_');
+      if (!document.getElementById(id))
+        div.insertAdjacentHTML('beforeend',
+          `<h2>${k}</h2><canvas id="${id}" width="900" height="120"></canvas>`);
+      plot(id, layers[k], '#e8710a');
+    });
+  }
+  draw(); setInterval(draw, 3000);
+}
+async function arbiter() {
+  el('<h1>Hyperparameter search</h1>' +
+     '<h2>Candidate scores</h2><canvas id="scores" width="900" height="220"></canvas>' +
+     '<div id="table"></div>');
+  async function draw() {
+    const res = await (await fetch('/api/arbiter')).json();
+    plot('scores', res.map(r => [r.index, r.score]), '#188038');
+    const rows = res.map(r =>
+      `<tr><td>${r.index}</td><td>${r.score.toPrecision(5)}</td>` +
+      `<td>${r.duration_s.toFixed(1)}s</td><td>${JSON.stringify(r.candidate)}</td></tr>`);
+    document.getElementById('table').innerHTML =
+      '<table><tr><th>#</th><th>score</th><th>time</th><th>candidate</th></tr>' +
+      rows.join('') + '</table>';
+  }
+  draw(); setInterval(draw, 3000);
+}
+async function tsne() {
+  el('<h1>t-SNE embedding</h1><canvas id="emb" width="800" height="800"></canvas>');
+  const data = await (await fetch('/api/tsne')).json();
+  const c = document.getElementById('emb'), g = c.getContext('2d');
+  if (!data.points || !data.points.length) { g.fillText('no embedding uploaded', 20, 20); return; }
+  const xs = data.points.map(p => p[0]), ys = data.points.map(p => p[1]);
+  const x0 = Math.min(...xs), x1 = Math.max(...xs), y0 = Math.min(...ys), y1 = Math.max(...ys);
+  const colors = ['#1a73e8','#e8710a','#188038','#d93025','#9334e6','#12b5cb','#f29900','#5f6368'];
+  data.points.forEach((p, i) => {
+    const x = 20 + (p[0]-x0)/((x1-x0)||1)*(c.width-40);
+    const y = 20 + (p[1]-y0)/((y1-y0)||1)*(c.height-40);
+    const lbl = (data.labels || [])[i];
+    g.fillStyle = colors[(typeof lbl === 'number' ? lbl : i) % colors.length];
+    g.fillRect(x-2, y-2, 4, 4);
+    if (typeof lbl === 'string') g.fillText(lbl, x + 4, y);
+  });
+}
+async function system() {
+  el('<h1>System</h1><h2>Device memory in use (bytes)</h2>' +
+     '<canvas id="mem" width="900" height="220"></canvas>');
+  async function draw() {
+    const recs = await (await fetch('/api/records')).json();
+    plot('mem', recs.filter(r => r.device_memory && r.device_memory.bytes_in_use)
+                    .map(r => [r.iteration, r.device_memory.bytes_in_use]), '#d93025');
+  }
+  draw(); setInterval(draw, 3000);
+}
+({overview, model, arbiter, tsne, system}[TAB] || overview)();
 </script></body></html>"""
 
 
@@ -54,7 +131,11 @@ class UIServer:
     _instance: Optional["UIServer"] = None
 
     def __init__(self):
+        from deeplearning4j_tpu.ui.stats import InMemoryStatsStorage
         self._storage = None
+        self._remote_storage = InMemoryStatsStorage()  # POSTed records
+        self._arbiter_results: List[Dict[str, Any]] = []
+        self._tsne: Dict[str, Any] = {}
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.port: Optional[int] = None
@@ -68,17 +149,48 @@ class UIServer:
     def attach(self, storage) -> None:
         self._storage = storage
 
-    def enable_remote_listener(self) -> None:  # reference API surface
-        pass
+    def enable_remote_listener(self) -> None:
+        """Reference API surface; ``POST /api/post`` is always accepted."""
+
+    def attach_arbiter(self, runner) -> None:
+        """Live-attach a :class:`LocalOptimizationRunner`: its results render
+        in the arbiter tab (the reference's arbiter UI module)."""
+        def listener(res):
+            self._arbiter_results.append({
+                "index": res.index, "score": float(res.score),
+                "duration_s": float(res.duration_s),
+                "candidate": {k: (v if isinstance(v, (int, float, str, bool))
+                                  else str(v))
+                              for k, v in res.candidate.items()},
+            })
+        runner.listeners.append(listener)
+
+    def upload_tsne(self, points, labels=None) -> None:
+        """Publish a 2-D embedding (e.g. from ``plot.BarnesHutTsne``) to the
+        t-SNE tab (reference UI's t-SNE visualization page)."""
+        import numpy as np
+        pts = np.asarray(points, dtype=float)
+        if labels is not None:
+            labels = [l.item() if hasattr(l, "item") else l for l in labels]
+        self._tsne = {"points": pts[:, :2].tolist(), "labels": labels}
+
+    def _records(self):
+        recs = list(self._storage.records()) if self._storage else []
+        return recs + self._remote_storage.records()
 
     def start(self, port: int = 9000) -> int:
-        storage_ref = self
+        ui = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):
                 if self.path.startswith("/api/records"):
-                    recs = storage_ref._storage.records() if storage_ref._storage else []
-                    body = json.dumps(recs).encode()
+                    body = json.dumps(ui._records()).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/api/arbiter"):
+                    body = json.dumps(ui._arbiter_results).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/api/tsne"):
+                    body = json.dumps(ui._tsne or {}).encode()
                     ctype = "application/json"
                 else:
                     body = _PAGE.encode()
@@ -88,6 +200,35 @@ class UIServer:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length)
+                code = 404
+                if self.path.startswith("/api/post"):
+                    try:
+                        record = json.loads(raw.decode())
+                        if not isinstance(record, dict):
+                            raise ValueError("record must be an object")
+                        ui._remote_storage.put_record(record)
+                        code = 200
+                    except Exception:
+                        code = 400
+                elif self.path.startswith("/api/arbiter"):
+                    try:
+                        r = json.loads(raw.decode())
+                        # shape-validate so one bad record can't break the tab
+                        entry = {"index": int(r["index"]),
+                                 "score": float(r["score"]),
+                                 "duration_s": float(r.get("duration_s", 0.0)),
+                                 "candidate": dict(r.get("candidate", {}))}
+                        ui._arbiter_results.append(entry)
+                        code = 200
+                    except Exception:
+                        code = 400
+                self.send_response(code)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
 
             def log_message(self, *a):
                 pass

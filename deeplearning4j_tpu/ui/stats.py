@@ -123,3 +123,29 @@ class StatsListener(TrainingListener):
         except Exception:
             pass
         self.storage.put_record(record)
+
+
+class RemoteUIStatsStorage(StatsStorage):
+    """POST records to a (possibly remote) :class:`UIServer` over HTTP
+    (reference ``RemoteUIStatsStorage`` / ``StatsStorageRouter``): run the UI
+    in one process/host, train in another, and pass this storage to
+    :class:`StatsListener`."""
+
+    def __init__(self, url: str = "http://127.0.0.1:9000"):
+        self.url = url.rstrip("/") + "/api/post"
+        self._sent: List[Dict[str, Any]] = []
+
+    def put_record(self, record):
+        import urllib.error
+        import urllib.request
+        data = json.dumps(record).encode()
+        req = urllib.request.Request(
+            self.url, data=data, headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=5).close()
+        except urllib.error.HTTPError as e:
+            raise IOError(f"UI server rejected record: HTTP {e.code}") from e
+        self._sent.append(record)
+
+    def records(self):
+        return list(self._sent)

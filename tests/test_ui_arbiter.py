@@ -93,3 +93,40 @@ def test_crash_report_contents():
     report = CrashReportingUtil.memory_report(net)
     assert "parameter memory breakdown" in report
     assert "layer_0" in report and "TOTAL" in report
+
+
+def test_ui_tabs_remote_storage_arbiter_and_tsne():
+    """Tabbed UI endpoints: remote record POSTing (RemoteUIStatsStorage),
+    arbiter results feed, and t-SNE upload all round-trip over HTTP."""
+    import json
+    import urllib.request
+    from deeplearning4j_tpu.ui import RemoteUIStatsStorage, UIServer
+
+    server = UIServer()  # separate instance; do not disturb the singleton
+    port = server.start(port=0)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        remote = RemoteUIStatsStorage(base)
+        remote.put_record({"iteration": 1, "score": 0.5})
+        remote.put_record({"iteration": 2, "score": 0.25})
+        recs = json.loads(urllib.request.urlopen(base + "/api/records").read())
+        assert [r["score"] for r in recs] == [0.5, 0.25]
+
+        class R:  # minimal OptimizationResult shape
+            index, score, duration_s, candidate = 0, 0.9, 1.5, {"lr": 0.1}
+        class Runner:
+            listeners = []
+        server.attach_arbiter(Runner)
+        Runner.listeners[0](R)
+        arb = json.loads(urllib.request.urlopen(base + "/api/arbiter").read())
+        assert arb[0]["score"] == 0.9 and arb[0]["candidate"] == {"lr": 0.1}
+
+        server.upload_tsne([[0.0, 1.0], [2.0, 3.0]], labels=[0, 1])
+        ts = json.loads(urllib.request.urlopen(base + "/api/tsne").read())
+        assert ts["points"] == [[0.0, 1.0], [2.0, 3.0]] and ts["labels"] == [0, 1]
+
+        for tab in ("/", "/model", "/arbiter", "/tsne", "/system"):
+            page = urllib.request.urlopen(base + tab).read().decode()
+            assert "deeplearning4j_tpu training UI" in page
+    finally:
+        server.stop()
