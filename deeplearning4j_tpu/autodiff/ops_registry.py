@@ -394,3 +394,294 @@ def _gru_cell(x_t, h, W, W_rec, b):
     """Single GRU step (reference ``sd.rnn().gruCell``)."""
     _, h_n = _gru_op(x_t[:, None, :], W, W_rec, b, h0=h)
     return h_n
+
+
+# ---------------------------------------------------------------- linalg
+# (reference sd.linalg() / org.nd4j.linalg.api.ops.impl.* matrix ops)
+
+
+@register("cholesky")
+def _cholesky(a):
+    return jnp.linalg.cholesky(a)
+
+
+@register("solve")
+def _solve(a, b, adjoint=False):
+    if adjoint:
+        a = jnp.swapaxes(jnp.conj(a), -1, -2)
+    return jnp.linalg.solve(a, b)
+
+
+@register("triangular_solve")
+def _triangular_solve(a, b, lower=True, adjoint=False):
+    import jax.scipy.linalg as jsl
+    return jsl.solve_triangular(a, b, lower=lower,
+                                trans="C" if adjoint else "N")
+
+
+@register("lstsq")
+def _lstsq(a, b, fast=True):
+    # `fast` is the reference's performance hint (Cholesky-vs-QR path);
+    # jnp.linalg.lstsq picks the backend-appropriate algorithm, result
+    # semantics are identical.
+    return jnp.linalg.lstsq(a, b)[0]
+
+
+@register("matrix_inverse")
+def _matrix_inverse(a):
+    return jnp.linalg.inv(a)
+
+
+@register("matrix_determinant")
+def _matrix_determinant(a):
+    return jnp.linalg.det(a)
+
+
+@register("logdet")
+def _logdet(a):
+    sign, logabs = jnp.linalg.slogdet(a)
+    return logabs
+
+
+@register("svd")
+def _svd(a, full_matrices=False, compute_uv=True):
+    if not compute_uv:
+        return jnp.linalg.svd(a, full_matrices=full_matrices, compute_uv=False)
+    u, s, vt = jnp.linalg.svd(a, full_matrices=full_matrices)
+    return s, u, vt  # reference Svd returns s first
+
+
+@register("qr")
+def _qr(a, full_matrices=False):
+    return jnp.linalg.qr(a, mode="complete" if full_matrices else "reduced")
+
+
+@register("eigh")
+def _eigh(a):
+    """Self-adjoint (symmetric/Hermitian) eigendecomposition. A general
+    non-symmetric ``eig`` is CPU-only in XLA and deliberately not registered
+    — silently wrong answers on symmetric-only backends are worse than an
+    unknown-op error."""
+    w, v = jnp.linalg.eigh(a)
+    return w, v
+
+
+@register("matrix_band_part")
+def _matrix_band_part(a, num_lower=-1, num_upper=-1):
+    m, n = a.shape[-2], a.shape[-1]
+    i = jnp.arange(m)[:, None]
+    j = jnp.arange(n)[None, :]
+    keep = jnp.ones((m, n), bool)
+    if num_lower >= 0:
+        keep &= (i - j) <= num_lower
+    if num_upper >= 0:
+        keep &= (j - i) <= num_upper
+    return jnp.where(keep, a, jnp.zeros((), a.dtype))
+
+
+@register("cross")
+def _cross(a, b):
+    return jnp.cross(a, b)
+
+
+@register("diag")
+def _diag(a):
+    return jnp.diagflat(a) if a.ndim == 1 else jnp.diagonal(a, axis1=-2, axis2=-1)
+
+
+@register("diag_part")
+def _diag_part(a):
+    return jnp.diagonal(a, axis1=-2, axis2=-1)
+
+
+@register("trace")
+def _trace(a):
+    return jnp.trace(a, axis1=-2, axis2=-1)
+
+
+# ---------------------------------------------------------------- bitwise
+# (reference sd.bitwise(): and/or/xor, shifts, cyclic shifts)
+
+
+@register("bitwise_and")
+def _bitwise_and(a, b):
+    return jnp.bitwise_and(a, b)
+
+
+@register("bitwise_or")
+def _bitwise_or(a, b):
+    return jnp.bitwise_or(a, b)
+
+
+@register("bitwise_xor")
+def _bitwise_xor(a, b):
+    return jnp.bitwise_xor(a, b)
+
+
+@register("bit_shift")
+def _bit_shift(a, shift):
+    return jnp.left_shift(a, shift)
+
+
+@register("bit_shift_right")
+def _bit_shift_right(a, shift):
+    return jnp.right_shift(a, shift)
+
+
+@register("bit_rotl")
+def _bit_rotl(a, shift):
+    bits = a.dtype.itemsize * 8
+    shift = jnp.asarray(shift) % bits
+    # logical rotate: force unsigned for the right shift; the complementary
+    # shift is taken mod bits because shifting by the full width is
+    # implementation-defined in StableHLO
+    ua = a.astype(jnp.dtype(f"uint{bits}"))
+    out = jnp.left_shift(ua, shift) | jnp.right_shift(ua, (bits - shift) % bits)
+    return out.astype(a.dtype)
+
+
+@register("bit_rotr")
+def _bit_rotr(a, shift):
+    bits = a.dtype.itemsize * 8
+    shift = jnp.asarray(shift) % bits
+    ua = a.astype(jnp.dtype(f"uint{bits}"))
+    out = jnp.right_shift(ua, shift) | jnp.left_shift(ua, (bits - shift) % bits)
+    return out.astype(a.dtype)
+
+
+# ---------------------------------------------------------------- random
+# (reference sd.random(): draws take an explicit integer `seed` attr —
+# jax.random threaded explicitly, no global RNG)
+
+
+def _key(seed):
+    import jax
+    return jax.random.PRNGKey(int(seed))
+
+
+@register("random_uniform")
+def _random_uniform(shape=None, minval=0.0, maxval=1.0, seed=0):
+    import jax
+    return jax.random.uniform(_key(seed), tuple(shape),
+                              minval=minval, maxval=maxval)
+
+
+@register("random_normal")
+def _random_normal(shape=None, mean=0.0, stddev=1.0, seed=0):
+    import jax
+    return mean + stddev * jax.random.normal(_key(seed), tuple(shape))
+
+
+@register("random_bernoulli")
+def _random_bernoulli(shape=None, p=0.5, seed=0):
+    import jax
+    return jax.random.bernoulli(_key(seed), p, tuple(shape)).astype(jnp.float32)
+
+
+@register("random_exponential")
+def _random_exponential(shape=None, lam=1.0, seed=0):
+    import jax
+    return jax.random.exponential(_key(seed), tuple(shape)) / lam
+
+
+@register("random_shuffle")
+def _random_shuffle(a, seed=0):
+    import jax
+    return jax.random.permutation(_key(seed), a, axis=0)
+
+
+# ---------------------------------------------------------------- image
+# (reference sd.image(): resize, crop, flip, adjust ops used by the CNN
+# import paths)
+
+
+@register("resize_bilinear")
+def _resize_bilinear(images, height=None, width=None, align_corners=False):
+    if align_corners:
+        raise NotImplementedError(
+            "resize_bilinear(align_corners=True) is not supported; "
+            "jax.image.resize uses half-pixel alignment")
+    n, h, w, c = images.shape
+    return jax.image.resize(images, (n, int(height), int(width), c),
+                            method="bilinear")
+
+
+@register("resize_nearest")
+def _resize_nearest(images, height=None, width=None):
+    n, h, w, c = images.shape
+    return jax.image.resize(images, (n, int(height), int(width), c),
+                            method="nearest")
+
+
+@register("crop_to_box")
+def _crop_to_box(images, top=0, left=0, height=None, width=None):
+    return jax.lax.dynamic_slice(
+        images, (0, int(top), int(left), 0),
+        (images.shape[0], int(height), int(width), images.shape[3]))
+
+
+@register("flip_left_right")
+def _flip_left_right(images):
+    return jnp.flip(images, axis=2)
+
+
+@register("flip_up_down")
+def _flip_up_down(images):
+    return jnp.flip(images, axis=1)
+
+
+@register("adjust_brightness")
+def _adjust_brightness(images, delta=0.0):
+    return images + jnp.asarray(delta, images.dtype)
+
+
+@register("adjust_contrast")
+def _adjust_contrast(images, factor=1.0):
+    mean = jnp.mean(images, axis=(1, 2), keepdims=True)
+    return (images - mean) * factor + mean
+
+
+@register("adjust_saturation")
+def _adjust_saturation(images, factor=1.0):
+    gray = jnp.mean(images, axis=-1, keepdims=True)
+    return gray + (images - gray) * factor
+
+
+@register("rgb_to_grayscale")
+def _rgb_to_grayscale(images):
+    w = jnp.asarray([0.2989, 0.587, 0.114], images.dtype)
+    return jnp.sum(images * w, axis=-1, keepdims=True)
+
+
+@register("hsv_to_rgb")
+def _hsv_to_rgb(images):
+    h, s, v = images[..., 0], images[..., 1], images[..., 2]
+    i = jnp.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    i = i.astype(jnp.int32) % 6
+    r = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [v, q, p, p, t, v])
+    g = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [t, v, v, q, p, p])
+    b = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [p, p, t, v, v, q])
+    return jnp.stack([r, g, b], axis=-1)
+
+
+@register("rgb_to_hsv")
+def _rgb_to_hsv(images):
+    r, g, b = images[..., 0], images[..., 1], images[..., 2]
+    mx = jnp.maximum(jnp.maximum(r, g), b)
+    mn = jnp.minimum(jnp.minimum(r, g), b)
+    d = mx - mn
+    safe_d = jnp.where(d > 0, d, 1.0)
+    h = jnp.where(
+        d == 0, 0.0,
+        jnp.where(mx == r, ((g - b) / safe_d) % 6.0,
+                  jnp.where(mx == g, (b - r) / safe_d + 2.0,
+                            (r - g) / safe_d + 4.0))) / 6.0
+    s = jnp.where(mx > 0, d / jnp.where(mx > 0, mx, 1.0), 0.0)
+    return jnp.stack([h, s, mx], axis=-1)

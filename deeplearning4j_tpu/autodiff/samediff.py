@@ -172,6 +172,8 @@ class _Namespace:
                 name, args = args[0], args[1:]
             vars_ = [self._sd._lift(a) for a in args]
             n_out = _MULTI_OUTPUT_OPS.get(op, 1)
+            if op == "svd" and attrs.get("compute_uv") is False:
+                n_out = 1  # singular values only
             return self._sd._apply(op, vars_, attrs=attrs, name=name,
                                    n_outputs=n_out)
 
@@ -186,10 +188,23 @@ _NN_OPS = ["relu", "relu6", "leaky_relu", "elu", "selu", "gelu", "sigmoid", "tan
 _CNN_OPS = ["conv2d", "max_pool2d", "avg_pool2d", "batch_norm"]
 _RNN_OPS = ["lstm_layer", "gru", "lstm_cell", "gru_cell"]
 # ops whose registry callable returns a tuple (namespace calls unpack them)
-_MULTI_OUTPUT_OPS = {"lstm_layer": 3, "gru": 2, "lstm_cell": 2}
+_MULTI_OUTPUT_OPS = {"lstm_layer": 3, "gru": 2, "lstm_cell": 2,
+                     "svd": 3, "qr": 2, "eigh": 2}
 _LOSS_OPS = ["softmax_cross_entropy", "sparse_softmax_cross_entropy",
              "sigmoid_cross_entropy", "mean_squared_error", "mean_absolute_error",
              "l2_loss", "log_loss", "cosine_distance", "hinge_loss", "huber_loss"]
+_LINALG_OPS = ["cholesky", "solve", "triangular_solve", "lstsq",
+               "matrix_inverse", "matrix_determinant", "logdet", "svd", "qr",
+               "eigh", "matrix_band_part", "cross", "diag", "diag_part",
+               "trace", "matmul"]
+_BITWISE_OPS = ["bitwise_and", "bitwise_or", "bitwise_xor", "bit_shift",
+                "bit_shift_right", "bit_rotl", "bit_rotr"]
+_RANDOM_OPS = ["random_uniform", "random_normal", "random_bernoulli",
+               "random_exponential", "random_shuffle"]
+_IMAGE_OPS = ["resize_bilinear", "resize_nearest", "crop_to_box",
+              "flip_left_right", "flip_up_down", "adjust_brightness",
+              "adjust_contrast", "adjust_saturation", "rgb_to_grayscale",
+              "hsv_to_rgb", "rgb_to_hsv"]
 
 
 @dataclasses.dataclass
@@ -234,6 +249,10 @@ class SameDiff:
         self.cnn = _Namespace(self, _CNN_OPS)
         self.rnn = _Namespace(self, _RNN_OPS)
         self.loss = _Namespace(self, _LOSS_OPS, loss_style=True)
+        self.linalg = _Namespace(self, _LINALG_OPS)
+        self.bitwise = _Namespace(self, _BITWISE_OPS)
+        self.random = _Namespace(self, _RANDOM_OPS)
+        self.image = _Namespace(self, _IMAGE_OPS)
 
     @staticmethod
     def create() -> "SameDiff":
@@ -430,13 +449,19 @@ class SameDiff:
 
     def output(self, placeholders: Dict[str, Any], *outputs: str):
         """Execute and return the requested outputs (reference
-        ``sd.output(Map, String...)``). Single name -> single array."""
-        names = tuple(outputs)
+        ``sd.output(Map, String...)``). Single name -> single array; a LIST
+        of names (reference ``output(Map, List<String>)``) -> name->array
+        dict."""
+        as_map = len(outputs) == 1 and isinstance(outputs[0], (list, tuple))
+        names = tuple(outputs[0]) if as_map else tuple(outputs)
+        names = tuple(n.name if isinstance(n, SDVariable) else n for n in names)
         ph = {k: jnp.asarray(v) for k, v in placeholders.items()}
         key = (names, tuple(sorted(ph.keys())))
         if key not in self._jit_cache:
             self._jit_cache[key] = self._build_forward(names, tuple(sorted(ph.keys())))
         res = self._jit_cache[key](self.arrays, ph)
+        if as_map:
+            return {n: np.asarray(r) for n, r in zip(names, res)}
         return res[0] if len(names) == 1 else res
 
     def batch_output(self, placeholders, outputs):

@@ -299,6 +299,16 @@ class MultiLayerNetwork:
                 if self.conf.tbptt_fwd_length and is_sequence_array(x):
                     self._fit_tbptt(x, y, fm, lm)
                     continue
+                if self.conf.global_conf.optimization_algo !=                         "STOCHASTIC_GRADIENT_DESCENT":
+                    from deeplearning4j_tpu.train.solvers import solver_fit_batch
+                    loss = solver_fit_batch(self, x, y, fm, lm)
+                    self._score = loss
+                    self._iteration += 1
+                    for lst in self._listeners:
+                        if isinstance(lst, PerformanceListener):
+                            lst.record_batch(x.shape[0])
+                        lst.iteration_done(self, self._iteration, self._epoch, loss)
+                    continue
                 rng = self.rng.next_key()
                 self.train_state, loss = step_fn(self.train_state, x, y, rng, fm, lm)
                 self._score = loss
@@ -434,13 +444,15 @@ class MultiLayerNetwork:
         return fn(self.train_state.params, self.train_state.model_state,
                   jnp.asarray(x), m)
 
-    def feed_forward(self, x):
+    def feed_forward(self, x, num_layers: Optional[int] = None):
         """All layer activations (reference ``feedForward``) — not jitted;
-        debugging/inspection path."""
+        debugging/inspection path. ``num_layers`` stops after that many
+        layers (reference ``feedForwardToLayer``)."""
         acts = [jnp.asarray(x)]
         cur = acts[0]
         ts = self.train_state
-        for i, layer in enumerate(self.layers):
+        stop = len(self.layers) if num_layers is None else int(num_layers)
+        for i, layer in enumerate(self.layers[:stop]):
             if i in self.conf.preprocessors:
                 cur = self.conf.preprocessors[i].pre_process(cur)
             k = _layer_key(i, layer)
@@ -448,6 +460,95 @@ class MultiLayerNetwork:
                                    cur, training=False, rng=None)
             acts.append(cur)
         return acts
+
+    def feed_forward_to_layer(self, layer_num: int, x):
+        """Reference ``feedForwardToLayer(layerNum, input)``: activations of
+        the input plus layers ``0..layer_num`` inclusive."""
+        return self.feed_forward(x, num_layers=layer_num + 1)
+
+    # --------------------------------------------------- external errors
+    def backprop_gradient(self, x, epsilon):
+        """Reference external-errors mode (``backpropGradient(epsilon)``
+        after ``feedForward``): given dL/dOutput produced OUTSIDE this
+        network (e.g. this net is an embedded component of a larger system),
+        return ``(param_gradients, dL/dInput)`` — one jitted vjp, no update."""
+        if self.train_state is None:
+            self.init()
+        x = jnp.asarray(x)
+        epsilon = jnp.asarray(epsilon)
+
+        def fn(params, model_state, x_, eps):
+            def f(p, xx):
+                out, _, new_state, _ = self._forward(
+                    p, model_state, xx, training=True, rng=None)
+                return out, new_state
+            out, vjp, _ = jax.vjp(f, params, x_, has_aux=True)
+            gp, gx = vjp(eps.astype(out.dtype))
+            return gp, gx
+
+        fn = self._jitted("backprop_external", lambda: jax.jit(fn))
+        return fn(self.train_state.params, self.train_state.model_state,
+                  x, epsilon)
+
+    def fit_external(self, x, epsilon):
+        """External-errors TRAINING step: backprop ``epsilon`` (dL/dOutput)
+        through the net and apply the configured updater — the reference's
+        ``computeGradientAndScore``-with-external-errors + updater pattern,
+        fused into one jitted donated step."""
+        if self.train_state is None:
+            self.init()
+        x = jnp.asarray(x)
+        epsilon = jnp.asarray(epsilon)
+
+        def make():
+            def step(ts: TrainState, x_, eps, rng):
+                def f(p, xx):
+                    out, _, new_state, _ = self._forward(
+                        p, ts.model_state, xx, training=True, rng=rng)
+                    return out, new_state
+                out, vjp, new_state = jax.vjp(f, ts.params, x_, has_aux=True)
+                gp, gx = vjp(eps.astype(out.dtype))
+                gp = self._trainable(gp)
+                updates, new_opt = self._tx.update(gp, ts.opt_state, ts.params)
+                new_params = optax.apply_updates(ts.params, updates)
+                return TrainState(params=new_params, model_state=new_state,
+                                  opt_state=new_opt, step=ts.step + 1), gx
+            return jax.jit(step, donate_argnums=(0,))
+
+        fn = self._jitted("fit_external", make)
+        self.train_state, gx = fn(self.train_state, x, epsilon,
+                                  self.rng.next_key())
+        self._iteration += 1
+        return gx
+
+    def rnn_activate_using_stored_state(self, x, training: bool = False,
+                                        store_last_for_tbptt: bool = False):
+        """Reference ``rnnActivateUsingStoredState``: forward a sequence
+        starting from the STORED recurrent state; optionally keep the final
+        state (the tBPTT carry behaviour). Returns the output activations."""
+        if self.train_state is None:
+            self.init()
+        x = jnp.asarray(x)
+        if self._rnn_carries is None:
+            self._rnn_carries = self._zero_carries(
+                x.shape[0], carry_dtype(x, get_environment().compute_dtype))
+
+        def make():
+            def fwd(params, model_state, carries, x_, rng):
+                out, _, _, new_carries = self._forward(
+                    params, model_state, x_, training=training, rng=rng,
+                    carries=carries)
+                return out, new_carries
+            return jax.jit(fwd)
+
+        fn = self._jitted(f"rnn_stored_state@train={training}", make)
+        rng = self.rng.next_key() if training else None
+        out, new_carries = fn(self.train_state.params,
+                              self.train_state.model_state,
+                              self._rnn_carries, x, rng)
+        if store_last_for_tbptt:
+            self._rnn_carries = new_carries
+        return out
 
     def score(self, dataset=None) -> float:
         """Loss on a DataSet (inference behaviour: no dropout, running BN
@@ -506,22 +607,10 @@ class MultiLayerNetwork:
     def rnn_time_step(self, x):
         """Stateful sequence inference (reference ``rnnTimeStep``): feeds a
         (batch, time, size) chunk, returns output and stores recurrent state
-        for the next call."""
-        if self.train_state is None:
-            self.init()
-        x = jnp.asarray(x)
-        if self._rnn_carries is None:
-            self._rnn_carries = self._zero_carries(x.shape[0], x.dtype)
-
-        def fwd(params, model_state, carries, x_):
-            out, _, _, new_carries = self._forward(
-                params, model_state, x_, training=False, rng=None, carries=carries)
-            return out, new_carries
-
-        fn = self._jitted("rnn_time_step", lambda: jax.jit(fwd))
-        out, self._rnn_carries = fn(self.train_state.params, self.train_state.model_state,
-                                    self._rnn_carries, x)
-        return out
+        for the next call. Same compiled program as
+        :meth:`rnn_activate_using_stored_state`."""
+        return self.rnn_activate_using_stored_state(
+            x, training=False, store_last_for_tbptt=True)
 
     def rnn_clear_previous_state(self) -> None:
         self._rnn_carries = None

@@ -87,6 +87,12 @@ class GlobalConfig:
     gradient_normalization: Optional[str] = None
     gradient_normalization_threshold: float = 1.0
     dtype: Any = None  # resolved against runtime Environment
+    # Reference OptimizationAlgorithm: STOCHASTIC_GRADIENT_DESCENT (default),
+    # LBFGS, CONJUGATE_GRADIENT, LINE_GRADIENT_DESCENT (legacy second-order /
+    # line-search solvers; see train/solvers.py).
+    optimization_algo: str = "STOCHASTIC_GRADIENT_DESCENT"
+    max_num_line_search_iterations: int = 5  # line-search step budget
+    solver_iterations: int = 10  # outer LBFGS/CG iterations per batch
 
 
 @dataclasses.dataclass

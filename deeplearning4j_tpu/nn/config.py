@@ -135,6 +135,22 @@ class Builder:
         self._g.gradient_normalization_threshold = float(threshold)
         return self
 
+    def optimization_algo(self, algo: str) -> "Builder":
+        """Reference ``optimizationAlgo``: SGD (default) / LBFGS /
+        CONJUGATE_GRADIENT / LINE_GRADIENT_DESCENT."""
+        self._g.optimization_algo = str(algo).upper()
+        return self
+
+    def max_num_line_search_iterations(self, n: int) -> "Builder":
+        self._g.max_num_line_search_iterations = int(n)
+        return self
+
+    def solver_iterations(self, n: int) -> "Builder":
+        """Outer LBFGS/CG/line-GD iterations per batch (the reference's
+        optimizer iteration loop)."""
+        self._g.solver_iterations = int(n)
+        return self
+
     def dtype(self, dt) -> "Builder":
         self._g.dtype = dt
         return self

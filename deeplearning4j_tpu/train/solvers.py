@@ -1,0 +1,141 @@
+"""Legacy second-order / line-search solvers (reference
+``org.deeplearning4j.optimize.solvers``: ``LBFGS``, ``ConjugateGradient``,
+``LineGradientDescent`` beside the default ``StochasticGradientDescent``).
+
+TPU-first shape: the whole per-batch inner optimization (K solver iterations,
+each with value/grad + zoom line search) compiles to ONE program — a
+``lax.scan`` over jitted iterations (the reference runs the same structure
+through ``Solver.optimize`` with per-op dispatch). The compiled program is
+cached on the network like the SGD train step, so repeated batches do not
+retrace.
+
+- LBFGS: ``optax.lbfgs`` (memory-10).
+- CONJUGATE_GRADIENT: Polak-Ribiere+ nonlinear CG with restart.
+- LINE_GRADIENT_DESCENT: steepest descent.
+
+All three cap their zoom line search at the builder's
+``maxNumLineSearchIterations`` (reference semantics: the line-search step
+budget); the outer per-batch iteration count is ``solver_iterations``.
+Frozen layers stay frozen (their gradient subtrees are zeroed before the
+solver update — the SGD path freezes via per-label optax.set_to_zero
+instead), and the final forward's model state (BatchNorm running stats
+etc.) is kept.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class _CGState(NamedTuple):
+    prev_grad: Any
+    direction: Any
+    linesearch: Any
+
+
+def conjugate_gradient(max_linesearch_steps: int = 15):
+    """Polak-Ribiere+ nonlinear conjugate gradient as an optax
+    GradientTransformationExtraArgs (needs value/grad/value_fn like lbfgs)."""
+    ls = optax.scale_by_zoom_linesearch(max_linesearch_steps=max_linesearch_steps)
+
+    def init_fn(params):
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        return _CGState(prev_grad=zeros, direction=zeros,
+                        linesearch=ls.init(params))
+
+    def update_fn(grads, state, params=None, *, value, grad, value_fn, **kw):
+        g_dot = sum(jnp.vdot(a, a) for a in jax.tree_util.tree_leaves(state.prev_grad))
+        gg = sum(jnp.vdot(g, g - pg) for g, pg in zip(
+            jax.tree_util.tree_leaves(grads),
+            jax.tree_util.tree_leaves(state.prev_grad)))
+        beta = jnp.where(g_dot > 0, jnp.maximum(gg / jnp.maximum(g_dot, 1e-30), 0.0), 0.0)
+        direction = jax.tree.map(lambda g, d: -g + beta * d, grads, state.direction)
+        # zoom line search expects a DESCENT direction as the updates and
+        # scales it by the accepted step size
+        updates, ls_state = ls.update(
+            direction, state.linesearch, params,
+            value=value, grad=grad, value_fn=value_fn)
+        new_state = _CGState(prev_grad=grads, direction=direction,
+                             linesearch=ls_state)
+        return updates, new_state
+
+    return optax.GradientTransformationExtraArgs(init_fn, update_fn)
+
+
+def line_gradient_descent(max_linesearch_steps: int = 15):
+    """Steepest descent with zoom line search (reference
+    ``LineGradientDescent``): negate the gradient, then scale by the accepted
+    step size."""
+    return optax.chain(
+        optax.scale(-1.0),
+        optax.scale_by_zoom_linesearch(
+            max_linesearch_steps=max_linesearch_steps))
+
+
+def make_solver(algo: str, max_linesearch_steps: int = 15):
+    algo = algo.upper()
+    if algo == "LBFGS":
+        return optax.lbfgs(linesearch=optax.scale_by_zoom_linesearch(
+            max_linesearch_steps=max_linesearch_steps))
+    if algo == "CONJUGATE_GRADIENT":
+        return conjugate_gradient(max_linesearch_steps)
+    if algo == "LINE_GRADIENT_DESCENT":
+        return line_gradient_descent(max_linesearch_steps)
+    raise ValueError(f"unknown optimization algorithm {algo!r}")
+
+
+def solver_fit_batch(net, x, y, fmask=None, lmask=None):
+    """One reference-``Solver.optimize`` pass on this batch. Params AND model
+    state are updated in the network's train state; returns the final loss."""
+    g = net.conf.global_conf
+    algo = g.optimization_algo
+    max_ls = max(1, int(g.max_num_line_search_iterations))
+    iters = max(1, int(getattr(g, "solver_iterations", 10)))
+    tx = make_solver(algo, max_ls)
+    from deeplearning4j_tpu.models.multi_layer_network import _layer_key
+    frozen_keys = {_layer_key(i, layer)
+                   for i, layer in enumerate(net.layers)
+                   if getattr(layer, "frozen", False)}
+
+    def make():
+        def run(params, model_state, x, y, fmask, lmask):
+            def value_fn(p):
+                loss, _ = net._loss(p, model_state, x, y, None, fmask, lmask,
+                                    training=True)
+                return loss
+
+            def mask_frozen(grads):
+                return {k: (jax.tree.map(jnp.zeros_like, v)
+                            if k in frozen_keys else v)
+                        for k, v in grads.items()}
+
+            def body(carry, _):
+                params, opt_state = carry
+                value, grads = jax.value_and_grad(value_fn)(params)
+                grads = mask_frozen(grads)  # frozen layers stay frozen
+                updates, opt_state = tx.update(
+                    grads, opt_state, params, value=value, grad=grads,
+                    value_fn=value_fn)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), value
+
+            (params, _), _ = jax.lax.scan(body, (params, tx.init(params)),
+                                          None, length=iters)
+            # final forward keeps the training-mode model state (BN stats)
+            loss, (new_state, _) = net._loss(
+                params, model_state, x, y, None, fmask, lmask, training=True)
+            return params, new_state, loss
+        return jax.jit(run)
+
+    run = net._jitted(f"solver_{algo}_{iters}_{max_ls}", make)
+    ts = net.train_state
+    new_params, new_state, loss = run(ts.params, ts.model_state, x, y,
+                                      fmask, lmask)
+    import dataclasses as _dc
+    net.train_state = _dc.replace(ts, params=new_params,
+                                  model_state=new_state, step=ts.step + 1)
+    return float(loss)

@@ -177,3 +177,64 @@ def test_rnn_namespace_gru_and_cells():
     for t in range(T):
         hcur = np.asarray(sd2.output({"xt": x[:, t], "h": hcur}, hout.name))
     np.testing.assert_allclose(hcur, np.asarray(out[1]), atol=1e-5)
+
+
+def test_sd_linalg_bitwise_random_image_namespaces():
+    """Reference op-namespace families sd.linalg()/bitwise()/random()/image()."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.autodiff import SameDiff
+    rng = np.random.default_rng(0)
+
+    # linalg: cholesky/solve round trip + svd reconstruction
+    sd = SameDiff.create()
+    a_np = rng.normal(0, 1, (4, 4))
+    spd = (a_np @ a_np.T + 4 * np.eye(4)).astype(np.float32)
+    b_np = rng.normal(0, 1, (4, 2)).astype(np.float32)
+    A = sd.constant("A", spd)
+    B = sd.constant("B", b_np)
+    L = sd.linalg.cholesky(A, name="L")
+    X = sd.linalg.solve(A, B, name="X")
+    out = sd.output({}, ["L", "X"])
+    np.testing.assert_allclose(out["L"] @ out["L"].T, spd, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(spd @ out["X"], b_np, rtol=1e-3, atol=1e-3)
+
+    sd2 = SameDiff.create()
+    M = sd2.constant("M", rng.normal(0, 1, (5, 3)).astype(np.float32))
+    s, u, vt = sd2.linalg.svd(M)
+    vals = sd2.output({}, [s.name, u.name, vt.name])
+    rec = vals[u.name] @ np.diag(vals[s.name]) @ vals[vt.name]
+    np.testing.assert_allclose(rec, np.asarray(sd2.arrays["M"]), rtol=1e-4, atol=1e-4)
+
+    # bitwise
+    sd3 = SameDiff.create()
+    x = sd3.constant("x", np.array([0b1100, 0b1010], np.int32))
+    y = sd3.constant("y", np.array([0b1010, 0b0110], np.int32))
+    res = sd3.output({}, [sd3.bitwise.bitwise_and(x, y).name,
+                          sd3.bitwise.bitwise_xor(x, y).name,
+                          sd3.bitwise.bit_shift(x, 2).name])
+    np.testing.assert_array_equal(list(res.values())[0], [0b1000, 0b0010])
+    np.testing.assert_array_equal(list(res.values())[1], [0b0110, 0b1100])
+    np.testing.assert_array_equal(list(res.values())[2], [0b110000, 0b101000])
+
+    # random: deterministic under the same seed attr
+    sd4 = SameDiff.create()
+    r1 = sd4.random.random_normal(shape=(3, 4), seed=7)
+    r2 = sd4.random.random_normal(shape=(3, 4), seed=7)
+    vals = sd4.output({}, [r1.name, r2.name])
+    np.testing.assert_array_equal(vals[r1.name], vals[r2.name])
+    assert vals[r1.name].shape == (3, 4)
+
+    # image: resize + flip
+    sd5 = SameDiff.create()
+    img = sd5.constant("img", rng.normal(0, 1, (1, 4, 4, 3)).astype(np.float32))
+    up = sd5.image.resize_nearest(img, height=8, width=8)
+    fl = sd5.image.flip_left_right(img)
+    vals = sd5.output({}, [up.name, fl.name])
+    assert vals[up.name].shape == (1, 8, 8, 3)
+    np.testing.assert_allclose(vals[fl.name][0, :, ::-1],
+                               np.asarray(sd5.arrays["img"])[0], atol=1e-6)
+
+    # gray conversion keeps rank
+    sd6 = SameDiff.create()
+    g = sd6.image.rgb_to_grayscale(sd6.constant("i", np.ones((1, 2, 2, 3), np.float32)))
+    assert sd6.output({}, g.name).shape == (1, 2, 2, 1)

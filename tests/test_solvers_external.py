@@ -1,0 +1,112 @@
+"""Legacy solvers (LBFGS/CG/line-search — reference
+org.deeplearning4j.optimize.solvers) and external-errors mode (reference
+MultiLayerNetwork backpropGradient(epsilon) / feedForwardToLayer /
+rnnActivateUsingStoredState)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn import (DenseLayer, InputType, LSTM, OutputLayer,
+                                   RnnOutputLayer)
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+from deeplearning4j_tpu.train.updaters import Sgd
+
+
+@pytest.mark.parametrize("algo,factor", [("LBFGS", 0.2),
+                                         ("CONJUGATE_GRADIENT", 0.5),
+                                         ("LINE_GRADIENT_DESCENT", 0.8)])
+def test_second_order_solvers_reduce_loss(algo, factor):
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (64, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .optimization_algo(algo).max_num_line_search_iterations(8).list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax"))
+            .set_input_type(InputType.feed_forward(6)).build())
+    net = MultiLayerNetwork(conf).init()
+    s0 = net.score(DataSet(x, y))
+    for _ in range(5):
+        net.fit(x, y)
+    assert net.score(DataSet(x, y)) < s0 * factor
+
+
+def test_external_errors_gradient_and_training():
+    rng = np.random.default_rng(0)
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.1)).list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(DenseLayer(n_out=4, activation="identity"))
+            .set_input_type(InputType.feed_forward(6)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = jnp.asarray(rng.normal(0, 1, (16, 6)), jnp.float32)
+    target = jnp.asarray(rng.normal(0, 1, (16, 4)), jnp.float32)
+
+    out = net.output(x)
+    eps = 2 * (out - target) / out.size
+    _, gx = net.backprop_gradient(x, eps)
+
+    def loss_of_x(xx):
+        return jnp.mean((net.output(xx) - target) ** 2)
+    gx_ref = jax.grad(loss_of_x)(x)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_ref),
+                               rtol=1e-4, atol=1e-5)
+
+    l0 = float(loss_of_x(x))
+    for _ in range(30):
+        out = net.output(x)
+        net.fit_external(x, 2 * (out - target) / out.size)
+    assert float(loss_of_x(x)) < l0 * 0.5
+
+
+def test_feed_forward_to_layer_and_rnn_stored_state():
+    rng = np.random.default_rng(0)
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.1)).list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(DenseLayer(n_out=4, activation="identity"))
+            .set_input_type(InputType.feed_forward(6)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = jnp.asarray(rng.normal(0, 1, (16, 6)), jnp.float32)
+    acts = net.feed_forward_to_layer(0, x)
+    assert len(acts) == 2 and acts[1].shape == (16, 8)
+
+    conf2 = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.01)).list()
+             .layer(LSTM(n_out=8, n_in=5))
+             .layer(RnnOutputLayer(n_out=3))
+             .set_input_type(InputType.recurrent(5, 4)).build())
+    net2 = MultiLayerNetwork(conf2).init()
+    xs = jnp.asarray(rng.normal(0, 1, (2, 4, 5)), jnp.float32)
+    o1 = net2.rnn_activate_using_stored_state(xs)
+    o2 = net2.rnn_activate_using_stored_state(xs)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), atol=1e-6)
+    net2.rnn_activate_using_stored_state(xs, store_last_for_tbptt=True)
+    o3 = net2.rnn_activate_using_stored_state(xs)
+    assert not np.allclose(np.asarray(o1), np.asarray(o3))
+
+
+def test_solver_respects_frozen_layers_and_updates_bn_state():
+    from deeplearning4j_tpu.nn import BatchNormalization
+    rng = np.random.default_rng(0)
+    x = rng.normal(2.0, 3.0, (64, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+    frozen_dense = DenseLayer(n_out=16, activation="tanh")
+    frozen_dense.frozen = True
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .optimization_algo("LBFGS").list()
+            .layer(frozen_dense)
+            .layer(BatchNormalization())
+            .layer(OutputLayer(n_out=3, activation="softmax"))
+            .set_input_type(InputType.feed_forward(6)).build())
+    net = MultiLayerNetwork(conf).init()
+    import jax as _jax
+    w0 = np.asarray(net.train_state.params["layer_0"]["W"])
+    bn0 = np.asarray(net.train_state.model_state["layer_1"]["mean"])
+    net.fit(x, y)
+    w1 = np.asarray(net.train_state.params["layer_0"]["W"])
+    bn1 = np.asarray(net.train_state.model_state["layer_1"]["mean"])
+    np.testing.assert_array_equal(w0, w1)          # frozen layer untouched
+    assert not np.allclose(bn0, bn1)               # BN running stats moved
