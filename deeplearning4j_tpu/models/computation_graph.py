@@ -596,12 +596,24 @@ class ComputationGraph:
             iterator.reset()
             for batch in iterator:
                 inputs, labels_, masks = self._coerce_batch(batch)
+                algo = self.conf.global_conf.optimization_algo
                 if self.conf.tbptt_fwd_length and any(
                         is_sequence_array(v) for v in inputs.values()):
+                    if algo != "STOCHASTIC_GRADIENT_DESCENT":
+                        raise NotImplementedError(
+                            "tBPTT training with optimization_algo="
+                            f"{algo!r} is not supported; use SGD or full-"
+                            "sequence BPTT")
                     self._fit_tbptt(inputs, labels_, masks)
                     continue
-                rng = self.rng.next_key()
-                self.train_state, loss = step_fn(self.train_state, inputs, labels_, rng, masks)
+                if algo != "STOCHASTIC_GRADIENT_DESCENT":
+                    from deeplearning4j_tpu.train.solvers import (
+                        graph_solver_fit_batch)
+                    loss = graph_solver_fit_batch(self, inputs, labels_, masks)
+                else:
+                    rng = self.rng.next_key()
+                    self.train_state, loss = step_fn(self.train_state, inputs,
+                                                     labels_, rng, masks)
                 self._score = loss
                 self._iteration += 1
                 for lst in self._listeners:
@@ -654,6 +666,67 @@ class ComputationGraph:
         fn = self._jitted("output", lambda: jax.jit(fwd))
         outs = fn(self.train_state.params, self.train_state.model_state, inputs)
         return outs[0] if len(outs) == 1 else outs
+
+    # --------------------------------------------------- external errors
+    def backprop_gradient(self, inputs, epsilons):
+        """Reference ``ComputationGraph`` external-errors mode: given
+        dL/dOutput for each graph output (produced OUTSIDE the graph), return
+        ``(param_gradients, {input_name: dL/dInput})`` — one jitted vjp."""
+        if self.train_state is None:
+            self.init()
+        if not isinstance(inputs, dict):
+            inputs = {n: v for n, v in zip(self.conf.inputs, [inputs])}
+        inputs = {k: jnp.asarray(v) for k, v in inputs.items()}
+        if not isinstance(epsilons, (list, tuple)):
+            epsilons = [epsilons]
+        epsilons = [jnp.asarray(e) for e in epsilons]
+
+        def fn(params, model_state, inputs_, eps):
+            def f(p, ins):
+                acts, _, new_state = self._forward_all(
+                    p, model_state, ins, training=True, rng=None)
+                return [acts[o] for o in self.conf.outputs], new_state
+            outs, vjp, _ = jax.vjp(f, params, inputs_, has_aux=True)
+            gp, gin = vjp([e.astype(o.dtype) for e, o in zip(eps, outs)])
+            return gp, gin
+
+        fn = self._jitted("backprop_external", lambda: jax.jit(fn))
+        return fn(self.train_state.params, self.train_state.model_state,
+                  inputs, epsilons)
+
+    def fit_external(self, inputs, epsilons):
+        """External-errors TRAINING step on the graph: backprop the provided
+        output cotangents and apply the configured updater (one jitted
+        donated step). Returns {input_name: dL/dInput}."""
+        if self.train_state is None:
+            self.init()
+        if not isinstance(inputs, dict):
+            inputs = {n: v for n, v in zip(self.conf.inputs, [inputs])}
+        inputs = {k: jnp.asarray(v) for k, v in inputs.items()}
+        if not isinstance(epsilons, (list, tuple)):
+            epsilons = [epsilons]
+        epsilons = [jnp.asarray(e) for e in epsilons]
+
+        def make():
+            def step(ts: TrainState, inputs_, eps, rng):
+                def f(p, ins):
+                    acts, _, new_state = self._forward_all(
+                        p, ts.model_state, ins, training=True, rng=rng)
+                    return [acts[o] for o in self.conf.outputs], new_state
+                outs, vjp, new_state = jax.vjp(f, ts.params, inputs_,
+                                               has_aux=True)
+                gp, gin = vjp([e.astype(o.dtype) for e, o in zip(eps, outs)])
+                updates, new_opt = self._tx.update(gp, ts.opt_state, ts.params)
+                new_params = optax.apply_updates(ts.params, updates)
+                return TrainState(params=new_params, model_state=new_state,
+                                  opt_state=new_opt, step=ts.step + 1), gin
+            return jax.jit(step, donate_argnums=(0,))
+
+        fn = self._jitted("fit_external", make)
+        self.train_state, gin = fn(self.train_state, inputs, epsilons,
+                                   self.rng.next_key())
+        self._iteration += 1
+        return gin
 
     def rnn_time_step(self, *xs):
         """Stateful step-by-step inference (reference

@@ -88,24 +88,25 @@ def make_solver(algo: str, max_linesearch_steps: int = 15):
     raise ValueError(f"unknown optimization algorithm {algo!r}")
 
 
-def solver_fit_batch(net, x, y, fmask=None, lmask=None):
-    """One reference-``Solver.optimize`` pass on this batch. Params AND model
-    state are updated in the network's train state; returns the final loss."""
+def _solver_core(net, frozen_keys, loss_fn, cache_suffix, args):
+    """Shared K-iteration solver inner loop (MultiLayerNetwork and
+    ComputationGraph differ only in their loss signature). ``loss_fn`` is
+    ``(params, model_state, rng, *args) -> (loss, new_model_state)``.
+
+    Dropout note: one rng is drawn PER BATCH and reused across the inner
+    iterations — the zoom line search needs a deterministic value_fn, so the
+    dropout mask is frozen for the batch (the reference's Solver holds one
+    dropout mask per optimize() call the same way)."""
     g = net.conf.global_conf
     algo = g.optimization_algo
     max_ls = max(1, int(g.max_num_line_search_iterations))
     iters = max(1, int(getattr(g, "solver_iterations", 10)))
     tx = make_solver(algo, max_ls)
-    from deeplearning4j_tpu.models.multi_layer_network import _layer_key
-    frozen_keys = {_layer_key(i, layer)
-                   for i, layer in enumerate(net.layers)
-                   if getattr(layer, "frozen", False)}
 
     def make():
-        def run(params, model_state, x, y, fmask, lmask):
+        def run(params, model_state, rng, args):
             def value_fn(p):
-                loss, _ = net._loss(p, model_state, x, y, None, fmask, lmask,
-                                    training=True)
+                loss, _ = loss_fn(p, model_state, rng, *args)
                 return loss
 
             def mask_frozen(grads):
@@ -126,16 +127,47 @@ def solver_fit_batch(net, x, y, fmask=None, lmask=None):
             (params, _), _ = jax.lax.scan(body, (params, tx.init(params)),
                                           None, length=iters)
             # final forward keeps the training-mode model state (BN stats)
-            loss, (new_state, _) = net._loss(
-                params, model_state, x, y, None, fmask, lmask, training=True)
+            loss, new_state = loss_fn(params, model_state, rng, *args)
             return params, new_state, loss
         return jax.jit(run)
 
-    run = net._jitted(f"solver_{algo}_{iters}_{max_ls}", make)
+    run = net._jitted(f"solver_{algo}_{iters}_{max_ls}_{cache_suffix}", make)
     ts = net.train_state
-    new_params, new_state, loss = run(ts.params, ts.model_state, x, y,
-                                      fmask, lmask)
+    rng = net.rng.next_key()
+    new_params, new_state, loss = run(ts.params, ts.model_state, rng, args)
     import dataclasses as _dc
     net.train_state = _dc.replace(ts, params=new_params,
                                   model_state=new_state, step=ts.step + 1)
     return float(loss)
+
+
+def solver_fit_batch(net, x, y, fmask=None, lmask=None):
+    """One reference-``Solver.optimize`` pass on this batch
+    (MultiLayerNetwork). Params AND model state are updated in the network's
+    train state; returns the final loss."""
+    from deeplearning4j_tpu.models.multi_layer_network import _layer_key
+    frozen_keys = {_layer_key(i, layer)
+                   for i, layer in enumerate(net.layers)
+                   if getattr(layer, "frozen", False)}
+
+    def loss_fn(p, model_state, rng, x, y, fmask, lmask):
+        loss, (new_state, _) = net._loss(p, model_state, x, y, rng,
+                                         fmask, lmask, training=True)
+        return loss, new_state
+
+    return _solver_core(net, frozen_keys, loss_fn, "mln",
+                        (x, y, fmask, lmask))
+
+
+def graph_solver_fit_batch(net, inputs, labels, masks=None):
+    """ComputationGraph variant of :func:`solver_fit_batch`."""
+    frozen_keys = {n.name for n in net.conf.nodes
+                   if n.kind == "layer" and getattr(n.obj, "frozen", False)}
+
+    def loss_fn(p, model_state, rng, inputs, labels, masks):
+        loss, (new_state, _) = net._loss(p, model_state, inputs, labels,
+                                         rng, masks)
+        return loss, new_state
+
+    return _solver_core(net, frozen_keys, loss_fn, "graph",
+                        (inputs, labels, masks))

@@ -110,3 +110,48 @@ def test_solver_respects_frozen_layers_and_updates_bn_state():
     bn1 = np.asarray(net.train_state.model_state["layer_1"]["mean"])
     np.testing.assert_array_equal(w0, w1)          # frozen layer untouched
     assert not np.allclose(bn0, bn1)               # BN running stats moved
+
+
+def test_graph_solver_and_external_errors():
+    from deeplearning4j_tpu.models import ComputationGraph
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (32, 5)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 32)]
+
+    # graph LBFGS
+    g = (NeuralNetConfiguration.builder().seed(0)
+         .optimization_algo("LBFGS").graph_builder().add_inputs("in"))
+    g.add_layer("d", DenseLayer(n_out=8, activation="tanh"), "in")
+    g.add_layer("out", OutputLayer(n_out=2, activation="softmax"), "d")
+    conf = g.set_outputs("out").set_input_types(InputType.feed_forward(5)).build()
+    net = ComputationGraph(conf).init()
+    from deeplearning4j_tpu.data.dataset import DataSet
+    s0 = net.score(DataSet(x, y))
+    net.fit(x, y)
+    assert net.score(DataSet(x, y)) < s0 * 0.5
+
+    # graph external errors (no loss layer): LossLayer-free head
+    g2 = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.1))
+          .graph_builder().add_inputs("in"))
+    g2.add_layer("d1", DenseLayer(n_out=8, activation="tanh"), "in")
+    g2.add_layer("d2", DenseLayer(n_out=3, activation="identity"), "d1")
+    conf2 = g2.set_outputs("d2").set_input_types(InputType.feed_forward(5)).build()
+    net2 = ComputationGraph(conf2).init()
+    target = jnp.asarray(rng.normal(0, 1, (32, 3)), jnp.float32)
+    xj = jnp.asarray(x)
+
+    def loss_now():
+        return float(jnp.mean((net2.output(xj) - target) ** 2))
+
+    out = net2.output(xj)
+    eps = 2 * (out - target) / out.size
+    gp, gin = net2.backprop_gradient({"in": xj}, [eps])
+    gx_ref = jax.grad(lambda xx: jnp.mean((net2.output(xx) - target) ** 2))(xj)
+    np.testing.assert_allclose(np.asarray(gin["in"]), np.asarray(gx_ref),
+                               rtol=1e-4, atol=1e-5)
+
+    l0 = loss_now()
+    for _ in range(60):
+        out = net2.output(xj)
+        net2.fit_external({"in": xj}, [2 * (out - target) / out.size])
+    assert loss_now() < l0 * 0.9
