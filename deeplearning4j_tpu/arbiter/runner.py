@@ -72,6 +72,19 @@ class LossScoreFunction(ScoreFunction):
         return float(np.mean(losses))
 
 
+class LoadedResults(list):
+    """Result list plus the persisted minimize/maximize direction, so
+    ``best()`` can be recomputed from the file alone."""
+
+    def __init__(self, results, minimize: bool):
+        super().__init__(results)
+        self.minimize = minimize
+
+    def best(self):
+        key = min if self.minimize else max
+        return key(self, key=lambda r: r.score) if self else None
+
+
 @dataclasses.dataclass
 class OptimizationResult:
     index: int
@@ -136,3 +149,29 @@ class LocalOptimizationRunner:
             return None
         key = (min if self.score_function.minimize else max)
         return key(self.results, key=lambda r: r.score)
+
+    # ---- result persistence (reference arbiter's ResultSaver) ----
+    def save_results(self, path: str) -> None:
+        """Write all candidate results as JSON (models are not serialized
+        here — save the best model separately via its own ``save``)."""
+        import json
+        recs = [{"index": r.index, "score": float(r.score),
+                 "duration_s": float(r.duration_s),
+                 "candidate": {k: (v if isinstance(v, (int, float, str, bool))
+                                   else str(v))
+                               for k, v in r.candidate.items()}}
+                for r in self.results]
+        with open(path, "w") as f:
+            json.dump({"minimize": self.score_function.minimize,
+                       "results": recs}, f, indent=1)
+
+    @staticmethod
+    def load_results(path: str) -> "LoadedResults":
+        import json
+        with open(path) as f:
+            data = json.load(f)
+        results = [OptimizationResult(index=r["index"], candidate=r["candidate"],
+                                      score=r["score"],
+                                      duration_s=r.get("duration_s", 0.0))
+                   for r in data["results"]]
+        return LoadedResults(results, bool(data.get("minimize", True)))

@@ -667,6 +667,20 @@ class ComputationGraph:
         outs = fn(self.train_state.params, self.train_state.model_state, inputs)
         return outs[0] if len(outs) == 1 else outs
 
+    def _coerce_inputs(self, inputs) -> Dict[str, jax.Array]:
+        """Accept a dict, a single array (single-input graph), or a
+        list/tuple of arrays zipped element-wise against ``conf.inputs``."""
+        if isinstance(inputs, dict):
+            return {k: jnp.asarray(v) for k, v in inputs.items()}
+        if isinstance(inputs, (list, tuple)):
+            if len(inputs) != len(self.conf.inputs):
+                raise ValueError(
+                    f"graph has {len(self.conf.inputs)} inputs "
+                    f"{self.conf.inputs}; got {len(inputs)} arrays")
+            return {n: jnp.asarray(v)
+                    for n, v in zip(self.conf.inputs, inputs)}
+        return {self.conf.inputs[0]: jnp.asarray(inputs)}
+
     # --------------------------------------------------- external errors
     def backprop_gradient(self, inputs, epsilons):
         """Reference ``ComputationGraph`` external-errors mode: given
@@ -674,9 +688,7 @@ class ComputationGraph:
         ``(param_gradients, {input_name: dL/dInput})`` — one jitted vjp."""
         if self.train_state is None:
             self.init()
-        if not isinstance(inputs, dict):
-            inputs = {n: v for n, v in zip(self.conf.inputs, [inputs])}
-        inputs = {k: jnp.asarray(v) for k, v in inputs.items()}
+        inputs = self._coerce_inputs(inputs)
         if not isinstance(epsilons, (list, tuple)):
             epsilons = [epsilons]
         epsilons = [jnp.asarray(e) for e in epsilons]
@@ -700,9 +712,7 @@ class ComputationGraph:
         donated step). Returns {input_name: dL/dInput}."""
         if self.train_state is None:
             self.init()
-        if not isinstance(inputs, dict):
-            inputs = {n: v for n, v in zip(self.conf.inputs, [inputs])}
-        inputs = {k: jnp.asarray(v) for k, v in inputs.items()}
+        inputs = self._coerce_inputs(inputs)
         if not isinstance(epsilons, (list, tuple)):
             epsilons = [epsilons]
         epsilons = [jnp.asarray(e) for e in epsilons]

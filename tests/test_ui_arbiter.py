@@ -130,3 +130,51 @@ def test_ui_tabs_remote_storage_arbiter_and_tsne():
             assert "deeplearning4j_tpu training UI" in page
     finally:
         server.stop()
+
+
+def test_resources_and_archive_utils(tmp_path):
+    """DL4JResources base-dir + ArchiveUtils extraction with zip-slip guard."""
+    import os
+    import zipfile
+    from deeplearning4j_tpu.runtime.resources import ArchiveUtils, DL4JResources, ResourceType
+
+    old = DL4JResources._base
+    try:
+        DL4JResources.set_base_directory(str(tmp_path / "res"))
+        d = DL4JResources.get_directory(ResourceType.DATASET, "mnist")
+        assert d.endswith(os.path.join("res", "datasets", "mnist"))
+        assert os.path.isdir(d)
+    finally:
+        DL4JResources._base = old
+
+    z = tmp_path / "a.zip"
+    with zipfile.ZipFile(z, "w") as f:
+        f.writestr("dir/file.txt", "hello")
+    out = ArchiveUtils.extract(str(z), str(tmp_path / "out"))
+    assert open(out[0]).read() == "hello"
+    assert ArchiveUtils.list_files(str(z)) == ["dir/file.txt"]
+
+    evil = tmp_path / "evil.zip"
+    with zipfile.ZipFile(evil, "w") as f:
+        f.writestr("../escape.txt", "bad")
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="escapes"):
+        ArchiveUtils.extract(str(evil), str(tmp_path / "out2"))
+
+
+def test_arbiter_result_persistence(tmp_path):
+    from deeplearning4j_tpu.arbiter.runner import (LocalOptimizationRunner,
+                                                   OptimizationResult)
+
+    class R:
+        class SF:
+            minimize = False
+        score_function = SF()
+        results = [OptimizationResult(0, {"lr": 0.1}, 0.8, 1.0),
+                   OptimizationResult(1, {"lr": 0.01}, 0.9, 1.1)]
+    path = str(tmp_path / "results.json")
+    LocalOptimizationRunner.save_results(R, path)
+    loaded = LocalOptimizationRunner.load_results(path)
+    assert [r.score for r in loaded] == [0.8, 0.9]
+    assert loaded[1].candidate == {"lr": 0.01}
+    assert loaded.minimize is False and loaded.best().score == 0.9
