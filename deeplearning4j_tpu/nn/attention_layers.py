@@ -35,20 +35,31 @@ def layer_norm(x, gamma, beta, eps=1e-12):
     return (y.astype(x.dtype)) * gamma + beta
 
 
-def dot_product_attention(q, k, v, mask=None, use_flash: bool = True):
+def dot_product_attention(q, k, v, mask=None, use_flash: bool = True,
+                          causal: bool = False):
     """(batch, heads, time, d) attention. Uses the Pallas flash kernel on TPU
-    when available/shapes allow, else the XLA softmax form."""
+    when available/shapes allow (incl. key-padding masks and causal), else
+    the XLA softmax form."""
     if use_flash:
         try:
             from deeplearning4j_tpu.ops.pallas.flash_attention import flash_attention_compatible, flash_attention
-            if flash_attention_compatible(q, k, v, mask):
-                return flash_attention(q, k, v, mask)
+            if flash_attention_compatible(q, k, v, mask, causal=causal):
+                return flash_attention(q, k, v, mask, causal=causal)
         except Exception:
             pass
     d = q.shape[-1]
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(jnp.asarray(d, q.dtype))
     if mask is not None:
+        if mask.ndim == 2:  # (batch, t_k) key-padding form
+            mask = mask[:, None, None, :]
         scores = jnp.where(mask, scores, jnp.asarray(-1e9, scores.dtype))
+    if causal:
+        t_q, t_k = q.shape[2], k.shape[2]
+        # bottom-right aligned triangle: for KV-cache decode (t_q < t_k) the
+        # last query row attends every key (offset = t_k - t_q)
+        tri = jnp.tril(jnp.ones((t_q, t_k), bool), k=t_k - t_q)
+        scores = jnp.where(tri[None, None], scores,
+                           jnp.asarray(-1e9, scores.dtype))
     weights = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
 

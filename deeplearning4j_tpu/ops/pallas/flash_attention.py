@@ -1,4 +1,5 @@
-"""Flash attention as Pallas TPU kernels — forward AND fused backward.
+"""Flash attention as Pallas TPU kernels — forward AND fused backward, with
+key-padding-mask and causal support.
 
 The hand-written-kernel layer of the framework (the role cuDNN's fused
 attention / libnd4j's CUDA helpers play in the reference — SURVEY.md §7.2):
@@ -21,6 +22,13 @@ the backward uses to recompute P = exp(S - L) blockwise (never storing the
     dQ += dS @ K        (dq kernel: grid over query blocks)
     dK += dS^T @ Q      (dkv kernel: grid over key blocks)
 
+Masking: a key-padding mask becomes an additive bias (0 / -1e30) of shape
+(batch, T_k, 1) streamed per batch row (the grid runs over batch*heads; the
+index map divides by heads so the bias is NOT materialised per head).
+``causal=True`` masks the upper triangle AND skips fully-masked key blocks:
+the forward/dq loops stop at the diagonal, the dk/dv loop starts there —
+roughly halving the FLOPs, which XLA's dense softmax cannot do.
+
 Used automatically by ``nn.attention_layers.dot_product_attention`` when
 shapes/platform allow; fall back is the XLA softmax form. Set
 ``DL4J_TPU_PALLAS_INTERPRET=1`` to run the kernels in interpreter mode on
@@ -42,6 +50,15 @@ BLOCK_K = 512
 # array dim, so per-row residuals (logsumexp, delta) are stored lane-broadcast
 # with a narrow trailing axis rather than as 1-D vectors.
 RES_LANES = 8
+# Large-but-finite mask value (the standard flash choice): -inf would poison
+# the running max for fully-masked rows.
+MASK_VALUE = -1e30
+
+# Below this key length XLA's unfused softmax attention measures faster on
+# v5e (the (T, T) scores still fit cache-friendly HBM tiles and the kernel's
+# fixed overhead dominates): fwd+bwd speedup was 0.86x @T=128, 0.94x @512,
+# 1.26x @2048, 1.40x @4096.
+MIN_SEQ_FOR_KERNEL = 1024
 
 
 def _interpret() -> bool:
@@ -58,23 +75,31 @@ def _pick_block(t: int, limit: int) -> int:
     return b
 
 
-# Below this key length XLA's unfused softmax attention measures faster on
-# v5e (the (T, T) scores still fit cache-friendly HBM tiles and the kernel's
-# fixed overhead dominates): fwd+bwd speedup was 0.86x @T=128, 0.94x @512,
-# 1.26x @2048, 1.40x @4096.
-MIN_SEQ_FOR_KERNEL = 1024
+def _padding_mask_2d(mask, b: int, t_k: int):
+    """Reduce a broadcastable attention mask to a (batch, t_k) key-padding
+    mask, or None if it is not that shape family."""
+    if mask is None:
+        return None
+    if mask.ndim == 2 and mask.shape == (b, t_k):
+        return mask
+    if mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[2] == 1 \
+            and mask.shape[0] == b and mask.shape[3] == t_k:
+        return mask[:, 0, 0, :]
+    return None
 
 
-def flash_attention_compatible(q, k, v, mask=None) -> bool:
-    """Kernel applicability: no mask (padding masks fall back to XLA),
-    block-divisible sequence, head dim that tiles onto the MXU lanes, and a
-    key length long enough that the kernel beats XLA (measured crossover)."""
-    if mask is not None:
-        return False
+def flash_attention_compatible(q, k, v, mask=None, causal: bool = False) -> bool:
+    """Kernel applicability: key-padding masks only (other mask shapes fall
+    back to XLA), block-divisible sequence, head dim that tiles onto the MXU
+    lanes, and a key length long enough that the kernel beats XLA."""
     if q.ndim != 4:
         return False
     t_q, d = q.shape[2], q.shape[3]
     t_k = k.shape[2]
+    if mask is not None and _padding_mask_2d(mask, q.shape[0], t_k) is None:
+        return False
+    if causal and t_q != t_k:
+        return False
     if t_q % 128 or t_k % 128:  # adaptive blocks bottom out at 128
         return False
     if d > 256:
@@ -89,17 +114,41 @@ def flash_attention_compatible(q, k, v, mask=None) -> bool:
     return platform in ("tpu", "axon")
 
 
+def _causal_hi(qi, block_q: int, block_k: int):
+    """Number of key blocks needed for query block qi under causal masking."""
+    return (qi * block_q + block_q + block_k - 1) // block_k
+
+
+def _diag_mask(s, qi, i, block_q: int, block_k: int):
+    """Apply the causal triangle inside a (block_q, block_k) score tile."""
+    rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    cols = i * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(cols <= rows, s, MASK_VALUE)
+
+
 # ---------------------------------------------------------------- forward
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, scale: float,
-                block_k: int):
+def _fwd_kernel(*refs, scale: float, block_k: int, has_bias: bool,
+                causal: bool, save_residuals: bool):
+    if has_bias:
+        q_ref, k_ref, v_ref, bias_ref = refs[:4]
+        rest = refs[4:]
+    else:
+        q_ref, k_ref, v_ref = refs[:3]
+        bias_ref = None
+        rest = refs[3:]
+    o_ref = rest[0]
+    lse_ref = rest[1] if save_residuals else None
+
     # Matmul operands stay in the input dtype (bf16 on the fast path) so the
     # MXU runs at full rate; accumulation and softmax stats are f32.
     q = q_ref[0]  # (BLOCK_Q, D)
     in_dtype = q.dtype
+    qi = pl.program_id(1)
     t_k = k_ref.shape[1]
     n_blocks = t_k // block_k
+    block_q = q.shape[0]
 
     def body(i, carry):
         acc, m, l = carry
@@ -108,6 +157,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, scale: float,
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
+        if bias_ref is not None:
+            s = s + bias_ref[0, pl.ds(i * block_k, block_k), 0][None, :]
+        if causal:
+            s = _diag_mask(s, qi, i, block_q, block_k)
         m_blk = jnp.max(s, axis=1)
         m_new = jnp.maximum(m, m_blk)
         p = jnp.exp(s - m_new[:, None])
@@ -121,7 +174,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, scale: float,
     acc = jnp.zeros((bq, d_v), jnp.float32)
     m = jnp.full((bq,), -jnp.inf, jnp.float32)
     l = jnp.zeros((bq,), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, n_blocks, body, (acc, m, l))
+    hi = _causal_hi(qi, block_q, block_k) if causal else n_blocks
+    acc, m, l = jax.lax.fori_loop(0, hi, body, (acc, m, l))
     l_safe = jnp.maximum(l, 1e-20)
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
     if lse_ref is not None:  # residuals only requested under differentiation
@@ -129,7 +183,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, scale: float,
         lse_ref[0] = jax.lax.broadcast_in_dim(lse, (bq, RES_LANES), (0,))
 
 
-def _flash_fwd(q, k, v, scale, save_residuals=True):
+def _flash_fwd(q, k, v, bias, scale, causal, has_bias, save_residuals=True):
     b, h, t_q, d = q.shape
     t_k = k.shape[2]
     d_v = v.shape[-1]
@@ -139,6 +193,18 @@ def _flash_fwd(q, k, v, scale, save_residuals=True):
     block_q = _pick_block(t_q, BLOCK_Q)
     block_k = _pick_block(t_k, BLOCK_K)
     grid = (b * h, t_q // block_q)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        pl.BlockSpec((1, t_k, d), lambda bh, qi: (bh, 0, 0)),
+        pl.BlockSpec((1, t_k, d_v), lambda bh, qi: (bh, 0, 0)),
+    ]
+    args = [qf, kf, vf]
+    if has_bias:
+        # bias is (b, t_k, 1); the index map divides the grid's batch*heads
+        # row by heads, so all heads of one batch share the same block.
+        in_specs.append(
+            pl.BlockSpec((1, t_k, 1), lambda bh, qi: (bh // h, 0, 0)))
+        args.append(bias)
     out_shape = [jax.ShapeDtypeStruct((b * h, t_q, d_v), q.dtype)]
     out_specs = [pl.BlockSpec((1, block_q, d_v), lambda bh, qi: (bh, qi, 0))]
     if save_residuals:
@@ -147,17 +213,15 @@ def _flash_fwd(q, k, v, scale, save_residuals=True):
         out_specs.append(
             pl.BlockSpec((1, block_q, RES_LANES), lambda bh, qi: (bh, qi, 0)))
     res = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, block_k=block_k),
+        functools.partial(_fwd_kernel, scale=scale, block_k=block_k,
+                          has_bias=has_bias, causal=causal,
+                          save_residuals=save_residuals),
         out_shape=out_shape,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, t_k, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, t_k, d_v), lambda bh, qi: (bh, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=out_specs,
         interpret=_interpret(),
-    )(qf, kf, vf)
+    )(*args)
     out = res[0].reshape(b, h, t_q, d_v)
     return (out, res[1]) if save_residuals else (out, None)
 
@@ -165,15 +229,24 @@ def _flash_fwd(q, k, v, scale, save_residuals=True):
 # ---------------------------------------------------------------- backward
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, scale: float, block_k: int):
+def _bwd_dq_kernel(*refs, scale: float, block_k: int, has_bias: bool,
+                   causal: bool):
+    if has_bias:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref = refs[:7]
+        dq_ref = refs[7]
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+        bias_ref = None
+        dq_ref = refs[6]
     q = q_ref[0]                              # (BQ, D)
     do = do_ref[0]                            # (BQ, Dv)
     in_dtype = q.dtype
     lse = lse_ref[0][:, 0]                    # (BQ,)
     delta = delta_ref[0][:, 0]                # (BQ,)
+    qi = pl.program_id(1)
     t_k = k_ref.shape[1]
     n_blocks = t_k // block_k
+    block_q = q.shape[0]
 
     def body(i, dq_acc):
         k_blk = k_ref[0, pl.ds(i * block_k, block_k), :]
@@ -181,6 +254,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
+        if bias_ref is not None:
+            s = s + bias_ref[0, pl.ds(i * block_k, block_k), 0][None, :]
+        if causal:
+            s = _diag_mask(s, qi, i, block_q, block_k)
         p = jnp.exp(s - lse[:, None])                       # (BQ, BK)
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
@@ -189,18 +266,31 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         return dq_acc + jax.lax.dot(ds, k_blk,
                                     preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(0, n_blocks,
+    hi = _causal_hi(qi, block_q, block_k) if causal else n_blocks
+    dq = jax.lax.fori_loop(0, hi,
                            body, jnp.zeros(q.shape, jnp.float32))
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale: float, block_q: int):
+def _bwd_dkv_kernel(*refs, scale: float, block_q: int, has_bias: bool,
+                    causal: bool):
+    if has_bias:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref = refs[:7]
+        dk_ref, dv_ref = refs[7:9]
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+        bias_ref = None
+        dk_ref, dv_ref = refs[6:8]
     k = k_ref[0]                              # (BK, D)
     v = v_ref[0]                              # (BK, Dv)
     in_dtype = k.dtype
+    ki = pl.program_id(1)
     t_q = q_ref.shape[1]
     n_blocks = t_q // block_q
+    block_k = k.shape[0]
+    # this key block's bias column (shared across q blocks)
+    bias_col = (bias_ref[0, pl.ds(ki * block_k, block_k), 0]
+                if bias_ref is not None else None)
 
     def body(i, carry):
         dk_acc, dv_acc = carry
@@ -211,6 +301,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q_blk, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
+        if bias_col is not None:
+            s = s + bias_col[None, :]
+        if causal:
+            s = _diag_mask(s, i, ki, block_q, block_k)
         p = jnp.exp(s - lse_blk[:, None])                   # (BQ, BK)
         p_cast = p.astype(in_dtype)
         dv_acc = dv_acc + jax.lax.dot_general(
@@ -225,14 +319,17 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)             # (BK, D)
         return dk_acc, dv_acc
 
+    # under causal masking, query blocks strictly above the diagonal
+    # contribute nothing to this key block
+    lo = (ki * block_k) // block_q if causal else 0
     dk, dv = jax.lax.fori_loop(
-        0, n_blocks, body,
+        lo, n_blocks, body,
         (jnp.zeros(k.shape, jnp.float32), jnp.zeros(v.shape, jnp.float32)))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, out, lse, g, scale):
+def _flash_bwd(q, k, v, bias, out, lse, g, scale, causal, has_bias):
     b, h, t_q, d = q.shape
     t_k = k.shape[2]
     d_v = v.shape[-1]
@@ -247,75 +344,105 @@ def _flash_bwd(q, k, v, out, lse, g, scale):
     lsef = lse  # already (b*h, t_q, RES_LANES) from the forward
     deltaf = jnp.broadcast_to(delta.reshape(b * h, t_q, 1),
                               (b * h, t_q, RES_LANES))
-
     block_q = _pick_block(t_q, BLOCK_Q)
     block_k = _pick_block(t_k, BLOCK_K)
+    bias_spec_q = pl.BlockSpec((1, t_k, 1), lambda bh, qi: (bh // h, 0, 0))
+    bias_spec_k = pl.BlockSpec((1, t_k, 1), lambda bh, ki: (bh // h, 0, 0))
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        pl.BlockSpec((1, t_k, d), lambda bh, qi: (bh, 0, 0)),
+        pl.BlockSpec((1, t_k, d_v), lambda bh, qi: (bh, 0, 0)),
+        pl.BlockSpec((1, block_q, d_v), lambda bh, qi: (bh, qi, 0)),
+        pl.BlockSpec((1, block_q, RES_LANES), lambda bh, qi: (bh, qi, 0)),
+        pl.BlockSpec((1, block_q, RES_LANES), lambda bh, qi: (bh, qi, 0)),
+    ]
+    args = [qf, kf, vf, dof, lsef, deltaf]
+    if has_bias:
+        in_specs.append(bias_spec_q)
+        args.append(bias)
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, block_k=block_k),
+        functools.partial(_bwd_dq_kernel, scale=scale, block_k=block_k,
+                          has_bias=has_bias, causal=causal),
         out_shape=jax.ShapeDtypeStruct((b * h, t_q, d), q.dtype),
         grid=(b * h, t_q // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, t_k, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, t_k, d_v), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, block_q, d_v), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, RES_LANES), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, RES_LANES), lambda bh, qi: (bh, qi, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
         interpret=_interpret(),
-    )(qf, kf, vf, dof, lsef, deltaf)
+    )(*args)
 
+    in_specs_kv = [
+        pl.BlockSpec((1, t_q, d), lambda bh, ki: (bh, 0, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+        pl.BlockSpec((1, block_k, d_v), lambda bh, ki: (bh, ki, 0)),
+        pl.BlockSpec((1, t_q, d_v), lambda bh, ki: (bh, 0, 0)),
+        pl.BlockSpec((1, t_q, RES_LANES), lambda bh, ki: (bh, 0, 0)),
+        pl.BlockSpec((1, t_q, RES_LANES), lambda bh, ki: (bh, 0, 0)),
+    ]
+    args_kv = [qf, kf, vf, dof, lsef, deltaf]
+    if has_bias:
+        in_specs_kv.append(bias_spec_k)
+        args_kv.append(bias)
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q),
+        functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
+                          has_bias=has_bias, causal=causal),
         out_shape=[
             jax.ShapeDtypeStruct((b * h, t_k, d), k.dtype),
             jax.ShapeDtypeStruct((b * h, t_k, d_v), v.dtype),
         ],
         grid=(b * h, t_k // block_k),
-        in_specs=[
-            pl.BlockSpec((1, t_q, d), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, d_v), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, t_q, d_v), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, t_q, RES_LANES), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, t_q, RES_LANES), lambda bh, ki: (bh, 0, 0)),
-        ],
+        in_specs=in_specs_kv,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, d_v), lambda bh, ki: (bh, ki, 0)),
         ],
         interpret=_interpret(),
-    )(qf, kf, vf, dof, lsef, deltaf)
+    )(*args_kv)
 
     return (dq.reshape(b, h, t_q, d), dk.reshape(b, h, t_k, d),
             dv.reshape(b, h, t_k, d_v))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _flash(q, k, v, scale):
-    out, _ = _flash_fwd(q, k, v, scale, save_residuals=False)
+# ------------------------------------------------------------- public VJP
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, bias, scale, causal, has_bias):
+    out, _ = _flash_fwd(q, k, v, bias, scale, causal, has_bias,
+                        save_residuals=False)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, scale):
-    out, lse = _flash_fwd(q, k, v, scale)
-    return out, (q, k, v, out, lse)
+def _flash_vjp_fwd(q, k, v, bias, scale, causal, has_bias):
+    out, lse = _flash_fwd(q, k, v, bias, scale, causal, has_bias)
+    return out, (q, k, v, bias, out, lse)
 
 
-def _flash_vjp_bwd(scale, res, g):
-    q, k, v, out, lse = res
-    return _flash_bwd(q, k, v, out, lse, g, scale)
+def _flash_vjp_bwd(scale, causal, has_bias, res, g):
+    q, k, v, bias, out, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, bias, out, lse, g, scale, causal,
+                            has_bias)
+    return dq, dk, dv, jnp.zeros_like(bias)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-def flash_attention(q, k, v, mask=None):
-    """(batch, heads, time, d) flash attention. ``mask`` must be None (check
-    :func:`flash_attention_compatible` first)."""
-    if mask is not None:
-        raise ValueError("flash_attention kernel does not take a mask; "
-                         "use the XLA fallback for masked attention")
+def flash_attention(q, k, v, mask=None, causal: bool = False):
+    """(batch, heads, time, d) flash attention. ``mask`` may be a key-padding
+    mask of shape (batch, t_k) or (batch, 1, 1, t_k) — 1/True = attend (check
+    :func:`flash_attention_compatible` first). ``causal=True`` applies the
+    autoregressive triangle with diagonal block skipping."""
+    b, t_k = q.shape[0], k.shape[2]
+    kmask = _padding_mask_2d(mask, b, t_k)
+    if mask is not None and kmask is None:
+        raise ValueError("flash_attention supports key-padding masks only; "
+                         "use the XLA fallback for other mask shapes")
     scale = 1.0 / float(q.shape[-1]) ** 0.5
-    return _flash(q, k, v, scale)
+    has_bias = kmask is not None
+    if has_bias:
+        bias = jnp.where(kmask.astype(bool), 0.0, MASK_VALUE)
+        bias = bias.astype(jnp.float32)[:, :, None]  # (b, t_k, 1)
+    else:
+        bias = jnp.zeros((b, t_k, 1), jnp.float32)  # unused dummy
+    return _flash(q, k, v, bias, scale, bool(causal), has_bias)

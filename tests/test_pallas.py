@@ -62,7 +62,11 @@ def test_incompatible_shapes_fall_back():
     q = jnp.zeros((1, 1, 100, 64))  # T not block-divisible
     assert not flash_attention_compatible(q, q, q)
     q2 = jnp.zeros((1, 1, 128, 64))
-    assert not flash_attention_compatible(q2, q2, q2, mask=jnp.ones((1, 1, 1, 128)))
+    # key-padding masks ARE kernel-compatible now
+    assert flash_attention_compatible(q2, q2, q2, mask=jnp.ones((1, 1, 1, 128)))
+    # full (b, 1, t_q, t_k) masks are not
+    assert not flash_attention_compatible(q2, q2, q2,
+                                          mask=jnp.ones((1, 1, 128, 128)))
 
 
 def test_flash_attention_fused_backward_cross_and_bf16():
@@ -189,3 +193,77 @@ def test_lstm_layer_routes_through_fused_kernel():
         fl.fused_lstm_compatible = orig
     np.testing.assert_allclose(np.asarray(y_kernel), np.asarray(y_scan),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_flash_attention_padding_mask_and_causal():
+    """Key-padding mask and causal triangle vs the XLA reference form,
+    forward AND gradients."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops.pallas.flash_attention import flash_attention
+    rng = np.random.default_rng(4)
+    B, H, T, D = 2, 2, 256, 64
+    q = jnp.asarray(rng.normal(0, 1, (B, H, T, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(0, 1, (B, H, T, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(0, 1, (B, H, T, D)), jnp.float32)
+    # ragged valid lengths per batch row
+    lens = np.array([200, 131])
+    kmask = jnp.asarray(np.arange(T)[None, :] < lens[:, None])
+
+    def ref(q, k, v, mask2d=None, causal=False):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        if mask2d is not None:
+            s = jnp.where(mask2d[:, None, None, :], s, -1e30)
+        if causal:
+            tri = jnp.tril(jnp.ones((T, T), bool))
+            s = jnp.where(tri[None, None], s, -1e30)
+        w = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", w, v)
+
+    # forward parity: padding mask (both mask layouts)
+    out = flash_attention(q, k, v, mask=kmask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref(q, k, v, kmask)),
+                               rtol=2e-4, atol=2e-5)
+    out4 = flash_attention(q, k, v, mask=kmask[:, None, None, :])
+    np.testing.assert_allclose(np.asarray(out4), np.asarray(out), atol=1e-6)
+
+    # forward parity: causal
+    outc = flash_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(outc),
+                               np.asarray(ref(q, k, v, causal=True)),
+                               rtol=2e-4, atol=2e-5)
+
+    # gradients: masked and causal
+    for kwargs, ref_kwargs in [({"mask": kmask}, {"mask2d": kmask}),
+                               ({"causal": True}, {"causal": True})]:
+        g1 = jax.grad(lambda *a: jnp.sum(flash_attention(*a, **kwargs) ** 2),
+                      argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(lambda *a: jnp.sum(ref(*a, **ref_kwargs) ** 2),
+                      argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-4)
+
+
+def test_dot_product_attention_fallback_mask_forms_and_decode_causal():
+    """XLA fallback must accept the same mask family as the kernel and use
+    bottom-right-aligned causal masking for KV-cache decode shapes."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.attention_layers import dot_product_attention
+    rng = np.random.default_rng(5)
+    B, H, T, D = 2, 2, 16, 8  # tiny: kernel gate rejects, fallback runs
+    q = jnp.asarray(rng.normal(0, 1, (B, H, T, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(0, 1, (B, H, T, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(0, 1, (B, H, T, D)), jnp.float32)
+    kmask = jnp.asarray(np.arange(T)[None, :] < np.array([12, 9])[:, None])
+    out2d = dot_product_attention(q, k, v, mask=kmask, use_flash=False)
+    out4d = dot_product_attention(q, k, v, mask=kmask[:, None, None, :],
+                                  use_flash=False)
+    np.testing.assert_allclose(np.asarray(out2d), np.asarray(out4d), atol=1e-6)
+
+    # decode: one query over T keys with causal=True attends ALL past keys
+    q1 = q[:, :, -1:, :]
+    dec = dot_product_attention(q1, k, v, causal=True, use_flash=False)
+    full = dot_product_attention(q, k, v, causal=True, use_flash=False)
+    np.testing.assert_allclose(np.asarray(dec[:, :, 0]),
+                               np.asarray(full[:, :, -1]), atol=1e-5)
