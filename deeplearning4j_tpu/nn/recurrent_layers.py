@@ -245,6 +245,14 @@ class GRU(BaseRecurrentLayer):
         zxs = jnp.swapaxes(x @ params["W"] + params["b"], 0, 1)  # hoisted
         ms = None if mask is None else jnp.swapaxes(mask, 0, 1)
 
+        if mask is None and type(self) is GRU:
+            from deeplearning4j_tpu.ops.pallas.fused_gru import (
+                fused_gru, fused_gru_compatible)
+            (h0,) = carry
+            if fused_gru_compatible(zxs, h0):
+                ys, h = fused_gru(zxs, params["W_rec"], h0.astype(zxs.dtype))
+                return jnp.swapaxes(ys, 0, 1), (h,)
+
         def step(hs, inp):
             (h,) = hs
             zx = inp[0] if ms is not None else inp

@@ -1,0 +1,14 @@
+"""Shared knobs for the Pallas kernel modules."""
+
+from __future__ import annotations
+
+import os
+
+# Scoped-VMEM budget per core (v5e exposes 16 MB; leave headroom for
+# Mosaic's own stack). Kernels gate their eligibility on fitting here.
+VMEM_BUDGET = 15 * 1024 * 1024
+
+
+def interpret_mode() -> bool:
+    """CPU interpreter-mode test path (DL4J_TPU_PALLAS_INTERPRET=1)."""
+    return os.environ.get("DL4J_TPU_PALLAS_INTERPRET", "") == "1"

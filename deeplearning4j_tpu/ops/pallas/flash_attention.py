@@ -61,8 +61,7 @@ MASK_VALUE = -1e30
 MIN_SEQ_FOR_KERNEL = 1024
 
 
-def _interpret() -> bool:
-    return os.environ.get("DL4J_TPU_PALLAS_INTERPRET", "") == "1"
+from deeplearning4j_tpu.ops.pallas.common import interpret_mode as _interpret
 
 
 def _pick_block(t: int, limit: int) -> int:

@@ -42,13 +42,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _interpret() -> bool:
-    return os.environ.get("DL4J_TPU_PALLAS_INTERPRET", "") == "1"
-
-
-# Scoped-VMEM budget (v5e exposes 16 MB; leave headroom for Mosaic's own
-# stack). The kernel pins W_rec plus double-buffered per-step blocks.
-_VMEM_BUDGET = 15 * 1024 * 1024
+from deeplearning4j_tpu.ops.pallas.common import VMEM_BUDGET as _VMEM_BUDGET
+from deeplearning4j_tpu.ops.pallas.common import interpret_mode as _interpret
 
 
 def _vmem_bytes(b: int, h: int, itemsize: int) -> int:

@@ -267,3 +267,78 @@ def test_dot_product_attention_fallback_mask_forms_and_decode_causal():
     full = dot_product_attention(q, k, v, causal=True, use_flash=False)
     np.testing.assert_allclose(np.asarray(dec[:, :, 0]),
                                np.asarray(full[:, :, -1]), atol=1e-5)
+
+
+def test_fused_gru_matches_scan():
+    """Persistent-GRU kernel (fwd + reverse-time bwd) vs the scan reference:
+    outputs, final carry, and ALL gradients (incl. the reset-gated n-path)."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops.pallas.fused_gru import (fused_gru,
+                                                         fused_gru_compatible)
+
+    T, B, H = 10, 8, 128
+    rng = np.random.default_rng(6)
+    zx = jnp.asarray(rng.normal(0, 1, (T, B, 3 * H)), jnp.float32)
+    w_rec = jnp.asarray(rng.normal(0, 0.3, (H, 3 * H)), jnp.float32)
+    h0 = jnp.asarray(rng.normal(0, 1, (B, H)), jnp.float32)
+    assert fused_gru_compatible(zx, h0)
+
+    def scan_gru(zx, w_rec, h0):
+        def step(h, zx_t):
+            zh = h @ w_rec
+            r = jax.nn.sigmoid(zx_t[:, :H] + zh[:, :H])
+            u = jax.nn.sigmoid(zx_t[:, H:2 * H] + zh[:, H:2 * H])
+            n = jnp.tanh(zx_t[:, 2 * H:] + r * zh[:, 2 * H:])
+            h_new = (1 - u) * n + u * h
+            return h_new, h_new
+        h, ys = jax.lax.scan(step, h0, zx)
+        return ys, h
+
+    ys1, h1 = fused_gru(zx, w_rec, h0)
+    ys2, h2 = scan_gru(zx, w_rec, h0)
+    np.testing.assert_allclose(np.asarray(ys1), np.asarray(ys2), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h1), np.asarray(h2), rtol=1e-5, atol=1e-5)
+
+    tgt = jnp.asarray(rng.normal(0, 1, (T, B, H)), jnp.float32)
+
+    def loss(fn):
+        def f(zx, w_rec, h0):
+            ys, hT = fn(zx, w_rec, h0)
+            return jnp.sum(ys * tgt) + jnp.sum(hT ** 2)
+        return f
+
+    g1 = jax.grad(loss(fused_gru), argnums=(0, 1, 2))(zx, w_rec, h0)
+    g2 = jax.grad(loss(scan_gru), argnums=(0, 1, 2))(zx, w_rec, h0)
+    for name, a, b in zip(["dzx", "dw_rec", "dh0"], g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4, err_msg=name)
+
+
+def test_gru_layer_routes_through_fused_kernel():
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.recurrent_layers import GRU
+    from deeplearning4j_tpu.nn.base import GlobalConfig
+    from deeplearning4j_tpu.nn.inputs import InputType
+
+    B, T, NIN, H = 8, 6, 16, 128
+    layer = GRU(n_out=H)
+    g = GlobalConfig()
+    layer._g = g
+    params, state = layer.init(jax.random.PRNGKey(0), InputType.recurrent(NIN, T), g)
+    x = jnp.asarray(np.random.default_rng(0).normal(0, 1, (B, T, NIN)), jnp.float32)
+
+    import deeplearning4j_tpu.ops.pallas.fused_gru as fg
+    calls = []
+    orig_fused, orig_compat = fg.fused_gru, fg.fused_gru_compatible
+    try:
+        fg.fused_gru = lambda *a: (calls.append(1), orig_fused(*a))[1]
+        y_kernel, _ = layer.forward(params, state, x)
+        assert calls, "fused GRU kernel was not selected"
+        fg.fused_gru_compatible = lambda *a, **k: False
+        y_scan, _ = layer.forward(params, state, x)
+    finally:
+        fg.fused_gru, fg.fused_gru_compatible = orig_fused, orig_compat
+    np.testing.assert_allclose(np.asarray(y_kernel), np.asarray(y_scan),
+                               rtol=1e-5, atol=1e-5)
