@@ -639,6 +639,43 @@ class MultiLayerNetwork:
             return 0
         return int(sum(np.prod(p.shape) for p in jax.tree.leaves(self.train_state.params)))
 
+    def get_layer(self, key) -> Layer:
+        """Layer by index or name (reference ``getLayer``)."""
+        if isinstance(key, int):
+            return self.layers[key]
+        for i, l in enumerate(self.layers):
+            if _layer_key(i, l) == key or l.name == key:
+                return l
+        raise KeyError(key)
+
+    def summary(self) -> str:
+        """Layer table: name, type, in->out shape, #params (reference
+        ``MultiLayerNetwork.summary()``)."""
+        if self.train_state is None:
+            self.init()
+        rows = [("idx", "name", "type", "nIn -> nOut", "params")]
+        total = 0
+        for i, layer in enumerate(self.layers):
+            k = _layer_key(i, layer)
+            p = self.train_state.params.get(k, {})
+            n = int(sum(np.prod(w.shape) for w in jax.tree.leaves(p)))
+            total += n
+            it = (self.conf.layer_input_types[i]
+                  if self.conf.layer_input_types else None)
+            shape = ""
+            if it is not None:
+                try:
+                    shape = f"{it.describe()} -> {layer.output_type(it).describe()}"
+                except Exception:
+                    shape = ""
+            rows.append((str(i), k, type(layer).__name__, shape, f"{n:,}"))
+        widths = [max(len(r[c]) for r in rows) for c in range(5)]
+        lines = ["  ".join(v.ljust(w) for v, w in zip(r, widths))
+                 for r in rows]
+        lines.insert(1, "-" * len(lines[0]))
+        lines.append(f"Total parameters: {total:,}")
+        return "\n".join(lines)
+
     @property
     def iteration(self) -> int:
         return self._iteration
