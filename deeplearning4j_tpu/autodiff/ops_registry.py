@@ -685,3 +685,513 @@ def _rgb_to_hsv(images):
                             (r - g) / safe_d + 4.0))) / 6.0
     s = jnp.where(mx > 0, d / jnp.where(mx > 0, mx, 1.0), 0.0)
     return jnp.stack([h, s, mx], axis=-1)
+
+
+# -------------------------------------------------- scatter / segment ops
+# (reference libnd4j scatter_* and segment_* declarable families — the
+# sparse-update path the embedding and graph-NN workloads use)
+
+
+@register("scatter_add")
+def _scatter_add(a, indices, updates):
+    return a.at[indices.astype(jnp.int32)].add(updates)
+
+
+@register("scatter_sub")
+def _scatter_sub(a, indices, updates):
+    return a.at[indices.astype(jnp.int32)].add(-updates)
+
+
+@register("scatter_mul")
+def _scatter_mul(a, indices, updates):
+    return a.at[indices.astype(jnp.int32)].multiply(updates)
+
+
+@register("scatter_div")
+def _scatter_div(a, indices, updates):
+    return a.at[indices.astype(jnp.int32)].divide(updates)
+
+
+@register("scatter_max")
+def _scatter_max(a, indices, updates):
+    return a.at[indices.astype(jnp.int32)].max(updates)
+
+
+@register("scatter_min")
+def _scatter_min(a, indices, updates):
+    return a.at[indices.astype(jnp.int32)].min(updates)
+
+
+@register("scatter_nd")
+def _scatter_nd(indices, updates, shape):
+    out = jnp.zeros(tuple(int(s) for s in shape), updates.dtype)
+    return out.at[tuple(jnp.moveaxis(indices.astype(jnp.int32), -1, 0))].add(updates)
+
+
+@register("scatter_nd_add")
+def _scatter_nd_add(a, indices, updates):
+    return a.at[tuple(jnp.moveaxis(indices.astype(jnp.int32), -1, 0))].add(updates)
+
+
+@register("scatter_nd_update")
+def _scatter_nd_update(a, indices, updates):
+    return a.at[tuple(jnp.moveaxis(indices.astype(jnp.int32), -1, 0))].set(updates)
+
+
+@register("segment_sum")
+def _segment_sum(data, segment_ids, num_segments=None):
+    n = int(num_segments) if num_segments is not None else None
+    return jax.ops.segment_sum(data, segment_ids.astype(jnp.int32), n)
+
+
+@register("segment_mean")
+def _segment_mean(data, segment_ids, num_segments=None):
+    ids = segment_ids.astype(jnp.int32)
+    n = int(num_segments) if num_segments is not None else None
+    tot = jax.ops.segment_sum(data, ids, n)
+    cnt = jax.ops.segment_sum(jnp.ones_like(data, jnp.float32), ids, n)
+    return tot / jnp.maximum(cnt, 1.0)
+
+
+@register("segment_max")
+def _segment_max(data, segment_ids, num_segments=None):
+    n = int(num_segments) if num_segments is not None else None
+    return jax.ops.segment_max(data, segment_ids.astype(jnp.int32), n)
+
+
+@register("segment_min")
+def _segment_min(data, segment_ids, num_segments=None):
+    n = int(num_segments) if num_segments is not None else None
+    return jax.ops.segment_min(data, segment_ids.astype(jnp.int32), n)
+
+
+@register("segment_prod")
+def _segment_prod(data, segment_ids, num_segments=None):
+    n = int(num_segments) if num_segments is not None else None
+    return jax.ops.segment_prod(data, segment_ids.astype(jnp.int32), n)
+
+
+@register("unsorted_segment_sum")
+def _unsorted_segment_sum(data, segment_ids, num_segments):
+    return jax.ops.segment_sum(data, segment_ids.astype(jnp.int32),
+                               int(num_segments), indices_are_sorted=False)
+
+
+@register("embedding_lookup")
+def _embedding_lookup(table, ids):
+    """Dense gather over the vocab axis (reference embedding_lookup — XLA
+    lowers this to a dynamic-gather the TPU executes natively)."""
+    return jnp.take(table, ids.astype(jnp.int32), axis=0)
+
+
+@register("embedding_bag")
+def _embedding_bag(table, ids, offsets=None, mode="sum"):
+    """Pooled embedding gather (reference/torch EmbeddingBag): ``ids``
+    (B, L) with -1 padding; pooled over L."""
+    ids = ids.astype(jnp.int32)
+    valid = (ids >= 0).astype(table.dtype)[..., None]
+    emb = jnp.take(table, jnp.maximum(ids, 0), axis=0) * valid
+    if mode == "sum":
+        return jnp.sum(emb, axis=-2)
+    if mode == "mean":
+        return jnp.sum(emb, axis=-2) / jnp.maximum(
+            jnp.sum(valid, axis=-2), 1.0)
+    if mode == "max":
+        neg = jnp.where(valid > 0, emb, jnp.full_like(emb, -jnp.inf))
+        return jnp.max(neg, axis=-2)
+    raise ValueError(f"embedding_bag mode {mode!r}")
+
+
+# ------------------------------------------------------ spatial transforms
+# (reference space_to_batch/depth family + dilation2d)
+
+
+@register("space_to_batch")
+def _space_to_batch(x, block_size=2, paddings=((0, 0), (0, 0))):
+    p = [[0, 0]] + [list(q) for q in paddings] + [[0, 0]]
+    x = jnp.pad(x, p)
+    n, h, w, c = x.shape
+    bs = int(block_size)
+    x = x.reshape(n, h // bs, bs, w // bs, bs, c)
+    x = x.transpose(2, 4, 0, 1, 3, 5)
+    return x.reshape(n * bs * bs, h // bs, w // bs, c)
+
+
+@register("batch_to_space")
+def _batch_to_space(x, block_size=2, crops=((0, 0), (0, 0))):
+    nb, h, w, c = x.shape
+    bs = int(block_size)
+    n = nb // (bs * bs)
+    x = x.reshape(bs, bs, n, h, w, c)
+    x = x.transpose(2, 3, 0, 4, 1, 5)
+    x = x.reshape(n, h * bs, w * bs, c)
+    (ct, cb), (cl, cr) = crops
+    return x[:, int(ct):h * bs - int(cb), int(cl):w * bs - int(cr), :]
+
+
+@register("space_to_depth")
+def _space_to_depth(x, block_size=2):
+    n, h, w, c = x.shape
+    bs = int(block_size)
+    x = x.reshape(n, h // bs, bs, w // bs, bs, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // bs, w // bs, bs * bs * c)
+
+
+@register("depth_to_space")
+def _depth_to_space(x, block_size=2):
+    n, h, w, c = x.shape
+    bs = int(block_size)
+    x = x.reshape(n, h, w, bs, bs, c // (bs * bs))
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(n, h * bs, w * bs, c // (bs * bs))
+
+
+@register("dilation2d")
+def _dilation2d(x, kernel, stride=(1, 1), rates=(1, 1), padding="SAME"):
+    """Grayscale morphological dilation (reference Dilation2D)."""
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max,
+        window_dimensions=(1, kernel.shape[0], kernel.shape[1], 1),
+        window_strides=(1, int(stride[0]), int(stride[1]), 1),
+        window_dilation=(1, int(rates[0]), int(rates[1]), 1),
+        padding=padding) if kernel.ndim == 2 else _dilation2d_full(
+            x, kernel, stride, rates, padding)
+
+
+def _dilation2d_full(x, kernel, stride, rates, padding):
+    # kernel (kh, kw, C): shifted adds then max — small kernels only
+    kh, kw, c = kernel.shape
+    pads = jax.lax.padtype_to_pads(
+        x.shape, (1, kh, kw, 1),
+        (1, int(stride[0]), int(stride[1]), 1), padding) if isinstance(
+            padding, str) else padding
+    patches = []
+    xp = jnp.pad(x, [(0, 0), tuple(pads[1]), tuple(pads[2]), (0, 0)],
+                 constant_values=-jnp.inf)
+    oh = (xp.shape[1] - ((kh - 1) * int(rates[0]) + 1)) // int(stride[0]) + 1
+    ow = (xp.shape[2] - ((kw - 1) * int(rates[1]) + 1)) // int(stride[1]) + 1
+    for i in range(kh):
+        for j in range(kw):
+            sl = xp[:, i * int(rates[0]):, j * int(rates[1]):, :]
+            sl = sl[:, :oh * int(stride[0]):int(stride[0]),
+                    :ow * int(stride[1]):int(stride[1]), :]
+            patches.append(sl + kernel[i, j])
+    return jnp.max(jnp.stack(patches), axis=0)
+
+
+# ------------------------------------------------------------ image extras
+# (reference crop_and_resize + non_max_suppression — the detection path)
+
+
+@register("crop_and_resize")
+def _crop_and_resize(images, boxes, box_indices, crop_size, method="bilinear"):
+    """Per-box crop + resize (reference CropAndResize; TF semantics:
+    boxes are normalised [y1, x1, y2, x2])."""
+    n, h, w, c = images.shape
+    ch, cw = (int(s) for s in crop_size)
+
+    def one(box, bi):
+        y1, x1, y2, x2 = box
+        ys = y1 * (h - 1) + jnp.arange(ch) * (y2 - y1) * (h - 1) / max(ch - 1, 1)
+        xs = x1 * (w - 1) + jnp.arange(cw) * (x2 - x1) * (w - 1) / max(cw - 1, 1)
+        img = images[bi]
+        if method == "nearest":
+            yi = jnp.clip(jnp.round(ys).astype(jnp.int32), 0, h - 1)
+            xi = jnp.clip(jnp.round(xs).astype(jnp.int32), 0, w - 1)
+            return img[yi][:, xi]
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+        x1i = jnp.clip(x0 + 1, 0, w - 1)
+        wy = (ys - y0)[:, None, None]
+        wx = (xs - x0)[None, :, None]
+        a = img[y0][:, x0]
+        b = img[y0][:, x1i]
+        cc = img[y1i][:, x0]
+        d = img[y1i][:, x1i]
+        return (a * (1 - wy) * (1 - wx) + b * (1 - wy) * wx
+                + cc * wy * (1 - wx) + d * wy * wx)
+
+    return jax.vmap(one)(boxes, box_indices.astype(jnp.int32))
+
+
+@register("non_max_suppression")
+def _non_max_suppression(boxes, scores, max_output_size=10,
+                         iou_threshold=0.5, score_threshold=-jnp.inf):
+    """Greedy NMS (reference NonMaxSuppression) as a fixed-trip lax.scan —
+    static output size (TPU-friendly): returns (indices, valid_mask)."""
+    k = int(max_output_size)
+
+    y1, x1, y2, x2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = jnp.maximum(y2 - y1, 0) * jnp.maximum(x2 - x1, 0)
+
+    def iou_with(i):
+        yy1 = jnp.maximum(y1, y1[i])
+        xx1 = jnp.maximum(x1, x1[i])
+        yy2 = jnp.minimum(y2, y2[i])
+        xx2 = jnp.minimum(x2, x2[i])
+        inter = jnp.maximum(yy2 - yy1, 0) * jnp.maximum(xx2 - xx1, 0)
+        return inter / jnp.maximum(area + area[i] - inter, 1e-9)
+
+    def step(state, _):
+        avail, = state
+        masked = jnp.where(avail, scores, -jnp.inf)
+        i = jnp.argmax(masked)
+        ok = masked[i] > score_threshold
+        suppress = iou_with(i) >= iou_threshold
+        avail = avail & ~suppress & (jnp.arange(len(scores)) != i)
+        return (avail,), (jnp.where(ok, i, -1), ok)
+
+    (_,), (idx, valid) = jax.lax.scan(
+        step, (jnp.ones(len(scores), bool),), None, length=k)
+    return idx.astype(jnp.int32), valid
+
+
+# --------------------------------------------------------- random (extras)
+
+
+@register("random_gamma")
+def _random_gamma(shape=None, alpha=1.0, beta=1.0, seed=0):
+    import jax
+    return jax.random.gamma(_key(seed), alpha, tuple(shape)) / beta
+
+
+@register("random_poisson")
+def _random_poisson(shape=None, lam=1.0, seed=0):
+    import jax
+    return jax.random.poisson(_key(seed), lam, tuple(shape)).astype(jnp.float32)
+
+
+@register("random_gumbel")
+def _random_gumbel(shape=None, seed=0):
+    import jax
+    return jax.random.gumbel(_key(seed), tuple(shape))
+
+
+@register("random_laplace")
+def _random_laplace(shape=None, seed=0):
+    import jax
+    return jax.random.laplace(_key(seed), tuple(shape))
+
+
+@register("truncated_normal")
+def _truncated_normal(shape=None, mean=0.0, stddev=1.0, seed=0):
+    import jax
+    return mean + stddev * jax.random.truncated_normal(
+        _key(seed), -2.0, 2.0, tuple(shape))
+
+
+@register("random_categorical")
+def _random_categorical(logits, num_samples=1, seed=0):
+    import jax
+    return jax.random.categorical(
+        _key(seed), logits, axis=-1,
+        shape=(int(num_samples),) + logits.shape[:-1]).swapaxes(0, -1)
+
+
+@register("multinomial")
+def _multinomial(probs, num_samples=1, seed=0):
+    import jax
+    return jax.random.categorical(
+        _key(seed), jnp.log(jnp.maximum(probs, 1e-30)), axis=-1,
+        shape=(int(num_samples),) + probs.shape[:-1]).swapaxes(0, -1)
+
+
+# ----------------------------------------------------- misc math / sorting
+
+
+@register("top_k")
+def _top_k(a, k=1):
+    v, i = jax.lax.top_k(a, int(k))
+    return v, i.astype(jnp.int32)
+
+
+@register("in_top_k")
+def _in_top_k(predictions, targets, k=1):
+    _, idx = jax.lax.top_k(predictions, int(k))
+    return jnp.any(idx == targets.astype(jnp.int32)[:, None], axis=-1)
+
+
+@register("sort")
+def _sort(a, axis=-1, descending=False):
+    out = jnp.sort(a, axis=axis)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+@register("argsort")
+def _argsort(a, axis=-1, descending=False):
+    out = jnp.argsort(a, axis=axis).astype(jnp.int32)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+@register("unique")
+def _unique(a, size=None):
+    """Static-size unique (XLA needs static shapes): returns (values,
+    counts) padded to ``size`` (defaults to a.size) with the fill value."""
+    n = int(size) if size is not None else a.size
+    vals, counts = jnp.unique(a, return_counts=True, size=n, fill_value=0)
+    return vals, counts.astype(jnp.int32)
+
+
+@register("bincount")
+def _bincount(a, minlength=0, maxlength=None, weights=None):
+    """TF ``tf.math.bincount`` semantics. Under jit the output length must
+    be static: pass ``maxlength`` (values >= maxlength are dropped, as in
+    TF). Without ``maxlength`` the length is computed from the concrete
+    data (numpy semantics) — eager only."""
+    flat = a.astype(jnp.int32).ravel()
+    if maxlength is not None:
+        return jnp.bincount(flat, weights=weights, minlength=int(minlength),
+                            length=int(maxlength))
+    # eager path: concrete max. Inside jit this raises a tracer error with
+    # a clear remedy rather than silently truncating counts.
+    try:
+        needed = int(jnp.max(flat)) + 1 if flat.size else 0
+    except Exception as e:
+        raise ValueError(
+            "bincount without maxlength needs concrete data; pass "
+            "maxlength= for a static output length under jit") from e
+    return jnp.bincount(flat, weights=weights,
+                        length=max(int(minlength), needed, 1))
+
+
+@register("searchsorted")
+def _searchsorted(sorted_seq, values, side="left"):
+    return jnp.searchsorted(sorted_seq, values, side=side).astype(jnp.int32)
+
+
+@register("isnan")
+def _isnan(a):
+    return jnp.isnan(a)
+
+
+@register("isinf")
+def _isinf(a):
+    return jnp.isinf(a)
+
+
+@register("isfinite")
+def _isfinite(a):
+    return jnp.isfinite(a)
+
+
+@register("nan_to_num")
+def _nan_to_num(a, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@register("atan2")
+def _atan2(a, b):
+    return jnp.arctan2(a, b)
+
+
+@register("asinh")
+def _asinh(a):
+    return jnp.arcsinh(a)
+
+
+@register("acosh")
+def _acosh(a):
+    return jnp.arccosh(a)
+
+
+@register("atanh")
+def _atanh(a):
+    return jnp.arctanh(a)
+
+
+@register("expm1")
+def _expm1(a):
+    return jnp.expm1(a)
+
+
+@register("rint")
+def _rint(a):
+    return jnp.rint(a)
+
+
+@register("erfc")
+def _erfc(a):
+    return jax.scipy.special.erfc(a)
+
+
+@register("lgamma")
+def _lgamma(a):
+    return jax.scipy.special.gammaln(a)
+
+
+@register("digamma")
+def _digamma(a):
+    return jax.scipy.special.digamma(a)
+
+
+@register("betainc")
+def _betainc(a, b, x):
+    return jax.scipy.special.betainc(a, b, x)
+
+
+@register("igamma")
+def _igamma(a, x):
+    return jax.scipy.special.gammainc(a, x)
+
+
+@register("igammac")
+def _igammac(a, x):
+    return jax.scipy.special.gammaincc(a, x)
+
+
+@register("zeta")
+def _zeta(x, q):
+    return jax.scipy.special.zeta(x, q)
+
+
+@register("polygamma")
+def _polygamma(n, x):
+    return jax.scipy.special.polygamma(n.astype(jnp.int32) if hasattr(n, "astype") else int(n), x)
+
+
+@register("xlogy")
+def _xlogy(x, y):
+    return jax.scipy.special.xlogy(x, y)
+
+
+@register("cumprod")
+def _cumprod(a, axis=-1):
+    return jnp.cumprod(a, axis=axis)
+
+
+@register("logcumsumexp")
+def _logcumsumexp(a, axis=-1):
+    return jax.lax.cumlogsumexp(a, axis=axis)
+
+
+@register("clip_by_norm")
+def _clip_by_norm(a, clip_norm, axes=None):
+    n = jnp.sqrt(jnp.sum(a * a, axis=axes, keepdims=axes is not None))
+    scale = jnp.where(n > clip_norm, clip_norm / jnp.maximum(n, 1e-12), 1.0)
+    return a * scale
+
+
+@register("clip_by_global_norm")
+def _clip_by_global_norm(a, clip_norm):
+    n = jnp.sqrt(jnp.sum(a * a))
+    return a * jnp.where(n > clip_norm, clip_norm / jnp.maximum(n, 1e-12), 1.0)
+
+
+@register("swap_axes")
+def _swap_axes(a, axis1=0, axis2=1):
+    return jnp.swapaxes(a, int(axis1), int(axis2))
+
+
+@register("meshgrid")
+def _meshgrid(a, b, indexing="xy"):
+    return tuple(jnp.meshgrid(a, b, indexing=indexing))
+
+
+@register("broadcast_to")
+def _broadcast_to(a, shape):
+    return jnp.broadcast_to(a, tuple(int(s) for s in shape))
+
+
+@register("squared_norm")
+def _squared_norm(a, axis=None, keepdims=False):
+    return jnp.sum(a * a, axis=axis, keepdims=keepdims)

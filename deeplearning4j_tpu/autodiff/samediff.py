@@ -146,6 +146,8 @@ class SDVariable:
 
     def set_arr(self, value):
         self.sd.arrays[self.name] = jnp.asarray(value)
+        # a CONSTANT's value is baked into traced train steps — invalidate
+        self.sd._graph_version += 1
 
     def rename(self, new_name: str) -> "SDVariable":
         self.sd._rename(self.name, new_name)
@@ -189,7 +191,9 @@ _CNN_OPS = ["conv2d", "max_pool2d", "avg_pool2d", "batch_norm"]
 _RNN_OPS = ["lstm_layer", "gru", "lstm_cell", "gru_cell"]
 # ops whose registry callable returns a tuple (namespace calls unpack them)
 _MULTI_OUTPUT_OPS = {"lstm_layer": 3, "gru": 2, "lstm_cell": 2,
-                     "svd": 3, "qr": 2, "eigh": 2}
+                     "svd": 3, "qr": 2, "eigh": 2,
+                     "top_k": 2, "unique": 2, "non_max_suppression": 2,
+                     "meshgrid": 2}
 _LOSS_OPS = ["softmax_cross_entropy", "sparse_softmax_cross_entropy",
              "sigmoid_cross_entropy", "mean_squared_error", "mean_absolute_error",
              "l2_loss", "log_loss", "cosine_distance", "hinge_loss", "huber_loss"]
@@ -200,11 +204,14 @@ _LINALG_OPS = ["cholesky", "solve", "triangular_solve", "lstsq",
 _BITWISE_OPS = ["bitwise_and", "bitwise_or", "bitwise_xor", "bit_shift",
                 "bit_shift_right", "bit_rotl", "bit_rotr"]
 _RANDOM_OPS = ["random_uniform", "random_normal", "random_bernoulli",
-               "random_exponential", "random_shuffle"]
+               "random_exponential", "random_shuffle", "random_gamma",
+               "random_poisson", "random_gumbel", "random_laplace",
+               "truncated_normal", "random_categorical", "multinomial"]
 _IMAGE_OPS = ["resize_bilinear", "resize_nearest", "crop_to_box",
               "flip_left_right", "flip_up_down", "adjust_brightness",
               "adjust_contrast", "adjust_saturation", "rgb_to_grayscale",
-              "hsv_to_rgb", "rgb_to_hsv"]
+              "hsv_to_rgb", "rgb_to_hsv", "crop_and_resize",
+              "non_max_suppression"]
 
 
 @dataclasses.dataclass
@@ -240,6 +247,7 @@ class SameDiff:
         self.loss_variables: List[str] = []
         self.training_config: Optional[TrainingConfig] = None
         self._name_counter = 0
+        self._graph_version = 0  # bumped on any change a traced step closed over
         self._opt_state = None
         self._tx = None
         self._jit_cache: Dict[Any, Any] = {}
@@ -307,6 +315,42 @@ class SameDiff:
             return x
         return self.constant(None, x)
 
+    def convert_to_variable(self, *names) -> None:
+        """Make CONSTANT variables trainable (reference
+        ``sd.convertToVariable``) — the fine-tune-an-imported-graph path:
+        ``TFGraphMapper.import_graph`` materialises weights as constants;
+        converting them lets ``fit()`` train them."""
+        for n in names:
+            n = n.name if isinstance(n, SDVariable) else n
+            v = self.vars[n]
+            if v.vtype == VariableType.VARIABLE:
+                continue
+            if v.vtype != VariableType.CONSTANT:
+                raise ValueError(f"{n!r} is {v.vtype.value}, not a constant")
+            v.vtype = VariableType.VARIABLE
+        self._jit_cache.clear()
+
+    def convert_to_constant(self, *names) -> None:
+        """Freeze VARIABLEs (reference ``sd.convertToConstant``) — e.g. to
+        fine-tune only a grafted head on an imported backbone."""
+        for n in names:
+            n = n.name if isinstance(n, SDVariable) else n
+            v = self.vars[n]
+            if v.vtype == VariableType.VARIABLE:
+                v.vtype = VariableType.CONSTANT
+        self._jit_cache.clear()
+
+    def trainable_float_constants(self, min_size: int = 2) -> List[str]:
+        """Names of float CONSTANTs big enough to plausibly be weights
+        (imported-model helper: everything except scalar/axis-style consts)."""
+        out = []
+        for n, a in self.arrays.items():
+            if (self.vars[n].vtype == VariableType.CONSTANT
+                    and jnp.issubdtype(a.dtype, jnp.floating)
+                    and a.size >= min_size):
+                out.append(n)
+        return out
+
     def _rename(self, old: str, new: str) -> None:
         if new in self.vars:
             raise ValueError(f"Variable {new!r} already exists")
@@ -320,6 +364,7 @@ class SameDiff:
             node.outputs = [new if o == old else o for o in node.outputs]
         self.loss_variables = [new if n == old else n for n in self.loss_variables]
         self._jit_cache.clear()
+        self._graph_version += 1
 
     # ------------------------------------------------------------------- ops
     def _apply(self, op: str, inputs: List[SDVariable], attrs=None, name=None,
@@ -335,6 +380,7 @@ class SameDiff:
         self.ops.append(OpNode(op=op, inputs=[v.name for v in inputs],
                                outputs=[o.name for o in outs], attrs=attrs))
         self._jit_cache.clear()
+        self._graph_version += 1
         return outs[0] if n_outputs == 1 else tuple(outs)
 
     def invoke(self, op: str, *args, name=None, n_outputs: int = 1, **attrs):
@@ -355,16 +401,20 @@ class SameDiff:
         self.ops.append(OpNode(op="__callable__", inputs=[v.name for v in inputs],
                                outputs=[o.name for o in outs], attrs={"fn": fn}))
         self._jit_cache.clear()
+        self._graph_version += 1
         return outs[0] if n_outputs == 1 else tuple(outs)
 
-    def cond(self, pred, true_fn, false_fn, *operands, name: str = "cond"):
+    def cond(self, pred, true_fn, false_fn, *operands, name: str = "cond",
+             n_outputs: int = 1):
         """``lax.cond`` over graph values: ``true_fn``/``false_fn`` take the
-        operand arrays and return one array (reference: If/Switch-Merge)."""
+        operand arrays and return ``n_outputs`` arrays (reference:
+        If/Switch-Merge)."""
         def fn(p, *xs):
             return jax.lax.cond(jnp.reshape(p, ()).astype(bool), true_fn, false_fn, *xs)
 
         return self._apply_callable(
-            fn, [self._lift(pred)] + [self._lift(o) for o in operands], name)
+            fn, [self._lift(pred)] + [self._lift(o) for o in operands], name,
+            n_outputs=n_outputs)
 
     def while_loop(self, cond_fn, body_fn, *init, name: str = "while",
                    max_iterations: Optional[int] = None):
@@ -473,6 +523,10 @@ class SameDiff:
 
     def set_training_config(self, cfg: TrainingConfig) -> None:
         self.training_config = cfg
+        self._graph_version += 1
+        # a new config means a new updater: rebuild optimizer state lazily
+        self._tx = None
+        self._opt_state = None
 
     def _trainable(self) -> Dict[str, jax.Array]:
         return {n: a for n, a in self.arrays.items()
@@ -482,13 +536,24 @@ class SameDiff:
         cfg = self.training_config
         consts = {n: a for n, a in self.arrays.items()
                   if self.vars[n].vtype == VariableType.CONSTANT}
+        # Mixed precision (TPU policy): master weights stay f32; the traced
+        # program computes in env.compute_dtype (bf16 when enabled via
+        # Environment.allow_bfloat16). Grads flow back through the cast, so
+        # updates land on the f32 masters.
+        from deeplearning4j_tpu.runtime.environment import get_environment
+        cdt = get_environment().compute_dtype
+
+        def _c(a):
+            if jnp.issubdtype(a.dtype, jnp.floating) and a.dtype != cdt:
+                return a.astype(cdt)
+            return a
 
         def loss_fn(trainable, placeholders):
-            env = dict(consts)
-            env.update(trainable)
-            env.update(placeholders)
+            env = {n: _c(a) for n, a in consts.items()}
+            env.update({n: _c(a) for n, a in trainable.items()})
+            env.update({n: _c(a) for n, a in placeholders.items()})
             losses = self._exec_graph(env, self.loss_variables)
-            total = sum(jnp.sum(l) for l in losses)
+            total = sum(jnp.sum(l.astype(jnp.float32)) for l in losses)
             if cfg.l2:
                 total = total + 0.5 * cfg.l2 * sum(
                     jnp.sum(w * w) for w in trainable.values())
@@ -512,8 +577,11 @@ class SameDiff:
         if not self.loss_variables:
             raise ValueError("Call set_loss_variables first")
         cfg = self.training_config
-        if labels is not None:
-            from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+        if isinstance(data, MultiDataSet):
+            from deeplearning4j_tpu.data.iterators import ExistingDataSetIterator
+            iterator = ExistingDataSetIterator([data])
+        elif labels is not None:
             from deeplearning4j_tpu.data.iterators import ListDataSetIterator
             iterator = ListDataSetIterator(
                 [DataSet(np.asarray(data), np.asarray(labels))],
@@ -525,7 +593,15 @@ class SameDiff:
             self._tx = cfg.updater.make()
             self._opt_state = self._tx.init(trainable)
         ph_names = tuple(cfg.data_set_feature_mapping + cfg.data_set_label_mapping)
-        step = self._make_train_step(ph_names)
+        from deeplearning4j_tpu.runtime.environment import get_environment
+        # _graph_version covers everything the traced step closes over that
+        # the structural key can't see: constant VALUES (set_arr), the
+        # training config (l1/l2), graph edits
+        key = ("train_step", ph_names, str(get_environment().compute_dtype),
+               tuple(sorted(trainable)), self._graph_version)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._make_train_step(ph_names)
+        step = self._jit_cache[key]
         history = []
         for _ in range(int(epochs)):
             iterator.reset()
@@ -537,9 +613,12 @@ class SameDiff:
                 ph.update({n: jnp.asarray(a) for n, a in
                            zip(cfg.data_set_label_mapping, labs)})
                 trainable, self._opt_state, loss = step(trainable, self._opt_state, ph)
-                history.append(float(loss))
+                # keep the loss on-device: a float() here would stall the
+                # pipeline on every step (one full host round-trip per batch
+                # through a remote-device tunnel)
+                history.append(loss)
         self.arrays.update(trainable)
-        return history
+        return [float(l) for l in history]
 
     def calculate_gradients(self, placeholders: Dict[str, Any],
                             *wrt: str) -> Dict[str, jax.Array]:

@@ -331,12 +331,77 @@ def _map_layer(kl) -> Optional[object]:
     if cls == "TimeDistributed":
         from deeplearning4j_tpu.nn import TimeDistributed
         return TimeDistributed(underlying=_map_layer(kl.layer))
+    if cls == "SeparableConv1D":
+        from deeplearning4j_tpu.nn import SeparableConvolution1D
+        return SeparableConvolution1D(
+            n_out=cfg["filters"],
+            kernel_size=cfg["kernel_size"][0] if isinstance(cfg["kernel_size"], (tuple, list)) else cfg["kernel_size"],
+            stride=cfg["strides"][0] if isinstance(cfg["strides"], (tuple, list)) else cfg["strides"],
+            convolution_mode="same" if cfg["padding"] == "same" else "truncate",
+            depth_multiplier=cfg.get("depth_multiplier", 1),
+            activation=_act_name(kl.activation), has_bias=cfg.get("use_bias", True))
+    if cls == "LocallyConnected1D":
+        from deeplearning4j_tpu.nn import LocallyConnected1D
+        return LocallyConnected1D(
+            n_out=cfg["filters"],
+            kernel_size=cfg["kernel_size"][0] if isinstance(cfg["kernel_size"], (tuple, list)) else cfg["kernel_size"],
+            stride=cfg["strides"][0] if isinstance(cfg["strides"], (tuple, list)) else cfg["strides"],
+            activation=_act_name(kl.activation), has_bias=cfg.get("use_bias", True))
+    if cls == "LocallyConnected2D":
+        from deeplearning4j_tpu.nn import LocallyConnected2D
+        return LocallyConnected2D(
+            n_out=cfg["filters"], kernel_size=_pair(cfg["kernel_size"]),
+            stride=_pair(cfg["strides"]),
+            activation=_act_name(kl.activation), has_bias=cfg.get("use_bias", True))
+    if cls == "ConvLSTM2D":
+        from deeplearning4j_tpu.nn import ConvLSTM2D
+        if cfg.get("recurrent_activation", "sigmoid") not in ("sigmoid",):
+            raise NotImplementedError(
+                "ConvLSTM2D recurrent_activation "
+                f"{cfg['recurrent_activation']!r} not mapped (sigmoid only)")
+        if cfg.get("activation", "tanh") not in ("tanh",):
+            raise NotImplementedError(
+                f"ConvLSTM2D activation {cfg['activation']!r} not mapped")
+        if cfg.get("dilation_rate") not in (None, 1, (1, 1), [1, 1]):
+            raise NotImplementedError("ConvLSTM2D dilation not mapped")
+        return ConvLSTM2D(n_out=cfg["filters"],
+                          kernel_size=_pair(cfg["kernel_size"]),
+                          stride=_pair(cfg.get("strides", 1)),
+                          convolution_mode="same" if cfg["padding"] == "same"
+                          else "truncate",
+                          has_bias=cfg.get("use_bias", True),
+                          return_sequences=cfg.get("return_sequences", False))
+    if cls in ("MaxPooling1D", "AveragePooling1D"):
+        from deeplearning4j_tpu.nn import Subsampling1DLayer
+        ps = cfg["pool_size"]
+        ps = ps[0] if isinstance(ps, (tuple, list)) else ps
+        st = cfg["strides"] or ps
+        st = st[0] if isinstance(st, (tuple, list)) else st
+        return Subsampling1DLayer(
+            pooling_type="max" if cls.startswith("Max") else "avg",
+            kernel_size=ps, stride=st,
+            convolution_mode="same" if cfg["padding"] == "same" else "truncate")
+    if cls == "Permute":
+        from deeplearning4j_tpu.nn import PermuteLayer
+        return PermuteLayer(dims=tuple(cfg["dims"]))
+    if cls == "ThresholdedReLU":
+        from deeplearning4j_tpu.nn.misc_layers import LambdaLayer
+        theta = float(cfg.get("theta", 1.0))
+        import jax.numpy as _jnp
+        return LambdaLayer(fn=lambda t, _th=theta: t * (t > _th).astype(t.dtype),
+                           fn_name=f"thresholded_relu_{theta}")
+    if cls in ("GlobalAveragePooling3D", "GlobalMaxPooling3D"):
+        return GlobalPoolingLayer(
+            pooling_type=PoolingType.AVG if "Average" in cls else PoolingType.MAX)
     if cls in ("SpatialDropout1D", "SpatialDropout2D", "GaussianDropout",
                "AlphaDropout"):
         # train-time-only stochastic layers; retain-prob dropout is the
         # closest training analog and all are identity at inference
         return DropoutLayer(dropout=1.0 - cfg.get("rate", 0.0))
-    if cls in ("Flatten", "InputLayer", "Reshape", "GaussianNoise",
+    if cls == "Flatten":
+        from deeplearning4j_tpu.nn import FlattenLayer
+        return FlattenLayer()
+    if cls in ("InputLayer", "Reshape", "GaussianNoise",
                "ActivityRegularization", "Masking"):
         # structural no-ops here: Flatten/Reshape via shape inference;
         # noise/regularization are identity at inference; Masking becomes an
@@ -367,10 +432,11 @@ def _copy_weights(kl, layer, params: Dict[str, np.ndarray]) -> Dict:
         if len(w) > 1:
             out["b"] = jnp.asarray(w[1])
     elif cls == "SeparableConv2D":
-        dw = w[0]  # (kh, kw, in, depth_mult) -> ours (kh, kw, 1, in*dm)
+        dw = w[0]  # (kh, kw, in, depth_mult) -> ours (kh, kw, 1, in*dm);
+        # grouped-conv output channels are group-major (c*dm + d), which is
+        # exactly the (in, dm) row-major flattening — no transpose
         kh, kw, cin, dm = dw.shape
-        out["W_depth"] = jnp.asarray(
-            np.transpose(dw, (0, 1, 3, 2)).reshape(kh, kw, 1, cin * dm))
+        out["W_depth"] = jnp.asarray(dw.reshape(kh, kw, 1, cin * dm))
         out["W_point"] = jnp.asarray(w[1])
         if len(w) > 2:
             out["b"] = jnp.asarray(w[2])
@@ -421,6 +487,32 @@ def _copy_weights(kl, layer, params: Dict[str, np.ndarray]) -> Dict:
         out["alpha"] = jnp.asarray(w[0])
     elif cls == "TimeDistributed":
         out = _copy_weights(kl.layer, layer.underlying, out)
+    elif cls == "SeparableConv1D":
+        dw = w[0]  # (k, in, depth_mult) -> ours (k, 1, 1, in*dm), group-major
+        k, cin, dm = dw.shape
+        out["W_depth"] = jnp.asarray(dw.reshape(k, 1, 1, cin * dm))
+        out["W_point"] = jnp.asarray(w[1][:, None, :, :])  # (1, in*dm, out)
+        if len(w) > 2:
+            out["b"] = jnp.asarray(w[2])
+    elif cls == "LocallyConnected1D":
+        # keras implementation=1 stores (out_t, k*in, filters)
+        k0 = w[0]
+        out["W"] = jnp.asarray(k0[:, None, :, :])
+        if len(w) > 1:
+            out["b"] = jnp.asarray(w[1].reshape(out["b"].shape)
+                                   if "b" in out else w[1])
+    elif cls == "LocallyConnected2D":
+        k0 = w[0]  # (oh*ow, k*k*in, filters) or (oh, ow, ...)
+        tgt = out["W"].shape
+        out["W"] = jnp.asarray(np.asarray(k0).reshape(tgt))
+        if len(w) > 1:
+            out["b"] = jnp.asarray(np.asarray(w[1]).reshape(out["b"].shape))
+    elif cls == "ConvLSTM2D":
+        # keras gate order [i, f, c, o] == ours [i, f, g, o]
+        out["W"] = jnp.asarray(w[0])
+        out["W_rec"] = jnp.asarray(w[1])
+        if len(w) > 2:
+            out["b"] = jnp.asarray(w[2])
     return out
 
 
@@ -523,6 +615,20 @@ def _import_functional(km):
             g.add_vertex(kl.name, ElementWiseVertex(op="subtract"), *srcs)
         elif cls == "Maximum":
             g.add_vertex(kl.name, ElementWiseVertex(op="max"), *srcs)
+        elif cls == "Minimum":
+            g.add_vertex(kl.name, ElementWiseVertex(op="min"), *srcs)
+        elif cls == "Dot":
+            dcfg = kl.get_config()
+            if dcfg.get("normalize"):
+                raise NotImplementedError("Dot(normalize=True) not mapped")
+            axes = dcfg.get("axes", -1)
+            ax_set = {axes} if isinstance(axes, int) else set(axes)
+            # the vertex contracts the LAST axis; anything else (batch_dot
+            # over middle axes) is a different computation — fail loudly
+            if not ax_set <= {-1, 1}:
+                raise NotImplementedError(
+                    f"Dot(axes={axes}) not mapped (last-axis only)")
+            g.add_vertex(kl.name, ElementWiseVertex(op="dot"), *srcs)
         elif cls == "Concatenate":
             g.add_vertex(kl.name, MergeVertex(), *srcs)
         elif cls == "Flatten":

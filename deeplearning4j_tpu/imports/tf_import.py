@@ -41,6 +41,40 @@ class TFGraphMapper:
             gd = path_or_graphdef
         return _GraphImporter(gd, input_shapes or {}).run()
 
+    @staticmethod
+    def import_saved_model(path: str, signature: str = "serving_default",
+                           input_shapes: Optional[Dict[str, tuple]] = None):
+        """Load a TF2 SavedModel, freeze the named signature, import it.
+        Returns ``(sd, input_names, output_names)`` (the reference's
+        SavedModel entry point on TFGraphMapper)."""
+        tf = _tf()
+        from tensorflow.python.framework.convert_to_constants import (
+            convert_variables_to_constants_v2)
+        sm = tf.saved_model.load(path)
+        fn = sm.signatures[signature]
+        frozen = convert_variables_to_constants_v2(fn)
+        gd = frozen.graph.as_graph_def()
+        sd = _GraphImporter(gd, input_shapes or {}).run()
+        inputs = [t.name.split(":")[0] for t in frozen.inputs
+                  if t.dtype != tf.resource]
+        outputs = [t.name.split(":")[0] for t in frozen.outputs]
+        return sd, inputs, outputs
+
+
+def _flatten_ref(ref: str) -> str:
+    """FunctionDef input ref ('node:tag:idx', 'node:idx' or 'arg') to
+    top-level GraphDef form ('node' / 'node:idx')."""
+    ctrl = ref.startswith("^")
+    if ctrl:
+        ref = ref[1:]
+    parts = ref.split(":")
+    if len(parts) == 1:
+        out = parts[0]
+    else:
+        idx = parts[-1]
+        out = parts[0] if idx == "0" or not idx.isdigit() else f"{parts[0]}:{idx}"
+    return ("^" + out) if ctrl else out
+
 
 class _GraphImporter:
     def __init__(self, graph_def, input_shapes: Dict[str, tuple]):
@@ -49,6 +83,11 @@ class _GraphImporter:
         self.sd = SameDiff.create()
         self.const_values: Dict[str, np.ndarray] = {}
         self.node_by_name = {n.name: n for n in self.gd.node}
+        # TF2 function library (While/If bodies, PartitionedCall targets)
+        self.functions = {f.signature.name: f
+                          for f in graph_def.library.function}
+        self._switch_pred: Dict[str, str] = {}   # Switch node -> pred ref
+        self._switch_memo: Dict[str, Optional[tuple]] = {}
 
     # --- helpers ---
     @staticmethod
@@ -90,6 +129,9 @@ class _GraphImporter:
 
     def _ensure_var(self, name: str) -> str:
         """Map a TF input ref to an sd variable name (materialising consts)."""
+        raw = name[1:] if name.startswith("^") else name
+        if raw in self.sd.vars:
+            return raw  # exact match, incl. multi-output refs like "while:1"
         name = self._clean(name)
         if name in self.sd.vars:
             return name
@@ -124,6 +166,128 @@ class _GraphImporter:
 
     def _inputs(self, node) -> List[str]:
         return [i for i in node.input if not i.startswith("^")]
+
+    def _controlling_switch(self, ref: str) -> Optional[tuple]:
+        """Walk ancestors of ``ref`` to the nearest Switch; returns
+        (switch_name, taken_output_index) or None."""
+        if ref in self._switch_memo:
+            return self._switch_memo[ref]
+        self._switch_memo[ref] = None  # cycle guard
+        ref2 = ref[1:] if ref.startswith("^") else ref
+        name, _, idx = ref2.partition(":")
+        node = self.node_by_name.get(name)
+        res = None
+        if node is not None:
+            if node.op == "Switch":
+                res = (name, int(idx) if idx else 0)
+            else:
+                for i in self._inputs(node):
+                    res = self._controlling_switch(i)
+                    if res:
+                        break
+        self._switch_memo[ref] = res
+        return res
+
+    def _name_outputs(self, node, outs) -> None:
+        """Rename emitted vars to TF's multi-output convention
+        (``name``, ``name:1``, ...)."""
+        for i, o in enumerate(outs):
+            want = node.name if i == 0 else f"{node.name}:{i}"
+            if o.name != want:
+                o.rename(want)
+
+    # ---- TF2 function library support ----
+    def _inline_call(self, node, fname: str, ins: List[str]) -> None:
+        """Inline a (Stateful)PartitionedCall: splice the FunctionDef body
+        into this graph under the call node's name prefix (the reference
+        inlines function graphs the same way before mapping)."""
+        from tensorflow.python.framework import tensor_util
+        fdef = self.functions.get(fname)
+        if fdef is None:
+            raise NotImplementedError(f"Call to unknown function {fname!r}")
+        prefix = node.name
+        arg_map = {arg.name: caller_in
+                   for arg, caller_in in zip(fdef.signature.input_arg, ins)}
+
+        def rewrite(ref: str) -> str:
+            ctrl = ref.startswith("^")
+            flat = _flatten_ref(ref)
+            if ctrl:
+                flat = flat[1:]
+            base, _, idx = flat.partition(":")
+            mapped = arg_map.get(base, f"{prefix}/{base}")
+            out = mapped if not idx else f"{mapped}:{idx}"
+            return ("^" + out) if ctrl else out
+
+        new_nodes = []
+        for nd in fdef.node_def:
+            cp = type(nd)()
+            cp.CopyFrom(nd)
+            cp.name = f"{prefix}/{nd.name}"
+            del cp.input[:]
+            cp.input.extend(rewrite(r) for r in nd.input)
+            new_nodes.append(cp)
+        for nd in new_nodes:
+            self.node_by_name[nd.name] = nd
+            if nd.op == "Const":
+                self.const_values[nd.name] = tensor_util.MakeNdarray(
+                    nd.attr["value"].tensor)
+        for nd in new_nodes:
+            self._map_node(nd)
+        # alias the call's outputs to the body's return values
+        for j, out_arg in enumerate(fdef.signature.output_arg):
+            src = rewrite(fdef.ret[out_arg.name])
+            want = node.name if j == 0 else f"{node.name}:{j}"
+            self._alias(want, src)
+
+    def _alias(self, want: str, src: str) -> None:
+        src = self._clean(src) if ":" not in src or src.split(":")[-1] == "0" \
+            else src
+        if src in self.const_values and src not in self.sd.vars:
+            self.const_values[want] = self.const_values[src]
+            return
+        v = self.sd._apply("identity", [self.sd.vars[self._ensure_var(src)]],
+                           name=want)
+        if v.name != want:
+            v.rename(want)
+
+    def _function_subgraph(self, fname: str):
+        """Materialise a FunctionDef as a standalone GraphDef + import it;
+        returns (sub_sd, input_names, output_names)."""
+        tf = _tf()
+        fdef = self.functions.get(fname)
+        if fdef is None:
+            raise NotImplementedError(f"Unknown function {fname!r}")
+        gd2 = tf.compat.v1.GraphDef()
+        gd2.library.CopyFrom(self.gd.library)  # nested calls resolve too
+        input_names = []
+        for arg in fdef.signature.input_arg:
+            nd = gd2.node.add()
+            nd.name = arg.name
+            nd.op = "Placeholder"
+            nd.attr["dtype"].type = arg.type
+            input_names.append(arg.name)
+        for body_node in fdef.node_def:
+            cp = gd2.node.add()
+            cp.CopyFrom(body_node)
+            del cp.input[:]
+            cp.input.extend(_flatten_ref(r) for r in body_node.input)
+        output_names = [_flatten_ref(fdef.ret[o.name])
+                        for o in fdef.signature.output_arg]
+        sub_sd = _GraphImporter(gd2, {}).run()
+        return sub_sd, input_names, output_names
+
+    def _function_callable(self, fname: str):
+        """FunctionDef -> python callable on jax arrays (feeds sd.while_loop
+        / sd.cond, which lower to lax.while_loop / lax.cond)."""
+        sub_sd, in_names, out_names = self._function_subgraph(fname)
+
+        def fn(*arrays):
+            env = dict(sub_sd.arrays)
+            env.update(zip(in_names, arrays))
+            return sub_sd._exec_graph(env, out_names)
+
+        return fn
 
     def _map_node(self, node) -> None:
         op = node.op
@@ -327,6 +491,81 @@ class _GraphImporter:
             x, gamma, beta, mean, var = ins[:5]
             self._emit(node, "batch_norm", [x, mean, var, gamma, beta],
                        eps=self._attr(node, "epsilon", 1e-3))
+            return
+
+        # ---- TF1-style lowered conditionals (Switch/Merge dataflow) ----
+        # Our graph is pure, so both branches are computable; Merge becomes a
+        # select on the controlling Switch's predicate. (Reference maps these
+        # into SameDiff frames; XLA wants branch-free dataflow or lax.cond.)
+        if op == "Switch":
+            # outputs: :0 = false branch, :1 = true branch; both carry data
+            data_v = sd.vars[self._ensure_var(ins[0])]
+            self._switch_pred[node.name] = ins[1]
+            o0 = sd._apply("identity", [data_v], name=node.name)
+            if o0.name != node.name:
+                o0.rename(node.name)
+            o1 = sd._apply("identity", [data_v], name=f"{node.name}:1")
+            if o1.name != f"{node.name}:1":
+                o1.rename(f"{node.name}:1")
+            return
+        if op == "Merge":
+            picks = [self._controlling_switch(i) for i in ins]
+            true_refs = [r for r, p in zip(ins, picks) if p and p[1] == 1]
+            false_refs = [r for r, p in zip(ins, picks) if p and p[1] == 0]
+            if not true_refs or not false_refs:
+                raise NotImplementedError(
+                    f"Merge {node.name!r}: cannot associate inputs with a "
+                    "Switch true/false pair (TF1 while-loop frames are not "
+                    "supported — re-freeze without lowering control flow, "
+                    "or use the functional While path)")
+            pred_ref = self._switch_pred[picks[0][0]]
+            pred_v = sd.vars[self._ensure_var(pred_ref)]
+            tv = sd.vars[self._ensure_var(true_refs[0])]
+            fv = sd.vars[self._ensure_var(false_refs[0])]
+            out = sd._apply("where", [pred_v, tv, fv], name=node.name)
+            if out.name != node.name:
+                out.rename(node.name)
+            # second output (value_index) is rarely consumed; emit if needed
+            return
+        if op in ("Enter", "Exit", "NextIteration", "LoopCond"):
+            raise NotImplementedError(
+                f"TF1 while-loop frame op {op!r} (node {node.name!r}): "
+                "re-export the model with functional control flow "
+                "(tf.function graph without lowering) — the functional "
+                "While/If path is supported")
+
+        # ---- TF2 function graphs + structured control flow ----
+        if op in ("PartitionedCall", "StatefulPartitionedCall"):
+            self._inline_call(node, node.attr["f"].func.name, ins)
+            return
+        if op in ("While", "StatelessWhile"):
+            cond_f = self._function_callable(node.attr["cond"].func.name)
+            body_f = self._function_callable(node.attr["body"].func.name)
+            n = len(ins)
+            vars_ = [sd.vars[self._ensure_var(i)] for i in ins]
+            outs = sd.while_loop(
+                lambda *c: cond_f(*c)[0],
+                lambda *c: tuple(body_f(*c)),
+                *vars_, name=node.name)
+            outs = outs if isinstance(outs, tuple) else (outs,)
+            self._name_outputs(node, outs)
+            return
+        if op in ("If", "StatelessIf"):
+            then_f = self._function_callable(node.attr["then_branch"].func.name)
+            else_f = self._function_callable(node.attr["else_branch"].func.name)
+            nout = len(node.attr["Tout"].list.type) or 1
+            pred_v = sd.vars[self._ensure_var(ins[0])]
+            arg_vs = [sd.vars[self._ensure_var(i)] for i in ins[1:]]
+            if nout == 1:
+                tf_fn = lambda *xs: then_f(*xs)[0]
+                ef_fn = lambda *xs: else_f(*xs)[0]
+            else:
+                tf_fn = lambda *xs: tuple(then_f(*xs))
+                ef_fn = lambda *xs: tuple(else_f(*xs))
+            outs = sd.cond(pred_v, tf_fn, ef_fn, *arg_vs, name=node.name,
+                           n_outputs=nout)
+            outs = outs if isinstance(outs, tuple) else (outs,)
+            self._name_outputs(node, outs)
             return
 
         raise NotImplementedError(

@@ -1,0 +1,153 @@
+"""Reference-style TF model builders used as import oracles.
+
+The flagship declarative workflow of the reference is
+``TFGraphMapper.importGraph(bert_frozen.pb)`` → graft a loss → ``sd.fit()``
+(upstream ``org.nd4j.imports.graphmapper.tf.TFGraphMapper``; SURVEY.md §3.3,
+BASELINE config #4). No pretrained checkpoint is downloadable in this
+environment, so we construct the *same computation* — a faithful BERT
+encoder GraphDef — with the local TensorFlow and deterministic random
+weights. The oracle property is exact: whatever TF computes for this graph,
+the imported SameDiff must reproduce.
+
+Everything here runs TF on CPU only; the imported graph runs on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+
+def build_bert_graphdef(
+    batch: int = 2,
+    seq_len: int = 128,
+    hidden: int = 768,
+    layers: int = 12,
+    heads: int = 12,
+    intermediate: int = 3072,
+    vocab: int = 30522,
+    type_vocab: int = 2,
+    seed: int = 0,
+) -> Tuple[object, List[str], List[str], Dict[str, np.ndarray]]:
+    """Build a frozen BERT encoder GraphDef (original google-research/bert
+    architecture: post-LN, gelu-via-erf, additive attention mask, tanh
+    pooler on [CLS]).
+
+    Returns ``(graph_def, input_names, output_names, weights)`` where
+    ``weights`` maps logical parameter names to the numpy arrays baked into
+    the graph (useful for asserting the importer picked them up).
+    """
+    import tensorflow as tf
+
+    rng = np.random.default_rng(seed)
+    dk = hidden // heads
+    W: Dict[str, np.ndarray] = {}
+
+    def mk(name, shape, scale=0.02):
+        W[name] = rng.normal(0.0, scale, shape).astype(np.float32)
+        return W[name]
+
+    mk("word_emb", (vocab, hidden))
+    mk("pos_emb", (seq_len, hidden))
+    mk("type_emb", (type_vocab, hidden))
+    W["emb_ln_g"] = np.ones(hidden, np.float32)
+    W["emb_ln_b"] = np.zeros(hidden, np.float32)
+    for i in range(layers):
+        for nm, shape in (("q", (hidden, hidden)), ("k", (hidden, hidden)),
+                          ("v", (hidden, hidden)), ("ao", (hidden, hidden)),
+                          ("ff1", (hidden, intermediate)),
+                          ("ff2", (intermediate, hidden))):
+            mk(f"l{i}_{nm}_w", shape)
+            W[f"l{i}_{nm}_b"] = np.zeros(shape[1], np.float32)
+        for nm in ("attn_ln", "out_ln"):
+            W[f"l{i}_{nm}_g"] = np.ones(hidden, np.float32)
+            W[f"l{i}_{nm}_b"] = np.zeros(hidden, np.float32)
+    mk("pool_w", (hidden, hidden))
+    W["pool_b"] = np.zeros(hidden, np.float32)
+
+    C = {k: tf.constant(v) for k, v in W.items()}
+
+    def layer_norm(x, g, b):
+        mean = tf.reduce_mean(x, axis=-1, keepdims=True)
+        var = tf.reduce_mean(tf.math.squared_difference(x, mean), axis=-1,
+                             keepdims=True)
+        return (x - mean) * tf.math.rsqrt(var + 1e-12) * g + b
+
+    def gelu(x):  # BERT's erf formulation
+        return 0.5 * x * (1.0 + tf.math.erf(x / np.float32(np.sqrt(2.0))))
+
+    def encoder(input_ids, token_type_ids, input_mask):
+        x = (tf.gather(C["word_emb"], input_ids)
+             + C["pos_emb"]
+             + tf.gather(C["type_emb"], token_type_ids))
+        x = layer_norm(x, C["emb_ln_g"], C["emb_ln_b"])
+        # additive mask: (B, 1, 1, T), 0 for keep / -10000 for pad
+        adder = (1.0 - tf.cast(input_mask, tf.float32)) * -10000.0
+        adder = tf.reshape(adder, (batch, 1, 1, seq_len))
+        for i in range(layers):
+            def proj(nm):
+                h = tf.matmul(tf.reshape(x, (batch * seq_len, hidden)),
+                              C[f"l{i}_{nm}_w"]) + C[f"l{i}_{nm}_b"]
+                h = tf.reshape(h, (batch, seq_len, heads, dk))
+                return tf.transpose(h, (0, 2, 1, 3))
+
+            q, k, v = proj("q"), proj("k"), proj("v")
+            s = tf.matmul(q, k, transpose_b=True) / np.float32(np.sqrt(dk))
+            p = tf.nn.softmax(s + adder, axis=-1)
+            ctx = tf.matmul(p, v)
+            ctx = tf.reshape(tf.transpose(ctx, (0, 2, 1, 3)),
+                             (batch * seq_len, hidden))
+            a = tf.matmul(ctx, C[f"l{i}_ao_w"]) + C[f"l{i}_ao_b"]
+            x = layer_norm(tf.reshape(a, (batch, seq_len, hidden)) + x,
+                           C[f"l{i}_attn_ln_g"], C[f"l{i}_attn_ln_b"])
+            h = gelu(tf.matmul(tf.reshape(x, (batch * seq_len, hidden)),
+                               C[f"l{i}_ff1_w"]) + C[f"l{i}_ff1_b"])
+            h = tf.matmul(h, C[f"l{i}_ff2_w"]) + C[f"l{i}_ff2_b"]
+            x = layer_norm(tf.reshape(h, (batch, seq_len, hidden)) + x,
+                           C[f"l{i}_out_ln_g"], C[f"l{i}_out_ln_b"])
+        seq_out = tf.identity(x, name="sequence_output")
+        cls = x[:, 0, :]
+        pooled = tf.tanh(tf.matmul(cls, C["pool_w"]) + C["pool_b"])
+        pooled = tf.identity(pooled, name="pooled_output")
+        return seq_out, pooled
+
+    conc = tf.function(encoder).get_concrete_function(
+        tf.TensorSpec((batch, seq_len), tf.int32, name="input_ids"),
+        tf.TensorSpec((batch, seq_len), tf.int32, name="token_type_ids"),
+        tf.TensorSpec((batch, seq_len), tf.int32, name="input_mask"),
+    )
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+    frozen = convert_variables_to_constants_v2(conc)
+    gd = frozen.graph.as_graph_def()
+    inputs = [t.name.split(":")[0] for t in frozen.inputs]
+    outputs = [t.name.split(":")[0] for t in frozen.outputs]
+    return gd, inputs, outputs, W
+
+
+def bert_synthetic_batch(batch, seq_len, vocab, n_classes=2, seed=0):
+    """SST-2-shaped synthetic batch: ids, types, mask (ragged lengths),
+    one-hot labels."""
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, vocab, (batch, seq_len)).astype(np.int32)
+    types = np.zeros((batch, seq_len), np.int32)
+    lens = rng.integers(seq_len // 2, seq_len + 1, batch)
+    mask = (np.arange(seq_len)[None, :] < lens[:, None]).astype(np.int32)
+    labels = np.eye(n_classes, dtype=np.float32)[rng.integers(0, n_classes, batch)]
+    return ids, types, mask, labels
+
+
+def graft_classifier(sd, pooled_name: str, hidden: int, n_classes: int = 2,
+                     seed: int = 0):
+    """Graft a classification head + loss onto an imported encoder (the
+    reference fine-tune recipe: importGraph → add head vars → sd.fit).
+    Returns (logits_var, loss_var); adds placeholder ``labels``."""
+    rng = np.random.default_rng(seed)
+    w = sd.var("cls_w", array=rng.normal(0, 0.02, (hidden, n_classes)).astype(np.float32))
+    b = sd.var("cls_b", array=np.zeros(n_classes, np.float32))
+    pooled = sd.vars[pooled_name]
+    logits = sd.invoke("linear", pooled, w, b, name="cls_logits")
+    labels = sd.placeholder("labels", (None, n_classes))
+    loss = sd.loss.softmax_cross_entropy("finetune_loss", labels, logits)
+    return logits, loss

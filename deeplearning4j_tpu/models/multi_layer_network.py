@@ -157,6 +157,12 @@ class MultiLayerNetwork:
         new_carries = {} if carries is not None else None
         last_input = x
         n = len(self.layers)
+        # A pure chain: every layer boundary is a remat cut point. With
+        # env.remat_segments on, each hidden layer's activations are
+        # recomputed in the backward pass instead of saved — HBM traffic
+        # traded for FLOPs (same policy as ComputationGraph._forward_remat).
+        use_remat = (env.remat_segments and training and carries is None
+                     and n > 2)
         for i, layer in enumerate(self.layers):
             k = _layer_key(i, layer)
             if i in self.conf.preprocessors:
@@ -175,7 +181,14 @@ class MultiLayerNetwork:
                 new_carries[k] = c_new
                 x = y
             else:
-                x, s_new = layer.forward(p, s, x, training=training, rng=lrng, mask=fmask)
+                if use_remat and i < n - 1:
+                    def _fwd(p_, s_, x_, lrng_, fmask_, _l=layer):
+                        return _l.forward(p_, s_, x_, training=True,
+                                          rng=lrng_, mask=fmask_)
+                    x, s_new = jax.checkpoint(_fwd)(p, s, x, lrng, fmask)
+                else:
+                    x, s_new = layer.forward(p, s, x, training=training,
+                                             rng=lrng, mask=fmask)
                 if s:
                     new_state[k] = s_new
             if fmask is not None and hasattr(layer, "transform_mask"):
@@ -262,6 +275,9 @@ class MultiLayerNetwork:
         return jax.jit(step, donate_argnums=(0, 1))
 
     def _jitted(self, name: str, factory):
+        # remat is read at TRACE time, so flipping env.set_remat() must
+        # produce a different cache entry (same rule as ComputationGraph)
+        name = f"{name}@remat={get_environment().remat_segments}"
         if name not in self._jit_cache:
             self._jit_cache[name] = factory()
         return self._jit_cache[name]
@@ -291,12 +307,21 @@ class MultiLayerNetwork:
             iterator.reset()
             for batch in iterator:
                 x, y = jnp.asarray(batch.features), jnp.asarray(batch.labels)
+                # zero-copy ref for listeners that sample activations
+                # (StatsListener histograms)
+                self._last_batch_features = x
                 fm = None if batch.features_mask is None else jnp.asarray(batch.features_mask)
                 # labels mask defaults to the features mask only for
                 # per-timestep labels (reference tBPTT/masking semantics)
                 lm = jnp.asarray(batch.labels_mask) if batch.labels_mask is not None \
                     else (self._output_time_mask(fm) if y.ndim == 3 else None)
                 if self.conf.tbptt_fwd_length and is_sequence_array(x):
+                    if self.conf.global_conf.optimization_algo != \
+                            "STOCHASTIC_GRADIENT_DESCENT":
+                        raise NotImplementedError(
+                            "truncated BPTT is only supported with "
+                            "STOCHASTIC_GRADIENT_DESCENT (matching "
+                            "ComputationGraph)")
                     self._fit_tbptt(x, y, fm, lm)
                     continue
                 if self.conf.global_conf.optimization_algo !=                         "STOCHASTIC_GRADIENT_DESCENT":

@@ -358,9 +358,100 @@ class INDArray:
         flat = moved.reshape((-1,) + tuple(self.array.shape[d] for d in dims))
         return INDArray(flat[index])
 
+    def get_rows(self, *rows) -> "INDArray":
+        return INDArray(self.array[jnp.asarray([int(r) for r in rows])])
+
+    def get_columns(self, *cols) -> "INDArray":
+        return INDArray(self.array[:, jnp.asarray([int(c) for c in cols])])
+
+    # ---- scalar reductions (reference xxxNumber() family) ----
+    def sum_number(self) -> float:
+        return float(jnp.sum(self.array))
+
+    def mean_number(self) -> float:
+        return float(jnp.mean(self.array))
+
+    def max_number(self) -> float:
+        return float(jnp.max(self.array))
+
+    def min_number(self) -> float:
+        return float(jnp.min(self.array))
+
+    def std_number(self, bias_corrected: bool = True) -> float:
+        return float(jnp.std(self.array, ddof=1 if bias_corrected else 0))
+
+    def var_number(self, bias_corrected: bool = True) -> float:
+        return float(jnp.var(self.array, ddof=1 if bias_corrected else 0))
+
+    def norm1_number(self) -> float:
+        return float(jnp.sum(jnp.abs(self.array)))
+
+    def norm2_number(self) -> float:
+        return float(jnp.sqrt(jnp.sum(self.array * self.array)))
+
+    def norm_max_number(self) -> float:
+        return float(jnp.max(jnp.abs(self.array)))
+
+    def amax(self, *dims):
+        return self._red(lambda a, axis=None: jnp.max(jnp.abs(a), axis=axis), dims)
+
+    def amin(self, *dims):
+        return self._red(lambda a, axis=None: jnp.min(jnp.abs(a), axis=axis), dims)
+
+    def arg_min(self, *dims) -> "INDArray":
+        axis = dims[0] if dims else None
+        return INDArray(jnp.argmin(self.array, axis=axis))
+
+    def entropy(self) -> float:
+        p = self.array
+        return float(-jnp.sum(p * jnp.log(jnp.maximum(p, 1e-30))))
+
+    # ---- float-classification / misc (reference isNaN/isInfinite etc.) ----
+    def is_nan(self) -> "INDArray":
+        return INDArray(jnp.isnan(self.array))
+
+    def is_infinite(self) -> "INDArray":
+        return INDArray(jnp.isinf(self.array))
+
+    def replace_where(self, value, condition) -> "INDArray":
+        """Reference ``BooleanIndexing.replaceWhere``: set elements matching
+        ``condition`` (a :class:`Condition`) to ``value`` (scalar or array)."""
+        m = condition(self.array)
+        self.array = jnp.where(m, _unwrap(value), self.array)
+        return self
+
+    def cond(self, condition) -> "INDArray":
+        """Elementwise condition mask (reference ``INDArray.cond``)."""
+        return INDArray(condition(self.array).astype(jnp.float32))
+
+    def diag(self) -> "INDArray":
+        a = self.array
+        return INDArray(jnp.diagflat(a) if a.ndim == 1
+                        else jnp.diagonal(a, axis1=-2, axis2=-1))
+
+    def like(self) -> "INDArray":
+        return INDArray(jnp.zeros_like(self.array))
+
+    ulike = like
+
+    def pad(self, *paddings) -> "INDArray":
+        return INDArray(jnp.pad(self.array, paddings))
+
+    def flatten(self) -> "INDArray":
+        return INDArray(self.array.reshape(-1))
+
     # ---- host access ----
     def numpy(self) -> np.ndarray:
         return np.asarray(self.array)
+
+    def to_int_vector(self):
+        return self.numpy().astype(np.int64).reshape(-1).tolist()
+
+    def to_float_vector(self):
+        return self.numpy().astype(np.float32).reshape(-1).tolist()
+
+    def to_float_matrix(self):
+        return self.numpy().astype(np.float32).tolist()
 
     def item(self) -> float:
         return self.array.item()
@@ -525,3 +616,159 @@ class Nd4j:
         ops come from ``autodiff.ops_registry`` — same names SameDiff uses)."""
         from deeplearning4j_tpu.autodiff.ops_registry import get_op
         return INDArray(get_op(op_name)(*[_unwrap(a) for a in arrs], **kwargs))
+
+
+class Conditions:
+    """Reference ``org.nd4j.linalg.indexing.conditions.Conditions``: factory
+    of elementwise predicates for ``BooleanIndexing`` / ``replace_where``."""
+
+    @staticmethod
+    def less_than(v):
+        return lambda a: a < v
+
+    @staticmethod
+    def less_than_or_equal(v):
+        return lambda a: a <= v
+
+    @staticmethod
+    def greater_than(v):
+        return lambda a: a > v
+
+    @staticmethod
+    def greater_than_or_equal(v):
+        return lambda a: a >= v
+
+    @staticmethod
+    def equals(v):
+        return lambda a: a == v
+
+    @staticmethod
+    def not_equals(v):
+        return lambda a: a != v
+
+    @staticmethod
+    def abs_greater_than(v):
+        return lambda a: jnp.abs(a) > v
+
+    @staticmethod
+    def abs_less_than(v):
+        return lambda a: jnp.abs(a) < v
+
+    @staticmethod
+    def is_nan():
+        return jnp.isnan
+
+    @staticmethod
+    def is_infinite():
+        return jnp.isinf
+
+
+class BooleanIndexing:
+    """Reference ``org.nd4j.linalg.indexing.BooleanIndexing``."""
+
+    @staticmethod
+    def replace_where(arr, value, condition):
+        return _as_ind(arr).replace_where(value, condition)
+
+    @staticmethod
+    def and_(arr, condition) -> bool:
+        return bool(jnp.all(condition(_unwrap(arr))))
+
+    @staticmethod
+    def or_(arr, condition) -> bool:
+        return bool(jnp.any(condition(_unwrap(arr))))
+
+
+def _as_ind(x) -> INDArray:
+    return x if isinstance(x, INDArray) else INDArray(jnp.asarray(x))
+
+
+class Transforms:
+    """Reference ``org.nd4j.linalg.ops.transforms.Transforms``: the
+    free-function math API over INDArrays. Thin jnp delegation — everything
+    jit-composes."""
+
+    @staticmethod
+    def _u(fn, x) -> INDArray:
+        return INDArray(fn(_unwrap(x)))
+
+    exp = staticmethod(lambda x: Transforms._u(jnp.exp, x))
+    log = staticmethod(lambda x: Transforms._u(jnp.log, x))
+    sqrt = staticmethod(lambda x: Transforms._u(jnp.sqrt, x))
+    abs = staticmethod(lambda x: Transforms._u(jnp.abs, x))
+    sign = staticmethod(lambda x: Transforms._u(jnp.sign, x))
+    floor = staticmethod(lambda x: Transforms._u(jnp.floor, x))
+    ceil = staticmethod(lambda x: Transforms._u(jnp.ceil, x))
+    round = staticmethod(lambda x: Transforms._u(jnp.round, x))
+    sin = staticmethod(lambda x: Transforms._u(jnp.sin, x))
+    cos = staticmethod(lambda x: Transforms._u(jnp.cos, x))
+    tanh = staticmethod(lambda x: Transforms._u(jnp.tanh, x))
+    sigmoid = staticmethod(lambda x: Transforms._u(jax.nn.sigmoid, x))
+    softmax = staticmethod(lambda x: Transforms._u(
+        lambda a: jax.nn.softmax(a, axis=-1), x))
+    relu = staticmethod(lambda x: Transforms._u(jax.nn.relu, x))
+    leaky_relu = staticmethod(lambda x, alpha=0.01: INDArray(
+        jax.nn.leaky_relu(_unwrap(x), alpha)))
+    elu = staticmethod(lambda x: Transforms._u(jax.nn.elu, x))
+    soft_plus = staticmethod(lambda x: Transforms._u(jax.nn.softplus, x))
+    hard_tanh = staticmethod(lambda x: Transforms._u(
+        lambda a: jnp.clip(a, -1.0, 1.0), x))
+
+    @staticmethod
+    def pow(x, p) -> INDArray:
+        return INDArray(jnp.power(_unwrap(x), _unwrap(p) if isinstance(p, INDArray) else p))
+
+    @staticmethod
+    def max(x, v) -> INDArray:
+        return INDArray(jnp.maximum(_unwrap(x), _unwrap(v) if isinstance(v, INDArray) else v))
+
+    @staticmethod
+    def min(x, v) -> INDArray:
+        return INDArray(jnp.minimum(_unwrap(x), _unwrap(v) if isinstance(v, INDArray) else v))
+
+    @staticmethod
+    def unit_vec(x) -> INDArray:
+        a = _unwrap(x)
+        return INDArray(a / jnp.maximum(jnp.sqrt(jnp.sum(a * a)), 1e-12))
+
+    @staticmethod
+    def normalize_zero_mean_and_unit_variance(x) -> INDArray:
+        a = _unwrap(x)
+        return INDArray((a - jnp.mean(a)) / jnp.maximum(jnp.std(a), 1e-12))
+
+    @staticmethod
+    def cosine_sim(a, b) -> float:
+        a, b = _unwrap(a).ravel(), _unwrap(b).ravel()
+        denom = jnp.sqrt(jnp.sum(a * a)) * jnp.sqrt(jnp.sum(b * b))
+        return float(jnp.sum(a * b) / jnp.maximum(denom, 1e-12))
+
+    @staticmethod
+    def cosine_distance(a, b) -> float:
+        return 1.0 - Transforms.cosine_sim(a, b)
+
+    @staticmethod
+    def euclidean_distance(a, b) -> float:
+        d = _unwrap(a).ravel() - _unwrap(b).ravel()
+        return float(jnp.sqrt(jnp.sum(d * d)))
+
+    @staticmethod
+    def manhattan_distance(a, b) -> float:
+        return float(jnp.sum(jnp.abs(_unwrap(a).ravel() - _unwrap(b).ravel())))
+
+    @staticmethod
+    def hamming_distance(a, b) -> float:
+        return float(jnp.mean(
+            (_unwrap(a).ravel() != _unwrap(b).ravel()).astype(jnp.float32)))
+
+    @staticmethod
+    def all_cosine_similarities(matrix, vector) -> INDArray:
+        """Row-wise cosine similarity of ``matrix`` rows against ``vector``
+        (the word2vec nearest-neighbour primitive) — one fused program."""
+        m, v = _unwrap(matrix), _unwrap(vector).ravel()
+        num = m @ v
+        den = jnp.sqrt(jnp.sum(m * m, axis=1)) * jnp.sqrt(jnp.sum(v * v))
+        return INDArray(num / jnp.maximum(den, 1e-12))
+
+    @staticmethod
+    def dot(a, b) -> float:
+        return float(jnp.sum(_unwrap(a).ravel() * _unwrap(b).ravel()))

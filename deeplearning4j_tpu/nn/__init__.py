@@ -58,7 +58,12 @@ from deeplearning4j_tpu.nn.extra_layers import (
     CenterLossOutputLayer,
     Convolution3D,
     Cropping2D,
+    ConvLSTM2D,
+    LocallyConnected1D,
     LocallyConnected2D,
+    PermuteLayer,
+    SeparableConvolution1D,
+    Subsampling1DLayer,
     Subsampling3DLayer,
     Upsampling1D,
     Upsampling3D,
@@ -71,6 +76,7 @@ from deeplearning4j_tpu.nn.autoencoder_layers import (
 from deeplearning4j_tpu.nn.moe_layers import MixtureOfExperts
 from deeplearning4j_tpu.nn.misc_layers import (
     Cropping1D,
+    FlattenLayer,
     ElementWiseMultiplicationLayer,
     MaskZeroLayer,
     PReLULayer,
@@ -124,7 +130,13 @@ __all__ = [
     "Upsampling1D",
     "Upsampling3D",
     "Cropping2D",
+    "ConvLSTM2D",
+    "LocallyConnected1D",
     "LocallyConnected2D",
+    "FlattenLayer",
+    "PermuteLayer",
+    "SeparableConvolution1D",
+    "Subsampling1DLayer",
     "CenterLossOutputLayer",
     "Yolo2OutputLayer",
     "AutoEncoder",

@@ -26,10 +26,17 @@ from deeplearning4j_tpu.ops.initializers import init_weights
 
 
 def layer_norm(x, gamma, beta, eps=1e-12):
-    # single-pass E[x^2]-E[x]^2 stats in f32 (see BatchNormalization.forward)
+    # Shifted single-pass stats in f32 (see BatchNormalization.forward):
+    # subtracting a per-row pivot (the first feature — free, no extra pass)
+    # before accumulating avoids E[x^2]-E[x]^2 catastrophic cancellation
+    # for large-mean/small-variance rows while both reductions still fuse
+    # into one read of x.
     xf = x.astype(jnp.float32)
-    mean = jnp.mean(xf, axis=-1, keepdims=True)
-    var = jnp.maximum(jnp.mean(xf * xf, axis=-1, keepdims=True) - mean * mean,
+    shift = jax.lax.stop_gradient(xf[..., :1])
+    d = xf - shift
+    dmean = jnp.mean(d, axis=-1, keepdims=True)
+    mean = shift + dmean
+    var = jnp.maximum(jnp.mean(d * d, axis=-1, keepdims=True) - dmean * dmean,
                       0.0)
     y = (xf - mean) * jax.lax.rsqrt(var + eps)
     return (y.astype(x.dtype)) * gamma + beta

@@ -238,8 +238,15 @@ class BatchNormalization(Layer):
             n = 1
             for a in axes:
                 n *= x.shape[a]
-            mean = jnp.sum(xf, axis=axes) / n
-            var = jnp.maximum(jnp.sum(xf * xf, axis=axes) / n - mean * mean,
+            # Shifted single-pass form: accumulating around the running mean
+            # (free — already in state) avoids the catastrophic cancellation
+            # of raw E[x^2]-E[x]^2 for large-mean/small-variance inputs
+            # while keeping both reductions in one fused read of x.
+            shift = state["mean"]
+            d = xf - shift
+            dmean = jnp.sum(d, axis=axes) / n
+            mean = shift + dmean
+            var = jnp.maximum(jnp.sum(d * d, axis=axes) / n - dmean * dmean,
                               0.0)
             new_state = {
                 "mean": self.decay * state["mean"] + (1 - self.decay) * mean,

@@ -313,3 +313,269 @@ class Yolo2OutputLayer(Layer):
         # Loss is averaged over the minibatch only (the reference's score
         # convention); per-object normalisation is deliberately not applied.
         return (self.lambda_coord * coord + obj_loss + cls_loss) / (b * 1.0)
+
+
+@register_layer
+@dataclasses.dataclass
+class LocallyConnected1D(Layer):
+    """1-D conv with UNSHARED weights per output position (reference
+    ``LocallyConnected1D``) via ``lax.conv_general_dilated_local`` on a
+    width-1 2-D input."""
+
+    n_out: int = 0
+    kernel_size: int = 3
+    stride: int = 1
+    has_bias: bool = True
+
+    def _geom(self, it: InputType):
+        k = int(self.kernel_size if not isinstance(self.kernel_size, (tuple, list))
+                else self.kernel_size[0])
+        s = int(self.stride if not isinstance(self.stride, (tuple, list))
+                else self.stride[0])
+        ot = (it.timesteps - k) // s + 1
+        return k, s, ot
+
+    def output_type(self, input_type: InputType) -> InputType:
+        _, _, ot = self._geom(input_type)
+        return InputType.recurrent(self.n_out, ot)
+
+    def init(self, key, input_type, g: GlobalConfig):
+        k, _, ot = self._geom(input_type)
+        c_in = input_type.size
+        params = {"W": init_weights(key, (ot, 1, c_in * k, self.n_out),
+                                    self._winit(g), fan=(c_in * k, self.n_out),
+                                    dtype=g.dtype)}
+        if self.has_bias:
+            params["b"] = jnp.full((ot, self.n_out), self._binit(g),
+                                   g.dtype or jnp.float32)
+        return params, {}
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        it = InputType.recurrent(x.shape[2], x.shape[1])
+        k, s, _ = self._geom(it)
+        y = lax.conv_general_dilated_local(
+            x[:, :, None, :], params["W"], window_strides=(s, 1),
+            padding="VALID", filter_shape=(k, 1),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))[:, :, 0, :]
+        if self.has_bias:
+            y = y + params["b"]
+        return get_activation(self._act(self._g))(y), state
+
+
+@register_layer
+@dataclasses.dataclass
+class SeparableConvolution1D(Layer):
+    """Depthwise-separable 1-D conv (reference/Keras ``SeparableConv1D``):
+    depthwise over time (feature_group_count) then pointwise 1x1."""
+
+    n_out: int = 0
+    kernel_size: int = 3
+    stride: int = 1
+    convolution_mode: str = "same"
+    depth_multiplier: int = 1
+    has_bias: bool = True
+
+    def _geom(self):
+        k = int(self.kernel_size if not isinstance(self.kernel_size, (tuple, list))
+                else self.kernel_size[0])
+        s = int(self.stride if not isinstance(self.stride, (tuple, list))
+                else self.stride[0])
+        return k, s, self.convolution_mode.lower() == "same"
+
+    def output_type(self, input_type: InputType) -> InputType:
+        k, s, same = self._geom()
+        t = input_type.timesteps
+        t_out = None if t is None else (-(-t // s) if same else (t - k) // s + 1)
+        return InputType.recurrent(self.n_out, t_out)
+
+    def init(self, key, input_type, g: GlobalConfig):
+        k, _, _ = self._geom()
+        c_in = input_type.size
+        dm = self.depth_multiplier
+        k1, k2 = jax.random.split(key)
+        params = {
+            "W_depth": init_weights(k1, (k, 1, 1, c_in * dm), self._winit(g),
+                                    fan=(k, k * dm), dtype=g.dtype),
+            "W_point": init_weights(k2, (1, 1, c_in * dm, self.n_out),
+                                    self._winit(g), fan=(c_in * dm, self.n_out),
+                                    dtype=g.dtype),
+        }
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self._binit(g), dtype=g.dtype)
+        return params, {}
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        x = self._apply_input_dropout(x, self._g, training, rng)
+        k, s, same = self._geom()
+        c_in = x.shape[-1]
+        y = lax.conv_general_dilated(
+            x[:, :, None, :], params["W_depth"], window_strides=(s, 1),
+            padding="SAME" if same else "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=c_in)
+        y = lax.conv_general_dilated(
+            y, params["W_point"], window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))[:, :, 0, :]
+        if self.has_bias:
+            y = y + params["b"]
+        return get_activation(self._act(self._g))(y), state
+
+
+@register_layer
+@dataclasses.dataclass
+class Subsampling1DLayer(Layer):
+    """1-D max/avg pooling over (batch, time, features) (reference
+    ``Subsampling1DLayer`` / Keras ``MaxPooling1D``/``AveragePooling1D``)."""
+
+    pooling_type: str = "max"
+    kernel_size: int = 2
+    stride: int = 2
+    convolution_mode: str = "truncate"
+
+    def output_type(self, input_type: InputType) -> InputType:
+        k, s = int(self.kernel_size), int(self.stride)
+        t = input_type.timesteps
+        same = self.convolution_mode.lower() == "same"
+        t_out = None if t is None else (-(-t // s) if same else (t - k) // s + 1)
+        return InputType.recurrent(input_type.size, t_out)
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        k, s = int(self.kernel_size), int(self.stride)
+        same = self.convolution_mode.lower() == "same"
+        pt = str(self.pooling_type).lower()
+        pad = "SAME" if same else "VALID"
+        if pt == "max":
+            y = lax.reduce_window(x, -jnp.inf, lax.max, (1, k, 1), (1, s, 1), pad)
+        else:
+            y = lax.reduce_window(x, 0.0, lax.add, (1, k, 1), (1, s, 1), pad)
+            # divide by the REAL window size (Keras/TF avg_pool excludes
+            # padding) — ones-reduction gives the per-position counts
+            cnt = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add,
+                                    (1, k, 1), (1, s, 1), pad)
+            y = y / cnt
+        return y, state
+
+    def transform_mask(self, mask):
+        if mask is None:
+            return None
+        k, s = int(self.kernel_size), int(self.stride)
+        same = self.convolution_mode.lower() == "same"
+        m = lax.reduce_window(mask.astype(jnp.float32), -jnp.inf, lax.max,
+                              (1, k), (1, s), "SAME" if same else "VALID")
+        return m
+
+
+@register_layer
+@dataclasses.dataclass
+class PermuteLayer(Layer):
+    """Permute non-batch axes (Keras ``Permute``; dims are 1-indexed like
+    Keras)."""
+
+    dims: Any = (2, 1)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        d = tuple(int(v) for v in self.dims)
+        if input_type.kind == "recurrent" and d == (2, 1):
+            return InputType.recurrent(input_type.timesteps, input_type.size)
+        if input_type.kind == "convolutional" and len(d) == 3:
+            hwc = (input_type.height, input_type.width, input_type.channels)
+            nh, nw, nc = (hwc[i - 1] for i in d)
+            return InputType.convolutional(nh, nw, nc)
+        if d == tuple(range(1, len(d) + 1)):
+            return input_type  # identity permutation
+        raise NotImplementedError(
+            f"Permute(dims={d}) on {input_type.kind} input: output shape "
+            "inference not implemented for this combination")
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        perm = (0,) + tuple(int(d) for d in self.dims)
+        return jnp.transpose(x, perm), state
+
+
+@register_layer
+@dataclasses.dataclass
+class ConvLSTM2D(Layer):
+    """Convolutional LSTM (reference/Keras ``ConvLSTM2D``): LSTM whose
+    input/recurrent transforms are SAME-padded 2-D convs; input
+    (batch, time, H, W, C) — the convolutional3d layout with depth=time."""
+
+    n_out: int = 0                 # filters
+    kernel_size: Any = (3, 3)
+    stride: Any = (1, 1)
+    convolution_mode: str = "same"  # input-conv padding; recurrent conv is
+    has_bias: bool = True           # always SAME/stride-1 on the output grid
+    return_sequences: bool = True
+
+    def _k(self):
+        k = self.kernel_size
+        return tuple(k) if isinstance(k, (tuple, list)) else (int(k), int(k))
+
+    def _s(self):
+        s = self.stride
+        return tuple(s) if isinstance(s, (tuple, list)) else (int(s), int(s))
+
+    def _out_hw(self, h, w):
+        kh, kw = self._k()
+        sh, sw = self._s()
+        if self.convolution_mode.lower() == "same":
+            return -(-h // sh), -(-w // sw)
+        return (h - kh) // sh + 1, (w - kw) // sw + 1
+
+    def output_type(self, input_type: InputType) -> InputType:
+        oh, ow = self._out_hw(input_type.height, input_type.width)
+        if self.return_sequences:
+            return InputType.convolutional3d(input_type.depth, oh, ow,
+                                             self.n_out)
+        return InputType.convolutional(oh, ow, self.n_out)
+
+    def init(self, key, input_type, g: GlobalConfig):
+        kh, kw = self._k()
+        c_in = input_type.channels
+        F = self.n_out
+        k1, k2 = jax.random.split(key)
+        params = {
+            "W": init_weights(k1, (kh, kw, c_in, 4 * F), self._winit(g),
+                              fan=(kh * kw * c_in, kh * kw * F), dtype=g.dtype),
+            "W_rec": init_weights(k2, (kh, kw, F, 4 * F), self._winit(g),
+                                  fan=(kh * kw * F, kh * kw * F), dtype=g.dtype),
+        }
+        if self.has_bias:
+            # forget-gate bias 1.0 (keras unit_forget_bias default)
+            b = jnp.zeros((4 * F,), g.dtype or jnp.float32)
+            params["b"] = b.at[F:2 * F].set(1.0)
+        return params, {}
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        x = self._apply_input_dropout(x, self._g, training, rng)
+        F = self.n_out
+        dn = ("NHWC", "HWIO", "NHWC")
+        same = self.convolution_mode.lower() == "same"
+
+        def conv(v, w, strides=(1, 1), pad="SAME"):
+            return lax.conv_general_dilated(v, w, strides, pad,
+                                            dimension_numbers=dn)
+
+        b = params.get("b", 0.0)
+        n, t = x.shape[0], x.shape[1]
+        # hoist the input conv over the whole sequence (one big MXU conv)
+        zx = conv(x.reshape((n * t,) + x.shape[2:]), params["W"],
+                  strides=self._s(), pad="SAME" if same else "VALID") + b
+        zx = zx.reshape((n, t) + zx.shape[1:]).swapaxes(0, 1)  # (T,B,H,W,4F)
+        h0 = jnp.zeros(zx.shape[1:-1] + (F,), x.dtype)
+        c0 = jnp.zeros_like(h0)
+
+        def step(hc, z):
+            h, c = hc
+            z = z + conv(h, params["W_rec"])
+            i = jax.nn.sigmoid(z[..., :F])
+            f = jax.nn.sigmoid(z[..., F:2 * F])
+            g_ = jnp.tanh(z[..., 2 * F:3 * F])
+            o = jax.nn.sigmoid(z[..., 3 * F:])
+            c_new = f * c + i * g_
+            h_new = o * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+
+        (hT, _), ys = lax.scan(step, (h0, c0), zx)
+        if self.return_sequences:
+            return ys.swapaxes(0, 1), state
+        return hT, state

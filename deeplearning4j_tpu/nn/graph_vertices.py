@@ -93,6 +93,14 @@ class ElementWiseVertex(GraphVertex):
             for x in inputs[1:]:
                 out = jnp.maximum(out, x)
             return out
+        if op == "min":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = jnp.minimum(out, x)
+            return out
+        if op == "dot":
+            # Keras Dot(axes=-1, normalize=False) over matching feature axes
+            return jnp.sum(inputs[0] * inputs[1], axis=-1, keepdims=True)
         raise ValueError(f"Unknown elementwise op {self.op!r}")
 
 

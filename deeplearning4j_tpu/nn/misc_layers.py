@@ -276,3 +276,22 @@ class LambdaLayer(Layer):
         d = super().to_dict()
         d.pop("fn", None)  # code is not data
         return d
+
+
+@register_layer
+@dataclasses.dataclass
+class FlattenLayer(Layer):
+    """Flatten all non-batch axes (Keras ``Flatten`` import target; row-major
+    like Keras channels-last)."""
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(input_type.flat_size())
+
+    def init(self, key, input_type, g: GlobalConfig):
+        return {}, {}
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        return x.reshape(x.shape[0], -1), state
+
+    def transform_mask(self, mask):
+        return None  # time axis is folded away

@@ -119,16 +119,17 @@ class LSTM(BaseRecurrentLayer):
         h_new = o * act(c_new)
         return h_new, c_new
 
-    def _kernel_eligible(self, mask) -> bool:
-        """The Pallas persistent-LSTM kernel implements the default cell
-        (sigmoid gates, tanh cell, no peepholes, unmasked). Anything else
-        falls back to the scan path. Subclasses with extra parameters
-        (GravesLSTM) override this to False."""
-        return (mask is None
-                and type(self) is LSTM
-                and get_activation(self.gate_activation)
+    def _kernel_act_ok(self) -> bool:
+        """The Pallas kernels implement the default activations only."""
+        return (get_activation(self.gate_activation)
                 is get_activation("sigmoid")
                 and self._cell_act() is get_activation("tanh"))
+
+    def _kernel_eligible(self, mask) -> bool:
+        """Plain persistent kernel: default cell, no peepholes, unmasked.
+        Masked sequences and GravesLSTM route to the generalised
+        peephole+mask kernel (fused_lstm_graves) instead."""
+        return mask is None and type(self) is LSTM and self._kernel_act_ok()
 
     def forward_with_carry(self, params, carry, x, *, training=False, rng=None, mask=None):
         zx = x @ params["W"] + params["b"]  # (batch, time, 4H): one big matmul
@@ -143,6 +144,23 @@ class LSTM(BaseRecurrentLayer):
                 ys, h, c = fused_lstm(zxs, params["W_rec"],
                                       h0.astype(zxs.dtype),
                                       c0.astype(zxs.dtype))
+                return jnp.swapaxes(ys, 0, 1), (h, c)
+        elif type(self) in _GRAVES_KERNEL_TYPES and self._kernel_act_ok():
+            # GravesLSTM (any mask) and masked plain LSTM: the generalised
+            # kernel (zero peepholes == plain cell)
+            from deeplearning4j_tpu.ops.pallas.fused_lstm_graves import (
+                fused_graves_lstm, fused_graves_lstm_compatible)
+            h0, c0 = carry
+            if fused_graves_lstm_compatible(zxs, h0):
+                H = self.n_out
+                peep = params.get("peephole")
+                if peep is None:
+                    peep = jnp.zeros((3 * H,), zxs.dtype)
+                m = jnp.ones(zxs.shape[:2], zxs.dtype) if ms is None \
+                    else ms.astype(zxs.dtype)
+                ys, h, c = fused_graves_lstm(
+                    zxs, params["W_rec"], peep.astype(zxs.dtype),
+                    h0.astype(zxs.dtype), c0.astype(zxs.dtype), m)
                 return jnp.swapaxes(ys, 0, 1), (h, c)
 
         def step(hc, inp):
@@ -163,7 +181,8 @@ class LSTM(BaseRecurrentLayer):
 @register_layer
 @dataclasses.dataclass
 class GravesLSTM(LSTM):
-    """LSTM with peephole connections (reference ``GravesLSTM``)."""
+    """LSTM with peephole connections (reference ``GravesLSTM``). Routes to
+    the fused peephole Pallas kernel when shapes allow."""
 
     def init(self, key, input_type, g: GlobalConfig):
         params, state = super().init(key, input_type, g)
@@ -184,6 +203,11 @@ class GravesLSTM(LSTM):
         o = gate(z[:, 3 * H:] + c_new * p[2 * H:])
         h_new = o * act(c_new)
         return h_new, c_new
+
+
+# Types served by the generalised peephole+mask kernel. Subclasses of these
+# may change the math arbitrarily, so membership is exact-type.
+_GRAVES_KERNEL_TYPES = (LSTM, GravesLSTM)
 
 
 @register_layer

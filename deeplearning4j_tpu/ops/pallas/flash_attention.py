@@ -105,6 +105,8 @@ def flash_attention_compatible(q, k, v, mask=None, causal: bool = False) -> bool
         return False
     if q.dtype not in (jnp.float32, jnp.bfloat16):
         return False
+    if k.dtype != q.dtype or v.dtype != q.dtype:
+        return False
     if _interpret():
         return True  # CPU test path exercises the kernel at any size
     if t_k < MIN_SEQ_FOR_KERNEL:

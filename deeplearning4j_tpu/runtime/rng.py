@@ -27,7 +27,10 @@ class RngManager:
 
     def __init__(self, seed: int = 0):
         self._seed = int(seed)
-        self._key = jax.random.PRNGKey(self._seed)
+        # Lazy: creating a PRNG key runs a computation, which would
+        # initialise the XLA backend at import time — and that must not
+        # happen before jax.distributed.initialize() on multihost.
+        self._key = None
         self._lock = threading.Lock()
 
     @property
@@ -37,6 +40,8 @@ class RngManager:
     def next_key(self, n: Optional[int] = None):
         """Return one fresh subkey (or a batch of ``n``)."""
         with self._lock:
+            if self._key is None:
+                self._key = jax.random.PRNGKey(self._seed)
             if n is None:
                 self._key, sub = jax.random.split(self._key)
                 return sub
@@ -47,7 +52,7 @@ class RngManager:
         with self._lock:
             if seed is not None:
                 self._seed = int(seed)
-            self._key = jax.random.PRNGKey(self._seed)
+            self._key = None  # re-created lazily from the (new) seed
 
 
 _default = RngManager(0)

@@ -39,6 +39,11 @@ from deeplearning4j_tpu.train.listeners import (
     ScoreIterationListener,
     TrainingListener,
 )
+from deeplearning4j_tpu.train.fault_tolerance import (
+    FaultTolerantTrainer,
+    HeartbeatMonitor,
+    TrainingFailure,
+)
 from deeplearning4j_tpu.train.early_stopping import (
     BestScoreEpochTerminationCondition,
     DataSetLossCalculator,
@@ -52,6 +57,9 @@ from deeplearning4j_tpu.train.early_stopping import (
 )
 
 __all__ = [
+    "FaultTolerantTrainer",
+    "HeartbeatMonitor",
+    "TrainingFailure",
     "Updater", "Sgd", "Adam", "AdaMax", "AMSGrad", "Nadam", "Nesterovs",
     "RmsProp", "AdaGrad", "AdaDelta", "NoOp",
     "Schedule", "StepSchedule", "ExponentialSchedule", "InverseSchedule",

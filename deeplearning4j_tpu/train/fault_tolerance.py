@@ -1,0 +1,129 @@
+"""Failure detection / auto-resume (SURVEY.md §5.3).
+
+The reference's fault story is: workers heartbeat into the parameter-server
+mesh (upstream ``org.nd4j.parameterserver.distributed.v2.util.MeshOrganizer``
+join/leave remap) and training restarts from the last checkpoint. On TPU the
+SPMD program is all-or-nothing — a lost chip kills the step — so the
+TPU-native equivalent is supervision AROUND the compiled step:
+checkpoint periodically, detect the failure (exception or watchdog timeout),
+restore the newest checkpoint, and continue the epoch loop.
+
+``FaultTolerantTrainer`` is that supervisor for single-controller training;
+on multihost each controller runs the same loop and
+``runtime.mesh.initialize_multihost`` re-forms the mesh on restart.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Callable, Optional
+
+from deeplearning4j_tpu.train.checkpoint import CheckpointListener
+
+logger = logging.getLogger(__name__)
+
+
+class TrainingFailure(RuntimeError):
+    pass
+
+
+class HeartbeatMonitor:
+    """Liveness watchdog (the heartbeat half of the reference's mesh
+    organizer): training calls :meth:`beat` every iteration; a supervisor
+    thread — or the trainer itself between epochs — calls :meth:`check`
+    and treats a stale heartbeat as a failure."""
+
+    def __init__(self, timeout_s: float = 600.0):
+        self.timeout_s = float(timeout_s)
+        self._last = time.monotonic()
+
+    def beat(self) -> None:
+        self._last = time.monotonic()
+
+    def seconds_since_beat(self) -> float:
+        return time.monotonic() - self._last
+
+    def check(self) -> None:
+        if self.seconds_since_beat() > self.timeout_s:
+            raise TrainingFailure(
+                f"no training heartbeat for {self.seconds_since_beat():.0f}s "
+                f"(timeout {self.timeout_s:.0f}s)")
+
+
+class _HeartbeatListener:
+    """TrainingListener shim feeding the monitor."""
+
+    def __init__(self, monitor: HeartbeatMonitor):
+        self.monitor = monitor
+
+    def iteration_done(self, model, iteration, epoch, score):
+        self.monitor.beat()
+
+    def on_epoch_start(self, model, epoch):
+        pass
+
+    def on_epoch_end(self, model, epoch):
+        pass
+
+
+class FaultTolerantTrainer:
+    """Checkpoint + restart supervision loop.
+
+    ``make_net()`` must build a FRESH, initialised network (the replacement
+    worker). ``fit`` runs epoch-at-a-time; on any exception it reloads the
+    newest checkpoint from ``checkpoint_dir`` into a fresh network and
+    continues, up to ``max_restarts`` times.
+    """
+
+    def __init__(self, make_net: Callable[[], object], checkpoint_dir: str,
+                 every_n_iterations: int = 50, keep_last: int = 3,
+                 max_restarts: int = 3,
+                 heartbeat_timeout_s: Optional[float] = None):
+        self.make_net = make_net
+        self.checkpoint_dir = checkpoint_dir
+        self.every_n_iterations = every_n_iterations
+        self.keep_last = keep_last
+        self.max_restarts = max_restarts
+        self.restarts = 0
+        self.monitor = (HeartbeatMonitor(heartbeat_timeout_s)
+                        if heartbeat_timeout_s else None)
+        os.makedirs(checkpoint_dir, exist_ok=True)
+
+    def _fresh_net(self):
+        base = self.make_net()  # one build: class, listeners, or the net itself
+        listeners = list(getattr(base, "_listeners", []))
+        ckpt = CheckpointListener.last_checkpoint_in(self.checkpoint_dir)
+        if ckpt is not None:
+            logger.warning("Restoring from checkpoint %s", ckpt)
+            net = type(base).load(ckpt)
+        else:
+            net = base
+        listeners.append(CheckpointListener(
+            self.checkpoint_dir, every_n_iterations=self.every_n_iterations,
+            keep_last=self.keep_last))
+        if self.monitor:
+            listeners.append(_HeartbeatListener(self.monitor))
+        net.set_listeners(*listeners)
+        return net
+
+    def fit(self, iterator, epochs: int = 1):
+        """Supervised training; returns the final (possibly restarted) net."""
+        net = self._fresh_net()
+        epoch = 0
+        while epoch < epochs:
+            try:
+                net.fit(iterator, epochs=1)
+                if self.monitor:
+                    self.monitor.check()
+                epoch += 1
+            except Exception as e:  # noqa: BLE001 — any failure -> restart
+                self.restarts += 1
+                if self.restarts > self.max_restarts:
+                    raise TrainingFailure(
+                        f"giving up after {self.max_restarts} restarts") from e
+                logger.warning("Training failed (%s); restart %d/%d",
+                               e, self.restarts, self.max_restarts)
+                net = self._fresh_net()
+        return net

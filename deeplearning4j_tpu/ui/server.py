@@ -62,8 +62,24 @@ async function overview() {
   }
   draw(); setInterval(draw, 3000);
 }
+function bars(id, hist, color) {
+  const c = document.getElementById(id), g = c.getContext('2d');
+  g.clearRect(0, 0, c.width, c.height);
+  if (!hist || !hist.counts) { g.fillText('no histogram', 10, 20); return; }
+  const n = hist.counts.length, mx = Math.max(...hist.counts) || 1;
+  const bw = (c.width - 60) / n;
+  g.fillStyle = color || '#1a73e8';
+  hist.counts.forEach((v, i) => {
+    const h = v / mx * (c.height - 30);
+    g.fillRect(40 + i * bw, c.height - 16 - h, Math.max(bw - 1, 1), h);
+  });
+  g.fillStyle = '#333';
+  g.fillText(Number(hist.lo).toPrecision(3), 40, c.height - 4);
+  g.fillText(Number(hist.hi).toPrecision(3), c.width - 60, c.height - 4);
+}
 async function model() {
-  el('<h1>Model: update : parameter ratios (log10)</h1><div id="charts"></div>');
+  el('<h1>Model</h1><h2>update : parameter ratios (log10)</h2><div id="charts"></div>' +
+     '<h2>Parameter / update histograms (latest sample)</h2><div id="hists"></div>');
   async function draw() {
     const recs = await (await fetch('/api/records')).json();
     const layers = {};
@@ -77,6 +93,31 @@ async function model() {
           `<h2>${k}</h2><canvas id="${id}" width="900" height="120"></canvas>`);
       plot(id, layers[k], '#e8710a');
     });
+    const last = recs.filter(r => r.params).slice(-1)[0];
+    if (last) {
+      const hd = document.getElementById('hists');
+      Object.entries(last.params).forEach(([layer, ps]) =>
+        Object.entries(ps).forEach(([pname, st]) => {
+          if (!st.hist) return;
+          const base = (layer + '_' + pname).replace(/[^a-zA-Z0-9]/g, '_');
+          if (!document.getElementById('h_' + base)) {
+            hd.insertAdjacentHTML('beforeend',
+              `<h3>${layer}/${pname}</h3>` +
+              `<canvas id="h_${base}" width="440" height="130"></canvas> ` +
+              `<canvas id="u_${base}" width="440" height="130"></canvas>`);
+          }
+          bars('h_' + base, st.hist, '#1a73e8');
+          const ust = ((last.updates || {})[layer] || {})[pname];
+          bars('u_' + base, ust && ust.hist, '#188038');
+        }));
+      if (last.activations && last.activations.length &&
+          !document.getElementById('a_0')) {
+        hd.insertAdjacentHTML('beforeend', '<h2>Activation histograms</h2>' +
+          last.activations.map((_, i) =>
+            `<h3>layer ${i}</h3><canvas id="a_${i}" width="440" height="130"></canvas>`).join(''));
+      }
+      (last.activations || []).forEach((a, i) => bars('a_' + i, a.hist, '#9334e6'));
+    }
   }
   draw(); setInterval(draw, 3000);
 }
@@ -139,6 +180,7 @@ class UIServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.port: Optional[int] = None
+        self._remote_enabled = False
 
     @classmethod
     def get_instance(cls) -> "UIServer":
@@ -150,7 +192,14 @@ class UIServer:
         self._storage = storage
 
     def enable_remote_listener(self) -> None:
-        """Reference API surface; ``POST /api/post`` is always accepted."""
+        """Opt in to accepting POSTed stats/arbiter records (reference:
+        ``UIServer.enableRemoteListener()``). Until called, the POST
+        endpoints return 403 so other local processes can't inject
+        records into the dashboard."""
+        self._remote_enabled = True
+
+    def disable_remote_listener(self) -> None:
+        self._remote_enabled = False
 
     def attach_arbiter(self, runner) -> None:
         """Live-attach a :class:`LocalOptimizationRunner`: its results render
@@ -205,7 +254,11 @@ class UIServer:
                 length = int(self.headers.get("Content-Length", 0))
                 raw = self.rfile.read(length)
                 code = 404
-                if self.path.startswith("/api/post"):
+                if not ui._remote_enabled and (
+                        self.path.startswith("/api/post")
+                        or self.path.startswith("/api/arbiter")):
+                    code = 403
+                elif self.path.startswith("/api/post"):
                     try:
                         record = json.loads(raw.decode())
                         if not isinstance(record, dict):

@@ -62,22 +62,85 @@ class FileStatsStorage(StatsStorage):
         return out
 
 
+HIST_BINS = 32
+
+
+def _leaf_hist(wf):
+    """Fixed-bin histogram on device: counts over [min, max]."""
+    lo, hi = jnp.min(wf), jnp.max(wf)
+    span = jnp.maximum(hi - lo, 1e-12)
+    idx = jnp.clip(((wf - lo) / span * HIST_BINS).astype(jnp.int32),
+                   0, HIST_BINS - 1)
+    counts = jnp.bincount(idx.ravel(), length=HIST_BINS)
+    return {"counts": counts, "lo": lo, "hi": hi}
+
+
 @jax.jit
 def _param_stats(params):
-    """One fused program: mean |w|, std, l2 per leaf."""
+    """One fused program: mean |w|, std, l2 AND a full histogram per leaf
+    (reference StatsListener records parameter histograms; bincount runs on
+    device so only 32 ints per leaf cross to the host)."""
     def leaf(w):
         wf = w.astype(jnp.float32)
         return {"mean_mag": jnp.mean(jnp.abs(wf)), "std": jnp.std(wf),
-                "l2": jnp.sqrt(jnp.sum(wf * wf))}
+                "l2": jnp.sqrt(jnp.sum(wf * wf)), "hist": _leaf_hist(wf)}
     return jax.tree.map(leaf, params, is_leaf=lambda x: isinstance(x, jax.Array))
 
 
+@jax.jit
+def _update_stats(params, prev_params):
+    """Histogram + mean magnitude of the parameter DELTA since the last
+    sampled iteration (reference: update histograms)."""
+    def leaf(w, p):
+        d = w.astype(jnp.float32) - p.astype(jnp.float32)
+        return {"mean_mag": jnp.mean(jnp.abs(d)), "hist": _leaf_hist(d)}
+    return jax.tree.map(leaf, params, prev_params,
+                        is_leaf=lambda x: isinstance(x, jax.Array))
+
+
+@jax.jit
+def _activation_stats(acts):
+    """Mean/std + histogram per sampled layer activation."""
+    def leaf(a):
+        af = a.astype(jnp.float32)
+        return {"mean": jnp.mean(af), "std": jnp.std(af),
+                "hist": _leaf_hist(af)}
+    return [leaf(a) for a in acts]
+
+
+def _jsonable(v):
+    """Device stats -> JSON-ready (np arrays to lists, scalars to floats)."""
+    if isinstance(v, dict):
+        return {k: _jsonable(x) for k, x in v.items()}
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    return float(v)
+
+
 class StatsListener(TrainingListener):
-    def __init__(self, storage: Optional[StatsStorage] = None, frequency: int = 10):
+    def __init__(self, storage: Optional[StatsStorage] = None, frequency: int = 10,
+                 collect_histograms: bool = True,
+                 collect_activations: bool = False):
         self.storage = storage or InMemoryStatsStorage()
         self.frequency = max(1, int(frequency))
+        self.collect_histograms = collect_histograms
+        self.collect_activations = collect_activations
         self._last_time = None
         self._prev_params = None
+        self._prev_device_params = None
+
+    @staticmethod
+    def _group(stats):
+        """Nested device stats -> {layer: {param_path: stat_dict}}."""
+        def is_stat(v):
+            return isinstance(v, dict) and ("mean_mag" in v or "mean" in v)
+
+        grouped: Dict[str, Dict[str, Any]] = {}
+        flat = jax.tree_util.tree_flatten_with_path(stats, is_leaf=is_stat)[0]
+        for path, val in flat:
+            keys = [str(getattr(p, "key", p)) for p in path]
+            grouped.setdefault(keys[0], {})["/".join(keys[1:])] = _jsonable(val)
+        return grouped
 
     def iteration_done(self, model, iteration, epoch, score):
         if iteration % self.frequency:
@@ -95,16 +158,23 @@ class StatsListener(TrainingListener):
         ts = getattr(model, "train_state", None)
         if ts is not None:
             stats = jax.device_get(_param_stats(ts.params))
-            layers = {}
-            flat = jax.tree_util.tree_flatten_with_path(stats)[0]
-            # group leaves: path like ('layer_0', 'W', 'mean_mag')
-            grouped: Dict[str, Dict[str, Dict[str, float]]] = {}
-            for path, val in flat:
-                keys = [str(getattr(p, "key", p)) for p in path]
-                layer, stat = keys[0], keys[-1]
-                pname = "/".join(keys[1:-1])
-                grouped.setdefault(layer, {}).setdefault(pname, {})[stat] = float(val)
-            record["params"] = grouped
+            record["params"] = self._group(stats)
+            if self.collect_histograms and self._prev_device_params is not None:
+                upd = jax.device_get(
+                    _update_stats(ts.params, self._prev_device_params))
+                record["updates"] = self._group(upd)
+            if self.collect_histograms:
+                # the train step DONATES its state pytree, so the old
+                # buffers die next step — snapshot a device-side copy
+                self._prev_device_params = jax.tree.map(jnp.copy, ts.params)
+            if self.collect_activations:
+                x = getattr(model, "_last_batch_features", None)
+                if x is not None and hasattr(model, "feed_forward"):
+                    acts = model.feed_forward(x)[1:]
+                    record["activations"] = [
+                        _jsonable(s) for s in jax.device_get(
+                            _activation_stats(acts))]
+            grouped = record["params"]
             if self._prev_params is not None:
                 ratios = {}
                 for layer, pstats in grouped.items():

@@ -6,6 +6,8 @@ import pytest
 
 from deeplearning4j_tpu.ndarray import INDArray, Nd4j, NDArrayIndex
 
+pytestmark = pytest.mark.quick
+
 
 def test_factories():
     assert Nd4j.zeros(2, 3).shape() == (2, 3)
@@ -135,3 +137,41 @@ def test_exec_named_op():
     a = Nd4j.create([[1.0, -2.0]])
     out = Nd4j.exec("relu", a)
     np.testing.assert_allclose(out.numpy(), [[1.0, 0.0]])
+
+
+def test_transforms_and_boolean_indexing():
+    """Reference Transforms / Conditions / BooleanIndexing API family."""
+    from deeplearning4j_tpu.ndarray import (BooleanIndexing, Conditions,
+                                            Nd4j, Transforms)
+    a = Nd4j.create(np.array([[1.0, -2.0], [3.0, -4.0]], np.float32))
+    np.testing.assert_allclose(Transforms.sigmoid(a).numpy(),
+                               1 / (1 + np.exp(-a.numpy())), rtol=1e-6)
+    np.testing.assert_allclose(Transforms.unit_vec(a).numpy(),
+                               a.numpy() / np.linalg.norm(a.numpy()), rtol=1e-6)
+    assert abs(Transforms.euclidean_distance(a.get_row(0), a.get_row(1))
+               - np.linalg.norm([1 - 3, -2 + 4])) < 1e-6
+    assert abs(Transforms.cosine_sim(a.get_row(0), a.get_row(0)) - 1.0) < 1e-6
+    sims = Transforms.all_cosine_similarities(a, a.get_row(1)).numpy()
+    assert abs(sims[1] - 1.0) < 1e-6
+
+    b = a.dup()
+    b.replace_where(0.0, Conditions.less_than(0))
+    np.testing.assert_array_equal(b.numpy(), [[1, 0], [3, 0]])
+    assert BooleanIndexing.or_(a, Conditions.less_than(-3))
+    assert not BooleanIndexing.and_(a, Conditions.greater_than(0))
+
+
+def test_number_reductions_and_misc():
+    from deeplearning4j_tpu.ndarray import Nd4j
+    a = Nd4j.create(np.array([[1.0, -2.0], [3.0, -4.0]], np.float32))
+    assert a.max_number() == 3.0 and a.min_number() == -4.0
+    assert a.sum_number() == -2.0 and abs(a.mean_number() + 0.5) < 1e-6
+    assert a.amax().item() == 4.0 and a.arg_min().item() == 3
+    assert a.norm_max_number() == 4.0
+    np.testing.assert_array_equal(a.get_rows(1, 0).numpy(), [[3, -4], [1, -2]])
+    np.testing.assert_array_equal(a.get_columns(1).numpy(), [[-2], [-4]])
+    np.testing.assert_array_equal(a.is_nan().numpy(), [[False] * 2] * 2)
+    assert a.like().sum_number() == 0.0
+    np.testing.assert_array_equal(a.diag().numpy(), [1.0, -4.0])
+    assert a.pad((1, 1), (0, 0)).shape() == (4, 2)
+    assert a.to_int_vector() == [1, -2, 3, -4]

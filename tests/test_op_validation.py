@@ -25,6 +25,45 @@ _KER = _R.normal(0, 0.3, (3, 3, 3, 5)).astype(np.float32)
 _IDX = np.array([2, 0, 1], np.int32)
 _LOGITS = _R.normal(0, 1, (4, 6)).astype(np.float32)
 _LABELS = np.eye(6, dtype=np.float32)[[1, 3, 0, 5]]
+_A3 = _R.normal(0, 1, (3, 3)).astype(np.float32)
+_SPD = (_A3 @ _A3.T + 3.0 * np.eye(3)).astype(np.float32)  # well-conditioned SPD
+_LOW = np.linalg.cholesky(_SPD).astype(np.float32)
+_RHS = _R.normal(0, 1, (3, 2)).astype(np.float32)
+_V3 = _R.normal(0, 1, (4, 3)).astype(np.float32)
+_W3 = _R.normal(0, 1, (4, 3)).astype(np.float32)
+_I1 = _R.integers(1, 1 << 20, (3, 4)).astype(np.int32)
+_I2 = _R.integers(1, 1 << 20, (3, 4)).astype(np.int32)
+_IMGP = _R.uniform(0.05, 0.95, (2, 8, 8, 3)).astype(np.float32)  # image in (0,1)
+
+
+def _np_rotl32(a, s):
+    ua = a.astype(np.uint32)
+    return ((ua << np.uint32(s)) | (ua >> np.uint32(32 - s))).astype(a.dtype)
+
+
+def _np_scatter(a, idx, upd, mode):
+    out = a.copy()
+    for j, i in enumerate(idx):
+        if mode == "add":
+            out[i] += upd[j]
+        elif mode == "sub":
+            out[i] -= upd[j]
+        elif mode == "mul":
+            out[i] *= upd[j]
+        elif mode == "div":
+            out[i] /= upd[j]
+        elif mode == "max":
+            out[i] = np.maximum(out[i], upd[j])
+        elif mode == "min":
+            out[i] = np.minimum(out[i], upd[j])
+    return out
+
+
+def _np_space_to_depth(x, block_size=2):
+    n, h, w, c = x.shape
+    b = block_size
+    return (x.reshape(n, h // b, b, w // b, b, c)
+            .transpose(0, 1, 3, 2, 4, 5).reshape(n, h // b, w // b, b * b * c))
 
 
 def _np(fn):
@@ -193,6 +232,169 @@ CASES = {
                   _R.normal(0, 0.4, (3, 12)).astype(np.float32),
                   _R.normal(0, 0.4, (4, 12)).astype(np.float32),
                   np.zeros(12, np.float32)), {}, None, (0, 2, 3)),
+    # linalg decompositions / solves (sd.linalg namespace)
+    "cholesky": ((_SPD,), {}, np.linalg.cholesky, (0,)),
+    "solve": ((_SPD, _RHS), {}, np.linalg.solve, (0, 1)),
+    "triangular_solve": ((_LOW, _RHS), {"lower": True},
+                         lambda a, b: np.linalg.solve(a, b), (0, 1)),
+    "lstsq": ((_M.T, _M.T[:, :2] + _R.normal(0, 0.1, (5, 2)).astype(np.float32)), {},
+              lambda a, b: np.linalg.lstsq(a, b, rcond=None)[0], ()),
+    "matrix_inverse": ((_SPD,), {}, np.linalg.inv, (0,)),
+    "matrix_determinant": ((_SPD,), {}, np.linalg.det, (0,)),
+    "logdet": ((_SPD,), {}, lambda a: np.linalg.slogdet(a)[1], (0,)),
+    # svd/qr/eigh outputs have per-column sign ambiguity vs any oracle —
+    # checked structurally here, reconstruction-checked in the tests below
+    "svd": ((_A,), {}, None, ()),
+    "qr": ((_A3,), {}, None, ()),
+    "eigh": ((_SPD,), {}, None, ()),
+    "matrix_band_part": ((_A3,), {"num_lower": 1, "num_upper": 1},
+                         lambda a: np.tril(np.triu(a, -1), 1), ()),
+    "cross": ((_V3, _W3), {}, np.cross, (0, 1)),
+    "diag": ((_A[0],), {}, np.diag, ()),
+    "diag_part": ((_SPD,), {}, np.diag, (0,)),
+    "trace": ((_SPD,), {}, np.trace, (0,)),
+    # bitwise (sd.bitwise namespace) — int32, structural oracle per op
+    "bitwise_and": ((_I1, _I2), {}, np.bitwise_and, ()),
+    "bitwise_or": ((_I1, _I2), {}, np.bitwise_or, ()),
+    "bitwise_xor": ((_I1, _I2), {}, np.bitwise_xor, ()),
+    "bit_shift": ((_I1, 3), {}, lambda a, s: np.left_shift(a, s), ()),
+    "bit_shift_right": ((_I1, 3), {}, lambda a, s: np.right_shift(a, s), ()),
+    "bit_rotl": ((_I1, 3), {}, _np_rotl32, ()),
+    "bit_rotr": ((_I1, 3), {}, lambda a, s: _np_rotl32(a, 32 - s), ()),
+    # random (sd.random namespace) — structural: finite, right shape; the
+    # distribution tests below check moments
+    "random_uniform": (((64, 64),), {"minval": 2.0, "maxval": 5.0, "seed": 7},
+                       None, ()),
+    "random_normal": (((64, 64),), {"mean": 1.0, "stddev": 2.0, "seed": 7},
+                      None, ()),
+    "random_bernoulli": (((64, 64),), {"p": 0.25, "seed": 7}, None, ()),
+    "random_exponential": (((64, 64),), {"lam": 2.0, "seed": 7}, None, ()),
+    "random_shuffle": ((_A,), {"seed": 7}, None, ()),
+    # image (sd.image namespace)
+    "resize_bilinear": ((_IMGP,), {"height": 4, "width": 4}, None, (0,)),
+    "resize_nearest": ((_IMGP,), {"height": 4, "width": 4}, None, ()),
+    "crop_to_box": ((_IMGP,), {"top": 2, "left": 1, "height": 4, "width": 5},
+                    lambda im: im[:, 2:6, 1:6, :], (0,)),
+    "flip_left_right": ((_IMGP,), {}, lambda im: im[:, :, ::-1, :], (0,)),
+    "flip_up_down": ((_IMGP,), {}, lambda im: im[:, ::-1, :, :], (0,)),
+    "adjust_brightness": ((_IMGP,), {"delta": 0.1}, lambda im: im + 0.1, (0,)),
+    "adjust_contrast": ((_IMGP,), {"factor": 1.5},
+                        lambda im: (im - im.mean((1, 2), keepdims=True)) * 1.5
+                        + im.mean((1, 2), keepdims=True), (0,)),
+    "adjust_saturation": ((_IMGP,), {"factor": 0.5},
+                          lambda im: im.mean(-1, keepdims=True)
+                          + (im - im.mean(-1, keepdims=True)) * 0.5, (0,)),
+    "rgb_to_grayscale": ((_IMGP,), {},
+                         lambda im: (im * np.array([0.2989, 0.587, 0.114],
+                                                   np.float32)).sum(-1, keepdims=True),
+                         (0,)),
+    "rgb_to_hsv": ((_IMGP,), {}, None, ()),
+    "hsv_to_rgb": ((np.stack([_U, _U, _U], -1)[None],), {}, None, ()),
+    # scatter / segment (sparse-update path)
+    "scatter_add": ((_A, _IDX, _B[:3]), {},
+                    lambda a, i, u: _np_scatter(a, i, u, "add"), (0, 2)),
+    "scatter_sub": ((_A, _IDX, _B[:3]), {},
+                    lambda a, i, u: _np_scatter(a, i, u, "sub"), (0, 2)),
+    "scatter_mul": ((_A, np.array([0, 1], np.int32), _B[:2]), {},
+                    lambda a, i, u: _np_scatter(a, i, u, "mul"), ()),
+    "scatter_div": ((_A, np.array([0, 1], np.int32), np.abs(_B[:2]) + 1.0), {},
+                    lambda a, i, u: _np_scatter(a, i, u, "div"), ()),
+    "scatter_max": ((_A, np.array([0, 1], np.int32), _B[:2]), {},
+                    lambda a, i, u: _np_scatter(a, i, u, "max"), ()),
+    "scatter_min": ((_A, np.array([0, 1], np.int32), _B[:2]), {},
+                    lambda a, i, u: _np_scatter(a, i, u, "min"), ()),
+    "scatter_nd": ((np.array([[0, 1], [2, 3]], np.int32),
+                    np.array([5.0, 7.0], np.float32), (3, 4)), {},
+                   None, ()),
+    "scatter_nd_add": ((_A, np.array([[0, 1], [2, 3]], np.int32),
+                        np.array([5.0, 7.0], np.float32)), {}, None, (0, 2)),
+    "scatter_nd_update": ((_A, np.array([[0, 1], [2, 3]], np.int32),
+                           np.array([5.0, 7.0], np.float32)), {}, None, ()),
+    "segment_sum": ((_A, np.array([0, 0, 1], np.int32)), {"num_segments": 2},
+                    lambda d, s: np.stack([d[:2].sum(0), d[2]]), (0,)),
+    "segment_mean": ((_A, np.array([0, 0, 1], np.int32)), {"num_segments": 2},
+                     lambda d, s: np.stack([d[:2].mean(0), d[2]]), (0,)),
+    "segment_max": ((_A, np.array([0, 0, 1], np.int32)), {"num_segments": 2},
+                    lambda d, s: np.stack([d[:2].max(0), d[2]]), ()),
+    "segment_min": ((_A, np.array([0, 0, 1], np.int32)), {"num_segments": 2},
+                    lambda d, s: np.stack([d[:2].min(0), d[2]]), ()),
+    "segment_prod": ((_A, np.array([0, 0, 1], np.int32)), {"num_segments": 2},
+                     lambda d, s: np.stack([d[:2].prod(0), d[2]]), ()),
+    "unsorted_segment_sum": ((_A, np.array([1, 0, 1], np.int32), 2), {},
+                             lambda d, s, n: np.stack([d[1], d[0] + d[2]]), ()),
+    "embedding_lookup": ((_M, _IDX), {}, lambda t, i: t[i], (0,)),
+    "embedding_bag": ((_M, np.array([[0, 2, -1], [1, -1, -1]], np.int32)), {},
+                      lambda t, i: np.stack([t[0] + t[2], t[1]]), (0,)),
+    # spatial transforms
+    "space_to_batch": ((_IMGP,), {"block_size": 2}, None, (0,)),
+    "batch_to_space": ((np.concatenate([_IMGP, _IMGP], 0),),
+                       {"block_size": 2}, None, (0,)),
+    "space_to_depth": ((_IMGP,), {"block_size": 2},
+                       _np_space_to_depth, (0,)),
+    "depth_to_space": ((_R.normal(0, 1, (1, 4, 4, 8)).astype(np.float32),),
+                       {"block_size": 2}, None, (0,)),
+    "dilation2d": ((_IMGP, np.zeros((2, 2), np.float32)),
+                   {"stride": (1, 1), "rates": (1, 1), "padding": "VALID"},
+                   None, ()),
+    # image extras (detection path)
+    "crop_and_resize": ((_IMGP, np.array([[0.0, 0.0, 0.5, 0.5],
+                                          [0.25, 0.25, 1.0, 1.0]], np.float32),
+                         np.array([0, 1], np.int32), (4, 4)), {}, None, (0,)),
+    "non_max_suppression": ((np.array([[0, 0, 1, 1], [0, 0, 1.05, 1],
+                                       [2, 2, 3, 3]], np.float32),
+                             np.array([0.9, 0.8, 0.7], np.float32)),
+                            {"max_output_size": 2}, None, ()),
+    # random extras — structural (moments tested separately)
+    "random_gamma": (((256,),), {"alpha": 2.0, "beta": 1.0, "seed": 3}, None, ()),
+    "random_poisson": (((256,),), {"lam": 3.0, "seed": 3}, None, ()),
+    "random_gumbel": (((256,),), {"seed": 3}, None, ()),
+    "random_laplace": (((256,),), {"seed": 3}, None, ()),
+    "truncated_normal": (((256,),), {"mean": 0.0, "stddev": 1.0, "seed": 3},
+                         None, ()),
+    "random_categorical": ((_LOGITS,), {"num_samples": 5, "seed": 3}, None, ()),
+    "multinomial": ((np.full((4, 6), 1 / 6, np.float32),),
+                    {"num_samples": 5, "seed": 3}, None, ()),
+    # sorting / search
+    "top_k": ((_A,), {"k": 2}, None, ()),
+    "in_top_k": ((_LOGITS, np.array([1, 3, 0, 5], np.int32)), {"k": 3}, None, ()),
+    "sort": ((_A,), {"axis": 1}, lambda a: np.sort(a, 1), ()),
+    "argsort": ((_A,), {"axis": 1}, lambda a: np.argsort(a, 1), ()),
+    "unique": ((np.array([3, 1, 3, 2, 1], np.int32),), {"size": 5}, None, ()),
+    "bincount": ((np.array([0, 1, 1, 3], np.int32),), {"minlength": 4},
+                 lambda a: np.bincount(a, minlength=4), ()),
+    "searchsorted": ((np.array([1.0, 3.0, 5.0], np.float32), _A), {},
+                     lambda s, v: np.searchsorted(s, v).astype(np.int32), ()),
+    # float-classification / numerics
+    "isnan": ((_A,), {}, np.isnan, ()),
+    "isinf": ((_A,), {}, np.isinf, ()),
+    "isfinite": ((_A,), {}, np.isfinite, ()),
+    "nan_to_num": ((_A,), {}, np.nan_to_num, ()),
+    "atan2": ((_A, _P), {}, np.arctan2, (0, 1)),
+    "asinh": ((_A,), {}, np.arcsinh, (0,)),
+    "acosh": ((_P + 1.0,), {}, np.arccosh, (0,)),
+    "atanh": ((_U * 0.9,), {}, np.arctanh, (0,)),
+    "expm1": ((_A,), {}, np.expm1, (0,)),
+    "rint": ((_A,), {}, np.rint, ()),
+    "erfc": ((_A,), {}, None, (0,)),
+    "lgamma": ((_P,), {}, None, (0,)),
+    "digamma": ((_P,), {}, None, (0,)),
+    "betainc": ((_P, _P, _U), {}, None, ()),
+    "igamma": ((_P, _P), {}, None, ()),
+    "igammac": ((_P, _P), {}, None, ()),
+    "zeta": ((_P + 1.5, _P), {}, None, ()),
+    "polygamma": ((1, _P), {}, None, ()),
+    "xlogy": ((_P, _P), {}, None, (0, 1)),
+    "cumprod": ((_A,), {"axis": 1}, lambda a: np.cumprod(a, 1), (0,)),
+    "logcumsumexp": ((_A,), {"axis": 1},
+                     lambda a: np.log(np.cumsum(np.exp(a), 1)), (0,)),
+    "clip_by_norm": ((_A, 1.0), {}, None, ()),
+    "clip_by_global_norm": ((_A, 1.0), {}, None, ()),
+    "swap_axes": ((_A,), {"axis1": 0, "axis2": 1}, lambda a: a.T, ()),
+    "meshgrid": ((np.arange(3.0, dtype=np.float32),
+                  np.arange(4.0, dtype=np.float32)), {}, None, ()),
+    "broadcast_to": ((_A[0], (3, 4)), {},
+                     lambda a, s: np.broadcast_to(a, s), ()),
+    "squared_norm": ((_A,), {}, lambda a: (a * a).sum(), (0,)),
 }
 
 
@@ -252,3 +454,55 @@ def test_op_gradient(name):
             fd = (float(scalar_fn(*up)) - float(scalar_fn(*dn))) / (2 * eps)
             assert abs(fd - g[j]) <= 2e-2 * max(1.0, abs(fd), abs(g[j])), \
                 f"{name} grad arg{gi}[{j}]: analytic {g[j]:.5f} vs fd {fd:.5f}"
+
+
+# ------------------------------------------------------------------
+# Semantic checks for ops whose outputs can't be compared to a single
+# oracle array (decomposition sign ambiguity, random draws, color spaces).
+
+
+def test_svd_reconstructs():
+    s, u, vt = get_op("svd")(jnp.asarray(_A))
+    np.testing.assert_allclose(np.asarray(u) * np.asarray(s) @ np.asarray(vt),
+                               _A, rtol=1e-4, atol=1e-4)
+    assert np.all(np.diff(np.asarray(s)) <= 1e-6)  # descending
+
+
+def test_qr_reconstructs():
+    q, r = get_op("qr")(jnp.asarray(_A3))
+    q, r = np.asarray(q), np.asarray(r)
+    np.testing.assert_allclose(q @ r, _A3, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(q.T @ q, np.eye(3), atol=1e-5)
+    np.testing.assert_allclose(np.tril(r, -1), 0, atol=1e-6)
+
+
+def test_eigh_reconstructs():
+    w, v = get_op("eigh")(jnp.asarray(_SPD))
+    w, v = np.asarray(w), np.asarray(v)
+    np.testing.assert_allclose(v * w @ v.T, _SPD, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.sort(w), np.sort(np.linalg.eigvalsh(_SPD)),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_rgb_hsv_roundtrip():
+    hsv = get_op("rgb_to_hsv")(jnp.asarray(_IMGP))
+    back = get_op("hsv_to_rgb")(hsv)
+    np.testing.assert_allclose(np.asarray(back), _IMGP, rtol=1e-4, atol=1e-4)
+
+
+def test_random_moments():
+    u = np.asarray(get_op("random_uniform")((4096,), minval=2.0, maxval=5.0, seed=1))
+    assert 2.0 <= u.min() and u.max() < 5.0 and abs(u.mean() - 3.5) < 0.1
+    n = np.asarray(get_op("random_normal")((4096,), mean=1.0, stddev=2.0, seed=1))
+    assert abs(n.mean() - 1.0) < 0.15 and abs(n.std() - 2.0) < 0.15
+    b = np.asarray(get_op("random_bernoulli")((4096,), p=0.25, seed=1))
+    assert set(np.unique(b)) <= {0.0, 1.0} and abs(b.mean() - 0.25) < 0.05
+    e = np.asarray(get_op("random_exponential")((4096,), lam=2.0, seed=1))
+    assert e.min() >= 0 and abs(e.mean() - 0.5) < 0.08
+
+
+def test_random_shuffle_is_permutation():
+    out = np.asarray(get_op("random_shuffle")(jnp.asarray(_A), seed=3))
+    # same multiset of rows, (almost surely) different order for seed 3
+    perm_found = {tuple(r) for r in out} == {tuple(r) for r in _A}
+    assert perm_found and out.shape == _A.shape

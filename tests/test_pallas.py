@@ -342,3 +342,102 @@ def test_gru_layer_routes_through_fused_kernel():
         fg.fused_gru, fg.fused_gru_compatible = orig_fused, orig_compat
     np.testing.assert_allclose(np.asarray(y_kernel), np.asarray(y_scan),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_fused_graves_lstm_matches_scan():
+    """Peephole+mask kernel (fwd + reverse-time bwd) vs the pure-scan
+    reference: outputs, final carries, all gradients incl. peepholes —
+    with a ragged mask AND nonzero peepholes at once."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops.pallas.fused_lstm_graves import (
+        fused_graves_lstm, fused_graves_lstm_compatible)
+
+    T, B, H = 12, 8, 128
+    rng = np.random.default_rng(5)
+    zx = jnp.asarray(rng.normal(0, 1, (T, B, 4 * H)), jnp.float32)
+    w_rec = jnp.asarray(rng.normal(0, 0.3, (H, 4 * H)), jnp.float32)
+    peep = jnp.asarray(rng.normal(0, 0.3, (3 * H,)), jnp.float32)
+    h0 = jnp.asarray(rng.normal(0, 1, (B, H)), jnp.float32)
+    c0 = jnp.asarray(rng.normal(0, 1, (B, H)), jnp.float32)
+    lens = rng.integers(3, T + 1, B)
+    mask = jnp.asarray((np.arange(T)[:, None] < lens[None, :]).astype(np.float32))
+    assert fused_graves_lstm_compatible(zx, h0)
+
+    def scan_graves(zx, w_rec, peep, h0, c0, mask):
+        def step(hc, inp):
+            h, c = hc
+            zx_t, m = inp
+            z = zx_t + h @ w_rec
+            i = jax.nn.sigmoid(z[:, :H] + c * peep[:H])
+            f = jax.nn.sigmoid(z[:, H:2 * H] + c * peep[H:2 * H])
+            g = jnp.tanh(z[:, 2 * H:3 * H])
+            c_til = f * c + i * g
+            o = jax.nn.sigmoid(z[:, 3 * H:] + c_til * peep[2 * H:])
+            h_til = o * jnp.tanh(c_til)
+            mm = m[:, None]
+            h_new = mm * h_til + (1 - mm) * h
+            c_new = mm * c_til + (1 - mm) * c
+            return (h_new, c_new), h_new
+        (h, c), ys = jax.lax.scan(step, (h0, c0), (zx, mask))
+        return ys, h, c
+
+    ys1, h1, c1 = fused_graves_lstm(zx, w_rec, peep, h0, c0, mask)
+    ys2, h2, c2 = scan_graves(zx, w_rec, peep, h0, c0, mask)
+    np.testing.assert_allclose(np.asarray(ys1), np.asarray(ys2), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h1), np.asarray(h2), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(c1), np.asarray(c2), rtol=1e-5, atol=1e-5)
+
+    tgt = jnp.asarray(rng.normal(0, 1, (T, B, H)), jnp.float32)
+
+    def loss(fn):
+        def f(zx, w_rec, peep, h0, c0):
+            ys, hT, cT = fn(zx, w_rec, peep, h0, c0, mask)
+            return jnp.sum(ys * tgt) + jnp.sum(hT ** 2) + 0.5 * jnp.sum(cT ** 2)
+        return f
+
+    g1 = jax.grad(loss(fused_graves_lstm), argnums=(0, 1, 2, 3, 4))(
+        zx, w_rec, peep, h0, c0)
+    g2 = jax.grad(loss(scan_graves), argnums=(0, 1, 2, 3, 4))(
+        zx, w_rec, peep, h0, c0)
+    for name, a, b in zip(["dzx", "dw_rec", "dpeep", "dh0", "dc0"], g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-3, err_msg=name)
+
+
+def test_graves_layer_routes_through_fused_kernel():
+    """GravesLSTM (peepholes) and masked plain LSTM both route through the
+    generalised kernel and must match their scan paths exactly."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.base import GlobalConfig
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.recurrent_layers import LSTM, GravesLSTM
+    import deeplearning4j_tpu.ops.pallas.fused_lstm_graves as fg
+
+    B, T, NIN, H = 8, 6, 16, 128
+    g = GlobalConfig()
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.normal(0, 1, (B, T, NIN)), jnp.float32)
+    mask = jnp.asarray((np.arange(T)[None, :]
+                        < rng.integers(2, T + 1, B)[:, None]).astype(np.float32))
+
+    for layer, m in ((GravesLSTM(n_out=H), None),
+                     (GravesLSTM(n_out=H), mask),
+                     (LSTM(n_out=H), mask)):
+        layer._g = g
+        params, state = layer.init(jax.random.PRNGKey(1),
+                                   InputType.recurrent(NIN, T), g)
+        if "peephole" in params:
+            params["peephole"] = jnp.asarray(
+                rng.normal(0, 0.3, (3 * H,)), jnp.float32)
+        y_kernel, _ = layer.forward(params, state, x, mask=m)
+        orig = fg.fused_graves_lstm_compatible
+        try:
+            fg.fused_graves_lstm_compatible = lambda *a, **k: False
+            y_scan, _ = layer.forward(params, state, x, mask=m)
+        finally:
+            fg.fused_graves_lstm_compatible = orig
+        np.testing.assert_allclose(np.asarray(y_kernel), np.asarray(y_scan),
+                                   rtol=1e-5, atol=1e-5,
+                                   err_msg=f"{type(layer).__name__} mask={m is not None}")

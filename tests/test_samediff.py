@@ -7,6 +7,8 @@ import pytest
 from deeplearning4j_tpu.autodiff import SameDiff, TrainingConfig
 from deeplearning4j_tpu.train import Adam
 
+pytestmark = pytest.mark.quick
+
 
 def _mlp_graph():
     sd = SameDiff.create()

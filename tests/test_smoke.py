@@ -15,6 +15,9 @@ from deeplearning4j_tpu.nn import (
 )
 from deeplearning4j_tpu.train import Adam
 from deeplearning4j_tpu.train.listeners import CollectScoresListener
+import pytest
+
+pytestmark = pytest.mark.quick
 
 
 def _toy_classification(n=256, d=20, classes=3, seed=0):
@@ -83,3 +86,52 @@ def test_deterministic_init():
     w1 = np.asarray(net1.params()["layer_0"]["W"])
     w2 = np.asarray(net2.params()["layer_0"]["W"])
     np.testing.assert_array_equal(w1, w2)
+
+
+def test_mln_remat_equivalence():
+    """env.set_remat() on a plain chain must not change the training math —
+    only where activations live (recomputed vs saved)."""
+    from deeplearning4j_tpu.runtime.environment import get_environment
+    x, y = _toy_classification(n=64)
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_in=20, n_out=16, activation="relu"))
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax"))
+            .set_input_type(InputType.feed_forward(20))
+            .build())
+    env = get_environment()
+    try:
+        net_a = MultiLayerNetwork(conf).init()
+        net_a.fit(x, y, epochs=2)
+        env.set_remat(True)
+        net_b = MultiLayerNetwork(conf).init()
+        net_b.fit(x, y, epochs=2)
+    finally:
+        env.set_remat(False)
+    np.testing.assert_allclose(net_a.score(), net_b.score(), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(net_a.output(x[:4])),
+                               np.asarray(net_b.output(x[:4])), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_tbptt_rejects_non_sgd_solver():
+    """LBFGS + truncated BPTT must raise (not silently train with SGD),
+    matching ComputationGraph."""
+    import pytest
+    from deeplearning4j_tpu.nn import LSTM, RnnOutputLayer
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).updater(Adam(1e-2))
+            .optimization_algo("LBFGS")
+            .list()
+            .layer(LSTM(n_in=5, n_out=8))
+            .layer(RnnOutputLayer(n_out=3, activation="softmax"))
+            .set_input_type(InputType.recurrent(5))
+            .tbptt_fwd_length(4)
+            .build())
+    x = np.random.default_rng(0).normal(0, 1, (8, 12, 5)).astype(np.float32)
+    yy = np.eye(3, dtype=np.float32)[np.random.default_rng(1).integers(0, 3, (8, 12))]
+    net = MultiLayerNetwork(conf).init()
+    with pytest.raises(NotImplementedError):
+        net.fit(x, yy)

@@ -107,6 +107,13 @@ def test_ui_tabs_remote_storage_arbiter_and_tsne():
     base = f"http://127.0.0.1:{port}"
     try:
         remote = RemoteUIStatsStorage(base)
+        # remote posting is opt-in (reference enableRemoteListener): 403 first
+        try:
+            remote.put_record({"iteration": 0, "score": 1.0})
+            assert False, "expected HTTP 403 before enable_remote_listener()"
+        except IOError as e:
+            assert "403" in str(e)
+        server.enable_remote_listener()
         remote.put_record({"iteration": 1, "score": 0.5})
         remote.put_record({"iteration": 2, "score": 0.25})
         recs = json.loads(urllib.request.urlopen(base + "/api/records").read())
@@ -178,3 +185,37 @@ def test_arbiter_result_persistence(tmp_path):
     assert [r.score for r in loaded] == [0.8, 0.9]
     assert loaded[1].candidate == {"lr": 0.01}
     assert loaded.minimize is False and loaded.best().score == 0.9
+
+
+def test_stats_listener_collects_histograms():
+    """Reference StatsListener records param/update/activation histograms;
+    ours computes them device-side (bincount) — verify they land in the
+    stats records and are JSON-serializable for the UI."""
+    import json
+    import numpy as np
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.train import Adam
+    from deeplearning4j_tpu.ui import StatsListener
+
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2)).list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax"))
+            .set_input_type(InputType.feed_forward(8)).build())
+    net = MultiLayerNetwork(conf).init()
+    sl = StatsListener(frequency=1, collect_activations=True)
+    net.set_listeners(sl)
+    x = np.random.default_rng(0).normal(0, 1, (64, 8)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[np.random.default_rng(1).integers(0, 3, 64)]
+    net.fit(x, y, epochs=3)
+
+    rec = sl.storage.records()[-1]
+    h = rec["params"]["layer_0"]["W"]["hist"]
+    assert len(h["counts"]) == 32 and sum(h["counts"]) == 8 * 16
+    assert h["lo"] < h["hi"]
+    uh = rec["updates"]["layer_0"]["W"]["hist"]
+    assert sum(uh["counts"]) == 8 * 16
+    assert len(rec["activations"]) == 2
+    assert sum(rec["activations"][0]["hist"]["counts"]) == 64 * 16
+    json.dumps(rec)  # UI transport
