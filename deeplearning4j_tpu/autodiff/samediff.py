@@ -147,7 +147,9 @@ class SDVariable:
     def set_arr(self, value):
         self.sd.arrays[self.name] = jnp.asarray(value)
         # a CONSTANT's value is baked into traced train steps — invalidate
+        # and EVICT (stale executables pin the old device buffers)
         self.sd._graph_version += 1
+        self.sd._jit_cache.clear()
 
     def rename(self, new_name: str) -> "SDVariable":
         self.sd._rename(self.name, new_name)
@@ -239,6 +241,31 @@ class TrainingConfig:
             l1=d.get("l1", 0.0), l2=d.get("l2", 0.0))
 
 
+class History(list):
+    """``sd.fit`` return value (reference
+    ``org.nd4j.autodiff.listeners.records.History``): behaves as the list of
+    per-iteration losses (backward compatible) and exposes the reference's
+    curve accessors."""
+
+    def __init__(self, losses, epoch_bounds):
+        super().__init__(losses)
+        self._bounds = list(epoch_bounds)  # iteration count at each epoch end
+
+    def loss_curve(self):
+        return list(self)
+
+    def epoch_losses(self):
+        out, start = [], 0
+        for end in self._bounds:
+            if end > start:
+                out.append(sum(self[start:end]) / (end - start))
+            start = end
+        return out
+
+    def final_loss(self):
+        return self[-1] if self else None
+
+
 class SameDiff:
     def __init__(self):
         self.vars: Dict[str, SDVariable] = {}
@@ -252,6 +279,7 @@ class SameDiff:
         self._tx = None
         self._jit_cache: Dict[Any, Any] = {}
         self._rng_key = jax.random.PRNGKey(0)
+        self._listeners: List[Any] = []
         self.math = _Namespace(self, _MATH_OPS)
         self.nn = _Namespace(self, _NN_OPS)
         self.cnn = _Namespace(self, _CNN_OPS)
@@ -528,6 +556,13 @@ class SameDiff:
         self._tx = None
         self._opt_state = None
 
+    def set_listeners(self, *listeners) -> None:
+        """Training listeners (reference ``sd.setListeners``): objects with
+        ``iteration_done(sd, iteration, epoch, loss)`` called per batch.
+        Note: reading ``loss`` forces a device sync; listeners receive the
+        on-device scalar and may keep it lazy."""
+        self._listeners = list(listeners)
+
     def _trainable(self) -> Dict[str, jax.Array]:
         return {n: a for n, a in self.arrays.items()
                 if self.vars[n].vtype == VariableType.VARIABLE}
@@ -603,7 +638,9 @@ class SameDiff:
             self._jit_cache[key] = self._make_train_step(ph_names)
         step = self._jit_cache[key]
         history = []
-        for _ in range(int(epochs)):
+        bounds = []
+        it_count = 0
+        for ep in range(int(epochs)):
             iterator.reset()
             for batch in iterator:
                 feats = [batch.features] if not isinstance(batch.features, list) else batch.features
@@ -617,8 +654,37 @@ class SameDiff:
                 # pipeline on every step (one full host round-trip per batch
                 # through a remote-device tunnel)
                 history.append(loss)
+                it_count += 1
+                for lst in self._listeners:
+                    lst.iteration_done(self, it_count, ep, loss)
+            bounds.append(it_count)
         self.arrays.update(trainable)
-        return [float(l) for l in history]
+        return History([float(l) for l in history], bounds)
+
+    def evaluate(self, iterator, output_name: str, evaluation=None,
+                 label_index: int = 0):
+        """Evaluate a graph output against the iterator's labels (reference
+        ``sd.evaluate(iterator, outputName, evaluation)``). Feature arrays
+        feed ``training_config.data_set_feature_mapping``; labels go to the
+        evaluation object, not the graph."""
+        if evaluation is None:
+            from deeplearning4j_tpu.evaluation import Evaluation
+            evaluation = Evaluation()
+        cfg = self.training_config
+        if cfg is None or not cfg.data_set_feature_mapping:
+            raise ValueError("evaluate() needs a TrainingConfig with "
+                             "data_set_feature_mapping")
+        iterator.reset()
+        for batch in iterator:
+            feats = [batch.features] if not isinstance(batch.features, list) \
+                else batch.features
+            labs = [batch.labels] if not isinstance(batch.labels, list) \
+                else batch.labels
+            ph = {n: jnp.asarray(a) for n, a in
+                  zip(cfg.data_set_feature_mapping, feats)}
+            pred = self.output(ph, output_name)
+            evaluation.eval(np.asarray(labs[label_index]), np.asarray(pred))
+        return evaluation
 
     def calculate_gradients(self, placeholders: Dict[str, Any],
                             *wrt: str) -> Dict[str, jax.Array]:

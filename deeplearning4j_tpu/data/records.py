@@ -136,6 +136,75 @@ class LineRecordReader(RecordReader):
         self._pos = 0
 
 
+class RegexLineRecordReader(RecordReader):
+    """Parse each line with a regex; groups become the record's columns
+    (reference ``RegexLineRecordReader``)."""
+
+    def __init__(self, regex: str, skip_num_lines: int = 0):
+        import re
+        self._re = re.compile(regex)
+        self.skip = skip_num_lines
+        self._records: List[List[Any]] = []
+        self._pos = 0
+
+    def initialize(self, source: Union[str, Sequence[str]]) -> "RegexLineRecordReader":
+        lines = (open(source).read().splitlines()
+                 if isinstance(source, str) else list(source))
+        self._records = []
+        for line in lines[self.skip:]:
+            m = self._re.match(line)
+            if m is None:
+                raise ValueError(f"Line does not match regex: {line!r}")
+            self._records.append([_maybe_num(g) for g in m.groups()])
+        self._pos = 0
+        return self
+
+    def has_next(self):
+        return self._pos < len(self._records)
+
+    def next(self):
+        r = self._records[self._pos]
+        self._pos += 1
+        return r
+
+    def reset(self):
+        self._pos = 0
+
+
+class JacksonLineRecordReader(RecordReader):
+    """JSON-object-per-line reader (reference ``JacksonLineRecordReader``):
+    ``field_selection`` lists the keys to extract, in column order."""
+
+    def __init__(self, field_selection: Sequence[str]):
+        self.fields = list(field_selection)
+        self._records: List[List[Any]] = []
+        self._pos = 0
+
+    def initialize(self, source: Union[str, Sequence[str]]) -> "JacksonLineRecordReader":
+        import json as _json
+        lines = (open(source).read().splitlines()
+                 if isinstance(source, str) else list(source))
+        self._records = []
+        for line in lines:
+            if not line.strip():
+                continue
+            obj = _json.loads(line)
+            self._records.append([obj.get(f) for f in self.fields])
+        self._pos = 0
+        return self
+
+    def has_next(self):
+        return self._pos < len(self._records)
+
+    def next(self):
+        r = self._records[self._pos]
+        self._pos += 1
+        return r
+
+    def reset(self):
+        self._pos = 0
+
+
 class CSVSequenceRecordReader(RecordReader):
     """One CSV file per sequence (reference ``CSVSequenceRecordReader``).
     ``next()`` returns a list of timestep records."""
@@ -864,6 +933,66 @@ class RecordReaderDataSetIterator(DataSetIterator):
 
     def total_examples(self):
         return len(self._x)
+
+
+class SequenceRecordReaderDataSetIterator(DataSetIterator):
+    """Sequence records -> padded (batch, time, features) DataSets with
+    masks (reference ``SequenceRecordReaderDataSetIterator``).
+    ``align="start"`` (default, reference ALIGN_START) pads at the end;
+    ``align="end"`` (reference ALIGN_END — last-timestep readout) pads at
+    the start. Per-timestep label column -> one-hot labels (B, T, C) with
+    the labels mask mirroring the features mask."""
+
+    def __init__(self, reader: RecordReader, batch_size: int,
+                 label_index: int = -1, num_classes: Optional[int] = None,
+                 regression: bool = False, align: str = "start"):
+        seqs = [s for s in reader]
+        feats, labels = [], []
+        for s in seqs:
+            fs, ls = [], []
+            for r in s:
+                li = label_index if label_index >= 0 else len(r) + label_index
+                fs.append([float(v) for i, v in enumerate(r) if i != li])
+                if regression:
+                    ls.append([float(r[li])])
+                else:
+                    oh = [0.0] * num_classes
+                    oh[int(r[li])] = 1.0
+                    ls.append(oh)
+            feats.append(fs)
+            labels.append(ls)
+        T = max(len(f) for f in feats)
+        nf, nl = len(feats[0][0]), len(labels[0][0])
+        self._x = np.zeros((len(feats), T, nf), np.float32)
+        self._y = np.zeros((len(feats), T, nl), np.float32)
+        self._mask = np.zeros((len(feats), T), np.float32)
+        for i, (f, l) in enumerate(zip(feats, labels)):
+            if align == "end":
+                self._x[i, T - len(f):] = f
+                self._y[i, T - len(l):] = l
+                self._mask[i, T - len(f):] = 1.0
+            else:
+                self._x[i, :len(f)] = f
+                self._y[i, :len(l)] = l
+                self._mask[i, :len(f)] = 1.0
+        self._batch = int(batch_size)
+        self._pos = 0
+
+    def has_next(self):
+        return self._pos < len(self._x)
+
+    def next(self) -> DataSet:
+        sl = slice(self._pos, self._pos + self._batch)
+        self._pos += self._batch
+        return DataSet(self._x[sl], self._y[sl],
+                       features_mask=self._mask[sl],
+                       labels_mask=self._mask[sl])
+
+    def reset(self):
+        self._pos = 0
+
+    def batch(self):
+        return self._batch
 
 
 # ------------------------------------------------------------------ analysis

@@ -134,7 +134,11 @@ def _make_subst_lambda():
 class KerasModelImport:
     @staticmethod
     def import_keras_model_and_weights(path: str):
-        """Returns a MultiLayerNetwork (Sequential) or ComputationGraph."""
+        """Returns a MultiLayerNetwork (Sequential) or ComputationGraph.
+        Keras 2/3 archives load through tf.keras; Keras 1.x H5 files (which
+        modern Keras refuses) go through the legacy dialect parser."""
+        if _is_keras1_h5(path):
+            return _import_keras1_h5(path)
         import tensorflow as tf
         from deeplearning4j_tpu.nn.misc_layers import _LAMBDA_REGISTRY
         lambda_names = _archive_lambda_names(path)
@@ -673,3 +677,166 @@ def _node_key(tensor) -> str:
 def _inbound_tensors(kl):
     inp = kl.input
     return inp if isinstance(inp, list) else [inp]
+
+
+# ------------------------------------------------------------- Keras 1.x
+# (reference: the keras-import module handles both 1.x and 2.x dialects —
+# `org.deeplearning4j.nn.modelimport.keras` KerasLayerConfiguration has
+# per-version field tables. Modern tf.keras refuses 1.x archives entirely,
+# so this path parses the H5 directly.)
+
+
+def _is_keras1_h5(path: str) -> bool:
+    import zipfile
+    if zipfile.is_zipfile(path):
+        return False  # .keras archives are v3
+    try:
+        import h5py
+        with h5py.File(path, "r") as f:
+            ver = f.attrs.get("keras_version", b"")
+            if isinstance(ver, bytes):
+                ver = ver.decode()
+            return str(ver).startswith("1.")
+    except Exception:
+        return False
+
+
+def _k1_act(name):
+    return {"linear": "identity"}.get(name or "linear", name or "identity")
+
+
+def _map_keras1_layer(cls: str, cfg: Dict):
+    """Keras 1.x dialect -> our layer configs (nb_filter/border_mode/
+    subsample/output_dim era field names)."""
+    if cls == "Dense":
+        return DenseLayer(n_out=cfg["output_dim"],
+                          activation=_k1_act(cfg.get("activation")),
+                          has_bias=cfg.get("bias", True))
+    if cls == "Convolution2D":
+        if cfg.get("dim_ordering", "tf") == "th":
+            raise NotImplementedError(
+                "Keras 1 dim_ordering='th' (channels-first) not supported")
+        return ConvolutionLayer(
+            n_out=cfg["nb_filter"],
+            kernel_size=(cfg["nb_row"], cfg["nb_col"]),
+            stride=tuple(cfg.get("subsample", (1, 1))),
+            convolution_mode="same" if cfg.get("border_mode") == "same"
+            else "truncate",
+            activation=_k1_act(cfg.get("activation")),
+            has_bias=cfg.get("bias", True))
+    if cls == "MaxPooling2D" or cls == "AveragePooling2D":
+        return SubsamplingLayer(
+            pooling_type=PoolingType.MAX if cls.startswith("Max")
+            else PoolingType.AVG,
+            kernel_size=tuple(cfg.get("pool_size", (2, 2))),
+            stride=tuple(cfg.get("strides") or cfg.get("pool_size", (2, 2))),
+            convolution_mode="same" if cfg.get("border_mode") == "same"
+            else "truncate")
+    if cls == "Activation":
+        return ActivationLayer(activation=_k1_act(cfg.get("activation")))
+    if cls == "Dropout":
+        return DropoutLayer(dropout=1.0 - cfg.get("p", 0.5))
+    if cls == "Flatten":
+        from deeplearning4j_tpu.nn import FlattenLayer
+        return FlattenLayer()
+    if cls == "Embedding":
+        return EmbeddingSequenceLayer(n_in=cfg["input_dim"],
+                                      n_out=cfg["output_dim"])
+    if cls == "LSTM":
+        if cfg.get("inner_activation", "hard_sigmoid") not in ("hard_sigmoid",
+                                                               "sigmoid"):
+            raise NotImplementedError(
+                f"Keras 1 LSTM inner_activation {cfg['inner_activation']!r}")
+        return LSTM(n_out=cfg["output_dim"],
+                    activation=_k1_act(cfg.get("activation", "tanh")),
+                    gate_activation=cfg.get("inner_activation", "hard_sigmoid"))
+    if cls == "GRU":
+        # Keras 1 GRU is the reset-BEFORE variant (tanh(x_h + (r*h) @ U_h))
+        # with hard_sigmoid gates; our GRU is the reset-after/CuDNN cell —
+        # importing the weights would load without error but compute a
+        # different function, so refuse loudly.
+        raise NotImplementedError(
+            "Keras 1 GRU uses the reset-before cell variant, which this "
+            "framework's GRU does not implement; re-export the model with "
+            "Keras 2+ (reset_after=True) or use an LSTM")
+    raise NotImplementedError(
+        f"Keras 1 layer {cls!r} not mapped; extend keras_import.py")
+
+
+def _keras1_input_type(first_cfg: Dict, first_cls: str):
+    shape = first_cfg.get("batch_input_shape")
+    if shape is None:
+        raise ValueError("Keras 1 model lacks batch_input_shape on layer 0")
+    dims = [d for d in shape[1:]]
+    if len(dims) == 3:
+        return InputType.convolutional(dims[0], dims[1], dims[2])
+    if len(dims) == 2:
+        return InputType.recurrent(dims[1], dims[0])
+    return InputType.feed_forward(dims[0])
+
+
+def _import_keras1_h5(path: str):
+    import dataclasses as _dc
+    import json
+
+    import h5py
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
+
+    with h5py.File(path, "r") as f:
+        raw = f.attrs["model_config"]
+        if isinstance(raw, bytes):
+            raw = raw.decode()
+        mc = json.loads(raw)
+        if isinstance(mc, dict) and mc.get("class_name") not in (None, "Sequential"):
+            raise NotImplementedError(
+                "Keras 1 import supports Sequential models")
+        layer_cfgs = mc["config"] if isinstance(mc, dict) else mc
+
+        mapped = [(lc["class_name"], lc["config"],
+                   _map_keras1_layer(lc["class_name"], lc["config"]))
+                  for lc in layer_cfgs]
+
+        it0 = _keras1_input_type(layer_cfgs[0]["config"],
+                                 layer_cfgs[0]["class_name"])
+        b = NeuralNetConfiguration.builder().list()
+        for _, _, layer in mapped:
+            b = b.layer(layer)
+        conf = b.set_input_type(it0).build()
+        net = MultiLayerNetwork(conf).init()
+
+        # weights: keras 1 stores one group per layer with a weight_names attr
+        wroot = f["model_weights"] if "model_weights" in f else f
+        params = dict(net.train_state.params)
+        for li, (cls, cfg, _) in enumerate(mapped):
+            name = cfg.get("name")
+            key = f"layer_{li}"
+            if name not in wroot:
+                continue
+            g = wroot[name]
+            wnames = [n.decode() if isinstance(n, bytes) else n
+                      for n in g.attrs.get("weight_names", [])]
+            arrs = [np.asarray(g[n]) for n in wnames] if wnames else \
+                [np.asarray(g[n]) for n in sorted(g.keys())]
+            if not arrs:
+                continue
+            p = dict(params.get(key, {}))
+            if cls in ("Dense", "Convolution2D"):
+                # keras 1 tf-ordering conv kernels are (rows, cols, in, out)
+                # == our HWIO; Dense is (in, out) == ours
+                p["W"] = jnp.asarray(arrs[0])
+                if len(arrs) > 1:
+                    p["b"] = jnp.asarray(arrs[1])
+            elif cls == "Embedding":
+                p["W"] = jnp.asarray(arrs[0])
+            elif cls == "LSTM" and len(arrs) == 12:
+                # keras 1 stores PER-GATE matrices [W_i,U_i,b_i, W_c,U_c,b_c,
+                # W_f,U_f,b_f, W_o,U_o,b_o]; ours packs [i, f, g(c), o]
+                Wi, Ui, bi, Wc, Uc, bc, Wf, Uf, bf, Wo, Uo, bo = arrs
+                p["W"] = jnp.asarray(np.concatenate([Wi, Wf, Wc, Wo], 1))
+                p["W_rec"] = jnp.asarray(np.concatenate([Ui, Uf, Uc, Uo], 1))
+                p["b"] = jnp.asarray(np.concatenate([bi, bf, bc, bo]))
+            params[key] = p
+        net.train_state = _dc.replace(net.train_state, params=params)
+    return net

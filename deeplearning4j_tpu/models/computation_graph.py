@@ -328,6 +328,12 @@ class ComputationGraph:
             x = pp.pre_process(x)
         mask = None if masks is None else masks.get(name)
         lrng = jax.random.fold_in(rng, i) if rng is not None else None
+        if training and getattr(node.obj, "weight_noise", None) is not None:
+            from deeplearning4j_tpu.nn.constraints import apply_weight_noise
+            params = dict(params)
+            params[name] = apply_weight_noise(
+                node.obj, params.get(name, {}),
+                None if lrng is None else jax.random.fold_in(lrng, 7919))
         if name in output_set and hasattr(node.obj, "compute_loss"):
             # apply input dropout ONCE; loss and forward share the result
             x = node.obj._apply_input_dropout(x, node.obj._g, training, lrng)
@@ -524,13 +530,29 @@ class ComputationGraph:
         return total
 
     # ------------------------------------------------------------ train/fit
+    def _apply_constraints(self, params):
+        """Post-update projections (reference applyConstraints)."""
+        from deeplearning4j_tpu.nn.constraints import apply_layer_constraints
+        layer_nodes = [n for n in self.conf.topo_order
+                       if self.conf.node(n).kind == "layer"]
+        if not any(getattr(self.conf.node(n).obj, "constraints", None)
+                   or getattr(self.conf.node(n).obj, "bias_constraints", None)
+                   for n in layer_nodes):
+            return params
+        out = dict(params)
+        for n in layer_nodes:
+            if n in out:
+                out[n] = apply_layer_constraints(self.conf.node(n).obj, out[n])
+        return out
+
     def _make_train_step(self):
         def step(ts: TrainState, inputs, labels, rng, masks):
             (loss, (new_state, _)), grads = jax.value_and_grad(
                 self._loss, has_aux=True)(
                 ts.params, ts.model_state, inputs, labels, rng, masks)
             updates, new_opt = self._tx.update(grads, ts.opt_state, ts.params)
-            new_params = optax.apply_updates(ts.params, updates)
+            new_params = self._apply_constraints(
+                optax.apply_updates(ts.params, updates))
             return TrainState(params=new_params, model_state=new_state,
                               opt_state=new_opt, step=ts.step + 1), loss
 

@@ -170,6 +170,11 @@ class MultiLayerNetwork:
             p = params.get(k, {})
             s = model_state.get(k, {})
             lrng = jax.random.fold_in(rng, i) if rng is not None else None
+            if training and getattr(layer, "weight_noise", None) is not None:
+                from deeplearning4j_tpu.nn.constraints import apply_weight_noise
+                p = apply_weight_noise(
+                    layer, p,
+                    None if lrng is None else jax.random.fold_in(lrng, 7919))
             if i == n - 1 and hasattr(layer, "compute_loss"):
                 x = layer._apply_input_dropout(x, layer._g, training, lrng)
                 last_input = x
@@ -249,12 +254,28 @@ class MultiLayerNetwork:
         return total
 
     # ------------------------------------------------------------ train step
+    def _apply_constraints(self, params):
+        """Post-update projections (reference applyConstraints) — pure ops
+        inside the same compiled step."""
+        from deeplearning4j_tpu.nn.constraints import apply_layer_constraints
+        if not any(getattr(l, "constraints", None)
+                   or getattr(l, "bias_constraints", None)
+                   for l in self.layers):
+            return params
+        out = dict(params)
+        for i, layer in enumerate(self.layers):
+            k = _layer_key(i, layer)
+            if k in out:
+                out[k] = apply_layer_constraints(layer, out[k])
+        return out
+
     def _make_train_step(self):
         def train_step(ts: TrainState, x, y, rng, fmask, lmask):
             (loss, (new_state, _)), grads = jax.value_and_grad(self._loss, has_aux=True)(
                 ts.params, ts.model_state, x, y, rng, fmask, lmask)
             updates, new_opt = self._tx.update(grads, ts.opt_state, ts.params)
-            new_params = optax.apply_updates(ts.params, updates)
+            new_params = self._apply_constraints(
+                optax.apply_updates(ts.params, updates))
             return TrainState(params=new_params, model_state=new_state,
                               opt_state=new_opt, step=ts.step + 1), loss
 

@@ -9,6 +9,14 @@ network forward composes into one XLA program.
 """
 
 from deeplearning4j_tpu.nn.base import GlobalConfig, Layer, get_layer_class, register_layer
+from deeplearning4j_tpu.nn.constraints import (
+    DropConnect,
+    MaxNormConstraint,
+    MinMaxNormConstraint,
+    NonNegativeConstraint,
+    UnitNormConstraint,
+    WeightNoise,
+)
 from deeplearning4j_tpu.nn.inputs import InputType
 from deeplearning4j_tpu.nn.config import (
     ListBuilder,
@@ -134,6 +142,12 @@ __all__ = [
     "LocallyConnected1D",
     "LocallyConnected2D",
     "FlattenLayer",
+    "MaxNormConstraint",
+    "MinMaxNormConstraint",
+    "UnitNormConstraint",
+    "NonNegativeConstraint",
+    "DropConnect",
+    "WeightNoise",
     "PermuteLayer",
     "SeparableConvolution1D",
     "Subsampling1DLayer",

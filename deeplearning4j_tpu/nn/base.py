@@ -114,6 +114,11 @@ class Layer:
     dropout: Optional[float] = None  # retain probability applied to layer INPUT
     updater: Any = None
     frozen: bool = False  # transfer-learning: exclude params from training
+    # Post-update projections (reference LayerConstraint) and train-time
+    # weight perturbation (reference IWeightNoise / DropConnect)
+    constraints: Any = None
+    bias_constraints: Any = None
+    weight_noise: Any = None
     # GlobalConfig attached by the network at build time (not serialized) so
     # forward() needs no extra argument.
     _g: Any = dataclasses.field(default=None, repr=False, compare=False)
@@ -175,6 +180,8 @@ class Layer:
                 v = v.to_dict() if hasattr(v, "to_dict") else dataclasses.asdict(v)
             elif hasattr(v, "to_dict"):
                 v = v.to_dict()
+            elif isinstance(v, (list, tuple)) and v and hasattr(v[0], "to_dict"):
+                v = [e.to_dict() for e in v]
             d[f.name] = v
         return d
 
@@ -195,6 +202,17 @@ class Layer:
             if k == "updater" and isinstance(v, dict):
                 from deeplearning4j_tpu.train.updaters import Updater
                 v = Updater.from_dict(v)
+            elif k in ("constraints", "bias_constraints") and v is not None:
+                from deeplearning4j_tpu.nn.constraints import Constraint
+                vs = v if isinstance(v, list) else [v]
+                v = [Constraint.from_dict(e) if isinstance(e, dict) else e
+                     for e in vs]
+            elif k == "weight_noise" and isinstance(v, dict):
+                from deeplearning4j_tpu.nn.constraints import (DropConnect,
+                                                               WeightNoise)
+                v = (DropConnect if v.get("type") == "DropConnect"
+                     else WeightNoise)(**{a: b for a, b in v.items()
+                                          if a != "type"})
             kwargs[k] = v
         return target(**kwargs)
 

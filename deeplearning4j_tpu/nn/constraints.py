@@ -1,0 +1,170 @@
+"""Weight constraints + weight noise (reference
+``org.deeplearning4j.nn.conf.constraint.*`` — MaxNormConstraint,
+MinMaxNormConstraint, UnitNormConstraint, NonNegativeConstraint — and
+``org.deeplearning4j.nn.conf.weightnoise.{DropConnect,WeightNoise}``).
+
+Constraints are projections applied to parameters AFTER each updater step
+(the reference applies them in ``BaseLayer.applyConstraints``); inside our
+jitted train step they are pure ops fused into the same program. Weight
+noise perturbs the weights seen by the forward pass during training only
+(DropConnect = Bernoulli mask on weights, the reference's formulation).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Constraint:
+    """Projection applied to a parameter after each update."""
+
+    def apply(self, w):
+        raise NotImplementedError
+
+    def to_dict(self):
+        d = {"type": type(self).__name__}
+        d.update({f.name: getattr(self, f.name)
+                  for f in dataclasses.fields(self)})
+        return d
+
+    @staticmethod
+    def from_dict(d):
+        cls = _CONSTRAINTS[d["type"]]
+        kw = {k: v for k, v in d.items() if k != "type"}
+        return cls(**kw)
+
+
+def _norms(w, axes):
+    if axes is None:
+        return jnp.sqrt(jnp.sum(w * w))
+    return jnp.sqrt(jnp.sum(w * w, axis=tuple(axes), keepdims=True))
+
+
+@dataclasses.dataclass
+class MaxNormConstraint(Constraint):
+    """Scale weights down so the norm over ``axes`` is <= max_norm."""
+
+    max_norm: float = 1.0
+    axes: Optional[Sequence[int]] = (0,)
+
+    def apply(self, w):
+        n = _norms(w, self.axes)
+        scale = jnp.minimum(1.0, self.max_norm / jnp.maximum(n, 1e-12))
+        return w * scale
+
+
+@dataclasses.dataclass
+class MinMaxNormConstraint(Constraint):
+    """Clamp the norm over ``axes`` into [min_norm, max_norm] with
+    interpolation ``rate`` (reference MinMaxNormConstraint)."""
+
+    min_norm: float = 0.0
+    max_norm: float = 1.0
+    rate: float = 1.0
+    axes: Optional[Sequence[int]] = (0,)
+
+    def apply(self, w):
+        n = _norms(w, self.axes)
+        clipped = jnp.clip(n, self.min_norm, self.max_norm)
+        target = self.rate * clipped + (1.0 - self.rate) * n
+        return w * (target / jnp.maximum(n, 1e-12))
+
+
+@dataclasses.dataclass
+class UnitNormConstraint(Constraint):
+    axes: Optional[Sequence[int]] = (0,)
+
+    def apply(self, w):
+        return w / jnp.maximum(_norms(w, self.axes), 1e-12)
+
+
+@dataclasses.dataclass
+class NonNegativeConstraint(Constraint):
+    def apply(self, w):
+        return jnp.maximum(w, 0.0)
+
+
+_CONSTRAINTS = {c.__name__: c for c in
+                (MaxNormConstraint, MinMaxNormConstraint, UnitNormConstraint,
+                 NonNegativeConstraint)}
+
+
+def apply_layer_constraints(layer, layer_params):
+    """Project one layer's params per its constraint config (weights via
+    ``constraints``, biases via ``bias_constraints``)."""
+    cs = getattr(layer, "constraints", None)
+    bcs = getattr(layer, "bias_constraints", None)
+    if not cs and not bcs:
+        return layer_params
+    wkeys = set(layer.regularizable_params())
+    out = dict(layer_params)
+    for k, v in layer_params.items():
+        if not isinstance(v, jax.Array):
+            continue
+        active = cs if k in wkeys else (bcs if k == "b" else None)
+        if active:
+            for c in (active if isinstance(active, (list, tuple)) else [active]):
+                v = c.apply(v)
+            out[k] = v
+    return out
+
+
+# ------------------------------------------------------------ weight noise
+@dataclasses.dataclass
+class DropConnect:
+    """Bernoulli mask on WEIGHTS during training (reference ``DropConnect``;
+    ``p`` is the retain probability, matching our dropout convention)."""
+
+    p: float = 0.5
+    apply_to_bias: bool = False
+
+    def apply(self, key, w):
+        keep = jax.random.bernoulli(key, self.p, w.shape)
+        return jnp.where(keep, w / self.p, 0.0).astype(w.dtype)
+
+    def to_dict(self):
+        return {"type": "DropConnect", "p": self.p,
+                "apply_to_bias": self.apply_to_bias}
+
+
+@dataclasses.dataclass
+class WeightNoise:
+    """Additive (or multiplicative) gaussian noise on weights during
+    training (reference ``WeightNoise`` with a Normal distribution)."""
+
+    stddev: float = 0.01
+    mean: float = 0.0
+    additive: bool = True
+    apply_to_bias: bool = False
+
+    def apply(self, key, w):
+        noise = (self.mean
+                 + self.stddev * jax.random.normal(key, w.shape)).astype(w.dtype)
+        return w + noise if self.additive else w * noise
+
+    def to_dict(self):
+        return {"type": "WeightNoise", "stddev": self.stddev,
+                "mean": self.mean, "additive": self.additive,
+                "apply_to_bias": self.apply_to_bias}
+
+
+def apply_weight_noise(layer, layer_params, rng):
+    """Perturb the weights a training forward sees (no-op at inference)."""
+    wn = getattr(layer, "weight_noise", None)
+    if wn is None or rng is None:
+        return layer_params
+    wkeys = set(layer.regularizable_params())
+    out = dict(layer_params)
+    i = 0
+    for k in sorted(layer_params):
+        v = layer_params[k]
+        if not isinstance(v, jax.Array):
+            continue
+        if k in wkeys or (k == "b" and wn.apply_to_bias):
+            out[k] = wn.apply(jax.random.fold_in(rng, i), v)
+        i += 1
+    return out

@@ -199,6 +199,7 @@ class LocallyConnected2D(Layer):
         return params, {}
 
     def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        x = self._apply_input_dropout(x, self._g, training, rng)
         kh, kw, sh, sw, _, _ = self._geom(
             InputType.convolutional(x.shape[1], x.shape[2], x.shape[3]))
         y = lax.conv_general_dilated_local(
@@ -351,6 +352,7 @@ class LocallyConnected1D(Layer):
         return params, {}
 
     def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        x = self._apply_input_dropout(x, self._g, training, rng)
         it = InputType.recurrent(x.shape[2], x.shape[1])
         k, s, _ = self._geom(it)
         y = lax.conv_general_dilated_local(

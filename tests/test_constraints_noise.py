@@ -1,0 +1,83 @@
+"""Weight constraints + weight noise (reference LayerConstraint /
+IWeightNoise-DropConnect; SURVEY §2.2 dl4j-nn configuration row)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn import (DenseLayer, DropConnect, InputType,
+                                   MaxNormConstraint, NeuralNetConfiguration,
+                                   NonNegativeConstraint, OutputLayer,
+                                   UnitNormConstraint, WeightNoise)
+from deeplearning4j_tpu.train import Adam
+
+
+def _data(n=64):
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 3, n)
+    x = (np.eye(3)[y] @ rng.normal(0, 1, (3, 8)) * 3
+         + rng.normal(0, .3, (n, 8))).astype(np.float32)
+    return x, np.eye(3, dtype=np.float32)[y]
+
+
+def _fit(layer0, epochs=3):
+    x, y = _data()
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(5e-2)).list()
+            .layer(layer0)
+            .layer(OutputLayer(n_out=3, activation="softmax"))
+            .set_input_type(InputType.feed_forward(8)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(x, y, epochs=epochs)
+    return net
+
+
+def test_max_norm_constraint_enforced_after_updates():
+    net = _fit(DenseLayer(n_out=16, activation="relu",
+                          constraints=[MaxNormConstraint(0.5, axes=(0,))]))
+    W = np.asarray(net.params()["layer_0"]["W"])
+    col_norms = np.linalg.norm(W, axis=0)
+    assert (col_norms <= 0.5 + 1e-5).all(), col_norms.max()
+
+
+def test_unit_norm_and_nonnegative():
+    net = _fit(DenseLayer(n_out=16, activation="relu",
+                          constraints=[UnitNormConstraint(axes=(0,))],
+                          bias_constraints=[NonNegativeConstraint()]))
+    p = net.params()["layer_0"]
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(p["W"]), axis=0),
+                               1.0, rtol=1e-5)
+    assert (np.asarray(p["b"]) >= 0).all()
+
+
+def test_dropconnect_trains_and_is_deterministic_at_inference():
+    from deeplearning4j_tpu.data import NumpyDataSetIterator
+    net = _fit(DenseLayer(n_out=16, activation="relu",
+                          weight_noise=DropConnect(p=0.7)), epochs=5)
+    x, y = _data()
+    out1 = np.asarray(net.output(x[:8]))
+    out2 = np.asarray(net.output(x[:8]))
+    np.testing.assert_array_equal(out1, out2)  # noise is train-only
+    acc = net.evaluate(NumpyDataSetIterator(x, y, batch_size=64)).accuracy()
+    assert acc > 0.8, acc
+
+
+def test_weight_noise_gaussian_changes_training_but_not_inference():
+    net = _fit(DenseLayer(n_out=16, activation="relu",
+                          weight_noise=WeightNoise(stddev=0.05)), epochs=2)
+    x, _ = _data()
+    np.testing.assert_array_equal(np.asarray(net.output(x[:4])),
+                                  np.asarray(net.output(x[:4])))
+
+
+def test_constraints_json_roundtrip():
+    from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2)).list()
+            .layer(DenseLayer(n_out=4, constraints=[MaxNormConstraint(2.0)],
+                              weight_noise=DropConnect(p=0.9)))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.feed_forward(3)).build())
+    js = conf.to_json()
+    conf2 = MultiLayerConfiguration.from_json(js)
+    c = conf2.layers[0].constraints[0]
+    assert type(c).__name__ == "MaxNormConstraint" and c.max_norm == 2.0
+    assert conf2.layers[0].weight_noise.p == 0.9
+    assert conf2.to_json() == js

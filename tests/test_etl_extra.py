@@ -125,3 +125,72 @@ def test_analyze_local():
     st = an.column_analysis("name")
     assert st.count_missing == 1 and st.count_unique == 3
     assert "amount" in str(an)
+
+
+def test_regex_and_jackson_line_readers():
+    """RegexLineRecordReader (groups -> columns) and JacksonLineRecordReader
+    (JSON-lines field selection) — reference datavec readers."""
+    from deeplearning4j_tpu.data.records import (JacksonLineRecordReader,
+                                                 RegexLineRecordReader)
+    rr = RegexLineRecordReader(r"(\d+)-(\w+)-([\d.]+)").initialize(
+        ["1-alpha-2.5", "2-beta-3.75"])
+    recs = [r for r in rr]
+    assert recs == [[1, "alpha", 2.5], [2, "beta", 3.75]]
+    rr.reset()
+    assert rr.has_next()
+
+    jr = JacksonLineRecordReader(["name", "score"]).initialize(
+        ['{"name": "a", "score": 1.5, "extra": 0}', '{"score": 2.0, "name": "b"}'])
+    assert [r for r in jr] == [["a", 1.5], ["b", 2.0]]
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="does not match"):
+        RegexLineRecordReader(r"(\d+)").initialize(["abc"])
+
+
+def test_sequence_record_reader_dataset_iterator(tmp_path):
+    """CSVSequenceRecordReader -> padded sequence DataSets with masks."""
+    from deeplearning4j_tpu.data.records import (
+        CSVSequenceRecordReader, SequenceRecordReaderDataSetIterator)
+    p1 = tmp_path / "s1.csv"
+    p1.write_text("0.1,0.2,0\n0.3,0.4,1\n0.5,0.6,1\n")
+    p2 = tmp_path / "s2.csv"
+    p2.write_text("0.7,0.8,0\n0.9,1.0,1\n")
+    rr = CSVSequenceRecordReader().initialize([str(p1), str(p2)])
+    it = SequenceRecordReaderDataSetIterator(rr, batch_size=2,
+                                             label_index=-1, num_classes=2)
+    ds = it.next()
+    assert ds.features.shape == (2, 3, 2)
+    assert ds.labels.shape == (2, 3, 2)
+    np.testing.assert_array_equal(ds.features_mask, [[1, 1, 1], [1, 1, 0]])
+    np.testing.assert_array_equal(ds.features[1, 2], [0.0, 0.0])  # padded
+    np.testing.assert_array_equal(ds.labels[0, 1], [0.0, 1.0])
+
+    # and it trains a masked RNN end-to-end
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn import (InputType, LSTM,
+                                       NeuralNetConfiguration, RnnOutputLayer)
+    from deeplearning4j_tpu.train import Adam
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2)).list()
+            .layer(LSTM(n_out=8))
+            .layer(RnnOutputLayer(n_out=2, activation="softmax"))
+            .set_input_type(InputType.recurrent(2, 3)).build())
+    net = MultiLayerNetwork(conf).init()
+    it.reset()
+    net.fit(it, epochs=2)
+
+
+def test_sequence_iterator_align_end(tmp_path):
+    from deeplearning4j_tpu.data.records import (
+        CSVSequenceRecordReader, SequenceRecordReaderDataSetIterator)
+    p1 = tmp_path / "a.csv"
+    p1.write_text("1,2,0\n3,4,1\n5,6,1\n")
+    p2 = tmp_path / "b.csv"
+    p2.write_text("7,8,0\n")
+    rr = CSVSequenceRecordReader().initialize([str(p1), str(p2)])
+    it = SequenceRecordReaderDataSetIterator(rr, 2, label_index=-1,
+                                             num_classes=2, align="end")
+    ds = it.next()
+    np.testing.assert_array_equal(ds.features_mask, [[1, 1, 1], [0, 0, 1]])
+    np.testing.assert_array_equal(ds.features[1, 2], [7.0, 8.0])  # at the END
+    np.testing.assert_array_equal(ds.features[1, 0], [0.0, 0.0])

@@ -520,3 +520,51 @@ def test_keras_functional_dot_minimum(tmp_path):
     x = np.random.default_rng(4).normal(0, 1, (5, 8)).astype(np.float32)
     np.testing.assert_allclose(np.asarray(net.output(x)), km(x).numpy(),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_keras1_h5_dialect_import(tmp_path):
+    """Keras 1.x H5 archives (nb_filter/border_mode/output_dim era) import
+    through the legacy dialect parser — modern Keras refuses these files
+    entirely, so the oracle is a manual numpy forward."""
+    import h5py
+    import json
+    from deeplearning4j_tpu.imports import KerasModelImport
+
+    rng = np.random.default_rng(0)
+    W1 = rng.normal(0, 0.5, (6, 10)).astype(np.float32)
+    b1 = rng.normal(0, 0.1, (10,)).astype(np.float32)
+    W2 = rng.normal(0, 0.5, (10, 3)).astype(np.float32)
+    b2 = np.zeros(3, np.float32)
+
+    model_config = {
+        "class_name": "Sequential",
+        "config": [
+            {"class_name": "Dense",
+             "config": {"name": "dense_1", "output_dim": 10,
+                        "activation": "relu", "batch_input_shape": [None, 6]}},
+            {"class_name": "Dense",
+             "config": {"name": "dense_2", "output_dim": 3,
+                        "activation": "softmax"}},
+        ],
+    }
+    path = str(tmp_path / "k1.h5")
+    with h5py.File(path, "w") as f:
+        f.attrs["keras_version"] = np.bytes_(b"1.2.2")
+        f.attrs["model_config"] = np.bytes_(json.dumps(model_config).encode())
+        mw = f.create_group("model_weights")
+        g1 = mw.create_group("dense_1")
+        g1.attrs["weight_names"] = [np.bytes_(b"dense_1_W"), np.bytes_(b"dense_1_b")]
+        g1.create_dataset("dense_1_W", data=W1)
+        g1.create_dataset("dense_1_b", data=b1)
+        g2 = mw.create_group("dense_2")
+        g2.attrs["weight_names"] = [np.bytes_(b"dense_2_W"), np.bytes_(b"dense_2_b")]
+        g2.create_dataset("dense_2_W", data=W2)
+        g2.create_dataset("dense_2_b", data=b2)
+
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    x = rng.normal(0, 1, (5, 6)).astype(np.float32)
+    h = np.maximum(x @ W1 + b1, 0)
+    logits = h @ W2 + b2
+    expected = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    np.testing.assert_allclose(np.asarray(net.output(x)), expected,
+                               rtol=1e-5, atol=1e-6)

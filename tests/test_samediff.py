@@ -240,3 +240,50 @@ def test_sd_linalg_bitwise_random_image_namespaces():
     sd6 = SameDiff.create()
     g = sd6.image.rgb_to_grayscale(sd6.constant("i", np.ones((1, 2, 2, 3), np.float32)))
     assert sd6.output({}, g.name).shape == (1, 2, 2, 1)
+
+
+def test_fit_history_listeners_and_evaluate():
+    """sd.fit returns a History (loss/epoch curves), dispatches listeners,
+    and sd.evaluate scores a graph output (reference SameDiff training API)."""
+    import numpy as np
+    from deeplearning4j_tpu.autodiff.samediff import (History, SameDiff,
+                                                      TrainingConfig)
+    from deeplearning4j_tpu.data import NumpyDataSetIterator
+    from deeplearning4j_tpu.evaluation import Evaluation
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    rng = np.random.default_rng(0)
+    yc = rng.integers(0, 3, 120)
+    x = (np.eye(3)[yc] @ rng.normal(0, 1, (3, 6)) * 2
+         + rng.normal(0, 0.3, (120, 6))).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[yc]
+
+    sd = SameDiff.create()
+    xin = sd.placeholder("x", (None, 6))
+    w = sd.var("w", (6, 3))
+    b = sd.var("b", array=np.zeros(3, np.float32))
+    logits = sd.invoke("linear", xin, w, b, name="logits")
+    probs = sd.nn.softmax(logits, name="probs")
+    labels = sd.placeholder("labels", (None, 3))
+    sd.loss.softmax_cross_entropy("loss", labels, logits)
+    sd.set_loss_variables("loss")
+    sd.set_training_config(TrainingConfig(
+        updater=Adam(5e-2), data_set_feature_mapping=["x"],
+        data_set_label_mapping=["labels"]))
+
+    seen = []
+    class L:
+        def iteration_done(self, sd_, it, ep, loss):
+            seen.append((it, ep))
+    sd.set_listeners(L())
+
+    it = NumpyDataSetIterator(x, y, batch_size=40)
+    hist = sd.fit(it, epochs=4)
+    assert isinstance(hist, History)
+    assert len(hist) == 12 and len(hist.epoch_losses()) == 4
+    assert hist.epoch_losses()[-1] < hist.epoch_losses()[0]
+    assert hist.final_loss() == hist[-1]
+    assert seen[-1] == (12, 3) and len(seen) == 12
+
+    ev = sd.evaluate(it, "probs", Evaluation())
+    assert ev.accuracy() > 0.9
