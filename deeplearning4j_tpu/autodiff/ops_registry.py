@@ -86,7 +86,7 @@ register("relu6")(jax.nn.relu6)
 register("leaky_relu")(lambda a, alpha=0.01: jax.nn.leaky_relu(a, alpha))
 register("elu")(jax.nn.elu)
 register("selu")(jax.nn.selu)
-register("gelu")(jax.nn.gelu)
+register("gelu")(lambda a, approximate=True: jax.nn.gelu(a, approximate=approximate))
 register("softplus")(jax.nn.softplus)
 register("softsign")(jax.nn.soft_sign)
 register("swish")(jax.nn.swish)

@@ -29,9 +29,12 @@ def _tf():
 
 class TFGraphMapper:
     @staticmethod
-    def import_graph(path_or_graphdef, input_shapes: Optional[Dict[str, tuple]] = None
-                     ) -> SameDiff:
-        """Import a frozen .pb file (or a GraphDef proto) into a SameDiff."""
+    def import_graph(path_or_graphdef, input_shapes: Optional[Dict[str, tuple]] = None,
+                     optimize: bool = True) -> SameDiff:
+        """Import a frozen .pb file (or a GraphDef proto) into a SameDiff.
+        ``optimize`` runs the graph-optimizer fusion passes (layernorm/gelu
+        patterns -> fused ops; reference: libnd4j's pre-execution graph
+        optimization)."""
         tf = _tf()
         if isinstance(path_or_graphdef, (str, bytes)):
             gd = tf.compat.v1.GraphDef()
@@ -39,12 +42,19 @@ class TFGraphMapper:
                 gd.ParseFromString(f.read())
         else:
             gd = path_or_graphdef
-        return _GraphImporter(gd, input_shapes or {}).run()
+        sd = _GraphImporter(gd, input_shapes or {}).run()
+        if optimize:
+            from deeplearning4j_tpu.autodiff.graph_optimizer import (
+                optimize as _opt)
+            _opt(sd)
+        return sd
 
     @staticmethod
     def import_saved_model(path: str, signature: str = "serving_default",
-                           input_shapes: Optional[Dict[str, tuple]] = None):
-        """Load a TF2 SavedModel, freeze the named signature, import it.
+                           input_shapes: Optional[Dict[str, tuple]] = None,
+                           optimize: bool = True):
+        """Load a TF2 SavedModel, freeze the named signature, import it
+        (same pipeline as :meth:`import_graph`, optimizer passes included).
         Returns ``(sd, input_names, output_names)`` (the reference's
         SavedModel entry point on TFGraphMapper)."""
         tf = _tf()
@@ -54,7 +64,7 @@ class TFGraphMapper:
         fn = sm.signatures[signature]
         frozen = convert_variables_to_constants_v2(fn)
         gd = frozen.graph.as_graph_def()
-        sd = _GraphImporter(gd, input_shapes or {}).run()
+        sd = TFGraphMapper.import_graph(gd, input_shapes, optimize=optimize)
         inputs = [t.name.split(":")[0] for t in frozen.inputs
                   if t.dtype != tf.resource]
         outputs = [t.name.split(":")[0] for t in frozen.outputs]

@@ -1,0 +1,90 @@
+"""Graph-optimizer pattern fusion (reference: libnd4j graph optimization
+passes before execution, SURVEY §3.2): imported layernorm/gelu subgraphs
+collapse to the fused registry ops with identical outputs."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from deeplearning4j_tpu.autodiff.graph_optimizer import optimize
+from deeplearning4j_tpu.imports import TFGraphMapper
+
+
+def _frozen(fn, specs):
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+    conc = tf.function(fn).get_concrete_function(*specs)
+    frozen = convert_variables_to_constants_v2(conc)
+    return (frozen.graph.as_graph_def(),
+            [t.name.split(":")[0] for t in frozen.inputs],
+            [t.name.split(":")[0] for t in frozen.outputs])
+
+
+def test_layernorm_and_gelu_fusion_preserves_outputs():
+    rng = np.random.default_rng(0)
+    D = 16
+    g = tf.constant(rng.normal(1, 0.1, (D,)).astype(np.float32))
+    b = tf.constant(rng.normal(0, 0.1, (D,)).astype(np.float32))
+
+    def model(x):
+        mean = tf.reduce_mean(x, axis=-1, keepdims=True)
+        var = tf.reduce_mean(tf.math.squared_difference(x, mean), axis=-1,
+                             keepdims=True)
+        y = (x - mean) * tf.math.rsqrt(var + 1e-12) * g + b
+        return 0.5 * y * (1.0 + tf.math.erf(y / np.float32(np.sqrt(2.0))))
+
+    gd, inputs, outputs = _frozen(
+        model, [tf.TensorSpec((4, D), tf.float32, name="x")])
+    x = rng.normal(0, 2, (4, D)).astype(np.float32)
+
+    sd = TFGraphMapper.import_graph(gd, optimize=False)
+    before = np.asarray(sd.output({inputs[0]: x}, outputs[0]))
+    n_before = len(sd.ops)
+    stats = optimize(sd)
+    after = np.asarray(sd.output({inputs[0]: x}, outputs[0]))
+
+    assert stats["layer_norm"] == 1 and stats["gelu_erf"] == 1, stats
+    assert len(sd.ops) < n_before - 8
+    np.testing.assert_allclose(after, before, rtol=1e-5, atol=1e-6)
+    ops = [n.op for n in sd.ops]
+    assert "layer_norm" in ops and "gelu" in ops
+    assert "squared_difference" not in ops and "erf" not in ops
+
+
+def test_fusion_respects_extra_consumers():
+    """A layernorm whose MEAN is also an observable output must NOT fuse."""
+    def model(x):
+        mean = tf.reduce_mean(x, axis=-1, keepdims=True)
+        var = tf.reduce_mean(tf.math.squared_difference(x, mean), axis=-1,
+                             keepdims=True)
+        y = (x - mean) * tf.math.rsqrt(var + 1e-12) * 2.0 + 0.5
+        return y, mean
+
+    gd, inputs, outputs = _frozen(
+        model, [tf.TensorSpec((2, 8), tf.float32, name="x")])
+    sd = TFGraphMapper.import_graph(gd, optimize=False)
+    # mark the mean output as a loss variable = externally observed
+    sd.set_loss_variables(outputs[1])
+    stats = optimize(sd)
+    assert stats["layer_norm"] == 0
+
+
+def test_bert_block_fusion_count():
+    """The full mini-BERT import fuses 2*layers+1 layernorms and `layers`
+    gelus."""
+    from deeplearning4j_tpu.imports.tf_oracles import build_bert_graphdef
+    L = 2
+    gd, inputs, _, _ = build_bert_graphdef(batch=2, seq_len=16, hidden=32,
+                                           layers=L, heads=2, intermediate=64,
+                                           vocab=50)
+    sd = TFGraphMapper.import_graph(gd, optimize=False)
+    from deeplearning4j_tpu.imports.tf_oracles import bert_synthetic_batch
+    ids, types, m, _ = bert_synthetic_batch(2, 16, 50)
+    feeds = dict(zip(inputs, [ids, types, m]))
+    before = np.asarray(sd.output(feeds, "pooled_output"))
+    stats = optimize(sd)
+    after = np.asarray(sd.output(feeds, "pooled_output"))
+    assert stats["layer_norm"] == 2 * L + 1, stats
+    assert stats["gelu_erf"] == L, stats
+    np.testing.assert_allclose(after, before, rtol=1e-4, atol=1e-5)
