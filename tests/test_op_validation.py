@@ -574,3 +574,25 @@ def test_random_shuffle_is_permutation():
     # same multiset of rows, (almost surely) different order for seed 3
     perm_found = {tuple(r) for r in out} == {tuple(r) for r in _A}
     assert perm_found and out.shape == _A.shape
+
+
+def test_ctc_loss_matches_torch():
+    """CTC forward recursion vs torch.nn.functional.ctc_loss (the CPU
+    reference oracle), incl. ragged input and label lengths."""
+    torch = pytest.importorskip("torch")
+
+    rng2 = np.random.default_rng(0)
+    B, T, C, S = 3, 10, 6, 4
+    logits = rng2.normal(0, 1, (B, T, C)).astype(np.float32)
+    lp = jax.nn.log_softmax(jnp.asarray(logits))
+    labels = rng2.integers(1, C, (B, S)).astype(np.int32)
+    il = np.array([10, 8, 10], np.int32)
+    ll = np.array([4, 2, 3], np.int32)
+    ours = float(get_op("ctc_loss")(lp, jnp.asarray(labels),
+                                    jnp.asarray(il), jnp.asarray(ll)))
+    t_lp = torch.log_softmax(torch.tensor(logits), -1).transpose(0, 1)
+    ref = torch.nn.functional.ctc_loss(
+        t_lp, torch.tensor(labels.astype(np.int64)),
+        torch.tensor(il.astype(np.int64)), torch.tensor(ll.astype(np.int64)),
+        blank=0, reduction="none")
+    np.testing.assert_allclose(ours, float(ref.mean()), rtol=1e-5)
