@@ -264,5 +264,118 @@ def fuse_gelu_erf(sd: SameDiff) -> int:
 
 def optimize(sd: SameDiff) -> Dict[str, int]:
     """Run all passes to fixpoint; returns per-pass fusion counts."""
-    stats = {"layer_norm": fuse_layer_norm(sd), "gelu_erf": fuse_gelu_erf(sd)}
+    stats = {"layer_norm": fuse_layer_norm(sd), "gelu_erf": fuse_gelu_erf(sd),
+             "attention": fuse_attention(sd)}
     return stats
+
+
+def _is_padding_bias(sd: SameDiff, prod, name: str) -> bool:
+    """True when `name` provably computes the additive key-padding pattern
+    ((1 - float(mask)) * -LARGE, possibly reshaped): values are exactly 0 or
+    -LARGE, so converting to a boolean mask preserves softmax outputs."""
+    node = prod.get(name)
+    if node is None:
+        return False
+    if node.op in ("reshape", "expand_dims", "identity"):
+        return _is_padding_bias(sd, prod, node.inputs[0])
+    if node.op != "mul" or len(node.inputs) != 2:
+        return False
+    for a, b in (node.inputs, node.inputs[::-1]):
+        c = _const_scalar(sd, b)
+        if c is None or c > -1e3:  # the -10000-style masking constant
+            continue
+        sub = prod.get(a)
+        if sub is None or sub.op != "sub":
+            continue
+        one = _const_scalar(sd, sub.inputs[0])
+        if one is not None and abs(one - 1.0) < 1e-12:
+            src = prod.get(sub.inputs[1])
+            # (1 - cast(mask)) where mask is a graph INPUT (placeholder):
+            # the importer's key-padding contract is a 0/1-valued mask
+            # feed. A cast of a COMPUTED tensor (e.g. a relative-position
+            # score) is not provably {0,1} and must stay additive.
+            if src is not None and src.op == "cast":
+                cast_in = src.inputs[0]
+                through = prod.get(cast_in)
+                while through is not None and through.op in (
+                        "reshape", "expand_dims", "identity"):
+                    cast_in = through.inputs[0]
+                    through = prod.get(cast_in)
+                v = sd.vars.get(cast_in)
+                if v is not None and v.vtype == VariableType.PLACEHOLDER:
+                    return True
+    return False
+
+
+def fuse_attention(sd: SameDiff) -> int:
+    """batch_matmul(q, k, T) * scale [+ bias] -> softmax -> batch_matmul(v)
+    collapses to scaled_dot_product_attention. When the bias is the proven
+    key-padding pattern, the fused op routes through dot_product_attention
+    (Pallas flash kernel for eligible shapes)."""
+    fused = 0
+    while True:
+        prod = _producers(sd)
+        uses = _use_counts(sd)
+
+        def sole(name):
+            return uses.get(name, 0) == 1 and name not in sd.loss_variables
+
+        match = None
+        for bm2 in sd.ops:
+            if bm2.op != "batch_matmul" or bm2.attrs.get("transpose_a") \
+                    or bm2.attrs.get("transpose_b"):
+                continue
+            p_name, v_name = bm2.inputs
+            sm = prod.get(p_name)
+            if sm is None or sm.op != "softmax" or not sole(p_name):
+                continue
+            if sm.attrs.get("axis", -1) != -1:
+                continue  # fused op normalizes the LAST axis only
+            scores_name = sm.inputs[0]
+            scores = prod.get(scores_name)
+            if scores is None or not sole(scores_name):
+                continue
+            bias_name = None
+            if scores.op == "add":
+                sa, sb = scores.inputs
+                # one side is the scaled qk product, the other the bias
+                for cand, other in ((sa, sb), (sb, sa)):
+                    cn = prod.get(cand)
+                    if cn is not None and cn.op in ("div", "mul") \
+                            and sole(cand):
+                        scaled, bias_name = cn, other
+                        break
+                else:
+                    continue
+            elif scores.op in ("div", "mul"):
+                scaled = scores
+            else:
+                continue
+            qk_name = scaled.inputs[0]
+            c = _const_scalar(sd, scaled.inputs[1])
+            if c is None:
+                continue
+            scale = (1.0 / c) if scaled.op == "div" else c
+            bm1 = prod.get(qk_name)
+            if (bm1 is None or bm1.op != "batch_matmul"
+                    or not bm1.attrs.get("transpose_b")
+                    or bm1.attrs.get("transpose_a")
+                    or not sole(qk_name)):
+                continue
+            q_name, k_name = bm1.inputs
+            boolean_bias = (bias_name is not None
+                            and _is_padding_bias(sd, prod, bias_name))
+            dead = [bm1, scaled] + ([scores] if scores is not scaled else []) \
+                + [sm, bm2]
+            inputs = [q_name, k_name, v_name] + (
+                [bias_name] if bias_name is not None else [])
+            match = (dead, inputs, scale, boolean_bias, bm2)
+            break
+        if not match:
+            return fused
+        dead, inputs, scale, boolean_bias, bm2 = match
+        _replace(sd, dead, OpNode(
+            op="scaled_dot_product_attention", inputs=inputs,
+            outputs=list(bm2.outputs),
+            attrs={"scale": scale, "boolean_bias": boolean_bias}))
+        fused += 1

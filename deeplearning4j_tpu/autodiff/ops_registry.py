@@ -1518,3 +1518,33 @@ def _ctc_loss(log_probs, label_seqs, input_lengths, label_lengths, blank=0):
     pA = jnp.where(label_lengths > 0, pA, neg)
     pB = jnp.take_along_axis(alpha, endB[:, None], axis=1)[:, 0]
     return -jnp.mean(jnp.logaddexp(pA, pB))
+
+
+@register("scaled_dot_product_attention")
+def _sdpa(q, k, v, bias=None, scale=None, boolean_bias=False):
+    """softmax(q @ k^T * scale + bias) @ v over (B, H, T, D) operands —
+    the graph-optimizer's fusion target for imported attention subgraphs.
+
+    ``boolean_bias=True`` is set by the fuser only when it PROVED the bias
+    subgraph is the additive key-padding pattern ((1 - mask) * -LARGE), in
+    which case it is converted to a boolean mask and the computation routes
+    through :func:`nn.attention_layers.dot_product_attention` (and from
+    there to the Pallas flash kernel when shapes allow). A general additive
+    bias keeps the exact XLA softmax form."""
+    from deeplearning4j_tpu.nn.attention_layers import dot_product_attention
+    d = q.shape[-1]
+    nat = 1.0 / math.sqrt(d)
+    s = nat if scale is None else float(scale)
+    if q.ndim == 4 and (bias is None or boolean_bias):
+        if not math.isclose(s, nat, rel_tol=1e-6):
+            q = q * jnp.asarray(s / nat, q.dtype)
+        mask = None if bias is None else (bias > jnp.asarray(-1.0, bias.dtype))
+        return dot_product_attention(q, k, v, mask=mask)
+    # rank-agnostic exact form (leading dims are batch; also the general
+    # additive-bias path)
+    scores = jnp.einsum("...qd,...kd->...qk", q, k) * jnp.asarray(s, q.dtype)
+    if bias is not None:
+        scores = scores + (jnp.where(bias > -1.0, 0.0, -1e9).astype(scores.dtype)
+                           if boolean_bias else bias)
+    weights = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("...qk,...kd->...qd", weights, v)

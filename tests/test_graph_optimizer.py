@@ -88,3 +88,76 @@ def test_bert_block_fusion_count():
     assert stats["layer_norm"] == 2 * L + 1, stats
     assert stats["gelu_erf"] == L, stats
     np.testing.assert_allclose(after, before, rtol=1e-4, atol=1e-5)
+
+
+def test_attention_fusion_with_padding_mask():
+    """The imported BERT attention chain (batch_matmul/scale/add-mask/
+    softmax/batch_matmul) fuses to scaled_dot_product_attention with the
+    padding bias PROVEN convertible to a boolean mask — outputs unchanged."""
+    from deeplearning4j_tpu.imports.tf_oracles import (bert_synthetic_batch,
+                                                       build_bert_graphdef)
+    L = 2
+    gd, inputs, _, _ = build_bert_graphdef(batch=2, seq_len=16, hidden=32,
+                                           layers=L, heads=2, intermediate=64,
+                                           vocab=50)
+    sd = TFGraphMapper.import_graph(gd, optimize=False)
+    ids, types, m, _ = bert_synthetic_batch(2, 16, 50)
+    feeds = dict(zip(inputs, [ids, types, m]))
+    before = np.asarray(sd.output(feeds, "pooled_output"))
+    stats = optimize(sd)
+    assert stats["attention"] == L, stats
+    sdpa = [n for n in sd.ops if n.op == "scaled_dot_product_attention"]
+    assert len(sdpa) == L and all(n.attrs["boolean_bias"] for n in sdpa)
+    assert not any(n.op == "softmax" for n in sd.ops)
+    after = np.asarray(sd.output(feeds, "pooled_output"))
+    np.testing.assert_allclose(after, before, rtol=1e-4, atol=1e-5)
+
+
+def test_attention_fusion_general_bias_stays_additive():
+    """A NON-padding additive bias (e.g. relative-position scores) must fuse
+    with boolean_bias=False and keep exact softmax(x+bias) numerics."""
+    rng = np.random.default_rng(0)
+    B, H, T, D = 2, 2, 8, 4
+    bias_np = rng.normal(0, 1, (B, H, T, T)).astype(np.float32)
+    bias_c = tf.constant(bias_np)
+
+    def model(q, k, v):
+        s = tf.matmul(q, k, transpose_b=True) / np.float32(np.sqrt(D))
+        return tf.matmul(tf.nn.softmax(s + bias_c, axis=-1), v)
+
+    spec = [tf.TensorSpec((B, H, T, D), tf.float32, name=n) for n in "qkv"]
+    gd, inputs, outputs = _frozen(model, spec)
+    sd = TFGraphMapper.import_graph(gd, optimize=False)
+    q, k, v = (rng.normal(0, 1, (B, H, T, D)).astype(np.float32)
+               for _ in range(3))
+    feeds = dict(zip(inputs, [q, k, v]))
+    before = np.asarray(sd.output(feeds, outputs[0]))
+    stats = optimize(sd)
+    assert stats["attention"] == 1, stats
+    sdpa = [n for n in sd.ops if n.op == "scaled_dot_product_attention"]
+    assert len(sdpa) == 1 and not sdpa[0].attrs["boolean_bias"]
+    after = np.asarray(sd.output(feeds, outputs[0]))
+    np.testing.assert_allclose(after, before, rtol=1e-5, atol=1e-6)
+
+
+def test_attention_fusion_rank3_single_head():
+    """A single-head (B, T, D) attention chain fuses and still computes
+    correctly (rank-agnostic einsum path)."""
+    rng = np.random.default_rng(1)
+    B, T, D = 2, 8, 4
+
+    def model(q, k, v):
+        s = tf.matmul(q, k, transpose_b=True) / np.float32(np.sqrt(D))
+        return tf.matmul(tf.nn.softmax(s, axis=-1), v)
+
+    spec = [tf.TensorSpec((B, T, D), tf.float32, name=n) for n in "qkv"]
+    gd, inputs, outputs = _frozen(model, spec)
+    sd = TFGraphMapper.import_graph(gd, optimize=False)
+    q, k, v = (rng.normal(0, 1, (B, T, D)).astype(np.float32)
+               for _ in range(3))
+    feeds = dict(zip(inputs, [q, k, v]))
+    before = np.asarray(sd.output(feeds, outputs[0]))
+    stats = optimize(sd)
+    assert stats["attention"] == 1, stats
+    after = np.asarray(sd.output(feeds, outputs[0]))
+    np.testing.assert_allclose(after, before, rtol=1e-5, atol=1e-6)

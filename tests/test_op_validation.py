@@ -463,6 +463,14 @@ CASES = {
                   np.array([[1, 2], [3, 0]], np.int32),
                   np.array([6, 6], np.int32),
                   np.array([2, 1], np.int32)), {}, None, (0,)),
+    "scaled_dot_product_attention": (
+        (_R.normal(0, 1, (2, 2, 8, 4)).astype(np.float32),
+         _R.normal(0, 1, (2, 2, 8, 4)).astype(np.float32),
+         _R.normal(0, 1, (2, 2, 8, 4)).astype(np.float32)), {},
+        lambda q, k, v: (lambda s: (np.exp(s - s.max(-1, keepdims=True))
+                                    / np.exp(s - s.max(-1, keepdims=True))
+                                    .sum(-1, keepdims=True)) @ v)(
+            np.einsum("bhqd,bhkd->bhqk", q, k) / 2.0), (0, 1, 2)),
 }
 
 
