@@ -335,38 +335,55 @@ def fuse_attention(sd: SameDiff) -> int:
             scores = prod.get(scores_name)
             if scores is None or not sole(scores_name):
                 continue
+            def resolve_scaled(node):
+                """-> (qk_name, scale, bm1) for div/mul-by-const of a
+                transpose_b batch_matmul, else None. Checks BOTH operand
+                orders for mul (exporters emit mul(const, qk) too; div's
+                constant is always the divisor)."""
+                orders = [(node.inputs[0], node.inputs[1])]
+                if node.op == "mul":
+                    orders.append((node.inputs[1], node.inputs[0]))
+                for qk_name, c_name in orders:
+                    c = _const_scalar(sd, c_name)
+                    if c is None:
+                        continue
+                    bm1 = prod.get(qk_name)
+                    if (bm1 is None or bm1.op != "batch_matmul"
+                            or not bm1.attrs.get("transpose_b")
+                            or bm1.attrs.get("transpose_a")
+                            or not sole(qk_name)):
+                        continue
+                    return qk_name, (1.0 / c) if node.op == "div" else c, bm1
+                return None
+
             bias_name = None
+            resolved = None
+            scale_node = None
             if scores.op == "add":
                 sa, sb = scores.inputs
-                # one side is the scaled qk product, the other the bias
+                # one side is the scaled qk product, the other the bias;
+                # try BOTH pairings fully (the bias itself may be a mul)
                 for cand, other in ((sa, sb), (sb, sa)):
                     cn = prod.get(cand)
-                    if cn is not None and cn.op in ("div", "mul") \
-                            and sole(cand):
-                        scaled, bias_name = cn, other
+                    if cn is None or cn.op not in ("div", "mul") \
+                            or not sole(cand):
+                        continue
+                    resolved = resolve_scaled(cn)
+                    if resolved is not None:
+                        bias_name = other
+                        scale_node = cn
                         break
-                else:
-                    continue
             elif scores.op in ("div", "mul"):
-                scaled = scores
-            else:
+                resolved = resolve_scaled(scores)
+                scale_node = scores
+            if resolved is None:
                 continue
-            qk_name = scaled.inputs[0]
-            c = _const_scalar(sd, scaled.inputs[1])
-            if c is None:
-                continue
-            scale = (1.0 / c) if scaled.op == "div" else c
-            bm1 = prod.get(qk_name)
-            if (bm1 is None or bm1.op != "batch_matmul"
-                    or not bm1.attrs.get("transpose_b")
-                    or bm1.attrs.get("transpose_a")
-                    or not sole(qk_name)):
-                continue
+            qk_name, scale, bm1 = resolved
             q_name, k_name = bm1.inputs
             boolean_bias = (bias_name is not None
                             and _is_padding_bias(sd, prod, bias_name))
-            dead = [bm1, scaled] + ([scores] if scores is not scaled else []) \
-                + [sm, bm2]
+            dead = [bm1, scale_node] \
+                + ([scores] if scores is not scale_node else []) + [sm, bm2]
             inputs = [q_name, k_name, v_name] + (
                 [bias_name] if bias_name is not None else [])
             match = (dead, inputs, scale, boolean_bias, bm2)

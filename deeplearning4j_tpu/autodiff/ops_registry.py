@@ -1538,7 +1538,14 @@ def _sdpa(q, k, v, bias=None, scale=None, boolean_bias=False):
     if q.ndim == 4 and (bias is None or boolean_bias):
         if not math.isclose(s, nat, rel_tol=1e-6):
             q = q * jnp.asarray(s / nat, q.dtype)
-        mask = None if bias is None else (bias > jnp.asarray(-1.0, bias.dtype))
+        mask = None
+        if bias is not None:
+            mask = bias > jnp.asarray(-1.0, bias.dtype)
+            # a FULLY-masked row's additive form is softmax(x + const) ==
+            # softmax(x); reproduce that exactly by unmasking such rows
+            # (a hard mask would instead give uniform/NaN weights)
+            row_any = jnp.any(mask, axis=-1, keepdims=True)
+            mask = mask | ~row_any
         return dot_product_attention(q, k, v, mask=mask)
     # rank-agnostic exact form (leading dims are batch; also the general
     # additive-bias path)
@@ -1548,3 +1555,269 @@ def _sdpa(q, k, v, bias=None, scale=None, boolean_bias=False):
                            if boolean_bias else bias)
     weights = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("...qk,...kd->...qd", weights, v)
+
+
+# ------------------------------------------------------- registry wave 4
+# (reduce3 distance ops, index accumulations, summary statistics, sequence
+# ops, remaining comparison/loss/matrix families of the declarable set)
+
+
+@register("logical_xor")
+def _logical_xor(a, b):
+    return jnp.logical_xor(a, b)
+
+
+@register("isclose")
+def _isclose(a, b, rtol=1e-5, atol=1e-8):
+    return jnp.isclose(a, b, rtol=rtol, atol=atol)
+
+
+@register("remainder")
+def _remainder(a, b):
+    return jnp.remainder(a, b)
+
+
+@register("trunc")
+def _trunc(a):
+    return jnp.trunc(a)
+
+
+@register("cube")
+def _cube(a):
+    return a * a * a
+
+
+@register("step")
+def _step(a, cutoff=0.0):
+    return (a > cutoff).astype(jnp.float32)
+
+
+@register("hard_tanh")
+def _hard_tanh(a):
+    return jnp.clip(a, -1.0, 1.0)
+
+
+@register("logspace")
+def _logspace(start, stop, num, base=10.0):
+    return jnp.logspace(start, stop, int(num), base=base)
+
+
+# summary statistics (reference SummaryStats ops)
+@register("skewness")
+def _skewness(a, axis=None, keepdims=False):
+    ax = _ax(axis)
+    m = jnp.mean(a, axis=ax, keepdims=True)
+    s = jnp.std(a, axis=ax, keepdims=True)
+    z = (a - m) / jnp.maximum(s, 1e-12)
+    return jnp.mean(z ** 3, axis=ax, keepdims=keepdims)
+
+
+@register("kurtosis")
+def _kurtosis(a, axis=None, keepdims=False):
+    ax = _ax(axis)
+    m = jnp.mean(a, axis=ax, keepdims=True)
+    s = jnp.std(a, axis=ax, keepdims=True)
+    z = (a - m) / jnp.maximum(s, 1e-12)
+    return jnp.mean(z ** 4, axis=ax, keepdims=keepdims) - 3.0
+
+
+# index accumulations (reference IAMax/IAMin/FirstIndex/LastIndex)
+@register("argamax")
+def _argamax(a, axis=-1):
+    return jnp.argmax(jnp.abs(a), axis=axis)
+
+
+@register("argamin")
+def _argamin(a, axis=-1):
+    return jnp.argmin(jnp.abs(a), axis=axis)
+
+
+@register("first_index")
+def _first_index(a, condition, axis=-1):
+    """Index of the first element matching ``condition`` along axis; -1 if
+    none (reference FirstIndex)."""
+    m = condition(a)
+    idx = jnp.argmax(m, axis=axis)
+    any_ = jnp.any(m, axis=axis)
+    return jnp.where(any_, idx, -1).astype(jnp.int32)
+
+
+@register("last_index")
+def _last_index(a, condition, axis=-1):
+    m = condition(a)
+    n = a.shape[axis]
+    idx = n - 1 - jnp.argmax(jnp.flip(m, axis), axis=axis)
+    any_ = jnp.any(m, axis=axis)
+    return jnp.where(any_, idx, -1).astype(jnp.int32)
+
+
+@register("size_at")
+def _size_at(a, dim=0):
+    return jnp.asarray(a.shape[int(dim)], jnp.int32)
+
+
+# reduce3 pairwise distances (reference org.nd4j...ops.impl.reduce3)
+@register("cosine_similarity")
+def _cosine_similarity(a, b, axis=-1, eps=1e-12):
+    num = jnp.sum(a * b, axis=_ax(axis))
+    den = (jnp.sqrt(jnp.sum(a * a, axis=_ax(axis)))
+           * jnp.sqrt(jnp.sum(b * b, axis=_ax(axis))))
+    return num / jnp.maximum(den, eps)
+
+
+@register("euclidean_distance")
+def _euclidean_distance(a, b, axis=-1):
+    d = a - b
+    return jnp.sqrt(jnp.sum(d * d, axis=_ax(axis)))
+
+
+@register("manhattan_distance")
+def _manhattan_distance(a, b, axis=-1):
+    return jnp.sum(jnp.abs(a - b), axis=_ax(axis))
+
+
+@register("hamming_distance")
+def _hamming_distance(a, b, axis=-1):
+    return jnp.sum((a != b).astype(jnp.float32), axis=_ax(axis))
+
+
+@register("jaccard_distance")
+def _jaccard_distance(a, b, axis=-1, eps=1e-12):
+    inter = jnp.sum(jnp.minimum(a, b), axis=_ax(axis))
+    union = jnp.sum(jnp.maximum(a, b), axis=_ax(axis))
+    return 1.0 - inter / jnp.maximum(union, eps)
+
+
+# sequence / matrix utilities
+@register("reverse_sequence")
+def _reverse_sequence(a, seq_lengths, seq_axis=1, batch_axis=0):
+    """Reverse each sequence's first ``seq_lengths[i]`` steps (reference/TF
+    ReverseSequence)."""
+    t = a.shape[seq_axis]
+    idx = jnp.arange(t)
+    lens = seq_lengths.astype(jnp.int32)
+    # per-batch gather indices: reversed inside the length, identity after
+    def gather_one(x, l):
+        g = jnp.where(idx < l, l - 1 - idx, idx)
+        return jnp.take(x, g, axis=seq_axis - 1 if seq_axis > batch_axis else seq_axis)
+    return jax.vmap(gather_one, in_axes=(batch_axis, 0), out_axes=batch_axis)(a, lens)
+
+
+@register("confusion_matrix")
+def _confusion_matrix(labels, predictions, num_classes, weights=None):
+    l = labels.astype(jnp.int32).ravel()
+    p = predictions.astype(jnp.int32).ravel()
+    n = int(num_classes)
+    flat = l * n + p
+    w = jnp.ones_like(flat, jnp.float32) if weights is None \
+        else weights.astype(jnp.float32).ravel()
+    out = jnp.zeros((n * n,), jnp.float32).at[flat].add(w)
+    return out.reshape(n, n)
+
+
+@register("nth_element")
+def _nth_element(a, n, reverse=False):
+    s = jnp.sort(a, axis=-1)
+    if reverse:
+        s = jnp.flip(s, axis=-1)
+    return s[..., int(n)]
+
+
+@register("standardize")
+def _standardize(a, axis=-1, eps=1e-12):
+    m = jnp.mean(a, axis=_ax(axis), keepdims=True)
+    s = jnp.std(a, axis=_ax(axis), keepdims=True)
+    return (a - m) / jnp.maximum(s, eps)
+
+
+@register("matrix_norm")
+def _matrix_norm(a, ord="fro", axis=None):
+    return jnp.linalg.norm(a, ord=ord, axis=axis)
+
+
+@register("lu")
+def _lu(a):
+    """LU with partial pivoting; returns (lu_packed, pivots) like
+    jax.scipy.linalg.lu_factor (reference Lu op)."""
+    import jax.scipy.linalg as jsl
+    lu_, piv = jsl.lu_factor(a)
+    return lu_, piv.astype(jnp.int32)
+
+
+# remaining losses / stochastic ops
+@register("weighted_cross_entropy_with_logits")
+def _wce(labels, logits, pos_weight=1.0):
+    log_w = (1.0 + (pos_weight - 1.0) * labels)
+    return jnp.mean(
+        (1.0 - labels) * logits
+        + log_w * (jnp.log1p(jnp.exp(-jnp.abs(logits)))
+                   + jnp.maximum(-logits, 0.0)))
+
+
+@register("log_poisson_loss")
+def _log_poisson_loss(targets, log_input, compute_full_loss=False):
+    loss = jnp.exp(log_input) - log_input * targets
+    if compute_full_loss:
+        stirling = (targets * jnp.log(jnp.maximum(targets, 1e-12)) - targets
+                    + 0.5 * jnp.log(2.0 * jnp.pi * jnp.maximum(targets, 1.0)))
+        loss = loss + jnp.where(targets > 1, stirling, 0.0)
+    return jnp.mean(loss)
+
+
+@register("random_binomial")
+def _random_binomial(shape=None, n=1, p=0.5, seed=0):
+    import jax
+    return jax.random.binomial(_key(seed), n, p, shape=tuple(shape)
+                               ).astype(jnp.float32)
+
+
+@register("random_lognormal")
+def _random_lognormal(shape=None, mean=0.0, stddev=1.0, seed=0):
+    import jax
+    return jnp.exp(mean + stddev * jax.random.normal(_key(seed), tuple(shape)))
+
+
+@register("alpha_dropout")
+def _alpha_dropout(a, key=None, rate=0.5):
+    """SELU-preserving dropout (reference AlphaDropOut); inference no-op
+    without a key."""
+    if key is None or rate <= 0.0:
+        return a
+    alpha_p = -1.7580993408473766
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, a.shape)
+    x = jnp.where(mask, a, alpha_p)
+    q = keep + alpha_p ** 2 * keep * (1 - keep)
+    scale = q ** -0.5
+    bias = -scale * alpha_p * (1 - keep)
+    return scale * x + bias
+
+
+# boolean structure checks
+@register("is_non_decreasing")
+def _is_non_decreasing(a):
+    f = a.ravel()
+    return jnp.all(f[1:] >= f[:-1]) if f.size > 1 else jnp.asarray(True)
+
+
+@register("is_strictly_increasing")
+def _is_strictly_increasing(a):
+    f = a.ravel()
+    return jnp.all(f[1:] > f[:-1]) if f.size > 1 else jnp.asarray(True)
+
+
+@register("is_numeric_tensor")
+def _is_numeric_tensor(a):
+    return jnp.asarray(jnp.issubdtype(a.dtype, jnp.number))
+
+
+@register("compare_and_set")
+def _compare_and_set(a, compare, set_value, eps=1e-12):
+    """Where |a - compare| <= eps, replace with set_value (reference
+    CompareAndSet)."""
+    return jnp.where(jnp.abs(a - compare) <= eps, set_value, a)
+
+
+@register("replace_nans")
+def _replace_nans(a, value=0.0):
+    return jnp.where(jnp.isnan(a), value, a)

@@ -200,7 +200,8 @@ _RNN_OPS = ["lstm_layer", "gru", "lstm_cell", "gru_cell"]
 _MULTI_OUTPUT_OPS = {"lstm_layer": 3, "gru": 2, "lstm_cell": 2,
                      "svd": 3, "qr": 2, "eigh": 2,
                      "top_k": 2, "unique": 2, "non_max_suppression": 2,
-                     "meshgrid": 2, "moments": 2, "normalize_moments": 2}
+                     "meshgrid": 2, "moments": 2, "normalize_moments": 2,
+                     "lu": 2}
 _LOSS_OPS = ["softmax_cross_entropy", "sparse_softmax_cross_entropy",
              "sigmoid_cross_entropy", "mean_squared_error", "mean_absolute_error",
              "l2_loss", "log_loss", "cosine_distance", "hinge_loss", "huber_loss",

@@ -161,3 +161,45 @@ def test_attention_fusion_rank3_single_head():
     assert stats["attention"] == 1, stats
     after = np.asarray(sd.output(feeds, outputs[0]))
     np.testing.assert_allclose(after, before, rtol=1e-5, atol=1e-6)
+
+
+def test_attention_fusion_fully_masked_row_matches_additive():
+    """An ALL-padding sequence in the batch: softmax(x + const) == softmax(x),
+    so the boolean conversion must reproduce that (not uniform/NaN rows)."""
+    from deeplearning4j_tpu.imports.tf_oracles import build_bert_graphdef
+    gd, inputs, _, _ = build_bert_graphdef(batch=2, seq_len=8, hidden=16,
+                                           layers=1, heads=2, intermediate=32,
+                                           vocab=30)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 30, (2, 8)).astype(np.int32)
+    types = np.zeros((2, 8), np.int32)
+    mask = np.stack([np.ones(8), np.zeros(8)]).astype(np.int32)  # row 2 ALL pad
+    feeds = dict(zip(inputs, [ids, types, mask]))
+    sd0 = TFGraphMapper.import_graph(gd, optimize=False)
+    before = np.asarray(sd0.output(feeds, "pooled_output"))
+    sd1 = TFGraphMapper.import_graph(gd)  # fused (boolean mask path)
+    after = np.asarray(sd1.output(feeds, "pooled_output"))
+    assert np.isfinite(after).all()
+    np.testing.assert_allclose(after, before, rtol=1e-4, atol=1e-5)
+
+
+def test_attention_fusion_mul_const_first():
+    """mul(const, qk) scale spelling also fuses."""
+    rng = np.random.default_rng(2)
+    B, H, T, D = 1, 2, 8, 4
+
+    def model(q, k, v):
+        s = np.float32(1.0 / np.sqrt(D)) * tf.matmul(q, k, transpose_b=True)
+        return tf.matmul(tf.nn.softmax(s, axis=-1), v)
+
+    spec = [tf.TensorSpec((B, H, T, D), tf.float32, name=n) for n in "qkv"]
+    gd, inputs, outputs = _frozen(model, spec)
+    sd = TFGraphMapper.import_graph(gd, optimize=False)
+    q, k, v = (rng.normal(0, 1, (B, H, T, D)).astype(np.float32)
+               for _ in range(3))
+    feeds = dict(zip(inputs, [q, k, v]))
+    before = np.asarray(sd.output(feeds, outputs[0]))
+    stats = optimize(sd)
+    assert stats["attention"] == 1, stats
+    after = np.asarray(sd.output(feeds, outputs[0]))
+    np.testing.assert_allclose(after, before, rtol=1e-5, atol=1e-6)

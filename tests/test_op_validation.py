@@ -471,6 +471,73 @@ CASES = {
                                     / np.exp(s - s.max(-1, keepdims=True))
                                     .sum(-1, keepdims=True)) @ v)(
             np.einsum("bhqd,bhkd->bhqk", q, k) / 2.0), (0, 1, 2)),
+    # wave 4: comparisons / elementwise
+    "logical_xor": ((_A > 0, _B > 0), {}, np.logical_xor, ()),
+    "isclose": ((_A, _A + 1e-7), {}, None, ()),
+    "remainder": ((_A, _P), {}, np.remainder, ()),
+    "trunc": ((_A,), {}, np.trunc, ()),
+    "cube": ((_A,), {}, lambda a: a ** 3, (0,)),
+    "step": ((_A,), {}, lambda a: (a > 0).astype(np.float32), ()),
+    "hard_tanh": ((_A,), {}, lambda a: np.clip(a, -1, 1), ()),
+    "logspace": ((0.0, 2.0, 5), {},
+                 lambda a, b, n: np.logspace(a, b, n).astype(np.float32), ()),
+    # wave 4: summary stats / index accumulations
+    "skewness": ((_A,), {"axis": 1}, None, ()),
+    "kurtosis": ((_A,), {"axis": 1}, None, ()),
+    "argamax": ((_A,), {"axis": 1}, lambda a: np.argmax(np.abs(a), 1), ()),
+    "argamin": ((_A,), {"axis": 1}, lambda a: np.argmin(np.abs(a), 1), ()),
+    "first_index": ((_A, lambda v: v > 0), {"axis": 1}, None, ()),
+    "last_index": ((_A, lambda v: v > 0), {"axis": 1}, None, ()),
+    "size_at": ((_A,), {"dim": 1}, lambda a: np.int32(4), ()),
+    # wave 4: reduce3 distances
+    "cosine_similarity": ((_A, _B), {},
+                          lambda a, b: (a * b).sum(-1)
+                          / (np.linalg.norm(a, axis=-1)
+                             * np.linalg.norm(b, axis=-1)), (0, 1)),
+    "euclidean_distance": ((_A, _B), {},
+                           lambda a, b: np.linalg.norm(a - b, axis=-1), (0, 1)),
+    "manhattan_distance": ((_A, _B), {},
+                           lambda a, b: np.abs(a - b).sum(-1), ()),
+    "hamming_distance": ((_IDX, np.array([2, 1, 1], np.int32)), {},
+                         lambda a, b: np.float32((a != b).sum()), ()),
+    "jaccard_distance": ((_P, np.abs(_B) + 0.5), {},
+                         lambda a, b: 1 - np.minimum(a, b).sum(-1)
+                         / np.maximum(a, b).sum(-1), ()),
+    # wave 4: sequence / matrix utilities
+    "reverse_sequence": ((_A, np.array([2, 4, 1], np.int32)), {},
+                         lambda a, l: np.stack([
+                             np.concatenate([r[:n][::-1], r[n:]])
+                             for r, n in zip(a, l)]), ()),
+    "confusion_matrix": ((np.array([0, 1, 2, 1], np.int32),
+                          np.array([0, 2, 2, 1], np.int32), 3), {},
+                         lambda l, p, n: np.array(
+                             [[1, 0, 0], [0, 1, 1], [0, 0, 1]], np.float32), ()),
+    "nth_element": ((_A, 1), {},
+                    lambda a, n: np.sort(a, -1)[..., 1], ()),
+    "standardize": ((_A,), {},
+                    lambda a: (a - a.mean(-1, keepdims=True))
+                    / a.std(-1, keepdims=True), (0,)),
+    "matrix_norm": ((_A,), {}, lambda a: np.linalg.norm(a), ()),
+    "lu": ((_SPD,), {}, None, ()),
+    # wave 4: losses / stochastic
+    "weighted_cross_entropy_with_logits": (((_LABELS > 0).astype(np.float32),
+                                            _LOGITS), {"pos_weight": 2.0},
+                                           None, (1,)),
+    "log_poisson_loss": ((np.abs(_LABELS), _LOGITS * 0.1), {}, None, (1,)),
+    "random_binomial": (((256,),), {"n": 5, "p": 0.4, "seed": 3}, None, ()),
+    "random_lognormal": (((256,),), {"seed": 3}, None, ()),
+    "alpha_dropout": ((_A,), {"key": jax.random.PRNGKey(0), "rate": 0.3},
+                      None, ()),
+    # wave 4: structure checks
+    "is_non_decreasing": ((np.sort(_A.ravel()),), {},
+                          lambda a: np.bool_(True), ()),
+    "is_strictly_increasing": ((_A,), {}, None, ()),
+    "is_numeric_tensor": ((_A,), {}, lambda a: np.bool_(True), ()),
+    "compare_and_set": ((_A, float(_A[0, 0]), 0.0), {},
+                        None, ()),
+    "replace_nans": ((np.where(_A > 1, np.nan, _A).astype(np.float32),),
+                     {"value": 7.0},
+                     lambda a: np.nan_to_num(a, nan=7.0), ()),
 }
 
 
