@@ -135,6 +135,7 @@ def fuse_layer_norm(sd: SameDiff) -> int:
                             var_node = prod.get(var_name)
                             if (eps is None or var_node is None
                                     or var_node.op != "reduce_mean"
+                                    or not var_node.attrs.get("keepdims")
                                     or not _is_last_axis(var_node.attrs.get("axis"))
                                     or not sole(var_name)):
                                 continue

@@ -986,17 +986,17 @@ def _truncated_normal(shape=None, mean=0.0, stddev=1.0, seed=0):
 @register("random_categorical")
 def _random_categorical(logits, num_samples=1, seed=0):
     import jax
-    return jax.random.categorical(
+    return jnp.moveaxis(jax.random.categorical(
         _key(seed), logits, axis=-1,
-        shape=(int(num_samples),) + logits.shape[:-1]).swapaxes(0, -1)
+        shape=(int(num_samples),) + logits.shape[:-1]), 0, -1)
 
 
 @register("multinomial")
 def _multinomial(probs, num_samples=1, seed=0):
     import jax
-    return jax.random.categorical(
+    return jnp.moveaxis(jax.random.categorical(
         _key(seed), jnp.log(jnp.maximum(probs, 1e-30)), axis=-1,
-        shape=(int(num_samples),) + probs.shape[:-1]).swapaxes(0, -1)
+        shape=(int(num_samples),) + probs.shape[:-1]), 0, -1)
 
 
 # ----------------------------------------------------- misc math / sorting

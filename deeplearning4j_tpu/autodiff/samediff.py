@@ -146,10 +146,12 @@ class SDVariable:
 
     def set_arr(self, value):
         self.sd.arrays[self.name] = jnp.asarray(value)
-        # a CONSTANT's value is baked into traced train steps — invalidate
-        # and EVICT (stale executables pin the old device buffers)
-        self.sd._graph_version += 1
-        self.sd._jit_cache.clear()
+        # only a CONSTANT's value is baked into traced train steps —
+        # invalidate and EVICT (stale executables pin the old device
+        # buffers); VARIABLE/ARRAY values are passed as step arguments
+        if self.vtype is VariableType.CONSTANT:
+            self.sd._graph_version += 1
+            self.sd._jit_cache.clear()
 
     def rename(self, new_name: str) -> "SDVariable":
         self.sd._rename(self.name, new_name)

@@ -528,7 +528,7 @@ class _GraphImporter:
                     "Switch true/false pair (TF1 while-loop frames are not "
                     "supported — re-freeze without lowering control flow, "
                     "or use the functional While path)")
-            pred_ref = self._switch_pred[picks[0][0]]
+            pred_ref = self._switch_pred[next(p for p in picks if p)[0]]
             pred_v = sd.vars[self._ensure_var(pred_ref)]
             tv = sd.vars[self._ensure_var(true_refs[0])]
             fv = sd.vars[self._ensure_var(false_refs[0])]

@@ -19,7 +19,8 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
-from deeplearning4j_tpu.nn.base import GlobalConfig, Layer, register_layer
+from deeplearning4j_tpu.nn.base import (GlobalConfig, Layer, dropout_mask,
+                                        register_layer)
 from deeplearning4j_tpu.nn.inputs import InputType
 from deeplearning4j_tpu.ops.activations import get_activation
 from deeplearning4j_tpu.ops.initializers import init_weights
@@ -108,6 +109,11 @@ class SelfAttentionLayer(Layer):
     def forward(self, params, state, x, *, training=False, rng=None, mask=None):
         b, t, _ = x.shape
         h = self.n_heads
+        # NOTE on fused QKV: concatenating W_q|W_k|W_v into one matmul was
+        # measured SLOWER on v5e (43.7 GB vs 40.5 GB accessed, 40.4 vs
+        # 39.1 ms/step on BERT-base) — the fused weight and its gradient
+        # materialize as extra traffic while XLA already schedules the three
+        # shared-LHS matmuls back-to-back. Kept unfused deliberately.
         q = (x @ params["W_q"] + params["b_q"]).reshape(b, t, h, -1).transpose(0, 2, 1, 3)
         k = (x @ params["W_k"] + params["b_k"]).reshape(b, t, h, -1).transpose(0, 2, 1, 3)
         v = (x @ params["W_v"] + params["b_v"]).reshape(b, t, h, -1).transpose(0, 2, 1, 3)
@@ -158,7 +164,7 @@ class TransformerEncoderBlock(Layer):
         if not training or rng is None or self.dropout_rate <= 0.0:
             return x
         keep = 1.0 - self.dropout_rate
-        mask = jax.random.bernoulli(rng, keep, shape=x.shape)
+        mask = dropout_mask(rng, keep, x.shape)
         return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
 
     def forward(self, params, state, x, *, training=False, rng=None, mask=None):
@@ -223,7 +229,7 @@ class BertEmbeddingLayer(Layer):
         y = layer_norm(y, params["ln_gamma"], params["ln_beta"], self.layer_norm_eps)
         if training and rng is not None and self.dropout_rate > 0:
             keep = 1.0 - self.dropout_rate
-            keep_mask = jax.random.bernoulli(rng, keep, shape=y.shape)
+            keep_mask = dropout_mask(rng, keep, y.shape)
             y = jnp.where(keep_mask, y / keep, 0.0).astype(y.dtype)
         return y, state
 

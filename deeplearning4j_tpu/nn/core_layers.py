@@ -13,7 +13,8 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from deeplearning4j_tpu.nn.base import GlobalConfig, Layer, register_layer
+from deeplearning4j_tpu.nn.base import (GlobalConfig, Layer, dropout_mask,
+                                        register_layer)
 from deeplearning4j_tpu.nn.inputs import InputType
 from deeplearning4j_tpu.ops.activations import get_activation
 from deeplearning4j_tpu.ops.initializers import init_weights
@@ -131,7 +132,7 @@ class DropoutLayer(Layer):
         p = self._dropout(self._g) or 0.5
         if not training or rng is None or p >= 1.0:
             return x, state
-        keep = jax.random.bernoulli(rng, p, shape=x.shape)
+        keep = dropout_mask(rng, p, x.shape)
         return jnp.where(keep, x / p, 0.0).astype(x.dtype), state
 
 

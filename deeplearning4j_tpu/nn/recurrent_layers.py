@@ -187,7 +187,11 @@ class GravesLSTM(LSTM):
     def init(self, key, input_type, g: GlobalConfig):
         params, state = super().init(key, input_type, g)
         H = self.n_out
-        params["peephole"] = jnp.zeros((3 * H,), g.dtype or jnp.float32)
+        # peephole columns live in the recurrent weight matrix in the
+        # reference and draw from the configured weight-init distribution
+        params["peephole"] = init_weights(
+            jax.random.fold_in(key, 3), (3 * H,), self._winit(g),
+            fan=(H, H), dtype=g.dtype)
         return params, state
 
     def _step(self, params, h, c, zx_t):

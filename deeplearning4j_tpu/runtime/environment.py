@@ -81,6 +81,18 @@ class Environment:
         self.compute_dtype = jnp.bfloat16
         return self
 
+    def enable_bf16_state(self) -> "Environment":
+        """FULL-bf16 training state: parameters AND optimizer moments live
+        in bfloat16 (compute already bf16). An HBM-traffic knob for
+        bandwidth-bound steps — BERT-base measured 35.8 vs 40.5 GB/step and
+        1724 vs 1637 samples/s on v5e. CAVEAT: bf16 has ~3 significant
+        digits, so parameter updates smaller than ~param*0.004 round away —
+        fine for pre-training-scale learning rates, risky for tiny
+        fine-tune LRs (2e-5 on mature weights). Opt-in, never default."""
+        self.default_dtype = jnp.bfloat16
+        self.compute_dtype = jnp.bfloat16
+        return self
+
     def set_nan_panic(self, enabled: bool) -> "Environment":
         self.nan_panic = enabled
         jax.config.update("jax_debug_nans", bool(enabled))
