@@ -86,6 +86,10 @@ register("relu6")(jax.nn.relu6)
 register("leaky_relu")(lambda a, alpha=0.01: jax.nn.leaky_relu(a, alpha))
 register("elu")(jax.nn.elu)
 register("selu")(jax.nn.selu)
+# NOTE: wrapping the erf form in jax.checkpoint to skip its saved
+# intermediate was measured BOTH ways on the imported BERT-base: -1.2 GB
+# before the layout passes, +1.8 GB after them (the checkpoint barrier
+# blocks the post-layout fusions). Kept plain.
 register("gelu")(lambda a, approximate=True: jax.nn.gelu(a, approximate=approximate))
 register("softplus")(jax.nn.softplus)
 register("softsign")(jax.nn.soft_sign)

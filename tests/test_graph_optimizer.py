@@ -203,3 +203,114 @@ def test_attention_fusion_mul_const_first():
     assert stats["attention"] == 1, stats
     after = np.asarray(sd.output(feeds, outputs[0]))
     np.testing.assert_allclose(after, before, rtol=1e-5, atol=1e-6)
+
+
+def test_layout_passes_fold_2d_matmul_roundtrips():
+    """The TF 2-D-matmul spelling (reshape -> matmul -> bias -> reshape)
+    folds back to the batched 3-D form with identical outputs; the
+    round-trip reshapes and their layout-conversion copies disappear
+    (round-3 fix for the imported-BERT HBM gap, BASELINE.md)."""
+    rng = np.random.default_rng(0)
+    B, T, H, K = 2, 8, 16, 12
+    W = rng.normal(0, 0.1, (H, K)).astype(np.float32)
+    b = rng.normal(0, 0.1, (K,)).astype(np.float32)
+    W2 = rng.normal(0, 0.1, (K, H)).astype(np.float32)
+
+    def model(x):
+        h = tf.matmul(tf.reshape(x, (B * T, H)), W) + b
+        h = tf.nn.relu(h)
+        h = tf.matmul(h, W2)
+        return tf.reshape(h, (B, T, H)) + x
+
+    gd, inputs, outputs = _frozen(
+        model, [tf.TensorSpec((B, T, H), tf.float32, name="x")])
+    x = rng.normal(0, 1, (B, T, H)).astype(np.float32)
+    sd0 = TFGraphMapper.import_graph(gd, optimize=False)
+    before = np.asarray(sd0.output({"x": x}, outputs[0]))
+
+    sd = TFGraphMapper.import_graph(gd, optimize=False)
+    from deeplearning4j_tpu.autodiff.graph_optimizer import optimize_layout
+    stats = optimize_layout(sd)
+    assert stats["layout_folds"] == 2, stats
+    assert stats["reshape_sinks"] >= 2, stats
+    after = np.asarray(sd.output({"x": x}, outputs[0]))
+    np.testing.assert_allclose(after, before, rtol=1e-5, atol=1e-6)
+
+
+def test_layout_passes_keep_multi_consumer_reshapes():
+    """A reshape with two consumers is shared state — the sink pass must
+    not duplicate or remove it."""
+    rng = np.random.default_rng(1)
+    B, T, H = 2, 4, 8
+    W = rng.normal(0, 0.1, (H, H)).astype(np.float32)
+
+    def model(x):
+        flat = tf.reshape(x, (B * T, H))      # two consumers
+        a = tf.matmul(flat, W)
+        return a + flat
+
+    gd, inputs, outputs = _frozen(
+        model, [tf.TensorSpec((B, T, H), tf.float32, name="x")])
+    x = rng.normal(0, 1, (B, T, H)).astype(np.float32)
+    sd0 = TFGraphMapper.import_graph(gd, optimize=False)
+    before = np.asarray(sd0.output({"x": x}, outputs[0]))
+    sd = TFGraphMapper.import_graph(gd)  # full optimize incl. layout
+    after = np.asarray(sd.output({"x": x}, outputs[0]))
+    np.testing.assert_allclose(after, before, rtol=1e-5, atol=1e-6)
+
+
+def test_layout_passes_attention_chain_golden():
+    """Full imported attention block (proj reshapes/transposes + sdpa) stays
+    golden through the layout passes."""
+    rng = np.random.default_rng(2)
+    B, T, H, heads = 2, 8, 16, 4
+    dk = H // heads
+    Wq, Wk, Wv = (rng.normal(0, 0.1, (H, H)).astype(np.float32)
+                  for _ in range(3))
+
+    def proj(x2, W):
+        h = tf.matmul(x2, W)
+        h = tf.reshape(h, (B, T, heads, dk))
+        return tf.transpose(h, (0, 2, 1, 3))
+
+    def model(x):
+        x2 = tf.reshape(x, (B * T, H))
+        q, k, v = proj(x2, Wq), proj(x2, Wk), proj(x2, Wv)
+        s = tf.matmul(q, k, transpose_b=True) / np.float32(np.sqrt(dk))
+        ctx = tf.matmul(tf.nn.softmax(s, axis=-1), v)
+        return tf.reshape(tf.transpose(ctx, (0, 2, 1, 3)), (B, T, H))
+
+    gd, inputs, outputs = _frozen(
+        model, [tf.TensorSpec((B, T, H), tf.float32, name="x")])
+    x = rng.normal(0, 1, (B, T, H)).astype(np.float32)
+    sd0 = TFGraphMapper.import_graph(gd, optimize=False)
+    before = np.asarray(sd0.output({"x": x}, outputs[0]))
+    sd = TFGraphMapper.import_graph(gd)
+    ops = [n.op for n in sd.ops]
+    assert "scaled_dot_product_attention" in ops
+    after = np.asarray(sd.output({"x": x}, outputs[0]))
+    np.testing.assert_allclose(after, before, rtol=1e-5, atol=1e-6)
+
+
+def test_layout_passes_dynamic_batch_stays_dynamic():
+    """Graphs frozen with a None batch dim must still execute at ANY batch
+    size after the layout passes — inferred (guessed) dims must never be
+    baked into emitted reshape attrs."""
+    rng = np.random.default_rng(3)
+    T, H = 4, 8
+    W = rng.normal(0, 0.1, (H, H)).astype(np.float32)
+    b = rng.normal(0, 0.1, (H,)).astype(np.float32)
+
+    def model(x):
+        h = tf.matmul(tf.reshape(x, (-1, H)), W) + b
+        return tf.reshape(h, (-1, T, H))
+
+    gd, inputs, outputs = _frozen(
+        model, [tf.TensorSpec((None, T, H), tf.float32, name="x")])
+    sd = TFGraphMapper.import_graph(gd)
+    sd0 = TFGraphMapper.import_graph(gd, optimize=False)
+    for B in (2, 5):
+        x = rng.normal(0, 1, (B, T, H)).astype(np.float32)
+        before = np.asarray(sd0.output({"x": x}, outputs[0]))
+        after = np.asarray(sd.output({"x": x}, outputs[0]))
+        np.testing.assert_allclose(after, before, rtol=1e-5, atol=1e-6)
