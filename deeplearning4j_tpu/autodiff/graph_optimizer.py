@@ -15,7 +15,7 @@ value has no other consumer, so observable outputs never change.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -266,7 +266,8 @@ def fuse_gelu_erf(sd: SameDiff) -> int:
 def optimize(sd: SameDiff) -> Dict[str, int]:
     """Run all passes to fixpoint; returns per-pass fusion counts."""
     stats = {"layer_norm": fuse_layer_norm(sd), "gelu_erf": fuse_gelu_erf(sd),
-             "attention": fuse_attention(sd)}
+             "attention": fuse_attention(sd),
+             "shape_folds": fold_shape_chains(sd)}
     stats.update(optimize_layout(sd))
     return stats
 
@@ -289,46 +290,145 @@ _SINK_BINARY = {"add", "sub", "mul", "div", "bias_add", "maximum", "minimum",
                 "squared_difference"}
 
 
-def infer_shapes(sd: SameDiff) -> Optional[Dict[str, Tuple[int, ...]]]:
-    """Static shapes for every op output via one ``jax.eval_shape`` trace.
+def _infer(sd: SameDiff, lead: Optional[int] = None):
+    """Incremental per-op shape + shape-VALUE propagation.
 
-    Every placeholder dim recorded as None is filled with the most common
-    known leading dim of the other placeholders (the importer freezes real
-    batch dims, so typically only grafted-loss label placeholders need
-    filling). Because such dims are GUESSES, the rewrite passes never bake
-    inferred leading dims into emitted reshape attrs (they use -1 / the
-    original attrs). Returns None when the graph cannot be shape-traced
-    (dynamic control flow etc.) — callers skip the layout passes then, and
-    a warning records that the optimization was lost."""
+    Walks the (topologically ordered) op list once. For each op, inputs
+    with statically-known VALUES (constants; shape_of of a known shape;
+    arithmetic thereon) are passed concretely via closure — so shape
+    chains evaluate to real integers — while the rest enter a per-op
+    ``jax.eval_shape`` abstractly. An op that cannot be evaluated only
+    blanks ITS outputs; downstream ops that don't depend on them still
+    resolve (a whole-graph trace used to lose everything to one bad op).
+
+    Every placeholder dim recorded as None is filled with ``lead`` (default:
+    the most common known leading dim — the importer freezes real batch
+    dims, so typically only grafted-loss label placeholders need filling).
+    Such dims are GUESSES: rewrite passes must never bake inferred leading
+    dims into emitted attrs (they use -1 / original attrs; see
+    fold_shape_chains for the two-run taint check).
+
+    Returns ``(shapes, values)`` dicts keyed by variable name."""
     import jax
     import jax.numpy as jnp
+    from deeplearning4j_tpu.autodiff.ops_registry import get_op
 
+    if lead is None:
+        known_lead = [v.shape[0] for v in sd.vars.values()
+                      if v.vtype == VariableType.PLACEHOLDER and v.shape
+                      and v.shape[0] is not None]
+        lead = max(set(known_lead), key=known_lead.count) if known_lead else 2
+
+    shapes: Dict[str, Tuple[int, ...]] = {}
+    dtypes: Dict[str, Any] = {}
+    values: Dict[str, np.ndarray] = {}
+    for name, a in sd.arrays.items():
+        shapes[name] = tuple(a.shape)
+        dtypes[name] = a.dtype
+        arr = np.asarray(a)
+        if sd.vars[name].vtype == VariableType.CONSTANT \
+                and arr.dtype.kind in "iu" and arr.size <= 64:
+            values[name] = arr
+    for name, v in sd.vars.items():
+        if name in shapes or v.vtype != VariableType.PLACEHOLDER \
+                or v.shape is None:
+            continue
+        shapes[name] = tuple(lead if d is None else int(d) for d in v.shape)
+        dtypes[name] = v.dtype or jnp.float32
+
+    for n in sd.ops:
+        if any(i not in shapes for i in n.inputs):
+            continue
+        if n.op == "shape_of":
+            out = n.outputs[0]
+            values[out] = np.asarray(shapes[n.inputs[0]], np.int64)
+            shapes[out] = values[out].shape
+            dtypes[out] = np.int32
+            continue
+        try:
+            fn = n.attrs["fn"] if n.op == "__callable__" else get_op(n.op)
+            attrs = {} if n.op == "__callable__" else n.attrs
+            conc = {j: values[i] for j, i in enumerate(n.inputs)
+                    if i in values}
+            specs = [jax.ShapeDtypeStruct(shapes[i], dtypes[i])
+                     for j, i in enumerate(n.inputs) if j not in conc]
+
+            def f(*xs, _fn=fn, _attrs=attrs, _conc=conc, _n=len(n.inputs)):
+                it = iter(xs)
+                full = [_conc[j] if j in _conc else next(it)
+                        for j in range(_n)]
+                return _fn(*full, **_attrs)
+
+            if conc and len(conc) == len(n.inputs):
+                # fully concrete: evaluate for real — this is how shape
+                # ARITHMETIC (slice/stack/mul of shape_of) stays a value
+                res = f()
+                res_t = res if isinstance(res, (tuple, list)) else (res,)
+                for o, r in zip(n.outputs, res_t):
+                    arr = np.asarray(r)
+                    shapes[o] = arr.shape
+                    dtypes[o] = arr.dtype
+                    if arr.dtype.kind in "iu" and arr.size <= 64:
+                        values[o] = arr
+            else:
+                res = jax.eval_shape(f, *specs)
+                res_t = res if isinstance(res, (tuple, list)) else (res,)
+                for o, r in zip(n.outputs, res_t):
+                    shapes[o] = tuple(r.shape)
+                    dtypes[o] = r.dtype
+        except Exception:
+            continue
+    return shapes, values
+
+
+def infer_shapes(sd: SameDiff, lead: Optional[int] = None
+                 ) -> Optional[Dict[str, Tuple[int, ...]]]:
+    """Shapes-only view of :func:`_infer` (None if nothing resolved)."""
+    shapes, _ = _infer(sd, lead)
+    return shapes or None
+
+
+def fold_shape_chains(sd: SameDiff) -> int:
+    """Rewrite ``reshape_dynamic`` (tensor shape operand, emitted by the TF
+    importer for computed shapes) into static ``reshape`` attrs using the
+    propagated shape VALUES from :func:`_infer`.
+
+    Dims that depend on a dynamic (None) placeholder dim are detected by
+    inferring twice with two different substituted leading dims: entries
+    whose value CHANGES between the runs become -1 in the rewritten attr
+    (jnp.reshape resolves one -1; chains needing more stay dynamic)."""
+    if not any(n.op == "reshape_dynamic" for n in sd.ops):
+        return 0
+    has_none = any(v.vtype == VariableType.PLACEHOLDER and v.shape
+                   and any(d is None for d in v.shape)
+                   for v in sd.vars.values())
     known_lead = [v.shape[0] for v in sd.vars.values()
                   if v.vtype == VariableType.PLACEHOLDER and v.shape
                   and v.shape[0] is not None]
     lead = max(set(known_lead), key=known_lead.count) if known_lead else 2
-    spec = {}
-    for name, v in sd.vars.items():
-        a = sd.arrays.get(name)
-        if a is not None:
-            spec[name] = jax.ShapeDtypeStruct(a.shape, a.dtype)
-        elif v.vtype == VariableType.PLACEHOLDER and v.shape is not None:
-            shape = tuple(lead if d is None else int(d) for d in v.shape)
-            spec[name] = jax.ShapeDtypeStruct(shape, v.dtype or jnp.float32)
-    outs = [o for n in sd.ops for o in n.outputs]
-    try:
-        res = jax.eval_shape(lambda env: sd._exec_graph(dict(env), outs), spec)
-    except Exception as e:
-        import warnings
-        warnings.warn(
-            f"graph_optimizer: shape inference failed ({e!r}); layout "
-            "passes skipped — imported 2-D matmul round trips will keep "
-            "their layout-conversion copies", stacklevel=2)
-        return None
-    shapes = {o: tuple(r.shape) for o, r in zip(outs, res)}
-    for name in spec:
-        shapes.setdefault(name, tuple(spec[name].shape))
-    return shapes
+    _, v1 = _infer(sd, lead=lead)
+    # the second run MUST use a different substituted dim or batch-dependent
+    # entries would match across runs and get baked as static ints
+    v2 = _infer(sd, lead=lead + 1)[1] if has_none else v1
+    folded = 0
+    for n in sd.ops:
+        if n.op != "reshape_dynamic":
+            continue
+        sname = n.inputs[1]
+        a, b = v1.get(sname), v2.get(sname)
+        if a is None or b is None or a.shape != b.shape or a.ndim != 1:
+            continue
+        target = [int(x) if int(x) == int(y) else -1 for x, y in zip(a, b)]
+        if sum(1 for t in target if t == -1) > 1:
+            continue
+        n.op = "reshape"
+        n.inputs = n.inputs[:1]
+        n.attrs = {"shape": target}
+        folded += 1
+    if folded:
+        sd._jit_cache.clear()
+        sd._graph_version += 1
+    return folded
 
 
 def _new_array_var(sd: SameDiff, base: str) -> str:

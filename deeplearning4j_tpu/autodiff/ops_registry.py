@@ -614,10 +614,18 @@ def _resize_bilinear(images, height=None, width=None, align_corners=False):
 
 
 @register("resize_nearest")
-def _resize_nearest(images, height=None, width=None):
+def _resize_nearest(images, height=None, width=None, half_pixel_centers=True):
     n, h, w, c = images.shape
-    return jax.image.resize(images, (n, int(height), int(width), c),
-                            method="nearest")
+    if half_pixel_centers:
+        return jax.image.resize(images, (n, int(height), int(width), c),
+                                method="nearest")
+    # legacy TF1 sampling (ResizeNearestNeighbor half_pixel_centers=False):
+    # src index = min(floor(dst * in/out), in-1)
+    hi = jnp.minimum((jnp.arange(int(height)) * (h / int(height)))
+                     .astype(jnp.int32), h - 1)
+    wi = jnp.minimum((jnp.arange(int(width)) * (w / int(width)))
+                     .astype(jnp.int32), w - 1)
+    return images[:, hi][:, :, wi]
 
 
 @register("crop_to_box")
@@ -1825,3 +1833,465 @@ def _compare_and_set(a, compare, set_value, eps=1e-12):
 @register("replace_nans")
 def _replace_nans(a, value=0.0):
     return jnp.where(jnp.isnan(a), value, a)
+
+
+# ------------------------------------------------------- registry wave 5
+# (round 3: importer-generality ops — einsum, deconv, dynamic reshape,
+# AddN — plus the remaining declarable families: FFT, dynamic
+# partition/stitch, sequence mask, matrix band, histograms)
+
+
+@register("einsum")
+def _einsum(*operands, equation=""):
+    """General einsum (TF Einsum / reference Einsum declarable op)."""
+    return jnp.einsum(equation, *operands)
+
+
+@register("conv2d_transpose")
+def _conv2d_transpose(y, w, stride=(1, 1), padding="SAME", output_shape=None):
+    """Gradient-of-conv2d w.r.t. its input (TF ``Conv2DBackpropInput``; the
+    reference's ``deconv2d`` declarable op / DL4J ``Deconvolution2D``).
+    ``w`` is HWIO like the forward conv; ``output_shape`` (when given, e.g.
+    by the TF importer) is validated against the result — TF's deconv
+    output size is ambiguous for some stride/pad combos and we only
+    implement the standard one ``lax.conv_transpose`` produces."""
+    out = lax.conv_transpose(y, w, tuple(stride), padding,
+                             dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                             transpose_kernel=True)
+    if output_shape is not None and tuple(int(s) for s in output_shape) != tuple(out.shape):
+        raise NotImplementedError(
+            f"conv2d_transpose: requested output shape {tuple(output_shape)} "
+            f"!= computed {tuple(out.shape)} (non-standard TF deconv sizing)")
+    return out
+
+
+@register("reshape_dynamic")
+def _reshape_dynamic(a, shape):
+    """Reshape with a TENSOR shape operand — the importer's fallback when a
+    TF Reshape's shape input is computed rather than Const. The values must
+    be trace-time concrete, which they are whenever the chain derives from
+    ``shape_of`` of statically-shaped tensors (shape_of returns a concrete
+    array at trace time); a genuinely data-dependent shape raises jax's
+    ConcretizationTypeError."""
+    import numpy as np
+    return jnp.reshape(a, tuple(int(s) for s in np.asarray(shape)))
+
+
+@register("add_n")
+def _add_n(*xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+@register("fft")
+def _fft(a):
+    return jnp.fft.fft(a)
+
+
+@register("ifft")
+def _ifft(a):
+    return jnp.fft.ifft(a)
+
+
+@register("rfft")
+def _rfft(a, fft_length=None):
+    return jnp.fft.rfft(a, n=int(fft_length) if fft_length else None)
+
+
+@register("irfft")
+def _irfft(a, fft_length=None):
+    return jnp.fft.irfft(a, n=int(fft_length) if fft_length else None)
+
+
+@register("fft2d")
+def _fft2d(a):
+    return jnp.fft.fft2(a)
+
+
+@register("ifft2d")
+def _ifft2d(a):
+    return jnp.fft.ifft2(a)
+
+
+@register("dynamic_partition")
+def _dynamic_partition(data, partitions, num_partitions=2):
+    """TF dynamic_partition with static sizes: returns ``num_partitions``
+    arrays of data.shape size padded with zeros plus a per-partition count
+    (XLA needs static shapes, so the TPU-native contract is the padded
+    form; the counts let callers mask). Rows of ``data`` whose partition
+    index equals p are packed (stably) at the front of output p."""
+    n = data.shape[0]
+    parts = []
+    counts = []
+    for p in range(int(num_partitions)):
+        sel = partitions == p
+        # stable pack-to-front permutation: order by (not selected, index)
+        order = jnp.argsort(jnp.where(sel, 0, 1) * n + jnp.arange(n))
+        packed = data[order]
+        cnt = jnp.sum(sel)
+        mask_shape = (n,) + (1,) * (data.ndim - 1)
+        keep = (jnp.arange(n) < cnt).reshape(mask_shape)
+        parts.append(jnp.where(keep, packed, jnp.zeros_like(packed)))
+        counts.append(cnt)
+    return tuple(parts) + (jnp.stack(counts),)
+
+
+@register("dynamic_stitch")
+def _dynamic_stitch(indices, *data, total=None):
+    """TF dynamic_stitch: scatter rows of each data piece to positions given
+    by the matching indices piece; later pieces win on overlap. XLA needs a
+    static output size: pass ``total`` explicitly, else it defaults to the
+    summed index-piece sizes (exact for the canonical partition/stitch
+    round trip, where indices cover 0..N-1)."""
+    idx_list = indices if isinstance(indices, (list, tuple)) else [indices]
+    n_pieces = len(idx_list)
+    vals = data[:n_pieces]
+    total = int(total) if total is not None \
+        else sum(int(i.size) for i in idx_list)
+    out_shape = (total,) + tuple(vals[0].shape[idx_list[0].ndim:])
+    out = jnp.zeros(out_shape, vals[0].dtype)
+    for i, v in zip(idx_list, vals):
+        out = out.at[i.reshape(-1)].set(v.reshape((-1,) + out_shape[1:]))
+    return out
+
+
+@register("sequence_mask")
+def _sequence_mask(lengths, maxlen=None, dtype="bool"):
+    if maxlen is None:
+        raise ValueError(
+            "sequence_mask needs a static maxlen under XLA (TF computes "
+            "max(lengths) dynamically; pass maxlen explicitly)")
+    mask = jnp.arange(int(maxlen)) < jnp.asarray(lengths)[..., None]
+    return mask if dtype == "bool" else mask.astype(dtype)
+
+
+@register("histogram_fixed_width")
+def _histogram_fixed_width(values, value_range, nbins=100):
+    lo, hi = value_range[0], value_range[1]
+    scaled = (values - lo) / jnp.maximum(hi - lo, 1e-30) * nbins
+    idx = jnp.clip(scaled.astype(jnp.int32), 0, nbins - 1)
+    return jnp.zeros((int(nbins),), jnp.int32).at[idx.reshape(-1)].add(1)
+
+
+@register("bincount")
+def _bincount(arr, size=None, weights=None):
+    if size is None:
+        raise ValueError(
+            "bincount needs a static size under XLA (TF sizes the output "
+            "by max(arr) dynamically; pass size explicitly)")
+    n = int(size)
+    if weights is None:
+        return jnp.zeros((n,), jnp.int32).at[arr.reshape(-1)].add(1)
+    return jnp.zeros((n,), jnp.asarray(weights).dtype).at[arr.reshape(-1)].add(
+        jnp.asarray(weights).reshape(-1))
+
+
+# ------------------------------------------------------- registry wave 6
+# (round 3: declarable-set long tail — image adjusts, matrix family,
+# segments, nan-reductions, signal/window family, quantization, misc math;
+# reference [U] libnd4j/include/ops/declarable/ families)
+
+register("xdivy")(lambda a, b: jnp.where(a == 0, 0.0, a / jnp.where(a == 0, 1.0, b)))
+register("multiply_no_nan")(lambda a, b: jnp.where(b == 0, 0.0, a * b))
+register("div_no_nan")(lambda a, b: jnp.where(b == 0, 0.0, a / jnp.where(b == 0, 1.0, b)))
+register("truncate_div")(lambda a, b: jnp.trunc(a / b).astype(a.dtype))
+register("truncate_mod")(lambda a, b: a - jnp.trunc(a / b).astype(a.dtype) * b)
+register("unravel_index")(lambda idx, shape=(): jnp.stack(
+    jnp.unravel_index(idx, tuple(int(s) for s in shape))))
+register("rot90")(lambda a, k=1: jnp.rot90(a, int(k)))
+register("diff")(lambda a, n=1, axis=-1: jnp.diff(a, int(n), axis=axis))
+register("ediff1d")(lambda a: jnp.diff(a.ravel()))
+register("percentile")(lambda a, q=50.0, axis=None, keepdims=False:
+                       jnp.percentile(a, q, axis=axis, keepdims=keepdims))
+register("median")(lambda a, axis=None, keepdims=False:
+                   jnp.median(a, axis=axis, keepdims=keepdims))
+register("nanmean")(lambda a, axis=None, keepdims=False: jnp.nanmean(a, axis, keepdims=keepdims))
+register("nansum")(lambda a, axis=None, keepdims=False: jnp.nansum(a, axis, keepdims=keepdims))
+register("nanmax")(lambda a, axis=None, keepdims=False: jnp.nanmax(a, axis, keepdims=keepdims))
+register("nanmin")(lambda a, axis=None, keepdims=False: jnp.nanmin(a, axis, keepdims=keepdims))
+register("nanvar")(lambda a, axis=None, keepdims=False: jnp.nanvar(a, axis, keepdims=keepdims))
+register("nanstd")(lambda a, axis=None, keepdims=False: jnp.nanstd(a, axis, keepdims=keepdims))
+register("allclose")(lambda a, b, rtol=1e-5, atol=1e-8: jnp.allclose(a, b, rtol, atol))
+register("array_equal")(lambda a, b: jnp.array_equal(a, b))
+register("isin")(lambda a, test: jnp.isin(a, test))
+register("take_along_axis")(lambda a, idx, axis=-1: jnp.take_along_axis(a, idx, axis))
+register("repeat")(lambda a, repeats=1, axis=None: jnp.repeat(a, int(repeats), axis=axis))
+register("swapaxes")(lambda a, axis1=0, axis2=1: jnp.swapaxes(a, int(axis1), int(axis2)))
+register("moveaxis")(lambda a, source=0, destination=-1:
+                     jnp.moveaxis(a, int(source), int(destination)))
+register("hstack")(lambda *xs: jnp.hstack(xs))
+register("vstack")(lambda *xs: jnp.vstack(xs))
+register("dstack")(lambda *xs: jnp.dstack(xs))
+register("tri")(lambda n, m=None, k=0: jnp.tri(int(n), int(m) if m else None, int(k)))
+register("vander")(lambda a, n=None: jnp.vander(a, int(n) if n else None))
+register("inner")(jnp.inner)
+register("vdot")(jnp.vdot)
+register("matrix_transpose")(lambda a: jnp.swapaxes(a, -1, -2))
+register("sinc")(jnp.sinc)
+register("log1mexp")(lambda a: jnp.log1p(-jnp.exp(-jnp.abs(a))))
+register("erfinv")(lambda a: jax.scipy.special.erfinv(a))
+register("nextafter")(jnp.nextafter)
+register("hardswish")(jax.nn.hard_swish)
+register("reduce_logsumexp")(lambda a, axis=None, keepdims=False:
+                             jax.scipy.special.logsumexp(a, axis=axis, keepdims=keepdims))
+register("reduce_euclidean_norm")(lambda a, axis=None, keepdims=False:
+                                  jnp.sqrt(jnp.sum(a * a, axis=axis, keepdims=keepdims)))
+register("cummax")(lambda a, axis=0: jax.lax.cummax(a, axis=int(axis)))
+register("cummin")(lambda a, axis=0: jax.lax.cummin(a, axis=int(axis)))
+register("hard_shrink")(lambda a, lambd=0.5: jnp.where(jnp.abs(a) > lambd, a, 0.0))
+register("soft_shrink")(lambda a, lambd=0.5:
+                        jnp.sign(a) * jnp.maximum(jnp.abs(a) - lambd, 0.0))
+register("kthvalue")(lambda a, k=1, axis=-1: jnp.sort(a, axis=axis).take(int(k) - 1, axis=axis))
+register("batch_gather")(lambda a, idx: jnp.take_along_axis(
+    a, idx, axis=1) if a.ndim > idx.ndim else jnp.take_along_axis(a, idx, axis=-1))
+register("adjoint")(lambda a: jnp.conj(jnp.swapaxes(a, -1, -2)))
+register("norm")(lambda a, ord=None, axis=None, keepdims=False:
+                 jnp.linalg.norm(a, ord=ord, axis=axis, keepdims=keepdims))
+register("pinv")(jnp.linalg.pinv)
+register("matrix_power")(lambda a, n=1: jnp.linalg.matrix_power(a, int(n)))
+register("slogdet")(lambda a: tuple(jnp.linalg.slogdet(a)))
+register("expm")(lambda a: jax.scipy.linalg.expm(a))
+register("matrix_diag_part")(lambda a: jnp.diagonal(a, axis1=-2, axis2=-1))
+register("matrix_solve")(lambda a, b: jnp.linalg.solve(a, b))
+register("cholesky_solve")(lambda chol, b: jax.scipy.linalg.cho_solve((chol, True), b))
+register("lu_solve")(lambda a, b: jnp.linalg.solve(a, b))  # factor+solve fused
+register("tridiagonal_solve")(lambda dl, d, du, b: jax.lax.linalg.tridiagonal_solve(
+    dl, d, du, b))
+register("invert_permutation")(lambda p: jnp.argsort(p))
+
+
+@register("setdiff1d")
+def _setdiff1d(a, b):
+    """Values in a not in b, padded with zeros to a's size plus count (XLA
+    static-shape contract, same style as dynamic_partition)."""
+    a = a.ravel()
+    keep = ~jnp.isin(a, b)
+    order = jnp.argsort(jnp.where(keep, 0, 1) * a.size + jnp.arange(a.size))
+    packed = a[order]
+    cnt = jnp.sum(keep)
+    return jnp.where(jnp.arange(a.size) < cnt, packed, 0), cnt
+
+
+@register("boolean_mask")
+def _boolean_mask(a, mask):
+    """Rows of a where mask, packed to the front and zero-padded, plus the
+    count (static-shape contract)."""
+    m = mask.ravel().astype(bool)
+    n = m.shape[0]
+    order = jnp.argsort(jnp.where(m, 0, 1) * n + jnp.arange(n))
+    packed = a[order]
+    cnt = jnp.sum(m)
+    keep = (jnp.arange(n) < cnt).reshape((n,) + (1,) * (a.ndim - 1))
+    return jnp.where(keep, packed, jnp.zeros_like(packed)), cnt
+
+
+def _unsorted_segment(op_name, kind):
+    def f(data, segment_ids, num_segments=None):
+        n = int(num_segments)
+        if kind == "one":
+            init = jnp.ones((), data.dtype)
+        else:
+            if jnp.issubdtype(data.dtype, jnp.floating):
+                ext = jnp.finfo(data.dtype)
+            else:
+                ext = jnp.iinfo(data.dtype)
+            init = ext.min if kind == "max" else ext.max
+        out = jnp.full((n,) + data.shape[segment_ids.ndim:], init, data.dtype)
+        return getattr(out.at[segment_ids.reshape(-1)],
+                       op_name)(data.reshape((-1,) + data.shape[segment_ids.ndim:]))
+    return f
+
+
+register("unsorted_segment_max")(_unsorted_segment("max", "max"))
+register("unsorted_segment_min")(_unsorted_segment("min", "min"))
+register("unsorted_segment_prod")(_unsorted_segment("mul", "one"))
+
+
+@register("unsorted_segment_mean")
+def _unsorted_segment_mean(data, segment_ids, num_segments=None):
+    n = int(num_segments)
+    flat = data.reshape((-1,) + data.shape[segment_ids.ndim:])
+    ids = segment_ids.reshape(-1)
+    tot = jnp.zeros((n,) + flat.shape[1:], data.dtype).at[ids].add(flat)
+    cnt = jnp.zeros((n,), data.dtype).at[ids].add(1.0)
+    return tot / jnp.maximum(cnt, 1.0).reshape((n,) + (1,) * (flat.ndim - 1))
+
+
+@register("bucketize")
+def _bucketize(a, boundaries=()):
+    return jnp.searchsorted(jnp.asarray(list(boundaries)), a, side="right")
+
+
+@register("tensor_scatter_update")
+def _tensor_scatter_update(a, indices, updates):
+    return a.at[tuple(jnp.moveaxis(indices, -1, 0))].set(updates)
+
+
+@register("batch_to_space_nd")
+def _batch_to_space_nd(a, block_shape=(2, 2), crops=((0, 0), (0, 0))):
+    bh, bw = int(block_shape[0]), int(block_shape[1])
+    n, h, w, c = a.shape
+    nb = n // (bh * bw)
+    x = a.reshape(bh, bw, nb, h, w, c).transpose(2, 3, 0, 4, 1, 5)
+    x = x.reshape(nb, h * bh, w * bw, c)
+    (ct, cb), (cl, cr) = crops
+    return x[:, int(ct):h * bh - int(cb), int(cl):w * bw - int(cr), :]
+
+
+@register("space_to_batch_nd")
+def _space_to_batch_nd(a, block_shape=(2, 2), paddings=((0, 0), (0, 0))):
+    bh, bw = int(block_shape[0]), int(block_shape[1])
+    (pt, pb), (pl, pr) = paddings
+    a = jnp.pad(a, ((0, 0), (int(pt), int(pb)), (int(pl), int(pr)), (0, 0)))
+    n, h, w, c = a.shape
+    x = a.reshape(n, h // bh, bh, w // bw, bw, c).transpose(2, 4, 0, 1, 3, 5)
+    return x.reshape(n * bh * bw, h // bh, w // bw, c)
+
+
+@register("fake_quant_with_min_max_vars")
+def _fake_quant(a, vmin=-6.0, vmax=6.0, num_bits=8):
+    levels = float(2 ** int(num_bits) - 1)
+    scale = (vmax - vmin) / levels
+    q = jnp.round((jnp.clip(a, vmin, vmax) - vmin) / scale)
+    return q * scale + vmin
+
+
+@register("quantize")
+def _quantize(a, scale=1.0, zero_point=0, dtype="int8"):
+    info = jnp.iinfo(jnp.dtype(dtype))
+    return jnp.clip(jnp.round(a / scale) + zero_point,
+                    info.min, info.max).astype(dtype)
+
+
+@register("dequantize")
+def _dequantize(q, scale=1.0, zero_point=0):
+    return (q.astype(jnp.float32) - zero_point) * scale
+
+
+@register("adjust_hue")
+def _adjust_hue(img, delta=0.0):
+    from deeplearning4j_tpu.autodiff.ops_registry import OPS as _O
+    hsv = _O["rgb_to_hsv"](img)
+    h = jnp.mod(hsv[..., 0:1] + delta, 1.0)
+    return _O["hsv_to_rgb"](jnp.concatenate([h, hsv[..., 1:]], axis=-1))
+
+
+@register("adjust_gamma")
+def _adjust_gamma(img, gamma=1.0, gain=1.0):
+    return gain * img ** gamma
+
+
+@register("grayscale_to_rgb")
+def _grayscale_to_rgb(img):
+    return jnp.repeat(img, 3, axis=-1) if img.shape[-1] == 1 \
+        else jnp.stack([img] * 3, axis=-1)
+
+
+@register("per_image_standardization")
+def _per_image_standardization(img):
+    axes = tuple(range(1, img.ndim))
+    n = 1
+    for a in axes:
+        n *= img.shape[a]
+    mean = jnp.mean(img, axis=axes, keepdims=True)
+    std = jnp.maximum(jnp.std(img, axis=axes, keepdims=True),
+                      1.0 / math.sqrt(n))
+    return (img - mean) / std
+
+
+@register("total_variation")
+def _total_variation(img):
+    dh = jnp.abs(img[:, 1:, :, :] - img[:, :-1, :, :])
+    dw = jnp.abs(img[:, :, 1:, :] - img[:, :, :-1, :])
+    axes = tuple(range(1, img.ndim))
+    return jnp.sum(dh, axis=axes) + jnp.sum(dw, axis=axes)
+
+
+@register("extract_image_patches")
+def _extract_image_patches(img, ksizes=(1, 3, 3, 1), strides=(1, 1, 1, 1),
+                           rates=(1, 1, 1, 1), padding="VALID"):
+    if any(int(r) != 1 for r in rates):
+        raise NotImplementedError(
+            f"extract_image_patches with rates={tuple(rates)} (dilated "
+            "patches) is not implemented")
+    kh, kw = int(ksizes[1]), int(ksizes[2])
+    sh, sw = int(strides[1]), int(strides[2])
+    n, h, w, c = img.shape
+    patches = jax.lax.conv_general_dilated_patches(
+        img, (kh, kw), (sh, sw), padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    # conv_patches emits C-major (c, kh, kw); TF wants (kh, kw, c)
+    nh, nw = patches.shape[1], patches.shape[2]
+    return patches.reshape(n, nh, nw, c, kh, kw).transpose(
+        0, 1, 2, 4, 5, 3).reshape(n, nh, nw, kh * kw * c)
+
+
+@register("col2im")
+def _col2im(cols, out_h=None, out_w=None, kernel=(3, 3), stride=(1, 1)):
+    """Inverse of im2col (overlap-add): cols (N, nh, nw, kh*kw*C) back to
+    (N, H, W, C). The reference's col2im declarable op."""
+    kh, kw = int(kernel[0]), int(kernel[1])
+    sh, sw = int(stride[0]), int(stride[1])
+    n, nh, nw, _ = cols.shape
+    c = cols.shape[-1] // (kh * kw)
+    H, W = int(out_h), int(out_w)
+    out = jnp.zeros((n, H, W, c), cols.dtype)
+    cols = cols.reshape(n, nh, nw, kh, kw, c)
+    for i in range(kh):
+        for j in range(kw):
+            out = out.at[:, i:i + nh * sh:sh, j:j + nw * sw:sw, :].add(
+                cols[:, :, :, i, j, :])
+    return out
+
+
+# -- signal/window family (reference [U] declarable ops + tf.signal) --
+register("hann_window")(lambda n, periodic=True: jnp.hanning(int(n) + 1)[:-1]
+                        if periodic else jnp.hanning(int(n)))
+register("hamming_window")(lambda n, periodic=True: jnp.hamming(int(n) + 1)[:-1]
+                           if periodic else jnp.hamming(int(n)))
+register("blackman_window")(lambda n, periodic=True: jnp.blackman(int(n) + 1)[:-1]
+                            if periodic else jnp.blackman(int(n)))
+
+
+@register("frame")
+def _frame(a, frame_length=256, frame_step=128, axis=-1):
+    fl, fs = int(frame_length), int(frame_step)
+    ax = int(axis) % a.ndim
+    n = a.shape[ax]
+    num = max(0, (n - fl) // fs + 1)
+    a = jnp.moveaxis(a, ax, -1)
+    idx = jnp.arange(num)[:, None] * fs + jnp.arange(fl)[None, :]
+    out = a[..., idx]  # (..., num, fl)
+    return out if ax == a.ndim - 1 else jnp.moveaxis(out, (-2, -1), (ax, ax + 1))
+
+
+@register("overlap_and_add")
+def _overlap_and_add(frames, frame_step=128):
+    fs = int(frame_step)
+    num, fl = frames.shape[-2], frames.shape[-1]
+    out_len = (num - 1) * fs + fl
+    out = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype)
+    for i in range(num):
+        out = out.at[..., i * fs:i * fs + fl].add(frames[..., i, :])
+    return out
+
+
+@register("stft")
+def _stft(a, frame_length=256, frame_step=128, fft_length=None):
+    fl = int(frame_length)
+    frames = _frame(a, fl, frame_step)
+    win = jnp.hanning(fl + 1)[:-1].astype(a.dtype)
+    return jnp.fft.rfft(frames * win,
+                        n=int(fft_length) if fft_length else fl)
+
+
+@register("istft")
+def _istft(spec, frame_length=256, frame_step=128):
+    fl, fs = int(frame_length), int(frame_step)
+    frames = jnp.fft.irfft(spec, n=fl)
+    win = jnp.hanning(fl + 1)[:-1]
+    acc = _overlap_and_add(frames * win, fs)
+    norm = _overlap_and_add(jnp.broadcast_to(win * win, frames.shape), fs)
+    return acc / jnp.maximum(norm, 1e-12)

@@ -529,12 +529,26 @@ class SameDiff:
         return [env[o] for o in outputs]
 
     def _build_forward(self, output_names: Tuple[str, ...], ph_names: Tuple[str, ...]):
+        # CONSTANTS are closed over (static): shape chains that mix
+        # shape_of results with graph constants (e.g. a Const -1 in a
+        # computed reshape target) then stay trace-time concrete, which
+        # reshape_dynamic requires. Consistency: set_arr on a CONSTANT
+        # clears the whole jit cache, so baked values never go stale.
+        # VARIABLES stay arguments — fit() updates them without recompiles.
+        consts = {n: a for n, a in self.arrays.items()
+                  if self.vars[n].vtype == VariableType.CONSTANT}
+
         def fn(variables, placeholders):
-            env = dict(variables)
+            env = dict(consts)
+            env.update(variables)
             env.update(placeholders)
             return self._exec_graph(env, output_names)
 
         return jax.jit(fn)
+
+    def _non_constant_arrays(self) -> Dict[str, Any]:
+        return {n: a for n, a in self.arrays.items()
+                if self.vars[n].vtype != VariableType.CONSTANT}
 
     def output(self, placeholders: Dict[str, Any], *outputs: str):
         """Execute and return the requested outputs (reference
@@ -548,7 +562,7 @@ class SameDiff:
         key = (names, tuple(sorted(ph.keys())))
         if key not in self._jit_cache:
             self._jit_cache[key] = self._build_forward(names, tuple(sorted(ph.keys())))
-        res = self._jit_cache[key](self.arrays, ph)
+        res = self._jit_cache[key](self._non_constant_arrays(), ph)
         if as_map:
             return {n: np.asarray(r) for n, r in zip(names, res)}
         return res[0] if len(names) == 1 else res
@@ -829,7 +843,7 @@ class SameDiff:
         names = tuple(outputs)
         ph = {k: jnp.asarray(v) for k, v in placeholders.items()}
         fn = self._build_forward(names, tuple(sorted(ph.keys())))
-        lowered = fn.lower(self.arrays, ph)
+        lowered = fn.lower(self._non_constant_arrays(), ph)
         return lowered.as_text()
 
     # convenience summaries (reference sd.summary())

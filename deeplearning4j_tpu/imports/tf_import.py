@@ -362,7 +362,14 @@ class _GraphImporter:
                        transpose_b=self._attr(node, "adj_y", False))
             return
         if op == "Reshape":
-            shape = self._const(ins[1]).astype(np.int64)
+            try:
+                shape = self._const(ins[1]).astype(np.int64)
+            except ValueError:
+                # computed shape operand: defer to trace time — shape_of
+                # chains stay concrete there, so the reshape is still
+                # static for statically-shaped graphs
+                self._emit(node, "reshape_dynamic", ins[:2])
+                return
             self._emit(node, "reshape", ins[:1], shape=[int(s) for s in shape])
             return
         if op == "Transpose":
@@ -484,9 +491,63 @@ class _GraphImporter:
             return
         if op == "Conv2D":
             strides = self._attr(node, "strides", [1, 1, 1, 1])
+            dil = self._attr(node, "dilations", [1, 1, 1, 1])
             self._emit(node, "conv2d", ins[:2],
                        stride=[int(strides[1]), int(strides[2])],
+                       padding=self._attr(node, "padding", "SAME"),
+                       dilation=[int(dil[1]), int(dil[2])])
+            return
+        if op == "DepthwiseConv2dNative":
+            strides = self._attr(node, "strides", [1, 1, 1, 1])
+            dil = self._attr(node, "dilations", [1, 1, 1, 1])
+            if any(int(d) != 1 for d in dil):
+                raise NotImplementedError(
+                    f"DepthwiseConv2dNative {node.name!r} with dilation {dil}")
+            self._emit(node, "depthwise_conv2d", ins[:2],
+                       stride=[int(strides[1]), int(strides[2])],
                        padding=self._attr(node, "padding", "SAME"))
+            return
+        if op == "Conv2DBackpropInput":
+            # (output_sizes, filter, out_backprop) -> deconvolution; Keras
+            # Conv2DTranspose layers export as this op
+            dil = self._attr(node, "dilations", [1, 1, 1, 1])
+            if any(int(d) != 1 for d in dil):
+                raise NotImplementedError(
+                    f"Conv2DBackpropInput {node.name!r} with dilation {dil}")
+            out_shape = [int(s) for s in self._const(ins[0])]
+            strides = self._attr(node, "strides", [1, 1, 1, 1])
+            self._emit(node, "conv2d_transpose", [ins[2], ins[1]],
+                       stride=[int(strides[1]), int(strides[2])],
+                       padding=self._attr(node, "padding", "SAME"),
+                       output_shape=out_shape)
+            return
+        if op == "Einsum":
+            self._emit(node, "einsum", ins,
+                       equation=self._attr(node, "equation"))
+            return
+        if op == "AddN":
+            self._emit(node, "add_n", ins)
+            return
+        if op in ("ResizeBilinear", "ResizeNearestNeighbor"):
+            size = [int(s) for s in self._const(ins[1])]
+            if self._attr(node, "align_corners", False):
+                raise NotImplementedError(
+                    f"{op} {node.name!r} with align_corners=True; re-export "
+                    "with tf.image.resize (half-pixel centers)")
+            if op == "ResizeBilinear" and not self._attr(
+                    node, "half_pixel_centers", False):
+                raise NotImplementedError(
+                    f"ResizeBilinear {node.name!r} uses the legacy TF1 "
+                    "corner-aligned-origin sampling (half_pixel_centers="
+                    "False); re-export with tf.image.resize")
+            if op == "ResizeBilinear":
+                self._emit(node, "resize_bilinear", ins[:1],
+                           height=size[0], width=size[1])
+            else:
+                self._emit(node, "resize_nearest", ins[:1],
+                           height=size[0], width=size[1],
+                           half_pixel_centers=self._attr(
+                               node, "half_pixel_centers", False))
             return
         if op in ("MaxPool", "AvgPool"):
             k = self._attr(node, "ksize", [1, 2, 2, 1])

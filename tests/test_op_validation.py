@@ -538,6 +538,172 @@ CASES = {
     "replace_nans": ((np.where(_A > 1, np.nan, _A).astype(np.float32),),
                      {"value": 7.0},
                      lambda a: np.nan_to_num(a, nan=7.0), ()),
+    # wave 5: importer-generality + declarable-family tail (round 3)
+    "einsum": ((_A, _M), {"equation": "ij,jk->ik"},
+               lambda a, b: a @ b, (0, 1)),
+    "conv2d_transpose": ((_R.normal(0, 1, (2, 4, 4, 5)).astype(np.float32),
+                          _KER), {"stride": (2, 2), "padding": "SAME"},
+                         None, (0, 1)),
+    "reshape_dynamic": ((_A, np.array([4, 3], np.int32)), {},
+                        lambda a, s: a.reshape(4, 3), (0,)),
+    "add_n": ((_A, _B, _A), {}, lambda a, b, c: a + b + c, (0, 1, 2)),
+    "fft": ((_A,), {}, lambda a: np.fft.fft(a), ()),
+    "ifft": ((_A.astype(np.complex64),), {}, lambda a: np.fft.ifft(a), ()),
+    "rfft": ((_A,), {}, lambda a: np.fft.rfft(a), ()),
+    "irfft": ((np.fft.rfft(_A),), {}, lambda a: np.fft.irfft(a), ()),
+    "fft2d": ((_A,), {}, lambda a: np.fft.fft2(a), ()),
+    "ifft2d": ((_A.astype(np.complex64),), {}, lambda a: np.fft.ifft2(a), ()),
+    "dynamic_partition": ((_A, np.array([1, 0, 1], np.int32)),
+                          {"num_partitions": 2}, None, ()),
+    "dynamic_stitch": (([np.array([0, 2], np.int32),
+                         np.array([1, 3], np.int32)],
+                        _A[:2], _B[:2]), {},
+                       lambda idx, a, b: np.stack([a[0], b[0], a[1], b[1]]),
+                       ()),
+    "sequence_mask": ((np.array([1, 3, 2], np.int32),), {"maxlen": 4},
+                      lambda l: np.arange(4)[None, :] < l[:, None], ()),
+    "histogram_fixed_width": ((_A, np.array([-3.0, 3.0], np.float32)),
+                              {"nbins": 8}, None, ()),
+    "bincount": ((np.array([0, 1, 1, 3], np.int32),), {"size": 5},
+                 lambda a: np.bincount(a, minlength=5), ()),
+    # wave 6: declarable-set long tail
+    "xdivy": ((np.array([[0.0, 2.0]], np.float32), np.array([[0.0, 4.0]], np.float32)),
+              {}, lambda a, b: np.array([[0.0, 0.5]], np.float32), ()),
+    "multiply_no_nan": ((np.array([[np.inf, 2.0]], np.float32),
+                         np.array([[0.0, 3.0]], np.float32)), {},
+                        lambda a, b: np.array([[0.0, 6.0]], np.float32), ()),
+    "div_no_nan": ((_A, np.where(np.abs(_B) < 0.1, 0, _B).astype(np.float32)), {},
+                   lambda a, b: np.where(b == 0, 0, a / np.where(b == 0, 1, b)), ()),
+    "truncate_div": ((_A, _P), {}, lambda a, b: np.trunc(a / b), ()),
+    "truncate_mod": ((_A, _P), {}, lambda a, b: a - np.trunc(a / b) * b, ()),
+    "unravel_index": ((np.array([5, 7], np.int32),), {"shape": (3, 4)},
+                      lambda i: np.stack(np.unravel_index(i, (3, 4))), ()),
+    "rot90": ((_A,), {"k": 1}, lambda a: np.rot90(a), ()),
+    "diff": ((_A,), {}, lambda a: np.diff(a), (0,)),
+    "ediff1d": ((_A,), {}, lambda a: np.diff(a.ravel()), ()),
+    "percentile": ((_A,), {"q": 50.0}, lambda a: np.percentile(a, 50.0), ()),
+    "median": ((_A,), {}, lambda a: np.median(a), ()),
+    "nanmean": ((np.where(_A > 1, np.nan, _A).astype(np.float32),), {},
+                lambda a: np.nanmean(a), ()),
+    "nansum": ((np.where(_A > 1, np.nan, _A).astype(np.float32),), {},
+               lambda a: np.nansum(a), ()),
+    "nanmax": ((np.where(_A > 1, np.nan, _A).astype(np.float32),), {},
+               lambda a: np.nanmax(a), ()),
+    "nanmin": ((np.where(_A > 1, np.nan, _A).astype(np.float32),), {},
+               lambda a: np.nanmin(a), ()),
+    "nanvar": ((np.where(_A > 1, np.nan, _A).astype(np.float32),), {},
+               lambda a: np.nanvar(a), ()),
+    "nanstd": ((np.where(_A > 1, np.nan, _A).astype(np.float32),), {},
+               lambda a: np.nanstd(a), ()),
+    "allclose": ((_A, _A), {}, lambda a, b: np.bool_(True), ()),
+    "array_equal": ((_A, _B), {}, lambda a, b: np.bool_(False), ()),
+    "isin": ((_IDX, np.array([0, 2], np.int32)), {},
+             lambda a, t: np.isin(a, t), ()),
+    "take_along_axis": ((_A, np.argsort(_A, axis=-1).astype(np.int32)), {},
+                        lambda a, i: np.take_along_axis(a, i, -1), (0,)),
+    "repeat": ((_A,), {"repeats": 2, "axis": 0}, lambda a: np.repeat(a, 2, 0), (0,)),
+    "swapaxes": ((_A,), {}, lambda a: np.swapaxes(a, 0, 1), (0,)),
+    "moveaxis": ((_A,), {}, lambda a: np.moveaxis(a, 0, -1), (0,)),
+    "hstack": ((_A, _B), {}, lambda a, b: np.hstack([a, b]), (0, 1)),
+    "vstack": ((_A, _B), {}, lambda a, b: np.vstack([a, b]), (0, 1)),
+    "dstack": ((_A, _B), {}, lambda a, b: np.dstack([a, b]), (0, 1)),
+    "tri": ((3,), {}, lambda n: np.tri(3), ()),
+    "vander": ((_A[0],), {}, lambda a: np.vander(a), ()),
+    "inner": ((_A, _B), {}, lambda a, b: np.inner(a, b), (0, 1)),
+    "vdot": ((_A, _B), {}, lambda a, b: np.vdot(a, b), (0, 1)),
+    "matrix_transpose": ((_A,), {}, lambda a: a.T, (0,)),
+    "sinc": ((_A,), {}, lambda a: np.sinc(a), (0,)),
+    "log1mexp": ((_P,), {}, lambda a: np.log1p(-np.exp(-np.abs(a))), (0,)),
+    "erfinv": ((_U * 0.8,), {},
+               lambda a: __import__("torch").erfinv(
+                   __import__("torch").tensor(a)).numpy(), (0,)),
+    "nextafter": ((_A, _B), {}, lambda a, b: np.nextafter(a, b), ()),
+    "hardswish": ((_A,), {}, lambda a: a * np.clip(a + 3, 0, 6) / 6, (0,)),
+    "reduce_logsumexp": ((_A,), {"axis": -1},
+                         lambda a: np.log(np.exp(a).sum(-1)), (0,)),
+    "reduce_euclidean_norm": ((_A,), {"axis": -1},
+                              lambda a: np.sqrt((a * a).sum(-1)), (0,)),
+    "cummax": ((_A,), {"axis": 1}, lambda a: np.maximum.accumulate(a, 1), ()),
+    "cummin": ((_A,), {"axis": 1}, lambda a: np.minimum.accumulate(a, 1), ()),
+    "hard_shrink": ((_A,), {}, lambda a: np.where(np.abs(a) > 0.5, a, 0), ()),
+    "soft_shrink": ((_A,), {},
+                    lambda a: np.sign(a) * np.maximum(np.abs(a) - 0.5, 0), ()),
+    "kthvalue": ((_A,), {"k": 2}, lambda a: np.sort(a, -1)[:, 1], ()),
+    "batch_gather": ((_A, np.zeros((3, 2), np.int32)), {},
+                     lambda a, i: np.take_along_axis(a, i, 1), ()),
+    "adjoint": ((_A3,), {}, lambda a: a.T, (0,)),
+    "norm": ((_A,), {}, lambda a: np.linalg.norm(a), (0,)),
+    "pinv": ((_A3 + 3 * np.eye(3, dtype=np.float32),), {},
+             lambda a: np.linalg.pinv(a), ()),
+    "matrix_power": ((_A3,), {"n": 2}, lambda a: a @ a, ()),
+    "slogdet": ((_SPD,), {}, lambda a: np.linalg.slogdet(a), ()),
+    "expm": ((_A3 * 0.1,), {},
+             lambda a: __import__("torch").matrix_exp(
+                 __import__("torch").tensor(a)).numpy(), ()),
+    "matrix_diag_part": ((_SPD,), {}, lambda a: np.diagonal(a), (0,)),
+    "matrix_solve": ((_SPD, _RHS), {}, lambda a, b: np.linalg.solve(a, b), (1,)),
+    "cholesky_solve": ((_LOW, _RHS), {},
+                       lambda L, b: np.linalg.solve(L @ L.T, b), (1,)),
+    "lu_solve": ((_SPD, _RHS), {}, lambda a, b: np.linalg.solve(a, b), (1,)),
+    "tridiagonal_solve": ((np.array([[0, 1, 1]], np.float32),
+                           np.array([[4, 4, 4]], np.float32),
+                           np.array([[1, 1, 0]], np.float32),
+                           np.ones((1, 3, 1), np.float32)), {}, None, ()),
+    "invert_permutation": ((np.array([2, 0, 1], np.int32),), {},
+                           lambda p: np.argsort(p), ()),
+    "setdiff1d": ((np.array([1, 2, 3, 4], np.int32),
+                   np.array([2, 4], np.int32)), {}, None, ()),
+    "boolean_mask": ((_A, np.array([True, False, True])), {}, None, ()),
+    "unsorted_segment_max": ((np.array([[1, 2], [5, 6], [3, 4]], np.int32),
+                              np.array([1, 0, 1], np.int32)),
+                             {"num_segments": 2},
+                             lambda a, s: np.stack([a[1], np.maximum(a[0], a[2])]),
+                             ()),
+    "unsorted_segment_min": ((_A, np.array([1, 0, 1], np.int32)),
+                             {"num_segments": 2}, None, ()),
+    "unsorted_segment_prod": ((_A, np.array([1, 0, 1], np.int32)),
+                              {"num_segments": 2}, None, ()),
+    "unsorted_segment_mean": ((_A, np.array([1, 0, 1], np.int32)),
+                              {"num_segments": 2},
+                              lambda a, s: np.stack([a[1], (a[0] + a[2]) / 2]),
+                              ()),
+    "bucketize": ((_A,), {"boundaries": (-1.0, 0.0, 1.0)},
+                  lambda a: np.searchsorted([-1.0, 0.0, 1.0], a, side="right"),
+                  ()),
+    "tensor_scatter_update": ((_A, np.array([[0], [2]], np.int32), _B[:2]), {},
+                              None, ()),
+    "batch_to_space_nd": ((_R.normal(0, 1, (8, 2, 2, 3)).astype(np.float32),),
+                          {"block_shape": (2, 2)}, None, ()),
+    "space_to_batch_nd": ((_R.normal(0, 1, (2, 4, 4, 3)).astype(np.float32),),
+                          {"block_shape": (2, 2)}, None, ()),
+    "fake_quant_with_min_max_vars": ((_A,), {"vmin": -2.0, "vmax": 2.0}, None, ()),
+    "quantize": ((_A,), {"scale": 0.1}, None, ()),
+    "dequantize": ((np.array([[10, -3]], np.int8),), {"scale": 0.1},
+                   lambda q: q.astype(np.float32) * 0.1, ()),
+    "adjust_hue": ((_IMGP,), {"delta": 0.1}, None, ()),
+    "adjust_gamma": ((_IMGP,), {"gamma": 2.0},
+                     lambda i: i ** 2.0, (0,)),
+    "grayscale_to_rgb": ((_IMGP[..., :1],), {},
+                         lambda i: np.repeat(i, 3, -1), ()),
+    "per_image_standardization": ((_IMG,), {}, None, (0,)),
+    "total_variation": ((_IMGP,), {}, None, (0,)),
+    "extract_image_patches": ((_IMG,), {"ksizes": (1, 3, 3, 1)}, None, ()),
+    "col2im": ((_R.normal(0, 1, (1, 3, 3, 18)).astype(np.float32),),
+               {"out_h": 5, "out_w": 5, "kernel": (3, 3), "stride": (1, 1)},
+               None, (0,)),
+    "hann_window": ((8,), {}, lambda n: np.hanning(9)[:-1], ()),
+    "hamming_window": ((8,), {}, lambda n: np.hamming(9)[:-1], ()),
+    "blackman_window": ((8,), {}, lambda n: np.blackman(9)[:-1], ()),
+    "frame": ((np.arange(16, dtype=np.float32),),
+              {"frame_length": 8, "frame_step": 4},
+              lambda a: np.stack([a[0:8], a[4:12], a[8:16]]), ()),
+    "overlap_and_add": ((np.ones((3, 8), np.float32),), {"frame_step": 4},
+                        None, ()),
+    "stft": ((np.sin(np.arange(64, dtype=np.float32)),),
+             {"frame_length": 16, "frame_step": 8}, None, ()),
+    "istft": ((np.fft.rfft(np.sin(np.arange(64)).reshape(4, 16)
+                           * np.hanning(17)[:-1]).astype(np.complex64),),
+              {"frame_length": 16, "frame_step": 8}, None, ()),
 }
 
 
