@@ -266,9 +266,10 @@ def fuse_gelu_erf(sd: SameDiff) -> int:
 def optimize(sd: SameDiff) -> Dict[str, int]:
     """Run all passes to fixpoint; returns per-pass fusion counts."""
     stats = {"layer_norm": fuse_layer_norm(sd), "gelu_erf": fuse_gelu_erf(sd),
-             "attention": fuse_attention(sd),
-             "shape_folds": fold_shape_chains(sd)}
-    stats.update(optimize_layout(sd))
+             "attention": fuse_attention(sd)}
+    folded, shapes = _fold_shape_chains(sd)
+    stats["shape_folds"] = folded
+    stats.update(optimize_layout(sd, shapes=shapes))
     return stats
 
 
@@ -383,12 +384,26 @@ def _infer(sd: SameDiff, lead: Optional[int] = None):
 
 def infer_shapes(sd: SameDiff, lead: Optional[int] = None
                  ) -> Optional[Dict[str, Tuple[int, ...]]]:
-    """Shapes-only view of :func:`_infer` (None if nothing resolved)."""
+    """Shapes-only view of :func:`_infer`. Returns None — with a warning,
+    since the layout passes then silently lose their measured win — when
+    not a single op output could be resolved."""
     shapes, _ = _infer(sd, lead)
+    if sd.ops and not any(o in shapes for n in sd.ops for o in n.outputs):
+        import warnings
+        warnings.warn(
+            "graph_optimizer: shape inference resolved no op outputs; "
+            "layout passes skipped — imported 2-D matmul round trips will "
+            "keep their layout-conversion copies", stacklevel=2)
+        return None
     return shapes or None
 
 
 def fold_shape_chains(sd: SameDiff) -> int:
+    """Public wrapper of :func:`_fold_shape_chains` (count only)."""
+    return _fold_shape_chains(sd)[0]
+
+
+def _fold_shape_chains(sd: SameDiff):
     """Rewrite ``reshape_dynamic`` (tensor shape operand, emitted by the TF
     importer for computed shapes) into static ``reshape`` attrs using the
     propagated shape VALUES from :func:`_infer`.
@@ -396,9 +411,13 @@ def fold_shape_chains(sd: SameDiff) -> int:
     Dims that depend on a dynamic (None) placeholder dim are detected by
     inferring twice with two different substituted leading dims: entries
     whose value CHANGES between the runs become -1 in the rewritten attr
-    (jnp.reshape resolves one -1; chains needing more stay dynamic)."""
+    (jnp.reshape resolves one -1; chains needing more stay dynamic).
+
+    Returns ``(folded_count, shapes_or_None)`` — the first run's shapes are
+    handed back so optimize() can feed the layout passes without a third
+    full graph walk (the rewrite preserves every output's shape)."""
     if not any(n.op == "reshape_dynamic" for n in sd.ops):
-        return 0
+        return 0, None
     has_none = any(v.vtype == VariableType.PLACEHOLDER and v.shape
                    and any(d is None for d in v.shape)
                    for v in sd.vars.values())
@@ -406,7 +425,7 @@ def fold_shape_chains(sd: SameDiff) -> int:
                   if v.vtype == VariableType.PLACEHOLDER and v.shape
                   and v.shape[0] is not None]
     lead = max(set(known_lead), key=known_lead.count) if known_lead else 2
-    _, v1 = _infer(sd, lead=lead)
+    s1, v1 = _infer(sd, lead=lead)
     # the second run MUST use a different substituted dim or batch-dependent
     # entries would match across runs and get baked as static ints
     v2 = _infer(sd, lead=lead + 1)[1] if has_none else v1
@@ -428,7 +447,7 @@ def fold_shape_chains(sd: SameDiff) -> int:
     if folded:
         sd._jit_cache.clear()
         sd._graph_version += 1
-    return folded
+    return folded, s1
 
 
 def _new_array_var(sd: SameDiff, base: str) -> str:
@@ -565,9 +584,13 @@ def collapse_reshapes(sd: SameDiff, shapes: Dict[str, Tuple[int, ...]]) -> int:
             return changed
 
 
-def optimize_layout(sd: SameDiff) -> Dict[str, int]:
-    """Run the 2-D-matmul folding + reshape sinking/collapsing to fixpoint."""
-    shapes = infer_shapes(sd)
+def optimize_layout(sd: SameDiff,
+                    shapes: Optional[Dict[str, Tuple[int, ...]]] = None
+                    ) -> Dict[str, int]:
+    """Run the 2-D-matmul folding + reshape sinking/collapsing to fixpoint.
+    ``shapes`` may be handed in from an earlier _infer walk this round."""
+    if shapes is None:
+        shapes = infer_shapes(sd)
     if shapes is None:
         return {"layout_folds": 0}
     total = {"layout_folds": 0, "reshape_sinks": 0, "reshape_collapses": 0}

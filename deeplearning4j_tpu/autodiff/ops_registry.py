@@ -1868,13 +1868,21 @@ def _conv2d_transpose(y, w, stride=(1, 1), padding="SAME", output_shape=None):
 @register("reshape_dynamic")
 def _reshape_dynamic(a, shape):
     """Reshape with a TENSOR shape operand — the importer's fallback when a
-    TF Reshape's shape input is computed rather than Const. The values must
-    be trace-time concrete, which they are whenever the chain derives from
-    ``shape_of`` of statically-shaped tensors (shape_of returns a concrete
-    array at trace time); a genuinely data-dependent shape raises jax's
-    ConcretizationTypeError."""
+    TF Reshape's shape input is computed rather than Const. The graph
+    optimizer's ``fold_shape_chains`` statically evaluates such chains at
+    import time and rewrites this op to a plain ``reshape``; executing it
+    directly under jit only works when ``shape`` is concrete (it is not,
+    once any primitive has touched it inside a trace)."""
     import numpy as np
-    return jnp.reshape(a, tuple(int(s) for s in np.asarray(shape)))
+    try:
+        vals = np.asarray(shape)
+    except Exception as e:
+        raise NotImplementedError(
+            "reshape_dynamic with a traced shape operand: computed reshape "
+            "shapes must be folded statically first — run "
+            "graph_optimizer.fold_shape_chains (TFGraphMapper does this "
+            "when optimize=True) or make the shape a constant") from e
+    return jnp.reshape(a, tuple(int(s) for s in vals))
 
 
 @register("add_n")
@@ -1948,8 +1956,18 @@ def _dynamic_stitch(indices, *data, total=None):
     idx_list = indices if isinstance(indices, (list, tuple)) else [indices]
     n_pieces = len(idx_list)
     vals = data[:n_pieces]
-    total = int(total) if total is not None \
-        else sum(int(i.size) for i in idx_list)
+    if total is not None:
+        total = int(total)
+    else:
+        try:  # TF sizing: max index + 1 — needs concrete indices
+            import numpy as _np
+            total = max(int(_np.asarray(i).max())
+                        for i in idx_list if i.size) + 1
+        except Exception as e:
+            raise ValueError(
+                "dynamic_stitch under jit needs a static output size: pass "
+                "total= explicitly (TF sizes by max(indices)+1, which is "
+                "data-dependent)") from e
     out_shape = (total,) + tuple(vals[0].shape[idx_list[0].ndim:])
     out = jnp.zeros(out_shape, vals[0].dtype)
     for i, v in zip(idx_list, vals):

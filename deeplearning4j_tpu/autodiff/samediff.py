@@ -529,14 +529,16 @@ class SameDiff:
         return [env[o] for o in outputs]
 
     def _build_forward(self, output_names: Tuple[str, ...], ph_names: Tuple[str, ...]):
-        # CONSTANTS are closed over (static): shape chains that mix
-        # shape_of results with graph constants (e.g. a Const -1 in a
-        # computed reshape target) then stay trace-time concrete, which
-        # reshape_dynamic requires. Consistency: set_arr on a CONSTANT
-        # clears the whole jit cache, so baked values never go stale.
-        # VARIABLES stay arguments — fit() updates them without recompiles.
+        # SMALL INTEGER constants are closed over (static): shape chains
+        # that mix shape_of results with graph constants (e.g. a Const -1
+        # in a computed reshape target) then stay trace-time concrete,
+        # which reshape_dynamic requires. Big float constants (imported
+        # frozen weights) stay ARGUMENTS — baking them would duplicate the
+        # weight set into every cached executable as HLO literals.
+        # Consistency: set_arr on a CONSTANT clears the whole jit cache,
+        # so baked values never go stale.
         consts = {n: a for n, a in self.arrays.items()
-                  if self.vars[n].vtype == VariableType.CONSTANT}
+                  if self._baked_const(n)}
 
         def fn(variables, placeholders):
             env = dict(consts)
@@ -546,9 +548,16 @@ class SameDiff:
 
         return jax.jit(fn)
 
+    def _baked_const(self, name: str) -> bool:
+        if self.vars[name].vtype != VariableType.CONSTANT:
+            return False
+        a = self.arrays[name]
+        return a.size <= 64 and jnp.issubdtype(a.dtype, jnp.integer)
+
     def _non_constant_arrays(self) -> Dict[str, Any]:
+        """Arrays passed as executable arguments (everything not baked)."""
         return {n: a for n, a in self.arrays.items()
-                if self.vars[n].vtype != VariableType.CONSTANT}
+                if not self._baked_const(n)}
 
     def output(self, placeholders: Dict[str, Any], *outputs: str):
         """Execute and return the requested outputs (reference

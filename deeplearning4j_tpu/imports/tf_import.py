@@ -514,7 +514,10 @@ class _GraphImporter:
             if any(int(d) != 1 for d in dil):
                 raise NotImplementedError(
                     f"Conv2DBackpropInput {node.name!r} with dilation {dil}")
-            out_shape = [int(s) for s in self._const(ins[0])]
+            try:
+                out_shape = [int(s) for s in self._const(ins[0])]
+            except ValueError:
+                out_shape = None  # computed sizes: registry op validates shape
             strides = self._attr(node, "strides", [1, 1, 1, 1])
             self._emit(node, "conv2d_transpose", [ins[2], ins[1]],
                        stride=[int(strides[1]), int(strides[2])],
