@@ -264,9 +264,13 @@ def _map_layer(kl) -> Optional[object]:
     if cls == "Embedding":
         return EmbeddingSequenceLayer(n_in=cfg["input_dim"], n_out=cfg["output_dim"])
     if cls == "LSTM":
-        return LSTM(n_out=cfg["units"], activation=_act_name(kl.activation))
+        return LSTM(n_out=cfg["units"], activation=_act_name(kl.activation),
+                    gate_activation=_act_name(kl.recurrent_activation))
     if cls == "GRU":
-        return GRU(n_out=cfg["units"])
+        return GRU(n_out=cfg["units"],
+                   reset_after=cfg.get("reset_after", True),
+                   activation=_act_name(kl.activation),
+                   gate_activation=_act_name(kl.recurrent_activation))
     if cls == "SimpleRNN":
         return SimpleRnn(n_out=cfg["units"], activation=_act_name(kl.activation))
     if cls == "Bidirectional":
@@ -455,29 +459,15 @@ def _copy_weights(kl, layer, params: Dict[str, np.ndarray]) -> Dict:
         out["W"] = jnp.asarray(w[0])
     elif cls in ("LSTM", "GRU", "SimpleRNN"):
         # keras gate order LSTM [i,f,c,o] == ours [i,f,g,o]; GRU keras [z,r,h]
-        if cls == "GRU":
-            units = w[0].shape[1] // 3
-            # keras packs [z(update), r(reset), h]; ours packs [r, u, n]
-            def reorder(m):
-                z, r, h = np.split(m, 3, axis=-1)
-                return np.concatenate([r, z, h], axis=-1)
-            out["W"] = jnp.asarray(reorder(w[0]))
-            out["W_rec"] = jnp.asarray(reorder(w[1]))
-            if len(w) > 2:
-                b = w[2]
-                b = b.sum(axis=0) if b.ndim == 2 else b
-                out["b"] = jnp.asarray(reorder(b[None])[0])
-        else:
-            out["W"] = jnp.asarray(w[0])
-            out["W_rec"] = jnp.asarray(w[1])
-            if len(w) > 2:
-                out["b"] = jnp.asarray(w[2])
+        _assign_rnn(out, w, gru=(cls == "GRU"))
     elif cls == "Bidirectional":
         half = len(w) // 2
         fwd = dict(out.get("fwd", {}))
         bwd = dict(out.get("bwd", {}))
-        _assign_rnn(fwd, w[:half])
-        _assign_rnn(bwd, w[half:])
+        inner = getattr(kl, "layer", None) or kl.forward_layer
+        gru = type(inner).__name__ == "GRU"
+        _assign_rnn(fwd, w[:half], gru=gru)
+        _assign_rnn(bwd, w[half:], gru=gru)
         out["fwd"], out["bwd"] = fwd, bwd
     elif cls == "Conv1D":
         out["W"] = jnp.asarray(w[0][:, None, :, :])  # (k, in, out) -> (k, 1, in, out)
@@ -520,8 +510,26 @@ def _copy_weights(kl, layer, params: Dict[str, np.ndarray]) -> Dict:
     return out
 
 
-def _assign_rnn(d, w):
+def _assign_rnn(d, w, gru: bool = False):
     import jax.numpy as jnp
+    if gru:
+        # keras packs [z(update), r(reset), h]; ours packs [r, u, n]
+        def reorder(m):
+            z, r, h = np.split(m, 3, axis=-1)
+            return np.concatenate([r, z, h], axis=-1)
+        d["W"] = jnp.asarray(reorder(w[0]))
+        d["W_rec"] = jnp.asarray(reorder(w[1]))
+        if len(w) > 2:
+            b = w[2]
+            if b.ndim == 2:
+                # reset_after=True dual bias: input bias + RECURRENT bias —
+                # the latter sits inside the reset product for the n gate
+                # (CuDNN semantics), so it must stay separate
+                d["b"] = jnp.asarray(reorder(b[0][None])[0])
+                d["b_rec"] = jnp.asarray(reorder(b[1][None])[0])
+            else:
+                d["b"] = jnp.asarray(reorder(b[None])[0])
+        return
     d["W"] = jnp.asarray(w[0])
     d["W_rec"] = jnp.asarray(w[1])
     if len(w) > 2:
@@ -752,13 +760,12 @@ def _map_keras1_layer(cls: str, cfg: Dict):
                     gate_activation=cfg.get("inner_activation", "hard_sigmoid"))
     if cls == "GRU":
         # Keras 1 GRU is the reset-BEFORE variant (tanh(x_h + (r*h) @ U_h))
-        # with hard_sigmoid gates; our GRU is the reset-after/CuDNN cell —
-        # importing the weights would load without error but compute a
-        # different function, so refuse loudly.
-        raise NotImplementedError(
-            "Keras 1 GRU uses the reset-before cell variant, which this "
-            "framework's GRU does not implement; re-export the model with "
-            "Keras 2+ (reset_after=True) or use an LSTM")
+        # with hard_sigmoid gates — GRU(reset_after=False) implements
+        # exactly that cell (round 3; formerly refused)
+        return GRU(n_out=cfg["output_dim"],
+                   activation=_k1_act(cfg.get("activation", "tanh")),
+                   gate_activation=cfg.get("inner_activation", "hard_sigmoid"),
+                   reset_after=False)
     raise NotImplementedError(
         f"Keras 1 layer {cls!r} not mapped; extend keras_import.py")
 
@@ -837,6 +844,21 @@ def _import_keras1_h5(path: str):
                 p["W"] = jnp.asarray(np.concatenate([Wi, Wf, Wc, Wo], 1))
                 p["W_rec"] = jnp.asarray(np.concatenate([Ui, Uf, Uc, Uo], 1))
                 p["b"] = jnp.asarray(np.concatenate([bi, bf, bc, bo]))
+            elif cls == "GRU" and len(arrs) == 9:
+                # keras 1 GRU per-gate matrices [W_z,U_z,b_z, W_r,U_r,b_r,
+                # W_h,U_h,b_h]; ours packs [r, u(z), n(h)] (reset-before
+                # cell via GRU(reset_after=False))
+                Wz, Uz, bz, Wr, Ur, br, Wh, Uh, bh = arrs
+                p["W"] = jnp.asarray(np.concatenate([Wr, Wz, Wh], 1))
+                p["W_rec"] = jnp.asarray(np.concatenate([Ur, Uz, Uh], 1))
+                p["b"] = jnp.asarray(np.concatenate([br, bz, bh]))
+            elif cls in ("LSTM", "GRU"):
+                # silent fall-through would keep RANDOM init — refuse loudly
+                raise NotImplementedError(
+                    f"Keras 1 {cls} stored {len(arrs)} weight arrays; only "
+                    f"the per-gate layout ({12 if cls == 'LSTM' else 9} "
+                    "arrays, consume_less='cpu'/'mem') is supported — "
+                    "re-save the model with consume_less='cpu'")
             params[key] = p
         net.train_state = _dc.replace(net.train_state, params=params)
     return net

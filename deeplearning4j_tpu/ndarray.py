@@ -459,6 +459,194 @@ class INDArray:
     def to_double_vector(self):
         return self.numpy().astype(np.float64).reshape(-1).tolist()
 
+    # ---- round-3 surface tier (docs/indarray_parity.md tracks coverage) --
+    def permutei(self, *axes) -> "INDArray":
+        """In-place permute (reference ``permutei``): rebinds the wrapper
+        (views-are-copies deviation applies — no aliasing)."""
+        self.array = jnp.transpose(self.array, axes)
+        return self
+
+    def transposei(self) -> "INDArray":
+        self.array = self.array.T
+        return self
+
+    def reshapei(self, *shape) -> "INDArray":
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        self.array = self.array.reshape(shape)
+        return self
+
+    def raveli(self) -> "INDArray":
+        self.array = self.array.reshape(-1)
+        return self
+
+    def is_row_vector(self) -> bool:
+        return self.array.ndim == 1 or (self.array.ndim == 2
+                                        and self.array.shape[0] == 1)
+
+    def is_column_vector(self) -> bool:
+        return self.array.ndim == 2 and self.array.shape[1] == 1
+
+    def is_square(self) -> bool:
+        return self.array.ndim == 2 \
+            and self.array.shape[0] == self.array.shape[1]
+
+    def is_empty(self) -> bool:
+        return self.array.size == 0
+
+    def ordering(self) -> str:
+        return "c"  # XLA arrays expose row-major logical order
+
+    def stride(self) -> Tuple[int, ...]:
+        """Logical C-order strides in ELEMENTS (the reference reports
+        buffer strides; XLA's physical tiling is opaque by design)."""
+        s, acc = [], 1
+        for d in reversed(self.array.shape):
+            s.append(acc)
+            acc *= int(d)
+        return tuple(reversed(s))
+
+    def offset(self) -> int:
+        return 0  # no view offsets: views are copies
+
+    def broadcast_to(self, *shape) -> "INDArray":
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = shape[0]
+        return self.broadcast(*shape)
+
+    def repmat(self, *reps) -> "INDArray":
+        return INDArray(jnp.tile(self.array, reps))
+
+    def tile(self, *reps) -> "INDArray":
+        return self.repmat(*reps)
+
+    def sub_array(self, offsets, shape) -> "INDArray":
+        sel = tuple(slice(int(o), int(o) + int(s))
+                    for o, s in zip(offsets, shape))
+        return INDArray(self.array[sel])
+
+    def put_where(self, comp, put):
+        """Replace elements where ``comp`` (boolean mask INDArray/array)
+        with ``put`` (reference ``putWhere``)."""
+        self.array = jnp.where(jnp.asarray(_unwrap(comp), bool),
+                               _unwrap(put), self.array)
+        return self
+
+    def get_where(self, comp, default=0.0) -> "INDArray":
+        """Elements where comp holds, others replaced by ``default``
+        (static-shape stand-in for the reference's compacting getWhere)."""
+        return INDArray(jnp.where(jnp.asarray(_unwrap(comp), bool),
+                                  self.array, default))
+
+    def assign_if(self, value, comp) -> "INDArray":
+        return self.put_where(comp, value)
+
+    def fmod(self, other) -> "INDArray":
+        return INDArray(jnp.fmod(self.array, _unwrap(other)))
+
+    def fmodi(self, other) -> "INDArray":
+        self.array = jnp.fmod(self.array, _unwrap(other))
+        return self
+
+    def remainder(self, other) -> "INDArray":
+        return INDArray(jnp.remainder(self.array, _unwrap(other)))
+
+    def remainderi(self, other) -> "INDArray":
+        self.array = jnp.remainder(self.array, _unwrap(other))
+        return self
+
+    def rdivi_row_vector(self, v) -> "INDArray":
+        return self._i(self._rowv(v, lambda a, b: b / a))
+
+    def rsubi_row_vector(self, v) -> "INDArray":
+        return self._i(self._rowv(v, lambda a, b: b - a))
+
+    def divi_row_vector(self, v) -> "INDArray":
+        return self._i(self.div_row_vector(v))
+
+    def subi_row_vector(self, v) -> "INDArray":
+        return self._i(self.sub_row_vector(v))
+
+    def addi_column_vector(self, v) -> "INDArray":
+        return self._i(self.add_column_vector(v))
+
+    def subi_column_vector(self, v) -> "INDArray":
+        return self._i(self.sub_column_vector(v))
+
+    def muli_column_vector(self, v) -> "INDArray":
+        return self._i(self.mul_column_vector(v))
+
+    def divi_column_vector(self, v) -> "INDArray":
+        return self._i(self.div_column_vector(v))
+
+    def squared_distance(self, other) -> float:
+        d = self.array.reshape(-1) - _unwrap(other).reshape(-1)
+        return float(jnp.sum(d * d))
+
+    def distance2(self, other) -> float:
+        return float(np.sqrt(self.squared_distance(other)))
+
+    def distance1(self, other) -> float:
+        d = self.array.reshape(-1) - _unwrap(other).reshape(-1)
+        return float(jnp.sum(jnp.abs(d)))
+
+    def median_number(self) -> float:
+        return float(jnp.median(self.array))
+
+    def percentile_number(self, q: float) -> float:
+        return float(jnp.percentile(self.array, q))
+
+    def cumsumi(self, dim: int = -1) -> "INDArray":
+        self.array = jnp.cumsum(self.array, axis=dim)
+        return self
+
+    def cumprod(self, dim: int = -1) -> "INDArray":
+        return INDArray(jnp.cumprod(self.array, axis=dim))
+
+    def any(self) -> bool:
+        return bool(jnp.any(self.array))
+
+    def all(self) -> bool:
+        return bool(jnp.all(self.array))
+
+    def none(self) -> bool:
+        return not self.any()
+
+    def norm_max(self, *dims):
+        if not dims:
+            return INDArray(jnp.max(jnp.abs(self.array)))
+        return INDArray(jnp.max(jnp.abs(self.array),
+                                axis=tuple(int(d) for d in dims)))
+
+    def to_double_matrix(self):
+        return self.numpy().astype(np.float64).tolist()
+
+    def to_int_matrix(self):
+        return self.numpy().astype(np.int64).tolist()
+
+    def min_index(self) -> int:
+        return int(jnp.argmin(self.array))
+
+    def max_index(self) -> int:
+        return int(jnp.argmax(self.array))
+
+    def vectors_along_dimension(self, dim: int):
+        """Number of 1-D vectors along ``dim`` (reference
+        ``vectorsAlongDimension`` count)."""
+        return int(self.array.size // self.array.shape[dim])
+
+    def tensors_along_dimension(self, *dims) -> int:
+        keep = 1
+        for d in dims:
+            keep *= self.array.shape[d]
+        return int(self.array.size // keep)
+
+    def detach(self) -> "INDArray":
+        return self  # no workspaces: arrays are always detached
+
+    def leverage_to(self, _workspace=None) -> "INDArray":
+        return self  # workspace no-op (XLA owns memory)
+
     def __repr__(self):
         return f"INDArray{self.shape()}\n{np.asarray(self.array)}"
 

@@ -254,7 +254,17 @@ class SimpleRnn(BaseRecurrentLayer):
 @register_layer
 @dataclasses.dataclass
 class GRU(BaseRecurrentLayer):
-    """GRU with packed gates [r, u, n]."""
+    """GRU with packed gates [r, u, n].
+
+    ``reset_after=True`` (default) is the CuDNN/modern-Keras cell
+    (``n = tanh(x_n + r * (h @ U_n [+ b_rn]))``); ``reset_after=False`` is
+    the classic reset-BEFORE variant (``n = tanh(x_n + (r*h) @ U_n)``) —
+    Keras 1's GRU and Keras 2 with ``reset_after=False``. An optional
+    ``b_rec`` param (recurrent bias, CuDNN's second bias set) is applied
+    inside the reset product, matching Keras's dual-bias semantics."""
+
+    reset_after: bool = True
+    gate_activation: Any = "sigmoid"
 
     def init(self, key, input_type, g: GlobalConfig):
         n_in, H = self._nin(input_type), self.n_out
@@ -270,10 +280,16 @@ class GRU(BaseRecurrentLayer):
 
     def forward_with_carry(self, params, carry, x, *, training=False, rng=None, mask=None):
         H = self.n_out
+        gate = get_activation(self.gate_activation)
+        act = self._cell_act()
         zxs = jnp.swapaxes(x @ params["W"] + params["b"], 0, 1)  # hoisted
         ms = None if mask is None else jnp.swapaxes(mask, 0, 1)
+        b_rec = params.get("b_rec")
 
-        if mask is None and type(self) is GRU:
+        if mask is None and type(self) is GRU and self.reset_after \
+                and b_rec is None \
+                and gate is get_activation("sigmoid") \
+                and act is get_activation("tanh"):  # kernel's fixed cell
             from deeplearning4j_tpu.ops.pallas.fused_gru import (
                 fused_gru, fused_gru_compatible)
             (h0,) = carry
@@ -284,10 +300,22 @@ class GRU(BaseRecurrentLayer):
         def step(hs, inp):
             (h,) = hs
             zx = inp[0] if ms is not None else inp
-            zh = h @ params["W_rec"]
-            r = jax.nn.sigmoid(zx[:, :H] + zh[:, :H])
-            u = jax.nn.sigmoid(zx[:, H:2 * H] + zh[:, H:2 * H])
-            n = jnp.tanh(zx[:, 2 * H:] + r * zh[:, 2 * H:])
+            # reset-before only needs the r/u thirds of the recurrent
+            # matmul here — the n third runs on (r*h) below
+            W_ru = params["W_rec"] if self.reset_after \
+                else params["W_rec"][:, :2 * H]
+            zh = h @ W_ru
+            if b_rec is not None:
+                zh = zh + (b_rec if self.reset_after else b_rec[:2 * H])
+            r = gate(zx[:, :H] + zh[:, :H])
+            u = gate(zx[:, H:2 * H] + zh[:, H:2 * H])
+            if self.reset_after:
+                n = act(zx[:, 2 * H:] + r * zh[:, 2 * H:])
+            else:
+                zn = (r * h) @ params["W_rec"][:, 2 * H:]
+                if b_rec is not None:
+                    zn = zn + b_rec[2 * H:]
+                n = act(zx[:, 2 * H:] + zn)
             h_new = (1 - u) * n + u * h
             if ms is not None:
                 m = inp[1][:, None].astype(h.dtype)

@@ -175,3 +175,65 @@ def test_number_reductions_and_misc():
     np.testing.assert_array_equal(a.diag().numpy(), [1.0, -4.0])
     assert a.pad((1, 1), (0, 0)).shape() == (4, 2)
     assert a.to_int_vector() == [1, -2, 3, -4]
+
+
+def test_round3_surface_tier():
+    """Round-3 INDArray additions: in-place reshape family, predicates,
+    vector-op completions, where-family, distances, index helpers."""
+    a = Nd4j.create(np.arange(12, dtype=np.float32).reshape(3, 4))
+    # in-place reshape family rebinds the wrapper
+    b = a.dup().permutei(1, 0)
+    assert b.shape() == (4, 3)
+    assert a.dup().transposei().shape() == (4, 3)
+    assert a.dup().reshapei(4, 3).shape() == (4, 3)
+    assert a.dup().raveli().shape() == (12,)
+    # predicates
+    assert Nd4j.create(np.ones((1, 5), np.float32)).is_row_vector()
+    assert Nd4j.create(np.ones((5, 1), np.float32)).is_column_vector()
+    assert Nd4j.eye(3).is_square() and not a.is_square()
+    assert a.ordering() == "c" and a.offset() == 0
+    assert a.stride() == (4, 1)
+    # broadcasting helpers
+    assert a.get_row(0).broadcast_to(3, 4).shape() == (3, 4)
+    assert a.repmat(2, 1).shape() == (6, 4)
+    assert a.sub_array((1, 1), (2, 2)).shape() == (2, 2)
+    np.testing.assert_allclose(a.sub_array((1, 1), (2, 2)).numpy(),
+                               np.arange(12).reshape(3, 4)[1:3, 1:3])
+    # where family
+    w = a.dup().put_where(a.numpy() > 5, 0.0)
+    assert w.numpy().max() == 5
+    g = a.get_where(a.numpy() > 5, default=-1.0)
+    assert (g.numpy() == -1).sum() == 6
+    # row/col in-place completions
+    r = np.array([1, 2, 3, 4], np.float32)
+    np.testing.assert_allclose(a.dup().subi_row_vector(r).numpy(),
+                               a.numpy() - r)
+    np.testing.assert_allclose(a.dup().divi_row_vector(r).numpy(),
+                               a.numpy() / r)
+    np.testing.assert_allclose(a.dup().rsubi_row_vector(r).numpy(),
+                               r - a.numpy())
+    c = np.array([1, 2, 4], np.float32)
+    np.testing.assert_allclose(a.dup().addi_column_vector(c).numpy(),
+                               a.numpy() + c[:, None])
+    np.testing.assert_allclose(a.dup().divi_column_vector(c).numpy(),
+                               a.numpy() / c[:, None])
+    # distances / stats
+    z = Nd4j.zeros(3, 4)
+    assert a.squared_distance(z) == pytest.approx((np.arange(12) ** 2).sum())
+    assert a.distance1(z) == pytest.approx(np.arange(12).sum())
+    assert a.median_number() == pytest.approx(5.5)
+    assert a.percentile_number(50) == pytest.approx(5.5)
+    assert a.norm_max().item() == 11
+    # index helpers
+    assert a.max_index() == 11 and a.min_index() == 0
+    assert a.vectors_along_dimension(1) == 3
+    assert a.tensors_along_dimension(0) == 4
+    # misc
+    np.testing.assert_allclose(a.dup().cumsumi(0).numpy(),
+                               np.cumsum(a.numpy(), 0))
+    np.testing.assert_allclose(a.cumprod(1).numpy(),
+                               np.cumprod(a.numpy(), 1))
+    assert (a.gt(5)).any() and not (a.gt(100)).any()
+    assert a.gte(0).all() and a.gt(100).none()
+    np.testing.assert_allclose(a.fmod(5.0).numpy(), np.fmod(a.numpy(), 5.0))
+    assert a.detach() is a and a.leverage_to(None) is a
