@@ -24,7 +24,9 @@ LENET_GOLDEN = [2.247756, 2.208591, 2.171265, 2.144371, 2.125517,
 # default (was inheriting global identity)
 LSTM_GOLDEN = [2.502273, 2.483148, 2.465421, 2.448907, 2.433449,
                2.418909, 2.405141, 2.391999]
-BERT_GOLDEN = [1.120854, 0.853812, 1.011297, 0.875949, 1.091719, 1.224608]
+# re-recorded in round 3: dropout masks moved from threefry to the rbg
+# generator (intentional perf change, BASELINE.md), changing dropout draws
+BERT_GOLDEN = [1.090776, 1.286131, 1.276235, 0.919525, 1.136208, 1.11544]
 
 _TOL = dict(rtol=2e-3, atol=2e-3)
 
