@@ -2313,3 +2313,193 @@ def _istft(spec, frame_length=256, frame_step=128):
     acc = _overlap_and_add(frames * win, fs)
     norm = _overlap_and_add(jnp.broadcast_to(win * win, frames.shape), fs)
     return acc / jnp.maximum(norm, 1e-12)
+
+
+# ------------------------------------------------------- registry wave 7
+# (round 3 cont.: math/complex/loss tails + the reference's native updater
+# ops — upstream org.nd4j.linalg.learning applied as single fused ops)
+
+register("cbrt")(jnp.cbrt)
+register("log2")(jnp.log2)
+register("log10")(jnp.log10)
+register("logaddexp")(jnp.logaddexp)
+register("logaddexp2")(jnp.logaddexp2)
+register("hypot")(jnp.hypot)
+register("copysign")(jnp.copysign)
+register("deg2rad")(jnp.deg2rad)
+register("rad2deg")(jnp.rad2deg)
+register("heaviside")(jnp.heaviside)
+register("signbit")(jnp.signbit)
+register("float_power")(jnp.float_power)
+register("gammaln")(lambda a: jax.scipy.special.gammaln(a))
+register("betaln")(lambda a, b: jax.scipy.special.betaln(a, b))
+register("factorial")(lambda n: jnp.exp(jax.scipy.special.gammaln(n + 1.0)))
+register("i0")(lambda a: jax.scipy.special.i0(a))
+register("i0e")(lambda a: jax.scipy.special.i0e(a))
+register("i1")(lambda a: jax.scipy.special.i1(a))
+register("i1e")(lambda a: jax.scipy.special.i1e(a))
+register("exprel")(lambda a: jnp.where(jnp.abs(a) < 1e-6, 1.0 + a / 2,
+                                       jnp.expm1(a) / jnp.where(
+                                           jnp.abs(a) < 1e-6, 1.0, a)))
+register("squareplus")(lambda a, b=4.0: 0.5 * (a + jnp.sqrt(a * a + b)))
+register("angle")(jnp.angle)
+register("real")(jnp.real)
+register("imag")(jnp.imag)
+register("conj")(jnp.conj)
+register("complex")(lambda re, im: jax.lax.complex(re, im))
+register("polar")(lambda mag, ang: jax.lax.complex(mag * jnp.cos(ang),
+                                                   mag * jnp.sin(ang)))
+register("clamp")(lambda a, lo=0.0, hi=1.0: jnp.clip(a, lo, hi))
+register("fix")(jnp.trunc)
+register("fliplr")(jnp.fliplr)
+register("flipud")(jnp.flipud)
+register("lerp")(lambda a, b, t=0.5: a + (b - a) * t)
+register("addcmul")(lambda a, b, c, value=1.0: a + value * b * c)
+register("addcdiv")(lambda a, b, c, value=1.0: a + value * b / c)
+register("round_half_to_even")(jnp.round)  # jnp.round IS banker's rounding
+register("isneginf")(jnp.isneginf)
+register("isposinf")(jnp.isposinf)
+register("population_count")(lambda a: lax.population_count(
+    a.astype(jnp.uint32)).astype(jnp.int32))
+register("bitwise_not")(jnp.bitwise_not)
+@register("eye_like")
+def _eye_like(a):
+    if a.ndim < 2:
+        raise ValueError(f"eye_like needs rank>=2, got shape {a.shape}")
+    e = jnp.eye(a.shape[-2], a.shape[-1], dtype=a.dtype)
+    return jnp.broadcast_to(e, a.shape)
+register("tril_indices")(lambda n, k=0: jnp.stack(jnp.tril_indices(int(n), int(k))))
+register("triu_indices")(lambda n, k=0: jnp.stack(jnp.triu_indices(int(n), int(k))))
+register("in1d")(lambda a, b: jnp.isin(a, b))
+register("list_diff")(lambda a, b: OPS["setdiff1d"](a, b))
+
+
+@register("unique_counts")
+def _unique_counts(a, size=None):
+    """unique values + counts, zero-padded to ``size`` (default a.size) —
+    the XLA static-shape contract (jnp.unique with size=)."""
+    n = int(size) if size is not None else int(a.size)
+    vals, counts = jnp.unique(a.reshape(-1), size=n, fill_value=0,
+                              return_counts=True)
+    return vals, counts
+
+
+@register("global_norm")
+def _global_norm(*tensors):
+    return jnp.sqrt(sum(jnp.sum(jnp.square(t.astype(jnp.float32)))
+                        for t in tensors))
+
+
+@register("renorm")
+def _renorm(a, p=2.0, axis=0, maxnorm=1.0):
+    """Clip the p-norm of each slice along ``axis`` to maxnorm (torch-style
+    renorm; the reference's per-row constraint op)."""
+    axes = tuple(i for i in range(a.ndim) if i != (axis % a.ndim))
+    norms = jnp.sum(jnp.abs(a) ** p, axis=axes, keepdims=True) ** (1.0 / p)
+    scale = jnp.where(norms > maxnorm, maxnorm / jnp.maximum(norms, 1e-12), 1.0)
+    return a * scale
+
+
+@register("clip_by_average_norm")
+def _clip_by_average_norm(a, clip_norm=1.0):
+    # TF semantics: scale so the AVERAGE (per-element) L2 norm is at most
+    # clip_norm; unchanged when avg <= clip_norm
+    avg = jnp.sqrt(jnp.sum(jnp.square(a))) / a.size
+    return a * (clip_norm / jnp.maximum(avg, clip_norm))
+
+
+# -- loss tail --
+@register("binary_cross_entropy")
+def _binary_cross_entropy(labels, probs, eps=1e-7):
+    p = jnp.clip(probs, eps, 1.0 - eps)
+    return -jnp.mean(labels * jnp.log(p) + (1.0 - labels) * jnp.log1p(-p))
+
+
+register("cross_entropy_with_logits")(
+    lambda labels, logits: -jnp.mean(jnp.sum(
+        labels * jax.nn.log_softmax(logits, axis=-1), axis=-1)))
+
+
+@register("focal_loss")
+def _focal_loss(labels, logits, gamma=2.0, alpha=0.25):
+    p = jax.nn.sigmoid(logits)
+    ce = -(labels * jnp.log(jnp.clip(p, 1e-7, 1.0))
+           + (1 - labels) * jnp.log(jnp.clip(1 - p, 1e-7, 1.0)))
+    pt = labels * p + (1 - labels) * (1 - p)
+    w = (labels * alpha + (1 - labels) * (1 - alpha)) * (1 - pt) ** gamma
+    return jnp.mean(w * ce)
+
+
+@register("dice_loss")
+def _dice_loss(labels, probs, eps=1.0):
+    num = 2.0 * jnp.sum(labels * probs) + eps
+    den = jnp.sum(labels) + jnp.sum(probs) + eps
+    return 1.0 - num / den
+
+
+@register("smooth_l1_loss")
+def _smooth_l1_loss(labels, preds, beta=1.0):
+    d = jnp.abs(preds - labels)
+    return jnp.mean(jnp.where(d < beta, 0.5 * d * d / beta, d - 0.5 * beta))
+
+
+@register("margin_ranking_loss")
+def _margin_ranking_loss(x1, x2, y, margin=0.0):
+    return jnp.mean(jnp.maximum(0.0, -y * (x1 - x2) + margin))
+
+
+@register("cosine_embedding_loss")
+def _cosine_embedding_loss(x1, x2, y, margin=0.0):
+    cos = jnp.sum(x1 * x2, -1) / jnp.maximum(
+        jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1), 1e-12)
+    return jnp.mean(jnp.where(y > 0, 1.0 - cos,
+                              jnp.maximum(0.0, cos - margin)))
+
+
+# -- native updater ops (reference org.nd4j.linalg.learning.*Updater as
+# fused ops: take (param, grad, state...) -> (new_param, new_state...)) --
+@register("sgd_update")
+def _sgd_update(param, grad, lr=0.01):
+    return param - lr * grad
+
+
+@register("momentum_update")
+def _momentum_update(param, grad, v, lr=0.01, momentum=0.9, nesterov=False):
+    v_new = momentum * v + grad
+    step = (momentum * v_new + grad) if nesterov else v_new
+    return param - lr * step, v_new
+
+
+@register("adam_update")
+def _adam_update(param, grad, m, v, t, lr=1e-3, beta1=0.9, beta2=0.999,
+                 eps=1e-8):
+    t = t + 1
+    m_new = beta1 * m + (1 - beta1) * grad
+    v_new = beta2 * v + (1 - beta2) * grad * grad
+    mhat = m_new / (1 - beta1 ** t)
+    vhat = v_new / (1 - beta2 ** t)
+    return param - lr * mhat / (jnp.sqrt(vhat) + eps), m_new, v_new, t
+
+
+@register("adagrad_update")
+def _adagrad_update(param, grad, accum, lr=0.01, eps=1e-8):
+    # eps INSIDE the sqrt — the reference AdaGradUpdater's form; outside
+    # it, a near-zero state gives first steps ~1/eps larger
+    accum_new = accum + grad * grad
+    return param - lr * grad / jnp.sqrt(accum_new + eps), accum_new
+
+
+@register("rmsprop_update")
+def _rmsprop_update(param, grad, ms, lr=0.001, decay=0.9, eps=1e-8):
+    # eps INSIDE the sqrt (reference RmsPropUpdater)
+    ms_new = decay * ms + (1 - decay) * grad * grad
+    return param - lr * grad / jnp.sqrt(ms_new + eps), ms_new
+
+
+@register("lars_update")
+def _lars_update(param, grad, lr=0.01, trust=0.001, weight_decay=0.0):
+    g = grad + weight_decay * param
+    pn = jnp.linalg.norm(param.reshape(-1))
+    gn = jnp.linalg.norm(g.reshape(-1))
+    local_lr = jnp.where(gn > 0, trust * pn / jnp.maximum(gn, 1e-12), 1.0)
+    return param - lr * local_lr * g

@@ -704,6 +704,86 @@ CASES = {
     "istft": ((np.fft.rfft(np.sin(np.arange(64)).reshape(4, 16)
                            * np.hanning(17)[:-1]).astype(np.complex64),),
               {"frame_length": 16, "frame_step": 8}, None, ()),
+    # wave 7: math/complex/loss tails + native updater ops
+    "cbrt": ((_A,), {}, np.cbrt, (0,)),
+    "log2": ((_P,), {}, np.log2, (0,)),
+    "log10": ((_P,), {}, np.log10, (0,)),
+    "logaddexp": ((_A, _B), {}, np.logaddexp, (0, 1)),
+    "logaddexp2": ((_A, _B), {}, np.logaddexp2, (0, 1)),
+    "hypot": ((_P, _P.T.copy().T), {}, np.hypot, ()),
+    "copysign": ((_A, _B), {}, np.copysign, ()),
+    "deg2rad": ((_A,), {}, np.deg2rad, (0,)),
+    "rad2deg": ((_A,), {}, np.rad2deg, (0,)),
+    "heaviside": ((_A, np.float32(0.5)), {}, np.heaviside, ()),
+    "signbit": ((_A,), {}, np.signbit, ()),
+    "float_power": ((_P, np.float32(2.0)), {}, np.float_power, ()),
+    "gammaln": ((_P,), {},
+                lambda a: pytest.importorskip("torch").lgamma(
+                    pytest.importorskip("torch").tensor(a)).numpy(), (0,)),
+    "betaln": ((_P, _P + 0.5), {}, None, ()),
+    "factorial": ((np.array([1.0, 2.0, 3.0, 4.0], np.float32),), {},
+                  lambda n: np.array([1, 2, 6, 24], np.float32), ()),
+    "i0": ((_A,), {}, np.i0, ()),
+    "i0e": ((_A,), {}, None, ()),
+    "i1": ((_A,), {}, None, ()),
+    "i1e": ((_A,), {}, None, ()),
+    "exprel": ((_A,), {}, lambda a: np.expm1(a) / a, ()),
+    "squareplus": ((_A,), {}, lambda a: 0.5 * (a + np.sqrt(a * a + 4)), (0,)),
+    "angle": ((_A.astype(np.complex64) + 1j * _B,), {}, np.angle, ()),
+    "real": ((_A.astype(np.complex64) + 1j * _B,), {}, np.real, ()),
+    "imag": ((_A.astype(np.complex64) + 1j * _B,), {}, np.imag, ()),
+    "conj": ((_A.astype(np.complex64) + 1j * _B,), {}, np.conj, ()),
+    "complex": ((_A, _B), {}, lambda a, b: a + 1j * b, ()),
+    "polar": ((_P, _A), {}, lambda m, a: m * np.cos(a) + 1j * m * np.sin(a), ()),
+    "clamp": ((_A,), {"lo": -0.5, "hi": 0.5}, lambda a: np.clip(a, -0.5, 0.5), ()),
+    "fix": ((_A,), {}, np.fix, ()),
+    "fliplr": ((_A,), {}, np.fliplr, (0,)),
+    "flipud": ((_A,), {}, np.flipud, (0,)),
+    "lerp": ((_A, _B), {"t": 0.3}, lambda a, b: a + 0.3 * (b - a), (0, 1)),
+    "addcmul": ((_A, _B, _A), {"value": 0.5}, lambda a, b, c: a + 0.5 * b * c,
+                (0, 1, 2)),
+    "addcdiv": ((_A, _B, _P), {"value": 0.5}, lambda a, b, c: a + 0.5 * b / c,
+                (0, 1)),
+    "round_half_to_even": ((np.array([0.5, 1.5, 2.5], np.float32),), {},
+                           lambda a: np.array([0.0, 2.0, 2.0], np.float32), ()),
+    "isneginf": ((np.array([-np.inf, 0.0], np.float32),), {}, np.isneginf, ()),
+    "isposinf": ((np.array([np.inf, 0.0], np.float32),), {}, np.isposinf, ()),
+    "population_count": ((np.array([0, 1, 3, 255], np.int32),), {},
+                         lambda a: np.array([0, 1, 2, 8], np.int32), ()),
+    "bitwise_not": ((np.array([0, -1, 5], np.int32),), {}, np.bitwise_not, ()),
+    "eye_like": ((_A,), {}, lambda a: np.eye(3, 4, dtype=np.float32), ()),
+    "tril_indices": ((3,), {}, lambda n: np.stack(np.tril_indices(3)), ()),
+    "triu_indices": ((3,), {}, lambda n: np.stack(np.triu_indices(3)), ()),
+    "in1d": ((_IDX, np.array([0, 2], np.int32)), {},
+             lambda a, b: np.isin(a, b), ()),
+    "list_diff": ((np.array([1, 2, 3, 4], np.int32),
+                   np.array([2, 4], np.int32)), {}, None, ()),
+    "unique_counts": ((np.array([3, 1, 3, 2, 1, 3], np.int32),), {"size": 6},
+                      None, ()),
+    "global_norm": ((_A, _B), {},
+                    lambda a, b: np.sqrt((a * a).sum() + (b * b).sum()), ()),
+    "renorm": ((_A,), {"p": 2.0, "axis": 0, "maxnorm": 1.0}, None, (0,)),
+    "clip_by_average_norm": ((_A,), {"clip_norm": 0.01}, None, ()),
+    "binary_cross_entropy": ((_U, _U), {},
+                             lambda y, p: float(-(y * np.log(p)
+                                                 + (1 - y) * np.log1p(-p)).mean()),
+                             (1,)),
+    "cross_entropy_with_logits": ((_LABELS, _LOGITS), {}, None, (1,)),
+    "focal_loss": (((_A > 0).astype(np.float32), _B), {}, None, (1,)),
+    "dice_loss": (((_A > 0).astype(np.float32), _U), {}, None, (1,)),
+    "smooth_l1_loss": ((_A, _B), {}, None, (1,)),
+    "margin_ranking_loss": ((_A[0], _B[0],
+                             np.sign(_A[1]).astype(np.float32)),
+                            {"margin": 0.1}, None, ()),
+    "cosine_embedding_loss": ((_A, _B, np.sign(_A[:, 0]).astype(np.float32)),
+                              {}, None, ()),
+    "sgd_update": ((_A, _B), {"lr": 0.1}, lambda p, g: p - 0.1 * g, ()),
+    "momentum_update": ((_A, _B, np.zeros_like(_A)), {"lr": 0.1}, None, ()),
+    "adam_update": ((_A, _B, np.zeros_like(_A), np.zeros_like(_A),
+                     np.int32(0)), {}, None, ()),
+    "adagrad_update": ((_A, _B, np.zeros_like(_A)), {}, None, ()),
+    "rmsprop_update": ((_A, _B, np.zeros_like(_A)), {}, None, ()),
+    "lars_update": ((_A, _B), {}, None, ()),
 }
 
 
