@@ -532,7 +532,9 @@ class TransformProcess:
             self._schema = schema
             self._steps: List[_Step] = []
 
-        def _add(self, kind, **args):
+        def _add(self, kind, /, **args):
+            # positional-only: step args may legitimately be NAMED "kind"
+            # (normalize's kind=...) without colliding
             self._steps.append(_Step(kind, args))
             return self
 
@@ -859,6 +861,19 @@ TransformProcess.Builder.offset_sequence = lambda self, columns, offset: \
 _SEQUENCE_STEPS = {"convert_to_sequence", "offset_sequence"}
 
 
+def _apply_one_step(st: "_Step", schema: Schema, recs, is_seq: bool):
+    """Apply one transform step with sequence-mode dispatch; shared by the
+    serial and parallel executors so their semantics cannot diverge."""
+    if st.kind == "convert_to_sequence":
+        recs = st.apply_records(schema, recs)
+        is_seq = True
+    elif is_seq and st.kind not in _SEQUENCE_STEPS:
+        recs = [st.apply_records(schema, seq) for seq in recs]
+    else:
+        recs = st.apply_records(schema, recs)
+    return recs, st.apply_schema(schema), is_seq
+
+
 class LocalTransformExecutor:
     """Reference ``org.datavec.local.transforms.LocalTransformExecutor``.
 
@@ -871,20 +886,104 @@ class LocalTransformExecutor:
         schema = tp.initial_schema
         is_seq = False
         for st in tp.steps:
-            if st.kind == "convert_to_sequence":
-                recs = st.apply_records(schema, recs)
-                is_seq = True
-            elif is_seq and st.kind not in _SEQUENCE_STEPS:
-                recs = [st.apply_records(schema, seq) for seq in recs]
-            else:
-                recs = st.apply_records(schema, recs)
-            schema = st.apply_schema(schema)
+            recs, schema, is_seq = _apply_one_step(st, schema, recs, is_seq)
         return recs
 
     @staticmethod
     def execute_join(left: Iterable[List[Any]], right: Iterable[List[Any]],
                      join: Join) -> List[List[Any]]:
         return join.execute([list(r) for r in left], [list(r) for r in right])
+
+
+def _apply_stage(payload):
+    """Worker body for ParallelTransformExecutor: run a chain of row-local
+    steps over one partition (module-level so it pickles)."""
+    steps, schema, part = payload
+    for st in steps:
+        part = st.apply_records(schema, part)
+        schema = st.apply_schema(schema)
+    return part
+
+
+class ParallelTransformExecutor:
+    """Multi-process TransformProcess execution — the local-cluster analog
+    of the reference's ``SparkTransformExecutor`` (upstream
+    ``org.datavec.spark.transform.SparkTransformExecutor``), the same way
+    the reference tested its Spark ETL with ``local[N]`` masters.
+
+    Consecutive ROW-LOCAL steps (column edits, math ops, filters) form a
+    stage that runs over record partitions in a process pool; steps that
+    need the whole dataset (normalize's stats, group-by reduce, sequence
+    conversion) run between stages on the merged records — the shuffle
+    boundary of the Spark original. Like Spark's serializable-function
+    requirement, parallel execution needs picklable step args; a stage
+    that fails to pickle (lambda predicates) silently degrades to the
+    serial executor, preserving results."""
+
+    ROW_LOCAL = {"remove_columns", "remove_all_columns_except",
+                 "rename_column", "categorical_to_integer",
+                 "categorical_to_one_hot", "conditional_replace",
+                 "double_math_op", "filter", "map_records"}
+
+    @staticmethod
+    def execute(records: Iterable[List[Any]], tp: TransformProcess,
+                num_workers: Optional[int] = None,
+                min_partition: int = 256) -> List[List[Any]]:
+        import concurrent.futures as cf
+        import os
+        import pickle
+
+        recs = [list(r) for r in records]
+        schema = tp.initial_schema
+        nw = num_workers or min(8, os.cpu_count() or 1)
+        i, steps = 0, list(tp.steps)
+        is_seq = False
+        pool = None  # ONE pool reused across stages (spawn cost is real)
+        try:
+            while i < len(steps):
+                stage = []
+                while i < len(steps) and not is_seq \
+                        and steps[i].kind in ParallelTransformExecutor.ROW_LOCAL:
+                    stage.append(steps[i])
+                    i += 1
+                if stage:
+                    parts_n = max(1, min(nw, len(recs) // max(min_partition, 1)))
+                    runnable = parts_n > 1
+                    if runnable:
+                        try:
+                            pickle.dumps((stage, schema))
+                        except Exception:
+                            runnable = False
+                    if runnable:
+                        bounds = [len(recs) * j // parts_n
+                                  for j in range(parts_n + 1)]
+                        payloads = [(stage, schema,
+                                     recs[bounds[j]:bounds[j + 1]])
+                                    for j in range(parts_n)]
+                        try:
+                            if pool is None:
+                                pool = cf.ProcessPoolExecutor(max_workers=nw)
+                            out = list(pool.map(_apply_stage, payloads))
+                            recs = [r for part in out for r in part]
+                        except Exception:
+                            # the serial-fallback CONTRACT covers worker-side
+                            # pickling/import failures too, not just the
+                            # stage-args probe above
+                            recs = _apply_stage((stage, schema, recs))
+                    else:
+                        recs = _apply_stage((stage, schema, recs))
+                    for st in stage:
+                        schema = st.apply_schema(schema)
+                    continue
+                st = steps[i]
+                i += 1
+                recs, schema, is_seq = _apply_one_step(st, schema, recs, is_seq)
+        finally:
+            if pool is not None:
+                pool.shutdown()
+        return recs
+
+    execute_join = LocalTransformExecutor.execute_join
 
 
 # -------------------------------------------------- iterator bridge to training

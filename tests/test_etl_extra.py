@@ -194,3 +194,37 @@ def test_sequence_iterator_align_end(tmp_path):
     np.testing.assert_array_equal(ds.features_mask, [[1, 1, 1], [0, 0, 1]])
     np.testing.assert_array_equal(ds.features[1, 2], [7.0, 8.0])  # at the END
     np.testing.assert_array_equal(ds.features[1, 0], [0.0, 0.0])
+
+
+def test_parallel_transform_executor_matches_serial():
+    """ParallelTransformExecutor (the SparkTransformExecutor local-cluster
+    analog) must produce exactly the serial executor's output — row-local
+    stages fan out over processes, global steps run at the merge."""
+    from deeplearning4j_tpu.data.records import (LocalTransformExecutor,
+                                                 ParallelTransformExecutor,
+                                                 Schema, TransformProcess)
+    schema = (Schema.builder()
+              .add_column_double("a").add_column_double("b")
+              .add_column_categorical("c", ["x", "y", "z"]).build())
+    tp = (TransformProcess.builder(schema)
+          .double_math_op("a", "add", 1.0)
+          .categorical_to_integer("c")
+          .remove_columns(["b"])
+          .normalize("a", "minmax")
+          .build())
+    rng = __import__("numpy").random.default_rng(0)
+    records = [[float(rng.normal()), float(rng.normal()),
+                ["x", "y", "z"][int(rng.integers(0, 3))]]
+               for _ in range(3000)]
+    serial = LocalTransformExecutor.execute(records, tp)
+    par = ParallelTransformExecutor.execute(records, tp, num_workers=4,
+                                            min_partition=100)
+    assert par == serial
+    # non-picklable stage (lambda filter) degrades to serial, same result
+    tp2 = (TransformProcess.builder(schema)
+           .filter(lambda r: r["a"] > 0)
+           .double_math_op("a", "multiply", 2.0)
+           .build())
+    assert ParallelTransformExecutor.execute(records, tp2, num_workers=4,
+                                             min_partition=100) \
+        == LocalTransformExecutor.execute(records, tp2)
