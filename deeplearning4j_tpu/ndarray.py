@@ -700,7 +700,7 @@ class Nd4j:
 
     @staticmethod
     def value_array_of(shape, value) -> INDArray:
-        return INDArray(jnp.full(tuple(shape), value, jnp.float32))
+        return Nd4j.full(shape, value)
 
     @staticmethod
     def eye(n: int) -> INDArray:
@@ -725,6 +725,84 @@ class Nd4j:
     @classmethod
     def randn(cls, *shape) -> INDArray:
         return INDArray(jax.random.normal(cls._next_key(), shape, jnp.float32))
+
+    # -- round-3 factory tier (docs/indarray_parity.md) --
+    @staticmethod
+    def zeros_like(a) -> INDArray:
+        return INDArray(jnp.zeros_like(_unwrap(a)))
+
+    @staticmethod
+    def ones_like(a) -> INDArray:
+        return INDArray(jnp.ones_like(_unwrap(a)))
+
+    @staticmethod
+    def full(shape, value, dtype=jnp.float32) -> INDArray:
+        return INDArray(jnp.full(shape, value, dtype))  # int or tuple shape
+
+    @staticmethod
+    def empty(dtype=jnp.float32) -> INDArray:
+        return INDArray(jnp.zeros((0,), dtype))
+
+    @classmethod
+    def rand_int(cls, high, *shape) -> INDArray:
+        return INDArray(jax.random.randint(cls._next_key(), shape, 0,
+                                           int(high), jnp.int32))
+
+    @classmethod
+    def shuffle(cls, a) -> INDArray:
+        """Row-shuffled COPY (reference Nd4j.shuffle mutates; functional
+        deviation consistent with views-are-copies)."""
+        arr = _unwrap(a)
+        return INDArray(jax.random.permutation(cls._next_key(), arr, axis=0))
+
+    @classmethod
+    def choice(cls, source, n: int) -> INDArray:
+        src = _unwrap(source).reshape(-1)
+        return INDArray(jax.random.choice(cls._next_key(), src, (int(n),)))
+
+    @staticmethod
+    def append(a, pad: int, value, axis: int = -1) -> INDArray:
+        arr = _unwrap(a)
+        widths = [(0, 0)] * arr.ndim
+        widths[axis] = (0, int(pad))
+        return INDArray(jnp.pad(arr, widths, constant_values=value))
+
+    @staticmethod
+    def prepend(a, pad: int, value, axis: int = -1) -> INDArray:
+        arr = _unwrap(a)
+        widths = [(0, 0)] * arr.ndim
+        widths[axis] = (int(pad), 0)
+        return INDArray(jnp.pad(arr, widths, constant_values=value))
+
+    @staticmethod
+    def rot90(a, k: int = 1) -> INDArray:
+        return INDArray(jnp.rot90(_unwrap(a), int(k)))
+
+    @staticmethod
+    def flip(a, *axes) -> INDArray:
+        return INDArray(jnp.flip(_unwrap(a), axes or None))
+
+    @staticmethod
+    def diag(a, k: int = 0) -> INDArray:
+        """Vector -> diagonal matrix, matrix/batch -> diagonal vector(s).
+        Delegates to INDArray.diag for k=0 (one source of truth)."""
+        if k == 0:
+            return INDArray(_unwrap(a)).diag()
+        return INDArray(jnp.diag(_unwrap(a), int(k)))
+
+    @staticmethod
+    def repeat(a, repeats: int, axis: Optional[int] = None) -> INDArray:
+        arr = INDArray(_unwrap(a))
+        return arr.repeat(axis, int(repeats)) if axis is not None \
+            else INDArray(jnp.repeat(arr.array, int(repeats)))
+
+    @staticmethod
+    def tile(a, *reps) -> INDArray:
+        return INDArray(_unwrap(a)).tile(*reps)
+
+    @staticmethod
+    def cumsum(a, axis: int = -1) -> INDArray:
+        return INDArray(_unwrap(a)).cumsum(axis)
 
     # -- combination --
     @staticmethod

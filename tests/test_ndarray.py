@@ -237,3 +237,31 @@ def test_round3_surface_tier():
     assert a.gte(0).all() and a.gt(100).none()
     np.testing.assert_allclose(a.fmod(5.0).numpy(), np.fmod(a.numpy(), 5.0))
     assert a.detach() is a and a.leverage_to(None) is a
+
+
+def test_round3_factory_tier():
+    a = Nd4j.create(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert Nd4j.zeros_like(a).numpy().sum() == 0
+    assert Nd4j.ones_like(a).numpy().sum() == 6
+    assert (Nd4j.full((2, 2), 7.0).numpy() == 7).all()
+    assert Nd4j.empty().length() == 0
+    r = Nd4j.rand_int(10, 4, 5)
+    assert r.shape() == (4, 5) and (r.numpy() >= 0).all() and (r.numpy() < 10).all()
+    s = Nd4j.shuffle(a)
+    assert sorted(map(tuple, s.numpy().tolist())) == sorted(map(tuple, a.numpy().tolist()))
+    c = Nd4j.choice(a, 10)
+    assert c.shape() == (10,) and set(c.numpy()) <= set(a.numpy().ravel())
+    ap = Nd4j.append(a, 2, -1.0, axis=1)
+    assert ap.shape() == (2, 5) and (ap.numpy()[:, 3:] == -1).all()
+    pp = Nd4j.prepend(a, 1, 0.0, axis=0)
+    assert pp.shape() == (3, 3) and (pp.numpy()[0] == 0).all()
+    np.testing.assert_allclose(Nd4j.rot90(a).numpy(), np.rot90(a.numpy()))
+    np.testing.assert_allclose(Nd4j.flip(a, 1).numpy(), a.numpy()[:, ::-1])
+    np.testing.assert_allclose(Nd4j.diag(Nd4j.create(np.array([1.0, 2.0]))).numpy(),
+                               np.diag([1.0, 2.0]))
+    v = Nd4j.diag(a.get(NDArrayIndex.interval(0, 2), NDArrayIndex.interval(0, 2)))
+    assert v.shape() == (2,)
+    np.testing.assert_allclose(Nd4j.repeat(a, 2, axis=0).numpy(),
+                               np.repeat(a.numpy(), 2, 0))
+    assert Nd4j.tile(a, 2, 1).shape() == (4, 3)
+    np.testing.assert_allclose(Nd4j.cumsum(a, 1).numpy(), np.cumsum(a.numpy(), 1))
