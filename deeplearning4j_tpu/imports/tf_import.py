@@ -339,6 +339,17 @@ class _GraphImporter:
             "Tanh": "tanh", "Sigmoid": "sigmoid", "Relu": "relu", "Relu6": "relu6",
             "Elu": "elu", "Selu": "selu", "Softplus": "softplus", "Softsign": "softsign",
             "Sin": "sin", "Cos": "cos", "Tan": "tan",
+            "Asin": "asin", "Acos": "acos", "Atan": "atan",
+            "Sinh": "sinh", "Cosh": "cosh", "Atan2": "atan2",
+            "Asinh": "asinh", "Acosh": "acosh", "Atanh": "atanh",
+            "Expm1": "expm1", "Erfc": "erfc", "Lgamma": "gammaln",
+            "Digamma": "digamma", "Rint": "rint", "Xlogy": "xlogy",
+            "Xdivy": "xdivy", "DivNoNan": "div_no_nan",
+            "MulNoNan": "multiply_no_nan", "TruncateDiv": "truncate_div",
+            "TruncateMod": "truncate_mod", "Inv": "reciprocal",
+            "InvertPermutation": "invert_permutation",
+            "Cholesky": "cholesky",
+            "MatrixDeterminant": "matrix_determinant",
             "Greater": "gt", "GreaterEqual": "gte", "Less": "lt", "LessEqual": "lte",
             "Equal": "eq", "NotEqual": "neq", "LogicalAnd": "logical_and",
             "LogicalOr": "logical_or", "LogicalNot": "logical_not",
@@ -527,6 +538,67 @@ class _GraphImporter:
         if op == "Einsum":
             self._emit(node, "einsum", ins,
                        equation=self._attr(node, "equation"))
+            return
+        if op == "LeakyRelu":
+            self._emit(node, "leaky_relu", ins,
+                       alpha=self._attr(node, "alpha", 0.2))
+            return
+        if op in ("Cumsum", "Cumprod"):
+            if self._attr(node, "exclusive", False) \
+                    or self._attr(node, "reverse", False):
+                raise NotImplementedError(
+                    f"{op} {node.name!r} with exclusive/reverse")
+            axis = int(self._const(ins[1]))
+            self._emit(node, op.lower(), ins[:1], axis=axis)
+            return
+        if op in ("DepthToSpace", "SpaceToDepth"):
+            if self._attr(node, "data_format", "NHWC") != "NHWC":
+                raise NotImplementedError(
+                    f"{op} {node.name!r} with data_format != NHWC")
+            self._emit(node,
+                       "depth_to_space" if op == "DepthToSpace"
+                       else "space_to_depth",
+                       ins[:1], block_size=self._attr(node, "block_size", 2))
+            return
+        if op == "MatrixBandPart":
+            self._emit(node, "matrix_band_part", ins[:1],
+                       num_lower=int(self._const(ins[1])),
+                       num_upper=int(self._const(ins[2])))
+            return
+        if op in ("MatrixDiag", "MatrixDiagV2", "MatrixDiagV3"):
+            if len(ins) > 1:  # V2/V3 carry (k, num_rows, num_cols, padding)
+                k = int(np.atleast_1d(self._const(ins[1]))[0])
+                extras = [int(np.atleast_1d(self._const(i))[0])
+                          for i in ins[2:4] if i]
+                if k != 0 or any(e not in (-1,) for e in extras):
+                    raise NotImplementedError(
+                        f"{op} {node.name!r} with k={k}/explicit dims")
+            self._emit(node, "matrix_diag", ins[:1])
+            return
+        if op in ("MatrixDiagPart", "MatrixDiagPartV2", "MatrixDiagPartV3"):
+            if len(ins) > 1:
+                k = int(np.atleast_1d(self._const(ins[1]))[0])
+                if k != 0:
+                    raise NotImplementedError(
+                        f"{op} {node.name!r} with k={k}")
+            self._emit(node, "matrix_diag_part", ins[:1])
+            return
+        if op == "MatrixInverse":
+            if self._attr(node, "adjoint", False):
+                raise NotImplementedError(
+                    f"MatrixInverse {node.name!r} with adjoint=True")
+            self._emit(node, "matrix_inverse", ins[:1])
+            return
+        if op == "ReverseV2":
+            axes = [int(a) for a in np.atleast_1d(self._const(ins[1]))]
+            self._emit(node, "reverse", ins[:1], axis=axes)
+            return
+        if op == "TopKV2":
+            k = int(self._const(ins[1]))
+            vars_ = [sd.vars[self._ensure_var(ins[0])]]
+            outs = sd._apply("top_k", vars_, attrs={"k": k},
+                             name=node.name, n_outputs=2)
+            self._name_outputs(node, outs if isinstance(outs, tuple) else (outs,))
             return
         if op == "AddN":
             self._emit(node, "add_n", ins)

@@ -761,18 +761,19 @@ class Nd4j:
         return INDArray(jax.random.choice(cls._next_key(), src, (int(n),)))
 
     @staticmethod
-    def append(a, pad: int, value, axis: int = -1) -> INDArray:
+    def _pad_edge(a, pad: int, value, axis: int, before: bool) -> INDArray:
         arr = _unwrap(a)
         widths = [(0, 0)] * arr.ndim
-        widths[axis] = (0, int(pad))
+        widths[axis] = (int(pad), 0) if before else (0, int(pad))
         return INDArray(jnp.pad(arr, widths, constant_values=value))
 
     @staticmethod
+    def append(a, pad: int, value, axis: int = -1) -> INDArray:
+        return Nd4j._pad_edge(a, pad, value, axis, before=False)
+
+    @staticmethod
     def prepend(a, pad: int, value, axis: int = -1) -> INDArray:
-        arr = _unwrap(a)
-        widths = [(0, 0)] * arr.ndim
-        widths[axis] = (int(pad), 0)
-        return INDArray(jnp.pad(arr, widths, constant_values=value))
+        return Nd4j._pad_edge(a, pad, value, axis, before=True)
 
     @staticmethod
     def rot90(a, k: int = 1) -> INDArray:

@@ -748,3 +748,41 @@ def test_keras2_bidirectional_gru_golden(tmp_path):
     want = model(x).numpy()
     got = np.asarray(net.output(x))
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_tf_import_round3_simple_op_batch(tmp_path):
+    """Round-3 simple-op mappings: trig/special tails, LeakyRelu, Cumsum,
+    DepthToSpace, ReverseV2, TopKV2, matrix ops — golden vs TF."""
+    tf = pytest.importorskip("tensorflow")
+    import numpy as np
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+    from deeplearning4j_tpu.imports import TFGraphMapper
+
+    rng = np.random.default_rng(0)
+
+    def model(x, img):
+        a = tf.math.asinh(x) + tf.math.atan2(x, x + 2.0)
+        a = tf.nn.leaky_relu(a, alpha=0.3) + tf.math.expm1(x * 0.1)
+        a = tf.cumsum(a, axis=1) + tf.math.xdivy(x, tf.math.rint(x))
+        a = tf.reverse(a, axis=[1])
+        vals, idx = tf.math.top_k(a, k=2)
+        d = tf.nn.depth_to_space(img, 2)
+        return a, vals, tf.cast(idx, tf.float32), d
+
+    conc = tf.function(model).get_concrete_function(
+        tf.TensorSpec((3, 5), tf.float32, name="x"),
+        tf.TensorSpec((2, 4, 4, 8), tf.float32, name="img"))
+    frozen = convert_variables_to_constants_v2(conc)
+    gd = frozen.graph.as_graph_def()
+    out_names = [t.name.split(":")[0] for t in frozen.outputs]
+
+    x = rng.normal(0, 1, (3, 5)).astype(np.float32)
+    img = rng.normal(0, 1, (2, 4, 4, 8)).astype(np.float32)
+    wants = [t.numpy() for t in model(tf.constant(x), tf.constant(img))]
+    sd = TFGraphMapper.import_graph(gd)
+    feeds = {"x": x, "img": img}
+    # outputs may share names with :N suffixes; fetch one by one
+    for want, name in zip(wants, out_names):
+        got = np.asarray(sd.output(feeds, name))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
