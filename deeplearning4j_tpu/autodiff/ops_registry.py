@@ -2503,3 +2503,292 @@ def _lars_update(param, grad, lr=0.01, trust=0.001, weight_decay=0.0):
     gn = jnp.linalg.norm(g.reshape(-1))
     local_lr = jnp.where(gn > 0, trust * pn / jnp.maximum(gn, 1e-12), 1.0)
     return param - lr * local_lr * g
+
+
+# ------------------------------------------------------- registry wave 8
+# (round 3 final: image colorspace/crop/augment family, statistics, polynomial/
+# signal math, scatter variants — crossing the reference's ~500-op scale)
+
+import numpy as _np
+
+# numpy (host) constants: module import must not allocate device buffers
+_YIQ = _np.array([[0.299, 0.587, 0.114],
+                  [0.59590059, -0.27455667, -0.32134392],
+                  [0.21153661, -0.52273617, 0.31119955]], _np.float32)
+_YUV = _np.array([[0.299, 0.587, 0.114],
+                  [-0.14714119, -0.28886916, 0.43601035],
+                  [0.61497538, -0.51496512, -0.10001026]], _np.float32)
+
+_YIQ_INV = _np.linalg.inv(_YIQ)
+_YUV_INV = _np.linalg.inv(_YUV)
+
+register("rgb_to_yiq")(lambda img: img @ _YIQ.T.astype(img.dtype))
+register("yiq_to_rgb")(lambda img: img @ _YIQ_INV.T.astype(img.dtype))
+register("rgb_to_yuv")(lambda img: img @ _YUV.T.astype(img.dtype))
+register("yuv_to_rgb")(lambda img: img @ _YUV_INV.T.astype(img.dtype))
+
+
+@register("central_crop")
+def _central_crop(img, fraction=1.0):
+    h, w = img.shape[-3], img.shape[-2]
+    ch, cw = int(round(h * fraction)), int(round(w * fraction))
+    top, left = (h - ch) // 2, (w - cw) // 2
+    return img[..., top:top + ch, left:left + cw, :]
+
+
+@register("pad_to_bounding_box")
+def _pad_to_bounding_box(img, offset_height=0, offset_width=0,
+                         target_height=None, target_width=None):
+    h, w = img.shape[-3], img.shape[-2]
+    th, tw = int(target_height), int(target_width)
+    pads = [(0, 0)] * (img.ndim - 3) + [
+        (int(offset_height), th - h - int(offset_height)),
+        (int(offset_width), tw - w - int(offset_width)), (0, 0)]
+    return jnp.pad(img, pads)
+
+
+@register("resize_with_crop_or_pad")
+def _resize_with_crop_or_pad(img, target_height=None, target_width=None):
+    h, w = img.shape[-3], img.shape[-2]
+    th, tw = int(target_height), int(target_width)
+    if h > th:
+        top = (h - th) // 2
+        img = img[..., top:top + th, :, :]
+    if w > tw:
+        left = (w - tw) // 2
+        img = img[..., :, left:left + tw, :]
+    h, w = img.shape[-3], img.shape[-2]
+    if h < th or w < tw:
+        img = _pad_to_bounding_box(img, (th - h) // 2, (tw - w) // 2, th, tw)
+    return img
+
+
+@register("random_crop")
+def _random_crop(img, size=(), seed=0):
+    size = tuple(int(s) for s in size)
+    key = _key(seed)
+    starts = []
+    for dim, s in zip(img.shape, size):
+        key, sub = jax.random.split(key)
+        starts.append(jax.random.randint(sub, (), 0, dim - s + 1))
+    return jax.lax.dynamic_slice(img, starts, size)
+
+
+@register("random_flip_left_right")
+def _random_flip_left_right(img, seed=0):
+    flip = jax.random.bernoulli(_key(seed), 0.5)
+    return jnp.where(flip, img[..., :, ::-1, :], img)
+
+
+@register("random_brightness")
+def _random_brightness(img, max_delta=0.1, seed=0):
+    delta = jax.random.uniform(_key(seed), (), minval=-max_delta,
+                               maxval=max_delta)
+    return img + delta.astype(img.dtype)
+
+
+@register("random_contrast")
+def _random_contrast(img, lower=0.8, upper=1.2, seed=0):
+    f = jax.random.uniform(_key(seed), (), minval=lower, maxval=upper)
+    mean = jnp.mean(img, axis=(-3, -2), keepdims=True)
+    return (img - mean) * f.astype(img.dtype) + mean
+
+
+@register("sobel_edges")
+def _sobel_edges(img):
+    """(B, H, W, C) -> (B, H, W, C, 2) [dy, dx] (tf.image.sobel_edges)."""
+    ky = jnp.array([[-1, -2, -1], [0, 0, 0], [1, 2, 1]], img.dtype)
+    kx = ky.T
+    c = img.shape[-1]
+    k = jnp.stack([ky, kx], -1)                      # (3,3,2)
+    k = jnp.tile(k[:, :, None, :], (1, 1, c, 1))     # (3,3,C,2)
+    pad = jnp.pad(img, ((0, 0), (1, 1), (1, 1), (0, 0)), mode="reflect")
+    out = jax.lax.conv_general_dilated(
+        pad, k.reshape(3, 3, 1, c * 2), (1, 1), "VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"), feature_group_count=c)
+    return out.reshape(img.shape + (2,))
+
+
+@register("image_gradients")
+def _image_gradients(img):
+    dy = jnp.concatenate([img[:, 1:] - img[:, :-1],
+                          jnp.zeros_like(img[:, :1])], axis=1)
+    dx = jnp.concatenate([img[:, :, 1:] - img[:, :, :-1],
+                          jnp.zeros_like(img[:, :, :1])], axis=2)
+    return dy, dx
+
+
+@register("draw_bounding_boxes")
+def _draw_bounding_boxes(img, boxes, color=1.0):
+    """Burn box OUTLINES into images; boxes (B, N, 4) normalized
+    [ymin, xmin, ymax, xmax] (tf.image.draw_bounding_boxes semantics)."""
+    b, h, w, c = img.shape
+    ys = jnp.arange(h)[None, :, None]  # (1,H,1)
+    xs = jnp.arange(w)[None, None, :]  # (1,1,W)
+    out = img
+    for i in range(boxes.shape[1]):
+        y0 = jnp.round(boxes[:, i, 0] * (h - 1))[:, None, None]
+        x0 = jnp.round(boxes[:, i, 1] * (w - 1))[:, None, None]
+        y1 = jnp.round(boxes[:, i, 2] * (h - 1))[:, None, None]
+        x1 = jnp.round(boxes[:, i, 3] * (w - 1))[:, None, None]
+        in_y = (ys >= y0) & (ys <= y1)
+        in_x = (xs >= x0) & (xs <= x1)
+        edge = (in_y & in_x) & ((ys == y0) | (ys == y1)
+                                | (xs == x0) | (xs == x1))
+        out = jnp.where(edge[..., None], color, out)
+    return out
+
+
+@register("psnr")
+def _psnr(a, b, max_val=1.0):
+    mse = jnp.mean(jnp.square(a.astype(jnp.float32) - b.astype(jnp.float32)),
+                   axis=(-3, -2, -1))
+    return 10.0 * jnp.log10(max_val * max_val / jnp.maximum(mse, 1e-12))
+
+
+@register("ssim")
+def _ssim(a, b, max_val=1.0, filter_size=11, k1=0.01, k2=0.03):
+    """Mean SSIM with a uniform window (TF uses Gaussian; uniform keeps the
+    kernel fully in-registry — documented approximation)."""
+    c1, c2 = (k1 * max_val) ** 2, (k2 * max_val) ** 2
+    f = int(filter_size)
+    win = (1, f, f, 1)
+
+    def mean_pool(x):
+        return lax.reduce_window(x, 0.0, lax.add, win, (1, 1, 1, 1),
+                                 "VALID") / (f * f)
+
+    af, bf = a.astype(jnp.float32), b.astype(jnp.float32)
+    mu_a, mu_b = mean_pool(af), mean_pool(bf)
+    var_a = mean_pool(af * af) - mu_a * mu_a
+    var_b = mean_pool(bf * bf) - mu_b * mu_b
+    cov = mean_pool(af * bf) - mu_a * mu_b
+    s = ((2 * mu_a * mu_b + c1) * (2 * cov + c2)) / (
+        (mu_a * mu_a + mu_b * mu_b + c1) * (var_a + var_b + c2))
+    return jnp.mean(s, axis=(-3, -2, -1))
+
+
+# -- statistics --
+@register("mode")
+def _mode(a):
+    vals, counts = jnp.unique(a.reshape(-1), size=int(a.size),
+                              fill_value=0, return_counts=True)
+    return vals[jnp.argmax(counts)]
+
+
+@register("skewness")
+def _skewness(a, axis=None):
+    m = jnp.mean(a, axis=axis, keepdims=True)
+    s = jnp.std(a, axis=axis, keepdims=True)
+    return jnp.mean(((a - m) / jnp.maximum(s, 1e-12)) ** 3, axis=axis)
+
+
+@register("kurtosis")
+def _kurtosis(a, axis=None, fisher=True):
+    m = jnp.mean(a, axis=axis, keepdims=True)
+    s = jnp.std(a, axis=axis, keepdims=True)
+    k = jnp.mean(((a - m) / jnp.maximum(s, 1e-12)) ** 4, axis=axis)
+    return k - 3.0 if fisher else k
+
+
+@register("weighted_mean")
+def _weighted_mean(a, weights, axis=None):
+    return jnp.sum(a * weights, axis=axis) / jnp.sum(weights, axis=axis)
+
+
+@register("pearson_correlation")
+def _pearson_correlation(a, b):
+    af, bf = a.reshape(-1), b.reshape(-1)
+    am, bm = af - jnp.mean(af), bf - jnp.mean(bf)
+    return jnp.sum(am * bm) / jnp.maximum(
+        jnp.linalg.norm(am) * jnp.linalg.norm(bm), 1e-12)
+
+
+@register("covariance_matrix")
+def _covariance_matrix(a, rowvar=False, ddof=1):
+    """Columns (rowvar=False) are variables, rows observations."""
+    x = a if rowvar else a.T
+    x = x - jnp.mean(x, axis=1, keepdims=True)
+    n = x.shape[1]
+    return (x @ x.T) / max(n - int(ddof), 1)
+
+
+@register("correlation_matrix")
+def _correlation_matrix(a, rowvar=False):
+    c = _covariance_matrix(a, rowvar=rowvar)
+    d = jnp.sqrt(jnp.diagonal(c))
+    return c / jnp.maximum(jnp.outer(d, d), 1e-12)
+
+
+# -- polynomial / signal math --
+register("polyval")(lambda coeffs, x: jnp.polyval(coeffs, x))
+register("interp")(lambda x, xp, fp: jnp.interp(x, xp, fp))
+register("gradient")(lambda a, axis=None: (jnp.gradient(a) if axis is None
+                                           else jnp.gradient(a, axis=axis)))
+register("trapz")(lambda y, dx=1.0: jnp.trapezoid(y, dx=dx))
+register("convolve")(lambda a, v, mode="full": jnp.convolve(a, v, mode=mode))
+register("correlate")(lambda a, v, mode="full": jnp.correlate(a, v, mode=mode))
+register("toeplitz")(lambda c, r=None: jax.scipy.linalg.toeplitz(
+    c, r if r is not None else c))
+register("block_diag")(lambda *ms: jax.scipy.linalg.block_diag(*ms))
+register("cond")(lambda a, p=None: jnp.linalg.cond(a, p))
+register("matrix_rank")(lambda a: jnp.linalg.matrix_rank(a))
+register("multi_dot")(lambda *ms: jnp.linalg.multi_dot(ms))
+register("log_matrix_determinant")(OPS["slogdet"])  # TF alias
+register("softmax_cross_entropy_with_logits_v2")(
+    lambda labels, logits: -jnp.sum(
+        labels * jax.nn.log_softmax(logits, axis=-1), axis=-1))
+
+
+@register("pad_sequences")
+def _pad_sequences(seqs, maxlen=None, value=0.0):
+    """List of 1-D arrays -> (N, maxlen) right-padded matrix (keras util /
+    reference sequence-batching helper)."""
+    seqs = [jnp.asarray(s).reshape(-1) for s in seqs]
+    m = int(maxlen) if maxlen is not None else max(int(s.shape[0]) for s in seqs)
+    out = jnp.full((len(seqs), m), value, seqs[0].dtype)  # keep int token ids
+    for i, s in enumerate(seqs):
+        k = min(int(s.shape[0]), m)
+        out = out.at[i, :k].set(s[:k].astype(out.dtype))
+    return out
+
+
+@register("ctc_greedy_decoder")
+def _ctc_greedy_decoder(log_probs, blank=0):
+    """(T, B, V) log-probs -> (B, T) best-path labels with repeats+blanks
+    collapsed, padded with -1, plus (B,) lengths (static-shape contract)."""
+    path = jnp.argmax(log_probs, axis=-1).T      # (B, T)
+    prev = jnp.concatenate([jnp.full_like(path[:, :1], -1), path[:, :-1]], 1)
+    keep = (path != blank) & (path != prev)
+    b, t = path.shape
+    order = jnp.argsort(jnp.where(keep, 0, 1) * t + jnp.arange(t)[None, :],
+                        axis=1)
+    packed = jnp.take_along_axis(path, order, axis=1)
+    lens = jnp.sum(keep, axis=1)
+    out = jnp.where(jnp.arange(t)[None, :] < lens[:, None], packed, -1)
+    return out, lens
+
+
+# -- scatter variants --
+@register("tensor_scatter_add")
+def _tensor_scatter_add(a, indices, updates):
+    return a.at[tuple(jnp.moveaxis(indices, -1, 0))].add(updates)
+
+
+@register("tensor_scatter_min")
+def _tensor_scatter_min(a, indices, updates):
+    return a.at[tuple(jnp.moveaxis(indices, -1, 0))].min(updates)
+
+
+@register("tensor_scatter_max")
+def _tensor_scatter_max(a, indices, updates):
+    return a.at[tuple(jnp.moveaxis(indices, -1, 0))].max(updates)
+
+
+@register("sparse_to_dense")
+def _sparse_to_dense(indices, output_shape, values, default_value=0.0):
+    out = jnp.full(tuple(int(s) for s in output_shape), default_value,
+                   jnp.asarray(values).dtype)  # TF: dtype follows values
+    if indices.ndim == 1:
+        return out.at[indices].set(values)
+    return out.at[tuple(jnp.moveaxis(indices, -1, 0))].set(values)

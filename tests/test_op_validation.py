@@ -784,6 +784,83 @@ CASES = {
     "adagrad_update": ((_A, _B, np.zeros_like(_A)), {}, None, ()),
     "rmsprop_update": ((_A, _B, np.zeros_like(_A)), {}, None, ()),
     "lars_update": ((_A, _B), {}, None, ()),
+    # wave 8: image colorspace/crop/augment, statistics, polynomial math,
+    # scatter variants
+    "rgb_to_yiq": ((_IMGP,), {}, None, (0,)),
+    "yiq_to_rgb": ((_IMGP,), {}, None, (0,)),
+    "rgb_to_yuv": ((_IMGP,), {}, None, (0,)),
+    "yuv_to_rgb": ((_IMGP,), {}, None, (0,)),
+    "central_crop": ((_IMGP,), {"fraction": 0.5},
+                     lambda i: i[:, 2:6, 2:6, :], ()),
+    "pad_to_bounding_box": ((_IMGP,), {"offset_height": 1, "offset_width": 2,
+                                       "target_height": 12, "target_width": 12},
+                            None, ()),
+    "resize_with_crop_or_pad": ((_IMGP,), {"target_height": 4,
+                                           "target_width": 12}, None, ()),
+    "random_crop": ((_IMGP,), {"size": (2, 4, 4, 3), "seed": 0}, None, ()),
+    "random_flip_left_right": ((_IMGP,), {"seed": 1}, None, ()),
+    "random_brightness": ((_IMGP,), {"max_delta": 0.05, "seed": 2}, None, ()),
+    "random_contrast": ((_IMGP,), {"seed": 3}, None, ()),
+    "sobel_edges": ((_IMGP,), {}, None, ()),
+    "image_gradients": ((_IMGP,), {}, None, ()),
+    "draw_bounding_boxes": ((_IMGP,
+                             np.array([[[0.1, 0.1, 0.8, 0.8]]], np.float32)
+                             .repeat(2, 0)), {}, None, ()),
+    "psnr": ((_IMGP, np.clip(_IMGP + 0.01, 0, 1).astype(np.float32)), {},
+             None, ()),
+    "ssim": ((_IMGP, _IMGP), {"filter_size": 3},
+             lambda a, b: np.ones(2, np.float32), ()),
+    "mode": ((np.array([1, 2, 2, 3, 2], np.int32),), {},
+             lambda a: np.int32(2), ()),
+    "skewness": ((_A,), {}, None, ()),
+    "kurtosis": ((_A,), {}, None, ()),
+    "weighted_mean": ((_A, np.abs(_B) + 0.1), {},
+                      lambda a, w: (a * w).sum() / w.sum(), ()),
+    "pearson_correlation": ((_A, _A), {}, lambda a, b: np.float32(1.0), ()),
+    "covariance_matrix": ((_V3,), {},
+                          lambda a: np.cov(a, rowvar=False).astype(np.float32),
+                          ()),
+    "correlation_matrix": ((_V3,), {},
+                           lambda a: np.corrcoef(a, rowvar=False)
+                           .astype(np.float32), ()),
+    "polyval": ((np.array([1.0, -2.0, 3.0], np.float32), _A), {},
+                lambda c, x: np.polyval(c, x), ()),
+    "interp": ((np.array([0.5, 1.5], np.float32),
+                np.array([0.0, 1.0, 2.0], np.float32),
+                np.array([0.0, 10.0, 20.0], np.float32)), {},
+               lambda x, xp, fp: np.interp(x, xp, fp), ()),
+    "gradient": ((_A[0],), {}, lambda a: np.gradient(a), ()),
+    "trapz": ((_A[0],), {}, lambda y: np.trapezoid(y), ()),
+    "convolve": ((_A[0], np.array([1.0, 2.0], np.float32)), {},
+                 lambda a, v: np.convolve(a, v), ()),
+    "correlate": ((_A[0], np.array([1.0, 2.0], np.float32)), {},
+                  lambda a, v: np.correlate(a, v, mode="full"), ()),
+    "toeplitz": ((np.array([1.0, 2.0, 3.0], np.float32),), {},
+                 None, ()),
+    "block_diag": ((_A3, np.eye(2, dtype=np.float32)), {}, None, ()),
+    "cond": ((_SPD,), {}, lambda a: np.linalg.cond(a).astype(np.float32), ()),
+    "matrix_rank": ((_SPD,), {}, lambda a: np.int32(3), ()),
+    "multi_dot": ((_A, _M, _M.T.copy()), {},
+                  lambda a, b, c: a @ b @ c, ()),
+    "log_matrix_determinant": ((_SPD,), {},
+                               lambda a: np.linalg.slogdet(a), ()),
+    "softmax_cross_entropy_with_logits_v2": ((_LABELS, _LOGITS), {},
+                                             None, (1,)),
+    "pad_sequences": (([np.array([1.0, 2.0]), np.array([3.0])],), {"maxlen": 3},
+                      lambda s: np.array([[1, 2, 0], [3, 0, 0]], np.float32),
+                      ()),
+    "ctc_greedy_decoder": ((np.log(np.abs(
+        _R.normal(0, 1, (6, 2, 5)).astype(np.float32)) + 0.1),), {}, None, ()),
+    "tensor_scatter_add": ((_A, np.array([[0], [2]], np.int32), _B[:2]), {},
+                           None, ()),
+    "tensor_scatter_min": ((_A, np.array([[0], [2]], np.int32), _B[:2]), {},
+                           None, ()),
+    "tensor_scatter_max": ((_A, np.array([[0], [2]], np.int32), _B[:2]), {},
+                           None, ()),
+    "sparse_to_dense": ((np.array([1, 3], np.int32), (5,),
+                         np.array([7.0, 8.0], np.float32)), {},
+                        lambda i, s, v: np.array([0, 7, 0, 8, 0], np.float32),
+                        ()),
 }
 
 
