@@ -40,7 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from deeplearning4j_tpu.autodiff.ops_registry import OPS, get_op
+from deeplearning4j_tpu.autodiff.ops_registry import OPS, RNG_OPS, get_op
 from deeplearning4j_tpu.ops.initializers import WeightInit, init_weights
 from deeplearning4j_tpu.train.updaters import Adam, Updater
 
@@ -290,6 +290,7 @@ class SameDiff:
         self._tx = None
         self._jit_cache: Dict[Any, Any] = {}
         self._rng_key = jax.random.PRNGKey(0)
+        self._train_iter = 0  # global step count (rng stream position)
         self._listeners: List[Any] = []
         self.math = _Namespace(self, _MATH_OPS)
         self.nn = _Namespace(self, _NN_OPS)
@@ -514,12 +515,26 @@ class SameDiff:
         return needed
 
     def _exec_graph(self, env: Dict[str, Any], outputs: Sequence[str]):
+        # "__rng__" is a RESERVED env entry (never a variable name): when the
+        # caller provides it (sd.fit's train step passes a per-iteration
+        # key), every stochastic op gets a distinct subkey — fold_in by the
+        # node's stable position in self.ops, so two dropout nodes never
+        # share a mask and re-traces are deterministic. Without it
+        # (output()/eval), RNG ops fall back to their static `seed` attr and
+        # dropout is the identity — the reference's inference semantics.
+        rng = env.get("__rng__")
+        pos = None
         for node in self._needed_ops(outputs):
             if all(o in env for o in node.outputs):
                 continue
             fn = node.attrs["fn"] if node.op == "__callable__" else get_op(node.op)
             args = [env[i] for i in node.inputs]
             attrs = {} if node.op == "__callable__" else node.attrs
+            if rng is not None and node.op in RNG_OPS:
+                if pos is None:
+                    pos = {id(n): i for i, n in enumerate(self.ops)}
+                attrs = dict(attrs)
+                attrs["key"] = jax.random.fold_in(rng, pos[id(node)])
             res = fn(*args, **attrs)
             if len(node.outputs) == 1:
                 env[node.outputs[0]] = res
@@ -617,10 +632,11 @@ class SameDiff:
                 return a.astype(cdt)
             return a
 
-        def loss_fn(trainable, placeholders):
+        def loss_fn(trainable, placeholders, rng):
             env = {n: _c(a) for n, a in consts.items()}
             env.update({n: _c(a) for n, a in trainable.items()})
             env.update({n: _c(a) for n, a in placeholders.items()})
+            env["__rng__"] = rng
             losses = self._exec_graph(env, self.loss_variables)
             total = sum(jnp.sum(l.astype(jnp.float32)) for l in losses)
             return total
@@ -636,8 +652,8 @@ class SameDiff:
             loss_fn = jax.checkpoint(
                 loss_fn, policy=jax.checkpoint_policies.dots_saveable)
 
-        def loss_with_reg(trainable, placeholders):
-            total = loss_fn(trainable, placeholders)
+        def loss_with_reg(trainable, placeholders, rng):
+            total = loss_fn(trainable, placeholders, rng)
             if cfg.l2:
                 total = total + 0.5 * cfg.l2 * sum(
                     jnp.sum(w * w) for w in trainable.values())
@@ -646,9 +662,16 @@ class SameDiff:
                     jnp.sum(jnp.abs(w)) for w in trainable.values())
             return total
 
-        def step(trainable, opt_state, placeholders):
-            loss, grads = jax.value_and_grad(loss_with_reg)(trainable,
-                                                            placeholders)
+        # Per-step randomness: the step takes the GLOBAL iteration index
+        # (a 4-byte scalar upload, async, negligible next to the batch) and
+        # folds it into a base key on-device. Fresh dropout masks / random
+        # draws every iteration; bit-reproducible given the SameDiff seed.
+        base_key = self._rng_key
+
+        def step(trainable, opt_state, placeholders, step_idx):
+            rng = jax.random.fold_in(base_key, step_idx)
+            loss, grads = jax.value_and_grad(loss_with_reg)(
+                trainable, placeholders, rng)
             updates, opt_state = self._tx.update(grads, opt_state, trainable)
             return optax.apply_updates(trainable, updates), opt_state, loss
 
@@ -731,7 +754,10 @@ class SameDiff:
                       zip(cfg.data_set_feature_mapping, feats)}
                 ph.update({n: dev(a) for n, a in
                            zip(cfg.data_set_label_mapping, labs)})
-                trainable, self._opt_state, loss = step(trainable, self._opt_state, ph)
+                trainable, self._opt_state, loss = step(
+                    trainable, self._opt_state, ph,
+                    np.uint32(self._train_iter))
+                self._train_iter += 1
                 # keep the loss on-device: a float() here would stall the
                 # pipeline on every step (one full host round-trip per batch
                 # through a remote-device tunnel)

@@ -137,6 +137,26 @@ class _GraphImporter:
             return list(a.list.i) or list(a.list.f) or [s.decode() for s in a.list.s]
         return default
 
+    def _tf_seed(self, node) -> int:
+        """Stream seed for a TF random node. Seeded ops keep their (seed,
+        seed2) pair; unseeded ops (seed=seed2=0, TF draws nondeterministic)
+        get a stable per-node stream from the node name, so two dropout
+        sites never share draws while the import stays reproducible. For
+        stateless ops the seed/key operand joins the hash when constant."""
+        import zlib
+        s1 = int(self._attr(node, "seed", 0) or 0)
+        s2 = int(self._attr(node, "seed2", 0) or 0)
+        if s1 or s2:
+            return (s1 * 2654435761 + s2) & 0x7FFFFFFF
+        h = zlib.crc32(node.name.encode())
+        if node.op.startswith("Stateless") and len(node.input) > 1:
+            try:
+                h ^= zlib.crc32(np.ascontiguousarray(
+                    self._const(node.input[1])).tobytes())
+            except ValueError:
+                pass
+        return h & 0x7FFFFFFF
+
     def _ensure_var(self, name: str) -> str:
         """Map a TF input ref to an sd variable name (materialising consts)."""
         raw = name[1:] if name.startswith("^") else name
@@ -631,6 +651,37 @@ class _GraphImporter:
                        ins[:1], kernel=[int(k[1]), int(k[2])],
                        stride=[int(s[1]), int(s[2])],
                        padding=self._attr(node, "padding", "VALID"))
+            return
+        if op in ("RandomUniform", "RandomStandardNormal", "TruncatedNormal",
+                  "StatelessRandomUniform", "StatelessRandomUniformV2",
+                  "StatelessRandomNormal", "StatelessRandomNormalV2",
+                  "StatelessTruncatedNormal", "StatelessTruncatedNormalV2"):
+            # Stochastic nodes (Keras training=True dropout exports these):
+            # the static `seed` names the stream; sd.fit's executor folds a
+            # per-step key into it so draws are fresh every training
+            # iteration (reference: stateful NativeRandom redraws per step).
+            reg = {"RandomUniform": "random_uniform",
+                   "StatelessRandomUniform": "random_uniform",
+                   "StatelessRandomUniformV2": "random_uniform",
+                   "RandomStandardNormal": "random_normal",
+                   "StatelessRandomNormal": "random_normal",
+                   "StatelessRandomNormalV2": "random_normal",
+                   "TruncatedNormal": "truncated_normal",
+                   "StatelessTruncatedNormal": "truncated_normal",
+                   "StatelessTruncatedNormalV2": "truncated_normal"}[op]
+            try:
+                shape = [int(s) for s in self._const(ins[0])]
+                self._emit(node, reg, [], shape=shape, seed=self._tf_seed(node))
+            except ValueError:
+                # computed shape (tf.shape(x), the Keras dropout form): the
+                # shape_of chain stays concrete at trace time, so the draw
+                # is still statically shaped
+                self._emit(node, reg, ins[:1], seed=self._tf_seed(node))
+            return
+        if op == "Multinomial":
+            num = int(self._const(ins[1]))
+            self._emit(node, "random_categorical", ins[:1],
+                       num_samples=num, seed=self._tf_seed(node))
             return
         if op in ("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3"):
             # inference form: (x, gamma, beta, mean, var)

@@ -786,3 +786,44 @@ def test_tf_import_round3_simple_op_batch(tmp_path):
     for want, name in zip(wants, out_names):
         got = np.asarray(sd.output(feeds, name))
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_tf_import_training_dropout_active_in_fit():
+    """A TF graph exported with dropout ACTIVE (training=True → stateful
+    RandomUniform node) imports, and sd.fit applies a fresh mask per step:
+    at lr=0 with constant data the loss varies across steps. Inference
+    (sd.output) stays deterministic. (Round-3 bug: SameDiff training was
+    silently dropout-free.)"""
+    from deeplearning4j_tpu.imports import TFGraphMapper
+    from deeplearning4j_tpu.autodiff import TrainingConfig
+    from deeplearning4j_tpu.train.updaters import Sgd
+    w = tf.constant(
+        np.random.default_rng(0).normal(0, 1, (8, 8)).astype(np.float32))
+
+    def model(x):
+        return tf.nn.dropout(tf.matmul(x, w), rate=0.5)
+
+    gd, inputs, outputs = _frozen_graphdef(
+        model, [tf.TensorSpec((16, 8), tf.float32, name="x")])
+    assert any(n.op == "RandomUniform" for n in gd.node)
+    sd = TFGraphMapper.import_graph(gd)
+
+    # inference: deterministic across calls (static-seed draw)
+    x = np.random.default_rng(1).normal(0, 1, (16, 8)).astype(np.float32)
+    o1 = np.asarray(sd.output({inputs[0]: x}, outputs[0]))
+    o2 = np.asarray(sd.output({inputs[0]: x}, outputs[0]))
+    np.testing.assert_array_equal(o1, o2)
+
+    # training: per-step stochasticity
+    pred = sd.vars[outputs[0]]
+    labels = sd.placeholder("labels", (None, 8))
+    sd.loss.mean_squared_error("loss", labels, pred)
+    sd.set_loss_variables("loss")
+    sd.set_training_config(TrainingConfig(
+        updater=Sgd(0.0), data_set_feature_mapping=[inputs[0]],
+        data_set_label_mapping=["labels"]))
+    y = np.zeros((16, 8), np.float32)
+    losses = []
+    for _ in range(3):
+        losses.extend(sd.fit(x, y, epochs=1))
+    assert len(set(np.round(losses, 10))) > 1, losses

@@ -287,3 +287,115 @@ def test_fit_history_listeners_and_evaluate():
 
     ev = sd.evaluate(it, "probs", Evaluation())
     assert ev.accuracy() > 0.9
+
+
+# ---------------------------------------------------------------------------
+# Train-time stochasticity (reference: TrainingSession applies real per-
+# iteration dropout/randomness via a stateful NativeRandom; here sd.fit
+# threads a per-step PRNG key through _exec_graph's reserved "__rng__" entry)
+# ---------------------------------------------------------------------------
+
+
+def _lr0_fit_losses(build, steps=3):
+    """Fit `steps` iterations at lr=0 on constant data; returns the per-step
+    losses. With frozen weights, any loss variation across steps can only
+    come from per-step randomness in the graph."""
+    from deeplearning4j_tpu.train.updaters import Sgd
+    sd, feed_name, label_name = build()
+    sd.set_training_config(TrainingConfig(
+        updater=Sgd(0.0), data_set_feature_mapping=[feed_name],
+        data_set_label_mapping=[label_name]))
+    x = np.random.default_rng(0).normal(0, 1, (16, 8)).astype(np.float32)
+    y = np.zeros((16, 1), np.float32)
+    losses = []
+    for _ in range(steps):
+        losses.extend(sd.fit(x, y, epochs=1))
+    return losses
+
+
+def test_samediff_dropout_active_in_fit():
+    """Two consecutive fit steps must draw DIFFERENT dropout masks (the
+    round-3 registry op was silently the identity during training)."""
+    def build():
+        sd = SameDiff.create()
+        xin = sd.placeholder("x", (None, 8))
+        w = sd.var("w", (8, 1))
+        h = sd.nn.dropout(xin, rate=0.5)
+        pred = h.mmul(w)
+        labels = sd.placeholder("labels", (None, 1))
+        sd.loss.mean_squared_error("loss", labels, pred)
+        sd.set_loss_variables("loss")
+        return sd, "x", "labels"
+
+    losses = _lr0_fit_losses(build)
+    # dropout on -> stochastic loss even with frozen weights
+    assert len(set(np.round(losses, 10))) > 1, losses
+    # and the mean is in a sane band for rate=0.5 inverted dropout
+    assert all(np.isfinite(l) for l in losses)
+
+
+def test_samediff_dropout_identity_at_inference():
+    sd = SameDiff.create()
+    xin = sd.placeholder("x", (None, 8))
+    out = sd.nn.dropout(xin, rate=0.5, name="out")
+    x = np.random.default_rng(1).normal(0, 1, (4, 8)).astype(np.float32)
+    got = np.asarray(sd.output({"x": x}, "out"))
+    np.testing.assert_array_equal(got, x)
+
+
+def test_samediff_random_ops_fresh_per_step():
+    """random_* registry ops redraw every fit step (round-3 bug: static
+    `seed` attr made a jitted step redraw the SAME numbers forever)."""
+    def build():
+        sd = SameDiff.create()
+        xin = sd.placeholder("x", (None, 8))
+        noise = sd.random.random_normal(shape=(16, 8), seed=7)
+        labels = sd.placeholder("labels", (None, 1))
+        w = sd.var("w", (8, 1))
+        pred = (xin + noise).mmul(w)
+        sd.loss.mean_squared_error("loss", labels, pred)
+        sd.set_loss_variables("loss")
+        return sd, "x", "labels"
+
+    losses = _lr0_fit_losses(build)
+    assert len(set(np.round(losses, 10))) > 1, losses
+
+
+def test_samediff_no_rng_deterministic_fit():
+    """A deterministic graph still yields identical losses at lr=0 — the key
+    plumbing must not perturb non-stochastic training."""
+    def build():
+        sd = SameDiff.create()
+        xin = sd.placeholder("x", (None, 8))
+        w = sd.var("w", (8, 1))
+        labels = sd.placeholder("labels", (None, 1))
+        sd.loss.mean_squared_error("loss", labels, xin.mmul(w))
+        sd.set_loss_variables("loss")
+        return sd, "x", "labels"
+
+    losses = _lr0_fit_losses(build)
+    assert len(set(np.round(losses, 8))) == 1, losses
+
+
+def test_samediff_two_dropout_nodes_distinct_masks():
+    """Two dropout nodes in one graph must not share a mask: with x=1 and
+    rate 0.5, (d1(x) - d2(x)) is nonzero somewhere unless masks collide
+    everywhere (probability ~2^-64 over the test sizes)."""
+    from deeplearning4j_tpu.train.updaters import Sgd
+    sd = SameDiff.create()
+    xin = sd.placeholder("x", (None, 64))
+    d1 = sd.nn.dropout(xin, rate=0.5)
+    d2 = sd.nn.dropout(xin, rate=0.5)
+    diff = (d1 - d2) * (d1 - d2)
+    labels = sd.placeholder("labels", (None, 64))
+    sd.loss.mean_squared_error("loss", labels, diff)
+    sd.set_loss_variables("loss")
+    sd.set_training_config(TrainingConfig(
+        updater=Sgd(0.0), data_set_feature_mapping=["x"],
+        data_set_label_mapping=["labels"]))
+    x = np.ones((4, 64), np.float32)
+    y = np.zeros((4, 64), np.float32)
+    losses = sd.fit(x, y, epochs=1)
+    # identical masks on both nodes would make diff == 0 and the loss == 0
+    # (label is 0); distinct masks make the MSE strictly positive
+    assert losses[0] > 0.0, losses
