@@ -449,9 +449,15 @@ class SameDiff:
         """``lax.cond`` over graph values: ``true_fn``/``false_fn`` take the
         operand arrays and return ``n_outputs`` arrays (reference:
         If/Switch-Merge)."""
-        def fn(p, *xs):
-            return jax.lax.cond(jnp.reshape(p, ()).astype(bool), true_fn, false_fn, *xs)
+        def fn(p, *xs, key=None):
+            tf_ = ((lambda *a: true_fn(*a, key=key))
+                   if getattr(true_fn, "_accepts_rng", False) else true_fn)
+            ff_ = ((lambda *a: false_fn(*a, key=key))
+                   if getattr(false_fn, "_accepts_rng", False) else false_fn)
+            return jax.lax.cond(jnp.reshape(p, ()).astype(bool), tf_, ff_, *xs)
 
+        if any(getattr(f, "_accepts_rng", False) for f in (true_fn, false_fn)):
+            fn._accepts_rng = True
         return self._apply_callable(
             fn, [self._lift(pred)] + [self._lift(o) for o in operands], name,
             n_outputs=n_outputs)
@@ -469,15 +475,23 @@ class SameDiff:
         differentiable."""
         n = len(init)
 
-        def fn(*xs):
+        def fn(*xs, key=None):
+            # stochastic bodies: the key is fixed per TRAINING STEP (fresh
+            # masks every sd.fit iteration) but constant across loop
+            # iterations within the step — per-loop-iteration freshness
+            # would need the counter folded in by the body itself
+            bf = ((lambda *a: body_fn(*a, key=key))
+                  if getattr(body_fn, "_accepts_rng", False) else body_fn)
+            cf = ((lambda *a: cond_fn(*a, key=key))
+                  if getattr(cond_fn, "_accepts_rng", False) else cond_fn)
             if max_iterations is None:
                 out = jax.lax.while_loop(
-                    lambda c: jnp.reshape(cond_fn(*c), ()).astype(bool),
-                    lambda c: tuple(body_fn(*c)), tuple(xs))
+                    lambda c: jnp.reshape(cf(*c), ()).astype(bool),
+                    lambda c: tuple(bf(*c)), tuple(xs))
             else:
                 def step(c, _):
-                    pred = jnp.reshape(cond_fn(*c), ()).astype(bool)
-                    new = tuple(body_fn(*c))
+                    pred = jnp.reshape(cf(*c), ()).astype(bool)
+                    new = tuple(bf(*c))
                     c2 = tuple(jnp.where(pred, b, a) for a, b in zip(c, new))
                     return c2, None
 
@@ -485,6 +499,8 @@ class SameDiff:
                                       length=max_iterations)
             return out if n > 1 else out[0]
 
+        if any(getattr(f, "_accepts_rng", False) for f in (cond_fn, body_fn)):
+            fn._accepts_rng = True
         return self._apply_callable(fn, [self._lift(i) for i in init], name,
                                     n_outputs=n)
 
@@ -530,7 +546,13 @@ class SameDiff:
             fn = node.attrs["fn"] if node.op == "__callable__" else get_op(node.op)
             args = [env[i] for i in node.inputs]
             attrs = {} if node.op == "__callable__" else node.attrs
-            if rng is not None and node.op in RNG_OPS:
+            if rng is not None and (
+                    node.op in RNG_OPS
+                    # control-flow callables that declare rng support
+                    # (cond/while bodies containing stochastic ops — the
+                    # sub-executor re-injects per-node subkeys from this key)
+                    or (node.op == "__callable__"
+                        and getattr(fn, "_accepts_rng", False))):
                 if pos is None:
                     pos = {id(n): i for i, n in enumerate(self.ops)}
                 attrs = dict(attrs)

@@ -309,14 +309,20 @@ class _GraphImporter:
 
     def _function_callable(self, fname: str):
         """FunctionDef -> python callable on jax arrays (feeds sd.while_loop
-        / sd.cond, which lower to lax.while_loop / lax.cond)."""
+        / sd.cond, which lower to lax.while_loop / lax.cond). Accepts an
+        optional per-step ``key`` so stochastic ops INSIDE control-flow
+        bodies (dropout in a While body, training=True) stay live during
+        sd.fit — the sub-executor re-injects per-node subkeys from it."""
         sub_sd, in_names, out_names = self._function_subgraph(fname)
 
-        def fn(*arrays):
+        def fn(*arrays, key=None):
             env = dict(sub_sd.arrays)
             env.update(zip(in_names, arrays))
+            if key is not None:
+                env["__rng__"] = key
             return sub_sd._exec_graph(env, out_names)
 
+        fn._accepts_rng = True
         return fn
 
     def _map_node(self, node) -> None:

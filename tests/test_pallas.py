@@ -441,3 +441,134 @@ def test_graves_layer_routes_through_fused_kernel():
         np.testing.assert_allclose(np.asarray(y_kernel), np.asarray(y_scan),
                                    rtol=1e-5, atol=1e-5,
                                    err_msg=f"{type(layer).__name__} mask={m is not None}")
+
+
+# ----------------------------------------------------------- fused dropout
+def test_fused_dropout_statistics_and_determinism():
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops.pallas.fused_dropout import (
+        fused_dropout, fused_dropout_add, fused_dropout_compatible,
+        seed_from_key)
+    h = jnp.asarray(np.random.default_rng(0).normal(0, 1, (1024, 256)),
+                    jnp.float32)
+    seed = seed_from_key(jax.random.PRNGKey(1))
+    assert fused_dropout_compatible(h, 0.5)
+    assert not fused_dropout_compatible(h, 0.0)   # rate 0: no kernel needed
+    assert not fused_dropout_compatible(h[:100], 0.5)  # rows not blockable
+    y = fused_dropout(h, seed, 0.5)
+    frac = float(jnp.mean((y == 0)))
+    assert 0.45 < frac < 0.55, frac
+    # kept elements are scaled by 1/keep
+    kept = np.asarray(y != 0)
+    np.testing.assert_allclose(np.asarray(y)[kept],
+                               np.asarray(h)[kept] * 2.0, rtol=1e-6)
+    # determinism given the seed; sensitivity to the seed
+    assert bool(jnp.all(y == fused_dropout(h, seed, 0.5)))
+    y2 = fused_dropout(h, seed + 1, 0.5)
+    assert not bool(jnp.all((y == 0) == (y2 == 0)))
+
+
+def test_fused_dropout_backward_mask_matches_forward():
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops.pallas.fused_dropout import (
+        fused_dropout, fused_dropout_add, seed_from_key)
+    h = jnp.asarray(np.random.default_rng(2).normal(0, 1, (512, 128)),
+                    jnp.float32)
+    x = jnp.asarray(np.random.default_rng(3).normal(0, 1, (512, 128)),
+                    jnp.float32)
+    seed = seed_from_key(jax.random.PRNGKey(7))
+    y = fused_dropout(h, seed, 0.3)
+    g = jax.grad(lambda h: jnp.sum(fused_dropout(h, seed, 0.3)))(h)
+    # the regenerated backward mask must be the SAME mask
+    assert bool(jnp.all((g != 0) == (y != 0)))
+    kept = np.asarray(y != 0)
+    np.testing.assert_allclose(np.asarray(g)[kept], 1.0 / 0.7, rtol=1e-6)
+    # residual-add form: dx is the identity
+    gx = jax.grad(lambda x: jnp.sum(fused_dropout_add(x, h, seed, 0.3)))(x)
+    np.testing.assert_allclose(np.asarray(gx), 1.0)
+
+
+# ------------------------------------------------------ short-T attention
+def test_short_attention_matches_reference():
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops.pallas.fused_attention_short import (
+        short_attention, short_attention_compatible)
+    rng = np.random.default_rng(0)
+    B, H, T, D = 2, 4, 128, 64
+    q, k, v = (jnp.asarray(rng.normal(0, 1, (B, H, T, D)), jnp.float32)
+               for _ in range(3))
+    assert short_attention_compatible(q, k, v)
+    out = np.asarray(short_attention(q, k, v))
+    ref = _ref_attention(np.asarray(q), np.asarray(k), np.asarray(v))
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+    # key-padding mask against the masked numpy form
+    mask = jnp.asarray(np.arange(T)[None, :] < np.array([100, T])[:, None])
+    out_m = np.asarray(short_attention(q, k, v, mask))
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+    s = np.where(np.asarray(mask)[:, None, None, :], s, -1e30)
+    w = np.exp(s - s.max(-1, keepdims=True))
+    w = w / w.sum(-1, keepdims=True)
+    ref_m = np.einsum("bhqk,bhkd->bhqd", w, v)
+    np.testing.assert_allclose(out_m, ref_m, rtol=2e-5, atol=2e-5)
+
+
+def test_short_attention_grads_match_xla():
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops.pallas.fused_attention_short import (
+        short_attention)
+    rng = np.random.default_rng(1)
+    B, H, T, D = 2, 2, 128, 64
+    q, k, v = (jnp.asarray(rng.normal(0, 1, (B, H, T, D)), jnp.float32)
+               for _ in range(3))
+    mask = jnp.asarray(np.arange(T)[None, :] < np.array([90, T])[:, None])
+
+    def xla(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        s = jnp.where(mask[:, None, None, :], s, -1e30)
+        return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+
+    g1 = jax.grad(lambda q, k, v: jnp.sum(short_attention(q, k, v, mask) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda q, k, v: jnp.sum(xla(q, k, v) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_short_attention_btd_layout_matches_transposed():
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops.pallas.fused_attention_short import (
+        short_attention_btd, short_attention_btd_compatible)
+    rng = np.random.default_rng(2)
+    B, T, H, D = 2, 128, 4, 64
+    q, k, v = (jnp.asarray(rng.normal(0, 1, (B, T, H * D)), jnp.float32)
+               for _ in range(3))
+    mask = jnp.asarray(np.arange(T)[None, :] < np.array([100, T])[:, None])
+    assert short_attention_btd_compatible(q, mask, heads=H)
+
+    def xla(q, k, v):
+        q4 = q.reshape(B, T, H, D).transpose(0, 2, 1, 3)
+        k4 = k.reshape(B, T, H, D).transpose(0, 2, 1, 3)
+        v4 = v.reshape(B, T, H, D).transpose(0, 2, 1, 3)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q4, k4) / np.sqrt(D)
+        s = jnp.where(mask[:, None, None, :], s, -1e30)
+        o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v4)
+        return o.transpose(0, 2, 1, 3).reshape(B, T, H * D)
+
+    np.testing.assert_allclose(np.asarray(short_attention_btd(q, k, v, mask, H)),
+                               np.asarray(xla(q, k, v)), rtol=2e-5, atol=2e-5)
+    g1 = jax.grad(lambda q: jnp.sum(short_attention_btd(q, k, v, mask, H) ** 2))(q)
+    g2 = jax.grad(lambda q: jnp.sum(xla(q, k, v) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=1e-3, atol=1e-3)

@@ -399,3 +399,36 @@ def test_samediff_two_dropout_nodes_distinct_masks():
     # identical masks on both nodes would make diff == 0 and the loss == 0
     # (label is 0); distinct masks make the MSE strictly positive
     assert losses[0] > 0.0, losses
+
+
+def test_samediff_dropout_inside_while_loop_active_in_fit():
+    """Stochastic ops inside control-flow bodies get the per-step key: a
+    body that declares ``_accepts_rng`` receives a subkey during sd.fit
+    (round-3 review: the top-level fix left While/cond bodies frozen)."""
+    from deeplearning4j_tpu.autodiff.ops_registry import get_op
+    from deeplearning4j_tpu.train.updaters import Sgd
+
+    sd = SameDiff.create()
+    xin = sd.placeholder("x", (None, 8))
+
+    def cond_fn(i, acc):
+        return i < 2
+
+    def body_fn(i, acc, key=None):
+        return i + 1, acc + get_op("dropout")(acc * 0 + 1.0, key=key, rate=0.5)
+
+    body_fn._accepts_rng = True
+    _, acc = sd.while_loop(cond_fn, body_fn, sd.constant(0),
+                           xin, max_iterations=2)
+    labels = sd.placeholder("labels", (None, 8))
+    sd.loss.mean_squared_error("loss", labels, acc)
+    sd.set_loss_variables("loss")
+    sd.set_training_config(TrainingConfig(
+        updater=Sgd(0.0), data_set_feature_mapping=["x"],
+        data_set_label_mapping=["labels"]))
+    x = np.zeros((16, 8), np.float32)
+    y = np.zeros((16, 8), np.float32)
+    losses = []
+    for _ in range(3):
+        losses.extend(sd.fit(x, y, epochs=1))
+    assert len(set(np.round(losses, 10))) > 1, losses
