@@ -98,6 +98,10 @@ class _GraphImporter:
                           for f in graph_def.library.function}
         self._switch_pred: Dict[str, str] = {}   # Switch node -> pred ref
         self._switch_memo: Dict[str, Optional[tuple]] = {}
+        # TF1 while frames: nodes consumed by a lowered frame are skipped
+        # by the per-node loop (the frame's cond/body are re-imported as
+        # standalone subgraphs feeding sd.while_loop)
+        self._frame_consumed: set = set()
 
     # --- helpers ---
     @staticmethod
@@ -325,7 +329,190 @@ class _GraphImporter:
         fn._accepts_rng = True
         return fn
 
+    # ---- TF1 while-loop frames (Enter/Merge/Switch/NextIteration/Exit) ----
+    def _extract_frame_subgraph(self, roots: List[str], stops: Dict[str, str],
+                                frame_nodes: set):
+        """Backward-slice the main graph from ``roots``, stopping at names
+        in ``stops`` (ref base name -> placeholder name). Returns
+        (interior node list in graph order, used stop names)."""
+        interior, used, seen = [], set(), set()
+        stack = [self._clean(r) for r in roots]
+        while stack:
+            name = stack.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            if name in stops:
+                used.add(name)
+                continue
+            node = self.node_by_name.get(name)
+            if node is None:
+                continue
+            if node.op in ("Enter", "Exit", "NextIteration", "LoopCond",
+                           "Merge", "Switch"):
+                raise NotImplementedError(
+                    f"TF1 frame node {name!r} ({node.op}) reached outside "
+                    "its carry chain — nested while frames are not "
+                    "supported; re-export with functional control flow")
+            interior.append(node)
+            frame_nodes.add(name)
+            stack.extend(self._clean(i) for i in node.input)
+        order = {n.name: i for i, n in enumerate(self.gd.node)}
+        interior.sort(key=lambda n: order[n.name])
+        return interior, used
+
+    def _frame_subgraph_callable(self, roots: List[str],
+                                 stops: Dict[str, str], frame_nodes: set):
+        """Build a jax callable for a frame's cond or body slice: stop
+        points become Placeholders fed by the loop carries, interior nodes
+        are re-imported as a standalone graph."""
+        tf = _tf()
+        interior, _ = self._extract_frame_subgraph(roots, stops, frame_nodes)
+        gd2 = tf.compat.v1.GraphDef()
+        gd2.library.CopyFrom(self.gd.library)
+        for base, ph in stops.items():
+            nd = gd2.node.add()
+            nd.name = ph
+            nd.op = "Placeholder"
+        for node in interior:
+            cp = gd2.node.add()
+            cp.CopyFrom(node)
+            del cp.input[:]
+            for ref in node.input:
+                if ref.startswith("^"):
+                    base = self._clean(ref)
+                    if base in stops or base not in {n.name for n in interior}:
+                        continue  # control dep to outside the slice
+                    cp.input.append(ref)
+                    continue
+                base, _, idx = ref.partition(":")
+                if base in stops:
+                    cp.input.append(stops[base])
+                else:
+                    cp.input.append(ref)
+        out_refs = []
+        for r in roots:
+            base, _, idx = r.partition(":")
+            out_refs.append(stops.get(base, r) if base in stops else r)
+        sub_sd = _GraphImporter(gd2, {}).run()
+        ph_names = [stops[b] for b in stops]
+
+        def fn(*arrays, key=None):
+            env = dict(sub_sd.arrays)
+            env.update(zip(ph_names, arrays))
+            if key is not None:
+                env["__rng__"] = key
+            return sub_sd._exec_graph(env, out_refs)
+
+        fn._accepts_rng = True
+        return fn, list(stops)
+
+    def _lower_tf1_frame(self, frame: str) -> None:
+        """Reconstruct one TF1 while frame and lower it onto
+        ``sd.while_loop`` (upstream ``TFGraphMapper`` + SameDiff frame ops;
+        SURVEY.md §3.3). Carries = Merge chains; loop-invariant Enters ride
+        along as carries the body returns unchanged. Forward execution via
+        ``lax.while_loop`` — like the functional While path, reverse-mode
+        AD through the loop is unsupported (freeze for inference)."""
+        enters = [n for n in self.gd.node
+                  if n.op == "Enter" and self._attr(n, "frame_name") == frame]
+        enter_names = {n.name for n in enters}
+        merges = {}
+        for n in self.gd.node:
+            if n.op == "Merge":
+                ins = self._inputs(n)
+                if ins and any(self._clean(i) in enter_names for i in ins):
+                    merges[n.name] = n
+        if not merges:
+            raise NotImplementedError(
+                f"TF1 frame {frame!r}: Enter nodes without Merge carries")
+        switches = {}
+        loopcond_name = None
+        for n in self.gd.node:
+            if n.op == "Switch":
+                ins = self._inputs(n)
+                if len(ins) == 2 and self._clean(ins[0]) in merges:
+                    switches[self._clean(ins[0])] = n
+                    loopcond_name = self._clean(ins[1])
+        if loopcond_name is None:
+            raise NotImplementedError(
+                f"TF1 frame {frame!r}: no Switch keyed on a LoopCond")
+        loopcond = self.node_by_name[loopcond_name]
+        frame_nodes = set(enter_names) | set(merges) | {loopcond_name}
+        frame_nodes.update(s.name for s in switches.values())
+
+        # per-carry bookkeeping, deterministic order
+        carry_names = sorted(merges)
+        next_refs, exit_nodes, enter_of = [], [], []
+        for mname in carry_names:
+            ins = self._inputs(merges[mname])
+            e = next(self._clean(i) for i in ins
+                     if self._clean(i) in enter_names)
+            ni = next(self._clean(i) for i in ins
+                      if self._clean(i) not in enter_names)
+            ni_node = self.node_by_name.get(ni)
+            if ni_node is None or ni_node.op != "NextIteration":
+                raise NotImplementedError(
+                    f"TF1 frame {frame!r}: Merge {mname!r} second input is "
+                    f"{ni!r}, not a NextIteration")
+            enter_of.append(e)
+            next_refs.append(ni_node.input[0])
+            frame_nodes.add(ni)
+            sw = switches.get(mname)
+            ex = None
+            if sw is not None:
+                for n in self.gd.node:
+                    if n.op == "Exit" and \
+                            self._clean(self._inputs(n)[0]) == sw.name:
+                        ex = n
+                        frame_nodes.add(n.name)
+                        break
+            exit_nodes.append(ex)
+        invariants = sorted(enter_names - set(enter_of))
+
+        # cond slice: placeholders at the Merges (+ invariant Enters)
+        stops_c = {m: f"__c_{i}" for i, m in enumerate(carry_names)}
+        stops_c.update({e: f"__ci_{i}" for i, e in enumerate(invariants)})
+        cond_fn, cond_stop_order = self._frame_subgraph_callable(
+            [loopcond.input[0]], stops_c, frame_nodes)
+        # body slice: placeholders at the Switches' taken side (:1)
+        stops_b = {switches[m].name if m in switches else m:
+                   f"__b_{i}" for i, m in enumerate(carry_names)}
+        stops_b.update({e: f"__bi_{i}" for i, e in enumerate(invariants)})
+        body_fn, body_stop_order = self._frame_subgraph_callable(
+            list(next_refs), stops_b, frame_nodes)
+
+        n_carry = len(carry_names)
+        n_total = n_carry + len(invariants)
+
+        # stop-dict iteration order == insertion order == carries then
+        # invariants, so positional zip in the callables lines up with the
+        # init list below
+        def cond(*args, key=None):
+            return cond_fn(*args, key=key)[0]
+
+        def body(*args, key=None):
+            outs = body_fn(*args[:], key=key)
+            return tuple(outs) + tuple(args[n_carry:])
+
+        cond._accepts_rng = True
+        body._accepts_rng = True
+
+        init_refs = [self.node_by_name[e].input[0] for e in enter_of] + \
+            [self.node_by_name[e].input[0] for e in invariants]
+        outs = self.sd.while_loop(
+            cond, body, *[self.sd.vars[self._ensure_var(r)]
+                          for r in init_refs],
+            name=f"{frame.replace('/', '_')}_while")
+        outs = outs if isinstance(outs, tuple) else (outs,)
+        for i, ex in enumerate(exit_nodes):
+            if ex is not None:
+                self._alias(ex.name, outs[i].name)
+        self._frame_consumed |= frame_nodes
+
     def _map_node(self, node) -> None:
+        if node.name in self._frame_consumed:
+            return
         op = node.op
         ins = self._inputs(node)
         sd = self.sd
@@ -730,12 +917,18 @@ class _GraphImporter:
                 out.rename(node.name)
             # second output (value_index) is rarely consumed; emit if needed
             return
-        if op in ("Enter", "Exit", "NextIteration", "LoopCond"):
+        if op == "Enter":
+            # First frame op in topo order: lower the WHOLE frame now
+            # (reference: TFGraphMapper maps Enter/Exit/NextIteration/
+            # LoopCond frames into SameDiff's loop frames; here the frame
+            # is reconstructed and lowered onto sd.while_loop -> XLA's
+            # structured lax.while_loop)
+            self._lower_tf1_frame(self._attr(node, "frame_name"))
+            return
+        if op in ("Exit", "NextIteration", "LoopCond"):
             raise NotImplementedError(
-                f"TF1 while-loop frame op {op!r} (node {node.name!r}): "
-                "re-export the model with functional control flow "
-                "(tf.function graph without lowering) — the functional "
-                "While/If path is supported")
+                f"Orphan TF1 frame op {op!r} (node {node.name!r}) with no "
+                "Enter — malformed frozen graph")
 
         # ---- TF2 function graphs + structured control flow ----
         if op in ("PartitionedCall", "StatefulPartitionedCall"):
