@@ -480,12 +480,31 @@ def _qr(a, full_matrices=False):
 
 @register("eigh")
 def _eigh(a):
-    """Self-adjoint (symmetric/Hermitian) eigendecomposition. A general
-    non-symmetric ``eig`` is CPU-only in XLA and deliberately not registered
-    — silently wrong answers on symmetric-only backends are worse than an
-    unknown-op error."""
+    """Self-adjoint (symmetric/Hermitian) eigendecomposition."""
     w, v = jnp.linalg.eigh(a)
     return w, v
+
+
+@register("eig")
+def _eig(a):
+    """General (non-symmetric) eigendecomposition -> (values, vectors),
+    complex64/128. XLA has no TPU lowering for general eig, so this runs
+    as a host callback to LAPACK via numpy — the same CPU-execution
+    fallback the reference uses for its ``eig`` custom op (upstream
+    ``libnd4j`` linalg family runs eig on host too). Forward-only: no
+    gradient is defined (matching the reference, which registers no
+    ``doDiff`` for it)."""
+    import numpy as _np
+    a = jnp.asarray(a)
+    cdt = jnp.complex128 if a.dtype == jnp.float64 else jnp.complex64
+    out_shape = (jax.ShapeDtypeStruct(a.shape[:-1], cdt),
+                 jax.ShapeDtypeStruct(a.shape, cdt))
+
+    def _cb(x):
+        w, v = _np.linalg.eig(_np.asarray(x))
+        return (w.astype(_np.dtype(cdt)), v.astype(_np.dtype(cdt)))
+
+    return tuple(jax.pure_callback(_cb, out_shape, a))
 
 
 @register("matrix_band_part")

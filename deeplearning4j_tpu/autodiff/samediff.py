@@ -200,7 +200,7 @@ _CNN_OPS = ["conv2d", "max_pool2d", "avg_pool2d", "batch_norm",
 _RNN_OPS = ["lstm_layer", "gru", "lstm_cell", "gru_cell"]
 # ops whose registry callable returns a tuple (namespace calls unpack them)
 _MULTI_OUTPUT_OPS = {"lstm_layer": 3, "gru": 2, "lstm_cell": 2,
-                     "svd": 3, "qr": 2, "eigh": 2,
+                     "svd": 3, "qr": 2, "eigh": 2, "eig": 2,
                      "top_k": 2, "unique": 2, "non_max_suppression": 2,
                      "meshgrid": 2, "moments": 2, "normalize_moments": 2,
                      "lu": 2}
@@ -212,7 +212,7 @@ _LOSS_OPS = ["softmax_cross_entropy", "sparse_softmax_cross_entropy",
              "ctc_loss"]
 _LINALG_OPS = ["cholesky", "solve", "triangular_solve", "lstsq",
                "matrix_inverse", "matrix_determinant", "logdet", "svd", "qr",
-               "eigh", "matrix_band_part", "cross", "diag", "diag_part",
+               "eigh", "eig", "matrix_band_part", "cross", "diag", "diag_part",
                "trace", "matmul"]
 _BITWISE_OPS = ["bitwise_and", "bitwise_or", "bitwise_xor", "bit_shift",
                 "bit_shift_right", "bit_rotl", "bit_rotr"]

@@ -538,6 +538,21 @@ class INDArray:
         return INDArray(jnp.where(jnp.asarray(_unwrap(comp), bool),
                                   self.array, default))
 
+    def get_where_with_mask(self, mask, default=0.0) -> "INDArray":
+        """Elements where ``mask`` is nonzero, others ``default`` (reference
+        ``getWhereWithMask``; static-shape form like ``get_where`` — the
+        compacting variant is shape-dynamic and XLA-hostile, so masked-out
+        slots carry ``default`` instead of being dropped)."""
+        return INDArray(jnp.where(jnp.asarray(_unwrap(mask)) != 0,
+                                  self.array, default))
+
+    def eps(self, other, eps: float = 1e-5) -> "INDArray":
+        """Elementwise fuzzy equality |a-b| < eps (reference
+        ``INDArray.eps`` with ``Nd4j.EPS_THRESHOLD``); returns a 0/1 array
+        like the reference's boolean-as-float convention."""
+        return INDArray((jnp.abs(self.array - _unwrap(other)) < eps)
+                        .astype(self.array.dtype))
+
     def assign_if(self, value, comp) -> "INDArray":
         return self.put_where(comp, value)
 

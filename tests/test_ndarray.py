@@ -265,3 +265,16 @@ def test_round3_factory_tier():
                                np.repeat(a.numpy(), 2, 0))
     assert Nd4j.tile(a, 2, 1).shape() == (4, 3)
     np.testing.assert_allclose(Nd4j.cumsum(a, 1).numpy(), np.cumsum(a.numpy(), 1))
+
+
+def test_get_where_with_mask_and_eps():
+    import numpy as np
+
+    from deeplearning4j_tpu.ndarray import INDArray
+    a = INDArray(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    mask = np.array([[1, 0], [0, 1]], np.float32)
+    got = np.asarray(a.get_where_with_mask(mask, default=-1.0).array)
+    np.testing.assert_array_equal(got, [[1.0, -1.0], [-1.0, 4.0]])
+    b = np.array([[1.0 + 5e-6, 2.1], [3.0, 4.0 - 1e-7]], np.float32)
+    e = np.asarray(a.eps(b).array)
+    np.testing.assert_array_equal(e, [[1.0, 0.0], [1.0, 1.0]])

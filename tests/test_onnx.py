@@ -120,3 +120,106 @@ def test_flatten_nondefault_axis(tmp_path):
 
     x = np.random.default_rng(5).normal(0, 1, (2, 3, 4, 5)).astype(np.float32)
     _roundtrip(M2(), x, tmp_path)
+
+
+# ------------------------------------------------------- stock-model golden
+class _BasicBlock(torch.nn.Module):
+    """torchvision.models.resnet.BasicBlock, reproduced faithfully (the
+    torchvision package is not in this image; architecture per the upstream
+    resnet18 definition — 3x3/3x3 with identity or 1x1-downsample skip)."""
+
+    def __init__(self, cin, cout, stride=1):
+        super().__init__()
+        nn = torch.nn
+        self.conv1 = nn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(cout)
+        self.conv2 = nn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(cout)
+        self.down = None
+        if stride != 1 or cin != cout:
+            self.down = nn.Sequential(
+                nn.Conv2d(cin, cout, 1, stride, bias=False),
+                nn.BatchNorm2d(cout))
+
+    def forward(self, x):
+        idn = x if self.down is None else self.down(x)
+        out = torch.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        return torch.relu(out + idn)
+
+
+class _ResNet18(torch.nn.Module):
+    """Stock resnet18 topology (conv7x7/2 - maxpool3/2 - [2,2,2,2] basic
+    blocks at 64/128/256/512 - GAP - fc), random weights."""
+
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        nn = torch.nn
+        self.conv1 = nn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.bn1 = nn.BatchNorm2d(64)
+        self.maxpool = nn.MaxPool2d(3, 2, 1)
+        layers = []
+        cin = 64
+        for cout, stride in ((64, 1), (64, 1), (128, 2), (128, 1),
+                             (256, 2), (256, 1), (512, 2), (512, 1)):
+            layers.append(_BasicBlock(cin, cout, stride))
+            cin = cout
+        self.layers = nn.Sequential(*layers)
+        self.avgpool = nn.AdaptiveAvgPool2d(1)
+        self.fc = nn.Linear(512, num_classes)
+
+    def forward(self, x):
+        x = torch.relu(self.bn1(self.conv1(x)))
+        x = self.maxpool(x)
+        x = self.layers(x)
+        x = self.avgpool(x).flatten(1)
+        return self.fc(x)
+
+
+def test_onnx_stock_resnet18_golden_graft_fit(tmp_path):
+    """The ONNX mirror of the stock-MobileNetV2 TF feat (VERDICT r3 next
+    #6): a full stock resnet18 exported by torch's C++ ONNX exporter
+    imports, golden-matches torch, takes a grafted loss, and fine-tunes."""
+    torch.manual_seed(0)
+    model = _ResNet18()
+    # randomize BN running stats so eval-mode inference exercises them
+    for m in model.modules():
+        if isinstance(m, torch.nn.BatchNorm2d):
+            m.running_mean.normal_(0, 0.05)
+            m.running_var.uniform_(0.8, 1.2)
+    model.eval()
+    x = np.random.default_rng(0).normal(0, 1, (2, 3, 64, 64)).astype(np.float32)
+    path = str(tmp_path / "resnet18.onnx")
+    _export(model, (torch.from_numpy(x),), path)
+    with torch.no_grad():
+        expected = model(torch.from_numpy(x)).numpy()
+
+    sd = OnnxGraphMapper.import_graph(path)
+    model_proto = onnx_proto.load_model(path)
+    inits = {t["name"] for t in model_proto["graph"].get("initializer", [])}
+    in_name = [vi["name"] for vi in model_proto["graph"]["input"]
+               if vi["name"] not in inits][0]
+    out_name = model_proto["graph"]["output"][0]["name"]
+    got = np.asarray(sd.output({in_name: x}, out_name))
+    np.testing.assert_allclose(got, expected, rtol=5e-3, atol=5e-4)
+
+    # graft a loss and fine-tune one step on the imported weights
+    from deeplearning4j_tpu.autodiff import TrainingConfig
+    from deeplearning4j_tpu.train.updaters import Adam
+    logits = sd.vars[out_name]
+    labels = sd.placeholder("labels", (None, 1000))
+    sd.loss.softmax_cross_entropy("finetune_loss", labels, logits)
+    sd.set_loss_variables("finetune_loss")
+    weights = sd.trainable_float_constants()
+    assert len(weights) > 20, f"expected a deep weight set, got {len(weights)}"
+    sd.convert_to_variable(*weights)
+    probe = weights[0]
+    before = np.asarray(sd.arrays[probe]).copy()
+    sd.set_training_config(TrainingConfig(
+        updater=Adam(1e-3), data_set_feature_mapping=[in_name],
+        data_set_label_mapping=["labels"]))
+    y = np.eye(1000, dtype=np.float32)[
+        np.random.default_rng(1).integers(0, 1000, 2)]
+    hist = sd.fit(x, y, epochs=1)
+    assert np.isfinite(hist[-1])
+    assert not np.allclose(before, np.asarray(sd.arrays[probe]))

@@ -247,6 +247,7 @@ CASES = {
     "svd": ((_A,), {}, None, ()),
     "qr": ((_A3,), {}, None, ()),
     "eigh": ((_SPD,), {}, None, ()),
+    "eig": ((_A3,), {}, None, ()),
     "matrix_band_part": ((_A3,), {"num_lower": 1, "num_upper": 1},
                          lambda a: np.tril(np.triu(a, -1), 1), ()),
     "cross": ((_V3, _W3), {}, np.cross, (0, 1)),
@@ -940,6 +941,18 @@ def test_qr_reconstructs():
     np.testing.assert_allclose(q @ r, _A3, rtol=1e-4, atol=1e-4)
     np.testing.assert_allclose(q.T @ q, np.eye(3), atol=1e-5)
     np.testing.assert_allclose(np.tril(r, -1), 0, atol=1e-6)
+
+
+def test_eig_reconstructs():
+    """General eig via host callback: A @ v_i == w_i * v_i."""
+    a = np.asarray(_A3, np.float32)
+    w, v = get_op("eig")(jnp.asarray(a))
+    w, v = np.asarray(w), np.asarray(v)
+    np.testing.assert_allclose(a.astype(np.complex64) @ v, v * w[None, :],
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(sorted(np.abs(w)),
+                               sorted(np.abs(np.linalg.eigvals(a))),
+                               rtol=1e-4, atol=1e-4)
 
 
 def test_eigh_reconstructs():
