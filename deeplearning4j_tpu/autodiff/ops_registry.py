@@ -89,8 +89,18 @@ register("selu")(jax.nn.selu)
 # NOTE: wrapping the erf form in jax.checkpoint to skip its saved
 # intermediate was measured BOTH ways on the imported BERT-base: -1.2 GB
 # before the layout passes, +1.8 GB after them (the checkpoint barrier
-# blocks the post-layout fusions). Kept plain.
-register("gelu")(lambda a, approximate=True: jax.nn.gelu(a, approximate=approximate))
+# blocks the post-layout fusions). The recompute-in-backward custom_vjps
+# (ops.activations) take the third route: save ONLY the input, recompute
+# erf/tanh in the backward — no checkpoint barrier, no saved intermediate.
+from deeplearning4j_tpu.ops.activations import (gelu_exact_recompute,
+                                                gelu_tanh_recompute)
+
+
+@register("gelu")
+def _gelu(a, approximate=True):
+    if approximate:
+        return gelu_tanh_recompute(a)
+    return gelu_exact_recompute(a)
 register("softplus")(jax.nn.softplus)
 register("softsign")(jax.nn.soft_sign)
 register("swish")(jax.nn.swish)

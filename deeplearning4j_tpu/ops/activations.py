@@ -50,6 +50,70 @@ def _rationaltanh(x):
     return 1.7159 * jnp.tanh((2.0 / 3.0) * x)
 
 
+@jax.custom_vjp
+def gelu_tanh_recompute(a):
+    """tanh-approximate gelu whose backward saves ONLY the input and
+    recomputes tanh — XLA's autodiff of the plain composition keeps the
+    (batch, ffn) tanh intermediate as a residual, which on BERT-base/v5e
+    was ~0.6 ms/step of pure save traffic (37.9 -> 37.3 ms measured). The
+    input is the producing matmul's output, materialised regardless, so
+    the residual set adds nothing. Values identical to
+    ``jax.nn.gelu(approximate=True)``; grads match to 1e-6.
+
+    Deviation: custom_vjp functions reject forward-mode autodiff — a
+    custom_jvp here would save the derivative tensor as the linearisation
+    residual and defeat the traffic cut. ``jax.jacfwd`` through a
+    gelu-activated layer raises; use ``jax.nn.gelu`` directly for
+    forward-mode work (the reference has no forward-mode surface at all)."""
+    return jax.nn.gelu(a, approximate=True)
+
+
+_GELU_C = 0.7978845608028654  # sqrt(2/pi)
+
+
+def _acc_dtype(dt):
+    # f32 accumulation for low precision; f64 stays f64 (x64 grad-checks)
+    return jnp.promote_types(dt, jnp.float32)
+
+
+def _gelu_tanh_fwd(a):
+    return jax.nn.gelu(a, approximate=True), a
+
+
+def _gelu_tanh_bwd(a, g):
+    af = a.astype(_acc_dtype(a.dtype))
+    t = jnp.tanh(_GELU_C * (af + 0.044715 * af ** 3))
+    d = 0.5 * (1.0 + t) + 0.5 * af * (1.0 - t * t) * _GELU_C * (
+        1.0 + 3 * 0.044715 * af * af)
+    return ((g.astype(af.dtype) * d).astype(a.dtype),)
+
+
+gelu_tanh_recompute.defvjp(_gelu_tanh_fwd, _gelu_tanh_bwd)
+
+
+@jax.custom_vjp
+def gelu_exact_recompute(a):
+    """Exact (erf) gelu with the same save-only-the-input backward as
+    ``gelu_tanh_recompute`` — imported BERT's erf-gelu residual was
+    ~2.6 GB/step of saved erf intermediates (1326 -> 1424 samples/s on
+    v5e when recomputed). Same forward-mode deviation applies."""
+    return jax.nn.gelu(a, approximate=False)
+
+
+def _gelu_exact_fwd(a):
+    return jax.nn.gelu(a, approximate=False), a
+
+
+def _gelu_exact_bwd(a, g):
+    af = a.astype(_acc_dtype(a.dtype))
+    cdf = 0.5 * (1.0 + jax.scipy.special.erf(af * 0.7071067811865476))
+    pdf = jnp.exp(-0.5 * af * af) * 0.3989422804014327
+    return ((g.astype(af.dtype) * (cdf + af * pdf)).astype(a.dtype),)
+
+
+gelu_exact_recompute.defvjp(_gelu_exact_fwd, _gelu_exact_bwd)
+
+
 _FNS: dict[str, Callable] = {
     "identity": lambda x: x,
     "relu": jax.nn.relu,
@@ -57,7 +121,7 @@ _FNS: dict[str, Callable] = {
     "leakyrelu": lambda x: jax.nn.leaky_relu(x, negative_slope=0.01),
     "elu": jax.nn.elu,
     "selu": jax.nn.selu,
-    "gelu": jax.nn.gelu,
+    "gelu": gelu_tanh_recompute,
     "tanh": jnp.tanh,
     "sigmoid": jax.nn.sigmoid,
     # DL4J/Keras hardSigmoid is clip(0.2x+0.5) — a DIFFERENT slope from
