@@ -330,6 +330,63 @@ def verify_kernels():
     out["gru_grad_speedup_spread"] = round(spread, 3)
     _log(f"[kernels] fused GRU: fwd_err={err_f:.2e} bwd_err={err_b:.2e} "
          f"grad speedup {sp:.2f}x ±{spread:.2f}")
+
+    # ---- short-T fused attention (opt-in; verify correctness on-device) ----
+    from deeplearning4j_tpu.ops.pallas.fused_attention_short import (
+        short_attention, short_attention_compatible)
+    Bs, Hs, Ts, Ds = 64, 12, 128, 64
+    qs = jnp.asarray(rng.normal(0, 1, (Bs, Hs, Ts, Ds)), jnp.bfloat16)
+    ks_ = jnp.asarray(rng.normal(0, 1, (Bs, Hs, Ts, Ds)), jnp.bfloat16)
+    vs = jnp.asarray(rng.normal(0, 1, (Bs, Hs, Ts, Ds)), jnp.bfloat16)
+    assert short_attention_compatible(qs, ks_, vs)
+
+    def xla_short(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) / np.sqrt(Ds)
+        return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1),
+                          v.astype(jnp.float32)).astype(q.dtype)
+
+    yk = jax.jit(lambda q, k, v: short_attention(q, k, v))(qs, ks_, vs)
+    yx = jax.jit(xla_short)(qs, ks_, vs)
+    err_f = float(jnp.max(jnp.abs(yk.astype(jnp.float32)
+                                  - yx.astype(jnp.float32))))
+    gk2 = jax.jit(jax.grad(lambda q: jnp.sum(
+        short_attention(q, ks_, vs).astype(jnp.float32) ** 2)))
+    gx2 = jax.jit(jax.grad(lambda q: jnp.sum(
+        xla_short(q, ks_, vs).astype(jnp.float32) ** 2)))
+    dk2, dx2 = gk2(qs), gx2(qs)
+    gscale = float(jnp.max(jnp.abs(dx2.astype(jnp.float32))))
+    err_b = float(jnp.max(jnp.abs(dk2.astype(jnp.float32)
+                                  - dx2.astype(jnp.float32))))
+    assert err_f <= 0.05, f"short attention fwd mismatch: {err_f}"
+    assert err_b <= 0.05 * max(gscale, 1.0), \
+        f"short attention bwd mismatch: {err_b}"
+    sp, spread, tk, tx = ab_speedup(lambda: gk2(qs), lambda: gx2(qs))
+    out["short_attn_fwd_max_err"] = err_f
+    out["short_attn_bwd_max_err"] = err_b
+    out["short_attn_isolated_speedup_vs_xla"] = round(sp, 3)
+    out["short_attn_speedup_spread"] = round(spread, 3)
+    _log(f"[kernels] short-T attention (opt-in): fwd_err={err_f:.4f} "
+         f"bwd_err={err_b:.4f} isolated grad speedup {sp:.2f}x ±{spread:.2f} "
+         f"(NOT auto-routed: in-model pallas boundary cost exceeds the win)")
+
+    # ---- fused dropout (opt-in; mask statistics + fwd/bwd consistency) ----
+    from deeplearning4j_tpu.ops.pallas.fused_dropout import (
+        fused_dropout, fused_dropout_compatible, seed_from_key)
+    hd = jnp.asarray(rng.normal(0, 1, (8192, 768)), jnp.bfloat16)
+    seedv = seed_from_key(jax.random.PRNGKey(3))
+    assert fused_dropout_compatible(hd, 0.1)
+    yd = jax.jit(lambda h, s: fused_dropout(h, s, 0.1))(hd, seedv)
+    frac = float(jnp.mean((yd == 0)))
+    gd_ = jax.jit(jax.grad(lambda h: jnp.sum(
+        fused_dropout(h, seedv, 0.1).astype(jnp.float32))))(hd)
+    mask_match = bool(jnp.all((gd_ != 0) == (yd != 0)))
+    assert 0.08 < frac < 0.12, f"fused dropout rate off: {frac}"
+    assert mask_match, "fused dropout bwd regenerated a different mask"
+    out["fused_dropout_zero_frac"] = round(frac, 4)
+    out["fused_dropout_bwd_mask_matches"] = mask_match
+    _log(f"[kernels] fused dropout (opt-in): zero_frac={frac:.4f} "
+         f"bwd mask regenerated identically: {mask_match}")
     return out
 
 
