@@ -30,11 +30,17 @@ def _tf():
 class TFGraphMapper:
     @staticmethod
     def import_graph(path_or_graphdef, input_shapes: Optional[Dict[str, tuple]] = None,
-                     optimize: bool = True) -> SameDiff:
+                     optimize: bool = True,
+                     while_max_iterations: Optional[int] = None) -> SameDiff:
         """Import a frozen .pb file (or a GraphDef proto) into a SameDiff.
         ``optimize`` runs the graph-optimizer fusion passes (layernorm/gelu
         patterns -> fused ops; reference: libnd4j's pre-execution graph
-        optimization)."""
+        optimization). ``while_max_iterations``: when set, every imported
+        While loop (functional or TF1 frames) lowers to a fixed-length
+        masked ``lax.scan`` of that length instead of ``lax.while_loop`` —
+        the scan form is reverse-differentiable, so graphs containing loops
+        can be fine-tuned with ``sd.fit`` (the while form is forward-only,
+        as in JAX)."""
         tf = _tf()
         if isinstance(path_or_graphdef, (str, bytes)):
             gd = tf.compat.v1.GraphDef()
@@ -42,7 +48,9 @@ class TFGraphMapper:
                 gd.ParseFromString(f.read())
         else:
             gd = path_or_graphdef
-        sd = _GraphImporter(gd, input_shapes or {}).run()
+        imp = _GraphImporter(gd, input_shapes or {})
+        imp.while_max_iterations = while_max_iterations
+        sd = imp.run()
         if optimize:
             from deeplearning4j_tpu.autodiff.graph_optimizer import (
                 optimize as _opt)
@@ -102,6 +110,8 @@ class _GraphImporter:
         # by the per-node loop (the frame's cond/body are re-imported as
         # standalone subgraphs feeding sd.while_loop)
         self._frame_consumed: set = set()
+        # opt-in: lower While loops to fixed-length differentiable scans
+        self.while_max_iterations: Optional[int] = None
 
     # --- helpers ---
     @staticmethod
@@ -357,9 +367,27 @@ class _GraphImporter:
             interior.append(node)
             frame_nodes.add(name)
             stack.extend(self._clean(i) for i in node.input)
-        order = {n.name: i for i, n in enumerate(self.gd.node)}
-        interior.sort(key=lambda n: order[n.name])
-        return interior, used
+        # TOPO-sort the slice: graphs lowered from functional control flow
+        # (convert_variables_to_constants_v2 lowers While to v1 frames) are
+        # NOT topologically ordered, and the sub-importer maps nodes in
+        # list order
+        names = {n.name for n in interior}
+        deps = {n.name: [d for d in (self._clean(i) for i in n.input)
+                         if d in names] for n in interior}
+        done, out_order, nodes_by = set(), [], {n.name: n for n in interior}
+        def visit(nm, chain=()):
+            if nm in done:
+                return
+            if nm in chain:
+                raise NotImplementedError(
+                    f"cycle through {nm!r} in frame slice")
+            for d in deps[nm]:
+                visit(d, chain + (nm,))
+            done.add(nm)
+            out_order.append(nodes_by[nm])
+        for n in interior:
+            visit(n.name)
+        return out_order, used
 
     def _frame_subgraph_callable(self, roots: List[str],
                                  stops: Dict[str, str], frame_nodes: set):
@@ -411,9 +439,10 @@ class _GraphImporter:
         """Reconstruct one TF1 while frame and lower it onto
         ``sd.while_loop`` (upstream ``TFGraphMapper`` + SameDiff frame ops;
         SURVEY.md §3.3). Carries = Merge chains; loop-invariant Enters ride
-        along as carries the body returns unchanged. Forward execution via
-        ``lax.while_loop`` — like the functional While path, reverse-mode
-        AD through the loop is unsupported (freeze for inference)."""
+        along as carries the body returns unchanged. Default lowering is
+        ``lax.while_loop`` (forward-only, like the functional While path);
+        pass ``while_max_iterations`` to ``import_graph`` for the
+        differentiable fixed-length scan form."""
         enters = [n for n in self.gd.node
                   if n.op == "Enter" and self._attr(n, "frame_name") == frame]
         enter_names = {n.name for n in enters}
@@ -503,7 +532,8 @@ class _GraphImporter:
         outs = self.sd.while_loop(
             cond, body, *[self.sd.vars[self._ensure_var(r)]
                           for r in init_refs],
-            name=f"{frame.replace('/', '_')}_while")
+            name=f"{frame.replace('/', '_')}_while",
+            max_iterations=self.while_max_iterations)
         outs = outs if isinstance(outs, tuple) else (outs,)
         for i, ex in enumerate(exit_nodes):
             if ex is not None:
@@ -939,10 +969,21 @@ class _GraphImporter:
             body_f = self._function_callable(node.attr["body"].func.name)
             n = len(ins)
             vars_ = [sd.vars[self._ensure_var(i)] for i in ins]
+
+            def cond_w(*c, key=None):
+                return cond_f(*c, key=key)[0]
+
+            def body_w(*c, key=None):
+                return tuple(body_f(*c, key=key))
+
+            # keep the per-step rng threading through the wrappers (dropout
+            # inside a While body stays live during sd.fit)
+            cond_w._accepts_rng = True
+            body_w._accepts_rng = True
             outs = sd.while_loop(
-                lambda *c: cond_f(*c)[0],
-                lambda *c: tuple(body_f(*c)),
-                *vars_, name=node.name)
+                cond_w, body_w,
+                *vars_, name=node.name,
+                max_iterations=self.while_max_iterations)
             outs = outs if isinstance(outs, tuple) else (outs,)
             self._name_outputs(node, outs)
             return
@@ -953,11 +994,19 @@ class _GraphImporter:
             pred_v = sd.vars[self._ensure_var(ins[0])]
             arg_vs = [sd.vars[self._ensure_var(i)] for i in ins[1:]]
             if nout == 1:
-                tf_fn = lambda *xs: then_f(*xs)[0]
-                ef_fn = lambda *xs: else_f(*xs)[0]
+                def tf_fn(*xs, key=None):
+                    return then_f(*xs, key=key)[0]
+
+                def ef_fn(*xs, key=None):
+                    return else_f(*xs, key=key)[0]
             else:
-                tf_fn = lambda *xs: tuple(then_f(*xs))
-                ef_fn = lambda *xs: tuple(else_f(*xs))
+                def tf_fn(*xs, key=None):
+                    return tuple(then_f(*xs, key=key))
+
+                def ef_fn(*xs, key=None):
+                    return tuple(else_f(*xs, key=key))
+            tf_fn._accepts_rng = True
+            ef_fn._accepts_rng = True
             outs = sd.cond(pred_v, tf_fn, ef_fn, *arg_vs, name=node.name,
                            n_outputs=nout)
             outs = outs if isinstance(outs, tuple) else (outs,)

@@ -896,3 +896,50 @@ def test_tf1_while_loop_invariant_and_multi_carry():
     sd = TFGraphMapper.import_graph(gd)
     got = np.asarray(sd.output({"x": x_np}, out_name))
     np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_while_import_differentiable_with_max_iterations():
+    """``import_graph(while_max_iterations=N)`` lowers imported While loops
+    to the masked-scan form, so graphs containing loops can be FINE-TUNED
+    (the default lax.while_loop lowering is forward-only)."""
+    from deeplearning4j_tpu.autodiff import TrainingConfig
+    from deeplearning4j_tpu.imports import TFGraphMapper
+    from deeplearning4j_tpu.train.updaters import Sgd
+    w = tf.constant(np.full((4, 4), 0.1, np.float32))
+
+    def model(x):
+        def cond(i, acc):
+            return i < 3
+
+        def body(i, acc):
+            return i + 1, tf.tanh(acc @ w) + x
+
+        _, acc = tf.while_loop(cond, body, (tf.constant(0), x))
+        return acc
+
+    gd, inputs, outputs = _frozen_graphdef(
+        model, [tf.TensorSpec((2, 4), tf.float32, name="x")])
+    x_np = np.random.default_rng(0).normal(0, 1, (2, 4)).astype(np.float32)
+    expected = model(tf.constant(x_np)).numpy()
+
+    sd = TFGraphMapper.import_graph(gd, while_max_iterations=3)
+    got = np.asarray(sd.output({inputs[0]: x_np}, outputs[0]))
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+    # fine-tune THROUGH the loop: convert the weight constant, fit, and
+    # require the loss to move (gradients flow through the scanned body)
+    out_v = sd.vars[outputs[0]]
+    labels = sd.placeholder("labels", (None, 4))
+    sd.loss.mean_squared_error("loss", labels, out_v)
+    sd.set_loss_variables("loss")
+    weights = sd.trainable_float_constants()
+    assert weights, "no weight constants found"
+    sd.convert_to_variable(*weights)
+    sd.set_training_config(TrainingConfig(
+        updater=Sgd(0.05), data_set_feature_mapping=[inputs[0]],
+        data_set_label_mapping=["labels"]))
+    y = np.zeros((2, 4), np.float32)
+    losses = []
+    for _ in range(8):
+        losses.extend(sd.fit(x_np, y, epochs=1))
+    assert losses[-1] < losses[0] * 0.9, losses
