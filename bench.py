@@ -75,18 +75,19 @@ def wait_for_quiet_host(threshold=LOAD_GATE, timeout=90, poll=3.0):
     return load
 
 
-def ab_speedup(fn_a, fn_b, iters=10, repeats=7, max_extra=7):
-    """A/B timing: load-gated, pair-interleaved, MIN-of-repeats based.
+def ab_speedup(fn_a, fn_b, iters=6, pairs=15):
+    """A/B timing: median of per-PAIR ratios over many short, load-gated,
+    order-alternated pairs.
 
-    Per repeat, A and B are timed back-to-back (a load spike hits both
-    sides). The reported speedup is min(t_b)/min(t_a) — the chip was
-    observed (round 4) to flip between ~fast and ~1.35x-slow regimes for
-    minutes at a time, so medians of mixed-regime samples wander across
-    runs; the contention-free FLOOR of each side is the stable, physically
-    meaningful statistic. Extra repeats are added (up to ``max_extra``)
-    while either side's floor is still improving >2%, which rides out a
-    slow-regime window instead of publishing it. ``spread`` is the range
-    of per-repeat ratios — an honesty figure, not the estimator."""
+    Why this exact shape (round-4 calibration): the chip flips between a
+    fast and a ~1.35x-slow regime on a MINUTES scale, so any estimator
+    that compares an A sample to a B sample from different moments
+    (medians of independent samples, or round 4's first attempt —
+    floor-of-each-side) wanders across runs. A single back-to-back pair is
+    much shorter than a regime window, so the regime multiplies both sides
+    of the pair equally and the RATIO stays clean; alternating the order
+    (a,b / b,a) cancels within-pair drift. The reported ``spread`` is the
+    interquartile range of the pair ratios — an honesty figure."""
     import jax
     for fn in (fn_a, fn_b):
         r = fn()
@@ -101,20 +102,19 @@ def ab_speedup(fn_a, fn_b, iters=10, repeats=7, max_extra=7):
         _drain(jax.tree.leaves(r)[0])
         return (time.perf_counter() - t0) / iters
 
-    tas, tbs, ratios = [], [], []
-    done = 0
-    while done < repeats + max_extra:
+    ratios, tas, tbs = [], [], []
+    for p in range(pairs):
         wait_for_quiet_host()
-        ta, tb = one(fn_a), one(fn_b)
+        if p % 2 == 0:
+            ta, tb = one(fn_a), one(fn_b)
+        else:
+            tb, ta = one(fn_b), one(fn_a)
         tas.append(ta); tbs.append(tb); ratios.append(tb / ta)
-        done += 1
-        if done >= repeats:
-            # stop once both floors have stopped improving
-            if (min(tas[:-1]) <= min(tas) * 1.02
-                    and min(tbs[:-1]) <= min(tbs) * 1.02):
-                break
-    spread = max(ratios) - min(ratios)
-    return min(tbs) / min(tas), spread, min(tas), min(tbs)
+    ratios.sort()
+    n = len(ratios)
+    med = ratios[n // 2]
+    iqr = ratios[(3 * n) // 4] - ratios[n // 4]
+    return med, iqr, min(tas), min(tbs)
 
 
 # ------------------------------------------------------------------ kernels
@@ -558,9 +558,11 @@ def bench_resnet():
         ts, loss = step_fn(ts, {"input": x}, [y],
                            jax.random.fold_in(key, 1000 + i), None)
         _ = float(loss)
-    repeats = 1 if on_cpu else 6
+    repeats = 1 if on_cpu else 5
     times = []
-    for r in range(repeats):
+    r = 0
+    # steady-state protocol — see bench_zoo_bert for the rationale
+    while r < (1 if on_cpu else 10):
         if not on_cpu:
             wait_for_quiet_host()
         t0 = time.perf_counter()
@@ -569,17 +571,15 @@ def bench_resnet():
                                jax.random.fold_in(key, i), None)
         _ = float(loss)  # drain; tunnel round trip amortised over steps
         times.append(time.perf_counter() - t0)
-        # ride out a slow-regime window (chip flips between ~fast and
-        # ~1.35x-slow for minutes): if this repeat was >15% off the floor,
-        # allow one extra repeat in its place
-        if not on_cpu and len(times) >= 3 and repeats < 10 \
-                and times[-1] > min(times) * 1.15:
-            repeats += 1
-    times.sort()
-    med = times[len(times) // 2]
-    _log(f"[resnet] {batch*steps/med:.0f} img/s median "
-         f"(best {batch*steps/times[0]:.0f}, worst {batch*steps/times[-1]:.0f},"
-         f" n={len(times)}, load {host_load()})")
+        r += 1
+        steady = [t for t in times if t <= min(times) * 1.10]
+        if len(steady) >= repeats:
+            break
+    steady = sorted(t for t in times if t <= min(times) * 1.10)
+    med = steady[len(steady) // 2]
+    _log(f"[resnet] {batch*steps/med:.0f} img/s steady-median "
+         f"(best {batch*steps/steady[0]:.0f}, {len(steady)}/{len(times)} "
+         f"steady, load {host_load()})")
     return batch * steps / med
 
 
@@ -613,7 +613,14 @@ def bench_zoo_bert(batch=64, seq=128, steps=20, repeats=6):
         _ = float(loss)
     times = []
     r = 0
-    while r < repeats:
+    # Steady-state protocol (round 4): the chip flips between a fast and a
+    # ~1.35x-slow regime for minutes at a time. Collect until >=
+    # ``repeats`` samples sit within 10% of the floor (cap 12 total);
+    # report the median OVER THE STEADY SAMPLES as the number of record,
+    # with every raw sample kept alongside for honesty. A slow-regime
+    # window then shows up as extra discarded samples, not as a
+    # permanently low median for the same binary.
+    while r < 12:
         if not on_cpu:
             wait_for_quiet_host()
         t0 = time.perf_counter()
@@ -622,19 +629,21 @@ def bench_zoo_bert(batch=64, seq=128, steps=20, repeats=6):
         _ = float(loss)
         times.append(time.perf_counter() - t0)
         r += 1
-        # slow-regime rider (see bench_resnet): extend while off the floor
-        if not on_cpu and len(times) >= 3 and repeats < 10 \
-                and times[-1] > min(times) * 1.15:
-            repeats += 1
-    times.sort()
-    med = times[len(times) // 2]
+        steady = [t for t in times if t <= min(times) * 1.10]
+        if len(steady) >= repeats:
+            break
+    steady = sorted(t for t in times if t <= min(times) * 1.10)
+    med = steady[len(steady) // 2]
     out = {"zoo_bert_samples_per_sec": round(batch * steps / med, 1),
-           "zoo_bert_samples_per_sec_best": round(batch * steps / times[0], 1),
-           "zoo_bert_repeats": len(times),
+           "zoo_bert_samples_per_sec_best": round(batch * steps / steady[0], 1),
+           "zoo_bert_all_samples_per_sec": [round(batch * steps / t, 1)
+                                            for t in sorted(times)],
+           "zoo_bert_discarded_slow_samples": len(times) - len(steady),
            "zoo_bert_host_load": host_load()}
-    _log(f"[zoo-bert] {out['zoo_bert_samples_per_sec']} samples/s median "
-         f"(best {out['zoo_bert_samples_per_sec_best']}, n={len(times)}, "
-         f"load {out['zoo_bert_host_load']})")
+    _log(f"[zoo-bert] {out['zoo_bert_samples_per_sec']} samples/s "
+         f"steady-median (best {out['zoo_bert_samples_per_sec_best']}, "
+         f"{len(steady)}/{len(times)} steady, load "
+         f"{out['zoo_bert_host_load']})")
 
     if not on_cpu:
         # opt-in full-bf16 state variant (params + Adam moments in bf16);
