@@ -358,12 +358,12 @@ class _GraphImporter:
             node = self.node_by_name.get(name)
             if node is None:
                 continue
-            if node.op in ("Enter", "Exit", "NextIteration", "LoopCond",
-                           "Merge", "Switch"):
-                raise NotImplementedError(
-                    f"TF1 frame node {name!r} ({node.op}) reached outside "
-                    "its carry chain — nested while frames are not "
-                    "supported; re-export with functional control flow")
+            # Frame ops reached here belong to a NESTED while (the current
+            # frame's own Merge/Switch/Enter are stop points): include the
+            # whole inner frame in the slice — the sub-importer lowers it
+            # recursively when it meets the inner Enter. Same-frame ops
+            # reached outside the carry chain are malformed and will hit
+            # the sub-importer's orphan-frame-op check.
             interior.append(node)
             frame_nodes.add(name)
             stack.extend(self._clean(i) for i in node.input)
@@ -372,8 +372,22 @@ class _GraphImporter:
         # NOT topologically ordered, and the sub-importer maps nodes in
         # list order
         names = {n.name for n in interior}
-        deps = {n.name: [d for d in (self._clean(i) for i in n.input)
-                         if d in names] for n in interior}
+
+        def _deps(n):
+            out = []
+            for d in (self._clean(i) for i in n.input):
+                if d not in names:
+                    continue
+                # a nested frame's Merge <- NextIteration edge is the
+                # loop's back-edge; dropping it makes the slice acyclic
+                # (the sub-importer re-discovers the loop structure)
+                if n.op == "Merge" and \
+                        self.node_by_name[d].op == "NextIteration":
+                    continue
+                out.append(d)
+            return out
+
+        deps = {n.name: _deps(n) for n in interior}
         done, out_order, nodes_by = set(), [], {n.name: n for n in interior}
         def visit(nm, chain=()):
             if nm in done:
@@ -539,6 +553,23 @@ class _GraphImporter:
             if ex is not None:
                 self._alias(ex.name, outs[i].name)
         self._frame_consumed |= frame_nodes
+        # Dead-limb sweep: nested frames leave unreferenced frame ops
+        # outside every slice (e.g. an inner loop-counter's Exit that
+        # nothing consumes). Any frame op whose data inputs are all
+        # consumed is part of the lowered region — absorb it, repeatedly.
+        frame_op_kinds = ("Enter", "Exit", "NextIteration", "LoopCond",
+                          "Merge", "Switch")
+        changed = True
+        while changed:
+            changed = False
+            for n in self.gd.node:
+                if n.op not in frame_op_kinds or \
+                        n.name in self._frame_consumed:
+                    continue
+                ins_ = [self._clean(i) for i in n.input]
+                if ins_ and all(i in self._frame_consumed for i in ins_):
+                    self._frame_consumed.add(n.name)
+                    changed = True
 
     def _map_node(self, node) -> None:
         if node.name in self._frame_consumed:

@@ -943,3 +943,37 @@ def test_while_import_differentiable_with_max_iterations():
     for _ in range(8):
         losses.extend(sd.fit(x_np, y, epochs=1))
     assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_tf1_nested_while_loops_import():
+    """A v1 while INSIDE a v1 while (nested frames): the outer frame's
+    body slice carries the whole inner frame, and the sub-importer lowers
+    it recursively."""
+    from deeplearning4j_tpu.imports import TFGraphMapper
+    tf.compat.v1.disable_control_flow_v2()
+    g = tf.Graph()
+    try:
+      with g.as_default():
+        with tf.compat.v1.Session() as sess:
+            xin = tf.compat.v1.placeholder(tf.float32, (2, 3), name="x")
+
+            def outer_body(i, acc):
+                def inner_body(j, a):
+                    return j + 1, a * 0.5 + 1.0
+
+                _, a_fin = tf.while_loop(
+                    lambda j, a: j < 2, inner_body, (tf.constant(0), acc))
+                return i + 1, a_fin + xin
+
+            _, out = tf.while_loop(lambda i, a: i < 3, outer_body,
+                                   (tf.constant(0), xin))
+            gd = sess.graph.as_graph_def()
+            out_name = out.name.split(":")[0]
+            x_np = np.random.default_rng(2).normal(0, 1, (2, 3)).astype(np.float32)
+            expected = sess.run(out, {xin: x_np})
+    finally:
+        tf.compat.v1.enable_control_flow_v2()
+    assert sum(1 for n in gd.node if n.op == "Enter") > 4  # two frames
+    sd = TFGraphMapper.import_graph(gd)
+    got = np.asarray(sd.output({"x": x_np}, out_name))
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
