@@ -198,6 +198,85 @@ class TransformerEncoderBlock(Layer):
 
 @register_layer
 @dataclasses.dataclass
+class TransformerEncoderStack(Layer):
+    """``n_layers`` identical post-LN encoder blocks executed as ONE
+    ``lax.scan`` over layer-stacked parameters.
+
+    Why it exists: per-layer parameter pytrees cost real money on
+    dispatch-latency-bound links (~400 buffer handles per BERT-base step
+    = ~5.4 ms of host marshaling through the v5e tunnel) and in compile
+    time (the scan body traces once: 28 s vs ~90 s full compile). Why it
+    is NOT the zoo default: measured 48 vs 37 ms/step on v5e at BERT-base
+    shape — ``lax.scan`` blocks XLA's inter-layer fusion/overlap and the
+    scan backward stacks extra residual copies, costing more on-device
+    than the dispatch saving. Pick it when compile time or dispatch
+    latency dominates (very deep stacks, remote links). Same math as a
+    stack of ``TransformerEncoderBlock``s; init draws the same
+    distributions via a vmapped per-layer key split (exact draws differ
+    from the sequential form).
+
+    Per-layer dropout keys are folded from the step key inside the scan.
+    """
+
+    n_layers: int = 12
+    n_heads: int = 12
+    ffn_size: int = 3072
+    dropout_rate: float = 0.1
+    layer_norm_eps: float = 1e-12
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def _block(self, g) -> TransformerEncoderBlock:
+        blk = TransformerEncoderBlock(
+            n_heads=self.n_heads, ffn_size=self.ffn_size,
+            dropout_rate=self.dropout_rate,
+            layer_norm_eps=self.layer_norm_eps)
+        blk._g = g
+        return blk
+
+    def init(self, key, input_type, g: GlobalConfig):
+        blk = self._block(g)
+
+        def one(k):
+            p, _ = blk.init(k, input_type, g)
+            return p
+
+        params = jax.vmap(one)(jax.random.split(key, self.n_layers))
+        return {"stack": params}, {}
+
+    def forward(self, params, state, x, *, training=False, rng=None, mask=None):
+        blk = self._block(self._g)
+        stack = params["stack"]
+        if rng is not None:
+            keys = jax.random.split(rng, self.n_layers)
+
+            def body(carry, per):
+                p, k = per
+                y, _ = blk.forward(p, {}, carry, training=training,
+                                   rng=k, mask=mask)
+                return y, None
+
+            y, _ = jax.lax.scan(body, x, (stack, keys))
+        else:
+            def body(carry, p):
+                y, _ = blk.forward(p, {}, carry, training=training,
+                                   rng=None, mask=mask)
+                return y, None
+
+            y, _ = jax.lax.scan(body, x, stack)
+        return y, state
+
+    def regularizable_params(self):
+        # W_ff1/W_ff2 live under the stacked subtree; per-key l1/l2 lookup
+        # does not reach them — BERT-style nets regularize via weight
+        # decay in the updater instead (reference BERT fine-tune recipes
+        # do the same)
+        return ()
+
+
+@register_layer
+@dataclasses.dataclass
 class BertEmbeddingLayer(Layer):
     """BERT input embeddings: token + learned position + segment embeddings,
     LayerNorm, dropout. Input: (batch, time) int32 token ids (single-segment;
