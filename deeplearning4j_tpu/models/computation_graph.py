@@ -554,7 +554,7 @@ class ComputationGraph:
                 out[n] = apply_layer_constraints(self.conf.node(n).obj, out[n])
         return out
 
-    def _make_train_step(self):
+    def _train_step_fn(self):
         def step(ts: TrainState, inputs, labels, rng, masks):
             (loss, (new_state, _)), grads = jax.value_and_grad(
                 self._loss, has_aux=True)(
@@ -565,7 +565,24 @@ class ComputationGraph:
             return TrainState(params=new_params, model_state=new_state,
                               opt_state=new_opt, step=ts.step + 1), loss
 
-        return jax.jit(step, donate_argnums=(0,))
+        return step
+
+    def _make_train_step(self):
+        return jax.jit(self._train_step_fn(), donate_argnums=(0,))
+
+    def _make_packed_train_step(self):
+        """Train step with flat-packed small leaves at the jit boundary
+        (see :mod:`deeplearning4j_tpu.runtime.state_packing`): same math,
+        bit-identical results, ~4x fewer buffer handles per dispatch."""
+        from deeplearning4j_tpu.runtime.state_packing import LeafPacker
+        packer = LeafPacker(self.train_state)
+        raw = self._train_step_fn()
+
+        def packed_step(pts, inputs, labels, rng, masks):
+            new_ts, loss = raw(packer.unpack(pts), inputs, labels, rng, masks)
+            return packer.pack(new_ts), loss
+
+        return jax.jit(packed_step, donate_argnums=(0,)), packer
 
     def _make_tbptt_step(self):
         """Train step carrying recurrent state across truncated chunks
@@ -591,6 +608,12 @@ class ComputationGraph:
         if key not in self._jit_cache:
             self._jit_cache[key] = factory()
         return self._jit_cache[key]
+
+    def _packed_cache_key(self) -> str:
+        return f"packed_train_step@remat={get_environment().remat_segments}"
+
+    def _jitted_packed(self):
+        return self._jitted("packed_train_step", self._make_packed_train_step)
 
     def _coerce_batch(self, batch) -> Tuple[Dict[str, Any], List[Any], Optional[Dict]]:
         from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
@@ -620,8 +643,18 @@ class ComputationGraph:
                 [DataSet(np.asarray(data), np.asarray(labels))], batch_size=len(data))
         else:
             iterator = data
-        step_fn = self._jitted("train_step", self._make_train_step)
-        for _ in range(int(epochs)):
+        from deeplearning4j_tpu.runtime.state_packing import PackedStepLoop
+        ploop = PackedStepLoop.for_network(self)
+        try:
+            self._fit_epochs(iterator, int(epochs), ploop)
+        finally:
+            # any exit path (incl. KeyboardInterrupt / iterator errors) must
+            # leave train_state reflecting every completed step
+            ploop.sync(release=True)
+        return self
+
+    def _fit_epochs(self, iterator, epochs: int, ploop) -> None:
+        for _ in range(epochs):
             for lst in self._listeners:
                 lst.on_epoch_start(self, self._epoch)
             iterator.reset()
@@ -635,24 +668,26 @@ class ComputationGraph:
                             "tBPTT training with optimization_algo="
                             f"{algo!r} is not supported; use SGD or full-"
                             "sequence BPTT")
+                    ploop.sync(release=True)  # tBPTT mutates train_state
                     self._fit_tbptt(inputs, labels_, masks)
                     continue
                 if algo != "STOCHASTIC_GRADIENT_DESCENT":
                     from deeplearning4j_tpu.train.solvers import (
                         graph_solver_fit_batch)
+                    ploop.sync(release=True)  # solver mutates train_state
                     loss = graph_solver_fit_batch(self, inputs, labels_, masks)
                 else:
                     rng = self.rng.next_key()
-                    self.train_state, loss = step_fn(self.train_state, inputs,
-                                                     labels_, rng, masks)
+                    loss, = ploop.step(inputs, labels_, rng, masks)
                 self._score = loss
                 self._iteration += 1
                 for lst in self._listeners:
                     lst.iteration_done(self, self._iteration, self._epoch, loss)
+            # no epoch-end sync: packing only runs when every listener is
+            # stateless, so nothing reads train_state until fit() returns
             for lst in self._listeners:
                 lst.on_epoch_end(self, self._epoch)
             self._epoch += 1
-        return self
 
     def _fit_tbptt(self, inputs, labels_, masks):
         """Chunk the time axis into tbptt-length windows, carrying hidden

@@ -277,7 +277,7 @@ class MultiLayerNetwork:
                 out[k] = apply_layer_constraints(layer, out[k])
         return out
 
-    def _make_train_step(self):
+    def _train_step_fn(self):
         def train_step(ts: TrainState, x, y, rng, fmask, lmask):
             (loss, (new_state, _)), grads = jax.value_and_grad(self._loss, has_aux=True)(
                 ts.params, ts.model_state, x, y, rng, fmask, lmask)
@@ -287,7 +287,24 @@ class MultiLayerNetwork:
             return TrainState(params=new_params, model_state=new_state,
                               opt_state=new_opt, step=ts.step + 1), loss
 
-        return jax.jit(train_step, donate_argnums=(0,))
+        return train_step
+
+    def _make_train_step(self):
+        return jax.jit(self._train_step_fn(), donate_argnums=(0,))
+
+    def _make_packed_train_step(self):
+        """Train step whose boundary carries flat-packed small leaves
+        (see :mod:`deeplearning4j_tpu.runtime.state_packing`): same math,
+        bit-identical results, ~4x fewer buffer handles per dispatch."""
+        from deeplearning4j_tpu.runtime.state_packing import LeafPacker
+        packer = LeafPacker(self.train_state)
+        raw = self._train_step_fn()
+
+        def packed_step(pts, x, y, rng, fmask, lmask):
+            new_ts, loss = raw(packer.unpack(pts), x, y, rng, fmask, lmask)
+            return packer.pack(new_ts), loss
+
+        return jax.jit(packed_step, donate_argnums=(0,)), packer
 
     def _make_tbptt_step(self):
         """Train step with explicit recurrent carries (truncated BPTT)."""
@@ -311,6 +328,12 @@ class MultiLayerNetwork:
             self._jit_cache[name] = factory()
         return self._jit_cache[name]
 
+    def _packed_cache_key(self) -> str:
+        return f"packed_train_step@remat={get_environment().remat_segments}"
+
+    def _jitted_packed(self):
+        return self._jitted("packed_train_step", self._make_packed_train_step)
+
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, epochs: int = 1, mask=None,
             labels_mask=None) -> "MultiLayerNetwork":
@@ -329,8 +352,18 @@ class MultiLayerNetwork:
             iterator = ListDataSetIterator([ds], batch_size=len(ds))
         else:
             iterator = data
-        step_fn = self._jitted("train_step", self._make_train_step)
-        for _ in range(int(epochs)):
+        from deeplearning4j_tpu.runtime.state_packing import PackedStepLoop
+        ploop = PackedStepLoop.for_network(self)
+        try:
+            self._fit_epochs(iterator, int(epochs), ploop)
+        finally:
+            # any exit path (incl. KeyboardInterrupt / iterator errors) must
+            # leave train_state reflecting every completed step
+            ploop.sync(release=True)
+        return self
+
+    def _fit_epochs(self, iterator, epochs: int, ploop) -> None:
+        for _ in range(epochs):
             for lst in self._listeners:
                 lst.on_epoch_start(self, self._epoch)
             iterator.reset()
@@ -351,10 +384,12 @@ class MultiLayerNetwork:
                             "truncated BPTT is only supported with "
                             "STOCHASTIC_GRADIENT_DESCENT (matching "
                             "ComputationGraph)")
+                    ploop.sync(release=True)  # tBPTT mutates train_state
                     self._fit_tbptt(x, y, fm, lm)
                     continue
                 if self.conf.global_conf.optimization_algo !=                         "STOCHASTIC_GRADIENT_DESCENT":
                     from deeplearning4j_tpu.train.solvers import solver_fit_batch
+                    ploop.sync(release=True)  # solver mutates train_state
                     loss = solver_fit_batch(self, x, y, fm, lm)
                     self._score = loss
                     self._iteration += 1
@@ -364,17 +399,18 @@ class MultiLayerNetwork:
                         lst.iteration_done(self, self._iteration, self._epoch, loss)
                     continue
                 rng = self.rng.next_key()
-                self.train_state, loss = step_fn(self.train_state, x, y, rng, fm, lm)
+                loss, = ploop.step(x, y, rng, fm, lm)
                 self._score = loss
                 self._iteration += 1
                 for lst in self._listeners:
                     if isinstance(lst, PerformanceListener):
                         lst.record_batch(x.shape[0])
                     lst.iteration_done(self, self._iteration, self._epoch, loss)
+            # no epoch-end sync: packing only runs when every listener is
+            # stateless, so nothing reads train_state until fit() returns
             for lst in self._listeners:
                 lst.on_epoch_end(self, self._epoch)
             self._epoch += 1
-        return self
 
     def _fit_tbptt(self, x, y, fmask, lmask):
         """Split the time axis into tbptt-length chunks, carrying hidden state

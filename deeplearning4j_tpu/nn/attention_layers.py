@@ -268,11 +268,12 @@ class TransformerEncoderStack(Layer):
         return y, state
 
     def regularizable_params(self):
-        # W_ff1/W_ff2 live under the stacked subtree; per-key l1/l2 lookup
-        # does not reach them — BERT-style nets regularize via weight
-        # decay in the updater instead (reference BERT fine-tune recipes
-        # do the same)
-        return ()
+        # W_ff1/W_ff2 live under the stacked subtree, but both the l1/l2
+        # walk and the weight-decay mask match by PATH COMPONENT, so the
+        # per-block keys reach the stacked leaves; sum-of-squares over the
+        # stacked array equals the per-layer sum — same penalty as the
+        # discrete-block stack.
+        return ("W_ff1", "W_ff2")
 
 
 @register_layer

@@ -19,6 +19,13 @@ logger = logging.getLogger("deeplearning4j_tpu")
 class TrainingListener:
     """SPI — subclass and override what you need (reference interface)."""
 
+    #: Whether this listener reads ``model.train_state`` (params/activations)
+    #: in its callbacks. Listeners that only consume the score/batch counters
+    #: override this to False, which lets ``fit`` keep the training state in
+    #: its packed flat-buffer form between steps (see
+    #: :mod:`deeplearning4j_tpu.runtime.state_packing`).
+    needs_model_state = True
+
     def iteration_done(self, model, iteration: int, epoch: int, score) -> None:
         pass
 
@@ -44,6 +51,8 @@ BaseTrainingListener = TrainingListener  # reference has an adapter base class
 class ScoreIterationListener(TrainingListener):
     """Log score every N iterations (reference ``ScoreIterationListener``)."""
 
+    needs_model_state = False
+
     def __init__(self, print_iterations: int = 10):
         self.print_iterations = max(1, int(print_iterations))
 
@@ -55,6 +64,8 @@ class ScoreIterationListener(TrainingListener):
 class PerformanceListener(TrainingListener):
     """Throughput reporting (reference ``PerformanceListener``): batches/sec,
     samples/sec, ETL fraction."""
+
+    needs_model_state = False
 
     def __init__(self, frequency: int = 10, report_samples: bool = True):
         self.frequency = max(1, int(frequency))
@@ -102,6 +113,8 @@ class EvaluativeListener(TrainingListener):
 class CollectScoresListener(TrainingListener):
     """Collect (iteration, score) pairs in memory (reference
     ``CollectScoresIterationListener``) — used by tests and loss-curve goldens."""
+
+    needs_model_state = False
 
     def __init__(self):
         self.scores: list[tuple[int, float]] = []

@@ -1,0 +1,65 @@
+"""TransformerEncoderStack (scan-over-layers) vs discrete blocks.
+
+The stack must compute the SAME function as N ``TransformerEncoderBlock``s
+when given the same weights (sliced per layer), and the regularization
+penalty must reach its stacked ``W_ff1/W_ff2`` leaves exactly as it reaches
+the discrete blocks'.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.attention_layers import (TransformerEncoderBlock,
+                                                    TransformerEncoderStack)
+from deeplearning4j_tpu.nn.base import GlobalConfig
+from deeplearning4j_tpu.nn.inputs import InputType
+
+
+def _setup(n_layers=3, d=16, heads=4, ffn=32, seed=0):
+    g = GlobalConfig(seed=seed)
+    it = InputType.recurrent(d, 8)
+    stack = TransformerEncoderStack(n_layers=n_layers, n_heads=heads,
+                                    ffn_size=ffn, dropout_rate=0.0)
+    stack._g = g
+    sparams, _ = stack.init(jax.random.PRNGKey(seed), it, g)
+    blk = TransformerEncoderBlock(n_heads=heads, ffn_size=ffn, dropout_rate=0.0)
+    blk._g = g
+    return g, it, stack, sparams, blk
+
+
+def test_stack_matches_discrete_blocks():
+    g, it, stack, sparams, blk = _setup()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 8, 16)), jnp.float32)
+
+    y_stack, _ = stack.forward(sparams, {}, x, training=False)
+
+    # slice the stacked params per layer and run discrete blocks
+    y = x
+    for i in range(stack.n_layers):
+        per = jax.tree.map(lambda a: a[i], sparams["stack"])
+        y, _ = blk.forward(per, {}, y, training=False)
+
+    np.testing.assert_allclose(np.asarray(y_stack), np.asarray(y),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_stack_regularization_reaches_ffn_weights():
+    """Path-component matching must produce the same l2 penalty as summing
+    the per-layer blocks (stack leaves are the per-layer leaves stacked)."""
+    _, _, stack, sparams, blk = _setup()
+    reg_keys = set(stack.regularizable_params())
+    assert reg_keys == {"W_ff1", "W_ff2"}
+
+    leaves = jax.tree_util.tree_flatten_with_path(sparams)[0]
+    total = sum(float(jnp.sum(w * w)) for path, w in leaves
+                if any(getattr(p, "key", None) in reg_keys for p in path))
+
+    per_layer = 0.0
+    for i in range(stack.n_layers):
+        per = jax.tree.map(lambda a: a[i], sparams["stack"])
+        per_layer += float(jnp.sum(per["W_ff1"] ** 2))
+        per_layer += float(jnp.sum(per["W_ff2"] ** 2))
+    assert total > 0.0
+    np.testing.assert_allclose(total, per_layer, rtol=1e-6)
