@@ -638,7 +638,7 @@ class SameDiff:
         return {n: a for n, a in self.arrays.items()
                 if self.vars[n].vtype == VariableType.VARIABLE}
 
-    def _make_train_step(self, ph_names: Tuple[str, ...]):
+    def _make_train_step(self, ph_names: Tuple[str, ...], packer=None):
         cfg = self.training_config
         consts = {n: a for n, a in self.arrays.items()
                   if self.vars[n].vtype == VariableType.CONSTANT}
@@ -697,7 +697,19 @@ class SameDiff:
             updates, opt_state = self._tx.update(grads, opt_state, trainable)
             return optax.apply_updates(trainable, updates), opt_state, loss
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        if packer is None:
+            return jax.jit(step, donate_argnums=(0, 1))
+
+        # Packed variant (runtime/state_packing.py): an imported BERT-base
+        # carries ~600 (variable + Adam-moment) leaves, mostly small bias/
+        # layernorm vectors — one buffer-handle marshal each per dispatch.
+        def packed_step(packed, placeholders, step_idx):
+            trainable, opt_state = packer.unpack(packed)
+            new_t, new_o, loss = step(trainable, opt_state, placeholders,
+                                      step_idx)
+            return packer.pack((new_t, new_o)), loss
+
+        return jax.jit(packed_step, donate_argnums=(0,))
 
     def fit(self, data, labels=None, epochs: int = 1, batch_size: Optional[int] = None):
         """Train (reference ``sd.fit(DataSetIterator)``). Accepts a
@@ -727,12 +739,24 @@ class SameDiff:
         # _graph_version covers everything the traced step closes over that
         # the structural key can't see: constant VALUES (set_arr), the
         # training config (l1/l2), graph edits
+        # Packing keeps self.arrays stale until fit returns, so it is only
+        # safe when no attached listener reads model state mid-fit (same
+        # rule as MultiLayerNetwork.fit).
+        use_packing = (get_environment().packed_state
+                       and all(not getattr(l, "needs_model_state", True)
+                               for l in self._listeners))
         key = ("train_step", ph_names, str(get_environment().compute_dtype),
                get_environment().remat_segments,
-               tuple(sorted(trainable)), self._graph_version)
+               tuple(sorted(trainable)), self._graph_version, use_packing)
         if key not in self._jit_cache:
-            self._jit_cache[key] = self._make_train_step(ph_names)
-        step = self._jit_cache[key]
+            if use_packing:
+                from deeplearning4j_tpu.runtime.state_packing import LeafPacker
+                packer = LeafPacker((trainable, self._opt_state))
+                self._jit_cache[key] = (self._make_train_step(ph_names, packer),
+                                        packer)
+            else:
+                self._jit_cache[key] = (self._make_train_step(ph_names), None)
+        step, packer = self._jit_cache[key]
         history = []
         bounds = []
         it_count = 0
@@ -767,28 +791,43 @@ class SameDiff:
                 pass
             return buf
 
-        for ep in range(int(epochs)):
-            iterator.reset()
-            for batch in iterator:
-                feats = [batch.features] if not isinstance(batch.features, list) else batch.features
-                labs = [batch.labels] if not isinstance(batch.labels, list) else batch.labels
-                ph = {n: dev(a) for n, a in
-                      zip(cfg.data_set_feature_mapping, feats)}
-                ph.update({n: dev(a) for n, a in
-                           zip(cfg.data_set_label_mapping, labs)})
-                trainable, self._opt_state, loss = step(
-                    trainable, self._opt_state, ph,
-                    np.uint32(self._train_iter))
-                self._train_iter += 1
-                # keep the loss on-device: a float() here would stall the
-                # pipeline on every step (one full host round-trip per batch
-                # through a remote-device tunnel)
-                history.append(loss)
-                it_count += 1
-                for lst in self._listeners:
-                    lst.iteration_done(self, it_count, ep, loss)
-            bounds.append(it_count)
-        self.arrays.update(trainable)
+        packed = (packer.pack_device((trainable, self._opt_state))
+                  if packer is not None else None)
+        try:
+            for ep in range(int(epochs)):
+                iterator.reset()
+                for batch in iterator:
+                    feats = [batch.features] if not isinstance(batch.features, list) else batch.features
+                    labs = [batch.labels] if not isinstance(batch.labels, list) else batch.labels
+                    ph = {n: dev(a) for n, a in
+                          zip(cfg.data_set_feature_mapping, feats)}
+                    ph.update({n: dev(a) for n, a in
+                               zip(cfg.data_set_label_mapping, labs)})
+                    if packer is None:
+                        trainable, self._opt_state, loss = step(
+                            trainable, self._opt_state, ph,
+                            np.uint32(self._train_iter))
+                    else:
+                        packed, loss = step(packed, ph,
+                                            np.uint32(self._train_iter))
+                    self._train_iter += 1
+                    # keep the loss on-device: a float() here would stall the
+                    # pipeline on every step (one full host round-trip per
+                    # batch through a remote-device tunnel)
+                    history.append(loss)
+                    it_count += 1
+                    for lst in self._listeners:
+                        lst.iteration_done(self, it_count, ep, loss)
+                bounds.append(it_count)
+        finally:
+            from deeplearning4j_tpu.runtime.state_packing import LeafPacker
+            if packed is not None and not LeafPacker.is_dead(packed):
+                # (a raising donated step leaves no newer state to recover)
+                trainable, self._opt_state = packer.unpack_device(
+                    packed, donate=True)
+                self.arrays.update(trainable)  # even on exceptional exit
+        if packer is None:
+            self.arrays.update(trainable)
         if history:
             # ONE device->host transfer for all losses: converting scalars
             # one by one costs a full round trip each on remote tunnels.

@@ -613,7 +613,12 @@ class ComputationGraph:
         return f"packed_train_step@remat={get_environment().remat_segments}"
 
     def _jitted_packed(self):
-        return self._jitted("packed_train_step", self._make_packed_train_step)
+        # keyed directly by _packed_cache_key so the invalidation path in
+        # PackedStepLoop.step pops the SAME key this populates
+        key = self._packed_cache_key()
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._make_packed_train_step()
+        return self._jit_cache[key]
 
     def _coerce_batch(self, batch) -> Tuple[Dict[str, Any], List[Any], Optional[Dict]]:
         from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet

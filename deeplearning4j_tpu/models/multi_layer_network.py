@@ -332,7 +332,12 @@ class MultiLayerNetwork:
         return f"packed_train_step@remat={get_environment().remat_segments}"
 
     def _jitted_packed(self):
-        return self._jitted("packed_train_step", self._make_packed_train_step)
+        # keyed directly by _packed_cache_key so the invalidation path in
+        # PackedStepLoop.step pops the SAME key this populates
+        key = self._packed_cache_key()
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._make_packed_train_step()
+        return self._jit_cache[key]
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, epochs: int = 1, mask=None,

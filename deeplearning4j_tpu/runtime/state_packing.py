@@ -23,12 +23,26 @@ sharding across leaves); this is the single-device/replicated fast path.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import warnings
 from typing import Any, Dict, List, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+
+@contextlib.contextmanager
+def _quiet_donation():
+    """The one-off pack/unpack donations intentionally donate many tiny
+    leaves that XLA cannot alias into the concatenated buffer (it copies
+    them instead — exactly the desired semantics); silence jax's
+    per-compile warning about it."""
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        yield
 
 # One packed segment: leaf index in tree_flatten order, original shape,
 # dtype name, offset (elements) into that dtype's flat buffer, element count.
@@ -109,10 +123,18 @@ class LeafPacker:
         segments: Dict[str, List[jax.Array]] = {dt: [] for dt in self._sizes}
         cursor: Dict[str, int] = {dt: 0 for dt in self._sizes}
         for i, shape, dt, off, n in self._specs:
+            if jnp.dtype(leaves[i].dtype).name != dt:
+                # a silent astype here would mask a stale packer (e.g. an
+                # f32 checkpoint restored into a bf16-template packer) as
+                # precision loss; raising routes callers to rebuild
+                raise ValueError(
+                    f"LeafPacker.pack: leaf {i} is {leaves[i].dtype}, the "
+                    f"packer template recorded {dt} — rebuild the packer "
+                    "for the current state")
             pad_to = off - cursor[dt]
             if pad_to:  # alignment gap from the PREVIOUS segment
                 segments[dt].append(jnp.zeros((pad_to,), dtype=dt))
-            segments[dt].append(leaves[i].reshape((n,)).astype(dt))
+            segments[dt].append(leaves[i].reshape((n,)))
             cursor[dt] = off + n
         buffers = {}
         for dt, total in self._sizes.items():
@@ -133,6 +155,15 @@ class LeafPacker:
             leaves[i] = kept[j]
         return jax.tree_util.tree_unflatten(self._treedef, leaves)
 
+    @staticmethod
+    def is_dead(packed) -> bool:
+        """True if a donated step consumed these buffers (it dispatched,
+        then raised): no post-step state exists anywhere."""
+        buffers, kept = packed
+        return (any(a.is_deleted() for a in buffers.values())
+                or any(a.is_deleted() for a in kept
+                       if hasattr(a, "is_deleted")))
+
     # ------------------------------------------------------------ round trip
     def pack_device(self, tree: Any):
         """Jitted pack (fit-loop entry). DONATES the input tree: kept big
@@ -141,7 +172,8 @@ class LeafPacker:
         a packed loop runs. Wrapper cached so repeat packs don't retrace."""
         if not hasattr(self, "_pack_jit"):
             self._pack_jit = jax.jit(self.pack, donate_argnums=(0,))
-        return self._pack_jit(tree)
+        with _quiet_donation():
+            return self._pack_jit(tree)
 
     def unpack_device(self, packed, donate: bool = False):
         """Jitted unpack (fit-loop exit / listener access); cached wrappers.
@@ -150,7 +182,8 @@ class LeafPacker:
         if donate:
             if not hasattr(self, "_unpack_jit_donate"):
                 self._unpack_jit_donate = jax.jit(self.unpack, donate_argnums=(0,))
-            return self._unpack_jit_donate(packed)
+            with _quiet_donation():
+                return self._unpack_jit_donate(packed)
         if not hasattr(self, "_unpack_jit"):
             self._unpack_jit = jax.jit(self.unpack)
         return self._unpack_jit(packed)
@@ -219,10 +252,7 @@ class PackedStepLoop:
         """
         if self._packed is None:
             return
-        buffers, kept = self._packed
-        dead = (any(a.is_deleted() for a in buffers.values())
-                or any(a.is_deleted() for a in kept if hasattr(a, "is_deleted")))
-        if dead:
+        if LeafPacker.is_dead(self._packed):
             self._packed = None
             return
         self._net.train_state = self._packer.unpack_device(

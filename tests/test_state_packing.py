@@ -63,6 +63,12 @@ class TestLeafPacker:
         with pytest.raises(ValueError):
             packer.pack({"a": jnp.ones((3,)), "b": jnp.ones((2,))})
 
+    def test_dtype_mismatch_raises(self):
+        tree = {"a": jnp.ones((3,), jnp.float32)}
+        packer = LeafPacker(tree)
+        with pytest.raises(ValueError, match="rebuild the packer"):
+            packer.pack({"a": jnp.ones((3,), jnp.bfloat16)})
+
     def test_handle_count_reduction(self):
         net = _make_net()
         packer = LeafPacker(net.train_state)
