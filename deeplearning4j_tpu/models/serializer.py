@@ -73,6 +73,18 @@ class ModelSerializer:
                 zf.writestr(_NORM, buf.getvalue())
 
     @staticmethod
+    def restore_model(path: str, load_updater: bool = True):
+        """Type-dispatching restore (reference ``ModelGuesser`` /
+        ``ModelSerializer.restoreMultiLayerNetworkAndNormalizer`` family):
+        reads the archive metadata and returns the right network class."""
+        with zipfile.ZipFile(path) as zf:
+            kind = (json.loads(zf.read(_META).decode()).get("model_type")
+                    if _META in zf.namelist() else None)
+        if kind == "ComputationGraph":
+            return ModelSerializer.restore_computation_graph(path, load_updater)
+        return ModelSerializer.restore_multi_layer_network(path, load_updater)
+
+    @staticmethod
     def restore_multi_layer_network(path: str, load_updater: bool = True):
         from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
         from deeplearning4j_tpu.nn.config import MultiLayerConfiguration

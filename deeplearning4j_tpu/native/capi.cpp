@@ -1,0 +1,275 @@
+// C API implementation: embeds CPython and drives deeplearning4j_tpu.
+// See dl4j_tpu_c.h for the contract and the parity rationale (reference
+// language bindings [U] jumpy/ pydl4j/ nd4s/ — direction inverted because
+// this framework's core is Python/JAX).
+
+#include "dl4j_tpu_c.h"
+
+#include <Python.h>
+
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+
+namespace {
+
+std::mutex g_mutex;
+std::map<int, PyObject *> g_models;  // handle -> network object
+int g_next_handle = 0;
+std::string g_last_error = "";
+bool g_initialized = false;
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
+  PyErr_Fetch(&type, &value, &trace);
+  PyErr_NormalizeException(&type, &value, &trace);
+  g_last_error = "unknown python error";
+  if (value) {
+    PyObject *s = PyObject_Str(value);
+    if (s) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c) g_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(trace);
+}
+
+// Build a numpy f32 array that COPIES from the caller's buffer.
+PyObject *np_from_buffer(const float *data, const int64_t *shape, int rank) {
+  PyObject *np = PyImport_ImportModule("numpy");
+  if (!np) return nullptr;
+  int64_t n = 1;
+  for (int i = 0; i < rank; ++i) n *= shape[i];
+  PyObject *mv = PyMemoryView_FromMemory(
+      reinterpret_cast<char *>(const_cast<float *>(data)),
+      n * sizeof(float), PyBUF_READ);
+  PyObject *arr = nullptr, *shaped = nullptr;
+  if (mv) {
+    // frombuffer gives a read-only view; .reshape().copy() detaches it
+    PyObject *flat = PyObject_CallMethod(np, "frombuffer", "Os", mv, "float32");
+    if (flat) {
+      PyObject *dims = PyTuple_New(rank);
+      for (int i = 0; i < rank; ++i)
+        PyTuple_SET_ITEM(dims, i, PyLong_FromLongLong(shape[i]));
+      shaped = PyObject_CallMethod(flat, "reshape", "O", dims);
+      if (shaped) arr = PyObject_CallMethod(shaped, "copy", nullptr);
+      Py_XDECREF(shaped);
+      Py_DECREF(dims);
+      Py_DECREF(flat);
+    }
+    Py_DECREF(mv);
+  }
+  Py_DECREF(np);
+  return arr;  // may be nullptr with a python error set
+}
+
+PyObject *get_model(int handle) {
+  auto it = g_models.find(handle);
+  if (it == g_models.end()) {
+    g_last_error = "invalid model handle";
+    return nullptr;
+  }
+  return it->second;
+}
+
+}  // namespace
+
+extern "C" {
+
+int dl4jtpu_init(const char *repo_path) {
+  std::lock_guard<std::mutex> lock(g_mutex);
+  if (g_initialized) return 0;
+  if (!Py_IsInitialized()) Py_InitializeEx(0);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = 0;
+  if (repo_path != nullptr) {
+    PyObject *sys = PyImport_ImportModule("sys");
+    PyObject *path = sys ? PyObject_GetAttrString(sys, "path") : nullptr;
+    PyObject *p = path ? PyUnicode_FromString(repo_path) : nullptr;
+    if (p) PyList_Insert(path, 0, p);
+    Py_XDECREF(p);
+    Py_XDECREF(path);
+    Py_XDECREF(sys);
+  }
+  PyObject *mod = PyImport_ImportModule("deeplearning4j_tpu.models.serializer");
+  if (!mod) {
+    set_error_from_python();
+    rc = -1;
+  } else {
+    Py_DECREF(mod);
+    g_initialized = true;
+  }
+  PyGILState_Release(gil);
+  if (rc == 0) {
+    // Py_InitializeEx leaves THIS thread holding the GIL; release it so
+    // other host threads' PyGILState_Ensure calls can proceed (the
+    // header promises any-thread calls).
+    static PyThreadState *g_main_tstate = nullptr;
+    if (g_main_tstate == nullptr && PyGILState_Check())
+      g_main_tstate = PyEval_SaveThread();
+    (void)g_main_tstate;
+  }
+  return rc;
+}
+
+int dl4jtpu_load(const char *model_path) {
+  std::lock_guard<std::mutex> lock(g_mutex);
+  if (!g_initialized) {
+    g_last_error = "dl4jtpu_init was not called";
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int handle = -1;
+  PyObject *mod = PyImport_ImportModule("deeplearning4j_tpu.models.serializer");
+  PyObject *ser = mod ? PyObject_GetAttrString(mod, "ModelSerializer") : nullptr;
+  PyObject *net = ser ? PyObject_CallMethod(ser, "restore_model", "s", model_path)
+                      : nullptr;
+  if (net) {
+    handle = g_next_handle++;
+    g_models[handle] = net;  // keep the reference
+  } else {
+    set_error_from_python();
+  }
+  Py_XDECREF(ser);
+  Py_XDECREF(mod);
+  PyGILState_Release(gil);
+  return handle;
+}
+
+int64_t dl4jtpu_output(int handle, const float *data, const int64_t *shape,
+                       int rank, float *out, int64_t out_capacity,
+                       int64_t *out_shape, int *out_rank) {
+  std::lock_guard<std::mutex> lock(g_mutex);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int64_t total = -1;
+  do {
+    PyObject *net = get_model(handle);
+    if (!net) break;
+    PyObject *x = np_from_buffer(data, shape, rank);
+    if (!x) { set_error_from_python(); break; }
+    PyObject *pred = PyObject_CallMethod(net, "output", "O", x);
+    Py_DECREF(x);
+    if (!pred) { set_error_from_python(); break; }
+    // ComputationGraph.output returns a list of outputs; take the first
+    if (PyList_Check(pred) || PyTuple_Check(pred)) {
+      PyObject *first = PySequence_GetItem(pred, 0);
+      Py_DECREF(pred);
+      pred = first;
+      if (!pred) { set_error_from_python(); break; }
+    }
+    PyObject *np = PyImport_ImportModule("numpy");
+    PyObject *arr = np ? PyObject_CallMethod(np, "ascontiguousarray", "Os",
+                                             pred, "float32")
+                       : nullptr;
+    Py_XDECREF(np);
+    Py_DECREF(pred);
+    if (!arr) { set_error_from_python(); break; }
+    Py_buffer view;
+    if (PyObject_GetBuffer(arr, &view, PyBUF_CONTIG_RO | PyBUF_FORMAT) != 0) {
+      set_error_from_python();
+      Py_DECREF(arr);
+      break;
+    }
+    total = static_cast<int64_t>(view.len / sizeof(float));
+    int64_t ncopy = total < out_capacity ? total : out_capacity;
+    if (out != nullptr && ncopy > 0)
+      memcpy(out, view.buf, ncopy * sizeof(float));
+    if (out_shape != nullptr && out_rank != nullptr) {
+      *out_rank = view.ndim <= 8 ? view.ndim : 8;
+      for (int i = 0; i < *out_rank; ++i) out_shape[i] = view.shape[i];
+    }
+    PyBuffer_Release(&view);
+    Py_DECREF(arr);
+  } while (false);
+  PyGILState_Release(gil);
+  return total;
+}
+
+double dl4jtpu_fit(int handle, const float *x, const int64_t *xshape,
+                   int xrank, const float *y, const int64_t *yshape,
+                   int yrank) {
+  std::lock_guard<std::mutex> lock(g_mutex);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  double score = std::nan("");
+  do {
+    PyObject *net = get_model(handle);
+    if (!net) break;
+    PyObject *xa = np_from_buffer(x, xshape, xrank);
+    PyObject *ya = xa ? np_from_buffer(y, yshape, yrank) : nullptr;
+    PyObject *r = ya ? PyObject_CallMethod(net, "fit", "OO", xa, ya) : nullptr;
+    Py_XDECREF(xa);
+    Py_XDECREF(ya);
+    if (!r) { set_error_from_python(); break; }
+    Py_DECREF(r);
+    PyObject *s = PyObject_CallMethod(net, "score", nullptr);
+    if (s) {
+      score = PyFloat_AsDouble(s);
+      Py_DECREF(s);
+      if (PyErr_Occurred()) { set_error_from_python(); score = std::nan(""); }
+    } else {
+      set_error_from_python();
+    }
+  } while (false);
+  PyGILState_Release(gil);
+  return score;
+}
+
+int dl4jtpu_save(int handle, const char *model_path) {
+  std::lock_guard<std::mutex> lock(g_mutex);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  do {
+    PyObject *net = get_model(handle);
+    if (!net) break;
+    PyObject *mod = PyImport_ImportModule("deeplearning4j_tpu.models.serializer");
+    PyObject *ser = mod ? PyObject_GetAttrString(mod, "ModelSerializer") : nullptr;
+    PyObject *r = ser ? PyObject_CallMethod(ser, "write_model", "Os", net,
+                                            model_path)
+                      : nullptr;
+    Py_XDECREF(ser);
+    Py_XDECREF(mod);
+    if (!r) { set_error_from_python(); break; }
+    Py_DECREF(r);
+    rc = 0;
+  } while (false);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+void dl4jtpu_close(int handle) {
+  std::lock_guard<std::mutex> lock(g_mutex);
+  auto it = g_models.find(handle);
+  if (it != g_models.end()) {
+    PyGILState_STATE gil = PyGILState_Ensure();
+    Py_DECREF(it->second);
+    PyGILState_Release(gil);
+    g_models.erase(it);
+  }
+}
+
+void dl4jtpu_last_error(char *buf, int64_t buflen) {
+  std::lock_guard<std::mutex> lock(g_mutex);
+  if (buf == nullptr || buflen <= 0) return;
+  snprintf(buf, static_cast<size_t>(buflen), "%s", g_last_error.c_str());
+}
+
+void dl4jtpu_shutdown(void) {
+  std::lock_guard<std::mutex> lock(g_mutex);
+  if (!g_initialized) return;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  for (auto &kv : g_models) Py_DECREF(kv.second);
+  g_models.clear();
+  PyGILState_Release(gil);
+  // Finalizing an embedded interpreter with live jax/XLA state can hang;
+  // leave the runtime alive for the process lifetime (standard practice
+  // for embedded ML runtimes).
+  g_initialized = false;
+}
+
+}  // extern "C"

@@ -1,0 +1,73 @@
+/* C API for deeplearning4j_tpu — language bindings for non-Python hosts.
+ *
+ * The reference shipped language bindings as bridges into its JVM core
+ * (jumpy / pydl4j: Python -> JVM via JNI; nd4s: Scala sugar — upstream
+ * [U] jumpy/, pydl4j/, nd4s/). This framework's core is Python/JAX, so the
+ * binding direction inverts: a C/C++ host application embeds the Python
+ * runtime and drives models through this flat C surface (load, predict,
+ * fit). Same capability row, TPU-era direction.
+ *
+ * Thread-safety: calls may come from any thread; each entry point takes
+ * the GIL. Heavy compute releases it inside JAX as usual.
+ *
+ * Build: see deeplearning4j_tpu/native/__init__.py::build_capi (g++,
+ * links libpython). A minimal host program:
+ *
+ *   dl4jtpu_init(NULL);
+ *   int h = dl4jtpu_load("model.zip");
+ *   int64_t shape[2] = {1, 784};
+ *   float out[10];
+ *   int64_t n = dl4jtpu_output(h, x, shape, 2, out, 10, NULL, NULL);
+ *   dl4jtpu_close(h);
+ *   dl4jtpu_shutdown();
+ */
+#ifndef DL4J_TPU_C_H
+#define DL4J_TPU_C_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Initialise the embedded Python runtime and import the framework.
+ * repo_path: directory to prepend to sys.path (NULL = rely on PYTHONPATH).
+ * Returns 0 on success, -1 on failure (see dl4jtpu_last_error). */
+int dl4jtpu_init(const char *repo_path);
+
+/* Load a ModelSerializer zip (MultiLayerNetwork or ComputationGraph).
+ * Returns a handle >= 0, or -1 on failure. */
+int dl4jtpu_load(const char *model_path);
+
+/* Forward pass. data: row-major f32 input of the given shape.
+ * Writes up to out_capacity floats of the (first) network output into out;
+ * returns the number of floats the full output has, or -1 on failure.
+ * out_shape (optional, may be NULL): receives up to 8 output dims,
+ * out_rank the dim count. */
+int64_t dl4jtpu_output(int handle, const float *data, const int64_t *shape,
+                       int rank, float *out, int64_t out_capacity,
+                       int64_t *out_shape, int *out_rank);
+
+/* One fit batch (features + one-hot/regression labels, both f32
+ * row-major). Returns the score (loss) after the step, or NaN on failure. */
+double dl4jtpu_fit(int handle, const float *x, const int64_t *xshape,
+                   int xrank, const float *y, const int64_t *yshape,
+                   int yrank);
+
+/* Save the model back to a ModelSerializer zip. 0 on success. */
+int dl4jtpu_save(int handle, const char *model_path);
+
+/* Release a model handle. */
+void dl4jtpu_close(int handle);
+
+/* Copy the last error message (UTF-8, NUL-terminated) into buf. */
+void dl4jtpu_last_error(char *buf, int64_t buflen);
+
+/* Finalise the embedded interpreter. */
+void dl4jtpu_shutdown(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* DL4J_TPU_C_H */
