@@ -1,0 +1,52 @@
+/* C host application over the flat C ABI (native/dl4j_tpu_c.h).
+ *
+ * Build (after `python -c "from deeplearning4j_tpu.native import build_capi;
+ * build_capi()"` has produced libdl4jtpu_capi.so):
+ *
+ *   gcc -o host c_bindings_host.c \
+ *       -I../deeplearning4j_tpu/native \
+ *       ../deeplearning4j_tpu/native/libdl4jtpu_capi.so \
+ *       -Wl,-rpath,$PWD/../deeplearning4j_tpu/native
+ *
+ * Run with the framework on PYTHONPATH (and PYTHONHOME at the base prefix
+ * when using a venv):
+ *
+ *   PYTHONPATH=.. JAX_PLATFORMS=cpu ./host model.zip
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include "dl4j_tpu_c.h"
+
+int main(int argc, char **argv) {
+  char err[512];
+  if (argc < 2) { fprintf(stderr, "usage: %s model.zip\n", argv[0]); return 1; }
+  if (dl4jtpu_init(NULL) != 0) {
+    dl4jtpu_last_error(err, sizeof err);
+    fprintf(stderr, "init: %s\n", err);
+    return 1;
+  }
+  int h = dl4jtpu_load(argv[1]);
+  if (h < 0) {
+    dl4jtpu_last_error(err, sizeof err);
+    fprintf(stderr, "load: %s\n", err);
+    return 1;
+  }
+  /* single 784-feature example (LeNet/MNIST-shaped input) */
+  float x[784];
+  for (int i = 0; i < 784; ++i) x[i] = 0.0f;
+  int64_t shape[2] = {1, 784};
+  float probs[10];
+  int64_t oshape[8];
+  int orank;
+  int64_t n = dl4jtpu_output(h, x, shape, 2, probs, 10, oshape, &orank);
+  if (n < 0) {
+    dl4jtpu_last_error(err, sizeof err);
+    fprintf(stderr, "output: %s\n", err);
+    return 1;
+  }
+  printf("class probabilities:");
+  for (int i = 0; i < (n < 10 ? n : 10); ++i) printf(" %.4f", probs[i]);
+  printf("\n");
+  dl4jtpu_close(h);
+  return 0;
+}

@@ -1,0 +1,39 @@
+"""LeNet on MNIST — BASELINE config #1, the canonical first example.
+
+Mirrors the reference's LenetMnistExample: builder config, fit(iterator),
+evaluation, single-file save/restore with exact resume.
+"""
+
+from deeplearning4j_tpu.data import MnistDataSetIterator
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.models.serializer import ModelSerializer
+from deeplearning4j_tpu.nn import (ConvolutionLayer, DenseLayer, InputType,
+                                   NeuralNetConfiguration, OutputLayer,
+                                   SubsamplingLayer)
+from deeplearning4j_tpu.train import Adam
+from deeplearning4j_tpu.train.listeners import ScoreIterationListener
+
+conf = (NeuralNetConfiguration.builder()
+        .seed(123)
+        .updater(Adam(1e-3))
+        .list()
+        .layer(ConvolutionLayer(n_out=20, kernel_size=(5, 5), activation="relu"))
+        .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        .layer(ConvolutionLayer(n_out=50, kernel_size=(5, 5), activation="relu"))
+        .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        .layer(DenseLayer(n_out=500, activation="relu"))
+        .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.convolutional_flat(28, 28, 1))
+        .build())
+
+net = MultiLayerNetwork(conf).init()
+net.set_listeners(ScoreIterationListener(100))
+net.fit(MnistDataSetIterator(batch_size=64), epochs=2)
+
+ev = net.evaluate(MnistDataSetIterator(batch_size=256, train=False))
+print(ev.stats())
+
+ModelSerializer.write_model(net, "/tmp/lenet.zip")
+restored = ModelSerializer.restore_model("/tmp/lenet.zip")
+print("restored accuracy:",
+      restored.evaluate(MnistDataSetIterator(batch_size=256, train=False)).accuracy())

@@ -1,0 +1,31 @@
+"""ResNet-50 training — BASELINE config #2 (zoo ComputationGraph).
+
+Synthetic data; switch the iterator for `ImageRecordReader` pipelines on
+real datasets. On a v5e this trains at ~2700 images/sec/chip in bf16.
+"""
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+from deeplearning4j_tpu.runtime.environment import get_environment
+from deeplearning4j_tpu.train.listeners import PerformanceListener
+from deeplearning4j_tpu.train.updaters import Nesterovs
+from deeplearning4j_tpu.zoo import ResNet50
+
+get_environment().allow_bfloat16()      # bf16 compute, f32 master weights
+
+import jax
+on_cpu = jax.devices()[0].platform == "cpu"
+size, batch = (64, 16) if on_cpu else (224, 256)
+
+net = ResNet50(num_classes=1000, height=size, width=size,
+               updater=Nesterovs(0.1, momentum=0.9)).init()
+net.set_listeners(PerformanceListener(frequency=10))
+
+rng = np.random.default_rng(0)
+batches = [DataSet(rng.normal(0, 1, (batch, size, size, 3)).astype(np.float32),
+                   np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)])
+           for _ in range(4)]
+net.fit(ListDataSetIterator(batches, batch_size=batch), epochs=2)
+print("final score:", float(net._score))
