@@ -27,19 +27,11 @@ from deeplearning4j_tpu.ops.initializers import init_weights
 
 
 def layer_norm(x, gamma, beta, eps=1e-12):
-    # Shifted single-pass stats in f32 (see BatchNormalization.forward):
-    # subtracting a per-row pivot (the first feature — free, no extra pass)
-    # before accumulating avoids E[x^2]-E[x]^2 catastrophic cancellation
-    # for large-mean/small-variance rows while both reductions still fuse
-    # into one read of x.
-    xf = x.astype(jnp.float32)
-    shift = jax.lax.stop_gradient(xf[..., :1])
-    d = xf - shift
-    dmean = jnp.mean(d, axis=-1, keepdims=True)
-    mean = shift + dmean
-    var = jnp.maximum(jnp.mean(d * d, axis=-1, keepdims=True) - dmean * dmean,
-                      0.0)
-    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    # Shifted single-pass stats in f32 — one fused read of x (see
+    # ops.activations.single_pass_norm_stats for the numerics rationale).
+    from deeplearning4j_tpu.ops.activations import single_pass_norm_stats
+    mean, var = single_pass_norm_stats(x, -1)
+    y = (x.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + eps)
     return (y.astype(x.dtype)) * gamma + beta
 
 

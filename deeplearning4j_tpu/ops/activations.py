@@ -150,3 +150,26 @@ def get_activation(name: Union[str, Activation, Callable]) -> Callable:
     if key not in _FNS:
         raise ValueError(f"Unknown activation {name!r}; known: {sorted(_FNS)}")
     return _FNS[key]
+
+
+def single_pass_norm_stats(x, axis=-1):
+    """Shifted single-pass (mean, var) in f32 over ``axis`` — ONE fused read
+    of ``x``. Subtracting a per-row pivot (the first element along the axis,
+    gradient-stopped — free, no extra pass) before accumulating avoids the
+    E[x^2]-E[x]^2 catastrophic cancellation of the raw single-pass form for
+    large-mean/small-variance rows. Shared by the zoo layers'
+    ``layer_norm`` and the op registry's ``layer_norm``
+    (``BatchNormalization`` uses the same idiom with its running mean as the
+    pivot). Returns f32 ``(mean, var)`` with ``keepdims=True``."""
+    import jax
+    import jax.numpy as jnp
+    xf = x.astype(jnp.float32)
+    idx = [slice(None)] * xf.ndim
+    idx[axis if axis >= 0 else xf.ndim + axis] = slice(0, 1)
+    shift = jax.lax.stop_gradient(xf[tuple(idx)])
+    d = xf - shift
+    dmean = jnp.mean(d, axis=axis, keepdims=True)
+    mean = shift + dmean
+    var = jnp.maximum(jnp.mean(d * d, axis=axis, keepdims=True)
+                      - dmean * dmean, 0.0)
+    return mean, var

@@ -28,4 +28,4 @@ batches = [DataSet(rng.normal(0, 1, (batch, size, size, 3)).astype(np.float32),
                    np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)])
            for _ in range(4)]
 net.fit(ListDataSetIterator(batches, batch_size=batch), epochs=2)
-print("final score:", float(net._score))
+print("final score:", net.score())
