@@ -739,6 +739,54 @@ def bench_word2vec(vocab=50000, dim=256, batch=8192, k=5, steps=40):
     return {"word2vec_sg_tokens_per_sec": round(tok)}
 
 
+# -------------------------------------------------------------- char-RNN
+def bench_char_rnn(batch=64, seq=256, vocab=96, hidden=512, steps=30):
+    """BASELINE config #3: GravesLSTM char-RNN training tokens/sec
+    (2x512 hidden, T=256, V=96 — the reference's cuDNN-RNN-helper shape).
+    The recurrent cells route through the persistent Pallas LSTM kernel;
+    packed state + a long timed block per bench_resnet's protocol."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.runtime.environment import get_environment
+    from deeplearning4j_tpu.zoo import TextGenerationLSTM
+
+    get_environment().allow_bfloat16()
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu:
+        batch, seq, vocab, hidden, steps = 4, 16, 20, 32, 2
+    net = TextGenerationLSTM(vocab_size=vocab, hidden=hidden, layers=2,
+                             tbptt_length=seq, graves=True).init()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, vocab, (batch, seq + 1))
+    x = jnp.asarray(np.eye(vocab, dtype=np.float32)[ids[:, :-1]])
+    y = jnp.asarray(np.eye(vocab, dtype=np.float32)[ids[:, 1:]])
+    step_fn, packer = net._jitted_packed()
+    key = jax.random.PRNGKey(0)
+    pts = packer.pack_device(net.train_state)
+    for i in range(5):
+        pts, loss = step_fn(pts, x, y, jax.random.fold_in(key, i), None, None)
+    _ = float(loss)
+    times = []
+    for r in range(1 if on_cpu else 5):
+        if not on_cpu:
+            wait_for_quiet_host()
+        t0 = time.perf_counter()
+        for i in range(steps):
+            pts, loss = step_fn(pts, x, y, jax.random.fold_in(key, i),
+                                None, None)
+        _ = float(loss)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    tok_best = batch * seq * steps / times[0]
+    tok_med = batch * seq * steps / times[len(times) // 2]
+    _log(f"[char-rnn] {tok_med/1e6:.2f}M tokens/s median "
+         f"(best {tok_best/1e6:.2f}M; 2x{hidden} GravesLSTM, B={batch}, "
+         f"T={seq}, V={vocab}, load {host_load()})")
+    return {"char_rnn_tokens_per_sec": round(tok_med),
+            "char_rnn_tokens_per_sec_best": round(tok_best)}
+
+
 def main():
     import gc
     here = os.path.dirname(os.path.abspath(__file__))
@@ -757,6 +805,11 @@ def main():
         extra.update(bench_word2vec())
     except Exception as e:
         extra["word2vec_error"] = repr(e)
+    gc.collect()
+    try:
+        extra.update(bench_char_rnn())
+    except Exception as e:
+        extra["char_rnn_error"] = repr(e)
     gc.collect()
     try:
         extra.update(mxu_probe())
