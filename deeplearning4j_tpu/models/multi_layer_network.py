@@ -54,6 +54,14 @@ def _layer_key(i: int, layer: Layer) -> str:
     return layer.name or f"layer_{i}"
 
 
+def _group_compatible(a, b) -> bool:
+    """Whether two buffered (x, y, rng, fm, lm) step tuples may share one
+    unrolled dispatch: same input/label shapes and mask presence."""
+    return (a[0].shape == b[0].shape and a[1].shape == b[1].shape
+            and (a[3] is None) == (b[3] is None)
+            and (a[4] is None) == (b[4] is None))
+
+
 class MultiLayerNetwork:
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
@@ -331,6 +339,30 @@ class MultiLayerNetwork:
     def _packed_cache_key(self) -> str:
         return f"packed_train_step@remat={get_environment().remat_segments}"
 
+    def _jitted_packed_unrolled(self, k: int):
+        """K same-shape batches per device dispatch (env.dispatch_unroll):
+        one jitted program runs K sequential train steps over stacked
+        inputs. Shares the single-step packer, so packed state flows
+        between grouped and single dispatches. (Mask presence needs no key
+        component: jit retraces on the None-vs-array pytree structure.)"""
+        key = f"{self._packed_cache_key()}@unroll={k}"
+        if key not in self._jit_cache:
+            _, packer = self._jitted_packed()
+            raw = self._train_step_fn()
+
+            def unrolled(pts, xs, ys, rngs, fms, lms):
+                ts = packer.unpack(pts)
+                losses = []
+                for i in range(k):
+                    fm = fms[i] if fms is not None else None
+                    lm = lms[i] if lms is not None else None
+                    ts, loss = raw(ts, xs[i], ys[i], rngs[i], fm, lm)
+                    losses.append(loss)
+                return packer.pack(ts), jnp.stack(losses)
+
+            self._jit_cache[key] = jax.jit(unrolled, donate_argnums=(0,))
+        return self._jit_cache[key]
+
     def _jitted_packed(self):
         # keyed directly by _packed_cache_key so the invalidation path in
         # PackedStepLoop.step pops the SAME key this populates
@@ -368,6 +400,39 @@ class MultiLayerNetwork:
         return self
 
     def _fit_epochs(self, iterator, epochs: int, ploop) -> None:
+        unroll = max(1, int(get_environment().dispatch_unroll))
+        pending = []  # buffered (x, y, rng, fm, lm) for grouped dispatch
+
+        def flush():
+            if not pending:
+                return
+            if len(pending) == unroll and unroll > 1:
+                losses = ploop.step_group(list(pending))
+            else:  # partial tail group: single steps avoid a fresh compile
+                losses = [ploop.step(*a)[0] for a in pending]
+            for (px, _, _, _, _), loss in zip(pending, losses):
+                self._score = loss
+                self._iteration += 1
+                for lst in self._listeners:
+                    if isinstance(lst, PerformanceListener):
+                        lst.record_batch(px.shape[0])
+                    lst.iteration_done(self, self._iteration, self._epoch, loss)
+            pending.clear()
+
+        try:
+            self._run_epochs(iterator, epochs, ploop, flush, pending)
+        finally:
+            if pending:
+                # deliver batches buffered before an exceptional exit; if
+                # the state itself is dead (a raising donated step), drop
+                # them WITHOUT masking the original exception
+                try:
+                    flush()
+                except Exception:
+                    pending.clear()
+
+    def _run_epochs(self, iterator, epochs, ploop, flush, pending) -> None:
+        unroll = max(1, int(get_environment().dispatch_unroll))
         for _ in range(epochs):
             for lst in self._listeners:
                 lst.on_epoch_start(self, self._epoch)
@@ -389,11 +454,13 @@ class MultiLayerNetwork:
                             "truncated BPTT is only supported with "
                             "STOCHASTIC_GRADIENT_DESCENT (matching "
                             "ComputationGraph)")
+                    flush()
                     ploop.sync(release=True)  # tBPTT mutates train_state
                     self._fit_tbptt(x, y, fm, lm)
                     continue
                 if self.conf.global_conf.optimization_algo !=                         "STOCHASTIC_GRADIENT_DESCENT":
                     from deeplearning4j_tpu.train.solvers import solver_fit_batch
+                    flush()
                     ploop.sync(release=True)  # solver mutates train_state
                     loss = solver_fit_batch(self, x, y, fm, lm)
                     self._score = loss
@@ -403,14 +470,13 @@ class MultiLayerNetwork:
                             lst.record_batch(x.shape[0])
                         lst.iteration_done(self, self._iteration, self._epoch, loss)
                     continue
-                rng = self.rng.next_key()
-                loss, = ploop.step(x, y, rng, fm, lm)
-                self._score = loss
-                self._iteration += 1
-                for lst in self._listeners:
-                    if isinstance(lst, PerformanceListener):
-                        lst.record_batch(x.shape[0])
-                    lst.iteration_done(self, self._iteration, self._epoch, loss)
+                args = (x, y, self.rng.next_key(), fm, lm)
+                if pending and not _group_compatible(pending[0], args):
+                    flush()
+                pending.append(args)
+                if len(pending) >= unroll:
+                    flush()
+            flush()
             # no epoch-end sync: packing only runs when every listener is
             # stateless, so nothing reads train_state until fit() returns
             for lst in self._listeners:

@@ -70,6 +70,13 @@ class Environment:
     # default for the single-process fit path; sharded training keeps
     # per-leaf state.
     packed_state: bool = True
+    # Batches grouped per device dispatch in MultiLayerNetwork.fit (>1 =
+    # opt-in): K same-shape batches run as ONE unrolled jitted program.
+    # For dispatch-bound small steps (char-RNN 2x512: 3.46 ms device step
+    # vs ~5 ms host cost per dispatch through a remote tunnel) this is the
+    # difference between 1.8M and 3.9M tokens/s. Costs K-fold compile
+    # time; losses/listeners still observe every step.
+    dispatch_unroll: int = 1
 
     def set_remat(self, enabled: bool = True) -> "Environment":
         self.remat_segments = bool(enabled)
@@ -102,6 +109,12 @@ class Environment:
 
     def set_packed_state(self, enabled: bool = True) -> "Environment":
         self.packed_state = bool(enabled)
+        return self
+
+    def set_dispatch_unroll(self, k: int) -> "Environment":
+        if int(k) < 1:
+            raise ValueError("dispatch_unroll must be >= 1")
+        self.dispatch_unroll = int(k)
         return self
 
     def set_nan_panic(self, enabled: bool) -> "Environment":

@@ -233,12 +233,39 @@ class PackedStepLoop:
             try:
                 self._packed = self._packer.pack_device(self._net.train_state)
             except ValueError:  # structure changed since the packer was built
-                self._net._jit_cache.pop(self._net._packed_cache_key(), None)
+                prefix = self._net._packed_cache_key()
+                for k in [k for k in self._net._jit_cache
+                          if k.startswith(prefix)]:  # incl. @unroll variants
+                    self._net._jit_cache.pop(k, None)
                 self._step_fn, self._packer = self._net._jitted_packed()
                 self._packed = self._packer.pack_device(self._net.train_state)
         out = self._step_fn(self._packed, *rest_args)
         self._packed = out[0]
         return out[1:]
+
+    def step_group(self, group):
+        """Run a list of ``(x, y, rng, fmask, lmask)`` batches as ONE
+        unrolled device dispatch (env.dispatch_unroll). All batches in the
+        group must share shapes and mask-presence (the fit loop guarantees
+        it). Returns a list of per-step losses (device scalars, lazy)."""
+        if not self._enabled or len(group) == 1:
+            return [self.step(*args)[0] for args in group]
+        if self._packed is None:
+            # first call packs lazily: run the first batch single-step,
+            # then the rest as a (possibly shorter) group
+            first_loss, = self.step(*group[0])
+            rest = self.step_group(group[1:]) if len(group) > 1 else []
+            return [first_loss] + rest
+        fn = self._net._jitted_packed_unrolled(len(group))
+        xs = jnp.stack([g[0] for g in group])
+        ys = jnp.stack([g[1] for g in group])
+        rngs = jnp.stack([g[2] for g in group])
+        fms = (jnp.stack([g[3] for g in group])
+               if group[0][3] is not None else None)
+        lms = (jnp.stack([g[4] for g in group])
+               if group[0][4] is not None else None)
+        self._packed, losses = fn(self._packed, xs, ys, rngs, fms, lms)
+        return [losses[i] for i in range(len(group))]
 
     def sync(self, release: bool = False) -> None:
         """Refresh ``net.train_state`` from the packed buffers.

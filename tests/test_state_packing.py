@@ -215,3 +215,84 @@ class TestPackedFitRobustness:
             net.fit(ExplodingIterator(3), epochs=1)
         # the three completed steps survive the exception
         assert int(net.train_state.step) == 3
+
+
+class TestDispatchUnroll:
+    def _data(self, n_batches, seed=9):
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+        rng = np.random.default_rng(seed)
+        batches = [DataSet(rng.normal(size=(8, 12)).astype(np.float32),
+                           np.eye(5, dtype=np.float32)[rng.integers(0, 5, 8)])
+                   for _ in range(n_batches)]
+        return ListDataSetIterator(batches, batch_size=8)
+
+    def test_unrolled_fit_bit_identical(self):
+        """fit with dispatch_unroll=3 (incl. a partial tail group) must match
+        the per-batch loop bitwise, including the listener loss sequence."""
+        from deeplearning4j_tpu.train.listeners import CollectScoresListener
+        env = get_environment()
+        prev = env.dispatch_unroll
+        try:
+            nets, scores = [], []
+            for k in (1, 3):
+                env.set_dispatch_unroll(k)
+                net = _make_net()
+                coll = CollectScoresListener()
+                net.set_listeners(coll)
+                net.fit(self._data(7), epochs=2)  # 7 % 3 != 0: partial tail
+                nets.append(net)
+                scores.append([s for _, s in coll.scores])
+        finally:
+            env.dispatch_unroll = prev
+        assert len(scores[0]) == len(scores[1]) == 14
+        np.testing.assert_allclose(scores[0], scores[1], rtol=0, atol=0)
+        _tree_equal(nets[0].train_state.params, nets[1].train_state.params)
+        assert int(nets[1].train_state.step) == 14
+
+    def test_exception_mid_fit_with_unroll_preserves_buffered(self):
+        """Iterator death mid-epoch with dispatch_unroll>1: batches buffered
+        before the exception must still train (flush in the finally)."""
+        from deeplearning4j_tpu.data.dataset import DataSet
+
+        rng = np.random.default_rng(6)
+        x = rng.normal(size=(8, 12)).astype(np.float32)
+        y = np.eye(5, dtype=np.float32)[rng.integers(0, 5, 8)]
+
+        class ExplodingIterator:
+            def __init__(self, n_good):
+                self.n_good, self._i = n_good, 0
+
+            def reset(self):
+                self._i = 0
+
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                if self._i >= self.n_good:
+                    raise RuntimeError("died")
+                self._i += 1
+                return DataSet(x, y)
+
+        env = get_environment()
+        prev = env.dispatch_unroll
+        try:
+            env.set_dispatch_unroll(4)
+            net = _make_net()
+            with pytest.raises(RuntimeError, match="died"):
+                net.fit(ExplodingIterator(3), epochs=1)  # 3 < unroll: all buffered
+        finally:
+            env.dispatch_unroll = prev
+        assert int(net.train_state.step) == 3
+
+    def test_unroll_with_packing_disabled_falls_back(self):
+        env = get_environment()
+        prev_u, prev_p = env.dispatch_unroll, env.packed_state
+        try:
+            env.set_dispatch_unroll(4)
+            env.set_packed_state(False)
+            net = _make_net().fit(self._data(5), epochs=1)
+        finally:
+            env.dispatch_unroll, env.packed_state = prev_u, prev_p
+        assert int(net.train_state.step) == 5
