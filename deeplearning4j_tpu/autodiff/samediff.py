@@ -638,7 +638,8 @@ class SameDiff:
         return {n: a for n, a in self.arrays.items()
                 if self.vars[n].vtype == VariableType.VARIABLE}
 
-    def _make_train_step(self, ph_names: Tuple[str, ...], packer=None):
+    def _make_train_step(self, ph_names: Tuple[str, ...], packer=None,
+                         unroll: int = 1):
         cfg = self.training_config
         consts = {n: a for n, a in self.arrays.items()
                   if self.vars[n].vtype == VariableType.CONSTANT}
@@ -703,13 +704,31 @@ class SameDiff:
         # Packed variant (runtime/state_packing.py): an imported BERT-base
         # carries ~600 (variable + Adam-moment) leaves, mostly small bias/
         # layernorm vectors — one buffer-handle marshal each per dispatch.
-        def packed_step(packed, placeholders, step_idx):
-            trainable, opt_state = packer.unpack(packed)
-            new_t, new_o, loss = step(trainable, opt_state, placeholders,
-                                      step_idx)
-            return packer.pack((new_t, new_o)), loss
+        if unroll <= 1:
+            def packed_step(packed, placeholders, step_idx):
+                trainable, opt_state = packer.unpack(packed)
+                new_t, new_o, loss = step(trainable, opt_state, placeholders,
+                                          step_idx)
+                return packer.pack((new_t, new_o)), loss
 
-        return jax.jit(packed_step, donate_argnums=(0,))
+            return jax.jit(packed_step, donate_argnums=(0,))
+
+        # Grouped dispatch (env.dispatch_unroll, same mechanism as
+        # MultiLayerNetwork.fit): K same-shape batches as ONE unrolled
+        # program. The batches arrive as a LIST of placeholder dicts — a
+        # plain pytree argument — rather than pre-stacked arrays: stacking
+        # on-device would cost ~4 tiny dispatches per placeholder per
+        # group, which is the very overhead grouping exists to remove.
+        def packed_step_unrolled(packed, ph_list, step_idxs):
+            trainable, opt_state = packer.unpack(packed)
+            losses = []
+            for i in range(unroll):
+                trainable, opt_state, loss = step(trainable, opt_state,
+                                                  ph_list[i], step_idxs[i])
+                losses.append(loss)
+            return packer.pack((trainable, opt_state)), jnp.stack(losses)
+
+        return jax.jit(packed_step_unrolled, donate_argnums=(0,))
 
     def fit(self, data, labels=None, epochs: int = 1, batch_size: Optional[int] = None):
         """Train (reference ``sd.fit(DataSetIterator)``). Accepts a
@@ -745,6 +764,8 @@ class SameDiff:
         use_packing = (get_environment().packed_state
                        and all(not getattr(l, "needs_model_state", True)
                                for l in self._listeners))
+        unroll = max(1, int(get_environment().dispatch_unroll)) \
+            if use_packing else 1
         key = ("train_step", ph_names, str(get_environment().compute_dtype),
                get_environment().remat_segments,
                tuple(sorted(trainable)), self._graph_version, use_packing)
@@ -757,6 +778,14 @@ class SameDiff:
             else:
                 self._jit_cache[key] = (self._make_train_step(ph_names), None)
         step, packer = self._jit_cache[key]
+        group_step = None
+        if unroll > 1:
+            gkey = key + ("unroll", unroll)
+            if gkey not in self._jit_cache:
+                self._jit_cache[gkey] = (
+                    self._make_train_step(ph_names, packer, unroll=unroll),
+                    packer)
+            group_step, _ = self._jit_cache[gkey]
         history = []
         bounds = []
         it_count = 0
@@ -793,8 +822,44 @@ class SameDiff:
 
         packed = (packer.pack_device((trainable, self._opt_state))
                   if packer is not None else None)
+        pending = []  # buffered (ph, step_idx) for grouped dispatch
+        cur_ep = 0
+
+        def flush_group():
+            nonlocal packed, it_count
+            if not pending:
+                return
+            # snapshot-and-clear BEFORE dispatch/listeners: a listener that
+            # raises must not leave already-executed batches buffered, or
+            # the finally-block flush would train the group a second time
+            # (same discipline as MultiLayerNetwork._fit_epochs.flush)
+            todo = list(pending)
+            pending.clear()
+            if group_step is not None and len(todo) == unroll:
+                idxs = np.asarray([p[1] for p in todo], np.uint32)
+                packed, losses = group_step(packed, [p[0] for p in todo],
+                                            idxs)
+                step_losses = [losses[i] for i in range(len(todo))]
+            else:  # partial tail / mixed shapes: single steps, no new compile
+                step_losses = []
+                for ph_i, idx in todo:
+                    packed, loss = step(packed, ph_i, np.uint32(idx))
+                    step_losses.append(loss)
+            for loss in step_losses:
+                # keep losses on-device: a float() here would stall the
+                # pipeline on every step (one full host round-trip per
+                # batch through a remote-device tunnel)
+                history.append(loss)
+                it_count += 1
+                for lst in self._listeners:
+                    lst.iteration_done(self, it_count, cur_ep, loss)
+
+        def ph_shapes(ph):
+            return {n: v.shape for n, v in ph.items()}
+
         try:
             for ep in range(int(epochs)):
+                cur_ep = ep
                 iterator.reset()
                 for batch in iterator:
                     feats = [batch.features] if not isinstance(batch.features, list) else batch.features
@@ -807,19 +872,25 @@ class SameDiff:
                         trainable, self._opt_state, loss = step(
                             trainable, self._opt_state, ph,
                             np.uint32(self._train_iter))
-                    else:
-                        packed, loss = step(packed, ph,
-                                            np.uint32(self._train_iter))
+                        self._train_iter += 1
+                        history.append(loss)
+                        it_count += 1
+                        for lst in self._listeners:
+                            lst.iteration_done(self, it_count, ep, loss)
+                        continue
+                    if pending and ph_shapes(pending[0][0]) != ph_shapes(ph):
+                        flush_group()
+                    pending.append((ph, self._train_iter))
                     self._train_iter += 1
-                    # keep the loss on-device: a float() here would stall the
-                    # pipeline on every step (one full host round-trip per
-                    # batch through a remote-device tunnel)
-                    history.append(loss)
-                    it_count += 1
-                    for lst in self._listeners:
-                        lst.iteration_done(self, it_count, ep, loss)
+                    if len(pending) >= unroll:
+                        flush_group()
+                flush_group()
                 bounds.append(it_count)
         finally:
+            try:
+                flush_group()  # deliver batches buffered before an error
+            except Exception:
+                pending.clear()  # dead state: keep the original exception
             from deeplearning4j_tpu.runtime.state_packing import LeafPacker
             if packed is not None and not LeafPacker.is_dead(packed):
                 # (a raising donated step leaves no newer state to recover)

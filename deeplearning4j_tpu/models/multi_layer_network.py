@@ -406,18 +406,22 @@ class MultiLayerNetwork:
         def flush():
             if not pending:
                 return
-            if len(pending) == unroll and unroll > 1:
-                losses = ploop.step_group(list(pending))
+            # snapshot-and-clear BEFORE dispatch/listeners: a raising
+            # listener must not leave already-executed batches buffered
+            # (the finally-block flush would train them a second time)
+            todo = list(pending)
+            pending.clear()
+            if len(todo) == unroll and unroll > 1:
+                losses = ploop.step_group(todo)
             else:  # partial tail group: single steps avoid a fresh compile
-                losses = [ploop.step(*a)[0] for a in pending]
-            for (px, _, _, _, _), loss in zip(pending, losses):
+                losses = [ploop.step(*a)[0] for a in todo]
+            for (px, _, _, _, _), loss in zip(todo, losses):
                 self._score = loss
                 self._iteration += 1
                 for lst in self._listeners:
                     if isinstance(lst, PerformanceListener):
                         lst.record_batch(px.shape[0])
                     lst.iteration_done(self, self._iteration, self._epoch, loss)
-            pending.clear()
 
         try:
             self._run_epochs(iterator, epochs, ploop, flush, pending)

@@ -432,3 +432,40 @@ def test_samediff_dropout_inside_while_loop_active_in_fit():
     for _ in range(3):
         losses.extend(sd.fit(x, y, epochs=1))
     assert len(set(np.round(losses, 10))) > 1, losses
+
+
+def test_fit_dispatch_unroll_matches_single():
+    """sd.fit with dispatch_unroll=3 (incl. a partial tail) must produce the
+    same loss history and final arrays as per-batch dispatch."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.runtime.environment import get_environment
+
+    def run(k):
+        env = get_environment()
+        prev = env.dispatch_unroll
+        try:
+            env.set_dispatch_unroll(k)
+            sd = _mlp_graph()
+            sd.set_training_config(TrainingConfig(
+                updater=Adam(5e-2), data_set_feature_mapping=["x"],
+                data_set_label_mapping=["labels"]))
+            rng = np.random.default_rng(0)
+            batches = []
+            for _ in range(7):  # 7 % 3 != 0: exercises the partial tail
+                x, y = _toy(32)
+                batches.append(DataSet(x, y))
+            hist = sd.fit(ListDataSetIterator(batches, batch_size=32), epochs=2)
+            return list(hist), {n: np.asarray(a) for n, a in sd.arrays.items()
+                                if sd.vars[n].vtype.value == "variable"}
+        finally:
+            env.dispatch_unroll = prev
+
+    h1, a1 = run(1)
+    h3, a3 = run(3)
+    assert len(h1) == len(h3) == 14
+    # the unrolled program lets XLA reassociate f32 sums across step
+    # boundaries: observed differences are ~1e-7 relative, not exact-zero
+    np.testing.assert_allclose(h1, h3, rtol=1e-5)
+    for n in a1:
+        np.testing.assert_allclose(a1[n], a3[n], rtol=1e-5, atol=1e-7)

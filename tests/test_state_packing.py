@@ -296,3 +296,41 @@ class TestDispatchUnroll:
         finally:
             env.dispatch_unroll, env.packed_state = prev_u, prev_p
         assert int(net.train_state.step) == 5
+
+    def test_raising_listener_does_not_double_train(self):
+        """A listener that raises mid-group must not cause the finally-flush
+        to re-dispatch already-executed batches (verified-by-execution bug:
+        the group trained twice)."""
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+        from deeplearning4j_tpu.train.listeners import TrainingListener
+
+        class RaiseOnFirst(TrainingListener):
+            needs_model_state = False
+
+            def __init__(self):
+                self.calls = 0
+
+            def iteration_done(self, model, iteration, epoch, score):
+                self.calls += 1
+                if self.calls == 1:
+                    raise RuntimeError("listener boom")
+
+        rng = np.random.default_rng(8)
+        x = rng.normal(size=(8, 12)).astype(np.float32)
+        y = np.eye(5, dtype=np.float32)[rng.integers(0, 5, 8)]
+        env = get_environment()
+        prev = env.dispatch_unroll
+        try:
+            env.set_dispatch_unroll(2)
+            net = _make_net()
+            lst = RaiseOnFirst()
+            net.set_listeners(lst)
+            it = ListDataSetIterator([DataSet(x, y) for _ in range(2)],
+                                     batch_size=8)
+            with pytest.raises(RuntimeError, match="listener boom"):
+                net.fit(it, epochs=1)
+        finally:
+            env.dispatch_unroll = prev
+        # the 2-batch group ran ONCE: step counter is 2, not 4
+        assert int(net.train_state.step) == 2
