@@ -70,8 +70,10 @@ class Environment:
     # default for the single-process fit path; sharded training keeps
     # per-leaf state.
     packed_state: bool = True
-    # Batches grouped per device dispatch in MultiLayerNetwork.fit (>1 =
-    # opt-in): K same-shape batches run as ONE unrolled jitted program.
+    # Batches grouped per device dispatch in MultiLayerNetwork.fit and
+    # SameDiff.fit (>1 = opt-in; ComputationGraph.fit dispatches per batch
+    # — its flagship steps are device-bound): K same-shape batches run as
+    # ONE unrolled jitted program.
     # For dispatch-bound small steps (char-RNN 2x512: 3.46 ms device step
     # vs ~5 ms host cost per dispatch through a remote tunnel) this is the
     # difference between 1.8M and 3.9M tokens/s. Costs K-fold compile
