@@ -25,6 +25,12 @@ the backward uses to recompute P = exp(S - L) blockwise (never storing the
 Masking: a key-padding mask becomes an additive bias (0 / -1e30) of shape
 (batch, T_k, 1) streamed per batch row (the grid runs over batch*heads; the
 index map divides by heads so the bias is NOT materialised per head).
+Sequence-length ceiling: the BACKWARD kernels keep the full K/V (and Q/dO
+in the dkv pass) VMEM-resident per grid step — ~17 MB of scoped VMEM at
+T=16384, over the 16 MB limit, so fwd+bwd is supported to T=8192 at D=64
+(verified on v5e); the forward streams fine beyond that, and longer
+contexts shard across chips via ring attention (parallel/ring_attention).
+
 ``causal=True`` masks the upper triangle AND skips fully-masked key blocks:
 the forward/dq loops stop at the diagonal, the dk/dv loop starts there —
 roughly halving the FLOPs, which XLA's dense softmax cannot do.

@@ -135,6 +135,7 @@ class Environment:
             "memory_fraction": self.memory_fraction,
             "remat_segments": self.remat_segments,
             "packed_state": self.packed_state,
+            "dispatch_unroll": self.dispatch_unroll,
         }
 
 
@@ -173,6 +174,8 @@ def get_environment() -> Environment:
                 _ENV_PREFIX + "REMAT", "").lower() in ("1", "true")
             if os.environ.get(_ENV_PREFIX + "PACKED_STATE", "").lower() in ("0", "false"):
                 env.packed_state = False
+            if os.environ.get(_ENV_PREFIX + "DISPATCH_UNROLL", "").isdigit():
+                env.set_dispatch_unroll(int(os.environ[_ENV_PREFIX + "DISPATCH_UNROLL"]))
             cache = os.environ.get(_ENV_PREFIX + "COMPILE_CACHE")
             if cache:
                 env.cache_compiled = cache
