@@ -660,18 +660,17 @@ def bench_zoo_bert(batch=64, seq=128, steps=60, repeats=6):
     pts = packer.pack_device(net.train_state)
     if K > 1:
         group_fn = net._jitted_packed_unrolled(K)
-        xs, ys = jnp.stack([x] * K), jnp.stack([y] * K)
-        fms = jnp.stack([fmask] * K)
         all_keys = jax.jit(lambda k: jnp.stack(
             [jax.random.fold_in(k, i) for i in range(16 * steps)]))(key)
-        jax.block_until_ready(all_keys)
+        key_list = [all_keys[i] for i in range(16 * steps)]
+        jax.block_until_ready(key_list)
 
         def run_steps(b0, n):
             nonlocal pts
             for b in range(n // K):
-                pts, losses = group_fn(
-                    pts, xs, ys, jax.lax.dynamic_slice_in_dim(
-                        all_keys, b0 + b * K, K), fms, None)
+                args = [(x, y, key_list[b0 + b * K + i], fmask, None)
+                        for i in range(K)]
+                pts, losses = group_fn(pts, args)
             return losses
     else:
         def run_steps(b0, n):
@@ -829,24 +828,24 @@ def bench_char_rnn(batch=64, seq=256, vocab=96, hidden=512, steps=200):
         group_fn = None
     else:
         group_fn = net._jitted_packed_unrolled(K)
-        xs = jnp.stack([x] * K)
-        ys = jnp.stack([y] * K)
     blocks = max(1, steps // K)
-    # pre-stage every per-step key on device: key math inside the timed
-    # loop costs ~8 tiny dispatches per group through the tunnel
+    # pre-stage every per-step key as its own device buffer BEFORE timing:
+    # key math (or even slicing a staged array) inside the timed loop
+    # costs one tiny dispatch per step through the tunnel
     all_keys = jax.jit(lambda k: jnp.stack(
         [jax.random.fold_in(k, i) for i in range(8 * blocks * K)]))(key)
-    jax.block_until_ready(all_keys)
+    key_list = [all_keys[i] for i in range(8 * blocks * K)]
+    jax.block_until_ready(key_list)
     def run_block(b0):
         nonlocal pts
         if group_fn is None:
             for i in range(K * blocks):
-                pts, loss = step_fn(pts, x, y, all_keys[b0 + i], None, None)
+                pts, loss = step_fn(pts, x, y, key_list[b0 + i], None, None)
             return loss
         for b in range(blocks):
-            pts, losses = group_fn(
-                pts, xs, ys, jax.lax.dynamic_slice_in_dim(
-                    all_keys, b0 + b * K, K), None, None)
+            args = [(x, y, key_list[b0 + b * K + i], None, None)
+                    for i in range(K)]
+            pts, losses = group_fn(pts, args)
         return losses
     _ = float(jnp.sum(run_block(6 * blocks * K)))  # compile + warm
     times = []

@@ -822,40 +822,35 @@ class SameDiff:
 
         packed = (packer.pack_device((trainable, self._opt_state))
                   if packer is not None else None)
-        pending = []  # buffered (ph, step_idx) for grouped dispatch
         cur_ep = 0
 
-        def flush_group():
-            nonlocal packed, it_count
-            if not pending:
-                return
-            # snapshot-and-clear BEFORE dispatch/listeners: a listener that
-            # raises must not leave already-executed batches buffered, or
-            # the finally-block flush would train the group a second time
-            # (same discipline as MultiLayerNetwork._fit_epochs.flush)
-            todo = list(pending)
-            pending.clear()
-            if group_step is not None and len(todo) == unroll:
-                idxs = np.asarray([p[1] for p in todo], np.uint32)
-                packed, losses = group_step(packed, [p[0] for p in todo],
-                                            idxs)
-                step_losses = [losses[i] for i in range(len(todo))]
-            else:  # partial tail / mixed shapes: single steps, no new compile
-                step_losses = []
-                for ph_i, idx in todo:
-                    packed, loss = step(packed, ph_i, np.uint32(idx))
-                    step_losses.append(loss)
-            for loss in step_losses:
-                # keep losses on-device: a float() here would stall the
-                # pipeline on every step (one full host round-trip per
-                # batch through a remote-device tunnel)
-                history.append(loss)
-                it_count += 1
-                for lst in self._listeners:
-                    lst.iteration_done(self, it_count, cur_ep, loss)
+        def run_single(a):
+            nonlocal packed
+            packed, loss = step(packed, a[0], np.uint32(a[1]))
+            return loss
 
-        def ph_shapes(ph):
-            return {n: v.shape for n, v in ph.items()}
+        def run_group(todo):
+            nonlocal packed
+            idxs = np.asarray([t[1] for t in todo], np.uint32)
+            packed, losses = group_step(packed, [t[0] for t in todo], idxs)
+            return [losses[i] for i in range(len(todo))]
+
+        def deliver(args, loss):
+            nonlocal it_count
+            # keep losses on-device: a float() here would stall the
+            # pipeline on every step (one full host round-trip per batch
+            # through a remote-device tunnel)
+            history.append(loss)
+            it_count += 1
+            for lst in self._listeners:
+                lst.iteration_done(self, it_count, cur_ep, loss)
+
+        from deeplearning4j_tpu.runtime.state_packing import GroupedDispatch
+        gd = GroupedDispatch(
+            unroll=unroll,
+            compatible=lambda a, b: ({n: v.shape for n, v in a[0].items()}
+                                     == {n: v.shape for n, v in b[0].items()}),
+            run_single=run_single, run_group=run_group, deliver=deliver)
 
         try:
             for ep in range(int(epochs)):
@@ -878,19 +873,12 @@ class SameDiff:
                         for lst in self._listeners:
                             lst.iteration_done(self, it_count, ep, loss)
                         continue
-                    if pending and ph_shapes(pending[0][0]) != ph_shapes(ph):
-                        flush_group()
-                    pending.append((ph, self._train_iter))
+                    gd.submit((ph, self._train_iter))
                     self._train_iter += 1
-                    if len(pending) >= unroll:
-                        flush_group()
-                flush_group()
+                gd.flush()
                 bounds.append(it_count)
         finally:
-            try:
-                flush_group()  # deliver batches buffered before an error
-            except Exception:
-                pending.clear()  # dead state: keep the original exception
+            gd.drain_on_error()  # deliver batches buffered before an error
             from deeplearning4j_tpu.runtime.state_packing import LeafPacker
             if packed is not None and not LeafPacker.is_dead(packed):
                 # (a raising donated step leaves no newer state to recover)

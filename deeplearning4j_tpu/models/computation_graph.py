@@ -226,6 +226,30 @@ class ComputationGraphConfiguration:
         return ComputationGraphConfiguration.from_dict(json.loads(s))
 
 
+def _cg_group_compatible(a, b) -> bool:
+    """Whether two buffered (inputs, labels, rng, masks) tuples may share
+    one unrolled dispatch: same input/label shapes and mask presence."""
+    ia, la, _, ma = a
+    ib, lb, _, mb = b
+    if set(ia) != set(ib) or len(la) != len(lb):
+        return False
+    if any(ia[n].shape != ib[n].shape for n in ia):
+        return False
+    if any(x.shape != y.shape for x, y in zip(la, lb)):
+        return False
+    if (ma is None) != (mb is None):
+        return False
+    if ma is not None:
+        if set(ma) != set(mb):
+            return False
+        for n in ma:
+            if (ma[n] is None) != (mb[n] is None):
+                return False
+            if ma[n] is not None and ma[n].shape != mb[n].shape:
+                return False
+    return True
+
+
 class ComputationGraph:
     def __init__(self, conf: ComputationGraphConfiguration):
         self.conf = conf
@@ -620,6 +644,18 @@ class ComputationGraph:
             self._jit_cache[key] = self._make_packed_train_step()
         return self._jit_cache[key]
 
+    def _jitted_packed_unrolled(self, k: int):
+        """K same-shape batches per device dispatch (env.dispatch_unroll);
+        shares the single-step packer (see MultiLayerNetwork)."""
+        key = f"{self._packed_cache_key()}@unroll={k}"
+        if key not in self._jit_cache:
+            from deeplearning4j_tpu.runtime.state_packing import (
+                make_unrolled_packed_step)
+            _, packer = self._jitted_packed()
+            self._jit_cache[key] = make_unrolled_packed_step(
+                self._train_step_fn(), packer, k)
+        return self._jit_cache[key]
+
     def _coerce_batch(self, batch) -> Tuple[Dict[str, Any], List[Any], Optional[Dict]]:
         from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
         if isinstance(batch, MultiDataSet):
@@ -648,17 +684,36 @@ class ComputationGraph:
                 [DataSet(np.asarray(data), np.asarray(labels))], batch_size=len(data))
         else:
             iterator = data
-        from deeplearning4j_tpu.runtime.state_packing import PackedStepLoop
+        from deeplearning4j_tpu.runtime.state_packing import (GroupedDispatch,
+                                                               PackedStepLoop)
         ploop = PackedStepLoop.for_network(self)
+
+        def deliver(args, loss):
+            self._score = loss
+            self._iteration += 1
+            for lst in self._listeners:
+                lst.iteration_done(self, self._iteration, self._epoch, loss)
+
+        gd = GroupedDispatch(
+            # with a state-reading listener, packing is off and batches must
+            # dispatch one at a time so iteration_done sees fresh state
+            unroll=(get_environment().dispatch_unroll if ploop.enabled else 1),
+            compatible=_cg_group_compatible,
+            run_single=lambda a: ploop.step(*a)[0],
+            run_group=ploop.step_group,
+            deliver=deliver)
         try:
-            self._fit_epochs(iterator, int(epochs), ploop)
+            try:
+                self._fit_epochs(iterator, int(epochs), ploop, gd)
+            finally:
+                gd.drain_on_error()
         finally:
             # any exit path (incl. KeyboardInterrupt / iterator errors) must
             # leave train_state reflecting every completed step
             ploop.sync(release=True)
         return self
 
-    def _fit_epochs(self, iterator, epochs: int, ploop) -> None:
+    def _fit_epochs(self, iterator, epochs: int, ploop, gd) -> None:
         for _ in range(epochs):
             for lst in self._listeners:
                 lst.on_epoch_start(self, self._epoch)
@@ -673,21 +728,20 @@ class ComputationGraph:
                             "tBPTT training with optimization_algo="
                             f"{algo!r} is not supported; use SGD or full-"
                             "sequence BPTT")
+                    gd.flush()
                     ploop.sync(release=True)  # tBPTT mutates train_state
                     self._fit_tbptt(inputs, labels_, masks)
                     continue
                 if algo != "STOCHASTIC_GRADIENT_DESCENT":
                     from deeplearning4j_tpu.train.solvers import (
                         graph_solver_fit_batch)
+                    gd.flush()
                     ploop.sync(release=True)  # solver mutates train_state
                     loss = graph_solver_fit_batch(self, inputs, labels_, masks)
-                else:
-                    rng = self.rng.next_key()
-                    loss, = ploop.step(inputs, labels_, rng, masks)
-                self._score = loss
-                self._iteration += 1
-                for lst in self._listeners:
-                    lst.iteration_done(self, self._iteration, self._epoch, loss)
+                    gd._deliver((inputs, labels_, None, masks), loss)
+                    continue
+                gd.submit((inputs, labels_, self.rng.next_key(), masks))
+            gd.flush()
             # no epoch-end sync: packing only runs when every listener is
             # stateless, so nothing reads train_state until fit() returns
             for lst in self._listeners:

@@ -340,27 +340,17 @@ class MultiLayerNetwork:
         return f"packed_train_step@remat={get_environment().remat_segments}"
 
     def _jitted_packed_unrolled(self, k: int):
-        """K same-shape batches per device dispatch (env.dispatch_unroll):
-        one jitted program runs K sequential train steps over stacked
-        inputs. Shares the single-step packer, so packed state flows
-        between grouped and single dispatches. (Mask presence needs no key
+        """K same-shape batches per device dispatch (env.dispatch_unroll).
+        Shares the single-step packer, so packed state flows between
+        grouped and single dispatches. (Mask presence needs no key
         component: jit retraces on the None-vs-array pytree structure.)"""
         key = f"{self._packed_cache_key()}@unroll={k}"
         if key not in self._jit_cache:
+            from deeplearning4j_tpu.runtime.state_packing import (
+                make_unrolled_packed_step)
             _, packer = self._jitted_packed()
-            raw = self._train_step_fn()
-
-            def unrolled(pts, xs, ys, rngs, fms, lms):
-                ts = packer.unpack(pts)
-                losses = []
-                for i in range(k):
-                    fm = fms[i] if fms is not None else None
-                    lm = lms[i] if lms is not None else None
-                    ts, loss = raw(ts, xs[i], ys[i], rngs[i], fm, lm)
-                    losses.append(loss)
-                return packer.pack(ts), jnp.stack(losses)
-
-            self._jit_cache[key] = jax.jit(unrolled, donate_argnums=(0,))
+            self._jit_cache[key] = make_unrolled_packed_step(
+                self._train_step_fn(), packer, k)
         return self._jit_cache[key]
 
     def _jitted_packed(self):
@@ -400,43 +390,30 @@ class MultiLayerNetwork:
         return self
 
     def _fit_epochs(self, iterator, epochs: int, ploop) -> None:
-        unroll = max(1, int(get_environment().dispatch_unroll))
-        pending = []  # buffered (x, y, rng, fm, lm) for grouped dispatch
+        from deeplearning4j_tpu.runtime.state_packing import GroupedDispatch
 
-        def flush():
-            if not pending:
-                return
-            # snapshot-and-clear BEFORE dispatch/listeners: a raising
-            # listener must not leave already-executed batches buffered
-            # (the finally-block flush would train them a second time)
-            todo = list(pending)
-            pending.clear()
-            if len(todo) == unroll and unroll > 1:
-                losses = ploop.step_group(todo)
-            else:  # partial tail group: single steps avoid a fresh compile
-                losses = [ploop.step(*a)[0] for a in todo]
-            for (px, _, _, _, _), loss in zip(todo, losses):
-                self._score = loss
-                self._iteration += 1
-                for lst in self._listeners:
-                    if isinstance(lst, PerformanceListener):
-                        lst.record_batch(px.shape[0])
-                    lst.iteration_done(self, self._iteration, self._epoch, loss)
+        def deliver(args, loss):
+            self._score = loss
+            self._iteration += 1
+            for lst in self._listeners:
+                if isinstance(lst, PerformanceListener):
+                    lst.record_batch(args[0].shape[0])
+                lst.iteration_done(self, self._iteration, self._epoch, loss)
 
+        gd = GroupedDispatch(
+            # with a state-reading listener, packing is off and batches must
+            # dispatch one at a time so iteration_done sees fresh state
+            unroll=(get_environment().dispatch_unroll if ploop.enabled else 1),
+            compatible=_group_compatible,
+            run_single=lambda a: ploop.step(*a)[0],
+            run_group=ploop.step_group,
+            deliver=deliver)
         try:
-            self._run_epochs(iterator, epochs, ploop, flush, pending)
+            self._run_epochs(iterator, epochs, ploop, gd)
         finally:
-            if pending:
-                # deliver batches buffered before an exceptional exit; if
-                # the state itself is dead (a raising donated step), drop
-                # them WITHOUT masking the original exception
-                try:
-                    flush()
-                except Exception:
-                    pending.clear()
+            gd.drain_on_error()
 
-    def _run_epochs(self, iterator, epochs, ploop, flush, pending) -> None:
-        unroll = max(1, int(get_environment().dispatch_unroll))
+    def _run_epochs(self, iterator, epochs, ploop, gd) -> None:
         for _ in range(epochs):
             for lst in self._listeners:
                 lst.on_epoch_start(self, self._epoch)
@@ -458,29 +435,19 @@ class MultiLayerNetwork:
                             "truncated BPTT is only supported with "
                             "STOCHASTIC_GRADIENT_DESCENT (matching "
                             "ComputationGraph)")
-                    flush()
+                    gd.flush()
                     ploop.sync(release=True)  # tBPTT mutates train_state
                     self._fit_tbptt(x, y, fm, lm)
                     continue
                 if self.conf.global_conf.optimization_algo !=                         "STOCHASTIC_GRADIENT_DESCENT":
                     from deeplearning4j_tpu.train.solvers import solver_fit_batch
-                    flush()
+                    gd.flush()
                     ploop.sync(release=True)  # solver mutates train_state
                     loss = solver_fit_batch(self, x, y, fm, lm)
-                    self._score = loss
-                    self._iteration += 1
-                    for lst in self._listeners:
-                        if isinstance(lst, PerformanceListener):
-                            lst.record_batch(x.shape[0])
-                        lst.iteration_done(self, self._iteration, self._epoch, loss)
+                    gd._deliver((x, y, None, fm, lm), loss)  # same bookkeeping
                     continue
-                args = (x, y, self.rng.next_key(), fm, lm)
-                if pending and not _group_compatible(pending[0], args):
-                    flush()
-                pending.append(args)
-                if len(pending) >= unroll:
-                    flush()
-            flush()
+                gd.submit((x, y, self.rng.next_key(), fm, lm))
+            gd.flush()
             # no epoch-end sync: packing only runs when every listener is
             # stateless, so nothing reads train_state until fit() returns
             for lst in self._listeners:

@@ -189,6 +189,81 @@ class LeafPacker:
         return self._unpack_jit(packed)
 
 
+def make_unrolled_packed_step(raw_step, packer, k: int):
+    """One jitted program running ``k`` sequential train steps
+    (env.dispatch_unroll). The per-step argument tuples arrive as a LIST
+    pytree — never pre-stacked on device, which would cost one tiny
+    dispatch per array per group (the very overhead grouping removes).
+    Shared by MultiLayerNetwork and ComputationGraph (both raw steps take
+    ``(train_state, *step_args)`` and return ``(new_state, loss)``)."""
+    def unrolled(pts, args_list):
+        ts = packer.unpack(pts)
+        losses = []
+        for i in range(k):
+            ts, loss = raw_step(ts, *args_list[i])
+            losses.append(loss)
+        return packer.pack(ts), jnp.stack(losses)
+
+    return jax.jit(unrolled, donate_argnums=(0,))
+
+
+class GroupedDispatch:
+    """Buffer-and-flush protocol for grouped dispatch, shared by the fit
+    loops (a raising listener or iterator must never leave an executed
+    group buffered — the exceptional-exit flush would train it twice, a
+    bug reproduced in review before this class existed).
+
+    - ``run_single(args) -> loss`` and ``run_group([args, ...]) -> [loss]``
+      perform the dispatches;
+    - ``compatible(a, b)`` says whether two buffered tuples may share one
+      unrolled program (same shapes / mask presence);
+    - ``deliver(args, loss)`` does the caller's per-step bookkeeping
+      (score, iteration counters, listeners) in submission order.
+    """
+
+    def __init__(self, unroll: int, compatible, run_single, run_group,
+                 deliver):
+        self._unroll = max(1, int(unroll))
+        self._compatible = compatible
+        self._run_single = run_single
+        self._run_group = run_group
+        self._deliver = deliver
+        self._pending: list = []
+
+    def submit(self, args) -> None:
+        if self._unroll <= 1:
+            self._deliver(args, self._run_single(args))
+            return
+        if self._pending and not self._compatible(self._pending[0], args):
+            self.flush()
+        self._pending.append(args)
+        if len(self._pending) >= self._unroll:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self._pending:
+            return
+        # snapshot-and-clear BEFORE dispatch/listeners (see class docstring)
+        todo = list(self._pending)
+        self._pending.clear()
+        if len(todo) == self._unroll and self._unroll > 1:
+            losses = self._run_group(todo)
+        else:  # partial tail group: single steps avoid a fresh compile
+            losses = [self._run_single(a) for a in todo]
+        for args, loss in zip(todo, losses):
+            self._deliver(args, loss)
+
+    def drain_on_error(self) -> None:
+        """Best-effort flush for exceptional exits: deliver batches that
+        were buffered but never dispatched; if the state itself is dead (a
+        raising donated step), drop them without masking the original
+        exception."""
+        try:
+            self.flush()
+        except Exception:
+            self._pending.clear()
+
+
 class PackedStepLoop:
     """Drives a network's jitted train step with packed state inside ``fit``.
 
@@ -218,6 +293,14 @@ class PackedStepLoop:
     def active(self) -> bool:
         return self._packed is not None
 
+    @property
+    def enabled(self) -> bool:
+        """Whether packed stepping is in effect (env flag + listener gate).
+        Grouped dispatch must also gate on this: with a state-reading
+        listener attached, batches must dispatch (and notify) one at a
+        time so the listener observes per-iteration state."""
+        return self._enabled
+
     def step(self, *rest_args):
         """One train step (packed when enabled, plain otherwise). Returns the
         ``(loss, aux...)`` tail of the step (everything after the state)."""
@@ -244,10 +327,10 @@ class PackedStepLoop:
         return out[1:]
 
     def step_group(self, group):
-        """Run a list of ``(x, y, rng, fmask, lmask)`` batches as ONE
-        unrolled device dispatch (env.dispatch_unroll). All batches in the
-        group must share shapes and mask-presence (the fit loop guarantees
-        it). Returns a list of per-step losses (device scalars, lazy)."""
+        """Run a list of per-step argument tuples as ONE unrolled device
+        dispatch (env.dispatch_unroll). All tuples in the group must share
+        shapes and mask-presence (the fit loop guarantees it). Returns the
+        per-step losses (device scalars, lazy)."""
         if not self._enabled or len(group) == 1:
             return [self.step(*args)[0] for args in group]
         if self._packed is None:
@@ -257,14 +340,8 @@ class PackedStepLoop:
             rest = self.step_group(group[1:]) if len(group) > 1 else []
             return [first_loss] + rest
         fn = self._net._jitted_packed_unrolled(len(group))
-        xs = jnp.stack([g[0] for g in group])
-        ys = jnp.stack([g[1] for g in group])
-        rngs = jnp.stack([g[2] for g in group])
-        fms = (jnp.stack([g[3] for g in group])
-               if group[0][3] is not None else None)
-        lms = (jnp.stack([g[4] for g in group])
-               if group[0][4] is not None else None)
-        self._packed, losses = fn(self._packed, xs, ys, rngs, fms, lms)
+        self._packed, losses = fn(self._packed,
+                                  [tuple(args) for args in group])
         return [losses[i] for i in range(len(group))]
 
     def sync(self, release: bool = False) -> None:

@@ -170,6 +170,38 @@ class TestPackedStepEquivalence:
         net.fit(x, y, epochs=3)
         assert lst.seen_steps == [1, 2, 3]
 
+    def test_stateful_listener_also_disables_grouping(self):
+        """dispatch_unroll>1 + a state-reading listener: batches must still
+        dispatch one at a time so iteration_done observes per-iteration
+        state (grouping would show iteration 1 the weights of iteration K)."""
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+        from deeplearning4j_tpu.train.listeners import TrainingListener
+
+        class Grabby(TrainingListener):
+            def __init__(self):
+                self.seen_steps = []
+
+            def iteration_done(self, model, iteration, epoch, score):
+                self.seen_steps.append(int(model.train_state.step))
+
+        env = get_environment()
+        prev = env.dispatch_unroll
+        rng = np.random.default_rng(7)
+        x = rng.normal(size=(8, 12)).astype(np.float32)
+        y = np.eye(5, dtype=np.float32)[rng.integers(0, 5, 8)]
+        try:
+            env.set_dispatch_unroll(4)
+            net = _make_net()
+            lst = Grabby()
+            net.set_listeners(lst)
+            it = ListDataSetIterator([DataSet(x, y) for _ in range(4)],
+                                     batch_size=8)
+            net.fit(it, epochs=1)
+        finally:
+            env.dispatch_unroll = prev
+        assert lst.seen_steps == [1, 2, 3, 4]
+
     def test_stateless_listener_keeps_packing(self):
         from deeplearning4j_tpu.train.listeners import CollectScoresListener
         net = _make_net()
@@ -334,3 +366,41 @@ class TestDispatchUnroll:
             env.dispatch_unroll = prev
         # the 2-batch group ran ONCE: step counter is 2, not 4
         assert int(net.train_state.step) == 2
+
+    def test_graph_unrolled_fit_matches_single(self):
+        """ComputationGraph fit with dispatch_unroll=3 == per-batch loop."""
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+        from deeplearning4j_tpu.models.computation_graph import ComputationGraph
+        from deeplearning4j_tpu.nn.graph_vertices import ElementWiseVertex
+
+        def build():
+            g = (NeuralNetConfiguration.builder().seed(13).updater(Adam(1e-2))
+                 .graph_builder().add_inputs("in"))
+            g.add_layer("d1", DenseLayer(n_out=16, activation="relu"), "in")
+            g.add_layer("d2", DenseLayer(n_out=16, activation="relu"), "d1")
+            g.add_vertex("add", ElementWiseVertex(op="add"), "d1", "d2")
+            g.add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                           loss="mcxent"), "add")
+            g.set_outputs("out")
+            g.set_input_types(InputType.feed_forward(8))
+            return ComputationGraph(g.build()).init()
+
+        rng = np.random.default_rng(12)
+        batches = [DataSet(rng.normal(size=(10, 8)).astype(np.float32),
+                           np.eye(3, dtype=np.float32)[rng.integers(0, 3, 10)])
+                   for _ in range(7)]
+        env = get_environment()
+        prev = env.dispatch_unroll
+        try:
+            nets = []
+            for k in (1, 3):
+                env.set_dispatch_unroll(k)
+                net = build()
+                net.fit(ListDataSetIterator(list(batches), batch_size=10),
+                        epochs=2)
+                nets.append(net)
+        finally:
+            env.dispatch_unroll = prev
+        _tree_equal(nets[0].train_state.params, nets[1].train_state.params)
+        assert int(nets[1].train_state.step) == 14
