@@ -964,12 +964,27 @@ class SameDiff:
 
     def save(self, path: str, save_updater_state: bool = False) -> None:
         """Zip: graph.json + arrays.npz (the ``.fb`` single-artifact analog —
-        reference ``sd.save(file, saveUpdaterState)``)."""
+        reference ``sd.save(file, saveUpdaterState)``).
+
+        The RNG stream position (``_train_iter`` + base key) is always
+        persisted: now that train-time stochasticity is real, a mid-training
+        save/restore must NOT replay dropout masks from step 0. With
+        ``save_updater_state=True`` the optimizer state (Adam moments etc.)
+        is saved too, giving bit-exact resume — the reference's
+        ``sd.save(file, true)`` contract."""
         with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
             zf.writestr("graph.json", json.dumps(self.to_dict(), indent=2))
             buf = io.BytesIO()
             np.savez(buf, **{k: np.asarray(v) for k, v in self.arrays.items()})
             zf.writestr("arrays.npz", buf.getvalue())
+            buf = io.BytesIO()
+            np.savez(buf, train_iter=np.asarray(self._train_iter, np.int64),
+                     rng_key=np.asarray(self._rng_key))
+            zf.writestr("training_state.npz", buf.getvalue())
+            if save_updater_state and self._opt_state is not None:
+                from deeplearning4j_tpu.models.serializer import _save_pytree_npz
+                zf.writestr("updaterState.npz",
+                            _save_pytree_npz(self._opt_state))
 
     @staticmethod
     def load(path: str) -> "SameDiff":
@@ -989,6 +1004,21 @@ class SameDiff:
             sd.loss_variables = d.get("loss_variables", [])
             if d.get("training_config"):
                 sd.training_config = TrainingConfig.from_dict(d["training_config"])
+            if "training_state.npz" in zf.namelist():
+                ts = np.load(io.BytesIO(zf.read("training_state.npz")))
+                sd._train_iter = int(ts["train_iter"])
+                sd._rng_key = jnp.asarray(ts["rng_key"])
+            if ("updaterState.npz" in zf.namelist()
+                    and sd.training_config is not None):
+                # Rebuild the optimizer pytree structure from the config
+                # (eval_shape: structure only, no device allocation — a
+                # BERT-scale moment set is hundreds of MB), then graft the
+                # saved leaves into it via the shared leaf-order protocol.
+                from deeplearning4j_tpu.models.serializer import _load_pytree_npz
+                sd._tx = sd.training_config.updater.make()
+                template = jax.eval_shape(sd._tx.init, sd._trainable())
+                sd._opt_state = _load_pytree_npz(
+                    zf.read("updaterState.npz"), template)
         return sd
 
     def export_stablehlo(self, placeholders: Dict[str, Any], *outputs: str) -> str:

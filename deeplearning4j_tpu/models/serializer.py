@@ -56,10 +56,16 @@ class ModelSerializer:
         kind = type(net).__name__
         with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
             zf.writestr(_CONF, net.conf.to_json())
+            # The RNG stream position rides along so a restored net does not
+            # replay dropout keys from the seed (exact resume: save at step
+            # N, restore, continue == an uninterrupted run).
+            rng_state = net.rng.get_state()
             zf.writestr(_META, json.dumps({
                 "model_type": kind,
                 "iteration": net._iteration,
                 "epoch": net._epoch,
+                "rng_seed": rng_state["seed"],
+                "rng_key": rng_state["key"],
                 "framework": "deeplearning4j_tpu",
             }))
             ts = net.train_state
@@ -118,6 +124,9 @@ class ModelSerializer:
         meta = json.loads(zf.read(_META).decode()) if _META in zf.namelist() else {}
         net._iteration = int(meta.get("iteration", 0))
         net._epoch = int(meta.get("epoch", 0))
+        if meta.get("rng_seed") is not None:
+            net.rng.set_state({"seed": meta["rng_seed"],
+                               "key": meta.get("rng_key")})
         net.train_state = new_ts
 
     @staticmethod

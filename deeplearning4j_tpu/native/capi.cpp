@@ -85,7 +85,13 @@ extern "C" {
 int dl4jtpu_init(const char *repo_path) {
   std::lock_guard<std::mutex> lock(g_mutex);
   if (g_initialized) return 0;
-  if (!Py_IsInitialized()) Py_InitializeEx(0);
+  // Sticky across retries: a failed first init (bad repo_path) must not
+  // make a later successful call forget that WE created the interpreter.
+  static bool g_we_initialized = false;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_we_initialized = true;
+  }
   PyGILState_STATE gil = PyGILState_Ensure();
   int rc = 0;
   if (repo_path != nullptr) {
@@ -106,10 +112,15 @@ int dl4jtpu_init(const char *repo_path) {
     g_initialized = true;
   }
   PyGILState_Release(gil);
-  if (rc == 0) {
+  if (g_we_initialized) {
     // Py_InitializeEx leaves THIS thread holding the GIL; release it so
     // other host threads' PyGILState_Ensure calls can proceed (the
-    // header promises any-thread calls).
+    // header promises any-thread calls). Done even when rc != 0 — a
+    // failed import must not leave the GIL parked on this thread. Only
+    // done when THIS library initialized the interpreter: a host that
+    // pre-initialized Python and calls dl4jtpu_init while holding the
+    // GIL keeps it (releasing it behind the host's back would break its
+    // own Python API use).
     static PyThreadState *g_main_tstate = nullptr;
     if (g_main_tstate == nullptr && PyGILState_Check())
       g_main_tstate = PyEval_SaveThread();

@@ -32,7 +32,10 @@ extern "C" {
 
 /* Initialise the embedded Python runtime and import the framework.
  * repo_path: directory to prepend to sys.path (NULL = rely on PYTHONPATH).
- * Returns 0 on success, -1 on failure (see dl4jtpu_last_error). */
+ * Returns 0 on success, -1 on failure (see dl4jtpu_last_error).
+ * If the host application already initialised CPython, its interpreter is
+ * reused and its GIL state is left exactly as found (this library only
+ * releases the GIL after init when it created the interpreter itself). */
 int dl4jtpu_init(const char *repo_path);
 
 /* Load a ModelSerializer zip (MultiLayerNetwork or ComputationGraph).

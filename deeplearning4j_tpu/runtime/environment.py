@@ -70,10 +70,9 @@ class Environment:
     # default for the single-process fit path; sharded training keeps
     # per-leaf state.
     packed_state: bool = True
-    # Batches grouped per device dispatch in MultiLayerNetwork.fit and
-    # SameDiff.fit (>1 = opt-in; ComputationGraph.fit dispatches per batch
-    # — its flagship steps are device-bound): K same-shape batches run as
-    # ONE unrolled jitted program.
+    # Batches grouped per device dispatch in all three fit loops
+    # (MultiLayerNetwork.fit, ComputationGraph.fit, SameDiff.fit; >1 =
+    # opt-in): K same-shape batches run as ONE unrolled jitted program.
     # For dispatch-bound small steps (char-RNN 2x512: 3.46 ms device step
     # vs ~5 ms host cost per dispatch through a remote tunnel) this is the
     # difference between 1.8M and 3.9M tokens/s. Costs K-fold compile
@@ -175,7 +174,10 @@ def get_environment() -> Environment:
             if os.environ.get(_ENV_PREFIX + "PACKED_STATE", "").lower() in ("0", "false"):
                 env.packed_state = False
             if os.environ.get(_ENV_PREFIX + "DISPATCH_UNROLL", "").isdigit():
-                env.set_dispatch_unroll(int(os.environ[_ENV_PREFIX + "DISPATCH_UNROLL"]))
+                # "0" from the environment means "disable" — clamp to the
+                # no-grouping value instead of tripping the >=1 validation.
+                env.set_dispatch_unroll(
+                    max(1, int(os.environ[_ENV_PREFIX + "DISPATCH_UNROLL"])))
             cache = os.environ.get(_ENV_PREFIX + "COMPILE_CACHE")
             if cache:
                 env.cache_compiled = cache

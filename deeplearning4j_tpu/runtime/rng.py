@@ -15,6 +15,8 @@ import threading
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 
 
 class RngManager:
@@ -53,6 +55,23 @@ class RngManager:
             if seed is not None:
                 self._seed = int(seed)
             self._key = None  # re-created lazily from the (new) seed
+
+    def get_state(self) -> dict:
+        """JSON-serializable stream position (seed + current key, or None
+        when the stream is still at its lazily-initialised origin). The
+        serializers persist this so restored training continues the SAME
+        key stream instead of replaying from the seed."""
+        with self._lock:
+            return {"seed": self._seed,
+                    "key": (None if self._key is None
+                            else np.asarray(self._key).tolist())}
+
+    def set_state(self, state: dict) -> None:
+        with self._lock:
+            self._seed = int(state["seed"])
+            k = state.get("key")
+            self._key = (None if k is None
+                         else jnp.asarray(np.asarray(k, np.uint32)))
 
 
 _default = RngManager(0)

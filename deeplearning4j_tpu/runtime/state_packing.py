@@ -315,7 +315,11 @@ class PackedStepLoop:
             self._step_fn, self._packer = self._net._jitted_packed()
             try:
                 self._packed = self._packer.pack_device(self._net.train_state)
-            except ValueError:  # structure changed since the packer was built
+            # Structure changed since the packer was built. A changed
+            # treedef/dtype raises ValueError; a changed leaf SHAPE with the
+            # same treedef surfaces as TypeError from the reshape inside
+            # pack — both mean "rebuild the packer".
+            except (ValueError, TypeError):
                 prefix = self._net._packed_cache_key()
                 for k in [k for k in self._net._jit_cache
                           if k.startswith(prefix)]:  # incl. @unroll variants

@@ -102,6 +102,18 @@ class OrbaxCheckpointer:
             self.dir, options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep, enable_async_checkpointing=True))
 
+    @staticmethod
+    def _rng_payload(net):
+        """Fixed-structure RNG stream position (a lazily-uninitialised key
+        is materialised to its origin, PRNGKey(seed), so save and restore
+        targets always share one structure)."""
+        import jax
+        import numpy as np
+        rs = net.rng.get_state()
+        key = (np.asarray(rs["key"], np.uint32) if rs["key"] is not None
+               else np.asarray(jax.random.PRNGKey(rs["seed"])))
+        return {"seed": np.asarray(rs["seed"], np.int64), "key": key}
+
     def save(self, net, step: Optional[int] = None) -> None:
         ts = net.train_state
         step = int(ts.step) if step is None else int(step)
@@ -109,23 +121,41 @@ class OrbaxCheckpointer:
             "params": ts.params, "opt_state": ts.opt_state,
             "model_state": ts.model_state, "step": ts.step,
             "iteration": net._iteration, "epoch": net._epoch,
+            "rng": self._rng_payload(net),
         }))
 
     def restore(self, net, step: Optional[int] = None):
         import dataclasses
+        import numpy as np
         if net.train_state is None:
             net.init()
         ts = net.train_state
         step = self.mngr.latest_step() if step is None else step
         target = {"params": ts.params, "opt_state": ts.opt_state,
                   "model_state": ts.model_state, "step": ts.step,
-                  "iteration": 0, "epoch": 0}
-        restored = self.mngr.restore(step, args=self._ocp.args.StandardRestore(target))
+                  "iteration": 0, "epoch": 0,
+                  "rng": self._rng_payload(net)}
+        try:
+            restored = self.mngr.restore(
+                step, args=self._ocp.args.StandardRestore(target))
+        except ValueError:
+            # Checkpoints written before the RNG payload existed have no
+            # "rng" entry, and StandardRestore refuses a target whose tree
+            # structure differs from disk — retry without it (the restored
+            # net then starts a fresh stream from its seed, the old
+            # behavior, instead of failing to resume at all).
+            target.pop("rng")
+            restored = self.mngr.restore(
+                step, args=self._ocp.args.StandardRestore(target))
         net.train_state = dataclasses.replace(
             ts, params=restored["params"], opt_state=restored["opt_state"],
             model_state=restored["model_state"], step=restored["step"])
         net._iteration = int(restored.get("iteration", 0))
         net._epoch = int(restored.get("epoch", 0))
+        rng = restored.get("rng")
+        if rng is not None:
+            net.rng.set_state({"seed": int(np.asarray(rng["seed"])),
+                               "key": np.asarray(rng["key"]).tolist()})
         return net
 
     def wait(self) -> None:

@@ -469,3 +469,60 @@ def test_fit_dispatch_unroll_matches_single():
     np.testing.assert_allclose(h1, h3, rtol=1e-5)
     for n in a1:
         np.testing.assert_allclose(a1[n], a3[n], rtol=1e-5, atol=1e-7)
+
+
+def test_save_load_exact_resume_with_dropout(tmp_path):
+    """fit 3 steps -> save(updater=True) -> load -> fit 3 more must bit-match
+    an uninterrupted 6-step run WITH dropout active: the archive carries the
+    RNG stream position (train_iter + base key) and the Adam moments
+    (reference ``sd.save(file, true)`` exact-resume contract)."""
+    def build():
+        sd = SameDiff.create()
+        xin = sd.placeholder("x", (None, 8))
+        w = sd.var("w", (8, 1))
+        h = sd.nn.dropout(xin, rate=0.5)
+        labels = sd.placeholder("labels", (None, 1))
+        sd.loss.mean_squared_error("loss", labels, h.mmul(w))
+        sd.set_loss_variables("loss")
+        sd.set_training_config(TrainingConfig(
+            updater=Adam(1e-2), data_set_feature_mapping=["x"],
+            data_set_label_mapping=["labels"]))
+        return sd
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(0, 1, (16, 8)).astype(np.float32)
+    y = rng.normal(0, 1, (16, 1)).astype(np.float32)
+
+    sd_full = build()
+    full = list(sd_full.fit(x, y, epochs=6))
+
+    sd_a = build()
+    first = list(sd_a.fit(x, y, epochs=3))
+    path = str(tmp_path / "resume.sdz")
+    sd_a.save(path, save_updater_state=True)
+    sd_b = SameDiff.load(path)
+    second = list(sd_b.fit(x, y, epochs=3))
+
+    np.testing.assert_array_equal(np.asarray(first + second),
+                                  np.asarray(full))
+    for n, a in sd_full.arrays.items():
+        if sd_full.vars[n].vtype.value == "variable":
+            np.testing.assert_array_equal(np.asarray(a),
+                                          np.asarray(sd_b.arrays[n]))
+
+
+def test_save_without_updater_still_restores_rng_position(tmp_path):
+    """Even with save_updater_state=False the RNG stream position rides
+    along: restored dropout masks continue from step N, not step 0."""
+    sd = _mlp_graph()
+    sd.set_training_config(TrainingConfig(
+        updater=Adam(5e-2), data_set_feature_mapping=["x"],
+        data_set_label_mapping=["labels"]))
+    x, y = _toy(32)
+    sd.fit(x, y, epochs=4)
+    path = str(tmp_path / "plain.sdz")
+    sd.save(path)
+    sd2 = SameDiff.load(path)
+    assert sd2._train_iter == sd._train_iter == 4
+    np.testing.assert_array_equal(np.asarray(sd2._rng_key),
+                                  np.asarray(sd._rng_key))

@@ -80,6 +80,96 @@ def test_model_serializer_roundtrip():
     net2.fit(x, y, epochs=1)
 
 
+def test_mln_save_load_exact_resume_with_dropout():
+    """fit 3 -> save -> load -> fit 3 bit-matches an uninterrupted 6-step run
+    with dropout active: the archive carries the RngManager stream position
+    (plus iteration count and updater state), so restored training draws the
+    SAME masks the uninterrupted run would."""
+    def conf():
+        return (NeuralNetConfiguration.builder()
+                .seed(11)
+                .updater(Adam(1e-2))
+                .list()
+                .layer(DenseLayer(n_out=32, activation="relu", dropout=0.5))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(20))
+                .build())
+
+    x, y = _toy_classification(n=64, seed=5)
+
+    net_full = MultiLayerNetwork(conf()).init()
+    s_full = CollectScoresListener()
+    net_full.set_listeners(s_full)
+    for _ in range(6):
+        net_full.fit(x, y, epochs=1)
+
+    net_a = MultiLayerNetwork(conf()).init()
+    s_a = CollectScoresListener()
+    net_a.set_listeners(s_a)
+    for _ in range(3):
+        net_a.fit(x, y, epochs=1)
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "resume.zip")
+        net_a.save(path)
+        net_b = MultiLayerNetwork.load(path)
+    s_b = CollectScoresListener()
+    net_b.set_listeners(s_b)
+    for _ in range(3):
+        net_b.fit(x, y, epochs=1)
+
+    full = [float(s) for _, s in s_full.scores]
+    split = [float(s) for _, s in s_a.scores] + [float(s) for _, s in s_b.scores]
+    np.testing.assert_array_equal(np.asarray(split), np.asarray(full))
+
+
+def test_orbax_exact_resume_with_dropout(tmp_path):
+    """OrbaxCheckpointer (the checkpoint-during-training path) carries the
+    same exact-resume payload as ModelSerializer: params, updater state,
+    iteration AND the RNG stream position."""
+    from deeplearning4j_tpu.train.checkpoint import OrbaxCheckpointer
+
+    def conf():
+        return (NeuralNetConfiguration.builder()
+                .seed(13)
+                .updater(Adam(1e-2))
+                .list()
+                .layer(DenseLayer(n_out=16, activation="relu", dropout=0.5))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(20))
+                .build())
+
+    x, y = _toy_classification(n=64, seed=9)
+
+    net_full = MultiLayerNetwork(conf()).init()
+    s_full = CollectScoresListener()
+    net_full.set_listeners(s_full)
+    for _ in range(6):
+        net_full.fit(x, y, epochs=1)
+
+    net_a = MultiLayerNetwork(conf()).init()
+    s_a = CollectScoresListener()
+    net_a.set_listeners(s_a)
+    for _ in range(3):
+        net_a.fit(x, y, epochs=1)
+    ckpt = OrbaxCheckpointer(str(tmp_path / "ckpt"))
+    ckpt.save(net_a, step=3)
+    ckpt.wait()
+    ckpt.close()
+
+    net_b = MultiLayerNetwork(conf()).init()
+    OrbaxCheckpointer(str(tmp_path / "ckpt")).restore(net_b)
+    s_b = CollectScoresListener()
+    net_b.set_listeners(s_b)
+    for _ in range(3):
+        net_b.fit(x, y, epochs=1)
+
+    full = [float(s) for _, s in s_full.scores]
+    split = [float(s) for _, s in s_a.scores] + [float(s) for _, s in s_b.scores]
+    np.testing.assert_array_equal(np.asarray(split), np.asarray(full))
+
+
 def test_deterministic_init():
     net1 = MultiLayerNetwork(_mlp_conf()).init()
     net2 = MultiLayerNetwork(_mlp_conf()).init()
