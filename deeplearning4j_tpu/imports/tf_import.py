@@ -31,7 +31,8 @@ class TFGraphMapper:
     @staticmethod
     def import_graph(path_or_graphdef, input_shapes: Optional[Dict[str, tuple]] = None,
                      optimize: bool = True,
-                     while_max_iterations: Optional[int] = None) -> SameDiff:
+                     while_max_iterations: Optional[int] = None,
+                     lazy_conditionals: bool = True) -> SameDiff:
         """Import a frozen .pb file (or a GraphDef proto) into a SameDiff.
         ``optimize`` runs the graph-optimizer fusion passes (layernorm/gelu
         patterns -> fused ops; reference: libnd4j's pre-execution graph
@@ -40,7 +41,11 @@ class TFGraphMapper:
         masked ``lax.scan`` of that length instead of ``lax.while_loop`` —
         the scan form is reverse-differentiable, so graphs containing loops
         can be fine-tuned with ``sd.fit`` (the while form is forward-only,
-        as in JAX)."""
+        as in JAX). ``lazy_conditionals``: TF1 Switch/Merge conditionals
+        lower onto ``sd.cond`` (only the taken branch executes); pass
+        False for the execute-both + where form, which costs up to 2x the
+        taken branch's work but keeps the graph free of python callables —
+        required if the imported graph must round-trip ``sd.save()``."""
         tf = _tf()
         if isinstance(path_or_graphdef, (str, bytes)):
             gd = tf.compat.v1.GraphDef()
@@ -50,6 +55,7 @@ class TFGraphMapper:
             gd = path_or_graphdef
         imp = _GraphImporter(gd, input_shapes or {})
         imp.while_max_iterations = while_max_iterations
+        imp.lazy_conditionals = lazy_conditionals
         sd = imp.run()
         if optimize:
             from deeplearning4j_tpu.autodiff.graph_optimizer import (
@@ -106,12 +112,16 @@ class _GraphImporter:
                           for f in graph_def.library.function}
         self._switch_pred: Dict[str, str] = {}   # Switch node -> pred ref
         self._switch_memo: Dict[str, Optional[tuple]] = {}
+        self._consumers: Optional[Dict[str, list]] = None  # lazy fwd edges
         # TF1 while frames: nodes consumed by a lowered frame are skipped
         # by the per-node loop (the frame's cond/body are re-imported as
         # standalone subgraphs feeding sd.while_loop)
         self._frame_consumed: set = set()
         # opt-in: lower While loops to fixed-length differentiable scans
         self.while_max_iterations: Optional[int] = None
+        # TF1 Switch/Merge conds -> sd.cond (lazy); False = where-select
+        # (keeps the graph serializable via sd.save)
+        self.lazy_conditionals: bool = True
 
     # --- helpers ---
     @staticmethod
@@ -338,6 +348,193 @@ class _GraphImporter:
 
         fn._accepts_rng = True
         return fn
+
+    # ---- TF1 lowered tf.cond (Switch/Merge dataflow) → lazy sd.cond ----
+    def _forward_reachable(self, roots) -> set:
+        """Node names forward-reachable from ``roots`` along data/control
+        edges — the region a Switch can gate."""
+        if self._consumers is None:
+            cons: Dict[str, list] = {}
+            for n in self.gd.node:
+                for i in n.input:
+                    cons.setdefault(self._clean(i), []).append(n.name)
+            self._consumers = cons
+        seen: set = set()
+        stack = list(roots)
+        while stack:
+            nm = stack.pop()
+            if nm in seen:
+                continue
+            seen.add(nm)
+            stack.extend(self._consumers.get(nm, ()))
+        return seen
+
+    def _cond_branch_callable(self, root_ref: str, switches: set, reach: set):
+        """Backward-slice ONE tf.cond branch from a Merge input and build a
+        jax callable for it (reference ``TFGraphMapper`` keeps Switch/Merge
+        as SameDiff frame ops with lazy branch execution; here the branch
+        subgraph is re-imported standalone and lowered onto ``sd.cond`` →
+        ``lax.cond``). Boundaries become Placeholders: a branch Switch is
+        fed by its data input (computed unconditionally — exactly
+        lax.cond's operand semantics, and TF's: Switch inputs run before
+        the branch), and any value produced outside the Switch-gated
+        region is fed as-is. Returns ``(fn, feed_refs)`` where
+        ``feed_refs[i]`` is the outer-graph ref supplying operand i."""
+        tf = _tf()
+        stops: Dict[str, str] = {}   # canonical boundary ref -> placeholder
+        feeds: list = []             # outer feed ref per placeholder
+        interior: Dict[str, Any] = {}
+        inline_consts: Dict[str, np.ndarray] = {}
+
+        def canon(ref: str):
+            """(key, feed) for a boundary ref, or None if interior."""
+            base = self._clean(ref)
+            if base in switches:
+                # both Switch outputs carry the same data value
+                return base, self.node_by_name[base].input[0]
+            if base not in reach and base not in inline_consts:
+                flat = _flatten_ref(ref[1:] if ref.startswith("^") else ref)
+                return flat, flat
+            return None
+
+        stack = [root_ref]
+        while stack:
+            ref = stack.pop()
+            if ref.startswith("^"):
+                continue  # ordering-only edges; graphs here are pure
+            base = self._clean(ref)
+            if base in switches:
+                if base not in stops:
+                    stops[base] = f"__cb_{len(stops)}"
+                    feeds.append(self.node_by_name[base].input[0])
+                continue
+            if base not in reach:
+                if base in inline_consts:
+                    continue
+                # Outside-region constants are INLINED into the branch
+                # subgraph (not fed as operands): branch ops that
+                # static-fold an operand — Mean/Reshape axes, shapes —
+                # must still see a Const, not a Placeholder.
+                try:
+                    inline_consts[base] = self._const(ref)
+                    continue
+                except ValueError:
+                    pass
+                flat = _flatten_ref(ref)
+                if flat not in stops:
+                    stops[flat] = f"__cb_{len(stops)}"
+                    feeds.append(flat)
+                continue
+            if base in interior:
+                continue
+            node = self.node_by_name.get(base)
+            if node is None:
+                raise NotImplementedError(
+                    f"cond branch references unknown node {base!r}")
+            interior[base] = node
+            stack.extend(node.input)
+
+        # topo-sort the slice (sub-importer maps in list order); a nested
+        # while frame's Merge <- NextIteration back-edge is dropped, as in
+        # the frame machinery — the sub-importer re-discovers the loop
+        def _deps(n):
+            out = []
+            for d in (self._clean(i) for i in n.input):
+                if d not in interior:
+                    continue
+                if n.op == "Merge" and \
+                        self.node_by_name[d].op == "NextIteration":
+                    continue
+                out.append(d)
+            return out
+
+        deps = {nm: _deps(n) for nm, n in interior.items()}
+        done: set = set()
+        order: list = []
+
+        def visit(nm, chain=()):
+            if nm in done:
+                return
+            if nm in chain:
+                raise NotImplementedError(
+                    f"cycle through {nm!r} in cond branch slice")
+            for d in deps[nm]:
+                visit(d, chain + (nm,))
+            done.add(nm)
+            order.append(interior[nm])
+
+        for nm in interior:
+            visit(nm)
+
+        gd2 = tf.compat.v1.GraphDef()
+        gd2.library.CopyFrom(self.gd.library)
+        for key, ph in stops.items():
+            nd = gd2.node.add()
+            nd.name = ph
+            nd.op = "Placeholder"
+        for cname, cval in inline_consts.items():
+            nd = gd2.node.add()
+            nd.name = cname
+            nd.op = "Const"
+            nd.attr["value"].tensor.CopyFrom(tf.make_tensor_proto(cval))
+            nd.attr["dtype"].type = nd.attr["value"].tensor.dtype
+        for node in order:
+            cp = gd2.node.add()
+            cp.CopyFrom(node)
+            del cp.input[:]
+            for ref in node.input:
+                if ref.startswith("^"):
+                    if self._clean(ref) in interior:
+                        cp.input.append(ref)
+                    continue
+                cb = canon(ref)
+                cp.input.append(stops[cb[0]] if cb is not None else ref)
+        cb = canon(root_ref)
+        out_ref = stops[cb[0]] if cb is not None else _flatten_ref(root_ref)
+        sub_sd = _GraphImporter(gd2, {}).run()
+        ph_names = list(stops.values())
+
+        def fn(*arrays, key=None):
+            env = dict(sub_sd.arrays)
+            env.update(zip(ph_names, arrays))
+            if key is not None:
+                env["__rng__"] = key
+            return sub_sd._exec_graph(env, [out_ref])[0]
+
+        fn._accepts_rng = True
+        return fn, feeds
+
+    def _lower_cond_merge(self, node, true_ref: str, false_ref: str,
+                          pred_ref: str) -> None:
+        """Lower one matched Switch/Merge conditional onto ``sd.cond``:
+        only the taken branch executes (lax.cond), unlike the
+        execute-both + ``where`` fallback. The branch nodes eagerly mapped
+        before this Merge was reached become dead code — ``_exec_graph``
+        is demand-driven and never computes them."""
+        sd = self.sd
+        pflat = _flatten_ref(pred_ref)
+        switches = {s for s, p in self._switch_pred.items()
+                    if _flatten_ref(p) == pflat}
+        reach = self._forward_reachable(switches)
+        tfn, tfeeds = self._cond_branch_callable(true_ref, switches, reach)
+        ffn, ffeeds = self._cond_branch_callable(false_ref, switches, reach)
+        feeds = list(dict.fromkeys(tfeeds + ffeeds))
+        t_idx = [feeds.index(r) for r in tfeeds]
+        f_idx = [feeds.index(r) for r in ffeeds]
+
+        def true_fn(*a, key=None):
+            return tfn(*[a[i] for i in t_idx], key=key)
+
+        def false_fn(*a, key=None):
+            return ffn(*[a[i] for i in f_idx], key=key)
+
+        true_fn._accepts_rng = True
+        false_fn._accepts_rng = True
+        out = sd.cond(sd.vars[self._ensure_var(pred_ref)], true_fn, false_fn,
+                      *[sd.vars[self._ensure_var(r)] for r in feeds],
+                      name=node.name)
+        if out.name != node.name:
+            out.rename(node.name)
 
     # ---- TF1 while-loop frames (Enter/Merge/Switch/NextIteration/Exit) ----
     def _extract_frame_subgraph(self, roots: List[str], stops: Dict[str, str],
@@ -945,9 +1142,11 @@ class _GraphImporter:
             return
 
         # ---- TF1-style lowered conditionals (Switch/Merge dataflow) ----
-        # Our graph is pure, so both branches are computable; Merge becomes a
-        # select on the controlling Switch's predicate. (Reference maps these
-        # into SameDiff frames; XLA wants branch-free dataflow or lax.cond.)
+        # Merge lowers onto sd.cond (lax.cond): each branch is backward-
+        # sliced into a standalone subgraph and only the taken one
+        # executes, matching the reference's lazy Switch/Merge frame
+        # semantics. Unmatched Merges fall back to execute-both + select
+        # (the graph is pure, so that form is numerically exact).
         if op == "Switch":
             # outputs: :0 = false branch, :1 = true branch; both carry data
             data_v = sd.vars[self._ensure_var(ins[0])]
@@ -965,17 +1164,37 @@ class _GraphImporter:
             false_refs = [r for r, p in zip(ins, picks) if p and p[1] == 0]
             if not true_refs or not false_refs:
                 raise NotImplementedError(
-                    f"Merge {node.name!r}: cannot associate inputs with a "
-                    "Switch true/false pair (TF1 while-loop frames are not "
-                    "supported — re-freeze without lowering control flow, "
-                    "or use the functional While path)")
+                    f"Merge {node.name!r}: cannot associate its inputs with "
+                    "a controlling Switch true/false pair. TF1 while-loop "
+                    "frames ARE supported (Enter-rooted frames lower onto "
+                    "sd.while_loop); this Merge is outside any frame and "
+                    "has no matched Switch — likely a malformed or "
+                    "hand-edited frozen graph")
             pred_ref = self._switch_pred[next(p for p in picks if p)[0]]
-            pred_v = sd.vars[self._ensure_var(pred_ref)]
-            tv = sd.vars[self._ensure_var(true_refs[0])]
-            fv = sd.vars[self._ensure_var(false_refs[0])]
-            out = sd._apply("where", [pred_v, tv, fv], name=node.name)
-            if out.name != node.name:
-                out.rename(node.name)
+            lowered = False
+            if self.lazy_conditionals:
+                try:
+                    # lazy branch-select: only the taken branch executes
+                    self._lower_cond_merge(node, true_refs[0], false_refs[0],
+                                           pred_ref)
+                    lowered = True
+                # The where-form is numerically exact, so ANY failure to
+                # build the lazy slice falls back rather than failing the
+                # import: NotImplementedError from the slice machinery,
+                # ValueError from a branch op that static-folds a Const
+                # the slice turned into a Placeholder, RecursionError from
+                # a pathologically deep branch topo-sort.
+                except (NotImplementedError, ValueError, RecursionError):
+                    pass
+            if not lowered:
+                # execute-both + select fallback (numerically identical,
+                # up to 2x the work of the taken branch)
+                pred_v = sd.vars[self._ensure_var(pred_ref)]
+                tv = sd.vars[self._ensure_var(true_refs[0])]
+                fv = sd.vars[self._ensure_var(false_refs[0])]
+                out = sd._apply("where", [pred_v, tv, fv], name=node.name)
+                if out.name != node.name:
+                    out.rename(node.name)
             # second output (value_index) is rarely consumed; emit if needed
             return
         if op == "Enter":

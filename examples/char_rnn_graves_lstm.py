@@ -16,13 +16,16 @@ vocab = len(chars)
 idx = {c: i for i, c in enumerate(chars)}
 ids = np.array([idx[c] for c in CORPUS])
 
-net = TextGenerationLSTM(vocab_size=vocab, hidden=128, layers=2,
-                         tbptt_length=32, graves=True).init()
+import os
+SMOKE = os.environ.get("DL4J_TPU_EXAMPLES_SMOKE") == "1"  # CI tiny run
+
+net = TextGenerationLSTM(vocab_size=vocab, hidden=32 if SMOKE else 128,
+                         layers=2, tbptt_length=32, graves=True).init()
 
 B, T = 16, 64
 rng = np.random.default_rng(0)
-starts = rng.integers(0, len(ids) - T - 1, B * 8)
-for epoch in range(3):
+starts = rng.integers(0, len(ids) - T - 1, B * (2 if SMOKE else 8))
+for epoch in range(1 if SMOKE else 3):
     for b in range(0, len(starts), B):
         s = starts[b:b + B]
         seq = np.stack([ids[i:i + T + 1] for i in s])
@@ -35,7 +38,7 @@ for epoch in range(3):
 net.rnn_clear_previous_state()
 cur = np.eye(vocab, dtype=np.float32)[[[idx["t"]]]]
 text = "t"
-for _ in range(80):
+for _ in range(20 if SMOKE else 80):
     probs = np.asarray(net.rnn_time_step(cur))[0, -1]
     logits = np.log(np.maximum(probs, 1e-9)) / 0.7
     p = np.exp(logits - logits.max())

@@ -26,14 +26,24 @@ conf = (NeuralNetConfiguration.builder()
         .set_input_type(InputType.convolutional_flat(28, 28, 1))
         .build())
 
+# DL4J_TPU_EXAMPLES_SMOKE=1: CI runs this script with a few hundred images
+# so an API break surfaces in the test suite (the numbers below are the
+# real example sizes).
+import os
+SMOKE = os.environ.get("DL4J_TPU_EXAMPLES_SMOKE") == "1"
+n_train, n_test, epochs = (512, 256, 1) if SMOKE else (None, None, 2)
+
 net = MultiLayerNetwork(conf).init()
 net.set_listeners(ScoreIterationListener(100))
-net.fit(MnistDataSetIterator(batch_size=64), epochs=2)
+net.fit(MnistDataSetIterator(batch_size=64, num_examples=n_train),
+        epochs=epochs)
 
-ev = net.evaluate(MnistDataSetIterator(batch_size=256, train=False))
+ev = net.evaluate(MnistDataSetIterator(batch_size=256, train=False,
+                                       num_examples=n_test))
 print(ev.stats())
 
 ModelSerializer.write_model(net, "/tmp/lenet.zip")
 restored = ModelSerializer.restore_model("/tmp/lenet.zip")
 print("restored accuracy:",
-      restored.evaluate(MnistDataSetIterator(batch_size=256, train=False)).accuracy())
+      restored.evaluate(MnistDataSetIterator(
+          batch_size=256, train=False, num_examples=n_test)).accuracy())

@@ -15,9 +15,12 @@ from deeplearning4j_tpu.zoo import ResNet50
 
 get_environment().allow_bfloat16()      # bf16 compute, f32 master weights
 
+import os
 import jax
 on_cpu = jax.devices()[0].platform == "cpu"
 size, batch = (64, 16) if on_cpu else (224, 256)
+if os.environ.get("DL4J_TPU_EXAMPLES_SMOKE") == "1":  # CI tiny-shape run
+    size, batch = 32, 4
 
 net = ResNet50(num_classes=1000, height=size, width=size,
                updater=Nesterovs(0.1, momentum=0.9)).init()

@@ -377,6 +377,131 @@ def test_tf_import_cond():
         np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
 
 
+def test_tf_import_cond_branch_heavy_golden():
+    """Branch-heavy lowered tf.cond vs the TF oracle: multi-node branch
+    subgraphs, shared external values, a value consumed both inside and
+    outside the conditional (round-5: Switch/Merge now lowers onto
+    sd.cond — lazy branch execution — instead of execute-both + where)."""
+    from deeplearning4j_tpu.imports import TFGraphMapper
+
+    scale = tf.constant(np.linspace(0.5, 2.0, 4).astype(np.float32))
+
+    def model(x):
+        base = x * scale                      # used by BOTH branches + tail
+        pred = tf.reduce_sum(x) > 0.0
+
+        def true_branch():
+            h = tf.nn.relu(base) + tf.sin(x)
+            return tf.reduce_mean(h, axis=1, keepdims=True) * base
+
+        def false_branch():
+            h = tf.nn.softplus(base - 1.0)
+            return h * 0.25 + tf.cos(x)
+
+        out = tf.cond(pred, true_branch, false_branch)
+        return out + base * 0.125             # tail also reads base
+
+    gd, inputs, outputs = _frozen_graphdef(
+        model, [tf.TensorSpec((3, 4), tf.float32, name="x")])
+    assert any(n.op == "Switch" for n in gd.node)
+    sd = TFGraphMapper.import_graph(gd)
+    # the Merge lowered to a lazy callable (lax.cond), not a where-select
+    merges = [n.name for n in gd.node if n.op == "Merge"]
+    lowered = [o for o in sd.ops
+               if o.op == "__callable__" and o.outputs[0] in merges]
+    assert lowered, [o.op for o in sd.ops]
+    for seed in (0, 1, 2, 9):  # both branch directions across seeds
+        x = np.random.default_rng(seed).normal(0, 1, (3, 4)).astype(np.float32)
+        expected = model(tf.constant(x)).numpy()
+        got = np.asarray(sd.output({inputs[0]: x}, outputs[0]))
+        np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_tf_import_cond_static_fold_const_in_branch():
+    """A branch op that static-folds its operand (Mean's axis, Reshape's
+    shape) fed by a Const OUTSIDE the switch-gated region: the slice must
+    inline the Const into the branch subgraph (a Placeholder there would
+    break the fold) and still lower lazily."""
+    from deeplearning4j_tpu.imports import TFGraphMapper
+
+    def model(x):
+        pred = tf.reduce_sum(x) > 0.0
+        return tf.cond(pred,
+                       lambda: tf.reduce_mean(x * 2.0, axis=1, keepdims=True),
+                       lambda: tf.reshape(tf.reduce_sum(x - 1.0, axis=1),
+                                          (3, 1)))
+
+    gd, inputs, outputs = _frozen_graphdef(
+        model, [tf.TensorSpec((3, 4), tf.float32, name="x")])
+    assert any(n.op == "Switch" for n in gd.node)
+    sd = TFGraphMapper.import_graph(gd)
+    merges = [n.name for n in gd.node if n.op == "Merge"]
+    assert [o for o in sd.ops
+            if o.op == "__callable__" and o.outputs[0] in merges], \
+        "fell back to where-select"
+    for seed in (0, 3):
+        x = np.random.default_rng(seed).normal(0, 1, (3, 4)).astype(np.float32)
+        expected = model(tf.constant(x)).numpy()
+        got = np.asarray(sd.output({inputs[0]: x}, outputs[0]))
+        np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_tf_import_cond_eager_optout_serializable(tmp_path):
+    """lazy_conditionals=False keeps the imported graph free of python
+    callables so sd.save()/load round-trips (the lazy form trades that
+    for taken-branch-only execution)."""
+    from deeplearning4j_tpu.imports import TFGraphMapper
+    from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+    def model(x):
+        pred = tf.reduce_sum(x) > 0.0
+        return tf.cond(pred, lambda: x * 2.0, lambda: x - 1.0)
+
+    gd, inputs, outputs = _frozen_graphdef(
+        model, [tf.TensorSpec((2, 4), tf.float32, name="x")])
+    assert any(n.op == "Switch" for n in gd.node)
+    sd = TFGraphMapper.import_graph(gd, lazy_conditionals=False)
+    path = str(tmp_path / "cond.sdz")
+    sd.save(path)  # would raise on the lazy (callable) form
+    sd2 = SameDiff.load(path)
+    for seed in (3, 4):
+        x = np.random.default_rng(seed).normal(0.5, 1, (2, 4)).astype(np.float32)
+        expected = model(tf.constant(x)).numpy()
+        got = np.asarray(sd2.output({inputs[0]: x}, outputs[0]))
+        np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_tf_import_cond_untaken_branch_grad_clean():
+    """The signature difference between lazy cond and execute-both+where:
+    reverse-mode through `where` computes BOTH branch vjps, and an untaken
+    sqrt-at-zero poisons the gradient with NaN (NaN * 0 = NaN). lax.cond
+    runs only the taken branch's vjp, so the gradient stays finite."""
+    from deeplearning4j_tpu.imports import TFGraphMapper
+    from deeplearning4j_tpu.autodiff.samediff import TrainingConfig
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    cvals = np.zeros((2, 3), np.float32)  # sqrt'(0) = inf in the dead lane
+
+    def model(x):
+        c = tf.constant(cvals, name="w_const")
+        pred = tf.reduce_sum(x) > 1e9      # always False at test inputs
+        out = tf.cond(pred, lambda: tf.sqrt(c) * x, lambda: c * 3.0 + x)
+        return tf.reduce_sum(out, axis=1)
+
+    gd, inputs, outputs = _frozen_graphdef(
+        model, [tf.TensorSpec((2, 3), tf.float32, name="x")])
+    assert any(n.op == "Switch" for n in gd.node)
+    sd = TFGraphMapper.import_graph(gd)
+    sd.convert_to_variable("w_const")
+    loss = sd.invoke("reduce_sum", sd.vars[outputs[0]], name="probe_loss")
+    sd.set_loss_variables(loss.name)
+    x = np.random.default_rng(0).normal(0, 1, (2, 3)).astype(np.float32)
+    grads = sd.calculate_gradients({inputs[0]: x}, "w_const")
+    g = np.asarray(grads["w_const"])
+    assert np.all(np.isfinite(g)), g      # where-form would be NaN here
+    np.testing.assert_allclose(g, np.full_like(g, 3.0), rtol=1e-6)
+
+
 def test_tf_import_saved_model(tmp_path):
     """SavedModel -> freeze serving signature -> import."""
     from deeplearning4j_tpu.imports import TFGraphMapper
