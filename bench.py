@@ -66,13 +66,17 @@ LOAD_GATE = 1.0  # 1-min loadavg above this corrupts tunnel-fed timings
 # of waiting for a judge to catch it (VERDICT r4 weak #5). Update these
 # bounds in the same commit that updates BASELINE.md's tables.
 RECORDED_RANGES = {
+    # bounds sit ~8% under each metric's recorded floor (regime flips are
+    # load-gated away; steady spreads are ±6%) so a real regression DOES
+    # flag — an out-of-range row only prints, it never fails the run
     "resnet50_images_per_sec": (2550, 2800),
-    "zoo_bert_samples_per_sec": (1550, 2000),
-    "bert_tf_import_samples_per_sec": (1400, 2000),
-    "word2vec_sg_tokens_per_sec": (1.55e6, 1.90e6),
-    "char_rnn_tokens_per_sec": (3.0e6, 5.0e6),
-    "mxu_tflops": (170.0, 197.0),
-    "flash_8k_tokens_per_sec": (380e3, 600e3),
+    "zoo_bert_samples_per_sec": (1730, 2050),
+    "bert_tf_import_samples_per_sec": (1650, 2050),
+    "flash_16k_tokens_per_sec": (320e3, 460e3),
+    "word2vec_sg_tokens_per_sec": (1.58e6, 1.90e6),
+    "char_rnn_tokens_per_sec": (3.3e6, 4.8e6),
+    "mxu_tflops": (175.0, 197.0),
+    "flash_8k_tokens_per_sec": (400e3, 520e3),
 }
 
 
@@ -404,34 +408,62 @@ def verify_kernels():
     _log(f"[kernels] fused dropout (opt-in): zero_frac={frac:.4f} "
          f"bwd mask regenerated identically: {mask_match}")
 
-    # ---- long-context flash attention (T=8192) ----
-    # At this length the naive form materializes an 8k x 8k score matrix
-    # per head (3 GB f32 for 12 heads) — the flash kernel's blockwise
-    # softmax is what makes the shape practical; correctness is covered by
-    # the T=2048 allclose above (same kernel, larger grid). T=16384 bwd
-    # currently exceeds the 16 MB scoped-VMEM limit (the bwd kernels keep
-    # full K/V resident per grid step — documented kernel limit; fwd is
-    # fine, and longer sequences shard across chips via ring attention).
-    Tl, Hl = 8192, 12
-    ql = jnp.asarray(rng.normal(0, 1, (1, Hl, Tl, 64)), jnp.bfloat16)
-    kl = jnp.asarray(rng.normal(0, 1, (1, Hl, Tl, 64)), jnp.bfloat16)
-    vl = jnp.asarray(rng.normal(0, 1, (1, Hl, Tl, 64)), jnp.bfloat16)
-    if flash_attention_compatible(ql, kl, vl, causal=True):
+    # ---- long-context flash attention (T=8192 and T=16384) ----
+    # At these lengths the naive form materializes a T x T score matrix
+    # per head (3 GB f32 for 12 heads at 8k) — the flash kernel's
+    # blockwise softmax is what makes the shape practical; correctness is
+    # covered by the T=2048 allclose above (same kernel, larger grid) and
+    # the chunked-backward allclose below. T=16384 runs the round-5
+    # CHUNKED backward kernels (Q/dO and K/V streamed through VMEM over a
+    # third grid dim; the single-chunk forms cap at 8192).
+    for Tl, tag in ((8192, "flash_8k"), (16384, "flash_16k")):
+        Hl = 12
+        ql = jnp.asarray(rng.normal(0, 1, (1, Hl, Tl, 64)), jnp.bfloat16)
+        kl = jnp.asarray(rng.normal(0, 1, (1, Hl, Tl, 64)), jnp.bfloat16)
+        vl = jnp.asarray(rng.normal(0, 1, (1, Hl, Tl, 64)), jnp.bfloat16)
+        if not flash_attention_compatible(ql, kl, vl, causal=True):
+            continue
         gl = jax.jit(jax.grad(lambda q, k, v: jnp.sum(
             flash_attention(q, k, v, causal=True).astype(jnp.float32) ** 2),
             argnums=(0, 1, 2)))
         r = gl(ql, kl, vl)
         _drain(r[0])
+        if Tl == 16384:
+            # on-device allclose vs a DENSE XLA oracle at ONE head (the
+            # dense T x T form at 12 heads would need 3 GB of f32 scores
+            # plus the backward's working set; 1 head keeps the oracle's
+            # footprint within budget)
+            q2, k2, v2 = (x[:, :1] for x in (ql, kl, vl))
+
+            def _xla_causal_attn(q, k, v):
+                s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                               k.astype(jnp.float32)) / np.sqrt(64)
+                tri = jnp.tril(jnp.ones((Tl, Tl), bool))
+                s = jnp.where(tri[None, None], s, -1e30)
+                p = jax.nn.softmax(s, axis=-1)
+                return jnp.einsum("bhqk,bhkd->bhqd", p,
+                                  v.astype(jnp.float32)).astype(q.dtype)
+
+            gref = jax.jit(jax.grad(lambda q, k, v: jnp.sum(
+                _xla_causal_attn(q, k, v).astype(jnp.float32) ** 2),
+                argnums=(0, 1, 2)))
+            ga = gl(q2, k2, v2)
+            gb = gref(q2, k2, v2)
+            for a, b in zip(ga, gb):
+                err = float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                            - b.astype(jnp.float32))))
+                assert err < 0.1, f"flash 16k bwd mismatch: {err}"
+            out["flash_16k_bwd_verified"] = True
         iters = 10
         t0 = time.perf_counter()
         for _ in range(iters):
             r = gl(ql, kl, vl)
         _drain(r[0])
         dt = (time.perf_counter() - t0) / iters
-        out["flash_8k_causal_grad_ms"] = round(dt * 1e3, 2)
-        out["flash_8k_tokens_per_sec"] = round(Tl / dt)
-        _log(f"[kernels] flash causal T=8192 fwd+bwd: {dt*1e3:.1f} ms "
-             f"({Tl/dt/1e3:.0f}k tokens/s single-sequence, 12 heads)")
+        out[f"{tag}_causal_grad_ms"] = round(dt * 1e3, 2)
+        out[f"{tag}_tokens_per_sec"] = round(Tl / dt)
+        _log(f"[kernels] flash causal T={Tl} fwd+bwd: {dt*1e3:.1f} ms "
+             f"({Tl/dt/1e3:.0f}k tokens/s single-sequence, {Hl} heads)")
     return out
 
 

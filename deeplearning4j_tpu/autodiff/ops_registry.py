@@ -228,7 +228,24 @@ def _strided_slice(a, begin=(), end=(), strides=None, begin_mask=0, end_mask=0,
     return a[tuple(idx)]
 
 
-register("gather")(lambda a, indices, axis=0: jnp.take(a, indices.astype(jnp.int32), axis=axis))
+@register("gather")
+def _gather(a, indices, axis=0):
+    idx = indices.astype(jnp.int32)
+    if (axis == 0 and a.ndim == 2 and a.shape[0] <= 16
+            and jnp.issubdtype(a.dtype, jnp.floating)):
+        # Tiny-table gather as a one-hot matmul (bit-exact: each output row
+        # is 1.0*row + 0.0*rest). The generic form's BACKWARD is a scatter
+        # with massively colliding indices for these tables (a BERT
+        # token-type lookup is 8192 updates onto 2 rows), which XLA:TPU
+        # lowers through a ~0.6 ms sort pipeline; the one-hot form's
+        # backward is a small dense matmul instead. Deviation: out-of-range
+        # ids produce a zero row here vs take()'s clamping.
+        oh = jax.nn.one_hot(idx, a.shape[0], dtype=a.dtype)
+        # HIGHEST precision: the default TPU matmul precision would
+        # bf16-round f32 table rows, breaking the bit-exactness claim
+        return jnp.einsum("...v,vd->...d", oh, a,
+                          precision=jax.lax.Precision.HIGHEST)
+    return jnp.take(a, idx, axis=axis)
 
 
 @register("gather_nd")

@@ -25,11 +25,14 @@ the backward uses to recompute P = exp(S - L) blockwise (never storing the
 Masking: a key-padding mask becomes an additive bias (0 / -1e30) of shape
 (batch, T_k, 1) streamed per batch row (the grid runs over batch*heads; the
 index map divides by heads so the bias is NOT materialised per head).
-Sequence-length ceiling: the BACKWARD kernels keep the full K/V (and Q/dO
-in the dkv pass) VMEM-resident per grid step — ~17 MB of scoped VMEM at
-T=16384, over the 16 MB limit, so fwd+bwd is supported to T=8192 at D=64
-(verified on v5e); the forward streams fine beyond that, and longer
-contexts shard across chips via ring attention (parallel/ring_attention).
+Sequence lengths: up to T=8192 the BACKWARD kernels keep the full K/V (dq
+pass) and Q/dO (dkv pass) VMEM-resident per grid step; past that
+(`BWD_CHUNK_THRESHOLD`) the round-5 CHUNKED backward kernels stream those
+operands through VMEM in `BWD_CHUNK`-row chunks over a third grid
+dimension, accumulating in f32 scratch that persists across the
+sequential minor grid steps — single-chip fwd+bwd verified at T=16384,
+D=64 on v5e. Longer contexts still shard across chips via ring attention
+(parallel/ring_attention).
 
 ``causal=True`` masks the upper triangle AND skips fully-masked key blocks:
 the forward/dq loops stop at the diagonal, the dk/dv loop starts there —
@@ -49,6 +52,7 @@ import os
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 BLOCK_Q = 512
 BLOCK_K = 512
@@ -336,7 +340,240 @@ def _bwd_dkv_kernel(*refs, scale: float, block_q: int, has_bias: bool,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
+# Above this sequence length the backward switches to the CHUNKED kernels:
+# the single-chunk forms keep full K/V (dq pass) and full Q/dO (dkv pass)
+# VMEM-resident per grid step, which blows the ~16 MB VMEM budget past
+# T=8192; the chunked forms stream those operands through VMEM in
+# BWD_CHUNK-row chunks via a third grid dimension, accumulating in f32
+# scratch that persists across the (sequential) minor grid steps.
+BWD_CHUNK_THRESHOLD = 8192
+BWD_CHUNK = 4096
+
+
+def _bwd_dq_kernel_chunked(*refs, scale: float, block_k: int,
+                           has_bias: bool, causal: bool, n_chunks: int):
+    """dq pass with K/V streamed in chunks: grid (bh, qi, ci); K/V blocks
+    are the ci-th chunk; dq accumulates in scratch, flushed at the last
+    chunk."""
+    if has_bias:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref = refs[:7]
+        dq_ref, acc_ref = refs[7], refs[8]
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+        bias_ref = None
+        dq_ref, acc_ref = refs[6], refs[7]
+    q = q_ref[0]
+    do = do_ref[0]
+    in_dtype = q.dtype
+    lse = lse_ref[0][:, 0]
+    delta = delta_ref[0][:, 0]
+    qi = pl.program_id(1)
+    ci = pl.program_id(2)
+    chunk_k = k_ref.shape[1]
+    nb = chunk_k // block_k
+    block_q = q.shape[0]
+
+    @pl.when(ci == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def body(i, dq_acc):
+        kb = ci * nb + i  # global key-block index (for the causal mask)
+        k_blk = k_ref[0, pl.ds(i * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(i * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if bias_ref is not None:
+            s = s + bias_ref[0, pl.ds(i * block_k, block_k), 0][None, :]
+        if causal:
+            s = _diag_mask(s, qi, kb, block_q, block_k)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[:, None]) * scale).astype(in_dtype)
+        return dq_acc + jax.lax.dot(ds, k_blk,
+                                    preferred_element_type=jnp.float32)
+
+    if causal:
+        hi_global = _causal_hi(qi, block_q, block_k)
+        nblk = jnp.clip(hi_global - ci * nb, 0, nb)
+    else:
+        nblk = nb
+    acc_ref[...] = jax.lax.fori_loop(0, nblk, body, acc_ref[...])
+
+    @pl.when(ci == n_chunks - 1)
+    def _flush():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel_chunked(*refs, scale: float, block_q: int,
+                            has_bias: bool, causal: bool, n_chunks: int):
+    """dk/dv pass with Q/dO/lse/delta streamed in chunks: grid
+    (bh, ki, ci); scratch accumulators flushed at the last chunk."""
+    if has_bias:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref = refs[:7]
+        dk_ref, dv_ref, dk_acc_ref, dv_acc_ref = refs[7:11]
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+        bias_ref = None
+        dk_ref, dv_ref, dk_acc_ref, dv_acc_ref = refs[6:10]
+    k = k_ref[0]
+    v = v_ref[0]
+    in_dtype = k.dtype
+    ki = pl.program_id(1)
+    ci = pl.program_id(2)
+    chunk_q = q_ref.shape[1]
+    nb = chunk_q // block_q
+    block_k = k.shape[0]
+    bias_col = (bias_ref[0, :, 0] if bias_ref is not None else None)
+
+    @pl.when(ci == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        qb = ci * nb + i  # global query-block index
+        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :]
+        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :]
+        lse_blk = lse_ref[0, pl.ds(i * block_q, block_q), :][:, 0]
+        delta_blk = delta_ref[0, pl.ds(i * block_q, block_q), :][:, 0]
+        s = jax.lax.dot_general(
+            q_blk, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if bias_col is not None:
+            s = s + bias_col[None, :]
+        if causal:
+            s = _diag_mask(s, qb, ki, block_q, block_k)
+        p = jnp.exp(s - lse_blk[:, None])
+        p_cast = p.astype(in_dtype)
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p_cast, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do_blk, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta_blk[:, None]) * scale).astype(in_dtype)
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    if causal:
+        lo_global = (ki * block_k) // block_q
+        lo = jnp.clip(lo_global - ci * nb, 0, nb)
+    else:
+        lo = 0
+    dk, dv = jax.lax.fori_loop(lo, nb, body,
+                               (dk_acc_ref[...], dv_acc_ref[...]))
+    dk_acc_ref[...] = dk
+    dv_acc_ref[...] = dv
+
+    @pl.when(ci == n_chunks - 1)
+    def _flush():
+        dk_ref[0] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_chunked(q, k, v, bias, out, lse, g, scale, causal, has_bias):
+    """Backward for T > BWD_CHUNK_THRESHOLD: same math as ``_flash_bwd``,
+    with the full-sequence operands streamed chunkwise (third grid dim)."""
+    b, h, t_q, d = q.shape
+    t_k = k.shape[2]
+    d_v = v.shape[-1]
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    qf = q.reshape(b * h, t_q, d)
+    kf = k.reshape(b * h, t_k, d)
+    vf = v.reshape(b * h, t_k, d_v)
+    dof = g.reshape(b * h, t_q, d_v)
+    lsef = lse
+    deltaf = jnp.broadcast_to(delta.reshape(b * h, t_q, 1),
+                              (b * h, t_q, RES_LANES))
+    block_q = _pick_block(t_q, BLOCK_Q)
+    block_k = _pick_block(t_k, BLOCK_K)
+
+    def _pick_chunk(t, block):
+        # largest multiple of `block` <= BWD_CHUNK that divides t (the
+        # kernels index sub-blocks inside the chunk, so block | chunk)
+        c = (BWD_CHUNK // block) * block
+        while c > block and t % c:
+            c -= block
+        return c
+
+    chunk_k = _pick_chunk(t_k, block_k)
+    chunk_q = _pick_chunk(t_q, block_q)
+    n_chunks_k = t_k // chunk_k
+    n_chunks_q = t_q // chunk_q
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, qi, ci: (bh, qi, 0)),
+        pl.BlockSpec((1, chunk_k, d), lambda bh, qi, ci: (bh, ci, 0)),
+        pl.BlockSpec((1, chunk_k, d_v), lambda bh, qi, ci: (bh, ci, 0)),
+        pl.BlockSpec((1, block_q, d_v), lambda bh, qi, ci: (bh, qi, 0)),
+        pl.BlockSpec((1, block_q, RES_LANES), lambda bh, qi, ci: (bh, qi, 0)),
+        pl.BlockSpec((1, block_q, RES_LANES), lambda bh, qi, ci: (bh, qi, 0)),
+    ]
+    args = [qf, kf, vf, dof, lsef, deltaf]
+    if has_bias:
+        in_specs.append(
+            pl.BlockSpec((1, chunk_k, 1), lambda bh, qi, ci: (bh // h, ci, 0)))
+        args.append(bias)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel_chunked, scale=scale,
+                          block_k=block_k, has_bias=has_bias, causal=causal,
+                          n_chunks=n_chunks_k),
+        out_shape=jax.ShapeDtypeStruct((b * h, t_q, d), q.dtype),
+        grid=(b * h, t_q // block_q, n_chunks_k),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ci: (bh, qi, 0)),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(*args)
+
+    in_specs_kv = [
+        pl.BlockSpec((1, chunk_q, d), lambda bh, ki, ci: (bh, ci, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, ki, ci: (bh, ki, 0)),
+        pl.BlockSpec((1, block_k, d_v), lambda bh, ki, ci: (bh, ki, 0)),
+        pl.BlockSpec((1, chunk_q, d_v), lambda bh, ki, ci: (bh, ci, 0)),
+        pl.BlockSpec((1, chunk_q, RES_LANES), lambda bh, ki, ci: (bh, ci, 0)),
+        pl.BlockSpec((1, chunk_q, RES_LANES), lambda bh, ki, ci: (bh, ci, 0)),
+    ]
+    args_kv = [qf, kf, vf, dof, lsef, deltaf]
+    if has_bias:
+        in_specs_kv.append(
+            pl.BlockSpec((1, block_k, 1), lambda bh, ki, ci: (bh // h, ki, 0)))
+        args_kv.append(bias)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel_chunked, scale=scale,
+                          block_q=block_q, has_bias=has_bias, causal=causal,
+                          n_chunks=n_chunks_q),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t_k, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, t_k, d_v), v.dtype),
+        ],
+        grid=(b * h, t_k // block_k, n_chunks_q),
+        in_specs=in_specs_kv,
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, ki, ci: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d_v), lambda bh, ki, ci: (bh, ki, 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d_v), jnp.float32)],
+        interpret=_interpret(),
+    )(*args_kv)
+
+    return (dq.reshape(b, h, t_q, d), dk.reshape(b, h, t_k, d),
+            dv.reshape(b, h, t_k, d_v))
+
+
 def _flash_bwd(q, k, v, bias, out, lse, g, scale, causal, has_bias):
+    if max(q.shape[2], k.shape[2]) > BWD_CHUNK_THRESHOLD:
+        return _flash_bwd_chunked(q, k, v, bias, out, lse, g, scale,
+                                  causal, has_bias)
     b, h, t_q, d = q.shape
     t_k = k.shape[2]
     d_v = v.shape[-1]

@@ -1,0 +1,134 @@
+"""Convergence goldens (VERDICT r4 item 8): training QUALITY targets per
+flagship config, locking learning dynamics against regression the way
+bench.py locks throughput. Reference: SURVEY §4's golden-file philosophy +
+BASELINE.json's loss-parity goal.
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+def test_lenet_mnist_accuracy_golden():
+    """BASELINE config #1: LeNet on (offline synthetic) MNIST must reach
+    >= 0.99 test accuracy — not merely 'learns something'."""
+    from deeplearning4j_tpu.data import MnistDataSetIterator
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn import (ConvolutionLayer, DenseLayer,
+                                       InputType, NeuralNetConfiguration,
+                                       OutputLayer, SubsamplingLayer)
+    from deeplearning4j_tpu.train import Adam
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(123)
+            .updater(Adam(1e-3))
+            .list()
+            .layer(ConvolutionLayer(n_out=20, kernel_size=(5, 5),
+                                    activation="relu"))
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(ConvolutionLayer(n_out=50, kernel_size=(5, 5),
+                                    activation="relu"))
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=500, activation="relu"))
+            .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional_flat(28, 28, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(MnistDataSetIterator(batch_size=64, num_examples=4096), epochs=3)
+    ev = net.evaluate(MnistDataSetIterator(batch_size=256, train=False,
+                                           num_examples=1024))
+    assert ev.accuracy() >= 0.99, ev.stats()
+
+
+def test_char_rnn_bits_per_char_golden():
+    """BASELINE config #3: a GravesLSTM char model on repetitive text must
+    compress well below the uniform-entropy baseline — the quality analog
+    of the tokens/s bench row."""
+    from deeplearning4j_tpu.zoo import TextGenerationLSTM
+
+    corpus = ("the quick brown fox jumps over the lazy dog. "
+              "pack my box with five dozen liquor jugs. ") * 60
+    chars = sorted(set(corpus))
+    vocab = len(chars)
+    idx = {c: i for i, c in enumerate(chars)}
+    ids = np.array([idx[c] for c in corpus])
+
+    net = TextGenerationLSTM(vocab_size=vocab, hidden=128, layers=1,
+                             tbptt_length=32, graves=True).init()
+    B, T = 16, 64
+    rng = np.random.default_rng(0)
+    starts = rng.integers(0, len(ids) - T - 1, B * 6)
+    final_scores = []
+    for epoch in range(18):
+        for b in range(0, len(starts), B):
+            s = starts[b:b + B]
+            seq = np.stack([ids[i:i + T + 1] for i in s])
+            x = np.eye(vocab, dtype=np.float32)[seq[:, :-1]]
+            y = np.eye(vocab, dtype=np.float32)[seq[:, 1:]]
+            net.fit(x, y, epochs=1)
+        final_scores.append(net.score())
+    # score is mean cross-entropy in nats/char; the corpus is two repeated
+    # pangrams (vocab ~28 -> uniform = log2(28) = 4.8 bits). A learning
+    # model must get well under 2 bits/char; a broken one sits near 4+.
+    bits_per_char = final_scores[-1] / np.log(2.0)
+    assert bits_per_char < 2.0, f"{bits_per_char:.2f} bits/char"
+
+
+def test_imported_bert_finetune_accuracy_golden():
+    """BASELINE config #4's QUALITY check: a TF-imported (tiny) BERT with a
+    grafted head must fine-tune to >= 0.95 on a separable synthetic
+    2-class task — import, graft, convert-to-variable, sd.fit end-to-end."""
+    from deeplearning4j_tpu.autodiff.samediff import TrainingConfig
+    from deeplearning4j_tpu.imports import TFGraphMapper
+    from deeplearning4j_tpu.imports.tf_oracles import (build_bert_graphdef,
+                                                       graft_classifier)
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    B, T, V, H = 16, 16, 64, 32
+    gd, inputs, _, _ = build_bert_graphdef(
+        batch=B, seq_len=T, hidden=H, layers=2, heads=2, intermediate=64,
+        vocab=V, seed=3)
+    sd = TFGraphMapper.import_graph(gd)
+    graft_classifier(sd, "pooled_output", hidden=H, n_classes=2)
+    sd.convert_to_variable(*sd.trainable_float_constants())
+    sd.set_loss_variables("finetune_loss")
+    sd.set_training_config(TrainingConfig(
+        updater=Adam(1e-3), data_set_feature_mapping=list(inputs),
+        data_set_label_mapping=["labels"]))
+
+    rng = np.random.default_rng(0)
+
+    def make_batch(n):
+        # class 0 draws tokens from the lower half of the vocab, class 1
+        # from the upper half — separable from the pooled representation
+        y = rng.integers(0, 2, n)
+        lo = rng.integers(2, V // 2, (n, T))
+        hi = rng.integers(V // 2, V, (n, T))
+        ids = np.where(y[:, None] == 1, hi, lo).astype(np.int32)
+        types = np.zeros((n, T), np.int32)
+        mask = np.ones((n, T), np.int32)
+        labels = np.eye(2, dtype=np.float32)[y]
+        return ids, types, mask, labels
+
+    from deeplearning4j_tpu.data.dataset import MultiDataSet
+    from deeplearning4j_tpu.data.iterators import ExistingDataSetIterator
+    batches = []
+    for _ in range(12):
+        ids, types, mask, labels = make_batch(B)
+        batches.append(MultiDataSet(features=[ids, types, mask],
+                                    labels=[labels]))
+    sd.fit(ExistingDataSetIterator(batches), epochs=8)
+
+    # the frozen graph bakes batch=B into its reshapes: evaluate in
+    # B-sized batches
+    hits, total = 0, 0
+    for _ in range(4):
+        ids, types, mask, labels = make_batch(B)
+        logits = np.asarray(sd.output(
+            {inputs[0]: ids, inputs[1]: types, inputs[2]: mask},
+            "cls_logits"))
+        hits += int((logits.argmax(-1) == labels.argmax(-1)).sum())
+        total += B
+    acc = hits / total
+    assert acc >= 0.95, f"fine-tune accuracy {acc}"

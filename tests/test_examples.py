@@ -36,4 +36,5 @@ def test_example_runs(script, monkeypatch, tmp_path):
     try:
         runpy.run_path(str(script), run_name="__main__")
     finally:
+        env.__dict__.clear()
         env.__dict__.update(saved)

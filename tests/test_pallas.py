@@ -56,6 +56,51 @@ def test_flash_attention_gradients():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-4)
 
 
+def test_flash_attention_chunked_backward_matches_reference(monkeypatch):
+    """The long-context CHUNKED backward kernels (round 5: stream Q/dO and
+    K/V through VMEM over a third grid dim with scratch accumulators) must
+    match the XLA oracle — exercised by lowering the chunk sizes so a
+    small T runs multiple chunks, incl. accumulate/flush and the causal
+    chunk-skip arithmetic."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops.pallas import flash_attention as fa
+
+    monkeypatch.setattr(fa, "BWD_CHUNK_THRESHOLD", 256)
+    monkeypatch.setattr(fa, "BWD_CHUNK", 512)
+    rng = np.random.default_rng(7)
+    B, H, T, D = 1, 2, 1024, 64  # 1024 rows -> 2 chunks of 512
+    q = jnp.asarray(rng.normal(0, 1, (B, H, T, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(0, 1, (B, H, T, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(0, 1, (B, H, T, D)).astype(np.float32))
+    mask = jnp.asarray((rng.random((B, T)) > 0.2).astype(np.float32))
+
+    for causal, m in ((False, None), (True, None), (False, mask)):
+        def loss_flash(q, k, v):
+            return jnp.sum(fa.flash_attention(q, k, v, mask=m,
+                                              causal=causal) ** 2)
+
+        def loss_ref(q, k, v):
+            d = q.shape[-1]
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(
+                jnp.asarray(d, jnp.float32))
+            if m is not None:
+                s = jnp.where(m[:, None, None, :].astype(bool), s, -1e30)
+            if causal:
+                tq = s.shape[2]
+                tri = jnp.tril(jnp.ones((tq, tq), bool))
+                s = jnp.where(tri[None, None], s, -1e30)
+            w = jax.nn.softmax(s, axis=-1)
+            return jnp.sum(jnp.einsum("bhqk,bhkd->bhqd", w, v) ** 2)
+
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=1e-4,
+                                       err_msg=f"causal={causal} mask={m is not None}")
+
+
 def test_incompatible_shapes_fall_back():
     import jax.numpy as jnp
     from deeplearning4j_tpu.ops.pallas.flash_attention import flash_attention_compatible
