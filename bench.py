@@ -923,6 +923,15 @@ def main():
     # BERT keeps ~2 GB of HBM alive) that was measured to cost ResNet >2x.
     imgs_per_sec = bench_resnet()
     extra["resnet50_images_per_sec"] = round(imgs_per_sec, 2)
+    # Round-5 megakernel experiment verdict (VERDICT r4 item 2; full
+    # numbers in BASELINE.md round-5 table): measured, negative.
+    extra["resnet_megakernel_experiment"] = (
+        "negative (round 5): Pallas 1x1-conv+BN-stats at the stage-4 "
+        "anchor shape is 8-13% SLOWER than XLA's emitter (0.149-0.159 vs "
+        "0.138 ms, XLA ~97% of bf16 peak); whole-block VMEM residency "
+        "does not fit at batch 256 even at stage 4, and training-BN "
+        "batch stats force full materialization of each conv output — "
+        "the ~2786 img/s roofline ceiling at current traffic stands")
     gc.collect()
     try:
         extra.update(bench_zoo_bert())

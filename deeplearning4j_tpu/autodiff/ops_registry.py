@@ -233,13 +233,16 @@ def _gather(a, indices, axis=0):
     idx = indices.astype(jnp.int32)
     if (axis == 0 and a.ndim == 2 and a.shape[0] <= 16
             and jnp.issubdtype(a.dtype, jnp.floating)):
-        # Tiny-table gather as a one-hot matmul (bit-exact: each output row
-        # is 1.0*row + 0.0*rest). The generic form's BACKWARD is a scatter
-        # with massively colliding indices for these tables (a BERT
-        # token-type lookup is 8192 updates onto 2 rows), which XLA:TPU
-        # lowers through a ~0.6 ms sort pipeline; the one-hot form's
-        # backward is a small dense matmul instead. Deviation: out-of-range
-        # ids produce a zero row here vs take()'s clamping.
+        # Tiny-table gather as a one-hot matmul (bit-exact for in-range
+        # ids: each output row is 1.0*row + 0.0*rest at HIGHEST
+        # precision). The generic form's BACKWARD is a scatter with
+        # massively colliding indices for these tables (a BERT token-type
+        # lookup is 8192 updates onto 2 rows), which XLA:TPU lowers
+        # through a ~0.6 ms sort pipeline; the one-hot form's backward is
+        # a small dense matmul instead. Deviation for INVALID ids only:
+        # this path yields an all-zero row, where jit-compiled take()
+        # wraps negative ids pythonically and fill-NaNs ids >= V — both
+        # out-of-contract for embedding lookups.
         oh = jax.nn.one_hot(idx, a.shape[0], dtype=a.dtype)
         # HIGHEST precision: the default TPU matmul precision would
         # bf16-round f32 table rows, breaking the bit-exactness claim

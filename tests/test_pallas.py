@@ -75,7 +75,8 @@ def test_flash_attention_chunked_backward_matches_reference(monkeypatch):
     v = jnp.asarray(rng.normal(0, 1, (B, H, T, D)).astype(np.float32))
     mask = jnp.asarray((rng.random((B, T)) > 0.2).astype(np.float32))
 
-    for causal, m in ((False, None), (True, None), (False, mask)):
+    for causal, m in ((False, None), (True, None), (False, mask),
+                      (True, mask)):
         def loss_flash(q, k, v):
             return jnp.sum(fa.flash_attention(q, k, v, mask=m,
                                               causal=causal) ** 2)
