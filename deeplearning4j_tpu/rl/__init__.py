@@ -16,14 +16,16 @@ TPU-native redesign (SURVEY.md §7.1 — capability, not translation):
 - n-step returns are computed with a scan inside the jitted update.
 """
 
-from deeplearning4j_tpu.rl.mdp import MDP, CartPole, DiscreteSpace, GridWorld, ObservationSpace
+from deeplearning4j_tpu.rl.mdp import (MDP, CartPole, DiscreteSpace, GridWorld,
+                                        GymEnv, ObservationSpace)
 from deeplearning4j_tpu.rl.replay import ExpReplay, Transition
 from deeplearning4j_tpu.rl.policy import BoltzmannPolicy, EpsGreedy, GreedyPolicy
 from deeplearning4j_tpu.rl.qlearning import QLearningConfiguration, QLearningDiscreteDense
 from deeplearning4j_tpu.rl.a2c import A2CConfiguration, AdvantageActorCritic
 
 __all__ = [
-    "MDP", "CartPole", "GridWorld", "DiscreteSpace", "ObservationSpace",
+    "MDP", "CartPole", "GridWorld", "GymEnv", "DiscreteSpace",
+    "ObservationSpace",
     "ExpReplay", "Transition", "EpsGreedy", "GreedyPolicy", "BoltzmannPolicy",
     "QLearningConfiguration", "QLearningDiscreteDense",
     "A2CConfiguration", "AdvantageActorCritic",

@@ -124,3 +124,67 @@ class GridWorld(MDP):
 
     def optimal_return(self) -> float:
         return 1.0 - 0.01 * (self.n - 2)
+
+
+class GymEnv(MDP):
+    """Adapter for Gym/Gymnasium-API environments (reference
+    ``rl4j-gym``'s ``GymEnv`` over gym-java-client): wraps any object
+    exposing ``reset()``/``step(a)`` with either the classic 4-tuple or
+    the gymnasium 5-tuple return, and ``observation_space``/
+    ``action_space`` with ``shape``/``n``. Pass an environment id to have
+    it constructed via ``gymnasium`` (or legacy ``gym``) if installed —
+    this box is offline, so the in-repo tests drive the adapter with a
+    stub environment instead."""
+
+    def __init__(self, env_or_id):
+        if isinstance(env_or_id, str):
+            try:
+                import gymnasium as _gym
+            except ImportError:
+                try:
+                    import gym as _gym  # legacy API
+                except ImportError:
+                    raise ImportError(
+                        "GymEnv('<id>') needs gymnasium or gym installed; "
+                        "pass a constructed env object instead") from None
+            env_or_id = _gym.make(env_or_id)
+        self.env = env_or_id
+        obs_space = self.env.observation_space
+        self.observation_space = ObservationSpace(
+            tuple(obs_space.shape),
+            float(np.min(obs_space.low)) if hasattr(obs_space, "low") else None,
+            float(np.max(obs_space.high)) if hasattr(obs_space, "high") else None)
+        self.action_space = DiscreteSpace(int(self.env.action_space.n))
+        self._done = True
+
+    def reset(self) -> np.ndarray:
+        out = self.env.reset()
+        # gymnasium returns (obs, info); classic gym returns obs
+        obs = out[0] if isinstance(out, tuple) else out
+        self._done = False
+        return np.asarray(obs, np.float32)
+
+    def step(self, action: int):
+        out = self.env.step(int(action))
+        if len(out) == 5:  # gymnasium: obs, reward, terminated, truncated, info
+            obs, reward, terminated, truncated, info = out
+            done = bool(terminated or truncated)
+            # The MDP SPI carries one done bit (reference-era RL4J API),
+            # but the terminated/truncated distinction matters for TD
+            # bootstrapping (a time-limit truncation is NOT a terminal
+            # state) — preserve it in info and on the adapter so learners
+            # that know about it can keep the gamma*maxQ(s') term.
+            info = dict(info or {})
+            info.setdefault("terminated", bool(terminated))
+            info.setdefault("truncated", bool(truncated))
+            self.last_truncated = bool(truncated) and not bool(terminated)
+        else:  # classic gym: obs, reward, done, info
+            obs, reward, done, info = out
+            done = bool(done)
+            self.last_truncated = False
+        self._done = done
+        return np.asarray(obs, np.float32), float(reward), done, info
+
+    def close(self) -> None:
+        if hasattr(self.env, "close"):
+            self.env.close()

@@ -75,3 +75,85 @@ def test_a2c_improves_on_gridworld():
     score = learner.play()
     assert score >= env.optimal_return() - 0.2, (
         f"a2c return {score} too far below optimal {env.optimal_return()}")
+
+
+class _StubSpace:
+    def __init__(self, shape=None, n=None, low=None, high=None):
+        self.shape, self.n, self.low, self.high = shape, n, low, high
+
+
+class _StubGymnasiumCorridor:
+    """Gymnasium-API (5-tuple step, (obs, info) reset) corridor identical
+    to GridWorld(n=5) — drives GymEnv without the offline-unavailable
+    gymnasium package, the way rl4j tests stub gym-java-client."""
+
+    def __init__(self, n=5):
+        self.n = n
+        self.observation_space = _StubSpace(shape=(n,),
+                                            low=np.zeros(n), high=np.ones(n))
+        self.action_space = _StubSpace(n=2)
+        self._pos = 0
+        self._steps = 0
+        self.closed = False
+
+    def _obs(self):
+        v = np.zeros(self.n, np.float64)  # adapter must cast to f32
+        v[self._pos] = 1.0
+        return v
+
+    def reset(self):
+        self._pos, self._steps = 0, 0
+        return self._obs(), {"info": True}
+
+    def step(self, a):
+        self._pos = min(self.n - 1, self._pos + 1) if a == 1 else max(0, self._pos - 1)
+        self._steps += 1
+        terminated = self._pos == self.n - 1
+        truncated = self._steps >= 4 * self.n
+        r = 1.0 if terminated else -0.01
+        return self._obs(), r, terminated, truncated, {}
+
+    def close(self):
+        self.closed = True
+
+
+class _StubClassicGymCorridor(_StubGymnasiumCorridor):
+    """Classic-gym API: reset() -> obs, step() -> 4-tuple."""
+
+    def reset(self):
+        return super().reset()[0]
+
+    def step(self, a):
+        obs, r, terminated, truncated, info = super().step(a)
+        return obs, r, terminated or truncated, info
+
+
+def test_gym_adapter_both_apis():
+    from deeplearning4j_tpu.rl import GymEnv
+    for stub_cls in (_StubGymnasiumCorridor, _StubClassicGymCorridor):
+        env = GymEnv(stub_cls())
+        assert env.observation_space.shape == (5,)
+        assert env.action_space.n == 2
+        obs = env.reset()
+        assert obs.dtype == np.float32 and obs.shape == (5,)
+        total, done = 0.0, False
+        while not done:
+            obs, r, done, info = env.step(1)
+            total += r
+        assert abs(total - (1.0 - 0.01 * 3)) < 1e-6, total
+        env.close()
+        assert env.env.closed
+
+
+def test_dqn_trains_through_gym_adapter():
+    """The full rl4j-style loop (replay, target net, eps-greedy) runs over
+    the gym-API adapter and solves the corridor."""
+    from deeplearning4j_tpu.rl import GymEnv
+    env = GymEnv(_StubGymnasiumCorridor(n=5))
+    conf = QLearningConfiguration(
+        seed=7, max_step=1200, max_epoch_step=40, batch_size=32,
+        exp_rep_max_size=2000, target_dqn_update_freq=100, update_start=32,
+        min_epsilon=0.05, epsilon_nb_step=600, gamma=0.95, double_dqn=True)
+    learner = QLearningDiscreteDense(env, conf, hidden=(32,))
+    learner.train()
+    assert learner.play() >= (1.0 - 0.01 * 3) - 1e-6
