@@ -156,12 +156,14 @@ class GymEnv(MDP):
             float(np.max(obs_space.high)) if hasattr(obs_space, "high") else None)
         self.action_space = DiscreteSpace(int(self.env.action_space.n))
         self._done = True
+        self.last_truncated = False
 
     def reset(self) -> np.ndarray:
         out = self.env.reset()
         # gymnasium returns (obs, info); classic gym returns obs
         obs = out[0] if isinstance(out, tuple) else out
         self._done = False
+        self.last_truncated = False
         return np.asarray(obs, np.float32)
 
     def step(self, action: int):
@@ -181,7 +183,10 @@ class GymEnv(MDP):
         else:  # classic gym: obs, reward, done, info
             obs, reward, done, info = out
             done = bool(done)
-            self.last_truncated = False
+            # classic gym signals time-limit truncation via the TimeLimit
+            # wrapper's info key (no 5-tuple)
+            self.last_truncated = bool(
+                (info or {}).get("TimeLimit.truncated", False))
         self._done = done
         return np.asarray(obs, np.float32), float(reward), done, info
 

@@ -131,3 +131,33 @@ def test_tokenizer_preprocess():
     tf.set_token_pre_processor(TokenPreProcess())
     toks = tf.create("Hello, World! (test)").get_tokens()
     assert toks == ["hello", "world", "test"]
+
+
+def test_word2vec_grouped_dispatch_matches_single():
+    """Word2Vec.fit with dispatch_unroll=4 (the fori-grouped _ns_step_group
+    path, incl. a ragged tail batch) must produce the same tables as
+    per-batch dispatch."""
+    import numpy as np
+    from deeplearning4j_tpu.nlp import Word2Vec
+    from deeplearning4j_tpu.runtime.environment import get_environment
+
+    sents = ["the quick brown fox jumps over the lazy dog",
+             "pack my box with five dozen liquor jugs",
+             "the five boxing wizards jump quickly"] * 6
+
+    def run(unroll):
+        env = get_environment()
+        prev = env.dispatch_unroll
+        try:
+            env.set_dispatch_unroll(unroll)
+            w2v = Word2Vec(layer_size=16, min_word_frequency=1, epochs=2,
+                           seed=3, batch_size=32)
+            w2v.fit(sents)
+            return np.asarray(w2v.emb_in), np.asarray(w2v.emb_out)
+        finally:
+            env.dispatch_unroll = prev
+
+    a_in, a_out = run(1)
+    b_in, b_out = run(4)
+    np.testing.assert_array_equal(a_in, b_in)
+    np.testing.assert_array_equal(a_out, b_out)
