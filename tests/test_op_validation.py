@@ -1007,3 +1007,24 @@ def test_ctc_loss_matches_torch():
         torch.tensor(il.astype(np.int64)), torch.tensor(ll.astype(np.int64)),
         blank=0, reduction="none")
     np.testing.assert_allclose(ours, float(ref.mean()), rtol=1e-5)
+
+
+def test_fusable_erf_accuracy():
+    """The rational erf behind gelu_exact_recompute (round 5: XLA:TPU's
+    builtin erf expands to a fusion-blocking ~30-op polynomial) must stay
+    within Abramowitz-Stegun 7.1.26's error budget — far below bf16
+    rounding and the 1e-5 import-golden tolerance."""
+    from deeplearning4j_tpu.ops.activations import (_fusable_erf,
+                                                    gelu_exact_recompute)
+
+    x = jnp.linspace(-9.0, 9.0, 100001).astype(jnp.float32)
+    err_erf = float(jnp.max(jnp.abs(
+        _fusable_erf(x) - jax.scipy.special.erf(x))))
+    assert err_erf < 5e-6, err_erf
+    ref = jax.nn.gelu(x, approximate=False)
+    err_gelu = float(jnp.max(jnp.abs(gelu_exact_recompute(x) - ref)))
+    assert err_gelu < 2e-6, err_gelu
+    g1 = jax.grad(lambda v: jnp.sum(gelu_exact_recompute(v)))(x)
+    g2 = jax.grad(lambda v: jnp.sum(jax.nn.gelu(v, approximate=False)))(x)
+    err_grad = float(jnp.max(jnp.abs(g1 - g2)))
+    assert err_grad < 5e-6, err_grad
