@@ -1,0 +1,73 @@
+"""Long-context attention, single-chip and sharded.
+
+Two complementary paths for sequences far past the dense (T, T) wall:
+
+1. Single-chip: Pallas flash attention with the round-5 chunked backward —
+   fwd+bwd at T=16384 on one v5e (40 ms causal, 12 heads; the dense score
+   matrix alone would be 6 GB). Blockwise softmax never materialises
+   scores; the backward streams Q/dO and K/V through VMEM in chunks.
+2. Multi-chip: ring attention over a `jax.sharding.Mesh` sequence axis —
+   each device holds a T/n shard and K/V blocks rotate around the ring
+   (`jax.lax.ppermute` over ICI), extending context linearly with chips.
+
+Run on CPU (8 virtual devices, tiny sizes are auto-selected):
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        PYTHONPATH=.. python long_context_attention.py
+"""
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+SMOKE = os.environ.get("DL4J_TPU_EXAMPLES_SMOKE") == "1"
+on_cpu = jax.devices()[0].platform == "cpu"
+if on_cpu:
+    # CPU has no Mosaic backend: run the Pallas kernels interpreted
+    os.environ.setdefault("DL4J_TPU_PALLAS_INTERPRET", "1")
+
+# ---- 1. single-chip flash attention, fwd+bwd ------------------------------
+from deeplearning4j_tpu.ops.pallas.flash_attention import (
+    flash_attention, flash_attention_compatible)
+
+H, D = (2, 64) if on_cpu else (12, 64)
+T = 512 if (SMOKE or on_cpu) else 16384
+rng = np.random.default_rng(0)
+dt = jnp.float32 if on_cpu else jnp.bfloat16
+q = jnp.asarray(rng.normal(0, 1, (1, H, T, D)), dt)
+k = jnp.asarray(rng.normal(0, 1, (1, H, T, D)), dt)
+v = jnp.asarray(rng.normal(0, 1, (1, H, T, D)), dt)
+
+assert flash_attention_compatible(q, k, v, causal=True)
+grad_fn = jax.jit(jax.grad(
+    lambda a, b, c: jnp.sum(
+        flash_attention(a, b, c, causal=True).astype(jnp.float32) ** 2),
+    argnums=(0, 1, 2)))
+dq, dk, dv = grad_fn(q, k, v)
+print(f"flash causal T={T}: dq norm "
+      f"{float(jnp.linalg.norm(dq.astype(jnp.float32))):.3f}")
+
+# ---- 2. ring attention over a sequence-sharded mesh -----------------------
+from jax.sharding import Mesh
+from deeplearning4j_tpu.parallel.ring_attention import (
+    sequence_parallel_attention)
+
+n = jax.device_count()
+mesh = Mesh(np.array(jax.devices()), ("sp",))
+Tg = 8 * n * 16  # global context, divisible by the ring
+qg = jnp.asarray(rng.normal(0, 1, (1, 2, Tg, 32)), jnp.float32)
+kg = jnp.asarray(rng.normal(0, 1, (1, 2, Tg, 32)), jnp.float32)
+vg = jnp.asarray(rng.normal(0, 1, (1, 2, Tg, 32)), jnp.float32)
+out = sequence_parallel_attention(qg, kg, vg, mesh, causal=False,
+                                  seq_axis="sp")
+
+# oracle: dense softmax attention on one device
+s = jnp.einsum("bhqd,bhkd->bhqk", qg, kg) / np.sqrt(32)
+ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), vg)
+err = float(jnp.max(jnp.abs(out - ref)))
+print(f"ring attention over {n} devices, T={Tg}: max err vs dense {err:.2e}")
+# TPU matmuls default to bf16 MXU passes, so ring-vs-dense agreement is at
+# bf16 rounding there; CPU computes exact f32
+assert err < (1e-4 if on_cpu else 5e-3)
+print("long-context attention example: OK")

@@ -27,14 +27,19 @@ def test_all_examples_enumerated():
 def test_example_runs(script, monkeypatch, tmp_path):
     monkeypatch.setenv("DL4J_TPU_EXAMPLES_SMOKE", "1")
     monkeypatch.chdir(tmp_path)  # artifacts the scripts write land here
-    # Examples mutate the process-wide Environment (e.g. allow_bfloat16);
-    # snapshot and restore so one example's policy can't leak into the
-    # rest of the suite.
+    # Examples mutate the process-wide Environment (e.g. allow_bfloat16)
+    # and may set env vars (e.g. the Pallas interpret flag); snapshot and
+    # restore both so one example's policy can't leak into the rest of
+    # the suite.
+    import os
     from deeplearning4j_tpu.runtime.environment import get_environment
     env = get_environment()
     saved = copy.copy(env.__dict__)
+    saved_osenv = dict(os.environ)
     try:
         runpy.run_path(str(script), run_name="__main__")
     finally:
         env.__dict__.clear()
         env.__dict__.update(saved)
+        os.environ.clear()
+        os.environ.update(saved_osenv)
