@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.parallel.sharding import ShardingStrategy, shard_batch, shard_train_state
-from deeplearning4j_tpu.runtime.mesh import create_mesh
+from deeplearning4j_tpu.runtime.mesh import DATA_AXIS, MODEL_AXIS, create_mesh
 from deeplearning4j_tpu.train.listeners import PerformanceListener
 
 
@@ -71,7 +71,14 @@ class ParallelWrapper:
             devs = jax.devices()
             if self._workers:
                 devs = devs[: self._workers]
-            mesh = create_mesh(devices_=devs)
+            if self._strategy_name == "tensor_parallel":
+                # TP needs a `model` mesh axis; default to all devices on
+                # it (Megatron single-node style). Build an explicit
+                # data x model ShardingStrategy for hybrid DPxTP.
+                mesh = create_mesh({DATA_AXIS: 1, MODEL_AXIS: -1},
+                                   devices_=devs)
+            else:
+                mesh = create_mesh(devices_=devs)
             factory = {
                 "data_parallel": ShardingStrategy.data_parallel,
                 "fsdp": ShardingStrategy.fsdp,

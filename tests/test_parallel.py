@@ -64,6 +64,23 @@ def test_fsdp_trains():
     assert np.isfinite(net.score())
 
 
+def test_tensor_parallel_builder_trains():
+    """`.strategy("tensor_parallel").build()` must construct a mesh WITH a
+    `model` axis itself (round-5 fix: the builder handed the TP strategy a
+    data-only mesh and crashed with KeyError 'model') and train a
+    transformer whose W_q/W_ff1 columns and W_o/W_ff2 rows shard over it."""
+    from deeplearning4j_tpu.zoo import Bert
+    net = Bert.small(vocab_size=100).init()
+    pw = ParallelWrapper.builder(net).strategy("tensor_parallel").build()
+    assert pw.strategy.mesh.shape["model"] == 8
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 100, (16, 8)).astype(np.int32)
+    labels = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+    it = NumpyDataSetIterator(ids, labels, batch_size=16)
+    pw.fit(it, epochs=1)
+    assert np.isfinite(net.score())
+
+
 def test_batch_not_divisible_raises():
     from deeplearning4j_tpu.parallel.sharding import shard_batch
     strat = ShardingStrategy.data_parallel(create_mesh())
