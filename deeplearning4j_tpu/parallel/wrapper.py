@@ -91,12 +91,64 @@ class ParallelWrapper:
         return ParallelWrapper.Builder(model)
 
     # -- training --
+    def _check_supported(self):
+        """ParallelWrapper drives the model's PLAIN jitted SGD step; modes
+        the model's own fit() special-cases (tBPTT chunking, legacy
+        solvers) would silently train with different gradients here — so
+        refuse loudly instead."""
+        conf = getattr(self.model, "conf", None)
+        gc = getattr(conf, "global_conf", None)
+        algo = getattr(gc, "optimization_algo",
+                       "STOCHASTIC_GRADIENT_DESCENT") or \
+            "STOCHASTIC_GRADIENT_DESCENT"
+        if algo != "STOCHASTIC_GRADIENT_DESCENT":
+            raise NotImplementedError(
+                f"ParallelWrapper supports optimization_algo=SGD only "
+                f"(got {algo!r}); legacy solvers run single-context via "
+                "the model's own fit()")
+        if getattr(conf, "tbptt_fwd_length", None):
+            raise NotImplementedError(
+                "tBPTT training under ParallelWrapper is not supported — "
+                "the wrapper would run full-sequence BPTT instead of the "
+                "model's tBPTT chunking; use the model's own fit(), or "
+                "full-sequence BPTT (unset tbptt_fwd_length) to train "
+                "sharded")
+
     def _ensure_sharded(self):
+        self._check_supported()
         if self.model.train_state is None:
             self.model.init()
         if not self._sharded:
             self.model.train_state = shard_train_state(self.model.train_state, self.strategy)
             self._sharded = True
+
+    def _run_step(self, step_fn, batch):
+        """One sharded train step, dispatching on the wrapped model's step
+        signature: MultiLayerNetwork takes (ts, x, y, rng, fmask, lmask);
+        ComputationGraph takes (ts, inputs_dict, labels_list, rng, masks)
+        — both are wrapped by the reference ParallelWrapper too."""
+        model = self.model
+        rng = model.rng.next_key()
+        if hasattr(model, "_coerce_batch"):  # ComputationGraph
+            inputs, labels_, masks = model._coerce_batch(batch)
+            inputs = {k: shard_batch(self.strategy, v)
+                      for k, v in inputs.items()}
+            labels_ = [shard_batch(self.strategy, l) for l in labels_]
+            if masks is not None:
+                masks = {k: (None if m is None
+                             else shard_batch(self.strategy, m))
+                         for k, m in masks.items()}
+            model.train_state, loss = step_fn(
+                model.train_state, inputs, labels_, rng, masks)
+            n = next(iter(inputs.values())).shape[0]
+            return loss, n
+        x = jnp.asarray(batch.features)
+        y = jnp.asarray(batch.labels)
+        fm = None if batch.features_mask is None else jnp.asarray(batch.features_mask)
+        lm = jnp.asarray(batch.labels_mask) if batch.labels_mask is not None else (fm if y.ndim == 3 else None)
+        x, y, fm, lm = shard_batch(self.strategy, x, y, fm, lm)
+        model.train_state, loss = step_fn(model.train_state, x, y, rng, fm, lm)
+        return loss, x.shape[0]
 
     def fit(self, iterator, epochs: int = 1):
         """Distributed fit: same listener/epoch semantics as the wrapped
@@ -110,18 +162,12 @@ class ParallelWrapper:
                     lst.on_epoch_start(model, model._epoch)
                 iterator.reset()
                 for batch in iterator:
-                    x = jnp.asarray(batch.features)
-                    y = jnp.asarray(batch.labels)
-                    fm = None if batch.features_mask is None else jnp.asarray(batch.features_mask)
-                    lm = jnp.asarray(batch.labels_mask) if batch.labels_mask is not None else (fm if y.ndim == 3 else None)
-                    x, y, fm, lm = shard_batch(self.strategy, x, y, fm, lm)
-                    rng = model.rng.next_key()
-                    model.train_state, loss = step_fn(model.train_state, x, y, rng, fm, lm)
+                    loss, n = self._run_step(step_fn, batch)
                     model._score = loss
                     model._iteration += 1
                     for lst in model._listeners:
                         if isinstance(lst, PerformanceListener):
-                            lst.record_batch(x.shape[0])
+                            lst.record_batch(n)
                         lst.iteration_done(model, model._iteration, model._epoch, loss)
                 for lst in model._listeners:
                     lst.on_epoch_end(model, model._epoch)

@@ -11,7 +11,7 @@ from deeplearning4j_tpu.nn import DenseLayer, InputType, NeuralNetConfiguration,
 from deeplearning4j_tpu.parallel import ParallelInference, ParallelWrapper, ShardingStrategy
 from deeplearning4j_tpu.parallel.ring_attention import sequence_parallel_attention
 from deeplearning4j_tpu.runtime.mesh import SEQ_AXIS, create_mesh
-from deeplearning4j_tpu.train import Sgd
+from deeplearning4j_tpu.train import Adam, Sgd
 
 
 def _conf(seed=7):
@@ -62,6 +62,39 @@ def test_fsdp_trains():
     pw = ParallelWrapper.builder(net).strategy("fsdp").build()
     pw.fit(it, epochs=2)
     assert np.isfinite(net.score())
+
+
+def test_computation_graph_through_parallel_wrapper():
+    """ParallelWrapper wraps ComputationGraph too (reference parity; the
+    CG step signature differs from MLN's — round-5 fix): DP-sharded CG
+    training matches the CG's own single-context fit."""
+    from deeplearning4j_tpu.models.computation_graph import ComputationGraph
+
+    def conf():
+        return (NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-2))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("h", DenseLayer(n_out=32, activation="relu"), "in")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss="mcxent"), "h")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(12))
+                .build())
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 12)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+
+    net1 = ComputationGraph(conf()).init()
+    net1.fit(NumpyDataSetIterator(x, y, batch_size=32), epochs=3)
+
+    net2 = ComputationGraph(conf()).init()
+    pw = ParallelWrapper.builder(net2).strategy("data_parallel").build()
+    pw.fit(NumpyDataSetIterator(x, y, batch_size=32), epochs=3)
+
+    w1 = np.asarray(net1.params()["h"]["W"])
+    w2 = np.asarray(net2.params()["h"]["W"])
+    np.testing.assert_allclose(w1, w2, rtol=2e-5, atol=2e-6)
 
 
 def test_tensor_parallel_builder_trains():
@@ -120,3 +153,38 @@ def test_ring_attention_matches_full_softmax():
 
     out_c = np.asarray(sequence_parallel_attention(q, k, v, mesh, causal=True))
     np.testing.assert_allclose(out_c, reference(q, k, v, True), rtol=2e-4, atol=2e-5)
+
+
+def test_parallel_wrapper_refuses_tbptt_and_solvers():
+    """Modes the model's own fit() special-cases (tBPTT chunking, legacy
+    solvers) must refuse loudly under ParallelWrapper instead of silently
+    training with different gradients (round-5 review finding)."""
+    from deeplearning4j_tpu.nn import GravesLSTM, RnnOutputLayer
+
+    conf_t = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.1))
+              .list()
+              .layer(GravesLSTM(n_out=8))
+              .layer(RnnOutputLayer(n_out=4, activation="softmax",
+                                    loss="mcxent"))
+              .set_input_type(InputType.recurrent(6))
+              .tbptt_fwd_length(4).tbptt_back_length(4)
+              .build())
+    net = MultiLayerNetwork(conf_t).init()
+    pw = ParallelWrapper.builder(net).strategy("data_parallel").build()
+    x = np.zeros((8, 6, 12), np.float32)
+    y = np.zeros((8, 4, 12), np.float32)
+    with pytest.raises(NotImplementedError, match="tBPTT"):
+        pw.fit(NumpyDataSetIterator(x, y, batch_size=8), epochs=1)
+
+    conf_s = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.1))
+              .optimization_algo("LBFGS")
+              .list()
+              .layer(DenseLayer(n_out=8, activation="tanh"))
+              .layer(OutputLayer(n_out=4, activation="softmax"))
+              .set_input_type(InputType.feed_forward(8))
+              .build())
+    net2 = MultiLayerNetwork(conf_s).init()
+    pw2 = ParallelWrapper.builder(net2).strategy("data_parallel").build()
+    xf, yf = _data(16)
+    with pytest.raises(NotImplementedError, match="SGD only"):
+        pw2.fit(NumpyDataSetIterator(xf, yf, batch_size=16), epochs=1)
