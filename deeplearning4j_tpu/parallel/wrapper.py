@@ -95,7 +95,8 @@ class ParallelWrapper:
         """ParallelWrapper drives the model's PLAIN jitted SGD step; modes
         the model's own fit() special-cases (tBPTT chunking, legacy
         solvers) would silently train with different gradients here — so
-        refuse loudly instead."""
+        refuse loudly instead. tBPTT is checked per-batch (the models'
+        own fit engages it only for sequence batches)."""
         conf = getattr(self.model, "conf", None)
         gc = getattr(conf, "global_conf", None)
         algo = getattr(gc, "optimization_algo",
@@ -106,7 +107,11 @@ class ParallelWrapper:
                 f"ParallelWrapper supports optimization_algo=SGD only "
                 f"(got {algo!r}); legacy solvers run single-context via "
                 "the model's own fit()")
-        if getattr(conf, "tbptt_fwd_length", None):
+
+    def _check_not_tbptt(self, x):
+        from deeplearning4j_tpu.models._tbptt import is_sequence_array
+        if getattr(getattr(self.model, "conf", None),
+                   "tbptt_fwd_length", None) and is_sequence_array(x):
             raise NotImplementedError(
                 "tBPTT training under ParallelWrapper is not supported — "
                 "the wrapper would run full-sequence BPTT instead of the "
@@ -131,6 +136,8 @@ class ParallelWrapper:
         rng = model.rng.next_key()
         if hasattr(model, "_coerce_batch"):  # ComputationGraph
             inputs, labels_, masks = model._coerce_batch(batch)
+            for v in inputs.values():
+                self._check_not_tbptt(v)
             inputs = {k: shard_batch(self.strategy, v)
                       for k, v in inputs.items()}
             labels_ = [shard_batch(self.strategy, l) for l in labels_]
@@ -144,8 +151,13 @@ class ParallelWrapper:
             return loss, n
         x = jnp.asarray(batch.features)
         y = jnp.asarray(batch.labels)
+        self._check_not_tbptt(x)
         fm = None if batch.features_mask is None else jnp.asarray(batch.features_mask)
-        lm = jnp.asarray(batch.labels_mask) if batch.labels_mask is not None else (fm if y.ndim == 3 else None)
+        # labels mask defaults for per-timestep labels via the model's own
+        # output-time alignment (a time-axis-changing layer makes the raw
+        # features mask the WRONG length for the loss)
+        lm = jnp.asarray(batch.labels_mask) if batch.labels_mask is not None \
+            else (model._output_time_mask(fm) if y.ndim == 3 else None)
         x, y, fm, lm = shard_batch(self.strategy, x, y, fm, lm)
         model.train_state, loss = step_fn(model.train_state, x, y, rng, fm, lm)
         return loss, x.shape[0]

@@ -188,3 +188,21 @@ def test_parallel_wrapper_refuses_tbptt_and_solvers():
     xf, yf = _data(16)
     with pytest.raises(NotImplementedError, match="SGD only"):
         pw2.fit(NumpyDataSetIterator(xf, yf, batch_size=16), epochs=1)
+
+
+def test_parallel_wrapper_tbptt_conf_with_nonsequence_data_trains():
+    """A tbptt_fwd_length config trained on NON-sequence batches never
+    engages tBPTT in the model's own fit — the wrapper must accept it too
+    (round-5 review: the first guard refused on configuration alone)."""
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.1))
+            .list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=4, activation="softmax"))
+            .set_input_type(InputType.feed_forward(8))
+            .tbptt_fwd_length(4).tbptt_back_length(4)
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    pw = ParallelWrapper.builder(net).strategy("data_parallel").build()
+    x, y = _data(16)
+    pw.fit(NumpyDataSetIterator(x, y, batch_size=16), epochs=1)
+    assert np.isfinite(net.score())

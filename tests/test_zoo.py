@@ -190,3 +190,26 @@ def test_remat_segments_match_plain_training_step():
     for a, b in zip(jax.tree_util.tree_leaves(p0), jax.tree_util.tree_leaves(p1)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_init_pretrained_loads_local_archive(tmp_path, monkeypatch):
+    """`init_pretrained()` is offline-first (reference `initPretrained`
+    downloads; here weights load from $DL4J_TPU_ZOO_DIR): a LeNet archive
+    placed under the zoo dir restores with identical outputs, and a
+    missing archive raises the documented FileNotFoundError."""
+    from deeplearning4j_tpu.zoo import LeNet
+
+    zoo = LeNet(num_classes=10)
+    net = zoo.init()
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (2, 784)).astype(np.float32)
+    before = np.asarray(net.output(x))
+
+    monkeypatch.setenv("DL4J_TPU_ZOO_DIR", str(tmp_path))
+    with pytest.raises(FileNotFoundError, match="DL4J_TPU_ZOO_DIR"):
+        LeNet(num_classes=10).init_pretrained()
+
+    net.save(str(tmp_path / "lenet.zip"))
+    net2 = LeNet(num_classes=10).init_pretrained()
+    after = np.asarray(net2.output(x))
+    np.testing.assert_allclose(before, after, rtol=1e-6)
