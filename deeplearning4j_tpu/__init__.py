@@ -29,6 +29,10 @@ Top-level layout (mirrors SURVEY.md §2's component inventory):
                   ``org.deeplearning4j.nn.modelimport``, ``org.nd4j.imports``).
 - ``parallel``  — mesh sharding (DP/TP/FSDP/SP), ParallelInference, multi-host
                   (reference: ParallelWrapper, dl4j-spark, nd4j-parameter-server).
+- ``serving``   — production model serving: registry with hot-swap, shape-
+                  bucketed continuous batcher, admission control, HTTP front
+                  end, SLO metrics (reference: ParallelInference + the
+                  konduit/dl4j model-server layer).
 - ``zoo``       — model zoo (reference: ``org.deeplearning4j.zoo``).
 - ``nlp``       — Word2Vec & friends (reference: deeplearning4j-nlp).
 - ``ui``        — stats collection/serving (reference: deeplearning4j-ui).

@@ -7,6 +7,11 @@ TPU-native replacement for the reference's entire scale-out stack (SURVEY.md
 ``VoidParameterServer`` mesh (multi-node DP with threshold-encoded gradient
 compression).
 
+Inference serving: ``ParallelInference`` here is the reference-shaped API
+over :mod:`deeplearning4j_tpu.serving`'s shape-bucketed continuous batcher;
+the production surface (model registry, admission control, HTTP front end,
+SLO metrics) lives in that package.
+
 Design (SURVEY.md §7.1): parallelism is *sharding*, not frameworks. One SPMD
 train step over a ``jax.sharding.Mesh``; XLA inserts fused allreduces over
 ICI/DCN. The reference's four DP flavors collapse into one mechanism — and

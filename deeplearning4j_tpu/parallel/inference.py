@@ -2,33 +2,34 @@
 
 Rebuild of upstream ``org.deeplearning4j.parallelism.ParallelInference``:
 the reference keeps N model replicas with worker threads and a dynamic
-batching observable (``BatchedInferenceObservable``). Here a single jitted
-forward runs SPMD over the mesh (replicated params, batch-sharded inputs),
-and the dynamic batcher is a host-side queue that coalesces concurrent
-``output()`` calls up to ``max_batch_size`` — same latency/throughput trade,
-one compiled program instead of N replicas.
+batching observable (``BatchedInferenceObservable``). Here the dynamic
+batcher is :class:`~deeplearning4j_tpu.serving.batcher.ContinuousBatcher`
+— ``ParallelInference`` is its single-model degenerate case, kept as the
+reference-shaped API (``Builder``, ``output()``, ``shutdown()``). The full
+serving subsystem (registry, admission control, HTTP front end, SLO
+metrics) lives in :mod:`deeplearning4j_tpu.serving`.
+
+Semantics inherited from the shared batcher (fixes two seed bugs):
+
+- the coalesce window is one deadline for the whole batch (the seed passed
+  the full ``batch_timeout_s`` to every ``queue.get``, so worst-case added
+  latency was ``max_batch_size x timeout``);
+- ``shutdown()`` drains queued-but-unbatched requests and fails them with
+  an explicit error instead of leaving concurrent ``output()`` callers
+  blocked forever;
+- multi-input ``ComputationGraph`` batches work (``output({"a": xa, ...})``
+  concatenates per input name — the seed's bare ``np.concatenate(r.x)``
+  only handled single-array MLN inputs).
 """
 
 from __future__ import annotations
 
-import queue
-import threading
-from typing import List, Optional
+from typing import Optional
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.parallel.sharding import ShardingStrategy
-from deeplearning4j_tpu.runtime.mesh import create_mesh
-
-
-class _Request:
-    def __init__(self, x: np.ndarray):
-        self.x = x
-        self.event = threading.Event()
-        self.result: Optional[np.ndarray] = None
-        self.error: Optional[BaseException] = None
+from deeplearning4j_tpu.serving.batcher import ContinuousBatcher
 
 
 class ParallelInference:
@@ -43,15 +44,11 @@ class ParallelInference:
                  max_batch_size: int = 32, queue_limit: int = 256,
                  batch_timeout_ms: float = 2.0):
         self.model = model
-        if model.train_state is None:
-            model.init()
-        self.strategy = strategy or ShardingStrategy.data_parallel(create_mesh())
+        self.strategy = strategy  # kept for API parity; forward is one jit
         self.max_batch_size = int(max_batch_size)
-        self.batch_timeout_s = batch_timeout_ms / 1000.0
-        self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=queue_limit)
-        self._shutdown = False
-        self._worker = threading.Thread(target=self._run, daemon=True)
-        self._worker.start()
+        self._batcher = ContinuousBatcher(
+            model, max_batch_size=max_batch_size, queue_limit=queue_limit,
+            batch_timeout_ms=batch_timeout_ms)
 
     class Builder:
         """Reference ``ParallelInference.Builder`` surface."""
@@ -92,47 +89,15 @@ class ParallelInference:
     def builder(model) -> "ParallelInference.Builder":
         return ParallelInference.Builder(model)
 
-    def output(self, x) -> np.ndarray:
-        """Blocking inference; safe from many threads at once."""
-        req = _Request(np.asarray(x))
-        self._queue.put(req)
-        req.event.wait()
-        if req.error is not None:
-            raise req.error
-        return req.result
-
-    def _run(self):
-        while not self._shutdown:
-            try:
-                first = self._queue.get(timeout=0.1)
-            except queue.Empty:
-                continue
-            batch: List[_Request] = [first]
-            total = first.x.shape[0]
-            # dynamic batching: coalesce whatever arrives within the window
-            deadline = self.batch_timeout_s
-            while total < self.max_batch_size:
-                try:
-                    nxt = self._queue.get(timeout=deadline)
-                except queue.Empty:
-                    break
-                batch.append(nxt)
-                total += nxt.x.shape[0]
-            try:
-                x = np.concatenate([r.x for r in batch], axis=0)
-                out = np.asarray(self.model.output(x))
-                ofs = 0
-                for r in batch:
-                    n = r.x.shape[0]
-                    r.result = out[ofs:ofs + n]
-                    ofs += n
-            except BaseException as e:
-                for r in batch:
-                    r.error = e
-            finally:
-                for r in batch:
-                    r.event.set()
+    def output(self, x):
+        """Blocking inference; safe from many threads at once. ``x`` is a
+        single array, or a ``{input_name: array}`` dict for multi-input
+        ``ComputationGraph`` models; returns np arrays (a list for
+        multi-output graphs)."""
+        out = self._batcher.submit(x)
+        if isinstance(out, list):
+            return [np.asarray(o) for o in out]
+        return np.asarray(out)
 
     def shutdown(self):
-        self._shutdown = True
-        self._worker.join(timeout=1.0)
+        self._batcher.shutdown(drain=True)

@@ -42,9 +42,13 @@ class OpProfiler:
     """
 
     def __init__(self, config: Optional[ProfilerConfig] = None):
+        from deeplearning4j_tpu.serving.metrics import LatencyHistogram
         self.config = config or ProfilerConfig(enabled=True)
         self._totals: Dict[str, float] = defaultdict(float)
         self._counts: Dict[str, int] = defaultdict(int)
+        # serving's SLO histogram doubles as the section-latency histogram:
+        # one percentile implementation across training and serving
+        self._hists: Dict[str, "LatencyHistogram"] = defaultdict(LatencyHistogram)
 
     @contextlib.contextmanager
     def section(self, name: str) -> Iterator[None]:
@@ -58,6 +62,7 @@ class OpProfiler:
             dt = time.perf_counter() - t0
             self._totals[name] += dt
             self._counts[name] += 1
+            self._hists[name].observe(dt)
 
     def timings(self) -> Dict[str, Dict[str, float]]:
         return {
@@ -65,6 +70,8 @@ class OpProfiler:
                 "total_s": self._totals[name],
                 "count": self._counts[name],
                 "mean_s": self._totals[name] / max(1, self._counts[name]),
+                "p50_s": self._hists[name].percentile(50),
+                "p99_s": self._hists[name].percentile(99),
             }
             for name in self._totals
         }
@@ -81,6 +88,7 @@ class OpProfiler:
     def reset(self) -> None:
         self._totals.clear()
         self._counts.clear()
+        self._hists.clear()
 
 
 @contextlib.contextmanager

@@ -1,0 +1,59 @@
+"""Production model serving (reference: ``ParallelInference`` + the
+konduit/dl4j model-server layer).
+
+The subsystem that puts traffic on this stack:
+
+- :class:`ModelRegistry` (``registry.py``) — named/versioned models loaded
+  from live nets, ``ModelSerializer`` archives, or the zoo; hot-swap with
+  pre-warmed replacements and graceful drain.
+- :class:`ContinuousBatcher` (``batcher.py``) — coalesces concurrent
+  requests and pads to a fixed set of power-of-two row buckets, AOT-warmed
+  at load, so XLA compilations are bounded by the bucket count instead of
+  growing with traffic. ``parallel.ParallelInference`` is the single-model
+  degenerate case of this batcher.
+- :class:`AdmissionController` (``admission.py``) — per-request deadlines,
+  queue limits, and load shedding with explicit :class:`Overloaded` /
+  :class:`DeadlineExceeded` rejections instead of unbounded queueing.
+- :class:`ModelServer` (``server.py``) — stdlib-HTTP JSON front end
+  (``/v1/models``, ``/v1/models/<name>/predict``, ``/healthz``,
+  ``/metrics``).
+- :class:`ServingMetrics` (``metrics.py``) — latency percentiles, QPS,
+  queue depth, batch occupancy, compile counts; Prometheus text on
+  ``/metrics``; the histogram is reused by ``runtime.profiler``.
+
+Exports resolve lazily (PEP 562) so that importing one leaf —
+``runtime.profiler`` pulling ``serving.metrics.LatencyHistogram`` — does
+not drag the batcher/registry/HTTP stack into the training import graph.
+"""
+
+import importlib
+
+_EXPORTS = {
+    "AdmissionController": "admission",
+    "DeadlineExceeded": "admission",
+    "Overloaded": "admission",
+    "ServingError": "admission",
+    "ServingShutdown": "admission",
+    "ContinuousBatcher": "batcher",
+    "default_buckets": "batcher",
+    "LatencyHistogram": "metrics",
+    "ServingMetrics": "metrics",
+    "ModelRegistry": "registry",
+    "ServedModel": "registry",
+    "ModelServer": "server",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    submodule = _EXPORTS.get(name)
+    if submodule is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    mod = importlib.import_module(f"{__name__}.{submodule}")
+    return getattr(mod, name)
+
+
+def __dir__():
+    return __all__

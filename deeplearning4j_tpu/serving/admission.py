@@ -1,0 +1,63 @@
+"""Admission control: deadlines, queue limits, load shedding.
+
+The seed's ``ParallelInference`` queued without bound and had no notion of a
+deadline — under overload every caller just waited longer. Production
+serving needs the opposite: reject *early* with an explicit error the client
+can act on (retry elsewhere, degrade, shed). Two error types:
+
+- :class:`Overloaded` — raised synchronously at submit time when the queue
+  is full (the request never entered the system).
+- :class:`DeadlineExceeded` — the request was admitted but its deadline
+  passed before the model ran it (the batcher fails it instead of wasting
+  compute on an answer nobody is waiting for).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+
+class ServingError(RuntimeError):
+    """Base class for explicit serving rejections."""
+
+
+class Overloaded(ServingError):
+    """Queue full — request rejected at admission, never enqueued."""
+
+
+class DeadlineExceeded(ServingError):
+    """Request admitted but its deadline expired before execution."""
+
+
+class ServingShutdown(ServingError):
+    """The batcher was shut down while this request was still queued."""
+
+
+class AdmissionController:
+    """Policy object consulted by the batcher at submit time.
+
+    ``queue_limit`` bounds how many *requests* may wait (load shedding);
+    ``default_timeout_ms`` gives every request a deadline even when the
+    caller does not pass one (None = wait forever, the seed behaviour).
+    """
+
+    def __init__(self, queue_limit: int = 256,
+                 default_timeout_ms: Optional[float] = None):
+        self.queue_limit = int(queue_limit)
+        self.default_timeout_ms = default_timeout_ms
+
+    def admit(self, queue_depth: int) -> None:
+        """Raise :class:`Overloaded` if the queue cannot take this request."""
+        if queue_depth >= self.queue_limit:
+            raise Overloaded(
+                f"serving queue full ({queue_depth}/{self.queue_limit} "
+                f"requests waiting); retry later or raise queue_limit")
+
+    def deadline_for(self, timeout_ms: Optional[float]) -> Optional[float]:
+        """Absolute monotonic deadline for a request, or None."""
+        if timeout_ms is None:
+            timeout_ms = self.default_timeout_ms
+        if timeout_ms is None:
+            return None
+        return time.monotonic() + float(timeout_ms) / 1000.0

@@ -1,0 +1,325 @@
+"""Shape-bucketed continuous batcher.
+
+The seed's ``ParallelInference`` coalesced concurrent requests into whatever
+total row count happened to arrive — so every distinct coalesced size was a
+fresh XLA compilation, and a long-running server would keep compiling for as
+long as traffic kept producing new sizes. Here coalesced batches are padded
+up to a fixed set of power-of-two row buckets that are AOT-warmed at model
+load, so the number of compilations is bounded by the bucket count, not by
+traffic. Padding rows are dead weight (row-wise inference ops never couple
+rows at inference time — BN uses running stats).
+
+Exactness contract: a request of ``n`` rows served at bucket ``b`` returns
+``model.output(pad_to_b(x))[:n]`` **bit-for-bit** — at a fixed program
+shape a row's result is independent of its neighbors and of its offset in
+the batch (verified empirically in ``tests/test_serving.py``). Across
+*different* program shapes XLA codegen may legitimately differ in the last
+ulp (e.g. a 1-row matvec path vs the same row inside a 16-row matmul on
+CPU), so "identical to a solo ``model.output`` call at the request's own
+shape" holds to ~1 ulp, not bitwise — that is XLA numerics, not batching.
+
+Also fixes two seed bugs (ISSUE satellites):
+
+- the coalesce window is ONE deadline for the whole batch, not a fresh
+  ``batch_timeout_s`` per ``queue.get`` (worst case used to be
+  ``max_batch_size x timeout`` of added latency under a slow trickle);
+- ``shutdown()`` drains queued-but-unbatched requests and fails them with
+  :class:`~deeplearning4j_tpu.serving.admission.ServingShutdown` instead of
+  leaving their callers blocked forever.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.admission import (
+    AdmissionController,
+    DeadlineExceeded,
+    Overloaded,
+    ServingShutdown,
+)
+from deeplearning4j_tpu.serving.metrics import ServingMetrics
+
+ArrayOrDict = Union[np.ndarray, Dict[str, np.ndarray]]
+
+
+def default_buckets(max_batch_size: int) -> List[int]:
+    """Powers of two up to ``max_batch_size`` (plus the max itself)."""
+    out, b = [], 1
+    while b < max_batch_size:
+        out.append(b)
+        b *= 2
+    out.append(int(max_batch_size))
+    return sorted(set(out))
+
+
+class _Request:
+    __slots__ = ("x", "rows", "deadline", "enqueued_at", "event",
+                 "result", "error")
+
+    def __init__(self, x: ArrayOrDict, rows: int, deadline: Optional[float]):
+        self.x = x
+        self.rows = rows
+        self.deadline = deadline
+        self.enqueued_at = time.monotonic()
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+
+
+class ContinuousBatcher:
+    """Continuous batching over one model (MLN or ComputationGraph).
+
+    Thread-safe: any number of threads call :meth:`submit` concurrently; a
+    single worker thread coalesces, pads to a bucket, runs the model's own
+    jitted ``output`` (sharing its compile cache) and scatters results.
+
+    Inputs: a single array for ``MultiLayerNetwork``-style models, or a
+    ``{input_name: array}`` dict for multi-input ``ComputationGraph``s.
+    """
+
+    def __init__(self, model, max_batch_size: int = 32,
+                 batch_timeout_ms: float = 2.0, queue_limit: int = 256,
+                 buckets: Optional[Sequence[int]] = None,
+                 admission: Optional[AdmissionController] = None,
+                 metrics: Optional[ServingMetrics] = None,
+                 warmup_example: Optional[ArrayOrDict] = None):
+        self.model = model
+        if model.train_state is None:
+            model.init()
+        self.max_batch_size = int(max_batch_size)
+        self.batch_timeout_s = float(batch_timeout_ms) / 1000.0
+        self.buckets = sorted(set(int(b) for b in
+                                  (buckets or default_buckets(max_batch_size))))
+        self.admission = admission or AdmissionController(queue_limit=queue_limit)
+        self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self.metrics = metrics or ServingMetrics(
+            queue_depth_fn=self._queue.qsize,
+            compile_count_fn=self.compile_count)
+        self._graph_inputs = list(getattr(model.conf, "inputs", []) or [])
+        self._shutdown = False
+        self._draining = False
+        self._carry: Optional[_Request] = None  # deferred overflow request
+        self._submit_lock = threading.Lock()  # vs shutdown: no orphan enqueues
+        if warmup_example is not None:
+            self.warmup(warmup_example)
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="ContinuousBatcher")
+        self._worker.start()
+
+    # ------------------------------------------------------------ warmup
+    def warmup(self, example: ArrayOrDict) -> int:
+        """AOT-compile every bucket size with zero rows shaped like
+        ``example`` (any leading row count). Returns the number of buckets
+        warmed. After this, steady-state traffic triggers no compilation."""
+        example = self._normalize(example)[0]
+        for b in self.buckets:
+            self._forward(self._zeros_with_rows(example, b))
+        return len(self.buckets)
+
+    @staticmethod
+    def _zeros_with_rows(x: ArrayOrDict, rows: int) -> ArrayOrDict:
+        if isinstance(x, dict):
+            return {k: np.zeros((rows,) + v.shape[1:], v.dtype)
+                    for k, v in x.items()}
+        return np.zeros((rows,) + x.shape[1:], x.dtype)
+
+    def compile_count(self) -> int:
+        """XLA compilations behind this model's inference path: the sum of
+        jit-cache entry counts of every cached ``output`` function."""
+        n = 0
+        for key, fn in getattr(self.model, "_jit_cache", {}).items():
+            if str(key).startswith("output@") and hasattr(fn, "_cache_size"):
+                n += fn._cache_size()
+        return n
+
+    # ------------------------------------------------------------ submit
+    def _normalize(self, x: ArrayOrDict):
+        if isinstance(x, dict):
+            xs = {k: np.asarray(v) for k, v in x.items()}
+            rows = {v.shape[0] for v in xs.values()}
+            if len(rows) != 1:
+                raise ValueError(f"inconsistent leading dims across inputs: "
+                                 f"{ {k: v.shape for k, v in xs.items()} }")
+            return xs, rows.pop()
+        xs = np.asarray(x)
+        if xs.ndim == 0:
+            raise ValueError("request must have a leading batch dimension")
+        return xs, xs.shape[0]
+
+    def submit(self, x: ArrayOrDict, timeout_ms: Optional[float] = None):
+        """Blocking inference; safe from many threads at once.
+
+        Raises :class:`Overloaded` when the queue is full,
+        :class:`DeadlineExceeded` when the deadline passed before the model
+        ran the request, :class:`ServingShutdown` if shut down first.
+        """
+        xs, rows = self._normalize(x)
+        with self._submit_lock:
+            if self._shutdown or self._draining:
+                raise ServingShutdown("batcher is shut down")
+            try:
+                self.admission.admit(self._queue.qsize())
+            except Overloaded:
+                self.metrics.record_rejection("overload")
+                raise
+            req = _Request(xs, rows, self.admission.deadline_for(timeout_ms))
+            self.metrics.record_admitted()
+            self._queue.put(req)
+        req.event.wait()
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    # ------------------------------------------------------------ worker
+    def _collect(self, first: _Request) -> List[_Request]:
+        """Coalesce: one deadline for the WHOLE window (seed bug: a fresh
+        ``batch_timeout_s`` per ``queue.get`` meant worst-case added latency
+        of ``max_batch_size x timeout`` under a slow trickle). A request
+        that would push the batch past ``max_batch_size`` is carried into
+        the next window instead of overflowing into a bigger bucket."""
+        batch = [first]
+        total = first.rows
+        deadline = time.monotonic() + self.batch_timeout_s
+        while total < self.max_batch_size:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                nxt = self._queue.get(timeout=remaining)
+            except queue.Empty:
+                break
+            if total + nxt.rows > self.max_batch_size:
+                self._carry = nxt
+                break
+            batch.append(nxt)
+            total += nxt.rows
+        return batch
+
+    def _bucket_for(self, rows: int) -> int:
+        for b in self.buckets:
+            if rows <= b:
+                return b
+        # oversized single request (rows > max bucket): round up to the next
+        # power of two and remember it, so the compile bound stays truthful
+        # (only the worker thread touches self.buckets after construction)
+        b = self.buckets[-1]
+        while b < rows:
+            b *= 2
+        self.buckets = sorted(set(self.buckets + [b]))
+        return b
+
+    def _forward(self, x: ArrayOrDict):
+        if isinstance(x, dict):
+            names = self._graph_inputs or sorted(x)
+            return self.model.output(*[x[n] for n in names])
+        return self.model.output(x)
+
+    @staticmethod
+    def _pad(x: ArrayOrDict, rows: int, bucket: int) -> ArrayOrDict:
+        pad = bucket - rows
+        if pad == 0:
+            return x
+        if isinstance(x, dict):
+            return {k: np.concatenate(
+                [v, np.zeros((pad,) + v.shape[1:], v.dtype)], axis=0)
+                for k, v in x.items()}
+        return np.concatenate(
+            [x, np.zeros((pad,) + x.shape[1:], x.dtype)], axis=0)
+
+    @staticmethod
+    def _concat(parts: List[ArrayOrDict]) -> ArrayOrDict:
+        if isinstance(parts[0], dict):
+            return {k: np.concatenate([p[k] for p in parts], axis=0)
+                    for k in parts[0]}
+        return np.concatenate(parts, axis=0)
+
+    def _execute(self, batch: List[_Request]) -> None:
+        now = time.monotonic()
+        live: List[_Request] = []
+        for r in batch:
+            if r.deadline is not None and now > r.deadline:
+                r.error = DeadlineExceeded(
+                    f"deadline passed {now - r.deadline:.3f}s before "
+                    f"execution (queued {now - r.enqueued_at:.3f}s)")
+                self.metrics.record_rejection("deadline")
+                r.event.set()
+            else:
+                live.append(r)
+        if not live:
+            return
+        try:
+            rows = sum(r.rows for r in live)
+            bucket = self._bucket_for(rows)
+            x = self._pad(self._concat([r.x for r in live]), rows, bucket)
+            t0 = time.monotonic()
+            out = self._forward(x)
+            if isinstance(out, (list, tuple)):
+                out = [np.asarray(o) for o in out]
+            else:
+                out = np.asarray(out)
+            t1 = time.monotonic()
+            self.metrics.record_batch(rows, bucket, t1 - t0)
+            ofs = 0
+            for r in live:
+                sl = slice(ofs, ofs + r.rows)
+                r.result = ([o[sl] for o in out]
+                            if isinstance(out, list) else out[sl])
+                ofs += r.rows
+                self.metrics.record_response(t1 - r.enqueued_at)
+        except BaseException as e:
+            for r in live:
+                r.error = e
+                self.metrics.record_rejection("error")
+        finally:
+            for r in live:
+                r.event.set()
+
+    def _run(self) -> None:
+        while True:
+            if self._shutdown:
+                break
+            if self._carry is not None:
+                first, self._carry = self._carry, None
+            else:
+                try:
+                    first = self._queue.get(timeout=0.05)
+                except queue.Empty:
+                    if self._draining:
+                        break
+                    continue
+            self._execute(self._collect(first))
+
+    # ---------------------------------------------------------- shutdown
+    def shutdown(self, drain: bool = True, timeout_s: float = 5.0) -> None:
+        """Stop the worker. ``drain=True`` (default) serves whatever is
+        already queued first; either way every still-pending request gets an
+        explicit :class:`ServingShutdown` error — no caller hangs (seed bug:
+        queued-but-unbatched requests never got ``event.set()``)."""
+        with self._submit_lock:
+            if drain:
+                self._draining = True
+            else:
+                self._shutdown = True
+        self._worker.join(timeout=timeout_s)
+        with self._submit_lock:
+            self._shutdown = True
+            self._draining = True
+        leftovers = []
+        if self._carry is not None:
+            leftovers.append(self._carry)
+            self._carry = None
+        while True:
+            try:
+                leftovers.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        for r in leftovers:
+            r.error = ServingShutdown(
+                "batcher shut down before this request was served")
+            r.event.set()

@@ -1,0 +1,187 @@
+"""SLO metrics for the serving subsystem.
+
+The reference stack exposes serving health through the konduit model-server's
+Prometheus endpoint; here the same signals — request latency percentiles,
+QPS, queue depth, batch occupancy, rejection counts, and XLA compile counts —
+are collected in-process and rendered on ``/metrics`` in Prometheus text
+format. :class:`LatencyHistogram` is deliberately stdlib-only so
+``runtime.profiler`` can reuse it for section-latency percentiles without
+pulling the serving stack into the training import graph.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+
+class LatencyHistogram:
+    """Fixed log-spaced latency histogram with percentile queries.
+
+    Buckets are geometric (factor 2) from ``lo`` seconds to ``hi`` seconds
+    plus an overflow bucket, so a p99 over millions of observations costs
+    O(#buckets) memory and the percentile error is bounded by one bucket
+    width (the standard Prometheus-histogram trade).
+    """
+
+    def __init__(self, lo: float = 1e-4, hi: float = 64.0):
+        self._bounds: List[float] = []
+        b = lo
+        while b <= hi:
+            self._bounds.append(b)
+            b *= 2.0
+        self._counts = [0] * (len(self._bounds) + 1)  # +1 overflow
+        self.count = 0
+        self.sum = 0.0
+        self.max = 0.0
+
+    def observe(self, seconds: float) -> None:
+        seconds = float(seconds)
+        i = 0
+        for i, b in enumerate(self._bounds):
+            if seconds <= b:
+                break
+        else:
+            i = len(self._bounds)
+        self._counts[i] += 1
+        self.count += 1
+        self.sum += seconds
+        if seconds > self.max:
+            self.max = seconds
+
+    def percentile(self, p: float) -> float:
+        """p in [0, 100]; returns the upper bound of the bucket holding the
+        p-th observation (0.0 when empty) — a conservative (>=) estimate."""
+        if self.count == 0:
+            return 0.0
+        rank = max(1, int(round(p / 100.0 * self.count)))
+        seen = 0
+        for i, c in enumerate(self._counts):
+            seen += c
+            if seen >= rank:
+                return self._bounds[i] if i < len(self._bounds) else self.max
+        return self.max
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+
+class ServingMetrics:
+    """Per-model serving counters, gauges and histograms (thread-safe)."""
+
+    def __init__(self, queue_depth_fn: Optional[Callable[[], int]] = None,
+                 compile_count_fn: Optional[Callable[[], int]] = None):
+        self._lock = threading.Lock()
+        self.started_at = time.monotonic()
+        self.requests_total = 0          # admitted into the queue
+        self.responses_total = 0         # completed successfully
+        self.rejected_overload = 0
+        self.rejected_deadline = 0
+        self.errors_total = 0            # model/runtime failures
+        self.batches_total = 0
+        self.rows_real_total = 0         # pre-padding rows executed
+        self.rows_padded_total = 0       # post-padding rows executed
+        self.request_latency = LatencyHistogram()
+        self.batch_latency = LatencyHistogram()
+        self._queue_depth_fn = queue_depth_fn or (lambda: 0)
+        self._compile_count_fn = compile_count_fn or (lambda: 0)
+        # 60-slot per-second ring for windowed QPS
+        self._qps_slots = [0] * 60
+        self._qps_times = [0] * 60
+
+    # ------------------------------------------------------------ recording
+    def record_admitted(self) -> None:
+        with self._lock:
+            self.requests_total += 1
+
+    def record_response(self, latency_s: float) -> None:
+        with self._lock:
+            self.responses_total += 1
+            self.request_latency.observe(latency_s)
+            now = int(time.monotonic())
+            slot = now % 60
+            if self._qps_times[slot] != now:
+                self._qps_times[slot] = now
+                self._qps_slots[slot] = 0
+            self._qps_slots[slot] += 1
+
+    def record_rejection(self, reason: str) -> None:
+        with self._lock:
+            if reason == "overload":
+                self.rejected_overload += 1
+            elif reason == "deadline":
+                self.rejected_deadline += 1
+            else:
+                self.errors_total += 1
+
+    def record_batch(self, real_rows: int, padded_rows: int,
+                     latency_s: float) -> None:
+        with self._lock:
+            self.batches_total += 1
+            self.rows_real_total += int(real_rows)
+            self.rows_padded_total += int(padded_rows)
+            self.batch_latency.observe(latency_s)
+
+    # -------------------------------------------------------------- reading
+    @property
+    def batch_occupancy(self) -> float:
+        """Fraction of executed rows that were real requests (1.0 = no
+        padding waste)."""
+        return (self.rows_real_total / self.rows_padded_total
+                if self.rows_padded_total else 0.0)
+
+    def qps(self, window_s: int = 10) -> float:
+        now = int(time.monotonic())
+        with self._lock:
+            n = sum(c for c, t in zip(self._qps_slots, self._qps_times)
+                    if now - t < window_s)
+        return n / float(window_s)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            req_lat, bat_lat = self.request_latency, self.batch_latency
+            snap = {
+                "requests_total": self.requests_total,
+                "responses_total": self.responses_total,
+                "rejected_overload": self.rejected_overload,
+                "rejected_deadline": self.rejected_deadline,
+                "errors_total": self.errors_total,
+                "batches_total": self.batches_total,
+                "rows_real_total": self.rows_real_total,
+                "rows_padded_total": self.rows_padded_total,
+                "batch_occupancy": round(self.batch_occupancy, 4),
+                "latency_p50_s": req_lat.percentile(50),
+                "latency_p99_s": req_lat.percentile(99),
+                "latency_mean_s": req_lat.mean,
+                "batch_latency_p50_s": bat_lat.percentile(50),
+                "uptime_s": round(time.monotonic() - self.started_at, 3),
+            }
+        snap["qps_10s"] = self.qps(10)
+        snap["queue_depth"] = int(self._queue_depth_fn())
+        snap["compile_count"] = int(self._compile_count_fn())
+        return snap
+
+    def render_prometheus(self, model: str) -> str:
+        s = self.snapshot()
+        lbl = f'{{model="{model}"}}'
+        lines = [
+            f"serving_requests_total{lbl} {s['requests_total']}",
+            f"serving_responses_total{lbl} {s['responses_total']}",
+            f'serving_rejected_total{{model="{model}",reason="overload"}} '
+            f"{s['rejected_overload']}",
+            f'serving_rejected_total{{model="{model}",reason="deadline"}} '
+            f"{s['rejected_deadline']}",
+            f"serving_errors_total{lbl} {s['errors_total']}",
+            f"serving_batches_total{lbl} {s['batches_total']}",
+            f"serving_batch_occupancy{lbl} {s['batch_occupancy']}",
+            f'serving_latency_seconds{{model="{model}",quantile="0.5"}} '
+            f"{s['latency_p50_s']}",
+            f'serving_latency_seconds{{model="{model}",quantile="0.99"}} '
+            f"{s['latency_p99_s']}",
+            f"serving_qps{lbl} {s['qps_10s']}",
+            f"serving_queue_depth{lbl} {s['queue_depth']}",
+            f"serving_xla_compile_count{lbl} {s['compile_count']}",
+        ]
+        return "\n".join(lines) + "\n"

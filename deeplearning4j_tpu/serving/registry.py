@@ -1,0 +1,136 @@
+"""Named/versioned model registry with hot-swap and per-model warmup.
+
+The front door of the serving subsystem: models are registered under a name
+(from a live ``MultiLayerNetwork``/``ComputationGraph``, a
+``ModelSerializer`` zip archive, or a zoo class), each gets its own
+:class:`~deeplearning4j_tpu.serving.batcher.ContinuousBatcher` +
+:class:`~deeplearning4j_tpu.serving.metrics.ServingMetrics`, and
+``predict(name, x)`` routes traffic. Re-registering a name hot-swaps: the
+replacement is built and AOT-warmed *before* the swap, then the old
+batcher drains gracefully — in-flight and already-queued requests complete
+against the old version, new traffic hits the new one, and no compilation
+happens on the serving path during the cut-over.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from deeplearning4j_tpu.serving.batcher import ArrayOrDict, ContinuousBatcher
+
+
+class ServedModel:
+    """One registered (name, version) with its batcher and metrics."""
+
+    def __init__(self, name: str, version: int, model, batcher: ContinuousBatcher):
+        self.name = name
+        self.version = int(version)
+        self.model = model
+        self.batcher = batcher
+        self.loaded_at = time.time()
+
+    @property
+    def metrics(self):
+        return self.batcher.metrics
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "version": self.version,
+            "model_type": type(self.model).__name__,
+            "buckets": list(self.batcher.buckets),
+            "max_batch_size": self.batcher.max_batch_size,
+            "loaded_at": self.loaded_at,
+            "metrics": self.metrics.snapshot(),
+        }
+
+
+class ModelRegistry:
+    """Thread-safe registry; the unit the HTTP server fronts."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._models: Dict[str, ServedModel] = {}
+
+    # ----------------------------------------------------------- register
+    def register(self, name: str, model, version: Optional[int] = None,
+                 warmup_example: Optional[ArrayOrDict] = None,
+                 **batcher_kw) -> ServedModel:
+        """Serve ``model`` under ``name``. Re-registering an existing name
+        hot-swaps (version auto-bumps unless given); the new batcher is
+        warmed before it takes traffic and the old one drains gracefully.
+        ``batcher_kw`` forwards to :class:`ContinuousBatcher`
+        (``max_batch_size``, ``batch_timeout_ms``, ``queue_limit``,
+        ``buckets``, ``admission``)."""
+        if model.train_state is None:
+            model.init()
+        batcher = ContinuousBatcher(model, warmup_example=warmup_example,
+                                    **batcher_kw)
+        with self._lock:
+            prev = self._models.get(name)
+            if version is None:
+                version = prev.version + 1 if prev else 1
+            served = ServedModel(name, version, model, batcher)
+            self._models[name] = served
+        if prev is not None:
+            prev.batcher.shutdown(drain=True)
+        return served
+
+    def load(self, name: str, path: str, load_updater: bool = False,
+             **kw) -> ServedModel:
+        """Register from a ``ModelSerializer`` zip archive (MLN or
+        ComputationGraph — the archive metadata dispatches the type)."""
+        from deeplearning4j_tpu.models.serializer import ModelSerializer
+        model = ModelSerializer.restore_model(path, load_updater=load_updater)
+        return self.register(name, model, **kw)
+
+    def register_zoo(self, name: str, zoo_model, **kw) -> ServedModel:
+        """Register a zoo entry: either an already-constructed ``ZooModel``
+        instance (``registry.register_zoo("lenet", LeNet())``) or a zoo
+        class name string looked up in ``deeplearning4j_tpu.zoo``."""
+        if isinstance(zoo_model, str):
+            import deeplearning4j_tpu.zoo as zoo
+            zoo_model = getattr(zoo, zoo_model)()
+        return self.register(name, zoo_model.init(), **kw)
+
+    # ------------------------------------------------------------ routing
+    def get(self, name: str) -> ServedModel:
+        with self._lock:
+            served = self._models.get(name)
+            have = sorted(self._models)
+        if served is None:
+            raise KeyError(f"no model registered under {name!r}; have {have}")
+        return served
+
+    def predict(self, name: str, x: ArrayOrDict,
+                timeout_ms: Optional[float] = None):
+        """Route one request through ``name``'s batcher. Raises ``KeyError``
+        for unknown names, ``Overloaded``/``DeadlineExceeded`` under
+        pressure — never hangs on a registered model."""
+        return self.get(name).batcher.submit(x, timeout_ms=timeout_ms)
+
+    # ---------------------------------------------------------- lifecycle
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def describe(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            served = list(self._models.values())
+        return [s.describe() for s in served]
+
+    def undeploy(self, name: str, drain: bool = True) -> None:
+        with self._lock:
+            served = self._models.pop(name, None)
+        if served is None:
+            raise KeyError(f"no model registered under {name!r}")
+        served.batcher.shutdown(drain=drain)
+
+    def shutdown(self, drain: bool = True) -> None:
+        with self._lock:
+            served = list(self._models.values())
+            self._models.clear()
+        for s in served:
+            s.batcher.shutdown(drain=drain)

@@ -1,0 +1,151 @@
+"""Stdlib-HTTP JSON model server (the konduit/dl4j model-server role).
+
+Same dependency-free ``ThreadingHTTPServer`` pattern as ``ui/server.py``
+(offline environment — no web framework). Endpoints:
+
+- ``GET  /v1/models``                  — registry listing + per-model metrics
+- ``GET  /v1/models/<name>``           — one model's description
+- ``POST /v1/models/<name>/predict``   — JSON inference
+- ``GET  /healthz``                    — liveness
+- ``GET  /metrics``                    — Prometheus text format
+
+Predict request body::
+
+    {"inputs": [[...], ...]}                       # single-input model
+    {"inputs": {"in_a": [[...]], "in_b": [[...]]}} # multi-input graph
+    {"inputs": ..., "timeout_ms": 50}              # per-request deadline
+
+Admission-control semantics map onto status codes: ``503`` for
+``Overloaded`` (queue full — shed, retry elsewhere), ``504`` for
+``DeadlineExceeded``, ``404`` unknown model, ``400`` malformed body. Every
+response is explicit; nothing queues unboundedly behind the socket.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.admission import DeadlineExceeded, Overloaded
+from deeplearning4j_tpu.serving.registry import ModelRegistry
+
+
+def _to_jsonable(out):
+    if isinstance(out, (list, tuple)):
+        return [np.asarray(o).tolist() for o in out]
+    return np.asarray(out).tolist()
+
+
+class ModelServer:
+    """``ModelServer(registry).start(port)`` — serve a registry over HTTP."""
+
+    def __init__(self, registry: Optional[ModelRegistry] = None):
+        self.registry = registry or ModelRegistry()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.port: Optional[int] = None
+
+    # ------------------------------------------------------------ handlers
+    def _handle_predict(self, name: str, raw: bytes):
+        try:
+            body = json.loads(raw.decode() or "{}")
+            inputs = body["inputs"]
+            timeout_ms = body.get("timeout_ms")
+            if isinstance(inputs, dict):
+                x = {k: np.asarray(v) for k, v in inputs.items()}
+            else:
+                x = np.asarray(inputs)  # ragged rows raise -> 400
+        except Exception as e:
+            return 400, {"error": f"malformed request body: {e}"}
+        # resolve the model OUTSIDE the submit try: a KeyError raised by a
+        # multi-input forward (wrong input name) must not read as 404
+        try:
+            served = self.registry.get(name)
+        except KeyError:
+            return 404, {"error": f"model {name!r} not found",
+                         "models": self.registry.names()}
+        try:
+            out = served.batcher.submit(x, timeout_ms=timeout_ms)
+        except Overloaded as e:
+            return 503, {"error": "overloaded", "detail": str(e)}
+        except DeadlineExceeded as e:
+            return 504, {"error": "deadline exceeded", "detail": str(e)}
+        except Exception as e:
+            return 500, {"error": repr(e)}
+        return 200, {"model": name, "version": served.version,
+                     "outputs": _to_jsonable(out)}
+
+    def _handle_get(self, path: str):
+        if path == "/healthz":
+            return 200, {"status": "ok", "models": self.registry.names()}
+        if path == "/v1/models":
+            return 200, {"models": self.registry.describe()}
+        if path.startswith("/v1/models/"):
+            name = path[len("/v1/models/"):].strip("/")
+            try:
+                return 200, self.registry.get(name).describe()
+            except KeyError:
+                return 404, {"error": f"model {name!r} not found"}
+        return 404, {"error": f"unknown path {path!r}"}
+
+    def _render_metrics(self) -> str:
+        parts = ["# TYPE serving_latency_seconds summary"]
+        for name in self.registry.names():
+            try:
+                parts.append(self.registry.get(name).metrics
+                             .render_prometheus(name))
+            except KeyError:
+                pass  # undeployed between listing and render
+        return "\n".join(parts) + "\n"
+
+    # ------------------------------------------------------------ plumbing
+    def start(self, port: int = 0, host: str = "127.0.0.1") -> int:
+        srv = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code: int, body: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    self._send(200, srv._render_metrics().encode(),
+                               "text/plain; version=0.0.4")
+                    return
+                code, obj = srv._handle_get(self.path)
+                self._send(code, json.dumps(obj).encode(), "application/json")
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length)
+                if (self.path.startswith("/v1/models/")
+                        and self.path.endswith("/predict")):
+                    name = self.path[len("/v1/models/"):-len("/predict")]
+                    code, obj = srv._handle_predict(name, raw)
+                else:
+                    code, obj = 404, {"error": f"unknown path {self.path!r}"}
+                self._send(code, json.dumps(obj).encode(), "application/json")
+
+            def log_message(self, *a):
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="ModelServer")
+        self._thread.start()
+        return self.port
+
+    def stop(self, shutdown_registry: bool = False) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd = None
+        if shutdown_registry:
+            self.registry.shutdown()

@@ -1,0 +1,96 @@
+"""Bench honesty checker (`bench.py --check-tables`, VERDICT item 3 /
+ISSUE 1 satellite): BASELINE.md's machine-checked closing table, the
+in-code RECORDED_RANGES copy, and the measured BENCH_EXTRA.json must agree
+— any drift fails loudly. Pure host logic, no device needed."""
+
+import importlib.util
+import json
+import pathlib
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+_spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
+bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench)
+
+
+def _table_md(ranges):
+    rows = "\n".join(f"| `{k}` | {lo} | {hi} |"
+                     for k, (lo, hi) in sorted(ranges.items()))
+    return ("# BASELINE\n\nprose\n\n## Closing table (machine-checked)\n\n"
+            "| metric | recorded low | recorded high |\n|---|---|---|\n"
+            + rows + "\n")
+
+
+def _mid(lo, hi):
+    return (lo + hi) / 2.0
+
+
+def test_parse_baseline_table_matches_recorded_ranges():
+    """The committed BASELINE.md closing table IS the RECORDED_RANGES copy
+    (the invariant --check-tables enforces)."""
+    doc = bench.parse_baseline_table(str(REPO / "BASELINE.md"))
+    assert doc == {k: tuple(map(float, v))
+                   for k, v in bench.RECORDED_RANGES.items()}
+
+
+def test_check_tables_passes_on_repo_state():
+    """The committed BASELINE.md + BENCH_EXTRA.json must be consistent —
+    this is the same check the driver can run in CI."""
+    assert bench.check_tables(log=lambda *a: None) == 0
+
+
+def test_check_tables_fails_on_out_of_range_measurement(tmp_path):
+    md = tmp_path / "BASELINE.md"
+    md.write_text(_table_md(bench.RECORDED_RANGES))
+    measured = {k: _mid(lo, hi)
+                for k, (lo, hi) in bench.RECORDED_RANGES.items()}
+    measured["resnet50_images_per_sec"] = 1.0  # regression
+    extra = tmp_path / "BENCH_EXTRA.json"
+    extra.write_text(json.dumps(measured))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("resnet50_images_per_sec" in m and "outside" in m
+               for m in msgs)
+
+
+def test_check_tables_fails_on_doc_code_drift(tmp_path):
+    drifted = dict(bench.RECORDED_RANGES)
+    k = sorted(drifted)[0]
+    lo, hi = drifted[k]
+    drifted[k] = (lo, hi * 10)  # doc quietly claims a wider range
+    md = tmp_path / "BASELINE.md"
+    md.write_text(_table_md(drifted))
+    measured = {kk: _mid(*rng) for kk, rng in bench.RECORDED_RANGES.items()}
+    extra = tmp_path / "BENCH_EXTRA.json"
+    extra.write_text(json.dumps(measured))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any(k in m and "RECORDED_RANGES" in m for m in msgs)
+
+
+def test_check_tables_fails_on_missing_table_row(tmp_path):
+    partial = dict(bench.RECORDED_RANGES)
+    partial.pop(sorted(partial)[0])
+    md = tmp_path / "BASELINE.md"
+    md.write_text(_table_md(partial))
+    measured = {kk: _mid(*rng) for kk, rng in bench.RECORDED_RANGES.items()}
+    extra = tmp_path / "BENCH_EXTRA.json"
+    extra.write_text(json.dumps(measured))
+    assert bench.check_tables(str(md), str(extra), log=lambda *a: None) == 1
+
+
+def test_check_tables_missing_measurement_is_warning_not_failure(tmp_path):
+    """A skipped bench section (e.g. BENCH_SKIP_BERT_IMPORT=1) must warn,
+    not fail — only disagreement between recorded and measured numbers is
+    dishonesty."""
+    md = tmp_path / "BASELINE.md"
+    md.write_text(_table_md(bench.RECORDED_RANGES))
+    measured = {kk: _mid(*rng) for kk, rng in bench.RECORDED_RANGES.items()}
+    measured.pop("bert_tf_import_samples_per_sec")
+    extra = tmp_path / "BENCH_EXTRA.json"
+    extra.write_text(json.dumps(measured))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 0
+    assert any("bert_tf_import_samples_per_sec" in m and "WARN" in m
+               for m in msgs)

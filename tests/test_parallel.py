@@ -131,6 +131,97 @@ def test_parallel_inference_batches():
     pi.shutdown()
 
 
+def test_parallel_inference_computation_graph_multi_input():
+    """ParallelInference over a multi-input ComputationGraph: dict batches
+    coalesce per input name (the seed's bare ``np.concatenate(r.x)`` only
+    handled single-array MLN inputs — ISSUE 1 satellite)."""
+    import threading
+
+    from deeplearning4j_tpu.models.computation_graph import ComputationGraph
+    from deeplearning4j_tpu.nn.graph_vertices import MergeVertex
+
+    def conf():
+        return (NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-2))
+                .graph_builder()
+                .add_inputs("in_a", "in_b")
+                .add_layer("ha", DenseLayer(n_out=16, activation="relu"),
+                           "in_a")
+                .add_layer("hb", DenseLayer(n_out=16, activation="relu"),
+                           "in_b")
+                .add_vertex("m", MergeVertex(), "ha", "hb")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss="mcxent"), "m")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(12),
+                                 InputType.feed_forward(6))
+                .build())
+
+    net = ComputationGraph(conf()).init()
+    rng = np.random.default_rng(0)
+    xa = rng.normal(size=(32, 12)).astype(np.float32)
+    xb = rng.normal(size=(32, 6)).astype(np.float32)
+
+    pi = ParallelInference(net, max_batch_size=8, batch_timeout_ms=5.0)
+    try:
+        results = {}
+
+        def client(i, n):
+            results[i] = pi.output({"in_a": xa[i:i + n], "in_b": xb[i:i + n]})
+
+        threads = [threading.Thread(target=client, args=(i, 1 + i % 3))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert len(results) == 8
+        for i in range(8):
+            n = 1 + i % 3
+            expect = np.asarray(net.output(xa[i:i + n], xb[i:i + n]))
+            np.testing.assert_allclose(results[i], expect, rtol=1e-6)
+    finally:
+        pi.shutdown()
+
+
+def test_parallel_inference_shutdown_does_not_hang_queued_callers():
+    """Seed bug (ISSUE 1 satellite): queued-but-unbatched requests must be
+    failed explicitly at shutdown, never left blocked forever."""
+    import threading
+
+    from deeplearning4j_tpu.serving import ServingShutdown
+
+    net = MultiLayerNetwork(_conf()).init()
+    pi = ParallelInference(net, max_batch_size=4, batch_timeout_ms=1.0)
+    x, _ = _data(16)
+    gate = threading.Event()
+    orig = pi._batcher._forward
+    pi._batcher._forward = lambda v: (gate.wait(5), orig(v))[1]
+    done = []
+
+    def client(i):
+        try:
+            pi.output(x[i:i + 1])
+            done.append("ok")
+        except ServingShutdown:
+            done.append("shutdown")
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    import time
+    time.sleep(0.3)  # stalled worker; requests pile up unbatched
+    sd = threading.Thread(
+        target=lambda: pi._batcher.shutdown(drain=False, timeout_s=10))
+    sd.start()
+    time.sleep(0.05)
+    gate.set()
+    sd.join(timeout=10)
+    for t in threads:
+        t.join(timeout=5)
+    assert not any(t.is_alive() for t in threads), "output() caller hung"
+    assert len(done) == 8 and "shutdown" in done
+
+
 def test_ring_attention_matches_full_softmax():
     mesh = create_mesh({SEQ_AXIS: 8})
     B, H, T, D = 2, 4, 64, 16
