@@ -702,6 +702,183 @@ def bench_imported_bert(batch=64, seq=128, steps=48):
     return round(sps, 1)
 
 
+# -------------------------------------------------------------- chaos smoke
+def chaos_smoke(seed=7, n_threads=6, per_thread=25, bench_extra=None,
+                log=_log):
+    """``bench.py --chaos-smoke`` (ISSUE 2): the serving sustained-load
+    benchmark under a FIXED seeded fault schedule. The invariant asserted
+    is *zero silent wrong answers*: every request must return either a
+    bit-exact result (identical to the reference model at one of the
+    buckets that could have served it) or an explicit typed error
+    (Overloaded / DeadlineExceeded / CircuitOpen / the model failure
+    itself after the retry budget) — never a corrupted payload, never a
+    hang. Counts are exported into ``BENCH_EXTRA.json["chaos_smoke"]``.
+    Returns a process exit code."""
+    import threading
+
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.runtime.chaos import (AddLatency, ChaosController,
+                                                  ChaosError,
+                                                  FailWithProbability, Policy)
+
+    class _Blackout(Policy):
+        """Fail every forward in a fixed call-index band — the
+        deterministic outage that guarantees the breaker trips (and then
+        recovers) at any traffic volume."""
+
+        def __init__(self, start, stop):
+            self.start, self.stop = int(start), int(stop)
+
+        def apply(self, point, index, rng, controller):
+            if self.start <= index < self.stop:
+                raise ChaosError(
+                    f"injected blackout at {point} (call #{index})")
+            return None
+    from deeplearning4j_tpu.serving import (CircuitBreaker, CircuitOpen,
+                                            DeadlineExceeded, ModelRegistry,
+                                            Overloaded, RetryPolicy)
+    from deeplearning4j_tpu.train import Sgd
+
+    def conf(s=3):
+        return (NeuralNetConfiguration.builder().seed(s).updater(Sgd(0.1))
+                .list()
+                .layer(DenseLayer(n_out=64, activation="tanh"))
+                .layer(OutputLayer(n_out=8, activation="softmax"))
+                .set_input_type(InputType.feed_forward(16)).build())
+
+    net = MultiLayerNetwork(conf()).init()
+    ref = MultiLayerNetwork(conf()).init()  # identical seeded weights
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0, 1, (256, 16)).astype(np.float32)
+    reg = ModelRegistry()
+    served = reg.register(
+        "smoke", net, warmup_example=x[:1], max_batch_size=16,
+        batch_timeout_ms=1.0, queue_limit=512,
+        breaker=CircuitBreaker(failure_threshold=6, window_s=10.0,
+                               reset_timeout_s=0.05),
+        retry=RetryPolicy(max_attempts=3, base_delay_s=0.002,
+                          max_delay_s=0.05, seed=seed))
+    buckets = list(served.batcher.buckets)
+
+    def pad_rows(a, b):
+        return np.concatenate(
+            [a, np.zeros((b - a.shape[0],) + a.shape[1:], a.dtype)], axis=0)
+
+    # candidate references: the exactness contract is per served-bucket
+    # shape and coalescing makes the bucket traffic-dependent
+    expected = {}
+    for ofs in range(200):
+        n = 1 + ofs % 4
+        expected[ofs] = [np.asarray(ref.output(pad_rows(x[ofs:ofs + n], b)))[:n]
+                         for b in buckets if b >= n]
+
+    counts = {"ok": 0, "wrong": 0, "overloaded": 0, "deadline": 0,
+              "circuit_open": 0, "model_error": 0}
+    lock = threading.Lock()
+
+    def client(i):
+        for j in range(per_thread):
+            ofs = (i * per_thread + j) % 200
+            n = 1 + ofs % 4
+            time.sleep(0.005)  # pace traffic past breaker recovery windows
+            try:
+                got = np.asarray(reg.predict("smoke", x[ofs:ofs + n],
+                                             timeout_ms=10_000))
+                ok = any((got == c).all() for c in expected[ofs])
+                key = "ok" if ok else "wrong"
+            except Overloaded:
+                key = "overloaded"
+            except DeadlineExceeded:
+                key = "deadline"
+            except CircuitOpen:
+                key = "circuit_open"
+            except Exception:
+                key = "model_error"
+            with lock:
+                counts[key] += 1
+
+    with ChaosController(seed=seed) as c:
+        c.on("serving.batcher.forward",
+             FailWithProbability(0.08), _Blackout(12, 22),
+             AddLatency(0.001))
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n_threads)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        hung = sum(t.is_alive() for t in threads)
+        elapsed = time.monotonic() - t0
+
+    # recovery: with chaos gone the breaker must close again (half-open
+    # probe) and a clean request must serve exactly
+    recovered = False
+    post_refs = [np.asarray(ref.output(pad_rows(x[:2], b)))[:2]
+                 for b in buckets if b >= 2]
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        try:
+            got = np.asarray(reg.predict("smoke", x[:2], timeout_ms=5_000))
+            recovered = any((got == c).all() for c in post_refs)
+            break
+        except Exception:
+            time.sleep(0.05)
+    snap = served.metrics.snapshot()
+    reg.shutdown()
+
+    total = n_threads * per_thread
+    answered = sum(counts.values())
+    out = dict(counts)
+    out.update({
+        "total_requests": total, "answered": answered, "hung_clients": hung,
+        "elapsed_s": round(elapsed, 3),
+        "retries_total": snap["retries_total"],
+        "errors_total": snap["errors_total"],
+        "breaker_opens_total": snap.get("breaker_opens_total", 0),
+        "recovered_after_chaos": recovered,
+        "fault_schedule": {"seed": seed, "forward_fail_p": 0.08,
+                           "forward_blackout_calls": [12, 22],
+                           "forward_latency_s": 0.001},
+    })
+    here = os.path.dirname(os.path.abspath(__file__))
+    bench_extra = bench_extra or os.path.join(here, "BENCH_EXTRA.json")
+    try:
+        with open(bench_extra) as f:
+            extra = json.load(f)
+    except Exception:
+        extra = {}
+    extra["chaos_smoke"] = out
+    with open(bench_extra, "w") as f:
+        json.dump(extra, f, indent=2)
+
+    failures = []
+    if counts["wrong"]:
+        failures.append(f"{counts['wrong']} SILENT WRONG ANSWER(S)")
+    if hung:
+        failures.append(f"{hung} hung client thread(s)")
+    if answered != total:
+        failures.append(f"unaccounted requests: {answered}/{total}")
+    if counts["ok"] == 0:
+        failures.append("no request succeeded under the fault schedule")
+    if out["breaker_opens_total"] == 0:
+        failures.append("fault schedule never tripped the breaker")
+    if not recovered:
+        failures.append("breaker did not recover after chaos ended")
+    log(f"[chaos-smoke] {counts} | retries={out['retries_total']} "
+        f"breaker_opens={out['breaker_opens_total']} "
+        f"recovered={recovered} ({elapsed:.2f}s)")
+    if failures:
+        for fmsg in failures:
+            log(f"[chaos-smoke] FAIL {fmsg}")
+        return 1
+    log(f"[chaos-smoke] OK: {total} requests, every one exact or an "
+        f"explicit error")
+    return 0
+
+
 # ------------------------------------------------------------------- resnet
 def bench_resnet():
     import jax
@@ -1090,4 +1267,6 @@ def main():
 if __name__ == "__main__":
     if "--check-tables" in sys.argv:
         sys.exit(check_tables())
+    if "--chaos-smoke" in sys.argv:
+        sys.exit(chaos_smoke())
     main()

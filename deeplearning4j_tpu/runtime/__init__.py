@@ -6,6 +6,18 @@ facade), ``CudaEnvironment`` (device runtime tuning), ``Nd4j.getRandom()``
 (global RNG), and ``OpProfiler`` (profiling hooks).
 """
 
+from deeplearning4j_tpu.runtime import chaos
+from deeplearning4j_tpu.runtime.chaos import (
+    AddLatency,
+    ChaosCancelled,
+    ChaosController,
+    ChaosError,
+    ChaosListener,
+    CorruptBytes,
+    FailNth,
+    FailWithProbability,
+    HangUntilCancelled,
+)
 from deeplearning4j_tpu.runtime.environment import Environment, get_environment
 from deeplearning4j_tpu.runtime.mesh import (
     MeshSpec,
@@ -18,6 +30,16 @@ from deeplearning4j_tpu.runtime.rng import RngManager, get_default_rng, set_defa
 from deeplearning4j_tpu.runtime.profiler import OpProfiler, ProfilerConfig, trace
 
 __all__ = [
+    "chaos",
+    "ChaosController",
+    "ChaosError",
+    "ChaosCancelled",
+    "ChaosListener",
+    "FailNth",
+    "FailWithProbability",
+    "AddLatency",
+    "CorruptBytes",
+    "HangUntilCancelled",
     "Environment",
     "get_environment",
     "MeshSpec",

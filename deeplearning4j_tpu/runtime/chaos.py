@@ -1,0 +1,329 @@
+"""Deterministic fault injection (the chaos-engineering layer).
+
+The ROADMAP north star is production serving; that requires the system to
+be provably well-behaved under *injected* failure, not just under load.
+This module is the injection side: production code declares **named
+injection points** (``chaos.inject("serving.batcher.forward")``,
+``chaos.transform_bytes("train.checkpoint.bytes", data)``) and a test,
+benchmark, or drill installs a :class:`ChaosController` that maps points to
+**policies**:
+
+- :class:`FailNth` — fail the N-th call (or every N-th) at a point.
+- :class:`FailWithProbability` — fail each call with probability ``p``
+  drawn from a per-policy seeded RNG, so a schedule replays exactly.
+- :class:`AddLatency` — sleep a fixed delay plus seeded jitter.
+- :class:`CorruptBytes` — corrupt data flowing through a byte point
+  (bit-flips or truncation at seeded offsets): the torn-write /
+  bit-rot simulator for checkpoint archives.
+- :class:`HangUntilCancelled` — block until the controller is cancelled
+  (scope exit), then raise :class:`ChaosCancelled`: the stuck-worker
+  simulator a heartbeat watchdog must catch.
+
+Design constraints:
+
+- **No-op fast path.** With no controller installed, ``inject()`` is one
+  module-global load and an ``is None`` test — nothing allocates, nothing
+  locks. Serving/training hot paths may call it unconditionally.
+- **Determinism.** Every policy owns a ``random.Random`` seeded from
+  ``(controller seed, point pattern, policy index, class name)``; per-point
+  call indices are sequential under a lock. The same seed and the same
+  call sequence produce the same fault schedule, and the controller's
+  ``events`` log records every decision for replay assertions.
+- **Scoped.** ``with ChaosController(seed=7) as c: ...`` installs the
+  controller globally for the block and restores the previous one (nesting
+  allowed) on exit; exit also cancels any :class:`HangUntilCancelled`
+  waiters so no thread outlives the blast radius.
+
+Catalogue of injection points threaded through the stack (see
+``docs/robustness.md``): ``serving.batcher.submit``,
+``serving.batcher.forward``, ``serving.batcher.warmup``,
+``serving.registry.register``, ``train.checkpoint.write`` (call),
+``train.checkpoint.bytes`` (byte point), ``train.epoch``,
+``train.iteration`` (via :class:`ChaosListener`).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import logging
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+class ChaosError(RuntimeError):
+    """An injected failure (never raised by real production faults)."""
+
+
+class ChaosCancelled(ChaosError):
+    """A :class:`HangUntilCancelled` hang released by controller exit."""
+
+
+class Policy:
+    """Base injection policy. Subclasses override :meth:`apply` (call
+    points: raise / sleep / hang) and/or :meth:`transform` (byte points)."""
+
+    def apply(self, point: str, index: int, rng: random.Random,
+              controller: "ChaosController") -> Optional[str]:
+        """Act on the ``index``-th call (1-based) of ``point``. Return a
+        short action tag for the event log, or None for no action."""
+        return None
+
+    def transform(self, point: str, index: int, rng: random.Random,
+                  data: bytes) -> Tuple[bytes, Optional[str]]:
+        """Transform bytes flowing through ``point``. Returns (data, tag);
+        return the SAME object untouched for no action."""
+        return data, None
+
+
+class FailNth(Policy):
+    """Fail the ``n``-th call at a point (1-based); with ``every=True``,
+    fail every ``n``-th call."""
+
+    def __init__(self, n: int, every: bool = False,
+                 exc: Optional[BaseException] = None):
+        self.n = int(n)
+        self.every = every
+        self.exc = exc
+
+    def apply(self, point, index, rng, controller):
+        hit = (index % self.n == 0) if self.every else (index == self.n)
+        if hit:
+            raise self.exc or ChaosError(
+                f"injected failure at {point} (call #{index})")
+        return None
+
+
+class FailWithProbability(Policy):
+    """Fail each call with probability ``p`` from the policy's seeded RNG
+    — the same seed replays the same fault schedule call-for-call."""
+
+    def __init__(self, p: float, exc: Optional[BaseException] = None):
+        if not 0.0 <= p <= 1.0:
+            raise ValueError(f"p must be in [0, 1], got {p}")
+        self.p = float(p)
+        self.exc = exc
+
+    def apply(self, point, index, rng, controller):
+        if rng.random() < self.p:
+            raise self.exc or ChaosError(
+                f"injected probabilistic failure at {point} (call #{index})")
+        return None
+
+
+class AddLatency(Policy):
+    """Sleep ``seconds`` plus uniform seeded jitter in [0, ``jitter``]."""
+
+    def __init__(self, seconds: float, jitter: float = 0.0):
+        self.seconds = float(seconds)
+        self.jitter = float(jitter)
+
+    def apply(self, point, index, rng, controller):
+        delay = self.seconds + (rng.uniform(0.0, self.jitter)
+                                if self.jitter else 0.0)
+        time.sleep(delay)
+        return f"latency:{delay:.4f}"
+
+
+class CorruptBytes(Policy):
+    """Corrupt bytes at a byte point: ``mode="flip"`` XORs ``n_bytes``
+    bytes at seeded offsets (bit rot), ``mode="truncate"`` cuts the tail at
+    a seeded offset (torn write). ``nth`` restricts corruption to one call
+    index (e.g. only the 3rd checkpoint); None corrupts every call."""
+
+    def __init__(self, n_bytes: int = 8, mode: str = "flip",
+                 nth: Optional[int] = None):
+        if mode not in ("flip", "truncate"):
+            raise ValueError(f"mode must be 'flip' or 'truncate', got {mode!r}")
+        self.n_bytes = int(n_bytes)
+        self.mode = mode
+        self.nth = nth
+
+    def transform(self, point, index, rng, data):
+        if self.nth is not None and index != self.nth:
+            return data, None
+        if not data:
+            return data, None
+        if self.mode == "truncate":
+            cut = rng.randrange(0, max(1, len(data) - 1))
+            return data[:cut], f"corrupt:truncate@{cut}"
+        buf = bytearray(data)
+        for _ in range(min(self.n_bytes, len(buf))):
+            i = rng.randrange(len(buf))
+            buf[i] ^= 0xFF
+        return bytes(buf), f"corrupt:flip:{min(self.n_bytes, len(buf))}"
+
+
+class HangUntilCancelled(Policy):
+    """Block the calling thread until the controller is cancelled (scope
+    exit or explicit :meth:`ChaosController.cancel`), then raise
+    :class:`ChaosCancelled`. ``timeout_s`` bounds the wait as a safety net
+    against a forgotten cancel (raises anyway when it expires)."""
+
+    def __init__(self, timeout_s: float = 60.0):
+        self.timeout_s = float(timeout_s)
+
+    def apply(self, point, index, rng, controller):
+        controller._cancel_event.wait(self.timeout_s)
+        raise ChaosCancelled(
+            f"injected hang at {point} (call #{index}) released")
+
+
+class ChaosController:
+    """Scoped, seeded registry of (point pattern -> policies).
+
+    Usage::
+
+        with ChaosController(seed=7) as c:
+            c.on("serving.batcher.forward", FailWithProbability(0.2))
+            c.on("train.checkpoint.write", CorruptBytes(mode="truncate"))
+            ... run traffic / training ...
+        # scope exit: hangs cancelled, previous controller restored
+
+    ``events`` is the append-only decision log — one
+    ``(point, call_index, policy_name, action)`` tuple per policy action —
+    used to assert deterministic replay of a fault schedule.
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+        self.events: List[Tuple[str, int, str, str]] = []
+        self._rules: List[Tuple[str, Policy, random.Random]] = []
+        self._counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._cancel_event = threading.Event()
+        self._previous: Optional["ChaosController"] = None
+
+    # -------------------------------------------------------------- config
+    def on(self, pattern: str, *policies: Policy) -> "ChaosController":
+        """Attach policies to an injection-point name or fnmatch pattern
+        (``"serving.*"`` matches every serving point). Chainable."""
+        if not policies:
+            raise ValueError("on() needs at least one policy")
+        with self._lock:
+            for p in policies:
+                # seed from the per-PATTERN policy position (not the global
+                # rule index): a schedule replays identically even when
+                # unrelated rules are registered around it
+                nth = sum(1 for pat, _, _ in self._rules if pat == pattern)
+                rng = random.Random(
+                    f"{self.seed}:{pattern}:{nth}:{type(p).__name__}")
+                self._rules.append((pattern, p, rng))
+        return self
+
+    def cancel(self) -> None:
+        """Release every :class:`HangUntilCancelled` waiter."""
+        self._cancel_event.set()
+
+    # --------------------------------------------------------------- scope
+    def __enter__(self) -> "ChaosController":
+        global _ACTIVE
+        with _INSTALL_LOCK:
+            self._previous = _ACTIVE
+            _ACTIVE = self
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        global _ACTIVE
+        self.cancel()
+        with _INSTALL_LOCK:
+            _ACTIVE = self._previous
+        self._previous = None
+
+    # ------------------------------------------------------------- plumbing
+    def _matching(self, name: str):
+        return [(pat, pol, rng) for pat, pol, rng in self._rules
+                if pat == name or fnmatch.fnmatchcase(name, pat)]
+
+    def _next_index(self, name: str) -> int:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + 1
+            return self._counts[name]
+
+    def count(self, name: str) -> int:
+        """How many times ``name`` has fired under this controller."""
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def _record(self, name, index, policy, action) -> None:
+        with self._lock:
+            self.events.append((name, index, type(policy).__name__, action))
+
+    def fire(self, name: str) -> None:
+        rules = self._matching(name)
+        if not rules:
+            return
+        index = self._next_index(name)
+        for _pat, policy, rng in rules:
+            try:
+                action = policy.apply(name, index, rng, self)
+            except BaseException as e:
+                self._record(name, index, policy, f"raise:{type(e).__name__}")
+                logger.info("chaos: %s #%d -> %s", name, index, e)
+                raise
+            if action is not None:
+                self._record(name, index, policy, action)
+
+    def transform(self, name: str, data: bytes) -> bytes:
+        rules = self._matching(name)
+        if not rules:
+            return data
+        index = self._next_index(name)
+        for _pat, policy, rng in rules:
+            out, action = policy.transform(name, index, rng, data)
+            if action is not None:
+                self._record(name, index, policy, action)
+                logger.info("chaos: %s #%d -> %s", name, index, action)
+                data = out
+        return data
+
+
+_INSTALL_LOCK = threading.Lock()
+_ACTIVE: Optional[ChaosController] = None
+
+
+def active() -> bool:
+    """True when a controller is installed (hot paths may use this to skip
+    chaos-only work like re-reading a file for byte corruption)."""
+    return _ACTIVE is not None
+
+
+def inject(name: str) -> None:
+    """Fire the injection point ``name``. No-op fast path when no
+    controller is installed; otherwise applies every matching policy
+    (which may raise, sleep, or hang)."""
+    c = _ACTIVE
+    if c is None:
+        return
+    c.fire(name)
+
+
+def transform_bytes(name: str, data: bytes) -> bytes:
+    """Pass ``data`` through the byte point ``name``. Returns ``data``
+    itself (same object) when no controller or no matching corruption
+    policy is installed."""
+    c = _ACTIVE
+    if c is None:
+        return data
+    return c.transform(name, data)
+
+
+class ChaosListener:
+    """TrainingListener shim firing ``train.iteration`` every iteration —
+    attach it to a net to schedule deterministic mid-epoch faults (the
+    in-process analog of losing a chip at step N)."""
+
+    def __init__(self, point: str = "train.iteration"):
+        self.point = point
+
+    def iteration_done(self, model, iteration, epoch, score):
+        inject(self.point)
+
+    def on_epoch_start(self, model, epoch):
+        pass
+
+    def on_epoch_end(self, model, epoch):
+        pass

@@ -18,8 +18,14 @@ The subsystem that puts traffic on this stack:
   (``/v1/models``, ``/v1/models/<name>/predict``, ``/healthz``,
   ``/metrics``).
 - :class:`ServingMetrics` (``metrics.py``) — latency percentiles, QPS,
-  queue depth, batch occupancy, compile counts; Prometheus text on
-  ``/metrics``; the histogram is reused by ``runtime.profiler``.
+  queue depth, batch occupancy, compile counts, breaker state, retry
+  counters; Prometheus text on ``/metrics``; the histogram is reused by
+  ``runtime.profiler``.
+- :class:`CircuitBreaker` / :class:`RetryPolicy` / :class:`HealthState`
+  (``resilience.py``) — per-model failure containment: breaker-shed
+  (:class:`CircuitOpen`), bounded retries with full jitter, and the
+  health machine surfaced on ``/readyz``. Chaos-hardened via
+  ``runtime.chaos`` injection points (``tests/test_chaos.py``).
 
 Exports resolve lazily (PEP 562) so that importing one leaf —
 ``runtime.profiler`` pulling ``serving.metrics.LatencyHistogram`` — does
@@ -41,6 +47,11 @@ _EXPORTS = {
     "ModelRegistry": "registry",
     "ServedModel": "registry",
     "ModelServer": "server",
+    "CircuitBreaker": "resilience",
+    "CircuitOpen": "resilience",
+    "CircuitState": "resilience",
+    "HealthState": "resilience",
+    "RetryPolicy": "resilience",
 }
 
 __all__ = sorted(_EXPORTS)

@@ -37,6 +37,7 @@ from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
+from deeplearning4j_tpu.runtime import chaos
 from deeplearning4j_tpu.serving.admission import (
     AdmissionController,
     DeadlineExceeded,
@@ -117,6 +118,7 @@ class ContinuousBatcher:
         """AOT-compile every bucket size with zero rows shaped like
         ``example`` (any leading row count). Returns the number of buckets
         warmed. After this, steady-state traffic triggers no compilation."""
+        chaos.inject("serving.batcher.warmup")
         example = self._normalize(example)[0]
         for b in self.buckets:
             self._forward(self._zeros_with_rows(example, b))
@@ -159,6 +161,7 @@ class ContinuousBatcher:
         :class:`DeadlineExceeded` when the deadline passed before the model
         ran the request, :class:`ServingShutdown` if shut down first.
         """
+        chaos.inject("serving.batcher.submit")
         xs, rows = self._normalize(x)
         with self._submit_lock:
             if self._shutdown or self._draining:
@@ -215,6 +218,7 @@ class ContinuousBatcher:
         return b
 
     def _forward(self, x: ArrayOrDict):
+        chaos.inject("serving.batcher.forward")
         if isinstance(x, dict):
             names = self._graph_inputs or sorted(x)
             return self.model.output(*[x[n] for n in names])

@@ -79,6 +79,8 @@ class ServingMetrics:
         self.responses_total = 0         # completed successfully
         self.rejected_overload = 0
         self.rejected_deadline = 0
+        self.rejected_circuit = 0        # shed by an open circuit breaker
+        self.retries_total = 0           # resubmits after transient failures
         self.errors_total = 0            # model/runtime failures
         self.batches_total = 0
         self.rows_real_total = 0         # pre-padding rows executed
@@ -87,6 +89,7 @@ class ServingMetrics:
         self.batch_latency = LatencyHistogram()
         self._queue_depth_fn = queue_depth_fn or (lambda: 0)
         self._compile_count_fn = compile_count_fn or (lambda: 0)
+        self._breaker = None             # CircuitBreaker, attached post-init
         # 60-slot per-second ring for windowed QPS
         self._qps_slots = [0] * 60
         self._qps_times = [0] * 60
@@ -113,8 +116,20 @@ class ServingMetrics:
                 self.rejected_overload += 1
             elif reason == "deadline":
                 self.rejected_deadline += 1
+            elif reason == "circuit":
+                self.rejected_circuit += 1
             else:
                 self.errors_total += 1
+
+    def record_retry(self) -> None:
+        with self._lock:
+            self.retries_total += 1
+
+    def attach_breaker(self, breaker) -> None:
+        """Attach the model's CircuitBreaker so snapshots and the
+        Prometheus rendering expose its state (gauge: 0 closed,
+        1 half-open, 2 open) and open count."""
+        self._breaker = breaker
 
     def record_batch(self, real_rows: int, padded_rows: int,
                      latency_s: float) -> None:
@@ -147,6 +162,8 @@ class ServingMetrics:
                 "responses_total": self.responses_total,
                 "rejected_overload": self.rejected_overload,
                 "rejected_deadline": self.rejected_deadline,
+                "rejected_circuit": self.rejected_circuit,
+                "retries_total": self.retries_total,
                 "errors_total": self.errors_total,
                 "batches_total": self.batches_total,
                 "rows_real_total": self.rows_real_total,
@@ -161,6 +178,11 @@ class ServingMetrics:
         snap["qps_10s"] = self.qps(10)
         snap["queue_depth"] = int(self._queue_depth_fn())
         snap["compile_count"] = int(self._compile_count_fn())
+        if self._breaker is not None:
+            b = self._breaker.snapshot()
+            snap["breaker_state"] = b["state"]
+            snap["breaker_opens_total"] = b["opens_total"]
+            snap["breaker_failures_in_window"] = b["failures_in_window"]
         return snap
 
     def render_prometheus(self, model: str) -> str:
@@ -173,6 +195,9 @@ class ServingMetrics:
             f"{s['rejected_overload']}",
             f'serving_rejected_total{{model="{model}",reason="deadline"}} '
             f"{s['rejected_deadline']}",
+            f'serving_rejected_total{{model="{model}",reason="circuit_open"}} '
+            f"{s['rejected_circuit']}",
+            f"serving_retries_total{lbl} {s['retries_total']}",
             f"serving_errors_total{lbl} {s['errors_total']}",
             f"serving_batches_total{lbl} {s['batches_total']}",
             f"serving_batch_occupancy{lbl} {s['batch_occupancy']}",
@@ -184,4 +209,10 @@ class ServingMetrics:
             f"serving_queue_depth{lbl} {s['queue_depth']}",
             f"serving_xla_compile_count{lbl} {s['compile_count']}",
         ]
+        if "breaker_state" in s:
+            state_gauge = {"CLOSED": 0, "HALF_OPEN": 1, "OPEN": 2}.get(
+                s["breaker_state"], -1)
+            lines.append(f"serving_breaker_state{lbl} {state_gauge}")
+            lines.append(f"serving_breaker_opens_total{lbl} "
+                         f"{s['breaker_opens_total']}")
         return "\n".join(lines) + "\n"

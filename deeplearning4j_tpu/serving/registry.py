@@ -1,39 +1,125 @@
-"""Named/versioned model registry with hot-swap and per-model warmup.
+"""Named/versioned model registry with hot-swap, warmup, and failure
+containment.
 
 The front door of the serving subsystem: models are registered under a name
 (from a live ``MultiLayerNetwork``/``ComputationGraph``, a
 ``ModelSerializer`` zip archive, or a zoo class), each gets its own
 :class:`~deeplearning4j_tpu.serving.batcher.ContinuousBatcher` +
-:class:`~deeplearning4j_tpu.serving.metrics.ServingMetrics`, and
+:class:`~deeplearning4j_tpu.serving.metrics.ServingMetrics` + a per-model
+:class:`~deeplearning4j_tpu.serving.resilience.CircuitBreaker` and
+:class:`~deeplearning4j_tpu.serving.resilience.RetryPolicy`, and
 ``predict(name, x)`` routes traffic. Re-registering a name hot-swaps: the
 replacement is built and AOT-warmed *before* the swap, then the old
 batcher drains gracefully — in-flight and already-queued requests complete
 against the old version, new traffic hits the new one, and no compilation
 happens on the serving path during the cut-over.
+
+Failure semantics (chaos-hardened, ``tests/test_chaos.py``):
+
+- **Hot-swap rollback**: an exception during the replacement's build or
+  warmup propagates to the caller but leaves the OLD entry serving — the
+  swap is committed only after the replacement is fully warmed, so a
+  failed deploy never leaves a hole (or a half-swapped pair) in the
+  registry.
+- **Retry**: a transient batcher failure (model raised mid-batch) is
+  retried with exponential backoff + full jitter, up to
+  ``retry.max_attempts``. Explicit admission rejections (``Overloaded`` /
+  ``DeadlineExceeded`` / ``ServingShutdown``) are never retried.
+- **Circuit breaking**: repeated model failures open the per-model
+  breaker; while open, ``predict`` sheds instantly with
+  :class:`CircuitOpen` instead of queueing doomed work; after the reset
+  timeout one probe request decides whether to close it again.
+- **Health**: every served model exposes a
+  :class:`~deeplearning4j_tpu.serving.resilience.HealthState` for
+  ``/readyz`` (STARTING during build/warmup, READY, DEGRADED while the
+  breaker is not closed, DRAINING during undeploy/shutdown).
 """
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from deeplearning4j_tpu.runtime import chaos
+from deeplearning4j_tpu.serving.admission import ServingError
 from deeplearning4j_tpu.serving.batcher import ArrayOrDict, ContinuousBatcher
+from deeplearning4j_tpu.serving.resilience import (
+    CircuitBreaker,
+    CircuitOpen,
+    CircuitState,
+    HealthState,
+    RetryPolicy,
+)
+
+logger = logging.getLogger(__name__)
 
 
 class ServedModel:
-    """One registered (name, version) with its batcher and metrics."""
+    """One registered (name, version) with its batcher, metrics, breaker,
+    retry policy, and health state."""
 
-    def __init__(self, name: str, version: int, model, batcher: ContinuousBatcher):
+    def __init__(self, name: str, version: int, model,
+                 batcher: ContinuousBatcher,
+                 breaker: Optional[CircuitBreaker] = None,
+                 retry: Optional[RetryPolicy] = None):
         self.name = name
         self.version = int(version)
         self.model = model
         self.batcher = batcher
+        self.breaker = breaker or CircuitBreaker()
+        self.retry = retry or RetryPolicy()
         self.loaded_at = time.time()
+        self._draining = False
+        self._started = False  # flipped by the registry after the swap
+        self.batcher.metrics.attach_breaker(self.breaker)
 
     @property
     def metrics(self):
         return self.batcher.metrics
+
+    @property
+    def health(self) -> HealthState:
+        if self._draining:
+            return HealthState.DRAINING
+        if not self._started:
+            return HealthState.STARTING
+        if self.breaker.state is not CircuitState.CLOSED:
+            return HealthState.DEGRADED
+        return HealthState.READY
+
+    def predict(self, x: ArrayOrDict, timeout_ms: Optional[float] = None):
+        """One request through the batcher, wrapped in the breaker and the
+        retry policy. Raises :class:`CircuitOpen` when the breaker sheds,
+        admission errors unretried, or the last model error after the
+        retry budget is spent. Each attempt gets a fresh deadline."""
+        last_err: Optional[BaseException] = None
+        for attempt in range(self.retry.max_attempts):
+            if not self.breaker.allow():
+                self.metrics.record_rejection("circuit")
+                raise CircuitOpen(
+                    f"model {self.name!r} circuit is "
+                    f"{self.breaker.state.name}; shedding request"
+                ) from last_err
+            try:
+                out = self.batcher.submit(x, timeout_ms=timeout_ms)
+            except ServingError:
+                # explicit admission/drain rejection: not a model fault —
+                # does not trip the breaker, is not retried, and must
+                # return a half-open probe slot it may have consumed
+                self.breaker.record_discard()
+                raise
+            except BaseException as e:
+                self.breaker.record_failure()
+                last_err = e
+                if attempt + 1 < self.retry.max_attempts:
+                    self.metrics.record_retry()
+                    self.retry.sleep_before_retry(attempt)
+                continue
+            self.breaker.record_success()
+            return out
+        raise last_err
 
     def describe(self) -> Dict[str, Any]:
         return {
@@ -43,6 +129,8 @@ class ServedModel:
             "buckets": list(self.batcher.buckets),
             "max_batch_size": self.batcher.max_batch_size,
             "loaded_at": self.loaded_at,
+            "health": self.health.value,
+            "breaker": self.breaker.snapshot(),
             "metrics": self.metrics.snapshot(),
         }
 
@@ -57,25 +145,48 @@ class ModelRegistry:
     # ----------------------------------------------------------- register
     def register(self, name: str, model, version: Optional[int] = None,
                  warmup_example: Optional[ArrayOrDict] = None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 retry: Optional[RetryPolicy] = None,
                  **batcher_kw) -> ServedModel:
         """Serve ``model`` under ``name``. Re-registering an existing name
         hot-swaps (version auto-bumps unless given); the new batcher is
         warmed before it takes traffic and the old one drains gracefully.
-        ``batcher_kw`` forwards to :class:`ContinuousBatcher`
-        (``max_batch_size``, ``batch_timeout_ms``, ``queue_limit``,
-        ``buckets``, ``admission``)."""
+        A failure during the replacement's build/warmup leaves the old
+        entry serving (rollback guarantee). ``batcher_kw`` forwards to
+        :class:`ContinuousBatcher` (``max_batch_size``,
+        ``batch_timeout_ms``, ``queue_limit``, ``buckets``,
+        ``admission``)."""
+        chaos.inject("serving.registry.register")
         if model.train_state is None:
             model.init()
-        batcher = ContinuousBatcher(model, warmup_example=warmup_example,
-                                    **batcher_kw)
+        # Build + AOT-warm OUTSIDE the lock and BEFORE the swap: if this
+        # raises (bad config, warmup failure, injected chaos) nothing has
+        # been swapped — the previous version, if any, keeps serving.
+        try:
+            batcher = ContinuousBatcher(model, warmup_example=warmup_example,
+                                        **batcher_kw)
+        except BaseException:
+            logger.warning(
+                "register(%r): replacement build/warmup failed; previous "
+                "version (if any) keeps serving", name)
+            raise
+        served = ServedModel(name, 0, model, batcher,
+                             breaker=breaker, retry=retry)
         with self._lock:
             prev = self._models.get(name)
             if version is None:
                 version = prev.version + 1 if prev else 1
-            served = ServedModel(name, version, model, batcher)
+            served.version = int(version)
             self._models[name] = served
+            served._started = True  # STARTING -> READY at the swap point
         if prev is not None:
-            prev.batcher.shutdown(drain=True)
+            prev._draining = True
+            try:
+                prev.batcher.shutdown(drain=True)
+            except Exception:
+                logger.exception(
+                    "register(%r): drain of replaced v%d failed (new "
+                    "version is serving)", name, prev.version)
         return served
 
     def load(self, name: str, path: str, load_updater: bool = False,
@@ -106,10 +217,12 @@ class ModelRegistry:
 
     def predict(self, name: str, x: ArrayOrDict,
                 timeout_ms: Optional[float] = None):
-        """Route one request through ``name``'s batcher. Raises ``KeyError``
-        for unknown names, ``Overloaded``/``DeadlineExceeded`` under
-        pressure — never hangs on a registered model."""
-        return self.get(name).batcher.submit(x, timeout_ms=timeout_ms)
+        """Route one request through ``name``'s served model (breaker +
+        retry + batcher). Raises ``KeyError`` for unknown names,
+        ``Overloaded``/``DeadlineExceeded`` under pressure,
+        ``CircuitOpen`` while the breaker sheds — never hangs on a
+        registered model."""
+        return self.get(name).predict(x, timeout_ms=timeout_ms)
 
     # ---------------------------------------------------------- lifecycle
     def names(self) -> List[str]:
@@ -121,11 +234,30 @@ class ModelRegistry:
             served = list(self._models.values())
         return [s.describe() for s in served]
 
+    def health(self) -> Dict[str, str]:
+        """Per-model health map for ``/readyz``."""
+        with self._lock:
+            served = list(self._models.values())
+        return {s.name: s.health.value for s in served}
+
+    @staticmethod
+    def ready_from(health: Dict[str, str]) -> bool:
+        """Readiness derived from ONE health snapshot: at least one model
+        registered and every model READY (a DEGRADED/DRAINING/STARTING
+        model fails readiness so an orchestrator routes traffic
+        elsewhere; liveness is separate)."""
+        return bool(health) and all(v == HealthState.READY.value
+                                    for v in health.values())
+
+    def ready(self) -> bool:
+        return self.ready_from(self.health())
+
     def undeploy(self, name: str, drain: bool = True) -> None:
         with self._lock:
             served = self._models.pop(name, None)
         if served is None:
             raise KeyError(f"no model registered under {name!r}")
+        served._draining = True
         served.batcher.shutdown(drain=drain)
 
     def shutdown(self, drain: bool = True) -> None:
@@ -133,4 +265,5 @@ class ModelRegistry:
             served = list(self._models.values())
             self._models.clear()
         for s in served:
+            s._draining = True
             s.batcher.shutdown(drain=drain)

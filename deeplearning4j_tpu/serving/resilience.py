@@ -1,0 +1,192 @@
+"""Failure handling for the serving subsystem: circuit breaking, retries,
+health states.
+
+PR 1 gave serving admission control (load is handled); this module handles
+*failures*: a model that starts throwing must not take every request down
+with it, a transient fault must not surface to the client when one cheap
+retry would absorb it, and orchestration needs an honest readiness signal.
+
+- :class:`CircuitBreaker` — per-model three-state breaker. CLOSED counts
+  consecutive-within-window failures; at ``failure_threshold`` it OPENs
+  (requests shed instantly with :class:`CircuitOpen`, no compute wasted on
+  a known-bad model). After ``reset_timeout_s`` it goes HALF_OPEN and
+  admits up to ``half_open_probes`` probe requests: a probe success closes
+  the breaker, a probe failure re-opens it and restarts the timer.
+- :class:`RetryPolicy` — bounded retries with exponential backoff and
+  **full jitter** (delay ~ U[0, min(cap, base * 2^attempt)]), the
+  decorrelated schedule that avoids retry stampedes. Seedable so tests
+  and chaos drills replay exactly.
+- :class:`HealthState` — the per-model lifecycle surfaced on ``/readyz``:
+  STARTING (build/warmup in progress), READY, DEGRADED (breaker not
+  closed), DRAINING (undeploy/shutdown in progress).
+
+Admission rejections (``Overloaded`` / ``DeadlineExceeded`` /
+``ServingShutdown``) are *load* signals, not model faults: they never trip
+the breaker and are never retried here.
+"""
+
+from __future__ import annotations
+
+import enum
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from deeplearning4j_tpu.serving.admission import ServingError
+
+
+class CircuitOpen(ServingError):
+    """Request shed because the model's circuit breaker is open."""
+
+
+class CircuitState(enum.Enum):
+    CLOSED = 0
+    HALF_OPEN = 1
+    OPEN = 2
+
+
+class HealthState(enum.Enum):
+    STARTING = "starting"
+    READY = "ready"
+    DEGRADED = "degraded"
+    DRAINING = "draining"
+
+
+class CircuitBreaker:
+    """Three-state breaker (thread-safe).
+
+    ``failure_threshold`` failures within ``window_s`` (a success clears
+    the count — i.e. consecutive-within-window semantics) open the
+    circuit. ``clock`` is injectable so tests drive transitions without
+    sleeping.
+    """
+
+    def __init__(self, failure_threshold: int = 5, window_s: float = 30.0,
+                 reset_timeout_s: float = 5.0, half_open_probes: int = 1,
+                 clock: Callable[[], float] = time.monotonic):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = int(failure_threshold)
+        self.window_s = float(window_s)
+        self.reset_timeout_s = float(reset_timeout_s)
+        self.half_open_probes = int(half_open_probes)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CircuitState.CLOSED
+        self._failures: List[float] = []  # timestamps within window
+        self._opened_at: Optional[float] = None
+        self._probes_issued = 0
+        self.opens_total = 0
+
+    # ------------------------------------------------------------ internal
+    def _prune(self, now: float) -> None:
+        cutoff = now - self.window_s
+        self._failures = [t for t in self._failures if t > cutoff]
+
+    def _tick(self, now: float) -> None:
+        """OPEN -> HALF_OPEN once the reset timeout elapses."""
+        if (self._state is CircuitState.OPEN
+                and now - self._opened_at >= self.reset_timeout_s):
+            self._state = CircuitState.HALF_OPEN
+            self._probes_issued = 0
+
+    # ------------------------------------------------------------- queries
+    @property
+    def state(self) -> CircuitState:
+        with self._lock:
+            self._tick(self._clock())
+            return self._state
+
+    def allow(self) -> bool:
+        """May a request proceed right now? HALF_OPEN admits at most
+        ``half_open_probes`` in-flight probes (counted here)."""
+        with self._lock:
+            now = self._clock()
+            self._tick(now)
+            if self._state is CircuitState.CLOSED:
+                return True
+            if self._state is CircuitState.OPEN:
+                return False
+            if self._probes_issued < self.half_open_probes:
+                self._probes_issued += 1
+                return True
+            return False
+
+    # ------------------------------------------------------------ outcomes
+    def record_success(self) -> None:
+        with self._lock:
+            self._tick(self._clock())
+            if self._state is CircuitState.HALF_OPEN:
+                self._state = CircuitState.CLOSED
+            self._failures.clear()
+
+    def record_discard(self) -> None:
+        """The allowed request ended in an admission rejection (Overloaded
+        / DeadlineExceeded / ServingShutdown) — neither a model success nor
+        a model failure. Returns a half-open probe slot so an admission
+        rejection during HALF_OPEN cannot leak the probe and wedge the
+        breaker in a permanent shedding state."""
+        with self._lock:
+            if (self._state is CircuitState.HALF_OPEN
+                    and self._probes_issued > 0):
+                self._probes_issued -= 1
+
+    def record_failure(self) -> None:
+        with self._lock:
+            now = self._clock()
+            self._tick(now)
+            if self._state is CircuitState.HALF_OPEN:
+                # failed probe: back to OPEN, restart the timer
+                self._state = CircuitState.OPEN
+                self._opened_at = now
+                self.opens_total += 1
+                return
+            if self._state is CircuitState.OPEN:
+                return
+            self._failures.append(now)
+            self._prune(now)
+            if len(self._failures) >= self.failure_threshold:
+                self._state = CircuitState.OPEN
+                self._opened_at = now
+                self.opens_total += 1
+                self._failures.clear()
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            self._tick(self._clock())
+            return {"state": self._state.name,
+                    "failures_in_window": len(self._failures),
+                    "opens_total": self.opens_total}
+
+
+class RetryPolicy:
+    """Exponential backoff with full jitter (seedable, thread-safe enough:
+    the RNG is only read under the caller's request thread; determinism is
+    per-policy-instance for single-threaded drills)."""
+
+    def __init__(self, max_attempts: int = 3, base_delay_s: float = 0.02,
+                 max_delay_s: float = 1.0, seed: Optional[int] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = int(max_attempts)
+        self.base_delay_s = float(base_delay_s)
+        self.max_delay_s = float(max_delay_s)
+        self._rng = random.Random(seed)
+        self._sleep = sleep
+
+    def delay_for(self, attempt: int) -> float:
+        """Full jitter: U[0, min(max_delay, base * 2^attempt)] for the
+        delay AFTER failed attempt number ``attempt`` (0-based)."""
+        cap = min(self.max_delay_s, self.base_delay_s * (2 ** attempt))
+        return self._rng.uniform(0.0, cap)
+
+    def sleep_before_retry(self, attempt: int) -> float:
+        d = self.delay_for(attempt)
+        if d > 0:
+            self._sleep(d)
+        return d
+
+
+NO_RETRY = RetryPolicy(max_attempts=1)

@@ -6,7 +6,10 @@ Same dependency-free ``ThreadingHTTPServer`` pattern as ``ui/server.py``
 - ``GET  /v1/models``                  — registry listing + per-model metrics
 - ``GET  /v1/models/<name>``           — one model's description
 - ``POST /v1/models/<name>/predict``   — JSON inference
-- ``GET  /healthz``                    — liveness
+- ``GET  /healthz``                    — liveness (the process serves HTTP)
+- ``GET  /readyz``                     — readiness (every model READY; a
+  DEGRADED breaker-open model or an empty registry returns 503 so an
+  orchestrator routes traffic elsewhere)
 - ``GET  /metrics``                    — Prometheus text format
 
 Predict request body::
@@ -16,9 +19,11 @@ Predict request body::
     {"inputs": ..., "timeout_ms": 50}              # per-request deadline
 
 Admission-control semantics map onto status codes: ``503`` for
-``Overloaded`` (queue full — shed, retry elsewhere), ``504`` for
-``DeadlineExceeded``, ``404`` unknown model, ``400`` malformed body. Every
-response is explicit; nothing queues unboundedly behind the socket.
+``Overloaded`` (queue full — shed, retry elsewhere) and for
+``CircuitOpen`` (breaker shedding a failing model, ``reason`` field
+disambiguates), ``504`` for ``DeadlineExceeded``, ``404`` unknown model,
+``400`` malformed body. Every response is explicit; nothing queues
+unboundedly behind the socket.
 """
 
 from __future__ import annotations
@@ -32,6 +37,7 @@ import numpy as np
 
 from deeplearning4j_tpu.serving.admission import DeadlineExceeded, Overloaded
 from deeplearning4j_tpu.serving.registry import ModelRegistry
+from deeplearning4j_tpu.serving.resilience import CircuitOpen
 
 
 def _to_jsonable(out):
@@ -69,9 +75,13 @@ class ModelServer:
             return 404, {"error": f"model {name!r} not found",
                          "models": self.registry.names()}
         try:
-            out = served.batcher.submit(x, timeout_ms=timeout_ms)
+            out = served.predict(x, timeout_ms=timeout_ms)
+        except CircuitOpen as e:
+            return 503, {"error": "unavailable", "reason": "circuit_open",
+                         "detail": str(e)}
         except Overloaded as e:
-            return 503, {"error": "overloaded", "detail": str(e)}
+            return 503, {"error": "overloaded", "reason": "overloaded",
+                         "detail": str(e)}
         except DeadlineExceeded as e:
             return 504, {"error": "deadline exceeded", "detail": str(e)}
         except Exception as e:
@@ -81,7 +91,14 @@ class ModelServer:
 
     def _handle_get(self, path: str):
         if path == "/healthz":
+            # liveness only: the process is up and serving HTTP
             return 200, {"status": "ok", "models": self.registry.names()}
+        if path == "/readyz":
+            # one snapshot for both fields so they can never disagree
+            health = self.registry.health()
+            ready = self.registry.ready_from(health)
+            return (200 if ready else 503), {"ready": ready,
+                                             "models": health}
         if path == "/v1/models":
             return 200, {"models": self.registry.describe()}
         if path.startswith("/v1/models/"):

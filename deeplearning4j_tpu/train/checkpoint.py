@@ -6,15 +6,146 @@ plus a TPU-native addition the reference lacks: async, sharded checkpoints via
 orbax (``OrbaxCheckpointer``) so multi-host state saves without stalling the
 device. ``ModelSerializer`` zips remain the portable interchange format;
 orbax is the training-loop format (SURVEY.md §5.4).
+
+Crash safety (ISSUE 2): the reference's listener wrote archives in place —
+a crash mid-``model.save`` left a truncated zip that a restart would
+happily "restore". Here every archive is **atomic** (written to a tmp file
+in the same directory, fsynced, then ``os.replace``d into place, directory
+fsynced) and recorded in a per-directory CRC32 **manifest**
+(``checkpoint_manifest.json``, itself written atomically).
+:meth:`CheckpointListener.last_checkpoint_in` verifies candidates newest-
+first — manifest CRC/size, then zip structure — and falls back to the
+newest *valid* checkpoint, logging what it skipped, instead of returning
+the lexically-newest path blindly. ``keep_every``-skipped checkpoints are
+decided BEFORE saving: an archive destined for immediate deletion is never
+written at all (the seed saved then unlinked — wasted IO and a window
+where the newest file on disk was one scheduled for removal).
+
+Chaos injection points (``runtime.chaos``): ``train.checkpoint.write``
+fires before each archive write (fail/latency/hang policies);
+``train.checkpoint.bytes`` is the byte point for
+:class:`~deeplearning4j_tpu.runtime.chaos.CorruptBytes` — the manifest CRC
+is computed from the *intended* bytes, so an injected torn write or
+bit-flip is exactly what restore-time verification catches.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import time
-from typing import List, Optional
+import zipfile
+import zlib
+from typing import Dict, List, Optional
 
+from deeplearning4j_tpu.runtime import chaos
 from deeplearning4j_tpu.train.listeners import TrainingListener, logger
+
+MANIFEST_NAME = "checkpoint_manifest.json"
+
+
+def _checkpoint_index(filename: str) -> Optional[int]:
+    """``checkpoint_<idx>_<tag>.zip`` -> idx, else None (foreign files —
+    including the manifest — never break directory scans)."""
+    parts = filename.split("_")
+    if (len(parts) >= 3 and parts[0] == "checkpoint"
+            and filename.endswith(".zip") and parts[1].isdigit()):
+        return int(parts[1])
+    return None
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a directory so a rename within it survives power loss (no-op
+    on platforms whose dirs can't be opened)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _crc32_file(path: str) -> Dict[str, int]:
+    crc, size = 0, 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+            size += len(chunk)
+    return {"crc32": crc & 0xFFFFFFFF, "size": size}
+
+
+def atomic_save_model(model, path: str, save_updater: bool = True) -> Dict[str, int]:
+    """Crash-safe archive write: tmp file in the same directory (same
+    filesystem, so the final ``os.replace`` is atomic), fsync, replace,
+    directory fsync. Returns ``{"crc32", "size"}`` of the bytes *intended*
+    for disk — computed before the chaos byte point, so injected write
+    corruption is detectable against the returned digest."""
+    d, base = os.path.split(os.path.abspath(path))
+    tmp = os.path.join(d, f".{base}.tmp")
+    chaos.inject("train.checkpoint.write")
+    try:
+        model.save(tmp, save_updater=save_updater)
+        entry = _crc32_file(tmp)
+        if chaos.active():
+            with open(tmp, "rb") as f:
+                data = f.read()
+            corrupted = chaos.transform_bytes("train.checkpoint.bytes", data)
+            if corrupted is not data:
+                with open(tmp, "wb") as f:
+                    f.write(corrupted)
+        with open(tmp, "rb") as f:
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        raise
+    _fsync_dir(d)
+    return entry
+
+
+def load_manifest(dir: str) -> Dict[str, Dict[str, int]]:
+    try:
+        with open(os.path.join(dir, MANIFEST_NAME)) as f:
+            m = json.load(f)
+        return m if isinstance(m, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def write_manifest(dir: str, manifest: Dict[str, Dict[str, int]]) -> None:
+    path = os.path.join(dir, MANIFEST_NAME)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(dir)
+
+
+def verify_checkpoint(path: str,
+                      entry: Optional[Dict[str, int]] = None) -> bool:
+    """Is ``path`` a restorable archive? Checks the manifest entry's
+    size + CRC32 when given (catches silent bit rot), then the zip's own
+    structure and per-member CRCs (catches truncation with no manifest)."""
+    try:
+        if entry is not None:
+            actual = _crc32_file(path)
+            if (actual["size"] != entry.get("size")
+                    or actual["crc32"] != entry.get("crc32")):
+                return False
+        if not zipfile.is_zipfile(path):
+            return False
+        with zipfile.ZipFile(path) as zf:
+            return zf.testzip() is None
+    except (OSError, zipfile.BadZipFile):
+        return False
 
 
 class CheckpointListener(TrainingListener):
@@ -41,26 +172,45 @@ class CheckpointListener(TrainingListener):
         self.keep_last = keep_last
         self.keep_every = max(1, int(keep_every))
         self.save_updater = save_updater
+        # the supervisor disarms an abandoned (hung-then-revoked) worker's
+        # listener so a straggler step cannot write stale archives into a
+        # directory the restarted run is checkpointing into
+        self.armed = True
         self._last_time = time.time()
         self._saved: List[str] = []
-        self._count = 0
         os.makedirs(dir, exist_ok=True)
+        # Resume the checkpoint counter past anything already on disk: a
+        # fresh listener after a supervisor restart must not reuse index 0
+        # — that would overwrite the oldest archive with the NEWEST state
+        # while last_checkpoint_in's newest-by-counter ordering still
+        # preferred the stale higher indices.
+        indices = [i for i in map(_checkpoint_index, os.listdir(dir))
+                   if i is not None]
+        self._count = max(indices) + 1 if indices else 0
 
     def _save(self, model, tag: str) -> None:
-        path = os.path.join(self.dir, f"checkpoint_{self._count}_{tag}.zip")
-        model.save(path, save_updater=self.save_updater)
-        self._count += 1
-        if self._count % self.keep_every == 0:
-            self._saved.append(path)
-        else:
-            os.unlink(path)
+        if not self.armed:
             return
+        idx = self._count
+        self._count += 1
+        # keep_every is decided BEFORE saving: never write an archive
+        # destined for immediate deletion (the kept set matches the old
+        # save-then-unlink behaviour: every keep_every-th trigger)
+        if (idx + 1) % self.keep_every != 0:
+            return
+        path = os.path.join(self.dir, f"checkpoint_{idx}_{tag}.zip")
+        entry = atomic_save_model(model, path, save_updater=self.save_updater)
+        manifest = load_manifest(self.dir)
+        manifest[os.path.basename(path)] = entry
+        self._saved.append(path)
         logger.info("Saved checkpoint: %s", path)
         if self.keep_last:
             while len(self._saved) > self.keep_last:
                 old = self._saved.pop(0)
+                manifest.pop(os.path.basename(old), None)
                 if os.path.exists(old):
                     os.unlink(old)
+        write_manifest(self.dir, manifest)
 
     def iteration_done(self, model, iteration, epoch, score):
         if self.every_n_iterations and iteration % self.every_n_iterations == 0:
@@ -78,12 +228,33 @@ class CheckpointListener(TrainingListener):
 
     @staticmethod
     def last_checkpoint_in(dir: str) -> Optional[str]:
-        files = [f for f in os.listdir(dir)
-                 if f.startswith("checkpoint_") and f.endswith(".zip")]
+        """Newest *valid* checkpoint in ``dir``, or None.
+
+        Candidates are ordered newest-first by checkpoint counter; each is
+        verified (manifest CRC/size when recorded, zip structure always)
+        and unreadable/corrupt archives are skipped with a warning instead
+        of being handed to a restart that would restore garbage."""
+        try:
+            files = [f for f in os.listdir(dir)
+                     if _checkpoint_index(f) is not None]
+        except OSError:
+            return None
         if not files:
             return None
-        files.sort(key=lambda f: int(f.split("_")[1]))
-        return os.path.join(dir, files[-1])
+        files.sort(key=_checkpoint_index, reverse=True)
+        manifest = load_manifest(dir)
+        for f in files:
+            path = os.path.join(dir, f)
+            if verify_checkpoint(path, manifest.get(f)):
+                return path
+            logger.warning(
+                "Skipping unreadable/corrupt checkpoint %s (%s); falling "
+                "back to the previous one", path,
+                "manifest CRC/size mismatch or bad zip" if f in manifest
+                else "bad zip, no manifest entry")
+        logger.warning("No valid checkpoint found in %s (%d candidate(s) "
+                       "all corrupt)", dir, len(files))
+        return None
 
 
 class OrbaxCheckpointer:

@@ -22,8 +22,10 @@ from deeplearning4j_tpu.models import MultiLayerNetwork
 from deeplearning4j_tpu.models.serializer import ModelSerializer
 from deeplearning4j_tpu.nn import (DenseLayer, InputType,
                                    NeuralNetConfiguration, OutputLayer)
-from deeplearning4j_tpu.serving import (DeadlineExceeded, ModelRegistry,
-                                        ModelServer, Overloaded)
+from deeplearning4j_tpu.runtime.chaos import ChaosController, FailNth
+from deeplearning4j_tpu.serving import (CircuitOpen, DeadlineExceeded,
+                                        ModelRegistry, ModelServer,
+                                        Overloaded)
 from deeplearning4j_tpu.train import Adam
 
 SMOKE = os.environ.get("DL4J_TPU_EXAMPLES_SMOKE") == "1"
@@ -84,6 +86,24 @@ req = urllib.request.Request(
     f"http://127.0.0.1:{port}/v1/models/classifier/predict", data=body)
 resp = json.loads(urllib.request.urlopen(req).read())
 print("HTTP predict ->", np.asarray(resp["outputs"]).shape)
+
+# ---- resilience: readiness + a chaos drill through the breaker ---------
+ready = json.loads(urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/readyz").read())
+print("readyz ->", ready)
+assert ready == {"ready": True, "models": {"classifier": "ready"}}
+
+# inject one transient forward failure: the retry policy absorbs it and
+# the client still gets the exact answer (docs/robustness.md)
+with ChaosController(seed=1) as c:
+    c.on("serving.batcher.forward", FailNth(1))
+    try:
+        registry.predict("classifier", x[:2], timeout_ms=2000)
+        kind = "served (retry absorbed the injected failure)"
+    except CircuitOpen:
+        kind = "shed by the breaker"
+print("chaos drill ->", kind)
+print("breaker ->", served.breaker.snapshot())
 
 snap = served.metrics.snapshot()
 print(f"served {counts['ok']} ok / {counts['rejected']} rejected; "

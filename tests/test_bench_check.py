@@ -80,6 +80,25 @@ def test_check_tables_fails_on_missing_table_row(tmp_path):
     assert bench.check_tables(str(md), str(extra), log=lambda *a: None) == 1
 
 
+def test_chaos_smoke_zero_silent_wrong_answers(tmp_path):
+    """`bench.py --chaos-smoke` (ISSUE 2 satellite): a small run of the
+    sustained-load benchmark under the fixed seeded fault schedule must
+    account for every request (exact result or explicit error), trip and
+    recover the breaker, and export its counts into BENCH_EXTRA.json."""
+    extra_path = tmp_path / "BENCH_EXTRA.json"
+    msgs = []
+    rc = bench.chaos_smoke(n_threads=4, per_thread=15,
+                           bench_extra=str(extra_path), log=msgs.append)
+    assert rc == 0, f"chaos smoke failed: {msgs}"
+    out = json.loads(extra_path.read_text())["chaos_smoke"]
+    assert out["wrong"] == 0
+    assert out["hung_clients"] == 0
+    assert out["answered"] == out["total_requests"] == 60
+    assert out["ok"] > 0
+    assert out["breaker_opens_total"] >= 1
+    assert out["recovered_after_chaos"] is True
+
+
 def test_check_tables_missing_measurement_is_warning_not_failure(tmp_path):
     """A skipped bench section (e.g. BENCH_SKIP_BERT_IMPORT=1) must warn,
     not fail — only disagreement between recorded and measured numbers is

@@ -1,0 +1,611 @@
+"""Chaos engineering layer tests (ISSUE 2 tentpole): deterministic fault
+injection, circuit-broken serving, crash-safe checkpoints, supervised
+auto-resume.
+
+All tier-1 (CPU mesh, no ``slow`` marker). The acceptance criteria
+exercised here: a seeded fault schedule replays deterministically, the
+breaker opens and recovers, no request ever returns a wrong (non-exact)
+answer, a corrupted newest checkpoint is detected and training resumes
+from the previous valid one, and the supervisor stops retrying once the
+restart budget is exhausted.
+"""
+
+import os
+import threading
+import time
+import zipfile
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import NumpyDataSetIterator
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.runtime import chaos
+from deeplearning4j_tpu.runtime.chaos import (AddLatency, ChaosCancelled,
+                                              ChaosController, ChaosError,
+                                              CorruptBytes, FailNth,
+                                              FailWithProbability,
+                                              HangUntilCancelled)
+from deeplearning4j_tpu.serving import (CircuitBreaker, CircuitOpen,
+                                        CircuitState, HealthState,
+                                        ModelRegistry, ModelServer,
+                                        RetryPolicy)
+from deeplearning4j_tpu.train import (Adam, CollectScoresListener,
+                                      FaultTolerantTrainer, Sgd,
+                                      TrainingFailure)
+from deeplearning4j_tpu.train.checkpoint import (CheckpointListener,
+                                                 atomic_save_model,
+                                                 load_manifest,
+                                                 verify_checkpoint)
+
+
+def _mln_conf(seed=7, n_in=8, n_out=4):
+    return (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.1))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=n_out, activation="softmax"))
+            .set_input_type(InputType.feed_forward(n_in)).build())
+
+
+def _data(n=64, seed=0, dim=8):
+    rng = np.random.default_rng(seed)
+    return rng.normal(0, 1, (n, dim)).astype(np.float32)
+
+
+def _train_conf():
+    return (NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-2)).list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax"))
+            .set_input_type(InputType.feed_forward(8)).build())
+
+
+def _train_iter(n=96):
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 3, n)
+    x = (np.eye(3)[y] @ rng.normal(0, 1, (3, 8)) * 2
+         + rng.normal(0, 0.3, (n, 8))).astype(np.float32)
+    return NumpyDataSetIterator(x, np.eye(3, dtype=np.float32)[y],
+                                batch_size=32)
+
+
+# ------------------------------------------------------- chaos framework
+def test_noop_fast_path_and_scoping():
+    assert not chaos.active()
+    chaos.inject("anything")  # no controller: must be a silent no-op
+    data = b"payload"
+    assert chaos.transform_bytes("anything", data) is data
+    outer = ChaosController(seed=1).on("p", AddLatency(0.0))
+    with outer:
+        assert chaos.active()
+        inner = ChaosController(seed=2)
+        with inner:
+            # nesting: the inner controller shadows the outer one
+            chaos.inject("p")
+            assert outer.count("p") == 0, "outer must be shadowed"
+        chaos.inject("p")  # inner exited: outer is active again
+        assert outer.count("p") == 1
+    assert not chaos.active()
+
+
+def test_fail_nth_and_every_nth():
+    with ChaosController() as c:
+        c.on("pt", FailNth(3))
+        chaos.inject("pt")
+        chaos.inject("pt")
+        with pytest.raises(ChaosError, match="call #3"):
+            chaos.inject("pt")
+        chaos.inject("pt")  # only the 3rd fails
+    with ChaosController() as c:
+        c.on("pt", FailNth(2, every=True))
+        chaos.inject("pt")
+        with pytest.raises(ChaosError):
+            chaos.inject("pt")
+        chaos.inject("pt")
+        with pytest.raises(ChaosError):
+            chaos.inject("pt")
+
+
+def test_seeded_probability_schedule_replays_deterministically():
+    def run(seed):
+        fired = []
+        with ChaosController(seed=seed) as c:
+            c.on("pt", FailWithProbability(0.4))
+            for i in range(50):
+                try:
+                    chaos.inject("pt")
+                except ChaosError:
+                    fired.append(i)
+            return fired, list(c.events)
+
+    fired_a, events_a = run(11)
+    fired_b, events_b = run(11)
+    assert fired_a == fired_b, "same seed must replay the same schedule"
+    assert events_a == events_b
+    assert 0 < len(fired_a) < 50, "p=0.4 over 50 calls: some, not all"
+    fired_c, _ = run(12)
+    assert fired_a != fired_c, "different seed must give a different schedule"
+
+
+def test_latency_and_corrupt_bytes_policies():
+    with ChaosController(seed=3) as c:
+        c.on("lat", AddLatency(0.02))
+        t0 = time.monotonic()
+        chaos.inject("lat")
+        assert time.monotonic() - t0 >= 0.02
+        c.on("bytes.flip", CorruptBytes(n_bytes=4, mode="flip"))
+        c.on("bytes.cut", CorruptBytes(mode="truncate"))
+        c.on("bytes.third", CorruptBytes(mode="flip", nth=3))
+        data = bytes(range(256)) * 4
+        flipped = chaos.transform_bytes("bytes.flip", data)
+        assert flipped != data and len(flipped) == len(data)
+        cut = chaos.transform_bytes("bytes.cut", data)
+        assert len(cut) < len(data)
+        assert chaos.transform_bytes("bytes.third", data) is data  # call 1
+        assert chaos.transform_bytes("bytes.third", data) is data  # call 2
+        assert chaos.transform_bytes("bytes.third", data) != data  # call 3
+    # replay: the same seed corrupts identically
+    with ChaosController(seed=3) as c:
+        c.on("bytes.flip", CorruptBytes(n_bytes=4, mode="flip"))
+        assert chaos.transform_bytes("bytes.flip", data) == flipped
+
+
+def test_hang_until_cancelled_releases_on_scope_exit():
+    released = {}
+
+    def victim(controller):
+        try:
+            chaos.inject("hang")
+        except ChaosCancelled:
+            released["cancelled"] = True
+
+    c = ChaosController().on("hang", HangUntilCancelled(timeout_s=30))
+    with c:
+        t = threading.Thread(target=victim, args=(c,), daemon=True)
+        t.start()
+        time.sleep(0.1)
+        assert t.is_alive(), "victim must be hanging"
+    # scope exit cancels the hang
+    t.join(timeout=5)
+    assert not t.is_alive() and released.get("cancelled")
+
+
+# -------------------------------------------------------- circuit breaker
+def test_breaker_open_half_open_close_transitions():
+    now = [0.0]
+    b = CircuitBreaker(failure_threshold=3, window_s=10.0,
+                       reset_timeout_s=5.0, clock=lambda: now[0])
+    assert b.state is CircuitState.CLOSED and b.allow()
+    b.record_failure()
+    b.record_failure()
+    b.record_success()  # success clears the consecutive window
+    b.record_failure()
+    b.record_failure()
+    assert b.state is CircuitState.CLOSED
+    b.record_failure()  # third consecutive -> OPEN
+    assert b.state is CircuitState.OPEN
+    assert not b.allow() and b.opens_total == 1
+    now[0] = 4.9
+    assert not b.allow(), "reset timeout not yet elapsed"
+    now[0] = 5.1
+    assert b.state is CircuitState.HALF_OPEN
+    assert b.allow(), "half-open must admit a probe"
+    assert not b.allow(), "only half_open_probes probes admitted"
+    b.record_failure()  # probe failed -> OPEN again, timer restarts
+    assert b.state is CircuitState.OPEN and b.opens_total == 2
+    now[0] = 10.3
+    assert b.allow()  # half-open probe again
+    b.record_success()  # probe succeeded -> CLOSED
+    assert b.state is CircuitState.CLOSED and b.allow()
+
+
+def test_half_open_probe_slot_returned_on_admission_rejection():
+    """Review regression: an admission rejection (not a model outcome)
+    during HALF_OPEN must return the probe slot — otherwise the breaker
+    wedges in a permanent shedding state on a healthy model."""
+    now = [0.0]
+    b = CircuitBreaker(failure_threshold=1, reset_timeout_s=1.0,
+                       clock=lambda: now[0])
+    b.record_failure()  # OPEN
+    now[0] = 1.5
+    assert b.allow()  # the half-open probe slot is consumed
+    b.record_discard()  # …but the request was shed at admission
+    assert b.allow(), "probe slot must be available again"
+    b.record_success()
+    assert b.state is CircuitState.CLOSED
+
+
+def test_checkpoint_counter_resumes_past_existing_archives(tmp_path):
+    """Review regression: a fresh listener over an existing directory
+    (supervisor restart) must continue the counter, not reuse index 0 —
+    reuse would overwrite the OLDEST archive with the NEWEST state while
+    newest-by-counter ordering still preferred the stale high indices."""
+    net = MultiLayerNetwork(_mln_conf()).init()
+    first = CheckpointListener(str(tmp_path), every_n_iterations=1)
+    for it in range(1, 3):
+        first.iteration_done(net, it, 0, 0.0)
+    second = CheckpointListener(str(tmp_path), every_n_iterations=1)
+    second.iteration_done(net, 3, 0, 0.0)
+    zips = sorted(f for f in os.listdir(tmp_path) if f.endswith(".zip"))
+    assert zips == ["checkpoint_0_iter1.zip", "checkpoint_1_iter2.zip",
+                    "checkpoint_2_iter3.zip"]
+    assert CheckpointListener.last_checkpoint_in(str(tmp_path)) == \
+        os.path.join(tmp_path, "checkpoint_2_iter3.zip")
+
+
+def test_breaker_window_expires_old_failures():
+    now = [0.0]
+    b = CircuitBreaker(failure_threshold=2, window_s=1.0,
+                       clock=lambda: now[0])
+    b.record_failure()
+    now[0] = 2.0  # first failure ages out of the window
+    b.record_failure()
+    assert b.state is CircuitState.CLOSED
+
+
+def test_retry_policy_full_jitter_bounds_and_determinism():
+    r1 = RetryPolicy(max_attempts=5, base_delay_s=0.01, max_delay_s=0.05,
+                     seed=9)
+    r2 = RetryPolicy(max_attempts=5, base_delay_s=0.01, max_delay_s=0.05,
+                     seed=9)
+    d1 = [r1.delay_for(a) for a in range(5)]
+    d2 = [r2.delay_for(a) for a in range(5)]
+    assert d1 == d2, "seeded retry delays must replay"
+    for a, d in enumerate(d1):
+        assert 0.0 <= d <= min(0.05, 0.01 * 2 ** a)
+    with pytest.raises(ValueError):
+        RetryPolicy(max_attempts=0)
+
+
+# ------------------------------------------------- serving under chaos
+def test_registry_warmup_failure_rolls_back_to_old_version():
+    """Satellite regression: an injected warmup failure during hot-swap
+    must leave the OLD version serving — never an unregistered name or a
+    half-swapped pair."""
+    reg = ModelRegistry()
+    x = _data(16)
+    net1 = MultiLayerNetwork(_mln_conf(seed=1)).init()
+    net2 = MultiLayerNetwork(_mln_conf(seed=2)).init()
+    try:
+        reg.register("m", net1, warmup_example=x[:1], max_batch_size=8)
+        y1 = np.asarray(reg.predict("m", x[:2]))
+        with ChaosController() as c:
+            c.on("serving.batcher.warmup", FailNth(1))
+            with pytest.raises(ChaosError):
+                reg.register("m", net2, warmup_example=x[:1],
+                             max_batch_size=8)
+        served = reg.get("m")
+        assert served.version == 1 and served.model is net1
+        assert served.health is HealthState.READY
+        y_after = np.asarray(reg.predict("m", x[:2]))
+        assert (y_after == y1).all(), "old version must keep serving"
+        # and a later clean re-register still hot-swaps normally
+        served2 = reg.register("m", net2, warmup_example=x[:1],
+                               max_batch_size=8)
+        assert served2.version == 2
+    finally:
+        reg.shutdown()
+
+
+def test_retry_absorbs_transient_forward_failure():
+    reg = ModelRegistry()
+    net = MultiLayerNetwork(_mln_conf()).init()
+    ref = MultiLayerNetwork(_mln_conf()).init()
+    x = _data(8)
+    try:
+        served = reg.register(
+            "m", net, warmup_example=x[:1], max_batch_size=8,
+            retry=RetryPolicy(max_attempts=3, base_delay_s=0.001, seed=1))
+        with ChaosController() as c:
+            # warmup already done; the FIRST live forward fails once
+            c.on("serving.batcher.forward", FailNth(1))
+            got = np.asarray(reg.predict("m", x[:2]))
+        np.testing.assert_allclose(got, np.asarray(ref.output(x[:2])),
+                                   rtol=1e-5)
+        snap = served.metrics.snapshot()
+        assert snap["retries_total"] == 1
+        assert snap["errors_total"] == 1  # the failed attempt was recorded
+        assert served.breaker.state is CircuitState.CLOSED
+    finally:
+        reg.shutdown()
+
+
+def test_breaker_opens_sheds_and_recovers():
+    reg = ModelRegistry()
+    net = MultiLayerNetwork(_mln_conf()).init()
+    x = _data(8)
+    try:
+        served = reg.register(
+            "m", net, warmup_example=x[:1], max_batch_size=8,
+            breaker=CircuitBreaker(failure_threshold=3, window_s=30.0,
+                                   reset_timeout_s=0.2),
+            retry=RetryPolicy(max_attempts=1))
+        with ChaosController() as c:
+            c.on("serving.batcher.forward", FailNth(1, every=True))
+            for _ in range(3):  # trip the breaker
+                with pytest.raises(ChaosError):
+                    reg.predict("m", x[:1])
+            assert served.breaker.state is CircuitState.OPEN
+            assert served.health is HealthState.DEGRADED
+            # while OPEN: requests shed instantly with CircuitOpen, the
+            # model never runs (no new forward calls recorded)
+            before = c.count("serving.batcher.forward")
+            with pytest.raises(CircuitOpen):
+                reg.predict("m", x[:1])
+            assert c.count("serving.batcher.forward") == before
+        # chaos gone; after the reset timeout a half-open probe closes it
+        time.sleep(0.25)
+        got = np.asarray(reg.predict("m", x[:2]))
+        assert got.shape == (2, 4)
+        assert served.breaker.state is CircuitState.CLOSED
+        assert served.health is HealthState.READY
+        snap = served.metrics.snapshot()
+        assert snap["rejected_circuit"] == 1
+        assert snap["breaker_opens_total"] == 1
+        assert snap["breaker_state"] == "CLOSED"
+    finally:
+        reg.shutdown()
+
+
+def test_readyz_and_breaker_metrics_on_http_server():
+    import json
+    import urllib.error
+    import urllib.request
+
+    reg = ModelRegistry()
+    srv = ModelServer(reg)
+    port = srv.start(0)
+    base = f"http://127.0.0.1:{port}"
+    net = MultiLayerNetwork(_mln_conf()).init()
+    x = _data(8)
+    try:
+        # empty registry: alive but NOT ready
+        assert json.loads(urllib.request.urlopen(
+            f"{base}/healthz").read())["status"] == "ok"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/readyz")
+        assert ei.value.code == 503
+
+        served = reg.register(
+            "m", net, warmup_example=x[:1], max_batch_size=8,
+            breaker=CircuitBreaker(failure_threshold=1, reset_timeout_s=60),
+            retry=RetryPolicy(max_attempts=1))
+        ready = json.loads(urllib.request.urlopen(f"{base}/readyz").read())
+        assert ready == {"ready": True, "models": {"m": "ready"}}
+
+        # trip the breaker -> DEGRADED -> /readyz 503, predict 503 circuit
+        with ChaosController() as c:
+            c.on("serving.batcher.forward", FailNth(1, every=True))
+            body = json.dumps({"inputs": x[:1].tolist()}).encode()
+            req = urllib.request.Request(f"{base}/v1/models/m/predict",
+                                         data=body)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req)
+            assert ei.value.code == 500  # the failure itself
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req)
+            assert ei.value.code == 503  # now shed by the open breaker
+            assert json.loads(ei.value.read())["reason"] == "circuit_open"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/readyz")
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["models"]["m"] == "degraded"
+        metrics = urllib.request.urlopen(f"{base}/metrics").read().decode()
+        assert 'serving_breaker_state{model="m"} 2' in metrics
+        assert 'serving_breaker_opens_total{model="m"} 1' in metrics
+        assert ('serving_rejected_total{model="m",reason="circuit_open"} 1'
+                in metrics)
+        assert 'serving_retries_total{model="m"} 0' in metrics
+        assert served.describe()["health"] == "degraded"
+    finally:
+        srv.stop(shutdown_registry=True)
+
+
+# ------------------------------------------------ crash-safe checkpoints
+def test_keep_every_decides_before_saving(tmp_path, monkeypatch):
+    """Satellite: a keep_every-skipped checkpoint must never be written
+    (the seed saved the archive, then immediately unlinked it)."""
+    net = MultiLayerNetwork(_mln_conf()).init()
+    writes = []
+    orig_save = type(net).save
+
+    def counting_save(self, path, save_updater=True):
+        writes.append(path)
+        return orig_save(self, path, save_updater=save_updater)
+
+    monkeypatch.setattr(type(net), "save", counting_save)
+    lst = CheckpointListener(str(tmp_path), every_n_iterations=1,
+                             keep_every=3)
+    for it in range(1, 7):
+        lst.iteration_done(net, it, 0, 0.0)
+    # 6 triggers, keep_every=3 -> exactly 2 archives written, 2 on disk
+    assert len(writes) == 2
+    zips = sorted(f for f in os.listdir(tmp_path) if f.endswith(".zip"))
+    assert len(zips) == 2
+    assert not [f for f in os.listdir(tmp_path) if ".tmp" in f]
+
+
+def test_atomic_save_and_manifest(tmp_path):
+    net = MultiLayerNetwork(_mln_conf()).init()
+    lst = CheckpointListener(str(tmp_path), every_n_iterations=1,
+                             keep_last=2)
+    for it in range(1, 4):
+        lst.iteration_done(net, it, 0, 0.0)
+    zips = sorted(f for f in os.listdir(tmp_path) if f.endswith(".zip"))
+    assert len(zips) == 2  # keep_last retention
+    manifest = load_manifest(str(tmp_path))
+    assert sorted(manifest) == zips  # retention also prunes the manifest
+    for f in zips:
+        path = os.path.join(tmp_path, f)
+        assert verify_checkpoint(path, manifest[f])
+        with zipfile.ZipFile(path) as zf:
+            assert zf.testzip() is None
+
+
+def test_corrupt_newest_checkpoint_falls_back_to_valid(tmp_path, caplog):
+    net = MultiLayerNetwork(_mln_conf()).init()
+    lst = CheckpointListener(str(tmp_path), every_n_iterations=1)
+    lst.iteration_done(net, 1, 0, 0.0)
+    with ChaosController(seed=5) as c:
+        # torn write on the SECOND (newest) archive only
+        c.on("train.checkpoint.bytes", CorruptBytes(mode="truncate", nth=1))
+        lst.iteration_done(net, 2, 0, 0.0)
+    zips = sorted(f for f in os.listdir(tmp_path) if f.endswith(".zip"))
+    assert len(zips) == 2
+    newest = os.path.join(tmp_path, "checkpoint_1_iter2.zip")
+    manifest = load_manifest(str(tmp_path))
+    assert not verify_checkpoint(newest, manifest[os.path.basename(newest)])
+    import logging
+    with caplog.at_level(logging.WARNING, logger="deeplearning4j_tpu"):
+        best = CheckpointListener.last_checkpoint_in(str(tmp_path))
+    assert best == os.path.join(tmp_path, "checkpoint_0_iter1.zip")
+    assert any("Skipping unreadable/corrupt" in r.message
+               for r in caplog.records)
+    # the fallback checkpoint actually restores
+    restored = MultiLayerNetwork.load(best)
+    x = _data(4)
+    np.testing.assert_allclose(np.asarray(restored.output(x)),
+                               np.asarray(net.output(x)), rtol=1e-5)
+
+
+def test_truncated_zip_without_manifest_is_skipped(tmp_path):
+    """Even with no manifest (e.g. pre-upgrade checkpoint dir), a
+    truncated archive must be skipped via the zip's own structure."""
+    net = MultiLayerNetwork(_mln_conf()).init()
+    p0 = str(tmp_path / "checkpoint_0_iter1.zip")
+    p1 = str(tmp_path / "checkpoint_1_iter2.zip")
+    atomic_save_model(net, p0)
+    atomic_save_model(net, p1)
+    with open(p1, "rb") as f:
+        data = f.read()
+    with open(p1, "wb") as f:
+        f.write(data[:len(data) // 2])  # crash mid-write
+    assert not os.path.exists(
+        os.path.join(tmp_path, "checkpoint_manifest.json"))
+    assert CheckpointListener.last_checkpoint_in(str(tmp_path)) == p0
+
+
+def test_all_checkpoints_corrupt_returns_none(tmp_path):
+    net = MultiLayerNetwork(_mln_conf()).init()
+    p0 = str(tmp_path / "checkpoint_0_iter1.zip")
+    atomic_save_model(net, p0)
+    with open(p0, "wb") as f:
+        f.write(b"not a zip at all")
+    assert CheckpointListener.last_checkpoint_in(str(tmp_path)) is None
+
+
+# ------------------------------------------- supervised trainer under chaos
+def test_supervised_resume_matches_uninterrupted_trajectory(tmp_path):
+    """Mid-epoch crash + restore: the resumed run's loss trajectory must
+    match an uninterrupted run iteration-for-iteration (exact-resume
+    checkpoints + batch skipping on restart)."""
+    epochs = 4
+
+    # ---- uninterrupted reference run
+    ref_scores = CollectScoresListener()
+
+    def make_ref():
+        net = MultiLayerNetwork(_train_conf()).init()
+        net.set_listeners(ref_scores)
+        return net
+
+    FaultTolerantTrainer(make_ref, str(tmp_path / "ref"),
+                         every_n_iterations=1).fit(_train_iter(),
+                                                   epochs=epochs)
+
+    # ---- chaotic run: killed at iteration 5 (mid-epoch 1; 3 batches per
+    # epoch). ChaosListener runs FIRST so the score of the killed
+    # iteration is never recorded and the newest checkpoint is iteration
+    # 4 — the resume re-trains iteration 5 from the iter-4 state exactly.
+    scores = CollectScoresListener()
+
+    def make_net():
+        net = MultiLayerNetwork(_train_conf()).init()
+        net.set_listeners(chaos.ChaosListener(), scores)
+        return net
+
+    trainer = FaultTolerantTrainer(make_net, str(tmp_path / "ckpt"),
+                                   every_n_iterations=1, max_restarts=2)
+    with ChaosController() as c:
+        c.on("train.iteration", FailNth(5))
+        net = trainer.fit(_train_iter(), epochs=epochs)
+    assert trainer.restarts == 1
+    assert net._epoch == epochs
+
+    # iteration numbering must be gapless and duplicate-free across the
+    # crash (restore to iter 4 + skip the epoch's already-trained batch),
+    # and every post-resume loss must bit-match the uninterrupted run
+    assert [i for i, _ in scores.scores] == [i for i, _ in ref_scores.scores]
+    got = [s for _, s in scores.scores]
+    ref = [s for _, s in ref_scores.scores]
+    np.testing.assert_allclose(got, ref, rtol=0, atol=0)
+
+
+def test_restart_budget_window_exhaustion(tmp_path):
+    it = _train_iter()
+
+    def make_net():
+        net = MultiLayerNetwork(_train_conf()).init()
+        net.set_listeners(chaos.ChaosListener())
+        return net
+
+    trainer = FaultTolerantTrainer(make_net, str(tmp_path / "ckpt"),
+                                   every_n_iterations=2, max_restarts=2,
+                                   restart_window_s=60.0)
+    with ChaosController() as c:
+        c.on("train.iteration", FailNth(1, every=True))  # every iteration
+        with pytest.raises(TrainingFailure, match="giving up after 2 "
+                                                  "restarts in 60s"):
+            trainer.fit(it, epochs=2)
+    assert trainer.restarts == 3  # budget + the exhausting attempt
+
+
+def test_hung_training_detected_and_abandoned(tmp_path):
+    """A HANG (not an exception) must be caught by the heartbeat watchdog:
+    the supervisor abandons the stalled worker and the restart budget
+    escalates (the hang persists) as TrainingFailure."""
+    it = _train_iter()
+
+    def make_net():
+        return MultiLayerNetwork(_train_conf()).init()
+
+    trainer = FaultTolerantTrainer(make_net, str(tmp_path / "ckpt"),
+                                   every_n_iterations=2, max_restarts=1,
+                                   heartbeat_timeout_s=0.3)
+    with ChaosController() as c:
+        c.on("train.epoch", HangUntilCancelled(timeout_s=30))
+        t0 = time.monotonic()
+        with pytest.raises(TrainingFailure, match="giving up"):
+            trainer.fit(it, epochs=2)
+        elapsed = time.monotonic() - t0
+    assert trainer.restarts == 2
+    assert elapsed < 10, "watchdog must abandon the hang, not wait it out"
+
+
+def test_hang_recovers_when_fault_clears(tmp_path):
+    """Hang on the FIRST epoch attempt only; the supervisor abandons it,
+    restarts, and training completes normally."""
+    it = _train_iter()
+
+    def make_net():
+        return MultiLayerNetwork(_train_conf()).init()
+
+    class HangOnce(HangUntilCancelled):
+        def apply(self, point, index, rng, controller):
+            if index == 1:
+                return super().apply(point, index, rng, controller)
+            return None
+
+    # timeout generous enough that the first step's jit compile on a
+    # fresh net is not misread as a hang
+    trainer = FaultTolerantTrainer(make_net, str(tmp_path / "ckpt"),
+                                   every_n_iterations=2, max_restarts=2,
+                                   heartbeat_timeout_s=5.0)
+    with ChaosController() as c:
+        c.on("train.epoch", HangOnce(timeout_s=60))
+        net = trainer.fit(it, epochs=2)
+    assert trainer.restarts == 1
+    assert net._epoch == 2
+    ev = net.evaluate(it)
+    assert ev.accuracy() > 0.8
