@@ -879,6 +879,204 @@ def chaos_smoke(seed=7, n_threads=6, per_thread=25, bench_extra=None,
     return 0
 
 
+# ------------------------------------------------------------ serving bench
+def bench_serving(n_threads=32, per_thread=40, bench_extra=None, log=_log):
+    """``bench.py --serving`` (ISSUE 3): sustained-load A/B of the
+    pipelined multi-replica executor against the synchronous PR-1 loop
+    (``pipeline_depth=0``, one replica) on the same workload and
+    identically-seeded weights. Asserts (a) pipelined throughput >=
+    synchronous, (b) every pipelined response bit-identical to
+    ``model.output`` at one of the buckets that could have served it,
+    (c) XLA compiles <= buckets x replicas. Writes ``serving_qps`` /
+    ``serving_p99_ms`` plus the full A/B to
+    ``BENCH_EXTRA.json["serving"]``. Returns a process exit code.
+
+    ``device_idle_fraction`` is approximate: busy time is the sum of
+    per-batch forward->readback latencies over ``elapsed x replicas``
+    (readback overlap inflates "busy" slightly, so idle is a floor).
+    """
+    import threading
+
+    import jax
+
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.serving import ContinuousBatcher
+
+    def conf(s=7):
+        # wide enough that device time dominates python dispatch — the
+        # regime where overlapping host batching with execution pays
+        return (NeuralNetConfiguration.builder().seed(s).updater(None)
+                .list()
+                .layer(DenseLayer(n_out=1024, activation="relu"))
+                .layer(DenseLayer(n_out=1024, activation="relu"))
+                .layer(DenseLayer(n_out=1024, activation="relu"))
+                .layer(OutputLayer(n_out=8, activation="softmax"))
+                .set_input_type(InputType.feed_forward(256)).build())
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (256, 256)).astype(np.float32)
+    ref = MultiLayerNetwork(conf()).init()
+    total = n_threads * per_thread
+    sizes = [1 + (k % 4) for k in range(total)]
+    offsets = [(k * 7) % 200 for k in range(total)]
+
+    def run_load(batcher):
+        outcomes = []
+        lock = threading.Lock()
+
+        def client(i):
+            for j in range(per_thread):
+                k = i * per_thread + j
+                ofs, n = offsets[k], sizes[k]
+                try:
+                    got = np.asarray(batcher.submit(x[ofs:ofs + n],
+                                                    timeout_ms=60_000))
+                    with lock:
+                        outcomes.append(("ok", k, got))
+                except Exception as e:
+                    with lock:
+                        outcomes.append((type(e).__name__, k, None))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n_threads)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        elapsed = time.monotonic() - t0
+        hung = sum(t.is_alive() for t in threads)
+        return outcomes, elapsed, hung
+
+    def pad_rows(a, b):
+        return np.concatenate(
+            [a, np.zeros((b - a.shape[0],) + a.shape[1:], a.dtype)], axis=0)
+
+    results = {}
+    failures = []
+    n_rep = min(2, len(jax.local_devices()))
+    # Both arms are built and warmed UP FRONT, then measured in
+    # order-alternated rounds (s,p / p,s — the ab_speedup lesson: the box
+    # drifts between fast and slow regimes on a minutes scale, so
+    # back-to-back pairs see the same regime and the comparison stays
+    # clean; per-arm best-of discards the noisy windows).
+    arm_kw = {"synchronous": dict(replicas=1, pipeline_depth=0),
+              "pipelined": dict(replicas=n_rep, pipeline_depth=4)}
+    arms = {}
+    for tag, kw in arm_kw.items():
+        net = MultiLayerNetwork(conf()).init()  # fresh jit cache per arm
+        # saturating workload: enough closed-loop clients that the window
+        # fills immediately and execution — not the coalesce wait — is the
+        # bottleneck (the regime the pipeline exists for)
+        b = ContinuousBatcher(net, max_batch_size=32, batch_timeout_ms=1.0,
+                              queue_limit=4096, warmup_example=x[:1], **kw)
+        # warm the python path once so neither arm pays first-call overhead
+        for n in (1, 2, 3, 4):
+            b.submit(x[:n])
+        arms[tag] = b
+    best = {}
+    all_ok = {tag: [] for tag in arms}
+    for pair in (("synchronous", "pipelined"),
+                 ("pipelined", "synchronous")):
+        for tag in pair:
+            b = arms[tag]
+            wait_for_quiet_host()
+            b.metrics.reset_window()
+            outcomes, elapsed, hung = run_load(b)
+            busy = b.metrics.batch_latency.sum  # forward->readback seconds
+            round_snap = b.metrics.snapshot()
+            all_ok[tag].extend(o for o in outcomes if o[0] == "ok")
+            if hung or len(outcomes) != total:
+                failures.append(f"{tag}: {hung} hung clients, "
+                                f"{len(outcomes)}/{total} accounted")
+            if tag not in best or elapsed < best[tag][1]:
+                best[tag] = (outcomes, elapsed, busy, round_snap)
+
+    # bitwise exactness of EVERY ok response from every round, against the
+    # reference at every feasible bucket (memoized: distinct
+    # (ofs, n, bucket) inputs number ~hundreds, responses thousands)
+    ref_cache = {}
+
+    def ref_at(ofs, n, bk):
+        key = (ofs, n, bk)
+        if key not in ref_cache:
+            ref_cache[key] = np.asarray(
+                ref.output(pad_rows(x[ofs:ofs + n], bk)))[:n]
+        return ref_cache[key]
+
+    for tag, b in arms.items():
+        kw = arm_kw[tag]
+        outcomes, elapsed, busy_s, snap = best[tag]
+        compiles = b.compile_count()
+        buckets = list(b.buckets)
+        b.shutdown()
+        ok = [o for o in outcomes if o[0] == "ok"]
+        wrong = 0
+        for _, k, got in all_ok[tag]:
+            ofs, n = offsets[k], sizes[k]
+            if not any((got == ref_at(ofs, n, bk)).all()
+                       for bk in buckets if bk >= n):
+                wrong += 1
+        if wrong:
+            failures.append(f"{tag}: {wrong} responses not bit-identical")
+        bound = len(buckets) * kw["replicas"]
+        if compiles > bound:
+            failures.append(f"{tag}: {compiles} compiles > bound {bound}")
+        results[tag] = {
+            "qps": round(len(ok) / elapsed, 1),
+            "rows_per_sec": round(sum(sizes[k] for _, k, _ in ok) / elapsed),
+            "elapsed_s": round(elapsed, 3),
+            "ok": len(ok), "rejected": total - len(ok),
+            "p50_ms": round(snap["latency_p50_s"] * 1e3, 2),
+            "p99_ms": round(snap["latency_p99_s"] * 1e3, 2),
+            "dispatch_to_completion_p99_ms": round(
+                snap["dispatch_p99_s"] * 1e3, 2),
+            "batches": snap["batches_total"],
+            "replica_batches": snap["replica_batches"],
+            "compile_count": compiles, "compile_bound": bound,
+            "replicas": kw["replicas"], "pipeline_depth": kw["pipeline_depth"],
+            "device_idle_fraction": round(max(
+                0.0, 1.0 - busy_s / (elapsed * kw["replicas"])), 3),
+        }
+        log(f"[serving] {tag}: {results[tag]['qps']} req/s "
+            f"({results[tag]['rows_per_sec']} rows/s), p50 "
+            f"{results[tag]['p50_ms']} ms p99 {results[tag]['p99_ms']} ms, "
+            f"{snap['batches_total']} batches on {kw['replicas']} "
+            f"replica(s), {compiles}/{bound} compiles, device idle "
+            f"~{results[tag]['device_idle_fraction']:.0%}")
+
+    sync_qps = results["synchronous"]["qps"]
+    pipe_qps = results["pipelined"]["qps"]
+    results["speedup"] = round(pipe_qps / max(sync_qps, 1e-9), 3)
+    if pipe_qps < sync_qps:
+        failures.append(f"pipelined ({pipe_qps} req/s) slower than "
+                        f"synchronous ({sync_qps} req/s)")
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    bench_extra = bench_extra or os.path.join(here, "BENCH_EXTRA.json")
+    try:
+        with open(bench_extra) as f:
+            extra = json.load(f)
+    except Exception:
+        extra = {}
+    extra["serving"] = results
+    extra["serving_qps"] = pipe_qps
+    extra["serving_p99_ms"] = results["pipelined"]["p99_ms"]
+    with open(bench_extra, "w") as f:
+        json.dump(extra, f, indent=2)
+
+    for fmsg in failures:
+        log(f"[serving] FAIL {fmsg}")
+    if failures:
+        return 1
+    log(f"[serving] OK: pipelined {pipe_qps} req/s >= synchronous "
+        f"{sync_qps} req/s ({results['speedup']}x), every response exact, "
+        f"compiles bounded")
+    return 0
+
+
 # ------------------------------------------------------------------- resnet
 def bench_resnet():
     import jax
@@ -1269,4 +1467,13 @@ if __name__ == "__main__":
         sys.exit(check_tables())
     if "--chaos-smoke" in sys.argv:
         sys.exit(chaos_smoke())
+    if "--serving" in sys.argv:
+        # give the CPU backend multiple virtual devices so the replica arm
+        # is real even off-TPU (flag only affects the host platform; must
+        # be set before the first backend initialization)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        sys.exit(bench_serving())
     main()

@@ -4,10 +4,13 @@ Rebuild of upstream ``org.deeplearning4j.parallelism.ParallelInference``:
 the reference keeps N model replicas with worker threads and a dynamic
 batching observable (``BatchedInferenceObservable``). Here the dynamic
 batcher is :class:`~deeplearning4j_tpu.serving.batcher.ContinuousBatcher`
-— ``ParallelInference`` is its single-model degenerate case, kept as the
-reference-shaped API (``Builder``, ``output()``, ``shutdown()``). The full
-serving subsystem (registry, admission control, HTTP front end, SLO
-metrics) lives in :mod:`deeplearning4j_tpu.serving`.
+— ``ParallelInference`` is its single-model case, kept as the
+reference-shaped API (``Builder``, ``output()``, ``shutdown()``) — and
+``Builder.workers(n)`` means what it means upstream: N *real* model
+replicas, here as device-resident parameter copies served least-loaded by
+the batcher's :class:`~deeplearning4j_tpu.serving.replica.ReplicaPool`
+(ISSUE 3). The full serving subsystem (registry, admission control, HTTP
+front end, SLO metrics) lives in :mod:`deeplearning4j_tpu.serving`.
 
 Semantics inherited from the shared batcher (fixes two seed bugs):
 
@@ -42,13 +45,21 @@ class ParallelInference:
 
     def __init__(self, model, strategy: Optional[ShardingStrategy] = None,
                  max_batch_size: int = 32, queue_limit: int = 256,
-                 batch_timeout_ms: float = 2.0):
+                 batch_timeout_ms: float = 2.0, workers: int = 1,
+                 pipeline_depth: int = 2):
         self.model = model
         self.strategy = strategy  # kept for API parity; forward is one jit
         self.max_batch_size = int(max_batch_size)
         self._batcher = ContinuousBatcher(
             model, max_batch_size=max_batch_size, queue_limit=queue_limit,
-            batch_timeout_ms=batch_timeout_ms)
+            batch_timeout_ms=batch_timeout_ms, replicas=workers,
+            pipeline_depth=pipeline_depth)
+
+    @property
+    def workers(self) -> int:
+        """Actual replica count (requested workers clamped to the local
+        device count)."""
+        return self._batcher.replica_count
 
     class Builder:
         """Reference ``ParallelInference.Builder`` surface."""
@@ -67,6 +78,18 @@ class ParallelInference:
 
         def queue_limit(self, n: int):
             self._kw["queue_limit"] = int(n)
+            return self
+
+        def workers(self, n: int):
+            """Reference ``workers(n)``: N device replicas of the model,
+            routed least-loaded (clamped to the local device count)."""
+            self._kw["workers"] = int(n)
+            return self
+
+        def pipeline_depth(self, n: int):
+            """Batches allowed in flight between dispatch and readback
+            (0 = synchronous)."""
+            self._kw["pipeline_depth"] = int(n)
             return self
 
         def inference_mode(self, mode: str):

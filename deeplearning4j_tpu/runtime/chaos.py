@@ -36,10 +36,13 @@ Design constraints:
 
 Catalogue of injection points threaded through the stack (see
 ``docs/robustness.md``): ``serving.batcher.submit``,
-``serving.batcher.forward``, ``serving.batcher.warmup``,
-``serving.registry.register``, ``train.checkpoint.write`` (call),
-``train.checkpoint.bytes`` (byte point), ``train.epoch``,
-``train.iteration`` (via :class:`ChaosListener`).
+``serving.batcher.forward`` (dispatch stage — fires as the batch is issued
+to a replica), ``serving.batcher.complete`` (completion stage — fires
+before the blocking readback, so ``AddLatency`` here simulates a slow
+device and fills the pipeline's in-flight window),
+``serving.batcher.warmup``, ``serving.registry.register``,
+``train.checkpoint.write`` (call), ``train.checkpoint.bytes`` (byte
+point), ``train.epoch``, ``train.iteration`` (via :class:`ChaosListener`).
 """
 
 from __future__ import annotations

@@ -8,9 +8,16 @@ The subsystem that puts traffic on this stack:
   pre-warmed replacements and graceful drain.
 - :class:`ContinuousBatcher` (``batcher.py``) — coalesces concurrent
   requests and pads to a fixed set of power-of-two row buckets, AOT-warmed
-  at load, so XLA compilations are bounded by the bucket count instead of
-  growing with traffic. ``parallel.ParallelInference`` is the single-model
-  degenerate case of this batcher.
+  at load, so XLA compilations are bounded by ``buckets x replicas``
+  instead of growing with traffic. The executor is a staged pipeline
+  (coalesce -> async dispatch -> completion readback) that overlaps host
+  batching with device execution; ``parallel.ParallelInference`` is the
+  single-model case of this batcher and its ``workers(n)`` means real
+  device replicas.
+- :class:`ReplicaPool` (``replica.py``) — N device-resident parameter
+  copies of one model, least-loaded routing, async per-device dispatch
+  through the model's own jitted ``output`` trace (bit-identical results,
+  shared compile ledger).
 - :class:`AdmissionController` (``admission.py``) — per-request deadlines,
   queue limits, and load shedding with explicit :class:`Overloaded` /
   :class:`DeadlineExceeded` rejections instead of unbounded queueing.
@@ -47,6 +54,8 @@ _EXPORTS = {
     "ModelRegistry": "registry",
     "ServedModel": "registry",
     "ModelServer": "server",
+    "Replica": "replica",
+    "ReplicaPool": "replica",
     "CircuitBreaker": "resilience",
     "CircuitOpen": "resilience",
     "CircuitState": "resilience",

@@ -1,39 +1,59 @@
-"""Shape-bucketed continuous batcher.
+"""Shape-bucketed continuous batcher with a pipelined executor.
 
 The seed's ``ParallelInference`` coalesced concurrent requests into whatever
 total row count happened to arrive — so every distinct coalesced size was a
 fresh XLA compilation, and a long-running server would keep compiling for as
 long as traffic kept producing new sizes. Here coalesced batches are padded
 up to a fixed set of power-of-two row buckets that are AOT-warmed at model
-load, so the number of compilations is bounded by the bucket count, not by
-traffic. Padding rows are dead weight (row-wise inference ops never couple
-rows at inference time — BN uses running stats).
+load, so the number of compilations is bounded by ``buckets x replicas``,
+not by traffic. Padding rows are dead weight (row-wise inference ops never
+couple rows at inference time — BN uses running stats).
+
+PR-1's executor was a single synchronous loop: coalesce -> host pad ->
+forward -> **blocking readback** -> scatter, then back to coalescing. The
+device idled during every host stage and the host idled during
+execute+readback. This version splits it into stages that overlap:
+
+1. **Coalescer/dispatcher** (one thread): blocking ``queue.get`` (no idle
+   polling — shutdown uses a sentinel), coalesces a window, copies request
+   rows into a *preallocated per-bucket pad buffer* (no per-batch
+   ``np.zeros`` + ``np.concatenate``), checks deadlines at coalesce AND
+   again at dispatch, then issues the forward on the least-loaded
+   :class:`~deeplearning4j_tpu.serving.replica.ReplicaPool` replica
+   WITHOUT blocking on the result — JAX async dispatch queues the work
+   per device.
+2. **In-flight window**: at most ``pipeline_depth`` dispatched batches may
+   await readback (a semaphore — the backpressure that bounds memory and
+   keeps deadline checks honest). ``pipeline_depth=0`` degenerates to the
+   PR-1 synchronous loop (the A/B baseline ``bench.py --serving`` uses).
+3. **Completion** (one thread): blocking readback, scatter rows to
+   requests, record metrics (incl. the dispatch-to-completion histogram
+   and per-replica batch counts), return the pad buffer to its pool.
+
+A failure anywhere — an injected ``serving.batcher.forward`` /
+``serving.batcher.complete`` chaos fault, a real device error at readback —
+fails only that batch's requests; later batches keep flowing.
 
 Exactness contract: a request of ``n`` rows served at bucket ``b`` returns
 ``model.output(pad_to_b(x))[:n]`` **bit-for-bit** — at a fixed program
 shape a row's result is independent of its neighbors and of its offset in
-the batch (verified empirically in ``tests/test_serving.py``). Across
+the batch, and a replica executes the model's own jitted ``output`` trace
+(same HLO, deterministic XLA codegen per backend), so this holds on every
+replica (verified empirically in ``tests/test_serving.py``). Across
 *different* program shapes XLA codegen may legitimately differ in the last
 ulp (e.g. a 1-row matvec path vs the same row inside a 16-row matmul on
 CPU), so "identical to a solo ``model.output`` call at the request's own
 shape" holds to ~1 ulp, not bitwise — that is XLA numerics, not batching.
-
-Also fixes two seed bugs (ISSUE satellites):
-
-- the coalesce window is ONE deadline for the whole batch, not a fresh
-  ``batch_timeout_s`` per ``queue.get`` (worst case used to be
-  ``max_batch_size x timeout`` of added latency under a slow trickle);
-- ``shutdown()`` drains queued-but-unbatched requests and fails them with
-  :class:`~deeplearning4j_tpu.serving.admission.ServingShutdown` instead of
-  leaving their callers blocked forever.
 """
 
 from __future__ import annotations
 
+import itertools
+import logging
 import queue
 import threading
 import time
-from typing import Dict, List, Optional, Sequence, Union
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -45,8 +65,13 @@ from deeplearning4j_tpu.serving.admission import (
     ServingShutdown,
 )
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
+from deeplearning4j_tpu.serving.replica import Replica, ReplicaPool
 
 ArrayOrDict = Union[np.ndarray, Dict[str, np.ndarray]]
+
+logger = logging.getLogger(__name__)
+
+_SENTINEL = object()  # queue wake-up token: shutdown/drain, never a request
 
 
 def default_buckets(max_batch_size: int) -> List[int]:
@@ -73,12 +98,33 @@ class _Request:
         self.error: Optional[BaseException] = None
 
 
+class _InFlight:
+    """One dispatched batch awaiting readback."""
+
+    __slots__ = ("requests", "rows", "bucket", "replica", "out", "buffers",
+                 "forward_at", "dispatched_at")
+
+    def __init__(self, requests, rows, bucket, replica, out, buffers,
+                 forward_at, dispatched_at):
+        self.requests: List[_Request] = requests
+        self.rows = rows
+        self.bucket = bucket
+        self.replica: Replica = replica
+        self.out = out                    # device array(s), not yet read back
+        self.buffers = buffers            # [(pool_key, np buffer), ...]
+        self.forward_at = forward_at      # just before the forward was issued
+        self.dispatched_at = dispatched_at  # when dispatch returned
+
+
 class ContinuousBatcher:
     """Continuous batching over one model (MLN or ComputationGraph).
 
     Thread-safe: any number of threads call :meth:`submit` concurrently; a
-    single worker thread coalesces, pads to a bucket, runs the model's own
-    jitted ``output`` (sharing its compile cache) and scatters results.
+    coalescer thread forms bucketed batches and dispatches them onto device
+    replicas without blocking on readback; a completion thread scatters
+    results. ``replicas=N`` serves from N device-resident parameter copies
+    (least-loaded routing); ``pipeline_depth`` bounds the dispatched-but-
+    unread batches in flight (0 = synchronous PR-1 behaviour).
 
     Inputs: a single array for ``MultiLayerNetwork``-style models, or a
     ``{input_name: array}`` dict for multi-input ``ComputationGraph``s.
@@ -89,7 +135,9 @@ class ContinuousBatcher:
                  buckets: Optional[Sequence[int]] = None,
                  admission: Optional[AdmissionController] = None,
                  metrics: Optional[ServingMetrics] = None,
-                 warmup_example: Optional[ArrayOrDict] = None):
+                 warmup_example: Optional[ArrayOrDict] = None,
+                 replicas: int = 1, pipeline_depth: int = 2,
+                 devices: Optional[Sequence] = None):
         self.model = model
         if model.train_state is None:
             model.init()
@@ -97,32 +145,66 @@ class ContinuousBatcher:
         self.batch_timeout_s = float(batch_timeout_ms) / 1000.0
         self.buckets = sorted(set(int(b) for b in
                                   (buckets or default_buckets(max_batch_size))))
+        self.pipeline_depth = max(0, int(pipeline_depth))
         self.admission = admission or AdmissionController(queue_limit=queue_limit)
         self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self._pool = ReplicaPool(model, n_replicas=replicas, devices=devices)
         self.metrics = metrics or ServingMetrics(
             queue_depth_fn=self._queue.qsize,
-            compile_count_fn=self.compile_count)
+            compile_count_fn=self.compile_count,
+            inflight_fn=self._pool.total_in_flight)
         self._graph_inputs = list(getattr(model.conf, "inputs", []) or [])
         self._shutdown = False
         self._draining = False
+        self._saw_sentinel = False
         self._carry: Optional[_Request] = None  # deferred overflow request
         self._submit_lock = threading.Lock()  # vs shutdown: no orphan enqueues
+        self._example: Optional[ArrayOrDict] = None  # 1-row zeros template
+        self._batch_seq = itertools.count(1)  # failure keys (breaker dedup)
+        # pad-buffer pools: (bucket, input, shape, dtype) -> free np buffers
+        self._buf_lock = threading.Lock()
+        self._buf_pool: Dict[tuple, List[np.ndarray]] = {}
+        # at most `depth` dispatched-unread batches; completion releases
+        self._slots = (threading.BoundedSemaphore(self.pipeline_depth)
+                       if self.pipeline_depth >= 1 else None)
+        self._completion_q: "queue.Queue[_InFlight]" = queue.Queue()
+        self._completion_lock = threading.Lock()
+        self._completion_closed = False  # set once shutdown drained the queue
         if warmup_example is not None:
             self.warmup(warmup_example)
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name="ContinuousBatcher")
+        self._completer: Optional[threading.Thread] = None
+        if self.pipeline_depth >= 1:
+            self._completer = threading.Thread(
+                target=self._complete_loop, daemon=True,
+                name="ContinuousBatcher-complete")
+            self._completer.start()
         self._worker.start()
+
+    # -------------------------------------------------------------- replicas
+    @property
+    def replica_count(self) -> int:
+        return len(self._pool)
 
     # ------------------------------------------------------------ warmup
     def warmup(self, example: ArrayOrDict) -> int:
-        """AOT-compile every bucket size with zero rows shaped like
-        ``example`` (any leading row count). Returns the number of buckets
-        warmed. After this, steady-state traffic triggers no compilation."""
+        """AOT-compile every (bucket, replica) program with zero rows shaped
+        like ``example`` (any leading row count), and preallocate one pad
+        buffer per bucket. Returns the number of programs warmed. After
+        this, steady-state traffic triggers no compilation."""
         chaos.inject("serving.batcher.warmup")
         example = self._normalize(example)[0]
-        for b in self.buckets:
-            self._forward(self._zeros_with_rows(example, b))
-        return len(self.buckets)
+        self._example = self._zeros_with_rows(example, 1)
+        n = 0
+        for rep in self._pool.replicas:
+            for b in self.buckets:
+                self._pool.forward_blocking(
+                    rep, self._zeros_with_rows(example, b))
+                n += 1
+        for b in self.buckets:  # preallocate the pad buffers
+            self._release_buffers(self._gather([], 0, b, template=example)[1])
+        return n
 
     @staticmethod
     def _zeros_with_rows(x: ArrayOrDict, rows: int) -> ArrayOrDict:
@@ -133,7 +215,8 @@ class ContinuousBatcher:
 
     def compile_count(self) -> int:
         """XLA compilations behind this model's inference path: the sum of
-        jit-cache entry counts of every cached ``output`` function."""
+        jit-cache entry counts of every cached ``output`` function. A warmed
+        pipeline holds exactly ``len(buckets) x replica_count`` entries."""
         n = 0
         for key, fn in getattr(self.model, "_jit_cache", {}).items():
             if str(key).startswith("output@") and hasattr(fn, "_cache_size"):
@@ -179,15 +262,29 @@ class ContinuousBatcher:
             raise req.error
         return req.result
 
-    # ------------------------------------------------------------ worker
+    # ----------------------------------------------------------- coalesce
+    @staticmethod
+    def _sig(x: ArrayOrDict):
+        """Coalescing signature: feature shape + dtype per input. Only
+        same-signature requests may share a pad buffer — a dtype mismatch
+        would silently cast one request's rows into the other's buffer
+        dtype (the replaced np.concatenate promoted instead), and a shape
+        mismatch would poison the whole window."""
+        if isinstance(x, dict):
+            return tuple(sorted((k, v.shape[1:], v.dtype.str)
+                                for k, v in x.items()))
+        return (x.shape[1:], x.dtype.str)
+
     def _collect(self, first: _Request) -> List[_Request]:
         """Coalesce: one deadline for the WHOLE window (seed bug: a fresh
         ``batch_timeout_s`` per ``queue.get`` meant worst-case added latency
         of ``max_batch_size x timeout`` under a slow trickle). A request
-        that would push the batch past ``max_batch_size`` is carried into
-        the next window instead of overflowing into a bigger bucket."""
+        that would push the batch past ``max_batch_size`` — or one whose
+        shape/dtype signature differs from the window's — is carried into
+        the next window instead of overflowing or poisoning this one."""
         batch = [first]
         total = first.rows
+        sig = self._sig(first.x)
         deadline = time.monotonic() + self.batch_timeout_s
         while total < self.max_batch_size:
             remaining = deadline - time.monotonic()
@@ -197,7 +294,11 @@ class ContinuousBatcher:
                 nxt = self._queue.get(timeout=remaining)
             except queue.Empty:
                 break
-            if total + nxt.rows > self.max_batch_size:
+            if nxt is _SENTINEL:
+                self._saw_sentinel = True
+                break
+            if (total + nxt.rows > self.max_batch_size
+                    or self._sig(nxt.x) != sig):
                 self._carry = nxt
                 break
             batch.append(nxt)
@@ -209,108 +310,299 @@ class ContinuousBatcher:
             if rows <= b:
                 return b
         # oversized single request (rows > max bucket): round up to the next
-        # power of two and remember it, so the compile bound stays truthful
+        # power of two, remember it, and warm it on every replica NOW — the
+        # creating request pays the compile once and the bound
+        # `compiles <= buckets x replicas` stays truthful for later traffic
         # (only the worker thread touches self.buckets after construction)
         b = self.buckets[-1]
         while b < rows:
             b *= 2
         self.buckets = sorted(set(self.buckets + [b]))
+        self._warm_bucket(b)
         return b
 
+    def _warm_bucket(self, b: int) -> None:
+        if self._example is None:
+            return  # never warmed and no traffic yet: first dispatch compiles
+        for rep in self._pool.replicas:
+            self._pool.forward_blocking(rep, self._zeros_with_rows(
+                self._example, b))
+
+    # ---------------------------------------------------------- pad buffers
+    def _acquire_buf(self, bucket: int, name, like: np.ndarray):
+        k = (bucket, name, like.shape[1:], like.dtype.str)
+        with self._buf_lock:
+            free = self._buf_pool.get(k)
+            if free:
+                return k, free.pop()
+        return k, np.empty((bucket,) + like.shape[1:], like.dtype)
+
+    def _release_buffers(self, buffers) -> None:
+        # a buffer returns only after its batch's readback completed, so
+        # device execution can no longer be reading it (safe even when the
+        # backend aliased the host buffer instead of copying)
+        cap = self.pipeline_depth + 2
+        with self._buf_lock:
+            for k, buf in buffers:
+                free = self._buf_pool.setdefault(k, [])
+                if len(free) < cap:
+                    free.append(buf)
+
+    def _gather(self, live: List[_Request], rows: int, bucket: int,
+                template: Optional[ArrayOrDict] = None
+                ) -> Tuple[ArrayOrDict, list]:
+        """Copy request rows into a pooled per-bucket pad buffer and zero
+        the tail — replaces PR-1's per-batch ``np.concatenate`` +
+        ``np.zeros`` allocations. Bit-identical to pad(concat(rows))."""
+        template = template if template is not None else live[0].x
+        held = []
+        if isinstance(template, dict):
+            x = {}
+            for name, v in template.items():
+                k, buf = self._acquire_buf(bucket, name, v)
+                ofs = 0
+                for r in live:
+                    buf[ofs:ofs + r.rows] = r.x[name]
+                    ofs += r.rows
+                if ofs < bucket:
+                    buf[ofs:] = 0
+                x[name] = buf
+                held.append((k, buf))
+            return x, held
+        k, buf = self._acquire_buf(bucket, None, template)
+        ofs = 0
+        for r in live:
+            buf[ofs:ofs + r.rows] = r.x
+            ofs += r.rows
+        if ofs < bucket:
+            buf[ofs:] = 0
+        return buf, [(k, buf)]
+
+    # ------------------------------------------------------------ dispatch
     def _forward(self, x: ArrayOrDict):
+        """Issue the forward on the least-loaded replica; returns
+        ``(device_out, replica)`` WITHOUT blocking on readback."""
         chaos.inject("serving.batcher.forward")
-        if isinstance(x, dict):
-            names = self._graph_inputs or sorted(x)
-            return self.model.output(*[x[n] for n in names])
-        return self.model.output(x)
+        replica = self._pool.acquire()
+        try:
+            out = self._pool.dispatch(replica, x)
+        except BaseException:
+            self._pool.release(replica)
+            raise
+        return out, replica
 
-    @staticmethod
-    def _pad(x: ArrayOrDict, rows: int, bucket: int) -> ArrayOrDict:
-        pad = bucket - rows
-        if pad == 0:
-            return x
-        if isinstance(x, dict):
-            return {k: np.concatenate(
-                [v, np.zeros((pad,) + v.shape[1:], v.dtype)], axis=0)
-                for k, v in x.items()}
-        return np.concatenate(
-            [x, np.zeros((pad,) + x.shape[1:], x.dtype)], axis=0)
-
-    @staticmethod
-    def _concat(parts: List[ArrayOrDict]) -> ArrayOrDict:
-        if isinstance(parts[0], dict):
-            return {k: np.concatenate([p[k] for p in parts], axis=0)
-                    for k in parts[0]}
-        return np.concatenate(parts, axis=0)
-
-    def _execute(self, batch: List[_Request]) -> None:
+    def _expire(self, batch: List[_Request], stage: str) -> List[_Request]:
         now = time.monotonic()
         live: List[_Request] = []
         for r in batch:
             if r.deadline is not None and now > r.deadline:
                 r.error = DeadlineExceeded(
                     f"deadline passed {now - r.deadline:.3f}s before "
-                    f"execution (queued {now - r.enqueued_at:.3f}s)")
+                    f"execution at the {stage} stage "
+                    f"(queued {now - r.enqueued_at:.3f}s)")
                 self.metrics.record_rejection("deadline")
                 r.event.set()
             else:
                 live.append(r)
+        return live
+
+    def _tag_failure(self, e: BaseException) -> None:
+        """Stamp a per-batch key so the circuit breaker can count one
+        faulted batch once, not once per coalesced request. Stamped
+        UNCONDITIONALLY: a chaos policy may raise the same exception
+        instance for every hit, and a stale key from an earlier batch
+        would make the breaker dedup real repeated failures (and never
+        open under a sustained fault)."""
+        try:
+            e._serving_failure_key = f"batch-{id(self)}-{next(self._batch_seq)}"
+        except Exception:
+            pass  # exceptions with __slots__: breaker falls back to per-request
+
+    def _fail(self, requests: List[_Request], e: BaseException) -> None:
+        self._tag_failure(e)
+        for r in requests:
+            r.error = e
+            self.metrics.record_rejection("error")
+            r.event.set()
+
+    def _abort(self, requests: List[_Request], e: BaseException,
+               buffers=(), replica=None, slot_held: bool = False,
+               reuse_buffers: bool = False) -> None:
+        """Fail ONE batch and release whatever it held. ``reuse_buffers``
+        may only be True when the forward was never dispatched — a
+        dispatched execution may still be reading an (aliased) pad buffer,
+        so those are dropped for GC instead of returned to the pool."""
+        if reuse_buffers:
+            self._release_buffers(buffers)
+        if replica is not None:
+            self._pool.release(replica)
+        if slot_held and self._slots is not None:
+            self._slots.release()
+        self._fail(requests, e)
+
+    def _dispatch(self, batch: List[_Request]) -> None:
+        live = self._expire(batch, "coalesce")
         if not live:
             return
+        slot_held = False
+        buffers: list = []
+        out = replica = None
         try:
+            if self._example is None:
+                self._example = self._zeros_with_rows(live[0].x, 1)
+            if self._slots is not None:
+                # backpressure: wait for an in-flight slot (bounded poll
+                # so a hard shutdown can't strand us here)
+                while not self._slots.acquire(timeout=0.1):
+                    if self._shutdown:
+                        self._fail(live, ServingShutdown(
+                            "batcher shut down before this batch was "
+                            "dispatched"))
+                        return
+                slot_held = True
+                # a slot wait can outlive a deadline: re-check at dispatch
+                live = self._expire(live, "dispatch")
+                if not live:
+                    self._slots.release()
+                    return
             rows = sum(r.rows for r in live)
-            bucket = self._bucket_for(rows)
-            x = self._pad(self._concat([r.x for r in live]), rows, bucket)
-            t0 = time.monotonic()
-            out = self._forward(x)
+            bucket = self._bucket_for(rows)      # may mint + warm a bucket
+            x, buffers = self._gather(live, rows, bucket)
+            forward_at = time.monotonic()
+            out, replica = self._forward(x)
+        except BaseException as e:
+            # fail only this batch — a bad request mix (inconsistent
+            # feature shapes, missing dict input key), a failed bucket
+            # warm, or an injected fault must not kill the coalescer
+            # (PR-1 kept the equivalent _execute body inside try too)
+            self._abort(live, e, buffers=buffers, replica=replica,
+                        slot_held=slot_held, reuse_buffers=out is None)
+            return
+        rec = _InFlight(live, rows, bucket, replica, out, buffers,
+                        forward_at, time.monotonic())
+        if self._slots is None:
+            self._complete(rec)          # synchronous (PR-1) mode
+            return
+        with self._completion_lock:
+            if not self._completion_closed:
+                self._completion_q.put(rec)
+                return
+        # shutdown already drained the completion queue (this worker
+        # outlived its join timeout): nobody will ever read this record —
+        # fail it here instead of stranding its callers
+        self._abort(live, ServingShutdown(
+            "batcher shut down before this batch could complete"),
+            buffers=buffers, replica=replica, slot_held=True)
+
+    # ---------------------------------------------------------- completion
+    def _complete(self, rec: _InFlight) -> None:
+        try:
+            chaos.inject("serving.batcher.complete")
+            out = rec.out
             if isinstance(out, (list, tuple)):
-                out = [np.asarray(o) for o in out]
+                out = [np.asarray(o) for o in out]   # blocking readback
             else:
                 out = np.asarray(out)
             t1 = time.monotonic()
-            self.metrics.record_batch(rows, bucket, t1 - t0)
+            # readback done => the execution can no longer be reading the
+            # pad buffers; only NOW may they return to the pool
+            self._release_buffers(rec.buffers)
+            self.metrics.record_batch(rec.rows, rec.bucket,
+                                      t1 - rec.forward_at,
+                                      replica=rec.replica.index)
+            self.metrics.record_dispatch(t1 - rec.dispatched_at)
             ofs = 0
-            for r in live:
+            for r in rec.requests:
                 sl = slice(ofs, ofs + r.rows)
                 r.result = ([o[sl] for o in out]
                             if isinstance(out, list) else out[sl])
                 ofs += r.rows
                 self.metrics.record_response(t1 - r.enqueued_at)
         except BaseException as e:
-            for r in live:
+            # fault before/at readback: execution state unknown, so the
+            # buffers are dropped for GC, not pooled (an aliased buffer
+            # must never be rewritten under an in-flight execution)
+            self._tag_failure(e)
+            for r in rec.requests:
                 r.error = e
                 self.metrics.record_rejection("error")
         finally:
-            for r in live:
+            self._pool.release(rec.replica)
+            if self._slots is not None:
+                self._slots.release()
+            for r in rec.requests:
                 r.event.set()
 
+    def _complete_loop(self) -> None:
+        while True:
+            rec = self._completion_q.get()
+            if rec is _SENTINEL:
+                break
+            self._complete(rec)
+
+    # -------------------------------------------------------------- worker
     def _run(self) -> None:
         while True:
             if self._shutdown:
                 break
             if self._carry is not None:
                 first, self._carry = self._carry, None
+            elif self._saw_sentinel:
+                break  # drained: everything before the sentinel is served
             else:
-                try:
-                    first = self._queue.get(timeout=0.05)
-                except queue.Empty:
-                    if self._draining:
-                        break
-                    continue
-            self._execute(self._collect(first))
+                first = self._queue.get()  # blocking — no idle busy-wake
+                if first is _SENTINEL:
+                    break
+            batch = self._collect(first)
+            try:
+                self._dispatch(batch)
+            except BaseException as e:  # last resort: _dispatch fails its
+                # own batch internally; whatever still escapes must fail
+                # the batch, never kill the coalescer thread
+                logger.exception("unexpected error dispatching a batch")
+                self._fail([r for r in batch if not r.event.is_set()], e)
 
     # ---------------------------------------------------------- shutdown
     def shutdown(self, drain: bool = True, timeout_s: float = 5.0) -> None:
-        """Stop the worker. ``drain=True`` (default) serves whatever is
-        already queued first; either way every still-pending request gets an
-        explicit :class:`ServingShutdown` error — no caller hangs (seed bug:
+        """Stop the pipeline. ``drain=True`` (default) serves whatever is
+        already queued AND waits for every in-flight batch to read back;
+        either way every still-pending request gets an explicit
+        :class:`ServingShutdown` error — no caller hangs (seed bug:
         queued-but-unbatched requests never got ``event.set()``)."""
         with self._submit_lock:
             if drain:
                 self._draining = True
             else:
                 self._shutdown = True
+        self._queue.put(_SENTINEL)  # wake the blocking coalescer
         self._worker.join(timeout=timeout_s)
+        if self._completer is not None:
+            self._completion_q.put(_SENTINEL)
+            self._completer.join(timeout=timeout_s)
+            # No record may be left for a consumer that will never read it
+            # ("no caller hangs" contract). Close the queue (a straggling
+            # worker now fails its own batches at dispatch), then drain:
+            # if the completer exited cleanly, finish stragglers inline;
+            # if it is WEDGED (hung readback), do not attempt more
+            # readbacks — fail the queued batches explicitly instead.
+            with self._completion_lock:
+                self._completion_closed = True
+            wedged = self._completer.is_alive()
+            while True:
+                try:
+                    rec = self._completion_q.get_nowait()
+                except queue.Empty:
+                    break
+                if rec is _SENTINEL:
+                    continue
+                if wedged:
+                    self._abort(rec.requests, ServingShutdown(
+                        "batcher completion stage wedged at shutdown; "
+                        "this batch was dispatched but never read back"),
+                        buffers=rec.buffers, replica=rec.replica,
+                        slot_held=True)
+                else:
+                    self._complete(rec)
         with self._submit_lock:
             self._shutdown = True
             self._draining = True
@@ -320,10 +612,17 @@ class ContinuousBatcher:
             self._carry = None
         while True:
             try:
-                leftovers.append(self._queue.get_nowait())
+                item = self._queue.get_nowait()
             except queue.Empty:
                 break
+            if item is not _SENTINEL:
+                leftovers.append(item)
         for r in leftovers:
             r.error = ServingShutdown(
                 "batcher shut down before this request was served")
             r.event.set()
+        # a worker that outlived its join timeout may have re-parked in the
+        # blocking get AFTER the drain above swallowed the first sentinel;
+        # leave one more so it can never be parked forever
+        if self._worker.is_alive():
+            self._queue.put(_SENTINEL)

@@ -4,7 +4,9 @@ The reference stack exposes serving health through the konduit model-server's
 Prometheus endpoint; here the same signals — request latency percentiles,
 QPS, queue depth, batch occupancy, rejection counts, and XLA compile counts —
 are collected in-process and rendered on ``/metrics`` in Prometheus text
-format. :class:`LatencyHistogram` is deliberately stdlib-only so
+format. The pipelined executor (ISSUE 3) adds its own observability: an
+in-flight depth gauge (dispatched batches awaiting readback), per-replica
+batch counts, and a dispatch-to-completion latency histogram. :class:`LatencyHistogram` is deliberately stdlib-only so
 ``runtime.profiler`` can reuse it for section-latency percentiles without
 pulling the serving stack into the training import graph.
 """
@@ -72,7 +74,8 @@ class ServingMetrics:
     """Per-model serving counters, gauges and histograms (thread-safe)."""
 
     def __init__(self, queue_depth_fn: Optional[Callable[[], int]] = None,
-                 compile_count_fn: Optional[Callable[[], int]] = None):
+                 compile_count_fn: Optional[Callable[[], int]] = None,
+                 inflight_fn: Optional[Callable[[], int]] = None):
         self._lock = threading.Lock()
         self.started_at = time.monotonic()
         self.requests_total = 0          # admitted into the queue
@@ -87,8 +90,13 @@ class ServingMetrics:
         self.rows_padded_total = 0       # post-padding rows executed
         self.request_latency = LatencyHistogram()
         self.batch_latency = LatencyHistogram()
+        # pipeline observability (ISSUE 3): time from async dispatch to
+        # readback completion, and which replica served each batch
+        self.dispatch_latency = LatencyHistogram()
+        self.replica_batches: Dict[int, int] = {}
         self._queue_depth_fn = queue_depth_fn or (lambda: 0)
         self._compile_count_fn = compile_count_fn or (lambda: 0)
+        self._inflight_fn = inflight_fn or (lambda: 0)
         self._breaker = None             # CircuitBreaker, attached post-init
         # 60-slot per-second ring for windowed QPS
         self._qps_slots = [0] * 60
@@ -132,12 +140,36 @@ class ServingMetrics:
         self._breaker = breaker
 
     def record_batch(self, real_rows: int, padded_rows: int,
-                     latency_s: float) -> None:
+                     latency_s: float, replica: Optional[int] = None) -> None:
         with self._lock:
             self.batches_total += 1
             self.rows_real_total += int(real_rows)
             self.rows_padded_total += int(padded_rows)
             self.batch_latency.observe(latency_s)
+            if replica is not None:
+                self.replica_batches[int(replica)] = \
+                    self.replica_batches.get(int(replica), 0) + 1
+
+    def record_dispatch(self, latency_s: float) -> None:
+        """Dispatch-to-completion: async dispatch returned -> readback done
+        (device queue wait + execution + readback for one batch)."""
+        with self._lock:
+            self.dispatch_latency.observe(latency_s)
+
+    def reset_window(self) -> None:
+        """Start a fresh measurement window: zero the latency histograms,
+        batch counters and per-replica counts. Cumulative service totals
+        (requests/responses/rejections) keep counting. Benchmarks call
+        this between rounds so percentiles describe ONE load window, not
+        warmup plus every discarded round."""
+        with self._lock:
+            self.request_latency = LatencyHistogram()
+            self.batch_latency = LatencyHistogram()
+            self.dispatch_latency = LatencyHistogram()
+            self.replica_batches = {}
+            self.batches_total = 0
+            self.rows_real_total = 0
+            self.rows_padded_total = 0
 
     # -------------------------------------------------------------- reading
     @property
@@ -173,11 +205,15 @@ class ServingMetrics:
                 "latency_p99_s": req_lat.percentile(99),
                 "latency_mean_s": req_lat.mean,
                 "batch_latency_p50_s": bat_lat.percentile(50),
+                "dispatch_p50_s": self.dispatch_latency.percentile(50),
+                "dispatch_p99_s": self.dispatch_latency.percentile(99),
+                "replica_batches": dict(self.replica_batches),
                 "uptime_s": round(time.monotonic() - self.started_at, 3),
             }
         snap["qps_10s"] = self.qps(10)
         snap["queue_depth"] = int(self._queue_depth_fn())
         snap["compile_count"] = int(self._compile_count_fn())
+        snap["inflight_depth"] = int(self._inflight_fn())
         if self._breaker is not None:
             b = self._breaker.snapshot()
             snap["breaker_state"] = b["state"]
@@ -208,7 +244,17 @@ class ServingMetrics:
             f"serving_qps{lbl} {s['qps_10s']}",
             f"serving_queue_depth{lbl} {s['queue_depth']}",
             f"serving_xla_compile_count{lbl} {s['compile_count']}",
+            f"serving_inflight_depth{lbl} {s['inflight_depth']}",
+            f'serving_dispatch_to_completion_seconds'
+            f'{{model="{model}",quantile="0.5"}} {s["dispatch_p50_s"]}',
+            f'serving_dispatch_to_completion_seconds'
+            f'{{model="{model}",quantile="0.99"}} {s["dispatch_p99_s"]}',
         ]
+        for idx in sorted(s["replica_batches"]):
+            lines.append(
+                f'serving_replica_batches_total'
+                f'{{model="{model}",replica="{idx}"}} '
+                f"{s['replica_batches'][idx]}")
         if "breaker_state" in s:
             state_gauge = {"CLOSED": 0, "HALF_OPEN": 1, "OPEN": 2}.get(
                 s["breaker_state"], -1)

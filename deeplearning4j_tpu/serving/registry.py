@@ -111,7 +111,11 @@ class ServedModel:
                 self.breaker.record_discard()
                 raise
             except BaseException as e:
-                self.breaker.record_failure()
+                # the batcher stamps one key per faulted batch so N
+                # coalesced requests sharing a fault count once (see
+                # CircuitBreaker.record_failure)
+                self.breaker.record_failure(
+                    key=getattr(e, "_serving_failure_key", None))
                 last_err = e
                 if attempt + 1 < self.retry.max_attempts:
                     self.metrics.record_retry()
@@ -128,6 +132,8 @@ class ServedModel:
             "model_type": type(self.model).__name__,
             "buckets": list(self.batcher.buckets),
             "max_batch_size": self.batcher.max_batch_size,
+            "replicas": self.batcher.replica_count,
+            "pipeline_depth": self.batcher.pipeline_depth,
             "loaded_at": self.loaded_at,
             "health": self.health.value,
             "breaker": self.breaker.snapshot(),
@@ -150,12 +156,14 @@ class ModelRegistry:
                  **batcher_kw) -> ServedModel:
         """Serve ``model`` under ``name``. Re-registering an existing name
         hot-swaps (version auto-bumps unless given); the new batcher is
-        warmed before it takes traffic and the old one drains gracefully.
+        warmed before it takes traffic and the old one drains gracefully —
+        queued requests are served AND every already-dispatched in-flight
+        batch reads back against the old version before its pipeline stops.
         A failure during the replacement's build/warmup leaves the old
         entry serving (rollback guarantee). ``batcher_kw`` forwards to
         :class:`ContinuousBatcher` (``max_batch_size``,
-        ``batch_timeout_ms``, ``queue_limit``, ``buckets``,
-        ``admission``)."""
+        ``batch_timeout_ms``, ``queue_limit``, ``buckets``, ``admission``,
+        ``replicas``, ``pipeline_depth``)."""
         chaos.inject("serving.registry.register")
         if model.train_state is None:
             model.init()

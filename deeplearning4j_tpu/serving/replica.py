@@ -1,0 +1,183 @@
+"""Device replicas for the serving pipeline.
+
+The reference's ``ParallelInference`` keeps N model *replicas*, each with a
+worker thread, and routes requests to whichever is free. On this stack a
+replica is cheaper and stronger: the model's parameters are ``device_put``
+onto one local device, and the model's own jitted ``output`` function —
+retrieved through the same ``_jitted("output", ...)`` cache the model uses,
+so serving and direct ``model.output`` calls share one compile ledger —
+executes on whichever device its committed arguments live on. One python
+callable, N executables, no per-replica threads: JAX's async dispatch
+queues work per device, so a :class:`ReplicaPool` plus the batcher's
+dispatch stage is the whole replica machinery.
+
+Placement/compile accounting: a committed-parameter call compiles one
+executable per (argument shapes, device) pair, so a warmed pool holds
+exactly ``len(buckets) x len(replicas)`` entries in the output function's
+jit cache — the bound ``ContinuousBatcher.compile_count`` reports against.
+
+Parameters are snapshotted (``device_put`` copies) at pool construction:
+a served model's weights are frozen for the lifetime of its batcher, and
+the supported update path is the registry's hot-swap (build + warm a new
+batcher, then drain the old one).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+
+ArrayOrDict = Union[np.ndarray, Dict[str, np.ndarray]]
+
+logger = logging.getLogger(__name__)
+
+
+class Replica:
+    """One device-resident copy of the served parameters. (Per-replica
+    batch counts live in :class:`ServingMetrics.replica_batches` — the
+    single source the snapshot and Prometheus rendering read.)"""
+
+    __slots__ = ("index", "device", "params", "model_state", "in_flight")
+
+    def __init__(self, index: int, device, params, model_state):
+        self.index = int(index)
+        self.device = device
+        self.params = params
+        self.model_state = model_state
+        self.in_flight = 0        # dispatched, readback not yet complete
+
+
+class ReplicaPool:
+    """N device replicas of one model with least-loaded routing.
+
+    ``acquire()`` claims the least-loaded replica (round-robin among ties,
+    so single-threaded traffic still exercises every replica — and every
+    replica's compiled programs stay warm); ``dispatch`` issues the forward
+    on the replica's device WITHOUT blocking on the result (JAX async
+    dispatch); ``complete`` returns the replica after readback.
+    """
+
+    def __init__(self, model, n_replicas: int = 1,
+                 devices: Optional[Sequence] = None):
+        if getattr(model, "train_state", None) is None:
+            model.init()
+        self.model = model
+        devs = list(devices) if devices else list(jax.local_devices())
+        n = max(1, int(n_replicas or 1))
+        if n > len(devs):
+            logger.warning(
+                "ReplicaPool: %d replicas requested but only %d local "
+                "device(s); clamping", n, len(devs))
+            n = len(devs)
+        self._graph_inputs = list(getattr(model.conf, "inputs", []) or [])
+        self._fn = self._output_fn(model)
+        self._lock = threading.Lock()
+        self._rr = 0
+        self.replicas: List[Replica] = []
+        if self._fn is None:
+            # fallback dispatch ignores replica placement entirely: one
+            # pseudo-replica, no device_put copies, honest accounting
+            if n > 1:
+                logger.warning(
+                    "ReplicaPool: %s lacks the MLN/CG internals; serving "
+                    "through its own output() on the default device "
+                    "(1 replica, %d requested)", type(model).__name__, n)
+            self.replicas.append(Replica(0, devs[0], None, None))
+            return
+        for i in range(n):
+            ts = model.train_state
+            self.replicas.append(Replica(
+                i, devs[i],
+                jax.device_put(ts.params, devs[i]),
+                jax.device_put(ts.model_state, devs[i])))
+
+    def __len__(self) -> int:
+        return len(self.replicas)
+
+    # ------------------------------------------------------------- forward
+    def _output_fn(self, model):
+        """The model's own jitted inference function, through the same
+        ``_jitted("output", ...)`` cache ``model.output`` populates — the
+        trace is identical to the model's, so a replica's result is
+        bit-identical to ``model.output`` at the same program shape, and
+        ``compile_count`` sees every (bucket, device) executable."""
+        if self._fallback(model):
+            return None
+        if self._graph_inputs:
+            # mirror ComputationGraph.output's fwd exactly
+            def fwd(params, model_state, inputs_):
+                acts, _, _ = model._forward_all(params, model_state, inputs_,
+                                                training=False, rng=None)
+                return [acts[o] for o in model.conf.outputs]
+        else:
+            # mirror MultiLayerNetwork.output's fwd exactly
+            def fwd(params, model_state, x_, m_):
+                out, _, _, _ = model._forward(params, model_state, x_,
+                                              training=False, rng=None,
+                                              fmask=m_)
+                return out
+        return model._jitted("output", lambda: jax.jit(fwd))
+
+    @staticmethod
+    def _fallback(model) -> bool:
+        """Duck-typed models without the MLN/CG internals serve through
+        their own ``output`` on the default device (single replica, no
+        device routing) instead of failing at pool construction."""
+        has_fwd = (hasattr(model, "_forward_all")
+                   if list(getattr(model.conf, "inputs", []) or [])
+                   else hasattr(model, "_forward"))
+        return not (has_fwd and hasattr(model, "_jitted"))
+
+    # ------------------------------------------------------------- routing
+    def acquire(self) -> Replica:
+        """Claim the least-loaded replica (ties broken round-robin) and
+        count the dispatch against it."""
+        with self._lock:
+            low = min(r.in_flight for r in self.replicas)
+            ties = [r for r in self.replicas if r.in_flight == low]
+            rep = ties[self._rr % len(ties)]
+            self._rr += 1
+            rep.in_flight += 1
+            return rep
+
+    def release(self, replica: Replica) -> None:
+        """Un-claim after readback completed OR after a dispatch that
+        never executed (chaos/raise)."""
+        with self._lock:
+            replica.in_flight -= 1
+
+    def total_in_flight(self) -> int:
+        with self._lock:
+            return sum(r.in_flight for r in self.replicas)
+
+    # ------------------------------------------------------------ dispatch
+    def dispatch(self, replica: Replica, x: ArrayOrDict):
+        """Issue the forward on ``replica``'s device and return the result
+        WITHOUT reading it back — with async dispatch the device executes
+        while the host goes on coalescing the next batch. The caller owns
+        the eventual blocking readback (``np.asarray``)."""
+        if self._fn is None:
+            out = (self.model.output(*[x[n] for n in
+                                       (self._graph_inputs or sorted(x))])
+                   if isinstance(x, dict) else self.model.output(x))
+            return out
+        if self._graph_inputs:
+            if not isinstance(x, dict):
+                x = {self._graph_inputs[0]: x}
+            inputs_ = {n: x[n] for n in self._graph_inputs}
+            outs = self._fn(replica.params, replica.model_state, inputs_)
+            return outs[0] if len(outs) == 1 else outs
+        return self._fn(replica.params, replica.model_state, x, None)
+
+    def forward_blocking(self, replica: Replica, x: ArrayOrDict):
+        """Dispatch + full readback on one replica (warmup path — forces
+        the XLA compile for this shape on this device, bypassing the
+        in-flight accounting)."""
+        out = self.dispatch(replica, x)
+        if isinstance(out, (list, tuple)):
+            return [np.asarray(o) for o in out]
+        return np.asarray(out)

@@ -75,6 +75,7 @@ class CircuitBreaker:
         self._lock = threading.Lock()
         self._state = CircuitState.CLOSED
         self._failures: List[float] = []  # timestamps within window
+        self._seen_keys: Dict[str, float] = {}  # batch-failure dedup
         self._opened_at: Optional[float] = None
         self._probes_issued = 0
         self.opens_total = 0
@@ -132,10 +133,23 @@ class CircuitBreaker:
                     and self._probes_issued > 0):
                 self._probes_issued -= 1
 
-    def record_failure(self) -> None:
+    def record_failure(self, key: Optional[str] = None) -> None:
+        """``key`` (optional) dedups shared faults: the pipelined batcher
+        stamps one key per faulted *batch*, so a single mid-flight failure
+        that takes down N coalesced requests counts once toward the
+        threshold, not N times — one bad batch must not read as an outage.
+        Distinct batches (e.g. each retry attempt) get distinct keys and
+        still count individually."""
         with self._lock:
             now = self._clock()
             self._tick(now)
+            if key is not None:
+                cutoff = now - self.window_s
+                self._seen_keys = {k: t for k, t in self._seen_keys.items()
+                                   if t > cutoff}
+                if key in self._seen_keys:
+                    return
+                self._seen_keys[key] = now
             if self._state is CircuitState.HALF_OPEN:
                 # failed probe: back to OPEN, restart the timer
                 self._state = CircuitState.OPEN

@@ -10,7 +10,10 @@ Same dependency-free ``ThreadingHTTPServer`` pattern as ``ui/server.py``
 - ``GET  /readyz``                     — readiness (every model READY; a
   DEGRADED breaker-open model or an empty registry returns 503 so an
   orchestrator routes traffic elsewhere)
-- ``GET  /metrics``                    — Prometheus text format
+- ``GET  /metrics``                    — Prometheus text format, incl. the
+  pipeline gauges (ISSUE 3): ``serving_inflight_depth`` (dispatched
+  batches awaiting readback), ``serving_replica_batches_total`` per device
+  replica, and the ``serving_dispatch_to_completion_seconds`` histogram
 
 Predict request body::
 
@@ -110,7 +113,10 @@ class ModelServer:
         return 404, {"error": f"unknown path {path!r}"}
 
     def _render_metrics(self) -> str:
-        parts = ["# TYPE serving_latency_seconds summary"]
+        parts = ["# TYPE serving_latency_seconds summary",
+                 "# TYPE serving_dispatch_to_completion_seconds summary",
+                 "# TYPE serving_inflight_depth gauge",
+                 "# TYPE serving_replica_batches_total counter"]
         for name in self.registry.names():
             try:
                 parts.append(self.registry.get(name).metrics

@@ -43,13 +43,18 @@ y = np.eye(5, dtype=np.float32)[rng.integers(0, 5, 128)]
 net.fit(x, y, epochs=1 if SMOKE else 5)
 ModelSerializer.write_model(net, "classifier.zip")
 
-# ---- registry: load the archive, AOT-warm the batch buckets ------------
+# ---- registry: load the archive, AOT-warm every (bucket, replica) ------
+# replicas=2: two device-resident parameter copies served least-loaded;
+# pipeline_depth=2: the coalescer keeps dispatching while earlier batches
+# are still executing/reading back (docs/serving_perf.md)
 registry = ModelRegistry()
 served = registry.load("classifier", "classifier.zip",
                        warmup_example=x[:1], max_batch_size=16,
-                       batch_timeout_ms=2.0, queue_limit=256)
+                       batch_timeout_ms=2.0, queue_limit=256,
+                       replicas=2, pipeline_depth=2)
 print(f"serving {served.name} v{served.version}: buckets "
-      f"{served.batcher.buckets}, {served.batcher.compile_count()} "
+      f"{served.batcher.buckets} on {served.batcher.replica_count} "
+      f"device replica(s), {served.batcher.compile_count()} "
       f"XLA compilations after warmup")
 
 # ---- HTTP front end ----------------------------------------------------
@@ -110,9 +115,13 @@ print(f"served {counts['ok']} ok / {counts['rejected']} rejected; "
       f"p50 {snap['latency_p50_s'] * 1e3:.1f} ms, "
       f"p99 {snap['latency_p99_s'] * 1e3:.1f} ms, "
       f"occupancy {snap['batch_occupancy']:.2f}, "
+      f"replica batches {snap['replica_batches']}, "
+      f"dispatch-to-completion p99 {snap['dispatch_p99_s'] * 1e3:.1f} ms, "
       f"compilations {snap['compile_count']} "
-      f"(<= {len(served.batcher.buckets)} buckets)")
-assert snap["compile_count"] <= len(served.batcher.buckets)
+      f"(<= {len(served.batcher.buckets)} buckets x "
+      f"{served.batcher.replica_count} replicas)")
+assert snap["compile_count"] <= (len(served.batcher.buckets)
+                                 * served.batcher.replica_count)
 
 server.stop(shutdown_registry=True)
 print("done")

@@ -1,5 +1,8 @@
 """Serving subsystem tests: registry, bucketed continuous batcher,
-admission control, SLO metrics, HTTP front end (ISSUE 1 tentpole).
+admission control, SLO metrics, HTTP front end (ISSUE 1 tentpole), and the
+pipelined multi-replica executor (ISSUE 3: async dispatch overlapping host
+batching with device execution, ReplicaPool least-loaded routing, deadline
+checks at both coalesce and dispatch stages, mid-flight fault isolation).
 
 All tier-1 (CPU mesh, no ``slow`` marker); the sustained-load test is sized
 to finish in a few seconds on the 8-virtual-device CPU backend.
@@ -221,6 +224,285 @@ def test_batcher_shutdown_fails_queued_requests():
         b.submit(x[:1])
 
 
+def test_idle_worker_blocks_without_polling():
+    """Satellite (ISSUE 3): the coalescer must sleep in a BLOCKING
+    ``queue.get`` between windows — the PR-1 0.05 s poll woke an idle
+    server's worker 20x/s. After serving one request and idling, the spy
+    must see only the window's timed gets plus one parked blocking get
+    (timeout=None), not a stream of poll wake-ups."""
+    net = MultiLayerNetwork(_mln_conf()).init()
+    x = _data(8)
+    b = ContinuousBatcher(net, max_batch_size=8, batch_timeout_ms=2.0,
+                          warmup_example=x[:1])
+    recorded = []
+    real_queue = b._queue
+
+    class SpyQueue:
+        def get(self, timeout=None):
+            recorded.append(timeout)
+            return real_queue.get(timeout=timeout)
+
+        def __getattr__(self, name):
+            return getattr(real_queue, name)
+
+    b._queue = SpyQueue()
+    try:
+        b.submit(x[:2])
+        time.sleep(0.6)  # idle: a 20 Hz poll would record ~12 gets here
+        assert recorded.count(None) >= 1, \
+            "worker must park in a blocking get when idle"
+        assert len(recorded) <= 5, \
+            f"idle worker woke {len(recorded)} times — busy-wake poll?"
+        timed = [t for t in recorded if t is not None]
+        assert all(t <= b.batch_timeout_s + 1e-6 for t in timed), \
+            "only coalesce-window gets may carry a timeout"
+    finally:
+        b.shutdown()
+
+
+def test_pipelined_bit_exact_under_concurrent_load():
+    """Tentpole: the staged executor (async dispatch, depth 4, 2 device
+    replicas) must return the same bit-exact bucket-padded results as the
+    synchronous path under concurrent load, with compiles bounded by
+    buckets x replicas."""
+    net = MultiLayerNetwork(_mln_conf()).init()
+    ref = MultiLayerNetwork(_mln_conf()).init()  # identical seeded weights
+    x = _data(64)
+    b = ContinuousBatcher(net, max_batch_size=16, batch_timeout_ms=2.0,
+                          queue_limit=512, replicas=2, pipeline_depth=4,
+                          warmup_example=x[:1])
+    assert b.replica_count == 2
+    assert b.compile_count() == len(b.buckets) * 2  # warmed per replica
+    try:
+        results = {}
+        lock = threading.Lock()
+
+        def client(i):
+            for j in range(15):
+                ofs = (i * 15 + j) % 48
+                n = 1 + (i + j) % 4
+                got = np.asarray(b.submit(x[ofs:ofs + n],
+                                          timeout_ms=10_000))
+                with lock:
+                    results[(i, j, ofs, n)] = got
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads), "client hung"
+        assert len(results) == 8 * 15
+        for (i, j, ofs, n), got in results.items():
+            candidates = [_ref_at_bucket(ref, x[ofs:ofs + n], bk)
+                          for bk in b.buckets if bk >= n]
+            assert any((got == c).all() for c in candidates), \
+                f"request {(i, j)} not bit-identical at any served bucket"
+        # sustained traffic added no compilations beyond the warmed set
+        assert b.compile_count() == len(b.buckets) * 2
+        snap = b.metrics.snapshot()
+        assert snap["dispatch_p99_s"] > 0  # histogram observed batches
+    finally:
+        b.shutdown()
+
+
+def test_replicas_identical_and_balanced():
+    """Satellite: responses must be bit-identical no matter which device
+    replica served them, and least-loaded routing (round-robin on ties)
+    must actually spread batches over the replicas."""
+    net = MultiLayerNetwork(_mln_conf()).init()
+    ref = MultiLayerNetwork(_mln_conf()).init()
+    x = _data(16)
+    b = ContinuousBatcher(net, max_batch_size=16, batch_timeout_ms=1.0,
+                          replicas=2, warmup_example=x[:1])
+    try:
+        expected = _ref_at_bucket(ref, x[:3], 4)  # alone -> bucket 4
+        for _ in range(8):  # sequential: each submit is its own batch
+            got = np.asarray(b.submit(x[:3]))
+            assert (got == expected).all(), \
+                "replica result differs from the reference bucket shape"
+        counts = b.metrics.snapshot()["replica_batches"]
+        assert sorted(counts) == [0, 1], f"replica counts: {counts}"
+        assert all(v >= 3 for v in counts.values()), \
+            f"routing did not balance: {counts}"
+    finally:
+        b.shutdown()
+
+
+def test_deadline_rejected_at_coalesce_and_dispatch_stages():
+    """Satellite: a request whose deadline lapses while the worker is busy
+    is rejected at the COALESCE check; one whose deadline lapses while the
+    batch waits for an in-flight slot (pipeline backpressure) is rejected
+    at the DISPATCH check — both explicit, neither wastes a forward."""
+    from deeplearning4j_tpu.runtime.chaos import AddLatency, ChaosController
+
+    net = MultiLayerNetwork(_mln_conf()).init()
+    x = _data(8)
+
+    # --- coalesce stage: worker stalled inside a forward
+    b = ContinuousBatcher(net, max_batch_size=4, batch_timeout_ms=1.0,
+                          warmup_example=x[:1])
+    gate = threading.Event()
+    orig_forward = b._forward
+    b._forward = lambda v: (gate.wait(5), orig_forward(v))[1]
+    parked = threading.Thread(target=lambda: b.submit(x[:1]))
+    parked.start()
+    time.sleep(0.05)
+    threading.Timer(0.3, gate.set).start()
+    with pytest.raises(DeadlineExceeded) as ei:
+        b.submit(x[:1], timeout_ms=10.0)
+    assert "coalesce" in str(ei.value)
+    parked.join(timeout=5)
+    b.shutdown()
+
+    # --- dispatch stage: slot starved by a slow completion (depth=1)
+    b2 = ContinuousBatcher(net, max_batch_size=4, batch_timeout_ms=1.0,
+                           pipeline_depth=1, warmup_example=x[:1])
+    try:
+        with ChaosController() as c:
+            c.on("serving.batcher.complete", AddLatency(0.5))
+            slow = threading.Thread(target=lambda: b2.submit(x[:1]))
+            slow.start()
+            time.sleep(0.1)  # batch 1 dispatched; completion sleeping
+            with pytest.raises(DeadlineExceeded) as ei:
+                b2.submit(x[1:2], timeout_ms=100.0)
+            assert "dispatch" in str(ei.value), \
+                f"expected dispatch-stage rejection, got: {ei.value}"
+            slow.join(timeout=10)
+            assert not slow.is_alive(), "slow batch never completed"
+    finally:
+        b2.shutdown()
+
+
+def test_midflight_fault_fails_only_that_batch():
+    """Satellite chaos drill: a ``serving.batcher.forward`` FailNth fired
+    mid-stream must fail exactly that batch's requests; earlier and later
+    batches flow bit-exact through the pipeline (no wedge, no hang). Same
+    for a fault at the completion (readback) stage."""
+    from deeplearning4j_tpu.runtime.chaos import (ChaosController, ChaosError,
+                                                  FailNth)
+
+    net = MultiLayerNetwork(_mln_conf()).init()
+    ref = MultiLayerNetwork(_mln_conf()).init()
+    x = _data(32)
+    b = ContinuousBatcher(net, max_batch_size=8, batch_timeout_ms=1.0,
+                          replicas=2, pipeline_depth=4,
+                          warmup_example=x[:1])
+    try:
+        with ChaosController() as c:
+            # warmup is done; live forwards count from 1
+            c.on("serving.batcher.forward", FailNth(2))
+            r1 = np.asarray(b.submit(x[:2]))
+            with pytest.raises(ChaosError):
+                b.submit(x[2:4])
+            r3 = np.asarray(b.submit(x[4:6]))
+        assert (r1 == _ref_at_bucket(ref, x[:2], 2)).all()
+        assert (r3 == _ref_at_bucket(ref, x[4:6], 2)).all()
+
+        # completion-stage fault: the batch dies at readback, the next one
+        # still serves (the completion thread must not exit on error)
+        with ChaosController() as c:
+            c.on("serving.batcher.complete", FailNth(1))
+            with pytest.raises(ChaosError):
+                b.submit(x[:2])
+            r5 = np.asarray(b.submit(x[6:8]))
+        assert (r5 == _ref_at_bucket(ref, x[6:8], 2)).all()
+
+        # concurrent burst straight after the faults: nothing is wedged
+        outcomes = []
+        lock = threading.Lock()
+
+        def client(i):
+            try:
+                got = np.asarray(b.submit(x[i:i + 1], timeout_ms=10_000))
+                ok = any((got == _ref_at_bucket(ref, x[i:i + 1], bk)).all()
+                         for bk in b.buckets)
+                with lock:
+                    outcomes.append("ok" if ok else "WRONG")
+            except BaseException as e:
+                with lock:
+                    outcomes.append(type(e).__name__)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=15)
+        assert not any(t.is_alive() for t in threads), "pipeline wedged"
+        assert outcomes.count("ok") == 8, f"outcomes: {outcomes}"
+    finally:
+        b.shutdown()
+
+
+def test_bad_request_mix_fails_batch_not_worker():
+    """A malformed batch (mismatched feature widths coalesced into one
+    window, or a shape the model rejects) must fail THAT batch explicitly
+    — never kill the coalescer thread and strand every later caller."""
+    net = MultiLayerNetwork(_mln_conf()).init()
+    x = _data(8)
+    b = ContinuousBatcher(net, max_batch_size=8, batch_timeout_ms=20.0,
+                          warmup_example=x[:1])
+    try:
+        results = []
+        lock = threading.Lock()
+
+        def client(arr):
+            try:
+                r = np.asarray(b.submit(arr))
+                with lock:
+                    results.append(("ok", r))
+            except BaseException as e:
+                with lock:
+                    results.append(("err", e))
+
+        threads = [threading.Thread(target=client, args=(x[:1],)),
+                   threading.Thread(target=client,
+                                    args=(np.zeros((1, 5), np.float32),))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert not any(t.is_alive() for t in threads), "caller hung"
+        assert len(results) == 2
+        assert any(k == "err" for k, _ in results), \
+            "the 5-wide request against an 8-wide model must fail"
+        # the worker survived and keeps serving
+        assert b._worker.is_alive(), "coalescer thread died"
+        got = np.asarray(b.submit(x[:2]))
+        assert got.shape == (2, 4)
+    finally:
+        b.shutdown()
+
+
+def test_oversized_request_warms_new_bucket_on_every_replica():
+    """Satellite: an oversized request mints the next power-of-two bucket
+    AND warms it on every replica at creation — later requests at that
+    size must not pay a surprise compile, and the compile count stays at
+    buckets x replicas."""
+    net = MultiLayerNetwork(_mln_conf()).init()
+    ref = MultiLayerNetwork(_mln_conf()).init()
+    x = _data(64)
+    b = ContinuousBatcher(net, max_batch_size=8, batch_timeout_ms=1.0,
+                          replicas=2, warmup_example=x[:1])
+    try:
+        assert b.buckets == [1, 2, 4, 8]
+        assert b.compile_count() == 4 * 2
+        got = np.asarray(b.submit(x[:20]))  # oversized -> bucket 32
+        assert 32 in b.buckets
+        assert (got == _ref_at_bucket(ref, x[:20], 32)).all()
+        assert b.compile_count() == len(b.buckets) * 2, \
+            "new bucket must be warmed on every replica at creation"
+        c0 = b.compile_count()
+        # the next requests at that size (either replica) compile nothing
+        np.asarray(b.submit(x[:17]))
+        np.asarray(b.submit(x[:20]))
+        assert b.compile_count() == c0, "surprise compile after bucket mint"
+    finally:
+        b.shutdown()
+
+
 def test_admission_overload_rejects_explicitly():
     net = MultiLayerNetwork(_mln_conf()).init()
     b = ContinuousBatcher(net, max_batch_size=4, batch_timeout_ms=1.0,
@@ -415,10 +697,12 @@ def test_latency_histogram_percentiles():
 
 def test_serving_metrics_snapshot_and_prometheus():
     from deeplearning4j_tpu.serving.metrics import ServingMetrics
-    m = ServingMetrics(queue_depth_fn=lambda: 3, compile_count_fn=lambda: 6)
+    m = ServingMetrics(queue_depth_fn=lambda: 3, compile_count_fn=lambda: 6,
+                       inflight_fn=lambda: 2)
     m.record_admitted()
     m.record_response(0.004)
-    m.record_batch(real_rows=6, padded_rows=8, latency_s=0.003)
+    m.record_batch(real_rows=6, padded_rows=8, latency_s=0.003, replica=1)
+    m.record_dispatch(0.002)
     m.record_rejection("overload")
     m.record_rejection("deadline")
     s = m.snapshot()
@@ -427,9 +711,17 @@ def test_serving_metrics_snapshot_and_prometheus():
     assert s["batch_occupancy"] == 0.75
     assert s["queue_depth"] == 3 and s["compile_count"] == 6
     assert s["latency_p50_s"] > 0
+    # pipeline observability (ISSUE 3 satellite)
+    assert s["inflight_depth"] == 2
+    assert s["replica_batches"] == {1: 1}
+    assert s["dispatch_p99_s"] > 0
     text = m.render_prometheus("m")
     assert 'serving_requests_total{model="m"} 1' in text
     assert 'serving_xla_compile_count{model="m"} 6' in text
+    assert 'serving_inflight_depth{model="m"} 2' in text
+    assert 'serving_replica_batches_total{model="m",replica="1"} 1' in text
+    assert ('serving_dispatch_to_completion_seconds'
+            '{model="m",quantile="0.99"}') in text
 
 
 def test_profiler_reuses_latency_histogram():
