@@ -761,9 +761,9 @@ class SameDiff:
         # Packing keeps self.arrays stale until fit returns, so it is only
         # safe when no attached listener reads model state mid-fit (same
         # rule as MultiLayerNetwork.fit).
+        from deeplearning4j_tpu.train.prefetch import stateless_listeners
         use_packing = (get_environment().packed_state
-                       and all(not getattr(l, "needs_model_state", True)
-                               for l in self._listeners))
+                       and stateless_listeners(self))
         unroll = max(1, int(get_environment().dispatch_unroll)) \
             if use_packing else 1
         key = ("train_step", ph_names, str(get_environment().compute_dtype),

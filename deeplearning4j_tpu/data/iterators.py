@@ -159,16 +159,55 @@ class ExistingDataSetIterator(DataSetIterator):
 _SENTINEL = object()
 
 
+def stop_aware_put(q: queue.Queue, item, stop: threading.Event,
+                   tick: float = 0.1) -> bool:
+    """Backpressure ``put`` that stays responsive to a stop event — a
+    worker parked forever on a full queue could never be joined. Returns
+    False when the stop fired first (item not enqueued). Shared by
+    :class:`AsyncDataSetIterator` and
+    :class:`~deeplearning4j_tpu.train.prefetch.DevicePrefetcher`."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=tick)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+def drain_and_join(q: queue.Queue, thread: threading.Thread,
+                   tick: float = 0.1) -> None:
+    """Join a queue-feeding worker, draining the queue so a worker blocked
+    on ``put`` wakes within one tick — the one copy of the delicate
+    teardown both background-feed stages share."""
+    while thread.is_alive():
+        try:
+            q.get_nowait()
+        except queue.Empty:
+            pass
+        thread.join(timeout=tick)
+
+
 class AsyncDataSetIterator(DataSetIterator):
     """Background-thread prefetch (reference ``AsyncDataSetIterator``):
     decouples host-side ETL from the training loop so the device never waits
-    on data. ``queue_size`` is the prefetch depth (reference default 8)."""
+    on data. ``queue_size`` is the prefetch depth (reference default 8).
+
+    The worker honors a per-start stop event: ``reset()``/``close()``
+    signal it to exit and join it instead of draining every remaining batch
+    of the base iterator (the pre-ISSUE-4 reset cost one full pass of ETL
+    work that was about to be thrown away). A worker ``_error`` surfaces on
+    the consumer's **next** ``has_next()``/``next()`` — not only after the
+    buffered batches and the sentinel — so a failed ETL stage stops the
+    training loop at the failure, not several batches later.
+    """
 
     def __init__(self, base: DataSetIterator, queue_size: int = 8):
         self.base = base
         self.queue_size = max(1, int(queue_size))
         self._queue: Optional[queue.Queue] = None
         self._thread: Optional[threading.Thread] = None
+        self._stop: Optional[threading.Event] = None
         self._peek = None
         self._error: Optional[BaseException] = None
         self._exhausted = False  # sentinel already consumed by has_next
@@ -177,36 +216,51 @@ class AsyncDataSetIterator(DataSetIterator):
         self._queue = queue.Queue(maxsize=self.queue_size)
         self._error = None
         self._exhausted = False
+        stop = self._stop = threading.Event()
+        q = self._queue
 
         def worker():
             try:
                 self.base.reset()
-                while self.base.has_next():
-                    self._queue.put(self.base.next())
+                while not stop.is_set() and self.base.has_next():
+                    if not stop_aware_put(q, self.base.next(), stop):
+                        return
             except BaseException as e:  # surfaced on the consumer side
                 self._error = e
             finally:
-                self._queue.put(_SENTINEL)
+                stop_aware_put(q, _SENTINEL, stop)
 
-        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread = threading.Thread(target=worker, daemon=True,
+                                        name="async-dataset-iterator")
         self._thread.start()
 
+    def _shutdown_worker(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        drain_and_join(self._queue, self._thread)
+        self._thread = None
+
     def reset(self) -> None:
-        if self._thread is not None and not self._exhausted:
-            # Drain until the sentinel so the worker can exit. Poll with a
-            # timeout: if the sentinel was already consumed elsewhere and the
-            # worker has exited, an unconditional get() would block forever.
-            while True:
-                try:
-                    item = self._queue.get(timeout=0.1)
-                except queue.Empty:
-                    if not self._thread.is_alive():
-                        break
-                    continue
-                if item is _SENTINEL:
-                    break
+        self._shutdown_worker()
         self._start()
         self._peek = None
+
+    def close(self) -> None:
+        """Stop the worker without restarting it (end-of-use teardown; a
+        later ``reset()`` starts fresh). Safe to call at any point."""
+        self._shutdown_worker()
+        self._queue = None
+        self._peek = None
+
+    def _raise_pending(self) -> None:
+        if self._error is not None:
+            err, self._error = self._error, None
+            self._exhausted = True
+            # stop+join first: a worker still parked on put() (full queue)
+            # must not outlive the raise — nothing will consume after it
+            self._shutdown_worker()
+            raise err
 
     def has_next(self) -> bool:
         if self._queue is None:
@@ -214,11 +268,13 @@ class AsyncDataSetIterator(DataSetIterator):
         if self._peek is None:
             if self._exhausted:
                 return False
+            # a fault that already happened surfaces NOW — buffered batches
+            # staged behind it are discarded, not trained
+            self._raise_pending()
             item = self._queue.get()
             if item is _SENTINEL:
                 self._exhausted = True
-                if self._error is not None:
-                    raise self._error
+                self._raise_pending()
                 return False
             self._peek = item
         return True

@@ -674,7 +674,12 @@ class ComputationGraph:
             masks = {self.conf.outputs[0]: jnp.asarray(ds.labels_mask)}
         return inputs, labels, masks
 
-    def fit(self, data, labels=None, epochs: int = 1) -> "ComputationGraph":
+    def fit(self, data, labels=None, epochs: int = 1,
+            prefetch_buffer: int = 0, profiler=None) -> "ComputationGraph":
+        """``prefetch_buffer > 0`` stages coerced batches on-device ahead of
+        the step (``train.prefetch.DevicePrefetcher``; trajectory
+        bit-identical to the synchronous loop); ``profiler`` takes a
+        :class:`~deeplearning4j_tpu.train.profiler.TrainingProfiler`."""
         if self.train_state is None:
             self.init()
         if labels is not None:
@@ -686,14 +691,28 @@ class ComputationGraph:
             iterator = data
         from deeplearning4j_tpu.runtime.state_packing import (GroupedDispatch,
                                                                PackedStepLoop)
+        from deeplearning4j_tpu.train.prefetch import (AsyncLossDelivery,
+                                                       stateless_listeners)
         ploop = PackedStepLoop.for_network(self)
+        if profiler is not None:
+            profiler.start()
 
-        def deliver(args, loss):
+        def deliver(_n, loss):
             self._score = loss
             self._iteration += 1
             for lst in self._listeners:
                 lst.iteration_done(self, self._iteration, self._epoch, loss)
 
+        # async loss readback (see MultiLayerNetwork._fit_epochs): listener
+        # delivery moves to a completion thread when every listener is
+        # stateless — same callbacks, same order, no dispatch stall; no
+        # listeners and no profiler = deliver inline, no thread
+        adel = (AsyncLossDelivery(deliver, profiler=profiler)
+                if (self._listeners or profiler is not None)
+                and stateless_listeners(self) else None)
+        # nothing but the loss crosses into the delivery queue — queued step
+        # args would pin full device batches for up to max_pending steps
+        sink = adel.submit if adel is not None else deliver
         gd = GroupedDispatch(
             # with a state-reading listener, packing is off and batches must
             # dispatch one at a time so iteration_done sees fresh state
@@ -701,47 +720,67 @@ class ComputationGraph:
             compatible=_cg_group_compatible,
             run_single=lambda a: ploop.step(*a)[0],
             run_group=ploop.step_group,
-            deliver=deliver)
+            deliver=lambda args, loss: sink(None, loss))
         try:
             try:
-                self._fit_epochs(iterator, int(epochs), ploop, gd)
+                self._fit_epochs(
+                    iterator, int(epochs), ploop, gd,
+                    drain=(adel.flush if adel is not None else (lambda: None)),
+                    prefetch_buffer=int(prefetch_buffer), profiler=profiler)
             finally:
                 gd.drain_on_error()
+                if adel is not None:
+                    adel.shutdown()  # never raises; original errors win
         finally:
             # any exit path (incl. KeyboardInterrupt / iterator errors) must
             # leave train_state reflecting every completed step
             ploop.sync(release=True)
+            if profiler is not None:
+                profiler.stop()
+        if adel is not None:
+            adel.raise_pending()
         return self
 
-    def _fit_epochs(self, iterator, epochs: int, ploop, gd) -> None:
+    def _fit_epochs(self, iterator, epochs: int, ploop, gd,
+                    drain=lambda: None, prefetch_buffer: int = 0,
+                    profiler=None) -> None:
+        from deeplearning4j_tpu.train.prefetch import batch_source
+        from deeplearning4j_tpu.train.profiler import submit_timed
         for _ in range(epochs):
             for lst in self._listeners:
                 lst.on_epoch_start(self, self._epoch)
-            iterator.reset()
-            for batch in iterator:
-                inputs, labels_, masks = self._coerce_batch(batch)
-                algo = self.conf.global_conf.optimization_algo
-                if self.conf.tbptt_fwd_length and any(
-                        is_sequence_array(v) for v in inputs.values()):
+            src = batch_source(iterator, self._coerce_batch,
+                               prefetch_buffer, profiler)
+            try:
+                for inputs, labels_, masks in src:
+                    algo = self.conf.global_conf.optimization_algo
+                    if self.conf.tbptt_fwd_length and any(
+                            is_sequence_array(v) for v in inputs.values()):
+                        if algo != "STOCHASTIC_GRADIENT_DESCENT":
+                            raise NotImplementedError(
+                                "tBPTT training with optimization_algo="
+                                f"{algo!r} is not supported; use SGD or full-"
+                                "sequence BPTT")
+                        gd.flush()
+                        drain()  # tBPTT notifies listeners inline (ordered)
+                        ploop.sync(release=True)  # tBPTT mutates train_state
+                        self._fit_tbptt(inputs, labels_, masks)
+                        continue
                     if algo != "STOCHASTIC_GRADIENT_DESCENT":
-                        raise NotImplementedError(
-                            "tBPTT training with optimization_algo="
-                            f"{algo!r} is not supported; use SGD or full-"
-                            "sequence BPTT")
-                    gd.flush()
-                    ploop.sync(release=True)  # tBPTT mutates train_state
-                    self._fit_tbptt(inputs, labels_, masks)
-                    continue
-                if algo != "STOCHASTIC_GRADIENT_DESCENT":
-                    from deeplearning4j_tpu.train.solvers import (
-                        graph_solver_fit_batch)
-                    gd.flush()
-                    ploop.sync(release=True)  # solver mutates train_state
-                    loss = graph_solver_fit_batch(self, inputs, labels_, masks)
-                    gd._deliver((inputs, labels_, None, masks), loss)
-                    continue
-                gd.submit((inputs, labels_, self.rng.next_key(), masks))
+                        from deeplearning4j_tpu.train.solvers import (
+                            graph_solver_fit_batch)
+                        gd.flush()
+                        ploop.sync(release=True)  # solver mutates train_state
+                        loss = graph_solver_fit_batch(self, inputs, labels_, masks)
+                        gd._deliver((inputs, labels_, None, masks), loss)
+                        continue
+                    submit_timed(
+                        gd, (inputs, labels_, self.rng.next_key(), masks),
+                        profiler)
+            finally:
+                src.close()
             gd.flush()
+            drain()  # on_epoch_end must observe every iteration_done
             # no epoch-end sync: packing only runs when every listener is
             # stateless, so nothing reads train_state until fit() returns
             for lst in self._listeners:

@@ -363,12 +363,20 @@ class MultiLayerNetwork:
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, epochs: int = 1, mask=None,
-            labels_mask=None) -> "MultiLayerNetwork":
+            labels_mask=None, prefetch_buffer: int = 0,
+            profiler=None) -> "MultiLayerNetwork":
         """``fit(iterator)``, ``fit(iterator, epochs=N)`` or
         ``fit(x, y[, mask, labels_mask])`` (reference overloads —
         ``fit(features, labels, featuresMask, labelsMask)``). ``mask`` is the
         FEATURES mask; the labels mask defaults to it propagated through any
-        time-axis-changing layers."""
+        time-axis-changing layers.
+
+        ``prefetch_buffer > 0`` stages that many coerced batches on-device
+        ahead of the step via a background
+        :class:`~deeplearning4j_tpu.train.prefetch.DevicePrefetcher`
+        (trajectory bit-identical to the synchronous loop); ``profiler``
+        takes a :class:`~deeplearning4j_tpu.train.profiler.TrainingProfiler`
+        that splits each iteration into data-wait/dispatch/step time."""
         if self.train_state is None:
             self.init()
         if labels is not None:
@@ -381,25 +389,46 @@ class MultiLayerNetwork:
             iterator = data
         from deeplearning4j_tpu.runtime.state_packing import PackedStepLoop
         ploop = PackedStepLoop.for_network(self)
+        if profiler is not None:
+            profiler.start()
         try:
-            self._fit_epochs(iterator, int(epochs), ploop)
+            self._fit_epochs(iterator, int(epochs), ploop,
+                             prefetch_buffer=int(prefetch_buffer),
+                             profiler=profiler)
         finally:
             # any exit path (incl. KeyboardInterrupt / iterator errors) must
             # leave train_state reflecting every completed step
             ploop.sync(release=True)
+            if profiler is not None:
+                profiler.stop()
         return self
 
-    def _fit_epochs(self, iterator, epochs: int, ploop) -> None:
+    def _fit_epochs(self, iterator, epochs: int, ploop,
+                    prefetch_buffer: int = 0, profiler=None) -> None:
         from deeplearning4j_tpu.runtime.state_packing import GroupedDispatch
+        from deeplearning4j_tpu.train.prefetch import (AsyncLossDelivery,
+                                                       stateless_listeners)
 
-        def deliver(args, loss):
+        def deliver(n, loss):
             self._score = loss
             self._iteration += 1
             for lst in self._listeners:
                 if isinstance(lst, PerformanceListener):
-                    lst.record_batch(args[0].shape[0])
+                    lst.record_batch(n)
                 lst.iteration_done(self, self._iteration, self._epoch, loss)
 
+        # async loss readback: with only stateless listeners, delivery moves
+        # to a completion thread (same callbacks, same order) so a listener
+        # reading float(loss) no longer blocks dispatch of the next step; a
+        # state-reading listener forces the synchronous path (it must see
+        # ITS iteration's post-step train_state). No listeners and no
+        # profiler = nothing worth a thread: deliver inline.
+        adel = (AsyncLossDelivery(deliver, profiler=profiler)
+                if (self._listeners or profiler is not None)
+                and stateless_listeners(self) else None)
+        # only the batch SIZE crosses into the delivery queue — queued step
+        # args would pin full device batches for up to max_pending steps
+        sink = adel.submit if adel is not None else deliver
         gd = GroupedDispatch(
             # with a state-reading listener, packing is off and batches must
             # dispatch one at a time so iteration_done sees fresh state
@@ -407,47 +436,61 @@ class MultiLayerNetwork:
             compatible=_group_compatible,
             run_single=lambda a: ploop.step(*a)[0],
             run_group=ploop.step_group,
-            deliver=deliver)
+            deliver=lambda args, loss: sink(args[0].shape[0], loss))
         try:
-            self._run_epochs(iterator, epochs, ploop, gd)
+            self._run_epochs(
+                iterator, epochs, ploop, gd,
+                drain=(adel.flush if adel is not None else (lambda: None)),
+                prefetch_buffer=prefetch_buffer, profiler=profiler)
         finally:
             gd.drain_on_error()
+            if adel is not None:
+                adel.shutdown()  # never raises; original errors win
+        if adel is not None:
+            adel.raise_pending()
 
-    def _run_epochs(self, iterator, epochs, ploop, gd) -> None:
+    def _run_epochs(self, iterator, epochs, ploop, gd, drain=lambda: None,
+                    prefetch_buffer=0, profiler=None) -> None:
+        from deeplearning4j_tpu.train.prefetch import (batch_source,
+                                                       coerce_training_batch)
+        from deeplearning4j_tpu.train.profiler import submit_timed
         for _ in range(epochs):
             for lst in self._listeners:
                 lst.on_epoch_start(self, self._epoch)
-            iterator.reset()
-            for batch in iterator:
-                x, y = jnp.asarray(batch.features), jnp.asarray(batch.labels)
-                # zero-copy ref for listeners that sample activations
-                # (StatsListener histograms)
-                self._last_batch_features = x
-                fm = None if batch.features_mask is None else jnp.asarray(batch.features_mask)
-                # labels mask defaults to the features mask only for
-                # per-timestep labels (reference tBPTT/masking semantics)
-                lm = jnp.asarray(batch.labels_mask) if batch.labels_mask is not None \
-                    else (self._output_time_mask(fm) if y.ndim == 3 else None)
-                if self.conf.tbptt_fwd_length and is_sequence_array(x):
+            src = batch_source(iterator,
+                               lambda ds: coerce_training_batch(self, ds),
+                               prefetch_buffer, profiler)
+            try:
+                for x, y, fm, lm in src:
+                    # zero-copy ref for listeners that sample activations
+                    # (StatsListener histograms)
+                    self._last_batch_features = x
+                    if self.conf.tbptt_fwd_length and is_sequence_array(x):
+                        if self.conf.global_conf.optimization_algo != \
+                                "STOCHASTIC_GRADIENT_DESCENT":
+                            raise NotImplementedError(
+                                "truncated BPTT is only supported with "
+                                "STOCHASTIC_GRADIENT_DESCENT (matching "
+                                "ComputationGraph)")
+                        gd.flush()
+                        drain()  # tBPTT notifies listeners inline (ordered)
+                        ploop.sync(release=True)  # tBPTT mutates train_state
+                        self._fit_tbptt(x, y, fm, lm)
+                        continue
                     if self.conf.global_conf.optimization_algo != \
                             "STOCHASTIC_GRADIENT_DESCENT":
-                        raise NotImplementedError(
-                            "truncated BPTT is only supported with "
-                            "STOCHASTIC_GRADIENT_DESCENT (matching "
-                            "ComputationGraph)")
-                    gd.flush()
-                    ploop.sync(release=True)  # tBPTT mutates train_state
-                    self._fit_tbptt(x, y, fm, lm)
-                    continue
-                if self.conf.global_conf.optimization_algo !=                         "STOCHASTIC_GRADIENT_DESCENT":
-                    from deeplearning4j_tpu.train.solvers import solver_fit_batch
-                    gd.flush()
-                    ploop.sync(release=True)  # solver mutates train_state
-                    loss = solver_fit_batch(self, x, y, fm, lm)
-                    gd._deliver((x, y, None, fm, lm), loss)  # same bookkeeping
-                    continue
-                gd.submit((x, y, self.rng.next_key(), fm, lm))
+                        from deeplearning4j_tpu.train.solvers import solver_fit_batch
+                        gd.flush()
+                        ploop.sync(release=True)  # solver mutates train_state
+                        loss = solver_fit_batch(self, x, y, fm, lm)
+                        gd._deliver((x, y, None, fm, lm), loss)  # same bookkeeping
+                        continue
+                    submit_timed(gd, (x, y, self.rng.next_key(), fm, lm),
+                                 profiler)
+            finally:
+                src.close()
             gd.flush()
+            drain()  # on_epoch_end must observe every iteration_done
             # no epoch-end sync: packing only runs when every listener is
             # stateless, so nothing reads train_state until fit() returns
             for lst in self._listeners:

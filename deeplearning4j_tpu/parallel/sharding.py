@@ -162,3 +162,12 @@ def shard_batch(strategy: ShardingStrategy, *arrays):
                 f"Batch size {a.shape[0]} not divisible by data-parallel size {n}")
         out.append(jax.device_put(a, strategy.batch_sharding(a.ndim)))
     return out if len(out) > 1 else out[0]
+
+
+def shard_batch_tree(strategy: ShardingStrategy, tree):
+    """:func:`shard_batch` over an arbitrary pytree of batch arrays — the
+    dict inputs / list labels / optional-mask dicts of a ComputationGraph
+    batch. ``None`` leaves (absent masks) pass through unsharded."""
+    return jax.tree_util.tree_map(
+        lambda a: None if a is None else shard_batch(strategy, a),
+        tree, is_leaf=lambda x: x is None)

@@ -11,6 +11,17 @@ averaging frequency, no encoded updates — one compiled program IS the
 distributed trainer, and it is mathematically equivalent to synchronous
 all-reduce SGD (averaging every iteration).
 
+The FEED path is a staged pipeline (ISSUE 4, mirroring the serving
+executor): a :class:`~deeplearning4j_tpu.train.prefetch.DevicePrefetcher`
+coerces batches and issues the sharded ``jax.device_put`` up to
+``prefetch_buffer`` batches ahead of the running step (the reference's
+``prefetchBuffer`` workspace ring, TPU-native), dispatch is unified onto
+``GroupedDispatch`` (honoring ``env.dispatch_unroll`` with an unrolled
+sharded step), and listener delivery rides the async completion path so a
+listener reading ``float(loss)`` never stalls dispatch. Trajectories are
+bit-identical to the synchronous loop — same batch order, same rng-key
+sequence, same compiled step.
+
 Multi-node: run the same script per host after
 ``runtime.mesh.initialize_multihost()`` — the mesh then spans hosts and the
 same step runs globally (the reference needed Spark + Aeron for this).
@@ -21,9 +32,11 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
-import jax.numpy as jnp
 
-from deeplearning4j_tpu.parallel.sharding import ShardingStrategy, shard_batch, shard_train_state
+from deeplearning4j_tpu.parallel.sharding import (ShardingStrategy, shard_batch,
+                                                  shard_batch_tree,
+                                                  shard_train_state)
+from deeplearning4j_tpu.runtime.environment import get_environment
 from deeplearning4j_tpu.runtime.mesh import DATA_AXIS, MODEL_AXIS, create_mesh
 from deeplearning4j_tpu.train.listeners import PerformanceListener
 
@@ -34,15 +47,20 @@ class ParallelWrapper:
         pw = (ParallelWrapper.builder(net)
               .workers(8)                      # optional; defaults to all devices
               .strategy("data_parallel")       # or "fsdp" / "tensor_parallel"
+              .prefetch_buffer(2)              # sharded device prefetch depth
               .build())
         pw.fit(iterator, epochs=2)
     """
 
-    def __init__(self, model, strategy: Optional[ShardingStrategy] = None):
+    def __init__(self, model, strategy: Optional[ShardingStrategy] = None,
+                 prefetch_buffer: int = 2):
         self.model = model
         if strategy is None:
             strategy = ShardingStrategy.data_parallel(create_mesh())
         self.strategy = strategy
+        # batches staged on-device ahead of the step (reference default 2);
+        # 0 = fully synchronous feed path (bit-identical either way)
+        self.prefetch_buffer = max(0, int(prefetch_buffer))
         self._sharded = False
 
     # -- builder API (reference parity) --
@@ -51,6 +69,7 @@ class ParallelWrapper:
             self._model = model
             self._workers = None
             self._strategy_name = "data_parallel"
+            self._prefetch_buffer = 2
 
         def workers(self, n: int) -> "ParallelWrapper.Builder":
             self._workers = int(n)
@@ -65,6 +84,9 @@ class ParallelWrapper:
             return self  # sync allreduce == averaging every iteration
 
         def prefetch_buffer(self, n: int) -> "ParallelWrapper.Builder":
+            """Sharded device-prefetch depth (reference ``prefetchBuffer``);
+            0 disables the background stage."""
+            self._prefetch_buffer = max(0, int(n))
             return self
 
         def build(self) -> "ParallelWrapper":
@@ -84,7 +106,8 @@ class ParallelWrapper:
                 "fsdp": ShardingStrategy.fsdp,
                 "tensor_parallel": ShardingStrategy.tensor_parallel,
             }[self._strategy_name]
-            return ParallelWrapper(self._model, factory(mesh))
+            return ParallelWrapper(self._model, factory(mesh),
+                                   prefetch_buffer=self._prefetch_buffer)
 
     @staticmethod
     def builder(model) -> "ParallelWrapper.Builder":
@@ -127,61 +150,132 @@ class ParallelWrapper:
             self.model.train_state = shard_train_state(self.model.train_state, self.strategy)
             self._sharded = True
 
-    def _run_step(self, step_fn, batch):
-        """One sharded train step, dispatching on the wrapped model's step
-        signature: MultiLayerNetwork takes (ts, x, y, rng, fmask, lmask);
-        ComputationGraph takes (ts, inputs_dict, labels_list, rng, masks)
-        — both are wrapped by the reference ParallelWrapper too."""
+    def _prepare_batch(self, batch):
+        """Host→device for one batch: coercion (shared helper), tBPTT
+        guard, then the sharded ``jax.device_put`` with the strategy's
+        ``NamedSharding``s. Pure with respect to model state, so the
+        prefetch worker runs it ahead of the current step. Returns
+        ``(step_args_without_rng, n_examples)`` — MultiLayerNetwork steps
+        take (ts, x, y, rng, fmask, lmask); ComputationGraph takes
+        (ts, inputs_dict, labels_list, rng, masks)."""
+        from deeplearning4j_tpu.train.prefetch import coerce_training_batch
         model = self.model
-        rng = model.rng.next_key()
         if hasattr(model, "_coerce_batch"):  # ComputationGraph
             inputs, labels_, masks = model._coerce_batch(batch)
             for v in inputs.values():
                 self._check_not_tbptt(v)
-            inputs = {k: shard_batch(self.strategy, v)
-                      for k, v in inputs.items()}
-            labels_ = [shard_batch(self.strategy, l) for l in labels_]
-            if masks is not None:
-                masks = {k: (None if m is None
-                             else shard_batch(self.strategy, m))
-                         for k, m in masks.items()}
-            model.train_state, loss = step_fn(
-                model.train_state, inputs, labels_, rng, masks)
+            inputs = shard_batch_tree(self.strategy, inputs)
+            labels_ = shard_batch_tree(self.strategy, labels_)
+            masks = None if masks is None else shard_batch_tree(
+                self.strategy, masks)
             n = next(iter(inputs.values())).shape[0]
-            return loss, n
-        x = jnp.asarray(batch.features)
-        y = jnp.asarray(batch.labels)
+            return (inputs, labels_, masks), n
+        x, y, fm, lm = coerce_training_batch(model, batch)
         self._check_not_tbptt(x)
-        fm = None if batch.features_mask is None else jnp.asarray(batch.features_mask)
-        # labels mask defaults for per-timestep labels via the model's own
-        # output-time alignment (a time-axis-changing layer makes the raw
-        # features mask the WRONG length for the loss)
-        lm = jnp.asarray(batch.labels_mask) if batch.labels_mask is not None \
-            else (model._output_time_mask(fm) if y.ndim == 3 else None)
         x, y, fm, lm = shard_batch(self.strategy, x, y, fm, lm)
-        model.train_state, loss = step_fn(model.train_state, x, y, rng, fm, lm)
-        return loss, x.shape[0]
+        return (x, y, fm, lm), x.shape[0]
 
-    def fit(self, iterator, epochs: int = 1):
-        """Distributed fit: same listener/epoch semantics as the wrapped
-        model's own ``fit``, with batches sharded across the mesh."""
+    def _insert_rng(self, args):
+        """Step args with the NEXT rng key spliced in at dispatch time —
+        key order (and so the trajectory) follows submission order, never
+        prefetch completion order."""
+        rng = self.model.rng.next_key()
+        if hasattr(self.model, "_coerce_batch"):  # (inputs, labels, rng, masks)
+            return (args[0], args[1], rng, args[2])
+        return (args[0], args[1], rng, args[2], args[3])
+
+    def _run_group(self, step_fn_unused, group):
+        """K compatible buffered steps as ONE device dispatch
+        (``env.dispatch_unroll``) — the sharded counterpart of the fit
+        loops' packed grouped dispatch (sharded state cannot pack, see
+        ``runtime/state_packing.py``)."""
+        from deeplearning4j_tpu.runtime.state_packing import make_unrolled_step
+        model = self.model
+        k = len(group)
+        fn = model._jitted(
+            f"pw_unrolled@k={k}",
+            lambda: make_unrolled_step(model._train_step_fn(), k))
+        model.train_state, losses = fn(model.train_state,
+                                       [args for args, _n in group])
+        return [losses[i] for i in range(k)]
+
+    def fit(self, iterator, epochs: int = 1, profiler=None):
+        """Distributed fit: same listener/epoch semantics (and bit-identical
+        trajectory) as the wrapped model's own ``fit``, with batches sharded
+        across the mesh, prefetched ``prefetch_buffer`` deep, and losses
+        delivered on the async completion path. ``profiler`` takes a
+        :class:`~deeplearning4j_tpu.train.profiler.TrainingProfiler`."""
+        from deeplearning4j_tpu.runtime.state_packing import GroupedDispatch
+        from deeplearning4j_tpu.train.prefetch import (AsyncLossDelivery,
+                                                       batch_source,
+                                                       stateless_listeners)
+        from deeplearning4j_tpu.train.profiler import submit_timed
         self._ensure_sharded()
         model = self.model
         step_fn = model._jitted("train_step", model._make_train_step)
-        with self.strategy.mesh:
-            for _ in range(int(epochs)):
-                for lst in model._listeners:
-                    lst.on_epoch_start(model, model._epoch)
-                iterator.reset()
-                for batch in iterator:
-                    loss, n = self._run_step(step_fn, batch)
-                    model._score = loss
-                    model._iteration += 1
+        if hasattr(model, "_coerce_batch"):
+            from deeplearning4j_tpu.models.computation_graph import (
+                _cg_group_compatible as base_compat)
+        else:
+            from deeplearning4j_tpu.models.multi_layer_network import (
+                _group_compatible as base_compat)
+        stateless = stateless_listeners(model)
+        if profiler is not None:
+            profiler.start()
+
+        def run_single(item):
+            args, _n = item
+            model.train_state, loss = step_fn(model.train_state, *args)
+            return loss
+
+        def deliver(n, loss):
+            model._score = loss
+            model._iteration += 1
+            for lst in model._listeners:
+                if isinstance(lst, PerformanceListener):
+                    lst.record_batch(n)
+                lst.iteration_done(model, model._iteration, model._epoch, loss)
+
+        # async loss readback (see MultiLayerNetwork._fit_epochs): a
+        # state-reading listener forces synchronous one-at-a-time delivery;
+        # no listeners and no profiler = deliver inline, no thread
+        adel = (AsyncLossDelivery(deliver, profiler=profiler)
+                if (model._listeners or profiler is not None)
+                and stateless else None)
+        # only the batch SIZE crosses into the delivery queue — queued step
+        # args would pin full sharded batches for up to max_pending steps
+        sink = adel.submit if adel is not None else deliver
+        gd = GroupedDispatch(
+            unroll=(get_environment().dispatch_unroll if stateless else 1),
+            compatible=lambda a, b: base_compat(a[0], b[0]),
+            run_single=run_single,
+            run_group=lambda group: self._run_group(step_fn, group),
+            deliver=lambda item, loss: sink(item[1], loss))
+        drain = adel.flush if adel is not None else (lambda: None)
+        try:
+            with self.strategy.mesh:
+                for _ in range(int(epochs)):
                     for lst in model._listeners:
-                        if isinstance(lst, PerformanceListener):
-                            lst.record_batch(n)
-                        lst.iteration_done(model, model._iteration, model._epoch, loss)
-                for lst in model._listeners:
-                    lst.on_epoch_end(model, model._epoch)
-                model._epoch += 1
+                        lst.on_epoch_start(model, model._epoch)
+                    src = batch_source(iterator, self._prepare_batch,
+                                       self.prefetch_buffer, profiler)
+                    try:
+                        for args, n in src:
+                            submit_timed(gd, (self._insert_rng(args), n),
+                                         profiler)
+                    finally:
+                        src.close()
+                    gd.flush()
+                    drain()  # on_epoch_end must observe every iteration
+                    for lst in model._listeners:
+                        lst.on_epoch_end(model, model._epoch)
+                    model._epoch += 1
+        finally:
+            gd.drain_on_error()
+            if adel is not None:
+                adel.shutdown()  # never raises; original errors win
+            if profiler is not None:
+                profiler.stop()
+        if adel is not None:
+            adel.raise_pending()
         return model

@@ -42,7 +42,13 @@ before the blocking readback, so ``AddLatency`` here simulates a slow
 device and fills the pipeline's in-flight window),
 ``serving.batcher.warmup``, ``serving.registry.register``,
 ``train.checkpoint.write`` (call), ``train.checkpoint.bytes`` (byte
-point), ``train.epoch``, ``train.iteration`` (via :class:`ChaosListener`).
+point), ``train.epoch``, ``train.iteration`` (via :class:`ChaosListener`),
+``train.prefetch.fetch`` (fires once per fetched batch on the training
+feed path, before coercion/transfer — in the
+:class:`~deeplearning4j_tpu.train.prefetch.DevicePrefetcher` worker when
+prefetching, inline otherwise, so one drill schedule covers both; a fault
+must fail the fit cleanly with no thread left behind, see
+``tests/test_train_pipeline.py``).
 """
 
 from __future__ import annotations

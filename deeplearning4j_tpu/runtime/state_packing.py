@@ -207,6 +207,23 @@ def make_unrolled_packed_step(raw_step, packer, k: int):
     return jax.jit(unrolled, donate_argnums=(0,))
 
 
+def make_unrolled_step(raw_step, k: int):
+    """One jitted program running ``k`` sequential train steps over
+    PER-LEAF state — the sharded-training counterpart of
+    :func:`make_unrolled_packed_step` (sharded training cannot pack: one
+    flat buffer would force a common sharding across leaves, see module
+    docstring). Used by ``ParallelWrapper`` to honor
+    ``env.dispatch_unroll`` on a mesh; state donated, losses stacked."""
+    def unrolled(ts, args_list):
+        losses = []
+        for i in range(k):
+            ts, loss = raw_step(ts, *args_list[i])
+            losses.append(loss)
+        return ts, jnp.stack(losses)
+
+    return jax.jit(unrolled, donate_argnums=(0,))
+
+
 class GroupedDispatch:
     """Buffer-and-flush protocol for grouped dispatch, shared by the fit
     loops (a raising listener or iterator must never leave an executed
@@ -284,9 +301,11 @@ class PackedStepLoop:
     @classmethod
     def for_network(cls, net) -> "PackedStepLoop":
         from deeplearning4j_tpu.runtime.environment import get_environment
+        from deeplearning4j_tpu.train.prefetch import stateless_listeners
+        # same listener gate as async loss delivery — the two must never
+        # desynchronize (a state-reading listener disables BOTH)
         enabled = (get_environment().packed_state
-                   and all(not getattr(l, "needs_model_state", True)
-                           for l in net._listeners))
+                   and stateless_listeners(net))
         return cls(net, enabled)
 
     @property

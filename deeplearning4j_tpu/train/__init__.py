@@ -44,6 +44,12 @@ from deeplearning4j_tpu.train.fault_tolerance import (
     HeartbeatMonitor,
     TrainingFailure,
 )
+from deeplearning4j_tpu.train.prefetch import (
+    AsyncLossDelivery,
+    DevicePrefetcher,
+    coerce_training_batch,
+)
+from deeplearning4j_tpu.train.profiler import TrainingProfiler
 from deeplearning4j_tpu.train.early_stopping import (
     BestScoreEpochTerminationCondition,
     DataSetLossCalculator,
@@ -60,6 +66,8 @@ __all__ = [
     "FaultTolerantTrainer",
     "HeartbeatMonitor",
     "TrainingFailure",
+    "DevicePrefetcher", "AsyncLossDelivery", "coerce_training_batch",
+    "TrainingProfiler",
     "Updater", "Sgd", "Adam", "AdaMax", "AMSGrad", "Nadam", "Nesterovs",
     "RmsProp", "AdaGrad", "AdaDelta", "NoOp",
     "Schedule", "StepSchedule", "ExponentialSchedule", "InverseSchedule",

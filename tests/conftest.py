@@ -22,3 +22,33 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import threading  # noqa: E402
+import time  # noqa: E402
+
+import pytest  # noqa: E402
+
+# Thread names of the training pipeline's background stages (ISSUE 4).
+# Every fit()/close() path must join these; a survivor after a test means a
+# leaked stage (e.g. a prefetcher abandoned without close()).
+_PIPELINE_THREAD_NAMES = ("train-prefetch", "train-listener-delivery",
+                          "async-dataset-iterator")
+
+
+@pytest.fixture(autouse=True)
+def _no_stray_pipeline_threads():
+    """Tier-1 guard: no prefetch/pipeline thread survives a test."""
+    yield
+
+    def stray():
+        return [t.name for t in threading.enumerate()
+                if t.is_alive() and t.name.startswith(_PIPELINE_THREAD_NAMES)]
+
+    # grace window: a worker that just received its stop/sentinel may still
+    # be mid-exit when the test body returns
+    deadline = time.monotonic() + 5.0
+    names = stray()
+    while names and time.monotonic() < deadline:
+        time.sleep(0.05)
+        names = stray()
+    assert not names, f"stray training-pipeline threads leaked: {names}"
