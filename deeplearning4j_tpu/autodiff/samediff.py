@@ -823,16 +823,30 @@ class SameDiff:
         packed = (packer.pack_device((trainable, self._opt_state))
                   if packer is not None else None)
         cur_ep = 0
+        # AOT dispatch fast path (env.aot_dispatch): per placeholder-shape
+        # signature, the hot loop calls a cached lower().compile()
+        # executable with the donated packed buffers instead of re-entering
+        # jit dispatch every step — bit-identical (same trace, same
+        # executable). The cache lives in _jit_cache, so graph edits /
+        # set_arr on constants (which clear it) invalidate executables too.
+        from deeplearning4j_tpu.runtime.compile_cache import AotCache
+        from deeplearning4j_tpu.runtime.state_packing import (
+            step_args_signature)
+        aot = self._jit_cache.setdefault("__aot__", AotCache("sd-fit"))
 
         def run_single(a):
             nonlocal packed
-            packed, loss = step(packed, a[0], np.uint32(a[1]))
+            packed, loss = aot.call(
+                ("single", key, step_args_signature((a[0],))),
+                step, packed, a[0], np.uint32(a[1]))
             return loss
 
         def run_group(todo):
             nonlocal packed
             idxs = np.asarray([t[1] for t in todo], np.uint32)
-            packed, losses = group_step(packed, [t[0] for t in todo], idxs)
+            packed, losses = aot.call(
+                ("group", gkey, step_args_signature((todo[0][0],))),
+                group_step, packed, [t[0] for t in todo], idxs)
             return [losses[i] for i in range(len(todo))]
 
         def deliver(args, loss):

@@ -45,15 +45,17 @@ def dot_product_attention(q, k, v, mask=None, use_flash: bool = True,
             from deeplearning4j_tpu.ops.pallas.flash_attention import flash_attention_compatible, flash_attention
             if flash_attention_compatible(q, k, v, mask, causal=causal):
                 return flash_attention(q, k, v, mask, causal=causal)
-            # NOTE: a short-T fused kernel exists
-            # (ops.pallas.fused_attention_short) and beats XLA ~4x in
-            # ISOLATION at BERT shapes, but auto-routing it here was
-            # measured a NET LOSS in-model on v5e (38 -> 51 ms/step for
-            # BERT-base): each pallas_call boundary in the big traced step
-            # costs ~0.5-0.7 ms of lost fusion/async-overlap around the
-            # custom call, x24 calls. Same composition failure as the
-            # round-3 custom_vjp batch-norm. It stays opt-in for users who
-            # want the kernel standalone.
+            # NOTE: the short-T fused kernel
+            # (ops.pallas.fused_attention_short) is DEPRECATED — never
+            # routed here. The chain-amortised bench-of-record A/B reads
+            # PARITY with XLA in isolation (0.98-1.01; the old "4x" was a
+            # per-call wall timing that overcharged the multi-op XLA
+            # reference for tunnel dispatch), and in-model it was a
+            # measured NET LOSS on v5e (38 -> 51 ms/step for BERT-base):
+            # each pallas_call boundary in the big traced step costs
+            # ~0.5-0.7 ms of lost fusion/async-overlap, x24 calls. Same
+            # composition failure as the round-3 custom_vjp batch-norm.
+            # See BASELINE.md round-6 update.
         except Exception:
             pass
     d = q.shape[-1]

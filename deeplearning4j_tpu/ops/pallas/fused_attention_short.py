@@ -1,5 +1,21 @@
 """Fused multi-head attention for SHORT sequences (BERT-class T <= 512).
 
+.. deprecated:: round 6
+   This kernel has no winning regime and is kept only as a measured
+   negative result (BASELINE.md round-6 update; VERDICT r5 weak #2). The
+   round-4 "4x vs XLA in isolation" figure was a single-shot per-call wall
+   timing through the remote tunnel, which charges the multi-op XLA
+   reference one dispatch per op but the single-kernel Pallas path one
+   total — the bench-of-record chain-amortised A/B
+   (``verify_kernels``, ``short_attn_isolated_speedup_vs_xla``) reads
+   **parity** (0.98-1.01 across rounds), and auto-routing it in-model was
+   a measured LOSS (51-55 ms/step vs 37 for BERT-base: each pallas_call
+   boundary in the big traced step costs ~0.5-0.7 ms of lost fusion/async
+   overlap, x24 sites). Nothing routes to it; correctness tests and the
+   bench row remain so the record stays auditable. Use the XLA softmax
+   path (``nn.attention_layers.dot_product_attention``) at short T and the
+   flash kernel beyond ``MIN_SEQ_FOR_KERNEL``.
+
 The flash kernel (``flash_attention.py``) exists for long sequences where
 the (T, T) score matrix cannot live on chip; below ``MIN_SEQ_FOR_KERNEL``
 it loses to XLA and bows out. But the XLA path it bows out TO is itself

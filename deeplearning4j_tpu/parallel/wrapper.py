@@ -189,15 +189,26 @@ class ParallelWrapper:
         (``env.dispatch_unroll``) — the sharded counterpart of the fit
         loops' packed grouped dispatch (sharded state cannot pack, see
         ``runtime/state_packing.py``)."""
-        from deeplearning4j_tpu.runtime.state_packing import make_unrolled_step
+        from deeplearning4j_tpu.runtime.state_packing import (
+            make_unrolled_step, step_args_signature)
         model = self.model
         k = len(group)
         fn = model._jitted(
             f"pw_unrolled@k={k}",
             lambda: make_unrolled_step(model._train_step_fn(), k))
-        model.train_state, losses = fn(model.train_state,
-                                       [args for args, _n in group])
+        model.train_state, losses = self._aot().call(
+            ("pw-group", k, step_args_signature(group[0][0])),
+            fn, model.train_state, [args for args, _n in group])
         return [losses[i] for i in range(k)]
+
+    def _aot(self):
+        """The sharded-dispatch AOT executable cache, stored in the model's
+        jit cache so ``init()`` invalidation covers it. Lowering captures
+        the committed NamedShardings, so a (graph, shape, mesh) signature
+        maps to exactly one executable."""
+        from deeplearning4j_tpu.runtime.compile_cache import AotCache
+        return self.model._jit_cache.setdefault(
+            "__aot_pw__", AotCache("pw-step"))
 
     def fit(self, iterator, epochs: int = 1, profiler=None):
         """Distributed fit: same listener/epoch semantics (and bit-identical
@@ -223,9 +234,15 @@ class ParallelWrapper:
         if profiler is not None:
             profiler.start()
 
+        from deeplearning4j_tpu.runtime.state_packing import (
+            step_args_signature)
+        aot = self._aot()
+
         def run_single(item):
             args, _n = item
-            model.train_state, loss = step_fn(model.train_state, *args)
+            out = aot.call(("pw", step_args_signature(args)),
+                           step_fn, model.train_state, *args)
+            model.train_state, loss = out
             return loss
 
         def deliver(n, loss):

@@ -48,7 +48,11 @@ feed path, before coercion/transfer — in the
 :class:`~deeplearning4j_tpu.train.prefetch.DevicePrefetcher` worker when
 prefetching, inline otherwise, so one drill schedule covers both; a fault
 must fail the fit cleanly with no thread left behind, see
-``tests/test_train_pipeline.py``).
+``tests/test_train_pipeline.py``), ``runtime.compile_cache.load`` (fires
+once per persistent-compilation-cache lookup, before the entry is read —
+a fault here simulates a corrupt/truncated cached executable and must
+degrade to a fresh compile, never a crash or a wrong answer, see
+``tests/test_compile_cache.py``).
 """
 
 from __future__ import annotations

@@ -78,6 +78,13 @@ class Environment:
     # difference between 1.8M and 3.9M tokens/s. Costs K-fold compile
     # time; losses/listeners still observe every step.
     dispatch_unroll: int = 1
+    # AOT dispatch fast path (runtime/compile_cache.AotCache): the fit
+    # loops and serving replicas call cached lower().compile() executables
+    # per (graph, shape, mesh) signature instead of re-entering jit
+    # dispatch every step. Bit-identical results (same trace, same
+    # executable); any signature drift falls back to the jit path. On by
+    # default; DL4J_TPU_AOT_DISPATCH=0 disables.
+    aot_dispatch: bool = True
 
     def set_remat(self, enabled: bool = True) -> "Environment":
         self.remat_segments = bool(enabled)
@@ -118,6 +125,18 @@ class Environment:
         self.dispatch_unroll = int(k)
         return self
 
+    def set_aot_dispatch(self, enabled: bool = True) -> "Environment":
+        self.aot_dispatch = bool(enabled)
+        return self
+
+    def set_compile_cache(self, directory: str) -> "Environment":
+        """Enable the persistent executable cache rooted at ``directory``
+        (builder-knob form of ``DL4J_TPU_COMPILE_CACHE``); see
+        :mod:`deeplearning4j_tpu.runtime.compile_cache`."""
+        from deeplearning4j_tpu.runtime import compile_cache
+        self.cache_compiled = compile_cache.enable(directory)
+        return self
+
     def set_nan_panic(self, enabled: bool) -> "Environment":
         self.nan_panic = enabled
         jax.config.update("jax_debug_nans", bool(enabled))
@@ -135,6 +154,7 @@ class Environment:
             "remat_segments": self.remat_segments,
             "packed_state": self.packed_state,
             "dispatch_unroll": self.dispatch_unroll,
+            "aot_dispatch": self.aot_dispatch,
         }
 
 
@@ -155,7 +175,8 @@ def get_environment() -> Environment:
 
     First call reads ``DL4J_TPU_*`` environment variables:
     ``DL4J_TPU_DTYPE``, ``DL4J_TPU_COMPUTE_DTYPE``, ``DL4J_TPU_NAN_PANIC``,
-    ``DL4J_TPU_VERBOSE``, ``DL4J_TPU_DEBUG``, ``DL4J_TPU_COMPILE_CACHE``.
+    ``DL4J_TPU_VERBOSE``, ``DL4J_TPU_DEBUG``, ``DL4J_TPU_COMPILE_CACHE``,
+    ``DL4J_TPU_AOT_DISPATCH``.
     """
     global _instance
     with _lock:
@@ -178,9 +199,19 @@ def get_environment() -> Environment:
                 # no-grouping value instead of tripping the >=1 validation.
                 env.set_dispatch_unroll(
                     max(1, int(os.environ[_ENV_PREFIX + "DISPATCH_UNROLL"])))
+            if os.environ.get(_ENV_PREFIX + "AOT_DISPATCH", "").lower() in (
+                    "0", "false"):
+                env.aot_dispatch = False
             cache = os.environ.get(_ENV_PREFIX + "COMPILE_CACHE")
             if cache:
-                env.cache_compiled = cache
-                jax.config.update("jax_compilation_cache_dir", cache)
+                # full wiring (framework-keyed dir, counters, corrupt
+                # tolerance) — not just the raw jax flag
+                try:
+                    from deeplearning4j_tpu.runtime import compile_cache
+                    env.cache_compiled = compile_cache.enable(cache)
+                except Exception:
+                    # unwritable dir etc.: degrade to the plain jax knob
+                    env.cache_compiled = cache
+                    jax.config.update("jax_compilation_cache_dir", cache)
             _instance = env
         return _instance

@@ -83,6 +83,14 @@ class OpProfiler:
                 f"  {name:30s} total={t['total_s'] * 1e3:9.2f}ms "
                 f"n={t['count']:6d} mean={t['mean_s'] * 1e3:9.3f}ms"
             )
+        cc = compile_cache_stats()
+        if cc["compiles"] or cc["hits"] or cc["aot_compiles"]:
+            lines.append(
+                f"  compile cache: hits={cc['hits']} misses={cc['misses']} "
+                f"corrupt={cc['corrupt_entries']} "
+                f"compile={cc['compile_seconds']:.2f}s "
+                f"aot={cc['aot_compiles']} "
+                f"(+{cc['aot_compile_seconds']:.2f}s)")
         return "\n".join(lines)
 
     def reset(self) -> None:
@@ -102,6 +110,15 @@ def trace(log_dir: str) -> Iterator[None]:
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+def compile_cache_stats() -> Dict[str, object]:
+    """Persistent-executable-cache and AOT-dispatch counters (hit/miss/
+    corrupt, backend compile seconds, AOT executables minted) — the same
+    numbers the serving ``/metrics`` endpoint renders; see
+    :mod:`deeplearning4j_tpu.runtime.compile_cache`."""
+    from deeplearning4j_tpu.runtime import compile_cache
+    return compile_cache.stats()
 
 
 def device_memory_stats() -> Dict[str, Dict[str, int]]:

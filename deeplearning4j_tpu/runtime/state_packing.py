@@ -281,6 +281,33 @@ class GroupedDispatch:
             self._pending.clear()
 
 
+def step_args_signature(args) -> tuple:
+    """Cheap structural signature of a step's per-batch argument tuple
+    (shapes/dtypes of arrays, None-ness of masks, dict/list structure) —
+    the :class:`~deeplearning4j_tpu.runtime.compile_cache.AotCache` key for
+    the fit loops. Dtypes are canonicalized (an np.float64 batch lands on
+    the float32 program when x64 is off, for jit and compiled executables
+    alike). Collisions are safe (the executable's argument check falls
+    back to jit); misses only cost one extra lower+compile."""
+    def leaf(a):
+        if a is None:
+            return None
+        if isinstance(a, dict):
+            return tuple(sorted((k, leaf(v)) for k, v in a.items()))
+        if isinstance(a, (list, tuple)):
+            return tuple(leaf(v) for v in a)
+        shape = getattr(a, "shape", None)
+        if shape is None:
+            return type(a).__name__
+        try:
+            dt = str(jax.dtypes.canonicalize_dtype(a.dtype))
+        except (TypeError, ValueError):  # extended dtypes (typed PRNG keys)
+            dt = str(a.dtype)
+        return tuple(shape), dt
+
+    return tuple(leaf(a) for a in args)
+
+
 class PackedStepLoop:
     """Drives a network's jitted train step with packed state inside ``fit``.
 
@@ -289,6 +316,14 @@ class PackedStepLoop:
     (listeners that need model state, solver/tBPTT branches, epoch ends).
     ``sync(release=True)`` additionally drops the packed copy so a
     subsequent step re-packs from the (possibly externally modified) state.
+
+    Dispatch rides the AOT fast path (``env.aot_dispatch``): per step-args
+    signature, the loop calls a cached ``lower().compile()`` executable
+    with the donated packed buffers instead of re-entering jit dispatch —
+    bit-identical trajectories (same trace → same executable). The
+    :class:`~deeplearning4j_tpu.runtime.compile_cache.AotCache` lives in
+    the NETWORK's jit cache, so repeated ``fit`` calls reuse executables
+    and ``init()``/graph edits (which clear that cache) invalidate them.
     """
 
     def __init__(self, net, enabled: bool):
@@ -297,6 +332,8 @@ class PackedStepLoop:
         self._packed = None
         self._step_fn = None
         self._packer = None
+        from deeplearning4j_tpu.runtime.compile_cache import AotCache
+        self._aot = net._jit_cache.setdefault("__aot__", AotCache("fit-step"))
 
     @classmethod
     def for_network(cls, net) -> "PackedStepLoop":
@@ -327,7 +364,9 @@ class PackedStepLoop:
             if self._step_fn is None:
                 self._step_fn = self._net._jitted(
                     "train_step", self._net._make_train_step)
-            out = self._step_fn(self._net.train_state, *rest_args)
+            out = self._aot.call(
+                ("plain", step_args_signature(rest_args)),
+                self._step_fn, self._net.train_state, *rest_args)
             self._net.train_state = out[0]
             return out[1:]
         if self._packed is None:
@@ -341,11 +380,16 @@ class PackedStepLoop:
             except (ValueError, TypeError):
                 prefix = self._net._packed_cache_key()
                 for k in [k for k in self._net._jit_cache
-                          if k.startswith(prefix)]:  # incl. @unroll variants
-                    self._net._jit_cache.pop(k, None)
+                          if isinstance(k, str) and k.startswith(prefix)]:
+                    self._net._jit_cache.pop(k, None)  # incl. @unroll variants
+                # AOT executables were lowered from the stale packed step
+                self._aot.clear()
                 self._step_fn, self._packer = self._net._jitted_packed()
                 self._packed = self._packer.pack_device(self._net.train_state)
-        out = self._step_fn(self._packed, *rest_args)
+        out = self._aot.call(
+            ("packed", self._net._packed_cache_key(),
+             step_args_signature(rest_args)),
+            self._step_fn, self._packed, *rest_args)
         self._packed = out[0]
         return out[1:]
 
@@ -363,8 +407,10 @@ class PackedStepLoop:
             rest = self.step_group(group[1:]) if len(group) > 1 else []
             return [first_loss] + rest
         fn = self._net._jitted_packed_unrolled(len(group))
-        self._packed, losses = fn(self._packed,
-                                  [tuple(args) for args in group])
+        self._packed, losses = self._aot.call(
+            ("packed-group", self._net._packed_cache_key(), len(group),
+             step_args_signature(group[0])),
+            fn, self._packed, [tuple(args) for args in group])
         return [losses[i] for i in range(len(group))]
 
     def sync(self, release: bool = False) -> None:

@@ -33,6 +33,12 @@ The subsystem that puts traffic on this stack:
   (:class:`CircuitOpen`), bounded retries with full jitter, and the
   health machine surfaced on ``/readyz``. Chaos-hardened via
   ``runtime.chaos`` injection points (``tests/test_chaos.py``).
+- :class:`WarmupManifest` (``manifest.py``) — persisted record of every
+  compiled (bucket, replica, dtype) pair, written next to model archives
+  and replayed by registry load / hot-swap so a restart reaches READY
+  without compiling on live traffic (with
+  ``runtime.compile_cache`` enabled, without compiling at all —
+  ``docs/coldstart.md``).
 
 Exports resolve lazily (PEP 562) so that importing one leaf —
 ``runtime.profiler`` pulling ``serving.metrics.LatencyHistogram`` — does
@@ -53,6 +59,8 @@ _EXPORTS = {
     "ServingMetrics": "metrics",
     "ModelRegistry": "registry",
     "ServedModel": "registry",
+    "WarmupManifest": "manifest",
+    "manifest_path": "manifest",
     "ModelServer": "server",
     "Replica": "replica",
     "ReplicaPool": "replica",

@@ -154,6 +154,7 @@ class ContinuousBatcher:
             compile_count_fn=self.compile_count,
             inflight_fn=self._pool.total_in_flight)
         self._graph_inputs = list(getattr(model.conf, "inputs", []) or [])
+        self._warmed_pairs: List[tuple] = []  # (bucket, replica, dtype)
         self._shutdown = False
         self._draining = False
         self._saw_sentinel = False
@@ -192,7 +193,9 @@ class ContinuousBatcher:
         """AOT-compile every (bucket, replica) program with zero rows shaped
         like ``example`` (any leading row count), and preallocate one pad
         buffer per bucket. Returns the number of programs warmed. After
-        this, steady-state traffic triggers no compilation."""
+        this, steady-state traffic triggers no compilation. Every warmed
+        (bucket, replica, dtype) pair is recorded for
+        :meth:`warmup_manifest`."""
         chaos.inject("serving.batcher.warmup")
         example = self._normalize(example)[0]
         self._example = self._zeros_with_rows(example, 1)
@@ -201,10 +204,39 @@ class ContinuousBatcher:
             for b in self.buckets:
                 self._pool.forward_blocking(
                     rep, self._zeros_with_rows(example, b))
+                self._record_warmed(b, rep.index)
                 n += 1
         for b in self.buckets:  # preallocate the pad buffers
             self._release_buffers(self._gather([], 0, b, template=example)[1])
         return n
+
+    def _record_warmed(self, bucket: int, replica: int) -> None:
+        if self._example is None:
+            dt = "?"
+        elif isinstance(self._example, dict):
+            dt = ",".join(sorted({str(v.dtype)
+                                  for v in self._example.values()}))
+        else:
+            dt = str(self._example.dtype)
+        self._warmed_pairs.append((int(bucket), int(replica), dt))
+
+    def warmup_manifest(self):
+        """Manifest of everything this batcher compiled — buckets
+        (including any minted under live traffic), replica count, the
+        input signature, and every recorded (bucket, replica, dtype) pair.
+        ``None`` until the batcher has been warmed or has seen traffic
+        (there is nothing to replay yet). Persisted next to model archives
+        by the registry so a restart can replay the warmup against the
+        persistent executable cache (``docs/coldstart.md``)."""
+        from deeplearning4j_tpu.serving.manifest import WarmupManifest
+        if self._example is None:
+            return None
+        return WarmupManifest.from_example(
+            self._example, buckets=list(self.buckets),
+            replicas=self.replica_count,
+            pairs=list(self._warmed_pairs),
+            max_batch_size=self.max_batch_size,
+            model=type(self.model).__name__)
 
     @staticmethod
     def _zeros_with_rows(x: ArrayOrDict, rows: int) -> ArrayOrDict:
@@ -214,10 +246,12 @@ class ContinuousBatcher:
         return np.zeros((rows,) + x.shape[1:], x.dtype)
 
     def compile_count(self) -> int:
-        """XLA compilations behind this model's inference path: the sum of
-        jit-cache entry counts of every cached ``output`` function. A warmed
-        pipeline holds exactly ``len(buckets) x replica_count`` entries."""
-        n = 0
+        """XLA compilations behind this model's inference path: AOT
+        executables minted by the replica pool (the fast-path ledger) plus
+        jit-cache entry counts of every cached ``output`` function (the
+        fallback/direct-call ledger). A warmed pipeline holds exactly
+        ``len(buckets) x replica_count`` executables."""
+        n = self._pool.aot_count()
         for key, fn in getattr(self.model, "_jit_cache", {}).items():
             if str(key).startswith("output@") and hasattr(fn, "_cache_size"):
                 n += fn._cache_size()
@@ -327,6 +361,7 @@ class ContinuousBatcher:
         for rep in self._pool.replicas:
             self._pool.forward_blocking(rep, self._zeros_with_rows(
                 self._example, b))
+            self._record_warmed(b, rep.index)
 
     # ---------------------------------------------------------- pad buffers
     def _acquire_buf(self, bucket: int, name, like: np.ndarray):

@@ -1,0 +1,170 @@
+"""Warmup manifests: the record that makes cold start replayable.
+
+A warmed :class:`~deeplearning4j_tpu.serving.batcher.ContinuousBatcher`
+knows exactly which XLA programs its steady state needs — one per
+(bucket, replica, dtype). That knowledge dies with the process, so every
+restart (and every registry hot-swap) used to rediscover it by compiling on
+live traffic. A :class:`WarmupManifest` persists it as JSON next to the
+model archive (``<archive>.warmup.json``):
+
+- ``ModelRegistry.load`` finds the manifest and replays it — the batcher is
+  constructed with the RECORDED bucket set (including buckets minted for
+  oversized requests under the previous process's traffic) and warmed from
+  the recorded input signature, so the model reaches READY having compiled
+  exactly the manifest's pairs and *nothing compiles on live traffic*.
+- With the persistent executable cache enabled
+  (:mod:`deeplearning4j_tpu.runtime.compile_cache`), each replayed warmup
+  compile is a cache *hit* — deserialization instead of XLA compilation —
+  so time-to-first-ready collapses (measured by ``bench.py --coldstart``;
+  ``serving_warmup_seconds`` on ``/metrics``).
+- A registry hot-swap inherits the OLD entry's manifest automatically, so
+  the replacement pre-warms the full live bucket set before taking
+  traffic.
+
+A missing, corrupt, or stale manifest is never fatal: the registry falls
+back to the ordinary cold path (default buckets, warm-on-example or
+compile-on-traffic) and writes a fresh manifest after warmup.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import tempfile
+import time
+from typing import Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+ArrayOrDict = Union[np.ndarray, Dict[str, np.ndarray]]
+
+logger = logging.getLogger(__name__)
+
+MANIFEST_SUFFIX = ".warmup.json"
+_FORMAT = "dl4j-tpu-warmup-v1"
+
+#: Key used for the single-array (MultiLayerNetwork-style) input signature.
+_SINGLE = "__single__"
+
+
+def manifest_path(archive_path: str) -> str:
+    """Where a model archive's warmup manifest lives (next to it)."""
+    return archive_path + MANIFEST_SUFFIX
+
+
+@dataclasses.dataclass
+class WarmupManifest:
+    """Everything needed to rebuild a batcher's warm state offline.
+
+    ``inputs`` maps input name (or ``__single__``) to
+    ``{"shape_tail": [...], "dtype": "float32"}`` — the per-row feature
+    signature warmup examples are built from. ``pairs`` is the audit
+    record: every (bucket, replica, dtype) the recording batcher actually
+    compiled, the bound "compiles on replay <= recorded pairs" is checked
+    against.
+    """
+
+    inputs: Dict[str, Dict[str, object]]
+    buckets: List[int]
+    replicas: int
+    pairs: List[Tuple[int, int, str]]
+    max_batch_size: int = 0  # 0 = unrecorded (fall back to max bucket)
+    model: str = ""
+    created_at: float = 0.0
+
+    # ------------------------------------------------------------ construct
+    @staticmethod
+    def from_example(example: ArrayOrDict, buckets: List[int], replicas: int,
+                     pairs: List[Tuple[int, int, str]],
+                     max_batch_size: int = 0,
+                     model: str = "") -> "WarmupManifest":
+        if isinstance(example, dict):
+            inputs = {str(k): {"shape_tail": list(v.shape[1:]),
+                               "dtype": str(np.asarray(v).dtype)}
+                      for k, v in example.items()}
+        else:
+            a = np.asarray(example)
+            inputs = {_SINGLE: {"shape_tail": list(a.shape[1:]),
+                                "dtype": str(a.dtype)}}
+        return WarmupManifest(inputs=inputs,
+                              buckets=sorted(int(b) for b in buckets),
+                              replicas=int(replicas),
+                              pairs=[(int(b), int(r), str(d))
+                                     for b, r, d in pairs],
+                              max_batch_size=int(max_batch_size),
+                              model=model, created_at=time.time())
+
+    def example(self, rows: int = 1) -> ArrayOrDict:
+        """A ``rows``-row zeros warmup example matching the recorded input
+        signature (zeros are what warmup uses anyway — only shape/dtype
+        reach the compiler)."""
+        def zeros(spec):
+            return np.zeros((rows,) + tuple(int(d) for d in
+                                            spec["shape_tail"]),
+                            np.dtype(str(spec["dtype"])))
+        if set(self.inputs) == {_SINGLE}:
+            return zeros(self.inputs[_SINGLE])
+        return {name: zeros(spec) for name, spec in self.inputs.items()}
+
+    # ----------------------------------------------------------------- serde
+    def to_dict(self) -> dict:
+        return {"format": _FORMAT, "model": self.model,
+                "created_at": self.created_at, "inputs": self.inputs,
+                "buckets": list(self.buckets), "replicas": self.replicas,
+                "max_batch_size": self.max_batch_size,
+                "pairs": [list(p) for p in self.pairs]}
+
+    @staticmethod
+    def from_dict(d: dict) -> "WarmupManifest":
+        if d.get("format") != _FORMAT:
+            raise ValueError(f"not a warmup manifest (format="
+                             f"{d.get('format')!r}, expected {_FORMAT!r})")
+        return WarmupManifest(
+            inputs={str(k): dict(v) for k, v in d["inputs"].items()},
+            buckets=[int(b) for b in d["buckets"]],
+            replicas=int(d["replicas"]),
+            pairs=[(int(b), int(r), str(dt)) for b, r, dt in
+                   d.get("pairs", [])],
+            max_batch_size=int(d.get("max_batch_size", 0)),
+            model=str(d.get("model", "")),
+            created_at=float(d.get("created_at", 0.0)))
+
+    def save(self, path: str) -> None:
+        """Atomic write (tmp + rename) — a crash mid-save must leave either
+        the old manifest or none, never a torn one (same discipline as
+        ``train/checkpoint.py``)."""
+        d = os.path.dirname(os.path.abspath(path)) or "."
+        fd, tmp = tempfile.mkstemp(prefix=".warmup-", dir=d)
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(self.to_dict(), f, indent=2)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    @staticmethod
+    def load(path: str) -> "WarmupManifest":
+        with open(path) as f:
+            return WarmupManifest.from_dict(json.load(f))
+
+    @staticmethod
+    def load_for_archive(archive_path: str) -> Optional["WarmupManifest"]:
+        """The manifest recorded next to ``archive_path``, or ``None`` when
+        absent or unreadable (a corrupt manifest only costs the cold path,
+        it never fails a load)."""
+        path = manifest_path(archive_path)
+        if not os.path.exists(path):
+            return None
+        try:
+            return WarmupManifest.load(path)
+        except Exception as e:
+            logger.warning("ignoring unreadable warmup manifest %s (%s: %s); "
+                           "falling back to cold warmup", path,
+                           type(e).__name__, e)
+            return None

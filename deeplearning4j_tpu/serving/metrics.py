@@ -94,6 +94,9 @@ class ServingMetrics:
         # readback completion, and which replica served each batch
         self.dispatch_latency = LatencyHistogram()
         self.replica_batches: Dict[int, int] = {}
+        # cold-start observability (ISSUE 5): build+warmup wall time of the
+        # served model, stamped by the registry at register/load/hot-swap
+        self.warmup_seconds = 0.0
         self._queue_depth_fn = queue_depth_fn or (lambda: 0)
         self._compile_count_fn = compile_count_fn or (lambda: 0)
         self._inflight_fn = inflight_fn or (lambda: 0)
@@ -132,6 +135,13 @@ class ServingMetrics:
     def record_retry(self) -> None:
         with self._lock:
             self.retries_total += 1
+
+    def set_warmup_seconds(self, seconds: float) -> None:
+        """Time-to-first-ready for this served model (build + AOT warmup,
+        manifest replay included) — the number ``bench.py --coldstart``
+        A/Bs cold vs warm."""
+        with self._lock:
+            self.warmup_seconds = float(seconds)
 
     def attach_breaker(self, breaker) -> None:
         """Attach the model's CircuitBreaker so snapshots and the
@@ -208,6 +218,7 @@ class ServingMetrics:
                 "dispatch_p50_s": self.dispatch_latency.percentile(50),
                 "dispatch_p99_s": self.dispatch_latency.percentile(99),
                 "replica_batches": dict(self.replica_batches),
+                "warmup_seconds": round(self.warmup_seconds, 4),
                 "uptime_s": round(time.monotonic() - self.started_at, 3),
             }
         snap["qps_10s"] = self.qps(10)
@@ -249,6 +260,7 @@ class ServingMetrics:
             f'{{model="{model}",quantile="0.5"}} {s["dispatch_p50_s"]}',
             f'serving_dispatch_to_completion_seconds'
             f'{{model="{model}",quantile="0.99"}} {s["dispatch_p99_s"]}',
+            f"serving_warmup_seconds{lbl} {s['warmup_seconds']}",
         ]
         for idx in sorted(s["replica_batches"]):
             lines.append(

@@ -14,6 +14,16 @@ batcher drains gracefully — in-flight and already-queued requests complete
 against the old version, new traffic hits the new one, and no compilation
 happens on the serving path during the cut-over.
 
+Cold start (ISSUE 5, ``docs/coldstart.md``): archive loads replay the
+:class:`~deeplearning4j_tpu.serving.manifest.WarmupManifest` recorded next
+to the archive (and hot-swaps inherit the live entry's manifest), so a
+restart pre-warms every (bucket, replica) pair the previous process
+served — with the persistent executable cache
+(:mod:`deeplearning4j_tpu.runtime.compile_cache`) enabled, each warmup
+compile is a deserialization hit and time-to-first-ready
+(``serving_warmup_seconds`` on ``/metrics``) collapses. Manifests are
+refreshed at graceful undeploy/shutdown to capture traffic-minted buckets.
+
 Failure semantics (chaos-hardened, ``tests/test_chaos.py``):
 
 - **Hot-swap rollback**: an exception during the replacement's build or
@@ -71,6 +81,7 @@ class ServedModel:
         self.breaker = breaker or CircuitBreaker()
         self.retry = retry or RetryPolicy()
         self.loaded_at = time.time()
+        self.archive_path: Optional[str] = None  # set by ModelRegistry.load
         self._draining = False
         self._started = False  # flipped by the registry after the swap
         self.batcher.metrics.attach_breaker(self.breaker)
@@ -153,6 +164,7 @@ class ModelRegistry:
                  warmup_example: Optional[ArrayOrDict] = None,
                  breaker: Optional[CircuitBreaker] = None,
                  retry: Optional[RetryPolicy] = None,
+                 manifest=None,
                  **batcher_kw) -> ServedModel:
         """Serve ``model`` under ``name``. Re-registering an existing name
         hot-swaps (version auto-bumps unless given); the new batcher is
@@ -163,13 +175,40 @@ class ModelRegistry:
         entry serving (rollback guarantee). ``batcher_kw`` forwards to
         :class:`ContinuousBatcher` (``max_batch_size``,
         ``batch_timeout_ms``, ``queue_limit``, ``buckets``, ``admission``,
-        ``replicas``, ``pipeline_depth``)."""
+        ``replicas``, ``pipeline_depth``).
+
+        ``manifest`` takes a
+        :class:`~deeplearning4j_tpu.serving.manifest.WarmupManifest` to
+        REPLAY: the batcher is built with the recorded buckets/replicas and
+        warmed from the recorded input signature, so the model reaches
+        READY compiling at most the manifest's pairs (cache hits when the
+        persistent executable cache is on) and nothing compiles on live
+        traffic. A hot-swap with no explicit ``manifest``/
+        ``warmup_example`` inherits the replaced entry's manifest, so the
+        replacement pre-warms the full live bucket set. Explicit
+        ``batcher_kw`` always wins over manifest-recorded values. Warmup
+        wall time is recorded as ``serving_warmup_seconds``."""
         chaos.inject("serving.registry.register")
         if model.train_state is None:
             model.init()
+        with self._lock:
+            prev_entry = self._models.get(name)
+        if manifest is None and warmup_example is None and prev_entry is not None:
+            # hot-swap replay: warm the replacement with everything the
+            # live entry is serving (incl. traffic-minted buckets)
+            manifest = prev_entry.batcher.warmup_manifest()
+        if manifest is not None:
+            if warmup_example is None:
+                warmup_example = manifest.example()
+            batcher_kw.setdefault("buckets", list(manifest.buckets))
+            batcher_kw.setdefault("replicas", manifest.replicas)
+            batcher_kw.setdefault(
+                "max_batch_size",
+                manifest.max_batch_size or max(manifest.buckets))
         # Build + AOT-warm OUTSIDE the lock and BEFORE the swap: if this
         # raises (bad config, warmup failure, injected chaos) nothing has
         # been swapped — the previous version, if any, keeps serving.
+        t0 = time.monotonic()
         try:
             batcher = ContinuousBatcher(model, warmup_example=warmup_example,
                                         **batcher_kw)
@@ -180,6 +219,7 @@ class ModelRegistry:
             raise
         served = ServedModel(name, 0, model, batcher,
                              breaker=breaker, retry=retry)
+        served.metrics.set_warmup_seconds(time.monotonic() - t0)
         with self._lock:
             prev = self._models.get(name)
             if version is None:
@@ -198,12 +238,42 @@ class ModelRegistry:
         return served
 
     def load(self, name: str, path: str, load_updater: bool = False,
+             replay_manifest: bool = True, save_manifest: bool = True,
              **kw) -> ServedModel:
         """Register from a ``ModelSerializer`` zip archive (MLN or
-        ComputationGraph — the archive metadata dispatches the type)."""
+        ComputationGraph — the archive metadata dispatches the type).
+
+        Cold-start path (``docs/coldstart.md``): when a warmup manifest
+        exists next to the archive (``<path>.warmup.json``) it is replayed
+        — recorded buckets/replicas, warmup from the recorded input
+        signature — so the model reaches READY without minting compiles on
+        live traffic (and with the persistent executable cache enabled,
+        without compiling at all). After warmup the up-to-date manifest is
+        written back (best effort), so each restart records the bucket set
+        the NEXT restart should pre-warm. ``replay_manifest=False`` forces
+        the cold path; ``save_manifest=False`` skips the write-back."""
         from deeplearning4j_tpu.models.serializer import ModelSerializer
+        from deeplearning4j_tpu.serving.manifest import WarmupManifest
         model = ModelSerializer.restore_model(path, load_updater=load_updater)
-        return self.register(name, model, **kw)
+        manifest = kw.pop("manifest", None)
+        if manifest is None and replay_manifest:
+            manifest = WarmupManifest.load_for_archive(path)
+        served = self.register(name, model, manifest=manifest, **kw)
+        served.archive_path = path if save_manifest else None
+        if save_manifest:
+            self.save_manifest(name)
+        return served
+
+    def save_manifest(self, name: str,
+                      archive_path: Optional[str] = None) -> Optional[str]:
+        """Persist ``name``'s CURRENT warmup manifest next to its archive
+        (or ``archive_path``), capturing buckets minted under live traffic
+        since load. Called automatically at load, graceful undeploy, and
+        shutdown, so the next restart pre-warms what this process actually
+        served. Best effort: a read-only model dir costs only the
+        optimization. Returns the manifest path, or ``None`` when there is
+        nothing to record or nowhere to put it."""
+        return self._persist_manifest(self.get(name), archive_path)
 
     def register_zoo(self, name: str, zoo_model, **kw) -> ServedModel:
         """Register a zoo entry: either an already-constructed ``ZooModel``
@@ -260,6 +330,27 @@ class ModelRegistry:
     def ready(self) -> bool:
         return self.ready_from(self.health())
 
+    @staticmethod
+    def _persist_manifest(served: ServedModel,
+                          archive_path: Optional[str] = None
+                          ) -> Optional[str]:
+        """The one manifest-persistence implementation behind
+        :meth:`save_manifest` and the graceful undeploy/shutdown refresh
+        (which captures traffic-minted buckets for the next restart)."""
+        from deeplearning4j_tpu.serving.manifest import manifest_path
+        target = archive_path or served.archive_path
+        recorded = served.batcher.warmup_manifest()
+        if target is None or recorded is None:
+            return None
+        path = manifest_path(target)
+        try:
+            recorded.save(path)
+        except OSError:
+            logger.warning("could not persist warmup manifest for %r to %s",
+                           served.name, path, exc_info=True)
+            return None
+        return path
+
     def undeploy(self, name: str, drain: bool = True) -> None:
         with self._lock:
             served = self._models.pop(name, None)
@@ -267,6 +358,10 @@ class ModelRegistry:
             raise KeyError(f"no model registered under {name!r}")
         served._draining = True
         served.batcher.shutdown(drain=drain)
+        if drain:
+            # AFTER the drain: a queued oversized request may mint a bucket
+            # while draining, and the manifest must record it
+            self._persist_manifest(served)
 
     def shutdown(self, drain: bool = True) -> None:
         with self._lock:
@@ -275,3 +370,5 @@ class ModelRegistry:
         for s in served:
             s._draining = True
             s.batcher.shutdown(drain=drain)
+            if drain:
+                self._persist_manifest(s)

@@ -13,8 +13,12 @@ dispatch stage is the whole replica machinery.
 
 Placement/compile accounting: a committed-parameter call compiles one
 executable per (argument shapes, device) pair, so a warmed pool holds
-exactly ``len(buckets) x len(replicas)`` entries in the output function's
-jit cache — the bound ``ContinuousBatcher.compile_count`` reports against.
+exactly ``len(buckets) x len(replicas)`` executables. With the AOT fast
+path on (``env.aot_dispatch``, the default) those live in the pool's
+:class:`~deeplearning4j_tpu.runtime.compile_cache.AotCache` (counted by
+:meth:`ReplicaPool.aot_count`); with it off they live in the output
+function's jit cache — ``ContinuousBatcher.compile_count`` sums both, so
+the ``compiles <= buckets x replicas`` bound holds either way.
 
 Parameters are snapshotted (``device_put`` copies) at pool construction:
 a served model's weights are frozen for the lifetime of its batcher, and
@@ -31,9 +35,21 @@ from typing import Dict, List, Optional, Sequence, Union
 import jax
 import numpy as np
 
+from deeplearning4j_tpu.runtime.compile_cache import AotCache
+from deeplearning4j_tpu.runtime.state_packing import step_args_signature
+
 ArrayOrDict = Union[np.ndarray, Dict[str, np.ndarray]]
 
 logger = logging.getLogger(__name__)
+
+
+def _request_signature(x: ArrayOrDict):
+    """AOT-cache key component for one padded batch: the shared structural
+    signature (shapes + CANONICALIZED dtypes — an f64 JSON request lands
+    on the f32 program under jit, so a raw-dtype key would mint a
+    duplicate executable and break the compiles <= buckets x replicas
+    ledger)."""
+    return step_args_signature((x,))
 
 
 class Replica:
@@ -75,6 +91,11 @@ class ReplicaPool:
             n = len(devs)
         self._graph_inputs = list(getattr(model.conf, "inputs", []) or [])
         self._fn = self._output_fn(model)
+        # AOT fast path (env.aot_dispatch): one lower().compile() executable
+        # per (bucket signature, replica device), minted at warmup and
+        # called directly from the dispatch stage — counted by aot_count()
+        # so the batcher's compile ledger stays truthful
+        self._aot = AotCache("replica")
         self._lock = threading.Lock()
         self._rr = 0
         self.replicas: List[Replica] = []
@@ -97,6 +118,11 @@ class ReplicaPool:
 
     def __len__(self) -> int:
         return len(self.replicas)
+
+    def aot_count(self) -> int:
+        """XLA executables minted through the AOT fast path (one per
+        (bucket, replica) pair when warmed)."""
+        return len(self._aot)
 
     # ------------------------------------------------------------- forward
     def _output_fn(self, model):
@@ -169,9 +195,13 @@ class ReplicaPool:
             if not isinstance(x, dict):
                 x = {self._graph_inputs[0]: x}
             inputs_ = {n: x[n] for n in self._graph_inputs}
-            outs = self._fn(replica.params, replica.model_state, inputs_)
+            outs = self._aot.call(
+                (replica.index, _request_signature(inputs_)),
+                self._fn, replica.params, replica.model_state, inputs_)
             return outs[0] if len(outs) == 1 else outs
-        return self._fn(replica.params, replica.model_state, x, None)
+        return self._aot.call(
+            (replica.index, _request_signature(x)),
+            self._fn, replica.params, replica.model_state, x, None)
 
     def forward_blocking(self, replica: Replica, x: ArrayOrDict):
         """Dispatch + full readback on one replica (warmup path — forces

@@ -116,6 +116,7 @@ class ModelServer:
         parts = ["# TYPE serving_latency_seconds summary",
                  "# TYPE serving_dispatch_to_completion_seconds summary",
                  "# TYPE serving_inflight_depth gauge",
+                 "# TYPE serving_warmup_seconds gauge",
                  "# TYPE serving_replica_batches_total counter"]
         for name in self.registry.names():
             try:
@@ -123,7 +124,26 @@ class ModelServer:
                              .render_prometheus(name))
             except KeyError:
                 pass  # undeployed between listing and render
+        parts.append(self._render_compile_cache())
         return "\n".join(parts) + "\n"
+
+    @staticmethod
+    def _render_compile_cache() -> str:
+        """Process-global persistent-executable-cache + AOT counters
+        (ISSUE 5 cold-start observability) — unlabelled: one XLA process,
+        one cache, shared by every served model."""
+        from deeplearning4j_tpu.runtime.compile_cache import stats
+        s = stats()
+        return "\n".join([
+            f"compile_cache_enabled {int(bool(s['enabled']))}",
+            f"compile_cache_hits_total {s['hits']}",
+            f"compile_cache_misses_total {s['misses']}",
+            f"compile_cache_corrupt_entries_total {s['corrupt_entries']}",
+            f"compile_cache_compile_seconds_total {s['compile_seconds']}",
+            f"compile_cache_retrieval_seconds_total {s['retrieval_seconds']}",
+            f"aot_dispatch_executables_total {s['aot_compiles']}",
+            f"aot_dispatch_fallbacks_total {s['aot_fallbacks']}",
+        ])
 
     # ------------------------------------------------------------ plumbing
     def start(self, port: int = 0, host: str = "127.0.0.1") -> int:

@@ -23,6 +23,9 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import glob  # noqa: E402
+import json  # noqa: E402
+import re  # noqa: E402
 import threading  # noqa: E402
 import time  # noqa: E402
 
@@ -33,6 +36,91 @@ import pytest  # noqa: E402
 # leaked stage (e.g. a prefetcher abandoned without close()).
 _PIPELINE_THREAD_NAMES = ("train-prefetch", "train-listener-delivery",
                           "async-dataset-iterator")
+
+
+# --------------------------------------------------------------------------
+# TESTS_r*.json: per-round test-run artifact (VERDICT r5 weak #3 — "full
+# suite green" must be a recorded artifact, not a commit-message claim).
+# Every pytest run overwrites the CURRENT round's summary: collected /
+# passed / failed / error / skipped counts, whether the slow tier was
+# included (markexpr), wall time and exit status. The round number is
+# max(BENCH_r*.json) + 1 — the round being built, stamped by the same
+# driver convention that records BENCH artifacts at round close.
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_outcomes = {"passed": 0, "failed": 0, "error": 0, "skipped": 0,
+             "xfailed": 0, "xpassed": 0}
+_collected = {"n": 0, "deselected": 0}
+_session_t0 = time.monotonic()
+
+
+def _current_round() -> int:
+    rounds = [int(m.group(1)) for p in
+              glob.glob(os.path.join(_REPO_ROOT, "BENCH_r*.json"))
+              if (m := re.search(r"BENCH_r(\d+)\.json$", p))]
+    return (max(rounds) + 1) if rounds else 1
+
+
+def pytest_collection_modifyitems(config, items):
+    _collected["n"] = len(items)
+
+
+def pytest_deselected(items):
+    _collected["deselected"] += len(items)
+
+
+def pytest_runtest_logreport(report):
+    if report.when == "call":
+        if hasattr(report, "wasxfail"):
+            _outcomes["xpassed" if report.passed else "xfailed"] += 1
+        elif report.passed:
+            _outcomes["passed"] += 1
+        elif report.failed:
+            _outcomes["failed"] += 1
+        elif report.skipped:
+            _outcomes["skipped"] += 1
+    elif report.when in ("setup", "teardown"):
+        if report.failed:
+            _outcomes["error"] += 1
+        elif report.when == "setup" and report.skipped:
+            _outcomes["skipped"] += 1
+
+
+def pytest_sessionfinish(session, exitstatus):
+    # only a full-suite run is a round artifact: a single-file, -k, --lf,
+    # --deselect, or collect-only run must not overwrite the record with a
+    # partial (or empty-but-green) count
+    opt = session.config.option
+    args = [a for a in session.config.args if not a.startswith("-")]
+    if any(not os.path.isdir(a) for a in args):
+        return
+    if (getattr(opt, "keyword", "") or getattr(opt, "collectonly", False)
+            or getattr(opt, "lf", False) or getattr(opt, "failedfirst", False)
+            or getattr(opt, "deselect", None)):
+        return
+    markexpr = getattr(opt, "markexpr", "") or ""
+    if markexpr not in ("", "not slow"):
+        return  # `-m slow` etc. is a subset run, not a round record
+    summary = {
+        "round": _current_round(),
+        "collected": _collected["n"],
+        **_outcomes,
+        # counted via pytest_deselected, NOT derived by subtraction (a
+        # teardown error double-counts its test against the outcomes sum)
+        "deselected": _collected["deselected"],
+        "markexpr": markexpr,
+        "slow_included": "not slow" not in markexpr,
+        "exit_status": int(exitstatus),
+        "duration_s": round(time.monotonic() - _session_t0, 1),
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    try:  # the artifact must never be able to fail the suite
+        path = os.path.join(_REPO_ROOT,
+                            f"TESTS_r{summary['round']:02d}.json")
+        with open(path, "w") as f:
+            json.dump(summary, f, indent=2)
+    except OSError:
+        pass
 
 
 @pytest.fixture(autouse=True)

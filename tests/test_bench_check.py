@@ -14,16 +14,23 @@ bench = importlib.util.module_from_spec(_spec)
 _spec.loader.exec_module(bench)
 
 
-def _table_md(ranges):
-    rows = "\n".join(f"| `{k}` | {lo} | {hi} |"
-                     for k, (lo, hi) in sorted(ranges.items()))
-    return ("# BASELINE\n\nprose\n\n## Closing table (machine-checked)\n\n"
-            "| metric | recorded low | recorded high |\n|---|---|---|\n"
-            + rows + "\n")
-
-
 def _mid(lo, hi):
     return (lo + hi) / 2.0
+
+
+def _table_md(ranges, measured=None):
+    """Synthetic BASELINE.md with both machine-checked tables (the
+    closing-measured rows default to each range's midpoint — the same
+    values the tests put into their synthetic BENCH_EXTRA.json)."""
+    rows = "\n".join(f"| `{k}` | {lo} | {hi} |"
+                     for k, (lo, hi) in sorted(ranges.items()))
+    if measured is None:
+        measured = {k: _mid(lo, hi) for k, (lo, hi) in ranges.items()}
+    mrows = "\n".join(f"| `{k}` | {v} |" for k, v in sorted(measured.items()))
+    return ("# BASELINE\n\nprose\n\n## Closing table (machine-checked)\n\n"
+            "| metric | recorded low | recorded high |\n|---|---|---|\n"
+            + rows + "\n\n## Closing measured (machine-checked)\n\n"
+            "| metric | recorded |\n|---|---|\n" + mrows + "\n")
 
 
 def test_parse_baseline_table_matches_recorded_ranges():
@@ -32,6 +39,43 @@ def test_parse_baseline_table_matches_recorded_ranges():
     doc = bench.parse_baseline_table(str(REPO / "BASELINE.md"))
     assert doc == {k: tuple(map(float, v))
                    for k, v in bench.RECORDED_RANGES.items()}
+
+
+def test_parse_measured_table_covers_recorded_ranges():
+    """The committed closing-measured table carries a POINT value for every
+    ranged metric (ISSUE 5 satellite: the table the 184.1-vs-178.5 drift
+    hid in is now parsed and diffed by machinery)."""
+    doc = bench.parse_measured_table(str(REPO / "BASELINE.md"))
+    assert set(doc) == set(bench.RECORDED_RANGES)
+
+
+def test_check_tables_fails_on_measured_value_drift(tmp_path):
+    """The VERDICT r5 weak-#1 drift class: a closing-table point value
+    written from a different run than the artifact it cites must fail
+    loudly."""
+    measured = {k: _mid(*rng) for k, rng in bench.RECORDED_RANGES.items()}
+    claimed = dict(measured)
+    # claim ~3% above what the artifact recorded (the 184.1-vs-178.5 gap)
+    claimed["mxu_tflops"] = measured["mxu_tflops"] * 1.031
+    md = tmp_path / "BASELINE.md"
+    md.write_text(_table_md(bench.RECORDED_RANGES, measured=claimed))
+    extra = tmp_path / "BENCH_EXTRA.json"
+    extra.write_text(json.dumps(measured))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("mxu_tflops" in m and "regenerate" in m for m in msgs)
+
+
+def test_check_tables_tolerates_doc_rounding(tmp_path):
+    """A verbatim copy rounded for the doc (well under 0.5%) is not
+    drift."""
+    measured = {k: _mid(*rng) for k, rng in bench.RECORDED_RANGES.items()}
+    claimed = {k: round(v, 1) for k, v in measured.items()}
+    md = tmp_path / "BASELINE.md"
+    md.write_text(_table_md(bench.RECORDED_RANGES, measured=claimed))
+    extra = tmp_path / "BENCH_EXTRA.json"
+    extra.write_text(json.dumps(measured))
+    assert bench.check_tables(str(md), str(extra), log=lambda *a: None) == 0
 
 
 def test_check_tables_passes_on_repo_state():
