@@ -1,0 +1,24 @@
+"""Round-record helpers shared by conftest.py and its tests.
+
+Kept free of module-level side effects: conftest.py mutates env vars and
+jax config at import, so tests exercising record logic import THIS module
+instead of re-executing conftest (pytest already imported it once).
+"""
+
+import json
+import os
+
+
+def record_downgrades_prior(summary: dict, path: str) -> bool:
+    """Ratchet: a ``not slow`` run must not clobber a same-round record that
+    already covers the full tier (slow_included: true) — a filtered run
+    overwriting the full record would silently drop any failures that live
+    in the slow tier. An unreadable/corrupt prior record never blocks."""
+    if summary["slow_included"] or not os.path.exists(path):
+        return False
+    try:
+        with open(path) as f:
+            prior = json.load(f)
+    except (OSError, ValueError):
+        return False
+    return bool(prior.get("slow_included"))

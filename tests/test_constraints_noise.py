@@ -50,8 +50,11 @@ def test_unit_norm_and_nonnegative():
 
 def test_dropconnect_trains_and_is_deterministic_at_inference():
     from deeplearning4j_tpu.data import NumpyDataSetIterator
+    # 10 epochs: dropconnect halves the effective gradient signal, and with
+    # this toolchain's mask draws 5 epochs stalls at ~0.72 accuracy while 10
+    # reaches 1.0 (the no-noise control fits in 5)
     net = _fit(DenseLayer(n_out=16, activation="relu",
-                          weight_noise=DropConnect(p=0.7)), epochs=5)
+                          weight_noise=DropConnect(p=0.7)), epochs=10)
     x, y = _data()
     out1 = np.asarray(net.output(x[:8]))
     out2 = np.asarray(net.output(x[:8]))

@@ -1,0 +1,50 @@
+"""TESTS_r*.json round-record hook (ISSUE 5 satellite 5 + review follow-up).
+
+The per-round artifact in conftest.py records what a pytest run actually
+covered. The ratchet under test here guards its one downgrade path: a
+``-m "not slow"`` run finishing after a full-tier run must not overwrite
+the full record — that would silently drop failures living in the slow
+tier from the round's record.
+"""
+
+import json
+
+from _round_record import record_downgrades_prior as _record_downgrades_prior
+
+
+def _summary(slow_included):
+    return {"round": 6, "collected": 10, "passed": 10, "failed": 0,
+            "slow_included": slow_included, "exit_status": 0}
+
+
+def test_filtered_run_cannot_clobber_full_tier_record(tmp_path):
+    path = tmp_path / "TESTS_r06.json"
+    path.write_text(json.dumps(_summary(slow_included=True)))
+    assert _record_downgrades_prior(_summary(slow_included=False), str(path))
+
+
+def test_full_run_always_writes(tmp_path):
+    path = tmp_path / "TESTS_r06.json"
+    path.write_text(json.dumps(_summary(slow_included=False)))
+    # full-tier runs overwrite anything, including a prior full-tier record
+    assert not _record_downgrades_prior(_summary(slow_included=True),
+                                        str(path))
+    path.write_text(json.dumps(_summary(slow_included=True)))
+    assert not _record_downgrades_prior(_summary(slow_included=True),
+                                        str(path))
+
+
+def test_filtered_run_writes_over_filtered_or_missing(tmp_path):
+    path = tmp_path / "TESTS_r06.json"
+    assert not _record_downgrades_prior(_summary(slow_included=False),
+                                        str(path))  # no prior record
+    path.write_text(json.dumps(_summary(slow_included=False)))
+    assert not _record_downgrades_prior(_summary(slow_included=False),
+                                        str(path))
+
+
+def test_corrupt_prior_record_never_blocks(tmp_path):
+    path = tmp_path / "TESTS_r06.json"
+    path.write_text("{truncated")
+    assert not _record_downgrades_prior(_summary(slow_included=False),
+                                        str(path))

@@ -57,7 +57,10 @@ def test_external_errors_gradient_and_training():
                                rtol=1e-4, atol=1e-5)
 
     l0 = float(loss_of_x(x))
-    for _ in range(30):
+    # 100 steps: plain SGD(0.1) from this init needs ~100 steps to halve the
+    # loss (verified against a hand-rolled jax.grad SGD oracle, which
+    # fit_external matches bit-for-bit step by step)
+    for _ in range(100):
         out = net.output(x)
         net.fit_external(x, 2 * (out - target) / out.size)
     assert float(loss_of_x(x)) < l0 * 0.5
