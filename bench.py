@@ -202,6 +202,10 @@ def check_tables(baseline_md=None, bench_extra=None, log=_log):
                     f"{claimed}, {os.path.basename(bench_extra)} recorded "
                     f"{v} — regenerate the table from the artifact")
 
+    # ISSUE 6 distributed keys: structural + internal-consistency coverage
+    if measured is not None:
+        check_distributed_section(measured, failures, warnings)
+
     for w in warnings:
         log(f"[check-tables] WARN {w}")
     for fmsg in failures:
@@ -1464,6 +1468,312 @@ def bench_training(n_batches=40, batch=256, features=512, bench_extra=None,
     return 0
 
 
+# -------------------------------------------------------------- distributed
+_DIST_WORKER = r"""
+import json, os, sys, time
+import numpy as np
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+mode = sys.argv[1]          # "worker" | "oracle"
+rank = int(sys.argv[2]); world = int(sys.argv[3]); port = sys.argv[4]
+threshold = float(sys.argv[5]); steps = int(sys.argv[6])
+warmup = int(sys.argv[7]); local_batch = int(sys.argv[8])
+features = int(sys.argv[9]); hidden = int(sys.argv[10])
+
+import jax
+if mode == "worker":
+    from deeplearning4j_tpu.runtime.mesh import initialize_multihost
+    initialize_multihost(coordinator_address=f"127.0.0.1:{port}",
+                         num_processes=world, process_id=rank)
+
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.train import Sgd
+from deeplearning4j_tpu.train.distributed import (DistributedConfig,
+                                                  DistributedTrainer)
+
+conf = (NeuralNetConfiguration.builder().seed(7).updater(Sgd(0.1)).list()
+        .layer(DenseLayer(n_out=hidden, activation="relu"))
+        .layer(OutputLayer(n_out=8, activation="softmax"))
+        .set_input_type(InputType.feed_forward(features)).build())
+net = MultiLayerNetwork(conf).init()
+tr = DistributedTrainer(
+    net, DistributedConfig(threshold=threshold),
+    world=world, rank=(None if mode == "oracle" else -1))
+
+B = world * local_batch
+def batch(i):
+    brng = np.random.default_rng(1000 + i)
+    x = brng.normal(0, 1, (B, features)).astype(np.float32)
+    y = np.eye(8, dtype=np.float32)[brng.integers(0, 8, B)]
+    return x, y
+
+try:
+    for i in range(warmup):
+        tr.step(*batch(i))
+    t0 = time.perf_counter()
+    for i in range(warmup, warmup + steps):
+        tr.step(*batch(i))
+    elapsed = time.perf_counter() - t0
+except BaseException as e:           # noqa: BLE001
+    print(f"WORKER-FAILED {type(e).__name__}: {e}", flush=True)
+    os._exit(17)  # skip jax.distributed's atexit barrier (peers see the
+                  # exit code instead of a stall)
+
+leaves = [np.asarray(l) for l in jax.tree.leaves(net.train_state.params)]
+import hashlib
+phash = hashlib.sha256(b"".join(l.tobytes() for l in leaves)).hexdigest()
+rep = tr.stats.report()
+print("RES" + json.dumps({
+    "steps_per_sec": round(steps / elapsed, 3),
+    "examples_per_sec": round(steps * B / elapsed, 1),
+    "losses": tr.losses,
+    "phash": phash,
+    "comms_bytes_per_step": rep["comms_bytes_per_step"],
+    "dense_bytes_per_step": rep["dense_bytes_per_step"],
+    "encode_mean_ms": rep["encode_mean_ms"],
+    "exchange_mean_ms": rep["exchange_mean_ms"],
+    "decode_mean_ms": rep["decode_mean_ms"],
+    "apply_mean_ms": rep["apply_mean_ms"],
+}), flush=True)
+os._exit(0)  # ditto: a clean worker must not stall in the shutdown barrier
+"""
+
+
+def _dist_run(wfile, mode, world, threshold, steps, warmup=3,
+              local_batch=256, features=512, hidden=512, timeout=420):
+    """Launch one arm — ``world`` worker processes (or one oracle
+    process) — and return the per-rank parsed RES payloads."""
+    import subprocess
+
+    from deeplearning4j_tpu.train.distributed import free_port, worker_env
+
+    port = free_port()
+    env = worker_env()
+    args = lambda r: [sys.executable, str(wfile), mode, str(r), str(world),
+                      port, str(threshold), str(steps), str(warmup),
+                      str(local_batch), str(features), str(hidden)]
+    n_procs = 1 if mode == "oracle" else world
+    from deeplearning4j_tpu.train import distributed as _dist
+    procs = [subprocess.Popen(args(r), stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, env=env, text=True)
+             for r in range(n_procs)]
+    for p in procs:
+        _dist._track_child(p)
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"distributed {mode} (world={world}, t={threshold}) rank "
+                    f"failed rc={p.returncode}:\n{out[-1000:]}\n{err[-2000:]}")
+            lines = [l for l in out.splitlines() if l.startswith("RES")]
+            if not lines:
+                raise RuntimeError(f"no RES line from {mode} worker:\n"
+                                   f"{out[-1000:]}\n{err[-2000:]}")
+            outs.append(json.loads(lines[0][3:]))
+    finally:
+        # one dead rank leaves its peers stalled in the collective forever
+        # — never exit leaving a wedged gloo worker on the box
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    return outs
+
+
+def bench_distributed(steps=16, bench_extra=None, log=_log):
+    """``bench.py --distributed`` (ISSUE 6): the multi-process
+    data-parallel trainer measured three ways on one box —
+
+    1. order-alternated A/B at world=2: dense f32 allreduce vs
+       threshold-encoded exchange (same model, same data, best-of-2 per
+       arm); asserts the encoded wire bytes are >= 5x smaller and that
+       both arms' workers stay in bit-exact lockstep,
+    2. bit-exactness anchor: each world=2 arm's trajectory must equal the
+       single-process loopback oracle (same class, ``rank=None``)
+       bit-for-bit — the zero-silent-divergence assert,
+    3. a 1->N process weak-scaling curve (fixed local batch) for the
+       encoded transport; ``scaling_efficiency`` = steps/sec at max N
+       over steps/sec at N=1.
+
+    Writes ``BENCH_EXTRA.json["distributed"]`` + top-level
+    ``dist_steps_per_sec`` / ``comms_bytes_per_step`` /
+    ``scaling_efficiency``. Returns a process exit code."""
+    import tempfile
+
+    THRESH = 1e-3
+    failures = []
+    results = {"threshold": THRESH, "steps_timed": steps,
+               "local_batch": 256}
+    with tempfile.TemporaryDirectory() as td:
+        wfile = os.path.join(td, "dist_worker.py")
+        with open(wfile, "w") as f:
+            f.write(_DIST_WORKER)
+
+        # -- A/B at world=2, order-alternated, best-of per arm ------------
+        arms = {0.0: [], THRESH: []}
+        for pair in ((0.0, THRESH), (THRESH, 0.0)):
+            for thr in pair:
+                wait_for_quiet_host()
+                outs = _dist_run(wfile, "worker", 2, thr, steps)
+                # trajectory fields only — per-worker timings differ
+                traj = [(o["losses"], o["phash"]) for o in outs]
+                if any(t != traj[0] for t in traj[1:]):
+                    failures.append(
+                        f"world=2 t={thr}: workers diverged (lockstep "
+                        f"invariant broken)")
+                arms[thr].append(outs[0])
+        for thr, tag in ((0.0, "dense"), (THRESH, "encoded")):
+            best = max(arms[thr], key=lambda o: o["steps_per_sec"])
+            oracle = _dist_run(wfile, "oracle", 2, thr, steps)[0]
+            if (best["losses"] != oracle["losses"]
+                    or best["phash"] != oracle["phash"]):
+                failures.append(
+                    f"{tag} world=2 trajectory != single-process oracle "
+                    f"(silent divergence)")
+            results[tag] = {
+                "steps_per_sec": best["steps_per_sec"],
+                "examples_per_sec": best["examples_per_sec"],
+                "comms_bytes_per_step": best["comms_bytes_per_step"],
+                "dense_bytes_per_step": best["dense_bytes_per_step"],
+                "encode_mean_ms": best["encode_mean_ms"],
+                "exchange_mean_ms": best["exchange_mean_ms"],
+                "decode_mean_ms": best["decode_mean_ms"],
+                "apply_mean_ms": best["apply_mean_ms"],
+                "matches_oracle": best["losses"] == oracle["losses"],
+            }
+            log(f"[distributed] world=2 {tag}: "
+                f"{best['steps_per_sec']} steps/s, "
+                f"{best['comms_bytes_per_step']} B/step on the wire, "
+                f"load {host_load()}")
+
+        reduction = (results["dense"]["comms_bytes_per_step"]
+                     / max(1, results["encoded"]["comms_bytes_per_step"]))
+        results["comms_reduction_vs_dense"] = round(reduction, 2)
+        if reduction < 5.0:
+            failures.append(f"encoded exchange only {reduction:.1f}x smaller "
+                            f"than dense (< 5x)")
+
+        # -- 1->N weak-scaling curve (encoded transport) ------------------
+        curve = {}
+        for world in (1, 2, 4):
+            wait_for_quiet_host()
+            outs = _dist_run(wfile, "worker", world, THRESH, steps)
+            curve[str(world)] = {
+                "steps_per_sec": outs[0]["steps_per_sec"],
+                "examples_per_sec": outs[0]["examples_per_sec"],
+            }
+            log(f"[distributed] world={world}: {outs[0]['steps_per_sec']} "
+                f"steps/s ({outs[0]['examples_per_sec']} ex/s)")
+        max_n = max(int(k) for k in curve)
+        eff = (curve[str(max_n)]["steps_per_sec"]
+               / max(1e-9, curve["1"]["steps_per_sec"]))
+        results["scaling_curve"] = curve
+        results["scaling_efficiency"] = round(eff, 3)
+        results["scaling_efficiency_world"] = max_n
+        results["dist_steps_per_sec"] = \
+            results["encoded"]["steps_per_sec"]
+
+    for fmsg in failures:
+        log(f"[distributed] FAIL {fmsg}")
+    if failures:
+        # never clobber the last good record with a failing run's numbers
+        return 1
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    bench_extra = bench_extra or os.path.join(here, "BENCH_EXTRA.json")
+    try:
+        with open(bench_extra) as f:
+            extra = json.load(f)
+    except Exception:
+        extra = {}
+    extra["distributed"] = results
+    extra["dist_steps_per_sec"] = results["dist_steps_per_sec"]
+    extra["comms_bytes_per_step"] = \
+        results["encoded"]["comms_bytes_per_step"]
+    extra["scaling_efficiency"] = results["scaling_efficiency"]
+    with open(bench_extra, "w") as f:
+        json.dump(extra, f, indent=2)
+    log(f"[distributed] OK: encoded {results['dist_steps_per_sec']} steps/s "
+        f"at world=2, wire bytes {results['comms_reduction_vs_dense']}x "
+        f"smaller than dense, weak-scaling efficiency "
+        f"{results['scaling_efficiency']} at world={max_n}, both arms "
+        f"bit-identical to the single-process oracle")
+    return 0
+
+
+def check_distributed_section(extra, failures, warnings):
+    """--check-tables coverage for the ISSUE 6 keys: the ``distributed``
+    section (when present) must carry the required metrics, agree with
+    its own top-level copies, and be internally consistent (the claimed
+    comms reduction and scaling efficiency must be recomputable from the
+    recorded rows)."""
+    if "distributed" not in extra:
+        warnings.append("distributed: not present in BENCH_EXTRA.json "
+                        "(bench --distributed not run?)")
+        return
+    d = extra["distributed"]
+    required = ["dist_steps_per_sec", "comms_reduction_vs_dense",
+                "scaling_efficiency", "scaling_curve", "dense", "encoded"]
+    for k in required:
+        if k not in d:
+            failures.append(f"distributed.{k}: missing from the recorded "
+                            f"section")
+    if any(k not in d for k in required):
+        return
+    try:
+        _check_distributed_consistency(extra, d, failures)
+    except (TypeError, ValueError, AttributeError, KeyError) as e:
+        # a malformed artifact is a FAILURE line, not a checker crash
+        failures.append(f"distributed: malformed section ({e!r})")
+
+
+def _check_distributed_consistency(extra, d, failures):
+    for arm in ("dense", "encoded"):
+        if d[arm].get("matches_oracle") is not True:
+            failures.append(
+                f"distributed.{arm}: matches_oracle is "
+                f"{d[arm].get('matches_oracle')!r} — the recorded run "
+                f"diverged from the single-process oracle")
+    for top in ("dist_steps_per_sec", "scaling_efficiency"):
+        if extra.get(top) != d[top]:
+            failures.append(
+                f"{top}: top-level copy {extra.get(top)} != "
+                f"distributed section {d[top]}")
+    if extra.get("comms_bytes_per_step") != \
+            d["encoded"]["comms_bytes_per_step"]:
+        failures.append(
+            "comms_bytes_per_step: top-level copy "
+            f"{extra.get('comms_bytes_per_step')} != encoded arm "
+            f"{d['encoded']['comms_bytes_per_step']}")
+    dense_b = d["dense"].get("comms_bytes_per_step", 0)
+    enc_b = d["encoded"].get("comms_bytes_per_step", 1)
+    red = dense_b / max(1, enc_b)
+    if abs(red - d["comms_reduction_vs_dense"]) > 0.02 * red:
+        failures.append(
+            f"comms_reduction_vs_dense: claims "
+            f"{d['comms_reduction_vs_dense']}, recorded byte rows give "
+            f"{red:.2f}")
+    curve = d["scaling_curve"]
+    max_n = str(d.get("scaling_efficiency_world",
+                      max(int(k) for k in curve)))
+    if "1" not in curve or max_n not in curve:
+        failures.append(f"scaling_curve: missing world=1 or world={max_n} "
+                        f"rows")
+        return
+    eff = (curve[max_n]["steps_per_sec"]
+           / max(1e-9, curve["1"]["steps_per_sec"]))
+    if abs(eff - d["scaling_efficiency"]) > 0.02 * max(eff, 1e-9):
+        failures.append(
+            f"scaling_efficiency: claims {d['scaling_efficiency']}, "
+            f"recorded curve gives {eff:.3f}")
+
+
 # ------------------------------------------------------------------- resnet
 def bench_resnet():
     import jax
@@ -1861,6 +2171,8 @@ if __name__ == "__main__":
         sys.exit(chaos_smoke())
     if "--training" in sys.argv:
         sys.exit(bench_training())
+    if "--distributed" in sys.argv:
+        sys.exit(bench_distributed())
     if "--serving" in sys.argv:
         # give the CPU backend multiple virtual devices so the replica arm
         # is real even off-TPU (flag only affects the host platform; must

@@ -87,6 +87,9 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.threshold_decode.restype = None
         lib.threshold_decode.argtypes = [c_i32p, ctypes.c_int64, ctypes.c_float,
                                          c_f32p, ctypes.c_int64]
+        lib.threshold_count.restype = ctypes.c_int64
+        lib.threshold_count.argtypes = [c_f32p, c_f32p, ctypes.c_int64,
+                                        ctypes.c_float]
         lib.bitmap_encode.restype = ctypes.c_int64
         lib.bitmap_encode.argtypes = [c_f32p, c_f32p, ctypes.c_int64,
                                       ctypes.c_float, c_u8p]
@@ -113,17 +116,55 @@ def _fp(a: np.ndarray, typ):
 
 class ThresholdCodec:
     """Sparse threshold gradient codec with residual state (reference
-    ``EncodedGradientsAccumulator`` wire format)."""
+    ``EncodedGradientsAccumulator`` wire format).
+
+    Input hardening (ISSUE 6 codec satellite — these were silent
+    out-of-bounds reads or wrong-answer paths before):
+
+    - ``encode``/``encode_bitmap`` require ``grad.size == self.size``; a
+      shorter buffer used to make the C kernel read past its end, a longer
+      one silently dropped the tail.
+    - ``decode``/``decode_bitmap`` validate a caller-supplied ``target``
+      (f32, contiguous, exactly ``size`` elements) — the ctypes cast would
+      otherwise reinterpret f64 memory as f32 and scribble garbage.
+    - ``decode_bitmap`` rejects truncated buffers (the C loop indexes
+      ``encoded[n >> 2]`` unconditionally).
+    - the numpy ``decode`` fallback now matches the C kernel's semantics
+      on invalid indices: 0 and out-of-range entries are *ignored* (0 used
+      to wrap to ``target[-1]``).
+    - bitmap encode/decode have bit-exact numpy fallbacks, so a
+      toolchain-less host degrades instead of raising.
+    """
 
     def __init__(self, size: int, threshold: float = 1e-3):
         self.size = int(size)
         self.threshold = float(threshold)
         self.residual = np.zeros(self.size, np.float32)
 
+    def _check_grad(self, grad: np.ndarray) -> np.ndarray:
+        grad = np.ascontiguousarray(grad, np.float32).reshape(-1)
+        if grad.size != self.size:
+            raise ValueError(
+                f"grad has {grad.size} elements, codec expects {self.size}")
+        return grad
+
+    def _check_target(self, target: Optional[np.ndarray]) -> np.ndarray:
+        if target is None:
+            return np.zeros(self.size, np.float32)
+        if (target.dtype != np.float32 or target.ndim != 1
+                or target.size != self.size
+                or not target.flags.c_contiguous):
+            # 1-D is part of the contract: the numpy fallbacks index the
+            # target directly (a (10,10) view would row-index)
+            raise ValueError(
+                f"target must be a contiguous 1-D float32 array of "
+                f"{self.size} elements, got {target.dtype}{target.shape}")
+        return target
+
     def encode(self, grad: np.ndarray) -> np.ndarray:
-        grad = np.ascontiguousarray(grad.reshape(-1), np.float32)
+        grad = self._check_grad(grad)
         lib = get_lib()
-        if lib is not None:
+        if lib is not None and self.size:
             out = np.empty(self.size, np.int32)
             n = lib.threshold_encode(
                 _fp(grad, ctypes.POINTER(ctypes.c_float)),
@@ -131,57 +172,196 @@ class ThresholdCodec:
                 self.size, self.threshold,
                 _fp(out, ctypes.POINTER(ctypes.c_int32)), self.size)
             return out[:n].copy()
-        # numpy fallback
+        # numpy fallback (kept bit-identical to the C kernel)
         acc = grad + self.residual
         pos = acc >= self.threshold
         neg = acc <= -self.threshold
         idx = np.nonzero(pos | neg)[0]
-        encoded = np.where(acc[idx] > 0, idx + 1, -(idx + 1)).astype(np.int32)
+        # sign convention matches the C kernel: `acc >= threshold` emits a
+        # positive index (threshold 0 ties encode as +0 contributions)
+        encoded = np.where(acc[idx] >= self.threshold,
+                           idx + 1, -(idx + 1)).astype(np.int32)
         self.residual = acc
-        self.residual[idx] -= np.sign(acc[idx]) * self.threshold
+        self.residual[idx] -= np.where(encoded > 0, self.threshold,
+                                       -self.threshold).astype(np.float32)
         return encoded
 
     def decode(self, encoded: np.ndarray, target: Optional[np.ndarray] = None
                ) -> np.ndarray:
-        if target is None:
-            target = np.zeros(self.size, np.float32)
-        encoded = np.ascontiguousarray(encoded, np.int32)
+        target = self._check_target(target)
+        encoded = np.ascontiguousarray(encoded, np.int32).reshape(-1)
         lib = get_lib()
+        if len(encoded) == 0:
+            return target
         if lib is not None:
             lib.threshold_decode(
                 _fp(encoded, ctypes.POINTER(ctypes.c_int32)), len(encoded),
                 self.threshold, _fp(target, ctypes.POINTER(ctypes.c_float)),
                 self.size)
             return target
-        idx = np.abs(encoded) - 1
-        target[idx] += np.sign(encoded) * self.threshold
+        # match C semantics: invalid indices (0, |idx| > size) are ignored
+        valid = encoded[(np.abs(encoded) >= 1) & (np.abs(encoded) <= self.size)]
+        idx = np.abs(valid) - 1
+        np.add.at(target, idx,
+                  np.where(valid > 0, self.threshold,
+                           -self.threshold).astype(np.float32))
         return target
 
+    def bitmap_nbytes(self) -> int:
+        """Wire size of a bitmap encoding: 2 bits per element."""
+        return (self.size + 3) // 4
+
     def encode_bitmap(self, grad: np.ndarray) -> np.ndarray:
-        grad = np.ascontiguousarray(grad.reshape(-1), np.float32)
+        grad = self._check_grad(grad)
         lib = get_lib()
-        nbytes = (self.size + 3) // 4
-        if lib is not None:
+        nbytes = self.bitmap_nbytes()
+        if lib is not None and self.size:
             out = np.empty(nbytes, np.uint8)
             lib.bitmap_encode(
                 _fp(grad, ctypes.POINTER(ctypes.c_float)),
                 _fp(self.residual, ctypes.POINTER(ctypes.c_float)),
                 self.size, self.threshold, _fp(out, ctypes.POINTER(ctypes.c_uint8)))
             return out
-        raise RuntimeError("bitmap encoding requires the native library")
+        # numpy fallback: same 2-bit little-endian packing as the C kernel
+        acc = grad + self.residual
+        code = np.zeros(self.size, np.uint8)
+        code[acc >= self.threshold] = 1
+        code[acc <= -self.threshold] = 2
+        self.residual = acc - np.where(
+            code == 1, self.threshold,
+            np.where(code == 2, -self.threshold, 0.0)).astype(np.float32)
+        padded = np.zeros(nbytes * 4, np.uint8)
+        padded[:self.size] = code
+        quads = padded.reshape(-1, 4)
+        return (quads[:, 0] | (quads[:, 1] << 2) | (quads[:, 2] << 4)
+                | (quads[:, 3] << 6)).astype(np.uint8)
 
     def decode_bitmap(self, encoded: np.ndarray,
                       target: Optional[np.ndarray] = None) -> np.ndarray:
-        if target is None:
-            target = np.zeros(self.size, np.float32)
+        target = self._check_target(target)
+        encoded = np.ascontiguousarray(encoded, np.uint8).reshape(-1)
+        nbytes = self.bitmap_nbytes()
+        if len(encoded) < nbytes:
+            raise ValueError(f"bitmap buffer has {len(encoded)} bytes, "
+                             f"need {nbytes} for {self.size} elements")
+        if self.size == 0:
+            return target
         lib = get_lib()
-        if lib is None:
-            raise RuntimeError("bitmap decoding requires the native library")
-        lib.bitmap_decode(_fp(np.ascontiguousarray(encoded, np.uint8),
-                              ctypes.POINTER(ctypes.c_uint8)),
-                          self.size, self.threshold,
-                          _fp(target, ctypes.POINTER(ctypes.c_float)))
+        if lib is not None:
+            lib.bitmap_decode(_fp(encoded, ctypes.POINTER(ctypes.c_uint8)),
+                              self.size, self.threshold,
+                              _fp(target, ctypes.POINTER(ctypes.c_float)))
+            return target
+        quads = encoded[:nbytes]
+        code = np.empty(nbytes * 4, np.uint8)
+        code[0::4] = quads & 3
+        code[1::4] = (quads >> 2) & 3
+        code[2::4] = (quads >> 4) & 3
+        code[3::4] = (quads >> 6) & 3
+        code = code[:self.size]
+        target[code == 1] += self.threshold
+        target[code == 2] -= self.threshold
         return target
+
+
+class TreeCodec:
+    """Threshold codec over a *flat param tree* — the ergonomics layer the
+    distributed trainer feeds (reference: ``EncodedGradientsAccumulator``
+    operates on the flattened-update view the updater blocks share).
+
+    Built from a list of template leaves (e.g. ``jax.tree.leaves(grads)``
+    materialized as numpy); owns the offsets, one residual buffer across
+    the whole tree, and the sparse/bitmap format choice:
+
+    - ``flatten(leaves)`` → one contiguous f32 vector
+    - ``unflatten(flat)`` → list of per-leaf arrays (template shapes)
+    - ``encode(flat)`` → ``(format, payload_bytes)`` where format is
+      ``FORMAT_SPARSE`` or ``FORMAT_BITMAP`` — chosen per call by
+      *predicted* wire size (the residual makes encoding stateful, so the
+      choice must happen before either encoder mutates it)
+    - ``decode_into(format, payload, target)`` — accumulate a peer's
+      encoded contribution into ``target``
+    """
+
+    FORMAT_DENSE = 0
+    FORMAT_SPARSE = 1
+    FORMAT_BITMAP = 2
+
+    def __init__(self, leaves, threshold: float = 1e-3):
+        self.shapes = [tuple(np.shape(l)) for l in leaves]
+        self.sizes = [int(np.prod(s)) if s else 1 for s in self.shapes]
+        self.offsets = np.cumsum([0] + self.sizes)
+        self.size = int(self.offsets[-1])
+        self.threshold = float(threshold)
+        self.codec = ThresholdCodec(self.size, threshold=self.threshold)
+
+    @property
+    def residual(self) -> np.ndarray:
+        return self.codec.residual
+
+    @residual.setter
+    def residual(self, value: np.ndarray) -> None:
+        value = np.ascontiguousarray(value, np.float32).reshape(-1)
+        if value.size != self.size:
+            raise ValueError(f"residual has {value.size} elements, "
+                             f"codec expects {self.size}")
+        self.codec.residual = value
+
+    def flatten(self, leaves) -> np.ndarray:
+        if len(leaves) != len(self.sizes):
+            raise ValueError(f"tree has {len(leaves)} leaves, codec "
+                             f"expects {len(self.sizes)}")
+        out = np.empty(self.size, np.float32)
+        for i, (leaf, lo, sz) in enumerate(
+                zip(leaves, self.offsets, self.sizes)):
+            flat = np.asarray(leaf, np.float32).reshape(-1)
+            if flat.size != sz:
+                # a size-1 leaf would silently broadcast into the slot
+                raise ValueError(f"leaf {i} has {flat.size} elements, "
+                                 f"template slot holds {sz}")
+            out[lo:lo + sz] = flat
+        return out
+
+    def unflatten(self, flat: np.ndarray):
+        flat = np.ascontiguousarray(flat, np.float32).reshape(-1)
+        if flat.size != self.size:
+            raise ValueError(f"flat vector has {flat.size} elements, "
+                             f"codec expects {self.size}")
+        return [flat[lo:lo + sz].reshape(shape) for lo, sz, shape in
+                zip(self.offsets, self.sizes, self.shapes)]
+
+    def predicted_format(self, flat: np.ndarray) -> int:
+        """Sparse-vs-bitmap choice by predicted wire size, *without*
+        touching the residual: count of would-be-emitted elements * 4
+        bytes against the fixed 2-bit bitmap. The count is a fused single
+        C pass (no temporaries) when the native lib is present."""
+        lib = get_lib()
+        if lib is not None and self.size:
+            flat32 = self.codec._check_grad(flat)
+            n_hits = int(lib.threshold_count(
+                _fp(flat32, ctypes.POINTER(ctypes.c_float)),
+                _fp(self.codec.residual, ctypes.POINTER(ctypes.c_float)),
+                self.size, self.threshold))
+        else:
+            n_hits = int(np.count_nonzero(
+                np.abs(flat + self.codec.residual) >= self.threshold))
+        return (self.FORMAT_SPARSE if n_hits * 4 <= self.codec.bitmap_nbytes()
+                else self.FORMAT_BITMAP)
+
+    def encode(self, flat: np.ndarray):
+        fmt = self.predicted_format(flat)
+        if fmt == self.FORMAT_SPARSE:
+            return fmt, self.codec.encode(flat).tobytes()
+        return fmt, self.codec.encode_bitmap(flat).tobytes()
+
+    def decode_into(self, fmt: int, payload: bytes,
+                    target: np.ndarray) -> np.ndarray:
+        if fmt == self.FORMAT_SPARSE:
+            return self.codec.decode(np.frombuffer(payload, np.int32), target)
+        if fmt == self.FORMAT_BITMAP:
+            return self.codec.decode_bitmap(
+                np.frombuffer(payload, np.uint8), target)
+        raise ValueError(f"unknown encoded-update format {fmt}")
 
 
 class ImagePipeline:

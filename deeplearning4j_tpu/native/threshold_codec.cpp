@@ -44,6 +44,19 @@ int64_t threshold_encode(const float* grad, float* residual, int64_t n,
   return count;
 }
 
+// Would-be-emitted element count for (grad + residual) against threshold,
+// WITHOUT touching the residual — the sparse-vs-bitmap format predictor
+// (the choice must precede encoding: encoding is stateful).
+int64_t threshold_count(const float* grad, const float* residual, int64_t n,
+                        float threshold) {
+  int64_t count = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    float acc = grad[i] + residual[i];
+    if (acc >= threshold || acc <= -threshold) ++count;
+  }
+  return count;
+}
+
 // Decode: target[|idx|-1] += sign(idx) * threshold
 void threshold_decode(const int32_t* encoded, int64_t count, float threshold,
                       float* target, int64_t n) {

@@ -52,7 +52,14 @@ must fail the fit cleanly with no thread left behind, see
 once per persistent-compilation-cache lookup, before the entry is read —
 a fault here simulates a corrupt/truncated cached executable and must
 degrade to a fresh compile, never a crash or a wrong answer, see
-``tests/test_compile_cache.py``).
+``tests/test_compile_cache.py``), ``train.distributed.exchange`` (fires
+once per distributed training step at the top of the gradient exchange —
+a fault kills the worker's step, which must surface as a supervised
+whole-group restart with exact checkpoint resume, never a silent
+divergence) and ``train.distributed.exchange.bytes`` (byte point over a
+worker's encoded-update payload AFTER its CRC header is computed, so
+injected wire corruption is exactly what every receiver's CRC check
+catches — see ``tests/test_distributed.py``).
 """
 
 from __future__ import annotations

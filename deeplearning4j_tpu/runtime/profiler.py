@@ -99,6 +99,84 @@ class OpProfiler:
         self._hists.clear()
 
 
+class ExchangeStats:
+    """Per-step stage split + compression counters for the distributed
+    trainer's gradient exchange (ISSUE 6): ``encode`` (threshold codec),
+    ``exchange`` (the collective), ``decode`` (peer-contribution
+    accumulate), ``apply`` (updater step). Reuses the serving
+    :class:`~deeplearning4j_tpu.serving.metrics.LatencyHistogram` — one
+    percentile implementation across serving, training and distributed
+    training. Attach to a
+    :class:`~deeplearning4j_tpu.train.profiler.TrainingProfiler` via
+    ``profiler.attach_exchange(stats)`` to surface the split and the
+    compression ratio on the training headline.
+
+    Thread-safety: recorded from the worker's step loop only, but guarded
+    by a lock anyway so a supervisor thread may snapshot mid-run.
+    """
+
+    STAGES = ("encode", "exchange", "decode", "apply")
+
+    def __init__(self):
+        import threading
+
+        from deeplearning4j_tpu.serving.metrics import LatencyHistogram
+        self._lock = threading.Lock()
+        self._hists = {s: LatencyHistogram() for s in self.STAGES}
+        self._totals = {s: 0.0 for s in self.STAGES}
+        self._counts = {s: 0 for s in self.STAGES}
+        self._dense_bytes = 0      # what a dense f32 exchange would move
+        self._wire_bytes = 0       # what this worker actually put on the wire
+        self._payload_bytes = 0    # unpadded encoded payload
+        self._steps = 0
+
+    def record(self, stage: str, seconds: float) -> None:
+        with self._lock:
+            self._totals[stage] += seconds
+            self._counts[stage] += 1
+            self._hists[stage].observe(seconds)
+
+    def record_bytes(self, dense_bytes: int, wire_bytes: int,
+                     payload_bytes: int) -> None:
+        with self._lock:
+            self._dense_bytes += int(dense_bytes)
+            self._wire_bytes += int(wire_bytes)
+            self._payload_bytes += int(payload_bytes)
+            self._steps += 1
+
+    @property
+    def steps(self) -> int:
+        with self._lock:
+            return self._steps
+
+    def report(self) -> Dict[str, float]:
+        with self._lock:
+            out: Dict[str, float] = {"steps": self._steps}
+            for s in self.STAGES:
+                n = self._counts[s]
+                out[f"{s}_total_s"] = round(self._totals[s], 4)
+                out[f"{s}_mean_ms"] = round(
+                    self._totals[s] / n * 1e3, 3) if n else 0.0
+                out[f"{s}_p99_ms"] = round(
+                    self._hists[s].percentile(99) * 1e3, 3)
+            steps = max(1, self._steps)
+            out["comms_bytes_per_step"] = round(self._wire_bytes / steps)
+            out["dense_bytes_per_step"] = round(self._dense_bytes / steps)
+            out["payload_bytes_per_step"] = round(self._payload_bytes / steps)
+            out["compression_ratio"] = round(
+                self._dense_bytes / self._wire_bytes, 2) \
+                if self._wire_bytes else 1.0
+        return out
+
+    def headline(self) -> str:
+        r = self.report()
+        return (f"exchange {r['exchange_mean_ms']:.2f}ms/step "
+                f"(encode {r['encode_mean_ms']:.2f} decode "
+                f"{r['decode_mean_ms']:.2f} apply {r['apply_mean_ms']:.2f}), "
+                f"{r['comms_bytes_per_step']} B/step on the wire "
+                f"({r['compression_ratio']}x vs dense)")
+
+
 @contextlib.contextmanager
 def trace(log_dir: str) -> Iterator[None]:
     """Capture a device trace (Chrome-trace analog of ``ProfilingListener``).

@@ -50,6 +50,12 @@ from deeplearning4j_tpu.train.prefetch import (
     coerce_training_batch,
 )
 from deeplearning4j_tpu.train.profiler import TrainingProfiler
+from deeplearning4j_tpu.train.distributed import (
+    DistributedConfig,
+    DistributedSupervisor,
+    DistributedTrainer,
+    ExchangeError,
+)
 from deeplearning4j_tpu.train.early_stopping import (
     BestScoreEpochTerminationCondition,
     DataSetLossCalculator,
@@ -68,6 +74,8 @@ __all__ = [
     "TrainingFailure",
     "DevicePrefetcher", "AsyncLossDelivery", "coerce_training_batch",
     "TrainingProfiler",
+    "DistributedTrainer", "DistributedConfig", "DistributedSupervisor",
+    "ExchangeError",
     "Updater", "Sgd", "Adam", "AdaMax", "AMSGrad", "Nadam", "Nesterovs",
     "RmsProp", "AdaGrad", "AdaDelta", "NoOp",
     "Schedule", "StepSchedule", "ExponentialSchedule", "InverseSchedule",

@@ -49,6 +49,16 @@ class TrainingProfiler:
         self._counts = {s: 0 for s in self.STAGES}
         self._t_start: Optional[float] = None
         self._t_stop: Optional[float] = None
+        self._exchange = None  # ExchangeStats from a DistributedTrainer
+
+    def attach_exchange(self, stats) -> "TrainingProfiler":
+        """Attach a :class:`~deeplearning4j_tpu.runtime.profiler.ExchangeStats`
+        (the distributed trainer does this when handed a profiler): its
+        encode/exchange/decode/apply split and compression counters merge
+        into :meth:`report` under ``exchange_*`` keys and onto the
+        :meth:`summary` headline."""
+        self._exchange = stats
+        return self
 
     # ------------------------------------------------------------ recording
     def start(self) -> "TrainingProfiler":
@@ -119,6 +129,8 @@ class TrainingProfiler:
             # state-reading listener forces synchronous delivery, where it
             # is never recorded — flag that rather than report 0 as "free"
             out["step_measured"] = self._counts["step"] > 0
+        if self._exchange is not None:
+            out["exchange"] = self._exchange.report()
         return out
 
     def summary(self) -> str:
@@ -126,11 +138,14 @@ class TrainingProfiler:
         step = (f"step {r['step_mean_ms']:.2f}ms submit->ready"
                 if r["step_measured"] else
                 "step unmeasured (synchronous delivery)")
-        return (f"TrainingProfiler: {r['iterations']} iterations in "
+        line = (f"TrainingProfiler: {r['iterations']} iterations in "
                 f"{r['elapsed_s']:.2f}s ({r['steps_per_sec']:.1f} steps/s); "
                 f"data wait {r['data_wait_total_s']:.2f}s "
                 f"({r['data_wait_fraction']:.0%} of wall), dispatch "
                 f"{r['dispatch_mean_ms']:.2f}ms/iter, {step}")
+        if self._exchange is not None:
+            line += "; " + self._exchange.headline()
+        return line
 
 
 def submit_timed(gd, args, profiler: Optional[TrainingProfiler] = None) -> None:

@@ -144,3 +144,25 @@ def _no_stray_pipeline_threads():
         time.sleep(0.05)
         names = stray()
     assert not names, f"stray training-pipeline threads leaked: {names}"
+
+
+@pytest.fixture(autouse=True)
+def _no_orphaned_distributed_workers():
+    """ISSUE 6 guard: no gloo worker subprocess launched through
+    ``train.distributed`` survives a test. Checked only when the module
+    was actually imported (importing it here would tax every unrelated
+    test), and stray workers are killed so one leak can't cascade into
+    every later test's assertion."""
+    yield
+    import sys as _sys
+    dist = _sys.modules.get("deeplearning4j_tpu.train.distributed")
+    if dist is None:
+        return
+    deadline = time.monotonic() + 5.0
+    pids = dist.live_worker_pids()
+    while pids and time.monotonic() < deadline:
+        time.sleep(0.05)
+        pids = dist.live_worker_pids()
+    if pids:
+        killed = dist.kill_stray_workers()
+        assert False, f"orphaned distributed worker processes leaked: {killed}"

@@ -143,6 +143,114 @@ def test_chaos_smoke_zero_silent_wrong_answers(tmp_path):
     assert out["recovered_after_chaos"] is True
 
 
+# ----------------------------------------------- ISSUE 6 distributed keys
+def _dist_section(steps=40.0, dense_b=1000000, enc_b=62500, eff=0.6):
+    return {
+        "dense": {"steps_per_sec": 41.0, "comms_bytes_per_step": dense_b,
+                  "matches_oracle": True},
+        "encoded": {"steps_per_sec": steps, "comms_bytes_per_step": enc_b,
+                    "matches_oracle": True},
+        "comms_reduction_vs_dense": round(dense_b / enc_b, 2),
+        "scaling_curve": {"1": {"steps_per_sec": 66.0},
+                          "2": {"steps_per_sec": 50.0},
+                          "4": {"steps_per_sec": round(66.0 * eff, 3)}},
+        "scaling_efficiency": eff,
+        "scaling_efficiency_world": 4,
+        "dist_steps_per_sec": steps,
+    }
+
+
+def _extra_with_dist(dist):
+    measured = {k: _mid(*rng) for k, rng in bench.RECORDED_RANGES.items()}
+    measured["distributed"] = dist
+    measured["dist_steps_per_sec"] = dist.get("dist_steps_per_sec")
+    measured["scaling_efficiency"] = dist.get("scaling_efficiency")
+    enc = dist.get("encoded") or {}
+    measured["comms_bytes_per_step"] = enc.get("comms_bytes_per_step")
+    return measured
+
+
+def test_check_tables_validates_distributed_section(tmp_path):
+    """ISSUE 6 satellite: --check-tables covers the distributed keys — a
+    self-consistent recorded section passes, and each drift class
+    (top-level copy disagreeing, reduction not recomputable from the byte
+    rows, efficiency not recomputable from the curve) fails loudly."""
+    md = tmp_path / "BASELINE.md"
+    md.write_text(_table_md(bench.RECORDED_RANGES))
+    extra = tmp_path / "BENCH_EXTRA.json"
+
+    extra.write_text(json.dumps(_extra_with_dist(_dist_section())))
+    assert bench.check_tables(str(md), str(extra), log=lambda *a: None) == 0
+
+    # top-level copy drift
+    bad = _extra_with_dist(_dist_section())
+    bad["dist_steps_per_sec"] = 999.0
+    extra.write_text(json.dumps(bad))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("dist_steps_per_sec" in m and "top-level" in m for m in msgs)
+
+    # claimed reduction not derivable from the recorded byte rows
+    dist = _dist_section()
+    dist["comms_reduction_vs_dense"] = 99.0
+    extra.write_text(json.dumps(_extra_with_dist(dist)))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("comms_reduction_vs_dense" in m for m in msgs)
+
+    # claimed scaling efficiency not derivable from the recorded curve
+    dist = _dist_section()
+    dist["scaling_efficiency"] = 0.95
+    extra.write_text(json.dumps(_extra_with_dist(dist)))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("scaling_efficiency" in m and "curve" in m for m in msgs)
+
+    # missing required key
+    dist = _dist_section()
+    dist.pop("scaling_curve")
+    extra.write_text(json.dumps(_extra_with_dist(dist)))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("scaling_curve" in m and "missing" in m for m in msgs)
+
+    # a recorded run that diverged from the oracle must never pass
+    dist = _dist_section()
+    dist["encoded"]["matches_oracle"] = False
+    extra.write_text(json.dumps(_extra_with_dist(dist)))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("matches_oracle" in m for m in msgs)
+
+    # a malformed section is a FAIL line, not a checker crash (empty
+    # curve, non-dict arm, non-numeric reduction all land here)
+    dist = _dist_section()
+    dist["scaling_curve"] = {}
+    extra.write_text(json.dumps(_extra_with_dist(dist)))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("malformed" in m for m in msgs)
+    dist = _dist_section()
+    dist["dense"] = "not-a-dict"
+    extra.write_text(json.dumps(_extra_with_dist(dist)))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("malformed" in m for m in msgs)
+
+
+def test_check_tables_distributed_absent_is_warning(tmp_path):
+    """No --distributed run recorded yet → warn, don't fail (same
+    contract as a skipped BERT import)."""
+    md = tmp_path / "BASELINE.md"
+    md.write_text(_table_md(bench.RECORDED_RANGES))
+    measured = {k: _mid(*rng) for k, rng in bench.RECORDED_RANGES.items()}
+    extra = tmp_path / "BENCH_EXTRA.json"
+    extra.write_text(json.dumps(measured))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 0
+    assert any("distributed" in m and "WARN" in m for m in msgs)
+
+
 def test_check_tables_missing_measurement_is_warning_not_failure(tmp_path):
     """A skipped bench section (e.g. BENCH_SKIP_BERT_IMPORT=1) must warn,
     not fail — only disagreement between recorded and measured numbers is

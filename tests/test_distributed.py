@@ -1,0 +1,447 @@
+"""Multi-process data-parallel trainer with threshold-encoded gradient
+exchange (ISSUE 6).
+
+Tier-1 tests exercise the full stack in LOOPBACK mode (the single-process
+oracle: same class, same jitted executables, per-rank codec residuals,
+same rank-order combine) plus the world=1 collective degenerate case —
+no subprocesses, so they stay cheap. The ``slow`` tier spawns real
+2-process gloo groups and proves:
+
+- the N-process trajectory is bit-deterministic across workers AND equals
+  the loopback oracle (threshold 0 and threshold > 0),
+- a chaos fault at ``train.distributed.exchange`` in ONE worker surfaces
+  as a supervised whole-group restart with exact checkpoint resume —
+  final weights bit-match the uninterrupted run, never silent divergence.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.runtime import chaos
+from deeplearning4j_tpu.train import Adam, Sgd, TrainingProfiler
+from deeplearning4j_tpu.train.distributed import (DistributedConfig,
+                                                  DistributedSupervisor,
+                                                  DistributedTrainer,
+                                                  ExchangeError)
+from deeplearning4j_tpu.train.fault_tolerance import TrainingFailure
+
+FEATURES, CLASSES, B, N_BATCHES = 16, 4, 8, 6
+
+
+def _conf(updater=None, seed=7):
+    return (NeuralNetConfiguration.builder().seed(seed)
+            .updater(updater or Sgd(0.1)).list()
+            .layer(DenseLayer(n_out=32, activation="relu"))
+            .layer(OutputLayer(n_out=CLASSES, activation="softmax"))
+            .set_input_type(InputType.feed_forward(FEATURES)).build())
+
+
+def _batches(n=N_BATCHES, batch=B, seed=0):
+    rng = np.random.default_rng(seed)
+    return [DataSet(rng.normal(size=(batch, FEATURES)).astype(np.float32),
+                    np.eye(CLASSES, dtype=np.float32)[
+                        rng.integers(0, CLASSES, batch)])
+            for _ in range(n)]
+
+
+def _iterator(batch=B):
+    return ListDataSetIterator(_batches(batch=batch), batch_size=batch)
+
+
+def _params(net):
+    return [np.asarray(l) for l in jax.tree.leaves(net.train_state.params)]
+
+
+def _fit_loopback(threshold, world=2, epochs=2, updater=None, **cfg_kw):
+    net = MultiLayerNetwork(_conf(updater)).init()
+    tr = DistributedTrainer(
+        net, DistributedConfig(threshold=threshold, **cfg_kw),
+        world=world, rank=None)
+    tr.fit(_iterator(), epochs=epochs)
+    return tr
+
+
+# ------------------------------------------------------------------ tier 1
+def test_loopback_dense_matches_sequential_shard_oracle():
+    """threshold=0 semantics, derived independently: the combined update
+    is the rank-ordered mean of per-shard gradients, so a hand-rolled
+    sequential loop with the same grad/apply functions must reproduce the
+    world=2 trajectory bit-for-bit."""
+    tr = _fit_loopback(0.0, world=2, epochs=1)
+
+    import optax
+    net = MultiLayerNetwork(_conf()).init()
+
+    def grad_fn(params, state, x, y, rng):
+        (loss, (new_state, _)), grads = jax.value_and_grad(
+            net._loss, has_aux=True)(params, state, x, y, rng, None, None)
+        return loss, grads, new_state
+
+    g_jit = jax.jit(grad_fn)
+
+    def apply_fn(ts, state0, combined):
+        leaves = jax.tree.leaves(ts.params)
+        sizes = [int(np.prod(np.shape(l))) for l in leaves]
+        offs = np.cumsum([0] + sizes)
+        gl = [combined[o:o + s].reshape(np.shape(l)).astype(l.dtype)
+              for o, s, l in zip(offs, sizes, leaves)]
+        gtree = jax.tree.unflatten(jax.tree.structure(ts.params), gl)
+        updates, new_opt = net._tx.update(gtree, ts.opt_state, ts.params)
+        import dataclasses
+        return dataclasses.replace(
+            ts, params=net._apply_constraints(
+                optax.apply_updates(ts.params, updates)),
+            model_state=state0, opt_state=new_opt, step=ts.step + 1)
+
+    # one compiled program like the trainer's apply step (eager optax
+    # associates float ops differently in the last ulp)
+    a_jit = jax.jit(apply_fn)
+    losses = []
+    for ds in _batches():
+        x, y = np.asarray(ds.features), np.asarray(ds.labels)
+        rng = net.rng.next_key()
+        ts = net.train_state
+        shard_losses, flats = [], []
+        state0 = None
+        for r in range(2):
+            lo = r * (B // 2)
+            loss, grads, new_state = g_jit(
+                ts.params, ts.model_state, x[lo:lo + B // 2],
+                y[lo:lo + B // 2], rng)
+            if r == 0:
+                state0 = new_state
+            # the exchange header carries each rank's loss as f32
+            shard_losses.append(float(np.float32(float(loss))))
+            flats.append(np.concatenate(
+                [np.asarray(g).ravel() for g in jax.tree.leaves(grads)])
+                .astype(np.float32) / np.float32(2))
+        combined = flats[0] + flats[1]
+        net.train_state = a_jit(ts, state0, combined)
+        losses.append((shard_losses[0] + shard_losses[1]) / 2)
+
+    assert losses == tr.losses
+    for a, b in zip(_params(net), _params(tr.net)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_loopback_world1_equals_collective_world1():
+    """The degenerate case: loopback world=1 and the (single-process)
+    collective transport produce identical bits — the two transports are
+    interchangeable."""
+    tr_loop = _fit_loopback(1e-3, world=1)
+    net = MultiLayerNetwork(_conf()).init()
+    tr_coll = DistributedTrainer(net, DistributedConfig(threshold=1e-3))
+    assert tr_coll.world == 1
+    tr_coll.fit(_iterator(), epochs=2)
+    assert tr_loop.losses == tr_coll.losses
+    for a, b in zip(_params(tr_loop.net), _params(tr_coll.net)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_loopback_encoded_converges_and_compresses():
+    """threshold>0: training still converges (residual accumulation keeps
+    un-sent mass) and the wire bytes shrink vs dense."""
+    tr = _fit_loopback(1e-3, world=2, epochs=3, updater=Adam(1e-2))
+    assert tr.losses[-1] < tr.losses[0]
+    rep = tr.stats.report()
+    assert rep["comms_bytes_per_step"] < rep["dense_bytes_per_step"]
+    assert rep["compression_ratio"] > 1.0
+    # residuals hold exactly the un-sent mass (non-trivial stream)
+    assert any(np.count_nonzero(ex.codec.residual) for ex in tr._exchanges)
+
+
+def test_threshold_zero_uses_dense_transport():
+    """threshold == 0 must take the dense path: the encoded format
+    degenerates to ±0 contributions there (a silent no-op update) — the
+    fallback-transport clause of the issue."""
+    tr = _fit_loopback(0.0, world=2, epochs=1)
+    rep = tr.stats.report()
+    # dense payload = 4 bytes per param + header, no compression claimed
+    assert rep["compression_ratio"] <= 1.01
+    assert tr._exchanges[0].dense
+    # and the trajectory actually trains (a ±0 encoded path would not)
+    assert tr.losses[-1] < tr.losses[0]
+
+
+def test_resync_preserves_f32_lockstep():
+    """Periodic parameter re-broadcast is bit-transparent when ranks are
+    in lockstep (f32 params round-trip the flat broadcast exactly)."""
+    tr_plain = _fit_loopback(1e-3, world=2, epochs=2)
+    tr_resync = _fit_loopback(1e-3, world=2, epochs=2, resync_every=2)
+    assert tr_plain.losses == tr_resync.losses
+    for a, b in zip(_params(tr_plain.net), _params(tr_resync.net)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_profiler_exchange_headline():
+    net = MultiLayerNetwork(_conf()).init()
+    prof = TrainingProfiler()
+    tr = DistributedTrainer(net, DistributedConfig(threshold=1e-3),
+                            world=2, rank=None, profiler=prof)
+    tr.fit(_iterator(), epochs=1)
+    rep = prof.report()
+    assert rep["iterations"] == N_BATCHES
+    assert "exchange" in rep
+    for stage in ("encode", "exchange", "decode", "apply"):
+        assert rep["exchange"][f"{stage}_mean_ms"] >= 0.0
+    assert rep["exchange"]["steps"] == N_BATCHES
+    assert "on the wire" in prof.summary()
+
+
+def test_global_batch_not_divisible_raises():
+    net = MultiLayerNetwork(_conf()).init()
+    tr = DistributedTrainer(net, DistributedConfig(threshold=0.0),
+                            world=3, rank=None)
+    with pytest.raises(ValueError, match="not divisible"):
+        tr.step(np.zeros((8, FEATURES), np.float32),
+                np.zeros((8, CLASSES), np.float32))
+
+
+def test_chaos_exchange_fault_fails_step_cleanly():
+    """``train.distributed.exchange`` drill (call point): the injected
+    fault surfaces as the step's failure — training stops at the faulted
+    step, state reflects every completed step, nothing hangs."""
+    net = MultiLayerNetwork(_conf()).init()
+    tr = DistributedTrainer(net, DistributedConfig(threshold=1e-3),
+                            world=2, rank=None)
+    with chaos.ChaosController(seed=3) as c:
+        c.on("train.distributed.exchange", chaos.FailNth(4))
+        with pytest.raises(chaos.ChaosError):
+            tr.fit(_iterator(), epochs=2)
+    assert len(tr.losses) == 3  # steps 1-3 completed, step 4 faulted
+    # the trainer is reusable after the blast radius closes
+    tr.fit(_iterator(), epochs=1)
+    assert len(tr.losses) > 3
+
+
+def test_chaos_corrupted_exchange_is_detected_not_silent():
+    """``train.distributed.exchange.bytes`` drill (byte point): injected
+    payload corruption must surface as :class:`ExchangeError` via the CRC
+    check — never decode into a divergent update."""
+    net = MultiLayerNetwork(_conf()).init()
+    tr = DistributedTrainer(net, DistributedConfig(threshold=1e-3),
+                            world=2, rank=None)
+    with chaos.ChaosController(seed=4) as c:
+        c.on("train.distributed.exchange.bytes",
+             chaos.CorruptBytes(n_bytes=4, mode="flip", nth=5))
+        with pytest.raises(ExchangeError, match="CRC mismatch"):
+            tr.fit(_iterator(), epochs=2)
+    # corruption of the 5th encoded payload = rank 0's frame at step 3
+    # (2 frames per step in loopback): steps 1-2 completed
+    assert len(tr.losses) == 2
+
+
+def test_checkpoint_exact_resume_loopback(tmp_path):
+    """Crash at a chaos-injected step; a FRESH trainer over the same
+    checkpoint dir restores (model archive + per-rank residuals) and the
+    finished trajectory bit-matches the uninterrupted run."""
+    tmp = str(tmp_path)
+    it = _iterator()
+    tr_ref = _fit_loopback(1e-3, world=2, epochs=2)
+
+    cfg = dict(threshold=1e-3, checkpoint_dir=tmp, checkpoint_every=3)
+    net_b = MultiLayerNetwork(_conf()).init()
+    tr_b = DistributedTrainer(net_b, DistributedConfig(**cfg),
+                              world=2, rank=None)
+    with chaos.ChaosController(seed=1) as c:
+        c.on("train.distributed.exchange", chaos.FailNth(8))
+        with pytest.raises(chaos.ChaosError):
+            tr_b.fit(it, epochs=2)
+
+    net_c = MultiLayerNetwork(_conf()).init()
+    tr_c = DistributedTrainer(net_c, DistributedConfig(**cfg),
+                              world=2, rank=None)
+    assert tr_c.restore()
+    assert net_c._iteration == 6  # newest checkpoint (step 6, not 3)
+    tr_c.fit(_iterator(), epochs=2)
+    for a, b in zip(_params(tr_ref.net), _params(net_c)):
+        np.testing.assert_array_equal(a, b)
+    # the resumed tail reproduces the uninterrupted run's tail exactly
+    assert tr_ref.losses[-len(tr_c.losses):] == tr_c.losses
+
+
+def test_restore_without_residual_refuses_inexact_resume(tmp_path):
+    """A checkpoint whose per-rank residual state is missing cannot
+    exact-resume an encoded stream — restore must refuse loudly instead
+    of silently resetting residuals (that WOULD diverge)."""
+    tmp = str(tmp_path)
+    cfg = dict(threshold=1e-3, checkpoint_dir=tmp, checkpoint_every=3)
+    net = MultiLayerNetwork(_conf()).init()
+    tr = DistributedTrainer(net, DistributedConfig(**cfg), world=2,
+                            rank=None)
+    tr.fit(_iterator(), epochs=1)
+    for f in os.listdir(tmp):
+        if f.startswith("exchange_r"):
+            os.unlink(os.path.join(tmp, f))
+    net2 = MultiLayerNetwork(_conf()).init()
+    tr2 = DistributedTrainer(net2, DistributedConfig(**cfg), world=2,
+                             rank=None)
+    with pytest.raises(TrainingFailure, match="residual"):
+        tr2.restore()
+
+
+# ------------------------------------------------------- slow: real procs
+_WORKER = r"""
+import json, os, sys
+import numpy as np
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+import jax
+from deeplearning4j_tpu.runtime.mesh import initialize_multihost
+
+rank = int(sys.argv[1]); world = int(sys.argv[2]); port = sys.argv[3]
+threshold = float(sys.argv[4]); ckpt = sys.argv[5] or None
+hb = sys.argv[6] or None; crash_marker = sys.argv[7] or None
+
+initialize_multihost(coordinator_address=f"127.0.0.1:{port}",
+                     num_processes=world, process_id=rank)
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.runtime import chaos
+from deeplearning4j_tpu.train import Sgd
+from deeplearning4j_tpu.train.distributed import (DistributedConfig,
+                                                  DistributedTrainer)
+
+conf = (NeuralNetConfiguration.builder().seed(7).updater(Sgd(0.1)).list()
+        .layer(DenseLayer(n_out=32, activation="relu"))
+        .layer(OutputLayer(n_out=4, activation="softmax"))
+        .set_input_type(InputType.feed_forward(16)).build())
+rng = np.random.default_rng(0)
+batches = [DataSet(rng.normal(size=(8, 16)).astype(np.float32),
+                   np.eye(4, dtype=np.float32)[rng.integers(0, 4, 8)])
+           for _ in range(6)]
+it = ListDataSetIterator(batches, batch_size=8)
+net = MultiLayerNetwork(conf).init()
+tr = DistributedTrainer(net, DistributedConfig(
+    threshold=threshold, checkpoint_dir=ckpt,
+    checkpoint_every=3 if ckpt else 0, heartbeat_file=hb))
+try:
+    tr.restore()
+    if rank == 1 and crash_marker and not os.path.exists(crash_marker):
+        with open(crash_marker, "w") as f:
+            f.write("armed")
+        with chaos.ChaosController(seed=1) as c:
+            # the 8th GLOBAL step: account for steps already checkpointed
+            c.on("train.distributed.exchange",
+                 chaos.FailNth(8 - int(net._iteration)))
+            tr.fit(it, epochs=2)
+    else:
+        tr.fit(it, epochs=2)
+except BaseException as e:  # noqa: BLE001
+    print(f"WORKER-FAILED {type(e).__name__}: {e}", flush=True)
+    os._exit(17)  # skip jax.distributed's atexit barrier: peers must see
+                  # an exit code, not a stalled shutdown handshake
+
+leaves = [np.asarray(l) for l in jax.tree.leaves(net.train_state.params)]
+print("RES" + json.dumps({
+    "losses": tr.losses,
+    "phash": [l.tobytes().hex() for l in leaves],
+    "comms_bytes_per_step": tr.stats.report()["comms_bytes_per_step"],
+}), flush=True)
+os._exit(0)
+"""
+
+
+def _write_worker(tmp_path):
+    wfile = tmp_path / "worker.py"
+    wfile.write_text(_WORKER)
+    return str(wfile)
+
+
+def _parse(out):
+    lines = [l for l in out.splitlines() if l.startswith("RES")]
+    assert lines, out[-2000:]
+    return json.loads(lines[0][3:])
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("threshold", [0.0, 1e-3])
+def test_two_process_trajectory_matches_oracle(tmp_path, threshold):
+    """The correctness anchor: 2-process training is bit-deterministic
+    across workers AND bit-matches the in-process loopback oracle — at
+    threshold 0 (dense transport) and threshold > 0 (encoded)."""
+    wfile = _write_worker(tmp_path)
+    sup = DistributedSupervisor(
+        lambda rank, port: [sys.executable, wfile, str(rank), "2", port,
+                            str(threshold), "", "", ""],
+        num_processes=2, heartbeat_files=[],
+        max_restarts=0, heartbeat_timeout_s=240)
+    outs = sup.run(round_timeout_s=240)
+    res = [_parse(o) for o, _ in outs]
+    assert res[0]["losses"] == res[1]["losses"]
+    assert res[0]["phash"] == res[1]["phash"]
+
+    oracle = _fit_loopback(threshold, world=2, epochs=2)
+    assert res[0]["losses"] == oracle.losses
+    assert res[0]["phash"] == [l.tobytes().hex() for l in
+                               _params(oracle.net)]
+    if threshold > 0:
+        dense = 4 * oracle._exchanges[0].codec.size
+        assert res[0]["comms_bytes_per_step"] < dense
+
+
+@pytest.mark.slow
+def test_supervised_restart_exact_resume(tmp_path):
+    """The ISSUE 6 chaos drill: a chaos fault at
+    ``train.distributed.exchange`` kills worker 1 mid-run; the supervisor
+    detects the death, kills the group, re-forms the mesh on a fresh port
+    and relaunches; workers restore the newest checkpoint (+ per-rank
+    residuals) and the final weights bit-match the uninterrupted oracle
+    — crash -> exact resume, not silent divergence."""
+    wfile = _write_worker(tmp_path)
+    ckpt = tmp_path / "ckpts"
+    ckpt.mkdir()
+    hbs = [str(tmp_path / f"hb{i}") for i in range(2)]
+    marker = str(tmp_path / "crash_armed")
+    sup = DistributedSupervisor(
+        lambda rank, port: [sys.executable, wfile, str(rank), "2", port,
+                            "1e-3", str(ckpt), hbs[rank], marker],
+        num_processes=2, heartbeat_files=hbs,
+        max_restarts=2, heartbeat_timeout_s=120)
+    outs = sup.run(round_timeout_s=300)
+    # the drill must actually have crashed once and restarted
+    assert os.path.exists(marker)
+    assert sup.restarts == 1, sup.rounds
+    assert sup.rounds[-1]["outcome"] == "success"
+    res = [_parse(o) for o, _ in outs]
+    assert res[0]["phash"] == res[1]["phash"]
+
+    oracle = _fit_loopback(1e-3, world=2, epochs=2)
+    assert res[0]["phash"] == [l.tobytes().hex() for l in
+                               _params(oracle.net)]
+    # resumed tail equals the oracle's tail at the same steps
+    n = len(res[0]["losses"])
+    assert res[0]["losses"] == oracle.losses[-n:]
+
+
+@pytest.mark.slow
+def test_supervisor_restart_budget_escalates(tmp_path):
+    """A crash loop must escalate ``TrainingFailure`` once the restart
+    budget is exhausted — burning accelerator time forever is not a
+    recovery strategy (same contract as FaultTolerantTrainer)."""
+    wfile = tmp_path / "always_dies.py"
+    wfile.write_text("import sys, os; os._exit(9)\n")
+    sup = DistributedSupervisor(
+        lambda rank, port: [sys.executable, str(wfile)],
+        num_processes=2, heartbeat_files=[], max_restarts=1,
+        heartbeat_timeout_s=60)
+    with pytest.raises(TrainingFailure, match="giving up"):
+        sup.run(round_timeout_s=60)
+    assert sup.restarts == 2  # budget 1 + the escalating attempt

@@ -1,9 +1,15 @@
 """Native C++ host component tests (threshold codec, image pipeline)."""
 
+import json
+import os
+import subprocess
+import sys
+
 import numpy as np
 import pytest
 
-from deeplearning4j_tpu.native import ImagePipeline, ThresholdCodec, get_lib
+from deeplearning4j_tpu.native import (ImagePipeline, ThresholdCodec,
+                                       TreeCodec, get_lib)
 
 
 def test_native_lib_builds():
@@ -59,6 +65,176 @@ def test_bitmap_codec():
     out = codec.decode_bitmap(bm)
     assert out[3] == np.float32(0.2) and out[7] == np.float32(-0.2)
     assert np.count_nonzero(out) == 2
+
+
+# --------------------------------------------------------- codec hardening
+# ISSUE 6 satellite: hand-rolled property tests (no hypothesis in this
+# environment) over seeded random cases and the edge shapes the issue
+# names — empty, all-below-threshold, all-above, non-contiguous, f32/f64.
+
+def _numpy_call(codec, method, *args):
+    """Run a codec method with the native lib temporarily hidden, so the
+    numpy fallback executes."""
+    import deeplearning4j_tpu.native as native
+    lib, native._lib = native._lib, None
+    failed, native._build_failed = native._build_failed, True
+    try:
+        return getattr(codec, method)(*args)
+    finally:
+        native._lib, native._build_failed = lib, failed
+
+
+_EDGE_CASES = []
+for label, maker in [
+    ("empty", lambda rng: np.empty(0, np.float32)),
+    ("all_below", lambda rng: rng.uniform(-0.05, 0.05, 257).astype(np.float32)),
+    ("all_above", lambda rng: np.where(rng.random(64) < 0.5, 1.0, -1.0)
+                                .astype(np.float32)),
+    ("mixed", lambda rng: rng.normal(0, 0.2, 1001).astype(np.float32)),
+    ("f64", lambda rng: rng.normal(0, 0.2, 333)),  # float64 input
+    ("noncontig", lambda rng: rng.normal(0, 0.2, (100, 6))
+                                 .astype(np.float32)[:, ::2]),
+]:
+    _EDGE_CASES.append((label, maker))
+
+
+@pytest.mark.parametrize("label,maker", _EDGE_CASES,
+                         ids=[l for l, _ in _EDGE_CASES])
+@pytest.mark.parametrize("threshold", [0.1, 0.0])
+def test_codec_roundtrip_properties(label, maker, threshold):
+    """Round-trip invariants on every edge shape, sparse AND bitmap, for
+    both backends: (a) decoded mass + residual == input + prior residual
+    (no gradient mass is created or destroyed), (b) every decoded entry
+    is exactly ±threshold, (c) native and numpy backends agree bit-for-
+    bit on encoding, residual and decode."""
+    rng = np.random.default_rng(hash(label) % 2**31)
+    grad = maker(rng)
+    n = int(np.prod(grad.shape))
+    as_f32 = np.ascontiguousarray(grad, np.float32).reshape(-1)
+
+    c_nat = ThresholdCodec(n, threshold)
+    c_np = ThresholdCodec(n, threshold)
+
+    enc_nat = c_nat.encode(grad)
+    enc_np = _numpy_call(c_np, "encode", grad)
+    np.testing.assert_array_equal(enc_nat, enc_np)
+    np.testing.assert_array_equal(c_nat.residual, c_np.residual)
+
+    dec_nat = c_nat.decode(enc_nat)
+    dec_np = _numpy_call(c_np, "decode", enc_np)
+    np.testing.assert_array_equal(dec_nat, dec_np)
+    # mass conservation: what was sent plus what stayed local is the input
+    np.testing.assert_allclose(dec_nat + c_nat.residual, as_f32,
+                               rtol=1e-6, atol=1e-6)
+    sent = dec_nat[dec_nat != 0]
+    if threshold > 0 and sent.size:
+        assert set(np.unique(np.abs(sent))) == {np.float32(threshold)}
+
+    # bitmap format: fresh codecs (encode mutates the residual), same
+    # decoded result as the sparse format for the same input
+    b_nat = ThresholdCodec(n, threshold)
+    b_np = ThresholdCodec(n, threshold)
+    bm_nat = b_nat.encode_bitmap(grad)
+    bm_np = _numpy_call(b_np, "encode_bitmap", grad)
+    np.testing.assert_array_equal(bm_nat, bm_np)
+    np.testing.assert_array_equal(b_nat.residual, b_np.residual)
+    np.testing.assert_array_equal(b_nat.residual, c_nat.residual)
+    dbm_nat = b_nat.decode_bitmap(bm_nat)
+    dbm_np = _numpy_call(b_np, "decode_bitmap", bm_np)
+    np.testing.assert_array_equal(dbm_nat, dbm_np)
+    np.testing.assert_array_equal(dbm_nat, dec_nat)
+
+
+def test_codec_bound_bugs_rejected():
+    """The hardening fixes: size-mismatched gradients, truncated bitmap
+    buffers and wrong-dtype targets used to read/write out of bounds
+    through the ctypes boundary — now they raise."""
+    codec = ThresholdCodec(100, 0.1)
+    with pytest.raises(ValueError):
+        codec.encode(np.zeros(50, np.float32))      # short grad: OOB read
+    with pytest.raises(ValueError):
+        codec.encode(np.zeros(200, np.float32))     # long grad: silent drop
+    with pytest.raises(ValueError):
+        codec.encode_bitmap(np.zeros(99, np.float32))
+    with pytest.raises(ValueError):
+        codec.decode_bitmap(np.zeros(10, np.uint8))  # truncated buffer
+    with pytest.raises(ValueError):
+        codec.decode(np.asarray([1], np.int32),
+                     target=np.zeros(100, np.float64))  # f64 reinterpret
+    with pytest.raises(ValueError):
+        codec.decode(np.asarray([1], np.int32),
+                     target=np.zeros(50, np.float32))   # short target
+    # invalid indices are IGNORED (C semantics), not wrapped: index 0 used
+    # to decrement target[-1] through the numpy fallback
+    out = _numpy_call(codec, "decode", np.asarray([0, 101, -101], np.int32))
+    assert np.count_nonzero(out) == 0
+    out_c = codec.decode(np.asarray([0, 101, -101], np.int32))
+    np.testing.assert_array_equal(out_c, out)
+
+
+def test_codec_residual_deterministic_across_processes():
+    """ISSUE 6 satellite: the residual stream must be bit-deterministic
+    across two FRESH processes — the property the distributed trainer's
+    exact-resume and lockstep invariants stand on."""
+    script = r"""
+import json, sys
+import numpy as np
+from deeplearning4j_tpu.native import ThresholdCodec
+rng = np.random.default_rng(42)
+codec = ThresholdCodec(2000, 1e-3)
+encs = []
+for step in range(5):
+    g = rng.normal(0, 0.003, 2000).astype(np.float32)
+    encs.append(codec.encode(g).tolist())
+print(json.dumps({"encs": encs,
+                  "residual": codec.residual.tobytes().hex()}))
+"""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo_root + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    outs = []
+    for _ in range(2):
+        r = subprocess.run([sys.executable, "-c", script], env=env,
+                           capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr[-2000:]
+        outs.append(json.loads(r.stdout))
+    assert outs[0] == outs[1]
+
+
+def test_tree_codec_flatten_roundtrip_and_formats():
+    """TreeCodec (flat param-tree ergonomics): flatten/unflatten round-
+    trips leaf shapes; the sparse-vs-bitmap choice follows the predicted
+    wire size and both formats decode to the same contribution."""
+    rng = np.random.default_rng(5)
+    leaves = [rng.normal(0, 0.01, (64, 32)).astype(np.float32),
+              rng.normal(0, 0.01, (32,)).astype(np.float32),
+              rng.normal(0, 0.01, (32, 8)).astype(np.float32)]
+    tc = TreeCodec(leaves, threshold=5e-3)
+    flat = tc.flatten(leaves)
+    assert flat.shape == (64 * 32 + 32 + 32 * 8,)
+    back = tc.unflatten(flat)
+    for a, b in zip(leaves, back):
+        np.testing.assert_array_equal(a, b)
+
+    # sparse wins when almost nothing clears the threshold
+    sparse_grad = np.zeros(tc.size, np.float32)
+    sparse_grad[:3] = 1.0
+    assert tc.predicted_format(sparse_grad) == TreeCodec.FORMAT_SPARSE
+    # bitmap wins when nearly everything does
+    dense_grad = np.full(tc.size, 1.0, np.float32)
+    tc2 = TreeCodec(leaves, threshold=5e-3)
+    assert tc2.predicted_format(dense_grad) == TreeCodec.FORMAT_BITMAP
+
+    fmt, payload = tc2.encode(dense_grad)
+    assert fmt == TreeCodec.FORMAT_BITMAP
+    assert len(payload) == tc2.codec.bitmap_nbytes()
+    target = np.zeros(tc2.size, np.float32)
+    tc2.decode_into(fmt, payload, target)
+    assert np.all(target == np.float32(5e-3))
+    with pytest.raises(ValueError):
+        tc2.decode_into(99, payload, target)
+    with pytest.raises(ValueError):
+        tc.flatten(leaves[:2])
 
 
 def test_image_pipeline_matches_numpy():
