@@ -206,6 +206,10 @@ def check_tables(baseline_md=None, bench_extra=None, log=_log):
     if measured is not None:
         check_distributed_section(measured, failures, warnings)
 
+    # ISSUE 7 fleet keys: both arms, drill records, recomputable speedup
+    if measured is not None:
+        check_fleet_section(measured, failures, warnings)
+
     for w in warnings:
         log(f"[check-tables] WARN {w}")
     for fmsg in failures:
@@ -1774,6 +1778,374 @@ def _check_distributed_consistency(extra, d, failures):
             f"recorded curve gives {eff:.3f}")
 
 
+# -------------------------------------------------------------------- fleet
+def bench_fleet(n_threads=4, per_thread=40, bench_extra=None, log=_log):
+    """``bench.py --fleet`` (ISSUE 7): the fleet-tier drill of record.
+
+    Order-alternated A/B under an injected straggler profile (seeded
+    ``AddLatency(p=...)`` on ``serving.worker.predict`` inside every
+    worker process): a routed 1-worker fleet (hedging impossible — the
+    unhedged arm) vs a routed 3-worker fleet with p99-derived hedging.
+    Asserted before anything is written (a failing run cannot produce the
+    artifact):
+
+    - hedged p99 beats unhedged p99 (the tail the hedge exists for),
+    - every response in BOTH arms is bit-identical to the in-process
+      single-model oracle,
+    - SIGKILL-one-of-3 under sustained load -> ZERO client-visible
+      errors (failover within the deadline) and the supervisor restarts
+      the victim within budget,
+    - a rolling deploy to a new archive under load -> zero 5xx, old AND
+      new versions served, and zero on-traffic compiles afterwards
+      (manifest-prewarmed readmission).
+
+    Results -> ``BENCH_EXTRA.json["fleet"]`` (validated by
+    ``--check-tables``)."""
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.runtime.environment import get_environment
+    from deeplearning4j_tpu.serving import ModelRegistry
+    from deeplearning4j_tpu.serving.fleet import FleetSupervisor, WorkerSpec
+    from deeplearning4j_tpu.serving.router import FleetRouter
+
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(None)
+            .list()
+            .layer(DenseLayer(n_out=32, activation="tanh"))
+            .layer(OutputLayer(n_out=8, activation="softmax"))
+            .set_input_type(InputType.feed_forward(16))
+            .build())
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(16, 16)).astype(np.float32)
+    batcher_kw = dict(max_batch_size=4, buckets=[1, 4],
+                      batch_timeout_ms=1.0, pipeline_depth=0)
+    # p chosen so the p99 of an arm isolates the hedge's effect: ~4% of
+    # calls straggle (so the unhedged p99 IS the straggler latency), while
+    # a double straggle — primary AND hedge both slow, which no hedge can
+    # beat — stays below the 99th percentile at this sample count (p^2 =
+    # 0.16%, ~0.5 expected in 320 requests)
+    straggle_ms, straggle_p = 120.0, 0.04
+
+    td = tempfile.mkdtemp(prefix="dl4j-bench-fleet-")
+    a1 = os.path.join(td, "model-v1.zip")
+    a2 = os.path.join(td, "model-v2.zip")
+    cache = os.path.join(td, "executable-cache")
+    MultiLayerNetwork(conf).init().save(a1)
+    MultiLayerNetwork(conf).init().save(a2)  # same seed -> same weights
+    # parent warms once: records the warmup manifest + fills the shared
+    # persistent executable cache every worker launch replays
+    get_environment().set_compile_cache(cache)
+    reg = ModelRegistry()
+    reg.load("m", a1, warmup_example=xs[:1], **batcher_kw)
+    oracle = reg.get("m").model
+    oracle_cache = {}
+
+    def oracle_out(n, ofs):
+        """Reference rows at every bucket that could have served them."""
+        if (n, ofs) not in oracle_cache:
+            outs = []
+            for bucket in (b for b in batcher_kw["buckets"] if b >= n):
+                padded = np.concatenate(
+                    [xs[ofs:ofs + n],
+                     np.zeros((bucket - n, xs.shape[1]), xs.dtype)], axis=0)
+                outs.append(np.asarray(oracle.output(padded))[:n])
+            oracle_cache[(n, ofs)] = outs
+        return oracle_cache[(n, ofs)]
+
+    reg.shutdown()  # graceful: persists the manifest next to a1
+
+    def spec(wid, seed):
+        return WorkerSpec(
+            worker_id=wid, model_name="m", archive=a1, version=1,
+            batcher_kw=dict(batcher_kw), cache_dir=cache,
+            straggle={"p": straggle_p, "ms": straggle_ms, "seed": seed})
+
+    def post(port, n, ofs, timeout_ms=15000):
+        body = json.dumps({"inputs": xs[ofs:ofs + n].tolist(),
+                           "timeout_ms": timeout_ms}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/m/predict", data=body)
+        t0 = time.perf_counter()
+        resp = urllib.request.urlopen(req, timeout=60)
+        out = json.loads(resp.read())
+        return time.perf_counter() - t0, out
+
+    def run_load(port, total, latencies=None, outcomes=None, stop=None):
+        """Closed-loop client threads; every outcome recorded."""
+        lock = threading.Lock()
+
+        def client(tid):
+            k = 0
+            while True:
+                if stop is not None and stop.is_set():
+                    return
+                if stop is None and k >= total:
+                    return
+                n, ofs = 1 + (tid + k) % 4, (3 * k + tid) % 8
+                try:
+                    dt, out = post(port, n, ofs)
+                    rec = ("ok", n, ofs,
+                           np.asarray(out["outputs"], np.float32),
+                           out.get("version"))
+                    if latencies is not None:
+                        with lock:
+                            latencies.append(dt)
+                except Exception as e:
+                    rec = (f"error:{type(e).__name__}", n, ofs, None, None)
+                if outcomes is not None:
+                    with lock:
+                        outcomes.append(rec)
+                k += 1
+                time.sleep(0.005)
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        return threads
+
+    def check_exact(outcomes, label):
+        bad = [o for o in outcomes if o[0] != "ok"]
+        assert not bad, (f"[fleet] {label}: {len(bad)} client-visible "
+                         f"failure(s): {bad[:5]}")
+        for _, n, ofs, got, _ in outcomes:
+            assert any(np.array_equal(got, ref)
+                       for ref in oracle_out(n, ofs)), \
+                f"[fleet] {label}: response (n={n}, ofs={ofs}) not " \
+                f"bit-identical to the oracle"
+
+    def measure(router, port, label):
+        """One measured round: per_thread requests per client thread."""
+        lat, outs = [], []
+        threads = run_load(port, per_thread, latencies=lat, outcomes=outs)
+        for t in threads:
+            t.join(timeout=300)
+        assert not any(t.is_alive() for t in threads), \
+            f"[fleet] {label}: hung client"
+        check_exact(outs, label)
+        return lat
+
+    results = {}
+    sup_u = FleetSupervisor([spec("u0", 101)],
+                            run_dir=os.path.join(td, "run-u"))
+    sup_h = FleetSupervisor([spec(f"h{i}", 201 + i) for i in range(3)],
+                            run_dir=os.path.join(td, "run-h"),
+                            max_restarts=4, heartbeat_timeout_s=60.0)
+    try:
+        sup_u.start()
+        sup_h.start()
+        router_u = FleetRouter(sup_u, hedge_enabled=False,
+                               probe_interval_s=0.1)
+        # hedge_factor < 1 keeps the p99-derived delay anchored near the
+        # clean-path latency: at factor 1.0 the feedback loop drifts to
+        # the straggler tail itself (observed p99 -> straggler latency ->
+        # hedge fires too late to help) — see docs/fleet_serving.md
+        router_h = FleetRouter(sup_h, hedge_enabled=True, hedge_factor=0.5,
+                               probe_interval_s=0.1, hedge_initial_ms=40.0)
+        port_u = router_u.start(0)
+        port_h = router_h.start(0)
+        try:
+            arms = {"unhedged": (router_u, port_u),
+                    "hedged": (router_h, port_h)}
+            for label, (router, port) in arms.items():  # warm p99 windows
+                for t in run_load(port, 12):
+                    t.join(timeout=120)
+            # hedge counters are cumulative from router start: snapshot
+            # after warm-up so the artifact's counts cover exactly the
+            # measured requests, not warm-up traffic
+            warm_snap = router_h.metrics.snapshot()
+            lat = {"unhedged": [], "hedged": []}
+            for order in (("unhedged", "hedged"), ("hedged", "unhedged")):
+                for label in order:  # order-alternated A/B
+                    lat[label].extend(measure(*arms[label], label))
+            for label in arms:
+                p50 = float(np.percentile(lat[label], 50) * 1000.0)
+                p99 = float(np.percentile(lat[label], 99) * 1000.0)
+                results[label] = {
+                    "workers": 1 if label == "unhedged" else 3,
+                    "hedge": label == "hedged",
+                    "requests": len(lat[label]),
+                    "p50_ms": round(p50, 2), "p99_ms": round(p99, 2),
+                    "matches_oracle": True,
+                    "straggler_p": straggle_p,
+                    "straggler_ms": straggle_ms,
+                }
+                log(f"[fleet] {label}: p50 {p50:.1f} ms, p99 {p99:.1f} ms "
+                    f"over {len(lat[label])} requests, all bit-identical")
+            snap = router_h.metrics.snapshot()
+            results["hedged"].update(
+                hedges=snap["hedges_total"] - warm_snap["hedges_total"],
+                hedge_wins=(snap["hedge_wins_total"]
+                            - warm_snap["hedge_wins_total"]),
+                hedges_discarded=(snap["hedges_discarded_total"]
+                                  - warm_snap["hedges_discarded_total"]))
+            speedup = (results["unhedged"]["p99_ms"]
+                       / max(1e-9, results["hedged"]["p99_ms"]))
+            results["p99_speedup"] = round(speedup, 2)
+            assert speedup > 1.0, (
+                f"[fleet] hedged p99 {results['hedged']['p99_ms']} ms did "
+                f"not beat unhedged {results['unhedged']['p99_ms']} ms")
+            assert results["hedged"]["hedges"] >= 1, \
+                "[fleet] straggler schedule never triggered a hedge"
+
+            # ---------------------------------------------- kill drill
+            outs = []
+            stop = threading.Event()
+            threads = run_load(port_h, 0, outcomes=outs, stop=stop)
+            time.sleep(0.6)  # steady state
+            victim = router_h.ranked_workers("m")[0].worker_id
+            sup_h.kill_worker(victim)
+            time.sleep(2.0)  # sustained load across the death + failover
+            stop.set()
+            for t in threads:
+                t.join(timeout=120)
+            check_exact(outs, "kill drill")
+            ksnap = router_h.metrics.snapshot()
+            absorbed = (ksnap["failovers_total"] - snap["failovers_total"]
+                        + ksnap["hedges_total"] - snap["hedges_total"])
+            deadline = time.monotonic() + 90
+            while len(sup_h.endpoints()) < 3 and time.monotonic() < deadline:
+                time.sleep(0.1)
+            assert len(sup_h.endpoints()) == 3, \
+                "[fleet] supervisor did not restart the killed worker"
+            sup_h.check()
+            results["kill_drill"] = {
+                "requests": len(outs), "errors": 0, "victim": victim,
+                "absorbed_attempts": absorbed,
+                "supervisor_restarts": sup_h.restarts,
+                "matches_oracle": True,
+            }
+            log(f"[fleet] kill drill: SIGKILL {victim} under load -> "
+                f"0/{len(outs)} client-visible errors, "
+                f"{absorbed} attempt(s) absorbed, restarted within budget")
+
+            # ------------------------------------------- rolling deploy
+            outs = []
+            stop = threading.Event()
+            threads = run_load(port_h, 0, outcomes=outs, stop=stop)
+            time.sleep(0.3)
+            report = router_h.rolling_deploy(a2, version=2,
+                                             ready_timeout_s=120)
+            time.sleep(0.5)
+            stop.set()
+            for t in threads:
+                t.join(timeout=120)
+            check_exact(outs, "rolling deploy")
+            versions = {o[4] for o in outs if o[0] == "ok"}
+            assert versions == {1, 2}, (
+                f"[fleet] deploy should serve old AND new versions under "
+                f"load, saw {versions}")
+
+            def compile_counts():
+                counts = {}
+                for wid, addr in sup_h.endpoints().items():
+                    desc = json.loads(urllib.request.urlopen(
+                        f"http://{addr}/v1/models", timeout=10).read())
+                    counts[wid] = \
+                        desc["models"][0]["metrics"]["compile_count"]
+                return counts
+
+            before = compile_counts()
+            for k in range(8):
+                post(port_h, 1 + k % 4, k % 8)
+            minted = sum(compile_counts().values()) - sum(before.values())
+            assert minted == 0, \
+                f"[fleet] {minted} on-traffic compile(s) after the deploy"
+            results["rolling_deploy"] = {
+                "requests": len(outs), "errors": 0,
+                "versions_seen": sorted(versions),
+                "on_traffic_compiles": 0, "workers": len(report["workers"]),
+                "ready_s": {w: r["ready_s"]
+                            for w, r in report["workers"].items()},
+            }
+            log(f"[fleet] rolling deploy: 3 workers -> v2 under load, "
+                f"0/{len(outs)} errors, versions {sorted(versions)} "
+                f"served, 0 on-traffic compiles after readmission")
+        finally:
+            router_u.stop()
+            router_h.stop()
+    finally:
+        sup_u.stop()
+        sup_h.stop()
+        shutil.rmtree(td, ignore_errors=True)
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    bench_extra = bench_extra or os.path.join(here, "BENCH_EXTRA.json")
+    try:
+        with open(bench_extra) as f:
+            extra = json.load(f)
+    except Exception:
+        extra = {}
+    extra["fleet"] = results
+    with open(bench_extra, "w") as f:
+        json.dump(extra, f, indent=2)
+    log(f"[fleet] OK: hedged p99 {results['hedged']['p99_ms']} ms vs "
+        f"unhedged {results['unhedged']['p99_ms']} ms "
+        f"({results['p99_speedup']}x), kill drill + rolling deploy clean")
+    return 0
+
+
+def check_fleet_section(extra, failures, warnings):
+    """--check-tables coverage for the ISSUE 7 keys: the ``fleet``
+    section (when present) must carry both arms plus the drill records,
+    every bit-identity flag must be True, the drills must record zero
+    errors and zero on-traffic compiles, and the claimed p99 speedup must
+    be recomputable from the recorded arm rows and exceed 1."""
+    if "fleet" not in extra:
+        warnings.append("fleet: not present in BENCH_EXTRA.json "
+                        "(bench --fleet not run?)")
+        return
+    d = extra["fleet"]
+    required = ["unhedged", "hedged", "p99_speedup", "kill_drill",
+                "rolling_deploy"]
+    for k in required:
+        if k not in d:
+            failures.append(f"fleet.{k}: missing from the recorded section")
+    if any(k not in d for k in required):
+        return
+    try:
+        for arm in ("unhedged", "hedged", "kill_drill", "rolling_deploy"):
+            if arm != "rolling_deploy" and \
+                    d[arm].get("matches_oracle") is not True:
+                failures.append(
+                    f"fleet.{arm}: matches_oracle is "
+                    f"{d[arm].get('matches_oracle')!r} — the recorded run "
+                    f"was not bit-identical to the oracle")
+        for drill in ("kill_drill", "rolling_deploy"):
+            if d[drill].get("errors") != 0:
+                failures.append(
+                    f"fleet.{drill}: recorded {d[drill].get('errors')!r} "
+                    f"client-visible errors (must be 0)")
+            if d[drill].get("requests", 0) <= 0:
+                failures.append(f"fleet.{drill}: no recorded traffic")
+        if d["rolling_deploy"].get("on_traffic_compiles") != 0:
+            failures.append(
+                "fleet.rolling_deploy: "
+                f"{d['rolling_deploy'].get('on_traffic_compiles')!r} "
+                "on-traffic compile(s) recorded (must be 0)")
+        if sorted(d["rolling_deploy"].get("versions_seen", [])) != [1, 2]:
+            failures.append(
+                "fleet.rolling_deploy: versions_seen "
+                f"{d['rolling_deploy'].get('versions_seen')!r} — the deploy "
+                "must serve old AND new versions under load")
+        sp = (d["unhedged"]["p99_ms"] / max(1e-9, d["hedged"]["p99_ms"]))
+        if abs(sp - d["p99_speedup"]) > 0.02 * max(sp, 1e-9):
+            failures.append(
+                f"fleet.p99_speedup: claims {d['p99_speedup']}, recorded "
+                f"arm p99 rows give {sp:.2f}")
+        if d["p99_speedup"] <= 1.0:
+            failures.append(
+                f"fleet.p99_speedup: {d['p99_speedup']} — hedging did not "
+                f"beat the unhedged arm in the recorded run")
+    except (TypeError, ValueError, AttributeError, KeyError) as e:
+        failures.append(f"fleet: malformed section ({e!r})")
+
+
 # ------------------------------------------------------------------- resnet
 def bench_resnet():
     import jax
@@ -2173,6 +2545,8 @@ if __name__ == "__main__":
         sys.exit(bench_training())
     if "--distributed" in sys.argv:
         sys.exit(bench_distributed())
+    if "--fleet" in sys.argv:
+        sys.exit(bench_fleet())
     if "--serving" in sys.argv:
         # give the CPU backend multiple virtual devices so the replica arm
         # is real even off-TPU (flag only affects the host platform; must

@@ -59,7 +59,16 @@ whole-group restart with exact checkpoint resume, never a silent
 divergence) and ``train.distributed.exchange.bytes`` (byte point over a
 worker's encoded-update payload AFTER its CRC header is computed, so
 injected wire corruption is exactly what every receiver's CRC check
-catches — see ``tests/test_distributed.py``).
+catches — see ``tests/test_distributed.py``), ``serving.worker.predict``
+(fires at the top of every ``ModelServer`` predict — per-PROCESS, so a
+fleet drill can slow or fail one worker without touching its peers;
+``AddLatency(p=...)`` here is the straggler injector ``bench.py
+--fleet`` hedges against), ``serving.router.forward`` (fires in the
+fleet router before each forward attempt — primary, hedge, or failover —
+a fault here is a failed attempt the router must absorb by failing over
+within the deadline) and ``serving.router.hedge`` (fires as a hedge is
+launched against a second worker, so a drill can fault or delay exactly
+the hedge path — see ``tests/test_router.py``).
 """
 
 from __future__ import annotations
@@ -135,13 +144,25 @@ class FailWithProbability(Policy):
 
 
 class AddLatency(Policy):
-    """Sleep ``seconds`` plus uniform seeded jitter in [0, ``jitter``]."""
+    """Sleep ``seconds`` plus uniform seeded jitter in [0, ``jitter``].
 
-    def __init__(self, seconds: float, jitter: float = 0.0):
+    ``p < 1.0`` makes it a *straggler* profile: each call sleeps with
+    probability ``p`` from the policy's seeded RNG (the tail-latency
+    simulator the fleet router's hedging exists for) — a given seed
+    replays the same slow-call schedule exactly. ``p=1.0`` (default)
+    draws nothing and slows every call, so existing schedules replay
+    unchanged."""
+
+    def __init__(self, seconds: float, jitter: float = 0.0, p: float = 1.0):
+        if not 0.0 < p <= 1.0:
+            raise ValueError(f"p must be in (0, 1], got {p}")
         self.seconds = float(seconds)
         self.jitter = float(jitter)
+        self.p = float(p)
 
     def apply(self, point, index, rng, controller):
+        if self.p < 1.0 and rng.random() >= self.p:
+            return None
         delay = self.seconds + (rng.uniform(0.0, self.jitter)
                                 if self.jitter else 0.0)
         time.sleep(delay)

@@ -199,6 +199,29 @@ def compile_cache_stats() -> Dict[str, object]:
     return compile_cache.stats()
 
 
+_ROUTER_METRICS = None
+
+
+def attach_router(metrics) -> None:
+    """Register the process's live
+    :class:`~deeplearning4j_tpu.serving.router.RouterMetrics` (ISSUE 7)
+    so profiling tooling can read the fleet gauges without holding a
+    router reference. Called by ``FleetRouter.start``; the newest router
+    wins (one routing tier per process)."""
+    global _ROUTER_METRICS
+    _ROUTER_METRICS = metrics
+
+
+def router_stats() -> Dict[str, object]:
+    """Fleet-router gauges for the process's attached router: forwards,
+    hedges launched/won/discarded-duplicates, failovers, shed skips,
+    rolling deploys, and request-latency percentiles. Empty dict when no
+    router is attached (the single-process serving topology)."""
+    if _ROUTER_METRICS is None:
+        return {}
+    return _ROUTER_METRICS.snapshot()
+
+
 def device_memory_stats() -> Dict[str, Dict[str, int]]:
     """Per-device memory stats — feeds the HBM crash report (§5.5 parity)."""
     out = {}

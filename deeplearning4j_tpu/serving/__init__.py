@@ -33,6 +33,15 @@ The subsystem that puts traffic on this stack:
   (:class:`CircuitOpen`), bounded retries with full jitter, and the
   health machine surfaced on ``/readyz``. Chaos-hardened via
   ``runtime.chaos`` injection points (``tests/test_chaos.py``).
+- :class:`FleetRouter` / :class:`StaticFleet` (``router.py``) and
+  :class:`FleetSupervisor` / :class:`WorkerSpec` (``fleet.py``) — the
+  fleet tier (ISSUE 7, ``docs/fleet_serving.md``): a front-end HTTP
+  router with per-worker health views, consistent rendezvous routing,
+  p99-derived request hedging (first bit-identical response wins,
+  duplicates suppressed by request id), transparent failover around a
+  dead worker, and zero-downtime rolling deploys over N supervised
+  ``ModelServer`` worker processes (heartbeat + exit-code watchdog,
+  budgeted restarts, manifest-prewarmed relaunches).
 - :class:`WarmupManifest` (``manifest.py``) — persisted record of every
   compiled (bucket, replica, dtype) pair, written next to model archives
   and replayed by registry load / hot-swap so a restart reaches READY
@@ -62,6 +71,11 @@ _EXPORTS = {
     "WarmupManifest": "manifest",
     "manifest_path": "manifest",
     "ModelServer": "server",
+    "FleetRouter": "router",
+    "RouterMetrics": "router",
+    "StaticFleet": "router",
+    "FleetSupervisor": "fleet",
+    "WorkerSpec": "fleet",
     "Replica": "replica",
     "ReplicaPool": "replica",
     "CircuitBreaker": "resilience",

@@ -23,7 +23,16 @@ class ServingError(RuntimeError):
 
 
 class Overloaded(ServingError):
-    """Queue full — request rejected at admission, never enqueued."""
+    """Queue full — request rejected at admission, never enqueued.
+
+    ``retry_after_ms`` (when set) is the shedding worker's own estimate of
+    when its queue will have drained — the hint the HTTP layer surfaces as
+    a ``Retry-After`` header so a router fails over to a *different*
+    worker instead of hammering the one that just shed (ISSUE 7)."""
+
+    def __init__(self, *args, retry_after_ms: Optional[float] = None):
+        super().__init__(*args)
+        self.retry_after_ms = retry_after_ms
 
 
 class DeadlineExceeded(ServingError):
@@ -43,16 +52,32 @@ class AdmissionController:
     """
 
     def __init__(self, queue_limit: int = 256,
-                 default_timeout_ms: Optional[float] = None):
+                 default_timeout_ms: Optional[float] = None,
+                 retry_after_floor_ms: float = 25.0):
         self.queue_limit = int(queue_limit)
         self.default_timeout_ms = default_timeout_ms
+        self.retry_after_floor_ms = float(retry_after_floor_ms)
 
-    def admit(self, queue_depth: int) -> None:
-        """Raise :class:`Overloaded` if the queue cannot take this request."""
+    def retry_after_ms(self, queue_depth: int,
+                       drain_ms_per_request: Optional[float] = None) -> float:
+        """How long a shed caller should wait before retrying THIS worker:
+        the queued work divided by the measured drain rate (the batcher
+        passes its recent per-request service estimate), floored so an
+        empty measurement window never advertises an instant retry."""
+        per = float(drain_ms_per_request or 0.0)
+        return max(self.retry_after_floor_ms, queue_depth * per)
+
+    def admit(self, queue_depth: int,
+              drain_ms_per_request: Optional[float] = None) -> None:
+        """Raise :class:`Overloaded` if the queue cannot take this request.
+        The rejection carries a queue-depth-derived ``retry_after_ms``
+        hint (see :meth:`retry_after_ms`)."""
         if queue_depth >= self.queue_limit:
             raise Overloaded(
                 f"serving queue full ({queue_depth}/{self.queue_limit} "
-                f"requests waiting); retry later or raise queue_limit")
+                f"requests waiting); retry later or raise queue_limit",
+                retry_after_ms=self.retry_after_ms(queue_depth,
+                                                   drain_ms_per_request))
 
     def deadline_for(self, timeout_ms: Optional[float]) -> Optional[float]:
         """Absolute monotonic deadline for a request, or None."""

@@ -271,6 +271,16 @@ class ContinuousBatcher:
             raise ValueError("request must have a leading batch dimension")
         return xs, xs.shape[0]
 
+    def _drain_ms_per_request(self) -> Optional[float]:
+        """Recent per-request service estimate (mean batch latency spread
+        over a full bucket) — the drain rate behind the ``Retry-After``
+        hint on :class:`Overloaded` rejections. ``None`` until a batch has
+        been measured."""
+        hist = self.metrics.batch_latency
+        if hist.count == 0:
+            return None
+        return hist.mean * 1000.0 / max(1, self.max_batch_size)
+
     def submit(self, x: ArrayOrDict, timeout_ms: Optional[float] = None):
         """Blocking inference; safe from many threads at once.
 
@@ -284,7 +294,8 @@ class ContinuousBatcher:
             if self._shutdown or self._draining:
                 raise ServingShutdown("batcher is shut down")
             try:
-                self.admission.admit(self._queue.qsize())
+                self.admission.admit(self._queue.qsize(),
+                                     self._drain_ms_per_request())
             except Overloaded:
                 self.metrics.record_rejection("overload")
                 raise

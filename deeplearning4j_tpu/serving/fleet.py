@@ -1,0 +1,629 @@
+"""Fleet worker lifecycle: supervised ``ModelServer`` processes (ISSUE 7).
+
+The :class:`~deeplearning4j_tpu.serving.router.FleetRouter` routes; this
+module owns the processes it routes *to*. It is the
+:class:`~deeplearning4j_tpu.train.distributed.DistributedSupervisor`
+pattern one level up the serving stack — heartbeat-file + exit-code
+watchdog, budgeted restarts, conftest-guarded worker pids — with one key
+difference: serving workers are independent fault domains, so a dead
+worker is restarted *alone* while its peers keep taking traffic (an SPMD
+training group, by contrast, restarts whole).
+
+- :class:`WorkerSpec` — everything one worker process needs: archive,
+  model name/version, batcher knobs, the shared persistent-compile-cache
+  dir, and an optional deterministic straggler schedule (seeded
+  ``AddLatency(p=...)`` on ``serving.worker.predict`` — the injected tail
+  latency ``bench.py --fleet`` hedges against).
+- :class:`FleetSupervisor` — spawns one subprocess per spec (``python -m
+  deeplearning4j_tpu.serving.fleet <spec.json>``), waits for each
+  worker's port file (written only after the registry is loaded and
+  manifest-warmed, so "port known" means "ready"), watches exit codes
+  and heartbeat files, and relaunches a crashed or stalled worker within
+  a restart budget (`TrainingFailure` escalation when exhausted).
+  ``restart_worker`` is the *intentional* relaunch (graceful SIGTERM →
+  worker drains its registry and refreshes the warmup manifest → spawn on
+  the new archive) that :meth:`FleetRouter.rolling_deploy` drives;
+  ``kill_worker`` is the chaos drill's SIGKILL.
+- Worker pids launched here register in a module-level table
+  (:func:`live_worker_pids` / :func:`kill_stray_workers`) polled by the
+  conftest leak guard, so no orphaned serving worker survives a test.
+
+Worker processes run on the CPU backend by default (``JAX_PLATFORMS``
+stripped from the inherited env exactly like
+``train.distributed.worker_env`` — the sitecustomize TPU bootstrap must
+not race the worker's own backend selection).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+# -------------------------------------------------------------------------
+# worker-pid registry (the conftest process-leak guard polls this, exactly
+# like train.distributed's)
+_children_lock = threading.Lock()
+_children: List[subprocess.Popen] = []
+
+
+def _track_child(proc: subprocess.Popen) -> None:
+    with _children_lock:
+        _children.append(proc)
+
+
+def live_worker_pids() -> List[int]:
+    """PIDs of fleet worker subprocesses launched through this module that
+    are still alive — polled by the conftest leak guard after every test."""
+    with _children_lock:
+        _children[:] = [p for p in _children if p.poll() is None]
+        return [p.pid for p in _children]
+
+
+def kill_stray_workers() -> List[int]:
+    """Kill any still-live tracked workers (leak-guard teardown); returns
+    the PIDs that had to be killed."""
+    with _children_lock:
+        stray = [p for p in _children if p.poll() is None]
+        for p in stray:
+            try:
+                p.kill()
+            except OSError:
+                pass
+        for p in stray:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                pass
+        _children[:] = [p for p in _children if p.poll() is None]
+    return [p.pid for p in stray]
+
+
+#: supervisors currently running (start()..stop()); their workers are
+#: MANAGED, not leaked — the per-test leak guard must only flag orphans,
+#: or a module-scoped fleet fixture would fail every test it spans.
+_active_supervisors: List["FleetSupervisor"] = []
+
+
+def orphaned_worker_pids() -> List[int]:
+    """Live tracked worker pids NOT owned by any active supervisor — what
+    the conftest leak guard polls (a supervised fixture fleet is fine; a
+    worker that outlived its supervisor is a leak)."""
+    managed = set()
+    for sup in list(_active_supervisors):
+        managed.update(sup.managed_pids())
+    return [pid for pid in live_worker_pids() if pid not in managed]
+
+
+def kill_orphaned_workers() -> List[int]:
+    """Kill only the ORPHANED tracked workers (leak-guard teardown); a
+    managed fixture fleet mid-suite must survive another test's leak, so
+    this never touches a live supervisor's children. Returns killed pids."""
+    orphans = set(orphaned_worker_pids())
+    with _children_lock:
+        stray = [p for p in _children
+                 if p.pid in orphans and p.poll() is None]
+        for p in stray:
+            try:
+                p.kill()
+            except OSError:
+                pass
+        for p in stray:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                pass
+        _children[:] = [p for p in _children if p.poll() is None]
+    return [p.pid for p in stray]
+
+
+def _worker_env(spec: "WorkerSpec") -> Dict[str, str]:
+    """Subprocess env for a fleet worker: strip the TPU bootstrap vars,
+    PIN the worker's backend (``python -m`` imports the package — and
+    therefore jax — before ``worker_main`` runs, so the platform choice
+    must already be in the env or jax may race into TPU-plugin
+    initialization), and put the repo on PYTHONPATH — the contract proven
+    by the multihost training workers."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+           and not k.startswith("PALLAS_AXON")}
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = spec.jax_platforms
+    env["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count="
+                        f"{int(spec.host_device_count)}")
+    return env
+
+
+# -------------------------------------------------------------------------
+@dataclasses.dataclass
+class WorkerSpec:
+    """One worker process's configuration (JSON-serializable; the spec
+    file IS the worker's argv)."""
+
+    worker_id: str
+    model_name: str
+    archive: str
+    version: Optional[int] = None
+    batcher_kw: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    #: manifest-style input signature ({name|"__single__": {"shape_tail",
+    #: "dtype"}}) used to build a zeros warmup example on a FIRST launch,
+    #: before any warmup manifest exists next to the archive. Replays of a
+    #: recorded manifest take precedence (they know the real bucket set).
+    warmup_signature: Optional[Dict[str, Any]] = None
+    cache_dir: Optional[str] = None          # shared persistent compile cache
+    straggle: Optional[Dict[str, Any]] = None  # {"p", "ms", "seed"[, "point"]}
+    jax_platforms: str = "cpu"
+    host_device_count: int = 1
+    heartbeat_interval_s: float = 0.5
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+class _WorkerHandle:
+    def __init__(self, spec: WorkerSpec, run_dir: str):
+        self.spec = spec
+        self.run_dir = run_dir
+        self.spec_path = os.path.join(run_dir, f"{spec.worker_id}.spec.json")
+        self.port_file = os.path.join(run_dir, f"{spec.worker_id}.port.json")
+        self.heartbeat_file = os.path.join(run_dir, f"{spec.worker_id}.hb")
+        self.proc: Optional[subprocess.Popen] = None
+        self.port: Optional[int] = None
+        self.stopping = False    # intentional stop/restart in progress
+        self.relaunching = False  # watchdog relaunch in progress
+        self.dead = False        # restart budget exhausted; left down
+        self.restarts = 0
+        self.generation = 0
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+
+class FleetSupervisor:
+    """Launch + watch + restart N independent serving workers.
+
+    ``specs`` is a list of :class:`WorkerSpec`. The restart budget
+    (``max_restarts`` within ``restart_window_s``, lifetime when None) is
+    shared across the fleet — a crash-looping fleet escalates with
+    :class:`~deeplearning4j_tpu.train.fault_tolerance.TrainingFailure`
+    (surfaced by :meth:`check`) instead of flapping forever. Intentional
+    restarts (:meth:`restart_worker`, the rolling-deploy path) do not
+    consume the budget.
+    """
+
+    def __init__(self, specs: List[WorkerSpec], run_dir: Optional[str] = None,
+                 max_restarts: int = 3,
+                 restart_window_s: Optional[float] = None,
+                 heartbeat_timeout_s: float = 30.0,
+                 ready_timeout_s: float = 180.0,
+                 poll_s: float = 0.2):
+        ids = [s.worker_id for s in specs]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate worker ids: {ids}")
+        self._own_run_dir = run_dir is None
+        self.run_dir = run_dir or tempfile.mkdtemp(prefix="dl4j-fleet-")
+        os.makedirs(self.run_dir, exist_ok=True)
+        self._handles: Dict[str, _WorkerHandle] = {
+            s.worker_id: _WorkerHandle(s, self.run_dir) for s in specs}
+        self.max_restarts = int(max_restarts)
+        self.restart_window_s = restart_window_s
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.ready_timeout_s = float(ready_timeout_s)
+        self.poll_s = float(poll_s)
+        self.restarts = 0
+        self._restart_times: deque = deque()
+        self._failure: Optional[BaseException] = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._watchdog: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- spawning
+    def _spawn(self, handle: _WorkerHandle) -> None:
+        for stale in (handle.port_file, handle.heartbeat_file):
+            try:
+                os.unlink(stale)
+            except OSError:
+                pass
+        spec = handle.spec.to_dict()
+        spec["port_file"] = handle.port_file
+        spec["heartbeat_file"] = handle.heartbeat_file
+        with open(handle.spec_path, "w") as f:
+            json.dump(spec, f, indent=2)
+        # output to temp FILES, not pipes (a chatty worker must not block
+        # on a full pipe buffer and read as a stalled straggler)
+        out_f = tempfile.NamedTemporaryFile(
+            mode="w+", prefix=f"dl4j-fleet-{handle.spec.worker_id}-out-",
+            dir=self.run_dir, delete=False)
+        err_f = tempfile.NamedTemporaryFile(
+            mode="w+", prefix=f"dl4j-fleet-{handle.spec.worker_id}-err-",
+            dir=self.run_dir, delete=False)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "deeplearning4j_tpu.serving.fleet",
+             handle.spec_path],
+            env=_worker_env(handle.spec), stdout=out_f, stderr=err_f,
+            text=True)
+        proc._dl4j_capture = (out_f, err_f)  # type: ignore[attr-defined]
+        _track_child(proc)
+        handle.proc = proc
+        handle.port = None
+        handle.generation += 1
+
+    @staticmethod
+    def _stderr_tail(handle: _WorkerHandle, n: int = 2000) -> str:
+        try:
+            _, err_f = getattr(handle.proc, "_dl4j_capture", (None, None))
+            err_f.flush()
+            err_f.seek(0, os.SEEK_END)
+            size = err_f.tell()
+            err_f.seek(max(0, size - n))
+            return err_f.read()
+        except Exception:
+            return "<no stderr captured>"
+
+    def _wait_port(self, handle: _WorkerHandle,
+                   timeout_s: Optional[float] = None) -> int:
+        """Block until the worker writes its port file (it does so only
+        AFTER the registry is loaded and warmed — ready, not just alive)."""
+        timeout_s = self.ready_timeout_s if timeout_s is None else timeout_s
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if handle.proc.poll() is not None:
+                raise RuntimeError(
+                    f"fleet worker {handle.spec.worker_id!r} exited "
+                    f"rc={handle.proc.returncode} before becoming ready:\n"
+                    f"{self._stderr_tail(handle)}")
+            try:
+                with open(handle.port_file) as f:
+                    info = json.load(f)
+                if info.get("pid") == handle.proc.pid:
+                    handle.port = int(info["port"])
+                    return handle.port
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.05)
+        handle.proc.kill()
+        raise RuntimeError(
+            f"fleet worker {handle.spec.worker_id!r} not ready after "
+            f"{timeout_s:.0f}s:\n{self._stderr_tail(handle)}")
+
+    def start(self) -> "FleetSupervisor":
+        """Spawn every worker (concurrently — warmups overlap), wait for
+        all to become ready, then start the watchdog. A worker failing to
+        come up kills the whole just-spawned group before raising —
+        a failed start must not leak processes."""
+        with self._lock:
+            for handle in self._handles.values():
+                self._spawn(handle)
+        try:
+            for handle in self._handles.values():
+                self._wait_port(handle)
+        except BaseException:
+            for handle in self._handles.values():
+                if handle.alive():
+                    handle.proc.kill()
+                    try:
+                        handle.proc.wait(timeout=10)
+                    except Exception:
+                        pass
+                self._close_capture(handle)
+            raise
+        self._stop.clear()
+        self._watchdog = threading.Thread(target=self._watch, daemon=True,
+                                          name="FleetSupervisor")
+        self._watchdog.start()
+        if self not in _active_supervisors:
+            _active_supervisors.append(self)
+        return self
+
+    # ------------------------------------------------------------ fleet API
+    def managed_pids(self) -> List[int]:
+        """PIDs of this supervisor's currently-live workers."""
+        with self._lock:
+            return [h.proc.pid for h in self._handles.values() if h.alive()]
+
+    def endpoints(self) -> Dict[str, str]:
+        """``{worker_id: "127.0.0.1:port"}`` for every worker that is
+        alive with a known port (the router's view of the fleet)."""
+        out = {}
+        with self._lock:
+            for wid, h in self._handles.items():
+                if h.port is not None and h.alive() and not h.stopping:
+                    out[wid] = f"127.0.0.1:{h.port}"
+        return out
+
+    def worker_ids(self) -> List[str]:
+        return sorted(self._handles)
+
+    def check(self) -> None:
+        """Raise the stored escalation (restart budget exhausted), if any."""
+        if self._failure is not None:
+            raise self._failure
+
+    def kill_worker(self, worker_id: str) -> int:
+        """SIGKILL a worker (the chaos drill). The watchdog notices the
+        exit and restarts it within the budget. Returns the killed pid."""
+        handle = self._handles[worker_id]
+        pid = handle.proc.pid
+        handle.proc.kill()
+        return pid
+
+    def restart_worker(self, worker_id: str, archive: Optional[str] = None,
+                       version: Optional[int] = None,
+                       stop_timeout_s: float = 30.0) -> int:
+        """Intentional relaunch (the rolling-deploy step): graceful
+        SIGTERM (the worker drains its registry, refreshing the warmup
+        manifest), then spawn — on ``archive``/``version`` when given —
+        and wait ready. Does not consume the restart budget."""
+        handle = self._handles[worker_id]
+        # claim the handle under the lock: the watchdog sets `relaunching`
+        # under the same lock before acting on a crash, so exactly one of
+        # the two paths owns the handle — no double spawn
+        with self._lock:
+            handle.stopping = True
+        # a watchdog crash-relaunch of this worker may be mid-flight
+        # (spawned, waiting for the port file); let it settle before
+        # replacing the process, or two children race for one handle
+        settle = time.monotonic() + self.ready_timeout_s
+        while handle.relaunching and time.monotonic() < settle:
+            time.sleep(0.05)
+        try:
+            if handle.alive():
+                handle.proc.terminate()
+                try:
+                    handle.proc.wait(timeout=stop_timeout_s)
+                except subprocess.TimeoutExpired:
+                    logger.warning("worker %s ignored SIGTERM; killing",
+                                   worker_id)
+                    handle.proc.kill()
+                    handle.proc.wait(timeout=10)
+            self._close_capture(handle)
+            if archive is not None:
+                handle.spec.archive = archive
+            if version is not None:
+                handle.spec.version = version
+            with self._lock:
+                self._spawn(handle)
+            port = self._wait_port(handle)
+        finally:
+            handle.stopping = False
+        return port
+
+    def prewarm_manifest(self, archive: str) -> Optional[str]:
+        """Ensure ``archive`` has a warmup manifest before a rolling
+        deploy: when it has none, copy a live worker's current-archive
+        manifest next to it (same model family — the recorded buckets /
+        input signature are what the replacement must pre-warm). This is
+        what makes readmission compile-free together with the shared
+        persistent executable cache."""
+        from deeplearning4j_tpu.serving.manifest import manifest_path
+        target = manifest_path(archive)
+        if os.path.exists(target):
+            return target
+        for handle in self._handles.values():
+            src = manifest_path(handle.spec.archive)
+            if os.path.exists(src) and os.path.abspath(src) != \
+                    os.path.abspath(target):
+                shutil.copyfile(src, target)
+                return target
+        return None
+
+    # ------------------------------------------------------------- watchdog
+    def _register_restart(self, cause: str) -> None:
+        now = time.monotonic()
+        self.restarts += 1
+        self._restart_times.append(now)
+        if self.restart_window_s is not None:
+            while (self._restart_times and
+                   now - self._restart_times[0] > self.restart_window_s):
+                self._restart_times.popleft()
+            recent = len(self._restart_times)
+            budget = (f"{self.max_restarts} restarts in "
+                      f"{self.restart_window_s:.0f}s")
+        else:
+            recent = self.restarts
+            budget = f"{self.max_restarts} restarts"
+        if recent > self.max_restarts:
+            from deeplearning4j_tpu.train.fault_tolerance import \
+                TrainingFailure
+            raise TrainingFailure(
+                f"fleet giving up after {budget} (last cause: {cause})")
+        logger.warning("fleet worker failed (%s); restart %d within "
+                       "budget %s", cause, recent, budget)
+
+    @staticmethod
+    def _close_capture(handle: _WorkerHandle) -> None:
+        for f in getattr(handle.proc, "_dl4j_capture", ()):
+            try:
+                f.close()
+                os.unlink(f.name)
+            except (OSError, ValueError):
+                pass
+
+    def _heartbeat_stale(self, handle: _WorkerHandle) -> bool:
+        if handle.port is None:  # not ready yet; readiness has its own wait
+            return False
+        try:
+            age = time.time() - os.stat(handle.heartbeat_file).st_mtime
+        except OSError:
+            return False
+        return age > self.heartbeat_timeout_s
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            for handle in list(self._handles.values()):
+                if handle.stopping or handle.dead or handle.proc is None:
+                    continue
+                cause = None
+                code = handle.proc.poll()
+                if code is not None:
+                    cause = (f"worker {handle.spec.worker_id} exited "
+                             f"rc={code}")
+                elif self._heartbeat_stale(handle):
+                    cause = (f"worker {handle.spec.worker_id} heartbeat "
+                             f"stale > {self.heartbeat_timeout_s:.0f}s")
+                    handle.proc.kill()
+                    try:
+                        handle.proc.wait(timeout=10)
+                    except Exception:
+                        pass
+                if cause is None:
+                    continue
+                # claim the handle before acting: restart_worker sets
+                # `stopping` under this lock, so a crash noticed just as
+                # an intentional restart begins is ceded to it instead of
+                # racing two spawns onto one handle
+                with self._lock:
+                    if handle.stopping:
+                        continue
+                    handle.relaunching = True
+                try:
+                    self._close_capture(handle)
+                    try:
+                        self._register_restart(cause)
+                    except BaseException as e:
+                        self._failure = e
+                        handle.dead = True
+                        logger.error("fleet restart budget exhausted: %s",
+                                     e)
+                        continue
+                    handle.restarts += 1
+                    try:
+                        with self._lock:
+                            self._spawn(handle)
+                        self._wait_port(handle)
+                    except Exception:
+                        logger.exception("relaunch of %s failed",
+                                         handle.spec.worker_id)
+                finally:
+                    handle.relaunching = False
+
+    # ------------------------------------------------------------ lifecycle
+    def stop(self, timeout_s: float = 30.0) -> None:
+        """Stop the watchdog, then gracefully stop every worker (SIGTERM →
+        drain → manifest refresh → exit 0), escalating to SIGKILL."""
+        self._stop.set()
+        if self in _active_supervisors:
+            _active_supervisors.remove(self)
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=10.0)
+            self._watchdog = None
+        for handle in self._handles.values():
+            handle.stopping = True
+            if handle.alive():
+                handle.proc.terminate()
+        deadline = time.monotonic() + timeout_s
+        for handle in self._handles.values():
+            if handle.proc is None:
+                continue
+            try:
+                handle.proc.wait(timeout=max(0.1,
+                                             deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                handle.proc.kill()
+                try:
+                    handle.proc.wait(timeout=10)
+                except Exception:
+                    pass
+            self._close_capture(handle)
+
+    def __enter__(self) -> "FleetSupervisor":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.stop()
+
+
+# -------------------------------------------------------------------------
+# worker process entry point: python -m deeplearning4j_tpu.serving.fleet
+# <spec.json>
+def worker_main(spec_path: str) -> int:
+    with open(spec_path) as f:
+        spec = json.load(f)
+    # The spawn env already pinned JAX_PLATFORMS/XLA_FLAGS (jax was
+    # imported with the package, before this function ran). Re-assert the
+    # platform through the config too: a sitecustomize that calls
+    # jax.config.update at interpreter start overrides the env var, and
+    # this update — legal while backends are uninitialized — overrides it
+    # back (the conftest recipe).
+    os.environ.setdefault("JAX_PLATFORMS", spec.get("jax_platforms", "cpu"))
+    import jax
+    jax.config.update("jax_platforms", spec.get("jax_platforms", "cpu"))
+    if spec.get("cache_dir"):
+        from deeplearning4j_tpu.runtime.environment import get_environment
+        get_environment().set_compile_cache(spec["cache_dir"])
+    straggle = spec.get("straggle")
+    if straggle:
+        from deeplearning4j_tpu.runtime.chaos import (AddLatency,
+                                                      ChaosController)
+        controller = ChaosController(seed=int(straggle.get("seed", 0)))
+        controller.on(straggle.get("point", "serving.worker.predict"),
+                      AddLatency(float(straggle["ms"]) / 1000.0,
+                                 p=float(straggle.get("p", 1.0))))
+        controller.__enter__()  # process-lifetime schedule, never exited
+
+    stop = threading.Event()
+
+    def _graceful(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+
+    from deeplearning4j_tpu.serving.manifest import WarmupManifest
+    from deeplearning4j_tpu.serving.registry import ModelRegistry
+    from deeplearning4j_tpu.serving.server import ModelServer
+
+    batcher_kw = dict(spec.get("batcher_kw") or {})
+    sig = spec.get("warmup_signature")
+    if sig and "warmup_example" not in batcher_kw and \
+            WarmupManifest.load_for_archive(spec["archive"]) is None:
+        # first launch of this archive: no manifest to replay yet — build
+        # a zeros warmup example from the recorded input signature so the
+        # worker still reaches READY fully AOT-warmed
+        batcher_kw["warmup_example"] = WarmupManifest(
+            inputs={str(k): dict(v) for k, v in sig.items()},
+            buckets=[], replicas=1, pairs=[]).example()
+    registry = ModelRegistry()
+    served = registry.load(spec["model_name"], spec["archive"],
+                           version=spec.get("version"), **batcher_kw)
+    server = ModelServer(registry, worker_id=spec["worker_id"])
+    port = server.start(0)
+    # the port file is the readiness signal: written only after the
+    # registry is loaded, manifest-warmed and serving — atomic so the
+    # supervisor never reads a torn record
+    info = {"port": port, "pid": os.getpid(),
+            "worker_id": spec["worker_id"], "version": served.version}
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(spec["port_file"]))
+    with os.fdopen(fd, "w") as f:
+        json.dump(info, f)
+    os.replace(tmp, spec["port_file"])
+
+    hb = spec["heartbeat_file"]
+    interval = float(spec.get("heartbeat_interval_s", 0.5))
+    while not stop.wait(interval):
+        with open(hb, "a"):
+            os.utime(hb)
+    # graceful drain: queued requests complete, the warmup manifest is
+    # refreshed next to the archive (traffic-minted buckets included) so
+    # the NEXT launch of this archive pre-warms what we actually served
+    registry.shutdown(drain=True)
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(worker_main(sys.argv[1]))

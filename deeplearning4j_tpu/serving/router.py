@@ -1,0 +1,838 @@
+"""Fleet router: the fault-domain boundary in front of N ``ModelServer``
+worker processes (ISSUE 7 tentpole; the reference's multi-JVM serving /
+parameter-server routing tier, ``docs/fleet_serving.md``).
+
+One ``ModelServer`` process as the whole fleet means any worker crash,
+stall, or deploy is a full outage. :class:`FleetRouter` is the same
+stdlib ``ThreadingHTTPServer`` idiom as ``serving/server.py``, one level
+up — it owns no models, only a **health view** of the workers behind it:
+
+- **Health**: an active prober polls every worker's ``/readyz``; passive
+  signals (connection failures, 5xx, shed responses) feed a per-worker
+  :class:`~deeplearning4j_tpu.serving.resilience.CircuitBreaker` — a
+  byzantine worker (one that keeps erroring) is isolated without taking
+  the fleet down, and re-admitted through the breaker's half-open probe.
+- **Consistent routing**: workers are ranked per model by rendezvous
+  (highest-random-weight) hashing, so one model's traffic concentrates on
+  one healthy worker (warm caches, stable batching) and spreads only when
+  health changes — no routing table to rebalance.
+- **Hedging**: a request still unanswered after a p99-derived delay is
+  *hedged* against the next-ranked worker; the first completed response
+  wins bit-identically, the loser's completion is discarded and counted
+  (``router_hedges_discarded_total``) — duplicate side effects are
+  suppressed by the shared ``X-Request-Id``, and the hedge carries the
+  REMAINING deadline (``X-Deadline-Ms``), never a fresh one.
+- **Failover**: a worker dying mid-request (connection reset, SIGKILL
+  under the chaos drill) fails the *attempt*, not the request — the
+  router retries the untried next-ranked worker within the original
+  deadline. A request is never silently dropped: it ends served, or with
+  an explicit 503/504.
+- **Load signals**: a worker's 503 ``Overloaded`` carries its
+  ``Retry-After-Ms`` drain estimate; the router routes around that
+  worker until the window passes instead of hammering it
+  (``router_shed_skips_total`` counts the avoided forwards).
+- **Zero-downtime rolling deploys**: :meth:`FleetRouter.rolling_deploy`
+  drains one worker (stop new routing, wait in-flight), has the
+  :class:`~deeplearning4j_tpu.serving.fleet.FleetSupervisor` relaunch it
+  on the new archive (warmup-manifest prewarmed, persistent compile
+  cache shared), re-admits it only after ``/readyz``, then moves to the
+  next — client traffic sees a mix of old and new versions and zero
+  errors, and readmitted workers compile nothing on live traffic.
+
+Chaos points: ``serving.router.forward`` fires before every forward
+attempt, ``serving.router.hedge`` as a hedge launches (catalogue in
+``runtime/chaos.py``; drills in ``tests/test_router.py`` and
+``bench.py --fleet``).
+
+This module deliberately imports no jax — the router is pure host code
+and can front workers from any process.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import http.client
+import json
+import logging
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+from deeplearning4j_tpu.runtime import chaos
+from deeplearning4j_tpu.serving.metrics import LatencyHistogram
+from deeplearning4j_tpu.serving.resilience import CircuitBreaker, CircuitState
+
+logger = logging.getLogger(__name__)
+
+#: statuses that END a request at the client (retrying cannot change them:
+#: 400/404 are the client's problem, 504 means the shared deadline — which
+#: every attempt inherits via X-Deadline-Ms — has truly expired).
+_TERMINAL = frozenset({200, 400, 404, 504})
+
+#: headers the router must NOT copy from a worker response onto its own:
+#: the router's HTTP layer emits its own framing (Content-Length) and
+#: identity (Date, Server), and hop-by-hop headers never cross a proxy —
+#: re-sending the worker's copy would emit duplicates that strict clients
+#: and intermediaries reject as a protocol error.
+_HOP_BY_HOP = frozenset({"content-length", "date", "server", "connection",
+                         "transfer-encoding", "keep-alive"})
+
+
+class StaticFleet:
+    """The simplest thing a :class:`FleetRouter` can front: a fixed
+    ``{worker_id: "host:port"}`` map (in-process workers, tests). The
+    supervisor-backed twin is
+    :class:`~deeplearning4j_tpu.serving.fleet.FleetSupervisor`."""
+
+    def __init__(self, endpoints: Dict[str, str]):
+        self._endpoints = dict(endpoints)
+
+    def endpoints(self) -> Dict[str, str]:
+        return dict(self._endpoints)
+
+
+class RouterMetrics:
+    """Router-level counters/gauges (thread-safe), rendered on the
+    router's ``/metrics`` and surfaced through
+    ``runtime.profiler.router_stats()``."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.requests_total = 0
+        self.responses_total = 0        # 2xx returned to clients
+        self.errors_total = 0           # non-2xx returned to clients
+        self.forwards_total = 0         # attempts launched (incl. hedges)
+        self.hedges_total = 0           # hedge attempts launched
+        self.hedge_wins_total = 0       # winner was the hedge attempt
+        self.hedges_discarded_total = 0  # duplicate completions suppressed
+        self.failovers_total = 0        # failed attempts retried elsewhere
+        self.shed_skips_total = 0       # workers skipped inside Retry-After
+        self.deploys_total = 0
+        self.request_latency = LatencyHistogram()
+        self.worker_requests: Dict[str, int] = {}
+
+    def record(self, counter: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, counter, getattr(self, counter) + n)
+
+    def record_response(self, status: int, latency_s: float) -> None:
+        with self._lock:
+            if 200 <= status < 300:
+                self.responses_total += 1
+                self.request_latency.observe(latency_s)
+            else:
+                self.errors_total += 1
+
+    def record_forward(self, worker_id: str) -> None:
+        with self._lock:
+            self.forwards_total += 1
+            self.worker_requests[worker_id] = \
+                self.worker_requests.get(worker_id, 0) + 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "requests_total": self.requests_total,
+                "responses_total": self.responses_total,
+                "errors_total": self.errors_total,
+                "forwards_total": self.forwards_total,
+                "hedges_total": self.hedges_total,
+                "hedge_wins_total": self.hedge_wins_total,
+                "hedges_discarded_total": self.hedges_discarded_total,
+                "failovers_total": self.failovers_total,
+                "shed_skips_total": self.shed_skips_total,
+                "deploys_total": self.deploys_total,
+                "latency_p50_s": self.request_latency.percentile(50),
+                "latency_p99_s": self.request_latency.percentile(99),
+                "worker_requests": dict(self.worker_requests),
+            }
+
+    def render_prometheus(self, workers: Dict[str, "WorkerView"]) -> str:
+        s = self.snapshot()
+        lines = [
+            "# TYPE router_requests_total counter",
+            f"router_requests_total {s['requests_total']}",
+            f"router_responses_total {s['responses_total']}",
+            f"router_errors_total {s['errors_total']}",
+            f"router_forwards_total {s['forwards_total']}",
+            f"router_hedges_total {s['hedges_total']}",
+            f"router_hedge_wins_total {s['hedge_wins_total']}",
+            f"router_hedges_discarded_total {s['hedges_discarded_total']}",
+            f"router_failovers_total {s['failovers_total']}",
+            f"router_shed_skips_total {s['shed_skips_total']}",
+            f"router_deploys_total {s['deploys_total']}",
+            f'router_latency_seconds{{quantile="0.5"}} '
+            f"{s['latency_p50_s']}",
+            f'router_latency_seconds{{quantile="0.99"}} '
+            f"{s['latency_p99_s']}",
+        ]
+        for wid, n in sorted(s["worker_requests"].items()):
+            lines.append(f'router_worker_requests_total{{worker="{wid}"}} '
+                         f"{n}")
+        now = time.monotonic()
+        for wid, view in sorted(workers.items()):
+            lines.append(f'router_worker_healthy{{worker="{wid}"}} '
+                         f"{int(view.admittable(now))}")
+            lines.append(f'router_worker_inflight{{worker="{wid}"}} '
+                         f"{view.inflight}")
+        return "\n".join(lines) + "\n"
+
+
+class WorkerView:
+    """The router's per-worker health view: one address, an active-probe
+    readiness bit, a passive-signal breaker, a shed window from the
+    worker's own ``Retry-After`` hints, and the in-flight count drains
+    wait on."""
+
+    def __init__(self, worker_id: str, address: str,
+                 breaker: Optional[CircuitBreaker] = None):
+        self.worker_id = worker_id
+        self.address = address
+        self.breaker = breaker or CircuitBreaker(
+            failure_threshold=3, window_s=30.0, reset_timeout_s=2.0)
+        self.ready = False
+        self.draining = False
+        self.shed_until = 0.0           # monotonic end of the shed window
+        self.inflight = 0
+        self.requests_total = 0
+        self.failures_total = 0
+        self.latency = LatencyHistogram()
+        self._lock = threading.Lock()
+
+    def admittable(self, now: Optional[float] = None) -> bool:
+        """May new requests be routed here right now? (Half-open breaker
+        probes are consumed at attempt time, not here.)"""
+        now = time.monotonic() if now is None else now
+        return (self.ready and not self.draining and now >= self.shed_until
+                and self.breaker.state is not CircuitState.OPEN)
+
+    def shedding(self, now: Optional[float] = None) -> bool:
+        now = time.monotonic() if now is None else now
+        return now < self.shed_until
+
+    def begin(self) -> None:
+        with self._lock:
+            self.inflight += 1
+            self.requests_total += 1
+
+    def done(self, ok: bool, latency_s: Optional[float] = None) -> None:
+        with self._lock:
+            self.inflight -= 1
+            if not ok:
+                self.failures_total += 1
+            elif latency_s is not None:
+                self.latency.observe(latency_s)
+
+    def snapshot(self) -> Dict[str, Any]:
+        now = time.monotonic()
+        return {"address": self.address, "ready": self.ready,
+                "draining": self.draining, "admittable": self.admittable(now),
+                "shedding_ms": max(0.0, (self.shed_until - now) * 1000.0),
+                "inflight": self.inflight,
+                "requests_total": self.requests_total,
+                "failures_total": self.failures_total,
+                "breaker": self.breaker.snapshot()}
+
+
+class _BreakerDeclined(Exception):
+    """The worker's half-open breaker had no probe slot left at forward
+    time — a retryable skip, not a worker fault."""
+
+
+class _Attempt:
+    """One forward attempt's outcome."""
+
+    __slots__ = ("view", "hedged", "status", "headers", "data", "error")
+
+    def __init__(self, view: WorkerView, hedged: bool):
+        self.view = view
+        self.hedged = hedged
+        self.status: Optional[int] = None
+        self.headers: Dict[str, str] = {}
+        self.data: bytes = b""
+        self.error: Optional[BaseException] = None
+
+    @property
+    def terminal(self) -> bool:
+        return self.status in _TERMINAL
+
+    @property
+    def retryable(self) -> bool:
+        """A failed attempt another worker might still serve: connection
+        faults, 5xx, and shed (503) responses."""
+        return not self.terminal
+
+
+class _Race:
+    """Exactly-one-winner coordination for a primary attempt and its
+    hedge. The first TERMINAL completion claims the request (its response
+    goes to the client bit-for-bit); any completion after that is a
+    duplicate — discarded and counted, the side-effect suppression the
+    shared request id exists for."""
+
+    def __init__(self, metrics: RouterMetrics):
+        self._metrics = metrics
+        self._cv = threading.Condition()
+        self.winner: Optional[_Attempt] = None
+        self.launched = 0
+        self.finished = 0
+        self.failures: List[_Attempt] = []
+
+    def register_launch(self) -> None:
+        with self._cv:
+            self.launched += 1
+
+    def complete(self, attempt: _Attempt) -> None:
+        with self._cv:
+            self.finished += 1
+            if attempt.terminal:
+                if self.winner is None:
+                    self.winner = attempt
+                    if attempt.hedged:
+                        self._metrics.record("hedge_wins_total")
+                else:
+                    self._metrics.record("hedges_discarded_total")
+            else:
+                if self.winner is not None and self.launched > 1:
+                    # the loser of a hedge race that ended in failure is
+                    # still a duplicate completion to account for
+                    self._metrics.record("hedges_discarded_total")
+                self.failures.append(attempt)
+            self._cv.notify_all()
+
+    def wait(self, timeout: Optional[float]) -> bool:
+        """Wait until a winner exists or every launched attempt finished.
+        Returns True when settled."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while self.winner is None and self.finished < self.launched:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cv.wait(remaining)
+            return True
+
+
+class FleetRouter:
+    """HTTP front end over a worker fleet.
+
+    ``fleet`` is anything with ``endpoints() -> {worker_id: "host:port"}``
+    (:class:`StaticFleet` or a
+    :class:`~deeplearning4j_tpu.serving.fleet.FleetSupervisor`; rolling
+    deploys additionally need the supervisor's ``restart_worker``).
+
+    Hedging: a request unanswered after ``hedge_delay_s()`` — the
+    measured p99 forward latency times ``hedge_factor``, clamped to
+    ``[hedge_min_ms, hedge_max_ms]``, or ``hedge_initial_ms`` until
+    ``hedge_warm_count`` responses have been observed — is duplicated to
+    the next-ranked worker. ``hedge_enabled=False`` disables it (the
+    unhedged arm of ``bench.py --fleet``).
+    """
+
+    def __init__(self, fleet, default_timeout_ms: Optional[float] = None,
+                 hedge_enabled: bool = True, hedge_factor: float = 1.0,
+                 hedge_min_ms: float = 10.0, hedge_max_ms: float = 1000.0,
+                 hedge_initial_ms: float = 75.0, hedge_warm_count: int = 32,
+                 probe_interval_s: float = 0.25,
+                 probe_timeout_s: float = 1.0,
+                 connect_timeout_s: float = 2.0,
+                 no_deadline_timeout_s: float = 60.0):
+        self._fleet = fleet
+        self.default_timeout_ms = default_timeout_ms
+        self.hedge_enabled = bool(hedge_enabled)
+        self.hedge_factor = float(hedge_factor)
+        self.hedge_min_ms = float(hedge_min_ms)
+        self.hedge_max_ms = float(hedge_max_ms)
+        self.hedge_initial_ms = float(hedge_initial_ms)
+        self.hedge_warm_count = int(hedge_warm_count)
+        self.probe_interval_s = float(probe_interval_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.no_deadline_timeout_s = float(no_deadline_timeout_s)
+        self.metrics = RouterMetrics()
+        self._views: Dict[str, WorkerView] = {}
+        self._views_lock = threading.Lock()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._prober: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.port: Optional[int] = None
+        self._sync_views()
+
+    # ------------------------------------------------------------ fleet view
+    def _sync_views(self) -> None:
+        """Reconcile worker views with the fleet's current endpoints: new
+        workers appear STARTING (not ready until probed), a restarted
+        worker (same id, new address) gets a fresh breaker and must
+        re-prove readiness, removed workers disappear."""
+        endpoints = self._fleet.endpoints()
+        with self._views_lock:
+            for wid, addr in endpoints.items():
+                view = self._views.get(wid)
+                if view is None:
+                    self._views[wid] = WorkerView(wid, addr)
+                elif view.address != addr:
+                    fresh = WorkerView(wid, addr)
+                    fresh.draining = view.draining
+                    self._views[wid] = fresh
+            for wid in list(self._views):
+                if wid not in endpoints:
+                    del self._views[wid]
+
+    def workers(self) -> Dict[str, WorkerView]:
+        with self._views_lock:
+            return dict(self._views)
+
+    def ranked_workers(self, model: str) -> List[WorkerView]:
+        """Every worker view, ranked by rendezvous hash for ``model`` —
+        deterministic, so one model's traffic concentrates on the same
+        healthy worker across requests (and across router restarts)."""
+        def score(wid: str) -> int:
+            h = hashlib.blake2b(f"{model}|{wid}".encode(), digest_size=8)
+            return int.from_bytes(h.digest(), "big")
+        views = self.workers()
+        return [views[wid] for wid in
+                sorted(views, key=score, reverse=True)]
+
+    def hedge_delay_s(self) -> float:
+        """The p99-derived hedge trigger (see class docstring)."""
+        hist = self.metrics.request_latency
+        if hist.count < self.hedge_warm_count:
+            ms = self.hedge_initial_ms
+        else:
+            ms = hist.percentile(99) * 1000.0 * self.hedge_factor
+        return min(self.hedge_max_ms, max(self.hedge_min_ms, ms)) / 1000.0
+
+    # ------------------------------------------------------------- probing
+    def _probe_worker(self, view: WorkerView) -> bool:
+        status, _, _ = self._http(view.address, "GET", "/readyz",
+                                  timeout=self.probe_timeout_s)
+        return status == 200
+
+    def _probe_cycle(self) -> None:
+        self._sync_views()
+        for view in self.workers().values():
+            try:
+                view.ready = self._probe_worker(view)
+            except Exception:
+                view.ready = False
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.probe_interval_s):
+            try:
+                self._probe_cycle()
+            except Exception:
+                logger.exception("router probe cycle failed")
+
+    # --------------------------------------------------------------- http
+    def _http(self, address: str, method: str, path: str,
+              body: Optional[bytes] = None,
+              headers: Optional[Dict[str, str]] = None,
+              timeout: Optional[float] = None
+              ) -> Tuple[int, Dict[str, str], bytes]:
+        host, port = address.rsplit(":", 1)
+        conn = http.client.HTTPConnection(
+            host, int(port),
+            timeout=self.connect_timeout_s if timeout is None else timeout)
+        try:
+            conn.request(method, path, body=body, headers=headers or {})
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, dict(resp.getheaders()), data
+        finally:
+            conn.close()
+
+    # ------------------------------------------------------------ routing
+    @staticmethod
+    def _shed_window_ms(headers: Dict[str, str], body: bytes) -> float:
+        h = {k.lower(): v for k, v in headers.items()}
+        if "retry-after-ms" in h:
+            try:
+                return float(h["retry-after-ms"])
+            except ValueError:
+                pass
+        if "retry-after" in h:
+            try:
+                return float(h["retry-after"]) * 1000.0
+            except ValueError:
+                pass
+        try:
+            ms = json.loads(body.decode()).get("retry_after_ms")
+            return float(ms) if ms is not None else 0.0
+        except Exception:
+            return 0.0
+
+    def _classify(self, attempt: _Attempt) -> None:
+        """Feed an attempt's outcome into the worker's health view."""
+        view = attempt.view
+        if isinstance(attempt.error, _BreakerDeclined):
+            return  # nothing was sent; neither fault nor success
+        if attempt.error is not None:
+            # connection-level fault: the worker is likely gone — fail
+            # fast for subsequent requests; the prober re-admits it
+            view.ready = False
+            view.breaker.record_failure()
+            return
+        if attempt.status == 503:
+            # a load/health signal, not a worker fault: honor the shed
+            # hint (Overloaded) or wait for the probe (circuit_open)
+            window_ms = self._shed_window_ms(attempt.headers, attempt.data)
+            if window_ms > 0:
+                view.shed_until = max(view.shed_until,
+                                      time.monotonic() + window_ms / 1000.0)
+            view.breaker.record_discard()
+            return
+        if attempt.status is not None and attempt.status >= 500:
+            view.breaker.record_failure()
+            return
+        view.breaker.record_success()
+
+    def _forward(self, race: _Race, view: WorkerView, name: str,
+                 body: bytes, rid: str, deadline: Optional[float],
+                 hedged: bool) -> None:
+        """One attempt against one worker (runs on its own thread)."""
+        attempt = _Attempt(view, hedged)
+        view.begin()
+        t0 = time.monotonic()
+        try:
+            chaos.inject("serving.router.forward")
+            # consume the breaker slot only for attempts actually sent —
+            # a half-open probe slot must never leak to a worker that was
+            # merely *ranked* (that would wedge the breaker half-open)
+            if not view.breaker.allow():
+                raise _BreakerDeclined(view.worker_id)
+            remaining = None if deadline is None else deadline - t0
+            if remaining is not None and remaining <= 0:
+                raise TimeoutError("deadline expired before forward")
+            headers = {"Content-Type": "application/json",
+                       "X-Request-Id": rid}
+            if remaining is not None:
+                headers["X-Deadline-Ms"] = f"{remaining * 1000.0:.1f}"
+            self.metrics.record_forward(view.worker_id)
+            # a deadline-free request's socket timeout must cover a SLOW
+            # predict, not just the connect — 2s here would misread a
+            # healthy-but-busy worker as dead and cascade into 503s
+            status, resp_headers, data = self._http(
+                view.address, "POST", f"/v1/models/{name}/predict",
+                body=body, headers=headers,
+                timeout=(self.no_deadline_timeout_s if remaining is None
+                         else remaining + 0.25))
+            attempt.status, attempt.headers, attempt.data = \
+                status, resp_headers, data
+        except BaseException as e:
+            attempt.error = e
+        latency = time.monotonic() - t0
+        self._classify(attempt)
+        view.done(ok=attempt.status == 200,
+                  latency_s=latency if attempt.status == 200 else None)
+        race.complete(attempt)
+
+    def _eligible(self, ranked: List[WorkerView], tried: set,
+                  now: float) -> List[WorkerView]:
+        out = []
+        for view in ranked:
+            if view.worker_id in tried:
+                continue
+            if view.shedding(now):
+                self.metrics.record("shed_skips_total")
+                continue
+            if view.admittable(now):
+                out.append(view)
+        return out
+
+    def _launch(self, race: _Race, view: WorkerView, name: str, body: bytes,
+                rid: str, deadline: Optional[float], hedged: bool) -> None:
+        race.register_launch()
+        threading.Thread(
+            target=self._forward,
+            args=(race, view, name, body, rid, deadline, hedged),
+            daemon=True, name=f"router-forward-{view.worker_id}").start()
+
+    def _route_predict(self, name: str, raw: bytes, inbound_headers
+                       ) -> Tuple[int, Dict[str, str], bytes]:
+        """The routing engine: ranked candidates -> hedged race ->
+        failover loop until a terminal response or the deadline."""
+        self.metrics.record("requests_total")
+        t_start = time.monotonic()
+        try:
+            body = json.loads(raw.decode() or "{}")
+            timeout_ms = body.get("timeout_ms", self.default_timeout_ms)
+        except Exception:
+            timeout_ms = self.default_timeout_ms
+        inbound = {k: v for k, v in (inbound_headers or {}).items()}
+        hdr_deadline = inbound.get("X-Deadline-Ms")
+        if hdr_deadline is not None:
+            try:
+                hd = float(hdr_deadline)
+                timeout_ms = hd if timeout_ms is None else min(timeout_ms, hd)
+            except ValueError:
+                pass
+        deadline = (None if timeout_ms is None
+                    else t_start + float(timeout_ms) / 1000.0)
+        rid = inbound.get("X-Request-Id") or uuid.uuid4().hex
+        ranked = self.ranked_workers(name)
+        tried: set = set()
+
+        def finish(status: int, headers: Dict[str, str], data: bytes):
+            self.metrics.record_response(status, time.monotonic() - t_start)
+            headers = {k: v for k, v in headers.items()
+                       if k.lower() not in _HOP_BY_HOP}
+            headers["X-Request-Id"] = rid
+            return status, headers, data
+
+        def reply_json(status: int, obj: Dict[str, Any],
+                       extra: Optional[Dict[str, str]] = None):
+            return finish(status, {"Content-Type": "application/json",
+                                   **(extra or {})},
+                          json.dumps(obj).encode())
+
+        while True:
+            now = time.monotonic()
+            if deadline is not None and now >= deadline:
+                return reply_json(504, {
+                    "error": "deadline exceeded",
+                    "detail": f"request {rid} expired after "
+                              f"{(now - t_start) * 1000:.0f} ms spanning "
+                              f"{len(tried)} worker attempt(s)"})
+            candidates = self._eligible(ranked, tried, now)
+            if not candidates:
+                # a worker that shed THIS request is in `tried` but its
+                # shed window is still the actionable signal to surface
+                shed = [v for v in ranked if v.shedding(now)]
+                if shed:
+                    wait_ms = min((v.shed_until - now) * 1000.0
+                                  for v in shed)
+                    return reply_json(503, {
+                        "error": "overloaded", "reason": "overloaded",
+                        "retry_after_ms": round(wait_ms, 1),
+                        "detail": "every eligible worker is shedding"},
+                        extra={"Retry-After-Ms": f"{wait_ms:.0f}"})
+                return reply_json(503, {
+                    "error": "unavailable", "reason": "no_healthy_workers",
+                    "detail": f"no healthy worker for model {name!r} "
+                              f"({len(tried)} tried, "
+                              f"{len(ranked)} known)"})
+            primary = candidates[0]
+            hedge_view = candidates[1] if len(candidates) > 1 else None
+            hedge_possible = self.hedge_enabled and hedge_view is not None
+            race = _Race(self.metrics)
+            if hedge_possible:
+                self._launch(race, primary, name, raw, rid, deadline,
+                             hedged=False)
+            else:
+                # no hedge can fire: run the attempt on the handler
+                # thread itself instead of paying a thread spawn per
+                # request just to block waiting on it
+                race.register_launch()
+                self._forward(race, primary, name, raw, rid, deadline,
+                              hedged=False)
+            tried.add(primary.worker_id)
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            if hedge_possible:
+                delay = self.hedge_delay_s()
+                if remaining is not None:
+                    delay = min(delay, max(0.0, remaining))
+                settled = race.wait(delay)
+                if not settled and race.winner is None:
+                    chaos.inject("serving.router.hedge")
+                    self.metrics.record("hedges_total")
+                    self._launch(race, hedge_view, name, raw, rid,
+                                 deadline, hedged=True)
+                    tried.add(hedge_view.worker_id)
+            race.wait(None if deadline is None
+                      else max(0.0, deadline - time.monotonic()))
+            if race.winner is not None:
+                win = race.winner
+                return finish(win.status, win.headers, win.data)
+            if race.finished < race.launched:
+                # deadline hit with attempts still in flight: their late
+                # completions are counted as discarded duplicates
+                return reply_json(504, {
+                    "error": "deadline exceeded",
+                    "detail": f"request {rid} expired with "
+                              f"{race.launched - race.finished} attempt(s) "
+                              f"still in flight"})
+            # every launched attempt failed retryably -> fail over
+            self.metrics.record("failovers_total", len(race.failures))
+
+    # ------------------------------------------------------------ lifecycle
+    def drain(self, worker_id: str, timeout_s: float = 30.0) -> None:
+        """Stop routing new requests to ``worker_id`` and wait for its
+        in-flight requests (including hedge losers) to finish."""
+        view = self.workers().get(worker_id)
+        if view is None:
+            raise KeyError(f"unknown worker {worker_id!r}")
+        view.draining = True
+        deadline = time.monotonic() + timeout_s
+        while view.inflight > 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        if view.inflight > 0:
+            raise TimeoutError(
+                f"drain of {worker_id!r} timed out with "
+                f"{view.inflight} request(s) still in flight")
+
+    def readmit(self, worker_id: str) -> None:
+        view = self.workers().get(worker_id)
+        if view is not None:
+            view.draining = False
+
+    def await_ready(self, worker_id: str, timeout_s: float = 120.0) -> float:
+        """Poll ``worker_id``'s ``/readyz`` directly (no probe-cycle
+        latency) until 200; returns the wait. The worker stays DRAINING
+        in the router until :meth:`readmit`."""
+        t0 = time.monotonic()
+        deadline = t0 + timeout_s
+        while time.monotonic() < deadline:
+            self._sync_views()
+            view = self.workers().get(worker_id)
+            if view is not None:
+                try:
+                    if self._probe_worker(view):
+                        view.ready = True
+                        return time.monotonic() - t0
+                except Exception:
+                    pass
+            time.sleep(0.05)
+        raise TimeoutError(f"worker {worker_id!r} not ready after "
+                           f"{timeout_s:.0f}s")
+
+    def rolling_deploy(self, archive: str, version: Optional[int] = None,
+                       drain_timeout_s: float = 30.0,
+                       ready_timeout_s: float = 120.0) -> Dict[str, Any]:
+        """Zero-downtime deploy of ``archive`` across the fleet, one
+        worker at a time: drain -> supervisor relaunch on the new archive
+        (manifest-prewarmed) -> ``/readyz`` -> readmit. Requires a
+        supervisor-backed fleet (``restart_worker``). Returns a per-worker
+        report (ready wait, restarts)."""
+        if not hasattr(self._fleet, "restart_worker"):
+            raise TypeError(
+                "rolling_deploy needs a supervisor-backed fleet "
+                "(FleetSupervisor); a StaticFleet cannot relaunch workers")
+        prewarm = getattr(self._fleet, "prewarm_manifest", None)
+        if prewarm is not None:
+            prewarm(archive)
+        report: Dict[str, Any] = {"archive": archive, "workers": {}}
+        # deploy over the SUPERVISOR's full roster, not just the live
+        # views — a worker that is down mid-crash-relaunch right now must
+        # still be moved to the new archive, or it comes back on the old
+        worker_ids = (sorted(self._fleet.worker_ids())
+                      if hasattr(self._fleet, "worker_ids")
+                      else sorted(self.workers()))
+        for wid in worker_ids:
+            if wid in self.workers():
+                self.drain(wid, timeout_s=drain_timeout_s)
+            try:
+                self._fleet.restart_worker(wid, archive=archive,
+                                           version=version)
+                ready_s = self.await_ready(wid, timeout_s=ready_timeout_s)
+            finally:
+                self.readmit(wid)
+            report["workers"][wid] = {"ready_s": round(ready_s, 3)}
+        self.metrics.record("deploys_total")
+        return report
+
+    # --------------------------------------------------------- GET handlers
+    def _handle_get(self, path: str):
+        if path == "/healthz":
+            return 200, {"status": "ok",
+                         "workers": {wid: v.admittable()
+                                     for wid, v in self.workers().items()}}
+        if path == "/readyz":
+            now = time.monotonic()
+            admittable = {wid: v.admittable(now)
+                          for wid, v in self.workers().items()}
+            ready = any(admittable.values())
+            return (200 if ready else 503), {"ready": ready,
+                                             "workers": admittable}
+        if path == "/fleet":
+            return 200, {
+                "workers": {wid: v.snapshot()
+                            for wid, v in self.workers().items()},
+                "hedge_delay_ms": round(self.hedge_delay_s() * 1000.0, 3),
+                "metrics": self.metrics.snapshot()}
+        if path == "/v1/models" or path.startswith("/v1/models/"):
+            # proxy the listing from the first admittable worker
+            now = time.monotonic()
+            for view in self.ranked_workers("__listing__"):
+                if not view.admittable(now):
+                    continue
+                try:
+                    status, _, data = self._http(
+                        view.address, "GET", path,
+                        timeout=self.probe_timeout_s)
+                    return status, json.loads(data.decode())
+                except Exception:
+                    continue
+            return 503, {"error": "unavailable",
+                         "reason": "no_healthy_workers"}
+        return 404, {"error": f"unknown path {path!r}"}
+
+    # ------------------------------------------------------------ plumbing
+    def start(self, port: int = 0, host: str = "127.0.0.1") -> int:
+        router = self
+        self._stop.clear()
+        self._probe_cycle()  # workers registered+probed before first request
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code: int, headers: Dict[str, str],
+                      body: bytes):
+                self.send_response(code)
+                for k, v in headers.items():
+                    self.send_header(k, str(v))
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    text = router.metrics.render_prometheus(
+                        router.workers()).encode()
+                    self._send(200, {"Content-Type":
+                                     "text/plain; version=0.0.4"}, text)
+                    return
+                code, obj = router._handle_get(self.path)
+                self._send(code, {"Content-Type": "application/json"},
+                           json.dumps(obj).encode())
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length)
+                if (self.path.startswith("/v1/models/")
+                        and self.path.endswith("/predict")):
+                    name = self.path[len("/v1/models/"):-len("/predict")]
+                    code, headers, data = router._route_predict(
+                        name, raw, self.headers)
+                else:
+                    code, headers, data = 404, {
+                        "Content-Type": "application/json"}, json.dumps(
+                        {"error": f"unknown path {self.path!r}"}).encode()
+                self._send(code, headers, data)
+
+            def log_message(self, *a):
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="FleetRouter")
+        self._thread.start()
+        self._prober = threading.Thread(target=self._probe_loop,
+                                        daemon=True,
+                                        name="FleetRouter-probe")
+        self._prober.start()
+        from deeplearning4j_tpu.runtime import profiler
+        profiler.attach_router(self.metrics)
+        return self.port
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd = None
+        if self._prober:
+            self._prober.join(timeout=5.0)
+            self._prober = None

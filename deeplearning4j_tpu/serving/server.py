@@ -27,17 +27,40 @@ Admission-control semantics map onto status codes: ``503`` for
 disambiguates), ``504`` for ``DeadlineExceeded``, ``404`` unknown model,
 ``400`` malformed body. Every response is explicit; nothing queues
 unboundedly behind the socket.
+
+Fleet-tier contract (ISSUE 7, ``docs/fleet_serving.md``) — the headers a
+:class:`~deeplearning4j_tpu.serving.router.FleetRouter` in front of this
+worker relies on:
+
+- ``X-Deadline-Ms`` (request): the caller's REMAINING deadline budget.
+  Honored as an upper bound on the body's ``timeout_ms``, so a hedged or
+  failed-over retry arriving late in a request's life never gets a fresh
+  full deadline (deadlines used to be process-local only).
+- ``Retry-After`` / ``Retry-After-Ms`` (503 ``Overloaded`` response): the
+  shedding worker's queue-depth-derived drain estimate
+  (:meth:`~deeplearning4j_tpu.serving.admission.AdmissionController
+  .retry_after_ms`) — the router routes around this worker until the
+  window passes instead of hammering it.
+- ``X-Request-Id`` (both ways): echoed verbatim so duplicate hedge
+  completions are attributable; ``X-Worker-Id`` / ``X-Model-Version``
+  (response) identify who actually served.
+
+``chaos.inject("serving.worker.predict")`` fires at the top of every
+predict so a drill (or ``bench.py --fleet``'s straggler schedule) can
+slow or fail an individual worker process.
 """
 
 from __future__ import annotations
 
 import json
+import math
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.runtime import chaos
 from deeplearning4j_tpu.serving.admission import DeadlineExceeded, Overloaded
 from deeplearning4j_tpu.serving.registry import ModelRegistry
 from deeplearning4j_tpu.serving.resilience import CircuitOpen
@@ -50,47 +73,75 @@ def _to_jsonable(out):
 
 
 class ModelServer:
-    """``ModelServer(registry).start(port)`` — serve a registry over HTTP."""
+    """``ModelServer(registry).start(port)`` — serve a registry over HTTP.
 
-    def __init__(self, registry: Optional[ModelRegistry] = None):
+    ``worker_id`` names this process in a fleet (stamped on responses as
+    ``X-Worker-Id`` so the router's hedge/failover accounting and the
+    bit-identity drills can attribute every answer)."""
+
+    def __init__(self, registry: Optional[ModelRegistry] = None,
+                 worker_id: Optional[str] = None):
         self.registry = registry or ModelRegistry()
+        self.worker_id = worker_id
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.port: Optional[int] = None
 
     # ------------------------------------------------------------ handlers
-    def _handle_predict(self, name: str, raw: bytes):
+    @staticmethod
+    def _effective_timeout_ms(body_timeout_ms, header_deadline_ms):
+        """The request's deadline budget: the body's ``timeout_ms`` capped
+        by the forwarded ``X-Deadline-Ms`` remaining budget — a retry that
+        arrives with 40 ms left gets 40 ms, never a fresh full window."""
+        values = [float(v) for v in (body_timeout_ms, header_deadline_ms)
+                  if v is not None]
+        return min(values) if values else None
+
+    def _handle_predict(self, name: str, raw: bytes, headers=None):
+        """Returns ``(status, json_body, extra_headers)``."""
+        chaos.inject("serving.worker.predict")
+        hdrs = {}
         try:
             body = json.loads(raw.decode() or "{}")
             inputs = body["inputs"]
-            timeout_ms = body.get("timeout_ms")
+            timeout_ms = self._effective_timeout_ms(
+                body.get("timeout_ms"),
+                (headers or {}).get("X-Deadline-Ms"))
             if isinstance(inputs, dict):
                 x = {k: np.asarray(v) for k, v in inputs.items()}
             else:
                 x = np.asarray(inputs)  # ragged rows raise -> 400
         except Exception as e:
-            return 400, {"error": f"malformed request body: {e}"}
+            return 400, {"error": f"malformed request body: {e}"}, hdrs
         # resolve the model OUTSIDE the submit try: a KeyError raised by a
         # multi-input forward (wrong input name) must not read as 404
         try:
             served = self.registry.get(name)
         except KeyError:
             return 404, {"error": f"model {name!r} not found",
-                         "models": self.registry.names()}
+                         "models": self.registry.names()}, hdrs
         try:
             out = served.predict(x, timeout_ms=timeout_ms)
         except CircuitOpen as e:
             return 503, {"error": "unavailable", "reason": "circuit_open",
-                         "detail": str(e)}
+                         "detail": str(e)}, hdrs
         except Overloaded as e:
+            retry_ms = getattr(e, "retry_after_ms", None)
+            if retry_ms is not None:
+                # standard header is integer seconds; the -Ms twin keeps
+                # sub-second hints honest for the router
+                hdrs["Retry-After"] = str(int(math.ceil(retry_ms / 1000.0)))
+                hdrs["Retry-After-Ms"] = f"{retry_ms:.0f}"
             return 503, {"error": "overloaded", "reason": "overloaded",
-                         "detail": str(e)}
+                         "retry_after_ms": retry_ms,
+                         "detail": str(e)}, hdrs
         except DeadlineExceeded as e:
-            return 504, {"error": "deadline exceeded", "detail": str(e)}
+            return 504, {"error": "deadline exceeded", "detail": str(e)}, hdrs
         except Exception as e:
-            return 500, {"error": repr(e)}
+            return 500, {"error": repr(e)}, hdrs
+        hdrs["X-Model-Version"] = str(served.version)
         return 200, {"model": name, "version": served.version,
-                     "outputs": _to_jsonable(out)}
+                     "outputs": _to_jsonable(out)}, hdrs
 
     def _handle_get(self, path: str):
         if path == "/healthz":
@@ -150,10 +201,18 @@ class ModelServer:
         srv = self
 
         class Handler(BaseHTTPRequestHandler):
-            def _send(self, code: int, body: bytes, ctype: str):
+            def _send(self, code: int, body: bytes, ctype: str,
+                      extra=None):
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                if srv.worker_id is not None:
+                    self.send_header("X-Worker-Id", srv.worker_id)
+                rid = self.headers.get("X-Request-Id")
+                if rid:
+                    self.send_header("X-Request-Id", rid)
+                for k, v in (extra or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -171,10 +230,14 @@ class ModelServer:
                 if (self.path.startswith("/v1/models/")
                         and self.path.endswith("/predict")):
                     name = self.path[len("/v1/models/"):-len("/predict")]
-                    code, obj = srv._handle_predict(name, raw)
+                    code, obj, extra = srv._handle_predict(
+                        name, raw, headers=self.headers)
                 else:
-                    code, obj = 404, {"error": f"unknown path {self.path!r}"}
-                self._send(code, json.dumps(obj).encode(), "application/json")
+                    code, obj, extra = (404,
+                                        {"error": f"unknown path "
+                                                  f"{self.path!r}"}, {})
+                self._send(code, json.dumps(obj).encode(),
+                           "application/json", extra=extra)
 
             def log_message(self, *a):
                 pass

@@ -146,23 +146,49 @@ def _no_stray_pipeline_threads():
     assert not names, f"stray training-pipeline threads leaked: {names}"
 
 
+def _assert_no_orphaned_workers(module_name: str, kind: str,
+                                pid_fn: str = "live_worker_pids",
+                                kill_fn: str = "kill_stray_workers"):
+    """Shared process-leak check: poll ``module_name``'s pid registry
+    (``pid_fn`` / ``kill_fn``) with a grace window, kill and fail on
+    survivors. Checked only when the module was actually imported
+    (importing it here would tax every unrelated test), and stray workers
+    are killed so one leak can't cascade into every later test's
+    assertion. ``kill_fn`` must kill exactly the population ``pid_fn``
+    reports — a guard that only flags orphans must not nuke a managed
+    fixture fleet while cleaning one up."""
+    import sys as _sys
+    mod = _sys.modules.get(module_name)
+    if mod is None:
+        return
+    poll = getattr(mod, pid_fn)
+    deadline = time.monotonic() + 5.0
+    pids = poll()
+    while pids and time.monotonic() < deadline:
+        time.sleep(0.05)
+        pids = poll()
+    if pids:
+        killed = getattr(mod, kill_fn)()
+        assert False, f"orphaned {kind} worker processes leaked: {killed}"
+
+
 @pytest.fixture(autouse=True)
 def _no_orphaned_distributed_workers():
     """ISSUE 6 guard: no gloo worker subprocess launched through
-    ``train.distributed`` survives a test. Checked only when the module
-    was actually imported (importing it here would tax every unrelated
-    test), and stray workers are killed so one leak can't cascade into
-    every later test's assertion."""
+    ``train.distributed`` survives a test."""
     yield
-    import sys as _sys
-    dist = _sys.modules.get("deeplearning4j_tpu.train.distributed")
-    if dist is None:
-        return
-    deadline = time.monotonic() + 5.0
-    pids = dist.live_worker_pids()
-    while pids and time.monotonic() < deadline:
-        time.sleep(0.05)
-        pids = dist.live_worker_pids()
-    if pids:
-        killed = dist.kill_stray_workers()
-        assert False, f"orphaned distributed worker processes leaked: {killed}"
+    _assert_no_orphaned_workers("deeplearning4j_tpu.train.distributed",
+                                "distributed")
+
+
+@pytest.fixture(autouse=True)
+def _no_orphaned_fleet_workers():
+    """ISSUE 7 guard: no serving fleet worker subprocess launched through
+    ``serving.fleet`` outlives its supervisor (a module-scoped fixture
+    fleet with a RUNNING FleetSupervisor is managed, not leaked — only
+    orphans fail the test)."""
+    yield
+    _assert_no_orphaned_workers("deeplearning4j_tpu.serving.fleet",
+                                "serving fleet",
+                                pid_fn="orphaned_worker_pids",
+                                kill_fn="kill_orphaned_workers")

@@ -265,3 +265,122 @@ def test_check_tables_missing_measurement_is_warning_not_failure(tmp_path):
     assert bench.check_tables(str(md), str(extra), log=msgs.append) == 0
     assert any("bert_tf_import_samples_per_sec" in m and "WARN" in m
                for m in msgs)
+
+
+# --------------------------------------------------------------- ISSUE 7
+def _fleet_section():
+    """A self-consistent BENCH_EXTRA.json["fleet"] section."""
+    return {
+        "unhedged": {"workers": 1, "hedge": False, "requests": 320,
+                     "p50_ms": 8.6, "p99_ms": 131.8, "matches_oracle": True,
+                     "straggler_p": 0.04, "straggler_ms": 120.0},
+        "hedged": {"workers": 3, "hedge": True, "requests": 320,
+                   "p50_ms": 12.5, "p99_ms": 25.4, "matches_oracle": True,
+                   "straggler_p": 0.04, "straggler_ms": 120.0,
+                   "hedges": 40, "hedge_wins": 12, "hedges_discarded": 35},
+        "p99_speedup": 5.19,
+        "kill_drill": {"requests": 567, "errors": 0, "victim": "h0",
+                       "absorbed_attempts": 27, "supervisor_restarts": 1,
+                       "matches_oracle": True},
+        "rolling_deploy": {"requests": 2206, "errors": 0,
+                           "versions_seen": [1, 2],
+                           "on_traffic_compiles": 0, "workers": 3,
+                           "ready_s": {"h0": 1.0, "h1": 1.0, "h2": 1.0}},
+    }
+
+
+def _extra_with_fleet(fleet):
+    measured = {k: _mid(*rng) for k, rng in bench.RECORDED_RANGES.items()}
+    measured["fleet"] = fleet
+    return measured
+
+
+def test_check_tables_validates_fleet_section(tmp_path):
+    """ISSUE 7 satellite: --check-tables covers the fleet keys — a
+    self-consistent recorded section passes, and each drift class (drill
+    errors, on-traffic compiles, single-version deploy, speedup not
+    recomputable or <= 1, divergence from the oracle) fails loudly."""
+    md = tmp_path / "BASELINE.md"
+    md.write_text(_table_md(bench.RECORDED_RANGES))
+    extra = tmp_path / "BENCH_EXTRA.json"
+
+    extra.write_text(json.dumps(_extra_with_fleet(_fleet_section())))
+    assert bench.check_tables(str(md), str(extra), log=lambda *a: None) == 0
+
+    # a kill drill that saw client-visible errors must never pass
+    fleet = _fleet_section()
+    fleet["kill_drill"]["errors"] = 3
+    extra.write_text(json.dumps(_extra_with_fleet(fleet)))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("kill_drill" in m and "errors" in m for m in msgs)
+
+    # on-traffic compiles after a deploy break the manifest-prewarm claim
+    fleet = _fleet_section()
+    fleet["rolling_deploy"]["on_traffic_compiles"] = 2
+    extra.write_text(json.dumps(_extra_with_fleet(fleet)))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("on-traffic compile" in m for m in msgs)
+
+    # a deploy that only ever served one version was not zero-downtime
+    fleet = _fleet_section()
+    fleet["rolling_deploy"]["versions_seen"] = [2]
+    extra.write_text(json.dumps(_extra_with_fleet(fleet)))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("versions_seen" in m for m in msgs)
+
+    # claimed speedup not derivable from the recorded arm rows
+    fleet = _fleet_section()
+    fleet["p99_speedup"] = 99.0
+    extra.write_text(json.dumps(_extra_with_fleet(fleet)))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("p99_speedup" in m for m in msgs)
+
+    # hedging that did not beat the unhedged arm fails the recorded claim
+    fleet = _fleet_section()
+    fleet["hedged"]["p99_ms"] = 140.0
+    fleet["p99_speedup"] = round(131.8 / 140.0, 2)
+    extra.write_text(json.dumps(_extra_with_fleet(fleet)))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("did not beat" in m for m in msgs)
+
+    # divergence from the oracle must never pass
+    fleet = _fleet_section()
+    fleet["hedged"]["matches_oracle"] = False
+    extra.write_text(json.dumps(_extra_with_fleet(fleet)))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("matches_oracle" in m for m in msgs)
+
+    # missing required key
+    fleet = _fleet_section()
+    fleet.pop("kill_drill")
+    extra.write_text(json.dumps(_extra_with_fleet(fleet)))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("kill_drill" in m and "missing" in m for m in msgs)
+
+    # a malformed section is a FAIL line, not a checker crash
+    fleet = _fleet_section()
+    fleet["hedged"] = "not-a-dict"
+    extra.write_text(json.dumps(_extra_with_fleet(fleet)))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("malformed" in m for m in msgs)
+
+
+def test_check_tables_fleet_absent_is_warning(tmp_path):
+    """No --fleet run recorded yet → warn, don't fail (same contract as
+    the distributed section)."""
+    md = tmp_path / "BASELINE.md"
+    md.write_text(_table_md(bench.RECORDED_RANGES))
+    measured = {k: _mid(*rng) for k, rng in bench.RECORDED_RANGES.items()}
+    extra = tmp_path / "BENCH_EXTRA.json"
+    extra.write_text(json.dumps(measured))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 0
+    assert any("fleet" in m and "WARN" in m for m in msgs)
