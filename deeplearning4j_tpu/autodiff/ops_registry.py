@@ -2270,16 +2270,55 @@ def _fake_quant(a, vmin=-6.0, vmax=6.0, num_bits=8):
     return q * scale + vmin
 
 
+def _quant_broadcast(v, ndim: int, axis):
+    """Reshape a per-channel scale/zero-point array so it broadcasts along
+    ``axis`` of a rank-``ndim`` tensor (scalars pass through untouched)."""
+    v = jnp.asarray(v)
+    if v.ndim == 0 or axis is None:
+        return v
+    if v.ndim != 1:
+        raise ValueError(f"per-channel quantization expects a 1-D "
+                         f"scale/zero-point array, got shape {v.shape}")
+    ax = axis % ndim
+    shape = [1] * ndim
+    shape[ax] = v.shape[0]
+    return v.reshape(shape)
+
+
 @register("quantize")
-def _quantize(a, scale=1.0, zero_point=0, dtype="int8"):
+def _quantize(a, scale=1.0, zero_point=0, dtype="int8", axis=None,
+              narrow_range=False):
+    """Affine quantization ``q = clip(round(a / scale) + zero_point)``.
+
+    Serving-grade semantics (ISSUE 8): ``scale``/``zero_point`` may be
+    per-channel 1-D arrays broadcast along ``axis`` (e.g. per-output-channel
+    int8 weights with ``axis=-1``); ``zero_point=0`` everywhere is the
+    symmetric scheme, a nonzero/array ``zero_point`` the asymmetric one;
+    ``narrow_range`` drops the most negative code (``[-127, 127]`` for
+    int8) so symmetric int8 stays sign-symmetric. f64 inputs are accepted
+    (rounding happens in the input's own floating dtype before the integer
+    cast, under whatever precision jax canonicalizes to)."""
+    a = jnp.asarray(a)
+    if not jnp.issubdtype(a.dtype, jnp.floating):
+        a = a.astype(jnp.float32)
+    scale = _quant_broadcast(jnp.asarray(scale, a.dtype), a.ndim, axis)
+    zp = _quant_broadcast(jnp.asarray(zero_point), a.ndim, axis)
     info = jnp.iinfo(jnp.dtype(dtype))
-    return jnp.clip(jnp.round(a / scale) + zero_point,
-                    info.min, info.max).astype(dtype)
+    lo = info.min + 1 if narrow_range else info.min
+    return jnp.clip(jnp.round(a / scale) + zp, lo, info.max).astype(dtype)
 
 
 @register("dequantize")
-def _dequantize(q, scale=1.0, zero_point=0):
-    return (q.astype(jnp.float32) - zero_point) * scale
+def _dequantize(q, scale=1.0, zero_point=0, axis=None, dtype="float32"):
+    """Inverse affine map ``(q - zero_point) * scale`` in ``dtype``
+    (float32 default; pass ``float64`` to reconstruct f64 inputs).
+    ``scale``/``zero_point`` accept the same per-channel 1-D arrays as
+    :func:`_quantize` (broadcast along ``axis``)."""
+    q = jnp.asarray(q)
+    out_dt = jnp.dtype(dtype)
+    scale = _quant_broadcast(jnp.asarray(scale, out_dt), q.ndim, axis)
+    zp = _quant_broadcast(jnp.asarray(zero_point), q.ndim, axis)
+    return (q.astype(out_dt) - zp.astype(out_dt)) * scale
 
 
 @register("adjust_hue")

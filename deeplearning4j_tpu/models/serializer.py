@@ -82,10 +82,19 @@ class ModelSerializer:
     def restore_model(path: str, load_updater: bool = True):
         """Type-dispatching restore (reference ``ModelGuesser`` /
         ``ModelSerializer.restoreMultiLayerNetworkAndNormalizer`` family):
-        reads the archive metadata and returns the right network class."""
+        reads the archive metadata and returns the right network class.
+        Quantized archives (``quantization.json`` member, written by
+        ``serving.quantize.quantize_archive``) restore as a
+        ``QuantizedModel`` — int8 weights + dtype policy — so every load
+        path (registry, fleet workers) serves them first-class."""
         with zipfile.ZipFile(path) as zf:
+            names = zf.namelist()
             kind = (json.loads(zf.read(_META).decode()).get("model_type")
-                    if _META in zf.namelist() else None)
+                    if _META in names else None)
+            quantized = "quantization.json" in names
+        if quantized:
+            from deeplearning4j_tpu.serving.quantize import QuantizedModel
+            return QuantizedModel.restore(path)
         if kind == "ComputationGraph":
             return ModelSerializer.restore_computation_graph(path, load_updater)
         return ModelSerializer.restore_multi_layer_network(path, load_updater)

@@ -222,6 +222,50 @@ def router_stats() -> Dict[str, object]:
     return _ROUTER_METRICS.snapshot()
 
 
+_QUANT_METRICS: Dict[str, object] = {}
+
+
+def attach_quant_metrics(name: str, metrics) -> None:
+    """Register a model's :class:`~deeplearning4j_tpu.serving.metrics
+    .ServingMetrics` under its served name when it carries a serving dtype
+    policy (ISSUE 8) so profiling tooling can read the quantized-vs-f32
+    latency split without holding a registry reference. Called by
+    ``ModelRegistry.register`` for policy-carrying models; a hot-swap
+    re-attaches the replacement's metrics (newest wins per name)."""
+    _QUANT_METRICS[str(name)] = metrics
+
+
+def quant_split_stats() -> Dict[str, Dict[str, object]]:
+    """Per-model quantized-vs-f32 serving split for every attached
+    policy-carrying model: the dtype-policy label, how much traffic rode
+    the reduced-precision path, and the latency percentiles of each dtype
+    class side by side — the profiler-side view of the
+    ``serving_dtype_latency_seconds`` / ``serving_quantized_requests_total``
+    series on ``/metrics``. Empty dict when nothing quantized is being
+    served."""
+    out: Dict[str, Dict[str, object]] = {}
+    for name, m in list(_QUANT_METRICS.items()):
+        s = m.snapshot()
+        out[name] = {
+            "dtype_policy": s.get("dtype_policy"),
+            "requests_total": s.get("requests_total", 0),
+            "quantized_requests_total": s.get("quantized_requests_total", 0),
+            "quant_responses": s.get("quant_responses", 0),
+            "float_responses": s.get("float_responses", 0),
+            "latency_quant_p50_s": s.get("latency_quant_p50_s"),
+            "latency_quant_p99_s": s.get("latency_quant_p99_s"),
+            "latency_float_p50_s": s.get("latency_float_p50_s"),
+            "latency_float_p99_s": s.get("latency_float_p99_s"),
+        }
+    return out
+
+
+def detach_quant_metrics(name: str) -> None:
+    """Drop a served name's attached quantized metrics (tests and graceful
+    undeploy; absent names are a no-op)."""
+    _QUANT_METRICS.pop(str(name), None)
+
+
 def device_memory_stats() -> Dict[str, Dict[str, int]]:
     """Per-device memory stats — feeds the HBM crash report (§5.5 parity)."""
     out = {}

@@ -78,6 +78,13 @@ _EXPORTS = {
     "WorkerSpec": "fleet",
     "Replica": "replica",
     "ReplicaPool": "replica",
+    "AccuracyGate": "quantize",
+    "AccuracyGateFailed": "quantize",
+    "CalibrationError": "quantize",
+    "DtypePolicy": "quantize",
+    "QuantizedModel": "quantize",
+    "quantize_archive": "quantize",
+    "quantize_requests": "quantize",
     "CircuitBreaker": "resilience",
     "CircuitOpen": "resilience",
     "CircuitState": "resilience",

@@ -86,9 +86,10 @@ def default_buckets(max_batch_size: int) -> List[int]:
 
 class _Request:
     __slots__ = ("x", "rows", "deadline", "enqueued_at", "event",
-                 "result", "error")
+                 "result", "error", "quantized")
 
-    def __init__(self, x: ArrayOrDict, rows: int, deadline: Optional[float]):
+    def __init__(self, x: ArrayOrDict, rows: int, deadline: Optional[float],
+                 quantized: bool = False):
         self.x = x
         self.rows = rows
         self.deadline = deadline
@@ -96,6 +97,7 @@ class _Request:
         self.event = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
+        self.quantized = quantized  # policy-dtype request (ISSUE 8)
 
 
 class _InFlight:
@@ -137,10 +139,17 @@ class ContinuousBatcher:
                  metrics: Optional[ServingMetrics] = None,
                  warmup_example: Optional[ArrayOrDict] = None,
                  replicas: int = 1, pipeline_depth: int = 2,
-                 devices: Optional[Sequence] = None):
+                 devices: Optional[Sequence] = None,
+                 dtype_policy=None):
         self.model = model
         if model.train_state is None:
             model.init()
+        # per-model/per-bucket serving dtype policy (ISSUE 8): warmup
+        # pre-warms the policy's quantized (bucket, replica, dtype) pairs
+        # alongside the float ones, quantized requests are counted and
+        # latency-split in the metrics, and the policy rides the warmup
+        # manifest so a restart prewarms the quantized executables too
+        self.dtype_policy = dtype_policy
         self.max_batch_size = int(max_batch_size)
         self.batch_timeout_s = float(batch_timeout_ms) / 1000.0
         self.buckets = sorted(set(int(b) for b in
@@ -153,6 +162,8 @@ class ContinuousBatcher:
             queue_depth_fn=self._queue.qsize,
             compile_count_fn=self.compile_count,
             inflight_fn=self._pool.total_in_flight)
+        if self.dtype_policy is not None:
+            self.metrics.set_dtype_policy(self.dtype_policy.label())
         self._graph_inputs = list(getattr(model.conf, "inputs", []) or [])
         self._warmed_pairs: List[tuple] = []  # (bucket, replica, dtype)
         self._shutdown = False
@@ -199,25 +210,43 @@ class ContinuousBatcher:
         chaos.inject("serving.batcher.warmup")
         example = self._normalize(example)[0]
         self._example = self._zeros_with_rows(example, 1)
+        # the dtype policy's quantized twin of the example (None without a
+        # policy): its (bucket, replica) pairs are warmed alongside the
+        # float ones so quantized traffic never compiles on the serving
+        # path, and its pad buffers get their own dtype-keyed pools
+        qex = (self.dtype_policy.quantized_zeros(example)
+               if self.dtype_policy is not None else None)
         n = 0
         for rep in self._pool.replicas:
             for b in self.buckets:
                 self._pool.forward_blocking(
                     rep, self._zeros_with_rows(example, b))
-                self._record_warmed(b, rep.index)
+                self._record_warmed(b, rep.index, example)
                 n += 1
+            if qex is not None:
+                for b in self.dtype_policy.buckets_for(self.buckets):
+                    self._pool.forward_blocking(
+                        rep, self._zeros_with_rows(qex, b))
+                    self._record_warmed(b, rep.index, qex)
+                    n += 1
         for b in self.buckets:  # preallocate the pad buffers
             self._release_buffers(self._gather([], 0, b, template=example)[1])
+        if qex is not None:
+            for b in self.dtype_policy.buckets_for(self.buckets):
+                self._release_buffers(self._gather([], 0, b,
+                                                   template=qex)[1])
         return n
 
-    def _record_warmed(self, bucket: int, replica: int) -> None:
-        if self._example is None:
+    def _record_warmed(self, bucket: int, replica: int,
+                       example: Optional[ArrayOrDict] = None) -> None:
+        example = example if example is not None else self._example
+        if example is None:
             dt = "?"
-        elif isinstance(self._example, dict):
+        elif isinstance(example, dict):
             dt = ",".join(sorted({str(v.dtype)
-                                  for v in self._example.values()}))
+                                  for v in example.values()}))
         else:
-            dt = str(self._example.dtype)
+            dt = str(example.dtype)
         self._warmed_pairs.append((int(bucket), int(replica), dt))
 
     def warmup_manifest(self):
@@ -236,7 +265,9 @@ class ContinuousBatcher:
             replicas=self.replica_count,
             pairs=list(self._warmed_pairs),
             max_batch_size=self.max_batch_size,
-            model=type(self.model).__name__)
+            model=type(self.model).__name__,
+            policy=(self.dtype_policy.to_dict()
+                    if self.dtype_policy is not None else None))
 
     @staticmethod
     def _zeros_with_rows(x: ArrayOrDict, rows: int) -> ArrayOrDict:
@@ -299,8 +330,11 @@ class ContinuousBatcher:
             except Overloaded:
                 self.metrics.record_rejection("overload")
                 raise
-            req = _Request(xs, rows, self.admission.deadline_for(timeout_ms))
-            self.metrics.record_admitted()
+            quant = (self.dtype_policy is not None
+                     and self.dtype_policy.is_quantized_request(xs))
+            req = _Request(xs, rows, self.admission.deadline_for(timeout_ms),
+                           quantized=quant)
+            self.metrics.record_admitted(quantized=quant)
             self._queue.put(req)
         req.event.wait()
         if req.error is not None:
@@ -369,10 +403,17 @@ class ContinuousBatcher:
     def _warm_bucket(self, b: int) -> None:
         if self._example is None:
             return  # never warmed and no traffic yet: first dispatch compiles
+        qex = (self.dtype_policy.quantized_zeros(self._example)
+               if self.dtype_policy is not None
+               and b in self.dtype_policy.buckets_for([b]) else None)
         for rep in self._pool.replicas:
             self._pool.forward_blocking(rep, self._zeros_with_rows(
                 self._example, b))
             self._record_warmed(b, rep.index)
+            if qex is not None:  # minted buckets stay policy-complete
+                self._pool.forward_blocking(
+                    rep, self._zeros_with_rows(qex, b))
+                self._record_warmed(b, rep.index, qex)
 
     # ---------------------------------------------------------- pad buffers
     def _acquire_buf(self, bucket: int, name, like: np.ndarray):
@@ -563,7 +604,8 @@ class ContinuousBatcher:
                 r.result = ([o[sl] for o in out]
                             if isinstance(out, list) else out[sl])
                 ofs += r.rows
-                self.metrics.record_response(t1 - r.enqueued_at)
+                self.metrics.record_response(t1 - r.enqueued_at,
+                                             quantized=r.quantized)
         except BaseException as e:
             # fault before/at readback: execution state unknown, so the
             # buffers are dropped for GC, not pooled (an aliased buffer

@@ -54,6 +54,29 @@ def manifest_path(archive_path: str) -> str:
     return archive_path + MANIFEST_SUFFIX
 
 
+def atomic_replace(path: str, writer, prefix: str = ".tmp-",
+                   suffix: str = "") -> None:
+    """Crash-safe file write shared by the serving sidecars (warmup
+    manifests, dtype-policy sidecars, quantized archives): ``writer(tmp)``
+    fills a temp file in the target's own directory (same filesystem, so
+    the final ``os.replace`` is atomic — the discipline of
+    ``train/checkpoint.py``), then the rename lands it; any failure
+    unlinks the temp so a crash leaves either the old file or none,
+    never a torn one."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(prefix=prefix, suffix=suffix, dir=d)
+    os.close(fd)
+    try:
+        writer(tmp)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
 @dataclasses.dataclass
 class WarmupManifest:
     """Everything needed to rebuild a batcher's warm state offline.
@@ -73,13 +96,19 @@ class WarmupManifest:
     max_batch_size: int = 0  # 0 = unrecorded (fall back to max bucket)
     model: str = ""
     created_at: float = 0.0
+    #: serving dtype policy of the recording batcher (ISSUE 8) — recorded
+    #: so a restart's audit trail shows WHY int8 pairs appear in ``pairs``
+    #: (the replayed warmup itself re-derives quantized variants from the
+    #: model's own embedded policy, which stays authoritative)
+    policy: Optional[dict] = None
 
     # ------------------------------------------------------------ construct
     @staticmethod
     def from_example(example: ArrayOrDict, buckets: List[int], replicas: int,
                      pairs: List[Tuple[int, int, str]],
                      max_batch_size: int = 0,
-                     model: str = "") -> "WarmupManifest":
+                     model: str = "",
+                     policy: Optional[dict] = None) -> "WarmupManifest":
         if isinstance(example, dict):
             inputs = {str(k): {"shape_tail": list(v.shape[1:]),
                                "dtype": str(np.asarray(v).dtype)}
@@ -94,7 +123,8 @@ class WarmupManifest:
                               pairs=[(int(b), int(r), str(d))
                                      for b, r, d in pairs],
                               max_batch_size=int(max_batch_size),
-                              model=model, created_at=time.time())
+                              model=model, created_at=time.time(),
+                              policy=policy)
 
     def example(self, rows: int = 1) -> ArrayOrDict:
         """A ``rows``-row zeros warmup example matching the recorded input
@@ -110,11 +140,14 @@ class WarmupManifest:
 
     # ----------------------------------------------------------------- serde
     def to_dict(self) -> dict:
-        return {"format": _FORMAT, "model": self.model,
-                "created_at": self.created_at, "inputs": self.inputs,
-                "buckets": list(self.buckets), "replicas": self.replicas,
-                "max_batch_size": self.max_batch_size,
-                "pairs": [list(p) for p in self.pairs]}
+        d = {"format": _FORMAT, "model": self.model,
+             "created_at": self.created_at, "inputs": self.inputs,
+             "buckets": list(self.buckets), "replicas": self.replicas,
+             "max_batch_size": self.max_batch_size,
+             "pairs": [list(p) for p in self.pairs]}
+        if self.policy is not None:
+            d["policy"] = self.policy
+        return d
 
     @staticmethod
     def from_dict(d: dict) -> "WarmupManifest":
@@ -129,24 +162,17 @@ class WarmupManifest:
                    d.get("pairs", [])],
             max_batch_size=int(d.get("max_batch_size", 0)),
             model=str(d.get("model", "")),
-            created_at=float(d.get("created_at", 0.0)))
+            created_at=float(d.get("created_at", 0.0)),
+            policy=d.get("policy"))
 
     def save(self, path: str) -> None:
         """Atomic write (tmp + rename) — a crash mid-save must leave either
         the old manifest or none, never a torn one (same discipline as
         ``train/checkpoint.py``)."""
-        d = os.path.dirname(os.path.abspath(path)) or "."
-        fd, tmp = tempfile.mkstemp(prefix=".warmup-", dir=d)
-        try:
-            with os.fdopen(fd, "w") as f:
+        def write(tmp):
+            with open(tmp, "w") as f:
                 json.dump(self.to_dict(), f, indent=2)
-            os.replace(tmp, path)
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+        atomic_replace(path, write, prefix=".warmup-")
 
     @staticmethod
     def load(path: str) -> "WarmupManifest":

@@ -90,6 +90,13 @@ class ServingMetrics:
         self.rows_padded_total = 0       # post-padding rows executed
         self.request_latency = LatencyHistogram()
         self.batch_latency = LatencyHistogram()
+        # quantized-serving observability (ISSUE 8): how much traffic rides
+        # the reduced-precision path, and its latency split vs float
+        # traffic (also surfaced by runtime.profiler.quant_split_stats)
+        self.quantized_requests_total = 0
+        self.dtype_policy_label: Optional[str] = None
+        self.quant_latency = LatencyHistogram()
+        self.float_latency = LatencyHistogram()
         # pipeline observability (ISSUE 3): time from async dispatch to
         # readback completion, and which replica served each batch
         self.dispatch_latency = LatencyHistogram()
@@ -106,14 +113,25 @@ class ServingMetrics:
         self._qps_times = [0] * 60
 
     # ------------------------------------------------------------ recording
-    def record_admitted(self) -> None:
+    def record_admitted(self, quantized: bool = False) -> None:
         with self._lock:
             self.requests_total += 1
+            if quantized:
+                self.quantized_requests_total += 1
 
-    def record_response(self, latency_s: float) -> None:
+    def set_dtype_policy(self, label: str) -> None:
+        """Attach the served model's dtype-policy label (rendered as the
+        ``serving_dtype_policy`` info gauge)."""
+        with self._lock:
+            self.dtype_policy_label = str(label)
+
+    def record_response(self, latency_s: float,
+                        quantized: bool = False) -> None:
         with self._lock:
             self.responses_total += 1
             self.request_latency.observe(latency_s)
+            (self.quant_latency if quantized
+             else self.float_latency).observe(latency_s)
             now = int(time.monotonic())
             slot = now % 60
             if self._qps_times[slot] != now:
@@ -176,6 +194,8 @@ class ServingMetrics:
             self.request_latency = LatencyHistogram()
             self.batch_latency = LatencyHistogram()
             self.dispatch_latency = LatencyHistogram()
+            self.quant_latency = LatencyHistogram()
+            self.float_latency = LatencyHistogram()
             self.replica_batches = {}
             self.batches_total = 0
             self.rows_real_total = 0
@@ -220,6 +240,14 @@ class ServingMetrics:
                 "replica_batches": dict(self.replica_batches),
                 "warmup_seconds": round(self.warmup_seconds, 4),
                 "uptime_s": round(time.monotonic() - self.started_at, 3),
+                "quantized_requests_total": self.quantized_requests_total,
+                "dtype_policy": self.dtype_policy_label,
+                "latency_quant_p50_s": self.quant_latency.percentile(50),
+                "latency_quant_p99_s": self.quant_latency.percentile(99),
+                "latency_float_p50_s": self.float_latency.percentile(50),
+                "latency_float_p99_s": self.float_latency.percentile(99),
+                "quant_responses": self.quant_latency.count,
+                "float_responses": self.float_latency.count,
             }
         snap["qps_10s"] = self.qps(10)
         snap["queue_depth"] = int(self._queue_depth_fn())
@@ -262,6 +290,23 @@ class ServingMetrics:
             f'{{model="{model}",quantile="0.99"}} {s["dispatch_p99_s"]}',
             f"serving_warmup_seconds{lbl} {s['warmup_seconds']}",
         ]
+        lines.append(f"serving_quantized_requests_total{lbl} "
+                     f"{s['quantized_requests_total']}")
+        if s["dtype_policy"] is not None:
+            # info gauge: the label IS the payload, the value is always 1
+            lines.append(f'serving_dtype_policy{{model="{model}",'
+                         f'policy="{s["dtype_policy"]}"}} 1')
+            for cls, p50, p99 in (
+                    ("quantized", s["latency_quant_p50_s"],
+                     s["latency_quant_p99_s"]),
+                    ("float", s["latency_float_p50_s"],
+                     s["latency_float_p99_s"])):
+                lines.append(f'serving_dtype_latency_seconds'
+                             f'{{model="{model}",class="{cls}",'
+                             f'quantile="0.5"}} {p50}')
+                lines.append(f'serving_dtype_latency_seconds'
+                             f'{{model="{model}",class="{cls}",'
+                             f'quantile="0.99"}} {p99}')
         for idx in sorted(s["replica_batches"]):
             lines.append(
                 f'serving_replica_batches_total'

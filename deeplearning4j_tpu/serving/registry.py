@@ -82,6 +82,7 @@ class ServedModel:
         self.retry = retry or RetryPolicy()
         self.loaded_at = time.time()
         self.archive_path: Optional[str] = None  # set by ModelRegistry.load
+        self.gate_report: Optional[Dict[str, Any]] = None  # deploy_quantized
         self._draining = False
         self._started = False  # flipped by the registry after the swap
         self.batcher.metrics.attach_breaker(self.breaker)
@@ -191,6 +192,13 @@ class ModelRegistry:
         chaos.inject("serving.registry.register")
         if model.train_state is None:
             model.init()
+        # a quantized model's embedded dtype policy is authoritative: the
+        # batcher pre-warms its quantized (bucket, replica, dtype) pairs,
+        # counts its traffic, and records it on the warmup manifest
+        if "dtype_policy" not in batcher_kw:
+            pol = getattr(model, "dtype_policy", None)
+            if pol is not None:
+                batcher_kw["dtype_policy"] = pol
         with self._lock:
             prev_entry = self._models.get(name)
         if manifest is None and warmup_example is None and prev_entry is not None:
@@ -227,6 +235,15 @@ class ModelRegistry:
             served.version = int(version)
             self._models[name] = served
             served._started = True  # STARTING -> READY at the swap point
+        from deeplearning4j_tpu.runtime import profiler
+        if batcher.dtype_policy is not None:
+            # profiler surface for the quantized-vs-f32 latency split
+            profiler.attach_quant_metrics(name, served.metrics)
+        else:
+            # a plain model replacing a quantized one under the same name
+            # must not leave the old split (and its batcher, via the bound
+            # metrics callbacks) pinned on the profiler
+            profiler.detach_quant_metrics(name)
         if prev is not None:
             prev._draining = True
             try:
@@ -262,6 +279,44 @@ class ModelRegistry:
         served.archive_path = path if save_manifest else None
         if save_manifest:
             self.save_manifest(name)
+        return served
+
+    def deploy_quantized(self, name: str, path: str, eval_inputs,
+                         eval_labels=None, golden=None, gate=None,
+                         **kw) -> ServedModel:
+        """Accuracy-gated deploy of a quantized archive over the serving
+        f32 version of ``name`` (ISSUE 8, ``docs/quantization.md``).
+
+        The gate runs BEFORE the hot-swap: the quantized model is
+        evaluated on ``eval_inputs`` **through its real serving path**
+        (request rows quantized per the policy, dequantized in-graph)
+        against ``golden`` (default: the currently-serving model) using
+        the ``evaluation/`` harness, with the threshold DECLARED in the
+        archive's dtype policy (override via ``gate``). A failed gate
+        raises :class:`~deeplearning4j_tpu.serving.quantize
+        .AccuracyGateFailed` with the measured report attached and the
+        old version keeps serving untouched — combined with
+        :meth:`register`'s build/warmup rollback, a bad quantization can
+        never take traffic. On success the quantized model hot-swaps in
+        as the next version (old drains gracefully) and the gate report
+        is kept on ``served.gate_report``."""
+        from deeplearning4j_tpu.models.serializer import ModelSerializer
+        from deeplearning4j_tpu.serving.quantize import (AccuracyGate,
+                                                         QuantizedModel)
+        chaos.inject("serving.registry.deploy_quantized")
+        model = ModelSerializer.restore_model(path, load_updater=False)
+        if not isinstance(model, QuantizedModel):
+            raise ValueError(
+                f"{path!r} is not a quantized archive; use load() for "
+                f"plain archives")
+        if golden is None:
+            golden = self.get(name).model
+        gate = gate or AccuracyGate.from_policy(model.dtype_policy)
+        report = gate.check(golden, model, eval_inputs, labels=eval_labels)
+        served = self.register(name, model, **kw)
+        served.archive_path = path
+        served.gate_report = report
+        self.save_manifest(name)
         return served
 
     def save_manifest(self, name: str,
@@ -362,13 +417,17 @@ class ModelRegistry:
             # AFTER the drain: a queued oversized request may mint a bucket
             # while draining, and the manifest must record it
             self._persist_manifest(served)
+        from deeplearning4j_tpu.runtime import profiler
+        profiler.detach_quant_metrics(name)
 
     def shutdown(self, drain: bool = True) -> None:
         with self._lock:
             served = list(self._models.values())
             self._models.clear()
+        from deeplearning4j_tpu.runtime import profiler
         for s in served:
             s._draining = True
             s.batcher.shutdown(drain=drain)
             if drain:
                 self._persist_manifest(s)
+            profiler.detach_quant_metrics(s.name)

@@ -20,6 +20,14 @@ Predict request body::
     {"inputs": [[...], ...]}                       # single-input model
     {"inputs": {"in_a": [[...]], "in_b": [[...]]}} # multi-input graph
     {"inputs": ..., "timeout_ms": 50}              # per-request deadline
+    {"inputs": [[...]], "dtype": "int8"}           # wire dtype (ISSUE 8)
+
+The optional ``dtype`` field (a numpy dtype name, or a per-input-name map
+for graphs) pins the parsed arrays' dtype — JSON integers otherwise parse
+as int64, which would miss the int8 executables a quantized model's
+dtype policy pre-warmed. Clients serving a quantized model send rows
+through :func:`~deeplearning4j_tpu.serving.quantize.quantize_requests`
+and declare ``"dtype": "int8"`` (``docs/quantization.md``).
 
 Admission-control semantics map onto status codes: ``503`` for
 ``Overloaded`` (queue full — shed, retry elsewhere) and for
@@ -107,10 +115,29 @@ class ModelServer:
             timeout_ms = self._effective_timeout_ms(
                 body.get("timeout_ms"),
                 (headers or {}).get("X-Deadline-Ms"))
+            dtype = body.get("dtype")
+
+            def _dt(name):
+                if dtype is None:
+                    return None
+                if isinstance(dtype, dict):
+                    if name not in dtype:
+                        return None
+                    dt = np.dtype(dtype[name])
+                else:
+                    dt = np.dtype(dtype)
+                if dt.kind not in "biuf":
+                    # object/str/datetime dtypes would defeat the
+                    # ragged-row guard below (np.asarray(..., object)
+                    # accepts ragged input) and fail inside the model,
+                    # feeding the circuit breaker instead of returning 400
+                    raise ValueError(f"unsupported request dtype {dt!s}")
+                return dt
             if isinstance(inputs, dict):
-                x = {k: np.asarray(v) for k, v in inputs.items()}
+                x = {k: np.asarray(v, dtype=_dt(k))
+                     for k, v in inputs.items()}
             else:
-                x = np.asarray(inputs)  # ragged rows raise -> 400
+                x = np.asarray(inputs, dtype=_dt(None))  # ragged rows -> 400
         except Exception as e:
             return 400, {"error": f"malformed request body: {e}"}, hdrs
         # resolve the model OUTSIDE the submit try: a KeyError raised by a

@@ -384,3 +384,128 @@ def test_check_tables_fleet_absent_is_warning(tmp_path):
     msgs = []
     assert bench.check_tables(str(md), str(extra), log=msgs.append) == 0
     assert any("fleet" in m and "WARN" in m for m in msgs)
+
+
+# --------------------------------------------------------------- ISSUE 8
+def _quant_section():
+    """A self-consistent BENCH_EXTRA.json["quant"] section."""
+    return {
+        "f32": {"qps": 650.0, "rows_per_sec": 52000, "ok": 640,
+                "rejected": 0, "p50_ms": 12.8, "p99_ms": 25.6,
+                "request_dtype": "float32",
+                "host_bytes_per_request": 5242880,
+                "on_traffic_compiles": 0, "bit_identical": True},
+        "int8": {"qps": 1365.0, "rows_per_sec": 109200, "ok": 640,
+                 "rejected": 0, "p50_ms": 6.4, "p99_ms": 12.8,
+                 "request_dtype": "int8",
+                 "host_bytes_per_request": 1310720,
+                 "on_traffic_compiles": 0, "bit_identical": True},
+        "speedup": 2.1,
+        "bytes_ratio": 4.0,
+        "accuracy_delta": 0.027,
+        "gate_max_delta": 0.05,
+        "gate_passed": True,
+        "gate_n_examples": 256,
+    }
+
+
+def _extra_with_quant(quant):
+    measured = {k: _mid(*rng) for k, rng in bench.RECORDED_RANGES.items()}
+    measured["quant"] = quant
+    measured["quant_speedup"] = quant.get("speedup")
+    measured["quant_accuracy_delta"] = quant.get("accuracy_delta")
+    return measured
+
+
+def test_check_tables_validates_quant_section(tmp_path):
+    """ISSUE 8 satellite: --check-tables covers the quant keys — a
+    self-consistent recorded section passes, and each drift class
+    (speedup not recomputable from the arm rows, speedup below the 1.2x
+    acceptance floor, accuracy delta outside the declared gate, a failed
+    gate flag, non-bit-identical arms, on-traffic compiles, stale
+    top-level copies) fails loudly."""
+    md = tmp_path / "BASELINE.md"
+    md.write_text(_table_md(bench.RECORDED_RANGES))
+    extra = tmp_path / "BENCH_EXTRA.json"
+
+    extra.write_text(json.dumps(_extra_with_quant(_quant_section())))
+    assert bench.check_tables(str(md), str(extra), log=lambda *a: None) == 0
+
+    # claimed speedup not derivable from the recorded arm qps rows
+    quant = _quant_section()
+    quant["speedup"] = 9.9
+    ex = _extra_with_quant(quant)
+    extra.write_text(json.dumps(ex))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("quant.speedup" in m and "recomputable" not in m for m in msgs)
+
+    # a recorded run below the 1.2x floor is a recorded regression
+    quant = _quant_section()
+    quant["int8"]["qps"] = 700.0
+    quant["speedup"] = round(700.0 / 650.0, 3)
+    extra.write_text(json.dumps(_extra_with_quant(quant)))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("1.2x" in m for m in msgs)
+
+    # accuracy delta past the declared gate must never pass
+    quant = _quant_section()
+    quant["accuracy_delta"] = 0.08
+    extra.write_text(json.dumps(_extra_with_quant(quant)))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("accuracy_delta" in m and "gate" in m for m in msgs)
+
+    # ...and so must a recorded failed-gate flag
+    quant = _quant_section()
+    quant["gate_passed"] = False
+    extra.write_text(json.dumps(_extra_with_quant(quant)))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("gate_passed" in m for m in msgs)
+
+    # a non-bit-identical arm invalidates the whole comparison
+    quant = _quant_section()
+    quant["int8"]["bit_identical"] = False
+    extra.write_text(json.dumps(_extra_with_quant(quant)))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("bit_identical" in m for m in msgs)
+
+    # on-traffic compiles break the policy-prewarm claim
+    quant = _quant_section()
+    quant["int8"]["on_traffic_compiles"] = 3
+    extra.write_text(json.dumps(_extra_with_quant(quant)))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("on-traffic compile" in m for m in msgs)
+
+    # stale top-level copies are doc drift
+    ex = _extra_with_quant(_quant_section())
+    ex["quant_speedup"] = 1.5
+    extra.write_text(json.dumps(ex))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("quant_speedup" in m and "top-level" in m for m in msgs)
+
+    # a missing required key is reported, not crashed over
+    quant = _quant_section()
+    del quant["bytes_ratio"]
+    extra.write_text(json.dumps(_extra_with_quant(quant)))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("quant.bytes_ratio" in m and "missing" in m for m in msgs)
+
+
+def test_check_tables_quant_absent_is_warning(tmp_path):
+    """No --quant run recorded yet -> warn, don't fail (same contract as
+    the other optional sections)."""
+    md = tmp_path / "BASELINE.md"
+    md.write_text(_table_md(bench.RECORDED_RANGES))
+    measured = {k: _mid(*rng) for k, rng in bench.RECORDED_RANGES.items()}
+    extra = tmp_path / "BENCH_EXTRA.json"
+    extra.write_text(json.dumps(measured))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 0
+    assert any("quant" in m and "WARN" in m for m in msgs)

@@ -1,0 +1,193 @@
+"""Quantize/dequantize op hardening (ISSUE 8 satellite).
+
+The ``quantize``/``dequantize`` pair in ``autodiff/ops_registry.py`` grew
+from per-tensor scalar affine maps to serving-grade semantics: per-channel
+1-D scale/zero-point arrays broadcast along an axis, symmetric AND
+asymmetric schemes, narrow-range int8, and f64 inputs. These are the ops
+``serving/quantize.py`` builds archives with, so the round-trip property —
+``|dequantize(quantize(x)) - x| <= scale/2`` for in-range values — is the
+foundation the whole quantized serving path's accuracy story rests on.
+
+All tier-1 (pure numpy/jax on CPU, no model build).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff.ops_registry import OPS
+
+quant = OPS["quantize"]
+dequant = OPS["dequantize"]
+
+
+def _roundtrip(x, **kw):
+    dq_kw = {k: kw[k] for k in ("scale", "zero_point", "axis") if k in kw}
+    q = quant(x, **kw)
+    return np.asarray(q), np.asarray(dequant(q, **dq_kw))
+
+
+# ------------------------------------------------------------ round trip
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_roundtrip_error_bounded_per_tensor_symmetric(seed):
+    """The headline property: symmetric per-tensor int8, in-range values,
+    |roundtrip - x| <= scale/2 (+ f32 rounding slack)."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0.0, 1.0, (32, 16)).astype(np.float32)
+    amax = float(np.abs(x).max())
+    scale = amax / 127.0
+    q, back = _roundtrip(x, scale=scale, zero_point=0, narrow_range=True)
+    assert q.dtype == np.int8
+    assert np.abs(back - x).max() <= scale / 2 + 1e-6
+
+
+@pytest.mark.parametrize("seed", [0, 7])
+def test_roundtrip_error_bounded_per_channel(seed):
+    """Per-channel 1-D scale arrays along the last axis: each channel's
+    round-trip error is bounded by ITS OWN scale/2 — the reason
+    per-channel beats per-tensor for weight matrices with spread-out
+    channel magnitudes."""
+    rng = np.random.default_rng(seed)
+    # channels with wildly different magnitudes (the per-channel win case)
+    mags = np.array([0.01, 0.1, 1.0, 10.0], np.float32)
+    x = (rng.normal(0, 1, (64, 4)).astype(np.float32) * mags)
+    scale = np.abs(x).max(axis=0) / 127.0
+    q, back = _roundtrip(x, scale=scale, zero_point=0, axis=-1,
+                         narrow_range=True)
+    assert q.dtype == np.int8
+    err = np.abs(back - x)
+    for c in range(4):
+        assert err[:, c].max() <= scale[c] / 2 + 1e-5 * mags[c], \
+            f"channel {c} error {err[:, c].max()} > scale/2 {scale[c] / 2}"
+    # per-tensor at the same data would do far worse on the small channels
+    pt_scale = float(np.abs(x).max()) / 127.0
+    _, back_pt = _roundtrip(x, scale=pt_scale, zero_point=0)
+    assert err[:, 0].max() < np.abs(back_pt - x)[:, 0].max()
+
+
+def test_roundtrip_asymmetric_uint8():
+    """Asymmetric scheme: nonzero zero_point, uint8 codes, shifted-range
+    data (e.g. post-ReLU activations)."""
+    rng = np.random.default_rng(3)
+    x = rng.uniform(0.5, 4.5, (128, 8)).astype(np.float32)
+    lo, hi = float(x.min()), float(x.max())
+    scale = (hi - lo) / 255.0
+    zp = int(round(-lo / scale))
+    q, back = _roundtrip(x, scale=scale, zero_point=zp, dtype="uint8")
+    assert q.dtype == np.uint8
+    assert np.abs(back - x).max() <= scale / 2 + 1e-5
+
+
+def test_per_channel_zero_point_array():
+    """Both scale AND zero_point may be per-channel arrays (fully
+    asymmetric per-channel affine)."""
+    rng = np.random.default_rng(11)
+    offs = np.array([0.0, 2.0, -3.0], np.float32)
+    x = rng.uniform(-1, 1, (64, 3)).astype(np.float32) + offs
+    # the affine range must cover 0 so the zero point is representable
+    # (exactly what calibrate_inputs enforces for activation data)
+    lo = np.minimum(x.min(axis=0), 0.0)
+    hi = np.maximum(x.max(axis=0), 0.0)
+    scale = ((hi - lo) / 255.0).astype(np.float32)
+    zp = np.clip(np.round(-lo / scale), 0, 255).astype(np.int32)
+    q, back = _roundtrip(x, scale=scale, zero_point=zp, axis=-1,
+                         dtype="uint8")
+    assert q.dtype == np.uint8
+    assert np.abs(back - x).max() <= scale.max() / 2 + 1e-5
+
+
+# ----------------------------------------------------------- edge cases
+def test_f64_inputs_accepted():
+    """f64 inputs quantize without raising (rounded in the input's own
+    floating dtype under whatever precision jax canonicalizes to), and
+    ``dequantize(dtype='float64')`` returns a floating result bit-close to
+    the f32 path — the op must not crash on a JSON-parsed f64 request."""
+    rng = np.random.default_rng(5)
+    x64 = rng.normal(0, 1, (16, 4))
+    assert x64.dtype == np.float64
+    scale = float(np.abs(x64).max()) / 127.0
+    q = np.asarray(quant(x64, scale=scale, narrow_range=True))
+    assert q.dtype == np.int8
+    back = np.asarray(dequant(q, scale=scale, dtype="float64"))
+    assert np.issubdtype(back.dtype, np.floating)
+    assert np.abs(back - x64.astype(np.float32)).max() <= scale / 2 + 1e-6
+
+
+def test_narrow_range_never_emits_most_negative_code():
+    """narrow_range symmetric int8 stays in [-127, 127] even for values
+    far past the representable range — the most negative code -128 (which
+    has no positive twin) never appears."""
+    x = np.array([-1e9, -4.0, 0.0, 4.0, 1e9], np.float32)
+    q = np.asarray(quant(x, scale=4.0 / 127.0, narrow_range=True))
+    assert q.min() >= -127 and q.max() <= 127
+    # without narrow_range the full [-128, 127] range is used
+    q_full = np.asarray(quant(x, scale=4.0 / 127.0))
+    assert q_full.min() == -128
+
+
+def test_out_of_range_saturates():
+    """Values past the representable range clip to the code range instead
+    of wrapping — saturation, not integer overflow."""
+    x = np.array([-100.0, 100.0], np.float32)
+    q = np.asarray(quant(x, scale=1.0 / 127.0))
+    assert q[0] == -128 and q[1] == 127
+    qu = np.asarray(quant(x, scale=1.0 / 255.0, zero_point=128,
+                          dtype="uint8"))
+    assert qu[0] == 0 and qu[1] == 255
+
+
+def test_integer_input_is_cast_not_rejected():
+    """Integer inputs are accepted (cast to f32 before the affine map) —
+    matches the reference op's permissive input contract."""
+    q = np.asarray(quant(np.array([1, 2, 3], np.int32), scale=0.5))
+    assert q.dtype == np.int8
+    assert list(q) == [2, 4, 6]
+
+
+def test_bad_per_channel_scale_rank_raises():
+    """A 2-D scale array is a usage bug, not something to broadcast
+    silently into the wrong shape."""
+    x = np.zeros((4, 4), np.float32)
+    with pytest.raises(ValueError, match="per-channel"):
+        quant(x, scale=np.ones((2, 2), np.float32), axis=-1)
+
+
+def test_axis_broadcast_on_leading_axis():
+    """axis is any axis, not just the last: per-ROW scales on axis=0."""
+    rng = np.random.default_rng(9)
+    x = rng.normal(0, 1, (3, 32)).astype(np.float32) * \
+        np.array([[0.1], [1.0], [10.0]], np.float32)
+    scale = np.abs(x).max(axis=1) / 127.0
+    q, back = _roundtrip(x, scale=scale, zero_point=0, axis=0,
+                         narrow_range=True)
+    err = np.abs(back - x)
+    for r in range(3):
+        assert err[r].max() <= scale[r] / 2 + 1e-5
+
+
+# ------------------------------------------- serving weight-quant helper
+def test_quantize_weight_roundtrip_bound():
+    """The serving path's per-output-channel weight quantizer inherits the
+    op property: per-channel round-trip error <= scale/2."""
+    from deeplearning4j_tpu.serving.quantize import (dequantize_weight,
+                                                     quantize_weight)
+    rng = np.random.default_rng(21)
+    w = (rng.normal(0, 1, (64, 16)).astype(np.float32)
+         * rng.uniform(0.01, 5.0, 16).astype(np.float32))
+    q, scale = quantize_weight(w, per_channel=True)
+    assert q.dtype == np.int8 and scale.shape == (16,)
+    assert np.abs(q).max() <= 127  # narrow range
+    back = dequantize_weight(q, scale)
+    err = np.abs(back - w)
+    for c in range(16):
+        assert err[:, c].max() <= scale[c] / 2 + 1e-6
+
+
+def test_quantize_weight_per_tensor_mode():
+    from deeplearning4j_tpu.serving.quantize import (dequantize_weight,
+                                                     quantize_weight)
+    rng = np.random.default_rng(22)
+    w = rng.normal(0, 2, (8, 8)).astype(np.float32)
+    q, scale = quantize_weight(w, per_channel=False)
+    assert scale.ndim == 0
+    back = dequantize_weight(q, scale)
+    assert np.abs(back - w).max() <= float(scale) / 2 + 1e-6

@@ -1,0 +1,455 @@
+"""Quantized & mixed-precision serving (ISSUE 8 tentpole).
+
+End-to-end coverage of the post-training-quantization serving path:
+
+- offline archive quantization (per-channel int8 weights, calibrated input
+  scales, sidecar dtype-policy manifest) and first-class restore through
+  ``ModelSerializer.restore_model``;
+- quantized archive load through ``ModelRegistry`` with the dtype policy's
+  (bucket, replica, dtype) pairs pre-warmed — zero on-traffic compiles —
+  and a manifest-prewarmed RESTART that stays compile-free and
+  bit-identical;
+- per-bucket dtype policy honored under concurrent mixed f32/int8 load
+  (separate pad-buffer pools, separate AOT executables, quantized traffic
+  counted and latency-split);
+- the accuracy gate: a passing deploy hot-swaps in, a failing deploy
+  raises and provably leaves the f32 version serving (the PR 2 rollback
+  guarantee);
+- the ``serving.quantize.calibrate`` chaos point: corrupt/truncated
+  calibration data degrades to a REFUSED deploy (no archive, no policy),
+  never a silently wrong scale;
+- a fleet of workers all serving one quantized archive bit-identically
+  through the router.
+
+All tier-1 (CPU mesh, in-process workers).
+"""
+
+import json
+import os
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.models.serializer import ModelSerializer
+from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.runtime.chaos import (ChaosController, CorruptBytes,
+                                              FailNth)
+from deeplearning4j_tpu.serving import (FleetRouter, ModelRegistry,
+                                        ModelServer, StaticFleet)
+from deeplearning4j_tpu.serving.manifest import WarmupManifest
+from deeplearning4j_tpu.serving.quantize import (AccuracyGate,
+                                                 AccuracyGateFailed,
+                                                 CalibrationError,
+                                                 DtypePolicy, QuantizedModel,
+                                                 calibrate_inputs,
+                                                 policy_path,
+                                                 quantize_archive,
+                                                 quantize_requests)
+from deeplearning4j_tpu.train import Sgd
+
+RNG = np.random.default_rng(42)
+X = RNG.normal(size=(16, 8)).astype(np.float32)
+CALIB = RNG.normal(size=(64, 8)).astype(np.float32)
+BATCHER_KW = dict(max_batch_size=4, buckets=[1, 4], batch_timeout_ms=1.0,
+                  pipeline_depth=1)
+
+
+def _conf(seed=7):
+    return (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .updater(Sgd(0.1))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=4, activation="softmax"))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+
+
+@pytest.fixture(scope="module")
+def archives(tmp_path_factory):
+    """One f32 archive + its quantized twin (+ policy sidecar)."""
+    td = tmp_path_factory.mktemp("quant")
+    src, dst = str(td / "model.zip"), str(td / "model.int8.zip")
+    net = MultiLayerNetwork(_conf()).init()
+    net.save(src)
+    policy, report = quantize_archive(src, dst, CALIB)
+    return src, dst, policy, report
+
+
+def _pad_rows(x, bucket):
+    return np.concatenate(
+        [x, np.zeros((bucket - x.shape[0],) + x.shape[1:], x.dtype)], axis=0)
+
+
+# ========================================================== archive round trip
+def test_quantize_archive_restore_and_report(archives):
+    src, dst, policy, report = archives
+    # sidecar policy written and loadable
+    assert os.path.exists(policy_path(dst))
+    side = DtypePolicy.load(policy_path(dst))
+    assert side.label() == policy.label()
+    assert side.inputs.keys() == policy.inputs.keys()
+    # both dense kernels quantized, byte budget shrank
+    assert report["weights_quantized"] == 2
+    assert report["params_bytes_quantized"] < report["params_bytes_f32"]
+    # restore dispatches to QuantizedModel via the standard entry point
+    qm = ModelSerializer.restore_model(dst)
+    assert isinstance(qm, QuantizedModel)
+    # close to the f32 net on both request dtypes (NOT bit-equal — int8)
+    f32 = MultiLayerNetwork.load(src, load_updater=False)
+    ref = np.asarray(f32.output(X))
+    assert np.abs(np.asarray(qm.output(X)) - ref).max() < 0.05
+    qx = quantize_requests(X, policy)
+    assert qx.dtype == np.int8
+    assert np.abs(np.asarray(qm.output(qx)) - ref).max() < 0.05
+
+
+def test_double_quantization_refused(archives):
+    _, dst, _, _ = archives
+    with pytest.raises(ValueError, match="already a quantized archive"):
+        quantize_archive(dst, dst + ".again", CALIB)
+
+
+# ============================================== registry load + restart replay
+def test_quantized_load_and_manifest_prewarmed_restart(archives, tmp_path):
+    _, dst, policy, _ = archives
+    qx = quantize_requests(X, policy)
+    reg = ModelRegistry()
+    try:
+        served = reg.load("q", dst, warmup_example=X[:1], **BATCHER_KW)
+        assert served.batcher.dtype_policy is not None  # embedded policy won
+        warmed = served.batcher.compile_count()
+        # policy warms BOTH dtype worlds: buckets x replicas x 2
+        assert warmed == 2 * len(served.batcher.buckets) \
+            * served.batcher.replica_count
+        out_q = np.asarray(reg.predict("q", qx[:3]))
+        out_f = np.asarray(reg.predict("q", X[:3]))
+        assert served.batcher.compile_count() == warmed, \
+            "mixed f32/int8 traffic minted a compile after warmup"
+        # the manifest records the int8 pairs and the policy
+        man = served.batcher.warmup_manifest()
+        assert {"float32", "int8"} <= {p[2] for p in man.pairs}
+        assert man.policy is not None
+        assert man.policy["inputs"].keys() == policy.inputs.keys()
+    finally:
+        reg.shutdown()  # graceful: persists the manifest next to dst
+    assert WarmupManifest.load_for_archive(dst) is not None
+
+    # restart: a fresh registry replays the manifest — READY without a
+    # single on-traffic compile, bit-identical to the previous process
+    reg2 = ModelRegistry()
+    try:
+        served2 = reg2.load("q", dst)
+        ready = served2.batcher.compile_count()
+        out_q2 = np.asarray(reg2.predict("q", qx[:3]))
+        out_f2 = np.asarray(reg2.predict("q", X[:3]))
+        assert served2.batcher.compile_count() == ready, \
+            "restart minted a compile on live traffic"
+        assert np.array_equal(out_q, out_q2)
+        assert np.array_equal(out_f, out_f2)
+    finally:
+        reg2.shutdown()
+
+
+def test_per_bucket_policy_restricts_prewarm(archives):
+    """quantized_buckets=[4]: only bucket 4 is pre-warmed at int8; other
+    buckets still SERVE quantized traffic (minting on first use)."""
+    _, dst, _, _ = archives
+    qm = ModelSerializer.restore_model(dst)
+    qm.dtype_policy.quantized_buckets = [4]
+    qx = quantize_requests(X, qm.dtype_policy)
+    reg = ModelRegistry()
+    try:
+        served = reg.register("q", qm, warmup_example=X[:1], **BATCHER_KW)
+        b = served.batcher
+        warmed = b.compile_count()
+        n_buckets, n_reps = len(b.buckets), b.replica_count
+        assert warmed == (n_buckets + 1) * n_reps  # f32 all + int8 only @4
+        int8_pairs = [p for p in b._warmed_pairs if p[2] == "int8"]
+        assert {p[0] for p in int8_pairs} == {4}
+        # a bucket-4 int8 request stays compile-free...
+        np.asarray(reg.predict("q", qx[:3]))
+        assert b.compile_count() == warmed
+        # ...and a bucket-1 int8 request still serves (one minted compile)
+        np.asarray(reg.predict("q", qx[:1]))
+        assert b.compile_count() == warmed + 1
+    finally:
+        reg.shutdown()
+
+
+# ==================================================== concurrent mixed load
+def test_mixed_dtype_concurrent_load_bit_identical(archives):
+    """8 threads of interleaved f32 and int8 traffic: every response is
+    bit-identical to the model's own output at the padded bucket shape,
+    no compile is minted after warmup (per-dtype executables + per-dtype
+    pad-buffer pools), and the quantized share of traffic is counted."""
+    _, dst, policy, _ = archives
+    qm = ModelSerializer.restore_model(dst)
+    qx_all = quantize_requests(X, policy)
+    reg = ModelRegistry()
+    try:
+        served = reg.register("q", qm, warmup_example=X[:1], **BATCHER_KW)
+        b = served.batcher
+        warmed = b.compile_count()
+        # per-bucket per-dtype references through the model's own trace
+        refs = {}
+        for n in (1, 2, 3):
+            bucket = 1 if n <= 1 else 4
+            refs[("f32", n)] = np.asarray(
+                qm.output(_pad_rows(X[:n], bucket)))[:n]
+            refs[("int8", n)] = np.asarray(
+                qm.output(_pad_rows(qx_all[:n], bucket)))[:n]
+        failures = []
+
+        def client(tid):
+            rng = np.random.default_rng(tid)
+            for k in range(25):
+                n = int(rng.integers(1, 4))
+                quantized = bool((tid + k) % 2)
+                x = qx_all[:n] if quantized else X[:n]
+                out = np.asarray(reg.predict("q", x, timeout_ms=30000))
+                ref = refs[("int8" if quantized else "f32", n)]
+                if not np.array_equal(out, ref):
+                    failures.append((tid, k, quantized, n))
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not failures, f"non-bit-identical responses: {failures[:5]}"
+        assert b.compile_count() == warmed, \
+            "mixed-dtype load minted executables after warmup"
+        snap = served.metrics.snapshot()
+        assert snap["requests_total"] == 8 * 25
+        assert snap["quantized_requests_total"] == 8 * 25 // 2
+        assert snap["quant_responses"] + snap["float_responses"] \
+            == snap["responses_total"]
+        assert snap["dtype_policy"] == policy.label()
+        # the profiler surfaces the same split
+        from deeplearning4j_tpu.runtime import profiler
+        split = profiler.quant_split_stats()["q"]
+        assert split["quantized_requests_total"] == 8 * 25 // 2
+        assert split["latency_quant_p50_s"] is not None
+    finally:
+        reg.shutdown()
+
+
+# ======================================================== accuracy gate
+def test_accuracy_gate_pass_deploys_quantized(archives):
+    src, dst, _, _ = archives
+    reg = ModelRegistry()
+    try:
+        reg.load("m", src, warmup_example=X[:1], **BATCHER_KW)
+        served = reg.deploy_quantized("m", dst, eval_inputs=CALIB,
+                                      **BATCHER_KW)
+        assert served.version == 2
+        assert isinstance(served.model, QuantizedModel)
+        assert served.gate_report["passed"] is True
+        assert served.gate_report["accuracy_delta"] \
+            <= served.gate_report["max_delta"]
+        # quantized traffic now serves
+        qx = quantize_requests(X, served.model.dtype_policy)
+        np.asarray(reg.predict("m", qx[:2]))
+        assert served.metrics.snapshot()["quantized_requests_total"] == 1
+    finally:
+        reg.shutdown()
+
+
+def test_accuracy_gate_fail_leaves_f32_serving(archives):
+    """The rollback drill: a deploy that fails its gate raises BEFORE the
+    hot-swap — same version keeps serving, outputs bit-identical to
+    before, zero quantized requests ever counted."""
+    src, dst, _, _ = archives
+    reg = ModelRegistry()
+    try:
+        reg.load("m", src, warmup_example=X[:1], **BATCHER_KW)
+        before = np.asarray(reg.predict("m", X[:2]))
+        v1 = reg.get("m")
+        # a gate no quantization can clear: delta must be <= -1
+        with pytest.raises(AccuracyGateFailed) as ei:
+            reg.deploy_quantized("m", dst, eval_inputs=CALIB,
+                                 gate=AccuracyGate(max_delta=-1.0),
+                                 **BATCHER_KW)
+        assert ei.value.report["passed"] is False
+        served = reg.get("m")
+        assert served is v1 and served.version == 1, \
+            "failed gate took traffic"
+        assert not isinstance(served.model, QuantizedModel)
+        after = np.asarray(reg.predict("m", X[:2]))
+        assert np.array_equal(before, after)
+        assert served.metrics.snapshot().get(
+            "quantized_requests_total", 0) == 0
+    finally:
+        reg.shutdown()
+
+
+def test_gate_chaos_fault_also_rolls_back(archives):
+    """A fault INSIDE the gate evaluation (injected at
+    ``serving.quantize.gate``) must behave like a failed gate: raised to
+    the caller, f32 keeps serving."""
+    src, dst, _, _ = archives
+    reg = ModelRegistry()
+    try:
+        reg.load("m", src, warmup_example=X[:1], **BATCHER_KW)
+        with ChaosController(seed=5) as c:
+            c.on("serving.quantize.gate", FailNth(1))
+            with pytest.raises(Exception):
+                reg.deploy_quantized("m", dst, eval_inputs=CALIB,
+                                     **BATCHER_KW)
+        assert reg.get("m").version == 1
+        np.asarray(reg.predict("m", X[:2]))  # still serving
+    finally:
+        reg.shutdown()
+
+
+# ==================================================== calibration chaos
+def test_corrupt_calibration_refuses_deploy(archives, tmp_path):
+    """The ``serving.quantize.calibrate`` drill: flipped calibration bytes
+    fail the CRC check -> CalibrationError, and NO archive or policy is
+    left behind (refused deploy, never a silently wrong scale)."""
+    src, _, _, _ = archives
+    out = str(tmp_path / "corrupt.int8.zip")
+    with ChaosController(seed=3) as c:
+        c.on("serving.quantize.calibrate", CorruptBytes(n_bytes=4,
+                                                        mode="flip"))
+        with pytest.raises(CalibrationError, match="CRC"):
+            quantize_archive(src, out, CALIB)
+        assert any(ev[0] == "serving.quantize.calibrate" for ev in c.events)
+    assert not os.path.exists(out)
+    assert not os.path.exists(policy_path(out))
+
+
+def test_truncated_calibration_refuses_deploy(archives, tmp_path):
+    src, _, _, _ = archives
+    out = str(tmp_path / "trunc.int8.zip")
+    with ChaosController(seed=4) as c:
+        c.on("serving.quantize.calibrate", CorruptBytes(mode="truncate"))
+        with pytest.raises(CalibrationError):
+            quantize_archive(src, out, CALIB)
+    assert not os.path.exists(out)
+    assert not os.path.exists(policy_path(out))
+
+
+def test_nonfinite_and_empty_calibration_refused():
+    bad = CALIB.copy()
+    bad[3, 2] = np.nan
+    with pytest.raises(CalibrationError, match="non-finite"):
+        calibrate_inputs(bad)
+    with pytest.raises(CalibrationError, match="empty"):
+        calibrate_inputs(np.zeros((0, 8), np.float32))
+
+
+# ========================================================== fleet router
+def test_fleet_router_serves_quantized_bit_identically(archives):
+    """Three workers all loading ONE quantized archive behind the router:
+    every worker's answer for the same int8 request is bit-identical (and
+    equals a direct QuantizedModel oracle), and the routed path preserves
+    it — the fleet tier needs no changes to carry quantized models."""
+    _, dst, policy, _ = archives
+    qm_oracle = ModelSerializer.restore_model(dst)
+    qx = quantize_requests(X, policy)
+    oracle = np.asarray(qm_oracle.output(_pad_rows(qx[:2], 4)))[:2]
+
+    servers, endpoints = [], {}
+    for i in range(3):
+        reg = ModelRegistry()
+        reg.load("m", dst, warmup_example=X[:1], **BATCHER_KW)
+        srv = ModelServer(reg, worker_id=f"w{i}")
+        endpoints[f"w{i}"] = f"127.0.0.1:{srv.start(0)}"
+        servers.append(srv)
+    body = json.dumps({"inputs": qx[:2].tolist(), "dtype": "int8",
+                       "timeout_ms": 30000}).encode()
+
+    def post(address):
+        req = urllib.request.Request(
+            f"http://{address}/v1/models/m/predict", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return np.asarray(json.loads(r.read())["outputs"], np.float32)
+
+    router = FleetRouter(StaticFleet(endpoints), probe_interval_s=0.05,
+                         hedge_initial_ms=2000.0)
+    port = router.start(0)
+    try:
+        # direct to every worker: all bit-identical to the oracle
+        for wid, address in endpoints.items():
+            got = post(address)
+            assert np.array_equal(got, oracle.astype(np.float32)), \
+                f"worker {wid} diverged on the quantized request"
+        # and through the router
+        for _ in range(6):
+            got = post(f"127.0.0.1:{port}")
+            assert np.array_equal(got, oracle.astype(np.float32))
+    finally:
+        router.stop()
+        for srv in servers:
+            srv.stop(shutdown_registry=True)
+
+
+# =============================================== review-hardening regressions
+def test_plain_integer_rows_are_not_dequantized(archives):
+    """Only rows in the policy's EXACT wire dtype carry codes: a plain
+    int64/int32 feature request must pass through untouched (same result
+    as the equivalent float rows), not get the affine map applied as if
+    it were int8 codes."""
+    _, dst, policy, _ = archives
+    qm = ModelSerializer.restore_model(dst)
+    xi = RNG.integers(-3, 4, size=(4, 8))
+    for dt in (np.int64, np.int32):
+        got = np.asarray(qm.output(xi.astype(dt)))
+        want = np.asarray(qm.output(xi.astype(np.float32)))
+        assert np.array_equal(got, want), \
+            f"{np.dtype(dt)} rows were treated as quantized codes"
+
+
+def test_server_rejects_non_numeric_dtype(archives):
+    """The request ``dtype`` field is client-controlled: ``object`` (which
+    would defeat the ragged-row guard and fail inside the model, feeding
+    the breaker) and other non-numeric dtypes must be a 400, before
+    anything is queued."""
+    _, dst, _, _ = archives
+    reg = ModelRegistry()
+    try:
+        served = reg.load("m", dst, warmup_example=X[:1], **BATCHER_KW)
+        srv = ModelServer(reg)
+        for bad in ("object", "str", "datetime64[s]"):
+            code, body, _ = srv._handle_predict(
+                "m", json.dumps({"inputs": [[1.0], [1.0, 2.0]],
+                                 "dtype": bad}).encode())
+            assert code == 400, (bad, code, body)
+            assert "dtype" in body["error"]
+        assert served.breaker.snapshot()["failures_in_window"] == 0
+        assert served.metrics.snapshot()["requests_total"] == 0
+    finally:
+        reg.shutdown()
+
+
+def test_quant_metrics_detached_on_undeploy_swap_and_shutdown(archives):
+    """attach_quant_metrics must be paired with detach everywhere a
+    quantized model stops serving — undeploy, a hot-swap to a plain f32
+    model, and registry shutdown — so the profiler neither pins the dead
+    batcher nor reports a removed model as live."""
+    from deeplearning4j_tpu.runtime import profiler
+    src, dst, _, _ = archives
+    reg = ModelRegistry()
+    try:
+        reg.load("gone", dst, warmup_example=X[:1], **BATCHER_KW)
+        reg.load("swapped", dst, warmup_example=X[:1], **BATCHER_KW)
+        reg.load("stays", dst, warmup_example=X[:1], **BATCHER_KW)
+        assert {"gone", "swapped", "stays"} <= profiler.quant_split_stats().keys()
+        reg.undeploy("gone")
+        # hot-swap to a plain f32 model under the same name
+        reg.load("swapped", src, warmup_example=X[:1], **BATCHER_KW)
+        stats = profiler.quant_split_stats()
+        assert "gone" not in stats
+        assert "swapped" not in stats
+        assert "stays" in stats
+    finally:
+        reg.shutdown()
+    assert "stays" not in profiler.quant_split_stats()
