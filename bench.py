@@ -215,6 +215,11 @@ def check_tables(baseline_md=None, bench_extra=None, log=_log):
     if measured is not None:
         check_quant_section(measured, failures, warnings)
 
+    # ISSUE 9 trace keys: recomputable overhead under the 3% bound,
+    # allocation-free rate-0 path, bit-identical arms
+    if measured is not None:
+        check_trace_section(measured, failures, warnings)
+
     for w in warnings:
         log(f"[check-tables] WARN {w}")
     for fmsg in failures:
@@ -2454,6 +2459,314 @@ def check_quant_section(extra, failures, warnings):
         failures.append(f"quant: malformed section ({e!r})")
 
 
+# -------------------------------------------------------------------- trace
+def bench_trace_overhead(n_threads=16, per_thread=50, rate=0.05,
+                         bench_extra=None, log=_log):
+    """``bench.py --trace-overhead`` (ISSUE 9): order-alternated A/B of
+    the serving hot path with tracing OFF (the rate-0 no-op fast path)
+    vs tail-sampled ON (``rate=0.05`` + latency threshold — the
+    production shape). The workload is the REAL serving stack — HTTP
+    POSTs over persistent loopback connections into a ``ModelServer``
+    (span, JSON decode, admission, batcher, SLO record, JSON encode) —
+    the path a deployed client pays, and the denominator every other
+    serving section of this bench uses for "qps". Asserted before the
+    artifact is written:
+
+    - sampled tracing costs < 3% qps vs the off arm,
+    - the rate-0 path adds ZERO per-request allocations attributable to
+      ``trace.py`` (tracemalloc over a dispatch-shaped hot loop),
+    - every response in BOTH arms is bit-identical to an
+      identically-seeded reference model at a bucket that could have
+      served it.
+
+    The raw per-request span cost (root + 2 stage children + 10
+    annotations, measured in-process where nothing masks it) is recorded
+    informationally as ``span_cost_us``. Results ->
+    ``BENCH_EXTRA.json["trace"]`` + top-level ``trace_overhead_pct``
+    (validated by ``--check-tables``)."""
+    import http.client
+    import threading
+    import tracemalloc
+
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.runtime import trace
+    from deeplearning4j_tpu.serving import ModelRegistry, ModelServer
+
+    def conf(s=7):
+        # deliberately small: a fast model keeps the python serving path
+        # (the part tracing can slow down) a large fraction of each
+        # request, so the 3% bound is tested in its hardest regime
+        return (NeuralNetConfiguration.builder().seed(s).updater(None)
+                .list()
+                .layer(DenseLayer(n_out=256, activation="relu"))
+                .layer(OutputLayer(n_out=8, activation="softmax"))
+                .set_input_type(InputType.feed_forward(64)).build())
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (64, 64)).astype(np.float32)
+    ref = MultiLayerNetwork(conf()).init()
+    total = n_threads * per_thread
+    sizes = [1 + (k % 4) for k in range(total)]
+    offsets = [(k * 7) % 32 for k in range(total)]
+    bodies = [json.dumps({"inputs": x[o:o + n].tolist(),
+                          "timeout_ms": 60_000}).encode()
+              for o, n in zip(offsets, sizes)]
+
+    failures = []
+
+    # ---- rate-0 allocation probe: the no-op fast path must not allocate
+    # per call (one-time interpreter specialization is not per-request)
+    trace.disable()
+
+    def hot_loop():
+        for _ in range(500):
+            with trace.span("batcher.dispatch") as sp:
+                sp.set("bucket", 4)
+                sp.event("x")
+            trace.annotate_current("aot", "hit")
+            trace.stage_event("encode", 0.01)
+
+    hot_loop()
+    tracemalloc.start()
+    hot_loop()
+    before = tracemalloc.take_snapshot()
+    hot_loop()
+    after = tracemalloc.take_snapshot()
+    tracemalloc.stop()
+    rate0_allocs = sum(
+        1 for st in after.compare_to(before, "lineno")
+        if st.size_diff > 0 and st.count_diff >= 100 and st.traceback
+        and any(fr.filename == trace.__file__ for fr in st.traceback))
+    if rate0_allocs:
+        failures.append(f"rate-0 path: {rate0_allocs} per-call "
+                        f"allocation site(s) attributed to trace.py")
+
+    # ---- raw span machinery cost, in-process (informational: the cost a
+    # traced request pays before amortization over the serving stack)
+    trace.enable(rate=rate, latency_threshold_ms=250.0, seed=11,
+                 capacity=256)
+    n_micro = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n_micro):
+        with trace.server_span("worker.predict") as sp:
+            sp.set("model", "m")
+            with sp.child("batcher.dispatch") as d:
+                d.set("bucket", 4)
+                d.set("rows", 2)
+                d.set("requests", 1)
+                d.set("replica", 0)
+            with sp.child("batcher.complete") as c:
+                c.set("bucket", 4)
+                c.set("replica", 0)
+                c.set("rows", 2)
+            sp.set("status", 200)
+    span_cost_us = round((time.perf_counter() - t0) / n_micro * 1e6, 2)
+    trace.disable()
+    trace.collector().clear()
+
+    reg = ModelRegistry()
+    reg.register("m", MultiLayerNetwork(conf()).init(),
+                 warmup_example=x[:1], max_batch_size=32,
+                 batch_timeout_ms=1.0, queue_limit=4096)
+    srv = ModelServer(reg, worker_id="bench-trace")
+    port = srv.start(0)
+    served = reg.get("m")
+    buckets = list(served.batcher.buckets)
+
+    def run_load():
+        outcomes = []
+        lock = threading.Lock()
+
+        def client(i):
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+            try:
+                for j in range(per_thread):
+                    k = i * per_thread + j
+                    conn.request("POST", "/v1/models/m/predict", bodies[k],
+                                 {"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    data = resp.read()  # drain: keep-alive reuse
+                    out = (json.loads(data).get("outputs")
+                           if resp.status == 200 else None)
+                    with lock:
+                        outcomes.append((k, resp.status, out))
+            finally:
+                conn.close()
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n_threads)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        elapsed = time.monotonic() - t0
+        hung = sum(t.is_alive() for t in threads)
+        return outcomes, elapsed, hung
+
+    def arm_on():
+        trace.enable(rate=rate, latency_threshold_ms=250.0, seed=11,
+                     capacity=256)
+
+    arm_fns = {"off": trace.disable, "sampled": arm_on}
+    # warm every bucket + the python path once per distinct size
+    for n in (1, 2, 3, 4):
+        srv._handle_predict("m", bodies[sizes.index(n)])
+
+    best = {}
+    all_ok = {tag: [] for tag in arm_fns}
+    try:
+        # order-alternated pairs (the ab_speedup lesson: the box drifts
+        # between regimes on a minutes scale — back-to-back pairs see the
+        # same regime, per-arm best-of discards the noisy windows; three
+        # pairs because loopback-HTTP round variance is a few percent,
+        # the same order as the 3% bound under test)
+        for pair in (("off", "sampled"), ("sampled", "off"),
+                     ("off", "sampled")):
+            for tag in pair:
+                arm_fns[tag]()
+                wait_for_quiet_host()
+                outcomes, elapsed, hung = run_load()
+                ok = [(k, out) for k, s, out in outcomes if s == 200]
+                all_ok[tag].extend(ok)
+                if hung or len(ok) != total:
+                    failures.append(
+                        f"{tag}: {hung} hung clients, {len(ok)}/{total} ok")
+                if tag not in best or elapsed < best[tag][0]:
+                    best[tag] = (elapsed, len(ok))
+        kept, dropped = trace.collector().kept, trace.collector().dropped
+    finally:
+        trace.disable()
+        trace.collector().clear()
+        srv.stop(shutdown_registry=True)
+
+    # bit-identity of EVERY ok response from every round: the JSON round
+    # trip is exact for float32, so equality against the reference at a
+    # feasible bucket is bitwise
+    ref_cache = {}
+
+    def ref_at(ofs, n, bk):
+        key = (ofs, n, bk)
+        if key not in ref_cache:
+            padded = np.concatenate(
+                [x[ofs:ofs + n],
+                 np.zeros((bk - n,) + x.shape[1:], x.dtype)], axis=0)
+            ref_cache[key] = np.asarray(ref.output(padded))[:n]
+        return ref_cache[key]
+
+    results = {}
+    for tag in arm_fns:
+        wrong = 0
+        for k, out in all_ok[tag]:
+            got = np.asarray(out, np.float32)
+            ofs, n = offsets[k], sizes[k]
+            if not any((got == ref_at(ofs, n, bk)).all()
+                       for bk in buckets if bk >= n):
+                wrong += 1
+        if wrong:
+            failures.append(f"{tag}: {wrong} responses not bit-identical "
+                            f"to the reference")
+        elapsed, n_ok = best[tag]
+        results[tag] = {"qps": round(n_ok / elapsed, 1),
+                        "elapsed_s": round(elapsed, 3), "ok": n_ok,
+                        "bit_identical": wrong == 0}
+        log(f"[trace] {tag}: {results[tag]['qps']} req/s "
+            f"({n_ok}/{total} ok, best of 3 rounds)")
+
+    off_qps = results["off"]["qps"]
+    on_qps = results["sampled"]["qps"]
+    overhead = round((1.0 - on_qps / max(off_qps, 1e-9)) * 100.0, 2)
+    results.update({
+        "overhead_pct": overhead, "sample_rate": rate,
+        "rate0_per_call_allocations": rate0_allocs,
+        "span_cost_us": span_cost_us,
+        "kept_traces": kept, "dropped_traces": dropped,
+    })
+    if overhead >= 3.0:
+        failures.append(f"sampled tracing costs {overhead}% qps "
+                        f"(bound: < 3%)")
+    if kept + dropped <= 0:
+        failures.append("sampled arm completed no traces — the on arm "
+                        "was not actually tracing")
+
+    for fmsg in failures:
+        log(f"[trace] FAIL {fmsg}")
+    if failures:
+        return 1  # a failing run cannot write the artifact
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    bench_extra = bench_extra or os.path.join(here, "BENCH_EXTRA.json")
+    try:
+        with open(bench_extra) as f:
+            extra = json.load(f)
+    except Exception:
+        extra = {}
+    extra["trace"] = results
+    extra["trace_overhead_pct"] = overhead
+    with open(bench_extra, "w") as f:
+        json.dump(extra, f, indent=2)
+    log(f"[trace] OK: sampled overhead {overhead}% (off {off_qps} vs "
+        f"sampled {on_qps} req/s), rate-0 allocation-free, "
+        f"{kept}/{kept + dropped} traces kept, all responses exact")
+    return 0
+
+
+def check_trace_section(extra, failures, warnings):
+    """--check-tables coverage for the ISSUE 9 keys: the ``trace``
+    section (when present) must carry both arms, the claimed overhead
+    must be recomputable from the recorded qps rows AND sit under the 3%
+    acceptance bound, the rate-0 path must have recorded zero per-call
+    allocations, both arms must have been bit-identical, the sampled arm
+    must actually have traced, and the top-level copy must agree."""
+    if "trace" not in extra:
+        warnings.append("trace: not present in BENCH_EXTRA.json "
+                        "(bench --trace-overhead not run?)")
+        return
+    d = extra["trace"]
+    required = ["off", "sampled", "overhead_pct",
+                "rate0_per_call_allocations", "kept_traces",
+                "dropped_traces"]
+    for k in required:
+        if k not in d:
+            failures.append(f"trace.{k}: missing from the recorded section")
+    if any(k not in d for k in required):
+        return
+    try:
+        for arm in ("off", "sampled"):
+            if d[arm].get("bit_identical") is not True:
+                failures.append(
+                    f"trace.{arm}: bit_identical is "
+                    f"{d[arm].get('bit_identical')!r} — the recorded run "
+                    f"was not bit-identical to its reference")
+        oh = (1.0 - d["sampled"]["qps"] / max(1e-9, d["off"]["qps"])) * 100
+        if abs(oh - d["overhead_pct"]) > max(0.05, 0.02 * abs(oh)):
+            failures.append(
+                f"trace.overhead_pct: claims {d['overhead_pct']}, "
+                f"recorded arm qps rows give {oh:.2f}")
+        if d["overhead_pct"] >= 3.0:
+            failures.append(
+                f"trace.overhead_pct: {d['overhead_pct']}% — the recorded "
+                f"run is over the 3% acceptance bound")
+        if d["rate0_per_call_allocations"] != 0:
+            failures.append(
+                f"trace.rate0_per_call_allocations: "
+                f"{d['rate0_per_call_allocations']!r} — the rate-0 fast "
+                f"path allocated per call (must be 0)")
+        if d["kept_traces"] + d["dropped_traces"] <= 0:
+            failures.append(
+                "trace: kept_traces + dropped_traces is 0 — the sampled "
+                "arm was not actually tracing")
+        if extra.get("trace_overhead_pct") != d["overhead_pct"]:
+            failures.append(
+                f"trace_overhead_pct: top-level copy "
+                f"{extra.get('trace_overhead_pct')} != trace section "
+                f"{d['overhead_pct']}")
+    except (TypeError, ValueError, AttributeError, KeyError) as e:
+        failures.append(f"trace: malformed section ({e!r})")
+
+
 # ------------------------------------------------------------------- resnet
 def bench_resnet():
     import jax
@@ -2857,6 +3170,8 @@ if __name__ == "__main__":
         sys.exit(bench_fleet())
     if "--quant" in sys.argv:
         sys.exit(bench_quant())
+    if "--trace-overhead" in sys.argv:
+        sys.exit(bench_trace_overhead())
     if "--serving" in sys.argv:
         # give the CPU backend multiple virtual devices so the replica arm
         # is real even off-TPU (flag only affects the host platform; must

@@ -27,9 +27,16 @@ from deeplearning4j_tpu.runtime.mesh import (
     local_mesh,
 )
 from deeplearning4j_tpu.runtime.rng import RngManager, get_default_rng, set_default_seed
-from deeplearning4j_tpu.runtime.profiler import OpProfiler, ProfilerConfig, trace
+from deeplearning4j_tpu.runtime.profiler import OpProfiler, ProfilerConfig
+# the jax device-trace context manager keeps its old spelling as
+# runtime.profiler.trace; the package-level name `trace` now names the
+# distributed-tracing module (ISSUE 9), re-exported here as device_trace
+from deeplearning4j_tpu.runtime.profiler import trace as device_trace
+from deeplearning4j_tpu.runtime import trace
 
 __all__ = [
+    "trace",
+    "device_trace",
     "chaos",
     "ChaosController",
     "ChaosError",
@@ -52,5 +59,4 @@ __all__ = [
     "set_default_seed",
     "OpProfiler",
     "ProfilerConfig",
-    "trace",
 ]

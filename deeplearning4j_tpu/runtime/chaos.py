@@ -80,6 +80,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from deeplearning4j_tpu.runtime import trace
+
 logger = logging.getLogger(__name__)
 
 
@@ -303,10 +305,15 @@ class ChaosController:
                 action = policy.apply(name, index, rng, self)
             except BaseException as e:
                 self._record(name, index, policy, f"raise:{type(e).__name__}")
+                # stamp the injected fault onto the active trace span
+                # (ISSUE 9): every fault drill is traceable after the
+                # fact, and tail sampling always keeps the trace
+                trace.stamp_chaos(name, f"raise:{type(e).__name__}")
                 logger.info("chaos: %s #%d -> %s", name, index, e)
                 raise
             if action is not None:
                 self._record(name, index, policy, action)
+                trace.stamp_chaos(name, action)
 
     def transform(self, name: str, data: bytes) -> bytes:
         rules = self._matching(name)
@@ -317,6 +324,7 @@ class ChaosController:
             out, action = policy.transform(name, index, rng, data)
             if action is not None:
                 self._record(name, index, policy, action)
+                trace.stamp_chaos(name, action)
                 logger.info("chaos: %s #%d -> %s", name, index, action)
                 data = out
         return data

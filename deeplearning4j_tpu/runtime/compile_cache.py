@@ -51,7 +51,7 @@ from typing import Any, Dict, Hashable, Optional
 
 import jax
 
-from deeplearning4j_tpu.runtime import chaos
+from deeplearning4j_tpu.runtime import chaos, trace
 
 logger = logging.getLogger(__name__)
 
@@ -270,6 +270,9 @@ class AotCache:
         if not aot_enabled():
             return jitted(*args)
         entry = self._entries.get(key)
+        # the dispatching span (batcher dispatch stage, fit-loop step)
+        # gets the executable-cache outcome stamped on it (ISSUE 9)
+        trace.annotate_current("aot", "hit" if entry is not None else "miss")
         if entry is None:
             t0 = time.perf_counter()
             entry = jitted.lower(*args).compile()

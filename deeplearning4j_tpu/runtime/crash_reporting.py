@@ -28,11 +28,16 @@ class CrashReportingUtil:
     @staticmethod
     def memory_report(model=None, error: Optional[BaseException] = None) -> str:
         import jax
+
+        from deeplearning4j_tpu.runtime import trace
         lines = ["===== deeplearning4j_tpu memory / crash report =====",
                  f"time: {datetime.datetime.now().isoformat()}",
                  f"python: {sys.version.split()[0]}  platform: {platform.platform()}",
                  f"jax: {jax.__version__}  backend: {jax.devices()[0].platform}",
-                 f"devices: {[str(d) for d in jax.devices()]}"]
+                 f"devices: {[str(d) for d in jax.devices()]}",
+                 # the active trace id (ISSUE 9): a crash report joins the
+                 # flight recorder's trace of the request/step that died
+                 f"trace: {trace.current_trace_id() or '-'}"]
         if error is not None:
             lines += ["", "---- error ----", repr(error)]
         lines += ["", "---- device memory ----"]

@@ -20,6 +20,11 @@ from typing import Dict, Iterator, Optional
 
 import jax
 
+# aliased: this module's own `trace` is the jax device-trace context
+# manager; the distributed-tracing module must not shadow (or be
+# shadowed by) it
+from deeplearning4j_tpu.runtime import trace as _dtrace
+
 
 @dataclasses.dataclass
 class ProfilerConfig:
@@ -131,6 +136,7 @@ class ExchangeStats:
         self._steps = 0
 
     def record(self, stage: str, seconds: float) -> None:
+        _dtrace.stage_event(stage, seconds)  # onto the active train.step span
         with self._lock:
             self._totals[stage] += seconds
             self._counts[stage] += 1

@@ -1,0 +1,596 @@
+"""End-to-end distributed tracing: the flight recorder for the fleet and
+the trainer (ISSUE 9 tentpole; ``docs/observability.md``).
+
+The stack spans processes — ``FleetRouter`` -> supervised ``ModelServer``
+workers -> batcher pipeline -> ``ReplicaPool``, and ``DistributedTrainer``
+ranks — but until this module every observability surface was per-process
+and per-subsystem (``/metrics`` histograms, profiler sections,
+``ExchangeStats``). Nothing correlated ONE request (or one training step)
+across those boundaries. This is the Dapper-shaped answer, and the analog
+of the reference DL4J's ``StatsListener`` -> UI-server pipeline
+(``docs/parity.md``): every unit of work is a :class:`Span` in a trace
+tree, propagated
+
+- **in-process** via a ``contextvars`` context (``span()`` parents to the
+  caller's active span) and explicitly across the batcher's worker
+  threads (a request's span rides its ``_Request``; batch stage spans
+  parent to the first traced request of the batch), and
+- **cross-process** via the ``X-Trace-Id`` / ``X-Parent-Span-Id`` HTTP
+  headers, piggybacking the fleet tier's existing ``X-Request-Id`` /
+  ``X-Deadline-Ms`` plumbing — the router's attempt span id becomes the
+  worker's root span parent, so router-side aggregation
+  (``FleetRouter /v1/traces``) can merge worker spans into one tree.
+
+Design constraints (the serving hot path calls into this unconditionally):
+
+- **Disabled = no-op fast path, zero allocations.** With tracing off (the
+  default; ``enable()`` never called, or rate 0 via ``DL4J_TPU_TRACE``),
+  ``span(name)`` is one module-global load, an ``is None`` test, and the
+  return of a shared singleton no-op span — nothing allocates, nothing
+  locks, and ``current_span()`` is ``None`` (``bench.py
+  --trace-overhead`` asserts this path is allocation-free and
+  bit-identical).
+- **Tail-based sampling.** While enabled, every request is *recorded*;
+  the keep/drop decision happens when the trace completes (root span and
+  every late child — e.g. a hedge loser — finished): a trace that was
+  flagged (``shed``, ``fault``, ``hedged``, ``deadline``, ``chaos``,
+  ``slow``) is ALWAYS kept; a healthy trace is kept with probability
+  ``rate`` (so ``enable(rate=0.0)`` keeps exactly the interesting
+  traces). This is what makes a post-hoc fault-drill investigation
+  possible without paying for healthy traffic.
+- **Bounded memory.** Kept traces land in a fixed-capacity lock-free
+  ring buffer (:class:`TraceCollector`) — one slot store per trace, old
+  traces overwritten, no growth under sustained load.
+- **Monotonic timing.** Span durations come from ``time.monotonic()``;
+  a wall-clock anchor per span start orders spans across processes
+  (same-host skew is microseconds — the fleet topology this serves).
+
+Export: :func:`to_chrome_trace` renders trace records as Chrome
+trace-event JSON (``chrome://tracing`` / Perfetto's legacy loader —
+``ph: "X"`` complete events per span, ``ph: "i"`` instants per chaos
+stamp); :func:`merge_traces` merges multi-process records by trace id
+(span-id deduplicated); :func:`span_tree` rebuilds the parent/child tree.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import json
+import os
+import random
+import sys
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional
+
+__all__ = [
+    "Span", "TraceCollector", "TraceConfig", "enable", "disable", "enabled",
+    "span", "server_span", "current_span", "current_trace_id", "collector",
+    "flag_current", "annotate_current", "stamp_chaos", "stage_event",
+    "merge_traces", "span_tree", "to_chrome_trace", "set_process_tag",
+    "access_log_enabled", "emit_access_log", "NOOP",
+]
+
+_CURRENT: "contextvars.ContextVar[Optional[Span]]" = \
+    contextvars.ContextVar("dl4j_tpu_trace_span", default=None)
+
+_ids = itertools.count(1)
+# per-process random base: ids are collision-free within a process by the
+# counter and across processes by the base; formatting one small counter
+# is several times cheaper than drawing fresh random bits per span (this
+# runs on the serving hot path for every recorded span)
+_ID_BASE = f"{random.getrandbits(48):012x}"
+
+
+def _new_id() -> str:
+    """Process-unique span/trace id (no uuid machinery on the recording
+    path)."""
+    return _ID_BASE + format(next(_ids), "08x")
+
+
+# ---------------------------------------------------------------- collector
+class TraceCollector:
+    """Bounded lock-free ring buffer of kept trace records.
+
+    ``record`` is a single slot store (the index comes from an
+    ``itertools.count``, atomic under the GIL) — no lock on the keep path;
+    a full ring overwrites the oldest trace. Readers snapshot the slots.
+    """
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = max(1, int(capacity))
+        # each slot holds (insertion seq, record) or None
+        self._slots: List[Optional[tuple]] = [None] * self.capacity
+        self._n = itertools.count()
+        # kept/dropped are per-TRACE (not per-span) counters; a plain
+        # `+= 1` from concurrent finalizing threads loses updates, so
+        # they take a (rarely contended) lock — the slot store itself
+        # stays lock-free via the atomic counter
+        self._count_lock = threading.Lock()
+        self.kept = 0        # traces stored (monotonic; ring may overwrite)
+        self.dropped = 0     # completed traces the sampler discarded
+
+    def record(self, rec: Dict[str, Any]) -> None:
+        n = next(self._n)
+        self._slots[n % self.capacity] = (n, rec)
+        with self._count_lock:
+            self.kept += 1
+
+    def record_dropped(self) -> None:
+        with self._count_lock:
+            self.dropped += 1
+
+    def traces(self) -> List[Dict[str, Any]]:
+        """Recent kept traces, oldest first (at most ``capacity``). Slots
+        carry their insertion sequence so order survives ring wraparound
+        (the read path is not hot; sorting <= capacity entries is fine)."""
+        entries = [e for e in list(self._slots) if e is not None]
+        entries.sort(key=lambda e: e[0])
+        return [rec for _, rec in entries]
+
+    def find(self, trace_id: str) -> Optional[Dict[str, Any]]:
+        for rec in reversed(self.traces()):
+            if rec.get("trace_id") == trace_id:
+                return rec
+        return None
+
+    def clear(self) -> None:
+        self._slots = [None] * self.capacity
+
+
+# ------------------------------------------------------------------- config
+class TraceConfig:
+    """Sampling policy: ``rate`` is the probability of keeping a HEALTHY
+    completed trace; flagged traces (shed/fault/hedged/deadline/chaos/slow)
+    are always kept. ``latency_threshold_ms`` flags any trace whose root
+    span exceeds it (``slow``). ``seed`` makes the probabilistic decision
+    replayable in tests."""
+
+    __slots__ = ("rate", "latency_threshold_ms", "_rng", "_rng_lock")
+
+    def __init__(self, rate: float = 0.0,
+                 latency_threshold_ms: Optional[float] = None,
+                 seed: Optional[int] = None):
+        if not 0.0 <= float(rate) <= 1.0:
+            raise ValueError(f"rate must be in [0, 1], got {rate}")
+        self.rate = float(rate)
+        self.latency_threshold_ms = (None if latency_threshold_ms is None
+                                     else float(latency_threshold_ms))
+        self._rng = random.Random(seed)
+        self._rng_lock = threading.Lock()
+
+    def keep(self, flagged: bool) -> bool:
+        if flagged:
+            return True
+        if self.rate <= 0.0:
+            return False
+        if self.rate >= 1.0:
+            return True
+        with self._rng_lock:
+            return self._rng.random() < self.rate
+
+
+_CONFIG: Optional[TraceConfig] = None
+_COLLECTOR = TraceCollector()
+_PROCESS_TAG = f"pid-{os.getpid()}"
+
+
+def set_process_tag(tag: str) -> None:
+    """Name this process in exported traces (``ModelServer`` sets its
+    ``worker_id``; defaults to ``pid-<n>``)."""
+    global _PROCESS_TAG
+    _PROCESS_TAG = str(tag)
+
+
+def enable(rate: float = 0.0, latency_threshold_ms: Optional[float] = None,
+           capacity: Optional[int] = None,
+           seed: Optional[int] = None) -> TraceConfig:
+    """Turn tracing on with the given tail-sampling policy. ``capacity``
+    (when given) replaces the process collector with a fresh ring of that
+    size. Returns the installed config."""
+    global _CONFIG, _COLLECTOR
+    if capacity is not None:
+        _COLLECTOR = TraceCollector(capacity)
+    _CONFIG = TraceConfig(rate, latency_threshold_ms, seed)
+    return _CONFIG
+
+
+def disable() -> None:
+    """Back to the no-op fast path (in-flight traces finish un-kept)."""
+    global _CONFIG
+    _CONFIG = None
+
+
+def enabled() -> bool:
+    return _CONFIG is not None
+
+
+def collector() -> TraceCollector:
+    return _COLLECTOR
+
+
+# -------------------------------------------------------------- trace state
+class _TraceState:
+    """Per-trace accumulation shared by every span of one trace in one
+    process: the span buffer, the flag set, and the open-span count that
+    defers the tail-sampling decision until the LAST span (e.g. a hedge
+    loser completing after the root) has finished."""
+
+    __slots__ = ("trace_id", "spans", "flags", "open", "root_done", "lock")
+
+    def __init__(self, trace_id: str):
+        self.trace_id = trace_id
+        self.spans: List[Dict[str, Any]] = []
+        self.flags: set = set()
+        self.open = 0
+        self.root_done = False
+        self.lock = threading.Lock()
+
+    def span_started(self) -> None:
+        with self.lock:
+            self.open += 1
+
+    def span_finished(self, span: "Span") -> None:
+        """Buffer the finished Span OBJECT — serialization to dicts is
+        deferred to :meth:`_finalize` and paid only for KEPT traces (at a
+        sampling rate of r, 1-r of the traffic skips it entirely)."""
+        with self.lock:
+            self.spans.append(span)
+            self.open -= 1
+            if span._is_root:
+                self.root_done = True
+            done = self.root_done and self.open == 0
+        if done:
+            self._finalize()
+
+    def _finalize(self) -> None:
+        cfg = _CONFIG
+        spans, self.spans = self.spans, []  # break the span<->state cycle
+        if cfg is None:
+            return  # tracing was disabled mid-trace: drop silently
+        if cfg.keep(bool(self.flags)):
+            _COLLECTOR.record({
+                "trace_id": self.trace_id,
+                "process": _PROCESS_TAG,
+                "flags": sorted(self.flags),
+                "spans": [s.to_dict() for s in spans],
+            })
+        else:
+            _COLLECTOR.record_dropped()
+
+
+# --------------------------------------------------------------------- span
+class Span:
+    """One timed unit of work. Use as a context manager; annotate with
+    :meth:`set`, stamp point events with :meth:`event`, and mark the whole
+    trace interesting with :meth:`flag`. ``child()`` creates an
+    explicitly-parented span for work handed to another thread (the
+    batcher's stage threads)."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "start_ts",
+                 "_t0", "duration_s", "annotations", "events", "thread",
+                 "_state", "_token", "_is_root", "_done")
+
+    recording = True
+
+    def __init__(self, name: str, state: _TraceState,
+                 parent_id: Optional[str], is_root: bool):
+        self.name = name
+        self.trace_id = state.trace_id
+        self.span_id = _new_id()
+        self.parent_id = parent_id
+        self.start_ts = time.time()
+        self._t0 = time.monotonic()
+        self.duration_s: Optional[float] = None
+        self.annotations: Dict[str, Any] = {}
+        self.events: Optional[List[Dict[str, Any]]] = None  # lazy: rare
+        self.thread = threading.current_thread().name
+        self._state = state
+        self._token = None
+        self._is_root = is_root
+        self._done = False
+        state.span_started()
+
+    # ------------------------------------------------------------ recording
+    def set(self, key: str, value: Any) -> "Span":
+        self.annotations[key] = value
+        return self
+
+    def event(self, name: str, **attrs: Any) -> "Span":
+        if self.events is None:
+            self.events = []
+        self.events.append({"name": name, "ts": time.time(),
+                            "offset_ms": round(
+                                (time.monotonic() - self._t0) * 1e3, 3),
+                            **attrs})
+        return self
+
+    def flag(self, reason: str) -> "Span":
+        """Mark the whole trace as always-keep (tail sampling)."""
+        with self._state.lock:
+            self._state.flags.add(str(reason))
+        return self
+
+    def child(self, name: str) -> "Span":
+        """A child span of THIS span (explicit parentage — safe from any
+        thread, independent of the calling thread's context)."""
+        return Span(name, self._state, self.span_id, is_root=False)
+
+    # ------------------------------------------------------------- lifecycle
+    def __enter__(self) -> "Span":
+        self._token = _CURRENT.set(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if self._token is not None:
+            _CURRENT.reset(self._token)
+            self._token = None
+        if exc is not None and not self._done:
+            self.set("error", type(exc).__name__)
+            self.flag("fault")
+        self.finish()
+
+    def finish(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        self.duration_s = time.monotonic() - self._t0
+        cfg = _CONFIG
+        if (self._is_root and cfg is not None
+                and cfg.latency_threshold_ms is not None
+                and self.duration_s * 1e3 > cfg.latency_threshold_ms):
+            self.flag("slow")
+        self._state.span_finished(self)
+
+    def to_dict(self) -> Dict[str, Any]:
+        """Serialize (called once, at keep-time, for kept traces only —
+        the span is finished and immutable, so no defensive copies)."""
+        return {"name": self.name, "trace_id": self.trace_id,
+                "span_id": self.span_id, "parent_id": self.parent_id,
+                "start_ts": self.start_ts,
+                "duration_s": self.duration_s, "thread": self.thread,
+                "process": _PROCESS_TAG,
+                "annotations": self.annotations,
+                "events": self.events or []}
+
+
+class _NoopSpan:
+    """The shared do-nothing span returned while tracing is disabled —
+    every method is a constant-return no-op, ``with`` works, nothing
+    allocates. There is exactly ONE instance (:data:`NOOP`)."""
+
+    __slots__ = ()
+    recording = False
+    trace_id = None
+    span_id = None
+    annotations: Dict[str, Any] = {}
+    events: List[Dict[str, Any]] = []
+
+    def set(self, key, value):
+        return self
+
+    def event(self, name, **attrs):
+        return self
+
+    def flag(self, reason):
+        return self
+
+    def child(self, name):
+        return self
+
+    def finish(self):
+        return None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return None
+
+
+NOOP = _NoopSpan()
+
+
+# ------------------------------------------------------------- entry points
+def span(name: str) -> Any:
+    """A span parented to the calling context's active span (or a new
+    trace root when there is none). THE hot-path entry point: with tracing
+    disabled this is one global load + ``is None`` + singleton return —
+    zero allocations."""
+    if _CONFIG is None:
+        return NOOP
+    cur = _CURRENT.get()
+    if cur is not None and cur.recording:
+        return Span(name, cur._state, cur.span_id, is_root=False)
+    return Span(name, _TraceState(_new_id()), None, is_root=True)
+
+
+def server_span(name: str, trace_id: Optional[str] = None,
+                parent_id: Optional[str] = None) -> Any:
+    """A request-root span continuing a REMOTE trace: ``trace_id`` /
+    ``parent_id`` come off the ``X-Trace-Id`` / ``X-Parent-Span-Id``
+    headers (absent -> a fresh trace). This span is the local root — its
+    completion (plus any late children) triggers the tail-sampling
+    decision for this process's part of the trace."""
+    if _CONFIG is None:
+        return NOOP
+    state = _TraceState(str(trace_id) if trace_id else _new_id())
+    return Span(name, state, str(parent_id) if parent_id else None,
+                is_root=True)
+
+
+def current_span() -> Optional[Span]:
+    if _CONFIG is None:
+        return None
+    return _CURRENT.get()
+
+
+def current_trace_id() -> Optional[str]:
+    sp = current_span()
+    return sp.trace_id if sp is not None else None
+
+
+def flag_current(reason: str) -> None:
+    sp = current_span()
+    if sp is not None:
+        sp.flag(reason)
+
+
+def annotate_current(key: str, value: Any) -> None:
+    sp = current_span()
+    if sp is not None:
+        sp.set(key, value)
+
+
+def stamp_chaos(point: str, action: str) -> None:
+    """Stamp a chaos-injection decision onto the active span (called by
+    :mod:`deeplearning4j_tpu.runtime.chaos` for every policy action) and
+    flag the trace ``chaos`` — every fault drill is traceable after the
+    fact, and tail sampling always keeps it."""
+    sp = current_span()
+    if sp is not None:
+        sp.event("chaos", point=point, action=action)
+        sp.flag("chaos")
+
+
+def stage_event(stage: str, seconds: float) -> None:
+    """Stamp a named stage duration (encode/exchange/decode/apply,
+    data_wait/dispatch/step) onto the active span — the bridge from the
+    existing ``ExchangeStats`` / ``TrainingProfiler`` hooks into the
+    trace tree."""
+    if _CONFIG is None:
+        return
+    sp = _CURRENT.get()
+    if sp is not None:
+        sp.event("stage", stage=stage, seconds=round(float(seconds), 6))
+
+
+# ------------------------------------------------------- merge / tree / export
+def merge_traces(records: Iterable[Dict[str, Any]]
+                 ) -> List[Dict[str, Any]]:
+    """Merge per-process trace records by trace id into one record per
+    trace (spans concatenated, de-duplicated by span id; flags unioned;
+    contributing processes listed). The router's ``/v1/traces``
+    aggregation is this function over its own collector plus every
+    worker's."""
+    by_id: Dict[str, Dict[str, Any]] = {}
+    for rec in records:
+        tid = rec.get("trace_id")
+        if tid is None:
+            continue
+        m = by_id.get(tid)
+        if m is None:
+            m = by_id[tid] = {"trace_id": tid, "flags": set(),
+                              "processes": [], "spans": [], "_seen": set()}
+        m["flags"].update(rec.get("flags", ()))
+        proc = rec.get("process")
+        if proc and proc not in m["processes"]:
+            m["processes"].append(proc)
+        for s in rec.get("spans", ()):
+            sid = s.get("span_id")
+            if sid in m["_seen"]:
+                continue
+            m["_seen"].add(sid)
+            m["spans"].append(s)
+    out = []
+    for m in by_id.values():
+        m.pop("_seen")
+        m["flags"] = sorted(m["flags"])
+        m["spans"].sort(key=lambda s: s.get("start_ts") or 0.0)
+        out.append(m)
+    out.sort(key=lambda m: min((s.get("start_ts") or 0.0
+                                for s in m["spans"]), default=0.0))
+    return out
+
+
+def span_tree(record: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Rebuild the span tree of one (merged) trace record: returns the
+    root spans, each with a ``children`` list, children sorted by start
+    time. A span whose parent is not in the record (a remote parent whose
+    process was not scraped) becomes a root."""
+    spans = [dict(s) for s in record.get("spans", ())]
+    by_id = {s["span_id"]: s for s in spans}
+    roots = []
+    for s in spans:
+        s.setdefault("children", [])
+    for s in spans:
+        parent = by_id.get(s.get("parent_id"))
+        if parent is None:
+            roots.append(s)
+        else:
+            parent["children"].append(s)
+    for s in spans:
+        s["children"].sort(key=lambda c: c.get("start_ts") or 0.0)
+    roots.sort(key=lambda s: s.get("start_ts") or 0.0)
+    return roots
+
+
+def to_chrome_trace(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Render trace records as Chrome trace-event JSON (the format
+    Perfetto's legacy importer and ``chrome://tracing`` load): one
+    ``ph: "X"`` complete event per span (``ts``/``dur`` in microseconds,
+    wall-clock anchored), one ``ph: "i"`` instant per span event (chaos
+    stamps, stage marks), ``pid`` = originating process tag, ``tid`` =
+    recording thread."""
+    events: List[Dict[str, Any]] = []
+    for rec in records:
+        for s in rec.get("spans", ()):
+            ts_us = (s.get("start_ts") or 0.0) * 1e6
+            events.append({
+                "name": s["name"], "ph": "X",
+                "ts": ts_us, "dur": (s.get("duration_s") or 0.0) * 1e6,
+                "pid": s.get("process", rec.get("process", "?")),
+                "tid": s.get("thread", "?"),
+                "args": {"trace_id": rec.get("trace_id"),
+                         "span_id": s.get("span_id"),
+                         "parent_id": s.get("parent_id"),
+                         **(s.get("annotations") or {})},
+            })
+            for ev in s.get("events", ()):
+                attrs = {k: v for k, v in ev.items()
+                         if k not in ("name", "ts", "offset_ms")}
+                events.append({
+                    "name": f"{s['name']}:{ev['name']}", "ph": "i", "s": "t",
+                    "ts": (ev.get("ts") or 0.0) * 1e6,
+                    "pid": s.get("process", rec.get("process", "?")),
+                    "tid": s.get("thread", "?"),
+                    "args": attrs,
+                })
+    events.sort(key=lambda e: e["ts"])
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# --------------------------------------------------------------- access log
+def access_log_enabled() -> bool:
+    """The ``DL4J_TPU_ACCESS_LOG`` env knob (off by default): one
+    structured JSON line per terminal request outcome on stderr."""
+    return os.environ.get("DL4J_TPU_ACCESS_LOG", "") not in ("", "0", "false")
+
+
+def emit_access_log(record: Dict[str, Any]) -> None:
+    """Write one JSON access-log line to stderr (no-op unless
+    :func:`access_log_enabled`). Never raises — logging must not be able
+    to fail a request."""
+    if not access_log_enabled():
+        return
+    try:
+        sys.stderr.write(json.dumps(
+            {"log": "dl4j_tpu_access", **record}, default=str) + "\n")
+        sys.stderr.flush()
+    except Exception:
+        pass
+
+
+# env bootstrap: DL4J_TPU_TRACE=<rate> enables tracing at import (fleet
+# worker subprocesses inherit the parent's env, so one knob traces the
+# whole fleet; 0/absent keeps the no-op fast path; bare truthy spellings
+# mean rate 1.0, matching the DL4J_TPU_ACCESS_LOG knob's convention)
+_env_rate = os.environ.get("DL4J_TPU_TRACE", "").strip().lower()
+if _env_rate not in ("", "0", "0.0", "false", "off", "no"):
+    try:
+        enable(rate=1.0 if _env_rate in ("true", "on", "yes")
+               else float(_env_rate))
+    except ValueError:
+        pass
+del _env_rate

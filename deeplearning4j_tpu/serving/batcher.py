@@ -57,7 +57,7 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
-from deeplearning4j_tpu.runtime import chaos
+from deeplearning4j_tpu.runtime import chaos, trace
 from deeplearning4j_tpu.serving.admission import (
     AdmissionController,
     DeadlineExceeded,
@@ -74,6 +74,17 @@ logger = logging.getLogger(__name__)
 _SENTINEL = object()  # queue wake-up token: shutdown/drain, never a request
 
 
+def _batch_span(requests, name: str):
+    """Stage span for a coalesced batch on a worker thread: parented to
+    the FIRST traced request of the batch (a batch span cannot have N
+    parents — the other requests are stamped with bucket/replica on their
+    own spans instead). The shared no-op span when nothing is traced."""
+    for r in requests:
+        if r.span is not None and r.span.recording:
+            return r.span.child(name)
+    return trace.NOOP
+
+
 def default_buckets(max_batch_size: int) -> List[int]:
     """Powers of two up to ``max_batch_size`` (plus the max itself)."""
     out, b = [], 1
@@ -86,7 +97,7 @@ def default_buckets(max_batch_size: int) -> List[int]:
 
 class _Request:
     __slots__ = ("x", "rows", "deadline", "enqueued_at", "event",
-                 "result", "error", "quantized")
+                 "result", "error", "quantized", "span")
 
     def __init__(self, x: ArrayOrDict, rows: int, deadline: Optional[float],
                  quantized: bool = False):
@@ -98,6 +109,10 @@ class _Request:
         self.result = None
         self.error: Optional[BaseException] = None
         self.quantized = quantized  # policy-dtype request (ISSUE 8)
+        # the submitting context's active span (ISSUE 9): batch stage
+        # spans on the worker threads parent to it, and bucket/replica
+        # annotations land on it — None while tracing is disabled
+        self.span = trace.current_span()
 
 
 class _InFlight:
@@ -329,6 +344,7 @@ class ContinuousBatcher:
                                      self._drain_ms_per_request())
             except Overloaded:
                 self.metrics.record_rejection("overload")
+                trace.flag_current("shed")  # tail sampling keeps sheds
                 raise
             quant = (self.dtype_policy is not None
                      and self.dtype_policy.is_quantized_request(xs))
@@ -488,6 +504,9 @@ class ContinuousBatcher:
                     f"execution at the {stage} stage "
                     f"(queued {now - r.enqueued_at:.3f}s)")
                 self.metrics.record_rejection("deadline")
+                if r.span is not None:
+                    r.span.flag("deadline")
+                    r.span.event("expired", stage=stage)
                 r.event.set()
             else:
                 live.append(r)
@@ -554,9 +573,26 @@ class ContinuousBatcher:
                     return
             rows = sum(r.rows for r in live)
             bucket = self._bucket_for(rows)      # may mint + warm a bucket
-            x, buffers = self._gather(live, rows, bucket)
-            forward_at = time.monotonic()
-            out, replica = self._forward(x)
+            # stage span (ISSUE 9): parented to the first traced request
+            # of the batch; chaos at serving.batcher.forward and the AOT
+            # hit/miss of the dispatch land on it, and every traced
+            # request is stamped with its bucket + replica
+            dsp = _batch_span(live, "batcher.dispatch")
+            with dsp:
+                if dsp.recording:
+                    dsp.set("bucket", bucket)
+                    dsp.set("rows", rows)
+                    dsp.set("requests", len(live))
+                x, buffers = self._gather(live, rows, bucket)
+                forward_at = time.monotonic()
+                # AotCache.call annotates "aot" hit/miss on this span
+                out, replica = self._forward(x)
+                if dsp.recording:
+                    dsp.set("replica", replica.index)
+                    for r in live:
+                        if r.span is not None and r.span.recording:
+                            r.span.set("bucket", bucket)
+                            r.span.set("replica", replica.index)
         except BaseException as e:
             # fail only this batch — a bad request mix (inconsistent
             # feature shapes, missing dict input key), a failed bucket
@@ -583,13 +619,19 @@ class ContinuousBatcher:
 
     # ---------------------------------------------------------- completion
     def _complete(self, rec: _InFlight) -> None:
+        csp = _batch_span(rec.requests, "batcher.complete")
         try:
-            chaos.inject("serving.batcher.complete")
-            out = rec.out
-            if isinstance(out, (list, tuple)):
-                out = [np.asarray(o) for o in out]   # blocking readback
-            else:
-                out = np.asarray(out)
+            with csp:
+                if csp.recording:
+                    csp.set("bucket", rec.bucket)
+                    csp.set("replica", rec.replica.index)
+                    csp.set("rows", rec.rows)
+                chaos.inject("serving.batcher.complete")
+                out = rec.out
+                if isinstance(out, (list, tuple)):
+                    out = [np.asarray(o) for o in out]   # blocking readback
+                else:
+                    out = np.asarray(out)
             t1 = time.monotonic()
             # readback done => the execution can no longer be reading the
             # pad buffers; only NOW may they return to the pool

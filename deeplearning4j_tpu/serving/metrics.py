@@ -69,6 +69,45 @@ class LatencyHistogram:
     def mean(self) -> float:
         return self.sum / self.count if self.count else 0.0
 
+    # ------------------------------------------------------ merge / wire
+    def to_wire(self) -> Dict[str, object]:
+        """JSON-able form carrying the raw bucket state, so a remote
+        reader (the fleet router's ``/metrics`` aggregation, ISSUE 9) can
+        :meth:`merge` histograms instead of averaging percentiles —
+        percentiles of a merged histogram are exact (to bucket width),
+        percentiles averaged across workers are meaningless."""
+        return {"bounds": list(self._bounds), "counts": list(self._counts),
+                "count": self.count, "sum": self.sum, "max": self.max}
+
+    @classmethod
+    def from_wire(cls, wire: Dict[str, object]) -> "LatencyHistogram":
+        h = cls.__new__(cls)
+        h._bounds = [float(b) for b in wire["bounds"]]
+        h._counts = [int(c) for c in wire["counts"]]
+        if len(h._counts) != len(h._bounds) + 1:
+            raise ValueError("histogram wire form has mismatched "
+                             f"{len(h._bounds)} bounds / "
+                             f"{len(h._counts)} counts")
+        h.count = int(wire["count"])
+        h.sum = float(wire["sum"])
+        h.max = float(wire["max"])
+        return h
+
+    def merge(self, other: "LatencyHistogram") -> "LatencyHistogram":
+        """Accumulate ``other``'s buckets into this histogram (in place;
+        returns self). Both must share the same bucket bounds — every
+        histogram built with the default ``lo``/``hi`` does."""
+        if other._bounds != self._bounds:
+            raise ValueError("cannot merge histograms with different "
+                             "bucket bounds")
+        for i, c in enumerate(other._counts):
+            self._counts[i] += c
+        self.count += other.count
+        self.sum += other.sum
+        if other.max > self.max:
+            self.max = other.max
+        return self
+
 
 class ServingMetrics:
     """Per-model serving counters, gauges and histograms (thread-safe)."""
@@ -259,6 +298,35 @@ class ServingMetrics:
             snap["breaker_opens_total"] = b["opens_total"]
             snap["breaker_failures_in_window"] = b["failures_in_window"]
         return snap
+
+    def wire_snapshot(self) -> Dict[str, object]:
+        """Machine-readable snapshot for the fleet router's ``/metrics``
+        aggregation (ISSUE 9): summable counters plus raw-bucket
+        histograms (:meth:`LatencyHistogram.to_wire`) so one scrape of the
+        router sees fleet-wide counts and MERGED latency percentiles."""
+        with self._lock:
+            return {
+                "counters": {
+                    "requests_total": self.requests_total,
+                    "responses_total": self.responses_total,
+                    "rejected_overload": self.rejected_overload,
+                    "rejected_deadline": self.rejected_deadline,
+                    "rejected_circuit": self.rejected_circuit,
+                    "retries_total": self.retries_total,
+                    "errors_total": self.errors_total,
+                    "batches_total": self.batches_total,
+                    "rows_real_total": self.rows_real_total,
+                    "rows_padded_total": self.rows_padded_total,
+                    "quantized_requests_total": self.quantized_requests_total,
+                },
+                "histograms": {
+                    # request_latency only: it is what the router's
+                    # aggregation merges; shipping the batch/dispatch
+                    # histograms too would inflate every scrape for no
+                    # consumer (add them here WHEN something merges them)
+                    "request_latency": self.request_latency.to_wire(),
+                },
+            }
 
     def render_prometheus(self, model: str) -> str:
         s = self.snapshot()

@@ -57,12 +57,15 @@ import logging
 import threading
 import time
 import uuid
+import zlib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
 
-from deeplearning4j_tpu.runtime import chaos
+from deeplearning4j_tpu.runtime import chaos, trace
 from deeplearning4j_tpu.serving.metrics import LatencyHistogram
 from deeplearning4j_tpu.serving.resilience import CircuitBreaker, CircuitState
+from deeplearning4j_tpu.serving.slo import SLOMonitor
 
 logger = logging.getLogger(__name__)
 
@@ -244,7 +247,8 @@ class _BreakerDeclined(Exception):
 class _Attempt:
     """One forward attempt's outcome."""
 
-    __slots__ = ("view", "hedged", "status", "headers", "data", "error")
+    __slots__ = ("view", "hedged", "status", "headers", "data", "error",
+                 "span")
 
     def __init__(self, view: WorkerView, hedged: bool):
         self.view = view
@@ -253,6 +257,7 @@ class _Attempt:
         self.headers: Dict[str, str] = {}
         self.data: bytes = b""
         self.error: Optional[BaseException] = None
+        self.span = trace.NOOP  # the attempt's router.attempt span
 
     @property
     def terminal(self) -> bool:
@@ -263,6 +268,10 @@ class _Attempt:
         """A failed attempt another worker might still serve: connection
         faults, 5xx, and shed (503) responses."""
         return not self.terminal
+
+
+def _crc(data: bytes) -> str:
+    return f"{zlib.crc32(data) & 0xffffffff:08x}"
 
 
 class _Race:
@@ -290,15 +299,25 @@ class _Race:
             if attempt.terminal:
                 if self.winner is None:
                     self.winner = attempt
+                    if attempt.span.recording:
+                        # the winner's bit-identity: a body checksum any
+                        # late duplicate can be compared against
+                        attempt.span.set("winner", True)
+                        attempt.span.set("body_crc32", _crc(attempt.data))
                     if attempt.hedged:
                         self._metrics.record("hedge_wins_total")
                 else:
                     self._metrics.record("hedges_discarded_total")
+                    if attempt.span.recording:
+                        attempt.span.set("discarded", True)
+                        attempt.span.set("body_crc32", _crc(attempt.data))
             else:
                 if self.winner is not None and self.launched > 1:
                     # the loser of a hedge race that ended in failure is
                     # still a duplicate completion to account for
                     self._metrics.record("hedges_discarded_total")
+                    if attempt.span.recording:
+                        attempt.span.set("discarded", True)
                 self.failures.append(attempt)
             self._cv.notify_all()
 
@@ -353,6 +372,11 @@ class FleetRouter:
         self.connect_timeout_s = float(connect_timeout_s)
         self.no_deadline_timeout_s = float(no_deadline_timeout_s)
         self.metrics = RouterMetrics()
+        # fleet-wide SLO attainment + burn rates (ISSUE 9): the router
+        # sees every client request whichever worker serves it, so ITS
+        # monitor is the per-model fleet-wide signal the autoscaler will
+        # consume (rendered on /metrics next to the worker aggregation)
+        self.slo = SLOMonitor()
         self._views: Dict[str, WorkerView] = {}
         self._views_lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -492,63 +516,105 @@ class FleetRouter:
 
     def _forward(self, race: _Race, view: WorkerView, name: str,
                  body: bytes, rid: str, deadline: Optional[float],
-                 hedged: bool) -> None:
-        """One attempt against one worker (runs on its own thread)."""
+                 hedged: bool, span=trace.NOOP) -> None:
+        """One attempt against one worker (runs on its own thread). When
+        tracing, ``span`` is the attempt's ``router.attempt`` child span
+        of the request's root — created by the CALLER before this thread
+        launches, so the root can never finalize its trace while an
+        attempt span is still unborn. Its span id rides
+        ``X-Parent-Span-Id`` to the worker, whose ``worker.predict`` span
+        parents to it, which is what lets the router-side aggregation
+        merge the two processes' spans into one tree."""
         attempt = _Attempt(view, hedged)
+        sp = span
+        attempt.span = sp
         view.begin()
         t0 = time.monotonic()
-        try:
-            chaos.inject("serving.router.forward")
-            # consume the breaker slot only for attempts actually sent —
-            # a half-open probe slot must never leak to a worker that was
-            # merely *ranked* (that would wedge the breaker half-open)
-            if not view.breaker.allow():
-                raise _BreakerDeclined(view.worker_id)
-            remaining = None if deadline is None else deadline - t0
-            if remaining is not None and remaining <= 0:
-                raise TimeoutError("deadline expired before forward")
-            headers = {"Content-Type": "application/json",
-                       "X-Request-Id": rid}
-            if remaining is not None:
-                headers["X-Deadline-Ms"] = f"{remaining * 1000.0:.1f}"
-            self.metrics.record_forward(view.worker_id)
-            # a deadline-free request's socket timeout must cover a SLOW
-            # predict, not just the connect — 2s here would misread a
-            # healthy-but-busy worker as dead and cascade into 503s
-            status, resp_headers, data = self._http(
-                view.address, "POST", f"/v1/models/{name}/predict",
-                body=body, headers=headers,
-                timeout=(self.no_deadline_timeout_s if remaining is None
-                         else remaining + 0.25))
-            attempt.status, attempt.headers, attempt.data = \
-                status, resp_headers, data
-        except BaseException as e:
-            attempt.error = e
-        latency = time.monotonic() - t0
-        self._classify(attempt)
-        view.done(ok=attempt.status == 200,
-                  latency_s=latency if attempt.status == 200 else None)
-        race.complete(attempt)
+        with sp:
+            if sp.recording:
+                sp.set("worker", view.worker_id)
+                sp.set("hedged", hedged)
+            try:
+                chaos.inject("serving.router.forward")
+                # consume the breaker slot only for attempts actually sent —
+                # a half-open probe slot must never leak to a worker that was
+                # merely *ranked* (that would wedge the breaker half-open)
+                if not view.breaker.allow():
+                    raise _BreakerDeclined(view.worker_id)
+                remaining = None if deadline is None else deadline - t0
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError("deadline expired before forward")
+                headers = {"Content-Type": "application/json",
+                           "X-Request-Id": rid}
+                if sp.recording:
+                    headers["X-Trace-Id"] = sp.trace_id
+                    headers["X-Parent-Span-Id"] = sp.span_id
+                    if hedged:
+                        # tail sampling decides per PROCESS: the worker
+                        # can't see the router's hedge verdict, so the
+                        # hedge attempt carries the flag and the worker's
+                        # half of the trace self-keeps
+                        headers["X-Trace-Flags"] = "hedged"
+                if remaining is not None:
+                    headers["X-Deadline-Ms"] = f"{remaining * 1000.0:.1f}"
+                self.metrics.record_forward(view.worker_id)
+                # a deadline-free request's socket timeout must cover a SLOW
+                # predict, not just the connect — 2s here would misread a
+                # healthy-but-busy worker as dead and cascade into 503s
+                status, resp_headers, data = self._http(
+                    view.address, "POST", f"/v1/models/{name}/predict",
+                    body=body, headers=headers,
+                    timeout=(self.no_deadline_timeout_s if remaining is None
+                             else remaining + 0.25))
+                attempt.status, attempt.headers, attempt.data = \
+                    status, resp_headers, data
+            except BaseException as e:
+                attempt.error = e
+            latency = time.monotonic() - t0
+            self._classify(attempt)
+            view.done(ok=attempt.status == 200,
+                      latency_s=latency if attempt.status == 200 else None)
+            if sp.recording:
+                if attempt.error is not None:
+                    sp.set("error", type(attempt.error).__name__)
+                    if not isinstance(attempt.error, _BreakerDeclined):
+                        sp.flag("fault")  # a failed attempt keeps the trace
+                elif attempt.status is not None:
+                    sp.set("status", attempt.status)
+            # completion INSIDE the span scope: the race marks the winner
+            # (bit-identity crc) or a discarded duplicate on this span
+            # before it closes
+            race.complete(attempt)
 
     def _eligible(self, ranked: List[WorkerView], tried: set,
-                  now: float) -> List[WorkerView]:
+                  now: float, span=trace.NOOP) -> List[WorkerView]:
         out = []
         for view in ranked:
             if view.worker_id in tried:
                 continue
             if view.shedding(now):
                 self.metrics.record("shed_skips_total")
+                if span.recording:
+                    span.event("shed_skip", worker=view.worker_id,
+                               remaining_ms=round(
+                                   (view.shed_until - now) * 1e3, 1))
                 continue
             if view.admittable(now):
                 out.append(view)
         return out
 
     def _launch(self, race: _Race, view: WorkerView, name: str, body: bytes,
-                rid: str, deadline: Optional[float], hedged: bool) -> None:
+                rid: str, deadline: Optional[float], hedged: bool,
+                parent_span=trace.NOOP) -> None:
         race.register_launch()
+        # the attempt span is created HERE, on the handler thread, so the
+        # request's trace counts it open before this thread even starts —
+        # a root finishing first can then never split the trace in two
+        sp = (parent_span.child("router.attempt") if parent_span.recording
+              else trace.NOOP)
         threading.Thread(
             target=self._forward,
-            args=(race, view, name, body, rid, deadline, hedged),
+            args=(race, view, name, body, rid, deadline, hedged, sp),
             daemon=True, name=f"router-forward-{view.worker_id}").start()
 
     def _route_predict(self, name: str, raw: bytes, inbound_headers
@@ -575,12 +641,36 @@ class FleetRouter:
         rid = inbound.get("X-Request-Id") or uuid.uuid4().hex
         ranked = self.ranked_workers(name)
         tried: set = set()
+        # the request's root span (ISSUE 9): attempt spans are its
+        # children; the tail-sampling decision for the router's part of
+        # the trace fires once the root AND every late child (a hedge
+        # loser completing after the winner) have finished
+        rsp = (trace.server_span("router.request",
+                                 trace_id=inbound.get("X-Trace-Id"),
+                                 parent_id=inbound.get("X-Parent-Span-Id"))
+               if trace.enabled() else trace.NOOP)
 
         def finish(status: int, headers: Dict[str, str], data: bytes):
-            self.metrics.record_response(status, time.monotonic() - t_start)
+            latency_s = time.monotonic() - t_start
+            self.metrics.record_response(status, latency_s)
+            # a client-sent name must not grow fleet SLO state until it
+            # has actually SERVED once (create only on 200) — otherwise
+            # junk names during an outage could permanently occupy the
+            # monitor's max_models slots and lock real models out of the
+            # autoscaler signal; once tracked, failures count in full
+            if status != 404:
+                self.slo.record(name, ok=status == 200, latency_s=latency_s,
+                                create=status == 200)
             headers = {k: v for k, v in headers.items()
                        if k.lower() not in _HOP_BY_HOP}
             headers["X-Request-Id"] = rid
+            if rsp.recording:
+                rsp.set("status", status)
+                if status == 503:
+                    rsp.flag("shed")
+                elif status == 504:
+                    rsp.flag("deadline")
+                headers["X-Trace-Id"] = rsp.trace_id
             return status, headers, data
 
         def reply_json(status: int, obj: Dict[str, Any],
@@ -589,75 +679,89 @@ class FleetRouter:
                                    **(extra or {})},
                           json.dumps(obj).encode())
 
-        while True:
-            now = time.monotonic()
-            if deadline is not None and now >= deadline:
-                return reply_json(504, {
-                    "error": "deadline exceeded",
-                    "detail": f"request {rid} expired after "
-                              f"{(now - t_start) * 1000:.0f} ms spanning "
-                              f"{len(tried)} worker attempt(s)"})
-            candidates = self._eligible(ranked, tried, now)
-            if not candidates:
-                # a worker that shed THIS request is in `tried` but its
-                # shed window is still the actionable signal to surface
-                shed = [v for v in ranked if v.shedding(now)]
-                if shed:
-                    wait_ms = min((v.shed_until - now) * 1000.0
-                                  for v in shed)
+        with rsp:
+            if rsp.recording:
+                rsp.set("model", name)
+                rsp.set("request_id", rid)
+            while True:
+                now = time.monotonic()
+                if deadline is not None and now >= deadline:
+                    return reply_json(504, {
+                        "error": "deadline exceeded",
+                        "detail": f"request {rid} expired after "
+                                  f"{(now - t_start) * 1000:.0f} ms spanning "
+                                  f"{len(tried)} worker attempt(s)"})
+                candidates = self._eligible(ranked, tried, now, span=rsp)
+                if not candidates:
+                    # a worker that shed THIS request is in `tried` but its
+                    # shed window is still the actionable signal to surface
+                    shed = [v for v in ranked if v.shedding(now)]
+                    if shed:
+                        wait_ms = min((v.shed_until - now) * 1000.0
+                                      for v in shed)
+                        return reply_json(503, {
+                            "error": "overloaded", "reason": "overloaded",
+                            "retry_after_ms": round(wait_ms, 1),
+                            "detail": "every eligible worker is shedding"},
+                            extra={"Retry-After-Ms": f"{wait_ms:.0f}"})
                     return reply_json(503, {
-                        "error": "overloaded", "reason": "overloaded",
-                        "retry_after_ms": round(wait_ms, 1),
-                        "detail": "every eligible worker is shedding"},
-                        extra={"Retry-After-Ms": f"{wait_ms:.0f}"})
-                return reply_json(503, {
-                    "error": "unavailable", "reason": "no_healthy_workers",
-                    "detail": f"no healthy worker for model {name!r} "
-                              f"({len(tried)} tried, "
-                              f"{len(ranked)} known)"})
-            primary = candidates[0]
-            hedge_view = candidates[1] if len(candidates) > 1 else None
-            hedge_possible = self.hedge_enabled and hedge_view is not None
-            race = _Race(self.metrics)
-            if hedge_possible:
-                self._launch(race, primary, name, raw, rid, deadline,
-                             hedged=False)
-            else:
-                # no hedge can fire: run the attempt on the handler
-                # thread itself instead of paying a thread spawn per
-                # request just to block waiting on it
-                race.register_launch()
-                self._forward(race, primary, name, raw, rid, deadline,
-                              hedged=False)
-            tried.add(primary.worker_id)
-            remaining = (None if deadline is None
-                         else deadline - time.monotonic())
-            if hedge_possible:
-                delay = self.hedge_delay_s()
-                if remaining is not None:
-                    delay = min(delay, max(0.0, remaining))
-                settled = race.wait(delay)
-                if not settled and race.winner is None:
-                    chaos.inject("serving.router.hedge")
-                    self.metrics.record("hedges_total")
-                    self._launch(race, hedge_view, name, raw, rid,
-                                 deadline, hedged=True)
-                    tried.add(hedge_view.worker_id)
-            race.wait(None if deadline is None
-                      else max(0.0, deadline - time.monotonic()))
-            if race.winner is not None:
-                win = race.winner
-                return finish(win.status, win.headers, win.data)
-            if race.finished < race.launched:
-                # deadline hit with attempts still in flight: their late
-                # completions are counted as discarded duplicates
-                return reply_json(504, {
-                    "error": "deadline exceeded",
-                    "detail": f"request {rid} expired with "
-                              f"{race.launched - race.finished} attempt(s) "
-                              f"still in flight"})
-            # every launched attempt failed retryably -> fail over
-            self.metrics.record("failovers_total", len(race.failures))
+                        "error": "unavailable",
+                        "reason": "no_healthy_workers",
+                        "detail": f"no healthy worker for model {name!r} "
+                                  f"({len(tried)} tried, "
+                                  f"{len(ranked)} known)"})
+                primary = candidates[0]
+                hedge_view = candidates[1] if len(candidates) > 1 else None
+                hedge_possible = self.hedge_enabled and hedge_view is not None
+                race = _Race(self.metrics)
+                if hedge_possible:
+                    self._launch(race, primary, name, raw, rid, deadline,
+                                 hedged=False, parent_span=rsp)
+                else:
+                    # no hedge can fire: run the attempt on the handler
+                    # thread itself instead of paying a thread spawn per
+                    # request just to block waiting on it
+                    race.register_launch()
+                    self._forward(race, primary, name, raw, rid, deadline,
+                                  hedged=False,
+                                  span=(rsp.child("router.attempt")
+                                        if rsp.recording else trace.NOOP))
+                tried.add(primary.worker_id)
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if hedge_possible:
+                    delay = self.hedge_delay_s()
+                    if remaining is not None:
+                        delay = min(delay, max(0.0, remaining))
+                    settled = race.wait(delay)
+                    if not settled and race.winner is None:
+                        chaos.inject("serving.router.hedge")
+                        self.metrics.record("hedges_total")
+                        if rsp.recording:
+                            rsp.flag("hedged")
+                            rsp.event("hedge",
+                                      worker=hedge_view.worker_id,
+                                      delay_ms=round(delay * 1e3, 2))
+                        self._launch(race, hedge_view, name, raw, rid,
+                                     deadline, hedged=True, parent_span=rsp)
+                        tried.add(hedge_view.worker_id)
+                race.wait(None if deadline is None
+                          else max(0.0, deadline - time.monotonic()))
+                if race.winner is not None:
+                    win = race.winner
+                    return finish(win.status, win.headers, win.data)
+                if race.finished < race.launched:
+                    # deadline hit with attempts still in flight: their late
+                    # completions are counted as discarded duplicates
+                    return reply_json(504, {
+                        "error": "deadline exceeded",
+                        "detail": f"request {rid} expired with "
+                                  f"{race.launched - race.finished} "
+                                  f"attempt(s) still in flight"})
+                # every launched attempt failed retryably -> fail over
+                self.metrics.record("failovers_total", len(race.failures))
+                if rsp.recording:
+                    rsp.event("failover", failed_attempts=len(race.failures))
 
     # ------------------------------------------------------------ lifecycle
     def drain(self, worker_id: str, timeout_s: float = 30.0) -> None:
@@ -735,8 +839,130 @@ class FleetRouter:
         self.metrics.record("deploys_total")
         return report
 
+    # ------------------------------------------- fleet scrape + trace merge
+    def _fanout(self, fn, views, timeout_s: float,
+                name: str = "trace-collector"):
+        """Run ``fn(view)`` against every view concurrently (one short-
+        lived thread per worker, joined before return — the conftest
+        thread-leak guard watches the ``trace-collector`` prefix).
+        Returns ``{worker_id: result}`` for the calls that returned
+        non-None without raising."""
+        results: Dict[str, Any] = {}
+        lock = threading.Lock()
+
+        def run(v):
+            try:
+                r = fn(v)
+            except Exception:
+                return  # an unreachable worker just drops out of the merge
+            if r is not None:
+                with lock:
+                    results[v.worker_id] = r
+
+        threads = [threading.Thread(target=run, args=(v,), daemon=True,
+                                    name=f"{name}-{v.worker_id}")
+                   for v in views]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=timeout_s + 1.0)
+        return results
+
+    def _scrape_workers(self) -> Dict[str, Dict[str, Any]]:
+        """Every ready worker's ``/v1/metricsz`` (counters + raw-bucket
+        histograms), fetched in parallel."""
+        views = [v for v in self.workers().values() if v.ready]
+
+        def fetch(v):
+            status, _, data = self._http(v.address, "GET", "/v1/metricsz",
+                                         timeout=self.probe_timeout_s)
+            return json.loads(data.decode()) if status == 200 else None
+
+        return self._fanout(fetch, views, self.probe_timeout_s)
+
+    def render_fleet_metrics(self) -> str:
+        """Fleet-wide ``/metrics`` section (ISSUE 9): worker counters
+        summed and latency histograms MERGED across the fleet (bucket
+        merge — percentiles of the merged histogram, never averaged
+        percentiles), per-worker series kept under a ``worker=`` label,
+        plus the router's fleet-wide SLO attainment and burn rates. One
+        scrape of the router sees the whole fleet."""
+        scraped = self._scrape_workers()
+        agg_counters: Dict[tuple, float] = {}
+        agg_hists: Dict[str, LatencyHistogram] = {}
+        per_worker = []
+        for wid, payload in sorted(scraped.items()):
+            for model, snap in sorted((payload.get("models") or {}).items()):
+                for cname, v in sorted((snap.get("counters") or {}).items()):
+                    if not isinstance(v, (int, float)):
+                        continue  # malformed counter: skip, never break
+                    per_worker.append(
+                        f'fleet_serving_{cname}{{model="{model}",'
+                        f'worker="{wid}"}} {v}')
+                    key = (model, cname)
+                    agg_counters[key] = agg_counters.get(key, 0) + v
+                wire = (snap.get("histograms") or {}).get("request_latency")
+                if not wire:
+                    continue
+                try:
+                    h = LatencyHistogram.from_wire(wire)
+                    if model in agg_hists:
+                        agg_hists[model].merge(h)
+                    else:
+                        agg_hists[model] = h
+                except (KeyError, ValueError, TypeError):
+                    pass  # malformed snapshot: skip, never break the scrape
+        lines = ["# TYPE fleet_serving_requests_total counter",
+                 f"fleet_workers_scraped {len(scraped)}"]
+        for (model, cname), v in sorted(agg_counters.items()):
+            lines.append(f'fleet_serving_{cname}{{model="{model}"}} {v}')
+        for model, h in sorted(agg_hists.items()):
+            lines.append(f'fleet_serving_latency_count{{model="{model}"}} '
+                         f"{h.count}")
+            for q in (50, 99):
+                lines.append(
+                    f'fleet_serving_latency_seconds{{model="{model}",'
+                    f'quantile="0.{q}"}} {h.percentile(q)}')
+        lines.extend(per_worker)
+        slo_text = self.slo.render_prometheus()
+        if slo_text:
+            lines.append(slo_text.rstrip("\n"))
+        return "\n".join(lines) + "\n"
+
+    def aggregate_traces(self, trace_id: Optional[str] = None
+                         ) -> List[Dict[str, Any]]:
+        """The flight recorder's read side: merge this router's kept
+        traces with every ready worker's ``/v1/traces`` into one record
+        per trace id — router attempt spans and the worker spans they
+        parented (predict, batcher stages) come back as ONE tree
+        (``trace.span_tree``)."""
+        records = list(trace.collector().traces())
+        views = [v for v in self.workers().values() if v.ready]
+        path = ("/v1/traces" if trace_id is None
+                else f"/v1/traces?trace_id={trace_id}")
+
+        def fetch(v):
+            status, _, data = self._http(v.address, "GET", path,
+                                         timeout=self.probe_timeout_s)
+            if status != 200:
+                return None
+            return json.loads(data.decode()).get("traces", [])
+
+        for recs in self._fanout(fetch, views, self.probe_timeout_s).values():
+            records.extend(recs or [])
+        merged = trace.merge_traces(records)
+        if trace_id is not None:
+            merged = [m for m in merged if m.get("trace_id") == trace_id]
+        return merged
+
     # --------------------------------------------------------- GET handlers
     def _handle_get(self, path: str):
+        if path.startswith("/v1/traces"):
+            q = parse_qs(urlsplit(path).query)
+            merged = self.aggregate_traces(q.get("trace_id", [None])[0])
+            if q.get("format", [None])[0] == "chrome":
+                return 200, trace.to_chrome_trace(merged)
+            return 200, {"traces": merged}
         if path == "/healthz":
             return 200, {"status": "ok",
                          "workers": {wid: v.admittable()
@@ -789,8 +1015,9 @@ class FleetRouter:
 
             def do_GET(self):
                 if self.path == "/metrics":
-                    text = router.metrics.render_prometheus(
-                        router.workers()).encode()
+                    text = (router.metrics.render_prometheus(
+                                router.workers())
+                            + router.render_fleet_metrics()).encode()
                     self._send(200, {"Content-Type":
                                      "text/plain; version=0.0.4"}, text)
                     return

@@ -63,15 +63,18 @@ from __future__ import annotations
 import json
 import math
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, urlsplit
 
 import numpy as np
 
-from deeplearning4j_tpu.runtime import chaos
+from deeplearning4j_tpu.runtime import chaos, trace
 from deeplearning4j_tpu.serving.admission import DeadlineExceeded, Overloaded
 from deeplearning4j_tpu.serving.registry import ModelRegistry
 from deeplearning4j_tpu.serving.resilience import CircuitOpen
+from deeplearning4j_tpu.serving.slo import SLOMonitor
 
 
 def _to_jsonable(out):
@@ -88,9 +91,13 @@ class ModelServer:
     bit-identity drills can attribute every answer)."""
 
     def __init__(self, registry: Optional[ModelRegistry] = None,
-                 worker_id: Optional[str] = None):
+                 worker_id: Optional[str] = None,
+                 slo: Optional[SLOMonitor] = None):
         self.registry = registry or ModelRegistry()
         self.worker_id = worker_id
+        # per-worker SLO attainment + burn rates (ISSUE 9); the router
+        # keeps its own fleet-wide monitor over the same outcomes
+        self.slo = slo or SLOMonitor()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.port: Optional[int] = None
@@ -106,7 +113,66 @@ class ModelServer:
         return min(values) if values else None
 
     def _handle_predict(self, name: str, raw: bytes, headers=None):
-        """Returns ``(status, json_body, extra_headers)``."""
+        """Returns ``(status, json_body, extra_headers)``.
+
+        Tracing (ISSUE 9): when enabled, the whole predict runs inside a
+        ``worker.predict`` span continuing the caller's trace off the
+        ``X-Trace-Id`` / ``X-Parent-Span-Id`` headers (the router's
+        attempt span id), so the router's ``/v1/traces`` aggregation can
+        merge this worker's spans — including the batcher stage spans the
+        request's span parents — into one tree. Terminal outcomes feed
+        the worker's :class:`SLOMonitor` and, behind the
+        ``DL4J_TPU_ACCESS_LOG`` knob, one structured JSON log line."""
+        h = headers or {}
+        if trace.enabled():
+            sp = trace.server_span("worker.predict",
+                                   trace_id=h.get("X-Trace-Id"),
+                                   parent_id=h.get("X-Parent-Span-Id"))
+            # a caller that already knows this trace is interesting (the
+            # router's hedge attempt) says so — tail sampling is decided
+            # per process, so the hint is what keeps THIS process's half
+            flags = h.get("X-Trace-Flags")
+            if flags and sp.recording:
+                for f in str(flags).split(","):
+                    if f.strip():
+                        sp.flag(f.strip())
+        else:
+            sp = trace.NOOP
+        t0 = time.monotonic()
+        with sp:
+            if sp.recording:
+                sp.set("model", name)
+                if self.worker_id is not None:
+                    sp.set("worker", self.worker_id)
+            status, obj, hdrs = self._predict_inner(name, raw, h)
+            latency_s = time.monotonic() - t0
+            if sp.recording:
+                sp.set("status", status)
+                if status == 503:
+                    sp.flag("shed")
+                elif status == 504:
+                    sp.flag("deadline")
+                elif status >= 500:
+                    sp.flag("fault")
+                hdrs["X-Trace-Id"] = sp.trace_id
+        if status != 404:
+            # 404 = the model name does not exist here; recording it
+            # would let arbitrary client-sent names grow SLO state
+            self.slo.record(name, ok=status == 200, latency_s=latency_s)
+        if trace.access_log_enabled():  # don't build the record otherwise
+            trace.emit_access_log({
+                "trace_id": sp.trace_id,
+                "request_id": h.get("X-Request-Id"),
+                "worker": self.worker_id,
+                "model": name,
+                "bucket": sp.annotations.get("bucket"),
+                "dtype": sp.annotations.get("dtype"),
+                "outcome": status,
+                "latency_ms": round(latency_s * 1e3, 3),
+            })
+        return status, obj, hdrs
+
+    def _predict_inner(self, name: str, raw: bytes, headers):
         chaos.inject("serving.worker.predict")
         hdrs = {}
         try:
@@ -116,6 +182,9 @@ class ModelServer:
                 body.get("timeout_ms"),
                 (headers or {}).get("X-Deadline-Ms"))
             dtype = body.get("dtype")
+            if dtype is not None:
+                trace.annotate_current(
+                    "dtype", dtype if isinstance(dtype, str) else dict(dtype))
 
             def _dt(name):
                 if dtype is None:
@@ -171,6 +240,32 @@ class ModelServer:
                      "outputs": _to_jsonable(out)}, hdrs
 
     def _handle_get(self, path: str):
+        if path.startswith("/v1/traces"):
+            # this process's kept traces (tail-sampled flight recorder);
+            # ?trace_id= filters, ?format=chrome renders Perfetto-loadable
+            # trace-event JSON (ISSUE 9, docs/observability.md)
+            q = parse_qs(urlsplit(path).query)
+            recs = trace.collector().traces()
+            tid = q.get("trace_id", [None])[0]
+            if tid:
+                recs = [r for r in recs if r.get("trace_id") == tid]
+            if q.get("format", [None])[0] == "chrome":
+                return 200, trace.to_chrome_trace(recs)
+            return 200, {"traces": recs,
+                         "kept": trace.collector().kept,
+                         "dropped": trace.collector().dropped,
+                         "worker": self.worker_id}
+        if path == "/v1/metricsz":
+            # machine-readable twin of /metrics: summable counters + raw
+            # bucket histograms so the router can aggregate fleet-wide
+            models = {}
+            for name in self.registry.names():
+                try:
+                    models[name] = \
+                        self.registry.get(name).metrics.wire_snapshot()
+                except KeyError:
+                    pass  # undeployed between listing and snapshot
+            return 200, {"worker": self.worker_id, "models": models}
         if path == "/healthz":
             # liveness only: the process is up and serving HTTP
             return 200, {"status": "ok", "models": self.registry.names()}
@@ -203,6 +298,9 @@ class ModelServer:
             except KeyError:
                 pass  # undeployed between listing and render
         parts.append(self._render_compile_cache())
+        slo_text = self.slo.render_prometheus()
+        if slo_text:
+            parts.append(slo_text.rstrip("\n"))
         return "\n".join(parts) + "\n"
 
     @staticmethod
@@ -226,6 +324,8 @@ class ModelServer:
     # ------------------------------------------------------------ plumbing
     def start(self, port: int = 0, host: str = "127.0.0.1") -> int:
         srv = self
+        if self.worker_id is not None:
+            trace.set_process_tag(self.worker_id)
 
         class Handler(BaseHTTPRequestHandler):
             def _send(self, code: int, body: bytes, ctype: str,

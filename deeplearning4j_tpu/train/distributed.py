@@ -74,7 +74,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from deeplearning4j_tpu.native import TreeCodec
-from deeplearning4j_tpu.runtime import chaos
+from deeplearning4j_tpu.runtime import chaos, trace
 from deeplearning4j_tpu.runtime.compile_cache import AotCache
 from deeplearning4j_tpu.runtime.profiler import ExchangeStats
 from deeplearning4j_tpu.train.checkpoint import (CheckpointListener,
@@ -461,7 +461,21 @@ class DistributedTrainer:
     # ----------------------------------------------------------------- step
     def step(self, x: np.ndarray, y: np.ndarray) -> float:
         """One lock-step distributed step over one GLOBAL batch. Returns
-        the combined (mean-of-ranks) loss."""
+        the combined (mean-of-ranks) loss.
+
+        Tracing (ISSUE 9): each step runs inside a ``train.step`` span —
+        the :class:`ExchangeStats` stage hooks stamp the encode /
+        exchange / decode / apply split onto it as stage events, a chaos
+        fault at ``train.distributed.exchange`` is stamped by the
+        injector, and tail sampling keeps exactly the interesting steps."""
+        with trace.span("train.step") as tsp:
+            if tsp.recording:
+                tsp.set("rank", "loopback" if self.loopback else self.rank)
+                tsp.set("world", self.world)
+                tsp.set("step", int(self.net._iteration) + 1)
+            return self._step_inner(x, y)
+
+    def _step_inner(self, x: np.ndarray, y: np.ndarray) -> float:
         b = x.shape[0]
         if b % self.world:
             raise ValueError(f"global batch of {b} not divisible by "

@@ -33,11 +33,13 @@ import pytest  # noqa: E402
 
 import _round_record  # noqa: E402  (sibling module; pytest puts this dir on sys.path)
 
-# Thread names of the training pipeline's background stages (ISSUE 4).
-# Every fit()/close() path must join these; a survivor after a test means a
-# leaked stage (e.g. a prefetcher abandoned without close()).
+# Thread names of the training pipeline's background stages (ISSUE 4) and
+# the trace-collector fan-out fetchers (ISSUE 9: the router's /v1/traces
+# and fleet-/metrics aggregation joins its per-worker fetch threads before
+# returning). Every fit()/close()/aggregate path must join these; a
+# survivor after a test means a leaked stage.
 _PIPELINE_THREAD_NAMES = ("train-prefetch", "train-listener-delivery",
-                          "async-dataset-iterator")
+                          "async-dataset-iterator", "trace-collector")
 
 
 # --------------------------------------------------------------------------

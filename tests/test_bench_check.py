@@ -509,3 +509,114 @@ def test_check_tables_quant_absent_is_warning(tmp_path):
     msgs = []
     assert bench.check_tables(str(md), str(extra), log=msgs.append) == 0
     assert any("quant" in m and "WARN" in m for m in msgs)
+
+
+def _trace_section():
+    """A self-consistent BENCH_EXTRA.json["trace"] section."""
+    return {
+        "off": {"qps": 430.0, "elapsed_s": 1.86, "ok": 800,
+                "bit_identical": True},
+        "sampled": {"qps": 425.7, "elapsed_s": 1.88, "ok": 800,
+                    "bit_identical": True},
+        "overhead_pct": 1.0,
+        "sample_rate": 0.05,
+        "rate0_per_call_allocations": 0,
+        "span_cost_us": 12.8,
+        "kept_traces": 34,
+        "dropped_traces": 766,
+    }
+
+
+def _extra_with_trace(trace):
+    measured = {k: _mid(*rng) for k, rng in bench.RECORDED_RANGES.items()}
+    measured["trace"] = trace
+    measured["trace_overhead_pct"] = trace.get("overhead_pct")
+    return measured
+
+
+def test_check_tables_validates_trace_section(tmp_path):
+    """ISSUE 9 satellite: --check-tables covers the trace keys — a
+    self-consistent recorded section passes, and each drift class
+    (overhead not recomputable from the arm qps rows, overhead over the
+    3% bound, a non-allocation-free rate-0 path, non-bit-identical arms,
+    a sampled arm that never traced, stale top-level copies, missing
+    keys) fails loudly."""
+    md = tmp_path / "BASELINE.md"
+    md.write_text(_table_md(bench.RECORDED_RANGES))
+    extra = tmp_path / "BENCH_EXTRA.json"
+
+    extra.write_text(json.dumps(_extra_with_trace(_trace_section())))
+    assert bench.check_tables(str(md), str(extra), log=lambda *a: None) == 0
+
+    # claimed overhead not derivable from the recorded arm qps rows
+    tr = _trace_section()
+    tr["overhead_pct"] = 2.5
+    ex = _extra_with_trace(tr)
+    extra.write_text(json.dumps(ex))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("trace.overhead_pct" in m and "give" in m for m in msgs)
+
+    # a recorded run over the 3% bound is a recorded regression
+    tr = _trace_section()
+    tr["sampled"]["qps"] = 400.0
+    tr["overhead_pct"] = round((1 - 400.0 / 430.0) * 100, 2)
+    ex = _extra_with_trace(tr)
+    extra.write_text(json.dumps(ex))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("3% acceptance bound" in m for m in msgs)
+
+    # the rate-0 fast path must never have allocated per call
+    tr = _trace_section()
+    tr["rate0_per_call_allocations"] = 2
+    extra.write_text(json.dumps(_extra_with_trace(tr)))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("rate0_per_call_allocations" in m for m in msgs)
+
+    # a non-bit-identical arm invalidates the whole comparison
+    tr = _trace_section()
+    tr["sampled"]["bit_identical"] = False
+    extra.write_text(json.dumps(_extra_with_trace(tr)))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("bit_identical" in m for m in msgs)
+
+    # an on arm that completed zero traces was not actually tracing
+    tr = _trace_section()
+    tr["kept_traces"] = tr["dropped_traces"] = 0
+    extra.write_text(json.dumps(_extra_with_trace(tr)))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("not actually tracing" in m for m in msgs)
+
+    # stale top-level copies are doc drift
+    ex = _extra_with_trace(_trace_section())
+    ex["trace_overhead_pct"] = 0.1
+    extra.write_text(json.dumps(ex))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("trace_overhead_pct" in m and "top-level" in m for m in msgs)
+
+    # a missing required key is reported, not crashed over
+    tr = _trace_section()
+    del tr["rate0_per_call_allocations"]
+    extra.write_text(json.dumps(_extra_with_trace(tr)))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("trace.rate0_per_call_allocations" in m and "missing" in m
+               for m in msgs)
+
+
+def test_check_tables_trace_absent_is_warning(tmp_path):
+    """No --trace-overhead run recorded yet -> warn, don't fail (same
+    contract as the other optional sections)."""
+    md = tmp_path / "BASELINE.md"
+    md.write_text(_table_md(bench.RECORDED_RANGES))
+    measured = {k: _mid(*rng) for k, rng in bench.RECORDED_RANGES.items()}
+    extra = tmp_path / "BENCH_EXTRA.json"
+    extra.write_text(json.dumps(measured))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 0
+    assert any("trace" in m and "WARN" in m for m in msgs)

@@ -340,9 +340,11 @@ def test_model_server_surfaces_retry_after_headers():
         def names(self):
             return ["m"]
 
+    from deeplearning4j_tpu.serving.slo import SLOMonitor
     server = ModelServer.__new__(ModelServer)
     server.registry = _FakeRegistry()
     server.worker_id = "w-test"
+    server.slo = SLOMonitor()
     code, obj, hdrs = server._handle_predict(
         "m", json.dumps({"inputs": [[1.0]]}).encode())
     assert code == 503
