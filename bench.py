@@ -220,6 +220,12 @@ def check_tables(baseline_md=None, bench_extra=None, log=_log):
     if measured is not None:
         check_trace_section(measured, failures, warnings)
 
+    # ISSUE 10 autoscale keys: zero-error bit-identical closed-loop drill,
+    # scale-up within the recorded tick budget, cooldown-respecting
+    # scale-down, zero on-traffic compiles
+    if measured is not None:
+        check_autoscale_section(measured, failures, warnings)
+
     for w in warnings:
         log(f"[check-tables] WARN {w}")
     for fmsg in failures:
@@ -2713,6 +2719,286 @@ def bench_trace_overhead(n_threads=16, per_thread=50, rate=0.05,
     return 0
 
 
+def bench_autoscale(bench_extra=None, log=_log):
+    """``bench.py --autoscale`` (ISSUE 10): the closed-loop SLO-feedback
+    acceptance drill over the real serving stack (HTTP into a
+    ``ModelServer`` behind a ``FleetRouter``, the router's fleet-wide
+    ``SLOMonitor`` as the signal, the ``SLOAutoscaler`` stepped at a
+    fixed control cadence so the timeline is deterministic):
+
+    1. a seeded straggler chaos profile (``AddLatency`` on
+       ``serving.worker.predict``) breaches the fast-window latency burn
+       rate; the drill records the first breach tick;
+    2. the autoscaler must scale up — a manifest-warmed replica on the
+       serving worker — within ``tick_budget`` control ticks of that
+       breach (multi-window confirm included);
+    3. the profile clears; traffic continues; the worker must mint ZERO
+       executables on live traffic after the scale (the replica was
+       warmed at scale time);
+    4. burn recovers; the scale-down must fire only after the configured
+       cooldown.
+
+    Asserted before the artifact is written: zero client-visible errors,
+    every response bit-identical to the oracle model, scale-up within
+    budget, zero on-traffic compiles, cooldown respected. Results ->
+    ``BENCH_EXTRA.json["autoscale"]`` + top-level
+    ``autoscale_ticks_to_scale`` (validated by ``--check-tables``)."""
+    import urllib.request
+
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.runtime.chaos import AddLatency, ChaosController
+    from deeplearning4j_tpu.serving import (AutoscalerConfig, ModelRegistry,
+                                            ModelServer, SLOAutoscaler,
+                                            SLOMonitor)
+    from deeplearning4j_tpu.serving.router import FleetRouter, StaticFleet
+    from deeplearning4j_tpu.serving.slo import SLOTarget
+
+    def conf(s=7):
+        return (NeuralNetConfiguration.builder().seed(s).updater(None)
+                .list()
+                .layer(DenseLayer(n_out=32, activation="tanh"))
+                .layer(OutputLayer(n_out=8, activation="softmax"))
+                .set_input_type(InputType.feed_forward(16)).build())
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (8, 16)).astype(np.float32)
+    reg = ModelRegistry()
+    reg.register("m", MultiLayerNetwork(conf()).init(),
+                 warmup_example=x[:1], max_batch_size=4, buckets=[1, 4],
+                 batch_timeout_ms=1.0, pipeline_depth=0)
+    served = reg.get("m")
+    oracle = np.asarray(served.model.output(
+        np.concatenate([x[:2], np.zeros((2, 16), x.dtype)])))[:2]
+    base_compiles = served.batcher.compile_count()
+    srv = ModelServer(reg, worker_id="bench-as")
+    addr = f"127.0.0.1:{srv.start(0)}"
+    slo = SLOMonitor(target=SLOTarget(availability=0.999, latency_ms=30.0,
+                                      latency_target=0.9),
+                     windows_s=(1, 2, 3600))
+    router = FleetRouter(StaticFleet({"w0": addr}), probe_interval_s=0.05,
+                         hedge_enabled=False, slo=slo)
+    port = router.start(0)
+    cfg = AutoscalerConfig(tick_s=0.1, fast_window_s=1, slow_window_s=2,
+                           up_burn=2.0, confirm_burn=1.0, down_burn=0.5,
+                           up_cooldown_s=0.5, down_cooldown_s=1.5,
+                           min_requests=5, max_replicas=2)
+    auto = SLOAutoscaler(router, config=cfg)
+    router.attach_autoscaler(auto)
+    tick_budget = 100
+    failures, outputs = [], []
+    errors = requests_total = 0
+
+    def post():
+        nonlocal errors, requests_total
+        requests_total += 1
+        body = json.dumps({"inputs": x[:2].tolist(),
+                           "timeout_ms": 15000}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/m/predict", data=body)
+        try:
+            resp = urllib.request.urlopen(req, timeout=30)
+            outputs.append(np.asarray(json.loads(resp.read())["outputs"],
+                                      np.float32))
+        except Exception as e:
+            errors += 1
+            log(f"[autoscale] request error: {e!r}")
+
+    def fast_burn():
+        rep = slo.report().get("m")
+        if rep is None:
+            return 0.0
+        w = rep["windows"][f"{cfg.fast_window_s}s"]
+        return max(w["availability_burn_rate"], w["latency_burn_rate"])
+
+    breach_tick = up_tick = None
+    up = down = None
+    try:
+        with ChaosController(seed=5) as c:
+            c.on("serving.worker.predict", AddLatency(0.08, p=0.7))
+            deadline = time.monotonic() + 45
+            while up is None and time.monotonic() < deadline:
+                post()
+                if breach_tick is None and fast_burn() >= cfg.up_burn:
+                    breach_tick = auto.ticks + 1  # the tick that sees it
+                for d in auto.tick():
+                    if d["action"] == "scale_up_replica" and d["ok"]:
+                        up, up_tick = d, auto.ticks
+        if up is not None and breach_tick is None:
+            breach_tick = up_tick  # burn crossed between sample and tick
+        if up is None:
+            failures.append(f"no scale-up within 45s "
+                            f"({auto.ticks} control ticks)")
+        elif up_tick - breach_tick > tick_budget:
+            failures.append(
+                f"scale-up took {up_tick - breach_tick} control ticks "
+                f"from the first breach (budget {tick_budget})")
+        compiles_at_scale = (up or {}).get("detail", {}).get("compile_count")
+        if up is not None and compiles_at_scale != \
+                base_compiles + len(served.batcher.buckets):
+            failures.append(
+                f"scale-up warmed {compiles_at_scale} executables, "
+                f"expected {base_compiles + len(served.batcher.buckets)} "
+                f"(one per bucket on the new replica)")
+
+        # profile cleared: healthy traffic, then recovery -> scale-down
+        for _ in range(10):
+            post()
+        on_traffic = (served.batcher.compile_count() - compiles_at_scale
+                      if up is not None else None)
+        if on_traffic:
+            failures.append(f"{on_traffic} executables minted on live "
+                            f"traffic after the scale-up")
+        deadline = time.monotonic() + 45
+        while down is None and up is not None and \
+                time.monotonic() < deadline:
+            post()
+            for d in auto.tick():
+                if d["action"] == "scale_down_replica" and d["ok"]:
+                    down = d
+            time.sleep(0.05)
+        if down is None:
+            failures.append("no cooldown-respecting scale-down within 45s")
+        elif down["ts"] - up["ts"] < cfg.down_cooldown_s - 0.05:
+            failures.append(
+                f"scale-down fired {down['ts'] - up['ts']:.2f}s after the "
+                f"scale-up — inside the {cfg.down_cooldown_s}s cooldown")
+    finally:
+        router.stop()
+        srv.stop(shutdown_registry=True)
+
+    wrong = sum(1 for got in outputs if not np.array_equal(got, oracle))
+    if wrong:
+        failures.append(f"{wrong}/{len(outputs)} responses not "
+                        f"bit-identical to the oracle")
+    if errors:
+        failures.append(f"{errors} client-visible errors during the drill")
+    for fmsg in failures:
+        log(f"[autoscale] FAIL {fmsg}")
+    if failures:
+        return 1  # a failing run cannot write the artifact
+
+    results = {
+        "requests_total": requests_total,
+        "errors": errors,
+        "bit_identical": wrong == 0,
+        "control_ticks": auto.ticks,
+        "tick_budget": tick_budget,
+        "breach_tick": breach_tick,
+        "scale_up_tick": up_tick,
+        "ticks_from_breach": up_tick - breach_tick,
+        "on_traffic_compiles": 0,
+        "scale_up": {
+            "burn_fast": up["burn"]["burn_fast"],
+            "burn_slow": up["burn"]["burn_slow"],
+            "replicas_after": up["detail"]["replicas"],
+            "compile_count": compiles_at_scale,
+            "headroom_bytes": up["capacity"]["headroom_bytes"],
+            "replica_cost_bytes": up["capacity"]["replica_cost_bytes"],
+        },
+        "scale_down": {
+            "burn_fast": down["burn"]["burn_fast"],
+            "replicas_after": down["detail"]["replicas"],
+            "elapsed_since_up_s": round(down["ts"] - up["ts"], 3),
+        },
+        "config": {
+            "up_burn": cfg.up_burn, "confirm_burn": cfg.confirm_burn,
+            "down_burn": cfg.down_burn,
+            "up_cooldown_s": cfg.up_cooldown_s,
+            "down_cooldown_s": cfg.down_cooldown_s,
+            "fast_window_s": cfg.fast_window_s,
+            "slow_window_s": cfg.slow_window_s,
+        },
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    bench_extra = bench_extra or os.path.join(here, "BENCH_EXTRA.json")
+    try:
+        with open(bench_extra) as f:
+            extra = json.load(f)
+    except Exception:
+        extra = {}
+    extra["autoscale"] = results
+    extra["autoscale_ticks_to_scale"] = results["ticks_from_breach"]
+    with open(bench_extra, "w") as f:
+        json.dump(extra, f, indent=2)
+    log(f"[autoscale] OK: breach at tick {breach_tick}, scale-up at tick "
+        f"{up_tick} (+{results['ticks_from_breach']}), scale-down "
+        f"{results['scale_down']['elapsed_since_up_s']}s later "
+        f"(cooldown {cfg.down_cooldown_s}s), {requests_total} requests, "
+        f"0 errors, all bit-identical, 0 on-traffic compiles")
+    return 0
+
+
+def check_autoscale_section(extra, failures, warnings):
+    """--check-tables coverage for the ISSUE 10 keys: the ``autoscale``
+    section (when present) must record a zero-error bit-identical drill,
+    a scale-up within its own recorded tick budget (recomputable from
+    the breach/scale-up tick rows), zero on-traffic compiles, a
+    cooldown-respecting scale-down (recomputable against the recorded
+    config), the replica counts both ways, and an in-sync top-level
+    copy."""
+    if "autoscale" not in extra:
+        warnings.append("autoscale: not present in BENCH_EXTRA.json "
+                        "(bench --autoscale not run?)")
+        return
+    d = extra["autoscale"]
+    required = ["requests_total", "errors", "bit_identical", "tick_budget",
+                "breach_tick", "scale_up_tick", "ticks_from_breach",
+                "on_traffic_compiles", "scale_up", "scale_down", "config"]
+    for k in required:
+        if k not in d:
+            failures.append(f"autoscale.{k}: missing from the recorded "
+                            f"section")
+    if any(k not in d for k in required):
+        return
+    try:
+        if d["errors"] != 0:
+            failures.append(f"autoscale.errors: {d['errors']} — the drill "
+                            f"must be client-invisible")
+        if d["bit_identical"] is not True:
+            failures.append("autoscale.bit_identical: the recorded run was "
+                            "not bit-identical to its oracle")
+        ticks = d["scale_up_tick"] - d["breach_tick"]
+        if ticks != d["ticks_from_breach"]:
+            failures.append(
+                f"autoscale.ticks_from_breach: claims "
+                f"{d['ticks_from_breach']}, recorded tick rows give {ticks}")
+        if d["ticks_from_breach"] > d["tick_budget"]:
+            failures.append(
+                f"autoscale.ticks_from_breach: {d['ticks_from_breach']} "
+                f"over the recorded budget {d['tick_budget']}")
+        if d["on_traffic_compiles"] != 0:
+            failures.append(
+                f"autoscale.on_traffic_compiles: "
+                f"{d['on_traffic_compiles']} — a scaled-up replica "
+                f"compiled on live traffic")
+        if d["scale_up"]["replicas_after"] != 2 or \
+                d["scale_down"]["replicas_after"] != 1:
+            failures.append(
+                f"autoscale: replica counts {d['scale_up']['replicas_after']}"
+                f"->{d['scale_down']['replicas_after']}, expected 2->1")
+        if d["scale_up"]["burn_fast"] < d["config"]["up_burn"]:
+            failures.append(
+                f"autoscale.scale_up.burn_fast "
+                f"{d['scale_up']['burn_fast']} under the trigger "
+                f"{d['config']['up_burn']} — the recorded breach never "
+                f"breached")
+        if d["scale_down"]["elapsed_since_up_s"] < \
+                d["config"]["down_cooldown_s"] - 0.05:
+            failures.append(
+                f"autoscale.scale_down: fired "
+                f"{d['scale_down']['elapsed_since_up_s']}s after scale-up, "
+                f"inside the {d['config']['down_cooldown_s']}s cooldown")
+        if extra.get("autoscale_ticks_to_scale") != d["ticks_from_breach"]:
+            failures.append(
+                f"autoscale_ticks_to_scale: top-level copy "
+                f"{extra.get('autoscale_ticks_to_scale')} != autoscale "
+                f"section {d['ticks_from_breach']}")
+    except (TypeError, ValueError, AttributeError, KeyError) as e:
+        failures.append(f"autoscale: malformed section ({e!r})")
+
+
 def check_trace_section(extra, failures, warnings):
     """--check-tables coverage for the ISSUE 9 keys: the ``trace``
     section (when present) must carry both arms, the claimed overhead
@@ -3172,6 +3458,8 @@ if __name__ == "__main__":
         sys.exit(bench_quant())
     if "--trace-overhead" in sys.argv:
         sys.exit(bench_trace_overhead())
+    if "--autoscale" in sys.argv:
+        sys.exit(bench_autoscale())
     if "--serving" in sys.argv:
         # give the CPU backend multiple virtual devices so the replica arm
         # is real even off-TPU (flag only affects the host platform; must

@@ -266,6 +266,17 @@ class AotCache:
     def clear(self) -> None:
         self._entries.clear()
 
+    def evict(self, pred) -> int:
+        """Drop every entry whose key satisfies ``pred`` (ISSUE 10: a
+        retired serving replica's executables leave the ledger so
+        ``compile_count`` keeps describing the LIVE pool). Returns the
+        number evicted. An execution already dispatched through an
+        evicted entry is unaffected — eviction only forgets the handle."""
+        dead = [k for k in list(self._entries) if pred(k)]
+        for k in dead:
+            self._entries.pop(k, None)
+        return len(dead)
+
     def call(self, key: Hashable, jitted, *args):
         if not aot_enabled():
             return jitted(*args)

@@ -272,6 +272,39 @@ def detach_quant_metrics(name: str) -> None:
     _QUANT_METRICS.pop(str(name), None)
 
 
+_CAPACITY_PROVIDER = None
+
+
+def attach_capacity(provider) -> None:
+    """Register a capacity provider (a zero-arg callable returning the
+    ``serving/capacity.py`` registry payload — ISSUE 10) so profiling
+    tooling can read per-model resource accounting without holding a
+    registry reference. Called by ``ModelServer.start``; the newest
+    provider wins (mirrors :func:`attach_router`)."""
+    global _CAPACITY_PROVIDER
+    _CAPACITY_PROVIDER = provider
+
+
+def detach_capacity(provider=None) -> None:
+    """Drop the attached capacity provider. When ``provider`` is given,
+    detach only if it is still the CURRENT one — a stopping server must
+    not clobber a newer server's attachment (``ModelServer.stop`` passes
+    its own provider)."""
+    global _CAPACITY_PROVIDER
+    if provider is None or _CAPACITY_PROVIDER is provider:
+        _CAPACITY_PROVIDER = None
+
+
+def capacity_stats() -> Dict[str, object]:
+    """The attached registry's capacity ledger (per-model parameter /
+    device bytes, replica utilization, queue headroom, compile footprint
+    — the same payload ``/v1/capacity`` serves). Empty dict when no
+    serving registry is attached."""
+    if _CAPACITY_PROVIDER is None:
+        return {}
+    return _CAPACITY_PROVIDER()
+
+
 def device_memory_stats() -> Dict[str, Dict[str, int]]:
     """Per-device memory stats — feeds the HBM crash report (§5.5 parity)."""
     out = {}

@@ -69,7 +69,8 @@ __all__ = [
     "span", "server_span", "current_span", "current_trace_id", "collector",
     "flag_current", "annotate_current", "stamp_chaos", "stage_event",
     "merge_traces", "span_tree", "to_chrome_trace", "set_process_tag",
-    "access_log_enabled", "emit_access_log", "NOOP",
+    "access_log_enabled", "emit_access_log", "bound_traces",
+    "TRACES_RESPONSE_BYTE_CAP", "NOOP",
 ]
 
 _CURRENT: "contextvars.ContextVar[Optional[Span]]" = \
@@ -561,6 +562,57 @@ def to_chrome_trace(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
+# ------------------------------------------------------------ read bounding
+#: hard cap on one ``/v1/traces`` response body (serialized record bytes):
+#: a scrape of a full ring must never produce an unbounded HTTP body — a
+#: 256-slot ring of deep fleet traces can reach tens of MB (ISSUE 10).
+TRACES_RESPONSE_BYTE_CAP = 4 * 1024 * 1024
+
+
+def _record_newest_ts(rec: Dict[str, Any]) -> float:
+    return max((s.get("start_ts") or 0.0 for s in rec.get("spans", ())),
+               default=0.0)
+
+
+def bound_traces(records: Iterable[Dict[str, Any]],
+                 limit: Optional[int] = None,
+                 since: Optional[float] = None,
+                 max_bytes: Optional[int] = None):
+    """Bound a trace-record read (the ``/v1/traces`` handlers' shared
+    selection): ``since`` keeps records whose newest span started at or
+    after the given wall-clock time, ``limit`` keeps the newest N, and
+    the serialized size of what remains is capped at ``max_bytes``
+    (default :data:`TRACES_RESPONSE_BYTE_CAP`) by dropping oldest-first —
+    the newest record is always returned even if it alone exceeds the
+    cap, so a scrape can never come back empty-but-truncated. Returns
+    ``(records_oldest_first, truncated)``. Records are (re)ordered by
+    their newest span's start time first, so "newest N" means newest in
+    TIME even when the input interleaves several processes' records
+    (the router's merge orders by *earliest* span — an overlapping
+    long-lived trace would otherwise outrank a genuinely newer one)."""
+    recs = sorted(records, key=_record_newest_ts)
+    if since is not None:
+        recs = [r for r in recs if _record_newest_ts(r) >= float(since)]
+    truncated = False
+    if limit is not None and limit >= 0 and len(recs) > int(limit):
+        truncated = True
+        recs = recs[len(recs) - int(limit):]
+    cap = TRACES_RESPONSE_BYTE_CAP if max_bytes is None else int(max_bytes)
+    total, kept = 0, []
+    for r in reversed(recs):               # newest first
+        size = len(json.dumps(r, default=str).encode())
+        if kept and total + size > cap:
+            truncated = True
+            break
+        kept.append(r)
+        total += size
+        if total > cap:                    # single over-cap record: keep it
+            truncated = truncated or len(kept) < len(recs)
+            break
+    kept.reverse()
+    return kept, truncated
+
+
 # --------------------------------------------------------------- access log
 def access_log_enabled() -> bool:
     """The ``DL4J_TPU_ACCESS_LOG`` env knob (off by default): one
@@ -585,12 +637,40 @@ def emit_access_log(record: Dict[str, Any]) -> None:
 # env bootstrap: DL4J_TPU_TRACE=<rate> enables tracing at import (fleet
 # worker subprocesses inherit the parent's env, so one knob traces the
 # whole fleet; 0/absent keeps the no-op fast path; bare truthy spellings
-# mean rate 1.0, matching the DL4J_TPU_ACCESS_LOG knob's convention)
-_env_rate = os.environ.get("DL4J_TPU_TRACE", "").strip().lower()
-if _env_rate not in ("", "0", "0.0", "false", "off", "no"):
-    try:
-        enable(rate=1.0 if _env_rate in ("true", "on", "yes")
-               else float(_env_rate))
-    except ValueError:
-        pass
-del _env_rate
+# mean rate 1.0, matching the DL4J_TPU_ACCESS_LOG knob's convention).
+# DL4J_TPU_TRACE_SLOW_MS=<ms> sets the worker-side slow threshold — and
+# by itself enables tracing at rate 0, the shape that closes PR 9's
+# documented per-process tail-sampling gap: a slow-but-healthy hedge
+# LOSER has nothing local to flag, so the straggling worker's half of
+# the trace self-keeps by flagging itself `slow` even at rate 0.
+def _env_config(environ) -> Optional[tuple]:
+    """Parse the two env knobs into ``(rate, latency_threshold_ms)``, or
+    ``None`` when tracing should stay on the no-op fast path. Pure so
+    the precedence rules are unit-testable without re-importing."""
+    rate_s = environ.get("DL4J_TPU_TRACE", "").strip().lower()
+    slow_s = environ.get("DL4J_TPU_TRACE_SLOW_MS", "").strip()
+    slow_ms: Optional[float] = None
+    if slow_s:
+        try:
+            slow_ms = float(slow_s)
+        except ValueError:
+            slow_ms = None
+        if slow_ms is not None and slow_ms <= 0:
+            slow_ms = None
+    rate: Optional[float] = None
+    if rate_s not in ("", "0", "0.0", "false", "off", "no"):
+        try:
+            rate = 1.0 if rate_s in ("true", "on", "yes") else float(rate_s)
+        except ValueError:
+            rate = None
+        if rate is not None and not 0.0 <= rate <= 1.0:
+            rate = None
+    if rate is None and slow_ms is None:
+        return None
+    return (rate if rate is not None else 0.0, slow_ms)
+
+
+_env_cfg = _env_config(os.environ)
+if _env_cfg is not None:
+    enable(rate=_env_cfg[0], latency_threshold_ms=_env_cfg[1])
+del _env_cfg

@@ -42,6 +42,17 @@ The subsystem that puts traffic on this stack:
   dead worker, and zero-downtime rolling deploys over N supervised
   ``ModelServer`` worker processes (heartbeat + exit-code watchdog,
   budgeted restarts, manifest-prewarmed relaunches).
+- :class:`SLOMonitor` (``slo.py``) and ``capacity.py`` — the telemetry
+  pair (ISSUES 9–10): per-model SLO attainment / multi-window burn rates
+  and per-model resource accounting (parameter/device bytes by dtype,
+  replica utilization, queue headroom, compile footprint) on
+  ``/v1/slo`` + ``/v1/capacity``, fleet-aggregated at the router.
+- :class:`SLOAutoscaler` (``autoscale.py``) — the closed loop (ISSUE 10,
+  ``docs/observability.md``): a control thread at the router consuming
+  burn rates + capacity headroom, driving runtime ``ReplicaPool`` resize
+  (manifest-warmed, zero on-traffic compiles) and fleet worker count,
+  with hysteresis, cooldowns, a capacity guard, and a traced, bounded
+  decision log on ``/v1/autoscaler``.
 - :class:`WarmupManifest` (``manifest.py``) — persisted record of every
   compiled (bucket, replica, dtype) pair, written next to model archives
   and replayed by registry load / hot-swap so a restart reaches READY
@@ -62,8 +73,14 @@ _EXPORTS = {
     "Overloaded": "admission",
     "ServingError": "admission",
     "ServingShutdown": "admission",
+    "AutoscalerConfig": "autoscale",
+    "SLOAutoscaler": "autoscale",
     "ContinuousBatcher": "batcher",
     "default_buckets": "batcher",
+    "model_capacity": "capacity",
+    "registry_capacity": "capacity",
+    "SLOMonitor": "slo",
+    "SLOTarget": "slo",
     "LatencyHistogram": "metrics",
     "ServingMetrics": "metrics",
     "ModelRegistry": "registry",

@@ -1,0 +1,541 @@
+"""SLO-feedback autoscaler: the telemetry loop closed (ISSUE 10 tentpole;
+ROADMAP item 2 — "grow or shrink ReplicaPool replicas, and fleet size,
+from the router's burn-rate signals").
+
+PR 9's :class:`~deeplearning4j_tpu.serving.slo.SLOMonitor` computes
+per-model multi-window burn rates fleet-wide at the router; PR 10's
+``serving/capacity.py`` accounts what a scaling decision would spend.
+:class:`SLOAutoscaler` is the control loop that makes both pay their way:
+a thread at the router that, each tick, reads the burn rates and the
+capacity headroom and drives two levers —
+
+- **replica resize**: ``POST /v1/models/<name>/replicas`` against the
+  worker currently ranked #1 for the model (the one its traffic
+  concentrates on under rendezvous routing) — the worker grows/shrinks
+  its :class:`~deeplearning4j_tpu.serving.replica.ReplicaPool` at
+  runtime, each new replica warmed from the live
+  :class:`~deeplearning4j_tpu.serving.manifest.WarmupManifest` BEFORE it
+  takes traffic (zero on-traffic compiles);
+- **fleet resize**: :meth:`FleetSupervisor.add_worker` /
+  :meth:`~deeplearning4j_tpu.serving.fleet.FleetSupervisor.remove_worker`
+  with a cloned :class:`WorkerSpec` — the router's existing ``/readyz``
+  prober readmits the newcomer, nothing new to integrate.
+
+Control policy (``docs/observability.md`` has the runbook):
+
+- **Multi-window burn**: scale-up requires the FAST window's burn rate
+  over ``up_burn`` (trigger) AND the SLOW window's over ``confirm_burn``
+  (confirm) — a one-second blip cannot trigger, a sustained breach
+  cannot hide. The burn signal is ``max(availability_burn,
+  latency_burn)``.
+- **Hysteresis + cooldown**: scale-down requires BOTH windows under
+  ``down_burn`` (strictly below the trigger band) and fires only after
+  ``down_cooldown_s`` since the last action; scale-ups are themselves
+  rate-limited by ``up_cooldown_s``. The gap between ``up_burn`` and
+  ``down_burn`` plus the cooldowns make flapping impossible: there is no
+  burn trajectory that alternates actions faster than the cooldowns.
+- **Capacity guard**: before any scale-up the aggregated capacity
+  accounting is consulted — a new replica costs the model's measured
+  ``param_bytes + model_state_bytes`` on the target worker, and the
+  guard refuses to scale past the memory budget
+  (``memory_budget_bytes``, else the worker's measured device budget
+  where the backend reports one). The refusal is itself a logged,
+  explained decision.
+- **Unwind discipline**: the autoscaler only scales down what IT scaled
+  up (a per-model action stack), so a hand-provisioned baseline is never
+  eroded below ``min_replicas``/the launch fleet.
+
+Every decision — acted, refused by the guard, or deferred by a cooldown —
+is an explained, traced event: a bounded log records the triggering
+burn-rate snapshot (both windows), the capacity headroom consulted, the
+action and its outcome, and the active trace id (decision spans carry the
+``autoscale`` flag so tail sampling always keeps them). ``GET
+/v1/autoscaler`` on the router serves the log, so "why did the fleet grow
+at 14:32" is answerable after the fact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from deeplearning4j_tpu.runtime import trace
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["AutoscalerConfig", "SLOAutoscaler"]
+
+
+@dataclasses.dataclass
+class AutoscalerConfig:
+    """Control-policy knobs (defaults are the production shape; drills
+    and tests shrink the windows/cooldowns via the injectable clock)."""
+
+    tick_s: float = 1.0
+    #: burn-rate windows (must be members of the monitor's ``windows_s``)
+    fast_window_s: int = 60
+    slow_window_s: int = 300
+    #: fast window triggers at this burn rate...
+    up_burn: float = 2.0
+    #: ...and the slow window must confirm at this one
+    confirm_burn: float = 1.0
+    #: both windows must sit under this (strictly below the trigger band:
+    #: the hysteresis gap) before a scale-down is considered
+    down_burn: float = 0.5
+    up_cooldown_s: float = 30.0
+    down_cooldown_s: float = 120.0
+    #: a fast window with fewer requests than this cannot trigger (burn
+    #: over 3 requests is noise, not an outage)
+    min_requests: int = 8
+    min_replicas: int = 1
+    max_replicas: int = 8
+    #: fleet lever: ``None`` disables worker scaling entirely
+    max_workers: Optional[int] = None
+    #: capacity guard budget; ``None`` falls back to the target worker's
+    #: measured device budget (backends that report one), else unbounded
+    memory_budget_bytes: Optional[int] = None
+    #: decision-log ring size
+    log_capacity: int = 256
+    #: socket budget for the replica lever (warmup compiles take seconds)
+    lever_timeout_s: float = 120.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+class _ModelState:
+    """Per-model controller state."""
+
+    __slots__ = ("actions", "last_action_ts", "suppressed")
+
+    def __init__(self):
+        self.actions: List[tuple] = []   # stack of ("replica"|"worker", wid)
+        self.last_action_ts = float("-inf")
+        self.suppressed: Optional[str] = None  # dedup key for skip logging
+
+    @property
+    def level(self) -> int:
+        return len(self.actions)
+
+
+class SLOAutoscaler:
+    """Closed-loop controller over a
+    :class:`~deeplearning4j_tpu.serving.router.FleetRouter`'s burn-rate
+    and capacity telemetry.
+
+    ``router`` supplies the SLO monitor (fleet-wide by construction),
+    the worker ranking, and the capacity aggregation; ``fleet`` (a
+    :class:`~deeplearning4j_tpu.serving.fleet.FleetSupervisor`) enables
+    the worker lever when given. ``replica_lever`` / ``worker_lever``
+    are injectable for unit tests — production uses the HTTP scale
+    endpoint and the supervisor.
+
+    :meth:`start` runs :meth:`tick` on a daemon control thread named
+    ``slo-autoscaler`` (covered by the conftest thread-leak guard);
+    :meth:`tick` is public so drills can step the loop deterministically.
+    """
+
+    def __init__(self, router, fleet=None,
+                 config: Optional[AutoscalerConfig] = None,
+                 models: Optional[List[str]] = None,
+                 capacity_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+                 replica_lever: Optional[Callable] = None,
+                 worker_lever: Optional[Callable] = None,
+                 now_fn: Callable[[], float] = time.monotonic):
+        self.router = router
+        self.fleet = fleet
+        self.config = config or AutoscalerConfig()
+        cfg = self.config
+        # coerce the window knobs: SLOMonitor.report keys windows as
+        # f"{int(w)}s", so a float 60.0 here would pass the membership
+        # check below (60.0 == 60) yet miss every lookup ("60.0s") and
+        # silently disable the controller
+        cfg.fast_window_s = int(cfg.fast_window_s)
+        cfg.slow_window_s = int(cfg.slow_window_s)
+        windows = getattr(router.slo, "windows_s", ())
+        for w in (cfg.fast_window_s, cfg.slow_window_s):
+            if w not in windows:
+                raise ValueError(
+                    f"autoscaler window {w}s is not one of the SLO "
+                    f"monitor's windows {windows} — the burn rates it "
+                    f"would read do not exist")
+        if cfg.fast_window_s >= cfg.slow_window_s:
+            raise ValueError(
+                f"fast window ({cfg.fast_window_s}s) must be shorter than "
+                f"the slow confirm window ({cfg.slow_window_s}s)")
+        if cfg.down_burn >= min(cfg.up_burn, cfg.confirm_burn):
+            raise ValueError(
+                f"down_burn ({cfg.down_burn}) must sit strictly below the "
+                f"trigger band (up {cfg.up_burn} / confirm "
+                f"{cfg.confirm_burn}) — no hysteresis gap means flapping")
+        self._models_filter = set(models) if models else None
+        self._capacity_fn = (capacity_fn if capacity_fn is not None
+                             else getattr(router, "fleet_capacity",
+                                          lambda: {}))
+        self._replica_lever = replica_lever or self._http_scale_replicas
+        self._worker_lever = worker_lever
+        self._now = now_fn
+        self._states: Dict[str, _ModelState] = {}
+        self._lock = threading.Lock()
+        self.decisions: deque = deque(maxlen=cfg.log_capacity)
+        self.ticks = 0
+        self._tick_capacity: Optional[Dict[str, Any]] = None
+        self._worker_seq = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- levers
+    def _http_scale_replicas(self, view, model: str, delta: int, span):
+        """Production replica lever: the worker's scale endpoint, driven
+        with a RELATIVE ``delta`` — the worker applies it to its own live
+        replica count under its resize lock, so a stale (or missing)
+        capacity scrape can never turn a scale-up into an absolute
+        scale-down. The decision span's ids ride the headers so the
+        worker-side ``worker.scale_replicas`` span joins the decision's
+        trace."""
+        host, port = view.address.rsplit(":", 1)
+        conn = http.client.HTTPConnection(
+            host, int(port), timeout=self.config.lever_timeout_s)
+        headers = {"Content-Type": "application/json"}
+        if span.recording:
+            headers["X-Trace-Id"] = span.trace_id
+            headers["X-Parent-Span-Id"] = span.span_id
+        try:
+            # the floor rides the request: the worker clamps the delta
+            # target against its LIVE count, so min_replicas holds even
+            # when the capacity scrape is stale
+            conn.request("POST", f"/v1/models/{model}/replicas",
+                         json.dumps({"delta": int(delta),
+                                     "floor": int(self.config.min_replicas)}
+                                    ).encode(), headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            try:
+                body = json.loads(data.decode())
+            except Exception:
+                body = {"raw": data.decode(errors="replace")[:200]}
+            return resp.status == 200, body
+        finally:
+            conn.close()
+
+    def _spawn_worker(self, base_view, span) -> tuple:
+        """Production worker lever (scale-up): clone the busiest worker's
+        spec under a fresh id and spawn it; the router's prober readmits
+        it through ``/readyz``."""
+        self._worker_seq += 1
+        new_id = f"{base_view.worker_id}-as{self._worker_seq}"
+        spec = self.fleet.clone_spec(base_view.worker_id, new_id)
+        self.fleet.add_worker(spec)
+        return True, {"worker_id": new_id}
+
+    # ---------------------------------------------------------- burn math
+    @staticmethod
+    def _burn(window: Dict[str, Any]) -> float:
+        return max(float(window.get("availability_burn_rate", 0.0)),
+                   float(window.get("latency_burn_rate", 0.0)))
+
+    def _capacity(self) -> Dict[str, Any]:
+        """The tick's capacity snapshot, scraped lazily (only ticks that
+        reach a decision pay for it) and at most once per tick."""
+        if self._tick_capacity is None:
+            try:
+                self._tick_capacity = self._capacity_fn()
+            except Exception:
+                logger.exception("autoscaler capacity scrape failed")
+                self._tick_capacity = {}
+        return self._tick_capacity
+
+    def _guard(self, model: str, view) -> tuple:
+        """Capacity guard: can the target worker afford one more replica
+        of ``model``? Returns ``(ok, headroom_record)`` — the record is
+        logged with the decision either way, so every decision shows the
+        headroom it consulted."""
+        cfg = self.config
+        cap = self._capacity()
+        worker = (cap.get("workers") or {}).get(
+            view.worker_id if view is not None else None, {})
+        entry = (worker.get("models") or {}).get(model, {})
+        needed = int(entry.get("param_bytes", 0)) + \
+            int(entry.get("model_state_bytes", 0))
+        in_use = int((worker.get("totals") or {}).get("device_bytes", 0))
+        budget = cfg.memory_budget_bytes
+        if budget is None:
+            budget = (worker.get("process") or {}).get("device_budget_bytes")
+        headroom = None if budget is None else int(budget) - in_use
+        record = {
+            "budget_bytes": budget,
+            "device_bytes_in_use": in_use,
+            "headroom_bytes": headroom,
+            "replica_cost_bytes": needed,
+            "replicas": entry.get("replicas"),
+            "utilization": entry.get("utilization"),
+            "queue": entry.get("queue"),
+        }
+        ok = headroom is None or headroom >= needed
+        return ok, record
+
+    # ------------------------------------------------------------ the loop
+    def tick(self) -> List[Dict[str, Any]]:
+        """One control iteration over every tracked model; returns the
+        decisions logged this tick (empty on a quiet tick)."""
+        self.ticks += 1
+        self._tick_capacity = None
+        try:
+            report = self.router.slo.report(
+                models=(sorted(self._models_filter)
+                        if self._models_filter else None))
+        except Exception:
+            logger.exception("autoscaler SLO read failed")
+            return []
+        out = []
+        for model in sorted(report):
+            d = self._decide(model, report[model])
+            if d is not None:
+                out.append(d)
+        return out
+
+    def _decide(self, model: str, rep: Dict[str, Any]
+                ) -> Optional[Dict[str, Any]]:
+        cfg = self.config
+        fast = rep.get("windows", {}).get(f"{cfg.fast_window_s}s")
+        slow = rep.get("windows", {}).get(f"{cfg.slow_window_s}s")
+        if fast is None or slow is None:
+            return None
+        burn_fast, burn_slow = self._burn(fast), self._burn(slow)
+        with self._lock:  # report() iterates _states under the same lock
+            st = self._states.setdefault(model, _ModelState())
+        now = self._now()
+        burn = {"fast_window_s": cfg.fast_window_s, "fast": fast,
+                "slow_window_s": cfg.slow_window_s, "slow": slow,
+                "burn_fast": burn_fast, "burn_slow": burn_slow}
+        breach = (int(fast.get("requests", 0)) >= cfg.min_requests
+                  and burn_fast >= cfg.up_burn
+                  and burn_slow >= cfg.confirm_burn)
+        recovered = (burn_fast <= cfg.down_burn
+                     and burn_slow <= cfg.down_burn)
+        if breach:
+            if now - st.last_action_ts < cfg.up_cooldown_s:
+                return self._log_suppressed(model, st, "up_cooldown", burn)
+            return self._act(model, st, burn, direction=+1)
+        if recovered and st.level > 0:
+            if now - st.last_action_ts < cfg.down_cooldown_s:
+                return self._log_suppressed(model, st, "down_cooldown", burn)
+            return self._act(model, st, burn, direction=-1)
+        st.suppressed = None
+        return None
+
+    # ----------------------------------------------------------- decisions
+    def _target_view(self, model: str):
+        now = time.monotonic()
+        for view in self.router.ranked_workers(model):
+            if view.admittable(now):
+                return view
+        return None
+
+    def _act(self, model: str, st: _ModelState, burn: Dict[str, Any],
+             direction: int) -> Optional[Dict[str, Any]]:
+        cfg = self.config
+        # the decision span: flagged so tail sampling ALWAYS keeps it —
+        # an autoscaling event is never a "healthy trace to drop"
+        sp = (trace.server_span("autoscaler.decision")
+              if trace.enabled() else trace.NOOP)
+        with sp:
+            if sp.recording:
+                sp.flag("autoscale")
+                sp.set("model", model)
+                sp.set("direction", direction)
+            view = self._target_view(model)
+            if view is None:
+                return self._log_suppressed(model, st, "no_healthy_worker",
+                                            burn, span=sp)
+            ok_guard, headroom = self._guard(model, view)
+            if direction > 0:
+                return self._scale_up(model, st, burn, view, ok_guard,
+                                      headroom, sp)
+            return self._scale_down(model, st, burn, view, headroom, sp)
+
+    def _scale_up(self, model, st, burn, view, ok_guard, headroom, sp):
+        cfg = self.config
+        if headroom.get("replicas") is None:
+            # no capacity entry for the target worker (scrape timed out
+            # or the worker just joined): a controller must not act
+            # blind — defer, explained, until the ledger is back
+            return self._log(model, st, "suppressed_no_capacity", burn,
+                             headroom, span=sp, ok=False,
+                             detail=f"no capacity data for worker "
+                                    f"{view.worker_id!r} this tick",
+                             dedup=True)
+        if not ok_guard:
+            return self._log(model, st, "suppressed_capacity_guard",
+                             burn, headroom, span=sp, ok=False,
+                             detail="scale-up refused: replica cost exceeds "
+                                    "memory headroom", dedup=True)
+        replicas = int(headroom["replicas"])
+        if replicas < cfg.max_replicas:
+            try:
+                ok, detail = self._replica_lever(view, model, +1, sp)
+            except Exception as e:
+                ok, detail = False, {"error": repr(e)}
+            if ok:
+                st.actions.append(("replica", view.worker_id))
+                st.last_action_ts = self._now()
+                st.suppressed = None
+            return self._log(model, st, "scale_up_replica", burn, headroom,
+                             span=sp, ok=ok, worker=view.worker_id,
+                             detail=detail)
+        if (self.fleet is not None and cfg.max_workers is not None
+                and len(self.router.workers()) < cfg.max_workers):
+            lever = self._worker_lever or self._spawn_worker
+            try:
+                ok, detail = lever(view, sp)
+            except Exception as e:
+                ok, detail = False, {"error": repr(e)}
+            if ok:
+                st.actions.append(("worker", detail.get("worker_id")))
+                st.last_action_ts = self._now()
+                st.suppressed = None
+            return self._log(model, st, "scale_up_worker", burn, headroom,
+                             span=sp, ok=ok, worker=view.worker_id,
+                             detail=detail)
+        return self._log(model, st, "suppressed_at_max", burn, headroom,
+                         span=sp, ok=False,
+                         detail=f"replicas={replicas} at max_replicas="
+                                f"{cfg.max_replicas} and no worker "
+                                f"headroom", dedup=True)
+
+    def _scale_down(self, model, st, burn, view, headroom, sp):
+        kind, wid = st.actions[-1]
+        if kind == "worker":
+            try:
+                self.fleet.remove_worker(wid)
+                ok, detail = True, {"worker_id": wid}
+            except Exception as e:
+                ok, detail = False, {"error": repr(e)}
+            if ok:
+                st.actions.pop()
+                st.last_action_ts = self._now()
+                st.suppressed = None
+            return self._log(model, st, "scale_down_worker", burn, headroom,
+                             span=sp, ok=ok, worker=wid, detail=detail)
+        # replica unwind: prefer the worker we scaled, fall back to the
+        # current target if it has since been replaced. The lever is a
+        # RELATIVE -1 applied to the worker's live count (floored at 1
+        # by the endpoint itself), so a stale scrape cannot collapse a
+        # multi-replica worker to the floor in one step.
+        target = self.router.workers().get(wid) or view
+        try:
+            ok, detail = self._replica_lever(target, model, -1, sp)
+        except Exception as e:
+            ok, detail = False, {"error": repr(e)}
+        if ok:
+            st.actions.pop()
+            st.last_action_ts = self._now()
+            st.suppressed = None
+        return self._log(model, st, "scale_down_replica", burn, headroom,
+                         span=sp, ok=ok, worker=target.worker_id,
+                         detail=detail)
+
+    # ------------------------------------------------------------- logging
+    def _log_suppressed(self, model, st, reason, burn, span=trace.NOOP):
+        """A deferred decision is logged ONCE per streak (the first tick
+        it would have acted), not once per tick — the log explains, it
+        does not spam."""
+        if st.suppressed == reason:
+            return None
+        st.suppressed = reason
+        return self._log(model, st, f"suppressed_{reason}", burn, None,
+                         span=span, ok=False,
+                         detail=f"deferred by {reason}")
+
+    def _log(self, model, st, action, burn, headroom, span=trace.NOOP,
+             ok=True, worker=None, detail=None, dedup=False):
+        if dedup:
+            if st.suppressed == action:
+                return None
+            st.suppressed = action
+        entry = {
+            "ts": time.time(),
+            "tick": self.ticks,
+            "model": model,
+            "action": action,
+            "ok": bool(ok),
+            "worker": worker,
+            "level": st.level,
+            "burn": burn,
+            "capacity": headroom,
+            "trace_id": span.trace_id,
+            "detail": detail,
+        }
+        if span.recording:
+            span.set("action", action)
+            span.set("ok", bool(ok))
+            span.event("decision", action=action, ok=bool(ok))
+        with self._lock:
+            self.decisions.append(entry)
+        logger.info("autoscaler: %s %s (ok=%s) burn_fast=%.2f "
+                    "burn_slow=%.2f level=%d", action, model, ok,
+                    burn["burn_fast"], burn["burn_slow"], st.level)
+        return entry
+
+    def report(self) -> Dict[str, Any]:
+        """The ``/v1/autoscaler`` payload: config, controller state, and
+        the bounded decision log (oldest first)."""
+        now = self._now()
+        with self._lock:
+            # decisions AND the states snapshot under the one lock: the
+            # control thread setdefault()s new models mid-tick, and a
+            # dict resize during an unlocked iteration would 500 the
+            # /v1/autoscaler scrape
+            decisions = list(self.decisions)
+            states = {m: (s.level, s.last_action_ts)
+                      for m, s in sorted(self._states.items())}
+        return {
+            "config": self.config.to_dict(),
+            "ticks": self.ticks,
+            "running": self._thread is not None,
+            "models": {m: {"level": level,
+                           "last_action_age_s": (
+                               None if last_ts == float("-inf")
+                               else round(now - last_ts, 3))}
+                       for m, (level, last_ts) in states.items()},
+            "decisions": decisions,
+        }
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> "SLOAutoscaler":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="slo-autoscaler")
+        self._thread.start()
+        attach = getattr(self.router, "attach_autoscaler", None)
+        if attach is not None:
+            attach(self)
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.config.tick_s):
+            try:
+                self.tick()
+            except Exception:
+                logger.exception("autoscaler tick failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(10.0,
+                                          self.config.lever_timeout_s))
+            self._thread = None
+
+    def __enter__(self) -> "SLOAutoscaler":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.stop()

@@ -181,6 +181,11 @@ class ContinuousBatcher:
             self.metrics.set_dtype_policy(self.dtype_policy.label())
         self._graph_inputs = list(getattr(model.conf, "inputs", []) or [])
         self._warmed_pairs: List[tuple] = []  # (bucket, replica, dtype)
+        # guards _warmed_pairs (worker thread mints buckets while a
+        # control thread resizes) and serializes whole-resize operations
+        # (two racing target-chasing scale loops would thrash replicas)
+        self._warm_lock = threading.Lock()
+        self.resize_lock = threading.Lock()
         self._shutdown = False
         self._draining = False
         self._saw_sentinel = False
@@ -213,6 +218,53 @@ class ContinuousBatcher:
     @property
     def replica_count(self) -> int:
         return len(self._pool)
+
+    def add_replica(self) -> int:
+        """Grow the pool by one device replica at runtime (ISSUE 10: the
+        SLO-feedback autoscaler's replica lever). The new replica is
+        warmed from the live :meth:`warmup_manifest` — every recorded
+        bucket, including traffic-minted ones, and the dtype policy's
+        quantized twins — BEFORE it is published for routing, so a
+        scaled-up replica never compiles on live traffic (the same
+        guarantee a restart gets from the persisted manifest). Safe to
+        call from a control/HTTP thread while traffic flows: warmup runs
+        on an unpublished replica, and routing only sees it after.
+        Returns the new replica count."""
+        rep = self._pool.create_replica()
+        manifest = self.warmup_manifest()
+        if manifest is not None:
+            example = manifest.example()
+            for b in manifest.buckets:
+                self._pool.forward_blocking(
+                    rep, self._zeros_with_rows(example, b))
+                self._record_warmed(b, rep.index, example)
+            qex = (self.dtype_policy.quantized_zeros(example)
+                   if self.dtype_policy is not None else None)
+            if qex is not None:
+                for b in self.dtype_policy.buckets_for(manifest.buckets):
+                    self._pool.forward_blocking(
+                        rep, self._zeros_with_rows(qex, b))
+                    self._record_warmed(b, rep.index, qex)
+        return self._pool.publish_replica(rep)
+
+    def remove_replica(self) -> int:
+        """Shrink the pool by one replica (the newest; replica 0 stays).
+        In-flight batches on the retired replica complete normally — only
+        new routing stops. Raises ``ValueError`` at one replica (the
+        autoscaler's ``min_replicas`` floor is enforced above this, but
+        the batcher itself must never become replica-less). Returns the
+        new replica count."""
+        rep = self._pool.retire_replica()
+        if rep is None:
+            raise ValueError("cannot remove the last replica")
+        # the manifest audit record describes the LIVE pool: drop the
+        # retired replica's pairs so a restart does not over-warm (under
+        # the warm lock — the worker thread may be minting a bucket and
+        # appending concurrently; an unlocked rebuild would lose it)
+        with self._warm_lock:
+            self._warmed_pairs[:] = [p for p in self._warmed_pairs
+                                     if p[1] != rep.index]
+        return self.replica_count
 
     # ------------------------------------------------------------ warmup
     def warmup(self, example: ArrayOrDict) -> int:
@@ -262,7 +314,8 @@ class ContinuousBatcher:
                                   for v in example.values()}))
         else:
             dt = str(example.dtype)
-        self._warmed_pairs.append((int(bucket), int(replica), dt))
+        with self._warm_lock:
+            self._warmed_pairs.append((int(bucket), int(replica), dt))
 
     def warmup_manifest(self):
         """Manifest of everything this batcher compiled — buckets
@@ -275,10 +328,12 @@ class ContinuousBatcher:
         from deeplearning4j_tpu.serving.manifest import WarmupManifest
         if self._example is None:
             return None
+        with self._warm_lock:
+            pairs = list(self._warmed_pairs)
         return WarmupManifest.from_example(
             self._example, buckets=list(self.buckets),
             replicas=self.replica_count,
-            pairs=list(self._warmed_pairs),
+            pairs=pairs,
             max_batch_size=self.max_batch_size,
             model=type(self.model).__name__,
             policy=(self.dtype_policy.to_dict()

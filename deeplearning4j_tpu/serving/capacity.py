@@ -1,0 +1,262 @@
+"""Per-model capacity and resource accounting (ISSUE 10 tentpole).
+
+PR 9 built the flight recorder — the fleet can SEE that a model is
+burning its latency budget — but nothing accounted for the resources a
+scaling decision would spend: how many bytes a served model's parameters
+occupy (and at which dtype, f32 vs the PR 8 int8 residency), how busy
+each device replica actually is, how much admission-queue headroom is
+left before shedding, and what the compile caches are holding. This
+module is that missing ledger. It is a pure *reader* over the live
+serving objects — it owns no state, takes no locks of its own beyond the
+metrics snapshots it calls, and never mutates what it measures — so a
+``/v1/capacity`` scrape can run at any time without perturbing traffic.
+
+Accounting model (the same one HBM-budgeted model paging will need):
+
+- **Parameter bytes** — every leaf of the model's ``train_state`` summed
+  as ``size x itemsize``, broken down per dtype so int8-resident
+  quantized archives (PR 8 ``weight_residency="int8"``) show their 4x
+  smaller footprint honestly.
+- **Device bytes** — each :class:`~deeplearning4j_tpu.serving.replica
+  .ReplicaPool` replica holds a ``device_put`` copy of params + model
+  state; the total is what replica scale-up actually costs, and what the
+  autoscaler's capacity guard checks against the memory budget.
+- **Replica utilization** — busy-fraction derived from the existing
+  per-batch telemetry (``serving_replica_batches_total`` counts + the
+  dispatch-to-completion histogram): the dispatch histogram's *sum* is
+  the pipeline's measured busy-seconds, apportioned per replica by its
+  batch share. Exported as (busy_s, window_s) PAIRS so a fleet
+  aggregation can sum numerators and denominators — a fraction is
+  derived at the edge, never averaged across workers.
+- **Queue headroom** — admission depth vs limit, with the drain estimate
+  reusing the exact :meth:`~deeplearning4j_tpu.serving.admission
+  .AdmissionController.retry_after_ms` math the ``Retry-After`` shed
+  hints already ship.
+- **Compile footprint** — AOT executables behind this model
+  (``compile_count``: the buckets x replicas ledger) plus the
+  process-wide persistent executable cache's on-disk bytes.
+
+Surfaces: ``GET /v1/capacity`` on :class:`ModelServer` (this registry),
+aggregated fleet-wide by :meth:`FleetRouter.fleet_capacity` (sums +
+bucket-merged histograms, never averaged percentiles), rendered as
+``capacity_*`` / ``fleet_capacity_*`` gauges on the respective
+``/metrics``, and reachable without a registry reference through
+``runtime.profiler.capacity_stats()``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+__all__ = ["model_capacity", "process_capacity", "registry_capacity",
+           "render_prometheus", "persistent_cache_bytes"]
+
+
+def _leaf_bytes(tree) -> Dict[str, int]:
+    """Per-dtype byte totals over a pytree of arrays (device or host)."""
+    import jax
+    out: Dict[str, int] = {}
+    for leaf in jax.tree_util.tree_leaves(tree):
+        dt = getattr(leaf, "dtype", None)
+        size = getattr(leaf, "size", None)
+        if dt is None or size is None:
+            continue
+        nbytes = int(size) * int(dt.itemsize)
+        key = str(dt)
+        out[key] = out.get(key, 0) + nbytes
+    return out
+
+
+def model_capacity(served) -> Dict[str, Any]:
+    """One served model's resource accounting (see module docstring).
+
+    ``served`` is a :class:`~deeplearning4j_tpu.serving.registry
+    .ServedModel`; this reads its batcher, replica pool and metrics
+    in place (same package — capacity is the serving stack's own
+    ledger, not an external probe)."""
+    batcher = served.batcher
+    pool = batcher._pool
+    metrics = served.metrics
+
+    ts = getattr(served.model, "train_state", None)
+    param_dtype_bytes = _leaf_bytes(getattr(ts, "params", None))
+    param_bytes = sum(param_dtype_bytes.values())
+    state_bytes = sum(_leaf_bytes(getattr(ts, "model_state", None)).values())
+
+    util = metrics.utilization_snapshot()
+    window_s = max(1e-9, util["window_s"])
+    busy_s = util["busy_s"]
+    batches_total = max(0, util["batches_total"])
+    replica_batches = util["replica_batches"]
+
+    per_replica = []
+    device_bytes_total = 0
+    for rep in list(pool.replicas):
+        if rep.params is not None:
+            rb = (sum(_leaf_bytes(rep.params).values())
+                  + sum(_leaf_bytes(rep.model_state).values()))
+        else:
+            # fallback pseudo-replica: no device_put copy of its own, the
+            # model's host state IS what executes
+            rb = param_bytes + state_bytes
+        device_bytes_total += rb
+        share = (replica_batches.get(rep.index, 0) / batches_total
+                 if batches_total else 0.0)
+        per_replica.append({
+            "replica": rep.index,
+            "device": str(rep.device),
+            "bytes": rb,
+            "batches": replica_batches.get(rep.index, 0),
+            "busy_s": round(busy_s * share, 6),
+            "busy_fraction": round(busy_s * share / window_s, 6),
+        })
+
+    queue_depth = batcher._queue.qsize()
+    queue_limit = batcher.admission.queue_limit
+    drain_ms = batcher._drain_ms_per_request()
+    est_drain_ms = (batcher.admission.retry_after_ms(queue_depth, drain_ms)
+                    if queue_depth > 0 else 0.0)
+
+    return {
+        "param_bytes": param_bytes,
+        "param_dtype_bytes": param_dtype_bytes,
+        "model_state_bytes": state_bytes,
+        "replicas": len(pool),
+        "device_bytes_total": device_bytes_total,
+        "per_replica": per_replica,
+        "utilization": {
+            # (busy_s, window_s) pair, NOT a pre-divided fraction: the
+            # fleet aggregation sums both and divides once at the edge
+            "busy_s": round(busy_s, 6),
+            "window_s": round(window_s, 3),
+            "busy_fraction": round(busy_s / window_s, 6),
+        },
+        "queue": {
+            "depth": queue_depth,
+            "limit": queue_limit,
+            "headroom_requests": max(0, queue_limit - queue_depth),
+            "drain_ms_per_request": (round(drain_ms, 4)
+                                     if drain_ms is not None else None),
+            "est_drain_ms": round(est_drain_ms, 2),
+        },
+        "aot_executables": batcher.compile_count(),
+        "warmed_pairs": len(batcher._warmed_pairs),
+        "buckets": list(batcher.buckets),
+        "max_batch_size": batcher.max_batch_size,
+        "dtype_policy": (batcher.dtype_policy.label()
+                         if batcher.dtype_policy is not None else None),
+        # raw-bucket wire form so the router can MERGE service-time
+        # histograms across workers instead of averaging percentiles
+        "dispatch_latency": util["dispatch_wire"],
+        "version": served.version,
+        "health": served.health.value,
+    }
+
+
+def persistent_cache_bytes() -> Optional[int]:
+    """On-disk bytes of the persistent XLA executable cache, or ``None``
+    when the cache is disabled (never raises — an unreadable entry just
+    drops out of the sum)."""
+    from deeplearning4j_tpu.runtime import compile_cache
+    d = compile_cache.cache_dir()
+    if d is None:
+        return None
+    total = 0
+    try:
+        for root, _, files in os.walk(d):
+            for f in files:
+                try:
+                    total += os.stat(os.path.join(root, f)).st_size
+                except OSError:
+                    pass
+    except OSError:
+        return None
+    return total
+
+
+def process_capacity() -> Dict[str, Any]:
+    """Process-level capacity: measured device memory (budget + in-use,
+    where the backend reports it — CPU does not) and the compile-cache
+    footprint."""
+    from deeplearning4j_tpu.runtime import compile_cache, profiler
+    devices = profiler.device_memory_stats()
+    budget = in_use = None
+    for stats in devices.values():
+        limit = stats.get("bytes_limit")
+        used = stats.get("bytes_in_use")
+        if limit is not None:
+            budget = (budget or 0) + int(limit)
+        if used is not None:
+            in_use = (in_use or 0) + int(used)
+    cc = compile_cache.stats()
+    return {
+        "devices": devices,
+        "device_budget_bytes": budget,
+        "device_in_use_bytes": in_use,
+        "compile_cache": {
+            "enabled": bool(cc["enabled"]),
+            "persistent_bytes": persistent_cache_bytes(),
+            "hits": cc["hits"],
+            "misses": cc["misses"],
+            "aot_executables": cc["aot_compiles"],
+        },
+    }
+
+
+def registry_capacity(registry) -> Dict[str, Any]:
+    """The full ``/v1/capacity`` payload for one registry: per-model
+    accounting plus the process section and summed totals."""
+    models: Dict[str, Any] = {}
+    for name in registry.names():
+        try:
+            models[name] = model_capacity(registry.get(name))
+        except KeyError:
+            pass  # undeployed between listing and snapshot
+    return {
+        "models": models,
+        "process": process_capacity(),
+        "totals": {
+            "param_bytes": sum(m["param_bytes"] for m in models.values()),
+            "device_bytes": sum(m["device_bytes_total"]
+                                for m in models.values()),
+            "replicas": sum(m["replicas"] for m in models.values()),
+        },
+    }
+
+
+def render_prometheus(payload: Dict[str, Any],
+                      prefix: str = "capacity") -> str:
+    """Render a :func:`registry_capacity` payload as Prometheus gauges
+    (the ``/metrics`` view of the same numbers ``/v1/capacity`` serves
+    machine-readably)."""
+    lines = [f"# TYPE {prefix}_param_bytes gauge"]
+    for model, c in sorted((payload.get("models") or {}).items()):
+        lbl = f'{{model="{model}"}}'
+        lines.append(f"{prefix}_param_bytes{lbl} {c['param_bytes']}")
+        lines.append(f"{prefix}_device_bytes{lbl} "
+                     f"{c['device_bytes_total']}")
+        lines.append(f"{prefix}_replicas{lbl} {c['replicas']}")
+        lines.append(f"{prefix}_utilization_busy_fraction{lbl} "
+                     f"{c['utilization']['busy_fraction']}")
+        lines.append(f"{prefix}_queue_headroom_requests{lbl} "
+                     f"{c['queue']['headroom_requests']}")
+        lines.append(f"{prefix}_queue_est_drain_ms{lbl} "
+                     f"{c['queue']['est_drain_ms']}")
+        lines.append(f"{prefix}_aot_executables{lbl} "
+                     f"{c['aot_executables']}")
+        for dt, b in sorted(c["param_dtype_bytes"].items()):
+            lines.append(f'{prefix}_param_dtype_bytes{{model="{model}",'
+                         f'dtype="{dt}"}} {b}')
+    proc = payload.get("process") or {}
+    if proc.get("device_budget_bytes") is not None:
+        lines.append(f"{prefix}_device_budget_bytes "
+                     f"{proc['device_budget_bytes']}")
+    if proc.get("device_in_use_bytes") is not None:
+        lines.append(f"{prefix}_device_in_use_bytes "
+                     f"{proc['device_in_use_bytes']}")
+    cc = proc.get("compile_cache") or {}
+    if cc.get("persistent_bytes") is not None:
+        lines.append(f"{prefix}_compile_cache_bytes "
+                     f"{cc['persistent_bytes']}")
+    return "\n".join(lines) + "\n"

@@ -36,6 +36,7 @@ not race the worker's own backend selection).
 
 from __future__ import annotations
 
+import copy
 import dataclasses
 import json
 import logging
@@ -401,6 +402,75 @@ class FleetSupervisor:
         finally:
             handle.stopping = False
         return port
+
+    def clone_spec(self, worker_id: str, new_worker_id: str) -> WorkerSpec:
+        """A deep copy of ``worker_id``'s CURRENT spec (post any rolling
+        deploy) under a fresh id — what the SLO autoscaler's worker lever
+        spawns (ISSUE 10). The clone shares the archive, batcher knobs
+        and persistent compile cache, so it comes up manifest-prewarmed
+        exactly like a rolling-deploy relaunch."""
+        spec = copy.deepcopy(self._handles[worker_id].spec)
+        spec.worker_id = str(new_worker_id)
+        return spec
+
+    def add_worker(self, spec: WorkerSpec,
+                   ready_timeout_s: Optional[float] = None) -> int:
+        """Grow the fleet by one worker at runtime (ISSUE 10: the
+        autoscaler's fleet lever). Spawns ``spec``, blocks until its port
+        file says ready (registry loaded + manifest-warmed), and hands it
+        to the running watchdog; the router's ``/readyz`` prober admits
+        it on its next cycle. Returns the worker's port."""
+        with self._lock:
+            if spec.worker_id in self._handles:
+                raise ValueError(f"worker id {spec.worker_id!r} already "
+                                 f"exists in this fleet")
+            handle = _WorkerHandle(spec, self.run_dir)
+            self._handles[spec.worker_id] = handle
+            self._spawn(handle)
+        try:
+            return self._wait_port(handle, ready_timeout_s)
+        except BaseException:
+            with self._lock:
+                self._handles.pop(spec.worker_id, None)
+            if handle.alive():
+                handle.proc.kill()
+                try:
+                    handle.proc.wait(timeout=10)
+                except Exception:
+                    pass
+            self._close_capture(handle)
+            raise
+
+    def remove_worker(self, worker_id: str,
+                      stop_timeout_s: float = 30.0) -> None:
+        """Retire one worker from the fleet (the autoscaler's scale-down
+        unwind): graceful SIGTERM — the worker drains its registry and
+        refreshes the warmup manifest — escalating to SIGKILL, then the
+        handle is dropped so the watchdog never resurrects it. The
+        router's view reconciles on its next probe cycle."""
+        with self._lock:
+            handle = self._handles.get(worker_id)
+            if handle is None:
+                raise KeyError(f"unknown worker {worker_id!r}")
+            handle.stopping = True
+        settle = time.monotonic() + self.ready_timeout_s
+        while handle.relaunching and time.monotonic() < settle:
+            time.sleep(0.05)
+        if handle.alive():
+            handle.proc.terminate()
+            try:
+                handle.proc.wait(timeout=stop_timeout_s)
+            except subprocess.TimeoutExpired:
+                logger.warning("worker %s ignored SIGTERM on retire; "
+                               "killing", worker_id)
+                handle.proc.kill()
+                try:
+                    handle.proc.wait(timeout=10)
+                except Exception:
+                    pass
+        self._close_capture(handle)
+        with self._lock:
+            self._handles.pop(worker_id, None)
 
     def prewarm_manifest(self, archive: str) -> Optional[str]:
         """Ensure ``archive`` has a warmup manifest before a rolling

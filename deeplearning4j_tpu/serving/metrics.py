@@ -117,6 +117,7 @@ class ServingMetrics:
                  inflight_fn: Optional[Callable[[], int]] = None):
         self._lock = threading.Lock()
         self.started_at = time.monotonic()
+        self._window_started_at = self.started_at  # reset_window restarts it
         self.requests_total = 0          # admitted into the queue
         self.responses_total = 0         # completed successfully
         self.rejected_overload = 0
@@ -239,6 +240,7 @@ class ServingMetrics:
             self.batches_total = 0
             self.rows_real_total = 0
             self.rows_padded_total = 0
+            self._window_started_at = time.monotonic()
 
     # -------------------------------------------------------------- reading
     @property
@@ -298,6 +300,24 @@ class ServingMetrics:
             snap["breaker_opens_total"] = b["opens_total"]
             snap["breaker_failures_in_window"] = b["failures_in_window"]
         return snap
+
+    def utilization_snapshot(self) -> Dict[str, object]:
+        """The raw pieces ``serving/capacity.py`` derives replica
+        busy-fractions from, captured in ONE lock acquisition so the
+        parts are mutually consistent: the dispatch-to-completion
+        histogram's *sum* is the pipeline's measured busy-seconds (a
+        depth>1 pipeline can legitimately exceed the window — overlap
+        reads as utilization > 1, i.e. queue pressure), apportioned per
+        replica by batch share; ``window_s`` is the metrics window
+        (since construction, or the last :meth:`reset_window`)."""
+        with self._lock:
+            return {
+                "window_s": time.monotonic() - self._window_started_at,
+                "busy_s": self.dispatch_latency.sum,
+                "batches_total": self.batches_total,
+                "replica_batches": dict(self.replica_batches),
+                "dispatch_wire": self.dispatch_latency.to_wire(),
+            }
 
     def wire_snapshot(self) -> Dict[str, object]:
         """Machine-readable snapshot for the fleet router's ``/metrics``

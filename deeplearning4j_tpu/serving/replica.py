@@ -89,6 +89,7 @@ class ReplicaPool:
                 "ReplicaPool: %d replicas requested but only %d local "
                 "device(s); clamping", n, len(devs))
             n = len(devs)
+        self._devs = devs
         self._graph_inputs = list(getattr(model.conf, "inputs", []) or [])
         self._fn = self._output_fn(model)
         # AOT fast path (env.aot_dispatch): one lower().compile() executable
@@ -108,6 +109,7 @@ class ReplicaPool:
                     "through its own output() on the default device "
                     "(1 replica, %d requested)", type(model).__name__, n)
             self.replicas.append(Replica(0, devs[0], None, None))
+            self._next_index = 1
             return
         for i in range(n):
             ts = model.train_state
@@ -115,6 +117,11 @@ class ReplicaPool:
                 i, devs[i],
                 jax.device_put(ts.params, devs[i]),
                 jax.device_put(ts.model_state, devs[i])))
+        # runtime resize (ISSUE 10) hands out indices from here on; an
+        # index is NEVER reused — the AOT cache keys on (index, signature)
+        # and a recycled index could hand a new replica an executable
+        # compiled for a device its parameters do not live on
+        self._next_index = n
 
     def __len__(self) -> int:
         return len(self.replicas)
@@ -179,6 +186,52 @@ class ReplicaPool:
     def total_in_flight(self) -> int:
         with self._lock:
             return sum(r.in_flight for r in self.replicas)
+
+    # ------------------------------------------------------ runtime resize
+    def create_replica(self, device=None) -> Replica:
+        """Mint a NEW device-resident parameter copy WITHOUT publishing it
+        for routing (ISSUE 10: the autoscaler's replica lever). The caller
+        warms it — :meth:`forward_blocking` works on an unpublished
+        replica — then :meth:`publish_replica` makes it routable, so a
+        scaled-up replica never compiles on live traffic. Devices are
+        assigned round-robin past the initial set (two replicas may share
+        a device on a small box; each still gets its own parameter copy
+        and executables, which is what the capacity ledger accounts)."""
+        if self._fn is None:
+            raise ValueError(
+                f"cannot scale a fallback pool ({type(self.model).__name__} "
+                f"serves through its own output() with no device routing)")
+        with self._lock:
+            idx = self._next_index
+            self._next_index += 1
+        dev = device if device is not None else self._devs[idx % len(self._devs)]
+        ts = self.model.train_state
+        return Replica(idx, dev,
+                       jax.device_put(ts.params, dev),
+                       jax.device_put(ts.model_state, dev))
+
+    def publish_replica(self, replica: Replica) -> int:
+        """Make a warmed replica routable; returns the new pool size."""
+        with self._lock:
+            self.replicas.append(replica)
+            return len(self.replicas)
+
+    def retire_replica(self) -> Optional[Replica]:
+        """Remove the NEWEST replica from routing (keeps replica 0 — the
+        one direct ``model.output`` calls share a trace with — stable),
+        or ``None`` when only one replica remains. In-flight batches hold
+        their own reference and complete normally; the retired replica's
+        AOT executables are evicted so ``aot_count`` keeps describing the
+        live pool. (A dispatch that acquired the replica just before
+        retirement may re-mint one executable — a wasted compile, never a
+        wrong result.)"""
+        with self._lock:
+            if len(self.replicas) <= 1:
+                return None
+            rep = self.replicas.pop()
+        self._aot.evict(lambda k: isinstance(k, tuple) and k
+                        and k[0] == rep.index)
+        return rep
 
     # ------------------------------------------------------------ dispatch
     def dispatch(self, replica: Replica, x: ArrayOrDict):

@@ -358,7 +358,8 @@ class FleetRouter:
                  probe_interval_s: float = 0.25,
                  probe_timeout_s: float = 1.0,
                  connect_timeout_s: float = 2.0,
-                 no_deadline_timeout_s: float = 60.0):
+                 no_deadline_timeout_s: float = 60.0,
+                 slo: Optional[SLOMonitor] = None):
         self._fleet = fleet
         self.default_timeout_ms = default_timeout_ms
         self.hedge_enabled = bool(hedge_enabled)
@@ -374,9 +375,12 @@ class FleetRouter:
         self.metrics = RouterMetrics()
         # fleet-wide SLO attainment + burn rates (ISSUE 9): the router
         # sees every client request whichever worker serves it, so ITS
-        # monitor is the per-model fleet-wide signal the autoscaler will
-        # consume (rendered on /metrics next to the worker aggregation)
-        self.slo = SLOMonitor()
+        # monitor is the per-model fleet-wide signal the SLOAutoscaler
+        # consumes (rendered on /metrics next to the worker aggregation;
+        # injectable so drills can run short burn windows)
+        self.slo = slo or SLOMonitor()
+        # the attached SLOAutoscaler (ISSUE 10), serving /v1/autoscaler
+        self.autoscaler = None
         self._views: Dict[str, WorkerView] = {}
         self._views_lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -868,17 +872,123 @@ class FleetRouter:
             t.join(timeout=timeout_s + 1.0)
         return results
 
-    def _scrape_workers(self) -> Dict[str, Dict[str, Any]]:
-        """Every ready worker's ``/v1/metricsz`` (counters + raw-bucket
-        histograms), fetched in parallel."""
+    def _scrape_workers(self, path: str = "/v1/metricsz"
+                        ) -> Dict[str, Dict[str, Any]]:
+        """Every ready worker's JSON payload at ``path`` (``/v1/metricsz``
+        counters + raw-bucket histograms, or the ISSUE 10 ``/v1/capacity``
+        ledger), fetched in parallel."""
         views = [v for v in self.workers().values() if v.ready]
 
         def fetch(v):
-            status, _, data = self._http(v.address, "GET", "/v1/metricsz",
+            status, _, data = self._http(v.address, "GET", path,
                                          timeout=self.probe_timeout_s)
             return json.loads(data.decode()) if status == 200 else None
 
         return self._fanout(fetch, views, self.probe_timeout_s)
+
+    def attach_autoscaler(self, autoscaler) -> None:
+        """Register the :class:`~deeplearning4j_tpu.serving.autoscale
+        .SLOAutoscaler` driving this router so ``/v1/autoscaler`` serves
+        its decision log (called by ``SLOAutoscaler.start``)."""
+        self.autoscaler = autoscaler
+
+    def fleet_capacity(self) -> Dict[str, Any]:
+        """Fleet-wide capacity aggregation (ISSUE 10 tentpole): every
+        ready worker's ``/v1/capacity`` ledger, aggregated the same way
+        ``/v1/metricsz`` is — bytes/counters SUMMED per model,
+        utilization carried as summed (busy_s, window_s) pairs divided
+        once at the edge, dispatch histograms bucket-MERGED (percentiles
+        of the merged histogram, never averaged percentiles). The
+        per-worker payloads ride along under ``workers`` so the
+        autoscaler's capacity guard can check the one worker it would
+        scale."""
+        scraped = self._scrape_workers("/v1/capacity")
+        models: Dict[str, Dict[str, Any]] = {}
+        hists: Dict[str, LatencyHistogram] = {}
+        budget = in_use = None
+        for wid, payload in sorted(scraped.items()):
+            proc = payload.get("process") or {}
+            if proc.get("device_budget_bytes") is not None:
+                budget = (budget or 0) + int(proc["device_budget_bytes"])
+            if proc.get("device_in_use_bytes") is not None:
+                in_use = (in_use or 0) + int(proc["device_in_use_bytes"])
+            for model, c in sorted((payload.get("models") or {}).items()):
+                # parse the WHOLE entry first, apply increments only
+                # after: a malformed field must skip the entry entirely,
+                # not leave its bytes counted with zero busy time (which
+                # would skew busy_fraction low — the very signal the
+                # autoscaler's guard reads)
+                try:
+                    inc = {
+                        "param_bytes": int(c["param_bytes"]),
+                        "device_bytes_total": int(c["device_bytes_total"]),
+                        "replicas": int(c["replicas"]),
+                        "workers": 1,
+                        "busy_s": float(c["utilization"]["busy_s"]),
+                        "window_s": float(c["utilization"]["window_s"]),
+                        "queue_depth": int(c["queue"]["depth"]),
+                        "queue_headroom_requests":
+                            int(c["queue"]["headroom_requests"]),
+                        "aot_executables": int(c["aot_executables"]),
+                    }
+                    wire = c.get("dispatch_latency")
+                    h = LatencyHistogram.from_wire(wire) if wire else None
+                    if h is not None:
+                        # merge checks bucket-bounds compatibility BEFORE
+                        # mutating, so a raise here leaves hists untouched
+                        if model in hists:
+                            hists[model].merge(h)
+                        else:
+                            hists[model] = h
+                except (KeyError, TypeError, ValueError):
+                    continue  # malformed worker entry: skip, never break
+                a = models.setdefault(model, {
+                    "param_bytes": 0, "device_bytes_total": 0,
+                    "replicas": 0, "workers": 0, "busy_s": 0.0,
+                    "window_s": 0.0, "queue_depth": 0,
+                    "queue_headroom_requests": 0, "aot_executables": 0})
+                for k, v in inc.items():
+                    a[k] += v
+        for model, a in models.items():
+            a["busy_fraction"] = round(
+                a["busy_s"] / a["window_s"], 6) if a["window_s"] else 0.0
+            h = hists.get(model)
+            if h is not None:
+                a["dispatch_p50_s"] = h.percentile(50)
+                a["dispatch_p99_s"] = h.percentile(99)
+                a["dispatch_count"] = h.count
+        return {
+            "workers": scraped,
+            "models": models,
+            "process": {"device_budget_bytes": budget,
+                        "device_in_use_bytes": in_use},
+        }
+
+    def render_fleet_capacity(self) -> str:
+        """``fleet_capacity_*`` gauges for the router's ``/metrics``."""
+        agg = self.fleet_capacity()
+        lines = ["# TYPE fleet_capacity_param_bytes gauge"]
+        for model, a in sorted(agg["models"].items()):
+            lbl = f'{{model="{model}"}}'
+            lines.append(f"fleet_capacity_param_bytes{lbl} "
+                         f"{a['param_bytes']}")
+            lines.append(f"fleet_capacity_device_bytes{lbl} "
+                         f"{a['device_bytes_total']}")
+            lines.append(f"fleet_capacity_replicas{lbl} {a['replicas']}")
+            lines.append(f"fleet_capacity_workers{lbl} {a['workers']}")
+            lines.append(f"fleet_capacity_utilization_busy_fraction{lbl} "
+                         f"{a['busy_fraction']}")
+            lines.append(f"fleet_capacity_queue_headroom_requests{lbl} "
+                         f"{a['queue_headroom_requests']}")
+            if "dispatch_p99_s" in a:
+                lines.append(
+                    f'fleet_capacity_dispatch_seconds{{model="{model}",'
+                    f'quantile="0.99"}} {a["dispatch_p99_s"]}')
+        proc = agg["process"]
+        if proc.get("device_budget_bytes") is not None:
+            lines.append(f"fleet_capacity_device_budget_bytes "
+                         f"{proc['device_budget_bytes']}")
+        return "\n".join(lines) + "\n"
 
     def render_fleet_metrics(self) -> str:
         """Fleet-wide ``/metrics`` section (ISSUE 9): worker counters
@@ -927,42 +1037,95 @@ class FleetRouter:
         slo_text = self.slo.render_prometheus()
         if slo_text:
             lines.append(slo_text.rstrip("\n"))
+        try:
+            lines.append(self.render_fleet_capacity().rstrip("\n"))
+        except Exception:
+            pass  # capacity must never be able to break a scrape
         return "\n".join(lines) + "\n"
 
-    def aggregate_traces(self, trace_id: Optional[str] = None
+    def aggregate_traces(self, trace_id: Optional[str] = None,
+                         limit: Optional[int] = None,
+                         since: Optional[float] = None
                          ) -> List[Dict[str, Any]]:
+        """The flight recorder's read side — see
+        :meth:`aggregate_traces_bounded`; this convenience returns the
+        (bounded) records alone."""
+        return self.aggregate_traces_bounded(trace_id, limit, since)[0]
+
+    def aggregate_traces_bounded(self, trace_id: Optional[str] = None,
+                                 limit: Optional[int] = None,
+                                 since: Optional[float] = None):
         """The flight recorder's read side: merge this router's kept
         traces with every ready worker's ``/v1/traces`` into one record
         per trace id — router attempt spans and the worker spans they
         parented (predict, batcher stages) come back as ONE tree
-        (``trace.span_tree``)."""
+        (``trace.span_tree``). ``limit``/``since`` bound the result
+        (ISSUE 10) — forwarded to the workers too, so the fan-out fetch
+        itself stays bounded, then re-applied (with the hard
+        response-size cap) after the merge. Returns
+        ``(records, truncated)``."""
         records = list(trace.collector().traces())
         views = [v for v in self.workers().values() if v.ready]
-        path = ("/v1/traces" if trace_id is None
-                else f"/v1/traces?trace_id={trace_id}")
+        params = []
+        if trace_id is not None:
+            params.append(f"trace_id={trace_id}")
+        if limit is not None:
+            params.append(f"limit={int(limit)}")
+        if since is not None:
+            params.append(f"since={float(since)}")
+        path = "/v1/traces" + ("?" + "&".join(params) if params else "")
 
         def fetch(v):
             status, _, data = self._http(v.address, "GET", path,
                                          timeout=self.probe_timeout_s)
             if status != 200:
                 return None
-            return json.loads(data.decode()).get("traces", [])
+            payload = json.loads(data.decode())
+            return payload.get("traces", []), bool(payload.get("truncated"))
 
-        for recs in self._fanout(fetch, views, self.probe_timeout_s).values():
+        worker_truncated = False
+        for recs, trunc in self._fanout(fetch, views,
+                                        self.probe_timeout_s).values():
             records.extend(recs or [])
+            # a worker that already cut its response means the merged
+            # view is incomplete even if the router-side bound trims
+            # nothing further — the flag must survive the hop
+            worker_truncated = worker_truncated or trunc
         merged = trace.merge_traces(records)
         if trace_id is not None:
             merged = [m for m in merged if m.get("trace_id") == trace_id]
-        return merged
+        bounded, truncated = trace.bound_traces(merged, limit=limit,
+                                                since=since)
+        return bounded, truncated or worker_truncated
 
     # --------------------------------------------------------- GET handlers
     def _handle_get(self, path: str):
         if path.startswith("/v1/traces"):
             q = parse_qs(urlsplit(path).query)
-            merged = self.aggregate_traces(q.get("trace_id", [None])[0])
+            try:
+                limit = (int(q["limit"][0]) if "limit" in q else None)
+                since = (float(q["since"][0]) if "since" in q else None)
+            except ValueError as e:
+                return 400, {"error": f"bad limit/since query param: {e}"}
+            merged, truncated = self.aggregate_traces_bounded(
+                q.get("trace_id", [None])[0], limit=limit, since=since)
             if q.get("format", [None])[0] == "chrome":
                 return 200, trace.to_chrome_trace(merged)
-            return 200, {"traces": merged}
+            return 200, {"traces": merged, "truncated": truncated}
+        if path == "/v1/slo":
+            # structured twin of the /metrics slo_* section — the signal
+            # the autoscaler consumes, fleet-wide by construction
+            return 200, {"windows_s": list(self.slo.windows_s),
+                         "slo": self.slo.report()}
+        if path == "/v1/capacity":
+            # fleet-wide capacity aggregation (sums + merged histograms)
+            return 200, self.fleet_capacity()
+        if path == "/v1/autoscaler":
+            # the decision log: why the fleet grew/shrank, with the
+            # triggering burn snapshots and the headroom consulted
+            if self.autoscaler is None:
+                return 404, {"error": "no autoscaler attached"}
+            return 200, self.autoscaler.report()
         if path == "/healthz":
             return 200, {"status": "ok",
                          "workers": {wid: v.admittable()

@@ -100,6 +100,7 @@ class ModelServer:
         self.slo = slo or SLOMonitor()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._capacity_provider = None  # our profiler attachment (stop)
         self.port: Optional[int] = None
 
     # ------------------------------------------------------------ handlers
@@ -243,18 +244,46 @@ class ModelServer:
         if path.startswith("/v1/traces"):
             # this process's kept traces (tail-sampled flight recorder);
             # ?trace_id= filters, ?format=chrome renders Perfetto-loadable
-            # trace-event JSON (ISSUE 9, docs/observability.md)
+            # trace-event JSON (ISSUE 9, docs/observability.md).
+            # Responses are BOUNDED (ISSUE 10): ?limit=N keeps the newest
+            # N, ?since=<unix ts> filters by span start, and a hard
+            # serialized-size cap applies regardless — a scrape of a full
+            # ring can never produce an unbounded HTTP body.
             q = parse_qs(urlsplit(path).query)
             recs = trace.collector().traces()
             tid = q.get("trace_id", [None])[0]
             if tid:
                 recs = [r for r in recs if r.get("trace_id") == tid]
+            try:
+                limit = (int(q["limit"][0]) if "limit" in q else None)
+                since = (float(q["since"][0]) if "since" in q else None)
+            except ValueError as e:
+                return 400, {"error": f"bad limit/since query param: {e}"}
+            recs, truncated = trace.bound_traces(recs, limit=limit,
+                                                 since=since)
             if q.get("format", [None])[0] == "chrome":
                 return 200, trace.to_chrome_trace(recs)
             return 200, {"traces": recs,
+                         "truncated": truncated,
                          "kept": trace.collector().kept,
                          "dropped": trace.collector().dropped,
                          "worker": self.worker_id}
+        if path == "/v1/slo":
+            # machine-readable twin of the /metrics slo_* section: the
+            # SLOMonitor report dict — what the autoscaler drill and
+            # external dashboards consume instead of parsing Prometheus
+            # text (ISSUE 10)
+            return 200, {"worker": self.worker_id,
+                         "windows_s": list(self.slo.windows_s),
+                         "slo": self.slo.report()}
+        if path == "/v1/capacity":
+            # per-model resource accounting (ISSUE 10 tentpole): parameter
+            # /device bytes by dtype, replica utilization, queue headroom,
+            # compile footprint — the ledger the autoscaler's capacity
+            # guard consults (aggregated fleet-wide by the router)
+            from deeplearning4j_tpu.serving import capacity
+            return 200, {"worker": self.worker_id,
+                         **capacity.registry_capacity(self.registry)}
         if path == "/v1/metricsz":
             # machine-readable twin of /metrics: summable counters + raw
             # bucket histograms so the router can aggregate fleet-wide
@@ -285,6 +314,80 @@ class ModelServer:
                 return 404, {"error": f"model {name!r} not found"}
         return 404, {"error": f"unknown path {path!r}"}
 
+    def _handle_scale(self, name: str, raw: bytes, headers=None):
+        """``POST /v1/models/<name>/replicas`` — runtime ReplicaPool
+        resize (ISSUE 10: the autoscaler's replica lever; also a manual
+        operator action). Body ``{"replicas": n}`` (absolute) or
+        ``{"delta": d}`` (relative to the LIVE count — what the
+        autoscaler sends, so a stale capacity scrape can never turn a
+        scale-up into an absolute scale-down; delta targets clamp to the
+        one-replica floor instead of erroring). Grows via
+        :meth:`ContinuousBatcher.add_replica` (each new replica warmed
+        from the live warmup manifest BEFORE routing — zero on-traffic
+        compiles) or shrinks via :meth:`remove_replica`; concurrent
+        resizes serialize on the batcher's resize lock (two racing
+        target-chasing loops would otherwise overshoot and thrash,
+        paying warmup compiles for replicas immediately removed). Joins
+        the caller's trace off the standard headers so the scaling
+        decision and its execution are ONE tree."""
+        h = headers or {}
+        sp = (trace.server_span("worker.scale_replicas",
+                                trace_id=h.get("X-Trace-Id"),
+                                parent_id=h.get("X-Parent-Span-Id"))
+              if trace.enabled() else trace.NOOP)
+        with sp:
+            if sp.recording:
+                sp.flag("autoscale")
+                sp.set("model", name)
+            try:
+                body = json.loads(raw.decode() or "{}")
+                if ("replicas" in body) == ("delta" in body):
+                    raise ValueError(
+                        "body must carry exactly one of 'replicas' "
+                        "(absolute) or 'delta' (relative)")
+                delta = int(body["delta"]) if "delta" in body else None
+                n = int(body["replicas"]) if "replicas" in body else None
+                if n is not None and not 1 <= n <= 64:
+                    raise ValueError(f"replicas must be in [1, 64], got {n}")
+                # optional floor for delta requests (the autoscaler sends
+                # its min_replicas): downward deltas clamp against it
+                floor = int(body.get("floor", 1))
+                if not 1 <= floor <= 64:
+                    raise ValueError(f"floor must be in [1, 64], got {floor}")
+                if floor != 1 and delta is None:
+                    raise ValueError("'floor' is only valid with 'delta'")
+            except Exception as e:
+                return 400, {"error": f"malformed scale request: {e}"}, {}
+            try:
+                served = self.registry.get(name)
+            except KeyError:
+                return 404, {"error": f"model {name!r} not found"}, {}
+            batcher = served.batcher
+            with batcher.resize_lock:
+                before = batcher.replica_count
+                if delta is not None:
+                    n = min(64, max(floor, before + delta))
+                try:
+                    while batcher.replica_count < n:
+                        batcher.add_replica()
+                    while batcher.replica_count > n:
+                        batcher.remove_replica()
+                except Exception as e:
+                    return 500, {"error": repr(e),
+                                 "replicas": batcher.replica_count}, {}
+            if sp.recording:
+                sp.set("replicas_before", before)
+                sp.set("replicas_after", batcher.replica_count)
+            try:
+                # persist the resized warm set so a restart pre-warms it
+                self.registry.save_manifest(name)
+            except Exception:
+                pass  # best effort, same as graceful-shutdown refresh
+            return 200, {"model": name, "replicas": batcher.replica_count,
+                         "replicas_before": before,
+                         "compile_count": batcher.compile_count(),
+                         "warmed_pairs": len(batcher._warmed_pairs)}, {}
+
     def _render_metrics(self) -> str:
         parts = ["# TYPE serving_latency_seconds summary",
                  "# TYPE serving_dispatch_to_completion_seconds summary",
@@ -301,6 +404,14 @@ class ModelServer:
         slo_text = self.slo.render_prometheus()
         if slo_text:
             parts.append(slo_text.rstrip("\n"))
+        try:
+            # the capacity ledger's /metrics view (ISSUE 10): same numbers
+            # /v1/capacity serves machine-readably
+            from deeplearning4j_tpu.serving import capacity
+            parts.append(capacity.render_prometheus(
+                capacity.registry_capacity(self.registry)).rstrip("\n"))
+        except Exception:
+            pass  # capacity must never be able to break a scrape
         return "\n".join(parts) + "\n"
 
     @staticmethod
@@ -326,6 +437,16 @@ class ModelServer:
         srv = self
         if self.worker_id is not None:
             trace.set_process_tag(self.worker_id)
+        # profiling tooling reads this registry's capacity ledger without
+        # holding a registry reference (ISSUE 10; newest server wins,
+        # mirroring profiler.attach_router)
+        from deeplearning4j_tpu.runtime import profiler
+
+        def _capacity_provider():
+            from deeplearning4j_tpu.serving import capacity
+            return capacity.registry_capacity(srv.registry)
+        self._capacity_provider = _capacity_provider
+        profiler.attach_capacity(_capacity_provider)
 
         class Handler(BaseHTTPRequestHandler):
             def _send(self, code: int, body: bytes, ctype: str,
@@ -359,6 +480,11 @@ class ModelServer:
                     name = self.path[len("/v1/models/"):-len("/predict")]
                     code, obj, extra = srv._handle_predict(
                         name, raw, headers=self.headers)
+                elif (self.path.startswith("/v1/models/")
+                        and self.path.endswith("/replicas")):
+                    name = self.path[len("/v1/models/"):-len("/replicas")]
+                    code, obj, extra = srv._handle_scale(
+                        name, raw, headers=self.headers)
                 else:
                     code, obj, extra = (404,
                                         {"error": f"unknown path "
@@ -380,5 +506,10 @@ class ModelServer:
         if self._httpd:
             self._httpd.shutdown()
             self._httpd = None
+        if self._capacity_provider is not None:
+            # detach only OUR provider — a newer server's stays attached
+            from deeplearning4j_tpu.runtime import profiler
+            profiler.detach_capacity(self._capacity_provider)
+            self._capacity_provider = None
         if shutdown_registry:
             self.registry.shutdown()

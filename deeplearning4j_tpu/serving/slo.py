@@ -191,14 +191,19 @@ class SLOMonitor:
             win.record(now_s, ok, slow)
 
     # -------------------------------------------------------------- reading
-    def report(self) -> Dict[str, Dict[str, Any]]:
+    def report(self, models: Optional[Sequence[str]] = None
+               ) -> Dict[str, Dict[str, Any]]:
         """Per-model, per-window attainment + burn rates.
 
         ``availability_burn = (bad/total) / (1 - availability_target)``;
         ``latency_burn = (ok_slow/ok) / (1 - latency_target)``. Empty
         windows report attainment 1.0 and burn 0.0 (no traffic spends no
-        budget)."""
+        budget). ``models`` restricts the report (and the ring-walk cost)
+        to the named models — the autoscaler's per-tick read passes its
+        filter so a 256-model fleet does not pay 256 ring walks per
+        control tick."""
         now_s = int(self._now_fn())
+        wanted = None if models is None else {str(m) for m in models}
         # SNAPSHOT the rings under the lock (record() recycles a stale
         # slot by writing times[i] before zeroing its counts, so an
         # unlocked reader could count an hour-old bucket as current),
@@ -206,7 +211,8 @@ class SLOMonitor:
         # a scrape must never stall the request threads feeding record()
         with self._lock:
             snaps = {model: win.snapshot()
-                     for model, win in sorted(self._models.items())}
+                     for model, win in sorted(self._models.items())
+                     if wanted is None or model in wanted}
         sums = {model: snap.multi_sums(now_s, self.windows_s)
                 for model, snap in snaps.items()}
         out: Dict[str, Dict[str, Any]] = {}

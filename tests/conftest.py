@@ -33,13 +33,15 @@ import pytest  # noqa: E402
 
 import _round_record  # noqa: E402  (sibling module; pytest puts this dir on sys.path)
 
-# Thread names of the training pipeline's background stages (ISSUE 4) and
+# Thread names of the training pipeline's background stages (ISSUE 4),
 # the trace-collector fan-out fetchers (ISSUE 9: the router's /v1/traces
 # and fleet-/metrics aggregation joins its per-worker fetch threads before
-# returning). Every fit()/close()/aggregate path must join these; a
-# survivor after a test means a leaked stage.
+# returning), and the SLO autoscaler control thread (ISSUE 10:
+# SLOAutoscaler.stop() must join it). Every fit()/close()/aggregate/stop
+# path must join these; a survivor after a test means a leaked stage.
 _PIPELINE_THREAD_NAMES = ("train-prefetch", "train-listener-delivery",
-                          "async-dataset-iterator", "trace-collector")
+                          "async-dataset-iterator", "trace-collector",
+                          "slo-autoscaler")
 
 
 # --------------------------------------------------------------------------

@@ -620,3 +620,106 @@ def test_check_tables_trace_absent_is_warning(tmp_path):
     msgs = []
     assert bench.check_tables(str(md), str(extra), log=msgs.append) == 0
     assert any("trace" in m and "WARN" in m for m in msgs)
+
+
+def _autoscale_section():
+    """A self-consistent BENCH_EXTRA.json["autoscale"] section (the
+    ISSUE 10 closed-loop drill record)."""
+    return {
+        "requests_total": 41,
+        "errors": 0,
+        "bit_identical": True,
+        "control_ticks": 19,
+        "tick_budget": 100,
+        "breach_tick": 1,
+        "scale_up_tick": 5,
+        "ticks_from_breach": 4,
+        "on_traffic_compiles": 0,
+        "scale_up": {"burn_fast": 8.0, "burn_slow": 4.4,
+                     "replicas_after": 2, "compile_count": 5,
+                     "headroom_bytes": None, "replica_cost_bytes": 2720},
+        "scale_down": {"burn_fast": 0.0, "replicas_after": 1,
+                       "elapsed_since_up_s": 1.52},
+        "config": {"up_burn": 2.0, "confirm_burn": 1.0, "down_burn": 0.5,
+                   "up_cooldown_s": 0.5, "down_cooldown_s": 1.5,
+                   "fast_window_s": 1, "slow_window_s": 2},
+    }
+
+
+def _extra_with_autoscale(section):
+    measured = {k: _mid(*rng) for k, rng in bench.RECORDED_RANGES.items()}
+    measured["autoscale"] = section
+    measured["autoscale_ticks_to_scale"] = section.get("ticks_from_breach")
+    return measured
+
+
+def test_check_tables_validates_autoscale_section(tmp_path):
+    """ISSUE 10 satellite: --check-tables covers the autoscale keys — a
+    self-consistent drill record passes; a drill with client errors, a
+    non-bit-identical run, a tick count not recomputable from the
+    breach/scale-up rows, an over-budget scale-up, on-traffic compiles,
+    a cooldown-violating scale-down, wrong replica trajectories, or a
+    stale top-level copy fails loudly."""
+    md = tmp_path / "BASELINE.md"
+    md.write_text(_table_md(bench.RECORDED_RANGES))
+    extra = tmp_path / "BENCH_EXTRA.json"
+
+    extra.write_text(json.dumps(_extra_with_autoscale(_autoscale_section())))
+    assert bench.check_tables(str(md), str(extra), log=lambda *a: None) == 0
+
+    cases = [
+        (dict(errors=3), "client-invisible"),
+        (dict(bit_identical=False), "bit-identical"),
+        (dict(ticks_from_breach=2), "tick rows give"),
+        (dict(breach_tick=1, scale_up_tick=150, ticks_from_breach=149),
+         "over the recorded budget"),
+        (dict(on_traffic_compiles=2), "compiled on live traffic"),
+    ]
+    for patch, needle in cases:
+        sec = _autoscale_section()
+        sec.update(patch)
+        extra.write_text(json.dumps(_extra_with_autoscale(sec)))
+        msgs = []
+        assert bench.check_tables(str(md), str(extra),
+                                  log=msgs.append) == 1, needle
+        assert any(needle in m for m in msgs), (needle, msgs)
+
+    # a scale-down inside the cooldown is a policy violation on record
+    sec = _autoscale_section()
+    sec["scale_down"]["elapsed_since_up_s"] = 0.8
+    extra.write_text(json.dumps(_extra_with_autoscale(sec)))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("inside the" in m and "cooldown" in m for m in msgs)
+
+    # wrong replica trajectory (never scaled, or never unwound)
+    sec = _autoscale_section()
+    sec["scale_down"]["replicas_after"] = 2
+    extra.write_text(json.dumps(_extra_with_autoscale(sec)))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("expected 2->1" in m for m in msgs)
+
+    # a recorded breach that never breached cannot justify the scale-up
+    sec = _autoscale_section()
+    sec["scale_up"]["burn_fast"] = 1.0
+    extra.write_text(json.dumps(_extra_with_autoscale(sec)))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("never breached" in m for m in msgs)
+
+    # stale top-level copy
+    ex = _extra_with_autoscale(_autoscale_section())
+    ex["autoscale_ticks_to_scale"] = 9
+    extra.write_text(json.dumps(ex))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("autoscale_ticks_to_scale" in m and "top-level" in m
+               for m in msgs)
+
+    # absence is a warning (section not run), never a silent pass
+    measured = {k: _mid(*rng) for k, rng in bench.RECORDED_RANGES.items()}
+    extra.write_text(json.dumps(measured))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 0
+    assert any("autoscale" in m and "WARN" in m for m in msgs)

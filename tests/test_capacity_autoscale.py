@@ -1,0 +1,871 @@
+"""Capacity telemetry + SLO-feedback autoscaler (ISSUE 10): the
+observability loop closed.
+
+Layers:
+
+- **Accounting** — ``serving/capacity.py``'s byte ledger matches the
+  registry's actual parameter sizes exactly; utilization/queue/compile
+  numbers render on ``/v1/capacity`` and ``/metrics``; the router
+  aggregates fleet-wide by summing (busy_s, window_s) pairs and
+  bucket-merging histograms, never averaging.
+- **Runtime replica resize** — ``ContinuousBatcher.add_replica`` warms
+  the newcomer from the live warmup manifest BEFORE routing sees it
+  (zero on-traffic compiles, bit-identical results), indices are never
+  reused, and the HTTP scale endpoint drives it cross-process.
+- **Controller policy** — unit-tested against a fake fleet with an
+  injectable clock: multi-window trigger+confirm, hysteresis gap,
+  cooldowns (deferred decisions logged once, not per tick), capacity
+  guard refusals, and the unwind stack that only scales down what the
+  autoscaler scaled up.
+- **The closed-loop acceptance drill** — a seeded chaos straggler
+  breaches the router's fast-window latency burn; the autoscaler adds a
+  manifest-warmed replica (zero client-visible errors, all responses
+  bit-identical, zero on-traffic compiles), and after the profile clears
+  scales back down only after the cooldown; the decision log explains
+  both decisions with their burn snapshots and capacity headroom.
+- **Satellites** — bounded ``/v1/traces`` (limit/since/hard byte cap),
+  ``/v1/slo`` JSON on server and router, ``DL4J_TPU_TRACE_SLOW_MS``
+  closing the hedge-loser tail-sampling gap, and the fleet
+  ``/v1/metricsz`` aggregation surviving a worker restart without
+  negative deltas.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.runtime import trace
+from deeplearning4j_tpu.runtime.chaos import AddLatency, ChaosController
+from deeplearning4j_tpu.serving import (AutoscalerConfig, ModelRegistry,
+                                        ModelServer, SLOAutoscaler,
+                                        SLOMonitor)
+from deeplearning4j_tpu.serving import capacity as cap
+from deeplearning4j_tpu.serving.metrics import ServingMetrics
+from deeplearning4j_tpu.serving.router import FleetRouter, StaticFleet
+from deeplearning4j_tpu.serving.slo import SLOTarget
+
+
+def _conf(seed=7):
+    return (NeuralNetConfiguration.builder().seed(seed).updater(None)
+            .list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=4, activation="softmax"))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+
+
+RNG = np.random.default_rng(0)
+X = RNG.normal(size=(16, 8)).astype(np.float32)
+BATCHER_KW = dict(max_batch_size=4, buckets=[1, 4], batch_timeout_ms=1.0,
+                  pipeline_depth=0)
+
+
+def _registry():
+    reg = ModelRegistry()
+    reg.register("m", MultiLayerNetwork(_conf()).init(),
+                 warmup_example=X[:1], **BATCHER_KW)
+    return reg
+
+
+def _tree_bytes(tree):
+    return sum(int(leaf.size) * leaf.dtype.itemsize
+               for leaf in jax.tree_util.tree_leaves(tree))
+
+
+def _get(port, path):
+    r = urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=30)
+    return r.status, json.loads(r.read())
+
+
+def _post_json(port, path, obj, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    r = urllib.request.urlopen(req, timeout=timeout)
+    return r.status, json.loads(r.read())
+
+
+# ==========================================================================
+# capacity accounting
+def test_capacity_accounting_matches_registry_exactly():
+    """ISSUE 10 acceptance: /v1/capacity per-model byte accounting matches
+    the registry's actual parameter sizes (zero tolerance here — both
+    sides count the same arrays)."""
+    reg = _registry()
+    try:
+        served = reg.get("m")
+        payload = cap.registry_capacity(reg)
+        c = payload["models"]["m"]
+        ts = served.model.train_state
+        assert c["param_bytes"] == _tree_bytes(ts.params)
+        assert c["model_state_bytes"] == _tree_bytes(ts.model_state)
+        # one replica => one device_put copy of params + model state
+        assert c["device_bytes_total"] == \
+            c["param_bytes"] + c["model_state_bytes"]
+        assert c["param_dtype_bytes"] == {"float32": c["param_bytes"]}
+        assert c["replicas"] == 1
+        assert c["queue"]["limit"] == 256
+        assert c["queue"]["headroom_requests"] == 256
+        assert c["aot_executables"] == len(c["buckets"])  # warmed 1 replica
+        assert payload["totals"]["param_bytes"] == c["param_bytes"]
+        # utilization ships as a (busy_s, window_s) PAIR for summing
+        u = c["utilization"]
+        assert u["window_s"] > 0 and u["busy_s"] >= 0.0
+        assert u["busy_fraction"] == pytest.approx(
+            u["busy_s"] / u["window_s"], rel=1e-3)
+    finally:
+        reg.shutdown()
+
+
+def test_capacity_endpoint_and_metrics_rendering():
+    reg = _registry()
+    srv = ModelServer(reg, worker_id="w0")
+    port = srv.start(0)
+    try:
+        reg.predict("m", X[:2])
+        status, payload = _get(port, "/v1/capacity")
+        assert status == 200
+        assert payload["worker"] == "w0"
+        assert payload["models"]["m"]["param_bytes"] > 0
+        assert "dispatch_latency" in payload["models"]["m"]  # wire hist
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30).read().decode()
+        for line in ("capacity_param_bytes{model=\"m\"}",
+                     "capacity_replicas{model=\"m\"} 1",
+                     "capacity_queue_headroom_requests",
+                     "capacity_param_dtype_bytes{model=\"m\","
+                     "dtype=\"float32\"}"):
+            assert line in text, line
+        # the profiler hook sees the same ledger without a registry ref
+        from deeplearning4j_tpu.runtime import profiler
+        stats = profiler.capacity_stats()
+        assert stats["models"]["m"]["param_bytes"] == \
+            payload["models"]["m"]["param_bytes"]
+    finally:
+        srv.stop(shutdown_registry=True)
+
+
+def test_router_aggregates_fleet_capacity_by_summing():
+    """Two workers serving the same model: the router's /v1/capacity sums
+    bytes/replicas/queue headroom and derives ONE busy fraction from the
+    summed (busy_s, window_s) pairs — never an average of fractions."""
+    regs = [_registry(), _registry()]
+    servers = [ModelServer(r, worker_id=f"w{i}")
+               for i, r in enumerate(regs)]
+    endpoints = {f"w{i}": f"127.0.0.1:{s.start(0)}"
+                 for i, s in enumerate(servers)}
+    router = FleetRouter(StaticFleet(endpoints), probe_interval_s=0.05,
+                         hedge_enabled=False)
+    port = router.start(0)
+    try:
+        deadline = time.monotonic() + 5
+        while (not all(v.ready for v in router.workers().values())
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        for r in regs:
+            r.predict("m", X[:2])
+        status, agg = _get(port, "/v1/capacity")
+        assert status == 200
+        one = cap.registry_capacity(regs[0])["models"]["m"]
+        m = agg["models"]["m"]
+        assert m["workers"] == 2
+        assert m["replicas"] == 2
+        assert m["param_bytes"] == 2 * one["param_bytes"]
+        assert m["device_bytes_total"] == 2 * one["device_bytes_total"]
+        assert m["queue_headroom_requests"] == 2 * 256
+        assert m["dispatch_count"] == 2  # merged histograms, one batch each
+        assert set(agg["workers"]) == {"w0", "w1"}
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30).read().decode()
+        assert 'fleet_capacity_param_bytes{model="m"} ' \
+            f'{2 * one["param_bytes"]}' in text
+        assert 'fleet_capacity_workers{model="m"} 2' in text
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop(shutdown_registry=True)
+
+
+# ==========================================================================
+# fleet /v1/metricsz aggregation under worker restart (ISSUE 10 satellite)
+class _MetricszStub:
+    """A scripted worker that serves /readyz + a settable /v1/metricsz
+    payload (no jax) — lets the restart drill swap in a fresh counter
+    state the way a relaunched worker would."""
+
+    def __init__(self, worker_id):
+        self.worker_id = worker_id
+        self.payload = {"worker": worker_id, "models": {}}
+        self.lock = threading.Lock()
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path == "/readyz":
+                    body = b'{"ready": true}'
+                elif self.path == "/v1/metricsz":
+                    with stub.lock:
+                        body = json.dumps(stub.payload).encode()
+                else:
+                    body = b"{}"
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.address = f"127.0.0.1:{self.httpd.server_address[1]}"
+        threading.Thread(target=self.httpd.serve_forever, daemon=True,
+                         name="metricsz-stub").start()
+
+    def set_metrics(self, metrics: ServingMetrics):
+        with self.lock:
+            self.payload = {"worker": self.worker_id,
+                            "models": {"m": metrics.wire_snapshot()}}
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+def _parse_metric(text, name):
+    out = {}
+    for line in text.splitlines():
+        if line.startswith(name + "{") or line.startswith(name + " "):
+            key, _, v = line.rpartition(" ")
+            out[key] = float(v)
+    return out
+
+
+def test_fleet_metricsz_merge_survives_worker_restart():
+    """ISSUE 10 satellite: a worker restart resets its counters to zero;
+    the router's fleet aggregation is a stateless sum of CURRENT values,
+    so the aggregate drops but can never go negative — and the merged
+    histogram count always equals the sum of the live workers'."""
+    def loaded_metrics(n_requests, latency_s):
+        m = ServingMetrics()
+        for _ in range(n_requests):
+            m.record_admitted()
+            m.record_response(latency_s)
+        m.record_batch(n_requests, n_requests, latency_s, replica=0)
+        return m
+
+    a, b = _MetricszStub("wa"), _MetricszStub("wb")
+    a.set_metrics(loaded_metrics(40, 0.01))
+    b.set_metrics(loaded_metrics(25, 0.05))
+    router = FleetRouter(StaticFleet({"wa": a.address, "wb": b.address}),
+                         probe_interval_s=0.05, hedge_enabled=False)
+    router.start(0)
+    try:
+        deadline = time.monotonic() + 5
+        while (not all(v.ready for v in router.workers().values())
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        text1 = router.render_fleet_metrics()
+        agg1 = _parse_metric(text1, "fleet_serving_responses_total")
+        assert agg1['fleet_serving_responses_total{model="m"}'] == 65
+        counts1 = _parse_metric(text1, "fleet_serving_latency_count")
+        assert counts1['fleet_serving_latency_count{model="m"}'] == 65
+
+        # "restart" wb: fresh process, counters reset to a small number
+        b.set_metrics(loaded_metrics(3, 0.05))
+        text2 = router.render_fleet_metrics()
+        agg2 = _parse_metric(text2, "fleet_serving_responses_total")
+        # the aggregate DROPS (sum of current values) — no negative delta
+        # artifact is possible because nothing subtracts across scrapes
+        assert agg2['fleet_serving_responses_total{model="m"}'] == 43
+        for key, v in {**_parse_metric(text2, "fleet_serving_requests_total"),
+                       **agg2}.items():
+            assert v >= 0, f"negative aggregate {key} = {v}"
+        counts2 = _parse_metric(text2, "fleet_serving_latency_count")
+        assert counts2['fleet_serving_latency_count{model="m"}'] == 43
+    finally:
+        router.stop()
+        a.stop()
+        b.stop()
+
+
+# ==========================================================================
+# runtime replica resize
+def test_replica_resize_bit_identical_and_never_reuses_indices():
+    reg = _registry()
+    try:
+        served = reg.get("m")
+        b = served.batcher
+        oracle = np.asarray(served.model.output(
+            np.concatenate([X[:2], np.zeros((2, 8), X.dtype)])))[:2]
+        base_compiles = b.compile_count()
+        assert b.replica_count == 1
+
+        assert b.add_replica() == 2
+        after_add = b.compile_count()
+        # newcomer fully warmed: exactly one executable per bucket ON TOP
+        # of the baseline ledger (which also counts the oracle's jit call)
+        assert after_add == base_compiles + len(b.buckets)
+        # traffic reaches BOTH replicas (least-loaded round-robin) with
+        # zero further compiles and bit-identical outputs
+        for _ in range(8):
+            assert np.array_equal(
+                np.asarray(reg.predict("m", X[:2])), oracle)
+        assert b.compile_count() == after_add, "compiled on live traffic"
+        assert set(served.metrics.snapshot()["replica_batches"]) == {0, 1}
+
+        assert b.remove_replica() == 1
+        assert b.compile_count() == base_compiles  # retiree's AOT evicted
+        assert np.array_equal(np.asarray(reg.predict("m", X[:2])), oracle)
+
+        # indices are NEVER reused: the next replica gets a fresh index,
+        # so a stale (index, signature) AOT entry can never serve it
+        b.add_replica()
+        assert [r.index for r in b._pool.replicas] == [0, 2]
+        assert b.remove_replica() == 1
+        with pytest.raises(ValueError):
+            b.remove_replica()  # floor: the batcher never goes replica-less
+    finally:
+        reg.shutdown()
+
+
+def test_scale_endpoint_over_http():
+    reg = _registry()
+    srv = ModelServer(reg, worker_id="w0")
+    port = srv.start(0)
+    try:
+        status, out = _post_json(port, "/v1/models/m/replicas",
+                                 {"replicas": 2})
+        assert status == 200
+        assert out["replicas"] == 2 and out["replicas_before"] == 1
+        assert out["compile_count"] == 2 * len(reg.get("m").batcher.buckets)
+        status, out = _post_json(port, "/v1/models/m/replicas",
+                                 {"replicas": 1})
+        assert status == 200 and out["replicas"] == 1
+        # relative form (the autoscaler's lever): applied to the LIVE
+        # count; downward deltas clamp at the one-replica floor
+        status, out = _post_json(port, "/v1/models/m/replicas",
+                                 {"delta": 1})
+        assert status == 200 and out["replicas"] == 2
+        status, out = _post_json(port, "/v1/models/m/replicas",
+                                 {"delta": -5})
+        assert status == 200 and out["replicas"] == 1
+        # the autoscaler's min_replicas floor rides the delta request and
+        # clamps against the LIVE count
+        status, out = _post_json(port, "/v1/models/m/replicas",
+                                 {"delta": 2})
+        assert status == 200 and out["replicas"] == 3
+        status, out = _post_json(port, "/v1/models/m/replicas",
+                                 {"delta": -5, "floor": 2})
+        assert status == 200 and out["replicas"] == 2
+        status, out = _post_json(port, "/v1/models/m/replicas",
+                                 {"delta": -1})
+        assert status == 200 and out["replicas"] == 1
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post_json(port, "/v1/models/m/replicas",
+                       {"replicas": 2, "floor": 2})
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post_json(port, "/v1/models/m/replicas", {"replicas": 0})
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post_json(port, "/v1/models/m/replicas",
+                       {"replicas": 2, "delta": 1})
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post_json(port, "/v1/models/nope/replicas", {"replicas": 2})
+        assert e.value.code == 404
+    finally:
+        srv.stop(shutdown_registry=True)
+
+
+# ==========================================================================
+# controller policy (unit: fake fleet, injectable clock)
+class _FakeView:
+    def __init__(self, wid):
+        self.worker_id = wid
+        self.address = "127.0.0.1:1"
+
+    def admittable(self, now=None):
+        return True
+
+
+class _FakeRouter:
+    """Just enough router for the controller: an SLOMonitor with an
+    injectable clock and a one-worker fleet view."""
+
+    def __init__(self, slo):
+        self.slo = slo
+        self.view = _FakeView("w0")
+        self.autoscaler = None
+
+    def ranked_workers(self, model):
+        return [self.view]
+
+    def workers(self):
+        return {"w0": self.view}
+
+    def attach_autoscaler(self, a):
+        self.autoscaler = a
+
+
+def _fake_capacity(replicas, budget=None, param_bytes=1000):
+    worker = {
+        "models": {"m": {"param_bytes": param_bytes,
+                         "model_state_bytes": 0,
+                         "replicas": replicas,
+                         "utilization": {"busy_fraction": 0.5},
+                         "queue": {"depth": 0,
+                                   "headroom_requests": 256}}},
+        "totals": {"device_bytes": replicas * param_bytes},
+        "process": {"device_budget_bytes": budget},
+    }
+    return {"workers": {"w0": worker}, "models": {}, "process": {}}
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def _controller(clock, slo_clock, **cfg_kw):
+    slo = SLOMonitor(target=SLOTarget(availability=0.999, latency_ms=50.0,
+                                      latency_target=0.9),
+                     windows_s=(10, 60), now_fn=slo_clock)
+    router = _FakeRouter(slo)
+    state = {"replicas": 1, "actions": []}
+
+    def replica_lever(view, model, delta, span):
+        # the production lever is RELATIVE (applied to the worker's live
+        # count under its resize lock) — the fake mirrors that contract
+        state["actions"].append(("delta", delta))
+        state["replicas"] = max(1, state["replicas"] + delta)
+        return True, {"replicas": state["replicas"]}
+
+    def capacity_fn():
+        return _fake_capacity(state["replicas"], budget=state.get("budget"))
+
+    defaults = dict(fast_window_s=10, slow_window_s=60,
+                    up_burn=2.0, confirm_burn=1.0, down_burn=0.5,
+                    up_cooldown_s=5.0, down_cooldown_s=30.0,
+                    min_requests=4, max_replicas=4)
+    defaults.update(cfg_kw)
+    cfg = AutoscalerConfig(**defaults)
+    auto = SLOAutoscaler(router, config=cfg, capacity_fn=capacity_fn,
+                         replica_lever=replica_lever, now_fn=clock)
+    return auto, slo, state
+
+
+def _feed(slo, n, ok=True, slow=False, latency=None):
+    for _ in range(n):
+        slo.record("m", ok=ok,
+                   latency_s=latency if latency is not None
+                   else (0.2 if slow else 0.001))
+
+
+def test_autoscaler_multi_window_trigger_and_confirm():
+    """A fast-window breach alone does not scale (the slow window must
+    confirm); a sustained breach does; cooldown defers the second
+    scale-up and logs the deferral ONCE, not per tick."""
+    clock, sclock = _Clock(), _Clock()
+    auto, slo, state = _controller(clock, sclock)
+
+    # 20 slow responses NOW: fast window (10s) burns hot; backfill the
+    # slow window (60s) with enough healthy history that it does NOT
+    # confirm (30 slow of 300 = 10% slow => latency burn 1.0 > ... )
+    sclock.t = 1000.0 - 50.0
+    _feed(slo, 400, slow=False)
+    sclock.t = 1000.0
+    _feed(slo, 20, slow=True)
+    decisions = auto.tick()
+    assert state["replicas"] == 1
+    assert not [d for d in decisions if d["action"].startswith("scale")]
+
+    # now the slow window confirms too (sustained breach)
+    _feed(slo, 400, slow=True)
+    decisions = auto.tick()
+    assert state["replicas"] == 2
+    up = [d for d in decisions if d["action"] == "scale_up_replica"]
+    assert len(up) == 1 and up[0]["ok"]
+    assert up[0]["burn"]["burn_fast"] >= 2.0
+    assert up[0]["burn"]["burn_slow"] >= 1.0
+    assert up[0]["capacity"]["replica_cost_bytes"] == 1000
+
+    # still breaching inside the up-cooldown: deferred, logged once
+    clock.t += 1.0
+    d1 = auto.tick()
+    d2 = auto.tick()
+    assert [d["action"] for d in d1] == ["suppressed_up_cooldown"]
+    assert d2 == []  # the streak is not re-logged every tick
+    # cooldown over: second scale-up fires
+    clock.t += 10.0
+    assert [d["action"] for d in auto.tick()] == ["scale_up_replica"]
+    assert state["replicas"] == 3
+
+
+def test_autoscaler_hysteresis_cooldown_and_unwind():
+    clock, sclock = _Clock(), _Clock()
+    auto, slo, state = _controller(clock, sclock)
+    _feed(slo, 400, slow=True)
+    auto.tick()
+    assert state["replicas"] == 2
+
+    # recovery: healthy traffic ages the breach out of both windows
+    sclock.t += 120.0
+    _feed(slo, 50, slow=False)
+    clock.t += 10.0  # past up_cooldown, inside down_cooldown
+    assert [d["action"] for d in auto.tick()] == ["suppressed_down_cooldown"]
+    assert state["replicas"] == 2
+    clock.t += 30.0  # past down_cooldown
+    downs = auto.tick()
+    assert [d["action"] for d in downs] == ["scale_down_replica"]
+    assert state["replicas"] == 1
+    # fully unwound: a still-healthy fleet never scales below baseline
+    clock.t += 100.0
+    assert auto.tick() == []
+    assert state["replicas"] == 1
+
+
+def test_autoscaler_capacity_guard_refuses_and_explains():
+    clock, sclock = _Clock(), _Clock()
+    auto, slo, state = _controller(clock, sclock)
+    state["budget"] = 1500  # one replica (1000 B) in use; +1000 won't fit
+    _feed(slo, 400, slow=True)
+    decisions = auto.tick()
+    assert state["replicas"] == 1  # refused
+    guard = [d for d in decisions
+             if d["action"] == "suppressed_capacity_guard"]
+    assert len(guard) == 1
+    assert guard[0]["capacity"]["headroom_bytes"] == 500
+    assert guard[0]["capacity"]["replica_cost_bytes"] == 1000
+    assert guard[0]["ok"] is False
+    # the refusal is deduped across the streak, then budget growth heals
+    assert auto.tick() == []
+    state["budget"] = 4000
+    assert [d["action"] for d in auto.tick()] == ["scale_up_replica"]
+    assert state["replicas"] == 2
+
+
+def test_autoscaler_worker_lever_when_replicas_at_max():
+    clock, sclock = _Clock(), _Clock()
+    added, removed = [], []
+
+    class _FakeFleet:
+        def remove_worker(self, wid):
+            removed.append(wid)
+
+    auto, slo, state = _controller(clock, sclock, max_replicas=1,
+                                   max_workers=3)
+    auto.fleet = _FakeFleet()
+    auto._worker_lever = lambda view, sp: (
+        added.append("w0-as1") or True, {"worker_id": "w0-as1"})
+    _feed(slo, 400, slow=True)
+    decisions = auto.tick()
+    assert [d["action"] for d in decisions] == ["scale_up_worker"]
+    assert added == ["w0-as1"]
+    sclock.t += 120.0
+    _feed(slo, 50, slow=False)
+    clock.t += 60.0
+    assert [d["action"] for d in auto.tick()] == ["scale_down_worker"]
+    assert removed == ["w0-as1"]
+
+
+def test_autoscaler_defers_without_capacity_data():
+    """A controller must not act blind: when the target worker has no
+    capacity entry this tick (scrape timed out, worker just joined), the
+    breach is deferred — explained once — instead of guessing a replica
+    count (an absolute guess could have turned a scale-up into a
+    collapse; the lever is relative, but the guard still needs data)."""
+    clock, sclock = _Clock(), _Clock()
+    auto, slo, state = _controller(clock, sclock)
+    auto._capacity_fn = lambda: {}  # scrape lost every worker
+    _feed(slo, 400, slow=True)
+    decisions = auto.tick()
+    assert [d["action"] for d in decisions] == ["suppressed_no_capacity"]
+    assert state["replicas"] == 1
+    assert auto.tick() == []  # deferral logged once per streak
+
+
+def test_autoscaler_config_validation():
+    slo = SLOMonitor(windows_s=(10, 60))
+    router = _FakeRouter(slo)
+    with pytest.raises(ValueError, match="not one of"):
+        SLOAutoscaler(router, config=AutoscalerConfig(fast_window_s=7,
+                                                      slow_window_s=60))
+    with pytest.raises(ValueError, match="shorter than"):
+        SLOAutoscaler(router, config=AutoscalerConfig(fast_window_s=60,
+                                                      slow_window_s=10))
+    with pytest.raises(ValueError, match="hysteresis"):
+        SLOAutoscaler(router, config=AutoscalerConfig(
+            fast_window_s=10, slow_window_s=60, down_burn=2.0))
+
+
+def test_autoscaler_control_thread_starts_and_joins():
+    """The control thread (named ``slo-autoscaler``; conftest leak guard)
+    runs ticks on its own and joins cleanly at stop()."""
+    slo = SLOMonitor(windows_s=(10, 60))
+    router = _FakeRouter(slo)
+    auto = SLOAutoscaler(router, config=AutoscalerConfig(
+        tick_s=0.02, fast_window_s=10, slow_window_s=60))
+    with auto:
+        assert router.autoscaler is auto
+        deadline = time.monotonic() + 5
+        while auto.ticks < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert auto.ticks >= 3
+    assert not any(t.name == "slo-autoscaler"
+                   for t in threading.enumerate() if t.is_alive())
+
+
+# ==========================================================================
+# the closed-loop acceptance drill (ISSUE 10)
+def test_closed_loop_autoscaling_drill():
+    """Seeded chaos straggler -> router fast-window latency burn breaches
+    -> the autoscaler adds a manifest-warmed replica (zero on-traffic
+    compiles, zero client-visible errors, responses bit-identical) ->
+    profile clears -> scale-down only after the cooldown; the decision
+    log explains both decisions; /v1/autoscaler serves it."""
+    reg = _registry()
+    served = reg.get("m")
+    oracle = np.asarray(served.model.output(
+        np.concatenate([X[:2], np.zeros((2, 8), X.dtype)])))[:2]
+    base_compiles = served.batcher.compile_count()
+    srv = ModelServer(reg, worker_id="w0")
+    addr = f"127.0.0.1:{srv.start(0)}"
+    slo = SLOMonitor(target=SLOTarget(availability=0.999, latency_ms=30.0,
+                                      latency_target=0.9),
+                     windows_s=(1, 2, 3600))
+    router = FleetRouter(StaticFleet({"w0": addr}), probe_interval_s=0.05,
+                         hedge_enabled=False, slo=slo)
+    port = router.start(0)
+    cfg = AutoscalerConfig(tick_s=0.1, fast_window_s=1, slow_window_s=2,
+                           up_burn=2.0, confirm_burn=1.0, down_burn=0.5,
+                           up_cooldown_s=0.5, down_cooldown_s=1.5,
+                           min_requests=5, max_replicas=2)
+    auto = SLOAutoscaler(router, config=cfg)
+    router.attach_autoscaler(auto)
+    errors, outputs = 0, []
+
+    def post():
+        nonlocal errors
+        body = json.dumps({"inputs": X[:2].tolist(),
+                           "timeout_ms": 15000}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/m/predict", data=body)
+        try:
+            r = urllib.request.urlopen(req, timeout=30)
+            outputs.append(np.asarray(json.loads(r.read())["outputs"],
+                                      np.float32))
+        except Exception:
+            errors += 1
+
+    try:
+        # phase 1: seeded straggler profile -> breach -> scale-up.
+        # ticked MANUALLY (auto.tick is public) so the drill is
+        # deterministic about what happened between which requests.
+        up = None
+        with ChaosController(seed=5) as c:
+            c.on("serving.worker.predict", AddLatency(0.08, p=0.7))
+            deadline = time.monotonic() + 20
+            while up is None and time.monotonic() < deadline:
+                post()
+                for d in auto.tick():
+                    if d["action"] == "scale_up_replica" and d["ok"]:
+                        up = d
+        assert up is not None, "no scale-up within the drill budget"
+        assert served.batcher.replica_count == 2
+        # the decision is explained: triggering burn snapshot + headroom
+        assert up["burn"]["burn_fast"] >= cfg.up_burn
+        assert up["burn"]["burn_slow"] >= cfg.confirm_burn
+        assert up["burn"]["fast"]["requests"] >= cfg.min_requests
+        assert up["capacity"]["replica_cost_bytes"] > 0
+        assert up["detail"]["replicas"] == 2
+        # manifest-warmed: the worker reported the full warmed ledger at
+        # scale time, and live traffic after it mints NOTHING
+        compiles_at_scale = up["detail"]["compile_count"]
+        assert compiles_at_scale == \
+            base_compiles + len(served.batcher.buckets)
+
+        # phase 2: profile cleared -> healthy traffic; no new compiles
+        for _ in range(10):
+            post()
+        assert served.batcher.compile_count() == compiles_at_scale, \
+            "a scaled-up replica compiled on live traffic"
+
+        # phase 3: recovery -> scale-down, only after the cooldown
+        down = None
+        deadline = time.monotonic() + 20
+        while down is None and time.monotonic() < deadline:
+            post()
+            for d in auto.tick():
+                if d["action"] == "scale_down_replica" and d["ok"]:
+                    down = d
+            time.sleep(0.05)
+        assert down is not None, "no scale-down within the drill budget"
+        assert served.batcher.replica_count == 1
+        assert down["ts"] - up["ts"] >= cfg.down_cooldown_s - 0.05
+        assert down["burn"]["burn_fast"] <= cfg.down_burn
+
+        # zero client-visible errors, every response bit-identical
+        assert errors == 0
+        assert len(outputs) >= 20
+        for got in outputs:
+            assert np.array_equal(got, oracle)
+
+        # the flight-recorder read side: /v1/autoscaler explains it all
+        status, rep = _get(port, "/v1/autoscaler")
+        assert status == 200
+        actions = [d["action"] for d in rep["decisions"] if d["ok"]]
+        assert "scale_up_replica" in actions
+        assert "scale_down_replica" in actions
+        assert rep["models"]["m"]["level"] == 0
+    finally:
+        router.stop()
+        srv.stop(shutdown_registry=True)
+
+
+# ==========================================================================
+# satellites: /v1/slo, bounded /v1/traces, DL4J_TPU_TRACE_SLOW_MS
+def test_slo_json_endpoint_on_server_and_router():
+    reg = _registry()
+    srv = ModelServer(reg, worker_id="w0")
+    port = srv.start(0)
+    router = FleetRouter(StaticFleet({"w0": f"127.0.0.1:{port}"}),
+                         probe_interval_s=0.05, hedge_enabled=False)
+    rport = router.start(0)
+    try:
+        body = json.dumps({"inputs": X[:2].tolist()}).encode()
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{rport}/v1/models/m/predict", data=body),
+            timeout=30).read()
+        status, worker_slo = _get(port, "/v1/slo")
+        assert status == 200
+        assert worker_slo["windows_s"] == [60, 300, 3600]
+        assert worker_slo["slo"]["m"]["windows"]["60s"]["requests"] == 1
+        status, fleet_slo = _get(rport, "/v1/slo")
+        assert status == 200
+        # the router's monitor is the fleet-wide signal (same report
+        # shape the autoscaler consumes)
+        assert fleet_slo["slo"]["m"]["windows"]["60s"]["requests"] == 1
+        assert fleet_slo["slo"]["m"]["windows"]["60s"][
+            "availability_burn_rate"] == 0.0
+    finally:
+        router.stop()
+        srv.stop(shutdown_registry=True)
+
+
+def _make_trace(tag):
+    with trace.server_span(f"req-{tag}") as sp:
+        sp.flag("fault")  # always kept
+    return sp.trace_id
+
+
+def test_bound_traces_limit_since_and_byte_cap():
+    trace.enable(rate=0.0, capacity=64, seed=1)
+    try:
+        ids = [_make_trace(i) for i in range(6)]
+        recs = trace.collector().traces()
+        assert [r["trace_id"] for r in recs] == ids
+
+        out, truncated = trace.bound_traces(recs, limit=2)
+        assert truncated and [r["trace_id"] for r in out] == ids[-2:]
+
+        cut = recs[3]["spans"][0]["start_ts"]
+        out, _ = trace.bound_traces(recs, since=cut)
+        assert [r["trace_id"] for r in out] == ids[3:]
+
+        one = len(json.dumps(recs[-1], default=str).encode())
+        out, truncated = trace.bound_traces(recs, max_bytes=one + 10)
+        assert truncated and [r["trace_id"] for r in out] == ids[-1:]
+        # a single over-cap record is still returned, flagged truncated
+        out, truncated = trace.bound_traces(recs, max_bytes=5)
+        assert truncated and [r["trace_id"] for r in out] == ids[-1:]
+    finally:
+        trace.disable()
+        trace.collector().clear()
+
+
+def test_traces_endpoint_is_bounded():
+    trace.enable(rate=0.0, capacity=64, seed=1)
+    reg = _registry()
+    srv = ModelServer(reg, worker_id="w0")
+    port = srv.start(0)
+    try:
+        for i in range(5):
+            _make_trace(i)
+        status, out = _get(port, "/v1/traces?limit=3")
+        assert status == 200
+        assert len(out["traces"]) == 3 and out["truncated"] is True
+        status, out = _get(port, "/v1/traces")
+        assert len(out["traces"]) == 5 and out["truncated"] is False
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(port, "/v1/traces?limit=junk")
+        assert e.value.code == 400
+        # router side: bound forwarded to workers AND applied post-merge
+        router = FleetRouter(StaticFleet({"w0": f"127.0.0.1:{port}"}),
+                             probe_interval_s=0.05, hedge_enabled=False)
+        rport = router.start(0)
+        try:
+            deadline = time.monotonic() + 5
+            while (not all(v.ready for v in router.workers().values())
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            status, out = _get(rport, "/v1/traces?limit=2")
+            assert status == 200
+            assert len(out["traces"]) == 2 and out["truncated"] is True
+        finally:
+            router.stop()
+    finally:
+        srv.stop(shutdown_registry=True)
+        trace.disable()
+        trace.collector().clear()
+
+
+def test_trace_env_slow_threshold_parsing():
+    """ISSUE 10 satellite: DL4J_TPU_TRACE_SLOW_MS alone enables tracing
+    at rate 0 with the threshold — the worker-side knob that lets a
+    slow-but-healthy hedge LOSER self-keep its half of the trace."""
+    parse = trace._env_config
+    assert parse({}) is None
+    assert parse({"DL4J_TPU_TRACE": "0"}) is None
+    assert parse({"DL4J_TPU_TRACE_SLOW_MS": "120"}) == (0.0, 120.0)
+    assert parse({"DL4J_TPU_TRACE": "0",
+                  "DL4J_TPU_TRACE_SLOW_MS": "120"}) == (0.0, 120.0)
+    assert parse({"DL4J_TPU_TRACE": "0.25",
+                  "DL4J_TPU_TRACE_SLOW_MS": "80"}) == (0.25, 80.0)
+    assert parse({"DL4J_TPU_TRACE": "on"}) == (1.0, None)
+    assert parse({"DL4J_TPU_TRACE_SLOW_MS": "junk"}) is None
+    assert parse({"DL4J_TPU_TRACE_SLOW_MS": "-5"}) is None
+
+
+def test_slow_threshold_keeps_straggler_half_at_rate_zero():
+    """The behavioral half of the gap-closing: at sampling rate 0 with a
+    latency threshold, a slow-but-healthy request self-keeps (flag
+    ``slow``) while a fast healthy one is dropped — exactly what the
+    hedge loser's worker needs."""
+    trace.enable(rate=0.0, latency_threshold_ms=20.0, capacity=16, seed=1)
+    try:
+        with trace.server_span("worker.predict"):
+            pass  # fast + healthy: dropped
+        with trace.server_span("worker.predict") as sp:
+            time.sleep(0.03)  # the straggling hedge loser's shape
+        kept = trace.collector().traces()
+        assert [r["trace_id"] for r in kept] == [sp.trace_id]
+        assert kept[0]["flags"] == ["slow"]
+        assert trace.collector().dropped == 1
+    finally:
+        trace.disable()
+        trace.collector().clear()

@@ -616,6 +616,41 @@ def test_rolling_deploy_zero_downtime_no_on_traffic_compiles(proc_fleet):
     assert compile_counts() == before, "a worker compiled on live traffic"
 
 
+def test_fleet_add_and_remove_worker_at_runtime(proc_fleet):
+    """ISSUE 10: the autoscaler's fleet lever. A cloned-spec worker joins
+    the running fleet (manifest-prewarmed, admitted through the existing
+    /readyz prober with zero integration work) and retires cleanly — the
+    watchdog never resurrects a retired worker."""
+    sup, router, port, oracle, _ = proc_fleet
+    assert _wait_until(lambda: len(sup.endpoints()) == 3, timeout_s=90)
+    spec = sup.clone_spec("w0", "w0-as1")
+    assert spec.worker_id == "w0-as1"
+    assert spec.archive == sup._handles["w0"].spec.archive
+    sup.add_worker(spec)
+    assert "w0-as1" in sup.endpoints()
+    # the router's prober admits the newcomer on its own
+    assert _wait_until(
+        lambda: (v := router.workers().get("w0-as1")) is not None
+        and v.ready, timeout_s=30)
+    with pytest.raises(ValueError):
+        sup.add_worker(spec)  # duplicate id refused
+    # traffic still bit-identical with the grown fleet
+    for k in range(6):
+        status, _, out = _post(port, n=1 + k % 4, ofs=k % 8)
+        assert status == 200
+        got = np.asarray(out["outputs"], np.float32)
+        assert any(np.array_equal(got, ref)
+                   for ref in _oracle_out(oracle, 1 + k % 4, k % 8))
+    sup.remove_worker("w0-as1")
+    assert "w0-as1" not in sup.endpoints()
+    assert "w0-as1" not in sup.worker_ids()
+    # removed for good: the watchdog does not bring it back
+    time.sleep(1.0)
+    assert "w0-as1" not in sup.endpoints()
+    assert _wait_until(lambda: "w0-as1" not in router.workers(),
+                       timeout_s=30)
+
+
 # ==========================================================================
 # slow tier: sustained load under a fixed chaos schedule
 @pytest.mark.slow
