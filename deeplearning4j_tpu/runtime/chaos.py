@@ -41,6 +41,9 @@ to a replica), ``serving.batcher.complete`` (completion stage — fires
 before the blocking readback, so ``AddLatency`` here simulates a slow
 device and fills the pipeline's in-flight window),
 ``serving.batcher.warmup``, ``serving.registry.register``,
+``serving.registry.page_in`` (fires as a cold model's single-flight
+rehydration begins — ``AddLatency`` here simulates a slow page-in so
+drills can exercise the queue-wait and honest-``Retry-After`` paths),
 ``train.checkpoint.write`` (call), ``train.checkpoint.bytes`` (byte
 point), ``train.epoch``, ``train.iteration`` (via :class:`ChaosListener`),
 ``train.prefetch.fetch`` (fires once per fetched batch on the training

@@ -53,6 +53,17 @@ The subsystem that puts traffic on this stack:
   (manifest-warmed, zero on-traffic compiles) and fleet worker count,
   with hysteresis, cooldowns, a capacity guard, and a traced, bounded
   decision log on ``/v1/autoscaler``.
+- ``paging.py`` (ISSUE 11, ``docs/fleet_serving.md``) — HBM-budgeted
+  model residency: under ``DL4J_TPU_HBM_BUDGET_BYTES`` (or the measured
+  device budget) the registry keeps only the highest-value models
+  RESIDENT, pages the rest COLD under cost-weighted-LRU eviction
+  (bytes x recompile-risk x traffic EWMA, in-flight-safe via pins), and
+  rehydrates on demand — single-flight, manifest-prewarmed, with honest
+  ``Retry-After`` (:class:`PagingInProgress`) when a deadline cannot
+  cover the wait. The router routes cold-model traffic to the worker
+  with the model resident (or the most eviction-free headroom), and the
+  autoscaler rebalances placement before spawning workers when the wall
+  is HBM, not compute.
 - :class:`WarmupManifest` (``manifest.py``) — persisted record of every
   compiled (bucket, replica, dtype) pair, written next to model archives
   and replayed by registry load / hot-swap so a restart reaches READY
@@ -70,9 +81,14 @@ import importlib
 _EXPORTS = {
     "AdmissionController": "admission",
     "DeadlineExceeded": "admission",
+    "HBMBudgetExceeded": "admission",
     "Overloaded": "admission",
+    "PagingInProgress": "admission",
     "ServingError": "admission",
     "ServingShutdown": "admission",
+    "PagingMetrics": "paging",
+    "Residency": "paging",
+    "TrafficEWMA": "paging",
     "AutoscalerConfig": "autoscale",
     "SLOAutoscaler": "autoscale",
     "ContinuousBatcher": "batcher",

@@ -35,12 +35,40 @@ class Overloaded(ServingError):
         self.retry_after_ms = retry_after_ms
 
 
+class PagingInProgress(Overloaded):
+    """The requested model is COLD and its page-in could not complete
+    within the caller's deadline (ISSUE 11, HBM-budgeted paging).
+
+    A cold-model request normally just WAITS in the page-in queue and
+    succeeds; this is raised only when the deadline provably cannot cover
+    the wait. ``retry_after_ms`` is the *honest* remaining estimate —
+    the model's measured page-in cost minus the time the in-flight load
+    has already spent (:func:`page_in_retry_after_ms`) — rather than the
+    generic drain-rate hint an overload rejection carries."""
+
+
+class HBMBudgetExceeded(ServingError):
+    """No room under the HBM budget and no evictable victim: every other
+    resident model is pinned by in-flight requests or is not
+    archive-backed. A transient condition — pins are request-scoped —
+    surfaced explicitly instead of silently overshooting the budget."""
+
+
 class DeadlineExceeded(ServingError):
     """Request admitted but its deadline expired before execution."""
 
 
 class ServingShutdown(ServingError):
     """The batcher was shut down while this request was still queued."""
+
+
+def page_in_retry_after_ms(est_page_in_ms: float, elapsed_ms: float = 0.0,
+                           floor_ms: float = 25.0) -> float:
+    """Honest ``Retry-After`` for a request that cannot wait out a cold
+    model's page-in: the measured page-in cost minus what the in-flight
+    load has already spent, floored like the overload drain hint so an
+    unmeasured first page-in never advertises an instant retry."""
+    return max(float(floor_ms), float(est_page_in_ms) - float(elapsed_ms))
 
 
 class AdmissionController:
@@ -78,6 +106,14 @@ class AdmissionController:
                 f"requests waiting); retry later or raise queue_limit",
                 retry_after_ms=self.retry_after_ms(queue_depth,
                                                    drain_ms_per_request))
+
+    def page_in_retry_after_ms(self, est_page_in_ms: float,
+                               elapsed_ms: float = 0.0) -> float:
+        """The page-in twin of :meth:`retry_after_ms` (ISSUE 11): the
+        honest cold-model hint, floored by this controller's own
+        ``retry_after_floor_ms``."""
+        return page_in_retry_after_ms(est_page_in_ms, elapsed_ms,
+                                      floor_ms=self.retry_after_floor_ms)
 
     def deadline_for(self, timeout_ms: Optional[float]) -> Optional[float]:
         """Absolute monotonic deadline for a request, or None."""

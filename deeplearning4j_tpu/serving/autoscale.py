@@ -44,6 +44,14 @@ Control policy (``docs/observability.md`` has the runbook):
 - **Unwind discipline**: the autoscaler only scales down what IT scaled
   up (a per-model action stack), so a hand-provisioned baseline is never
   eroded below ``min_replicas``/the launch fleet.
+- **Out of HBM != out of compute** (ISSUE 11): a capacity-guard refusal
+  means the worker is memory-bound — more replicas there cannot help.
+  The controller first REBALANCES PLACEMENT: page the model in on a
+  worker with eviction-free headroom (``POST /v1/models/<m>/residency``;
+  the router's placement-aware ranking then shifts the traffic), and
+  only spawns a worker — new HBM — when no placed worker has room. The
+  decision log's ``capacity.bound`` field (``"hbm"`` vs ``"compute"``)
+  records which wall was hit.
 
 Every decision — acted, refused by the guard, or deferred by a cooldown —
 is an explained, traced event: a bounded log records the triggering
@@ -100,6 +108,10 @@ class AutoscalerConfig:
     #: capacity guard budget; ``None`` falls back to the target worker's
     #: measured device budget (backends that report one), else unbounded
     memory_budget_bytes: Optional[int] = None
+    #: when a scale-up is refused for MEMORY (out of HBM, not compute —
+    #: ISSUE 11), first try to rebalance placement: page the model in on
+    #: a worker with eviction-free headroom instead of spawning a worker
+    rebalance_enabled: bool = True
     #: decision-log ring size
     log_capacity: int = 256
     #: socket budget for the replica lever (warmup compiles take seconds)
@@ -147,6 +159,7 @@ class SLOAutoscaler:
                  capacity_fn: Optional[Callable[[], Dict[str, Any]]] = None,
                  replica_lever: Optional[Callable] = None,
                  worker_lever: Optional[Callable] = None,
+                 residency_lever: Optional[Callable] = None,
                  now_fn: Callable[[], float] = time.monotonic):
         self.router = router
         self.fleet = fleet
@@ -180,6 +193,7 @@ class SLOAutoscaler:
                                           lambda: {}))
         self._replica_lever = replica_lever or self._http_scale_replicas
         self._worker_lever = worker_lever
+        self._residency_lever = residency_lever or self._http_page_in
         self._now = now_fn
         self._states: Dict[str, _ModelState] = {}
         self._lock = threading.Lock()
@@ -214,6 +228,32 @@ class SLOAutoscaler:
                          json.dumps({"delta": int(delta),
                                      "floor": int(self.config.min_replicas)}
                                     ).encode(), headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            try:
+                body = json.loads(data.decode())
+            except Exception:
+                body = {"raw": data.decode(errors="replace")[:200]}
+            return resp.status == 200, body
+        finally:
+            conn.close()
+
+    def _http_page_in(self, view, model: str, span) -> tuple:
+        """Placement-rebalance lever (ISSUE 11): page ``model`` in on
+        ``view`` via the worker's residency endpoint — the worker with
+        eviction-free headroom becomes a RESIDENT home for the model, and
+        the router's placement-aware ranking shifts its traffic there
+        before any worker is spawned."""
+        host, port = view.address.rsplit(":", 1)
+        conn = http.client.HTTPConnection(
+            host, int(port), timeout=self.config.lever_timeout_s)
+        headers = {"Content-Type": "application/json"}
+        if span.recording:
+            headers["X-Trace-Id"] = span.trace_id
+            headers["X-Parent-Span-Id"] = span.span_id
+        try:
+            conn.request("POST", f"/v1/models/{model}/residency",
+                         json.dumps({"state": "resident"}).encode(), headers)
             resp = conn.getresponse()
             data = resp.read()
             try:
@@ -278,6 +318,13 @@ class SLOAutoscaler:
             "queue": entry.get("queue"),
         }
         ok = headroom is None or headroom >= needed
+        # classify the binding constraint (ISSUE 11): a guard refusal is
+        # "out of HBM" — the fix is placement (evict/page elsewhere) or a
+        # NEW worker's memory, never more replicas on this one; an
+        # approved scale-up is "out of compute" (burn with memory to
+        # spare). The decision log carries it so "why did the fleet grow"
+        # distinguishes the two resource walls.
+        record["bound"] = "compute" if ok else "hbm"
         return ok, record
 
     # ------------------------------------------------------------ the loop
@@ -372,10 +419,35 @@ class SLOAutoscaler:
                                     f"{view.worker_id!r} this tick",
                              dedup=True)
         if not ok_guard:
+            # OUT OF HBM, not out of compute (ISSUE 11): more replicas on
+            # this worker cannot help. Rebalance placement first — page
+            # the model in on a worker with eviction-free headroom, so
+            # the router's placement ranking moves the traffic — and only
+            # spawn a worker (new HBM) when no such worker exists.
+            if cfg.rebalance_enabled:
+                target = self._rebalance_target(model, view)
+                if target is not None:
+                    try:
+                        ok, detail = self._residency_lever(target, model, sp)
+                    except Exception as e:
+                        ok, detail = False, {"error": repr(e)}
+                    if ok:
+                        st.last_action_ts = self._now()
+                        st.suppressed = None
+                    return self._log(model, st, "rebalance_page_in", burn,
+                                     headroom, span=sp, ok=ok,
+                                     worker=target.worker_id, detail=detail)
+            entry = self._worker_entry(model, st, burn, view, headroom, sp,
+                                       reason="out of HBM on every placed "
+                                              "worker")
+            if entry is not None:
+                return entry
             return self._log(model, st, "suppressed_capacity_guard",
                              burn, headroom, span=sp, ok=False,
-                             detail="scale-up refused: replica cost exceeds "
-                                    "memory headroom", dedup=True)
+                             detail="scale-up refused: out of HBM (replica "
+                                    "cost exceeds memory headroom) and no "
+                                    "rebalance target or worker headroom",
+                             dedup=True)
         replicas = int(headroom["replicas"])
         if replicas < cfg.max_replicas:
             try:
@@ -389,25 +461,73 @@ class SLOAutoscaler:
             return self._log(model, st, "scale_up_replica", burn, headroom,
                              span=sp, ok=ok, worker=view.worker_id,
                              detail=detail)
-        if (self.fleet is not None and cfg.max_workers is not None
-                and len(self.router.workers()) < cfg.max_workers):
-            lever = self._worker_lever or self._spawn_worker
-            try:
-                ok, detail = lever(view, sp)
-            except Exception as e:
-                ok, detail = False, {"error": repr(e)}
-            if ok:
-                st.actions.append(("worker", detail.get("worker_id")))
-                st.last_action_ts = self._now()
-                st.suppressed = None
-            return self._log(model, st, "scale_up_worker", burn, headroom,
-                             span=sp, ok=ok, worker=view.worker_id,
-                             detail=detail)
+        entry = self._worker_entry(model, st, burn, view, headroom, sp,
+                                   reason="replicas at max")
+        if entry is not None:
+            return entry
         return self._log(model, st, "suppressed_at_max", burn, headroom,
                          span=sp, ok=False,
                          detail=f"replicas={replicas} at max_replicas="
                                 f"{cfg.max_replicas} and no worker "
                                 f"headroom", dedup=True)
+
+    def _worker_entry(self, model, st, burn, view, headroom, sp, reason):
+        """The fleet lever (spawn a cloned worker), shared by the
+        compute-bound (replicas at max) and HBM-bound (no rebalance
+        target) paths; ``None`` when the lever is unavailable."""
+        cfg = self.config
+        if not (self.fleet is not None and cfg.max_workers is not None
+                and len(self.router.workers()) < cfg.max_workers):
+            return None
+        lever = self._worker_lever or self._spawn_worker
+        try:
+            ok, detail = lever(view, sp)
+        except Exception as e:
+            ok, detail = False, {"error": repr(e)}
+        if ok:
+            st.actions.append(("worker", detail.get("worker_id")))
+            st.last_action_ts = self._now()
+            st.suppressed = None
+        if isinstance(detail, dict):
+            detail = {**detail, "reason": reason}
+        return self._log(model, st, "scale_up_worker", burn, headroom,
+                         span=sp, ok=ok, worker=view.worker_id,
+                         detail=detail)
+
+    def _rebalance_target(self, model, view):
+        """The best placement-rebalance target: an admittable worker
+        (other than ``view``) that knows ``model`` COLD and has the most
+        eviction-free headroom covering the model's bytes. ``None`` when
+        no worker qualifies — or when the model is already RESIDENT
+        elsewhere (routing, not this controller, should shift the
+        traffic)."""
+        cap = self._capacity()
+        live = self.router.workers()
+        now = time.monotonic()
+        best = None
+        best_headroom = None
+        for wid, payload in sorted((cap.get("workers") or {}).items()):
+            if wid == view.worker_id:
+                continue
+            w = live.get(wid)
+            if w is None or not w.admittable(now):
+                continue
+            res = payload.get("residency")
+            if not isinstance(res, dict):
+                continue
+            entry = (res.get("models") or {}).get(model)
+            if not isinstance(entry, dict):
+                continue
+            if entry.get("state") == "resident":
+                return None  # already placed elsewhere; routing handles it
+            budget = res.get("hbm_budget_bytes")
+            headroom = (float("inf") if budget is None else
+                        int(budget) - int(res.get("resident_bytes", 0)))
+            if headroom < int(entry.get("bytes", 0)):
+                continue  # paging in here would evict someone else
+            if best_headroom is None or headroom > best_headroom:
+                best, best_headroom = w, headroom
+        return best
 
     def _scale_down(self, model, st, burn, view, headroom, sp):
         kind, wid = st.actions[-1]

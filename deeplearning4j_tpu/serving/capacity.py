@@ -50,7 +50,8 @@ import os
 from typing import Any, Dict, Optional
 
 __all__ = ["model_capacity", "process_capacity", "registry_capacity",
-           "render_prometheus", "persistent_cache_bytes"]
+           "render_prometheus", "persistent_cache_bytes",
+           "served_device_bytes"]
 
 
 def _leaf_bytes(tree) -> Dict[str, int]:
@@ -66,6 +67,27 @@ def _leaf_bytes(tree) -> Dict[str, int]:
         key = str(dt)
         out[key] = out.get(key, 0) + nbytes
     return out
+
+
+def served_device_bytes(served) -> int:
+    """One served model's total device-resident bytes: every replica's
+    ``device_put`` param + model-state copies (the fallback pseudo-replica
+    counts the host state that executes). This is the number the
+    registry's HBM-budget ledger tracks per model (ISSUE 11) — the same
+    per-replica math :func:`model_capacity` reports, so reservation,
+    eviction accounting, and the ``/v1/capacity`` scrape all agree."""
+    pool = served.batcher._pool
+    ts = getattr(served.model, "train_state", None)
+    host = (sum(_leaf_bytes(getattr(ts, "params", None)).values())
+            + sum(_leaf_bytes(getattr(ts, "model_state", None)).values()))
+    total = 0
+    for rep in list(pool.replicas):
+        if rep.params is not None:
+            total += (sum(_leaf_bytes(rep.params).values())
+                      + sum(_leaf_bytes(rep.model_state).values()))
+        else:
+            total += host
+    return total
 
 
 def model_capacity(served) -> Dict[str, Any]:
@@ -206,14 +228,19 @@ def process_capacity() -> Dict[str, Any]:
 
 def registry_capacity(registry) -> Dict[str, Any]:
     """The full ``/v1/capacity`` payload for one registry: per-model
-    accounting plus the process section and summed totals."""
+    accounting plus the process section, summed totals, and — when the
+    registry is a pager (ISSUE 11) — the ``residency`` section: HBM
+    budget vs resident bytes, per-name residency state, and the paging
+    counters. The residency section is what the fleet router's
+    placement-aware ranking and the autoscaler's HBM-vs-compute
+    distinction consume."""
     models: Dict[str, Any] = {}
     for name in registry.names():
         try:
             models[name] = model_capacity(registry.get(name))
         except KeyError:
-            pass  # undeployed between listing and snapshot
-    return {
+            pass  # cold, or undeployed between listing and snapshot
+    out = {
         "models": models,
         "process": process_capacity(),
         "totals": {
@@ -223,6 +250,13 @@ def registry_capacity(registry) -> Dict[str, Any]:
             "replicas": sum(m["replicas"] for m in models.values()),
         },
     }
+    snap = getattr(registry, "residency_snapshot", None)
+    if snap is not None:
+        try:
+            out["residency"] = snap()
+        except Exception:
+            pass  # the ledger must never be able to break a scrape
+    return out
 
 
 def render_prometheus(payload: Dict[str, Any],
@@ -259,4 +293,30 @@ def render_prometheus(payload: Dict[str, Any],
     if cc.get("persistent_bytes") is not None:
         lines.append(f"{prefix}_compile_cache_bytes "
                      f"{cc['persistent_bytes']}")
+    res = payload.get("residency")
+    if res:
+        # the pager's /metrics view (ISSUE 11): resident bytes vs budget,
+        # per-model residency state, and the page-in/eviction counters
+        if res.get("hbm_budget_bytes") is not None:
+            lines.append(f"{prefix}_hbm_budget_bytes "
+                         f"{res['hbm_budget_bytes']}")
+        lines.append(f"{prefix}_resident_bytes "
+                     f"{res.get('resident_bytes', 0)}")
+        for model, m in sorted((res.get("models") or {}).items()):
+            lines.append(f'{prefix}_model_resident{{model="{model}"}} '
+                         f"{int(m.get('state') == 'resident')}")
+            lines.append(f'{prefix}_model_bytes{{model="{model}"}} '
+                         f"{m.get('bytes', 0)}")
+        pg = res.get("paging") or {}
+        for counter in ("page_ins_total", "evictions_total",
+                        "page_in_queue_waits_total",
+                        "page_in_rejections_total",
+                        "page_in_failures_total",
+                        "resident_hits_total", "cold_hits_total"):
+            if counter in pg:
+                lines.append(f"{prefix}_{counter} {pg[counter]}")
+        for q, key in ((0.5, "page_in_p50_s"), (0.99, "page_in_p99_s")):
+            if key in pg:
+                lines.append(f'{prefix}_page_in_seconds{{quantile="{q}"}} '
+                             f"{pg[key]}")
     return "\n".join(lines) + "\n"

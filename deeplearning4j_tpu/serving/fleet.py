@@ -167,6 +167,14 @@ class WorkerSpec:
     warmup_signature: Optional[Dict[str, Any]] = None
     cache_dir: Optional[str] = None          # shared persistent compile cache
     straggle: Optional[Dict[str, Any]] = None  # {"p", "ms", "seed"[, "point"]}
+    #: HBM-budgeted paging (ISSUE 11): resident-byte ceiling for this
+    #: worker's registry (None = env knob / measured budget / unbounded)
+    hbm_budget_bytes: Optional[int] = None
+    #: additional archives registered COLD ({name: archive_path}): zero
+    #: HBM until first request, paged in on demand under the budget —
+    #: a fleet where every worker KNOWS every model but each is resident
+    #: only where traffic placed it
+    extra_models: Dict[str, str] = dataclasses.field(default_factory=dict)
     jax_platforms: str = "cpu"
     host_device_count: int = 1
     heartbeat_interval_s: float = 0.5
@@ -667,9 +675,16 @@ def worker_main(spec_path: str) -> int:
         batcher_kw["warmup_example"] = WarmupManifest(
             inputs={str(k): dict(v) for k, v in sig.items()},
             buckets=[], replicas=1, pairs=[]).example()
-    registry = ModelRegistry()
+    registry = ModelRegistry(hbm_budget_bytes=spec.get("hbm_budget_bytes"))
     served = registry.load(spec["model_name"], spec["archive"],
                            version=spec.get("version"), **batcher_kw)
+    # paging catalogue (ISSUE 11): extra archives registered COLD — zero
+    # HBM now, rehydrated on demand under the worker's budget with the
+    # same batcher knobs as the primary model
+    for extra_name, extra_archive in sorted(
+            (spec.get("extra_models") or {}).items()):
+        registry.load(extra_name, extra_archive, resident=False,
+                      **batcher_kw)
     server = ModelServer(registry, worker_id=spec["worker_id"])
     port = server.start(0)
     # the port file is the readiness signal: written only after the

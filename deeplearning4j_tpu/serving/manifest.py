@@ -101,6 +101,13 @@ class WarmupManifest:
     #: (the replayed warmup itself re-derives quantized variants from the
     #: model's own embedded policy, which stays authoritative)
     policy: Optional[dict] = None
+    #: measured device bytes of the recording served model (ISSUE 11):
+    #: lets a registry COLD-register this archive with an accurate HBM
+    #: cost estimate without restoring it first (0 = unrecorded)
+    device_bytes: int = 0
+    #: measured page-in wall seconds (ISSUE 11): seeds the honest
+    #: ``Retry-After`` estimate before this process has paged it in once
+    page_in_s: float = 0.0
 
     # ------------------------------------------------------------ construct
     @staticmethod
@@ -147,6 +154,10 @@ class WarmupManifest:
              "pairs": [list(p) for p in self.pairs]}
         if self.policy is not None:
             d["policy"] = self.policy
+        if self.device_bytes:
+            d["device_bytes"] = int(self.device_bytes)
+        if self.page_in_s:
+            d["page_in_s"] = float(self.page_in_s)
         return d
 
     @staticmethod
@@ -163,7 +174,9 @@ class WarmupManifest:
             max_batch_size=int(d.get("max_batch_size", 0)),
             model=str(d.get("model", "")),
             created_at=float(d.get("created_at", 0.0)),
-            policy=d.get("policy"))
+            policy=d.get("policy"),
+            device_bytes=int(d.get("device_bytes", 0)),
+            page_in_s=float(d.get("page_in_s", 0.0)))
 
     def save(self, path: str) -> None:
         """Atomic write (tmp + rename) — a crash mid-save must leave either

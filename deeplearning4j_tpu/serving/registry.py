@@ -43,17 +43,41 @@ Failure semantics (chaos-hardened, ``tests/test_chaos.py``):
   :class:`~deeplearning4j_tpu.serving.resilience.HealthState` for
   ``/readyz`` (STARTING during build/warmup, READY, DEGRADED while the
   breaker is not closed, DRAINING during undeploy/shutdown).
+
+HBM-budgeted paging (ISSUE 11, ``docs/fleet_serving.md``): under an
+explicit budget (``DL4J_TPU_HBM_BUDGET_BYTES``, the constructor's
+``hbm_budget_bytes``, or the measured device budget) the registry keeps
+only part of its catalogue RESIDENT. Archive-backed entries page out to
+COLD under cost-weighted-LRU eviction (``serving/paging.py``) — the
+manifest is refreshed first, so the page-in replays every traffic-minted
+bucket compile-free — and page back in on demand: :meth:`acquire`
+resolves a name to a PINNED resident entry, triggering a single-flight
+rehydration when cold (N concurrent requests for one cold model cause
+exactly one load; the rest wait). A request whose deadline cannot cover
+the wait gets :class:`~deeplearning4j_tpu.serving.admission
+.PagingInProgress` with an honest measured-cost ``Retry-After`` instead
+of a generic failure. Pins make eviction in-flight-safe: a model with an
+active request is never unloaded mid-request. Room is *reserved* before
+a load mints its device copies, so ``resident_bytes()`` never exceeds
+the budget at any sample point even under concurrent page-ins.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional
 
-from deeplearning4j_tpu.runtime import chaos
-from deeplearning4j_tpu.serving.admission import ServingError
+from deeplearning4j_tpu.runtime import chaos, trace
+from deeplearning4j_tpu.serving import paging
+from deeplearning4j_tpu.serving.admission import (
+    HBMBudgetExceeded,
+    PagingInProgress,
+    ServingError,
+    page_in_retry_after_ms,
+)
 from deeplearning4j_tpu.serving.batcher import ArrayOrDict, ContinuousBatcher
 from deeplearning4j_tpu.serving.resilience import (
     CircuitBreaker,
@@ -83,9 +107,30 @@ class ServedModel:
         self.loaded_at = time.time()
         self.archive_path: Optional[str] = None  # set by ModelRegistry.load
         self.gate_report: Optional[Dict[str, Any]] = None  # deploy_quantized
+        self.device_bytes = 0  # measured at register (ISSUE 11 ledger)
         self._draining = False
         self._started = False  # flipped by the registry after the swap
+        self._pins = 0         # in-flight requests holding this entry
+        self._pin_lock = threading.Lock()
         self.batcher.metrics.attach_breaker(self.breaker)
+
+    # ------------------------------------------------------------- pinning
+    # In-flight-safe eviction (ISSUE 11): the registry pins an entry for
+    # the duration of each request it routes (acquire() under the registry
+    # lock), and the pager only evicts entries with zero pins — an active
+    # replica is never unloaded mid-request.
+    def pin(self) -> None:
+        with self._pin_lock:
+            self._pins += 1
+
+    def unpin(self) -> None:
+        with self._pin_lock:
+            self._pins -= 1
+
+    @property
+    def pins(self) -> int:
+        with self._pin_lock:
+            return self._pins
 
     @property
     def metrics(self):
@@ -140,6 +185,7 @@ class ServedModel:
     def describe(self) -> Dict[str, Any]:
         return {
             "name": self.name,
+            "residency": paging.RESIDENT,
             "version": self.version,
             "model_type": type(self.model).__name__,
             "buckets": list(self.batcher.buckets),
@@ -153,12 +199,69 @@ class ServedModel:
         }
 
 
-class ModelRegistry:
-    """Thread-safe registry; the unit the HTTP server fronts."""
+class _PageFlight:
+    """Single-flight coordination for one cold model's page-in: the first
+    requester (the leader) performs the load; every concurrent requester
+    waits on the event. Exactly one rehydration per cold miss."""
+
+    __slots__ = ("event", "error", "started_at")
 
     def __init__(self):
+        self.event = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.started_at = time.monotonic()
+
+
+class ModelRegistry:
+    """Thread-safe registry; the unit the HTTP server fronts.
+
+    ``hbm_budget_bytes`` caps the summed measured device bytes of
+    RESIDENT models (ISSUE 11 paging; default: the
+    ``DL4J_TPU_HBM_BUDGET_BYTES`` env knob, else the measured device
+    budget where the backend reports one, else unbounded — paging off)."""
+
+    def __init__(self, hbm_budget_bytes: Optional[int] = None):
         self._lock = threading.Lock()
         self._models: Dict[str, ServedModel] = {}
+        # ------------------------------------------- paging state (ISSUE 11)
+        self._explicit_budget = hbm_budget_bytes
+        self._budget_resolved = False
+        self._budget: Optional[int] = None
+        self._residency: Dict[str, paging.Residency] = {}
+        self._reserved: Dict[str, int] = {}  # in-build byte reservations
+        self._flights: Dict[str, _PageFlight] = {}
+        self._flight_lock = threading.Lock()
+        self.paging = paging.PagingMetrics()
+
+    # ----------------------------------------------------------- HBM budget
+    @property
+    def hbm_budget_bytes(self) -> Optional[int]:
+        """The resident-byte ceiling, resolved once: explicit constructor
+        value, else ``DL4J_TPU_HBM_BUDGET_BYTES``, else the measured
+        device budget (backends that report one), else ``None`` =
+        unbounded (paging disabled; cold registration still works)."""
+        if not self._budget_resolved:
+            b = self._explicit_budget
+            if b is None:
+                b = paging.env_hbm_budget()
+            if b is None:
+                b = paging.measured_device_budget()
+            self._budget = int(b) if b else None
+            self._budget_resolved = True
+        return self._budget
+
+    def resident_bytes(self) -> int:
+        """Summed measured device bytes of RESIDENT models — the ledger
+        the budget caps (reservations for in-build loads included, so a
+        sample taken mid-page-in still never exceeds the budget)."""
+        with self._lock:
+            return self._resident_bytes_locked()
+
+    def _resident_bytes_locked(self, exclude: str = "") -> int:
+        total = sum(int(r.bytes or 0) for n, r in self._residency.items()
+                    if r.state == paging.RESIDENT and n != exclude)
+        return total + sum(v for n, v in self._reserved.items()
+                           if n != exclude)
 
     # ----------------------------------------------------------- register
     def register(self, name: str, model, version: Optional[int] = None,
@@ -166,6 +269,7 @@ class ModelRegistry:
                  breaker: Optional[CircuitBreaker] = None,
                  retry: Optional[RetryPolicy] = None,
                  manifest=None,
+                 _archive_info=None,
                  **batcher_kw) -> ServedModel:
         """Serve ``model`` under ``name``. Re-registering an existing name
         hot-swaps (version auto-bumps unless given); the new batcher is
@@ -213,6 +317,18 @@ class ModelRegistry:
             batcher_kw.setdefault(
                 "max_batch_size",
                 manifest.max_batch_size or max(manifest.buckets))
+        # Paging (ISSUE 11): RESERVE room under the HBM budget before the
+        # batcher mints its device_put replica copies — the estimate is
+        # the same per-replica leaf-byte math the capacity ledger later
+        # measures, so the resident-byte ledger can never overshoot the
+        # budget, even transiently under concurrent page-ins. Evicts
+        # cost-weighted-LRU victims as needed.
+        est = self._estimate_device_bytes(model, batcher_kw, manifest)
+        self._reserve_room(name, est)
+        # recompile risk cached OUTSIDE the lock (it stats the manifest
+        # path) so victim selection never touches the filesystem
+        risk = (paging.recompile_risk(_archive_info[0])
+                if _archive_info is not None else 1.0)
         # Build + AOT-warm OUTSIDE the lock and BEFORE the swap: if this
         # raises (bad config, warmup failure, injected chaos) nothing has
         # been swapped — the previous version, if any, keeps serving.
@@ -221,6 +337,8 @@ class ModelRegistry:
             batcher = ContinuousBatcher(model, warmup_example=warmup_example,
                                         **batcher_kw)
         except BaseException:
+            with self._lock:
+                self._reserved.pop(name, None)
             logger.warning(
                 "register(%r): replacement build/warmup failed; previous "
                 "version (if any) keeps serving", name)
@@ -228,13 +346,41 @@ class ModelRegistry:
         served = ServedModel(name, 0, model, batcher,
                              breaker=breaker, retry=retry)
         served.metrics.set_warmup_seconds(time.monotonic() - t0)
+        from deeplearning4j_tpu.serving import capacity
+        try:
+            served.device_bytes = capacity.served_device_bytes(served)
+        except Exception:
+            served.device_bytes = est  # never let accounting fail a deploy
         with self._lock:
+            self._reserved.pop(name, None)
             prev = self._models.get(name)
             if version is None:
                 version = prev.version + 1 if prev else 1
             served.version = int(version)
             self._models[name] = served
             served._started = True  # STARTING -> READY at the swap point
+            res = self._residency.get(name)
+            if res is None:
+                res = paging.Residency(name)
+                self._residency[name] = res
+            res.state = paging.RESIDENT
+            res.bytes = int(served.device_bytes)
+            res.bytes_estimated = False
+            res.version = served.version
+            res.last_used = time.monotonic()
+            if _archive_info is not None:
+                # archive-backed (load/deploy_quantized): record the
+                # rehydration recipe ATOMICALLY with the swap, so a
+                # concurrent page-in never observes a resident model in a
+                # briefly non-evictable state
+                res.evictable = True
+                res.archive_path = _archive_info[0]
+                res.load_kwargs = dict(_archive_info[1])
+                res.risk = risk
+            else:
+                # a live-net register has nothing to rehydrate from
+                res.evictable = False
+                res.archive_path = None
         from deeplearning4j_tpu.runtime import profiler
         if batcher.dtype_policy is not None:
             # profiler surface for the quantized-vs-f32 latency split
@@ -256,7 +402,7 @@ class ModelRegistry:
 
     def load(self, name: str, path: str, load_updater: bool = False,
              replay_manifest: bool = True, save_manifest: bool = True,
-             **kw) -> ServedModel:
+             resident: bool = True, **kw) -> Optional[ServedModel]:
         """Register from a ``ModelSerializer`` zip archive (MLN or
         ComputationGraph — the archive metadata dispatches the type).
 
@@ -268,18 +414,77 @@ class ModelRegistry:
         without compiling at all). After warmup the up-to-date manifest is
         written back (best effort), so each restart records the bucket set
         the NEXT restart should pre-warm. ``replay_manifest=False`` forces
-        the cold path; ``save_manifest=False`` skips the write-back."""
+        the cold path; ``save_manifest=False`` skips the write-back.
+
+        ``resident=False`` (ISSUE 11) registers the archive COLD without
+        restoring it: the entry spends no HBM until the first request (or
+        an explicit :meth:`page_in`) rehydrates it — the multi-tenant
+        door: register thousands, stay under budget. Returns ``None`` in
+        that case (there is no served model yet)."""
+        load_kwargs = {k: v for k, v in kw.items()
+                       if k not in ("manifest", "version")}
+        load_kwargs.update(load_updater=load_updater,
+                           replay_manifest=replay_manifest,
+                           save_manifest=save_manifest)
+        if not resident:
+            self.register_cold(name, path,
+                               version=kw.get("version"), **load_kwargs)
+            return None
         from deeplearning4j_tpu.models.serializer import ModelSerializer
         from deeplearning4j_tpu.serving.manifest import WarmupManifest
         model = ModelSerializer.restore_model(path, load_updater=load_updater)
         manifest = kw.pop("manifest", None)
         if manifest is None and replay_manifest:
             manifest = WarmupManifest.load_for_archive(path)
-        served = self.register(name, model, manifest=manifest, **kw)
+        served = self.register(name, model, manifest=manifest,
+                               _archive_info=(path, load_kwargs), **kw)
         served.archive_path = path if save_manifest else None
         if save_manifest:
             self.save_manifest(name)
         return served
+
+    def register_cold(self, name: str, path: str,
+                      version: Optional[int] = None,
+                      **load_kwargs) -> "paging.Residency":
+        """Register ``name`` as a COLD archive-backed entry WITHOUT
+        loading it (ISSUE 11): no restore, no warmup, zero HBM. The byte
+        cost is estimated from the warmup manifest's recorded
+        ``device_bytes`` when the archive has been served before, else
+        the archive file size; the first :meth:`acquire` (or an explicit
+        :meth:`page_in`) rehydrates with ``load_kwargs`` forwarded to
+        :meth:`load`. Raises ``ValueError`` when ``name`` is currently
+        resident (evict or undeploy first)."""
+        from deeplearning4j_tpu.serving.manifest import WarmupManifest
+        m = WarmupManifest.load_for_archive(path)
+        est = int(m.device_bytes) if m is not None and m.device_bytes else 0
+        if est <= 0:
+            try:
+                est = os.path.getsize(path)
+            except OSError:
+                est = 0
+        load_kwargs.pop("version", None)
+        risk = paging.recompile_risk(path)  # stat outside the lock
+        with self._lock:
+            if name in self._models:
+                raise ValueError(
+                    f"{name!r} is already resident; evict() or undeploy() "
+                    f"before re-registering it cold")
+            res = self._residency.get(name)
+            if res is None:
+                res = paging.Residency(name)
+                self._residency[name] = res
+            res.state = paging.COLD
+            res.evictable = True
+            res.archive_path = path
+            res.load_kwargs = dict(load_kwargs)
+            res.risk = risk
+            res.bytes = int(est)
+            res.bytes_estimated = True
+            if version is not None:
+                res.version = int(version)
+            if m is not None and m.page_in_s and res.page_in_s <= 0:
+                res.page_in_s = float(m.page_in_s)
+        return res
 
     def deploy_quantized(self, name: str, path: str, eval_inputs,
                          eval_labels=None, golden=None, gate=None,
@@ -313,9 +518,18 @@ class ModelRegistry:
             golden = self.get(name).model
         gate = gate or AccuracyGate.from_policy(model.dtype_policy)
         report = gate.check(golden, model, eval_inputs, labels=eval_labels)
-        served = self.register(name, model, **kw)
+        # a page-in of this archive must NOT re-run the gate (it already
+        # passed): plain load() is the rehydration recipe, and the gate
+        # report survives evictions on the residency record
+        lkw = {k: v for k, v in kw.items() if k not in ("manifest",
+                                                        "version")}
+        served = self.register(name, model, _archive_info=(path, lkw), **kw)
         served.archive_path = path
         served.gate_report = report
+        with self._lock:
+            res = self._residency.get(name)
+            if res is not None:
+                res.gate_report = report
         self.save_manifest(name)
         return served
 
@@ -341,62 +555,484 @@ class ModelRegistry:
 
     # ------------------------------------------------------------ routing
     def get(self, name: str) -> ServedModel:
+        """The RESIDENT entry for ``name`` (introspection; the request
+        path uses :meth:`acquire`, which also pages in and pins). Raises
+        ``KeyError`` for unknown and for cold names — the message says
+        which."""
         with self._lock:
             served = self._models.get(name)
             have = sorted(self._models)
+            cold = (name in self._residency
+                    and self._residency[name].state == paging.COLD)
         if served is None:
+            if cold:
+                raise KeyError(
+                    f"no model registered under {name!r} (it is COLD — "
+                    f"acquire()/page_in() rehydrates it); resident: {have}")
             raise KeyError(f"no model registered under {name!r}; have {have}")
         return served
+
+    def acquire(self, name: str,
+                timeout_ms: Optional[float] = None) -> ServedModel:
+        """Resolve ``name`` to a PINNED resident entry, paging it in from
+        its archive when COLD (ISSUE 11). The caller MUST ``unpin()`` the
+        returned entry when its request finishes — the pin is what makes
+        eviction in-flight-safe. Concurrent cold requests single-flight:
+        one rehydration, everyone else waits in the page-in queue (up to
+        ``timeout_ms``; a deadline that cannot cover the wait raises
+        :class:`PagingInProgress` with the honest measured-cost
+        ``Retry-After``). Raises ``KeyError`` for names that are neither
+        resident nor cold-registered."""
+        deadline = (None if timeout_ms is None
+                    else time.monotonic() + float(timeout_ms) / 1000.0)
+        cold_hit = False
+        while True:
+            with self._lock:
+                served = self._models.get(name)
+                res = self._residency.get(name)
+                if served is not None:
+                    served.pin()
+                    if res is not None and not cold_hit:
+                        # touch ONCE per request — a cold hit already
+                        # touched in the cold branch below, and double
+                        # counting would inflate cold models' retention
+                        # weight over genuinely hotter resident ones
+                        now = time.monotonic()
+                        res.ewma.update(now)
+                        res.last_used = now
+                    self.paging.record_hit(resident=not cold_hit)
+                    return served
+                if res is None or res.archive_path is None:
+                    have = sorted(self._models)
+                    raise KeyError(
+                        f"no model registered under {name!r}; have {have}")
+                if not cold_hit:
+                    now = time.monotonic()
+                    res.ewma.update(now)
+                    res.last_used = now
+            cold_hit = True
+            self._page_in(name, deadline)
 
     def predict(self, name: str, x: ArrayOrDict,
                 timeout_ms: Optional[float] = None):
         """Route one request through ``name``'s served model (breaker +
-        retry + batcher). Raises ``KeyError`` for unknown names,
-        ``Overloaded``/``DeadlineExceeded`` under pressure,
+        retry + batcher), paging a cold model in first (ISSUE 11). Raises
+        ``KeyError`` for unknown names, ``Overloaded``/
+        ``DeadlineExceeded``/``PagingInProgress`` under pressure,
         ``CircuitOpen`` while the breaker sheds — never hangs on a
-        registered model."""
-        return self.get(name).predict(x, timeout_ms=timeout_ms)
+        registered model. The deadline is spent ONCE: time passed
+        waiting on a page-in is deducted from the budget the batcher
+        sees, never granted twice."""
+        deadline = (None if timeout_ms is None
+                    else time.monotonic() + float(timeout_ms) / 1000.0)
+        served = self.acquire(name, timeout_ms=timeout_ms)
+        try:
+            remaining = (None if deadline is None else
+                         max(0.0, (deadline - time.monotonic()) * 1000.0))
+            return served.predict(x, timeout_ms=remaining)
+        finally:
+            served.unpin()
+
+    # ------------------------------------------------------ paging (ISSUE 11)
+    def page_in(self, name: str,
+                timeout_ms: Optional[float] = None) -> ServedModel:
+        """Explicitly rehydrate a cold model (no-op when already
+        resident; the residency endpoint's and the autoscaler placement
+        rebalancer's lever). Blocks until resident; single-flight with
+        any request-triggered page-in already underway."""
+        deadline = (None if timeout_ms is None
+                    else time.monotonic() + float(timeout_ms) / 1000.0)
+        while True:
+            with self._lock:
+                served = self._models.get(name)
+                if served is not None:
+                    return served
+                if name not in self._residency or \
+                        self._residency[name].archive_path is None:
+                    raise KeyError(
+                        f"no archive-backed model registered under {name!r}")
+            self._page_in(name, deadline)
+
+    def _page_in(self, name: str, deadline: Optional[float]) -> None:
+        """Single-flight page-in: the first caller (leader) performs the
+        rehydration; concurrent callers wait on its flight. On return the
+        model is resident (re-check and pin under the registry lock — an
+        eviction may race) or an exception explains why not."""
+        with self._flight_lock:
+            fl = self._flights.get(name)
+            leader = fl is None
+            if leader:
+                fl = _PageFlight()
+                self._flights[name] = fl
+        if leader:
+            t0 = time.monotonic()
+            try:
+                loaded = self._rehydrate(name)
+            except BaseException as e:
+                fl.error = e
+                self.paging.record_page_in_failure()
+                raise
+            finally:
+                with self._flight_lock:
+                    self._flights.pop(name, None)
+                fl.event.set()
+            if not loaded:
+                return  # raced: someone else made it resident — a ~0s
+                # "page-in" must not halve the measured cost estimate
+            seconds = time.monotonic() - t0
+            self.paging.record_page_in(seconds)
+            with self._lock:
+                res = self._residency.get(name)
+                if res is not None:
+                    res.record_page_in_cost(seconds)
+            return
+        # follower: wait in the page-in queue instead of failing — the
+        # whole point of request-triggered paging (ISSUE 11). The wait is
+        # bounded by the request's own deadline; the rejection hint is the
+        # measured page-in cost minus what the flight already spent.
+        t0 = time.monotonic()
+        remaining = None if deadline is None else deadline - t0
+        sp = trace.current_span()
+        if remaining is not None and remaining <= 0:
+            self.paging.record_rejection()
+            raise PagingInProgress(
+                f"model {name!r} is paging in and the request deadline has "
+                f"already expired",
+                retry_after_ms=self._page_in_hint_ms(name, fl))
+        ok = fl.event.wait(remaining)
+        waited = time.monotonic() - t0
+        self.paging.record_queue_wait(waited)
+        if sp is not None and sp.recording:
+            sp.event("page_in_wait", model=name,
+                     waited_ms=round(waited * 1e3, 2), completed=ok)
+        if not ok:
+            self.paging.record_rejection()
+            raise PagingInProgress(
+                f"model {name!r} is still paging in after a "
+                f"{waited * 1e3:.0f} ms wait; deadline too short to keep "
+                f"waiting", retry_after_ms=self._page_in_hint_ms(name, fl))
+        if fl.error is not None:
+            raise RuntimeError(
+                f"page-in of {name!r} failed") from fl.error
+
+    def _page_in_hint_ms(self, name: str, fl: _PageFlight) -> float:
+        """Honest ``Retry-After`` for a rejected page-in waiter: measured
+        page-in cost (1s default before the first measurement) minus the
+        flight's elapsed time, floored (``admission
+        .page_in_retry_after_ms``)."""
+        with self._lock:
+            res = self._residency.get(name)
+            est_ms = (res.page_in_s * 1000.0
+                      if res is not None and res.page_in_s > 0 else 1000.0)
+        elapsed_ms = (time.monotonic() - fl.started_at) * 1000.0
+        return page_in_retry_after_ms(est_ms, elapsed_ms)
+
+    def _rehydrate(self, name: str) -> bool:
+        """The leader's load: replay the archive + warmup manifest through
+        the ordinary :meth:`load` path (room is reserved and victims are
+        evicted inside :meth:`register`), traced as a ``registry.page_in``
+        span under the triggering request so a cold hit's latency
+        breakdown is one tree. Returns ``False`` when the model turned
+        out to be resident already (raced with another loader)."""
+        chaos.inject("serving.registry.page_in")
+        with self._lock:
+            res = self._residency.get(name)
+            if res is None or res.archive_path is None:
+                raise KeyError(
+                    f"no archive-backed model registered under {name!r}")
+            if name in self._models:
+                return False  # raced: already resident
+            path = res.archive_path
+            version = res.version
+            kwargs = dict(res.load_kwargs)
+            gate_report = res.gate_report
+        cur = trace.current_span()
+        if cur is not None and cur.recording:
+            sp = cur.child("registry.page_in")
+        elif trace.enabled():
+            sp = trace.server_span("registry.page_in")
+        else:
+            sp = trace.NOOP
+        with sp:
+            if sp.recording:
+                sp.flag("page_in")
+                sp.set("model", name)
+            served = self.load(name, path, version=version, **kwargs)
+            served.gate_report = gate_report
+            if sp.recording:
+                sp.set("bytes", served.device_bytes)
+                sp.set("version", served.version)
+        return True
+
+    def evict(self, name: str) -> bool:
+        """Page ``name`` out to COLD (ISSUE 11): refresh its warmup
+        manifest (traffic-minted buckets included — what makes the next
+        page-in compile-free), drain its batcher, and drop the device
+        copies. Returns ``False`` — without touching anything — when it
+        cannot right now: not resident, not archive-backed, or pinned by
+        in-flight requests (eviction is in-flight-safe by construction)."""
+        with self._lock:
+            served = self._models.get(name)
+            res = self._residency.get(name)
+            if served is None or res is None or not res.evictable:
+                return False
+            if served.pins > 0:
+                return False
+            del self._models[name]
+            res.state = paging.COLD
+            res.bytes = int(served.device_bytes) or res.bytes
+            res.bytes_estimated = False
+            res.evictions += 1
+            res.gate_report = served.gate_report or res.gate_report
+        cur = trace.current_span()
+        if cur is not None and cur.recording:
+            sp = cur.child("registry.evict")
+        elif trace.enabled():
+            sp = trace.server_span("registry.evict")
+        else:
+            sp = trace.NOOP
+        with sp:
+            if sp.recording:
+                sp.flag("evict")
+                sp.set("model", name)
+                sp.set("bytes", served.device_bytes)
+            served._draining = True
+            try:
+                served.batcher.shutdown(drain=True)
+            except Exception:
+                logger.exception("evict(%r): drain failed; the device "
+                                 "copies are dropped regardless", name)
+            # AFTER the drain, like undeploy: a queued oversized request
+            # may mint a bucket while draining and the manifest must
+            # record it for the page-in to replay
+            self._persist_manifest(served)
+        from deeplearning4j_tpu.runtime import profiler
+        profiler.detach_quant_metrics(name)
+        self.paging.record_eviction()
+        logger.info("evicted %r to cold (%d bytes freed)", name,
+                    served.device_bytes)
+        return True
+
+    def _estimate_device_bytes(self, model, batcher_kw: Dict[str, Any],
+                               manifest) -> int:
+        """What registering ``model`` will cost in device bytes: host
+        param + model-state leaf bytes times the replica count the
+        batcher will build — the same math ``capacity
+        .served_device_bytes`` measures afterwards, so reservation equals
+        measurement."""
+        from deeplearning4j_tpu.serving.capacity import _leaf_bytes
+        ts = getattr(model, "train_state", None)
+        host = (sum(_leaf_bytes(getattr(ts, "params", None)).values())
+                + sum(_leaf_bytes(getattr(ts, "model_state", None)).values()))
+        replicas = batcher_kw.get("replicas")
+        if not replicas and manifest is not None:
+            replicas = manifest.replicas
+        return host * max(1, int(replicas or 1))
+
+    def _reserve_room(self, name: str, est: int) -> None:
+        """Block until ``est`` bytes fit under the HBM budget (evicting
+        cost-weighted-LRU victims), then reserve them under ``name`` so a
+        concurrent load cannot double-book the same headroom. No-op
+        without a budget. Raises :class:`HBMBudgetExceeded` when no
+        victim frees enough room within a bounded wait (every candidate
+        pinned or non-evictable)."""
+        budget = self.hbm_budget_bytes
+        if budget is None:
+            return
+        give_up = time.monotonic() + 10.0
+        while True:
+            with self._lock:
+                in_use = self._resident_bytes_locked(exclude=name)
+                if in_use + est <= budget:
+                    # a hot-swap replaces the OLD version's bytes, which
+                    # stay counted (and loaded) until the swap: reserve
+                    # only the DELTA so the ledger (old + reservation)
+                    # never reads over budget mid-build. The physical
+                    # transient of old+new copies is the hot-swap's
+                    # pre-existing build-before-swap cost.
+                    res = self._residency.get(name)
+                    old = (int(res.bytes or 0) if res is not None
+                           and res.state == paging.RESIDENT else 0)
+                    self._reserved[name] = max(0, int(est) - old)
+                    return
+                victim = self._pick_victim_locked(exclude=name)
+                # can waiting ever help? yes while something evictable is
+                # resident (pins are transient) or another load holds a
+                # reservation (it will land as an evictable model, or
+                # release its bytes on failure). Otherwise fail fast.
+                could_ever = any(
+                    n != name and (r := self._residency.get(n)) is not None
+                    and r.evictable
+                    for n in self._models) or any(
+                    n != name for n in self._reserved)
+            if victim is not None:
+                if self.evict(victim):
+                    continue
+            if not could_ever or time.monotonic() >= give_up:
+                raise HBMBudgetExceeded(
+                    f"cannot fit {name!r} ({est} bytes) under the HBM "
+                    f"budget ({budget} bytes, {in_use} in use) — "
+                    + ("every evictable model is pinned by in-flight "
+                       "requests" if could_ever else
+                       "nothing evictable remains (the model alone "
+                       "exceeds the budget, or every resident entry is "
+                       "live-registered)"))
+            time.sleep(0.005)  # pins are request-scoped; retry shortly
+
+    def _pick_victim_locked(self, exclude: str = "") -> Optional[str]:
+        """The cost-weighted-LRU victim among evictable, unpinned
+        resident models (``paging.retention_weight``; LRU tie-break).
+        Caller holds ``self._lock``."""
+        now = time.monotonic()
+        best = None
+        for n, served in self._models.items():
+            if n == exclude:
+                continue
+            res = self._residency.get(n)
+            if res is None or not res.evictable or served.pins > 0:
+                continue
+            key = (paging.retention_weight(
+                res.bytes, res.ewma.rate(now), res.risk),
+                res.last_used, n)
+            if best is None or key < best:
+                best = key
+        return best[2] if best is not None else None
+
+    def refresh_device_bytes(self, name: str) -> int:
+        """Re-measure a resident model's device bytes and update the
+        ledger — called after a runtime replica resize (the scale
+        endpoint), which mints or drops ``device_put`` copies the
+        register-time measurement cannot know about. If the new footprint
+        pushed past the budget, other models are paged out best-effort
+        (the resize already happened — refusing it is the autoscaler
+        guard's job, keeping the ledger honest is ours). Returns the
+        measured bytes (0 when ``name`` is not resident)."""
+        with self._lock:
+            served = self._models.get(name)
+        if served is None:
+            return 0
+        from deeplearning4j_tpu.serving import capacity
+        try:
+            measured = int(capacity.served_device_bytes(served))
+        except Exception:
+            return served.device_bytes
+        with self._lock:
+            served.device_bytes = measured
+            res = self._residency.get(name)
+            if res is not None:
+                res.bytes = measured
+                res.bytes_estimated = False
+        budget = self.hbm_budget_bytes
+        if budget is not None:
+            while True:
+                with self._lock:
+                    over = self._resident_bytes_locked() > budget
+                    victim = (self._pick_victim_locked(exclude=name)
+                              if over else None)
+                if victim is None:
+                    if over:
+                        logger.warning(
+                            "replica resize of %r left the registry %d "
+                            "bytes over the HBM budget with nothing "
+                            "evictable", name,
+                            self.resident_bytes() - budget)
+                    break
+                if not self.evict(victim):
+                    break
+        return measured
+
+    def residency_snapshot(self) -> Dict[str, Any]:
+        """The pager's ledger for ``/v1/capacity``'s ``residency``
+        section: budget, resident bytes (reservations included), per-name
+        state, and the paging counters — what the paging drill samples to
+        prove the budget is never exceeded."""
+        budget = self.hbm_budget_bytes  # resolve outside the lock
+        now = time.monotonic()
+        with self._lock:
+            models = {n: r.snapshot(now)
+                      for n, r in sorted(self._residency.items())}
+            resident = self._resident_bytes_locked()
+        return {
+            "hbm_budget_bytes": budget,
+            "resident_bytes": resident,
+            "models": models,
+            "paging": self.paging.snapshot(),
+        }
 
     # ---------------------------------------------------------- lifecycle
     def names(self) -> List[str]:
+        """Every registered name — resident AND cold (a cold model is
+        registered and servable; it just is not loaded right now)."""
+        with self._lock:
+            return sorted(set(self._models) | set(self._residency))
+
+    def resident_names(self) -> List[str]:
         with self._lock:
             return sorted(self._models)
 
     def describe(self) -> List[Dict[str, Any]]:
         with self._lock:
             served = list(self._models.values())
-        return [s.describe() for s in served]
+            cold = [(n, r) for n, r in sorted(self._residency.items())
+                    if n not in self._models and r.archive_path is not None]
+        out = [s.describe() for s in served]
+        now = time.monotonic()
+        for n, r in cold:
+            out.append({"name": n, "residency": paging.COLD,
+                        "version": r.version, "archive": r.archive_path,
+                        **{k: v for k, v in r.snapshot(now).items()
+                           if k != "state"}})
+        return out
 
     def health(self) -> Dict[str, str]:
-        """Per-model health map for ``/readyz``."""
+        """Per-model health map for ``/readyz``. Cold archive-backed
+        entries report ``"cold"`` — they are SERVABLE (a request pages
+        them in), so a worker whose whole catalogue happens to be paged
+        out at this instant (eviction churn, a page-in mid-build) must
+        not drop out of the fleet: pulled from routing, it could never
+        receive the request that would page a model back in."""
         with self._lock:
             served = list(self._models.values())
-        return {s.name: s.health.value for s in served}
+            cold = [n for n, r in self._residency.items()
+                    if n not in self._models and r.archive_path is not None]
+        out = {s.name: s.health.value for s in served}
+        for n in cold:
+            out[n] = "cold"
+        return out
 
     @staticmethod
     def ready_from(health: Dict[str, str]) -> bool:
         """Readiness derived from ONE health snapshot: at least one model
-        registered and every model READY (a DEGRADED/DRAINING/STARTING
-        model fails readiness so an orchestrator routes traffic
-        elsewhere; liveness is separate)."""
-        return bool(health) and all(v == HealthState.READY.value
-                                    for v in health.values())
+        registered and every model READY or cold-servable (a DEGRADED/
+        DRAINING/STARTING model fails readiness so an orchestrator routes
+        traffic elsewhere; liveness is separate; a COLD model is ready by
+        construction — the request path rehydrates it)."""
+        return bool(health) and all(
+            v in (HealthState.READY.value, "cold")
+            for v in health.values())
 
     def ready(self) -> bool:
         return self.ready_from(self.health())
 
-    @staticmethod
-    def _persist_manifest(served: ServedModel,
+    def _persist_manifest(self, served: ServedModel,
                           archive_path: Optional[str] = None
                           ) -> Optional[str]:
         """The one manifest-persistence implementation behind
-        :meth:`save_manifest` and the graceful undeploy/shutdown refresh
-        (which captures traffic-minted buckets for the next restart)."""
+        :meth:`save_manifest`, eviction, and the graceful undeploy/
+        shutdown refresh (which captures traffic-minted buckets for the
+        next restart). Stamps the measured device bytes and page-in cost
+        (ISSUE 11) so a cold registration of this archive knows its HBM
+        cost without restoring it."""
         from deeplearning4j_tpu.serving.manifest import manifest_path
         target = archive_path or served.archive_path
         recorded = served.batcher.warmup_manifest()
         if target is None or recorded is None:
             return None
+        recorded.device_bytes = int(served.device_bytes or 0)
+        with self._lock:
+            res = self._residency.get(served.name)
+            if res is not None and res.page_in_s > 0:
+                recorded.page_in_s = round(res.page_in_s, 4)
         path = manifest_path(target)
         try:
             recorded.save(path)
@@ -404,12 +1040,24 @@ class ModelRegistry:
             logger.warning("could not persist warmup manifest for %r to %s",
                            served.name, path, exc_info=True)
             return None
+        # a manifest now exists next to the archive: refresh the cached
+        # recompile risk the eviction policy reads
+        risk = paging.recompile_risk(target)
+        with self._lock:
+            res = self._residency.get(served.name)
+            if res is not None:
+                res.risk = risk
         return path
 
     def undeploy(self, name: str, drain: bool = True) -> None:
+        """Remove ``name`` entirely — resident or cold (unlike
+        :meth:`evict`, which keeps the cold entry servable)."""
         with self._lock:
             served = self._models.pop(name, None)
+            res = self._residency.pop(name, None)
         if served is None:
+            if res is not None:
+                return  # cold entry: nothing loaded, nothing to drain
             raise KeyError(f"no model registered under {name!r}")
         served._draining = True
         served.batcher.shutdown(drain=drain)
@@ -424,6 +1072,8 @@ class ModelRegistry:
         with self._lock:
             served = list(self._models.values())
             self._models.clear()
+            self._residency.clear()
+            self._reserved.clear()
         from deeplearning4j_tpu.runtime import profiler
         for s in served:
             s._draining = True

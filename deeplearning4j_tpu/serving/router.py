@@ -359,6 +359,7 @@ class FleetRouter:
                  probe_timeout_s: float = 1.0,
                  connect_timeout_s: float = 2.0,
                  no_deadline_timeout_s: float = 60.0,
+                 residency_refresh_s: float = 1.0,
                  slo: Optional[SLOMonitor] = None):
         self._fleet = fleet
         self.default_timeout_ms = default_timeout_ms
@@ -381,6 +382,14 @@ class FleetRouter:
         self.slo = slo or SLOMonitor()
         # the attached SLOAutoscaler (ISSUE 10), serving /v1/autoscaler
         self.autoscaler = None
+        # placement view (ISSUE 11): {worker_id: {"models": {name: state},
+        # "headroom_bytes": int|None}} refreshed by the probe loop from
+        # the workers' /v1/capacity residency sections — what makes
+        # ranked_workers() route cold-model traffic to the worker that
+        # has the model RESIDENT (or the most eviction-free headroom)
+        self.residency_refresh_s = float(residency_refresh_s)
+        self._residency_view: Dict[str, Dict[str, Any]] = {}
+        self._last_residency_refresh = 0.0
         self._views: Dict[str, WorkerView] = {}
         self._views_lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -415,15 +424,65 @@ class FleetRouter:
             return dict(self._views)
 
     def ranked_workers(self, model: str) -> List[WorkerView]:
-        """Every worker view, ranked by rendezvous hash for ``model`` —
-        deterministic, so one model's traffic concentrates on the same
-        healthy worker across requests (and across router restarts)."""
+        """Every worker view, ranked for ``model``: rendezvous
+        (highest-random-weight) hashing — deterministic, so one model's
+        traffic concentrates on the same healthy worker across requests
+        (and across router restarts) — refined by PLACEMENT when the
+        fleet pages models (ISSUE 11): workers with the model RESIDENT
+        rank first (rendezvous order among them), then cold workers by
+        eviction-free headroom (budget minus resident bytes; an
+        unbudgeted worker counts as infinite — loading there evicts
+        nothing). Fleets whose residency view never mentions ``model``
+        keep pure rendezvous order, so non-paging deployments are
+        untouched."""
         def score(wid: str) -> int:
             h = hashlib.blake2b(f"{model}|{wid}".encode(), digest_size=8)
             return int.from_bytes(h.digest(), "big")
         views = self.workers()
-        return [views[wid] for wid in
-                sorted(views, key=score, reverse=True)]
+        order = sorted(views, key=score, reverse=True)
+        rv = getattr(self, "_residency_view", None)
+        if rv and any(model in (rv.get(w) or {}).get("models", {})
+                      for w in order):
+            def placement(wid: str):
+                info = rv.get(wid) or {}
+                models = info.get("models", {})
+                if models.get(model) == "resident":
+                    return (0, 0.0)
+                if model not in models:
+                    # this worker does not KNOW the model (or reported no
+                    # residency at all): it would 404 — terminal, no
+                    # failover — so it must rank LAST, never outrank a
+                    # cold-registered worker
+                    return (2, 0.0)
+                h = info.get("headroom_bytes")
+                return (1, -(float("inf") if h is None else float(h)))
+            order = sorted(order, key=placement)  # stable: rendezvous ties
+        return [views[wid] for wid in order]
+
+    def _refresh_residency(self) -> None:
+        """Refresh the placement view from every ready worker's
+        ``/v1/capacity`` residency section (throttled to
+        ``residency_refresh_s`` by the probe loop; stale entries for
+        vanished workers drop out). Workers without a residency section
+        (stubs, older payloads) simply stay out of the view — ranking
+        falls back to pure rendezvous."""
+        view: Dict[str, Dict[str, Any]] = {}
+        try:
+            for wid, payload in self._scrape_workers("/v1/capacity").items():
+                res = payload.get("residency")
+                if not isinstance(res, dict):
+                    continue
+                models = {str(m): d.get("state")
+                          for m, d in (res.get("models") or {}).items()
+                          if isinstance(d, dict)}
+                budget = res.get("hbm_budget_bytes")
+                headroom = (None if budget is None else
+                            int(budget) - int(res.get("resident_bytes", 0)))
+                view[wid] = {"models": models, "headroom_bytes": headroom}
+        except Exception:
+            logger.exception("residency refresh failed; keeping last view")
+            return
+        self._residency_view = view
 
     def hedge_delay_s(self) -> float:
         """The p99-derived hedge trigger (see class docstring)."""
@@ -447,6 +506,10 @@ class FleetRouter:
                 view.ready = self._probe_worker(view)
             except Exception:
                 view.ready = False
+        now = time.monotonic()
+        if now - self._last_residency_refresh >= self.residency_refresh_s:
+            self._last_residency_refresh = now
+            self._refresh_residency()
 
     def _probe_loop(self) -> None:
         while not self._stop.wait(self.probe_interval_s):
@@ -906,7 +969,36 @@ class FleetRouter:
         models: Dict[str, Dict[str, Any]] = {}
         hists: Dict[str, LatencyHistogram] = {}
         budget = in_use = None
+        hbm_budget = resident_bytes = None
+        placement: Dict[str, Dict[str, List[str]]] = {}
+        paging_totals = {"page_ins_total": 0, "evictions_total": 0,
+                         "page_in_queue_waits_total": 0,
+                         "page_in_rejections_total": 0,
+                         "page_in_failures_total": 0,
+                         "resident_hits_total": 0, "cold_hits_total": 0}
         for wid, payload in sorted(scraped.items()):
+            # residency aggregation (ISSUE 11): budgets/resident bytes
+            # summed, per-model worker placement lists, paging counters
+            res = payload.get("residency")
+            if isinstance(res, dict):
+                try:
+                    if res.get("hbm_budget_bytes") is not None:
+                        hbm_budget = ((hbm_budget or 0)
+                                      + int(res["hbm_budget_bytes"]))
+                    resident_bytes = ((resident_bytes or 0)
+                                      + int(res.get("resident_bytes", 0)))
+                    for m, d in sorted((res.get("models") or {}).items()):
+                        slot = placement.setdefault(
+                            m, {"resident_workers": [], "cold_workers": []})
+                        key = ("resident_workers"
+                               if d.get("state") == "resident"
+                               else "cold_workers")
+                        slot[key].append(wid)
+                    pg = res.get("paging") or {}
+                    for k in paging_totals:
+                        paging_totals[k] += int(pg.get(k, 0))
+                except (TypeError, ValueError):
+                    pass  # malformed residency: skip it, never the scrape
             proc = payload.get("process") or {}
             if proc.get("device_budget_bytes") is not None:
                 budget = (budget or 0) + int(proc["device_budget_bytes"])
@@ -957,12 +1049,20 @@ class FleetRouter:
                 a["dispatch_p50_s"] = h.percentile(50)
                 a["dispatch_p99_s"] = h.percentile(99)
                 a["dispatch_count"] = h.count
-        return {
+        out = {
             "workers": scraped,
             "models": models,
             "process": {"device_budget_bytes": budget,
                         "device_in_use_bytes": in_use},
         }
+        if placement or hbm_budget is not None:
+            out["residency"] = {
+                "hbm_budget_bytes": hbm_budget,
+                "resident_bytes": resident_bytes or 0,
+                "models": placement,
+                "paging": paging_totals,
+            }
+        return out
 
     def render_fleet_capacity(self) -> str:
         """``fleet_capacity_*`` gauges for the router's ``/metrics``."""
@@ -988,6 +1088,23 @@ class FleetRouter:
         if proc.get("device_budget_bytes") is not None:
             lines.append(f"fleet_capacity_device_budget_bytes "
                          f"{proc['device_budget_bytes']}")
+        res = agg.get("residency")
+        if res:
+            if res.get("hbm_budget_bytes") is not None:
+                lines.append(f"fleet_capacity_hbm_budget_bytes "
+                             f"{res['hbm_budget_bytes']}")
+            lines.append(f"fleet_capacity_resident_bytes "
+                         f"{res.get('resident_bytes', 0)}")
+            for m, slot in sorted((res.get("models") or {}).items()):
+                lines.append(
+                    f'fleet_capacity_resident_workers{{model="{m}"}} '
+                    f"{len(slot.get('resident_workers', []))}")
+            pg = res.get("paging") or {}
+            for counter in ("page_ins_total", "evictions_total",
+                            "page_in_queue_waits_total",
+                            "page_in_failures_total"):
+                if counter in pg:
+                    lines.append(f"fleet_capacity_{counter} {pg[counter]}")
         return "\n".join(lines) + "\n"
 
     def render_fleet_metrics(self) -> str:

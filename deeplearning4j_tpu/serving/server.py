@@ -5,7 +5,12 @@ Same dependency-free ``ThreadingHTTPServer`` pattern as ``ui/server.py``
 
 - ``GET  /v1/models``                  — registry listing + per-model metrics
 - ``GET  /v1/models/<name>``           — one model's description
-- ``POST /v1/models/<name>/predict``   — JSON inference
+- ``POST /v1/models/<name>/predict``   — JSON inference (pages a COLD
+  model in first — ISSUE 11; the request waits, and a deadline that
+  cannot cover the wait gets 503 ``paging_in`` with an honest
+  ``Retry-After`` from the measured page-in cost)
+- ``POST /v1/models/<name>/residency`` — explicit paging lever:
+  ``{"state": "resident"|"cold"}`` pages in / evicts (409 while pinned)
 - ``GET  /healthz``                    — liveness (the process serves HTTP)
 - ``GET  /readyz``                     — readiness (every model READY; a
   DEGRADED breaker-open model or an empty registry returns 503 so an
@@ -71,7 +76,12 @@ from urllib.parse import parse_qs, urlsplit
 import numpy as np
 
 from deeplearning4j_tpu.runtime import chaos, trace
-from deeplearning4j_tpu.serving.admission import DeadlineExceeded, Overloaded
+from deeplearning4j_tpu.serving.admission import (
+    DeadlineExceeded,
+    Overloaded,
+    PagingInProgress,
+    ServingError,
+)
 from deeplearning4j_tpu.serving.registry import ModelRegistry
 from deeplearning4j_tpu.serving.resilience import CircuitOpen
 from deeplearning4j_tpu.serving.slo import SLOMonitor
@@ -211,12 +221,44 @@ class ModelServer:
         except Exception as e:
             return 400, {"error": f"malformed request body: {e}"}, hdrs
         # resolve the model OUTSIDE the submit try: a KeyError raised by a
-        # multi-input forward (wrong input name) must not read as 404
+        # multi-input forward (wrong input name) must not read as 404.
+        # acquire() also PAGES IN a cold model (ISSUE 11) — the request
+        # waits in the page-in queue instead of failing — and pins the
+        # entry so eviction can never unload it mid-request.
+        acquire = getattr(self.registry, "acquire", None)
+        # the deadline is spent ONCE: time the request waits on a page-in
+        # is deducted from the budget the batcher sees afterwards
+        deadline = (None if timeout_ms is None
+                    else time.monotonic() + float(timeout_ms) / 1000.0)
         try:
-            served = self.registry.get(name)
+            if acquire is not None:
+                served = acquire(name, timeout_ms=timeout_ms)
+            else:  # duck-typed stub registry (tests): resident-only lookup
+                served = self.registry.get(name)
         except KeyError:
             return 404, {"error": f"model {name!r} not found",
                          "models": self.registry.names()}, hdrs
+        except PagingInProgress as e:
+            # the deadline provably cannot cover the page-in: an HONEST
+            # Retry-After from the measured page-in cost, not a generic 503
+            retry_ms = e.retry_after_ms
+            if retry_ms is not None:
+                hdrs["Retry-After"] = str(int(math.ceil(retry_ms / 1000.0)))
+                hdrs["Retry-After-Ms"] = f"{retry_ms:.0f}"
+            trace.flag_current("shed")
+            return 503, {"error": "paging in", "reason": "paging_in",
+                         "retry_after_ms": retry_ms,
+                         "detail": str(e)}, hdrs
+        except ServingError as e:
+            # e.g. HBMBudgetExceeded mid-page-in: transient, retryable
+            return 503, {"error": "unavailable", "reason": "paging_failed",
+                         "detail": str(e)}, hdrs
+        except Exception as e:
+            # a corrupt archive mid-page-in must not read as model fault 500
+            return 503, {"error": "unavailable", "reason": "paging_failed",
+                         "detail": repr(e)}, hdrs
+        if deadline is not None:
+            timeout_ms = max(0.0, (deadline - time.monotonic()) * 1000.0)
         try:
             out = served.predict(x, timeout_ms=timeout_ms)
         except CircuitOpen as e:
@@ -236,6 +278,10 @@ class ModelServer:
             return 504, {"error": "deadline exceeded", "detail": str(e)}, hdrs
         except Exception as e:
             return 500, {"error": repr(e)}, hdrs
+        finally:
+            unpin = getattr(served, "unpin", None)
+            if unpin is not None:  # stubs have no pin ledger
+                unpin()
         hdrs["X-Model-Version"] = str(served.version)
         return 200, {"model": name, "version": served.version,
                      "outputs": _to_jsonable(out)}, hdrs
@@ -311,6 +357,11 @@ class ModelServer:
             try:
                 return 200, self.registry.get(name).describe()
             except KeyError:
+                # a COLD model is registered, not gone (ISSUE 11): serve
+                # its catalogue description instead of a false 404
+                for d in self.registry.describe():
+                    if d.get("name") == name:
+                        return 200, d
                 return 404, {"error": f"model {name!r} not found"}
         return 404, {"error": f"unknown path {path!r}"}
 
@@ -361,6 +412,10 @@ class ModelServer:
             try:
                 served = self.registry.get(name)
             except KeyError:
+                if name in self.registry.names():
+                    # registered but COLD: a resize has no pool to act on
+                    return 409, {"error": f"model {name!r} is cold; page "
+                                          f"it in before resizing"}, {}
                 return 404, {"error": f"model {name!r} not found"}, {}
             batcher = served.batcher
             with batcher.resize_lock:
@@ -378,6 +433,12 @@ class ModelServer:
             if sp.recording:
                 sp.set("replicas_before", before)
                 sp.set("replicas_after", batcher.replica_count)
+            refresh = getattr(self.registry, "refresh_device_bytes", None)
+            if refresh is not None:
+                # the resize minted/dropped device_put copies: the HBM
+                # ledger must see the new footprint (and page others out
+                # if it overshot the budget) — ISSUE 11
+                refresh(name)
             try:
                 # persist the resized warm set so a restart pre-warms it
                 self.registry.save_manifest(name)
@@ -387,6 +448,59 @@ class ModelServer:
                          "replicas_before": before,
                          "compile_count": batcher.compile_count(),
                          "warmed_pairs": len(batcher._warmed_pairs)}, {}
+
+    def _handle_residency(self, name: str, raw: bytes, headers=None):
+        """``POST /v1/models/<name>/residency`` — explicit paging lever
+        (ISSUE 11): body ``{"state": "resident"}`` pages a cold model in
+        (manifest-prewarmed, single-flight with any request-triggered
+        page-in underway), ``{"state": "cold"}`` evicts (refused with 409
+        while in-flight requests pin the model — eviction is never
+        unsafe, only deferred). Drives the autoscaler's placement
+        rebalancing and operator runbooks; joins the caller's trace so a
+        rebalance decision and its page-in are one tree."""
+        h = headers or {}
+        sp = (trace.server_span("worker.residency",
+                                trace_id=h.get("X-Trace-Id"),
+                                parent_id=h.get("X-Parent-Span-Id"))
+              if trace.enabled() else trace.NOOP)
+        with sp:
+            if sp.recording:
+                sp.flag("page_in")
+                sp.set("model", name)
+            try:
+                body = json.loads(raw.decode() or "{}")
+                state = body["state"]
+                if state not in ("resident", "cold"):
+                    raise ValueError(f"state must be 'resident' or 'cold', "
+                                     f"got {state!r}")
+            except Exception as e:
+                return 400, {"error": f"malformed residency request: "
+                                      f"{e}"}, {}
+            if sp.recording:
+                sp.set("target_state", state)
+            if state == "resident":
+                try:
+                    served = self.registry.page_in(name)
+                except KeyError:
+                    return 404, {"error": f"no archive-backed model "
+                                          f"{name!r}"}, {}
+                except Exception as e:
+                    return 500, {"error": repr(e)}, {}
+                return 200, {"model": name, "state": "resident",
+                             "version": served.version,
+                             "device_bytes": served.device_bytes}, {}
+            if name not in self.registry.names():
+                return 404, {"error": f"model {name!r} not found"}, {}
+            if self.registry.evict(name):
+                return 200, {"model": name, "state": "cold"}, {}
+            # idempotence: asking for a state the model is already in is
+            # a no-op 200, not a 409 (retried runbooks must not alert)
+            if name not in self.registry.resident_names():
+                return 200, {"model": name, "state": "cold",
+                             "already": True}, {}
+            return 409, {"error": f"cannot evict {name!r}: pinned by "
+                                  f"in-flight requests or not "
+                                  f"archive-backed"}, {}
 
     def _render_metrics(self) -> str:
         parts = ["# TYPE serving_latency_seconds summary",
@@ -484,6 +598,11 @@ class ModelServer:
                         and self.path.endswith("/replicas")):
                     name = self.path[len("/v1/models/"):-len("/replicas")]
                     code, obj, extra = srv._handle_scale(
+                        name, raw, headers=self.headers)
+                elif (self.path.startswith("/v1/models/")
+                        and self.path.endswith("/residency")):
+                    name = self.path[len("/v1/models/"):-len("/residency")]
+                    code, obj, extra = srv._handle_residency(
                         name, raw, headers=self.headers)
                 else:
                     code, obj, extra = (404,

@@ -723,3 +723,114 @@ def test_check_tables_validates_autoscale_section(tmp_path):
     msgs = []
     assert bench.check_tables(str(md), str(extra), log=msgs.append) == 0
     assert any("autoscale" in m and "WARN" in m for m in msgs)
+
+
+# --------------------------------------------------------------- ISSUE 11
+def _paging_section():
+    """A self-consistent BENCH_EXTRA.json["paging"] section (the ISSUE 11
+    HBM-budgeted paging drill record)."""
+    return {
+        "models_registered": 8,
+        "hbm_budget_bytes": 2120,
+        "per_model_bytes": 848,
+        "budget_models": 2,
+        "zipf_a": 1.5,
+        "requests_total": 300,
+        "request_errors": 0,
+        "wrong_outputs": 0,
+        "zipf_wall_s": 60.0,
+        "resident_hits": 192,
+        "cold_hits": 108,
+        "hit_rate": 0.64,
+        "page_ins": 144,
+        "evictions": 150,
+        "page_in_queue_waits": 30,
+        "cold_page_in_p50_ms": 819.2,
+        "cold_page_in_p99_ms": 1638.4,
+        "cold_p99_bound_ms": 30000.0,
+        "hot_qps_baseline": 400.0,
+        "hot_qps_paged": 410.0,
+        "hot_ratio": 1.025,
+        "hot_ratio_floor": 0.95,
+        "budget_samples": 31,
+        "budget_exceeded_samples": 0,
+        "max_resident_bytes": 1696,
+        "on_traffic_compiles_after_page_in": 0,
+    }
+
+
+def _extra_with_paging(section):
+    measured = {k: _mid(*rng) for k, rng in bench.RECORDED_RANGES.items()}
+    measured["paging"] = section
+    measured["paging_hit_rate"] = section.get("hit_rate")
+    measured["paging_cold_p99_ms"] = section.get("cold_page_in_p99_ms")
+    return measured
+
+
+def test_check_tables_validates_paging_section(tmp_path):
+    """ISSUE 11 satellite: --check-tables covers the paging keys — a
+    self-consistent drill record passes; dropped requests, wrong outputs,
+    budget-exceeded samples, a max-resident row over the budget, a
+    non-recomputable hit rate or hot ratio, a hot ratio under its floor,
+    a cold p99 over its recorded bound, a drill that never paged,
+    on-traffic compiles after a page-in, or stale top-level copies all
+    fail loudly."""
+    md = tmp_path / "BASELINE.md"
+    md.write_text(_table_md(bench.RECORDED_RANGES))
+    extra = tmp_path / "BENCH_EXTRA.json"
+
+    extra.write_text(json.dumps(_extra_with_paging(_paging_section())))
+    assert bench.check_tables(str(md), str(extra), log=lambda *a: None) == 0
+
+    cases = [
+        (dict(request_errors=2), "never drop"),
+        (dict(wrong_outputs=1), "answered differently"),
+        (dict(budget_exceeded_samples=3), "crossed the budget"),
+        (dict(max_resident_bytes=99999), "over the recorded budget"),
+        (dict(hit_rate=0.9), "recorded hit rows give"),
+        (dict(hot_ratio=1.4), "recorded qps rows give"),
+        (dict(hot_qps_paged=300.0, hot_ratio=0.75), "under the recorded "
+                                                    "floor"),
+        (dict(cold_page_in_p99_ms=99999.0), "over the recorded bound"),
+        (dict(page_ins=0, evictions=0), "never actually paged"),
+        (dict(on_traffic_compiles_after_page_in=3),
+         "compiled on live traffic"),
+    ]
+    for patch, needle in cases:
+        sec = _paging_section()
+        sec.update(patch)
+        ex = _extra_with_paging(sec)
+        # keep the top-level copies in sync so only the intended drift
+        # class fires (staleness has its own case below)
+        ex["paging_hit_rate"] = sec["hit_rate"]
+        ex["paging_cold_p99_ms"] = sec["cold_page_in_p99_ms"]
+        extra.write_text(json.dumps(ex))
+        msgs = []
+        assert bench.check_tables(str(md), str(extra),
+                                  log=msgs.append) == 1, needle
+        assert any(needle in m for m in msgs), (needle, msgs)
+
+    # a missing required key is its own loud failure
+    sec = _paging_section()
+    del sec["budget_exceeded_samples"]
+    extra.write_text(json.dumps(_extra_with_paging(sec)))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("budget_exceeded_samples" in m and "missing" in m
+               for m in msgs)
+
+    # stale top-level copies
+    for key in ("paging_hit_rate", "paging_cold_p99_ms"):
+        ex = _extra_with_paging(_paging_section())
+        ex[key] = 0.123
+        extra.write_text(json.dumps(ex))
+        msgs = []
+        assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+        assert any(key in m and "top-level" in m for m in msgs), (key, msgs)
+
+    # absence is a warning (section not run), never a silent pass
+    measured = {k: _mid(*rng) for k, rng in bench.RECORDED_RANGES.items()}
+    extra.write_text(json.dumps(measured))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 0
+    assert any("paging" in m and "WARN" in m for m in msgs)

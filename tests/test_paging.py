@@ -1,0 +1,901 @@
+"""HBM-budgeted model paging (ISSUE 11): the registry pager, its policy,
+and the fleet placement layer.
+
+Layers:
+
+- **Policy units** (no model): env-knob budget parsing, the
+  cost-weighted-LRU retention weight (``bytes x recompile-risk x traffic
+  EWMA``), the decayed traffic estimate, and the honest page-in
+  ``Retry-After`` math — deterministic, tier-1.
+- **Registry state machine**: budget enforcement at load (resident bytes
+  NEVER exceed the budget, reservations included), cost-weighted
+  eviction choosing the idle model over the hot one, in-flight-safe
+  pins, cold registration (zero HBM until first request), manifest
+  ``device_bytes``/``page_in_s`` stamping, undeploy of cold entries.
+- **Single-flight page-in**: the ISSUE's race drill — N threads fired at
+  one cold model cause exactly ONE rehydration and N bit-identical
+  successes; a deadline that cannot cover the wait gets
+  :class:`PagingInProgress` with the measured-cost hint (surfaced as 503
+  ``paging_in`` + ``Retry-After`` headers over HTTP).
+- **Compile-free page-in**: a rehydration replays the warmup manifest;
+  traffic after it mints zero executables.
+- **Fleet tier**: the router's placement-aware ranking (resident worker
+  first, then most eviction-free headroom, rendezvous ties) and the
+  autoscaler's out-of-HBM path (rebalance placement via the residency
+  lever before spawning workers).
+- **Soak** (``slow``): a zipf-distributed mini-drill over 6 models under
+  a 2-model budget — every request succeeds, the budget holds at every
+  sample.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.models.serializer import ModelSerializer
+from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.runtime.chaos import AddLatency, ChaosController
+from deeplearning4j_tpu.serving import (HBMBudgetExceeded, ModelRegistry,
+                                        ModelServer, PagingInProgress)
+from deeplearning4j_tpu.serving import paging
+from deeplearning4j_tpu.serving.admission import page_in_retry_after_ms
+from deeplearning4j_tpu.serving.manifest import WarmupManifest, manifest_path
+
+
+def _conf(seed=7):
+    return (NeuralNetConfiguration.builder().seed(seed).updater(None)
+            .list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=4, activation="softmax"))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+
+
+RNG = np.random.default_rng(0)
+X = RNG.normal(size=(4, 8)).astype(np.float32)
+KW = dict(max_batch_size=4, buckets=[1, 4], batch_timeout_ms=1.0,
+          pipeline_depth=0, warmup_example=X[:1])
+
+
+@pytest.fixture(scope="module")
+def archives(tmp_path_factory):
+    """Six tiny archives (distinct seeds) + their oracle outputs, saved
+    once for the whole module — loads are cheap, saves are not free."""
+    td = tmp_path_factory.mktemp("paging-archives")
+    paths, oracles = [], []
+    for i in range(6):
+        net = MultiLayerNetwork(_conf(i)).init()
+        p = str(td / f"m{i}.zip")
+        ModelSerializer.write_model(net, p)
+        paths.append(p)
+        oracles.append(np.asarray(net.output(X)))
+    return paths, oracles
+
+
+def _per_model_bytes(archives):
+    reg = ModelRegistry()
+    try:
+        return reg.load("probe", archives[0][0], **KW).device_bytes
+    finally:
+        reg.shutdown()
+
+
+# ==========================================================================
+# policy units (no model, no jax state)
+def test_env_budget_parsing():
+    assert paging.env_hbm_budget({}) is None
+    assert paging.env_hbm_budget({paging.ENV_BUDGET: ""}) is None
+    assert paging.env_hbm_budget({paging.ENV_BUDGET: "  123456 "}) == 123456
+    assert paging.env_hbm_budget({paging.ENV_BUDGET: "nope"}) is None
+    assert paging.env_hbm_budget({paging.ENV_BUDGET: "-5"}) is None
+    assert paging.env_hbm_budget({paging.ENV_BUDGET: "0"}) is None
+
+
+def test_retention_weight_cost_weighted_lru():
+    """The eviction key: evict first the model that frees the most bytes
+    per unit of (traffic x recompile risk)."""
+    # same traffic + risk: the BIGGER model has the lower weight (goes
+    # first — more bytes freed per unit of pain)
+    assert paging.retention_weight(10_000, 1.0, 1.0) < \
+        paging.retention_weight(1_000, 1.0, 1.0)
+    # same size + risk: the COLDER model goes first
+    assert paging.retention_weight(1_000, 0.1, 1.0) < \
+        paging.retention_weight(1_000, 10.0, 1.0)
+    # same size + traffic: the CHEAP-to-restore model goes first
+    assert paging.retention_weight(1_000, 1.0, 0.25) < \
+        paging.retention_weight(1_000, 1.0, 1.0)
+    # zero traffic never divides by zero / collapses ordering by size
+    assert paging.retention_weight(2_000, 0.0, 1.0) < \
+        paging.retention_weight(1_000, 0.0, 1.0)
+
+
+def test_traffic_ewma_decays_with_halflife():
+    e = paging.TrafficEWMA(halflife_s=10.0)
+    for _ in range(8):
+        e.update(now=100.0)
+    assert e.rate(now=100.0) == pytest.approx(8.0)
+    assert e.rate(now=110.0) == pytest.approx(4.0)   # one halflife
+    assert e.rate(now=130.0) == pytest.approx(1.0)   # three halflives
+    e.update(now=130.0)
+    assert e.rate(now=130.0) == pytest.approx(2.0)
+
+
+def test_recompile_risk_tiers(tmp_path):
+    """No archive / no manifest = full risk; a manifest halves it (the
+    page-in replays it compile-free)."""
+    assert paging.recompile_risk(None) == 1.0
+    archive = str(tmp_path / "m.zip")
+    assert paging.recompile_risk(archive) == 1.0  # no manifest yet
+    WarmupManifest.from_example(X[:1], buckets=[1, 4], replicas=1,
+                                pairs=[(1, 0, "float32")]).save(
+        manifest_path(archive))
+    assert paging.recompile_risk(archive) in (0.25, 0.5)
+
+
+def test_page_in_retry_after_honest_math():
+    # measured 900ms, flight already 300ms in: honest remainder
+    assert page_in_retry_after_ms(900.0, 300.0) == 600.0
+    # flight has overrun the estimate: floored, never instant/negative
+    assert page_in_retry_after_ms(900.0, 2000.0) == 25.0
+    assert page_in_retry_after_ms(0.0, 0.0, floor_ms=40.0) == 40.0
+
+
+def test_manifest_roundtrips_paging_fields(tmp_path):
+    m = WarmupManifest.from_example(X[:1], buckets=[1, 4], replicas=1,
+                                    pairs=[(1, 0, "float32")])
+    m.device_bytes = 4096
+    m.page_in_s = 0.75
+    p = str(tmp_path / "m.warmup.json")
+    m.save(p)
+    back = WarmupManifest.load(p)
+    assert back.device_bytes == 4096
+    assert back.page_in_s == 0.75
+    # absent fields default to zero (older manifests stay loadable)
+    assert WarmupManifest.from_dict(
+        {k: v for k, v in m.to_dict().items()
+         if k not in ("device_bytes", "page_in_s")}).device_bytes == 0
+
+
+# ==========================================================================
+# registry state machine
+def test_budget_enforced_and_cost_weighted_eviction(archives):
+    """Three models under a two-model budget: the IDLE one is evicted
+    when the third loads (cost-weighted: traffic keeps the hot one), and
+    the resident-byte ledger never exceeds the budget at any point."""
+    paths, oracles = archives
+    per = _per_model_bytes(archives)
+    budget = int(per * 2.5)
+    reg = ModelRegistry(hbm_budget_bytes=budget)
+    try:
+        reg.load("a", paths[0], **KW)
+        reg.load("b", paths[1], **KW)
+        assert reg.resident_bytes() <= budget
+        for _ in range(5):  # traffic on "a": b becomes the LRU victim
+            reg.predict("a", X)
+        reg.load("c", paths[2], **KW)
+        assert reg.resident_bytes() <= budget
+        snap = reg.residency_snapshot()
+        assert snap["models"]["a"]["state"] == "resident"
+        assert snap["models"]["b"]["state"] == "cold"
+        assert snap["models"]["c"]["state"] == "resident"
+        assert snap["hbm_budget_bytes"] == budget
+        assert snap["resident_bytes"] == reg.resident_bytes()
+        # the evicted model is still SERVABLE: the request pages it in
+        # (and the ledger still holds)
+        out = np.asarray(reg.predict("b", X))
+        assert np.array_equal(out, oracles[1])
+        assert reg.resident_bytes() <= budget
+        assert reg.paging.snapshot()["page_ins_total"] == 1
+        assert reg.paging.snapshot()["evictions_total"] >= 2
+    finally:
+        reg.shutdown()
+
+
+def test_single_flight_page_in_race(archives):
+    """The ISSUE's race drill: N threads fired at one cold model trigger
+    exactly ONE rehydration; every request succeeds bit-identically."""
+    paths, oracles = archives
+    per = _per_model_bytes(archives)
+    reg = ModelRegistry(hbm_budget_bytes=int(per * 1.5))
+    try:
+        reg.load("a", paths[0], **KW)
+        reg.load("b", paths[1], **KW)   # evicts a
+        assert reg.resident_names() == ["b"]
+        before = reg.paging.snapshot()["page_ins_total"]
+        results, errors = [], []
+
+        def hit():
+            try:
+                results.append(np.asarray(reg.predict("a", X)))
+            except Exception as e:  # pragma: no cover - the assert reports
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=hit) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errors == []
+        assert len(results) == 8
+        assert all(np.array_equal(r, oracles[0]) for r in results)
+        pg = reg.paging.snapshot()
+        assert pg["page_ins_total"] - before == 1  # ONE rehydration
+        assert pg["page_in_queue_waits_total"] >= 1  # someone waited
+    finally:
+        reg.shutdown()
+
+
+def test_pinned_model_never_evicted(archives):
+    """Eviction is in-flight-safe: a pinned entry is refused (False) and
+    stays serving; unpinning makes it evictable again."""
+    paths, _ = archives
+    reg = ModelRegistry()  # no budget: manual evictions only
+    try:
+        reg.load("a", paths[0], **KW)
+        served = reg.acquire("a")
+        assert served.pins == 1
+        assert reg.evict("a") is False
+        assert reg.resident_names() == ["a"]
+        served.unpin()
+        assert reg.evict("a") is True
+        assert reg.resident_names() == []
+        assert reg.residency_snapshot()["models"]["a"]["state"] == "cold"
+    finally:
+        reg.shutdown()
+
+
+def test_register_cold_spends_no_hbm_until_first_request(archives):
+    """``load(resident=False)`` registers the catalogue without loading:
+    zero resident bytes, first request rehydrates, and the byte estimate
+    comes from the manifest's recorded ``device_bytes`` once the archive
+    has been served (and evicted) before."""
+    paths, oracles = archives
+    reg1 = ModelRegistry()
+    try:  # serve + evict once so the manifest records measured bytes
+        measured = reg1.load("m", paths[3], **KW).device_bytes
+        assert reg1.evict("m") is True
+        m = WarmupManifest.load_for_archive(paths[3])
+        assert m.device_bytes == measured
+    finally:
+        reg1.shutdown()
+    reg = ModelRegistry()
+    try:
+        assert reg.load("m", paths[3], resident=False, **KW) is None
+        assert reg.resident_bytes() == 0
+        assert "m" in reg.names() and reg.resident_names() == []
+        snap = reg.residency_snapshot()["models"]["m"]
+        assert snap["state"] == "cold"
+        assert snap["bytes"] == measured  # manifest-sourced estimate
+        with pytest.raises(KeyError):
+            reg.get("m")  # cold: introspection says so, routing pages in
+        out = np.asarray(reg.predict("m", X))
+        assert np.array_equal(out, oracles[3])
+        assert reg.resident_names() == ["m"]
+        assert reg.get("m").device_bytes == measured
+        # cold entries can be undeployed without ever having loaded
+        reg.load("never", paths[4], resident=False, **KW)
+        reg.undeploy("never")
+        assert "never" not in reg.names()
+    finally:
+        reg.shutdown()
+
+
+def test_page_in_is_compile_free_after_manifest(archives):
+    """A page-in replays the warmup manifest: after it, live traffic
+    mints ZERO executables (the zero-on-traffic-compiles guarantee the
+    restart path already had, now for evict/rehydrate cycles)."""
+    paths, _ = archives
+    reg = ModelRegistry()
+    try:
+        reg.load("m", paths[0], **KW)
+        assert reg.evict("m") is True
+        served = reg.page_in("m")
+        at_page_in = served.batcher.compile_count()
+        for _ in range(5):
+            reg.predict("m", X)
+        assert served.batcher.compile_count() == at_page_in
+    finally:
+        reg.shutdown()
+
+
+def test_deadline_too_short_gets_honest_paging_rejection(archives):
+    """A follower whose deadline cannot cover the page-in wait is
+    rejected with :class:`PagingInProgress` carrying an honest
+    ``retry_after_ms`` — while the leader's request still succeeds."""
+    paths, oracles = archives
+    reg = ModelRegistry()
+    try:
+        reg.load("m", paths[0], **KW)
+        assert reg.evict("m") is True
+        leader_out = []
+
+        def leader():
+            # the chaos latency fires INSIDE the flight (after it is
+            # registered), so the main thread can deterministically wait
+            # for the flight and then land a follower in its window
+            with ChaosController(seed=1) as c:
+                c.on("serving.registry.page_in", AddLatency(0.6))
+                leader_out.append(np.asarray(reg.predict("m", X)))
+
+        t = threading.Thread(target=leader)
+        t.start()
+        deadline = time.monotonic() + 5.0
+        while "m" not in reg._flights and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert "m" in reg._flights, "leader never opened a page-in flight"
+        with pytest.raises(PagingInProgress) as ei:
+            reg.predict("m", X, timeout_ms=30.0)
+        t.join()
+        assert ei.value.retry_after_ms >= 25.0
+        assert np.array_equal(leader_out[0], oracles[0])
+        assert reg.paging.snapshot()["page_in_rejections_total"] >= 1
+    finally:
+        reg.shutdown()
+
+
+def test_budget_smaller_than_one_model_raises_explicitly(archives):
+    paths, _ = archives
+    per = _per_model_bytes(archives)
+    reg = ModelRegistry(hbm_budget_bytes=max(1, per // 2))
+    try:
+        with pytest.raises(HBMBudgetExceeded):
+            reg.load("m", paths[0], **KW)
+        assert reg.resident_names() == []
+        assert reg.resident_bytes() == 0  # failed reservation released
+    finally:
+        reg.shutdown()
+
+
+def test_describe_and_names_include_cold(archives):
+    paths, _ = archives
+    reg = ModelRegistry()
+    try:
+        reg.load("hot", paths[0], **KW)
+        reg.load("cold", paths[1], resident=False, **KW)
+        assert reg.names() == ["cold", "hot"]
+        desc = {d["name"]: d for d in reg.describe()}
+        assert desc["hot"]["residency"] == "resident"
+        assert desc["cold"]["residency"] == "cold"
+        assert desc["cold"]["archive"] == paths[1]
+        # readiness is judged on RESIDENT models only: a cold catalogue
+        # entry must not fail /readyz
+        assert reg.ready() is True
+    finally:
+        reg.shutdown()
+
+
+# ==========================================================================
+# HTTP surfaces: predict-through-page-in, Retry-After, residency lever
+def test_server_pages_in_and_surfaces_paging_headers(archives):
+    paths, oracles = archives
+    reg = ModelRegistry()
+    srv = ModelServer(reg, worker_id="w-paging")
+    try:
+        reg.load("m", paths[0], **KW)
+        port = srv.start(0)
+        assert reg.evict("m") is True
+
+        # a plain request pages the model in and succeeds (200)
+        body = json.dumps({"inputs": X.tolist()}).encode()
+        resp = urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/m/predict", data=body),
+            timeout=60)
+        out = np.asarray(json.loads(resp.read())["outputs"], np.float32)
+        assert np.array_equal(out, oracles[0])
+
+        # evict again, slow the page-in, and land a short-deadline
+        # request inside the flight: 503 paging_in + honest Retry-After
+        assert reg.evict("m") is True
+
+        def leader():
+            with ChaosController(seed=2) as c:
+                c.on("serving.registry.page_in", AddLatency(0.6))
+                reg.page_in("m")
+
+        t = threading.Thread(target=leader)
+        t.start()
+        deadline = time.monotonic() + 5.0
+        while "m" not in reg._flights and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert "m" in reg._flights, "leader never opened a page-in flight"
+        short = json.dumps({"inputs": X.tolist(),
+                            "timeout_ms": 30}).encode()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/models/m/predict",
+                data=short), timeout=60)
+        t.join()
+        assert ei.value.code == 503
+        payload = json.loads(ei.value.read())
+        headers = dict(ei.value.headers)
+        assert payload["reason"] == "paging_in"
+        assert payload["retry_after_ms"] >= 25.0
+        assert float(headers["Retry-After-Ms"]) == pytest.approx(
+            payload["retry_after_ms"], abs=1.0)
+        assert int(headers["Retry-After"]) >= 1
+    finally:
+        srv.stop()
+        reg.shutdown()
+
+
+def test_residency_endpoint_and_capacity_metrics(archives):
+    paths, _ = archives
+    reg = ModelRegistry()
+    srv = ModelServer(reg, worker_id="w-res")
+    try:
+        reg.load("m", paths[0], **KW)
+        port = srv.start(0)
+
+        def post(path, obj, expect_error=False):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}",
+                data=json.dumps(obj).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                r = urllib.request.urlopen(req, timeout=60)
+                return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                if not expect_error:
+                    raise
+                return e.code, json.loads(e.read())
+
+        # evict over HTTP, verify /v1/capacity reflects it
+        code, obj = post("/v1/models/m/residency", {"state": "cold"})
+        assert (code, obj["state"]) == (200, "cold")
+        # idempotent: already-cold is a 200 no-op, not a 409 (retried
+        # runbooks must not alert)
+        code, obj = post("/v1/models/m/residency", {"state": "cold"})
+        assert (code, obj.get("already")) == (200, True)
+        cap = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/capacity", timeout=60).read())
+        assert cap["residency"]["models"]["m"]["state"] == "cold"
+        assert cap["residency"]["resident_bytes"] == 0
+        # page back in over HTTP
+        code, obj = post("/v1/models/m/residency", {"state": "resident"})
+        assert (code, obj["state"]) == (200, "resident")
+        assert obj["device_bytes"] > 0
+        # pinned model: eviction deferred with 409, never unsafe
+        served = reg.acquire("m")
+        try:
+            code, obj = post("/v1/models/m/residency", {"state": "cold"},
+                             expect_error=True)
+            assert code == 409
+        finally:
+            served.unpin()
+        # malformed / unknown
+        assert post("/v1/models/m/residency", {"state": "warm"},
+                    expect_error=True)[0] == 400
+        assert post("/v1/models/nope/residency", {"state": "resident"},
+                    expect_error=True)[0] == 404
+        # the /metrics rendering carries the pager gauges + counters
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=60).read().decode()
+        assert "capacity_resident_bytes" in text
+        assert 'capacity_model_resident{model="m"} 1' in text
+        assert "capacity_evictions_total 1" in text
+        assert "capacity_page_ins_total 1" in text
+    finally:
+        srv.stop()
+        reg.shutdown()
+
+
+# ==========================================================================
+# fleet tier: placement-aware routing + autoscaler rebalance
+def test_ranked_workers_prefer_resident_then_headroom():
+    from deeplearning4j_tpu.serving.router import FleetRouter, StaticFleet
+    router = FleetRouter(StaticFleet({"a": "127.0.0.1:1",
+                                      "b": "127.0.0.1:2",
+                                      "c": "127.0.0.1:3"}),
+                         hedge_enabled=False)
+    plain = [v.worker_id for v in router.ranked_workers("m")]
+    # no residency view: pure rendezvous (existing fleets untouched)
+    assert sorted(plain) == ["a", "b", "c"]
+    router._residency_view = {
+        "a": {"models": {"m": "cold"}, "headroom_bytes": 100},
+        "b": {"models": {"m": "resident"}, "headroom_bytes": 0},
+        "c": {"models": {"m": "cold"}, "headroom_bytes": 5000},
+    }
+    ranked = [v.worker_id for v in router.ranked_workers("m")]
+    # resident first, then cold by eviction-free headroom
+    assert ranked == ["b", "c", "a"]
+    # an unbudgeted worker (headroom None) counts as infinite headroom
+    router._residency_view["a"]["headroom_bytes"] = None
+    assert [v.worker_id for v in router.ranked_workers("m")] == \
+        ["b", "a", "c"]
+    # a model the view never mentions keeps pure rendezvous order
+    assert [v.worker_id for v in router.ranked_workers("other")] == plain
+
+
+def test_fleet_capacity_aggregates_residency():
+    """The router's fleet capacity merge: budgets/resident bytes summed,
+    per-model placement lists, paging counters summed."""
+    from deeplearning4j_tpu.serving.router import FleetRouter
+
+    payloads = {
+        "w0": {"models": {}, "process": {},
+               "residency": {"hbm_budget_bytes": 1000, "resident_bytes": 800,
+                             "models": {"m": {"state": "resident",
+                                              "bytes": 800}},
+                             "paging": {"page_ins_total": 2,
+                                        "evictions_total": 1}}},
+        "w1": {"models": {}, "process": {},
+               "residency": {"hbm_budget_bytes": 1000, "resident_bytes": 0,
+                             "models": {"m": {"state": "cold",
+                                              "bytes": 800}},
+                             "paging": {"page_ins_total": 1,
+                                        "evictions_total": 3}}},
+    }
+    router = FleetRouter.__new__(FleetRouter)
+    router._scrape_workers = lambda path="/v1/capacity": payloads
+    agg = router.fleet_capacity()
+    res = agg["residency"]
+    assert res["hbm_budget_bytes"] == 2000
+    assert res["resident_bytes"] == 800
+    assert res["models"]["m"]["resident_workers"] == ["w0"]
+    assert res["models"]["m"]["cold_workers"] == ["w1"]
+    assert res["paging"]["page_ins_total"] == 3
+    assert res["paging"]["evictions_total"] == 4
+
+
+def test_autoscaler_rebalances_placement_before_spawning_workers():
+    """Out of HBM != out of compute: on a capacity-guard refusal the
+    controller pages the model in on the worker with eviction-free
+    headroom (residency lever) instead of suppressing or spawning."""
+    from tests.test_capacity_autoscale import (_Clock, _controller, _feed,
+                                               _FakeView)
+
+    clock, sclock = _Clock(), _Clock()
+    auto, slo, state = _controller(clock, sclock)
+    state["budget"] = 1500  # one 1000-B replica in use: +1000 won't fit
+    other = _FakeView("w1")
+    auto.router.workers = lambda: {"w0": auto.router.view, "w1": other}
+    paged = []
+    auto._residency_lever = lambda view, model, sp: (
+        paged.append((view.worker_id, model)) or True, {"state": "resident"})
+
+    base_capacity = auto._capacity_fn
+
+    def capacity_fn():
+        cap = base_capacity()
+        cap["workers"]["w0"]["residency"] = {
+            "hbm_budget_bytes": 1500, "resident_bytes": 1000,
+            "models": {"m": {"state": "resident", "bytes": 1000}}}
+        cap["workers"]["w1"] = {
+            "models": {},
+            "residency": {"hbm_budget_bytes": 4000, "resident_bytes": 0,
+                          "models": {"m": {"state": "cold",
+                                           "bytes": 1000}}}}
+        return cap
+
+    auto._capacity_fn = capacity_fn
+    _feed(slo, 400, slow=True)
+    decisions = auto.tick()
+    assert [d["action"] for d in decisions] == ["rebalance_page_in"]
+    assert decisions[0]["ok"] is True
+    assert decisions[0]["worker"] == "w1"
+    assert decisions[0]["capacity"]["bound"] == "hbm"
+    assert paged == [("w1", "m")]
+    assert state["replicas"] == 1  # the memory-bound worker was NOT grown
+
+
+def test_autoscaler_guard_refusal_without_target_still_suppresses():
+    """No rebalance target (no other worker knows the model) and no
+    fleet lever: the refusal is the explained suppression it always
+    was — now naming HBM as the wall."""
+    from tests.test_capacity_autoscale import _Clock, _controller, _feed
+
+    clock, sclock = _Clock(), _Clock()
+    auto, slo, state = _controller(clock, sclock)
+    state["budget"] = 1500
+    _feed(slo, 400, slow=True)
+    decisions = auto.tick()
+    assert [d["action"] for d in decisions] == ["suppressed_capacity_guard"]
+    assert decisions[0]["capacity"]["bound"] == "hbm"
+    assert "HBM" in decisions[0]["detail"]
+
+
+# ==========================================================================
+# soak (slow): zipf traffic over an over-subscribed registry
+@pytest.mark.slow
+def test_paging_soak_zipf_never_drops_never_overshoots(archives):
+    """Mini version of ``bench.py --paging``: 6 models under a 2.5-model
+    budget, 120 zipf-distributed requests from 3 threads — every request
+    succeeds bit-identically, and the resident-byte ledger holds at
+    every sample."""
+    paths, oracles = archives
+    per = _per_model_bytes(archives)
+    budget = int(per * 2.5)
+    reg = ModelRegistry(hbm_budget_bytes=budget)
+    try:
+        for i, p in enumerate(paths):
+            reg.load(f"m{i}", p, **KW)
+            assert reg.resident_bytes() <= budget
+        draws = (np.random.default_rng(7).zipf(a=1.5, size=120) - 1) % 6
+        errors, wrong, overs = [], [0], [0]
+        cursor = [0]
+        lock = threading.Lock()
+
+        def client():
+            while True:
+                with lock:
+                    if cursor[0] >= len(draws):
+                        return
+                    i = cursor[0]
+                    cursor[0] += 1
+                m = int(draws[i])
+                try:
+                    out = np.asarray(reg.predict(f"m{m}", X))
+                    if not np.array_equal(out, oracles[m]):
+                        wrong[0] += 1
+                except Exception as e:
+                    errors.append(repr(e))
+                if reg.resident_bytes() > budget:
+                    overs[0] += 1
+
+        threads = [threading.Thread(target=client) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errors == []
+        assert wrong[0] == 0
+        assert overs[0] == 0
+        pg = reg.paging.snapshot()
+        assert pg["page_ins_total"] >= 1
+        assert pg["evictions_total"] >= 1
+        assert pg["page_in_failures_total"] == 0
+    finally:
+        reg.shutdown()
+
+
+@pytest.mark.slow
+def test_fleet_pages_in_extra_models_and_places_traffic(tmp_path_factory):
+    """End-to-end fleet paging (slow): every worker KNOWS two models but
+    only the primary is resident (``WorkerSpec.extra_models`` +
+    ``hbm_budget_bytes``); a routed request for the cold model pages it
+    in on one worker, and the router's placement view then ranks that
+    worker first for it."""
+    from deeplearning4j_tpu.runtime.environment import get_environment
+    from deeplearning4j_tpu.serving.fleet import FleetSupervisor, WorkerSpec
+    from deeplearning4j_tpu.serving.router import FleetRouter
+
+    td = tmp_path_factory.mktemp("paging-fleet")
+    a_main, a_extra = str(td / "main.zip"), str(td / "extra.zip")
+    cache = str(td / "executable-cache")
+    MultiLayerNetwork(_conf(1)).init().save(a_main)
+    extra_net = MultiLayerNetwork(_conf(2)).init()
+    extra_net.save(a_extra)
+    oracle = np.asarray(extra_net.output(X))
+    # parent prewarm: manifests + shared executable cache => fast worker
+    # launches AND manifest-recorded device_bytes for the cold estimate
+    get_environment().set_compile_cache(cache)
+    reg = ModelRegistry()
+    per = reg.load("m", a_main, **KW).device_bytes
+    reg.load("x", a_extra, **KW)
+    reg.shutdown()
+
+    kw = {k: v for k, v in KW.items() if k != "warmup_example"}
+    specs = [WorkerSpec(worker_id=f"w{i}", model_name="m", archive=a_main,
+                        version=1, batcher_kw=kw, cache_dir=cache,
+                        warmup_signature={"__single__": {
+                            "shape_tail": [8], "dtype": "float32"}},
+                        hbm_budget_bytes=int(per * 3),
+                        extra_models={"x": a_extra})
+             for i in range(2)]
+    sup = FleetSupervisor(specs, run_dir=str(td / "run"),
+                          heartbeat_timeout_s=60.0).start()
+    router = FleetRouter(sup, probe_interval_s=0.1, hedge_enabled=False,
+                         residency_refresh_s=0.1)
+    port = router.start(0)
+    try:
+        # the cold model is listed, not loaded, on every worker
+        cap = router.fleet_capacity()
+        assert cap["residency"]["models"]["x"]["resident_workers"] == []
+        assert sorted(cap["residency"]["models"]["x"]["cold_workers"]) == \
+            ["w0", "w1"]
+        # a routed request pages it in (the request waits, then succeeds)
+        body = json.dumps({"inputs": X.tolist()}).encode()
+        resp = urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/x/predict", data=body),
+            timeout=120)
+        payload = json.loads(resp.read())
+        assert np.array_equal(
+            np.asarray(payload["outputs"], np.float32), oracle)
+        home = resp.headers["X-Worker-Id"]
+        # the placement view converges: the worker holding x resident
+        # ranks FIRST for it (cold traffic stops thrashing other budgets)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            ranked = [v.worker_id for v in router.ranked_workers("x")]
+            if ranked and ranked[0] == home and \
+                    router._residency_view.get(home, {}).get(
+                        "models", {}).get("x") == "resident":
+                break
+            time.sleep(0.1)
+        assert ranked[0] == home
+        cap = router.fleet_capacity()
+        assert cap["residency"]["models"]["x"]["resident_workers"] == [home]
+        assert cap["residency"]["paging"]["page_ins_total"] >= 1
+    finally:
+        router.stop()
+        sup.stop()
+
+
+# ==========================================================================
+# review-fix regressions
+def test_deadline_spent_once_across_page_in(archives):
+    """The deadline is ONE budget: a leader that pays a page-in longer
+    than its deadline gets an honest DeadlineExceeded afterwards (the
+    batcher sees only the REMAINING time, never a fresh window) — but
+    the work is not wasted: the model is resident for the next caller."""
+    from deeplearning4j_tpu.serving.admission import DeadlineExceeded
+    paths, oracles = archives
+    reg = ModelRegistry()
+    try:
+        reg.load("m", paths[0], **KW)
+        assert reg.evict("m") is True
+        with ChaosController(seed=3) as c:
+            c.on("serving.registry.page_in", AddLatency(0.4))
+            with pytest.raises(DeadlineExceeded):
+                reg.predict("m", X, timeout_ms=50.0)
+        assert reg.resident_names() == ["m"]  # the page-in still landed
+        out = np.asarray(reg.predict("m", X))
+        assert np.array_equal(out, oracles[0])
+    finally:
+        reg.shutdown()
+
+
+def test_cold_hit_counts_traffic_once(archives):
+    """A cold hit must not double-count on the traffic EWMA (once in the
+    cold branch, once after the page-in) — that would inflate cold
+    models' retention weight over genuinely hotter resident ones."""
+    paths, _ = archives
+    per = _per_model_bytes(archives)
+    reg = ModelRegistry(hbm_budget_bytes=int(per * 1.5))
+    try:
+        reg.load("a", paths[0], **KW)
+        reg.load("b", paths[1], **KW)  # evicts a
+        reg.predict("a", X)            # ONE cold hit
+        snap = reg.residency_snapshot()["models"]["a"]
+        assert snap["traffic_ewma"] == pytest.approx(1.0, abs=0.05)
+    finally:
+        reg.shutdown()
+
+
+def test_hot_swap_ledger_never_over_budget(archives):
+    """A hot-swap reserves only the DELTA over the old version's bytes:
+    the resident-byte ledger sampled from another thread during the
+    replacement's build never reads over budget."""
+    paths, _ = archives
+    per = _per_model_bytes(archives)
+    budget = int(per * 1.5)
+    reg = ModelRegistry(hbm_budget_bytes=budget)
+    try:
+        reg.load("a", paths[0], **KW)
+        samples, stop = [], threading.Event()
+
+        def sampler():
+            while not stop.is_set():
+                samples.append(reg.resident_bytes())
+                time.sleep(0.002)
+
+        t = threading.Thread(target=sampler)
+        t.start()
+        try:
+            with ChaosController(seed=4) as c:
+                c.on("serving.batcher.warmup", AddLatency(0.2))
+                reg.load("a", paths[1], **KW)  # hot-swap under the budget
+        finally:
+            stop.set()
+            t.join()
+        assert samples and max(samples) <= budget
+        assert reg.get("a").version == 2
+    finally:
+        reg.shutdown()
+
+
+def test_all_cold_registry_stays_ready(archives):
+    """A worker whose whole catalogue is paged out at this instant must
+    NOT fail /readyz — pulled from routing it could never receive the
+    request that pages a model back in. Cold models read as servable."""
+    paths, _ = archives
+    reg = ModelRegistry()
+    try:
+        reg.load("m", paths[0], **KW)
+        assert reg.evict("m") is True
+        assert reg.health() == {"m": "cold"}
+        assert reg.ready() is True
+        # a degraded/starting RESIDENT model still fails readiness
+        reg.page_in("m")
+        assert reg.ready() is True
+        reg.get("m")._started = False
+        assert reg.ready() is False
+    finally:
+        reg.shutdown()
+
+
+def test_ranking_puts_unknowing_workers_last():
+    """A worker that does not KNOW the model would answer a terminal 404
+    — it must rank behind every cold-registered worker, regardless of
+    headroom."""
+    from deeplearning4j_tpu.serving.router import FleetRouter, StaticFleet
+    router = FleetRouter(StaticFleet({"a": "127.0.0.1:1",
+                                      "b": "127.0.0.1:2",
+                                      "c": "127.0.0.1:3"}),
+                         hedge_enabled=False)
+    router._residency_view = {
+        "a": {"models": {}, "headroom_bytes": None},      # unknown: last
+        "b": {"models": {"m": "cold"}, "headroom_bytes": 10},
+        "c": {"models": {"m": "cold"}, "headroom_bytes": 5000},
+    }
+    assert [v.worker_id for v in router.ranked_workers("m")] == \
+        ["c", "b", "a"]
+
+
+def test_replica_resize_refreshes_hbm_ledger(archives):
+    """A runtime replica resize mints device copies the register-time
+    measurement cannot know: the scale endpoint must re-measure the
+    ledger — and page others out when the new footprint overshoots."""
+    paths, _ = archives
+    per = _per_model_bytes(archives)
+    reg = ModelRegistry(hbm_budget_bytes=int(per * 3.5))
+    srv = ModelServer(reg, worker_id="w-resize")
+    try:
+        reg.load("a", paths[0], **KW)
+        reg.load("b", paths[1], **KW)
+        port = srv.start(0)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/a/replicas",
+            data=json.dumps({"replicas": 2}).encode(),
+            headers={"Content-Type": "application/json"})
+        assert urllib.request.urlopen(req, timeout=120).status == 200
+        snap = reg.residency_snapshot()
+        assert snap["models"]["a"]["bytes"] == 2 * per  # re-measured
+        assert snap["resident_bytes"] == 3 * per
+        # grow past the budget: the ledger stays honest and the OTHER
+        # model is paged out to fit
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/a/replicas",
+            data=json.dumps({"replicas": 3}).encode(),
+            headers={"Content-Type": "application/json"})
+        assert urllib.request.urlopen(req, timeout=120).status == 200
+        snap = reg.residency_snapshot()
+        assert snap["models"]["a"]["bytes"] == 3 * per
+        assert snap["models"]["b"]["state"] == "cold"
+        assert snap["resident_bytes"] <= int(per * 3.5)
+    finally:
+        srv.stop()
+        reg.shutdown()
+
+
+def test_cold_model_detail_endpoint_not_404(archives):
+    paths, _ = archives
+    reg = ModelRegistry()
+    srv = ModelServer(reg, worker_id="w-detail")
+    try:
+        reg.load("m", paths[0], **KW)
+        port = srv.start(0)
+        assert reg.evict("m") is True
+        obj = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/models/m", timeout=30).read())
+        assert obj["residency"] == "cold"
+        assert obj["archive"] == paths[0]
+        # resizing a cold model is a clear 409, not a false 404
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/m/replicas",
+            data=json.dumps({"replicas": 2}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 409
+    finally:
+        srv.stop()
+        reg.shutdown()
