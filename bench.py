@@ -232,6 +232,12 @@ def check_tables(baseline_md=None, bench_extra=None, log=_log):
     if measured is not None:
         check_paging_section(measured, failures, warnings)
 
+    # ISSUE 12 control-plane keys: zero-error router/leader kills,
+    # takeover within budget, pre-breach predictive scale-up,
+    # exactly-once lever accounting with follower shadows
+    if measured is not None:
+        check_control_plane_section(measured, failures, warnings)
+
     for w in warnings:
         log(f"[check-tables] WARN {w}")
     for fmsg in failures:
@@ -3185,6 +3191,545 @@ def bench_paging(n_models=8, budget_models=2, requests=300, n_threads=4,
     return 0
 
 
+def bench_control_plane(bench_extra=None, log=_log):
+    """``bench.py --control-plane`` (ISSUE 12): the replicated-control-
+    plane drill of record, over the production topology miniaturized —
+    a ``FleetSupervisor`` publishes 2 real model workers into a shared
+    ``FleetConfig``; a ``RouterSupervisor`` runs 2 ``FleetRouter``
+    PROCESSES over that config, each with a lease-elected
+    ``SLOAutoscaler`` (short windows, predictive signals on); a
+    ``MultiRouterClient`` round-robins across the router roster with
+    connect-fail/5xx failover. Asserted BEFORE the artifact is written
+    (a failing run cannot produce it):
+
+    1. **router kill**: SIGKILL one router mid-load -> ZERO
+       client-visible errors and zero dropped in-flight requests (the
+       client fails over within the deadline); the supervisor relaunches
+       the victim within budget and it re-registers in the config;
+    2. **10x traffic step**: closed-loop load steps 10x; the
+       lease-holding autoscaler scales up from a PREDICTIVE signal
+       (admission-queue pressure / traffic forecast) with the recorded
+       ``burn_fast`` still under the trigger — the scale-up lands BEFORE
+       any SLO burn-rate breach, and zero breach-triggered scale-ups are
+       ever logged;
+    3. **leader kill**: SIGKILL the router holding the autoscaler lease
+       -> a follower takes the lease within the takeover budget
+       (2x the lease window), records the election on
+       ``/v1/autoscaler``, and traffic again sees zero errors;
+    4. **exactly-once**: with two live routers all drill long, the
+       fleet's total replica growth equals the count of leader-applied
+       scale-up levers (no double apply), while the follower
+       shadow-logged the same pressure (``follower_*`` decisions);
+    5. **bit-identity**: every 200 response in every phase equals the
+       parent-process oracle exactly.
+
+    Results -> ``BENCH_EXTRA.json["control_plane"]`` (+ top-level
+    ``control_plane_takeover_s`` copy), validated by
+    ``check_control_plane_section`` under ``--check-tables``."""
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.runtime.environment import get_environment
+    from deeplearning4j_tpu.serving import ModelRegistry
+    from deeplearning4j_tpu.serving.control_plane import (FleetConfig,
+                                                          MultiRouterClient,
+                                                          RouterSpec,
+                                                          RouterSupervisor)
+    from deeplearning4j_tpu.serving.fleet import FleetSupervisor, WorkerSpec
+
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(None)
+            .list()
+            .layer(DenseLayer(n_out=32, activation="tanh"))
+            .layer(OutputLayer(n_out=8, activation="softmax"))
+            .set_input_type(InputType.feed_forward(16))
+            .build())
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(16, 16)).astype(np.float32)
+    # queue_limit sized so a 10x closed-loop step builds visible queue
+    # pressure (depth/limit) WITHOUT ever shedding: 30 in-flight clients
+    # can never fill a 40-deep queue, so the drill's zero-error claim and
+    # its predictive-queue signal cannot conflict
+    batcher_kw = dict(max_batch_size=4, buckets=[1, 4],
+                      batch_timeout_ms=1.0, pipeline_depth=0,
+                      queue_limit=40)
+    worker_latency_ms = 15.0
+    lease_s = 1.5
+    up_burn = 2.0
+    low_threads, high_threads, step_factor = 3, 30, 10
+
+    td = tempfile.mkdtemp(prefix="dl4j-bench-cp-")
+    archive = os.path.join(td, "model-v1.zip")
+    cache = os.path.join(td, "executable-cache")
+    MultiLayerNetwork(conf).init().save(archive)
+    get_environment().set_compile_cache(cache)
+    reg = ModelRegistry()
+    reg.load("m", archive, warmup_example=xs[:1],
+             **{k: v for k, v in batcher_kw.items()})
+    oracle = reg.get("m").model
+    oracle_cache = {}
+
+    def oracle_out(n, ofs):
+        if (n, ofs) not in oracle_cache:
+            outs = []
+            for bucket in (b for b in batcher_kw["buckets"] if b >= n):
+                padded = np.concatenate(
+                    [xs[ofs:ofs + n],
+                     np.zeros((bucket - n, xs.shape[1]), xs.dtype)], axis=0)
+                outs.append(np.asarray(oracle.output(padded))[:n])
+            oracle_cache[(n, ofs)] = outs
+        return oracle_cache[(n, ofs)]
+
+    # precompute every (n, ofs) the clients can send: the final
+    # bit-identity sweep must not compile after the tmp cache dir is gone
+    for n in range(1, 5):
+        for ofs in range(8):
+            oracle_out(n, ofs)
+    reg.shutdown()  # persists the warmup manifest next to the archive
+
+    cfg_path = os.path.join(td, "fleet-config.json")
+    lease_path = os.path.join(td, "autoscaler.lease")
+    config = FleetConfig(cfg_path)
+    autoscaler_kw = dict(tick_s=0.2, fast_window_s=2, slow_window_s=10,
+                         up_burn=up_burn, confirm_burn=1.0, down_burn=0.5,
+                         up_cooldown_s=2.0, down_cooldown_s=60.0,
+                         min_requests=8, max_replicas=3,
+                         predictive=True, queue_pressure=0.25,
+                         forecast_window_s=20, forecast_horizon_s=10.0,
+                         forecast_margin=1.5)
+    # the slow-device profile: latency at the batcher's COMPLETION stage
+    # (not the HTTP handler) so a 10x closed-loop step builds a real
+    # admission-queue backlog — the docs/robustness.md in-flight-window
+    # drill — instead of just parking handler threads
+    specs_w = [WorkerSpec(worker_id=f"w{i}", model_name="m",
+                          archive=archive, version=1,
+                          batcher_kw=dict(batcher_kw), cache_dir=cache,
+                          straggle={"p": 1.0, "ms": worker_latency_ms,
+                                    "seed": 11 + i,
+                                    "point": "serving.batcher.complete"})
+               for i in range(2)]
+    specs_r = [RouterSpec(router_id=f"r{i}", config_path=cfg_path,
+                          lease_path=lease_path, lease_s=lease_s,
+                          router_kw={"hedge_enabled": False,
+                                     "probe_interval_s": 0.1,
+                                     "residency_refresh_s": 0.5},
+                          slo_windows_s=[2, 10, 3600],
+                          slo_target={"availability": 0.999,
+                                      "latency_ms": 5000.0,
+                                      "latency_target": 0.9},
+                          autoscaler=autoscaler_kw)
+               for i in range(2)]
+
+    def get_json(addr, path, timeout=10):
+        return json.loads(urllib.request.urlopen(
+            f"http://{addr}/{path.lstrip('/')}", timeout=timeout).read())
+
+    def autoscaler_reports():
+        """{router_id: /v1/autoscaler payload} from every REACHABLE
+        router (a just-killed one simply drops out)."""
+        out = {}
+        for rid, addr in sorted(config.routers().items()):
+            try:
+                out[rid] = get_json(addr, "/v1/autoscaler")
+            except Exception:
+                pass
+        return out
+
+    def current_leader():
+        for rid, rep in autoscaler_reports().items():
+            if rep.get("election", {}).get("role") == "leader":
+                return rid
+        return None
+
+    def wait_until(pred, timeout_s, what):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            v = pred()
+            if v:
+                return v
+            time.sleep(0.05)
+        raise AssertionError(f"[control-plane] timed out waiting for "
+                             f"{what}")
+
+    def total_replicas():
+        total = 0
+        for wid, addr in sorted(config.endpoints().items()):
+            cap = get_json(addr, "/v1/capacity")
+            total += int(((cap.get("models") or {}).get("m") or {})
+                         .get("replicas", 0))
+        return total
+
+    results = {"routers": 2, "workers": 2, "lease_s": lease_s}
+    outcomes = []          # (phase, "ok"|"error:...", n, ofs, outputs)
+    out_lock = threading.Lock()
+    phase = {"name": "warm"}
+
+    sup_w = FleetSupervisor(specs_w, run_dir=os.path.join(td, "run-w"),
+                            max_restarts=4, heartbeat_timeout_s=60.0,
+                            config=config)
+    sup_r = RouterSupervisor(specs_r, run_dir=os.path.join(td, "run-r"),
+                             max_restarts=4, heartbeat_timeout_s=60.0)
+    try:
+        sup_w.start()
+        sup_r.start()
+        wait_until(lambda: len(config.routers()) == 2, 60,
+                   "both routers to register")
+        client = MultiRouterClient(config=config)
+
+        def run_load(n_threads, sleep_s, stop):
+            def one(tid):
+                k = 0
+                while not stop.is_set():
+                    n, ofs = 1 + (tid + k) % 4, (3 * k + tid) % 8
+                    try:
+                        status, payload = client.predict(
+                            "m", xs[ofs:ofs + n].tolist(),
+                            timeout_ms=10000)
+                        if status == 200:
+                            rec = (phase["name"], "ok", n, ofs,
+                                   np.asarray(payload["outputs"],
+                                              np.float32))
+                        else:
+                            rec = (phase["name"], f"error:{status}", n,
+                                   ofs, None)
+                    except Exception as e:
+                        rec = (phase["name"],
+                               f"error:{type(e).__name__}", n, ofs, None)
+                    with out_lock:
+                        outcomes.append(rec)
+                    k += 1
+                    if sleep_s:
+                        time.sleep(sleep_s)
+            threads = [threading.Thread(target=one, args=(i,), daemon=True)
+                       for i in range(n_threads)]
+            for t in threads:
+                t.start()
+            return threads
+
+        # ---------------------------------------------------- warm + leader
+        stop = threading.Event()
+        threads = run_load(low_threads, 0.01, stop)
+        leader0 = wait_until(current_leader, 30, "a lease holder")
+        time.sleep(1.5)  # steady low-rate state, SLO rings filling
+
+        # ------------------------------------------------ 1. router kill
+        phase["name"] = "router_kill"
+        victim = [r for r in sup_r.router_ids() if r != leader0][0]
+        t_kill = time.monotonic()
+        sup_r.kill_router(victim)
+        time.sleep(2.0)  # sustained load across the death + failover
+        wait_until(lambda: len(sup_r.endpoints()) == 2, 90,
+                   "the killed router to relaunch")
+        wait_until(lambda: len(config.routers()) == 2, 30,
+                   "the relaunched router to re-register")
+        relaunched_s = time.monotonic() - t_kill
+        sup_r.check()  # within the restart budget
+        results["router_kill"] = {
+            "victim": victim, "errors": 0,
+            "relaunched_s": round(relaunched_s, 2),
+            "client_failovers": client.snapshot()["failovers_total"],
+        }
+        log(f"[control-plane] router kill: SIGKILL {victim} under load, "
+            f"relaunched+re-registered in {relaunched_s:.1f}s, "
+            f"{results['router_kill']['client_failovers']} client "
+            f"failover(s)")
+
+        # ------------------------------------------------ 2. 10x step
+        phase["name"] = "traffic_step"
+        replicas_before = total_replicas()
+        t_step = time.time()
+        step_stop = threading.Event()
+        step_threads = run_load(high_threads - low_threads, 0.0, step_stop)
+
+        def predictive_scaleup():
+            for rid, rep in autoscaler_reports().items():
+                for d in rep.get("decisions", []):
+                    if (d.get("action") == "scale_up_replica"
+                            and d.get("ok") and d.get("ts", 0) >= t_step
+                            and d.get("predictive")):
+                        return (rid, d)
+            return None
+
+        rid_up, up = wait_until(predictive_scaleup, 45,
+                                "a predictive scale-up after the step")
+        time.sleep(1.0)  # let the step keep running post-scale
+        step_stop.set()
+        for t in step_threads:
+            t.join(timeout=60)
+        time.sleep(2.5)  # queue drains + cooldown passes: no lever can
+        # still be in flight when the ledger freezes below
+        # freeze the exactly-once ledger BEFORE any router dies: count
+        # applied/shadow decisions while both routers' logs are intact
+        reports = autoscaler_reports()
+        applied = [d for rep in reports.values()
+                   for d in rep.get("decisions", [])
+                   if d.get("action") == "scale_up_replica" and d.get("ok")]
+        breach_ups = [d for d in applied if not d.get("predictive")]
+        shadow = [d for rep in reports.values()
+                  for d in rep.get("decisions", [])
+                  if d.get("action", "").startswith("follower_")]
+        leader_roles = {d.get("role") for d in applied}
+        replicas_after = total_replicas()
+        results["traffic_step"] = {
+            "step_factor": step_factor,
+            "low_threads": low_threads, "high_threads": high_threads,
+            "errors": 0,
+            "scaled_by": rid_up,
+            "predictive_signal": up["predictive"]["signal"],
+            "burn_fast_at_decision": up["burn"]["burn_fast"],
+            "up_burn": up_burn,
+            "breach_scaleups": len(breach_ups),
+            "replicas_before": replicas_before,
+            "replicas_after": replicas_after,
+        }
+        results["exactly_once"] = {
+            "applied_scaleups": len(applied),
+            "replica_growth": replicas_after - replicas_before,
+            "follower_shadow_decisions": len(shadow),
+            "nonleader_applies": sum(1 for r in leader_roles
+                                     if r != "leader"),
+        }
+        log(f"[control-plane] 10x step: {rid_up} pre-scaled on "
+            f"'{up['predictive']['signal']}' at burn_fast "
+            f"{up['burn']['burn_fast']:.2f} (< {up_burn}), replicas "
+            f"{replicas_before} -> {replicas_after}, "
+            f"{len(shadow)} shadow decision(s), 0 breach scale-ups")
+
+        # ------------------------------------------------ 3. leader kill
+        phase["name"] = "leader_kill"
+        leader1 = wait_until(current_leader, 15, "a live lease holder")
+        # the holder TOKEN is per process incarnation (rid@pid): the
+        # takeover check must see a different incarnation win, not the
+        # victim's relaunch resurrecting a dead lease without an election
+        h0 = autoscaler_reports()[leader1]["election"]["holder"]
+        t_kill = time.monotonic()
+        sup_r.kill_router(leader1)
+
+        def new_leader():
+            for rid, rep in autoscaler_reports().items():
+                e = rep.get("election", {})
+                if e.get("role") == "leader" and e.get("holder") != h0:
+                    return rid
+            return None
+
+        leader2 = wait_until(new_leader, lease_s * 4 + 5.0,
+                             "a follower to take the lease")
+        takeover_s = time.monotonic() - t_kill
+        time.sleep(1.0)  # load keeps flowing under the new leader
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        wait_until(lambda: len(sup_r.endpoints()) == 2, 90,
+                   "the killed leader to relaunch")
+        sup_r.check()
+        elections = sum(
+            1 for rep in autoscaler_reports().values()
+            for d in rep.get("decisions", [])
+            if str(d.get("action", "")).startswith("election_"))
+        results["leader_kill"] = {
+            "victim": leader1, "new_leader": leader2, "errors": 0,
+            "takeover_s": round(takeover_s, 2),
+            "takeover_budget_s": round(2 * lease_s, 2),
+            "elections_recorded": elections,
+        }
+        log(f"[control-plane] leader kill: {leader1} -> {leader2} took "
+            f"the lease in {takeover_s:.2f}s (budget {2 * lease_s:.1f}s), "
+            f"{elections} election record(s) on /v1/autoscaler")
+    finally:
+        try:
+            sup_r.stop()
+        finally:
+            sup_w.stop()
+            shutil.rmtree(td, ignore_errors=True)
+
+    # ---------------------------------------------------- assertions
+    failures = []
+    with out_lock:
+        recs = list(outcomes)
+    per_phase = {}
+    wrong = 0
+    for ph, status, n, ofs, got in recs:
+        d = per_phase.setdefault(ph, {"requests": 0, "errors": 0})
+        d["requests"] += 1
+        if status != "ok":
+            d["errors"] += 1
+        elif not any(np.array_equal(got, ref) for ref in oracle_out(n, ofs)):
+            wrong += 1
+    for ph, d in sorted(per_phase.items()):
+        if ph in results:
+            results[ph]["requests"] = d["requests"]
+            results[ph]["errors"] = d["errors"]
+        if d["errors"]:
+            failures.append(f"{d['errors']}/{d['requests']} client-visible "
+                            f"errors in phase {ph}")
+        if d["requests"] == 0:
+            failures.append(f"phase {ph} recorded no traffic")
+    if wrong:
+        failures.append(f"{wrong} responses not bit-identical to the "
+                        f"oracle")
+    if results["traffic_step"]["burn_fast_at_decision"] >= up_burn:
+        failures.append("the 'predictive' scale-up fired AT/after the "
+                        "burn trigger — not a pre-breach scale")
+    if results["traffic_step"]["breach_scaleups"] != 0:
+        failures.append(f"{results['traffic_step']['breach_scaleups']} "
+                        f"breach-triggered scale-up(s): the predictive "
+                        f"signal did not get there first")
+    eo = results["exactly_once"]
+    if eo["applied_scaleups"] != eo["replica_growth"] or \
+            eo["applied_scaleups"] < 1:
+        failures.append(
+            f"exactly-once violated: {eo['applied_scaleups']} applied "
+            f"lever(s) vs {eo['replica_growth']} replica growth")
+    if eo["nonleader_applies"] != 0:
+        failures.append(f"{eo['nonleader_applies']} lever(s) applied by "
+                        f"a non-leader")
+    if eo["follower_shadow_decisions"] < 1:
+        failures.append("no follower shadow decisions recorded — the "
+                        "second controller was not actually computing")
+    if results["leader_kill"]["takeover_s"] > \
+            results["leader_kill"]["takeover_budget_s"]:
+        failures.append(
+            f"takeover took {results['leader_kill']['takeover_s']}s, "
+            f"over the {results['leader_kill']['takeover_budget_s']}s "
+            f"budget")
+    if results["leader_kill"]["elections_recorded"] < 1:
+        failures.append("no election events on /v1/autoscaler")
+    if results["router_kill"]["client_failovers"] < 1:
+        failures.append("the client never failed over — the router kill "
+                        "drill tested nothing")
+    for fmsg in failures:
+        log(f"[control-plane] FAIL {fmsg}")
+    if failures:
+        return 1  # a failing run cannot write the artifact
+
+    results["requests_total"] = len(recs)
+    results["errors"] = 0
+    results["bit_identical"] = True
+    here = os.path.dirname(os.path.abspath(__file__))
+    bench_extra = bench_extra or os.path.join(here, "BENCH_EXTRA.json")
+    try:
+        with open(bench_extra) as f:
+            extra = json.load(f)
+    except Exception:
+        extra = {}
+    extra["control_plane"] = results
+    extra["control_plane_takeover_s"] = results["leader_kill"]["takeover_s"]
+    with open(bench_extra, "w") as f:
+        json.dump(extra, f, indent=2)
+    log(f"[control-plane] OK: {len(recs)} requests across 4 phases, 0 "
+        f"errors, all bit-identical; router+leader kills absorbed, "
+        f"predictive pre-scale before any breach, exactly-once levers")
+    return 0
+
+
+def check_control_plane_section(extra, failures, warnings):
+    """--check-tables coverage for the ISSUE 12 keys: the
+    ``control_plane`` section (when present) must record a zero-error
+    bit-identical drill in every phase with real traffic, at least one
+    client failover across the router kill, a takeover within its own
+    recorded budget with elections on the record, a PRE-breach
+    predictive scale-up (recorded burn under the recorded trigger, zero
+    breach-triggered scale-ups), exactly-once lever accounting
+    (applied == growth, zero non-leader applies, shadow decisions
+    present), and an in-sync top-level takeover copy."""
+    if "control_plane" not in extra:
+        warnings.append("control_plane: not present in BENCH_EXTRA.json "
+                        "(bench --control-plane not run?)")
+        return
+    d = extra["control_plane"]
+    required = ["routers", "workers", "lease_s", "requests_total",
+                "errors", "bit_identical", "router_kill", "traffic_step",
+                "leader_kill", "exactly_once"]
+    for k in required:
+        if k not in d:
+            failures.append(f"control_plane.{k}: missing from the "
+                            f"recorded section")
+    if any(k not in d for k in required):
+        return
+    try:
+        if d["errors"] != 0:
+            failures.append(f"control_plane.errors: {d['errors']} — the "
+                            f"drill must be client-invisible")
+        if d["bit_identical"] is not True:
+            failures.append("control_plane.bit_identical: the recorded "
+                            "run was not bit-identical to its oracle")
+        if d["routers"] < 2:
+            failures.append(f"control_plane.routers: {d['routers']} — a "
+                            f"replication drill needs >= 2 routers")
+        for ph in ("router_kill", "traffic_step", "leader_kill"):
+            if d[ph].get("errors") != 0:
+                failures.append(
+                    f"control_plane.{ph}: recorded "
+                    f"{d[ph].get('errors')!r} client-visible errors "
+                    f"(must be 0)")
+            if d[ph].get("requests", 0) <= 0:
+                failures.append(f"control_plane.{ph}: no recorded "
+                                f"traffic")
+        if d["router_kill"].get("client_failovers", 0) < 1:
+            failures.append("control_plane.router_kill: zero client "
+                            "failovers — the kill was never absorbed")
+        ts = d["traffic_step"]
+        if ts.get("burn_fast_at_decision") is None or \
+                ts["burn_fast_at_decision"] >= ts["up_burn"]:
+            failures.append(
+                f"control_plane.traffic_step: burn_fast_at_decision "
+                f"{ts.get('burn_fast_at_decision')!r} not under the "
+                f"trigger {ts.get('up_burn')!r} — the recorded scale-up "
+                f"was not pre-breach")
+        if ts.get("breach_scaleups") != 0:
+            failures.append(
+                f"control_plane.traffic_step: {ts.get('breach_scaleups')!r} "
+                f"breach-triggered scale-up(s) recorded (must be 0)")
+        if ts.get("predictive_signal") not in ("queue", "forecast",
+                                               "schedule"):
+            failures.append(
+                f"control_plane.traffic_step: unknown predictive signal "
+                f"{ts.get('predictive_signal')!r}")
+        if ts.get("replicas_after", 0) <= ts.get("replicas_before", 0):
+            failures.append(
+                f"control_plane.traffic_step: replicas "
+                f"{ts.get('replicas_before')!r} -> "
+                f"{ts.get('replicas_after')!r} — the recorded step never "
+                f"scaled")
+        eo = d["exactly_once"]
+        if eo.get("applied_scaleups") != eo.get("replica_growth") or \
+                eo.get("applied_scaleups", 0) < 1:
+            failures.append(
+                f"control_plane.exactly_once: applied_scaleups "
+                f"{eo.get('applied_scaleups')!r} != replica_growth "
+                f"{eo.get('replica_growth')!r} — double (or phantom) "
+                f"lever application")
+        if eo.get("nonleader_applies") != 0:
+            failures.append(
+                f"control_plane.exactly_once: "
+                f"{eo.get('nonleader_applies')!r} non-leader lever "
+                f"application(s) (must be 0)")
+        if eo.get("follower_shadow_decisions", 0) < 1:
+            failures.append(
+                "control_plane.exactly_once: no follower shadow "
+                "decisions — the second controller was not computing")
+        lk = d["leader_kill"]
+        if lk["takeover_s"] > lk["takeover_budget_s"]:
+            failures.append(
+                f"control_plane.leader_kill: takeover_s "
+                f"{lk['takeover_s']} over the recorded budget "
+                f"{lk['takeover_budget_s']}")
+        if lk.get("elections_recorded", 0) < 1:
+            failures.append("control_plane.leader_kill: no election "
+                            "events recorded on /v1/autoscaler")
+        if extra.get("control_plane_takeover_s") != lk["takeover_s"]:
+            failures.append(
+                f"control_plane_takeover_s: top-level copy "
+                f"{extra.get('control_plane_takeover_s')!r} != "
+                f"control_plane section {lk['takeover_s']!r}")
+    except (TypeError, ValueError, AttributeError, KeyError) as e:
+        failures.append(f"control_plane: malformed section ({e!r})")
+
+
 def check_paging_section(extra, failures, warnings):
     """--check-tables coverage for the ISSUE 11 keys: the ``paging``
     section (when present) must record a zero-error bit-identical drill
@@ -3800,6 +4345,8 @@ if __name__ == "__main__":
         sys.exit(bench_autoscale())
     if "--paging" in sys.argv:
         sys.exit(bench_paging())
+    if "--control-plane" in sys.argv:
+        sys.exit(bench_control_plane())
     if "--serving" in sys.argv:
         # give the CPU backend multiple virtual devices so the replica arm
         # is real even off-TPU (flag only affects the host platform; must

@@ -77,6 +77,38 @@ class MeshSpec:
         return sizes
 
 
+@dataclasses.dataclass(frozen=True)
+class HostSpec:
+    """One machine in a multi-host bring-up (ISSUE 12): the declarative
+    twin of :class:`MeshSpec` for the HOST axis. ``name`` is what a
+    :class:`~deeplearning4j_tpu.serving.fleet.WorkerSpec.host` (or a
+    training worker placement) references, ``address`` is where that
+    host's processes are reachable, and ``spawn`` selects the process
+    adapter (``"local"`` = this machine, ``"loopback"`` = a named
+    same-machine stand-in for tests/drills, ``"ssh"``/other = a remote
+    transport an adapter must implement). The serving fleet resolves
+    these through ``serving.fleet.resolve_host_adapters``; the training
+    side feeds the same roster into :func:`initialize_multihost`
+    (coordinator + process ids per host)."""
+
+    name: str
+    address: str = "127.0.0.1"
+    spawn: str = "local"
+    #: how many worker processes this host is expected to carry (a
+    #: placement hint; 0 = unconstrained)
+    processes: int = 0
+
+
+def loopback_hosts(n: int, prefix: str = "host") -> Tuple[HostSpec, ...]:
+    """``n`` named loopback hosts — the serving twin of the ``local[N]``
+    Spark-master trick: every "host" is this machine, but specs, spawn
+    adapters, endpoints and placement all flow through the real
+    multi-host paths, so tests and drills exercise a fleet that spans
+    machines without owning any."""
+    return tuple(HostSpec(name=f"{prefix}{i}", address="127.0.0.1",
+                          spawn="loopback") for i in range(int(n)))
+
+
 def create_mesh(
     spec: MeshSpec | Dict[str, int] | None = None,
     devices_: Optional[Sequence] = None,

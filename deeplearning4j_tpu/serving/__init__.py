@@ -64,6 +64,17 @@ The subsystem that puts traffic on this stack:
   with the model resident (or the most eviction-free headroom), and the
   autoscaler rebalances placement before spawning workers when the wall
   is HBM, not compute.
+- ``control_plane.py`` (ISSUE 12, ``docs/fleet_serving.md``) — the
+  replicated control plane: :class:`FleetConfig` (the versioned shared
+  fleet-config file N routers front one worker roster through, written
+  with checkpoint atomics, read with degrade-never-crash semantics),
+  :class:`LeaseElection` (file-lock leader election so exactly one
+  router's autoscaler acts while the rest shadow-compute),
+  :class:`RouterSupervisor` + ``router_main`` (N supervised
+  ``FleetRouter`` processes — port-file readiness, heartbeat watchdog,
+  budgeted restarts), and :class:`MultiRouterClient` (round-robin +
+  connect-fail/5xx failover across routers, so a SIGKILL'd router is
+  invisible to callers).
 - :class:`WarmupManifest` (``manifest.py``) — persisted record of every
   compiled (bucket, replica, dtype) pair, written next to model archives
   and replayed by registry load / hot-swap so a restart reaches READY
@@ -91,6 +102,12 @@ _EXPORTS = {
     "TrafficEWMA": "paging",
     "AutoscalerConfig": "autoscale",
     "SLOAutoscaler": "autoscale",
+    "forecast_rate": "autoscale",
+    "FleetConfig": "control_plane",
+    "LeaseElection": "control_plane",
+    "MultiRouterClient": "control_plane",
+    "RouterSpec": "control_plane",
+    "RouterSupervisor": "control_plane",
     "ContinuousBatcher": "batcher",
     "default_buckets": "batcher",
     "model_capacity": "capacity",

@@ -77,7 +77,7 @@ from deeplearning4j_tpu.runtime import trace
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["AutoscalerConfig", "SLOAutoscaler"]
+__all__ = ["AutoscalerConfig", "SLOAutoscaler", "forecast_rate"]
 
 
 @dataclasses.dataclass
@@ -116,9 +116,52 @@ class AutoscalerConfig:
     log_capacity: int = 256
     #: socket budget for the replica lever (warmup compiles take seconds)
     lever_timeout_s: float = 120.0
+    # ---- predictive scaling (ISSUE 12): act BEFORE the burn-rate breach
+    #: master switch for the pre-breach signals below
+    predictive: bool = True
+    #: look-ahead horizon of the SLO-ring traffic forecast
+    forecast_horizon_s: float = 15.0
+    #: per-second history the trend is fitted over (clamped to the SLO
+    #: monitor's ring horizon)
+    forecast_window_s: int = 30
+    #: forecast demand must exceed the estimated serveable rate by this
+    #: factor before a pre-scale fires
+    forecast_margin: float = 1.2
+    #: admission-queue pressure (depth / limit) that predicts a breach —
+    #: the queue is already measured for the ``Retry-After`` drain hints
+    queue_pressure: float = 0.5
+    #: scheduled pre-scaling windows: ``{"model": name-or-"*",
+    #: "start_ts", "end_ts"}`` (unix seconds) — capacity ahead of a
+    #: KNOWN traffic event, no signal required
+    schedules: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list)
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
+
+
+def forecast_rate(counts: List[float], horizon_s: float
+                  ) -> "tuple[float, float, float]":
+    """Least-squares linear trend over per-second request counts ->
+    ``(predicted_rate_at_now+horizon, slope_per_s, rate_now)``.
+    ``rate_now`` is the mean of the newest quarter of the window, so one
+    noisy second does not define "now"; fewer than 4 samples fit no
+    trend (slope 0). Pure function — the forecast unit tests drive it
+    with hand-built ramps."""
+    n = len(counts)
+    if n == 0:
+        return 0.0, 0.0, 0.0
+    tail = max(1, n // 4)
+    rate_now = sum(counts[-tail:]) / tail
+    if n < 4:
+        return rate_now, 0.0, rate_now
+    mean_x = (n - 1) / 2.0
+    mean_y = sum(counts) / n
+    sxx = sum((i - mean_x) ** 2 for i in range(n))
+    sxy = sum((i - mean_x) * (counts[i] - mean_y) for i in range(n))
+    slope = sxy / sxx if sxx else 0.0
+    pred = mean_y + slope * ((n - 1) + float(horizon_s) - mean_x)
+    return max(0.0, pred), slope, rate_now
 
 
 class _ModelState:
@@ -160,9 +203,17 @@ class SLOAutoscaler:
                  replica_lever: Optional[Callable] = None,
                  worker_lever: Optional[Callable] = None,
                  residency_lever: Optional[Callable] = None,
+                 election=None,
                  now_fn: Callable[[], float] = time.monotonic):
         self.router = router
         self.fleet = fleet
+        #: lease election (ISSUE 12): with one attached, this controller
+        #: only ACTS while it holds the lease — otherwise every decision
+        #: is shadow-computed and logged with role="follower". None keeps
+        #: the single-controller behaviour (always leader).
+        self.election = election
+        if election is not None and election.on_transition is None:
+            election.on_transition = self._record_election
         self.config = config or AutoscalerConfig()
         cfg = self.config
         # coerce the window knobs: SLOMonitor.report keys windows as
@@ -274,6 +325,42 @@ class SLOAutoscaler:
         self.fleet.add_worker(spec)
         return True, {"worker_id": new_id}
 
+    # --------------------------------------------------------- leadership
+    def _role(self) -> str:
+        """``"leader"`` when this controller may act (no election wired,
+        or the lease is ours); ``"follower"`` otherwise. A lock-free read
+        — safe on the tick path even while a chaos drill hangs the
+        leader's heartbeat."""
+        if self.election is None:
+            return "leader"
+        return "leader" if self.election.is_leader() else "follower"
+
+    def _record_election(self, event: Dict[str, Any]) -> None:
+        """Fold a lease transition into the decision log (ISSUE 12):
+        every election — acquired, takeover, lost, released — is an
+        explained ``/v1/autoscaler`` entry next to the decisions it
+        gates."""
+        entry = {
+            "ts": event.get("ts", time.time()),
+            "tick": self.ticks,
+            "model": None,
+            "action": f"election_{event.get('role')}",
+            "ok": True,
+            "role": event.get("role"),
+            "worker": None,
+            "level": None,
+            "burn": None,
+            "capacity": None,
+            "trace_id": None,
+            "detail": {k: event.get(k)
+                       for k in ("holder", "seq", "reason", "id")},
+        }
+        with self._lock:
+            self.decisions.append(entry)
+        logger.info("autoscaler election: %s -> %s (%s)",
+                    event.get("id"), event.get("role"),
+                    event.get("reason"))
+
     # ---------------------------------------------------------- burn math
     @staticmethod
     def _burn(window: Dict[str, Any]) -> float:
@@ -333,6 +420,11 @@ class SLOAutoscaler:
         decisions logged this tick (empty on a quiet tick)."""
         self.ticks += 1
         self._tick_capacity = None
+        if self.election is not None:
+            # one election step per tick (plus the election's own
+            # heartbeat thread): a controller that just lost its lease
+            # must learn so BEFORE deciding, not a heartbeat later
+            self.election.ensure()
         try:
             report = self.router.slo.report(
                 models=(sorted(self._models_filter)
@@ -370,11 +462,91 @@ class SLOAutoscaler:
             if now - st.last_action_ts < cfg.up_cooldown_s:
                 return self._log_suppressed(model, st, "up_cooldown", burn)
             return self._act(model, st, burn, direction=+1)
+        if cfg.predictive:
+            # pre-breach signals (ISSUE 12): queue pressure, traffic
+            # forecast, scheduled windows. Checked BEFORE the recovery
+            # branch — a 10x ramp can still read "recovered" on burn
+            # alone, and scaling DOWN into a ramp is the one wrong move.
+            sig = self._predictive_signal(model, fast)
+            if sig is not None:
+                if now - st.last_action_ts < cfg.up_cooldown_s:
+                    return self._log_suppressed(model, st, "up_cooldown",
+                                                burn)
+                burn = {**burn, "predictive": sig}
+                return self._act(model, st, burn, direction=+1,
+                                 predictive=sig)
         if recovered and st.level > 0:
             if now - st.last_action_ts < cfg.down_cooldown_s:
                 return self._log_suppressed(model, st, "down_cooldown", burn)
             return self._act(model, st, burn, direction=-1)
         st.suppressed = None
+        return None
+
+    def _predictive_signal(self, model: str, fast: Dict[str, Any]
+                           ) -> Optional[Dict[str, Any]]:
+        """The pre-breach scale-up signal (ISSUE 12), or ``None``:
+
+        - **schedule** — a configured pre-scaling window covers now
+          (checked first: planned capacity needs no live traffic at all);
+        - **queue** — admission-queue pressure ``depth/limit`` at or over
+          ``queue_pressure`` (the same queue the ``Retry-After`` drain
+          hints are computed from): requests are already waiting, the
+          latency burn just has not caught up yet;
+        - **forecast** — the short-horizon linear trend over the SLO
+          ring's per-second request counts exceeds the estimated
+          serveable rate (current rate / busy fraction) by
+          ``forecast_margin``: the 10x step is scaled for BEFORE the
+          burn-rate breach it would otherwise become."""
+        cfg = self.config
+        now_wall = time.time()
+        for sched in (cfg.schedules or []):
+            try:
+                if sched.get("model") not in (model, "*", None):
+                    continue
+                if (float(sched["start_ts"]) <= now_wall
+                        <= float(sched["end_ts"])):
+                    return {"signal": "schedule",
+                            "start_ts": float(sched["start_ts"]),
+                            "end_ts": float(sched["end_ts"])}
+            except (TypeError, KeyError, ValueError):
+                continue  # malformed schedule entry: skip, never crash
+        if int(fast.get("requests", 0)) < cfg.min_requests:
+            return None  # too little traffic to predict from
+        # the fleet-aggregated capacity schema (FleetRouter
+        # .fleet_capacity): flattened queue_depth / queue_headroom /
+        # busy_fraction summed across workers
+        entry = (self._capacity().get("models") or {}).get(model) or {}
+        try:
+            depth = int(entry.get("queue_depth", 0))
+            headroom = int(entry.get("queue_headroom_requests", 0))
+        except (TypeError, ValueError):
+            depth = headroom = 0
+        limit = depth + headroom
+        if limit > 0 and depth / limit >= cfg.queue_pressure:
+            return {"signal": "queue", "queue_depth": depth,
+                    "queue_limit": limit}
+        recent = getattr(self.router.slo, "recent_counts", None)
+        if recent is None:
+            return None
+        counts = recent(model, cfg.forecast_window_s)
+        pred, slope, rate_now = forecast_rate(counts,
+                                              cfg.forecast_horizon_s)
+        if slope <= 0 or rate_now <= 0:
+            return None
+        try:
+            busy = float(entry.get("busy_fraction", 0.0))
+        except (TypeError, ValueError):
+            busy = 0.0
+        if busy <= 0.01:
+            return None  # near-idle: no honest capacity estimate
+        serveable = rate_now / min(1.0, max(busy, 1e-6))
+        if pred > serveable * cfg.forecast_margin:
+            return {"signal": "forecast",
+                    "rate_now": round(rate_now, 3),
+                    "predicted_rate": round(pred, 3),
+                    "serveable_rate": round(serveable, 3),
+                    "slope_per_s": round(slope, 4),
+                    "horizon_s": cfg.forecast_horizon_s}
         return None
 
     # ----------------------------------------------------------- decisions
@@ -386,7 +558,8 @@ class SLOAutoscaler:
         return None
 
     def _act(self, model: str, st: _ModelState, burn: Dict[str, Any],
-             direction: int) -> Optional[Dict[str, Any]]:
+             direction: int, predictive: Optional[Dict[str, Any]] = None
+             ) -> Optional[Dict[str, Any]]:
         cfg = self.config
         # the decision span: flagged so tail sampling ALWAYS keeps it —
         # an autoscaling event is never a "healthy trace to drop"
@@ -397,6 +570,19 @@ class SLOAutoscaler:
                 sp.flag("autoscale")
                 sp.set("model", model)
                 sp.set("direction", direction)
+                if predictive is not None:
+                    sp.set("predictive", predictive.get("signal"))
+            if self._role() == "follower":
+                # shadow decision (ISSUE 12): computed like the leader's,
+                # logged with role="follower", levers NEVER touched — the
+                # exactly-once guarantee two live routers depend on
+                return self._log(
+                    model, st,
+                    ("follower_scale_up" if direction > 0
+                     else "follower_scale_down"),
+                    burn, None, span=sp, ok=False, role="follower",
+                    detail="shadow decision: not the lease holder",
+                    dedup=True)
             view = self._target_view(model)
             if view is None:
                 return self._log_suppressed(model, st, "no_healthy_worker",
@@ -404,10 +590,30 @@ class SLOAutoscaler:
             ok_guard, headroom = self._guard(model, view)
             if direction > 0:
                 return self._scale_up(model, st, burn, view, ok_guard,
-                                      headroom, sp)
+                                      headroom, sp, predictive=predictive)
             return self._scale_down(model, st, burn, view, headroom, sp)
 
-    def _scale_up(self, model, st, burn, view, ok_guard, headroom, sp):
+    def _fenced(self, model, st, burn, headroom, sp):
+        """Last-instant lease re-check before a lever fires: a leader
+        that lost its lease mid-decision must NOT act (the new leader may
+        already be acting on the same signal). ``election.verify()``
+        reads the lease FILE directly — lock-free, so it stays truthful
+        even while the election's own heartbeat thread is hung inside a
+        step (the ``serving.autoscale.lease`` chaos drill), which is
+        exactly when the cached role lies. An arbitrary scheduler pause
+        between this check and the lever remains possible (full fencing
+        would need the seq token validated at the worker); the check
+        closes every observable lost-lease window. Returns the
+        suppression entry when fencing triggers, else ``None``."""
+        if self.election is not None and not self.election.verify():
+            return self._log(model, st, "suppressed_lost_lease", burn,
+                             headroom, span=sp, ok=False, role="follower",
+                             detail="lease lost between decision and "
+                                    "lever; deferring to the new leader")
+        return None
+
+    def _scale_up(self, model, st, burn, view, ok_guard, headroom, sp,
+                  predictive=None):
         cfg = self.config
         if headroom.get("replicas") is None:
             # no capacity entry for the target worker (scrape timed out
@@ -427,6 +633,9 @@ class SLOAutoscaler:
             if cfg.rebalance_enabled:
                 target = self._rebalance_target(model, view)
                 if target is not None:
+                    fenced = self._fenced(model, st, burn, headroom, sp)
+                    if fenced is not None:
+                        return fenced
                     try:
                         ok, detail = self._residency_lever(target, model, sp)
                     except Exception as e:
@@ -450,6 +659,9 @@ class SLOAutoscaler:
                              dedup=True)
         replicas = int(headroom["replicas"])
         if replicas < cfg.max_replicas:
+            fenced = self._fenced(model, st, burn, headroom, sp)
+            if fenced is not None:
+                return fenced
             try:
                 ok, detail = self._replica_lever(view, model, +1, sp)
             except Exception as e:
@@ -460,7 +672,7 @@ class SLOAutoscaler:
                 st.suppressed = None
             return self._log(model, st, "scale_up_replica", burn, headroom,
                              span=sp, ok=ok, worker=view.worker_id,
-                             detail=detail)
+                             detail=detail, predictive=predictive)
         entry = self._worker_entry(model, st, burn, view, headroom, sp,
                                    reason="replicas at max")
         if entry is not None:
@@ -479,6 +691,9 @@ class SLOAutoscaler:
         if not (self.fleet is not None and cfg.max_workers is not None
                 and len(self.router.workers()) < cfg.max_workers):
             return None
+        fenced = self._fenced(model, st, burn, headroom, sp)
+        if fenced is not None:
+            return fenced
         lever = self._worker_lever or self._spawn_worker
         try:
             ok, detail = lever(view, sp)
@@ -530,6 +745,9 @@ class SLOAutoscaler:
         return best
 
     def _scale_down(self, model, st, burn, view, headroom, sp):
+        fenced = self._fenced(model, st, burn, headroom, sp)
+        if fenced is not None:
+            return fenced
         kind, wid = st.actions[-1]
         if kind == "worker":
             try:
@@ -574,7 +792,8 @@ class SLOAutoscaler:
                          detail=f"deferred by {reason}")
 
     def _log(self, model, st, action, burn, headroom, span=trace.NOOP,
-             ok=True, worker=None, detail=None, dedup=False):
+             ok=True, worker=None, detail=None, dedup=False, role=None,
+             predictive=None):
         if dedup:
             if st.suppressed == action:
                 return None
@@ -585,6 +804,7 @@ class SLOAutoscaler:
             "model": model,
             "action": action,
             "ok": bool(ok),
+            "role": role or self._role(),
             "worker": worker,
             "level": st.level,
             "burn": burn,
@@ -592,6 +812,8 @@ class SLOAutoscaler:
             "trace_id": span.trace_id,
             "detail": detail,
         }
+        if predictive is not None:
+            entry["predictive"] = predictive
         if span.recording:
             span.set("action", action)
             span.set("ok", bool(ok))
@@ -615,10 +837,11 @@ class SLOAutoscaler:
             decisions = list(self.decisions)
             states = {m: (s.level, s.last_action_ts)
                       for m, s in sorted(self._states.items())}
-        return {
+        out = {
             "config": self.config.to_dict(),
             "ticks": self.ticks,
             "running": self._thread is not None,
+            "role": self._role(),
             "models": {m: {"level": level,
                            "last_action_age_s": (
                                None if last_ts == float("-inf")
@@ -626,6 +849,15 @@ class SLOAutoscaler:
                        for m, (level, last_ts) in states.items()},
             "decisions": decisions,
         }
+        if self.election is not None:
+            # the election record (ISSUE 12): who holds the lease, how
+            # fresh its heartbeat is, and every transition this
+            # controller observed
+            try:
+                out["election"] = self.election.snapshot()
+            except Exception:
+                out["election"] = {"error": "election snapshot failed"}
+        return out
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> "SLOAutoscaler":

@@ -51,7 +51,7 @@ from typing import Any, Dict, Optional
 
 __all__ = ["model_capacity", "process_capacity", "registry_capacity",
            "render_prometheus", "persistent_cache_bytes",
-           "served_device_bytes"]
+           "served_device_bytes", "served_device_dtype_bytes"]
 
 
 def _leaf_bytes(tree) -> Dict[str, int]:
@@ -75,19 +75,38 @@ def served_device_bytes(served) -> int:
     counts the host state that executes). This is the number the
     registry's HBM-budget ledger tracks per model (ISSUE 11) — the same
     per-replica math :func:`model_capacity` reports, so reservation,
-    eviction accounting, and the ``/v1/capacity`` scrape all agree."""
+    eviction accounting, and the ``/v1/capacity`` scrape all agree. The
+    single source of truth for the traversal is
+    :func:`served_device_dtype_bytes`; this is its scalar sum."""
+    return sum(served_device_dtype_bytes(served).values())
+
+
+def served_device_dtype_bytes(served) -> Dict[str, int]:
+    """Per-dtype breakdown of :func:`served_device_bytes` (ISSUE 12
+    satellite; ROADMAP item 3 headroom): the registry records this on the
+    model's residency record so the pager's eviction scoring runs on the
+    ACTUAL device dtypes — an int8-resident quantized model shows its
+    4x-smaller footprint, which is exactly what makes it 4x cheaper to
+    keep resident under ``paging.retention_weight``."""
     pool = served.batcher._pool
     ts = getattr(served.model, "train_state", None)
-    host = (sum(_leaf_bytes(getattr(ts, "params", None)).values())
-            + sum(_leaf_bytes(getattr(ts, "model_state", None)).values()))
-    total = 0
+    host: Dict[str, int] = {}
+    for part in (getattr(ts, "params", None),
+                 getattr(ts, "model_state", None)):
+        for dt, b in _leaf_bytes(part).items():
+            host[dt] = host.get(dt, 0) + b
+    out: Dict[str, int] = {}
     for rep in list(pool.replicas):
         if rep.params is not None:
-            total += (sum(_leaf_bytes(rep.params).values())
-                      + sum(_leaf_bytes(rep.model_state).values()))
+            src: Dict[str, int] = {}
+            for part in (rep.params, rep.model_state):
+                for dt, b in _leaf_bytes(part).items():
+                    src[dt] = src.get(dt, 0) + b
         else:
-            total += host
-    return total
+            src = host
+        for dt, b in src.items():
+            out[dt] = out.get(dt, 0) + b
+    return out
 
 
 def model_capacity(served) -> Dict[str, Any]:

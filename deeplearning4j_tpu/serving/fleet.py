@@ -32,6 +32,19 @@ Worker processes run on the CPU backend by default (``JAX_PLATFORMS``
 stripped from the inherited env exactly like
 ``train.distributed.worker_env`` — the sitecustomize TPU bootstrap must
 not race the worker's own backend selection).
+
+Multi-host fleets (ISSUE 12): ``WorkerSpec.host`` names the machine a
+worker lives on, resolved through a :class:`HostAdapter` — the per-host
+spawn/address seam over the ``runtime/mesh.py`` bring-up machinery
+(:class:`~deeplearning4j_tpu.runtime.mesh.HostSpec`). The default
+``"local"`` adapter is today's behaviour; ``loopback`` adapters are
+same-machine stand-ins that let tests and drills exercise the multi-host
+spawn/watchdog/endpoint paths without real remote machines; a real
+remote adapter needs only ``spawn`` + ``address``. The supervisor can
+also PUBLISH its live roster into a shared
+:class:`~deeplearning4j_tpu.serving.control_plane.FleetConfig` so N
+replicated routers (ISSUE 12 tentpole) discover workers from one
+versioned file instead of holding a supervisor reference.
 """
 
 from __future__ import annotations
@@ -56,78 +69,101 @@ logger = logging.getLogger(__name__)
 # -------------------------------------------------------------------------
 # worker-pid registry (the conftest process-leak guard polls this, exactly
 # like train.distributed's)
-_children_lock = threading.Lock()
-_children: List[subprocess.Popen] = []
+class PidRegistry:
+    """Subprocess bookkeeping for one supervised tier (fleet workers
+    here; router processes in ``serving/control_plane.py`` instantiate
+    their own): track spawned children, poll the live set, kill
+    strays/orphans with one wait-and-prune discipline. ``active`` holds
+    the tier's RUNNING supervisors (``start()``..``stop()``) — their
+    children are MANAGED, not leaked, so the per-test leak guard flags
+    only orphans (a module-scoped fixture fleet must survive another
+    test's cleanup)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._children: List[subprocess.Popen] = []
+        self.active: List[Any] = []   # running supervisors of this tier
+
+    def track(self, proc: subprocess.Popen) -> None:
+        with self._lock:
+            self._children.append(proc)
+
+    def live_pids(self) -> List[int]:
+        with self._lock:
+            self._children[:] = [p for p in self._children
+                                 if p.poll() is None]
+            return [p.pid for p in self._children]
+
+    def _kill(self, pids: Optional[set] = None) -> List[int]:
+        with self._lock:
+            stray = [p for p in self._children if p.poll() is None
+                     and (pids is None or p.pid in pids)]
+            for p in stray:
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+            for p in stray:
+                try:
+                    p.wait(timeout=10)
+                except Exception:
+                    pass
+            self._children[:] = [p for p in self._children
+                                 if p.poll() is None]
+        return [p.pid for p in stray]
+
+    def kill_stray(self) -> List[int]:
+        """Kill EVERY still-live tracked child (teardown of last resort)."""
+        return self._kill()
+
+    def orphaned_pids(self) -> List[int]:
+        """Live tracked pids NOT owned by any active supervisor — what
+        the conftest leak guard polls."""
+        managed = set()
+        for sup in list(self.active):
+            managed.update(sup.managed_pids())
+        return [pid for pid in self.live_pids() if pid not in managed]
+
+    def kill_orphaned(self) -> List[int]:
+        """Kill only the ORPHANED children; never a live supervisor's."""
+        return self._kill(set(self.orphaned_pids()))
+
+
+_registry = PidRegistry()
 
 
 def _track_child(proc: subprocess.Popen) -> None:
-    with _children_lock:
-        _children.append(proc)
+    _registry.track(proc)
 
 
 def live_worker_pids() -> List[int]:
     """PIDs of fleet worker subprocesses launched through this module that
     are still alive — polled by the conftest leak guard after every test."""
-    with _children_lock:
-        _children[:] = [p for p in _children if p.poll() is None]
-        return [p.pid for p in _children]
+    return _registry.live_pids()
 
 
 def kill_stray_workers() -> List[int]:
     """Kill any still-live tracked workers (leak-guard teardown); returns
     the PIDs that had to be killed."""
-    with _children_lock:
-        stray = [p for p in _children if p.poll() is None]
-        for p in stray:
-            try:
-                p.kill()
-            except OSError:
-                pass
-        for p in stray:
-            try:
-                p.wait(timeout=10)
-            except Exception:
-                pass
-        _children[:] = [p for p in _children if p.poll() is None]
-    return [p.pid for p in stray]
-
-
-#: supervisors currently running (start()..stop()); their workers are
-#: MANAGED, not leaked — the per-test leak guard must only flag orphans,
-#: or a module-scoped fleet fixture would fail every test it spans.
-_active_supervisors: List["FleetSupervisor"] = []
+    return _registry.kill_stray()
 
 
 def orphaned_worker_pids() -> List[int]:
     """Live tracked worker pids NOT owned by any active supervisor — what
     the conftest leak guard polls (a supervised fixture fleet is fine; a
     worker that outlived its supervisor is a leak)."""
-    managed = set()
-    for sup in list(_active_supervisors):
-        managed.update(sup.managed_pids())
-    return [pid for pid in live_worker_pids() if pid not in managed]
+    return _registry.orphaned_pids()
 
 
 def kill_orphaned_workers() -> List[int]:
     """Kill only the ORPHANED tracked workers (leak-guard teardown); a
     managed fixture fleet mid-suite must survive another test's leak, so
     this never touches a live supervisor's children. Returns killed pids."""
-    orphans = set(orphaned_worker_pids())
-    with _children_lock:
-        stray = [p for p in _children
-                 if p.pid in orphans and p.poll() is None]
-        for p in stray:
-            try:
-                p.kill()
-            except OSError:
-                pass
-        for p in stray:
-            try:
-                p.wait(timeout=10)
-            except Exception:
-                pass
-        _children[:] = [p for p in _children if p.poll() is None]
-    return [p.pid for p in stray]
+    return _registry.kill_orphaned()
+
+
+#: the tier's running supervisors (see PidRegistry.active)
+_active_supervisors = _registry.active
 
 
 def _worker_env(spec: "WorkerSpec") -> Dict[str, str]:
@@ -147,6 +183,84 @@ def _worker_env(spec: "WorkerSpec") -> Dict[str, str]:
     env["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count="
                         f"{int(spec.host_device_count)}")
     return env
+
+
+# -------------------------------------------------------------------------
+# host adapters (ISSUE 12): the per-host seam the supervisor spawns and
+# watches workers through. An adapter answers two questions — "launch this
+# argv on your machine" (returning a Popen-compatible handle the watchdog
+# polls/kills) and "at what address are your workers reachable". The
+# mesh-level description of the host roster is
+# ``runtime.mesh.HostSpec`` / ``runtime.mesh.loopback_hosts`` (kept there,
+# next to MeshSpec, because the same roster seeds the multi-host training
+# bring-up); this module holds the process-spawning side so it stays
+# importable without jax.
+class HostAdapter:
+    """One machine's process bring-up. ``name`` is what
+    :attr:`WorkerSpec.host` references; ``address`` is the host part of
+    every endpoint this host's workers serve on."""
+
+    name = "local"
+    address = "127.0.0.1"
+
+    def spawn(self, argv: List[str], env: Dict[str, str],
+              stdout, stderr) -> subprocess.Popen:
+        raise NotImplementedError
+
+    def describe(self) -> Dict[str, str]:
+        return {"name": self.name, "address": self.address,
+                "kind": type(self).__name__}
+
+
+class LocalHostAdapter(HostAdapter):
+    """This machine (the default): plain subprocess spawn."""
+
+    def spawn(self, argv, env, stdout, stderr) -> subprocess.Popen:
+        return subprocess.Popen(argv, env=env, stdout=stdout,
+                                stderr=stderr, text=True)
+
+
+class LoopbackHostAdapter(LocalHostAdapter):
+    """A NAMED same-machine "host": processes spawn locally but carry a
+    distinct host identity, so tests and drills drive the multi-host
+    spawn/watchdog/endpoint paths (per-host adapters, host-qualified
+    endpoints, host-spread placement) without remote machines — the
+    serving twin of the ``local[N]`` Spark-master trick."""
+
+    def __init__(self, name: str, address: str = "127.0.0.1"):
+        self.name = str(name)
+        self.address = str(address)
+
+
+def resolve_host_adapters(specs: List["WorkerSpec"],
+                          hosts=None) -> Dict[str, HostAdapter]:
+    """The ``{host_name: adapter}`` map for a fleet: ``hosts`` may carry
+    :class:`HostAdapter` instances or ``runtime.mesh.HostSpec``-shaped
+    records (``.name``/``.address``/``.spawn``); every host a spec
+    references must resolve (``"local"`` always does), so a typo'd host
+    fails at supervisor construction, not at first relaunch."""
+    out: Dict[str, HostAdapter] = {"local": LocalHostAdapter()}
+    for h in (hosts or []) if not isinstance(hosts, dict) else hosts.values():
+        if isinstance(h, HostAdapter):
+            out[h.name] = h
+            continue
+        name = getattr(h, "name", None)
+        spawn = getattr(h, "spawn", "loopback")
+        if name is None:
+            raise TypeError(f"not a host adapter or HostSpec: {h!r}")
+        if spawn in ("loopback", "local"):
+            out[str(name)] = LoopbackHostAdapter(
+                str(name), getattr(h, "address", "127.0.0.1"))
+        else:
+            raise NotImplementedError(
+                f"host {name!r} wants spawn={spawn!r}; only local/loopback "
+                f"adapters ship — a remote adapter implements "
+                f"HostAdapter.spawn over its own transport")
+    missing = sorted({getattr(s, "host", "local") for s in specs} - set(out))
+    if missing:
+        raise ValueError(f"worker specs reference unknown host(s) "
+                         f"{missing}; pass adapters via hosts=")
+    return out
 
 
 # -------------------------------------------------------------------------
@@ -175,6 +289,10 @@ class WorkerSpec:
     #: a fleet where every worker KNOWS every model but each is resident
     #: only where traffic placed it
     extra_models: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: which machine this worker lives on (ISSUE 12): the name of a
+    #: :class:`HostAdapter` registered with the supervisor ("local" =
+    #: this machine; loopback adapters are the tests' multi-host stand-in)
+    host: str = "local"
     jax_platforms: str = "cpu"
     host_device_count: int = 1
     heartbeat_interval_s: float = 0.5
@@ -214,15 +332,37 @@ class FleetSupervisor:
     consume the budget.
     """
 
+    #: subprocess entry module + pid/active registries — class seams so
+    #: RouterSupervisor (serving/control_plane.py: the same supervisor
+    #: pattern one level up, over router processes) reuses this machinery
+    #: wholesale while keeping its own leak-guard population
+    _worker_module = "deeplearning4j_tpu.serving.fleet"
+
+    @staticmethod
+    def _track(proc: subprocess.Popen) -> None:
+        _track_child(proc)
+
+    @staticmethod
+    def _active_list() -> List["FleetSupervisor"]:
+        return _active_supervisors
+
     def __init__(self, specs: List[WorkerSpec], run_dir: Optional[str] = None,
                  max_restarts: int = 3,
                  restart_window_s: Optional[float] = None,
                  heartbeat_timeout_s: float = 30.0,
                  ready_timeout_s: float = 180.0,
-                 poll_s: float = 0.2):
+                 poll_s: float = 0.2,
+                 hosts=None,
+                 config=None):
         ids = [s.worker_id for s in specs]
         if len(set(ids)) != len(ids):
             raise ValueError(f"duplicate worker ids: {ids}")
+        self._hosts = resolve_host_adapters(specs, hosts)
+        #: a shared FleetConfig-shaped object (``set_workers(endpoints)``)
+        #: the supervisor publishes its live roster into on every change —
+        #: what replicated routers (ISSUE 12) read instead of holding a
+        #: supervisor reference
+        self._config = config
         self._own_run_dir = run_dir is None
         self.run_dir = run_dir or tempfile.mkdtemp(prefix="dl4j-fleet-")
         os.makedirs(self.run_dir, exist_ok=True)
@@ -260,13 +400,12 @@ class FleetSupervisor:
         err_f = tempfile.NamedTemporaryFile(
             mode="w+", prefix=f"dl4j-fleet-{handle.spec.worker_id}-err-",
             dir=self.run_dir, delete=False)
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "deeplearning4j_tpu.serving.fleet",
-             handle.spec_path],
-            env=_worker_env(handle.spec), stdout=out_f, stderr=err_f,
-            text=True)
+        adapter = self._hosts[getattr(handle.spec, "host", "local")]
+        proc = adapter.spawn(
+            [sys.executable, "-m", self._worker_module, handle.spec_path],
+            env=_worker_env(handle.spec), stdout=out_f, stderr=err_f)
         proc._dl4j_capture = (out_f, err_f)  # type: ignore[attr-defined]
-        _track_child(proc)
+        self._track(proc)
         handle.proc = proc
         handle.port = None
         handle.generation += 1
@@ -334,8 +473,9 @@ class FleetSupervisor:
         self._watchdog = threading.Thread(target=self._watch, daemon=True,
                                           name="FleetSupervisor")
         self._watchdog.start()
-        if self not in _active_supervisors:
-            _active_supervisors.append(self)
+        if self not in self._active_list():
+            self._active_list().append(self)
+        self._publish_roster()
         return self
 
     # ------------------------------------------------------------ fleet API
@@ -345,14 +485,41 @@ class FleetSupervisor:
             return [h.proc.pid for h in self._handles.values() if h.alive()]
 
     def endpoints(self) -> Dict[str, str]:
-        """``{worker_id: "127.0.0.1:port"}`` for every worker that is
-        alive with a known port (the router's view of the fleet)."""
+        """``{worker_id: "host:port"}`` for every worker that is alive
+        with a known port (the router's view of the fleet). The host part
+        comes from the worker's host adapter, so a multi-host fleet's
+        endpoints point at the right machines."""
         out = {}
         with self._lock:
             for wid, h in self._handles.items():
                 if h.port is not None and h.alive() and not h.stopping:
-                    out[wid] = f"127.0.0.1:{h.port}"
+                    adapter = self._hosts[getattr(h.spec, "host", "local")]
+                    out[wid] = f"{adapter.address}:{h.port}"
         return out
+
+    def hosts(self) -> Dict[str, Dict[str, str]]:
+        """The resolved host roster (``{name: describe()}``) plus each
+        host's live worker ids — the multi-host topology surface."""
+        with self._lock:
+            per_host: Dict[str, List[str]] = {}
+            for wid, h in self._handles.items():
+                per_host.setdefault(
+                    getattr(h.spec, "host", "local"), []).append(wid)
+        return {name: {**adapter.describe(),
+                       "workers": sorted(per_host.get(name, []))}
+                for name, adapter in sorted(self._hosts.items())}
+
+    def _publish_roster(self) -> None:
+        """Best-effort push of the live endpoints into the shared fleet
+        config (when attached) — called on every membership change so N
+        shared-nothing routers converge on the roster within one config
+        read. Publication must never take the fleet down."""
+        if self._config is None:
+            return
+        try:
+            self._config.set_workers(self.endpoints())
+        except Exception:
+            logger.exception("fleet roster publication failed")
 
     def worker_ids(self) -> List[str]:
         return sorted(self._handles)
@@ -409,6 +576,7 @@ class FleetSupervisor:
             port = self._wait_port(handle)
         finally:
             handle.stopping = False
+        self._publish_roster()
         return port
 
     def clone_spec(self, worker_id: str, new_worker_id: str) -> WorkerSpec:
@@ -428,6 +596,9 @@ class FleetSupervisor:
         file says ready (registry loaded + manifest-warmed), and hands it
         to the running watchdog; the router's ``/readyz`` prober admits
         it on its next cycle. Returns the worker's port."""
+        if getattr(spec, "host", "local") not in self._hosts:
+            raise ValueError(f"worker spec references unknown host "
+                             f"{spec.host!r}; known: {sorted(self._hosts)}")
         with self._lock:
             if spec.worker_id in self._handles:
                 raise ValueError(f"worker id {spec.worker_id!r} already "
@@ -436,7 +607,9 @@ class FleetSupervisor:
             self._handles[spec.worker_id] = handle
             self._spawn(handle)
         try:
-            return self._wait_port(handle, ready_timeout_s)
+            port = self._wait_port(handle, ready_timeout_s)
+            self._publish_roster()
+            return port
         except BaseException:
             with self._lock:
                 self._handles.pop(spec.worker_id, None)
@@ -479,6 +652,7 @@ class FleetSupervisor:
         self._close_capture(handle)
         with self._lock:
             self._handles.pop(worker_id, None)
+        self._publish_roster()
 
     def prewarm_manifest(self, archive: str) -> Optional[str]:
         """Ensure ``archive`` has a warmup manifest before a rolling
@@ -583,6 +757,7 @@ class FleetSupervisor:
                         with self._lock:
                             self._spawn(handle)
                         self._wait_port(handle)
+                        self._publish_roster()
                     except Exception:
                         logger.exception("relaunch of %s failed",
                                          handle.spec.worker_id)
@@ -594,8 +769,8 @@ class FleetSupervisor:
         """Stop the watchdog, then gracefully stop every worker (SIGTERM →
         drain → manifest refresh → exit 0), escalating to SIGKILL."""
         self._stop.set()
-        if self in _active_supervisors:
-            _active_supervisors.remove(self)
+        if self in self._active_list():
+            self._active_list().remove(self)
         if self._watchdog is not None:
             self._watchdog.join(timeout=10.0)
             self._watchdog = None
@@ -617,6 +792,7 @@ class FleetSupervisor:
                 except Exception:
                     pass
             self._close_capture(handle)
+        self._publish_roster()  # an empty roster, not a stale one
 
     def __enter__(self) -> "FleetSupervisor":
         return self.start()

@@ -323,9 +323,16 @@ class ServingMetrics:
         """Machine-readable snapshot for the fleet router's ``/metrics``
         aggregation (ISSUE 9): summable counters plus raw-bucket
         histograms (:meth:`LatencyHistogram.to_wire`) so one scrape of the
-        router sees fleet-wide counts and MERGED latency percentiles."""
+        router sees fleet-wide counts and MERGED latency percentiles.
+        Ships the model's own breaker verdict too (ISSUE 12): what a
+        freshly (re)started router warm-starts its passive per-worker
+        breaker from, so it never re-routes traffic into a worker its
+        peers already isolated."""
+        breaker = (self._breaker.snapshot()
+                   if self._breaker is not None else None)
         with self._lock:
             return {
+                "breaker": breaker,
                 "counters": {
                     "requests_total": self.requests_total,
                     "responses_total": self.responses_total,

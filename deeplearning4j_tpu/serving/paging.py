@@ -53,7 +53,8 @@ logger = logging.getLogger(__name__)
 
 __all__ = ["ENV_BUDGET", "RESIDENT", "COLD", "TrafficEWMA", "Residency",
            "PagingMetrics", "env_hbm_budget", "measured_device_budget",
-           "recompile_risk", "retention_weight"]
+           "recompile_risk", "retention_weight", "dtype_density",
+           "policy_adjusted_archive_bytes"]
 
 ENV_BUDGET = "DL4J_TPU_HBM_BUDGET_BYTES"
 
@@ -154,8 +155,66 @@ def retention_weight(nbytes: int, traffic: float, risk: float) -> float:
     this model — ``traffic x recompile_risk / bytes``. The eviction
     victim is the resident model with the MINIMUM weight (big, idle,
     cheap-to-restore models go first); the registry breaks ties by
-    ``last_used`` (plain LRU)."""
+    ``last_used`` (plain LRU).
+
+    ``nbytes`` must be the model's ACTUAL per-dtype device bytes (ISSUE
+    12 satellite; ROADMAP item 3 headroom): an int8-resident quantized
+    model occupies 4x fewer device bytes than its f32 twin, so at equal
+    traffic and risk its weight is 4x higher — 4x cheaper to keep
+    resident, evicted last. The registry feeds measured per-dtype bytes
+    for resident models (:meth:`Residency.retention`) and the
+    dtype-policy-corrected estimate for cold ones
+    (:func:`policy_adjusted_archive_bytes`)."""
     return (float(traffic) + 1e-9) * float(risk) / float(max(1, nbytes))
+
+
+def _weight_itemsize(policy) -> int:
+    """Bytes per weight element at the policy's STORAGE dtype (1 on any
+    failure — the conservative, largest-inflation fallback)."""
+    try:
+        import numpy as np
+        return max(1, int(np.dtype(getattr(policy, "weight_dtype",
+                                           "int8")).itemsize))
+    except Exception:
+        return 1
+
+
+def dtype_density(policy) -> float:
+    """Device-byte density of an archive's dtype policy relative to f32,
+    in (0, 1]: an ``int8``-resident policy (in-graph dequant) keeps its
+    weights on device at 1 byte/param — density 0.25 — while a
+    ``dequantized`` policy mints f32 device copies at load (density 1.0
+    no matter how small the archive is). ``None`` (no policy: a plain
+    f32 archive) is density 1.0."""
+    if policy is None:
+        return 1.0
+    if getattr(policy, "weight_residency", "dequantized") != "int8":
+        return 1.0
+    return _weight_itemsize(policy) / 4.0
+
+
+def policy_adjusted_archive_bytes(archive_path: str,
+                                  file_bytes: int) -> int:
+    """Dtype-policy-aware DEVICE-byte estimate for a cold archive (ISSUE
+    12 satellite): the archive's on-disk size reflects its STORAGE dtype
+    (int8 payloads are ~4x smaller), but what the budget ledger must
+    reserve is the RESIDENCY dtype — a ``dequantized`` policy's device
+    copies are f32, so its file size underestimates the page-in cost by
+    ~4x (exactly the kind of optimistic estimate that over-admits and
+    busts the budget); an ``int8``-resident policy's file size is about
+    right. One formula: f32-equivalent bytes (file x 4/storage-itemsize)
+    scaled back down by :func:`dtype_density` — the residency rule lives
+    in exactly one place. No sidecar = plain archive = file size
+    stands."""
+    try:
+        from deeplearning4j_tpu.serving.quantize import DtypePolicy
+        policy = DtypePolicy.load_for_archive(archive_path)
+    except Exception:
+        policy = None
+    if policy is None:
+        return int(file_bytes)
+    return int(file_bytes * (4.0 / _weight_itemsize(policy))
+               * dtype_density(policy))
 
 
 class Residency:
@@ -166,8 +225,8 @@ class Residency:
 
     __slots__ = ("name", "state", "evictable", "archive_path", "version",
                  "load_kwargs", "gate_report", "bytes", "bytes_estimated",
-                 "last_used", "ewma", "page_in_s", "page_ins", "evictions",
-                 "risk")
+                 "dtype_bytes", "last_used", "ewma", "page_in_s",
+                 "page_ins", "evictions", "risk")
 
     def __init__(self, name: str, halflife_s: float = 60.0):
         self.name = name
@@ -183,6 +242,11 @@ class Residency:
         self.gate_report = None         # survives deploy_quantized evictions
         self.bytes = 0                  # measured (or estimated) device bytes
         self.bytes_estimated = True
+        #: per-dtype breakdown of ``bytes`` when measured (ISSUE 12
+        #: satellite): the ACTUAL device dtypes — an int8-resident model
+        #: shows {"int8": ...} 4x smaller than its f32 twin — feeding
+        #: dtype-aware eviction scoring and the residency snapshot
+        self.dtype_bytes: Dict[str, int] = {}
         self.last_used = 0.0
         self.ewma = TrafficEWMA(halflife_s)
         self.page_in_s = 0.0            # decayed page-in cost estimate
@@ -198,12 +262,25 @@ class Residency:
         else:
             self.page_in_s = 0.5 * self.page_in_s + 0.5 * float(seconds)
 
+    def retention(self, now: Optional[float] = None) -> float:
+        """This record's cost-weighted-LRU retention weight from its
+        ACTUAL per-dtype device bytes (falls back to the scalar estimate
+        while unmeasured) — the dtype-aware eviction score: a 4x-denser
+        int8-resident model weighs 4x more per byte, so it is evicted
+        last among equals."""
+        now = time.monotonic() if now is None else now
+        nbytes = (sum(self.dtype_bytes.values()) if self.dtype_bytes
+                  else int(self.bytes or 0))
+        return retention_weight(nbytes, self.ewma.rate(now), self.risk)
+
     def snapshot(self, now: Optional[float] = None) -> Dict[str, Any]:
         now = time.monotonic() if now is None else now
         return {
             "state": self.state,
             "bytes": int(self.bytes or 0),
             "bytes_estimated": bool(self.bytes_estimated),
+            "dtype_bytes": dict(self.dtype_bytes),
+            "retention_weight": self.retention(now),
             "evictable": bool(self.evictable),
             "traffic_ewma": round(self.ewma.rate(now), 4),
             "idle_s": (round(now - self.last_used, 3)

@@ -347,8 +347,10 @@ class ModelRegistry:
                              breaker=breaker, retry=retry)
         served.metrics.set_warmup_seconds(time.monotonic() - t0)
         from deeplearning4j_tpu.serving import capacity
+        dtype_bytes: Dict[str, int] = {}
         try:
-            served.device_bytes = capacity.served_device_bytes(served)
+            dtype_bytes = capacity.served_device_dtype_bytes(served)
+            served.device_bytes = sum(dtype_bytes.values())
         except Exception:
             served.device_bytes = est  # never let accounting fail a deploy
         with self._lock:
@@ -366,6 +368,10 @@ class ModelRegistry:
             res.state = paging.RESIDENT
             res.bytes = int(served.device_bytes)
             res.bytes_estimated = False
+            # the measured per-dtype breakdown (ISSUE 12 satellite): what
+            # makes eviction scoring dtype-aware — int8-resident models
+            # carry their actual 4x-smaller footprint into retention()
+            res.dtype_bytes = dict(dtype_bytes)
             res.version = served.version
             res.last_used = time.monotonic()
             if _archive_info is not None:
@@ -459,7 +465,12 @@ class ModelRegistry:
         est = int(m.device_bytes) if m is not None and m.device_bytes else 0
         if est <= 0:
             try:
-                est = os.path.getsize(path)
+                # dtype-policy-aware (ISSUE 12 satellite): an archive's
+                # file size reflects its STORAGE dtype; the budget must
+                # reserve its RESIDENCY dtype (a dequantized-residency
+                # quantized archive pages in ~4x its file size)
+                est = paging.policy_adjusted_archive_bytes(
+                    path, os.path.getsize(path))
             except OSError:
                 est = 0
         load_kwargs.pop("version", None)
@@ -881,8 +892,10 @@ class ModelRegistry:
 
     def _pick_victim_locked(self, exclude: str = "") -> Optional[str]:
         """The cost-weighted-LRU victim among evictable, unpinned
-        resident models (``paging.retention_weight``; LRU tie-break).
-        Caller holds ``self._lock``."""
+        resident models (``Residency.retention`` — dtype-aware: scored
+        on the measured per-dtype device bytes, so an int8-resident
+        model outweighs an equally-trafficked f32 one 4:1 per byte; LRU
+        tie-break). Caller holds ``self._lock``."""
         now = time.monotonic()
         best = None
         for n, served in self._models.items():
@@ -891,9 +904,7 @@ class ModelRegistry:
             res = self._residency.get(n)
             if res is None or not res.evictable or served.pins > 0:
                 continue
-            key = (paging.retention_weight(
-                res.bytes, res.ewma.rate(now), res.risk),
-                res.last_used, n)
+            key = (res.retention(now), res.last_used, n)
             if best is None or key < best:
                 best = key
         return best[2] if best is not None else None
@@ -913,7 +924,8 @@ class ModelRegistry:
             return 0
         from deeplearning4j_tpu.serving import capacity
         try:
-            measured = int(capacity.served_device_bytes(served))
+            dtype_bytes = capacity.served_device_dtype_bytes(served)
+            measured = sum(dtype_bytes.values())
         except Exception:
             return served.device_bytes
         with self._lock:
@@ -922,6 +934,7 @@ class ModelRegistry:
             if res is not None:
                 res.bytes = measured
                 res.bytes_estimated = False
+                res.dtype_bytes = dict(dtype_bytes)
         budget = self.hbm_budget_bytes
         if budget is not None:
             while True:

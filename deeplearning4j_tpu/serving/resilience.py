@@ -166,6 +166,22 @@ class CircuitBreaker:
                 self.opens_total += 1
                 self._failures.clear()
 
+    def warm_open(self) -> None:
+        """Adopt an externally observed OPEN verdict (ISSUE 12: a fresh
+        router warm-starts its passive per-worker breaker from the
+        worker's own ``/v1/metricsz`` breaker states instead of
+        re-learning the failure streak from live traffic). A no-op unless
+        CLOSED — an already OPEN/HALF_OPEN breaker keeps its own timer,
+        so a warm-start can never reset an in-progress recovery probe."""
+        with self._lock:
+            now = self._clock()
+            self._tick(now)
+            if self._state is CircuitState.CLOSED:
+                self._state = CircuitState.OPEN
+                self._opened_at = now
+                self.opens_total += 1
+                self._failures.clear()
+
     def snapshot(self) -> Dict[str, object]:
         with self._lock:
             self._tick(self._clock())

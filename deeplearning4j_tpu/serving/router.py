@@ -54,6 +54,7 @@ import hashlib
 import http.client
 import json
 import logging
+import os
 import threading
 import time
 import uuid
@@ -195,6 +196,10 @@ class WorkerView:
         self.address = address
         self.breaker = breaker or CircuitBreaker(
             failure_threshold=3, window_s=30.0, reset_timeout_s=2.0)
+        #: flips True after the one-shot /v1/metricsz warm-start scrape
+        #: (ISSUE 12): a fresh view adopts the worker's OWN breaker
+        #: verdict instead of re-learning a failure streak from traffic
+        self.breaker_warmed = False
         self.ready = False
         self.draining = False
         self.shed_until = 0.0           # monotonic end of the shed window
@@ -360,8 +365,17 @@ class FleetRouter:
                  connect_timeout_s: float = 2.0,
                  no_deadline_timeout_s: float = 60.0,
                  residency_refresh_s: float = 1.0,
-                 slo: Optional[SLOMonitor] = None):
+                 slo: Optional[SLOMonitor] = None,
+                 router_id: str = "router"):
         self._fleet = fleet
+        #: identity in a replicated router tier (ISSUE 12): the key this
+        #: router registers under in the shared config's router roster,
+        #: and what peers report it as
+        self.router_id = str(router_id)
+        #: shared FleetConfig (attach_config): peer discovery + the
+        #: idempotency ledger config-versioned levers claim through
+        self._config = None
+        self._peer_view: Dict[str, Dict[str, Any]] = {}
         self.default_timeout_ms = default_timeout_ms
         self.hedge_enabled = bool(hedge_enabled)
         self.hedge_factor = float(hedge_factor)
@@ -506,10 +520,99 @@ class FleetRouter:
                 view.ready = self._probe_worker(view)
             except Exception:
                 view.ready = False
+            if view.ready and not view.breaker_warmed:
+                self._warm_start_breaker(view)
         now = time.monotonic()
         if now - self._last_residency_refresh >= self.residency_refresh_s:
             self._last_residency_refresh = now
             self._refresh_residency()
+            self._refresh_peers()
+
+    def _warm_start_breaker(self, view: WorkerView) -> None:
+        """Warm-start a fresh :class:`WorkerView`'s passive breaker from
+        the worker's own ``/v1/metricsz`` breaker states (ISSUE 12): a
+        freshly (re)started router builds every breaker CLOSED, so
+        without this it would happily route traffic into a worker its
+        peers had already isolated — re-learning the failure streak at
+        the clients' expense. One scrape decides: any model breaker the
+        worker itself reports OPEN/HALF_OPEN pre-opens the router's
+        passive breaker; re-admission then runs through the breaker's
+        normal half-open probe, exactly as if this router had observed
+        the failures first-hand."""
+        try:
+            status, _, data = self._http(view.address, "GET",
+                                         "/v1/metricsz",
+                                         timeout=self.probe_timeout_s)
+        except Exception:
+            return  # unreachable: the prober already handles that
+        view.breaker_warmed = True
+        if status != 200:
+            return  # a stub without metricsz: nothing to adopt
+        try:
+            payload = json.loads(data.decode())
+            states = {str((m.get("breaker") or {}).get("state"))
+                      for m in (payload.get("models") or {}).values()
+                      if isinstance(m, dict)}
+        except Exception:
+            return  # malformed payload: warm with no verdict to adopt
+        if states & {"OPEN", "HALF_OPEN"}:
+            view.breaker.warm_open()
+            logger.warning(
+                "worker %s reports open breaker(s) %s; warm-starting its "
+                "passive breaker OPEN", view.worker_id,
+                sorted(states & {"OPEN", "HALF_OPEN"}))
+
+    # ----------------------------------------------------- config + peering
+    def attach_config(self, config) -> None:
+        """Attach the shared :class:`~deeplearning4j_tpu.serving
+        .control_plane.FleetConfig` (ISSUE 12): enables peer discovery
+        (``/v1/peers``, the ``/readyz`` peering section) and makes
+        :meth:`rolling_deploy` idempotent + config-versioned through the
+        applied-action ledger, so two live routers can never double-apply
+        one deploy."""
+        self._config = config
+
+    def peers(self) -> Dict[str, str]:
+        """Peer routers from the shared config's roster (everyone but
+        us); empty without an attached config."""
+        if self._config is None:
+            return {}
+        try:
+            routers = self._config.routers()
+        except Exception:
+            return {}
+        return {rid: addr for rid, addr in sorted(routers.items())
+                if rid != self.router_id}
+
+    def _refresh_peers(self) -> None:
+        """Router-to-router ``/readyz`` peering: probe each peer on the
+        residency-refresh cadence so any live router can answer "which of
+        my peers is up" — the observability a supervisor or client needs
+        to see a dead router from the survivors. Probes run CONCURRENTLY
+        through the same fan-out helper as the worker scrapes: one hung
+        peer (exactly what peering exists to surface) must not stall the
+        probe loop that feeds the data path's own worker health."""
+        peers = self.peers()
+        view: Dict[str, Dict[str, Any]] = {
+            rid: {"address": addr, "ready": False}
+            for rid, addr in peers.items()}
+
+        class _Peer:
+            def __init__(self, rid, addr):
+                self.worker_id = rid
+                self.address = addr
+
+        def probe(p):
+            status, _, _ = self._http(p.address, "GET", "/readyz",
+                                      timeout=self.probe_timeout_s)
+            return status == 200
+
+        results = self._fanout(
+            probe, [_Peer(r, a) for r, a in peers.items()],
+            self.probe_timeout_s)
+        for rid, ok in results.items():
+            view[rid]["ready"] = bool(ok)
+        self._peer_view = view
 
     def _probe_loop(self) -> None:
         while not self._stop.wait(self.probe_interval_s):
@@ -878,32 +981,79 @@ class FleetRouter:
         worker at a time: drain -> supervisor relaunch on the new archive
         (manifest-prewarmed) -> ``/readyz`` -> readmit. Requires a
         supervisor-backed fleet (``restart_worker``). Returns a per-worker
-        report (ready wait, restarts)."""
+        report (ready wait, restarts).
+
+        With a shared config attached (ISSUE 12) the deploy is
+        IDEMPOTENT and config-versioned: the (archive, version) action is
+        claimed in the applied-action ledger before any worker is
+        touched, so the same deploy issued against two live routers runs
+        exactly once — the loser returns a ``skipped`` report naming who
+        applied it — and the completed deploy state is recorded in the
+        config for every router (and every restarted router) to see."""
         if not hasattr(self._fleet, "restart_worker"):
             raise TypeError(
                 "rolling_deploy needs a supervisor-backed fleet "
                 "(FleetSupervisor); a StaticFleet cannot relaunch workers")
-        prewarm = getattr(self._fleet, "prewarm_manifest", None)
-        if prewarm is not None:
-            prewarm(archive)
-        report: Dict[str, Any] = {"archive": archive, "workers": {}}
-        # deploy over the SUPERVISOR's full roster, not just the live
-        # views — a worker that is down mid-crash-relaunch right now must
-        # still be moved to the new archive, or it comes back on the old
-        worker_ids = (sorted(self._fleet.worker_ids())
-                      if hasattr(self._fleet, "worker_ids")
-                      else sorted(self.workers()))
-        for wid in worker_ids:
-            if wid in self.workers():
-                self.drain(wid, timeout_s=drain_timeout_s)
-            try:
-                self._fleet.restart_worker(wid, archive=archive,
-                                           version=version)
-                ready_s = self.await_ready(wid, timeout_s=ready_timeout_s)
-            finally:
-                self.readmit(wid)
-            report["workers"][wid] = {"ready_s": round(ready_s, 3)}
+        # the FULL path keys the claim: two different artifacts that
+        # happen to share a filename must be two different actions
+        action_id = (f"rolling_deploy:{os.path.abspath(archive)}"
+                     f":v{version}")
+        if self._config is not None:
+            if not self._config.try_claim(
+                    action_id, {"router": self.router_id,
+                                "archive": archive, "version": version}):
+                applied = self._config.applied(action_id)
+                logger.info("rolling deploy %s already applied by %s; "
+                            "skipping", action_id,
+                            (applied or {}).get("router"))
+                return {"archive": archive, "version": version,
+                        "skipped": True, "action_id": action_id,
+                        "applied_by": applied}
+        try:
+            prewarm = getattr(self._fleet, "prewarm_manifest", None)
+            if prewarm is not None:
+                prewarm(archive)
+            report: Dict[str, Any] = {"archive": archive, "workers": {}}
+            # deploy over the SUPERVISOR's full roster, not just the live
+            # views — a worker that is down mid-crash-relaunch right now
+            # must still be moved to the new archive, or it comes back on
+            # the old
+            worker_ids = (sorted(self._fleet.worker_ids())
+                          if hasattr(self._fleet, "worker_ids")
+                          else sorted(self.workers()))
+            for wid in worker_ids:
+                if wid in self.workers():
+                    self.drain(wid, timeout_s=drain_timeout_s)
+                try:
+                    self._fleet.restart_worker(wid, archive=archive,
+                                               version=version)
+                    ready_s = self.await_ready(wid,
+                                               timeout_s=ready_timeout_s)
+                finally:
+                    self.readmit(wid)
+                report["workers"][wid] = {"ready_s": round(ready_s, 3)}
+        except BaseException:
+            # a failed deploy must RELEASE its claim, or its own retry
+            # (from any router) is skipped forever as "already applied"
+            # while the fleet still runs the old archive
+            if self._config is not None:
+                try:
+                    self._config.release_claim(action_id)
+                except Exception:
+                    logger.exception("claim rollback failed for %s",
+                                     action_id)
+            raise
         self.metrics.record("deploys_total")
+        if self._config is not None:
+            try:
+                def fn(cfg):
+                    cfg["deploy"] = {"archive": archive, "version": version,
+                                     "router": self.router_id,
+                                     "action_id": action_id,
+                                     "completed_at": time.time()}
+                self._config.mutate(fn)
+            except Exception:
+                logger.exception("deploy-state publication failed")
         return report
 
     # ------------------------------------------- fleet scrape + trace merge
@@ -1252,14 +1402,40 @@ class FleetRouter:
             admittable = {wid: v.admittable(now)
                           for wid, v in self.workers().items()}
             ready = any(admittable.values())
-            return (200 if ready else 503), {"ready": ready,
-                                             "workers": admittable}
+            out = {"ready": ready, "router_id": self.router_id,
+                   "workers": admittable}
+            if self._peer_view:
+                # router-to-router peering (ISSUE 12): which peers this
+                # router last saw ready — readiness itself stays a
+                # function of OUR workers only
+                out["peers"] = {rid: p["ready"]
+                                for rid, p in self._peer_view.items()}
+            return (200 if ready else 503), out
+        if path == "/v1/peers":
+            # the peering view in full (ISSUE 12): peer addresses +
+            # last-probed readiness, and the shared-config health this
+            # router routes from
+            out = {"router_id": self.router_id,
+                   "peers": dict(self._peer_view)}
+            if self._config is not None:
+                try:
+                    out["config"] = self._config.counters()
+                except Exception:
+                    pass
+            return 200, out
         if path == "/fleet":
-            return 200, {
+            out = {
+                "router_id": self.router_id,
                 "workers": {wid: v.snapshot()
                             for wid, v in self.workers().items()},
                 "hedge_delay_ms": round(self.hedge_delay_s() * 1000.0, 3),
                 "metrics": self.metrics.snapshot()}
+            if self._config is not None:
+                try:
+                    out["config"] = self._config.counters()
+                except Exception:
+                    pass
+            return 200, out
         if path == "/v1/models" or path.startswith("/v1/models/"):
             # proxy the listing from the first admittable worker
             now = time.monotonic()

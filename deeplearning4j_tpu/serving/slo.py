@@ -191,6 +191,28 @@ class SLOMonitor:
             win.record(now_s, ok, slow)
 
     # -------------------------------------------------------------- reading
+    def recent_counts(self, model: str, seconds: int) -> list:
+        """Per-second request totals for ``model`` over the last
+        ``seconds`` FULL seconds, oldest first (the current partial
+        second is excluded — it systematically undercounts). This is the
+        short-horizon traffic-forecast feed (ISSUE 12): the autoscaler
+        fits a trend over these samples to pre-scale BEFORE a burn-rate
+        breach. Seconds with no traffic read 0; an untracked model reads
+        all zeros."""
+        seconds = max(1, min(int(seconds), self._horizon))
+        now_s = int(self._now_fn())
+        with self._lock:
+            win = self._models.get(str(model))
+            snap = win.snapshot() if win is not None else None
+        out = [0] * seconds
+        if snap is None:
+            return out
+        for i in range(snap.horizon):
+            age = now_s - snap.times[i]
+            if 1 <= age <= seconds:
+                out[seconds - age] += snap.total[i]
+        return out
+
     def report(self, models: Optional[Sequence[str]] = None
                ) -> Dict[str, Dict[str, Any]]:
         """Per-model, per-window attainment + burn rates.

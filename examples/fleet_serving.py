@@ -116,6 +116,41 @@ with supervisor:
         print(f"rolling deploy -> v2 live on every worker, zero downtime; "
               f"manifest-prewarmed readmission waits: {waits}")
         supervisor.check()  # no restart-budget escalation
+
+        # -------- replicated control plane (ISSUE 12): no single point
+        # of failure. A second router shares the fleet through a
+        # versioned config file, a MultiRouterClient round-robins with
+        # failover, and stopping one router cold is invisible to callers
+        # (docs/fleet_serving.md "Replicated control plane").
+        from deeplearning4j_tpu.serving import (FleetConfig,
+                                                MultiRouterClient)
+        config = FleetConfig(os.path.join(workdir, "fleet-config.json"))
+        config.set_workers(supervisor.endpoints())
+        router_b = FleetRouter(config, hedge_factor=0.5,
+                               hedge_initial_ms=60.0,
+                               probe_interval_s=0.1, router_id="rb")
+        port_b = router_b.start(0)
+        config.set_router("ra", f"127.0.0.1:{port}")
+        config.set_router("rb", f"127.0.0.1:{port_b}")
+        client = MultiRouterClient(config=config)
+        try:
+            for _ in range(N_REQUESTS // 4):
+                status, payload = client.predict("m", x[:1].tolist(),
+                                                 timeout_ms=15000)
+                assert status == 200
+            router.stop()  # one router dies: callers must not notice
+            for _ in range(N_REQUESTS // 4):
+                status, payload = client.predict("m", x[:1].tolist(),
+                                                 timeout_ms=15000)
+                assert status == 200 and np.array_equal(
+                    np.asarray(payload["outputs"], np.float32), oracle), \
+                    "failover response diverged from the oracle"
+            print(f"control plane -> router 'ra' stopped cold under "
+                  f"traffic: zero client-visible errors, "
+                  f"{client.snapshot()['failovers_total']} failover(s) "
+                  f"(shared config v{config.version})")
+        finally:
+            router_b.stop()
     finally:
         router.stop()
 print("done")

@@ -36,12 +36,14 @@ import _round_record  # noqa: E402  (sibling module; pytest puts this dir on sys
 # Thread names of the training pipeline's background stages (ISSUE 4),
 # the trace-collector fan-out fetchers (ISSUE 9: the router's /v1/traces
 # and fleet-/metrics aggregation joins its per-worker fetch threads before
-# returning), and the SLO autoscaler control thread (ISSUE 10:
-# SLOAutoscaler.stop() must join it). Every fit()/close()/aggregate/stop
-# path must join these; a survivor after a test means a leaked stage.
+# returning), the SLO autoscaler control thread (ISSUE 10:
+# SLOAutoscaler.stop() must join it), and the lease-election heartbeat
+# threads (ISSUE 12: LeaseElection.stop() must join its heartbeat). Every
+# fit()/close()/aggregate/stop path must join these; a survivor after a
+# test means a leaked stage.
 _PIPELINE_THREAD_NAMES = ("train-prefetch", "train-listener-delivery",
                           "async-dataset-iterator", "trace-collector",
-                          "slo-autoscaler")
+                          "slo-autoscaler", "lease-election")
 
 
 # --------------------------------------------------------------------------
@@ -196,3 +198,15 @@ def _no_orphaned_fleet_workers():
                                 "serving fleet",
                                 pid_fn="orphaned_worker_pids",
                                 kill_fn="kill_orphaned_workers")
+
+
+@pytest.fixture(autouse=True)
+def _no_orphaned_router_processes():
+    """ISSUE 12 guard: no router subprocess launched through
+    ``serving.control_plane`` outlives its RouterSupervisor — the same
+    contract as the fleet-worker guard, one tier up."""
+    yield
+    _assert_no_orphaned_workers("deeplearning4j_tpu.serving.control_plane",
+                                "router",
+                                pid_fn="orphaned_router_pids",
+                                kill_fn="kill_orphaned_routers")

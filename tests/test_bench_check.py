@@ -834,3 +834,130 @@ def test_check_tables_validates_paging_section(tmp_path):
     msgs = []
     assert bench.check_tables(str(md), str(extra), log=msgs.append) == 0
     assert any("paging" in m and "WARN" in m for m in msgs)
+
+
+# --------------------------------------------------------------- ISSUE 12
+def _control_plane_section():
+    """A self-consistent BENCH_EXTRA.json["control_plane"] section (the
+    ISSUE 12 replicated-control-plane drill record)."""
+    return {
+        "routers": 2,
+        "workers": 2,
+        "lease_s": 1.5,
+        "requests_total": 900,
+        "errors": 0,
+        "bit_identical": True,
+        "router_kill": {"victim": "r1", "errors": 0, "requests": 220,
+                        "relaunched_s": 6.2, "client_failovers": 4},
+        "traffic_step": {"step_factor": 10, "low_threads": 3,
+                         "high_threads": 30, "errors": 0,
+                         "requests": 500, "scaled_by": "r0",
+                         "predictive_signal": "queue",
+                         "burn_fast_at_decision": 0.0, "up_burn": 2.0,
+                         "breach_scaleups": 0, "replicas_before": 2,
+                         "replicas_after": 3},
+        "leader_kill": {"victim": "r0", "new_leader": "r1", "errors": 0,
+                        "requests": 180, "takeover_s": 1.9,
+                        "takeover_budget_s": 3.0,
+                        "elections_recorded": 3},
+        "exactly_once": {"applied_scaleups": 1, "replica_growth": 1,
+                         "follower_shadow_decisions": 2,
+                         "nonleader_applies": 0},
+    }
+
+
+def _extra_with_control_plane(section):
+    measured = {k: _mid(*rng) for k, rng in bench.RECORDED_RANGES.items()}
+    measured["control_plane"] = section
+    measured["control_plane_takeover_s"] = \
+        section["leader_kill"].get("takeover_s")
+    return measured
+
+
+def test_check_tables_validates_control_plane_section(tmp_path):
+    """ISSUE 12 satellite: --check-tables covers the control-plane keys —
+    a self-consistent drill record passes; client errors in any phase, a
+    non-bit-identical run, a single-router "replication" drill, a kill
+    absorbed with zero failovers, an at/after-breach "predictive"
+    scale-up, breach-triggered scale-ups, a step that never scaled,
+    double or non-leader lever applies, a missing follower shadow, an
+    over-budget takeover, zero recorded elections, or a stale top-level
+    takeover copy all fail loudly."""
+    md = tmp_path / "BASELINE.md"
+    md.write_text(_table_md(bench.RECORDED_RANGES))
+    extra = tmp_path / "BENCH_EXTRA.json"
+
+    extra.write_text(json.dumps(
+        _extra_with_control_plane(_control_plane_section())))
+    assert bench.check_tables(str(md), str(extra), log=lambda *a: None) == 0
+
+    def patched(path, value):
+        sec = _control_plane_section()
+        node = sec
+        for k in path[:-1]:
+            node = node[k]
+        node[path[-1]] = value
+        return sec
+
+    cases = [
+        (patched(("errors",), 3), "client-invisible"),
+        (patched(("bit_identical",), False), "bit-identical"),
+        (patched(("routers",), 1), ">= 2 routers"),
+        (patched(("router_kill", "errors"), 2), "must be 0"),
+        (patched(("traffic_step", "requests"), 0), "no recorded traffic"),
+        (patched(("router_kill", "client_failovers"), 0),
+         "never absorbed"),
+        (patched(("traffic_step", "burn_fast_at_decision"), 2.5),
+         "not pre-breach"),
+        (patched(("traffic_step", "breach_scaleups"), 2), "must be 0"),
+        (patched(("traffic_step", "predictive_signal"), "vibes"),
+         "unknown predictive signal"),
+        (patched(("traffic_step", "replicas_after"), 2), "never scaled"),
+        (patched(("exactly_once", "applied_scaleups"), 2),
+         "double (or phantom) lever"),
+        (patched(("exactly_once", "nonleader_applies"), 1),
+         "non-leader lever"),
+        (patched(("exactly_once", "follower_shadow_decisions"), 0),
+         "not computing"),
+        (patched(("leader_kill", "elections_recorded"), 0),
+         "no election events"),
+    ]
+    for sec, needle in cases:
+        extra.write_text(json.dumps(_extra_with_control_plane(sec)))
+        msgs = []
+        assert bench.check_tables(str(md), str(extra),
+                                  log=msgs.append) == 1, needle
+        assert any(needle in m for m in msgs), (needle, msgs)
+
+    # an over-budget takeover fails against its OWN recorded budget
+    sec = _control_plane_section()
+    sec["leader_kill"]["takeover_s"] = 5.0
+    extra.write_text(json.dumps(_extra_with_control_plane(sec)))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("over the recorded budget" in m for m in msgs)
+
+    # a missing required key is its own loud failure
+    sec = _control_plane_section()
+    del sec["exactly_once"]
+    extra.write_text(json.dumps(_extra_with_control_plane(sec)))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("control_plane.exactly_once" in m and "missing" in m
+               for m in msgs)
+
+    # stale top-level takeover copy
+    ex = _extra_with_control_plane(_control_plane_section())
+    ex["control_plane_takeover_s"] = 0.1
+    extra.write_text(json.dumps(ex))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("control_plane_takeover_s" in m and "top-level" in m
+               for m in msgs)
+
+    # absence is a warning (section not run), never a silent pass
+    measured = {k: _mid(*rng) for k, rng in bench.RECORDED_RANGES.items()}
+    extra.write_text(json.dumps(measured))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 0
+    assert any("control_plane" in m and "WARN" in m for m in msgs)

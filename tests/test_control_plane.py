@@ -1,0 +1,820 @@
+"""Replicated control plane tests (ISSUE 12): versioned shared fleet
+config, file-lease leader election, router-tier supervision, client-side
+router failover, predictive autoscaling signals, and multi-router
+consistency.
+
+Layers, cheapest first:
+
+- **Pure units** — ``FleetConfig`` atomics/versioning/exactly-once
+  claims, ``LeaseElection`` acquire/heartbeat/takeover/release,
+  ``forecast_rate`` trend math, ``SLOMonitor.recent_counts``.
+- **Chaos** — ``serving.router.config_load`` (corrupt/stale config
+  degrades to the last-valid snapshot with a loud counter, never a
+  crash) and ``serving.autoscale.lease`` (a hung heartbeat yields
+  leadership within one lease window).
+- **In-process routers over stub workers** — breaker warm-start from the
+  first ``/v1/metricsz`` scrape, idempotent config-versioned rolling
+  deploys (two routers, one applied deploy), multi-router consistency
+  (identical ``ranked_workers`` orders, shed-window agreement within one
+  probe interval, bit-identical responses for the same request stream).
+- **Subprocess router tier** — ``RouterSupervisor`` + ``router_main``
+  processes over the shared config: SIGKILL a router mid-load through a
+  ``MultiRouterClient`` with ZERO client-visible errors, watchdog
+  relaunch within budget, peering visible from the survivor.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.runtime.chaos import (AddLatency, ChaosController,
+                                              CorruptBytes, FailNth)
+from deeplearning4j_tpu.serving.autoscale import (AutoscalerConfig,
+                                                  SLOAutoscaler,
+                                                  forecast_rate)
+from deeplearning4j_tpu.serving.control_plane import (FleetConfig,
+                                                      LeaseElection,
+                                                      MultiRouterClient,
+                                                      RouterSpec,
+                                                      RouterSupervisor)
+from deeplearning4j_tpu.serving.resilience import CircuitState
+from deeplearning4j_tpu.serving.router import FleetRouter, StaticFleet
+from deeplearning4j_tpu.serving.slo import SLOMonitor, SLOTarget
+
+
+def _wait_until(pred, timeout_s=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+# ==========================================================================
+# stub worker: scripted, no jax (same idiom as test_router)
+class _StubWorker:
+    """A fake worker: ``/readyz`` 200, predict scripted via ``mode``
+    ("ok" | "shed"), optional ``/v1/metricsz`` breaker payload (the
+    warm-start seam)."""
+
+    def __init__(self, body=b'{"outputs": [[1.0]], "version": 1}',
+                 metricsz=None):
+        self.mode = "ok"
+        self.body = body
+        self.retry_after_ms = 500.0
+        self.metricsz = metricsz
+        self.hits = 0
+        self.lock = threading.Lock()
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code, payload, extra=None):
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                for k, v in (extra or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                if self.path == "/readyz":
+                    self._send(200, b'{"ready": true}')
+                elif self.path == "/v1/metricsz" and stub.metricsz \
+                        is not None:
+                    self._send(200, json.dumps(stub.metricsz).encode())
+                else:
+                    self._send(404, b'{}')
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                with stub.lock:
+                    stub.hits += 1
+                    mode = stub.mode
+                if mode == "shed":
+                    self._send(503, json.dumps(
+                        {"error": "overloaded",
+                         "retry_after_ms": stub.retry_after_ms}).encode(),
+                        extra={"Retry-After-Ms":
+                               f"{stub.retry_after_ms:.0f}"})
+                else:
+                    self._send(200, stub.body)
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        self.thread = threading.Thread(target=self.httpd.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    @property
+    def address(self):
+        return f"127.0.0.1:{self.port}"
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+# ==========================================================================
+# FleetConfig
+def test_fleet_config_versioned_atomic_roundtrip(tmp_path):
+    p = str(tmp_path / "fleet.json")
+    cfg = FleetConfig(p)
+    assert cfg.version == 0 and cfg.endpoints() == {}
+    cfg.set_workers({"w0": "127.0.0.1:1", "w1": "127.0.0.1:2"})
+    assert cfg.version == 1
+    # a second process (fresh object) sees the same roster + version
+    other = FleetConfig(p)
+    assert other.version == 1
+    assert other.endpoints() == {"w0": "127.0.0.1:1", "w1": "127.0.0.1:2"}
+    # unchanged roster writes nothing (no version churn)
+    cfg.set_workers({"w1": "127.0.0.1:2", "w0": "127.0.0.1:1"})
+    assert cfg.version == 1
+    # router roster round-trips too
+    cfg.set_router("r0", "127.0.0.1:9")
+    assert other.routers() == {"r0": "127.0.0.1:9"}
+    cfg.remove_router("r0")
+    assert other.routers() == {}
+
+
+def test_fleet_config_try_claim_exactly_once_across_instances(tmp_path):
+    p = str(tmp_path / "fleet.json")
+    a, b = FleetConfig(p), FleetConfig(p)
+    assert a.try_claim("deploy:v2", {"router": "a"}) is True
+    assert b.try_claim("deploy:v2", {"router": "b"}) is False
+    assert b.applied("deploy:v2")["router"] == "a"
+    assert a.try_claim("deploy:v3") is True
+
+
+def test_fleet_config_concurrent_mutations_all_land(tmp_path):
+    """N threads x M mutations through two instances: the lock file
+    serializes them, so the version advances by exactly N*M and every
+    key lands."""
+    p = str(tmp_path / "fleet.json")
+    configs = [FleetConfig(p), FleetConfig(p)]
+    n_threads, per_thread = 4, 8
+
+    def run(tid):
+        for k in range(per_thread):
+            def fn(cfg, tid=tid, k=k):
+                cfg["models"][f"t{tid}-{k}"] = {"v": k}
+            configs[tid % 2].mutate(fn)
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    final = FleetConfig(p).snapshot()
+    assert final["version"] == n_threads * per_thread
+    assert len(final["models"]) == n_threads * per_thread
+
+
+def test_fleet_config_corrupt_and_stale_degrade_to_last_valid(tmp_path):
+    p = str(tmp_path / "fleet.json")
+    cfg = FleetConfig(p)
+    cfg.set_workers({"w0": "127.0.0.1:1"})
+    good = cfg.endpoints()
+    # torn write: readers keep the last-valid snapshot, loudly
+    with open(p, "w") as f:
+        f.write('{"format": "dl4j-fleet-config-v1", "version": ')
+    assert cfg.endpoints() == good
+    assert cfg.counters()["load_failures_total"] == 1
+    # a blind overwrite that REGRESSES the version is stale, not truth
+    with open(p, "w") as f:
+        json.dump({"format": "dl4j-fleet-config-v1", "version": 0,
+                   "workers": {}}, f)
+    assert cfg.endpoints() == good
+    assert cfg.counters()["load_failures_total"] == 2
+    # a good write recovers without a restart
+    cfg.set_workers({"w9": "127.0.0.1:9"})
+    assert FleetConfig(p).endpoints() == {"w9": "127.0.0.1:9"}
+
+
+def test_fleet_config_chaos_load_fault_and_corruption(tmp_path):
+    """The ``serving.router.config_load`` chaos point: an injected load
+    fault or byte corruption degrades to the last-valid snapshot with
+    the counter bumped — never a raise on the read path."""
+    p = str(tmp_path / "fleet.json")
+    cfg = FleetConfig(p)
+    cfg.set_workers({"w0": "127.0.0.1:1"})
+    good = cfg.endpoints()
+    with ChaosController(seed=3) as c:
+        c.on("serving.router.config_load", FailNth(1, every=True))
+        # force a reload: the file changes under an always-failing point
+        FleetConfig(p).set_workers({"w0": "127.0.0.1:1",
+                                    "w1": "127.0.0.1:2"})
+        assert cfg.endpoints() == good  # degraded, not crashed
+        assert cfg.counters()["load_failures_total"] >= 1
+    # corruption flavour: bytes mangled between disk and parse
+    with ChaosController(seed=4) as c:
+        c.on("serving.router.config_load",
+             CorruptBytes(n_bytes=16, mode="truncate"))
+        fresh = FleetConfig(p, create=False)
+        assert fresh.endpoints() == {}  # nothing valid ever loaded...
+        assert fresh.counters()["load_failures_total"] >= 1
+    # ...and the same object recovers on the next clean read
+    fresh.set_router("r0", "127.0.0.1:5")  # mutate re-reads + rewrites
+    assert fresh.endpoints() == {"w0": "127.0.0.1:1", "w1": "127.0.0.1:2"}
+
+
+# ==========================================================================
+# LeaseElection
+def test_lease_acquire_heartbeat_takeover_release(tmp_path):
+    lease = str(tmp_path / "lease")
+    a = LeaseElection(lease, "r0", lease_s=0.4)
+    b = LeaseElection(lease, "r1", lease_s=0.4)
+    assert a.ensure() == "leader"
+    assert b.ensure() == "follower"
+    assert b.holder() == "r0"
+    # heartbeats keep the lease across a full window
+    for _ in range(4):
+        time.sleep(0.15)
+        assert a.ensure() == "leader"
+    assert b.ensure() == "follower"
+    # the leader dies (stops heartbeating): takeover after one window,
+    # with the fencing seq bumped
+    seq0 = b.snapshot()["seq"]
+    time.sleep(0.55)
+    assert b.ensure() == "leader"
+    assert b.snapshot()["seq"] == seq0 + 1
+    # the old leader observes the loss and steps down (never utimes the
+    # new holder's lease)
+    assert a.ensure() == "follower"
+    assert a.snapshot()["holder"] == "r1"
+    # voluntary release frees the lease immediately
+    b.release()
+    assert a.ensure() == "leader"
+    roles = [e["role"] for e in a.elections]
+    assert roles[-1] == "leader" and "follower" in roles
+
+
+def test_lease_release_by_follower_never_revokes_leader(tmp_path):
+    lease = str(tmp_path / "lease")
+    a = LeaseElection(lease, "r0", lease_s=5.0)
+    b = LeaseElection(lease, "r1", lease_s=5.0)
+    assert a.ensure() == "leader"
+    assert b.ensure() == "follower"
+    b.release()  # not the holder: must be a no-op
+    assert a.ensure() == "leader"
+    assert a.holder() == "r0"
+
+
+def test_lease_chaos_hung_heartbeat_yields_leadership(tmp_path):
+    """The ``serving.autoscale.lease`` chaos point: a heartbeat delayed
+    past the lease window (the hung-leader drill) lets a follower take
+    over; when the hung beat finally returns, the old leader re-reads
+    the lease, sees the new holder, and steps down WITHOUT touching the
+    file."""
+    lease = str(tmp_path / "lease")
+    a = LeaseElection(lease, "ra", lease_s=0.4)
+    b = LeaseElection(lease, "rb", lease_s=0.4)
+    assert a.ensure() == "leader"
+    assert b.ensure() == "follower"
+    with ChaosController(seed=1) as c:
+        c.on("serving.autoscale.lease", AddLatency(0.8))
+        done = threading.Event()
+
+        def hung_beat():
+            a.ensure()  # sleeps 0.8s inside the chaos point
+            done.set()
+
+        t = threading.Thread(target=hung_beat, daemon=True)
+        t.start()
+        assert _wait_until(lambda: b.ensure() == "leader", timeout_s=3.0), \
+            "follower never took over from the hung leader"
+        assert done.wait(5.0)
+        t.join(5.0)
+    # the old leader lost: stepped down, and rb's lease survived intact
+    assert a.role == "follower"
+    assert b.ensure() == "leader"
+    assert b.holder() == "rb"
+    assert any(e["reason"] == "lease_lost" for e in a.elections)
+
+
+def test_lease_heartbeat_thread_lifecycle(tmp_path):
+    lease = str(tmp_path / "lease")
+    a = LeaseElection(lease, "r0", lease_s=0.5)
+    with a:
+        assert _wait_until(a.is_leader, timeout_s=3.0)
+    # stop() released: the file is gone and the thread joined (the
+    # conftest lease-election thread guard watches the name prefix)
+    assert a.holder() is None
+
+
+# ==========================================================================
+# forecast + recent_counts
+def test_forecast_rate_trends():
+    # empty / flat / too-short: no trend
+    assert forecast_rate([], 10.0) == (0.0, 0.0, 0.0)
+    pred, slope, now = forecast_rate([5, 5, 5], 10.0)
+    assert slope == 0.0 and now == 5.0
+    pred, slope, now = forecast_rate([4.0] * 20, 15.0)
+    assert abs(slope) < 1e-9 and abs(pred - 4.0) < 1e-6
+    # a ramp extrapolates ahead of the current rate
+    ramp = [float(i) for i in range(20)]
+    pred, slope, now = forecast_rate(ramp, 15.0)
+    assert slope == pytest.approx(1.0)
+    assert pred == pytest.approx(19 + 15.0)
+    assert now == pytest.approx(np.mean(ramp[-5:]))
+    # a 10x step: positive slope, prediction well above current capacity
+    step = [1.0] * 15 + [10.0] * 5
+    pred, slope, now = forecast_rate(step, 15.0)
+    assert slope > 0 and now == pytest.approx(10.0) and pred > now
+    # a decaying series never predicts negative traffic
+    pred, slope, _ = forecast_rate([20.0 - i for i in range(20)], 60.0)
+    assert slope < 0 and pred == 0.0
+
+
+def test_slo_recent_counts_per_second_history():
+    clock = {"t": 1000.0}
+    mon = SLOMonitor(windows_s=(10, 60), now_fn=lambda: clock["t"])
+    for sec, n in ((1000, 2), (1001, 5), (1003, 1)):
+        clock["t"] = float(sec)
+        for _ in range(n):
+            mon.record("m", ok=True, latency_s=0.001)
+    clock["t"] = 1004.0
+    # seconds 999..1003 (current partial second 1004 excluded)
+    assert mon.recent_counts("m", 5) == [0, 2, 5, 0, 1]
+    assert mon.recent_counts("ghost", 5) == [0, 0, 0, 0, 0]
+    # clamped to the ring horizon, zero-padded on the old side
+    counts = mon.recent_counts("m", 600)
+    assert len(counts) == 60 and sum(counts) == 8
+
+
+# ==========================================================================
+# autoscaler: leadership + predictive signals (unit: fake router)
+class _FakeView:
+    def __init__(self, wid):
+        self.worker_id = wid
+        self.address = "127.0.0.1:1"
+
+    def admittable(self, now=None):
+        return True
+
+
+class _FakeRouter:
+    def __init__(self, slo):
+        self.slo = slo
+        self.view = _FakeView("w0")
+        self.autoscaler = None
+
+    def ranked_workers(self, model):
+        return [self.view]
+
+    def workers(self):
+        return {"w0": self.view}
+
+    def attach_autoscaler(self, a):
+        self.autoscaler = a
+
+
+def _capacity(replicas=1, queue_depth=0, queue_headroom=256,
+              busy_fraction=0.2):
+    # the fleet-aggregated schema fleet_capacity() produces
+    return {"workers": {"w0": {
+                "models": {"m": {"param_bytes": 100,
+                                 "model_state_bytes": 0,
+                                 "replicas": replicas,
+                                 "utilization": {"busy_fraction":
+                                                 busy_fraction},
+                                 "queue": {"depth": queue_depth,
+                                           "headroom_requests":
+                                           queue_headroom}}},
+                "totals": {"device_bytes": 100 * replicas},
+                "process": {"device_budget_bytes": None}}},
+            "models": {"m": {"param_bytes": 100, "replicas": replicas,
+                             "queue_depth": queue_depth,
+                             "queue_headroom_requests": queue_headroom,
+                             "busy_fraction": busy_fraction}},
+            "process": {}}
+
+
+def _controller(tmp_path=None, holder="r0", election=None, **cfg_kw):
+    clock = {"t": 1000.0}
+    slo = SLOMonitor(target=SLOTarget(availability=0.999, latency_ms=50.0,
+                                      latency_target=0.9),
+                     windows_s=(10, 60), now_fn=lambda: clock["t"])
+    router = _FakeRouter(slo)
+    state = {"replicas": 1, "levers": [],
+             "capacity": _capacity()}
+
+    def replica_lever(view, model, delta, span):
+        state["levers"].append(("delta", delta))
+        state["replicas"] = max(1, state["replicas"] + delta)
+        return True, {"replicas": state["replicas"]}
+
+    defaults = dict(fast_window_s=10, slow_window_s=60, up_burn=2.0,
+                    confirm_burn=1.0, down_burn=0.5, up_cooldown_s=5.0,
+                    down_cooldown_s=30.0, min_requests=4, max_replicas=4)
+    defaults.update(cfg_kw)
+    auto = SLOAutoscaler(router, config=AutoscalerConfig(**defaults),
+                         capacity_fn=lambda: state["capacity"],
+                         replica_lever=replica_lever,
+                         election=election,
+                         now_fn=lambda: clock["t"])
+    return auto, slo, state, clock
+
+
+def _feed(slo, n, ok=True, slow=False):
+    for _ in range(n):
+        slo.record("m", ok=ok, latency_s=0.2 if slow else 0.001)
+
+
+def test_follower_shadow_computes_but_never_acts(tmp_path):
+    lease = str(tmp_path / "lease")
+    ea = LeaseElection(lease, "ra", lease_s=30.0)
+    eb = LeaseElection(lease, "rb", lease_s=30.0)
+    auto_a, slo_a, state_a, _ = _controller(election=ea)
+    auto_b, slo_b, state_b, _ = _controller(election=eb)
+    for slo in (slo_a, slo_b):  # both see the same breach
+        _feed(slo, 20, ok=False)
+    da = auto_a.tick()
+    db = auto_b.tick()
+    # the leader scaled; every one of its decisions says so
+    assert [d["action"] for d in da] == ["scale_up_replica"]
+    assert da[0]["role"] == "leader" and da[0]["ok"]
+    assert state_a["levers"] == [("delta", 1)]
+    # the follower shadow-computed the SAME pressure but touched nothing
+    assert [d["action"] for d in db] == ["follower_scale_up"]
+    assert db[0]["role"] == "follower" and not db[0]["ok"]
+    assert state_b["levers"] == []
+    assert auto_a.report()["role"] == "leader"
+    assert auto_b.report()["role"] == "follower"
+    assert auto_b.report()["election"]["holder"] == "ra"
+
+
+def test_takeover_moves_the_acting_autoscaler(tmp_path):
+    lease = str(tmp_path / "lease")
+    ea = LeaseElection(lease, "ra", lease_s=0.3)
+    eb = LeaseElection(lease, "rb", lease_s=0.3)
+    auto_a, slo_a, state_a, _ = _controller(election=ea)
+    auto_b, slo_b, state_b, _ = _controller(election=eb)
+    _feed(slo_a, 20, ok=False)
+    _feed(slo_b, 20, ok=False)
+    assert [d["action"] for d in auto_a.tick()] == ["scale_up_replica"]
+    assert [d["action"] for d in auto_b.tick()] == ["follower_scale_up"]
+    # the leader dies (no more heartbeats); the follower's next tick
+    # past the lease window takes over and ACTS
+    time.sleep(0.45)
+    _feed(slo_b, 20, ok=False)
+    db = auto_b.tick()
+    assert state_b["levers"] == [("delta", 1)]
+    assert [d["action"] for d in db] == ["scale_up_replica"]
+    assert db[0]["role"] == "leader"
+    # the election itself is on the record
+    actions = [d["action"] for d in auto_b.report()["decisions"]]
+    assert "election_leader" in actions
+    assert auto_b.report()["election"]["role"] == "leader"
+
+
+def test_predictive_queue_pressure_scales_before_breach():
+    auto, slo, state, _ = _controller(queue_pressure=0.5)
+    # healthy traffic, zero burn — but the admission queue is backing up
+    _feed(slo, 20, ok=True)
+    state["capacity"] = _capacity(queue_depth=40, queue_headroom=24)
+    decisions = auto.tick()
+    assert [d["action"] for d in decisions] == ["scale_up_replica"]
+    d = decisions[0]
+    assert d["predictive"]["signal"] == "queue"
+    assert d["burn"]["burn_fast"] < auto.config.up_burn  # pre-breach
+    assert state["levers"] == [("delta", 1)]
+
+
+def test_predictive_forecast_scales_on_traffic_ramp():
+    auto, slo, state, clock = _controller(forecast_window_s=20,
+                                          forecast_horizon_s=15.0,
+                                          forecast_margin=1.2)
+    state["capacity"] = _capacity(busy_fraction=0.9)
+    # 15 s of 1 rps, then a 100x step over the last 5 s — all healthy
+    for sec in range(15):
+        clock["t"] = 1000.0 + sec
+        _feed(slo, 1)
+    for sec in range(15, 20):
+        clock["t"] = 1000.0 + sec
+        _feed(slo, 100)
+    clock["t"] = 1020.0
+    decisions = auto.tick()
+    assert [d["action"] for d in decisions] == ["scale_up_replica"]
+    sig = decisions[0]["predictive"]
+    assert sig["signal"] == "forecast"
+    assert sig["predicted_rate"] > sig["serveable_rate"] * 1.2
+    assert decisions[0]["burn"]["burn_fast"] < auto.config.up_burn
+
+
+def test_predictive_scheduled_window_needs_no_traffic():
+    now = time.time()
+    auto, slo, state, _ = _controller(
+        schedules=[{"model": "m", "start_ts": now - 1,
+                    "end_ts": now + 60}])
+    _feed(slo, 1)  # the model must exist in the report; no real traffic
+    decisions = auto.tick()
+    assert [d["action"] for d in decisions] == ["scale_up_replica"]
+    assert decisions[0]["predictive"]["signal"] == "schedule"
+
+
+def test_predictive_quiet_fleet_does_not_scale():
+    auto, slo, state, _ = _controller()
+    _feed(slo, 20, ok=True)  # healthy, no queue, flat traffic
+    assert auto.tick() == []
+    assert state["levers"] == []
+
+
+# ==========================================================================
+# breaker warm-start (satellite: a fresh router adopts the worker's verdict)
+def test_fresh_router_warm_starts_breaker_from_metricsz():
+    sick = _StubWorker(metricsz={"worker": "w0", "models": {
+        "m": {"breaker": {"state": "OPEN", "opens_total": 3},
+              "counters": {}}}})
+    healthy = _StubWorker(metricsz={"worker": "w1", "models": {
+        "m": {"breaker": {"state": "CLOSED", "opens_total": 0},
+              "counters": {}}}})
+    bare = _StubWorker()  # no metricsz at all (stub/old payload)
+    try:
+        router = FleetRouter(StaticFleet({"w0": sick.address,
+                                          "w1": healthy.address,
+                                          "w2": bare.address}),
+                             hedge_enabled=False)
+        router._probe_cycle()
+        views = router.workers()
+        assert views["w0"].breaker.state is CircuitState.OPEN
+        assert views["w1"].breaker.state is CircuitState.CLOSED
+        assert views["w2"].breaker.state is CircuitState.CLOSED
+        # warm-start is one-shot: the verdict was adopted, not subscribed
+        assert all(v.breaker_warmed for v in views.values())
+        # the isolated worker is not admittable until its breaker's own
+        # half-open probe path re-admits it
+        assert not views["w0"].admittable()
+        assert views["w1"].admittable()
+    finally:
+        for s in (sick, healthy, bare):
+            s.stop()
+
+
+# ==========================================================================
+# idempotent, config-versioned rolling deploys
+class _FakeDeployFleet:
+    def __init__(self, endpoints):
+        self._e = dict(endpoints)
+        self.restarts = []
+
+    def endpoints(self):
+        return dict(self._e)
+
+    def worker_ids(self):
+        return sorted(self._e)
+
+    def restart_worker(self, wid, archive=None, version=None):
+        self.restarts.append((wid, archive, version))
+
+
+def test_rolling_deploy_applies_exactly_once_across_routers(tmp_path):
+    stub = _StubWorker()
+    try:
+        cfg_path = str(tmp_path / "fleet.json")
+        config_a, config_b = FleetConfig(cfg_path), FleetConfig(cfg_path)
+        fleet_a = _FakeDeployFleet({"w0": stub.address})
+        fleet_b = _FakeDeployFleet({"w0": stub.address})
+        ra = FleetRouter(fleet_a, hedge_enabled=False, router_id="ra")
+        rb = FleetRouter(fleet_b, hedge_enabled=False, router_id="rb")
+        ra.attach_config(config_a)
+        rb.attach_config(config_b)
+        report_a = ra.rolling_deploy("model-v2.zip", version=2,
+                                     ready_timeout_s=10)
+        assert fleet_a.restarts == [("w0", "model-v2.zip", 2)]
+        assert "skipped" not in report_a
+        # the same deploy through the OTHER router: claimed already —
+        # skipped, no worker touched, the applier named
+        report_b = rb.rolling_deploy("model-v2.zip", version=2,
+                                     ready_timeout_s=10)
+        assert report_b["skipped"] is True
+        assert report_b["applied_by"]["router"] == "ra"
+        assert fleet_b.restarts == []
+        # the completed deploy state is in the shared config for all
+        assert config_b.snapshot()["deploy"]["archive"] == "model-v2.zip"
+        # a DIFFERENT version is a different action: it applies
+        report_b2 = rb.rolling_deploy("model-v3.zip", version=3,
+                                      ready_timeout_s=10)
+        assert "skipped" not in report_b2
+        assert fleet_b.restarts == [("w0", "model-v3.zip", 3)]
+    finally:
+        stub.stop()
+
+
+# ==========================================================================
+# multi-router consistency (satellite: shared-nothing routers agree)
+def test_two_routers_rank_identically_and_agree_on_shed(tmp_path):
+    stubs = [_StubWorker() for _ in range(4)]
+    try:
+        endpoints = {f"w{i}": s.address for i, s in enumerate(stubs)}
+        probe_s = 0.05
+        ra = FleetRouter(StaticFleet(endpoints), hedge_enabled=False,
+                         probe_interval_s=probe_s, router_id="ra")
+        rb = FleetRouter(StaticFleet(endpoints), hedge_enabled=False,
+                         probe_interval_s=probe_s, router_id="rb")
+        pa, pb = ra.start(0), rb.start(0)
+        try:
+            # rendezvous + placement determinism: identical orders for
+            # every model name, computed independently
+            for model in ("m", "alpha", "zoo/bert", "x" * 40):
+                assert [v.worker_id for v in ra.ranked_workers(model)] == \
+                       [v.worker_id for v in rb.ranked_workers(model)]
+            # one worker sheds: each router learns from ITS OWN traffic,
+            # and their shed windows agree within one probe interval
+            victim = ra.ranked_workers("m")[0].worker_id
+            stubs[int(victim[1:])].mode = "shed"
+            body = json.dumps({"inputs": [[1.0]]}).encode()
+            for port in (pa, pb):
+                urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{port}/v1/models/m/predict",
+                    data=body), timeout=10).read()
+            now = time.monotonic()
+            rem_a = ra.workers()[victim].shed_until - now
+            rem_b = rb.workers()[victim].shed_until - now
+            assert rem_a > 0 and rem_b > 0
+            assert abs(rem_a - rem_b) <= probe_s + 0.25
+            assert not ra.workers()[victim].admittable()
+            assert not rb.workers()[victim].admittable()
+        finally:
+            ra.stop()
+            rb.stop()
+    finally:
+        for s in stubs:
+            s.stop()
+
+
+def test_two_routers_serve_bit_identical_responses():
+    """The same request stream through two independent routers over real
+    workers returns byte-identical outputs (rendezvous agreement means
+    the same worker concentration; bit-identity means a client cannot
+    tell routers apart)."""
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.serving import ModelRegistry, ModelServer
+
+    def conf():
+        return (NeuralNetConfiguration.builder().seed(7).updater(None)
+                .list()
+                .layer(DenseLayer(n_out=16, activation="tanh"))
+                .layer(OutputLayer(n_out=4, activation="softmax"))
+                .set_input_type(InputType.feed_forward(8)).build())
+
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(8, 8)).astype(np.float32)
+    kw = dict(max_batch_size=4, buckets=[1, 4], batch_timeout_ms=1.0,
+              pipeline_depth=0)
+    servers = []
+    for wid in range(2):
+        reg = ModelRegistry()
+        reg.register("m", MultiLayerNetwork(conf()).init(),
+                     warmup_example=xs[:1], **kw)
+        srv = ModelServer(reg, worker_id=f"w{wid}")
+        srv.start(0)
+        servers.append(srv)
+    endpoints = {f"w{i}": f"127.0.0.1:{s.port}"
+                 for i, s in enumerate(servers)}
+    ra = FleetRouter(StaticFleet(endpoints), hedge_enabled=False)
+    rb = FleetRouter(StaticFleet(endpoints), hedge_enabled=False)
+    pa, pb = ra.start(0), rb.start(0)
+    try:
+        for k in range(8):
+            n, ofs = 1 + k % 4, k % 4
+            outs = []
+            for port in (pa, pb):
+                body = json.dumps({"inputs": xs[ofs:ofs + n].tolist(),
+                                   "timeout_ms": 10000}).encode()
+                resp = urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{port}/v1/models/m/predict",
+                    data=body), timeout=30)
+                outs.append(np.asarray(
+                    json.loads(resp.read())["outputs"], np.float32))
+            assert np.array_equal(outs[0], outs[1]), \
+                f"routers disagreed on request {k}"
+    finally:
+        ra.stop()
+        rb.stop()
+        for s in servers:
+            s.stop(shutdown_registry=True)
+
+
+# ==========================================================================
+# MultiRouterClient failover (in-process routers)
+def test_multi_router_client_round_robin_and_failover():
+    stub = _StubWorker()
+    ra = FleetRouter(StaticFleet({"w0": stub.address}),
+                     hedge_enabled=False, probe_interval_s=0.05)
+    rb = FleetRouter(StaticFleet({"w0": stub.address}),
+                     hedge_enabled=False, probe_interval_s=0.05)
+    pa, pb = ra.start(0), rb.start(0)
+    client = MultiRouterClient(endpoints=[f"127.0.0.1:{pa}",
+                                          f"127.0.0.1:{pb}"])
+    try:
+        for _ in range(6):
+            status, payload = client.predict("m", [[1.0]],
+                                             timeout_ms=5000)
+            assert status == 200 and payload["outputs"] == [[1.0]]
+        snap = client.snapshot()
+        assert snap["failovers_total"] == 0
+        assert set(snap["router_requests"]) == {f"127.0.0.1:{pa}",
+                                                f"127.0.0.1:{pb}"}
+        # one router dies: every request still lands, via failover
+        ra.stop()
+        for _ in range(6):
+            status, payload = client.predict("m", [[1.0]],
+                                             timeout_ms=5000)
+            assert status == 200 and payload["outputs"] == [[1.0]]
+        assert client.snapshot()["failovers_total"] >= 3
+    finally:
+        ra.stop()
+        rb.stop()
+        stub.stop()
+
+
+# ==========================================================================
+# subprocess router tier: SIGKILL drill through the supervisor
+def test_router_supervisor_sigkill_drill_zero_client_errors(tmp_path):
+    """The production topology, miniaturized: 2 supervised router
+    PROCESSES over a shared config fronting stub workers. SIGKILL one
+    router mid-load through a ``MultiRouterClient`` -> zero
+    client-visible errors; the watchdog relaunches it within budget and
+    it re-registers; the survivor's peering saw the death."""
+    stubs = [_StubWorker() for _ in range(2)]
+    cfg_path = str(tmp_path / "fleet.json")
+    config = FleetConfig(cfg_path)
+    config.set_workers({f"w{i}": s.address for i, s in enumerate(stubs)})
+    specs = [RouterSpec(router_id=f"r{i}", config_path=cfg_path,
+                        router_kw={"hedge_enabled": False,
+                                   "probe_interval_s": 0.1})
+             for i in range(2)]
+    sup = RouterSupervisor(specs, run_dir=str(tmp_path / "run"),
+                           max_restarts=4, heartbeat_timeout_s=60.0)
+    try:
+        sup.start()
+        assert _wait_until(lambda: len(config.routers()) == 2,
+                           timeout_s=30), "routers never registered"
+        client = MultiRouterClient(config=config)
+        outcomes = []
+        stop = threading.Event()
+        lock = threading.Lock()
+
+        def client_loop():
+            while not stop.is_set():
+                try:
+                    status, payload = client.predict("m", [[1.0]],
+                                                     timeout_ms=8000)
+                    rec = ("ok" if status == 200 and
+                           payload.get("outputs") == [[1.0]]
+                           else f"bad:{status}")
+                except Exception as e:
+                    rec = f"error:{type(e).__name__}"
+                with lock:
+                    outcomes.append(rec)
+                time.sleep(0.01)
+
+        threads = [threading.Thread(target=client_loop, daemon=True)
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)  # steady state
+        victim = sup.router_ids()[0]
+        sup.kill_router(victim)
+        time.sleep(1.5)  # sustained load across the death + failover
+        # the watchdog relaunches the victim and it re-registers
+        assert _wait_until(lambda: len(sup.endpoints()) == 2,
+                           timeout_s=60), "router not relaunched"
+        assert _wait_until(lambda: len(config.routers()) == 2,
+                           timeout_s=30), "router never re-registered"
+        sup.check()  # within the restart budget
+        time.sleep(0.3)
+        stop.set()
+        for t in threads:
+            t.join(30)
+        bad = [o for o in outcomes if o != "ok"]
+        assert outcomes and not bad, \
+            f"{len(bad)}/{len(outcomes)} client-visible failures: {bad[:5]}"
+        assert client.snapshot()["failovers_total"] >= 1
+        # the survivor's peering observed the topology the whole time
+        survivor = [r for r in sup.router_ids() if r != victim][0]
+        addr = config.routers()[survivor]
+        peers = json.loads(urllib.request.urlopen(
+            f"http://{addr}/v1/peers", timeout=10).read())
+        assert peers["router_id"] == survivor
+        assert victim in peers["peers"]
+    finally:
+        sup.stop()
+        for s in stubs:
+            s.stop()
+    # graceful stop deregistered both routers from the shared config
+    assert _wait_until(lambda: config.routers() == {}, timeout_s=10)

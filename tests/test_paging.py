@@ -899,3 +899,122 @@ def test_cold_model_detail_endpoint_not_404(archives):
     finally:
         srv.stop()
         reg.shutdown()
+
+
+# ==========================================================================
+# int8 residency in eviction scoring (ISSUE 12 satellite; ROADMAP item 3
+# headroom): retention weights run on the policy's ACTUAL per-dtype
+# device bytes, so a 4x-denser quantized model is 4x cheaper to keep
+def test_dtype_density_follows_residency_policy():
+    from deeplearning4j_tpu.serving.quantize import DtypePolicy
+    assert paging.dtype_density(None) == 1.0
+    # dequantized residency mints f32 device copies: density 1.0 no
+    # matter how small the archive is
+    assert paging.dtype_density(
+        DtypePolicy(weight_residency="dequantized")) == 1.0
+    # int8 residency keeps 1-byte weights on device: 4x denser
+    assert paging.dtype_density(
+        DtypePolicy(weight_residency="int8", weight_dtype="int8")) == 0.25
+
+
+def test_policy_adjusted_archive_bytes(tmp_path):
+    from deeplearning4j_tpu.serving.quantize import DtypePolicy, policy_path
+    plain = str(tmp_path / "plain.zip")
+    open(plain, "wb").write(b"x" * 1000)
+    # no sidecar: file size stands
+    assert paging.policy_adjusted_archive_bytes(plain, 1000) == 1000
+    # dequantized residency: the int8 archive pages in as f32 device
+    # copies — the estimate must inflate ~4x, or the budget over-admits
+    deq = str(tmp_path / "deq.zip")
+    open(deq, "wb").write(b"x" * 1000)
+    DtypePolicy(weight_residency="dequantized",
+                weight_dtype="int8").save(policy_path(deq))
+    assert paging.policy_adjusted_archive_bytes(deq, 1000) == 4000
+    # int8 residency: archive dtype IS the device dtype — file size holds
+    res = str(tmp_path / "res.zip")
+    open(res, "wb").write(b"x" * 1000)
+    DtypePolicy(weight_residency="int8",
+                weight_dtype="int8").save(policy_path(res))
+    assert paging.policy_adjusted_archive_bytes(res, 1000) == 1000
+
+
+def test_register_cold_estimate_is_policy_aware(tmp_path, archives):
+    """A cold-registered dequantized-residency archive reserves ~4x its
+    file size (its page-in mints f32 copies); an int8-residency twin
+    reserves its file size."""
+    from deeplearning4j_tpu.serving.quantize import DtypePolicy, policy_path
+    paths, _ = archives
+    import shutil
+    deq = str(tmp_path / "deq.zip")
+    shutil.copyfile(paths[0], deq)
+    DtypePolicy(weight_residency="dequantized",
+                weight_dtype="int8").save(policy_path(deq))
+    res8 = str(tmp_path / "res8.zip")
+    shutil.copyfile(paths[0], res8)
+    DtypePolicy(weight_residency="int8",
+                weight_dtype="int8").save(policy_path(res8))
+    reg = ModelRegistry()
+    try:
+        size = os.path.getsize(deq)
+        r_deq = reg.register_cold("deq", deq)
+        r_res = reg.register_cold("res8", res8)
+        assert r_deq.bytes == 4 * size
+        assert r_res.bytes == os.path.getsize(res8)
+        assert r_deq.bytes_estimated and r_res.bytes_estimated
+    finally:
+        reg.shutdown()
+
+
+def test_retention_runs_on_measured_dtype_bytes():
+    """Two residency records with equal traffic and risk: the one whose
+    MEASURED per-dtype bytes are int8 (4x smaller) has 4x the retention
+    weight — evicted last. The dtype breakdown rides the snapshot."""
+    now = 1000.0
+    f32 = paging.Residency("f32")
+    q8 = paging.Residency("q8")
+    for r in (f32, q8):
+        r.risk = 0.5
+        r.ewma.update(now)
+    f32.bytes = 4000
+    f32.dtype_bytes = {"float32": 4000}
+    q8.bytes = 1000
+    q8.dtype_bytes = {"int8": 900, "float32": 100}
+    assert q8.retention(now) == pytest.approx(4 * f32.retention(now))
+    snap = q8.snapshot(now)
+    assert snap["dtype_bytes"] == {"int8": 900, "float32": 100}
+    assert snap["retention_weight"] == pytest.approx(q8.retention(now))
+    # the scalar estimate is the fallback while unmeasured
+    cold = paging.Residency("cold")
+    cold.bytes = 2000
+    cold.ewma.update(now)
+    cold.risk = 1.0
+    assert cold.retention(now) == pytest.approx(
+        paging.retention_weight(2000, cold.ewma.rate(now), 1.0))
+
+
+def test_registry_records_dtype_bytes_and_evicts_f32_first(archives):
+    """End to end through the registry: measured residency carries the
+    per-dtype breakdown, and under pressure the f32 model is the victim
+    over an equally-trafficked 4x-denser entry (simulated via the
+    recorded dtype bytes)."""
+    paths, _ = archives
+    per = _per_model_bytes(archives)
+    reg = ModelRegistry(hbm_budget_bytes=3 * per)
+    try:
+        a = reg.load("a", paths[0], **KW)
+        b = reg.load("b", paths[1], **KW)
+        snap = reg.residency_snapshot()
+        for name in ("a", "b"):
+            d = snap["models"][name]["dtype_bytes"]
+            assert sum(d.values()) == snap["models"][name]["bytes"]
+            assert all(v > 0 for v in d.values())
+        # equal traffic; shrink "b"'s recorded footprint to the 4x-dense
+        # int8 shape — "a" (f32, more bytes freed per unit of pain) must
+        # be the victim
+        with reg._lock:
+            resb = reg._residency["b"]
+            resb.dtype_bytes = {"int8": max(1, resb.bytes // 4)}
+        victim = reg._pick_victim_locked()
+        assert victim == "a"
+    finally:
+        reg.shutdown()
