@@ -40,4 +40,11 @@ Top-level layout (mirrors SURVEY.md §2's component inventory):
 
 __version__ = "0.1.0"
 
+# Opt-in runtime lock-order witness (ISSUE 14). MUST run before any other
+# package import so module-level locks are constructed through the
+# patched factories; fleet worker subprocesses inherit the env var, so a
+# drill's whole process tree is witnessed. No-op unless DL4J_TPU_LOCKDEP=1.
+from deeplearning4j_tpu.analysis import lockdep as _lockdep
+_lockdep.enable_from_env()
+
 from deeplearning4j_tpu.runtime import environment as _environment  # noqa: F401

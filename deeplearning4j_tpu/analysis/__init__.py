@@ -1,0 +1,21 @@
+"""Static + runtime concurrency analysis (ISSUE 14).
+
+Two halves, one contract — the fleet's thread discipline is machine
+checked, not reviewed by hand:
+
+- :mod:`deeplearning4j_tpu.analysis.lockdep` — the runtime lock-order
+  witness (``DL4J_TPU_LOCKDEP=1``): named lock proxies, the acquisition-
+  order graph, cycle / blocking-while-holding / waits-while-holding
+  detection, ``lockdep_allow.toml`` as the reviewed allowlist.
+- :mod:`deeplearning4j_tpu.analysis.lint` — the AST project-invariant
+  linter (``python -m deeplearning4j_tpu.analysis``): thread naming,
+  ``# guards:`` lock declarations, chaos-point registry/doc/test parity,
+  route + metric documentation, wallclock bans in trajectory modules.
+
+The registries both halves (and conftest) share live in
+:mod:`deeplearning4j_tpu.analysis.registry`. The playbook is
+``docs/static_analysis.md``; this package plays the role TSan/sanitizer
+builds play for libnd4j in the reference (``docs/parity.md``).
+"""
+
+from deeplearning4j_tpu.analysis import lockdep, registry  # noqa: F401

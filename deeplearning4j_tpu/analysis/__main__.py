@@ -1,0 +1,36 @@
+"""``python -m deeplearning4j_tpu.analysis`` — run the project lint.
+
+Exit status 1 on any finding (CI-friendly); ``--json`` emits the
+machine-readable findings list the driver tooling consumes.
+"""
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m deeplearning4j_tpu.analysis",
+        description="Project concurrency/observability invariant lint")
+    ap.add_argument("--json", action="store_true",
+                    help="emit machine-readable findings JSON")
+    ap.add_argument("--root", default=None,
+                    help="package root to lint (default: the installed "
+                         "deeplearning4j_tpu package)")
+    args = ap.parse_args(argv)
+
+    from deeplearning4j_tpu.analysis import lint
+
+    if args.root:
+        findings = lint.run_lint(package_root=args.root)
+    else:
+        findings = lint.run_lint()
+    if args.json:
+        print(lint.to_json(findings))
+    else:
+        print(lint.render(findings))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

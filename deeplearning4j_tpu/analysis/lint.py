@@ -1,0 +1,647 @@
+"""AST-based project-invariant linter (stdlib ``ast``, no new deps).
+
+The repo's concurrency and observability discipline lives in conventions
+the review rounds kept re-checking by hand. This linter turns each into a
+machine-checked invariant (run as the tier-1 test
+``tests/test_analysis.py::test_repo_is_clean`` and as
+``python -m deeplearning4j_tpu.analysis``):
+
+- **THREAD-UNNAMED / THREAD-UNREGISTERED** — every ``threading.Thread``
+  is named, and the name's static prefix is registered in
+  ``analysis/registry.py:THREAD_NAME_PREFIXES`` (conftest's leak guard
+  imports the same registry, so the two can never drift).
+- **LOCK-UNDECLARED / GUARD-VIOLATION** — every ``threading.Lock`` /
+  ``RLock`` / ``Condition`` assigned to an attribute carries an adjacent
+  ``# guards:`` declaration, and no declared-guarded attribute is touched
+  outside a ``with`` on its lock within the same class (intraprocedural;
+  ``__init__`` is exempt — the object is not shared yet — and a method
+  annotated ``# holds: <lock>`` declares its callers hold the lock).
+- **CHAOS-UNREGISTERED / CHAOS-STALE / CHAOS-UNDOCUMENTED /
+  CHAOS-UNTESTED** — every chaos point fired in code exists in
+  ``runtime/chaos.py:REGISTERED_POINTS``, every registered point is
+  fired somewhere, has a ``docs/robustness.md`` row, and appears in at
+  least one test.
+- **ROUTE-UNDOCUMENTED** — every ``/v1/*`` route string appears in
+  ``docs/observability.md`` (placeholders normalised to ``<name>``).
+- **METRIC-UNDOCUMENTED / METRIC-NAMESPACE** — every Prometheus series
+  the package renders (recognised by the ``name{labels} value`` /
+  ``# TYPE name`` emission shape) is namespaced per
+  ``registry.METRIC_NAMESPACES`` and documented in
+  ``docs/observability.md``.
+- **WALLCLOCK** — no ``time.time()`` / ``time.time_ns()`` and no stdlib
+  ``random`` in trajectory-affecting modules
+  (``registry.TRAJECTORY_MODULES``): inject a clock/RNG instead. Escape
+  hatch for reviewed exceptions: ``# lint: wallclock-ok (<why>)`` on the
+  line.
+
+See ``docs/static_analysis.md`` for how to read findings and when an
+allowlist/escape is acceptable.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from deeplearning4j_tpu.analysis.registry import (
+    METRIC_NAMESPACES,
+    PIPELINE_THREAD_NAMES,
+    THREAD_NAME_PREFIXES,
+    TRAJECTORY_MODULES,
+)
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO_ROOT = os.path.dirname(_PKG_ROOT)
+
+_PH = "\x00"  # placeholder marker for f-string holes in templates
+
+
+class Finding:
+    def __init__(self, code: str, path: str, line: int, message: str):
+        self.code = code
+        self.path = path
+        self.line = int(line)
+        self.message = message
+
+    def __repr__(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"code": self.code, "path": self.path, "line": self.line,
+                "message": self.message}
+
+
+# --------------------------------------------------------------------- utils
+def _template(node: ast.AST) -> Optional[str]:
+    """A string Constant, or a JoinedStr flattened with ``\\x00`` marking
+    each formatted hole — the shape checks run over this template."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append(_PH)
+        return "".join(parts)
+    return None
+
+
+def _parents(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    par: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            par[child] = node
+    return par
+
+
+def _enclosing_function(node: ast.AST, par) -> Optional[ast.AST]:
+    cur = par.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = par.get(cur)
+    return None
+
+
+def _is_threading_attr(func: ast.AST, names: Sequence[str]) -> Optional[str]:
+    if (isinstance(func, ast.Attribute) and func.attr in names
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "threading"):
+        return func.attr
+    return None
+
+
+class _FileCtx:
+    """One parsed file plus the comment-aware source-line helpers."""
+
+    def __init__(self, rel_path: str, source: str):
+        self.rel_path = rel_path
+        self.source = source
+        self.lines = source.split("\n")
+        self.tree = ast.parse(source)
+        self.par = _parents(self.tree)
+
+    def line(self, n: int) -> str:
+        return self.lines[n - 1] if 1 <= n <= len(self.lines) else ""
+
+    def adjacent(self, n: int) -> str:
+        """The line, the line above and the line below — the window a
+        declaration comment may live in."""
+        return "\n".join(self.line(i) for i in (n - 1, n, n + 1))
+
+
+# ------------------------------------------------------------ thread naming
+def _resolve_str_prefix(node: ast.AST, ctx: _FileCtx,
+                        depth: int = 0) -> Optional[str]:
+    """Best-effort static prefix of a string expression: constants,
+    f-string heads, ``%``/``+`` left sides, and simple Name resolution
+    through enclosing-function locals and parameter defaults."""
+    if depth > 4 or node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        if not node.values:
+            return None
+        head = node.values[0]
+        if isinstance(head, ast.Constant) and isinstance(head.value, str):
+            return head.value
+        if isinstance(head, ast.FormattedValue):
+            return _resolve_str_prefix(head.value, ctx, depth + 1)
+        return None
+    if isinstance(node, ast.BinOp) and isinstance(node.op, (ast.Add, ast.Mod)):
+        return _resolve_str_prefix(node.left, ctx, depth + 1)
+    if isinstance(node, ast.Name):
+        fn = _enclosing_function(node, ctx.par)
+        # parameter default
+        while fn is not None:
+            args = fn.args
+            pos = args.posonlyargs + args.args
+            defaults = args.defaults
+            for a, d in zip(pos[len(pos) - len(defaults):], defaults):
+                if a.arg == node.id:
+                    return _resolve_str_prefix(d, ctx, depth + 1)
+            for a, d in zip(args.kwonlyargs, args.kw_defaults):
+                if a.arg == node.id and d is not None:
+                    return _resolve_str_prefix(d, ctx, depth + 1)
+            # local assignment inside the function
+            for sub in ast.walk(fn):
+                if (isinstance(sub, ast.Assign)
+                        and any(isinstance(t, ast.Name) and t.id == node.id
+                                for t in sub.targets)):
+                    return _resolve_str_prefix(sub.value, ctx, depth + 1)
+            fn = _enclosing_function(fn, ctx.par)
+        # module-level constant
+        for sub in ctx.tree.body:
+            if (isinstance(sub, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == node.id
+                            for t in sub.targets)):
+                return _resolve_str_prefix(sub.value, ctx, depth + 1)
+    return None
+
+
+def check_thread_names(ctx: _FileCtx,
+                       prefixes: Sequence[str] = THREAD_NAME_PREFIXES
+                       ) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_threading_attr(node.func, ("Thread",)) is None:
+            continue
+        name_kw = next((k.value for k in node.keywords if k.arg == "name"),
+                       None)
+        if name_kw is None:
+            out.append(Finding(
+                "THREAD-UNNAMED", ctx.rel_path, node.lineno,
+                "threading.Thread without name= — every thread must carry "
+                "a registered name (analysis/registry.py)"))
+            continue
+        prefix = _resolve_str_prefix(name_kw, ctx)
+        if prefix is None:
+            out.append(Finding(
+                "THREAD-UNREGISTERED", ctx.rel_path, node.lineno,
+                "thread name is not statically resolvable — use a constant "
+                "or f-string with a registered constant prefix"))
+            continue
+        if not any(prefix.startswith(p) for p in prefixes):
+            out.append(Finding(
+                "THREAD-UNREGISTERED", ctx.rel_path, node.lineno,
+                f"thread name prefix {prefix!r} is not registered in "
+                f"analysis/registry.py:THREAD_NAME_PREFIXES"))
+    return out
+
+
+# --------------------------------------------------------- lock declarations
+_GUARDS_RE = re.compile(r"#\s*guards:\s*(.+?)\s*$", re.M)
+_HOLDS_RE = re.compile(r"#\s*holds:\s*([\w, ]+)")
+
+
+def _lock_assignments(ctx: _FileCtx):
+    """Yield (assign_node, owner, attr, kind) for every
+    ``<target> = threading.Lock()/RLock()/Condition()`` in the file.
+    owner is the ClassDef for ``self.X`` targets, None for module/local."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        call = node.value
+        if not isinstance(call, ast.Call):
+            continue
+        kind = _is_threading_attr(call.func, ("Lock", "RLock", "Condition"))
+        if kind is None:
+            continue
+        tgt = node.targets[0]
+        owner = None
+        if (isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"):
+            cur = ctx.par.get(node)
+            while cur is not None and not isinstance(cur, ast.ClassDef):
+                cur = ctx.par.get(cur)
+            owner = cur
+            attr = tgt.attr
+        elif isinstance(tgt, ast.Name):
+            attr = tgt.id
+        else:
+            continue
+        yield node, owner, attr, kind
+
+
+def _parse_guards(decl: str) -> List[str]:
+    """``# guards: _a, _b`` -> ["_a", "_b"]; ``# guards: (free text)`` ->
+    [] (declared, but no machine-checkable attribute mapping)."""
+    decl = decl.strip()
+    if decl.startswith("("):
+        return []
+    return [a.strip() for a in decl.split(",") if a.strip()]
+
+
+def check_lock_guards(ctx: _FileCtx) -> List[Finding]:
+    out: List[Finding] = []
+    # class -> {lock_attr: [guarded attrs]}
+    class_locks: Dict[ast.ClassDef, Dict[str, List[str]]] = {}
+    for node, owner, attr, kind in _lock_assignments(ctx):
+        window = ctx.adjacent(node.lineno)
+        m = _GUARDS_RE.search(window)
+        if m is None:
+            out.append(Finding(
+                "LOCK-UNDECLARED", ctx.rel_path, node.lineno,
+                f"threading.{kind} assigned to {attr!r} without an adjacent "
+                f"'# guards:' declaration (list the attributes it guards, "
+                f"or '# guards: (<what invariant it protects>)')"))
+            continue
+        if owner is not None:
+            class_locks.setdefault(owner, {})[attr] = \
+                _parse_guards(m.group(1))
+
+    for cls, locks in class_locks.items():
+        guarded: Dict[str, str] = {}      # attr -> lock attr
+        for lock_attr, attrs in locks.items():
+            for a in attrs:
+                guarded[a] = lock_attr
+        if not guarded:
+            continue
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if meth.name == "__init__":
+                continue
+            held: Set[str] = set()
+            m = _HOLDS_RE.search(ctx.adjacent(meth.lineno))
+            if m:
+                held |= {h.strip() for h in m.group(1).split(",") if h.strip()}
+            out.extend(_check_method_guards(ctx, cls, meth, guarded, held))
+    return out
+
+
+def _with_locks(node: ast.With) -> Set[str]:
+    got: Set[str] = set()
+    for item in node.items:
+        e = item.context_expr
+        if (isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name)
+                and e.value.id == "self"):
+            got.add(e.attr)
+    return got
+
+
+def _check_method_guards(ctx: _FileCtx, cls: ast.ClassDef, meth,
+                         guarded: Dict[str, str],
+                         held: Set[str]) -> List[Finding]:
+    out: List[Finding] = []
+
+    def visit(node, held_now: Set[str]):
+        if isinstance(node, ast.With):
+            held_now = held_now | _with_locks(node)
+        elif (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self" and node.attr in guarded
+                and guarded[node.attr] not in held_now):
+            if "# unguarded-ok" not in ctx.line(node.lineno):
+                out.append(Finding(
+                    "GUARD-VIOLATION", ctx.rel_path, node.lineno,
+                    f"{cls.name}.{meth.name} touches self.{node.attr} "
+                    f"outside 'with self.{guarded[node.attr]}' (declared "
+                    f"'# guards:' on that lock); annotate the def with "
+                    f"'# holds: {guarded[node.attr]}' if callers hold it"))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held_now)
+
+    for stmt in meth.body:
+        visit(stmt, set(held))
+    return out
+
+
+# ----------------------------------------------------------------- chaos
+def parse_registered_points(chaos_source: str) -> Dict[str, str]:
+    tree = ast.parse(chaos_source)
+    for node in tree.body:
+        if isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        elif isinstance(node, ast.Assign):
+            targets = node.targets
+        else:
+            continue
+        if (len(targets) == 1 and isinstance(targets[0], ast.Name)
+                and targets[0].id == "REGISTERED_POINTS"
+                and isinstance(node.value, ast.Dict)):
+            points = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                if (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)):
+                    points[k.value] = v.value
+            return points
+    return {}
+
+
+def collect_fired_points(ctx: _FileCtx) -> List[Tuple[str, int]]:
+    """Chaos points fired in this file: first args of
+    ``chaos.inject(...)`` / ``chaos.transform_bytes(...)`` calls (module
+    alias or bare ``inject``/``transform_bytes`` imported names),
+    constants resolved through module-level names."""
+    fired: List[Tuple[str, int]] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        f = node.func
+        is_point_call = (
+            (isinstance(f, ast.Attribute)
+             and f.attr in ("inject", "transform_bytes")
+             and isinstance(f.value, ast.Name) and f.value.id == "chaos")
+            or (isinstance(f, ast.Name)
+                and f.id in ("inject", "transform_bytes")))
+        if not is_point_call:
+            continue
+        val = _resolve_str_prefix(node.args[0], ctx)
+        if isinstance(node.args[0], ast.Constant) or val is not None:
+            if val:
+                fired.append((val, node.lineno))
+    return fired
+
+
+# ----------------------------------------------------------------- routes
+def collect_routes(ctx: _FileCtx) -> List[Tuple[str, int]]:
+    routes: List[Tuple[str, int]] = []
+    for node in ast.walk(ctx.tree):
+        t = _template(node)
+        if t is None or not t.startswith("/v1/"):
+            continue
+        norm = t.split("?", 1)[0]
+        norm = norm.replace(_PH, "<name>").rstrip("/")
+        if norm:
+            routes.append((norm, node.lineno))
+    return routes
+
+
+# ---------------------------------------------------------------- metrics
+_METRIC_HEAD = re.compile(r"^([a-z][a-z0-9_]*)")
+_METRIC_SUFFIX_HEAD = re.compile(r"^\x00(_[a-z0-9_]+)")
+
+
+def _looks_like_sample(rest: str) -> bool:
+    """After the metric name: optional ``{labels}`` / placeholder label
+    block, then a space and a value (placeholder or literal number)."""
+    if rest.startswith("{"):
+        close = rest.find("}")
+        if close < 0:
+            # f-string splits the label block across constants; treat a
+            # trailing open brace as label-block-then-value elsewhere
+            return True
+        rest = rest[close + 1:]
+    if rest.startswith(_PH):
+        rest = rest[1:]
+    if not rest.startswith(" "):
+        return False
+    rest = rest.lstrip(" ")
+    return bool(rest) and (rest[0] == _PH or rest[0].isdigit()
+                           or rest[0] == "-")
+
+
+def collect_metric_names(ctx: _FileCtx) -> List[Tuple[str, int, bool]]:
+    """(name, line, is_suffix) for every metric-sample-shaped string.
+    ``is_suffix`` marks dynamic-prefix emissions (``f"{prefix}_x ..."``)
+    where only the suffix is statically known."""
+    found: List[Tuple[str, int, bool]] = []
+    for node in ast.walk(ctx.tree):
+        t = _template(node)
+        if t is None:
+            continue
+        for raw in t.split("\n"):
+            line = raw.strip()
+            if line.startswith("# HELP ") or line.startswith("# TYPE "):
+                parts = line.split(" ")
+                if len(parts) >= 3 and _METRIC_HEAD.match(parts[2]):
+                    found.append((parts[2], node.lineno, False))
+                continue
+            m = _METRIC_SUFFIX_HEAD.match(line)
+            if m and _looks_like_sample(line[m.end():]):
+                found.append((m.group(1), node.lineno, True))
+                continue
+            m = _METRIC_HEAD.match(line)
+            if (m and "_" in m.group(1)
+                    and _looks_like_sample(line[m.end():])):
+                found.append((m.group(1), node.lineno, False))
+    return found
+
+
+# --------------------------------------------------------------- wallclock
+def check_wallclock(ctx: _FileCtx) -> List[Finding]:
+    top = ctx.rel_path.split("/", 1)[0]
+    if top not in TRAJECTORY_MODULES:
+        return []
+    out: List[Finding] = []
+    imports_random = any(
+        (isinstance(n, ast.Import)
+         and any(a.name == "random" for a in n.names))
+        or (isinstance(n, ast.ImportFrom) and n.module == "random")
+        for n in ast.walk(ctx.tree))
+    for node in ast.walk(ctx.tree):
+        bad = None
+        if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)):
+            if node.value.id == "time" and node.attr in ("time", "time_ns"):
+                bad = f"time.{node.attr}"
+            elif node.value.id == "random" and imports_random:
+                bad = f"random.{node.attr}"
+        if bad and "# lint: wallclock-ok" not in ctx.line(node.lineno):
+            out.append(Finding(
+                "WALLCLOCK", ctx.rel_path, node.lineno,
+                f"{bad} in trajectory-affecting module — inject a "
+                f"clock/RNG (or annotate '# lint: wallclock-ok (<why>)' "
+                f"for a reviewed observability-only use)"))
+    return out
+
+
+# ------------------------------------------------------------------ runner
+class Linter:
+    """Whole-package run. Tests drive the per-file checks directly with
+    synthetic sources via :meth:`lint_source`."""
+
+    def __init__(self, package_root: str = _PKG_ROOT,
+                 repo_root: str = _REPO_ROOT):
+        self.package_root = package_root
+        self.repo_root = repo_root
+        self.findings: List[Finding] = []
+        self._fired: List[Tuple[str, str, int]] = []   # (point, path, line)
+        self._routes: List[Tuple[str, str, int]] = []
+        self._metrics: List[Tuple[str, str, int, bool]] = []
+        self._all_sources: Dict[str, str] = {}
+
+    # ---------------------------------------------------------- file pass
+    def lint_source(self, rel_path: str, source: str) -> List[Finding]:
+        """Run every per-file check over one source blob; returns (and
+        does not accumulate) the findings — the entry point the analyzer
+        self-tests feed fixture snippets through."""
+        ctx = _FileCtx(rel_path, source)
+        findings = []
+        findings += check_thread_names(ctx)
+        findings += check_lock_guards(ctx)
+        findings += check_wallclock(ctx)
+        return findings
+
+    def _file_pass(self, rel_path: str, source: str) -> None:
+        try:
+            ctx = _FileCtx(rel_path, source)
+        except SyntaxError as e:
+            self.findings.append(Finding("PARSE-ERROR", rel_path,
+                                         e.lineno or 0, str(e)))
+            return
+        self.findings += check_thread_names(ctx)
+        self.findings += check_lock_guards(ctx)
+        self.findings += check_wallclock(ctx)
+        for point, line in collect_fired_points(ctx):
+            self._fired.append((point, rel_path, line))
+        for route, line in collect_routes(ctx):
+            self._routes.append((route, rel_path, line))
+        for name, line, is_suffix in collect_metric_names(ctx):
+            self._metrics.append((name, rel_path, line, is_suffix))
+
+    # --------------------------------------------------------- cross-file
+    def _read(self, *parts) -> str:
+        try:
+            with open(os.path.join(self.repo_root, *parts)) as f:
+                return f.read()
+        except OSError:
+            return ""
+
+    def _cross_checks(self) -> None:
+        chaos_src = self._all_sources.get("runtime/chaos.py", "")
+        registered = parse_registered_points(chaos_src)
+        robustness = self._read("docs", "robustness.md")
+        observability = self._read("docs", "observability.md")
+        tests_text = ""
+        tests_dir = os.path.join(self.repo_root, "tests")
+        if os.path.isdir(tests_dir):
+            for fn in sorted(os.listdir(tests_dir)):
+                if fn.endswith(".py"):
+                    tests_text += self._read("tests", fn)
+        bench_text = self._read("bench.py")
+
+        for point, path, line in self._fired:
+            if point not in registered:
+                self.findings.append(Finding(
+                    "CHAOS-UNREGISTERED", path, line,
+                    f"chaos point {point!r} fired but absent from "
+                    f"runtime/chaos.py:REGISTERED_POINTS"))
+        pkg_text = "".join(self._all_sources.values())
+        for point in registered:
+            if point not in pkg_text:
+                self.findings.append(Finding(
+                    "CHAOS-STALE", "runtime/chaos.py", 0,
+                    f"registered chaos point {point!r} never appears in "
+                    f"package code"))
+            if f"`{point}`" not in robustness:
+                self.findings.append(Finding(
+                    "CHAOS-UNDOCUMENTED", "runtime/chaos.py", 0,
+                    f"registered chaos point {point!r} has no "
+                    f"docs/robustness.md row"))
+            if point not in tests_text and point not in bench_text:
+                self.findings.append(Finding(
+                    "CHAOS-UNTESTED", "runtime/chaos.py", 0,
+                    f"registered chaos point {point!r} is exercised by no "
+                    f"test or bench drill"))
+
+        for route, path, line in sorted(set(self._routes)):
+            if route not in observability:
+                self.findings.append(Finding(
+                    "ROUTE-UNDOCUMENTED", path, line,
+                    f"route {route!r} not documented in "
+                    f"docs/observability.md"))
+
+        doc_words = set(re.findall(r"[a-z][a-z0-9_]+", observability))
+        for name, path, line, is_suffix in sorted(set(self._metrics)):
+            if is_suffix:
+                if not any(w.endswith(name) for w in doc_words):
+                    self.findings.append(Finding(
+                        "METRIC-UNDOCUMENTED", path, line,
+                        f"dynamic-prefix metric '*{name}' has no "
+                        f"documented name ending with that suffix in "
+                        f"docs/observability.md"))
+                continue
+            if name.endswith("_"):
+                # dynamic-suffix emission (f"fleet_capacity_{counter} ...")
+                if not any(w.startswith(name) for w in doc_words):
+                    self.findings.append(Finding(
+                        "METRIC-UNDOCUMENTED", path, line,
+                        f"dynamic-suffix metric '{name}*' has no "
+                        f"documented name starting with that prefix in "
+                        f"docs/observability.md"))
+                continue
+            if not name.startswith(METRIC_NAMESPACES):
+                self.findings.append(Finding(
+                    "METRIC-NAMESPACE", path, line,
+                    f"metric {name!r} outside the registered namespaces "
+                    f"(analysis/registry.py:METRIC_NAMESPACES)"))
+                continue
+            if name not in doc_words:
+                self.findings.append(Finding(
+                    "METRIC-UNDOCUMENTED", path, line,
+                    f"metric {name!r} not documented in "
+                    f"docs/observability.md"))
+
+        for name in PIPELINE_THREAD_NAMES:
+            if name not in THREAD_NAME_PREFIXES:
+                self.findings.append(Finding(
+                    "REGISTRY-DRIFT", "analysis/registry.py", 0,
+                    f"PIPELINE_THREAD_NAMES entry {name!r} missing from "
+                    f"THREAD_NAME_PREFIXES"))
+
+    # -------------------------------------------------------------- drive
+    def run(self) -> List[Finding]:
+        for root, dirs, files in os.walk(self.package_root):
+            dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+            for fn in sorted(files):
+                if not fn.endswith(".py"):
+                    continue
+                full = os.path.join(root, fn)
+                rel = os.path.relpath(full, self.package_root).replace(
+                    os.sep, "/")
+                if rel.startswith("analysis/"):
+                    continue      # the analyzer does not lint itself
+                with open(full) as f:
+                    src = f.read()
+                self._all_sources[rel] = src
+                self._file_pass(rel, src)
+        self._cross_checks()
+        self.findings.sort(key=lambda f: (f.path, f.line, f.code))
+        return self.findings
+
+
+def run_lint(package_root: str = _PKG_ROOT,
+             repo_root: str = _REPO_ROOT) -> List[Finding]:
+    return Linter(package_root, repo_root).run()
+
+
+def render(findings: List[Finding]) -> str:
+    if not findings:
+        return "lint: clean"
+    return "\n".join(repr(f) for f in findings) + \
+        f"\n{len(findings)} finding(s)"
+
+
+def to_json(findings: List[Finding]) -> str:
+    return json.dumps({"findings": [f.to_dict() for f in findings],
+                       "count": len(findings)}, indent=2)

@@ -1,0 +1,788 @@
+"""Runtime lock-order witness (the Python analog of kernel lockdep).
+
+Twelve PRs of concurrent serving/training machinery rest on ~35 lock
+sites whose ordering discipline was, until now, convention plus code
+review. This module makes it machine-checked: with ``DL4J_TPU_LOCKDEP=1``
+(the tier-1 conftest enables it for the whole suite),
+``threading.Lock`` / ``RLock`` / ``Condition`` constructions **inside the
+deeplearning4j_tpu package** return named, site-attributed proxies that
+
+- record the per-thread held-lock stack,
+- build the global acquisition-order graph (edges between lock *classes*,
+  keyed by creation site — two instances of ``ContinuousBatcher`` share
+  one witness name, exactly like lockdep lock classes),
+- flag **cycle formation** (A taken under B somewhere, B taken under A
+  somewhere else = a potential deadlock, even if the two paths never
+  raced yet) with both witness stacks,
+- flag **blocking-while-holding**: entering a blocking boundary —
+  ``queue.Queue.get``, an HTTP forward (``http.client``),
+  ``subprocess`` waits, or a chaos ``HangUntilCancelled`` — while any
+  witness lock is held. A lock held across an unbounded wait starves
+  every sibling thread that needs it; the PR 9/10 review rounds caught
+  two of these by hand, this catches them by machine,
+- flag **waits-while-holding** Condition inversions: ``Condition.wait``
+  releases *its own* lock, but any OTHER witness lock still held sleeps
+  with the waiter.
+
+Violations are recorded (never raised mid-flight — a witness must not
+change the system it observes); the conftest guard fails the responsible
+test, and ``analysis/lockdep_allow.toml`` is the explicit, reviewed
+allowlist for the few accepted edges. See ``docs/static_analysis.md``.
+
+Construction-site filtering keeps the blast radius zero for everything
+else: a lock created from stdlib code (``queue``, ``logging``,
+``concurrent.futures``) gets the real primitive, so only package locks
+pay the (small, measured: ``bench.py --analysis`` bounds it at < 5% on
+the serving hot path) bookkeeping cost.
+"""
+
+from __future__ import annotations
+
+import linecache
+import os
+import re
+import sys
+import threading
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ALLOWLIST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "lockdep_allow.toml")
+
+# real primitives, captured before any patching can replace them
+_real_lock = threading.Lock
+_real_rlock = threading.RLock
+_real_condition = threading.Condition
+
+
+class Violation:
+    """One witnessed discipline violation. ``key`` is the stable identity
+    the allowlist matches on; ``stacks`` carries the witness stack(s)."""
+
+    def __init__(self, kind: str, key: str, message: str,
+                 stacks: Optional[List[str]] = None):
+        self.kind = kind          # "cycle" | "blocking" | "wait-holding"
+        self.key = key
+        self.message = message
+        self.stacks = stacks or []
+
+    def render(self) -> str:
+        out = [f"[{self.kind}] {self.key}", f"  {self.message}"]
+        for i, s in enumerate(self.stacks):
+            out.append(f"  --- witness stack {i + 1} ---")
+            out.extend("  " + ln for ln in s.rstrip().split("\n"))
+        return "\n".join(out)
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"kind": self.kind, "key": self.key,
+                "message": self.message, "stacks": self.stacks}
+
+
+# --------------------------------------------------------------------------
+# allowlist: a deliberately tiny TOML subset (this interpreter is 3.10,
+# tomllib lands in 3.11). Supported: ``[[cycle]]`` / ``[[blocking]]`` /
+# ``[[wait]]`` array-of-table headers with ``key = "string"`` entries.
+def parse_allowlist(text: str) -> Dict[str, List[Dict[str, str]]]:
+    sections: Dict[str, List[Dict[str, str]]] = {
+        "cycle": [], "blocking": [], "wait": []}
+    current: Optional[Dict[str, str]] = None
+    for lineno, raw in enumerate(text.split("\n"), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = re.fullmatch(r"\[\[(\w+)\]\]", line)
+        if m:
+            name = m.group(1)
+            if name not in sections:
+                raise ValueError(
+                    f"lockdep_allow.toml:{lineno}: unknown table {name!r}")
+            current = {}
+            sections[name].append(current)
+            continue
+        m = re.fullmatch(r'(\w+)\s*=\s*"((?:[^"\\]|\\.)*)"', line)
+        if m and current is not None:
+            current[m.group(1)] = m.group(2)
+            continue
+        raise ValueError(f"lockdep_allow.toml:{lineno}: unparseable line "
+                         f"{line!r}")
+    for name, rows in sections.items():
+        for row in rows:
+            if "reason" not in row:
+                raise ValueError(f"lockdep_allow.toml: every [[{name}]] "
+                                 f"entry needs a reason (got {row})")
+    return sections
+
+
+def _load_allowlist(path: str = _ALLOWLIST_PATH):
+    try:
+        with open(path) as f:
+            return parse_allowlist(f.read())
+    except FileNotFoundError:
+        return {"cycle": [], "blocking": [], "wait": []}
+
+
+# --------------------------------------------------------------------------
+def _derive_name(frame) -> Optional[str]:
+    """Name a lock from its construction site: module + class (via the
+    frame's ``self``) + the assigned attribute parsed off the source line.
+    Returns None for construction sites outside the package (those get
+    real primitives). Names are line-number-free so the allowlist and the
+    acquisition graph survive unrelated edits."""
+    fn = frame.f_code.co_filename
+    try:
+        rel = os.path.relpath(fn, _PKG_ROOT)
+    except ValueError:          # different drive (windows); not ours
+        return None
+    if rel.startswith("..") or not rel.endswith(".py"):
+        return None
+    mod = rel[:-3].replace(os.sep, ".")
+    if mod.startswith("analysis."):
+        return None             # the witness never witnesses itself
+    if mod.endswith(".__init__"):
+        mod = mod[:-len(".__init__")]
+    line = linecache.getline(fn, frame.f_lineno)
+    m = re.search(r"(?:self\.)?([A-Za-z_]\w*)\s*(?::[^=]+)?=[^=]", line)
+    attr = m.group(1) if m else f"anon_L{frame.f_lineno}"
+    slf = frame.f_locals.get("self")
+    cls = type(slf).__name__ if slf is not None else None
+    fn_name = frame.f_code.co_name
+    if cls is not None:
+        return f"{mod}.{cls}.{attr}"
+    if fn_name != "<module>":
+        return f"{mod}.{fn_name}.{attr}"
+    return f"{mod}.{attr}"
+
+
+def _site(frame) -> str:
+    return f"{os.path.relpath(frame.f_code.co_filename, _PKG_ROOT)}" \
+           f":{frame.f_lineno}"
+
+
+_THIS_FILE = os.path.abspath(__file__)
+
+
+def _caller_frame():
+    """First frame outside this module (the user code acquiring)."""
+    f = sys._getframe(1)
+    while f is not None and f.f_code.co_filename == _THIS_FILE:
+        f = f.f_back
+    return f or sys._getframe(1)
+
+
+def _capture_stack(limit: int = 18) -> str:
+    try:
+        return "".join(traceback.format_stack(_caller_frame(), limit=limit))
+    except Exception:           # pragma: no cover - diagnostics only
+        return "(stack unavailable)"
+
+
+class Witness:
+    """The acquisition-order graph plus the violation ledger. One global
+    default instance backs the patched constructors; tests build their
+    own isolated instances (``isolated()``) so fixture deadlocks don't
+    contaminate the suite's graph."""
+
+    def __init__(self, allowlist: Optional[dict] = None):
+        self._mu = _real_lock()           # guards: _edges, _violations, _seen_keys, _lock_names
+        self._tls = threading.local()
+        # edge (a, b) -> (acquire-site, witness stack) of first observation
+        self._edges: Dict[Tuple[str, str], Tuple[str, str]] = {}
+        self._violations: List[Violation] = []
+        self._seen_keys: set = set()
+        self._lock_names: set = set()
+        self._taken = 0                   # take_new_violations cursor
+        self.allowlist = allowlist if allowlist is not None \
+            else _load_allowlist()
+
+    # ------------------------------------------------------------ factories
+    def make_lock(self, name: str, site: str = "?") -> "_LockProxy":
+        return _LockProxy(self, name, site)
+
+    def make_rlock(self, name: str, site: str = "?") -> "_RLockProxy":
+        return _RLockProxy(self, name, site)
+
+    def make_condition(self, name: str, site: str = "?",
+                       lock=None) -> "_ConditionProxy":
+        return _ConditionProxy(self, name, site, lock)
+
+    # ------------------------------------------------------------- held TLS
+    def _held(self) -> list:
+        h = getattr(self._tls, "held", None)
+        if h is None:
+            h = self._tls.held = []
+        return h
+
+    def held_names(self) -> List[str]:
+        return [p.name for p in self._held()]
+
+    # ---------------------------------------------------------- allowlisting
+    def _allowed(self, kind: str, **fields) -> bool:
+        for row in self.allowlist.get(kind, ()):
+            if all(row.get(k) == v for k, v in fields.items()):
+                return True
+        return False
+
+    # ------------------------------------------------------------ recording
+    def _record(self, v: Violation) -> None:
+        with self._mu:
+            if v.key in self._seen_keys:
+                return
+            self._seen_keys.add(v.key)
+            self._violations.append(v)
+
+    def violations(self) -> List[Violation]:
+        with self._mu:
+            return list(self._violations)
+
+    def take_new_violations(self) -> List[Violation]:
+        """Violations recorded since the last call — the per-test guard's
+        read, so each failure is attributed to the test that induced it."""
+        with self._mu:
+            new = self._violations[self._taken:]
+            self._taken = len(self._violations)
+            return list(new)
+
+    def reset(self) -> None:
+        with self._mu:
+            self._edges.clear()
+            self._violations.clear()
+            self._seen_keys.clear()
+            self._taken = 0
+
+    def stats(self) -> Dict[str, int]:
+        with self._mu:
+            return {"locks": len(self._lock_names),
+                    "edges": len(self._edges),
+                    "violations": len(self._violations)}
+
+    # --------------------------------------------------------------- events
+    def note_created(self, name: str) -> None:
+        # lock-free: set.add is atomic in CPython, and this runs per
+        # construction (per request for the batcher's _Request condition)
+        self._lock_names.add(name)
+
+    def before_acquire(self, proxy) -> None:
+        """Called before a blocking acquire: adds the (top-of-stack ->
+        proxy) edge and checks it for cycle formation. Top-only edges are
+        enough — the rest of the held stack already has edges to the top,
+        so any cycle through a deeper lock closes transitively."""
+        held = self._held()
+        if not held:
+            return
+        top = held[-1]
+        a, b = top.name, proxy.name
+        # known-edge fast path, deliberately outside _mu: _edges is
+        # add-only and CPython dict reads are safe against concurrent
+        # inserts, so the steady state (every edge already witnessed)
+        # costs one dict probe and no global mutex
+        if a != b and (a, b) in self._edges:
+            return
+        if a == b:
+            # same lock class nested (two instances, or a real
+            # self-deadlock on one instance). Either way it is an
+            # ordering hazard between identically-named locks.
+            key = f"cycle:{a} -> {b}"
+            if not self._allowed("cycle", edge=f"{a} -> {b}"):
+                self._record(Violation(
+                    "cycle", key,
+                    f"lock class {a!r} acquired while already held by this "
+                    f"thread (self-order: instance nesting needs an "
+                    f"explicit hierarchy)",
+                    [_capture_stack()]))
+            return
+        with self._mu:
+            known = (a, b) in self._edges
+            if not known:
+                self._edges[(a, b)] = (_site(_caller_frame()),
+                                       _capture_stack())
+                cycle_path = self._find_path(b, a)
+            else:
+                cycle_path = None
+        if cycle_path is not None:
+            edge_txt = f"{a} -> {b}"
+            key = f"cycle:{edge_txt}"
+            if not self._allowed("cycle", edge=edge_txt) \
+                    and not self._allowed("cycle",
+                                          edge=f"{b} -> {a}"):
+                back = " -> ".join(cycle_path)
+                with self._mu:
+                    back_stack = self._edges.get(
+                        (cycle_path[0], cycle_path[1]),
+                        ("?", "(stack unavailable)"))[1]
+                self._record(Violation(
+                    "cycle", key,
+                    f"lock-order cycle: this thread takes {edge_txt} while "
+                    f"the graph already holds {back} — two threads on these "
+                    f"paths can deadlock",
+                    [_capture_stack(), back_stack]))
+
+    def _find_path(self, src: str, dst: str) -> Optional[List[str]]:
+        """DFS for src ~> dst over recorded edges; caller holds _mu."""
+        stack = [(src, [src])]
+        seen = {src}
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for (x, y) in self._edges:
+                if x == node and y not in seen:
+                    seen.add(y)
+                    stack.append((y, path + [y]))
+        return None
+
+    def note_acquired(self, proxy) -> None:
+        self._held().append(proxy)
+
+    def note_released(self, proxy) -> None:
+        held = self._held()
+        # normal case is LIFO; out-of-order release is legal Python, so
+        # remove by identity wherever it sits
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is proxy:
+                del held[i]
+                return
+
+    # ------------------------------------------------- blocking boundaries
+    def check_blocking(self, op: str) -> None:
+        """A blocking boundary (queue.get / HTTP / subprocess / chaos
+        hang) is being entered; any held witness lock is a violation."""
+        held = self._held()
+        if not held:
+            return
+        top = held[-1]
+        if self._allowed("blocking", lock=top.name, op=op):
+            return
+        key = f"blocking:{top.name} @ {op}"
+        self._record(Violation(
+            "blocking", key,
+            f"blocking call {op!r} entered while holding {top.name!r} "
+            f"(held stack: {self.held_names()}) — every thread needing "
+            f"that lock now waits on this I/O",
+            [_capture_stack()]))
+
+    def check_wait(self, cond_proxy) -> None:
+        """Condition.wait releases the condition's own lock; anything
+        else still held sleeps with the waiter."""
+        others = [p for p in self._held()
+                  if p is not cond_proxy and p.name != cond_proxy.name]
+        if not others:
+            return
+        top = others[-1]
+        if self._allowed("wait", cond=cond_proxy.name, holding=top.name):
+            return
+        key = f"wait-holding:{cond_proxy.name} while {top.name}"
+        self._record(Violation(
+            "wait-holding", key,
+            f"Condition {cond_proxy.name!r} waits while this thread still "
+            f"holds {top.name!r} — the wait parks the lock until notify",
+            [_capture_stack()]))
+
+
+class _LockProxy:
+    """threading.Lock stand-in with witness bookkeeping.
+
+    The hot path is deliberately inlined: an uncontended ``with lock:``
+    on an empty held stack costs one thread-local read, one list
+    append/pop and two bound C-lock calls — measured ~3x a raw lock in
+    nanoseconds, bounded < 5% end-to-end by ``bench.py --analysis``."""
+
+    __slots__ = ("_real", "_witness", "_tls", "_racquire", "_rrelease",
+                 "name", "site", "_owner")
+
+    def __init__(self, witness: Witness, name: str, site: str):
+        self._real = _real_lock()
+        self._racquire = self._real.acquire
+        self._rrelease = self._real.release
+        self._witness = witness
+        self._tls = witness._tls
+        self.name = name
+        self.site = site
+        self._owner: Optional[int] = None
+        witness.note_created(name)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        if blocking and held:
+            self._witness.before_acquire(self)
+        ok = self._racquire(blocking, timeout)
+        if ok:
+            self._owner = threading.get_ident()
+            held.append(self)
+        return ok
+
+    def release(self) -> None:
+        self._owner = None
+        held = getattr(self._tls, "held", None)
+        if held:
+            if held[-1] is self:
+                held.pop()
+            else:               # out-of-order release (legal, rare)
+                for i in range(len(held) - 1, -1, -1):
+                    if held[i] is self:
+                        del held[i]
+                        break
+        self._rrelease()
+
+    def locked(self) -> bool:
+        return self._real.locked()
+
+    # Condition-compatibility (threading.Condition probes for these)
+    def _is_owned(self) -> bool:
+        return self._owner == threading.get_ident()
+
+    def __enter__(self) -> bool:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        if held:
+            self._witness.before_acquire(self)
+        self._racquire()
+        self._owner = threading.get_ident()
+        held.append(self)
+        return True
+
+    def __exit__(self, *exc) -> None:
+        self._owner = None
+        held = self._tls.held
+        if held[-1] is self:
+            held.pop()
+        else:
+            for i in range(len(held) - 1, -1, -1):
+                if held[i] is self:
+                    del held[i]
+                    break
+        self._rrelease()
+
+    def __repr__(self) -> str:
+        return f"<lockdep Lock {self.name} @ {self.site}>"
+
+
+class _RLockProxy:
+    """threading.RLock stand-in: recursion tracked so the held stack and
+    the order graph see only the outermost acquire/release."""
+
+    __slots__ = ("_real", "_witness", "name", "site", "_owner", "_count")
+
+    def __init__(self, witness: Witness, name: str, site: str):
+        self._real = _real_rlock()
+        self._witness = witness
+        self.name = name
+        self.site = site
+        self._owner: Optional[int] = None
+        self._count = 0
+        witness.note_created(name)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        me = threading.get_ident()
+        first = self._owner != me
+        if blocking and first:
+            self._witness.before_acquire(self)
+        ok = self._real.acquire(blocking, timeout)
+        if ok:
+            if first:
+                self._owner = me
+                self._count = 1
+                self._witness.note_acquired(self)
+            else:
+                self._count += 1
+        return ok
+
+    def release(self) -> None:
+        if self._owner != threading.get_ident():
+            raise RuntimeError("cannot release un-acquired lock")
+        self._count -= 1
+        if self._count == 0:
+            self._owner = None
+            self._witness.note_released(self)
+        self._real.release()
+
+    # Condition-compatibility trio
+    def _is_owned(self) -> bool:
+        return self._owner == threading.get_ident()
+
+    def _release_save(self):
+        count, owner = self._count, self._owner
+        self._count = 0
+        self._owner = None
+        self._witness.note_released(self)
+        for _ in range(count):
+            self._real.release()
+        return (count, owner)
+
+    def _acquire_restore(self, state) -> None:
+        count, owner = state
+        self._witness.before_acquire(self)
+        for _ in range(count):
+            self._real.acquire()
+        self._count = count
+        self._owner = owner
+        self._witness.note_acquired(self)
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<lockdep RLock {self.name} @ {self.site}>"
+
+
+class _ConditionProxy:
+    """threading.Condition stand-in whose wait() checks for other held
+    witness locks (the waits-while-holding inversion).
+
+    The underlying Condition and its RLock are REAL primitives — all the
+    notify/wait release-restore machinery runs at C speed (a _Request
+    constructs one of these per serving request). The proxy participates
+    in the witness only at the edges: enter/exit maintain the held stack
+    (so condition locks appear in the acquisition-order graph), and
+    wait()/wait_for() run the waits-while-holding check."""
+
+    __slots__ = ("_witness", "_tls", "name", "site", "_real")
+
+    def __init__(self, witness: Witness, name: str, site: str, lock=None):
+        self._witness = witness
+        self._tls = witness._tls
+        self.name = name
+        self.site = site
+        # an explicit lock may be a witness proxy (it quacks enough for
+        # threading.Condition) or a real primitive; default is real
+        self._real = _real_condition(lock)
+        witness.note_created(name)
+
+    # lock face: the held-stack entry IS this proxy
+    def acquire(self, *a, **kw):
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        if held:
+            self._witness.before_acquire(self)
+        ok = self._real.acquire(*a, **kw)
+        if ok:
+            held.append(self)
+        return ok
+
+    def release(self):
+        held = getattr(self._tls, "held", None)
+        if held:
+            for i in range(len(held) - 1, -1, -1):
+                if held[i] is self:
+                    del held[i]
+                    break
+        return self._real.release()
+
+    def __enter__(self):
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        if held:
+            self._witness.before_acquire(self)
+        self._real.__enter__()
+        held.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        held = self._tls.held
+        if held[-1] is self:
+            held.pop()
+        else:
+            for i in range(len(held) - 1, -1, -1):
+                if held[i] is self:
+                    del held[i]
+                    break
+        return self._real.__exit__(*exc)
+
+    def wait(self, timeout: Optional[float] = None):
+        held = getattr(self._tls, "held", None)
+        if held and len(held) > 1:
+            self._witness.check_wait(self)
+        return self._real.wait(timeout)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        held = getattr(self._tls, "held", None)
+        if held and len(held) > 1:
+            self._witness.check_wait(self)
+        return self._real.wait_for(predicate, timeout)
+
+    def notify(self, n: int = 1):
+        return self._real.notify(n)
+
+    def notify_all(self):
+        return self._real.notify_all()
+
+    def __repr__(self) -> str:
+        return f"<lockdep Condition {self.name} @ {self.site}>"
+
+
+# --------------------------------------------------------------------------
+# the global witness + constructor/boundary patching
+
+_default_witness: Optional[Witness] = None
+_patch_mu = _real_lock()                 # guards: _enabled, _originals
+_enabled = False
+_originals: Dict[str, object] = {}
+
+
+def default_witness() -> Witness:
+    global _default_witness
+    if _default_witness is None:
+        _default_witness = Witness()
+    return _default_witness
+
+
+class isolated:
+    """``with lockdep.isolated() as w:`` — route the patched constructors
+    and boundary checks to a fresh Witness for the scope, so analyzer
+    self-tests can induce cycles without dirtying the suite's graph."""
+
+    def __init__(self, allowlist: Optional[dict] = None):
+        self.witness = Witness(allowlist=allowlist or
+                               {"cycle": [], "blocking": [], "wait": []})
+
+    def __enter__(self) -> Witness:
+        global _default_witness
+        self._prev = _default_witness
+        _default_witness = self.witness
+        return self.witness
+
+    def __exit__(self, *exc) -> None:
+        global _default_witness
+        _default_witness = self._prev
+
+
+# (filename, lineno) -> (name|None, site). A construction site's name is
+# derived once — per-request lock constructions (each _Request carries a
+# Condition) cost two dict probes, not a path walk.
+_SITE_CACHE: Dict[Tuple[str, int], Tuple[Optional[str], str]] = {}
+
+
+def _site_info(frame) -> Tuple[Optional[str], str]:
+    key = (frame.f_code.co_filename, frame.f_lineno)
+    hit = _SITE_CACHE.get(key)
+    if hit is None:
+        hit = (_derive_name(frame), _site(frame))
+        _SITE_CACHE[key] = hit
+    return hit
+
+
+def _patched_lock():
+    name, site = _site_info(sys._getframe(1))
+    if name is None:
+        return _real_lock()
+    return default_witness().make_lock(name, site)
+
+
+def _patched_rlock():
+    name, site = _site_info(sys._getframe(1))
+    if name is None:
+        return _real_rlock()
+    return default_witness().make_rlock(name, site)
+
+
+def _patched_condition(lock=None):
+    name, site = _site_info(sys._getframe(1))
+    if name is None:
+        return _real_condition(lock)
+    return default_witness().make_condition(name, site, lock)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable() -> None:
+    """Install the witness: patch the threading constructors (package
+    construction sites only) and the blocking boundaries. Idempotent."""
+    global _enabled
+    with _patch_mu:
+        if _enabled:
+            return
+        import http.client
+        import queue
+        import subprocess
+
+        _originals["Lock"] = threading.Lock
+        _originals["RLock"] = threading.RLock
+        _originals["Condition"] = threading.Condition
+        threading.Lock = _patched_lock
+        threading.RLock = _patched_rlock
+        threading.Condition = _patched_condition
+
+        def _wrap_boundary(cls, attr, op, store):
+            orig = getattr(cls, attr)
+            _originals[store] = (cls, attr, orig)
+
+            def wrapped(self, *a, **kw):
+                w = _default_witness
+                if (w is not None
+                        and getattr(w._tls, "held", None)
+                        and op_is_blocking(op, a, kw)):
+                    w.check_blocking(op)
+                return orig(self, *a, **kw)
+
+            setattr(cls, attr, wrapped)
+
+        def op_is_blocking(op, a, kw) -> bool:
+            if op == "queue.get":
+                # get(block=False) / get_nowait cannot park the holder
+                return bool(a[0]) if a else bool(kw.get("block", True))
+            return True
+
+        _wrap_boundary(queue.Queue, "get", "queue.get", "queue_get")
+        _wrap_boundary(http.client.HTTPConnection, "getresponse",
+                       "http.request", "http_getresponse")
+        _wrap_boundary(http.client.HTTPConnection, "connect",
+                       "http.connect", "http_connect")
+        _wrap_boundary(subprocess.Popen, "wait", "subprocess.wait",
+                       "popen_wait")
+        try:
+            from deeplearning4j_tpu.runtime import chaos as _chaos
+            _wrap_boundary(_chaos.HangUntilCancelled, "apply",
+                           "chaos.hang", "chaos_hang")
+        except Exception:       # pragma: no cover - import cycle guard
+            pass
+        _enabled = True
+
+
+def disable() -> None:
+    """Remove every patch (existing proxy locks keep working — they hold
+    real primitives — but stop contributing new constructions)."""
+    global _enabled
+    with _patch_mu:
+        if not _enabled:
+            return
+        threading.Lock = _originals.pop("Lock")
+        threading.RLock = _originals.pop("RLock")
+        threading.Condition = _originals.pop("Condition")
+        for key in list(_originals):
+            cls, attr, orig = _originals.pop(key)
+            setattr(cls, attr, orig)
+        _enabled = False
+
+
+def enable_from_env() -> bool:
+    """The production opt-in: ``DL4J_TPU_LOCKDEP=1`` in the environment
+    enables the witness at import (fleet worker subprocesses inherit the
+    env, so a drill's whole process tree is witnessed)."""
+    if os.environ.get("DL4J_TPU_LOCKDEP", "") == "1":
+        enable()
+        return True
+    return False
+
+
+def violations() -> List[Violation]:
+    return default_witness().violations()
+
+
+def take_new_violations() -> List[Violation]:
+    return default_witness().take_new_violations()
+
+
+def render_report(vs: List[Violation]) -> str:
+    if not vs:
+        return "lockdep: no violations"
+    return "\n\n".join(v.render() for v in vs)

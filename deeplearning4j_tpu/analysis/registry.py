@@ -1,0 +1,76 @@
+"""Single source of truth for the project's concurrency/observability
+registries — the names the AST linter (``analysis/lint.py``) enforces and
+the tier-1 conftest consumes.
+
+Keeping these HERE (not in conftest, not scattered per-module) is the
+point of ISSUE 14's last satellite: conftest's ``_PIPELINE_THREAD_NAMES``
+imports :data:`PIPELINE_THREAD_NAMES`, and the lint checks every
+``threading.Thread(name=...)`` in the package against
+:data:`THREAD_NAME_PREFIXES` — the two can never drift because there is
+only one tuple of each.
+
+Dependency rule: this module must stay stdlib-free-of-imports (conftest
+and ``python -m deeplearning4j_tpu.analysis`` both load it before jax is
+configured in some flows).
+"""
+
+# Background threads every fit()/close()/stop()/aggregate path must JOIN —
+# the conftest leak guard fails any test one of these survives. A name
+# goes here only when some shutdown path owns joining it.
+PIPELINE_THREAD_NAMES = (
+    "train-prefetch",
+    "train-listener-delivery",
+    "async-dataset-iterator",
+    "trace-collector",
+    "slo-autoscaler",
+    "lease-election",
+)
+
+# Every thread the package spawns must carry a name starting with one of
+# these prefixes (the lint resolves the static prefix of each
+# ``threading.Thread(name=...)`` call). An unlisted prefix is a finding:
+# register it here — deliberately, in review — or rename the thread.
+THREAD_NAME_PREFIXES = PIPELINE_THREAD_NAMES + (
+    "ContinuousBatcher",        # batcher coalescer + "-complete" stage
+    "ModelServer",              # serving HTTP front end
+    "FleetRouter",              # router HTTP server + "-probe" loop
+    "FleetSupervisor",          # worker-process watchdog
+    "FaultTolerantTrainer-epoch",
+    "router-forward",           # per-attempt forward threads (joined by race)
+    "ui-stats-server",          # ui/server.py stats HTTP thread
+)
+
+# Prometheus metric-name namespaces the package may emit. The lint
+# recognises a metric emission by shape (``name{labels} value`` /
+# ``# TYPE name``), then requires (a) the name to live in one of these
+# namespaces and (b) the name to be documented in docs/observability.md.
+METRIC_NAMESPACES = (
+    "serving_",
+    "router_",
+    "fleet_",
+    "capacity_",
+    "compile_cache_",
+    "config_",
+    "slo_",
+    "trace_",
+    "autoscaler_",
+    "registry_",
+    "paging_",
+    "aot_",                     # AOT dispatch fast-path ledger (ISSUE 5)
+)
+
+# Package directories whose code affects numeric trajectories — the
+# bit-identity guarantee's blind spot. ``time.time()`` / ``time.time_ns``
+# and the stdlib ``random`` module are banned here (inject a clock/RNG
+# instead); observability timing uses ``time.monotonic`` /
+# ``time.perf_counter``, which stay legal.
+TRAJECTORY_MODULES = (
+    "models",
+    "nn",
+    "ops",
+    "autodiff",
+    "parallel",
+    "native",
+    "train",
+    "data",
+)

@@ -29,7 +29,7 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_DIR, "libdl4jtpu_host.so")
 _SOURCES = ["threshold_codec.cpp", "image_pipeline.cpp"]
 
-_lock = threading.Lock()
+_lock = threading.Lock()  # guards: (_lib/_build_failed lazy dlopen)
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
 
@@ -42,7 +42,12 @@ def _compile(srcs, out_path, extra_flags=(), headers=(), timeout=180,
     newest = max(os.path.getmtime(f) for f in tuple(srcs) + tuple(headers))
     if os.path.exists(out_path) and os.path.getmtime(out_path) >= newest:
         return out_path
-    tmp = out_path + f".tmp.{os.getpid()}"
+    # unique per BUILDER, not just per process: since the compile runs
+    # outside the module lock (lockdep: no subprocess wait under a lock),
+    # two cold-start threads may race _compile on the same output — each
+    # needs its own tmp so neither can truncate or unlink the other's
+    # in-progress object; the atomic rename publishes whichever finishes
+    tmp = out_path + f".tmp.{os.getpid()}.{threading.get_ident()}"
     base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread"]
     variants = ([base + ["-march=native"], base] if march_native else [base])
     for cc in variants:
@@ -68,12 +73,21 @@ def _build() -> Optional[str]:
 
 
 def get_lib() -> Optional[ctypes.CDLL]:
-    """Compile-on-first-use loader; None if no toolchain (fallback mode)."""
+    """Compile-on-first-use loader; None if no toolchain (fallback mode).
+
+    The compile itself runs OUTSIDE ``_lock`` (lockdep: never hold a lock
+    across a subprocess wait — ISSUE 14). ``_compile`` is idempotent and
+    atomic (mtime skip, per-PID tmp + rename), so two cold-start racers
+    at worst both compile and the loser's rename is a no-op overwrite of
+    identical bytes; publication under the lock stays single-assignment."""
     global _lib, _build_failed
     with _lock:
         if _lib is not None or _build_failed:
             return _lib
-        path = _build()
+    path = _build()
+    with _lock:
+        if _lib is not None or _build_failed:   # raced: first racer won
+            return _lib
         if path is None:
             _build_failed = True
             return None
@@ -436,13 +450,16 @@ def build_capi(force: bool = False) -> Optional[str]:
     import sysconfig
     src = os.path.join(_DIR, "capi.cpp")
     hdr = os.path.join(_DIR, "dl4j_tpu_c.h")
+    # the unlink is the only shared-state mutation; the compile itself
+    # runs OUTSIDE _lock (lockdep: never hold a lock across a subprocess
+    # wait — _compile is idempotent and atomic, same contract as get_lib)
     with _lock:
         if force and os.path.exists(_CAPI_LIB):
             os.unlink(_CAPI_LIB)
-        inc = sysconfig.get_paths()["include"]
-        libdir = sysconfig.get_config_var("LIBDIR") or ""
-        ver = sysconfig.get_config_var("LDVERSION") or "3"
-        return _compile(
-            [src], _CAPI_LIB, headers=[hdr], march_native=False,
-            extra_flags=[f"-I{inc}", f"-L{libdir}", f"-Wl,-rpath,{libdir}",
-                         f"-lpython{ver}"])
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ver = sysconfig.get_config_var("LDVERSION") or "3"
+    return _compile(
+        [src], _CAPI_LIB, headers=[hdr], march_native=False,
+        extra_flags=[f"-I{inc}", f"-L{libdir}", f"-Wl,-rpath,{libdir}",
+                     f"-lpython{ver}"])

@@ -88,6 +88,38 @@ from deeplearning4j_tpu.runtime import trace
 logger = logging.getLogger(__name__)
 
 
+# Central chaos-point registry (ISSUE 14): every injection point fired
+# anywhere in the package, name -> one-line description. The analysis
+# lint diffs this registry against (a) the `chaos.inject`/`transform_bytes`
+# call sites in code, (b) the `docs/robustness.md` catalogue rows, and
+# (c) the test/bench corpus — a point missing from any leg is a finding,
+# so code, registry, docs and drills can never drift apart.
+REGISTERED_POINTS: Dict[str, str] = {
+    "serving.batcher.submit": "every request admission into the batcher",
+    "serving.batcher.forward": "dispatch stage, as a batch is issued to a replica",
+    "serving.batcher.complete": "completion stage, before the blocking readback",
+    "serving.batcher.warmup": "AOT bucket warmup during build/hot-swap",
+    "serving.registry.register": "start of every model registration",
+    "serving.registry.deploy_quantized": "top of the accuracy-gated quantized deploy",
+    "serving.registry.page_in": "start of a cold model's single-flight rehydration",
+    "serving.worker.predict": "top of every ModelServer predict (per process)",
+    "serving.router.forward": "router, before each forward attempt",
+    "serving.router.hedge": "router, as a hedge launches against a second worker",
+    "serving.router.config_load": "FleetConfig reload (call + byte point)",
+    "serving.autoscale.lease": "LeaseElection, before every leader heartbeat",
+    "serving.quantize.calibrate": "per calibration batch (call + CRC byte point)",
+    "serving.quantize.gate": "top of the deploy_quantized accuracy-gate eval",
+    "train.checkpoint.write": "before each checkpoint archive write",
+    "train.checkpoint.bytes": "byte point over the checkpoint archive bytes",
+    "train.epoch": "supervised epoch worker, before net.fit",
+    "train.iteration": "every iteration via chaos.ChaosListener",
+    "train.prefetch.fetch": "per fetched batch on the training feed path",
+    "train.distributed.exchange": "top of each distributed gradient exchange",
+    "train.distributed.exchange.bytes": "byte point over a worker's encoded update",
+    "runtime.compile_cache.load": "per persistent-executable-cache lookup",
+}
+
+
 class ChaosError(RuntimeError):
     """An injected failure (never raised by real production faults)."""
 
@@ -239,7 +271,9 @@ class ChaosController:
         self.events: List[Tuple[str, int, str, str]] = []
         self._rules: List[Tuple[str, Policy, random.Random]] = []
         self._counts: Dict[str, int] = {}
-        self._lock = threading.Lock()
+        # _rules is append-under-lock / read-lock-free by design (list
+        # iteration over a snapshot reference is safe in CPython)
+        self._lock = threading.Lock()  # guards: _counts, events
         self._cancel_event = threading.Event()
         self._previous: Optional["ChaosController"] = None
 
@@ -333,7 +367,7 @@ class ChaosController:
         return data
 
 
-_INSTALL_LOCK = threading.Lock()
+_INSTALL_LOCK = threading.Lock()  # guards: (_ACTIVE install/restore)
 _ACTIVE: Optional[ChaosController] = None
 
 

@@ -64,10 +64,11 @@ class CompileCacheStats:
     """Thread-safe counters for the persistent cache + AOT layer."""
 
     def __init__(self):
+        # guards: hits, misses, corrupt_entries, compiles, compile_seconds, retrieval_seconds, aot_compiles, aot_compile_seconds, aot_fallbacks
         self._lock = threading.Lock()
         self._zero()
 
-    def _zero(self):
+    def _zero(self):  # holds: _lock (or pre-sharing, from __init__)
         self.hits = 0               # executables deserialized from the cache
         self.misses = 0             # consulted, absent -> backend compile
         self.corrupt_entries = 0    # unreadable entry -> fallback compile

@@ -166,7 +166,7 @@ def _coerce_dtype(dtype):
     return jnp.dtype(dtype).type
 
 
-_lock = threading.Lock()
+_lock = threading.Lock()  # guards: (_instance singleton construction)
 _instance: Optional[Environment] = None
 
 

@@ -126,6 +126,7 @@ class ExchangeStats:
         import threading
 
         from deeplearning4j_tpu.serving.metrics import LatencyHistogram
+        # guards: _totals, _counts, _hists, _wire_bytes, _dense_bytes, _payload_bytes, _steps
         self._lock = threading.Lock()
         self._hists = {s: LatencyHistogram() for s in self.STAGES}
         self._totals = {s: 0.0 for s in self.STAGES}

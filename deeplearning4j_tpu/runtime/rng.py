@@ -33,7 +33,7 @@ class RngManager:
         # initialise the XLA backend at import time — and that must not
         # happen before jax.distributed.initialize() on multihost.
         self._key = None
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: _key
 
     @property
     def seed(self) -> int:

@@ -108,7 +108,7 @@ class TraceCollector:
         # `+= 1` from concurrent finalizing threads loses updates, so
         # they take a (rarely contended) lock — the slot store itself
         # stays lock-free via the atomic counter
-        self._count_lock = threading.Lock()
+        self._count_lock = threading.Lock()  # guards: kept, dropped
         self.kept = 0        # traces stored (monotonic; ring may overwrite)
         self.dropped = 0     # completed traces the sampler discarded
 
@@ -159,7 +159,7 @@ class TraceConfig:
         self.latency_threshold_ms = (None if latency_threshold_ms is None
                                      else float(latency_threshold_ms))
         self._rng = random.Random(seed)
-        self._rng_lock = threading.Lock()
+        self._rng_lock = threading.Lock()  # guards: _rng
 
     def keep(self, flagged: bool) -> bool:
         if flagged:
@@ -226,7 +226,7 @@ class _TraceState:
         self.flags: set = set()
         self.open = 0
         self.root_done = False
-        self.lock = threading.Lock()
+        self.lock = threading.Lock()  # guards: open, root_done
 
     def span_started(self) -> None:
         with self.lock:

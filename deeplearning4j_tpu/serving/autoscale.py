@@ -247,7 +247,7 @@ class SLOAutoscaler:
         self._residency_lever = residency_lever or self._http_page_in
         self._now = now_fn
         self._states: Dict[str, _ModelState] = {}
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: decisions, _states
         self.decisions: deque = deque(maxlen=cfg.log_capacity)
         self.ticks = 0
         self._tick_capacity: Optional[Dict[str, Any]] = None

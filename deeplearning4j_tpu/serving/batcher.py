@@ -181,26 +181,27 @@ class ContinuousBatcher:
             self.metrics.set_dtype_policy(self.dtype_policy.label())
         self._graph_inputs = list(getattr(model.conf, "inputs", []) or [])
         self._warmed_pairs: List[tuple] = []  # (bucket, replica, dtype)
-        # guards _warmed_pairs (worker thread mints buckets while a
-        # control thread resizes) and serializes whole-resize operations
-        # (two racing target-chasing scale loops would thrash replicas)
-        self._warm_lock = threading.Lock()
-        self.resize_lock = threading.Lock()
+        # worker thread mints buckets while a control thread resizes
+        self._warm_lock = threading.Lock()  # guards: _warmed_pairs
+        # serializes whole-resize operations (two racing target-chasing
+        # scale loops would thrash replicas)
+        self.resize_lock = threading.Lock()  # guards: (whole-resize serialization)
         self._shutdown = False
         self._draining = False
         self._saw_sentinel = False
         self._carry: Optional[_Request] = None  # deferred overflow request
-        self._submit_lock = threading.Lock()  # vs shutdown: no orphan enqueues
+        # vs shutdown: no orphan enqueues after the drain flag flips
+        self._submit_lock = threading.Lock()  # guards: _draining
         self._example: Optional[ArrayOrDict] = None  # 1-row zeros template
         self._batch_seq = itertools.count(1)  # failure keys (breaker dedup)
         # pad-buffer pools: (bucket, input, shape, dtype) -> free np buffers
-        self._buf_lock = threading.Lock()
+        self._buf_lock = threading.Lock()  # guards: _buf_pool
         self._buf_pool: Dict[tuple, List[np.ndarray]] = {}
         # at most `depth` dispatched-unread batches; completion releases
         self._slots = (threading.BoundedSemaphore(self.pipeline_depth)
                        if self.pipeline_depth >= 1 else None)
         self._completion_q: "queue.Queue[_InFlight]" = queue.Queue()
-        self._completion_lock = threading.Lock()
+        self._completion_lock = threading.Lock()  # guards: _completion_closed
         self._completion_closed = False  # set once shutdown drained the queue
         if warmup_example is not None:
             self.warmup(warmup_example)

@@ -160,6 +160,9 @@ class FleetConfig:
         self.lock_timeout_s = float(lock_timeout_s)
         self.stale_lock_s = float(stale_lock_s)
         self.max_applied_actions = int(max_applied_actions)
+        # reload/mutate critical section; readers take the _last_valid
+        # reference lock-free by design (degrade-never-crash)
+        # guards: (reload/mutate critical section)
         self._lock = threading.Lock()
         self._last_valid = _empty_config()
         self._last_stat: Optional[Tuple[int, int]] = None
@@ -450,7 +453,7 @@ class LeaseElection:
         self.role = "follower"
         self.seq = 0                      # fencing token of OUR last lease
         self.elections: deque = deque(maxlen=64)
-        self._lock = threading.Lock()     # serializes ensure() steps
+        self._lock = threading.Lock()  # guards: (ensure()/heartbeat step serialization)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -713,6 +716,7 @@ class MultiRouterClient:
         self._config = config
         self.timeout_s = float(timeout_s)
         self._rr = itertools.count()
+        # guards: requests_total, failovers_total, router_requests
         self._lock = threading.Lock()
         self.requests_total = 0
         self.failovers_total = 0

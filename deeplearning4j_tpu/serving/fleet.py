@@ -80,7 +80,7 @@ class PidRegistry:
     test's cleanup)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: _children
         self._children: List[subprocess.Popen] = []
         self.active: List[Any] = []   # running supervisors of this tier
 
@@ -376,6 +376,9 @@ class FleetSupervisor:
         self.restarts = 0
         self._restart_times: deque = deque()
         self._failure: Optional[BaseException] = None
+        # spawn/restart/retire serialization: closes the watchdog-vs-
+        # deploy double-spawn race and covers _handles roster mutations
+        # guards: (spawn/restart/retire serialization)
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._watchdog: Optional[threading.Thread] = None

@@ -115,6 +115,7 @@ class ServingMetrics:
     def __init__(self, queue_depth_fn: Optional[Callable[[], int]] = None,
                  compile_count_fn: Optional[Callable[[], int]] = None,
                  inflight_fn: Optional[Callable[[], int]] = None):
+        # guards: requests_total, responses_total, rejected_overload, rejected_deadline, rejected_circuit, retries_total, errors_total, batches_total, rows_real_total, rows_padded_total, request_latency, batch_latency, dispatch_latency, quant_latency, float_latency, quantized_requests_total, dtype_policy_label, replica_batches, warmup_seconds, _qps_slots, _qps_times, _window_started_at
         self._lock = threading.Lock()
         self.started_at = time.monotonic()
         self._window_started_at = self.started_at  # reset_window restarts it
@@ -243,7 +244,7 @@ class ServingMetrics:
             self._window_started_at = time.monotonic()
 
     # -------------------------------------------------------------- reading
-    @property
+    @property                                           # holds: _lock
     def batch_occupancy(self) -> float:
         """Fraction of executed rows that were real requests (1.0 = no
         padding waste)."""

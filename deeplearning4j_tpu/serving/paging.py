@@ -299,6 +299,7 @@ class PagingMetrics:
     can sum them."""
 
     def __init__(self):
+        # guards: page_ins_total, evictions_total, page_in_queue_waits_total, page_in_rejections_total, page_in_failures_total, resident_hits_total, cold_hits_total, page_in_seconds, page_in_wait_seconds
         self._lock = threading.Lock()
         self.page_ins_total = 0
         self.page_in_failures_total = 0

@@ -111,7 +111,7 @@ class ServedModel:
         self._draining = False
         self._started = False  # flipped by the registry after the swap
         self._pins = 0         # in-flight requests holding this entry
-        self._pin_lock = threading.Lock()
+        self._pin_lock = threading.Lock()  # guards: _pins
         self.batcher.metrics.attach_breaker(self.breaker)
 
     # ------------------------------------------------------------- pinning
@@ -221,6 +221,7 @@ class ModelRegistry:
     budget where the backend reports one, else unbounded — paging off)."""
 
     def __init__(self, hbm_budget_bytes: Optional[int] = None):
+        # guards: _models, _residency, _reserved
         self._lock = threading.Lock()
         self._models: Dict[str, ServedModel] = {}
         # ------------------------------------------- paging state (ISSUE 11)
@@ -230,7 +231,7 @@ class ModelRegistry:
         self._residency: Dict[str, paging.Residency] = {}
         self._reserved: Dict[str, int] = {}  # in-build byte reservations
         self._flights: Dict[str, _PageFlight] = {}
-        self._flight_lock = threading.Lock()
+        self._flight_lock = threading.Lock()  # guards: _flights
         self.paging = paging.PagingMetrics()
 
     # ----------------------------------------------------------- HBM budget
@@ -257,7 +258,7 @@ class ModelRegistry:
         with self._lock:
             return self._resident_bytes_locked()
 
-    def _resident_bytes_locked(self, exclude: str = "") -> int:
+    def _resident_bytes_locked(self, exclude: str = "") -> int:  # holds: _lock
         total = sum(int(r.bytes or 0) for n, r in self._residency.items()
                     if r.state == paging.RESIDENT and n != exclude)
         return total + sum(v for n, v in self._reserved.items()
@@ -890,7 +891,7 @@ class ModelRegistry:
                        "live-registered)"))
             time.sleep(0.005)  # pins are request-scoped; retry shortly
 
-    def _pick_victim_locked(self, exclude: str = "") -> Optional[str]:
+    def _pick_victim_locked(self, exclude: str = "") -> Optional[str]:  # holds: _lock
         """The cost-weighted-LRU victim among evictable, unpinned
         resident models (``Residency.retention`` — dtype-aware: scored
         on the measured per-dtype device bytes, so an int8-resident

@@ -97,7 +97,7 @@ class ReplicaPool:
         # called directly from the dispatch stage — counted by aot_count()
         # so the batcher's compile ledger stays truthful
         self._aot = AotCache("replica")
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: _rr, _next_index
         self._rr = 0
         self.replicas: List[Replica] = []
         if self._fn is None:

@@ -72,6 +72,7 @@ class CircuitBreaker:
         self.reset_timeout_s = float(reset_timeout_s)
         self.half_open_probes = int(half_open_probes)
         self._clock = clock
+        # guards: _state, _failures, _seen_keys, _opened_at, _probes_issued, opens_total
         self._lock = threading.Lock()
         self._state = CircuitState.CLOSED
         self._failures: List[float] = []  # timestamps within window
@@ -81,11 +82,11 @@ class CircuitBreaker:
         self.opens_total = 0
 
     # ------------------------------------------------------------ internal
-    def _prune(self, now: float) -> None:
+    def _prune(self, now: float) -> None:  # holds: _lock
         cutoff = now - self.window_s
         self._failures = [t for t in self._failures if t > cutoff]
 
-    def _tick(self, now: float) -> None:
+    def _tick(self, now: float) -> None:  # holds: _lock
         """OPEN -> HALF_OPEN once the reset timeout elapses."""
         if (self._state is CircuitState.OPEN
                 and now - self._opened_at >= self.reset_timeout_s):

@@ -103,6 +103,7 @@ class RouterMetrics:
     ``runtime.profiler.router_stats()``."""
 
     def __init__(self):
+        # guards: requests_total, responses_total, errors_total, forwards_total, hedges_total, hedge_wins_total, hedges_discarded_total, failovers_total, shed_skips_total, deploys_total, request_latency, worker_requests
         self._lock = threading.Lock()
         self.requests_total = 0
         self.responses_total = 0        # 2xx returned to clients
@@ -207,6 +208,7 @@ class WorkerView:
         self.requests_total = 0
         self.failures_total = 0
         self.latency = LatencyHistogram()
+        # guards: inflight, requests_total, failures_total, latency
         self._lock = threading.Lock()
 
     def admittable(self, now: Optional[float] = None) -> bool:
@@ -235,12 +237,18 @@ class WorkerView:
 
     def snapshot(self) -> Dict[str, Any]:
         now = time.monotonic()
+        # counters read under the lock so a scrape sees one consistent
+        # view (inflight can never exceed requests_total in a snapshot)
+        with self._lock:
+            inflight = self.inflight
+            requests_total = self.requests_total
+            failures_total = self.failures_total
         return {"address": self.address, "ready": self.ready,
                 "draining": self.draining, "admittable": self.admittable(now),
                 "shedding_ms": max(0.0, (self.shed_until - now) * 1000.0),
-                "inflight": self.inflight,
-                "requests_total": self.requests_total,
-                "failures_total": self.failures_total,
+                "inflight": inflight,
+                "requests_total": requests_total,
+                "failures_total": failures_total,
                 "breaker": self.breaker.snapshot()}
 
 
@@ -288,7 +296,7 @@ class _Race:
 
     def __init__(self, metrics: RouterMetrics):
         self._metrics = metrics
-        self._cv = threading.Condition()
+        self._cv = threading.Condition()  # guards: winner, launched, finished, failures
         self.winner: Optional[_Attempt] = None
         self.launched = 0
         self.finished = 0
@@ -405,7 +413,7 @@ class FleetRouter:
         self._residency_view: Dict[str, Dict[str, Any]] = {}
         self._last_residency_refresh = 0.0
         self._views: Dict[str, WorkerView] = {}
-        self._views_lock = threading.Lock()
+        self._views_lock = threading.Lock()  # guards: _views
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._prober: Optional[threading.Thread] = None
@@ -1065,7 +1073,7 @@ class FleetRouter:
         Returns ``{worker_id: result}`` for the calls that returned
         non-None without raising."""
         results: Dict[str, Any] = {}
-        lock = threading.Lock()
+        lock = threading.Lock()  # guards: (results dict merge)
 
         def run(v):
             try:

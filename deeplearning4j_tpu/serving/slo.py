@@ -151,7 +151,7 @@ class SLOMonitor:
             raise ValueError(f"bad windows {windows_s!r}")
         self._horizon = max(self.windows_s)
         self._now_fn = now_fn
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: _models
         self._models: Dict[str, _ModelWindow] = {}
         self._targets: Dict[str, SLOTarget] = {}
         # hard cap on tracked model names: each window ring is ~5 lists x

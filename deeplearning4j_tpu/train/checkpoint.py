@@ -161,7 +161,7 @@ class CheckpointListener(TrainingListener):
                  every_n_epochs: Optional[int] = None,
                  every_n_minutes: Optional[float] = None,
                  keep_last: Optional[int] = None, keep_every: int = 1,
-                 save_updater: bool = True):
+                 save_updater: bool = True, clock=None):
         if not (every_n_iterations or every_n_epochs or every_n_minutes):
             raise ValueError("Configure at least one of every_n_iterations / "
                              "every_n_epochs / every_n_minutes")
@@ -176,7 +176,11 @@ class CheckpointListener(TrainingListener):
         # listener so a straggler step cannot write stale archives into a
         # directory the restarted run is checkpointing into
         self.armed = True
-        self._last_time = time.time()
+        # injectable clock (ISSUE 14: no wall clock in trajectory-adjacent
+        # modules — and the every_n_minutes cadence wants a monotonic
+        # reading anyway, immune to NTP steps mid-training)
+        self._clock = clock if clock is not None else time.monotonic
+        self._last_time = self._clock()
         self._saved: List[str] = []
         os.makedirs(dir, exist_ok=True)
         # Resume the checkpoint counter past anything already on disk: a
@@ -215,9 +219,9 @@ class CheckpointListener(TrainingListener):
     def iteration_done(self, model, iteration, epoch, score):
         if self.every_n_iterations and iteration % self.every_n_iterations == 0:
             self._save(model, f"iter{iteration}")
-        if self.every_n_minutes and (time.time() - self._last_time) >= 60 * self.every_n_minutes:
+        if self.every_n_minutes and (self._clock() - self._last_time) >= 60 * self.every_n_minutes:
             self._save(model, f"iter{iteration}")
-            self._last_time = time.time()
+            self._last_time = self._clock()
 
     def on_epoch_end(self, model, epoch):
         if self.every_n_epochs and (epoch + 1) % self.every_n_epochs == 0:

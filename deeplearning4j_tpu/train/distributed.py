@@ -122,7 +122,7 @@ def worker_env(extra: Optional[Dict[str, str]] = None) -> Dict[str, str]:
     return env
 
 
-_children_lock = threading.Lock()
+_children_lock = threading.Lock()  # guards: (_children pid registry)
 _children: List[subprocess.Popen] = []
 
 

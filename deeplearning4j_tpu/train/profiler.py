@@ -45,6 +45,7 @@ class TrainingProfiler:
 
     def __init__(self):
         from deeplearning4j_tpu.serving.metrics import LatencyHistogram
+        # guards: _totals, _counts, _hists, _t_start, _t_stop
         self._lock = threading.Lock()
         self._hists = {s: LatencyHistogram() for s in self.STAGES}
         self._totals = {s: 0.0 for s in self.STAGES}

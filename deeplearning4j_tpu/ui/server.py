@@ -288,7 +288,8 @@ class UIServer:
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         self.port = self._httpd.server_address[1]
-        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="ui-stats-server")
         self._thread.start()
         return self.port
 

@@ -33,17 +33,26 @@ import pytest  # noqa: E402
 
 import _round_record  # noqa: E402  (sibling module; pytest puts this dir on sys.path)
 
+# Lock-order witness (ISSUE 14): the whole tier-1 suite runs with lockdep
+# active (opt out with DL4J_TPU_LOCKDEP=0 when bisecting). The env var
+# must be set BEFORE the package import below — the package bootstrap
+# patches the threading constructors at import, so module-level locks are
+# witnessed too, and spawned fleet/distributed workers inherit the env.
+os.environ.setdefault("DL4J_TPU_LOCKDEP", "1")
+
+from deeplearning4j_tpu.analysis import lockdep as _lockdep  # noqa: E402
+from deeplearning4j_tpu.analysis.registry import (  # noqa: E402
+    PIPELINE_THREAD_NAMES as _PIPELINE_THREAD_NAMES,
+)
+
 # Thread names of the training pipeline's background stages (ISSUE 4),
-# the trace-collector fan-out fetchers (ISSUE 9: the router's /v1/traces
-# and fleet-/metrics aggregation joins its per-worker fetch threads before
-# returning), the SLO autoscaler control thread (ISSUE 10:
-# SLOAutoscaler.stop() must join it), and the lease-election heartbeat
-# threads (ISSUE 12: LeaseElection.stop() must join its heartbeat). Every
-# fit()/close()/aggregate/stop path must join these; a survivor after a
-# test means a leaked stage.
-_PIPELINE_THREAD_NAMES = ("train-prefetch", "train-listener-delivery",
-                          "async-dataset-iterator", "trace-collector",
-                          "slo-autoscaler", "lease-election")
+# the trace-collector fan-out fetchers (ISSUE 9), the SLO autoscaler
+# control thread (ISSUE 10), and the lease-election heartbeat threads
+# (ISSUE 12). Every fit()/close()/aggregate/stop path must join these; a
+# survivor after a test means a leaked stage. The tuple is IMPORTED from
+# the analysis registry (ISSUE 14) — the lint checks every
+# threading.Thread name against the same source, so the leak guard and
+# the linter can never drift.
 
 
 # --------------------------------------------------------------------------
@@ -131,6 +140,22 @@ def pytest_sessionfinish(session, exitstatus):
             json.dump(summary, f, indent=2)
     except OSError:
         pass
+
+
+@pytest.fixture(autouse=True)
+def _no_lockdep_violations():
+    """ISSUE 14 guard: the lock-order witness recorded no new violation
+    during this test. Cycle formation, blocking-while-holding and
+    waits-while-holding all land here, attributed to the test whose
+    traffic induced them (background threads may attribute a violation
+    one test late — the suite still fails loudly, with both witness
+    stacks in the report). Accepted edges live in
+    analysis/lockdep_allow.toml with a reason, nowhere else."""
+    yield
+    if not _lockdep.enabled():
+        return
+    new = _lockdep.take_new_violations()
+    assert not new, "lockdep violations:\n" + _lockdep.render_report(new)
 
 
 @pytest.fixture(autouse=True)

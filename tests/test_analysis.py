@@ -1,0 +1,426 @@
+"""ISSUE 14: the concurrency lockdep witness + project-invariant lint.
+
+Two layers of assurance, both proven HERE before they are trusted:
+
+1. Detector self-tests — fixture snippets with a KNOWN deadlock cycle,
+   blocking-while-holding, waits-while-holding, unguarded attribute,
+   unnamed thread, undocumented endpoint, and wallclock-in-trajectory
+   each must fire their detector (a checker that cannot fail its
+   fixtures proves nothing), plus a clean fixture that must produce
+   zero findings (no false positives).
+2. ``test_repo_is_clean`` — the full lint over the real package: every
+   finding class at zero. This is the tier-1 ratchet: a new thread
+   without a registered name, a new lock without a ``# guards:``
+   declaration, a chaos point missing docs/tests, an undocumented
+   route/metric — any of these fails CI from this commit on.
+
+The runtime witness also runs over the whole suite (conftest enables
+``DL4J_TPU_LOCKDEP=1``); its per-test guard lives in conftest, so every
+OTHER test doubles as a lockdep drill.
+"""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from deeplearning4j_tpu.analysis import lockdep
+from deeplearning4j_tpu.analysis.lint import Linter, run_lint
+from deeplearning4j_tpu.analysis.registry import (PIPELINE_THREAD_NAMES,
+                                                  THREAD_NAME_PREFIXES)
+
+_EMPTY = {"cycle": [], "blocking": [], "wait": []}
+
+
+def _witness():
+    return lockdep.Witness(allowlist=dict(_EMPTY))
+
+
+# ---------------------------------------------------------------------------
+# lockdep detectors
+
+
+def test_lock_order_cycle_detected_with_both_witness_stacks():
+    w = _witness()
+    a, b = w.make_lock("mod.A"), w.make_lock("mod.B")
+    with a:
+        with b:
+            pass
+    assert w.violations() == []          # one order alone is fine
+    with b:
+        with a:                          # the inversion closes the cycle
+            pass
+    vs = w.violations()
+    assert [v.kind for v in vs] == ["cycle"]
+    assert vs[0].key == "cycle:mod.B -> mod.A"
+    assert len(vs[0].stacks) == 2        # this thread's stack + the recorded edge's
+
+
+def test_cycle_detected_across_threads_without_an_actual_deadlock():
+    """The lockdep property: the cycle is flagged from the ORDER graph
+    even though the two threads never race — a deadlock that has not
+    happened yet is still reported."""
+    w = _witness()
+    a, b = w.make_lock("t.A"), w.make_lock("t.B")
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    th = threading.Thread(target=t1, name="trace-collector-fixture")
+    th.start()
+    th.join()
+
+    with b:
+        with a:
+            pass
+    assert [v.kind for v in w.violations()] == ["cycle"]
+
+
+def test_transitive_cycle_through_three_locks():
+    w = _witness()
+    a, b, c = (w.make_lock(n) for n in ("x.A", "x.B", "x.C"))
+    with a, b:
+        pass
+    with b, c:
+        pass
+    assert w.violations() == []
+    with c, a:
+        pass
+    assert [v.kind for v in w.violations()] == ["cycle"]
+
+
+def test_rlock_recursion_is_not_a_self_cycle():
+    w = _witness()
+    r = w.make_rlock("mod.R")
+    with r:
+        with r:
+            pass
+    assert w.violations() == []
+
+
+def test_same_class_instance_nesting_is_flagged():
+    w = _witness()
+    l1, l2 = w.make_lock("cls.L"), w.make_lock("cls.L")
+    with l1:
+        with l2:
+            pass
+    assert [v.kind for v in w.violations()] == ["cycle"]
+    assert "self-order" in w.violations()[0].message
+
+
+def test_wait_while_holding_condition_inversion():
+    w = _witness()
+    h = w.make_lock("mod.H")
+    cv = w.make_condition("mod.CV")
+    with cv:
+        cv.wait(timeout=0.01)            # alone: fine
+    assert w.violations() == []
+    with h:
+        with cv:
+            cv.wait(timeout=0.01)        # parks mod.H until notify
+    vs = w.violations()
+    assert [v.kind for v in vs] == ["wait-holding"]
+    assert "mod.H" in vs[0].key
+
+
+def test_blocking_queue_get_while_holding_is_flagged():
+    if not lockdep.enabled():
+        pytest.skip("lockdep disabled for this run (DL4J_TPU_LOCKDEP=0)")
+    with lockdep.isolated() as w:
+        lk = w.make_lock("mod.QL")
+        q = queue.Queue()
+        q.put(1)
+        with lk:
+            q.get(timeout=0.05)          # blocking get under a lock
+        with lk:
+            q.put(2)
+            q.get_nowait()               # non-blocking: allowed
+    kinds = [(v.kind, v.key) for v in w.violations()]
+    assert kinds == [("blocking", "blocking:mod.QL @ queue.get")]
+
+
+def test_chaos_hang_while_holding_is_flagged():
+    if not lockdep.enabled():
+        pytest.skip("lockdep disabled for this run (DL4J_TPU_LOCKDEP=0)")
+    from deeplearning4j_tpu.runtime.chaos import (ChaosCancelled,
+                                                  ChaosController,
+                                                  HangUntilCancelled)
+    with lockdep.isolated() as w:
+        lk = w.make_lock("mod.HL")
+        with ChaosController(seed=1) as c:
+            c.on("fixture.hang", HangUntilCancelled(timeout_s=0.05))
+            with lk:
+                with pytest.raises(ChaosCancelled):
+                    from deeplearning4j_tpu.runtime import chaos
+                    chaos.inject("fixture.hang")
+    assert [v.kind for v in w.violations()] == ["blocking"]
+    assert "chaos.hang" in w.violations()[0].key
+
+
+def test_allowlisted_edge_is_not_a_violation():
+    allow = {"cycle": [{"edge": "al.B -> al.A", "reason": "fixture"}],
+             "blocking": [], "wait": []}
+    w = lockdep.Witness(allowlist=allow)
+    a, b = w.make_lock("al.A"), w.make_lock("al.B")
+    with a, b:
+        pass
+    with b, a:
+        pass
+    assert w.violations() == []
+
+
+def test_allowlist_parser_roundtrip_and_reason_required():
+    text = """
+# comment
+[[cycle]]
+edge = "a -> b"
+reason = "why"
+
+[[blocking]]
+lock = "x"
+op = "queue.get"
+reason = "bounded"
+"""
+    parsed = lockdep.parse_allowlist(text)
+    assert parsed["cycle"] == [{"edge": "a -> b", "reason": "why"}]
+    assert parsed["blocking"][0]["op"] == "queue.get"
+    with pytest.raises(ValueError):
+        lockdep.parse_allowlist('[[cycle]]\nedge = "a -> b"\n')
+    with pytest.raises(ValueError):
+        lockdep.parse_allowlist("[[nonsense]]\n")
+
+
+def test_violations_deduplicate_and_take_new_cursor():
+    w = _witness()
+    a, b = w.make_lock("d.A"), w.make_lock("d.B")
+    for _ in range(3):
+        with a, b:
+            pass
+        with b, a:
+            pass
+    assert len(w.violations()) == 1      # same key recorded once
+    assert len(w.take_new_violations()) == 1
+    assert w.take_new_violations() == []  # cursor advanced
+
+
+def test_out_of_order_release_keeps_held_stack_consistent():
+    w = _witness()
+    a, b = w.make_lock("o.A"), w.make_lock("o.B")
+    a.acquire()
+    b.acquire()
+    a.release()                          # out of order (legal)
+    assert w.held_names() == ["o.B"]
+    b.release()
+    assert w.held_names() == []
+
+
+def test_condition_proxy_is_a_working_condition():
+    """The proxy must still BE a condition: notify wakes a waiter."""
+    w = _witness()
+    cv = w.make_condition("mod.WCV")
+    hits = []
+
+    def waiter():
+        with cv:
+            hits.append(cv.wait(timeout=5.0))
+
+    th = threading.Thread(target=waiter, name="trace-collector-fixture")
+    th.start()
+    time.sleep(0.05)
+    with cv:
+        cv.notify_all()
+    th.join(timeout=5)
+    assert hits == [True]
+    assert w.violations() == []
+
+
+# ---------------------------------------------------------------------------
+# lint detectors (fixture snippets through Linter.lint_source)
+
+
+def _lint(src, path="serving/fixture.py"):
+    return Linter().lint_source(path, src)
+
+
+def test_lint_unnamed_thread_fixture_caught():
+    fs = _lint("import threading\n"
+               "t = threading.Thread(target=print)\n")
+    assert [f.code for f in fs] == ["THREAD-UNNAMED"]
+
+
+def test_lint_unregistered_thread_name_caught():
+    fs = _lint("import threading\n"
+               "t = threading.Thread(target=print, name='rogue-worker')\n")
+    assert [f.code for f in fs] == ["THREAD-UNREGISTERED"]
+
+
+def test_lint_registered_thread_names_clean():
+    src = ("import threading\n"
+           "def go(wid):\n"
+           "    t = threading.Thread(target=print,\n"
+           "                         name=f'trace-collector-{wid}')\n"
+           "    u = threading.Thread(target=print, name='slo-autoscaler')\n")
+    assert _lint(src) == []
+
+
+def test_lint_thread_name_resolved_through_parameter_default():
+    src = ("import threading\n"
+           "def go(name='train-prefetch'):\n"
+           "    t = threading.Thread(target=print, name=name)\n")
+    assert _lint(src) == []
+
+
+def test_lint_undeclared_lock_caught_and_declared_clean():
+    bad = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n")
+    assert [f.code for f in _lint(bad)] == ["LOCK-UNDECLARED"]
+    good = bad.replace("threading.Lock()",
+                       "threading.Lock()  # guards: _x")
+    assert _lint(good) == []
+
+
+def test_lint_unguarded_attribute_access_caught():
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()  # guards: _x\n"
+           "        self._x = 0\n"                    # __init__ exempt
+           "    def good(self):\n"
+           "        with self._lock:\n"
+           "            self._x += 1\n"
+           "    def bad(self):\n"
+           "        return self._x\n")
+    fs = _lint(src)
+    assert [f.code for f in fs] == ["GUARD-VIOLATION"]
+    assert "C.bad" in fs[0].message
+    held = src.replace("    def bad(self):",
+                       "    def bad(self):  # holds: _lock")
+    assert _lint(held) == []
+
+
+def test_lint_wallclock_in_trajectory_module_caught():
+    src = "import time\nT0 = time.time()\n"
+    fs = _lint(src, path="train/fixture.py")
+    assert [f.code for f in fs] == ["WALLCLOCK"]
+    # same code outside the trajectory set: fine
+    assert _lint(src, path="serving/fixture.py") == []
+    # monotonic is always fine
+    assert _lint("import time\nT0 = time.monotonic()\n",
+                 path="train/fixture.py") == []
+    # reviewed escape hatch
+    ok = "import time\nT0 = time.time()  # lint: wallclock-ok (fixture)\n"
+    assert _lint(ok, path="train/fixture.py") == []
+
+
+def test_lint_random_module_in_trajectory_module_caught():
+    src = "import random\nx = random.random()\n"
+    assert [f.code for f in _lint(src, path="models/fixture.py")] \
+        == ["WALLCLOCK"]
+    # numpy/jax RNG use does not trip the stdlib-random detector
+    assert _lint("import numpy as np\nx = np.random.default_rng(0)\n",
+                 path="models/fixture.py") == []
+
+
+def test_lint_undocumented_endpoint_and_metric_fixtures_caught():
+    lin = Linter()
+    lin._file_pass("serving/fixture.py", (
+        'def h(self):\n'
+        '    if self.path == "/v1/made_up_endpoint":\n'
+        '        pass\n'
+        '    lines = [f"serving_made_up_total{{m}} {1}"]\n'))
+    lin._all_sources["serving/fixture.py"] = ""
+    lin._cross_checks()
+    codes = sorted(f.code for f in lin.findings
+                   if f.path == "serving/fixture.py")
+    assert codes == ["METRIC-UNDOCUMENTED", "ROUTE-UNDOCUMENTED"]
+
+
+def test_lint_unregistered_chaos_point_fixture_caught():
+    lin = Linter()
+    lin._file_pass("serving/fixture.py",
+                   'from deeplearning4j_tpu.runtime import chaos\n'
+                   'chaos.inject("fixture.not.registered")\n')
+    lin._all_sources["serving/fixture.py"] = ""
+    lin._cross_checks()
+    assert any(f.code == "CHAOS-UNREGISTERED" for f in lin.findings)
+
+
+def test_lint_clean_fixture_has_no_findings():
+    """No-false-positive control: idiomatic, disciplined code."""
+    src = (
+        "import threading\n"
+        "import queue\n"
+        "class Worker:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()  # guards: _state\n"
+        "        self._state = {}\n"
+        "        self._q = queue.Queue()\n"
+        "        self._t = threading.Thread(target=self._run, daemon=True,\n"
+        "                                   name='train-prefetch')\n"
+        "    def _run(self):\n"
+        "        item = self._q.get()\n"
+        "        with self._lock:\n"
+        "            self._state[item] = True\n"
+        "    def snapshot(self):\n"
+        "        with self._lock:\n"
+        "            return dict(self._state)\n")
+    assert _lint(src) == []
+
+
+# ---------------------------------------------------------------------------
+# the ratchet + registry drift
+
+
+def test_repo_is_clean():
+    """The full project lint over the real package: zero findings.
+
+    When this fails, read the finding — it names the file, line and the
+    registry/doc that needs updating (docs/static_analysis.md has the
+    playbook per finding code)."""
+    findings = run_lint()
+    assert not findings, "project lint findings:\n" + \
+        "\n".join(repr(f) for f in findings)
+
+
+def test_pipeline_thread_names_cannot_drift_from_registry():
+    """Satellite: conftest imports its leak-guard tuple FROM the analysis
+    registry, and every leak-guarded name is a registered prefix."""
+    import conftest
+    assert conftest._PIPELINE_THREAD_NAMES is PIPELINE_THREAD_NAMES
+    for name in PIPELINE_THREAD_NAMES:
+        assert any(name.startswith(p) for p in THREAD_NAME_PREFIXES)
+
+
+def test_registered_points_registry_is_well_formed():
+    from deeplearning4j_tpu.runtime.chaos import REGISTERED_POINTS
+    assert len(REGISTERED_POINTS) >= 20
+    for point, desc in REGISTERED_POINTS.items():
+        assert point and desc and isinstance(desc, str)
+        assert point == point.strip() and " " not in point
+
+
+def test_cli_json_output(tmp_path):
+    """python -m deeplearning4j_tpu.analysis --json emits machine-readable
+    findings and exits non-zero iff findings exist."""
+    import json as _json
+
+    from deeplearning4j_tpu.analysis import lint as lint_mod
+    out = lint_mod.to_json(run_lint())
+    payload = _json.loads(out)
+    assert payload["count"] == 0 and payload["findings"] == []
+
+
+def test_lockdep_suite_guard_is_active():
+    """Acceptance: the tier-1 suite really runs with the witness on (a
+    disabled witness would make every other guard vacuous). Opt-out runs
+    (DL4J_TPU_LOCKDEP=0) skip."""
+    import os
+    if os.environ.get("DL4J_TPU_LOCKDEP") == "0":
+        pytest.skip("lockdep explicitly disabled for this run")
+    assert lockdep.enabled()
+    assert threading.Lock is lockdep._patched_lock

@@ -961,3 +961,85 @@ def test_check_tables_validates_control_plane_section(tmp_path):
     msgs = []
     assert bench.check_tables(str(md), str(extra), log=msgs.append) == 0
     assert any("control_plane" in m and "WARN" in m for m in msgs)
+
+
+def _analysis_section():
+    """A self-consistent BENCH_EXTRA.json["analysis"] section (the
+    ISSUE 14 lockdep-overhead + lint record)."""
+    return {
+        "off": {"qps": 4178.0, "bit_identical": True},
+        "on": {"qps": 4131.6, "bit_identical": True},
+        "overhead_pct": 1.11,
+        "bound_pct": 5.0,
+        "lint_findings": 0,
+        "lockdep_lock_classes": 7,
+        "lockdep_edges": 1,
+        "lockdep_violations": 0,
+    }
+
+
+def _extra_with_analysis(section):
+    measured = {k: _mid(*rng) for k, rng in bench.RECORDED_RANGES.items()}
+    measured["analysis"] = section
+    measured["analysis_lockdep_overhead_pct"] = section.get("overhead_pct")
+    return measured
+
+
+def test_check_tables_validates_analysis_section(tmp_path):
+    """ISSUE 14 satellite: --check-tables covers the analysis keys — a
+    self-consistent recorded section passes, and each drift class
+    (overhead not recomputable from the arm qps rows, overhead over the
+    recorded bound, non-bit-identical arms, a dirty lint, recorded
+    violations, an inert witness, stale top-level copy, missing keys)
+    fails loudly."""
+    md = tmp_path / "BASELINE.md"
+    md.write_text(_table_md(bench.RECORDED_RANGES))
+    extra = tmp_path / "BENCH_EXTRA.json"
+
+    extra.write_text(json.dumps(_extra_with_analysis(_analysis_section())))
+    assert bench.check_tables(str(md), str(extra), log=lambda *a: None) == 0
+
+    def failing(mutate, needle):
+        s = _analysis_section()
+        mutate(s)
+        ex = _extra_with_analysis(s)
+        extra.write_text(json.dumps(ex))
+        msgs = []
+        assert bench.check_tables(str(md), str(extra),
+                                  log=msgs.append) == 1, needle
+        assert any(needle in m for m in msgs), (needle, msgs)
+
+    failing(lambda s: s.update(overhead_pct=0.3),
+            "recorded arm qps rows give")
+    failing(lambda s: (s.update(bound_pct=1.0)), "over the recorded")
+    failing(lambda s: s["on"].update(bit_identical=False),
+            "analysis.on: bit_identical")
+    failing(lambda s: s.update(lint_findings=3), "analysis.lint_findings")
+    failing(lambda s: s.update(lockdep_violations=1),
+            "analysis.lockdep_violations")
+    failing(lambda s: s.update(lockdep_lock_classes=0),
+            "not actually witnessed")
+    failing(lambda s: s.pop("bound_pct"), "missing from the recorded")
+
+    # stale top-level copy
+    ex = _extra_with_analysis(_analysis_section())
+    ex["analysis_lockdep_overhead_pct"] = 0.5
+    # keep the section's own overhead recomputable so ONLY the copy drifts
+    extra.write_text(json.dumps(ex))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("analysis_lockdep_overhead_pct: top-level copy" in m
+               for m in msgs)
+
+
+def test_check_tables_analysis_absent_is_warning(tmp_path):
+    """No --analysis run recorded yet -> warn, don't fail (same contract
+    as the other optional sections)."""
+    md = tmp_path / "BASELINE.md"
+    md.write_text(_table_md(bench.RECORDED_RANGES))
+    measured = {k: _mid(*rng) for k, rng in bench.RECORDED_RANGES.items()}
+    extra = tmp_path / "BENCH_EXTRA.json"
+    extra.write_text(json.dumps(measured))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 0
+    assert any("analysis" in m and "WARN" in m for m in msgs)

@@ -609,3 +609,63 @@ def test_hang_recovers_when_fault_clears(tmp_path):
     assert net._epoch == 2
     ev = net.evaluate(it)
     assert ev.accuracy() > 0.8
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 14: every REGISTERED_POINTS entry must be exercised by a drill —
+# these four points existed in code but had no test firing them (the
+# analysis lint now fails the suite if one regresses to untested).
+
+def test_chaos_point_batcher_submit_is_explicit_error():
+    from deeplearning4j_tpu.serving import ContinuousBatcher
+    net = MultiLayerNetwork(_mln_conf()).init()
+    b = ContinuousBatcher(net, max_batch_size=4, batch_timeout_ms=1.0)
+    x = _data(2)
+    try:
+        with ChaosController(seed=3) as c:
+            c.on("serving.batcher.submit", FailNth(1))
+            with pytest.raises(ChaosError):
+                b.submit(x)
+        # the fault was one admission, not the batcher: next request serves
+        got = np.asarray(b.submit(x))
+        np.testing.assert_array_equal(got, np.asarray(net.output(x)))
+    finally:
+        b.shutdown()
+
+
+def test_chaos_points_registry_register_and_deploy(tmp_path):
+    reg = ModelRegistry()
+    net = MultiLayerNetwork(_mln_conf()).init()
+    try:
+        with ChaosController(seed=3) as c:
+            c.on("serving.registry.register", FailNth(1))
+            with pytest.raises(ChaosError):
+                reg.register("m", net, warmup_example=_data(1))
+        assert "m" not in reg.names()
+        # registration succeeds once the fault clears
+        reg.register("m", net, warmup_example=_data(1))
+        assert "m" in reg.names()
+        # deploy_quantized faults BEFORE the gate/build: old version intact
+        with ChaosController(seed=3) as c:
+            c.on("serving.registry.deploy_quantized", FailNth(1))
+            with pytest.raises(ChaosError):
+                reg.deploy_quantized("m", str(tmp_path / "none.zip"),
+                                     eval_inputs=[_data(2)])
+        assert reg.get("m").model is net
+    finally:
+        reg.shutdown()
+
+
+def test_chaos_point_checkpoint_write_fails_cleanly(tmp_path):
+    net = MultiLayerNetwork(_mln_conf()).init()
+    path = tmp_path / "ckpt.zip"
+    with ChaosController(seed=3) as c:
+        c.on("train.checkpoint.write", FailNth(1))
+        with pytest.raises(ChaosError):
+            atomic_save_model(net, str(path))
+    # the faulted write left nothing behind — no archive, no tmp litter
+    assert not path.exists()
+    assert [p for p in os.listdir(tmp_path) if not p.startswith(".")] == []
+    # and the next write lands atomically as usual
+    entry = atomic_save_model(net, str(path))
+    assert path.exists() and verify_checkpoint(str(path), entry)
