@@ -244,6 +244,13 @@ def check_tables(baseline_md=None, bench_extra=None, log=_log):
     if measured is not None:
         check_analysis_section(measured, failures, warnings)
 
+    # ISSUE 15 blackbox keys: incident opened within the tick budget,
+    # zero-error bit-identical drill, bundle timeline complete/ordered/
+    # trace-linked/gapless, journal A/B overhead recomputable and under
+    # the 1% bound
+    if measured is not None:
+        check_blackbox_section(measured, failures, warnings)
+
     for w in warnings:
         log(f"[check-tables] WARN {w}")
     for fmsg in failures:
@@ -4141,6 +4148,509 @@ def check_analysis_section(extra, failures, warnings):
         failures.append(f"analysis: malformed section ({e!r})")
 
 
+def bench_blackbox(n_threads=16, per_thread=40, bench_extra=None, log=_log):
+    """``bench.py --blackbox`` (ISSUE 15): the black-box drill of record.
+
+    Phase A — seeded incident: a routed 3-worker subprocess fleet under
+    seeded straggler chaos and sustained load; SIGKILL the busiest
+    worker. Asserted before anything is written:
+
+    - the anomaly watchdog (ticked at a fixed 0.5 s control cadence)
+      opens an incident within 2 ticks of the kill,
+    - ZERO client-visible errors and every response bit-identical to the
+      in-process oracle (the PR 7 failover guarantee, re-proven with the
+      journal on),
+    - ONE ``GET /v1/debug/bundle`` pull reconstructs the full timeline —
+      kill -> breaker open -> failover -> supervisor restart -> router
+      readmit, in merged order, every timeline event trace-linked, the
+      merged view wall-ordered and per-incarnation seq-GAPLESS — and
+      carries journal/traces/metrics/capacity/slo/watchdog/stacks
+      sections.
+
+    Phase B — overhead: order-alternated journal-on vs journal-off A/B
+    over the ``--serving`` workload shape (fresh identically-seeded
+    batcher per round, per-arm best-of) — journal-on serving must cost
+    < 1% qps with every response bit-identical to the seeded reference
+    (no journal event fires per-request on the serving hot path; the
+    bound proves it).
+
+    Results -> ``BENCH_EXTRA.json["blackbox"]`` + top-level
+    ``blackbox_journal_overhead_pct``, validated by ``--check-tables``.
+    """
+    import io
+    import shutil
+    import tarfile
+    import tempfile
+    import threading
+    import urllib.request
+
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.runtime import journal, trace
+    from deeplearning4j_tpu.runtime.environment import get_environment
+    from deeplearning4j_tpu.serving import ModelRegistry, blackbox
+    from deeplearning4j_tpu.serving.fleet import FleetSupervisor, WorkerSpec
+    from deeplearning4j_tpu.serving.router import FleetRouter
+
+    failures = []
+    results = {}
+
+    # ------------------------------------------------ phase A: incident
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(None)
+            .list()
+            .layer(DenseLayer(n_out=32, activation="tanh"))
+            .layer(OutputLayer(n_out=8, activation="softmax"))
+            .set_input_type(InputType.feed_forward(16))
+            .build())
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(16, 16)).astype(np.float32)
+    batcher_kw = dict(max_batch_size=4, buckets=[1, 4],
+                      batch_timeout_ms=1.0, pipeline_depth=0)
+    td = tempfile.mkdtemp(prefix="dl4j-bench-blackbox-")
+    archive = os.path.join(td, "model-v1.zip")
+    cache = os.path.join(td, "executable-cache")
+    MultiLayerNetwork(conf).init().save(archive)
+    get_environment().set_compile_cache(cache)
+    reg = ModelRegistry()
+    reg.load("m", archive, warmup_example=xs[:1], **batcher_kw)
+    oracle = reg.get("m").model
+    oracle_cache = {}
+
+    def oracle_out(n, ofs):
+        if (n, ofs) not in oracle_cache:
+            outs = []
+            for bucket in (b for b in batcher_kw["buckets"] if b >= n):
+                padded = np.concatenate(
+                    [xs[ofs:ofs + n],
+                     np.zeros((bucket - n, xs.shape[1]), xs.dtype)], axis=0)
+                outs.append(np.asarray(oracle.output(padded))[:n])
+            oracle_cache[(n, ofs)] = outs
+        return oracle_cache[(n, ofs)]
+
+    reg.shutdown()
+
+    journal.enable(capacity=8192)
+    trace.enable(rate=0.0, capacity=512)  # flagged-only keep; ids for all
+    specs = [WorkerSpec(worker_id=f"b{i}", model_name="m", archive=archive,
+                        version=1, batcher_kw=dict(batcher_kw),
+                        cache_dir=cache,
+                        straggle={"p": 0.15, "ms": 80.0, "seed": 31 + i})
+             for i in range(3)]
+    sup = FleetSupervisor(specs, run_dir=os.path.join(td, "run"),
+                          max_restarts=4, heartbeat_timeout_s=60.0)
+    tick_s = 0.5
+    try:
+        sup.start()
+        router = FleetRouter(sup, hedge_enabled=True, hedge_factor=0.5,
+                             probe_interval_s=0.1, hedge_initial_ms=250.0)
+        wd = blackbox.AnomalyWatchdog(
+            rules=[blackbox.RateRule(
+                "restart_storm",
+                {"fleet.worker_kill", "fleet.worker_restart"},
+                threshold=1, window_s=120.0)],
+            interval_s=1e9,  # probe loop never ticks it: WE do, at tick_s
+            clear_after_s=600.0)
+        router.attach_watchdog(wd)
+        port = router.start(0)
+        try:
+            outs, lock, stop = [], threading.Lock(), threading.Event()
+
+            def client(tid):
+                import urllib.request as _rq
+                k = 0
+                while not stop.is_set():
+                    n, ofs = 1 + (tid + k) % 4, (3 * k + tid) % 8
+                    body = json.dumps(
+                        {"inputs": xs[ofs:ofs + n].tolist(),
+                         "timeout_ms": 15000}).encode()
+                    try:
+                        resp = _rq.urlopen(_rq.Request(
+                            f"http://127.0.0.1:{port}/v1/models/m/predict",
+                            data=body), timeout=60)
+                        out = json.loads(resp.read())
+                        rec = ("ok", n, ofs,
+                               np.asarray(out["outputs"], np.float32))
+                    except Exception as e:
+                        rec = (f"error:{type(e).__name__}", n, ofs, None)
+                    with lock:
+                        outs.append(rec)
+                    k += 1
+                    time.sleep(0.005)
+
+            threads = [threading.Thread(target=client, args=(i,),
+                                        daemon=True) for i in range(4)]
+            for t in threads:
+                t.start()
+            time.sleep(0.8)  # steady state
+            victim = router.ranked_workers("m")[0].worker_id
+            # drill knob: one in-flight connection fault opens the
+            # victim's passive breaker deterministically
+            router.workers()[victim].breaker.failure_threshold = 1
+            kill_wall = time.time()
+            sup.kill_worker(victim)
+            opened_within = None
+            for tick in range(1, 9):
+                time.sleep(tick_s)
+                if any(e["type"] == "incident.open" for e in wd.tick()):
+                    opened_within = tick
+                    break
+                if wd.snapshot()["open"]:
+                    opened_within = tick
+                    break
+            if opened_within is None or opened_within > 2:
+                failures.append(f"watchdog opened the incident in "
+                                f"{opened_within} control ticks (budget: 2)")
+            deadline = time.monotonic() + 120
+            readmitted = False
+            while time.monotonic() < deadline:
+                evs = journal.events(types={"router.worker_ready"},
+                                     since=kill_wall)
+                if any(e["attrs"]["worker"] == victim for e in evs):
+                    readmitted = True
+                    break
+                time.sleep(0.1)
+            if not readmitted:
+                failures.append("killed worker never readmitted")
+            time.sleep(0.3)
+            stop.set()
+            for t in threads:
+                t.join(timeout=120)
+            errors = [o for o in outs if o[0] != "ok"]
+            if errors:
+                failures.append(f"incident drill: {len(errors)} "
+                                f"client-visible error(s): {errors[:3]}")
+            wrong = sum(
+                1 for tag, n, ofs, got in outs if tag == "ok"
+                and not any((got == ref).all() for ref in oracle_out(n, ofs)))
+            if wrong:
+                failures.append(f"incident drill: {wrong} responses not "
+                                f"bit-identical to the oracle")
+
+            # ---- ONE bundle pull reconstructs everything ------------
+            data = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/debug/bundle",
+                timeout=60).read()
+            with tarfile.open(fileobj=io.BytesIO(data)) as tf:
+                names = set(tf.getnames())
+                events = json.load(
+                    tf.extractfile("journal.json"))["events"]
+            required = {"journal.json", "traces.json", "metrics.txt",
+                        "capacity.json", "slo.json", "watchdog.json",
+                        "manifest.json"}
+            if not required <= names:
+                failures.append(f"bundle missing sections: "
+                                f"{sorted(required - names)}")
+            stack_files = [n for n in names if n.startswith("stacks/")]
+            if len(stack_files) < 4:  # router + 3 workers
+                failures.append(f"bundle carries {len(stack_files)} stack "
+                                f"samples; want router + every worker")
+
+            def first_index(pred):
+                for i, e in enumerate(events):
+                    if pred(e):
+                        return i
+                return None
+
+            marks = {
+                "kill": first_index(
+                    lambda e: e["type"] == "fleet.worker_kill"
+                    and e["attrs"]["worker"] == victim),
+                "breaker_open": first_index(
+                    lambda e: e["type"] == "breaker.open"
+                    and e["attrs"].get("scope") == f"worker:{victim}"),
+                "failover": first_index(
+                    lambda e: e["type"] == "router.failover"
+                    and e["ts"] >= kill_wall - 1),
+                "restart": first_index(
+                    lambda e: e["type"] == "fleet.worker_restart"
+                    and e["attrs"]["worker"] == victim),
+                "readmit": first_index(
+                    lambda e: e["type"] == "router.worker_ready"
+                    and e["attrs"]["worker"] == victim
+                    and e["ts"] >= kill_wall),
+            }
+            timeline_complete = None not in marks.values()
+            if not timeline_complete:
+                failures.append(f"bundle timeline incomplete: "
+                                f"{ {k: v for k, v in marks.items()} }")
+            ordered = trace_linked = False
+            if timeline_complete:
+                ordered = (marks["kill"] < marks["breaker_open"]
+                           and marks["kill"] < marks["failover"]
+                           and marks["kill"] < marks["restart"]
+                           < marks["readmit"])
+                if not ordered:
+                    failures.append(f"bundle timeline out of order: {marks}")
+                trace_linked = all(events[i]["trace_id"]
+                                   for i in marks.values())
+                if not trace_linked:
+                    failures.append("timeline events missing trace links")
+            ts = [e["ts"] for e in events]
+            wall_ordered = ts == sorted(ts)
+            if not wall_ordered:
+                failures.append("merged journal not wall-ordered")
+            by_inc = {}
+            for e in events:
+                by_inc.setdefault(e["incarnation"], []).append(e["seq"])
+            gapless = all(
+                seqs == list(range(seqs[0], seqs[0] + len(seqs)))
+                for seqs in by_inc.values())
+            if not gapless:
+                failures.append("seq gap inside an incarnation's stream")
+            incident_idx = first_index(
+                lambda e: e["type"] == "incident.open")
+            if incident_idx is None:
+                failures.append("bundle journal carries no incident.open")
+            results["incident"] = {
+                "victim": victim,
+                "requests": len(outs),
+                "errors": 0,
+                "matches_oracle": bool(not wrong),
+                "opened_within_ticks": opened_within,
+                "tick_budget": 2,
+                "tick_s": tick_s,
+                "bundle_sections": sorted(required & names),
+                "stack_samples": len(stack_files),
+                "timeline_complete": timeline_complete,
+                "timeline_ordered": bool(ordered),
+                "timeline_trace_linked": bool(trace_linked),
+                "journal_wall_ordered": wall_ordered,
+                "journal_gapless": gapless,
+                "merged_events": len(events),
+                "processes": len(by_inc),
+            }
+            log(f"[blackbox] incident: SIGKILL {victim} -> incident in "
+                f"{opened_within} tick(s), 0/{len(outs)} errors, bundle "
+                f"reconstructs kill->breaker->failover->restart->readmit "
+                f"({len(events)} merged events, {len(by_inc)} processes, "
+                f"trace-linked, gapless)")
+        finally:
+            router.stop()
+    finally:
+        sup.stop()
+        trace.disable()
+        journal.enable(capacity=1024)
+        # td (and the compile cache inside it) lives until the END of
+        # phase B — the B rounds still write cache entries there
+
+    if failures:
+        for fmsg in failures:
+            log(f"[blackbox] FAIL {fmsg}")
+        shutil.rmtree(td, ignore_errors=True)
+        return 1
+
+    # ------------------------------------------------ phase B: overhead
+    import threading as _threading
+
+    def conf_b():
+        return (NeuralNetConfiguration.builder().seed(7).updater(None)
+                .list()
+                .layer(DenseLayer(n_out=1024, activation="relu"))
+                .layer(DenseLayer(n_out=1024, activation="relu"))
+                .layer(OutputLayer(n_out=8, activation="softmax"))
+                .set_input_type(InputType.feed_forward(256)).build())
+
+    xb = np.random.default_rng(0).normal(0, 1, (256, 256)).astype(np.float32)
+    total = n_threads * per_thread
+    arm_nets = {"off": MultiLayerNetwork(conf_b()).init(),
+                "on": MultiLayerNetwork(conf_b()).init()}
+
+    def run_round(journaled):
+        from deeplearning4j_tpu.serving import ContinuousBatcher
+        if journaled:
+            journal.enable(capacity=1024)
+        else:
+            journal.disable()
+        try:
+            net = arm_nets["on" if journaled else "off"]
+            b = ContinuousBatcher(net, max_batch_size=32,
+                                  batch_timeout_ms=1.0, queue_limit=4096,
+                                  warmup_example=xb[:1], replicas=1,
+                                  pipeline_depth=4)
+            for n in (1, 2, 3, 4):
+                b.submit(xb[:n])
+            outcomes = {}
+            olock = _threading.Lock()
+
+            def client(i):
+                for j in range(per_thread):
+                    k = i * per_thread + j
+                    ofs, n = (k * 7) % 200, 1 + (k % 4)
+                    try:
+                        got = np.asarray(b.submit(xb[ofs:ofs + n],
+                                                  timeout_ms=60_000))
+                        with olock:
+                            outcomes[k] = got
+                    except Exception as e:
+                        with olock:
+                            outcomes[k] = type(e).__name__
+            threads = [_threading.Thread(target=client, args=(i,))
+                       for i in range(n_threads)]
+            wait_for_quiet_host()
+            t0 = time.monotonic()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            elapsed = time.monotonic() - t0
+            buckets = list(b.buckets)
+            b.shutdown()
+            return outcomes, elapsed, buckets
+        finally:
+            journal.enable(capacity=1024)
+
+    ref = MultiLayerNetwork(conf_b()).init()
+    ref_cache = {}
+
+    def pad_rows(a, bk):
+        return np.concatenate(
+            [a, np.zeros((bk - a.shape[0],) + a.shape[1:], a.dtype)], axis=0)
+
+    def ref_at(ofs, n, bk):
+        key = (ofs, n, bk)
+        if key not in ref_cache:
+            ref_cache[key] = np.asarray(
+                ref.output(pad_rows(xb[ofs:ofs + n], bk)))[:n]
+        return ref_cache[key]
+
+    best = {}
+    bit_identical = {"off": True, "on": True}
+    for pair in (("off", "on"), ("on", "off"), ("off", "on"),
+                 ("on", "off")):
+        for tag in pair:
+            outcomes, elapsed, buckets = run_round(tag == "on")
+            if len(outcomes) != total:
+                failures.append(f"{tag}: {len(outcomes)}/{total} "
+                                f"requests accounted")
+            errs = sum(1 for v in outcomes.values() if isinstance(v, str))
+            if errs:
+                failures.append(f"{tag}: {errs} request errors")
+            wrong = 0
+            for k, got in outcomes.items():
+                if isinstance(got, str):
+                    continue
+                ofs, n = (k * 7) % 200, 1 + (k % 4)
+                if not any((got == ref_at(ofs, n, bk)).all()
+                           for bk in buckets if bk >= n):
+                    wrong += 1
+            if wrong:
+                bit_identical[tag] = False
+                failures.append(f"{tag}: {wrong} responses not "
+                                f"bit-identical to the seeded reference")
+            if tag not in best or elapsed < best[tag]:
+                best[tag] = elapsed
+            log(f"[blackbox] {tag} round: {total / elapsed:.0f} req/s")
+
+    off_qps = round(total / best["off"], 1)
+    on_qps = round(total / best["on"], 1)
+    overhead = round((1.0 - on_qps / max(off_qps, 1e-9)) * 100.0, 2)
+    if overhead >= 1.0:
+        failures.append(f"journal-on serving costs {overhead}% qps "
+                        f"(bound: < 1%)")
+
+    shutil.rmtree(td, ignore_errors=True)
+    for fmsg in failures:
+        log(f"[blackbox] FAIL {fmsg}")
+    if failures:
+        return 1  # a failing run cannot write the artifact
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    bench_extra = bench_extra or os.path.join(here, "BENCH_EXTRA.json")
+    try:
+        with open(bench_extra) as f:
+            extra = json.load(f)
+    except Exception:
+        extra = {}
+    extra["blackbox"] = {
+        **results,
+        "off": {"qps": off_qps, "bit_identical": bit_identical["off"]},
+        "on": {"qps": on_qps, "bit_identical": bit_identical["on"]},
+        "overhead_pct": overhead,
+        "bound_pct": 1.0,
+    }
+    extra["blackbox_journal_overhead_pct"] = overhead
+    with open(bench_extra, "w") as f:
+        json.dump(extra, f, indent=2)
+    log(f"[blackbox] OK: journal overhead {overhead}% (off {off_qps} vs "
+        f"on {on_qps} req/s, bound < 1%), incident opened in "
+        f"{results['incident']['opened_within_ticks']} tick(s), bundle "
+        f"timeline complete/ordered/trace-linked/gapless")
+    return 0
+
+
+def check_blackbox_section(extra, failures, warnings):
+    """--check-tables coverage for the ISSUE 15 keys: the ``blackbox``
+    section (when present) must carry the incident drill record
+    (incident opened within the recorded tick budget; zero errors;
+    bit-identical; bundle timeline complete, ordered, trace-linked;
+    merged journal wall-ordered and gapless; all required bundle
+    sections + a stack sample per process) and both A/B arms with a
+    claimed overhead recomputable from the arm qps rows AND under the
+    recorded 1% bound; the top-level copy must agree."""
+    if "blackbox" not in extra:
+        warnings.append("blackbox: not present in BENCH_EXTRA.json "
+                        "(bench --blackbox not run?)")
+        return
+    d = extra["blackbox"]
+    required = ["incident", "off", "on", "overhead_pct", "bound_pct"]
+    for k in required:
+        if k not in d:
+            failures.append(f"blackbox.{k}: missing from the recorded "
+                            f"section")
+    if any(k not in d for k in required):
+        return
+    try:
+        inc = d["incident"]
+        if inc.get("opened_within_ticks") is None or \
+                inc["opened_within_ticks"] > inc.get("tick_budget", 2):
+            failures.append(
+                f"blackbox.incident: opened_within_ticks "
+                f"{inc.get('opened_within_ticks')!r} over the recorded "
+                f"budget {inc.get('tick_budget')!r}")
+        if inc.get("errors") != 0:
+            failures.append(f"blackbox.incident.errors: "
+                            f"{inc.get('errors')!r} (must be 0)")
+        for flag in ("matches_oracle", "timeline_complete",
+                     "timeline_ordered", "timeline_trace_linked",
+                     "journal_wall_ordered", "journal_gapless"):
+            if inc.get(flag) is not True:
+                failures.append(f"blackbox.incident.{flag}: "
+                                f"{inc.get(flag)!r} (must be true)")
+        sections = set(inc.get("bundle_sections") or [])
+        need = {"journal.json", "traces.json", "metrics.txt",
+                "capacity.json", "slo.json", "watchdog.json",
+                "manifest.json"}
+        if not need <= sections:
+            failures.append(f"blackbox.incident.bundle_sections: missing "
+                            f"{sorted(need - sections)}")
+        if int(inc.get("stack_samples", 0)) < 4:
+            failures.append(f"blackbox.incident.stack_samples: "
+                            f"{inc.get('stack_samples')!r} < 4 "
+                            f"(router + every worker)")
+        for arm in ("off", "on"):
+            if d[arm].get("bit_identical") is not True:
+                failures.append(
+                    f"blackbox.{arm}: bit_identical is "
+                    f"{d[arm].get('bit_identical')!r}")
+        oh = (1.0 - d["on"]["qps"] / max(1e-9, d["off"]["qps"])) * 100
+        if abs(oh - d["overhead_pct"]) > max(0.05, 0.02 * abs(oh)):
+            failures.append(
+                f"blackbox.overhead_pct: claims {d['overhead_pct']}, "
+                f"recorded arm qps rows give {oh:.2f}")
+        if d["overhead_pct"] >= d["bound_pct"]:
+            failures.append(
+                f"blackbox.overhead_pct: {d['overhead_pct']}% — over the "
+                f"recorded {d['bound_pct']}% bound")
+        if extra.get("blackbox_journal_overhead_pct") != d["overhead_pct"]:
+            failures.append(
+                f"blackbox_journal_overhead_pct: top-level copy "
+                f"{extra.get('blackbox_journal_overhead_pct')} != "
+                f"blackbox section {d['overhead_pct']}")
+    except (TypeError, ValueError, AttributeError, KeyError) as e:
+        failures.append(f"blackbox: malformed section ({e!r})")
+
+
 def check_trace_section(extra, failures, warnings):
     """--check-tables coverage for the ISSUE 9 keys: the ``trace``
     section (when present) must carry both arms, the claimed overhead
@@ -4612,6 +5122,12 @@ if __name__ == "__main__":
             os.environ["XLA_FLAGS"] = (
                 flags + " --xla_force_host_platform_device_count=8").strip()
         sys.exit(bench_analysis())
+    if "--blackbox" in sys.argv:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        sys.exit(bench_blackbox())
     if "--serving" in sys.argv:
         # give the CPU backend multiple virtual devices so the replica arm
         # is real even off-TPU (flag only affects the host platform; must

@@ -21,6 +21,12 @@ machine-checked invariant (run as the tier-1 test
   ``runtime/chaos.py:REGISTERED_POINTS``, every registered point is
   fired somewhere, has a ``docs/robustness.md`` row, and appears in at
   least one test.
+- **JOURNAL-UNREGISTERED / JOURNAL-STALE / JOURNAL-UNDOCUMENTED /
+  JOURNAL-UNTESTED** — the same four-way diff over journal event types
+  (ISSUE 15): every ``journal.emit("<type>", ...)`` site names a type in
+  ``runtime/journal.py:EVENT_TYPES``, every registered type is emitted
+  somewhere, documented in ``docs/observability.md``, and exercised by a
+  test or bench drill.
 - **ROUTE-UNDOCUMENTED** — every ``/v1/*`` route string appears in
   ``docs/observability.md`` (placeholders normalised to ``<name>``).
 - **METRIC-UNDOCUMENTED / METRIC-NAMESPACE** — every Prometheus series
@@ -383,6 +389,50 @@ def collect_fired_points(ctx: _FileCtx) -> List[Tuple[str, int]]:
     return fired
 
 
+# ---------------------------------------------------------------- journal
+def parse_event_types(journal_source: str) -> Dict[str, str]:
+    """The ``EVENT_TYPES`` dict literal out of ``runtime/journal.py``
+    (same AST extraction as :func:`parse_registered_points`)."""
+    tree = ast.parse(journal_source)
+    for node in tree.body:
+        if isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        elif isinstance(node, ast.Assign):
+            targets = node.targets
+        else:
+            continue
+        if (len(targets) == 1 and isinstance(targets[0], ast.Name)
+                and targets[0].id == "EVENT_TYPES"
+                and isinstance(node.value, ast.Dict)):
+            types = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                if (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)):
+                    types[k.value] = v.value
+            return types
+    return {}
+
+
+def collect_emitted_types(ctx: _FileCtx) -> List[Tuple[str, int]]:
+    """Journal event types emitted in this file: first args of
+    ``journal.emit(...)`` calls (the required call spelling — emit sites
+    import the module, not the function, so the linter can see them)."""
+    emitted: List[Tuple[str, int]] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "emit"
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "journal"):
+            continue
+        val = _resolve_str_prefix(node.args[0], ctx)
+        if val:
+            emitted.append((val, node.lineno))
+    return emitted
+
+
 # ----------------------------------------------------------------- routes
 def collect_routes(ctx: _FileCtx) -> List[Tuple[str, int]]:
     routes: List[Tuple[str, int]] = []
@@ -486,6 +536,7 @@ class Linter:
         self.repo_root = repo_root
         self.findings: List[Finding] = []
         self._fired: List[Tuple[str, str, int]] = []   # (point, path, line)
+        self._emitted: List[Tuple[str, str, int]] = []  # (etype, path, line)
         self._routes: List[Tuple[str, str, int]] = []
         self._metrics: List[Tuple[str, str, int, bool]] = []
         self._all_sources: Dict[str, str] = {}
@@ -514,6 +565,8 @@ class Linter:
         self.findings += check_wallclock(ctx)
         for point, line in collect_fired_points(ctx):
             self._fired.append((point, rel_path, line))
+        for etype, line in collect_emitted_types(ctx):
+            self._emitted.append((etype, rel_path, line))
         for route, line in collect_routes(ctx):
             self._routes.append((route, rel_path, line))
         for name, line, is_suffix in collect_metric_names(ctx):
@@ -563,6 +616,33 @@ class Linter:
                     "CHAOS-UNTESTED", "runtime/chaos.py", 0,
                     f"registered chaos point {point!r} is exercised by no "
                     f"test or bench drill"))
+
+        # journal event types: the same four-way parity as chaos points
+        # (ISSUE 15) — emit sites <-> registry <-> docs table <-> drills
+        journal_src = self._all_sources.get("runtime/journal.py", "")
+        event_types = parse_event_types(journal_src)
+        for etype, path, line in self._emitted:
+            if etype not in event_types:
+                self.findings.append(Finding(
+                    "JOURNAL-UNREGISTERED", path, line,
+                    f"journal event type {etype!r} emitted but absent "
+                    f"from runtime/journal.py:EVENT_TYPES"))
+        for etype in event_types:
+            if not any(e == etype for e, _, _ in self._emitted):
+                self.findings.append(Finding(
+                    "JOURNAL-STALE", "runtime/journal.py", 0,
+                    f"registered journal event type {etype!r} is emitted "
+                    f"nowhere in package code"))
+            if f"`{etype}`" not in observability:
+                self.findings.append(Finding(
+                    "JOURNAL-UNDOCUMENTED", "runtime/journal.py", 0,
+                    f"registered journal event type {etype!r} has no "
+                    f"docs/observability.md row"))
+            if etype not in tests_text and etype not in bench_text:
+                self.findings.append(Finding(
+                    "JOURNAL-UNTESTED", "runtime/journal.py", 0,
+                    f"registered journal event type {etype!r} is "
+                    f"exercised by no test or bench drill"))
 
         for route, path, line in sorted(set(self._routes)):
             if route not in observability:

@@ -57,6 +57,8 @@ METRIC_NAMESPACES = (
     "registry_",
     "paging_",
     "aot_",                     # AOT dispatch fast-path ledger (ISSUE 5)
+    "journal_",                 # event-journal ring health (ISSUE 15)
+    "incident_",                # anomaly-watchdog incidents (ISSUE 15)
 )
 
 # Package directories whose code affects numeric trajectories — the

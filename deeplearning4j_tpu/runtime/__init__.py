@@ -33,9 +33,13 @@ from deeplearning4j_tpu.runtime.profiler import OpProfiler, ProfilerConfig
 # distributed-tracing module (ISSUE 9), re-exported here as device_trace
 from deeplearning4j_tpu.runtime.profiler import trace as device_trace
 from deeplearning4j_tpu.runtime import trace
+# the fleet event journal (ISSUE 15): the black box every control seam
+# writes to — see docs/observability.md "Black box"
+from deeplearning4j_tpu.runtime import journal
 
 __all__ = [
     "trace",
+    "journal",
     "device_trace",
     "chaos",
     "ChaosController",

@@ -83,7 +83,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from deeplearning4j_tpu.runtime import trace
+from deeplearning4j_tpu.runtime import journal, trace
 
 logger = logging.getLogger(__name__)
 
@@ -331,6 +331,11 @@ class ChaosController:
     def _record(self, name, index, policy, action) -> None:
         with self._lock:
             self.events.append((name, index, type(policy).__name__, action))
+        # the black box sees every injected fault (ISSUE 15): the event
+        # rides next to the breaker/failover/restart events the fault
+        # causes, trace-linked via the active span like the chaos stamp
+        journal.emit("chaos.action", point=name, index=index,
+                     policy=type(policy).__name__, action=action)
 
     def fire(self, name: str) -> None:
         rules = self._matching(name)

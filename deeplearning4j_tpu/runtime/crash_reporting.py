@@ -8,6 +8,14 @@ the XLA error text — written to a timestamped file + returned as a string.
 
 Wire-up: ``CrashReportingUtil.wrap(fn, model)`` runs ``fn`` and produces the
 report on ``XlaRuntimeError``/``RESOURCE_EXHAUSTED``.
+
+Black-box wiring (ISSUE 15): every written (or failed) dump emits a
+``crash.report`` event into the fleet event journal carrying the report
+path and the active trace id, so the one artifact the debug bundle pulls
+(``serving/blackbox.py`` includes the newest N dump files) is also an
+entry in the ordered incident timeline. ``CrashReportingUtil.clock`` is
+injectable (default ``datetime.datetime.now``) so tests drive the
+timestamped filename deterministically.
 """
 
 from __future__ import annotations
@@ -16,14 +24,20 @@ import datetime
 import os
 import platform
 import sys
-from typing import Optional
+from typing import Callable, Optional
 
 import numpy as np
+
+from deeplearning4j_tpu.runtime import journal
 
 
 class CrashReportingUtil:
     crash_dump_dir: Optional[str] = None
     enabled: bool = True
+    #: injectable wall clock (ISSUE 15 satellite): returns a
+    #: ``datetime.datetime`` — drives both the report header and the
+    #: dump filename, so tests assert exact paths without freezing time
+    clock: Callable[[], datetime.datetime] = datetime.datetime.now
 
     @staticmethod
     def memory_report(model=None, error: Optional[BaseException] = None) -> str:
@@ -31,7 +45,7 @@ class CrashReportingUtil:
 
         from deeplearning4j_tpu.runtime import trace
         lines = ["===== deeplearning4j_tpu memory / crash report =====",
-                 f"time: {datetime.datetime.now().isoformat()}",
+                 f"time: {CrashReportingUtil.clock().isoformat()}",
                  f"python: {sys.version.split()[0]}  platform: {platform.platform()}",
                  f"jax: {jax.__version__}  backend: {jax.devices()[0].platform}",
                  f"devices: {[str(d) for d in jax.devices()]}",
@@ -69,12 +83,20 @@ class CrashReportingUtil:
         report = CrashReportingUtil.memory_report(model, error)
         d = CrashReportingUtil.crash_dump_dir or os.getcwd()
         path = os.path.join(
-            d, f"dl4j-tpu-memory-crash-dump-{datetime.datetime.now():%Y%m%d-%H%M%S}.txt")
+            d, f"dl4j-tpu-memory-crash-dump-"
+               f"{CrashReportingUtil.clock():%Y%m%d-%H%M%S}.txt")
+        written = True
         try:
             with open(path, "w") as f:
                 f.write(report)
         except OSError:
-            pass
+            written = False
+        # the crash joins the black box: the event carries the report
+        # path and (via journal.emit) the active trace id, so the bundle
+        # and the timeline reference the same artifact (ISSUE 15)
+        journal.emit("crash.report", path=path if written else None,
+                     written=written,
+                     error=type(error).__name__ if error else None)
         return report
 
     @staticmethod

@@ -69,6 +69,7 @@ __all__ = [
     "span", "server_span", "current_span", "current_trace_id", "collector",
     "flag_current", "annotate_current", "stamp_chaos", "stage_event",
     "merge_traces", "span_tree", "to_chrome_trace", "set_process_tag",
+    "process_tag",
     "access_log_enabled", "emit_access_log", "bound_traces",
     "TRACES_RESPONSE_BYTE_CAP", "NOOP",
 ]
@@ -182,6 +183,13 @@ def set_process_tag(tag: str) -> None:
     ``worker_id``; defaults to ``pid-<n>``)."""
     global _PROCESS_TAG
     _PROCESS_TAG = str(tag)
+
+
+def process_tag() -> str:
+    """This process's tag — shared with the event journal
+    (``runtime/journal.py``) so journal events and trace spans name the
+    same process the same way."""
+    return _PROCESS_TAG
 
 
 def enable(rate: float = 0.0, latency_threshold_ms: Optional[float] = None,
@@ -614,22 +622,76 @@ def bound_traces(records: Iterable[Dict[str, Any]],
 
 
 # --------------------------------------------------------------- access log
+#: spellings that DISABLE the access log — aligned with the journal's
+#: ``DL4J_TPU_JOURNAL`` parsing, so "off"/"no" can never be mistaken
+#: for a file literally named ./off
+_ACCESS_LOG_OFF = ("", "0", "false", "off", "no")
+#: bare truthy spellings that mean "enabled, to stderr" (the original
+#: behaviour); anything else is a file path
+_ACCESS_LOG_STDERR = ("1", "true", "on", "yes")
+
+
 def access_log_enabled() -> bool:
     """The ``DL4J_TPU_ACCESS_LOG`` env knob (off by default): one
-    structured JSON line per terminal request outcome on stderr."""
-    return os.environ.get("DL4J_TPU_ACCESS_LOG", "") not in ("", "0", "false")
+    structured JSON line per terminal request outcome — to stderr for
+    the bare truthy spellings, to a FILE when the value is a path."""
+    return os.environ.get("DL4J_TPU_ACCESS_LOG",
+                          "").strip().lower() not in _ACCESS_LOG_OFF
+
+
+def _access_log_path() -> Optional[str]:
+    """The access-log destination file, or ``None`` for stderr (the
+    original behaviour for bare truthy spellings of the knob)."""
+    v = os.environ.get("DL4J_TPU_ACCESS_LOG", "")
+    if v.strip().lower() in _ACCESS_LOG_OFF + _ACCESS_LOG_STDERR:
+        return None
+    return v
+
+
+def _access_log_max_bytes() -> int:
+    """``DL4J_TPU_ACCESS_LOG_MAX_BYTES``: size-based rotation threshold
+    for the file form (0 / unset / unparsable = no rotation)."""
+    try:
+        return max(0, int(os.environ.get(
+            "DL4J_TPU_ACCESS_LOG_MAX_BYTES", "0")))
+    except ValueError:
+        return 0
+
+
+# serializes the size check + rename + append so concurrent request
+# threads cannot double-rotate or interleave partial lines
+_ACCESS_LOG_LOCK = threading.Lock()  # guards: (access-log rotate+append)
 
 
 def emit_access_log(record: Dict[str, Any]) -> None:
-    """Write one JSON access-log line to stderr (no-op unless
-    :func:`access_log_enabled`). Never raises — logging must not be able
-    to fail a request."""
+    """Write one JSON access-log line (no-op unless
+    :func:`access_log_enabled`). When ``DL4J_TPU_ACCESS_LOG`` is a file
+    path, lines append there with size-based rotation (ISSUE 15): once
+    the file would exceed ``DL4J_TPU_ACCESS_LOG_MAX_BYTES`` it is
+    atomically renamed to ``<path>.1`` (keep-1 rollover — a soak can
+    never grow the log unbounded) and a fresh file starts. Never raises
+    — logging must not be able to fail a request."""
     if not access_log_enabled():
         return
     try:
-        sys.stderr.write(json.dumps(
-            {"log": "dl4j_tpu_access", **record}, default=str) + "\n")
-        sys.stderr.flush()
+        line = json.dumps({"log": "dl4j_tpu_access", **record},
+                          default=str) + "\n"
+        path = _access_log_path()
+        if path is None:
+            sys.stderr.write(line)
+            sys.stderr.flush()
+            return
+        max_bytes = _access_log_max_bytes()
+        with _ACCESS_LOG_LOCK:
+            if max_bytes:
+                try:
+                    size = os.path.getsize(path)
+                except OSError:
+                    size = 0
+                if size and size + len(line.encode()) > max_bytes:
+                    os.replace(path, path + ".1")  # atomic keep-1 rollover
+            with open(path, "a") as f:
+                f.write(line)
     except Exception:
         pass
 

@@ -75,6 +75,14 @@ The subsystem that puts traffic on this stack:
   budgeted restarts), and :class:`MultiRouterClient` (round-robin +
   connect-fail/5xx failover across routers, so a SIGKILL'd router is
   invisible to callers).
+- ``blackbox.py`` (ISSUE 15, ``docs/observability.md`` "Black box") —
+  the anomaly watchdog (:class:`AnomalyWatchdog`: journal-rate +
+  SLO-ring rules — breaker-flap, restart-storm, page-in-thrash,
+  election churn, fast-burn — opening/closing ``incident`` events in
+  the fleet event journal, ``runtime/journal.py``) and the one-command
+  incident bundle (``GET /v1/debug/bundle``: journal window, traces,
+  metrics, capacity, SLO, autoscaler log, config version, per-process
+  stack samples, newest crash reports, in one tar.gz).
 - :class:`WarmupManifest` (``manifest.py``) — persisted record of every
   compiled (bucket, replica, dtype) pair, written next to model archives
   and replayed by registry load / hot-swap so a restart reaches READY
@@ -103,6 +111,9 @@ _EXPORTS = {
     "AutoscalerConfig": "autoscale",
     "SLOAutoscaler": "autoscale",
     "forecast_rate": "autoscale",
+    "AnomalyWatchdog": "blackbox",
+    "BurnRule": "blackbox",
+    "RateRule": "blackbox",
     "FleetConfig": "control_plane",
     "LeaseElection": "control_plane",
     "MultiRouterClient": "control_plane",

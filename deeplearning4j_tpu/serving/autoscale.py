@@ -66,18 +66,23 @@ from __future__ import annotations
 
 import dataclasses
 import http.client
+import itertools
 import json
 import logging
 import threading
 import time
-from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
-from deeplearning4j_tpu.runtime import trace
+from deeplearning4j_tpu.runtime import journal, trace
 
 logger = logging.getLogger(__name__)
 
 __all__ = ["AutoscalerConfig", "SLOAutoscaler", "forecast_rate"]
+
+#: per-process controller counter: each SLOAutoscaler's journal events
+#: carry a unique controller id so two controllers in one process (unit
+#: tests, drills) read back exactly their own decisions
+_CONTROLLER_IDS = itertools.count(1)
 
 
 @dataclasses.dataclass
@@ -247,8 +252,20 @@ class SLOAutoscaler:
         self._residency_lever = residency_lever or self._http_page_in
         self._now = now_fn
         self._states: Dict[str, _ModelState] = {}
-        self._lock = threading.Lock()  # guards: decisions, _states
-        self.decisions: deque = deque(maxlen=cfg.log_capacity)
+        self._lock = threading.Lock()  # guards: _states
+        # decision records live in the EVENT JOURNAL (ISSUE 15): _log
+        # emits one `autoscale.decision` event per entry and report()
+        # reads them back — one source, no double bookkeeping. The
+        # controller id scopes the read-back to THIS controller.
+        self._cid = (f"{getattr(router, 'router_id', 'router')}"
+                     f"#{next(_CONTROLLER_IDS)}")
+        if not journal.enabled():
+            # the decision log LIVES in the journal now: with it disabled
+            # every decision still acts but /v1/autoscaler shows nothing
+            logger.warning(
+                "event journal disabled (DL4J_TPU_JOURNAL=0): autoscaler "
+                "decisions will act but /v1/autoscaler's decision log "
+                "will be empty")
         self.ticks = 0
         self._tick_capacity: Optional[Dict[str, Any]] = None
         self._worker_seq = 0
@@ -339,7 +356,9 @@ class SLOAutoscaler:
         """Fold a lease transition into the decision log (ISSUE 12):
         every election — acquired, takeover, lost, released — is an
         explained ``/v1/autoscaler`` entry next to the decisions it
-        gates."""
+        gates. The entry is an ``autoscale.election`` JOURNAL event
+        (ISSUE 15) — the black box and the ``/v1/autoscaler`` view read
+        the same record."""
         entry = {
             "ts": event.get("ts", time.time()),
             "tick": self.ticks,
@@ -355,8 +374,8 @@ class SLOAutoscaler:
             "detail": {k: event.get(k)
                        for k in ("holder", "seq", "reason", "id")},
         }
-        with self._lock:
-            self.decisions.append(entry)
+        journal.emit("autoscale.election", controller=self._cid,
+                     entry=entry)
         logger.info("autoscaler election: %s -> %s (%s)",
                     event.get("id"), event.get("role"),
                     event.get("reason"))
@@ -818,29 +837,54 @@ class SLOAutoscaler:
             span.set("action", action)
             span.set("ok", bool(ok))
             span.event("decision", action=action, ok=bool(ok))
-        with self._lock:
-            self.decisions.append(entry)
+        # the decision IS a journal event (ISSUE 15): /v1/autoscaler and
+        # the black box read the same record — no double bookkeeping
+        journal.emit("autoscale.decision", _trace_id=span.trace_id,
+                     controller=self._cid, entry=entry)
         logger.info("autoscaler: %s %s (ok=%s) burn_fast=%.2f "
                     "burn_slow=%.2f level=%d", action, model, ok,
                     burn["burn_fast"], burn["burn_slow"], st.level)
         return entry
 
+    def decision_log(self) -> List[Dict[str, Any]]:
+        """THIS controller's decision + election entries, oldest first,
+        read back from the event journal (ISSUE 15: the journal is the
+        single source; the deque it replaced is gone). Bounded by the
+        configured ``log_capacity`` — and by the journal ring itself: a
+        flood of OTHER event types can overwrite old decisions (the
+        tradeoff of one shared black box; ``report()`` surfaces the
+        ring's ``overwritten_total`` so a shortened log is explainable,
+        and ``journal.enable(capacity=...)`` sizes the ring for long
+        incidents)."""
+        entries = [
+            e["attrs"]["entry"]
+            for e in journal.events(
+                types=("autoscale.decision", "autoscale.election"))
+            if e.get("attrs", {}).get("controller") == self._cid
+            and isinstance(e.get("attrs", {}).get("entry"), dict)]
+        cap = int(self.config.log_capacity)
+        return entries[max(0, len(entries) - cap):]
+
     def report(self) -> Dict[str, Any]:
         """The ``/v1/autoscaler`` payload: config, controller state, and
-        the bounded decision log (oldest first)."""
+        the bounded decision log (oldest first, journal-backed)."""
         now = self._now()
+        decisions = self.decision_log()
         with self._lock:
-            # decisions AND the states snapshot under the one lock: the
-            # control thread setdefault()s new models mid-tick, and a
-            # dict resize during an unlocked iteration would 500 the
-            # /v1/autoscaler scrape
-            decisions = list(self.decisions)
+            # the states snapshot under the lock: the control thread
+            # setdefault()s new models mid-tick, and a dict resize
+            # during an unlocked iteration would 500 the scrape
             states = {m: (s.level, s.last_action_ts)
                       for m, s in sorted(self._states.items())}
         out = {
             "config": self.config.to_dict(),
             "ticks": self.ticks,
             "running": self._thread is not None,
+            # the log's provenance (ISSUE 15): journal-backed, with the
+            # ring counters that explain a shortened history
+            "decision_log_source": ("journal" if journal.enabled()
+                                    else "journal_disabled"),
+            "journal": journal.counters(),
             "role": self._role(),
             "models": {m: {"level": level,
                            "last_action_age_s": (

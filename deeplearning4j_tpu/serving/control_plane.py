@@ -68,7 +68,7 @@ from collections import deque
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional, Tuple
 
-from deeplearning4j_tpu.runtime import chaos
+from deeplearning4j_tpu.runtime import chaos, journal
 from deeplearning4j_tpu.serving.fleet import FleetSupervisor, PidRegistry
 from deeplearning4j_tpu.serving.manifest import atomic_replace
 
@@ -347,6 +347,12 @@ class FleetConfig:
                 except OSError:
                     self._last_stat = None
                 self.loads_total += 1
+            # every committed mutation is a journal event (ISSUE 15): the
+            # black box shows WHICH config version a deploy/roster change
+            # produced, next to the stages that consumed it
+            journal.emit("control.config_apply", version=cfg["version"],
+                         workers=len(cfg.get("workers") or {}),
+                         routers=len(cfg.get("routers") or {}))
             return copy.deepcopy(cfg)
 
     def set_workers(self, endpoints: Dict[str, str]) -> None:

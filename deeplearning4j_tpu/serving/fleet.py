@@ -64,6 +64,8 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+from deeplearning4j_tpu.runtime import journal, trace
+
 logger = logging.getLogger(__name__)
 
 # -------------------------------------------------------------------------
@@ -412,6 +414,12 @@ class FleetSupervisor:
         handle.proc = proc
         handle.port = None
         handle.generation += 1
+        # every process bring-up is a journal event (ISSUE 15): initial
+        # start, watchdog relaunch and deploy restart all leave a record
+        journal.emit("fleet.worker_spawn",
+                     worker=handle.spec.worker_id, pid=proc.pid,
+                     generation=handle.generation,
+                     host=getattr(handle.spec, "host", "local"))
 
     @staticmethod
     def _stderr_tail(handle: _WorkerHandle, n: int = 2000) -> str:
@@ -534,10 +542,20 @@ class FleetSupervisor:
 
     def kill_worker(self, worker_id: str) -> int:
         """SIGKILL a worker (the chaos drill). The watchdog notices the
-        exit and restarts it within the budget. Returns the killed pid."""
+        exit and restarts it within the budget. Returns the killed pid.
+
+        The kill is the first event of an incident timeline (ISSUE 15),
+        so it gets its own flagged trace span — the journal event is
+        trace-linked like the breaker/failover events that follow it."""
         handle = self._handles[worker_id]
         pid = handle.proc.pid
-        handle.proc.kill()
+        sp = trace.span("fleet.kill") if trace.enabled() else trace.NOOP
+        with sp:
+            if sp.recording:
+                sp.flag("fleet")
+                sp.set("worker", worker_id)
+            journal.emit("fleet.worker_kill", worker=worker_id, pid=pid)
+            handle.proc.kill()
         return pid
 
     def restart_worker(self, worker_id: str, archive: Optional[str] = None,
@@ -574,6 +592,9 @@ class FleetSupervisor:
                 handle.spec.archive = archive
             if version is not None:
                 handle.spec.version = version
+            journal.emit("fleet.worker_restart", worker=worker_id,
+                         cause="intentional", archive=archive,
+                         version=version)
             with self._lock:
                 self._spawn(handle)
             port = self._wait_port(handle)
@@ -655,6 +676,7 @@ class FleetSupervisor:
         self._close_capture(handle)
         with self._lock:
             self._handles.pop(worker_id, None)
+        journal.emit("fleet.worker_retire", worker=worker_id)
         self._publish_roster()
 
     def prewarm_manifest(self, archive: str) -> Optional[str]:
@@ -757,9 +779,22 @@ class FleetSupervisor:
                         continue
                     handle.restarts += 1
                     try:
-                        with self._lock:
-                            self._spawn(handle)
-                        self._wait_port(handle)
+                        # the crash relaunch is the incident timeline's
+                        # recovery leg (ISSUE 15): flagged span so the
+                        # journal event is trace-linked
+                        sp = (trace.span("fleet.relaunch")
+                              if trace.enabled() else trace.NOOP)
+                        with sp:
+                            if sp.recording:
+                                sp.flag("fleet")
+                                sp.set("worker", handle.spec.worker_id)
+                            journal.emit("fleet.worker_restart",
+                                         worker=handle.spec.worker_id,
+                                         cause=cause,
+                                         restarts=handle.restarts)
+                            with self._lock:
+                                self._spawn(handle)
+                            self._wait_port(handle)
                         self._publish_roster()
                     except Exception:
                         logger.exception("relaunch of %s failed",

@@ -70,7 +70,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-from deeplearning4j_tpu.runtime import chaos, trace
+from deeplearning4j_tpu.runtime import chaos, journal, trace
 from deeplearning4j_tpu.serving import paging
 from deeplearning4j_tpu.serving.admission import (
     HBMBudgetExceeded,
@@ -103,6 +103,8 @@ class ServedModel:
         self.model = model
         self.batcher = batcher
         self.breaker = breaker or CircuitBreaker()
+        # journal events from this breaker name the model (ISSUE 15)
+        self.breaker.journal_scope = f"model:{name}"
         self.retry = retry or RetryPolicy()
         self.loaded_at = time.time()
         self.archive_path: Optional[str] = None  # set by ModelRegistry.load
@@ -388,6 +390,13 @@ class ModelRegistry:
                 # a live-net register has nothing to rehydrate from
                 res.evictable = False
                 res.archive_path = None
+        if prev is not None:
+            # hot-swap on the record (ISSUE 15): the black box shows the
+            # version flip next to the deploy stages that caused it
+            journal.emit("registry.hot_swap", model=name,
+                         old_version=prev.version,
+                         new_version=served.version,
+                         device_bytes=served.device_bytes)
         from deeplearning4j_tpu.runtime import profiler
         if batcher.dtype_policy is not None:
             # profiler surface for the quantized-vs-f32 latency split
@@ -697,6 +706,11 @@ class ModelRegistry:
                 res = self._residency.get(name)
                 if res is not None:
                     res.record_page_in_cost(seconds)
+                bytes_in = int(res.bytes) if res is not None else None
+            # the pager's journal record (ISSUE 15): with registry.evict
+            # events, the watchdog's page-in-thrash rule counts these
+            journal.emit("registry.page_in", model=name,
+                         seconds=round(seconds, 4), bytes=bytes_in)
             return
         # follower: wait in the page-in queue instead of failing — the
         # whole point of request-triggered paging (ISSUE 11). The wait is
@@ -808,6 +822,8 @@ class ModelRegistry:
                 sp.flag("evict")
                 sp.set("model", name)
                 sp.set("bytes", served.device_bytes)
+            journal.emit("registry.evict", model=name,
+                         bytes=int(served.device_bytes or 0))
             served._draining = True
             try:
                 served.batcher.shutdown(drain=True)

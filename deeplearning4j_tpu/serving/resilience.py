@@ -33,6 +33,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from deeplearning4j_tpu.runtime import journal
 from deeplearning4j_tpu.serving.admission import ServingError
 
 
@@ -60,6 +61,15 @@ class CircuitBreaker:
     the count — i.e. consecutive-within-window semantics) open the
     circuit. ``clock`` is injectable so tests drive transitions without
     sleeping.
+
+    Every state TRANSITION emits a ``breaker.open`` / ``breaker.half_open``
+    / ``breaker.close`` event into the fleet journal (ISSUE 15) tagged
+    with ``journal_scope`` — ``"model:<name>"`` for the registry's
+    per-model breakers, ``"worker:<id>"`` for the router's passive
+    per-worker views — so a flapping breaker is visible in the black box
+    and the watchdog's breaker-flap rule has something to count. Steady
+    state emits nothing (the serving hot path records successes without
+    a transition).
     """
 
     def __init__(self, failure_threshold: int = 5, window_s: float = 30.0,
@@ -72,6 +82,9 @@ class CircuitBreaker:
         self.reset_timeout_s = float(reset_timeout_s)
         self.half_open_probes = int(half_open_probes)
         self._clock = clock
+        #: who this breaker protects, for journal events (set by the
+        #: owner; None = emit unscoped)
+        self.journal_scope: Optional[str] = None
         # guards: _state, _failures, _seen_keys, _opened_at, _probes_issued, opens_total
         self._lock = threading.Lock()
         self._state = CircuitState.CLOSED
@@ -92,6 +105,7 @@ class CircuitBreaker:
                 and now - self._opened_at >= self.reset_timeout_s):
             self._state = CircuitState.HALF_OPEN
             self._probes_issued = 0
+            journal.emit("breaker.half_open", scope=self.journal_scope)
 
     # ------------------------------------------------------------- queries
     @property
@@ -121,6 +135,7 @@ class CircuitBreaker:
             self._tick(self._clock())
             if self._state is CircuitState.HALF_OPEN:
                 self._state = CircuitState.CLOSED
+                journal.emit("breaker.close", scope=self.journal_scope)
             self._failures.clear()
 
     def record_discard(self) -> None:
@@ -156,6 +171,9 @@ class CircuitBreaker:
                 self._state = CircuitState.OPEN
                 self._opened_at = now
                 self.opens_total += 1
+                journal.emit("breaker.open", scope=self.journal_scope,
+                             reason="probe_failed",
+                             opens_total=self.opens_total)
                 return
             if self._state is CircuitState.OPEN:
                 return
@@ -165,6 +183,10 @@ class CircuitBreaker:
                 self._state = CircuitState.OPEN
                 self._opened_at = now
                 self.opens_total += 1
+                journal.emit("breaker.open", scope=self.journal_scope,
+                             reason="failure_threshold",
+                             failures=len(self._failures),
+                             opens_total=self.opens_total)
                 self._failures.clear()
 
     def warm_open(self) -> None:
@@ -181,6 +203,9 @@ class CircuitBreaker:
                 self._state = CircuitState.OPEN
                 self._opened_at = now
                 self.opens_total += 1
+                journal.emit("breaker.open", scope=self.journal_scope,
+                             reason="warm_start",
+                             opens_total=self.opens_total)
                 self._failures.clear()
 
     def snapshot(self) -> Dict[str, object]:

@@ -63,7 +63,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlsplit
 
-from deeplearning4j_tpu.runtime import chaos, trace
+from deeplearning4j_tpu.runtime import chaos, journal, trace
 from deeplearning4j_tpu.serving.metrics import LatencyHistogram
 from deeplearning4j_tpu.serving.resilience import CircuitBreaker, CircuitState
 from deeplearning4j_tpu.serving.slo import SLOMonitor
@@ -197,6 +197,9 @@ class WorkerView:
         self.address = address
         self.breaker = breaker or CircuitBreaker(
             failure_threshold=3, window_s=30.0, reset_timeout_s=2.0)
+        # breaker transitions land in the event journal under this scope
+        # (ISSUE 15): the watchdog's breaker-flap rule counts them
+        self.breaker.journal_scope = f"worker:{worker_id}"
         #: flips True after the one-shot /v1/metricsz warm-start scrape
         #: (ISSUE 12): a fresh view adopts the worker's OWN breaker
         #: verdict instead of re-learning a failure streak from traffic
@@ -404,6 +407,9 @@ class FleetRouter:
         self.slo = slo or SLOMonitor()
         # the attached SLOAutoscaler (ISSUE 10), serving /v1/autoscaler
         self.autoscaler = None
+        # the attached AnomalyWatchdog (ISSUE 15): ticked by the probe
+        # loop, rendered on /metrics, snapshotted into the debug bundle
+        self.watchdog = None
         # placement view (ISSUE 11): {worker_id: {"models": {name: state},
         # "headroom_bytes": int|None}} refreshed by the probe loop from
         # the workers' /v1/capacity residency sections — what makes
@@ -524,12 +530,37 @@ class FleetRouter:
     def _probe_cycle(self) -> None:
         self._sync_views()
         for view in self.workers().values():
+            was_ready = view.ready
             try:
                 view.ready = self._probe_worker(view)
             except Exception:
                 view.ready = False
+            if view.ready != was_ready:
+                # readiness TRANSITIONS are journal events (ISSUE 15):
+                # kill -> unready and restart -> readmit are the
+                # bookends of the incident drill's timeline. Each gets
+                # its own flagged span so the event is trace-linked even
+                # though no request context exists on the probe thread.
+                sp = (trace.server_span("router.worker_transition")
+                      if trace.enabled() else trace.NOOP)
+                with sp:
+                    if sp.recording:
+                        sp.flag("fleet")
+                        sp.set("worker", view.worker_id)
+                        sp.set("ready", view.ready)
+                    if view.ready:
+                        journal.emit("router.worker_ready",
+                                     worker=view.worker_id,
+                                     address=view.address)
+                    else:
+                        journal.emit("router.worker_unready",
+                                     worker=view.worker_id,
+                                     address=view.address)
             if view.ready and not view.breaker_warmed:
                 self._warm_start_breaker(view)
+        wd = self.watchdog
+        if wd is not None:
+            wd.maybe_tick()
         now = time.monotonic()
         if now - self._last_residency_refresh >= self.residency_refresh_s:
             self._last_residency_refresh = now
@@ -674,7 +705,15 @@ class FleetRouter:
             return  # nothing was sent; neither fault nor success
         if attempt.error is not None:
             # connection-level fault: the worker is likely gone — fail
-            # fast for subsequent requests; the prober re-admits it
+            # fast for subsequent requests; the prober re-admits it.
+            # The readiness flip is journaled HERE (not only in the
+            # probe loop): the data path usually sees a dead worker
+            # first, and the probe's transition detector would then
+            # find ready already False and record nothing (ISSUE 15).
+            if view.ready:
+                journal.emit("router.worker_unready",
+                             worker=view.worker_id, address=view.address,
+                             reason="connect_fault")
             view.ready = False
             view.breaker.record_failure()
             return
@@ -685,6 +724,8 @@ class FleetRouter:
             if window_ms > 0:
                 view.shed_until = max(view.shed_until,
                                       time.monotonic() + window_ms / 1000.0)
+                journal.emit("router.shed_window", worker=view.worker_id,
+                             window_ms=round(window_ms, 1))
             view.breaker.record_discard()
             return
         if attempt.status is not None and attempt.status >= 500:
@@ -915,6 +956,11 @@ class FleetRouter:
                     if not settled and race.winner is None:
                         chaos.inject("serving.router.hedge")
                         self.metrics.record("hedges_total")
+                        journal.emit("router.hedge", model=name,
+                                     request_id=rid,
+                                     worker=hedge_view.worker_id,
+                                     primary=primary.worker_id,
+                                     delay_ms=round(delay * 1e3, 2))
                         if rsp.recording:
                             rsp.flag("hedged")
                             rsp.event("hedge",
@@ -938,6 +984,10 @@ class FleetRouter:
                                   f"attempt(s) still in flight"})
                 # every launched attempt failed retryably -> fail over
                 self.metrics.record("failovers_total", len(race.failures))
+                journal.emit("router.failover", model=name, request_id=rid,
+                             failed_attempts=len(race.failures),
+                             workers=[a.view.worker_id
+                                      for a in race.failures])
                 if rsp.recording:
                     rsp.event("failover", failed_attempts=len(race.failures))
 
@@ -1014,9 +1064,15 @@ class FleetRouter:
                 logger.info("rolling deploy %s already applied by %s; "
                             "skipping", action_id,
                             (applied or {}).get("router"))
+                journal.emit("control.deploy_stage", stage="skipped",
+                             archive=archive, version=version,
+                             applied_by=(applied or {}).get("router"))
                 return {"archive": archive, "version": version,
                         "skipped": True, "action_id": action_id,
                         "applied_by": applied}
+            journal.emit("control.deploy_stage", stage="claimed",
+                         archive=archive, version=version,
+                         router=self.router_id)
         try:
             prewarm = getattr(self._fleet, "prewarm_manifest", None)
             if prewarm is not None:
@@ -1032,6 +1088,8 @@ class FleetRouter:
             for wid in worker_ids:
                 if wid in self.workers():
                     self.drain(wid, timeout_s=drain_timeout_s)
+                    journal.emit("control.deploy_stage", stage="drained",
+                                 worker=wid, archive=archive)
                 try:
                     self._fleet.restart_worker(wid, archive=archive,
                                                version=version)
@@ -1039,6 +1097,9 @@ class FleetRouter:
                                                timeout_s=ready_timeout_s)
                 finally:
                     self.readmit(wid)
+                journal.emit("control.deploy_stage", stage="readmitted",
+                             worker=wid, archive=archive,
+                             ready_s=round(ready_s, 3))
                 report["workers"][wid] = {"ready_s": round(ready_s, 3)}
         except BaseException:
             # a failed deploy must RELEASE its claim, or its own retry
@@ -1052,6 +1113,9 @@ class FleetRouter:
                                      action_id)
             raise
         self.metrics.record("deploys_total")
+        journal.emit("control.deploy_stage", stage="completed",
+                     archive=archive, version=version,
+                     workers=sorted(report["workers"]))
         if self._config is not None:
             try:
                 def fn(cfg):
@@ -1112,6 +1176,42 @@ class FleetRouter:
         .SLOAutoscaler` driving this router so ``/v1/autoscaler`` serves
         its decision log (called by ``SLOAutoscaler.start``)."""
         self.autoscaler = autoscaler
+
+    def attach_watchdog(self, watchdog) -> None:
+        """Register an :class:`~deeplearning4j_tpu.serving.blackbox
+        .AnomalyWatchdog` (ISSUE 15): the probe loop ticks it on the
+        control cadence, its incident gauges render on ``/metrics``, and
+        its state rides into ``/v1/debug/bundle``."""
+        self.watchdog = watchdog
+
+    def fleet_journal(self, since: Optional[float] = None,
+                      limit: Optional[int] = None,
+                      types=None):
+        """The fleet's merged event timeline (ISSUE 15): this router's
+        journal plus every ready worker's ``/v1/journal``, merged
+        wall-anchor-first (``journal.merge_events`` — a restarted
+        worker's seq reset cannot reorder the view) and bounded exactly
+        like ``/v1/traces``. Filters are forwarded to the workers so the
+        fan-out fetch stays bounded, then re-applied after the merge.
+        Returns ``(events, truncated)``."""
+        params = []
+        if since is not None:
+            params.append(f"since={float(since)}")
+        if limit is not None:
+            params.append(f"limit={int(limit)}")
+        if types:
+            params.append("type=" + ",".join(sorted(types)))
+        path = "/v1/journal" + ("?" + "&".join(params) if params else "")
+        streams = [journal.events(since=since, limit=limit, types=types)]
+        worker_truncated = False
+        for payload in self._scrape_workers(path).values():
+            streams.append(payload.get("events") or [])
+            worker_truncated = worker_truncated or \
+                bool(payload.get("truncated"))
+        merged = journal.merge_events(streams)
+        bounded, truncated = journal.bound_events(
+            merged, since=since, limit=limit, types=types)
+        return bounded, truncated or worker_truncated
 
     def fleet_capacity(self) -> Dict[str, Any]:
         """Fleet-wide capacity aggregation (ISSUE 10 tentpole): every
@@ -1318,6 +1418,18 @@ class FleetRouter:
             pass  # capacity must never be able to break a scrape
         return "\n".join(lines) + "\n"
 
+    def _render_blackbox_metrics(self) -> str:
+        """The ``journal_*`` + ``incident_*`` section of the router's
+        ``/metrics`` (ISSUE 15)."""
+        parts = [journal.render_prometheus().rstrip("\n")]
+        wd = self.watchdog
+        if wd is not None:
+            try:
+                parts.append(wd.render_prometheus().rstrip("\n"))
+            except Exception:
+                pass  # the black box must never break a scrape
+        return "\n".join(parts) + "\n"
+
     def aggregate_traces(self, trace_id: Optional[str] = None,
                          limit: Optional[int] = None,
                          since: Optional[float] = None
@@ -1387,6 +1499,27 @@ class FleetRouter:
             if q.get("format", [None])[0] == "chrome":
                 return 200, trace.to_chrome_trace(merged)
             return 200, {"traces": merged, "truncated": truncated}
+        if path.startswith("/v1/journal"):
+            # the black box's fleet read side (ISSUE 15): this router's
+            # ring merged with every ready worker's, ordered and bounded
+            q = parse_qs(urlsplit(path).query)
+            try:
+                limit = (int(q["limit"][0]) if "limit" in q else None)
+                since = (float(q["since"][0]) if "since" in q else None)
+            except ValueError as e:
+                return 400, {"error": f"bad limit/since query param: {e}"}
+            types = None
+            if "type" in q:
+                types = {t for v in q["type"] for t in v.split(",") if t}
+            events, truncated = self.fleet_journal(since=since, limit=limit,
+                                                   types=types)
+            return 200, {"router_id": self.router_id, "events": events,
+                         "truncated": truncated,
+                         "counters": journal.counters()}
+        if path == "/v1/debug/stacks":
+            from deeplearning4j_tpu.serving import blackbox
+            return 200, {"router_id": self.router_id,
+                         "stacks": blackbox.stack_sample()}
         if path == "/v1/slo":
             # structured twin of the /metrics slo_* section — the signal
             # the autoscaler consumes, fleet-wide by construction
@@ -1481,9 +1614,27 @@ class FleetRouter:
                 if self.path == "/metrics":
                     text = (router.metrics.render_prometheus(
                                 router.workers())
-                            + router.render_fleet_metrics()).encode()
+                            + router.render_fleet_metrics()
+                            + router._render_blackbox_metrics()).encode()
                     self._send(200, {"Content-Type":
                                      "text/plain; version=0.0.4"}, text)
+                    return
+                if self.path.startswith("/v1/debug/bundle"):
+                    # one curl away from a postmortem (ISSUE 15): the
+                    # fleet incident bundle, as a binary tar.gz
+                    from deeplearning4j_tpu.serving import blackbox
+                    try:
+                        data = blackbox.fleet_bundle(router)
+                    except Exception as e:
+                        self._send(500,
+                                   {"Content-Type": "application/json"},
+                                   json.dumps({"error": repr(e)}).encode())
+                        return
+                    self._send(200, {
+                        "Content-Type": "application/gzip",
+                        "Content-Disposition": 'attachment; filename='
+                                               '"debug-bundle.tar.gz"'},
+                        data)
                     return
                 code, obj = router._handle_get(self.path)
                 self._send(code, {"Content-Type": "application/json"},

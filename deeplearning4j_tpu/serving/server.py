@@ -75,7 +75,7 @@ from urllib.parse import parse_qs, urlsplit
 
 import numpy as np
 
-from deeplearning4j_tpu.runtime import chaos, trace
+from deeplearning4j_tpu.runtime import chaos, journal, trace
 from deeplearning4j_tpu.serving.admission import (
     DeadlineExceeded,
     Overloaded,
@@ -287,6 +287,30 @@ class ModelServer:
                      "outputs": _to_jsonable(out)}, hdrs
 
     def _handle_get(self, path: str):
+        if path.startswith("/v1/journal"):
+            # this process's slice of the black box (ISSUE 15): the
+            # router merges it fleet-wide; same bounded-read contract
+            # as /v1/traces
+            q = parse_qs(urlsplit(path).query)
+            try:
+                limit = (int(q["limit"][0]) if "limit" in q else None)
+                since = (float(q["since"][0]) if "since" in q else None)
+            except ValueError as e:
+                return 400, {"error": f"bad limit/since query param: {e}"}
+            types = None
+            if "type" in q:
+                types = {t for v in q["type"] for t in v.split(",") if t}
+            events, truncated = journal.bound_events(
+                journal.events(), since=since, limit=limit, types=types)
+            return 200, {"worker": self.worker_id, "events": events,
+                         "truncated": truncated,
+                         "counters": journal.counters()}
+        if path == "/v1/debug/stacks":
+            # per-process stack sample: what the router's fleet bundle
+            # scrapes so the postmortem shows where EVERY process was
+            from deeplearning4j_tpu.serving import blackbox
+            return 200, {"worker": self.worker_id,
+                         "stacks": blackbox.stack_sample()}
         if path.startswith("/v1/traces"):
             # this process's kept traces (tail-sampled flight recorder);
             # ?trace_id= filters, ?format=chrome renders Perfetto-loadable
@@ -478,6 +502,11 @@ class ModelServer:
                                       f"{e}"}, {}
             if sp.recording:
                 sp.set("target_state", state)
+            # the explicit lever is a journal event either way (ISSUE 15):
+            # an autoscaler rebalance and an operator runbook leave the
+            # same black-box record
+            journal.emit("registry.residency_lever", model=name,
+                         target_state=state)
             if state == "resident":
                 try:
                     served = self.registry.page_in(name)
@@ -526,6 +555,8 @@ class ModelServer:
                 capacity.registry_capacity(self.registry)).rstrip("\n"))
         except Exception:
             pass  # capacity must never be able to break a scrape
+        # the black box's ring health (ISSUE 15): journal_* gauges
+        parts.append(journal.render_prometheus().rstrip("\n"))
         return "\n".join(parts) + "\n"
 
     @staticmethod
@@ -582,6 +613,19 @@ class ModelServer:
                 if self.path == "/metrics":
                     self._send(200, srv._render_metrics().encode(),
                                "text/plain; version=0.0.4")
+                    return
+                if self.path.startswith("/v1/debug/bundle"):
+                    # the worker's local incident bundle (ISSUE 15); the
+                    # router's twin merges the whole fleet
+                    from deeplearning4j_tpu.serving import blackbox
+                    try:
+                        data = blackbox.local_bundle(srv)
+                    except Exception as e:
+                        self._send(500, json.dumps(
+                            {"error": repr(e)}).encode(),
+                            "application/json")
+                        return
+                    self._send(200, data, "application/gzip")
                     return
                 code, obj = srv._handle_get(self.path)
                 self._send(code, json.dumps(obj).encode(), "application/json")

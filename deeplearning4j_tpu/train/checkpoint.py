@@ -38,7 +38,7 @@ import zipfile
 import zlib
 from typing import Dict, List, Optional
 
-from deeplearning4j_tpu.runtime import chaos
+from deeplearning4j_tpu.runtime import chaos, journal
 from deeplearning4j_tpu.train.listeners import TrainingListener, logger
 
 MANIFEST_NAME = "checkpoint_manifest.json"
@@ -106,6 +106,9 @@ def atomic_save_model(model, path: str, save_updater: bool = True) -> Dict[str, 
                 pass
         raise
     _fsync_dir(d)
+    # the checkpoint joins the black box (ISSUE 15): a resume/restart
+    # investigation sees exactly which archives existed when
+    journal.emit("train.checkpoint", path=path, size=entry["size"])
     return entry
 
 

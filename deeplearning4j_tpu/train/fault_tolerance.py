@@ -49,7 +49,7 @@ import time
 from collections import deque
 from typing import Callable, Optional
 
-from deeplearning4j_tpu.runtime import chaos
+from deeplearning4j_tpu.runtime import chaos, journal
 from deeplearning4j_tpu.train.checkpoint import CheckpointListener
 
 logger = logging.getLogger(__name__)
@@ -231,6 +231,9 @@ class FaultTolerantTrainer:
         if ckpt is not None:
             logger.warning("Restoring from checkpoint %s", ckpt)
             net = type(base).load(ckpt)
+            # resume on the black-box record (ISSUE 15): which archive a
+            # restarted trainer actually picked up
+            journal.emit("train.resume", checkpoint=ckpt)
         else:
             net = base
         listeners.append(CheckpointListener(
@@ -303,6 +306,8 @@ class FaultTolerantTrainer:
             budget = f"{self.max_restarts} restarts"
         if recent > self.max_restarts:
             raise TrainingFailure(f"giving up after {budget}") from cause
+        journal.emit("train.restart", cause=type(cause).__name__,
+                     restarts=self.restarts)
         logger.warning("Training failed (%s); restart %d within budget %s",
                        cause, recent, budget)
 

@@ -350,6 +350,40 @@ def test_lint_unregistered_chaos_point_fixture_caught():
     assert any(f.code == "CHAOS-UNREGISTERED" for f in lin.findings)
 
 
+def test_lint_unregistered_journal_event_type_fixture_caught():
+    """ISSUE 15: journal.emit of a type missing from EVENT_TYPES fires
+    JOURNAL-UNREGISTERED; a registered-but-never-emitted type fires
+    JOURNAL-STALE."""
+    lin = Linter()
+    lin._file_pass("serving/fixture.py",
+                   'from deeplearning4j_tpu.runtime import journal\n'
+                   'journal.emit("fixture.not.registered", x=1)\n')
+    lin._all_sources["serving/fixture.py"] = ""
+    lin._all_sources["runtime/journal.py"] = (
+        'EVENT_TYPES = {"ghost.event": "never emitted"}\n')
+    lin._cross_checks()
+    codes = {f.code for f in lin.findings}
+    assert "JOURNAL-UNREGISTERED" in codes
+    assert "JOURNAL-STALE" in codes
+
+
+def test_lint_journal_event_type_parser():
+    from deeplearning4j_tpu.analysis.lint import parse_event_types
+    src = ('from x import y\n'
+           'EVENT_TYPES = {"a.b": "desc", "c.d": "other"}\n')
+    assert parse_event_types(src) == {"a.b": "desc", "c.d": "other"}
+    assert parse_event_types("x = 1\n") == {}
+
+
+def test_journal_event_registry_is_well_formed():
+    from deeplearning4j_tpu.runtime.journal import EVENT_TYPES
+    assert len(EVENT_TYPES) >= 20
+    for etype, desc in EVENT_TYPES.items():
+        assert etype and desc and isinstance(desc, str)
+        assert etype == etype.strip() and " " not in etype
+        assert "." in etype  # <subsystem>.<event> naming
+
+
 def test_lint_clean_fixture_has_no_findings():
     """No-false-positive control: idiomatic, disciplined code."""
     src = (

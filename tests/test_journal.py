@@ -1,0 +1,836 @@
+"""ISSUE 15: the fleet black box — unified event journal, anomaly
+watchdog, and one-command incident bundles.
+
+Layers:
+
+- **Journal core** — ring bounds + wraparound seq ordering, bounded
+  reads (`since`/`limit`/`type` + byte cap), trace-id capture, and the
+  fleet merge across a worker restart (seq reset under a fresh
+  incarnation must NOT reorder the merged timeline).
+- **Emitters** — breaker transitions (`breaker.open` / `breaker.half_open`
+  / `breaker.close`, scoped), registry hot-swap/page-in/evict/residency,
+  config applies + rolling-deploy stages, trainer checkpoint/resume/
+  restart, crash reports (with the injectable clock), shed windows.
+- **Watchdog** — every rule unit-tested with injectable clocks (no
+  sleeping): breaker-flap, restart-storm, page-in-thrash, election
+  churn, SLO fast-burn; incidents open once (no flapping) and close
+  after the quiet window.
+- **Autoscaler migration** — decisions and elections are journal events
+  and `/v1/autoscaler`'s `decisions` reads them back (single source).
+- **Access log rotation** — `DL4J_TPU_ACCESS_LOG=<path>` +
+  `DL4J_TPU_ACCESS_LOG_MAX_BYTES` keep-1 rollover.
+- **The tier-1 incident drill** — SIGKILL a worker under seeded
+  stragglers in a real subprocess fleet; ONE `/v1/debug/bundle` pull
+  reconstructs the whole timeline: kill -> breaker open -> failover ->
+  restart -> readmit, seq-ordered, gapless per incarnation, every
+  timeline event trace-linked.
+"""
+
+import io
+import json
+import os
+import tarfile
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.runtime import journal, trace
+from deeplearning4j_tpu.serving import blackbox
+from deeplearning4j_tpu.serving.resilience import CircuitBreaker
+from deeplearning4j_tpu.serving.slo import SLOMonitor, SLOTarget
+
+
+@pytest.fixture()
+def fresh_journal():
+    """A fresh bounded ring for this test; restores a default ring
+    after (the journal is process-global)."""
+    j = journal.enable(capacity=512)
+    yield j
+    journal.enable(capacity=1024)
+
+
+def _conf(seed=7):
+    return (NeuralNetConfiguration.builder().seed(seed).updater(None)
+            .list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=4, activation="softmax"))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+
+
+X = np.random.default_rng(0).normal(size=(16, 8)).astype(np.float32)
+BATCHER_KW = dict(max_batch_size=4, buckets=[1, 4], batch_timeout_ms=1.0,
+                  pipeline_depth=0)
+
+
+# ==========================================================================
+# journal core
+def test_ring_bounds_and_wraparound_seq_order(fresh_journal):
+    j = journal.enable(capacity=8)
+    for i in range(20):
+        journal.emit("chaos.action", point="fixture", index=i,
+                     policy="FailNth", action="raise")
+    evs = j.events()
+    assert len(evs) == 8                      # bounded
+    seqs = [e["seq"] for e in evs]
+    assert seqs == sorted(seqs) == list(range(12, 20))  # newest, ordered
+    c = j.counters()
+    assert c["events_total"] == 20
+    assert c["overwritten_total"] == 12
+    assert c["live"] == 8
+    # every event carries the schema fields
+    for e in evs:
+        assert e["type"] == "chaos.action"
+        assert e["incarnation"] == journal.incarnation()
+        assert isinstance(e["ts"], float)
+        assert e["attrs"]["policy"] == "FailNth"
+
+
+def test_bound_events_filters_limit_and_byte_cap(fresh_journal):
+    base = 1000.0
+    evs = [{"seq": i, "ts": base + i, "type": ("a" if i % 2 else "b"),
+            "incarnation": "x", "attrs": {}} for i in range(10)]
+    out, trunc = journal.bound_events(evs, types={"a"})
+    assert [e["seq"] for e in out] == [1, 3, 5, 7, 9] and not trunc
+    out, trunc = journal.bound_events(evs, since=base + 6)
+    assert [e["seq"] for e in out] == [6, 7, 8, 9] and not trunc
+    out, trunc = journal.bound_events(evs, limit=3)
+    assert [e["seq"] for e in out] == [7, 8, 9] and trunc
+    # byte cap drops oldest-first but always keeps the newest
+    out, trunc = journal.bound_events(evs, max_bytes=1)
+    assert [e["seq"] for e in out] == [9] and trunc
+
+
+def test_merge_across_worker_restart_seq_reset_does_not_reorder():
+    """The satellite regression: a restarted worker's seq resets to 0
+    under a fresh incarnation; the merged view must stay in wall-time
+    order (seq-first ordering would teleport the new events before the
+    old)."""
+    old = [{"seq": i, "ts": 100.0 + i, "type": "fleet.worker_spawn",
+            "incarnation": "old", "attrs": {}} for i in range(5)]
+    new = [{"seq": i, "ts": 200.0 + i, "type": "fleet.worker_spawn",
+            "incarnation": "new", "attrs": {}} for i in range(3)]
+    merged = journal.merge_events([new, old, new])  # dup stream too
+    assert len(merged) == 8                   # de-duplicated
+    assert [e["incarnation"] for e in merged] == ["old"] * 5 + ["new"] * 3
+    ts = [e["ts"] for e in merged]
+    assert ts == sorted(ts)
+    # same-tick events within one process keep seq order
+    tied = [{"seq": s, "ts": 50.0, "type": "chaos.action",
+             "incarnation": "t", "attrs": {}} for s in (3, 1, 2)]
+    merged = journal.merge_events([tied])
+    assert [e["seq"] for e in merged] == [1, 2, 3]
+
+
+def test_emit_captures_active_trace_id(fresh_journal):
+    trace.enable(rate=1.0, capacity=16)
+    try:
+        with trace.span("fixture.work") as sp:
+            rec = journal.emit("chaos.action", point="p", index=1,
+                               policy="X", action="a")
+            assert rec["trace_id"] == sp.trace_id
+    finally:
+        trace.disable()
+    rec = journal.emit("chaos.action", point="p", index=2, policy="X",
+                       action="a")
+    assert rec["trace_id"] is None
+    rec = journal.emit("chaos.action", _trace_id="forced", point="p",
+                       index=3, policy="X", action="a")
+    assert rec["trace_id"] == "forced"
+
+
+def test_disabled_journal_is_noop(fresh_journal):
+    journal.disable()
+    try:
+        assert journal.emit("chaos.action", point="p", index=0,
+                            policy="X", action="a") is None
+        assert journal.events() == []
+        assert journal.counters()["events_total"] == 0
+        assert "journal_enabled 0" in journal.render_prometheus()
+    finally:
+        journal.enable(capacity=512)
+
+
+# ==========================================================================
+# emitters
+def test_breaker_transitions_emit_scoped_events(fresh_journal):
+    clk = {"t": 0.0}
+    b = CircuitBreaker(failure_threshold=2, window_s=60.0,
+                       reset_timeout_s=5.0, clock=lambda: clk["t"])
+    b.journal_scope = "model:m"
+    b.record_failure()
+    assert journal.events(types={"breaker.open"}) == []  # below threshold
+    b.record_failure()                       # CLOSED -> OPEN
+    clk["t"] = 10.0
+    assert b.state.name == "HALF_OPEN"       # OPEN -> HALF_OPEN via tick
+    assert b.allow()
+    b.record_success()                       # HALF_OPEN -> CLOSED
+    types = [(e["type"], e["attrs"].get("scope")) for e in journal.events(
+        types={"breaker.open", "breaker.half_open", "breaker.close"})]
+    assert types == [("breaker.open", "model:m"),
+                     ("breaker.half_open", "model:m"),
+                     ("breaker.close", "model:m")]
+    # a failed half-open probe re-opens, with the reason recorded
+    b.record_failure(); b.record_failure()
+    clk["t"] = 20.0
+    assert b.state.name == "HALF_OPEN" and b.allow()
+    b.record_failure()
+    opens = journal.events(types={"breaker.open"})
+    assert opens[-1]["attrs"]["reason"] == "probe_failed"
+
+
+def test_config_apply_events(fresh_journal, tmp_path):
+    from deeplearning4j_tpu.serving.control_plane import FleetConfig
+    cfg = FleetConfig(str(tmp_path / "fleet.json"))
+    cfg.set_workers({"w0": "127.0.0.1:1"})
+    cfg.set_router("r0", "127.0.0.1:2")
+    evs = journal.events(types={"control.config_apply"})
+    assert [e["attrs"]["version"] for e in evs] == [1, 2]
+    assert evs[-1]["attrs"]["routers"] == 1
+    cfg.set_workers({"w0": "127.0.0.1:1"})   # no-op mutation: no event
+    assert len(journal.events(types={"control.config_apply"})) == 2
+
+
+class _ReadyStub:
+    """A minimal always-ready HTTP worker for router-side emitter tests
+    (no jax)."""
+
+    def __init__(self, predict_status=200, retry_after_ms=None):
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code, payload, extra=None):
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                for k, v in (extra or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                self._send(200, b'{"ready": true}')
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                extra = {}
+                if stub.retry_after_ms is not None:
+                    extra["Retry-After-Ms"] = f"{stub.retry_after_ms:.0f}"
+                body = (b'{"error": "overloaded", "reason": "overloaded"}'
+                        if stub.predict_status != 200 else b'{"outputs": []}')
+                self._send(stub.predict_status, body, extra)
+
+            def log_message(self, *a):
+                pass
+
+        self.predict_status = predict_status
+        self.retry_after_ms = retry_after_ms
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        self.thread = threading.Thread(target=self.httpd.serve_forever,
+                                       daemon=True, name="ModelServer-stub")
+        self.thread.start()
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+def test_router_shed_window_event(fresh_journal):
+    from deeplearning4j_tpu.serving.router import FleetRouter, StaticFleet
+    stub = _ReadyStub(predict_status=503, retry_after_ms=700.0)
+    router = FleetRouter(StaticFleet({"w0": f"127.0.0.1:{stub.port}"}),
+                         hedge_enabled=False, probe_interval_s=0.05)
+    port = router.start(0)
+    try:
+        body = json.dumps({"inputs": [[0.0]], "timeout_ms": 500}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/m/predict", data=body)
+        try:
+            urllib.request.urlopen(req, timeout=10)
+        except urllib.error.HTTPError:
+            pass  # 503 expected: the only worker is shedding
+        evs = journal.events(types={"router.shed_window"})
+        assert evs and evs[0]["attrs"]["worker"] == "w0"
+        assert evs[0]["attrs"]["window_ms"] == pytest.approx(700.0, abs=1.0)
+        # worker readiness transition also journaled
+        assert any(e["attrs"]["worker"] == "w0"
+                   for e in journal.events(types={"router.worker_ready"}))
+    finally:
+        router.stop()
+        stub.stop()
+
+
+class _FakeRestartFleet:
+    """Duck-typed supervisor for rolling_deploy: one always-ready stub
+    worker, restart is a no-op (the stub keeps serving)."""
+
+    def __init__(self, stub):
+        self._stub = stub
+        self.restarted = []
+
+    def endpoints(self):
+        return {"w0": f"127.0.0.1:{self._stub.port}"}
+
+    def worker_ids(self):
+        return ["w0"]
+
+    def restart_worker(self, wid, archive=None, version=None):
+        self.restarted.append((wid, archive, version))
+
+
+def test_rolling_deploy_stage_events(fresh_journal, tmp_path):
+    from deeplearning4j_tpu.serving.router import FleetRouter
+    stub = _ReadyStub()
+    fleet = _FakeRestartFleet(stub)
+    router = FleetRouter(fleet, probe_interval_s=0.05)
+    port = router.start(0)
+    try:
+        archive = str(tmp_path / "model-v9.zip")
+        with open(archive, "wb") as f:
+            f.write(b"zip")
+        report = router.rolling_deploy(archive, version=9,
+                                       ready_timeout_s=10)
+        assert fleet.restarted == [("w0", archive, 9)]
+        stages = [e["attrs"]["stage"]
+                  for e in journal.events(types={"control.deploy_stage"})]
+        assert stages == ["drained", "readmitted", "completed"]
+        assert "w0" in report["workers"]
+    finally:
+        router.stop()
+        stub.stop()
+
+
+@pytest.fixture(scope="module")
+def model_archive(tmp_path_factory):
+    td = tmp_path_factory.mktemp("journal-models")
+    path = str(td / "model.zip")
+    MultiLayerNetwork(_conf()).init().save(path)
+    return path
+
+
+def test_registry_hot_swap_page_in_evict_and_residency_events(
+        fresh_journal, model_archive):
+    from deeplearning4j_tpu.serving import ModelRegistry, ModelServer
+    reg = ModelRegistry()
+    try:
+        reg.load("m", model_archive, warmup_example=X[:1], **BATCHER_KW)
+        reg.load("m", model_archive, warmup_example=X[:1],
+                 **BATCHER_KW)                    # hot-swap v1 -> v2
+        hs = journal.events(types={"registry.hot_swap"})
+        assert [(e["attrs"]["old_version"], e["attrs"]["new_version"])
+                for e in hs] == [(1, 2)]
+        assert reg.evict("m")
+        ev = journal.events(types={"registry.evict"})
+        assert ev and ev[0]["attrs"]["model"] == "m"
+        served = reg.acquire("m")                 # cold hit -> page-in
+        served.unpin()
+        pi = journal.events(types={"registry.page_in"})
+        assert pi and pi[0]["attrs"]["model"] == "m"
+        assert pi[0]["attrs"]["seconds"] > 0
+        # the explicit lever (through the server handler, no HTTP)
+        srv = ModelServer(reg, worker_id="w-test")
+        code, obj, _ = srv._handle_residency(
+            "m", json.dumps({"state": "cold"}).encode())
+        assert code == 200
+        lev = journal.events(types={"registry.residency_lever"})
+        assert lev and lev[-1]["attrs"]["target_state"] == "cold"
+    finally:
+        reg.shutdown(drain=False)
+
+
+def test_crash_report_injectable_clock_and_event(fresh_journal, tmp_path):
+    import datetime
+
+    from deeplearning4j_tpu.runtime.crash_reporting import CrashReportingUtil
+    fixed = datetime.datetime(2026, 8, 4, 12, 30, 45)
+    old_clock, old_dir = CrashReportingUtil.clock, \
+        CrashReportingUtil.crash_dump_dir
+    CrashReportingUtil.clock = lambda: fixed
+    CrashReportingUtil.crash_dump_dir = str(tmp_path)
+    try:
+        report = CrashReportingUtil.write_memory_crash_dump(
+            error=MemoryError("RESOURCE_EXHAUSTED fixture"))
+        expected = str(tmp_path /
+                       "dl4j-tpu-memory-crash-dump-20260804-123045.txt")
+        assert os.path.exists(expected)
+        assert "2026-08-04T12:30:45" in report
+        evs = journal.events(types={"crash.report"})
+        assert evs and evs[0]["attrs"]["path"] == expected
+        assert evs[0]["attrs"]["written"] is True
+        assert evs[0]["attrs"]["error"] == "MemoryError"
+        assert blackbox.crash_report_paths(5, str(tmp_path)) == [expected]
+    finally:
+        CrashReportingUtil.clock = old_clock
+        CrashReportingUtil.crash_dump_dir = old_dir
+
+
+def test_trainer_checkpoint_resume_restart_events(fresh_journal, tmp_path):
+    from deeplearning4j_tpu.train.checkpoint import CheckpointListener
+    from deeplearning4j_tpu.train.fault_tolerance import FaultTolerantTrainer
+    net = MultiLayerNetwork(_conf()).init()
+    listener = CheckpointListener(str(tmp_path), every_n_iterations=1)
+    listener._save(net, "iter1")
+    ck = journal.events(types={"train.checkpoint"})
+    assert ck and ck[0]["attrs"]["path"].endswith("checkpoint_0_iter1.zip")
+    assert ck[0]["attrs"]["size"] > 0
+
+    trainer = FaultTolerantTrainer(lambda: MultiLayerNetwork(_conf()),
+                                   checkpoint_dir=str(tmp_path),
+                                   every_n_iterations=10, max_restarts=3)
+    trainer._register_restart(RuntimeError("injected"))
+    rs = journal.events(types={"train.restart"})
+    assert rs and rs[0]["attrs"]["cause"] == "RuntimeError"
+    trainer._fresh_net()                      # restores the checkpoint
+    rz = journal.events(types={"train.resume"})
+    assert rz and rz[0]["attrs"]["checkpoint"].endswith(
+        "checkpoint_0_iter1.zip")
+
+
+# ==========================================================================
+# watchdog rules (injectable clocks; no sleeping)
+def _mk_watchdog(rules, events_ref, wall):
+    return blackbox.AnomalyWatchdog(
+        rules=rules, events_fn=lambda: list(events_ref),
+        clear_after_s=10.0, interval_s=0.0,
+        wall_fn=lambda: wall["t"], mono_fn=lambda: wall["t"])
+
+
+def _ev(etype, ts, **attrs):
+    return {"seq": int(ts * 10), "ts": ts, "type": etype,
+            "incarnation": "w", "trace_id": None, "attrs": attrs}
+
+
+@pytest.mark.parametrize("rule_name,etype", [
+    ("breaker_flap", "breaker.open"),
+    ("restart_storm", "fleet.worker_restart"),
+    ("page_in_thrash", "registry.page_in"),
+    ("election_churn", "autoscale.election"),
+])
+def test_watchdog_rate_rules_open_once_and_close(fresh_journal, rule_name,
+                                                 etype):
+    rule = next(r for r in blackbox.default_rules()
+                if r.name == rule_name)
+    events, wall = [], {"t": 1000.0}
+    wd = _mk_watchdog([rule], events, wall)
+    # below threshold: quiet
+    events.extend(_ev(etype, 999.0) for _ in range(rule.threshold - 1))
+    assert wd.tick() == []
+    # at threshold: exactly one incident.open, and no flapping while the
+    # rule keeps firing
+    events.append(_ev(etype, 999.5))
+    opened = wd.tick()
+    assert [e["type"] for e in opened] == ["incident.open"]
+    assert opened[0]["attrs"]["rule"] == rule_name
+    assert opened[0]["attrs"]["count"] >= rule.threshold
+    assert wd.tick() == []
+    assert rule_name in wd.snapshot()["open"]
+    assert f'incident_open{{rule="{rule_name}"}} 1' in \
+        wd.render_prometheus()
+    # quiet past the clear window: incident.close with the duration
+    wall["t"] = 1000.0 + rule.window_s + 30.0
+    closed = wd.tick()
+    assert [e["type"] for e in closed] == ["incident.close"]
+    assert closed[0]["attrs"]["rule"] == rule_name
+    assert closed[0]["attrs"]["duration_s"] > 0
+    assert wd.snapshot()["open"] == {}
+    assert wd.incidents_total == 1
+
+
+def test_watchdog_page_in_thrash_counts_evictions_too(fresh_journal):
+    rule = next(r for r in blackbox.default_rules()
+                if r.name == "page_in_thrash")
+    events, wall = [], {"t": 1000.0}
+    wd = _mk_watchdog([rule], events, wall)
+    for i in range(3):
+        events.append(_ev("registry.page_in", 999.0 + i, model="m"))
+        events.append(_ev("registry.evict", 999.2 + i, model="m"))
+    opened = wd.tick()
+    assert opened and opened[0]["attrs"]["rule"] == "page_in_thrash"
+
+
+def test_watchdog_slo_burn_rule(fresh_journal):
+    clk = {"t": 1000.0}
+    slo = SLOMonitor(target=SLOTarget(availability=0.999, latency_ms=50.0),
+                     windows_s=(60, 300), now_fn=lambda: clk["t"])
+    for _ in range(20):
+        slo.record("m", ok=False, latency_s=0.01)   # 100% errors: burning
+    rule = blackbox.BurnRule(slo, window_s=60, burn=2.0, min_requests=8)
+    fired = rule.evaluate([], now_wall=clk["t"])
+    assert fired and "m" in fired["burning_models"]
+    events, wall = [], {"t": 1000.0}
+    wd = _mk_watchdog([rule], events, wall)
+    opened = wd.tick()
+    assert opened and opened[0]["attrs"]["rule"] == "slo_fast_burn"
+
+
+def test_watchdog_ignores_its_own_incident_events(fresh_journal):
+    """Self-feedback guard: incident.open events must not feed rules."""
+    rule = blackbox.RateRule("meta", {"incident.open"}, 1, 60.0)
+    events, wall = [{"seq": 0, "ts": 999.0, "type": "incident.open",
+                     "incarnation": "w", "attrs": {}}], {"t": 1000.0}
+    wd = _mk_watchdog([rule], events, wall)
+    assert wd.tick() == []
+
+
+# ==========================================================================
+# autoscaler migration: the journal is the single source
+class _FakeView:
+    worker_id = "w0"
+    address = "127.0.0.1:1"
+
+    def admittable(self, now=None):
+        return True
+
+
+class _FakeRouter:
+    router_id = "router-journal-test"
+
+    def __init__(self, slo):
+        self.slo = slo
+        self.view = _FakeView()
+        self.autoscaler = None
+
+    def ranked_workers(self, model):
+        return [self.view]
+
+    def workers(self):
+        return {"w0": self.view}
+
+    def attach_autoscaler(self, a):
+        self.autoscaler = a
+
+
+def _controller(slo_clock, now_clock):
+    from deeplearning4j_tpu.serving import AutoscalerConfig, SLOAutoscaler
+    slo = SLOMonitor(target=SLOTarget(availability=0.999, latency_ms=50.0,
+                                      latency_target=0.9),
+                     windows_s=(10, 60), now_fn=lambda: slo_clock["t"])
+    state = {"replicas": 1}
+
+    def lever(view, model, delta, span):
+        state["replicas"] = max(1, state["replicas"] + delta)
+        return True, {"replicas": state["replicas"]}
+
+    def capacity_fn():
+        return {"workers": {"w0": {
+            "models": {"m": {"param_bytes": 10, "model_state_bytes": 0,
+                             "replicas": state["replicas"],
+                             "utilization": {"busy_fraction": 0.5},
+                             "queue": {"depth": 0,
+                                       "headroom_requests": 64}}},
+            "totals": {"device_bytes": 10},
+            "process": {"device_budget_bytes": None}}},
+            "models": {}, "process": {}}
+
+    cfg = AutoscalerConfig(fast_window_s=10, slow_window_s=60,
+                           up_burn=2.0, confirm_burn=1.0, down_burn=0.5,
+                           up_cooldown_s=5.0, down_cooldown_s=30.0,
+                           min_requests=4, max_replicas=4, predictive=False)
+    auto = SLOAutoscaler(_FakeRouter(slo), config=cfg,
+                         capacity_fn=capacity_fn, replica_lever=lever,
+                         now_fn=lambda: now_clock["t"])
+    return auto, slo, state
+
+
+def test_autoscaler_decisions_are_journal_events_and_report_reads_back(
+        fresh_journal):
+    slo_clock, now_clock = {"t": 1000.0}, {"t": 0.0}
+    auto, slo, state = _controller(slo_clock, now_clock)
+    for _ in range(40):
+        slo.record("m", ok=False, latency_s=0.001)  # sustained breach
+    decisions = auto.tick()
+    assert [d["action"] for d in decisions] == ["scale_up_replica"]
+    assert state["replicas"] == 2
+    # the decision IS a journal event...
+    evs = journal.events(types={"autoscale.decision"})
+    assert len(evs) == 1
+    assert evs[0]["attrs"]["entry"]["action"] == "scale_up_replica"
+    # ...and the /v1/autoscaler view reads it back from the journal
+    rep = auto.report()
+    assert [d["action"] for d in rep["decisions"]] == ["scale_up_replica"]
+    assert rep["decisions"][0] == decisions[0]
+    # elections land in the same log, via autoscale.election events
+    auto._record_election({"ts": 123.0, "role": "leader",
+                           "holder": "r@1", "seq": 2,
+                           "reason": "takeover", "id": "r@1"})
+    assert journal.events(types={"autoscale.election"})
+    actions = [d["action"] for d in auto.report()["decisions"]]
+    assert actions == ["scale_up_replica", "election_leader"]
+
+
+def test_two_controllers_do_not_cross_read(fresh_journal):
+    slo_clock, now_clock = {"t": 1000.0}, {"t": 0.0}
+    auto_a, slo_a, _ = _controller(slo_clock, now_clock)
+    auto_b, slo_b, _ = _controller(slo_clock, now_clock)
+    for _ in range(40):
+        slo_a.record("m", ok=False, latency_s=0.001)
+    assert auto_a.tick()
+    assert auto_a.report()["decisions"]
+    assert auto_b.report()["decisions"] == []  # b never decided anything
+
+
+# ==========================================================================
+# access-log rotation (ISSUE 15 satellite)
+def test_access_log_file_rotation_keep_one(tmp_path, monkeypatch):
+    path = str(tmp_path / "access.log")
+    monkeypatch.setenv("DL4J_TPU_ACCESS_LOG", path)
+    monkeypatch.setenv("DL4J_TPU_ACCESS_LOG_MAX_BYTES", "300")
+    for i in range(20):
+        trace.emit_access_log({"request_id": f"r{i:03d}", "outcome": 200,
+                               "latency_ms": 1.0})
+    assert os.path.exists(path) and os.path.exists(path + ".1")
+    # rotation keeps exactly one rollover and bounds the live file
+    assert os.path.getsize(path) <= 300
+    assert os.path.getsize(path + ".1") <= 300
+    assert not os.path.exists(path + ".2")
+    with open(path) as f:
+        lines = [json.loads(ln) for ln in f.read().splitlines()]
+    assert all(ln["log"] == "dl4j_tpu_access" for ln in lines)
+    # newest record is in the live file
+    assert lines[-1]["request_id"] == "r019"
+
+
+def test_access_log_stderr_spelling_unchanged(tmp_path, monkeypatch,
+                                              capsys):
+    monkeypatch.setenv("DL4J_TPU_ACCESS_LOG", "1")
+    trace.emit_access_log({"request_id": "r0", "outcome": 200})
+    err = capsys.readouterr().err
+    assert '"dl4j_tpu_access"' in err
+    assert not os.path.exists(str(tmp_path / "1"))
+
+
+def test_access_log_off_spellings_disable_not_filename(tmp_path,
+                                                       monkeypatch):
+    """Review fix: 'off'/'no' must DISABLE the log (aligned with
+    DL4J_TPU_JOURNAL's parsing), never write to a file named ./off."""
+    monkeypatch.chdir(tmp_path)
+    for v in ("off", "no", "0", "false", ""):
+        monkeypatch.setenv("DL4J_TPU_ACCESS_LOG", v)
+        assert not trace.access_log_enabled()
+        trace.emit_access_log({"request_id": "r0", "outcome": 200})
+        assert not os.path.exists(str(tmp_path / v)) or v == ""
+
+
+# ==========================================================================
+# worker endpoints + local bundle (no subprocesses)
+def test_worker_journal_stacks_and_bundle_endpoints(fresh_journal):
+    from deeplearning4j_tpu.serving import ModelRegistry, ModelServer
+    srv = ModelServer(ModelRegistry(), worker_id="w-bb")
+    journal.emit("chaos.action", point="fixture", index=1, policy="P",
+                 action="a")
+    code, obj = srv._handle_get("/v1/journal?limit=5")
+    assert code == 200 and obj["worker"] == "w-bb"
+    assert [e["type"] for e in obj["events"]] == ["chaos.action"]
+    code, obj = srv._handle_get("/v1/journal?type=registry.page_in")
+    assert code == 200 and obj["events"] == []
+    code, obj = srv._handle_get("/v1/journal?limit=nope")
+    assert code == 400
+    code, obj = srv._handle_get("/v1/debug/stacks")
+    assert code == 200 and any("MainThread" in k for k in obj["stacks"])
+    data = blackbox.local_bundle(srv)
+    with tarfile.open(fileobj=io.BytesIO(data)) as tf:
+        names = tf.getnames()
+        manifest = json.load(tf.extractfile("manifest.json"))
+        jpayload = json.load(tf.extractfile("journal.json"))
+    assert {"journal.json", "traces.json", "metrics.txt", "capacity.json",
+            "slo.json", "manifest.json"} <= set(names)
+    assert any(n.startswith("stacks/") for n in names)
+    assert manifest["kind"] == "worker" and manifest["contents"] == \
+        sorted(manifest["contents"])
+    assert [e["type"] for e in jpayload["events"]] == ["chaos.action"]
+    # journal gauges render on the worker /metrics
+    assert "journal_events_total" in srv._render_metrics()
+
+
+# ==========================================================================
+# the tier-1 incident drill: subprocess fleet, SIGKILL, one bundle
+@pytest.fixture(scope="module")
+def incident_fleet(tmp_path_factory):
+    """A supervised 3-worker fleet under seeded straggler chaos, a
+    router with an attached drill-tuned watchdog, and tracing enabled so
+    every journal event is trace-linkable."""
+    from deeplearning4j_tpu.runtime.environment import get_environment
+    from deeplearning4j_tpu.serving import ModelRegistry
+    from deeplearning4j_tpu.serving.fleet import FleetSupervisor, WorkerSpec
+    from deeplearning4j_tpu.serving.router import FleetRouter
+
+    td = tmp_path_factory.mktemp("incident")
+    archive = str(td / "model-v1.zip")
+    cache = str(td / "executable-cache")
+    MultiLayerNetwork(_conf()).init().save(archive)
+    get_environment().set_compile_cache(cache)
+    reg = ModelRegistry()
+    reg.load("m", archive, warmup_example=X[:1], **BATCHER_KW)
+    oracle = reg.get("m").model
+    reg.shutdown()  # persists the warmup manifest next to the archive
+
+    journal.enable(capacity=4096)
+    trace.enable(rate=0.0, capacity=512)  # flagged-only keep; ids for all
+    specs = [WorkerSpec(worker_id=f"w{i}", model_name="m", archive=archive,
+                        version=1, batcher_kw=dict(BATCHER_KW),
+                        cache_dir=cache,
+                        straggle={"p": 0.2, "ms": 80.0, "seed": 11 + i})
+             for i in range(3)]
+    sup = FleetSupervisor(specs, run_dir=str(td / "run"), max_restarts=4,
+                          heartbeat_timeout_s=60.0).start()
+    router = FleetRouter(sup, probe_interval_s=0.1, hedge_initial_ms=250.0)
+    wd = blackbox.AnomalyWatchdog(
+        rules=[blackbox.RateRule(
+            "restart_storm", {"fleet.worker_kill", "fleet.worker_restart"},
+            threshold=1, window_s=120.0)],
+        interval_s=0.1, clear_after_s=300.0)
+    router.attach_watchdog(wd)
+    port = router.start(0)
+    try:
+        yield sup, router, port, oracle
+    finally:
+        router.stop()
+        sup.stop()
+        trace.disable()
+        journal.enable(capacity=1024)
+
+
+def _drill_post(port, n, ofs, timeout_ms=10000):
+    body = json.dumps({"inputs": X[ofs:ofs + n].tolist(),
+                       "timeout_ms": timeout_ms}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/models/m/predict", data=body)
+    resp = urllib.request.urlopen(req, timeout=30)
+    return resp.status, json.loads(resp.read())
+
+
+def test_incident_drill_one_bundle_reconstructs_the_timeline(
+        incident_fleet):
+    sup, router, port, oracle = incident_fleet
+
+    outcomes, lock, stop = [], threading.Lock(), threading.Event()
+
+    def client(tid):
+        k = 0
+        while not stop.is_set():
+            n, ofs = 1 + (tid + k) % 4, (3 * k + tid) % 8
+            try:
+                status, out = _drill_post(port, n, ofs)
+                rec = ("ok", status)
+            except Exception as e:
+                rec = ("error", type(e).__name__)
+            with lock:
+                outcomes.append(rec)
+            k += 1
+            time.sleep(0.01)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(6)]
+    for t in threads:
+        t.start()
+    time.sleep(0.8)                          # steady state under load
+    victim = router.ranked_workers("m")[0].worker_id
+    # drill knob: one in-flight connection fault opens the victim's
+    # passive breaker (production threshold is 3; the kill severs every
+    # in-flight request at once, but the drill must be deterministic)
+    router.workers()[victim].breaker.failure_threshold = 1
+    kill_wall = time.time()
+    sup.kill_worker(victim)
+    time.sleep(2.0)                          # failover + probe + watchdog
+    # wait for the supervisor relaunch and router readmission
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        evs = journal.events(types={"router.worker_ready"},
+                             since=kill_wall)
+        if any(e["attrs"]["worker"] == victim for e in evs):
+            break
+        time.sleep(0.1)
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+
+    # zero client-visible errors across the kill (the PR 7 guarantee)
+    bad = [o for o in outcomes if o[0] != "ok"]
+    assert not bad, f"client-visible failures: {bad[:5]}"
+
+    # ---- ONE bundle pull reconstructs everything -------------------
+    data = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/v1/debug/bundle", timeout=60).read()
+    with tarfile.open(fileobj=io.BytesIO(data)) as tf:
+        names = set(tf.getnames())
+        manifest = json.load(tf.extractfile("manifest.json"))
+        events = json.load(tf.extractfile("journal.json"))["events"]
+        metrics = tf.extractfile("metrics.txt").read().decode()
+        watchdog = json.load(tf.extractfile("watchdog.json"))
+    assert {"journal.json", "traces.json", "metrics.txt", "capacity.json",
+            "slo.json", "watchdog.json", "manifest.json"} <= names
+    # a stack sample for the router process AND every worker
+    stacks = {n for n in names if n.startswith("stacks/")}
+    assert len(stacks) >= 4, stacks
+    assert manifest["kind"] == "fleet"
+
+    # the timeline: kill -> breaker open -> failover -> restart ->
+    # readmit, in merged order
+    def first_index(pred):
+        for i, e in enumerate(events):
+            if pred(e):
+                return i
+        return None
+
+    i_kill = first_index(lambda e: e["type"] == "fleet.worker_kill"
+                         and e["attrs"]["worker"] == victim)
+    i_open = first_index(lambda e: e["type"] == "breaker.open"
+                         and e["attrs"].get("scope") == f"worker:{victim}"
+                         and events and e["ts"] >= kill_wall - 1)
+    i_fail = first_index(lambda e: e["type"] == "router.failover"
+                         and e["ts"] >= kill_wall - 1)
+    i_restart = first_index(lambda e: e["type"] == "fleet.worker_restart"
+                            and e["attrs"]["worker"] == victim)
+    i_unready = first_index(lambda e: e["type"] == "router.worker_unready"
+                            and e["attrs"]["worker"] == victim)
+    i_ready = first_index(lambda e: e["type"] == "router.worker_ready"
+                          and e["attrs"]["worker"] == victim
+                          and e["ts"] >= kill_wall)
+    assert None not in (i_kill, i_open, i_fail, i_restart, i_unready,
+                        i_ready), \
+        [(e["type"], e["attrs"]) for e in events][-40:]
+    assert i_kill < i_open, "breaker opened before the kill?"
+    assert i_kill < i_fail and i_kill < i_restart < i_ready
+    assert i_kill < i_unready < i_ready
+    timeline = [events[i] for i in (i_kill, i_open, i_fail, i_restart,
+                                    i_ready)]
+    # every timeline event is trace-linked
+    assert all(e["trace_id"] for e in timeline), timeline
+    # worker-side chaos (the straggler schedule) merged into the view
+    assert any(e["type"] == "chaos.action" for e in events)
+    # the merged view is wall-ordered and per-incarnation seq-gapless
+    ts = [e["ts"] for e in events]
+    assert ts == sorted(ts)
+    by_inc = {}
+    for e in events:
+        by_inc.setdefault(e["incarnation"], []).append(e["seq"])
+    for inc, seqs in by_inc.items():
+        assert seqs == list(range(seqs[0], seqs[0] + len(seqs))), \
+            f"seq gap in incarnation {inc}"
+
+    # the watchdog opened an incident on the kill
+    incident = first_index(lambda e: e["type"] == "incident.open"
+                           and e["attrs"]["rule"] == "restart_storm")
+    assert incident is not None and incident > i_kill
+    assert watchdog["incidents_total"] >= 1
+    assert "incident_opens_total" in metrics
+    assert "journal_events_total" in metrics
+
+    # a filtered fleet /v1/journal scrape works end to end
+    payload = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/v1/journal?type=fleet.worker_kill",
+        timeout=30).read())
+    assert [e["type"] for e in payload["events"]] == ["fleet.worker_kill"]
+
+    # retire leg: removing a worker leaves a fleet.worker_retire record
+    sup.remove_worker(victim)
+    assert any(e["attrs"]["worker"] == victim
+               for e in journal.events(types={"fleet.worker_retire"}))
