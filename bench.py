@@ -251,6 +251,13 @@ def check_tables(baseline_md=None, bench_extra=None, log=_log):
     if measured is not None:
         check_blackbox_section(measured, failures, warnings)
 
+    # ISSUE 16 session keys: both arms bit-identical, batched step
+    # throughput at least the serial rnn_time_step loop (recomputable),
+    # zero on-traffic compiles, zero lost sessions, spill/rehydrate p99s
+    # from a real rehydrate cycle
+    if measured is not None:
+        check_sessions_section(measured, failures, warnings)
+
     for w in warnings:
         log(f"[check-tables] WARN {w}")
     for fmsg in failures:
@@ -4651,6 +4658,242 @@ def check_blackbox_section(extra, failures, warnings):
         failures.append(f"blackbox: malformed section ({e!r})")
 
 
+def bench_sessions(n_sessions=8, steps=30, bucket=8, bench_extra=None,
+                   log=_log):
+    """``bench.py --sessions`` (ISSUE 16): the session tier A/B.
+
+    Serial arm: one ``rnn_time_step``-shaped step at a time through the
+    SessionStore (bucket occupancy 1 — exactly what a client doing its
+    own streaming loop gets). Batched arm: one thread per session, so
+    concurrent steps coalesce into the fixed session bucket. Both arms
+    run at the SAME padded shape, so the contract is throughput >= serial
+    AND bit-identity against a raw ``rnn_time_step`` oracle AND zero
+    on-traffic compiles after the single warmup. A spill -> rehydrate
+    cycle over every session records the state-movement percentiles
+    (``serving.session.step`` / ``serving.session.rehydrate`` are the
+    matching chaos points for the robustness drills)."""
+    import shutil
+    import tempfile
+    import threading
+
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn import LSTM, InputType, RnnOutputLayer
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.serving import ModelRegistry, SessionStore
+
+    t_feat = 3
+
+    def make_net():
+        conf = (NeuralNetConfiguration.builder().seed(7).list()
+                .layer(LSTM(n_out=16))
+                .layer(RnnOutputLayer(n_out=4, activation="softmax"))
+                .set_input_type(InputType.recurrent(t_feat, 1))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    chunk_sets = [[rng.standard_normal((1, 1, t_feat)).astype(np.float32)
+                   for _ in range(steps)] for _ in range(n_sessions)]
+
+    # the serial oracle: a raw rnn_time_step loop on zeros-padded batches
+    # of the SAME bucket size, session in row 0
+    oracle_net = make_net()
+    oracles = []
+    for chunks in chunk_sets:
+        oracle_net.rnn_clear_previous_state()
+        outs = []
+        for c in chunks:
+            xb = np.zeros((bucket, 1, t_feat), np.float32)
+            xb[0] = c[0]
+            outs.append(np.asarray(oracle_net.rnn_time_step(xb))[:1])
+        oracles.append(outs)
+    oracle_net.rnn_clear_previous_state()
+
+    spill = tempfile.mkdtemp(prefix="bench-sessions-")
+    reg = ModelRegistry()
+    reg.register("lstm", make_net(), max_batch_size=bucket, replicas=1,
+                 pipeline_depth=0)
+    batcher = reg.get("lstm").batcher
+    batcher.enable_sessions(np.zeros((1, 1, t_feat), np.float32),
+                            session_bucket=bucket)
+    store = SessionStore(reg, spill, worker_id="bench",
+                         start_evictor=False)
+    compiles_warm = batcher.compile_count()
+    mismatches = []
+
+    def run_arm(arm, rnd, concurrent):
+        sids = [f"{arm}{rnd}-{i}" for i in range(n_sessions)]
+        for sid in sids:
+            store.create("lstm", session_id=sid)
+        outs = {sid: [] for sid in sids}
+
+        def drive(idx):
+            sid = sids[idx]
+            for k, c in enumerate(chunk_sets[idx]):
+                out, _, _ = store.step("lstm", sid, c, client_step=k)
+                outs[sid].append(np.asarray(out))
+
+        t0 = time.perf_counter()
+        if concurrent:
+            ts = [threading.Thread(target=drive, args=(i,))
+                  for i in range(n_sessions)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        else:
+            for i in range(n_sessions):
+                drive(i)
+        dt = time.perf_counter() - t0
+        for i, sid in enumerate(sids):
+            for k, out in enumerate(outs[sid]):
+                if not np.array_equal(out, oracles[i][k]):
+                    mismatches.append((arm, rnd, sid, k))
+            store.close("lstm", sid)
+        return n_sessions * steps / dt
+
+    try:
+        # order-alternated A/B: serial-first then batched-first, so
+        # neither arm systematically inherits a warmer cache/allocator
+        serial_qps, batched_qps = [], []
+        for rnd, order in enumerate((("serial", "batched"),
+                                     ("batched", "serial"))):
+            for arm in order:
+                qps = run_arm(arm, rnd, concurrent=(arm == "batched"))
+                (batched_qps if arm == "batched"
+                 else serial_qps).append(qps)
+        serial = round(sum(serial_qps) / len(serial_qps), 2)
+        batched = round(sum(batched_qps) / len(batched_qps), 2)
+        on_traffic_compiles = batcher.compile_count() - compiles_warm
+
+        # spill -> rehydrate percentiles: push every session cold one at
+        # a time, then touch each so it rehydrates from its CRC frame
+        spill_times = []
+        sids = [f"sp-{i}" for i in range(n_sessions)]
+        for i, sid in enumerate(sids):
+            store.create("lstm", sid)
+            store.step("lstm", sid, chunk_sets[i][0], client_step=0)
+        with store._lock:
+            sessions = list(store._sessions.values())
+        for sess in sessions:
+            t0 = time.perf_counter()
+            store._evict_one(sess, "bench", block_s=5.0)
+            spill_times.append(time.perf_counter() - t0)
+        for i, sid in enumerate(sids):
+            out, _, _ = store.step("lstm", sid, chunk_sets[i][1],
+                                   client_step=1)
+            if not np.array_equal(np.asarray(out), oracles[i][1]):
+                mismatches.append(("rehydrate", 0, sid, 1))
+        snap = store.snapshot()
+        spill_p99 = round(float(np.percentile(spill_times, 99)), 6)
+    finally:
+        store.shutdown(spill=False)
+        reg.shutdown()
+        shutil.rmtree(spill, ignore_errors=True)
+
+    results = {
+        "n_sessions": n_sessions,
+        "steps_per_session": steps,
+        "bucket": bucket,
+        "serial": {"qps": serial, "bit_identical": not any(
+            m[0] == "serial" for m in mismatches)},
+        "batched": {"qps": batched, "bit_identical": not any(
+            m[0] == "batched" for m in mismatches)},
+        "speedup": round(batched / max(1e-9, serial), 3),
+        "on_traffic_compiles": on_traffic_compiles,
+        "spill_p99_s": spill_p99,
+        "rehydrate_p99_s": snap["rehydrate"]["p99_s"],
+        "rehydrate_count": snap["rehydrate"]["count"],
+        "lost": snap["counters"]["lost_total"],
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    bench_extra = bench_extra or os.path.join(here, "BENCH_EXTRA.json")
+    try:
+        with open(bench_extra) as f:
+            extra = json.load(f)
+    except Exception:
+        extra = {}
+    extra["sessions"] = results
+    extra["sessions_step_speedup"] = results["speedup"]
+    with open(bench_extra, "w") as f:
+        json.dump(extra, f, indent=2)
+    if mismatches:
+        log(f"[sessions] FAIL: {len(mismatches)} output(s) diverged from "
+            f"the serial oracle, first {mismatches[0]}")
+        return 1
+    if results["speedup"] < 1.0:
+        log(f"[sessions] FAIL: batched arm {batched} steps/s under the "
+            f"serial arm {serial} steps/s (speedup {results['speedup']})")
+        return 1
+    if on_traffic_compiles != 0:
+        log(f"[sessions] FAIL: {on_traffic_compiles} compile(s) on "
+            f"session traffic after warmup")
+        return 1
+    log(f"[sessions] OK: batched {batched} vs serial {serial} steps/s "
+        f"({results['speedup']}x, {n_sessions} sessions x {steps} steps, "
+        f"bucket {bucket}), all bit-identical, 0 on-traffic compiles, "
+        f"spill p99 {spill_p99}s, rehydrate p99 "
+        f"{snap['rehydrate']['p99_s']}s, 0 lost")
+    return 0
+
+
+def check_sessions_section(extra, failures, warnings):
+    """--check-tables coverage for the ISSUE 16 keys: the ``sessions``
+    section (when present) must carry both arms bit-identical, a claimed
+    speedup recomputable from the recorded arm qps rows AND at least 1.0
+    (the batched step path must not lose to a serial rnn_time_step
+    loop), zero on-traffic compiles, zero lost sessions, spill/rehydrate
+    p99s actually recorded from a non-empty rehydrate cycle, and an
+    agreeing top-level copy."""
+    if "sessions" not in extra:
+        warnings.append("sessions: not present in BENCH_EXTRA.json "
+                        "(bench --sessions not run?)")
+        return
+    d = extra["sessions"]
+    required = ["serial", "batched", "speedup", "on_traffic_compiles",
+                "spill_p99_s", "rehydrate_p99_s", "rehydrate_count",
+                "lost"]
+    for k in required:
+        if k not in d:
+            failures.append(f"sessions.{k}: missing from the recorded "
+                            f"section")
+    if any(k not in d for k in required):
+        return
+    try:
+        for arm in ("serial", "batched"):
+            if d[arm].get("bit_identical") is not True:
+                failures.append(f"sessions.{arm}: bit_identical is "
+                                f"{d[arm].get('bit_identical')!r}")
+        sp = d["batched"]["qps"] / max(1e-9, d["serial"]["qps"])
+        if abs(sp - d["speedup"]) > max(0.01, 0.02 * abs(sp)):
+            failures.append(
+                f"sessions.speedup: claims {d['speedup']}, recorded arm "
+                f"qps rows give {sp:.3f}")
+        if d["speedup"] < 1.0:
+            failures.append(
+                f"sessions.speedup: {d['speedup']} — the batched step "
+                f"path lost to the serial rnn_time_step loop")
+        if d["on_traffic_compiles"] != 0:
+            failures.append(f"sessions.on_traffic_compiles: "
+                            f"{d['on_traffic_compiles']!r} (must be 0)")
+        if d["lost"] != 0:
+            failures.append(f"sessions.lost: {d['lost']!r} (must be 0)")
+        if int(d["rehydrate_count"]) < 1:
+            failures.append("sessions.rehydrate_count: 0 — the spill -> "
+                            "rehydrate cycle never ran")
+        for k in ("spill_p99_s", "rehydrate_p99_s"):
+            if not (isinstance(d[k], (int, float)) and d[k] >= 0):
+                failures.append(f"sessions.{k}: {d[k]!r} is not a "
+                                f"non-negative latency")
+        if extra.get("sessions_step_speedup") != d["speedup"]:
+            failures.append(
+                f"sessions_step_speedup: top-level copy "
+                f"{extra.get('sessions_step_speedup')} != sessions "
+                f"section {d['speedup']}")
+    except (TypeError, ValueError, AttributeError, KeyError) as e:
+        failures.append(f"sessions: malformed section ({e!r})")
+
+
 def check_trace_section(extra, failures, warnings):
     """--check-tables coverage for the ISSUE 9 keys: the ``trace``
     section (when present) must carry both arms, the claimed overhead
@@ -5128,6 +5371,8 @@ if __name__ == "__main__":
             os.environ["XLA_FLAGS"] = (
                 flags + " --xla_force_host_platform_device_count=8").strip()
         sys.exit(bench_blackbox())
+    if "--sessions" in sys.argv:
+        sys.exit(bench_sessions())
     if "--serving" in sys.argv:
         # give the CPU backend multiple virtual devices so the replica arm
         # is real even off-TPU (flag only affects the host platform; must

@@ -24,6 +24,8 @@ PIPELINE_THREAD_NAMES = (
     "trace-collector",
     "slo-autoscaler",
     "lease-election",
+    "session-evictor",          # SessionStore idle-TTL/byte-budget sweeper
+    "stream-writer",            # per-stream SSE writer (joined by handler)
 )
 
 # Every thread the package spawns must carry a name starting with one of

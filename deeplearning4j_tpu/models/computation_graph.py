@@ -902,6 +902,27 @@ class ComputationGraph:
         self._iteration += 1
         return gin
 
+    def _rnn_step_fn(self):
+        """The jitted ``(params, model_state, inputs, carries) ->
+        (outs, new_carries)`` program behind :meth:`rnn_time_step` and
+        :meth:`rnn_time_step_external` — one shared cache key, so the
+        stateful and pure-functional paths compile once and stay
+        bit-identical at equal program shape."""
+        def make():
+            def fwd(params, model_state, inputs_, carries):
+                acts, _, _, new_carries = self._forward_all(
+                    params, model_state, inputs_, training=False, rng=None,
+                    carries=carries)
+                return [acts[o] for o in self.conf.outputs], new_carries
+            return jax.jit(fwd)
+
+        return self._jitted("rnn_time_step", make)
+
+    def _rnn_zero_carries(self, batch: int, carry_dt):
+        return {n.name: n.obj.init_carry(batch, carry_dt)
+                for n in self.conf.nodes
+                if n.kind == "layer" and isinstance(n.obj, BaseRecurrentLayer)}
+
     def rnn_time_step(self, *xs):
         """Stateful step-by-step inference (reference
         ``ComputationGraph.rnnTimeStep``): hidden state carries across calls
@@ -912,18 +933,9 @@ class ComputationGraph:
         first = next(iter(inputs.values()))
         carry_dt = carry_dtype(first, get_environment().compute_dtype)
         if getattr(self, "_rnn_carries", None) is None:
-            self._rnn_carries = {
-                n.name: n.obj.init_carry(first.shape[0], carry_dt)
-                for n in self.conf.nodes
-                if n.kind == "layer" and isinstance(n.obj, BaseRecurrentLayer)}
-
-        def fwd(params, model_state, inputs_, carries):
-            acts, _, _, new_carries = self._forward_all(
-                params, model_state, inputs_, training=False, rng=None,
-                carries=carries)
-            return [acts[o] for o in self.conf.outputs], new_carries
-
-        fn = self._jitted("rnn_time_step", lambda: jax.jit(fwd))
+            self._rnn_carries = self._rnn_zero_carries(first.shape[0],
+                                                       carry_dt)
+        fn = self._rnn_step_fn()
         outs, self._rnn_carries = fn(self.train_state.params,
                                      self.train_state.model_state, inputs,
                                      self._rnn_carries)
@@ -931,6 +943,48 @@ class ComputationGraph:
 
     def rnn_clear_previous_state(self) -> None:
         self._rnn_carries = None
+
+    def rnn_get_state(self):
+        """Serializable copy of the stored recurrent state (reference
+        ``rnnGetPreviousState``): numpy-leaved tree, dtype-stable, ``None``
+        when no state is stored. Bit-exact round trip through
+        :meth:`rnn_set_state`."""
+        if getattr(self, "_rnn_carries", None) is None:
+            return None
+        return jax.tree.map(np.asarray, self._rnn_carries)
+
+    def rnn_set_state(self, state) -> None:
+        """Install a state captured with :meth:`rnn_get_state` (reference
+        ``rnnSetPreviousState``); ``None`` clears."""
+        self._rnn_carries = (None if state is None
+                             else jax.tree.map(jnp.asarray, state))
+
+    def rnn_zero_state(self, batch: int, like=None):
+        """Fresh zero recurrent state for a ``batch``-row stream — the tree
+        :meth:`rnn_time_step` would lazily create on first call."""
+        if self.train_state is None:
+            self.init()
+        dt = (get_environment().compute_dtype if like is None else
+              carry_dtype(jnp.asarray(like), get_environment().compute_dtype))
+        return self._rnn_zero_carries(batch, dt)
+
+    def rnn_time_step_external(self, *xs, state):
+        """Pure-functional ``rnnTimeStep`` on the graph: advance ``state``
+        (or ``None`` for a fresh stream) by one chunk without touching the
+        stored state; returns ``(out, new_state)``. Shares
+        :meth:`rnn_time_step`'s compiled program."""
+        if self.train_state is None:
+            self.init()
+        inputs = {n: jnp.asarray(x) for n, x in zip(self.conf.inputs, xs)}
+        first = next(iter(inputs.values()))
+        if state is None:
+            state = self._rnn_zero_carries(
+                first.shape[0],
+                carry_dtype(first, get_environment().compute_dtype))
+        fn = self._rnn_step_fn()
+        outs, new_state = fn(self.train_state.params,
+                             self.train_state.model_state, inputs, state)
+        return (outs[0] if len(outs) == 1 else outs), new_state
 
     def score(self, dataset=None) -> float:
         if dataset is None:

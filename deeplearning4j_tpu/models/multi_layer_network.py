@@ -696,6 +696,24 @@ class MultiLayerNetwork:
         self._iteration += 1
         return gx
 
+    def _rnn_step_fn(self, training: bool = False):
+        """The jitted ``(params, model_state, carries, x, rng) ->
+        (out, new_carries)`` program behind every stateful-RNN entry point.
+        One cache key per ``training`` flag: :meth:`rnn_time_step`,
+        :meth:`rnn_activate_using_stored_state` and
+        :meth:`rnn_time_step_external` all share the SAME compiled
+        executable, so a serving-tier external step is bit-identical to
+        the stored-state step at the same program shape."""
+        def make():
+            def fwd(params, model_state, carries, x_, rng):
+                out, _, _, new_carries = self._forward(
+                    params, model_state, x_, training=training, rng=rng,
+                    carries=carries)
+                return out, new_carries
+            return jax.jit(fwd)
+
+        return self._jitted(f"rnn_stored_state@train={training}", make)
+
     def rnn_activate_using_stored_state(self, x, training: bool = False,
                                         store_last_for_tbptt: bool = False):
         """Reference ``rnnActivateUsingStoredState``: forward a sequence
@@ -707,16 +725,7 @@ class MultiLayerNetwork:
         if self._rnn_carries is None:
             self._rnn_carries = self._zero_carries(
                 x.shape[0], carry_dtype(x, get_environment().compute_dtype))
-
-        def make():
-            def fwd(params, model_state, carries, x_, rng):
-                out, _, _, new_carries = self._forward(
-                    params, model_state, x_, training=training, rng=rng,
-                    carries=carries)
-                return out, new_carries
-            return jax.jit(fwd)
-
-        fn = self._jitted(f"rnn_stored_state@train={training}", make)
+        fn = self._rnn_step_fn(training)
         rng = self.rng.next_key() if training else None
         out, new_carries = fn(self.train_state.params,
                               self.train_state.model_state,
@@ -789,6 +798,56 @@ class MultiLayerNetwork:
 
     def rnn_clear_previous_state(self) -> None:
         self._rnn_carries = None
+
+    def rnn_get_state(self):
+        """Serializable copy of the stored recurrent state (reference
+        ``rnnGetPreviousState``, whole network instead of per-layer): a
+        pytree with numpy leaves whose dtypes match the carries exactly,
+        or ``None`` when no state is stored. Round-trips bit-exactly
+        through :meth:`rnn_set_state` — the contract the serving session
+        store spills to disk."""
+        if self._rnn_carries is None:
+            return None
+        return jax.tree.map(np.asarray, self._rnn_carries)
+
+    def rnn_set_state(self, state) -> None:
+        """Install a recurrent state previously captured with
+        :meth:`rnn_get_state` (reference ``rnnSetPreviousState``); ``None``
+        clears, like :meth:`rnn_clear_previous_state`. Leaf dtypes are
+        preserved as given — no recast — so set(get()) is bit-exact."""
+        self._rnn_carries = (None if state is None
+                             else jax.tree.map(jnp.asarray, state))
+
+    def rnn_zero_state(self, batch: int, like=None):
+        """Fresh zero recurrent state for a ``batch``-row stream: the tree
+        :meth:`rnn_time_step` would lazily create on its first call.
+        ``like`` (an example input) pins the carry dtype the same way the
+        stateful path does; without it the environment compute dtype is
+        used."""
+        if self.train_state is None:
+            self.init()
+        dt = (get_environment().compute_dtype if like is None else
+              carry_dtype(jnp.asarray(like), get_environment().compute_dtype))
+        return self._zero_carries(batch, dt)
+
+    def rnn_time_step_external(self, x, state):
+        """Pure-functional ``rnnTimeStep``: advance ``state`` (a tree from
+        :meth:`rnn_get_state` / :meth:`rnn_zero_state`, or ``None`` for a
+        fresh stream) by one input chunk WITHOUT touching the state stored
+        on the network. Returns ``(out, new_state)``. Same compiled
+        program as :meth:`rnn_time_step` — at equal program shape the two
+        are bit-identical — which is what lets the serving session tier
+        batch many independent streams through one executable."""
+        if self.train_state is None:
+            self.init()
+        x = jnp.asarray(x)
+        if state is None:
+            state = self._zero_carries(
+                x.shape[0], carry_dtype(x, get_environment().compute_dtype))
+        fn = self._rnn_step_fn(training=False)
+        out, new_state = fn(self.train_state.params,
+                            self.train_state.model_state, state, x, None)
+        return out, new_state
 
     # -------------------------------------------------------------- plumbing
     def set_listeners(self, *listeners: TrainingListener) -> None:

@@ -117,6 +117,8 @@ REGISTERED_POINTS: Dict[str, str] = {
     "train.distributed.exchange": "top of each distributed gradient exchange",
     "train.distributed.exchange.bytes": "byte point over a worker's encoded update",
     "runtime.compile_cache.load": "per persistent-executable-cache lookup",
+    "serving.session.step": "top of every streaming-session step",
+    "serving.session.rehydrate": "session spill read-back; also a byte point over the CRC-framed spill frame",
 }
 
 

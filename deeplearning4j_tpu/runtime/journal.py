@@ -105,6 +105,13 @@ EVENT_TYPES: Dict[str, str] = {
     "crash.report": "CrashReportingUtil wrote (or failed to write) a dump",
     "incident.open": "anomaly watchdog opened an incident (rule + evidence)",
     "incident.close": "anomaly watchdog closed an incident (quiet again)",
+    "session.create": "streaming session opened (zero carry, spill written)",
+    "session.step_miss": "session step found no resident carry; rehydrating",
+    "session.spill": "session carry pushed cold to its CRC-framed spill file",
+    "session.rehydrate": "session carry read back from spill (CRC-verified)",
+    "session.migrate": "session moved workers (rehydrated a foreign spill)",
+    "session.evict": "session memory copy dropped (idle TTL or byte budget)",
+    "session.close": "streaming session closed; spill file deleted",
 }
 
 #: per-process-incarnation id: a restarted worker starts a fresh seq

@@ -83,6 +83,14 @@ The subsystem that puts traffic on this stack:
   incident bundle (``GET /v1/debug/bundle``: journal window, traces,
   metrics, capacity, SLO, autoscaler log, config version, per-process
   stack samples, newest crash reports, in one tar.gz).
+- :class:`SessionStore` (``sessions.py``, ISSUE 16,
+  ``docs/fleet_serving.md`` "Session tier") — server-side
+  ``rnnTimeStep`` state for streaming inference: per-session carry
+  pinned to a worker via router affinity (never hedged), write-through
+  CRC-framed spills with idle-TTL/byte-budget eviction and single-flight
+  rehydration, drain-by-migration across rolling deploys, and a
+  fixed-bucket batched step path in the batcher that stays bit-identical
+  to a serial ``rnn_time_step`` loop.
 - :class:`WarmupManifest` (``manifest.py``) — persisted record of every
   compiled (bucket, replica, dtype) pair, written next to model archives
   and replayed by registry load / hot-swap so a restart reaches READY
@@ -132,6 +140,10 @@ _EXPORTS = {
     "WarmupManifest": "manifest",
     "manifest_path": "manifest",
     "ModelServer": "server",
+    "Session": "sessions",
+    "SessionLost": "sessions",
+    "SessionStepConflict": "sessions",
+    "SessionStore": "sessions",
     "FleetRouter": "router",
     "RouterMetrics": "router",
     "StaticFleet": "router",

@@ -55,6 +55,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
+import jax
 import numpy as np
 
 from deeplearning4j_tpu.runtime import chaos, trace
@@ -112,6 +113,28 @@ class _Request:
         # the submitting context's active span (ISSUE 9): batch stage
         # spans on the worker threads parent to it, and bucket/replica
         # annotations land on it — None while tracing is disabled
+        self.span = trace.current_span()
+
+
+class _StepRequest:
+    """One session step awaiting the session coalescer (ISSUE 16): a
+    single stream row plus its batch-1 carry tree. Duck-types the
+    ``_Request`` fields ``_expire``/``_fail`` touch so the deadline and
+    failure paths are shared with stateless traffic."""
+
+    __slots__ = ("x", "carries", "rows", "deadline", "enqueued_at", "event",
+                 "result", "error", "quantized", "span")
+
+    def __init__(self, x, carries, deadline: Optional[float]):
+        self.x = x
+        self.carries = carries
+        self.rows = 1
+        self.deadline = deadline
+        self.enqueued_at = time.monotonic()
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.quantized = False
         self.span = trace.current_span()
 
 
@@ -203,6 +226,19 @@ class ContinuousBatcher:
         self._completion_q: "queue.Queue[_InFlight]" = queue.Queue()
         self._completion_lock = threading.Lock()  # guards: _completion_closed
         self._completion_closed = False  # set once shutdown drained the queue
+        # session-step path (ISSUE 16): a parallel coalescer for stateful
+        # rnnTimeStep traffic, disabled until enable_sessions(). Every
+        # step batch executes at ONE fixed padded bucket — under the
+        # Exactness contract above a row's result is then independent of
+        # how steps happened to coalesce, so a serial oracle padded to the
+        # same shape reproduces every stream bit-identically.
+        self._session_q: Optional["queue.Queue"] = None
+        self._session_bucket: Optional[int] = None
+        self._session_template = None    # batch-1 zero-carry tree (numpy)
+        self._session_call = None        # (params, mstate, carries, xb) -> (out, new)
+        self._session_carry: Optional[_StepRequest] = None
+        self._session_saw_sentinel = False
+        self._session_worker: Optional[threading.Thread] = None
         if warmup_example is not None:
             self.warmup(warmup_example)
         self._worker = threading.Thread(target=self._run, daemon=True,
@@ -355,7 +391,16 @@ class ContinuousBatcher:
         ``len(buckets) x replica_count`` executables."""
         n = self._pool.aot_count()
         for key, fn in getattr(self.model, "_jit_cache", {}).items():
-            if str(key).startswith("output@") and hasattr(fn, "_cache_size"):
+            k = str(key)
+            # "output@*": the stateless fallback/direct-call ledger.
+            # "rnn_stored_state@train=False@*" / "rnn_time_step@*": the
+            # session-step program (ISSUE 16) — counted so the "zero
+            # on-traffic compiles after warm" assertion covers session
+            # traffic too.
+            if (k.startswith("output@")
+                    or k.startswith("rnn_stored_state@train=False@")
+                    or k.startswith("rnn_time_step@")) \
+                    and hasattr(fn, "_cache_size"):
                 n += fn._cache_size()
         return n
 
@@ -412,6 +457,232 @@ class ContinuousBatcher:
         if req.error is not None:
             raise req.error
         return req.result
+
+    # ----------------------------------------------------- session steps
+    def enable_sessions(self, example: ArrayOrDict,
+                        session_bucket: int = 8) -> None:
+        """Switch on the stateful session-step path (ISSUE 16).
+
+        ``example`` is ONE stream row of step input — shape ``(1, T, F)``
+        — used to pin the carry dtype and AOT-warm the fixed session
+        program on every replica before traffic. ``session_bucket`` is
+        the single padded batch size every step batch executes at: a
+        FIXED program shape, deliberately not the stateless bucket
+        ladder, because cross-shape XLA codegen may differ in the last
+        ulp and the session tier promises bit-identity to a serial
+        ``rnn_time_step`` loop padded to the same shape. Idempotent."""
+        if self._session_q is not None:
+            return
+        model = self.model
+        if not hasattr(model, "rnn_zero_state"):
+            raise ValueError("model has no recurrent-state API "
+                             "(rnn_zero_state); sessions need an RNN")
+        xs, rows = self._normalize(example)
+        if isinstance(xs, dict):
+            if len(xs) != 1:
+                raise ValueError("session steps support single-input "
+                                 "models only")
+            xs = next(iter(xs.values()))
+        if rows != 1:
+            raise ValueError("session warmup example must be exactly one "
+                             "stream row")
+        outputs = list(getattr(model.conf, "outputs", []) or [])
+        if self._graph_inputs and len(outputs) != 1:
+            raise ValueError("session steps support single-output graphs "
+                             "only")
+        template = model.rnn_zero_state(1, like=xs)
+        if not jax.tree.leaves(template):
+            raise ValueError("model has no recurrent layers; use submit()")
+        self._session_template = jax.tree.map(np.asarray, template)
+        if self._graph_inputs:
+            name = self._graph_inputs[0]
+            raw = model._rnn_step_fn()
+
+            def call(params, mstate, carries, xb, _n=name, _raw=raw):
+                outs, new = _raw(params, mstate, {_n: xb}, carries)
+                return outs[0], new
+        else:
+            raw = model._rnn_step_fn(training=False)
+
+            def call(params, mstate, carries, xb, _raw=raw):
+                return _raw(params, mstate, carries, xb, None)
+        self._session_call = call
+        self._session_bucket = max(1, int(session_bucket))
+        # warm the one fixed shape on every replica now — first session
+        # traffic must never pay a compile
+        xb = np.zeros((self._session_bucket,) + xs.shape[1:], xs.dtype)
+        carries = self._stack_carries([], self._session_bucket)
+        for rep in list(self._pool.replicas):
+            params, mstate = self._replica_state(rep)
+            out, _ = self._session_call(params, mstate, carries, xb)
+            np.asarray(out)  # block until the executable exists
+        self._session_q = queue.Queue()
+        self._session_worker = threading.Thread(
+            target=self._run_sessions, daemon=True,
+            name="ContinuousBatcher-session")
+        self._session_worker.start()
+
+    @property
+    def session_bucket(self) -> Optional[int]:
+        return self._session_bucket
+
+    def session_state_template(self):
+        """Fresh copy of the batch-1 zero-carry tree a new stream starts
+        from (numpy leaves, carry dtype already pinned by warmup)."""
+        if self._session_template is None:
+            raise RuntimeError("sessions not enabled on this batcher")
+        return jax.tree.map(np.copy, self._session_template)
+
+    def _replica_state(self, rep):
+        """(params, model_state) a session step executes against — the
+        replica's device_put copies, or the model's host state for the
+        fallback pseudo-replica."""
+        if rep.params is not None:
+            return rep.params, rep.model_state
+        ts = self.model.train_state
+        return ts.params, ts.model_state
+
+    def _stack_carries(self, trees, bucket: int):
+        """Gather per-stream batch-1 carry trees into one batch-``bucket``
+        tree: concatenate along axis 0, zero-pad the tail rows with the
+        template. Padding rows cannot perturb live rows — fixed program
+        shape, row-independent results (Exactness contract)."""
+        trees = list(trees) + [self._session_template] * (bucket - len(trees))
+        return jax.tree.map(
+            lambda *ls: np.concatenate([np.asarray(l) for l in ls], axis=0),
+            *trees)
+
+    def submit_step(self, x: ArrayOrDict, carries,
+                    timeout_ms: Optional[float] = None):
+        """Blocking session step: advance ONE stream row by one input
+        chunk. ``carries`` is the stream's batch-1 carry tree (``None``
+        for a fresh stream). Returns ``(out_row, new_carries)`` with
+        numpy leaves. Steps coalesce with other streams' concurrent steps
+        into the fixed session bucket; admission, deadlines and shutdown
+        semantics are shared with :meth:`submit`."""
+        if self._session_q is None:
+            raise RuntimeError("sessions not enabled on this batcher "
+                               "(call enable_sessions first)")
+        chaos.inject("serving.batcher.submit")
+        xs, rows = self._normalize(x)
+        if isinstance(xs, dict):
+            if len(xs) != 1:
+                raise ValueError("session steps support single-input "
+                                 "models only")
+            xs = next(iter(xs.values()))
+        if rows != 1:
+            raise ValueError("a session step carries exactly one stream "
+                             "row")
+        with self._submit_lock:
+            if self._shutdown or self._draining:
+                raise ServingShutdown("batcher is shut down")
+            try:
+                self.admission.admit(self._session_q.qsize(),
+                                     self._drain_ms_per_request())
+            except Overloaded:
+                self.metrics.record_rejection("overload")
+                trace.flag_current("shed")
+                raise
+            req = _StepRequest(xs, carries,
+                               self.admission.deadline_for(timeout_ms))
+            self.metrics.record_admitted()
+            self._session_q.put(req)
+        req.event.wait()
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def _collect_steps(self, first: _StepRequest) -> List[_StepRequest]:
+        """Session-window coalescing: same one-deadline-per-window rule as
+        :meth:`_collect`, capped at the fixed session bucket; a step whose
+        input signature differs from the window's carries over."""
+        batch = [first]
+        sig = self._sig(first.x)
+        deadline = time.monotonic() + self.batch_timeout_s
+        while len(batch) < self._session_bucket:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                nxt = self._session_q.get(timeout=remaining)
+            except queue.Empty:
+                break
+            if nxt is _SENTINEL:
+                self._session_saw_sentinel = True
+                break
+            if self._sig(nxt.x) != sig:
+                self._session_carry = nxt
+                break
+            batch.append(nxt)
+        return batch
+
+    def _dispatch_steps(self, batch: List[_StepRequest]) -> None:
+        live = self._expire(batch, "session-dispatch")
+        if not live:
+            return
+        bucket = self._session_bucket
+        rows = len(live)
+        replica = None
+        t0 = time.monotonic()
+        dsp = _batch_span(live, "batcher.session_step")
+        try:
+            with dsp:
+                if dsp.recording:
+                    dsp.set("bucket", bucket)
+                    dsp.set("rows", rows)
+                xb = np.zeros((bucket,) + live[0].x.shape[1:],
+                              live[0].x.dtype)
+                for i, r in enumerate(live):
+                    xb[i] = r.x[0]
+                carries = self._stack_carries(
+                    [r.carries if r.carries is not None
+                     else self._session_template for r in live], bucket)
+                chaos.inject("serving.batcher.forward")
+                replica = self._pool.acquire()
+                params, mstate = self._replica_state(replica)
+                out, new = self._session_call(params, mstate, carries, xb)
+                out = np.asarray(out)            # blocking readback
+                new = jax.tree.map(np.asarray, new)
+                if dsp.recording:
+                    dsp.set("replica", replica.index)
+        except BaseException as e:
+            # fail only this window — an injected fault or a bad step mix
+            # must not kill the session coalescer
+            if replica is not None:
+                self._pool.release(replica)
+            self._fail(live, e)
+            return
+        t1 = time.monotonic()
+        self._pool.release(replica)
+        self.metrics.record_batch(rows, bucket, t1 - t0,
+                                  replica=replica.index)
+        for i, r in enumerate(live):
+            row_out = np.ascontiguousarray(out[i:i + 1])
+            row_new = jax.tree.map(
+                lambda l, _i=i: np.ascontiguousarray(l[_i:_i + 1]), new)
+            r.result = (row_out, row_new)
+            self.metrics.record_response(t1 - r.enqueued_at)
+            r.event.set()
+
+    def _run_sessions(self) -> None:
+        while True:
+            if self._shutdown:
+                break
+            if self._session_carry is not None:
+                first, self._session_carry = self._session_carry, None
+            elif self._session_saw_sentinel:
+                break  # drained: every step before the sentinel is served
+            else:
+                first = self._session_q.get()
+                if first is _SENTINEL:
+                    break
+            batch = self._collect_steps(first)
+            try:
+                self._dispatch_steps(batch)
+            except BaseException as e:
+                logger.exception("unexpected error dispatching a session "
+                                 "step window")
+                self._fail([r for r in batch if not r.event.is_set()], e)
 
     # ----------------------------------------------------------- coalesce
     @staticmethod
@@ -761,7 +1032,11 @@ class ContinuousBatcher:
             else:
                 self._shutdown = True
         self._queue.put(_SENTINEL)  # wake the blocking coalescer
+        if self._session_q is not None:
+            self._session_q.put(_SENTINEL)  # wake the session coalescer
         self._worker.join(timeout=timeout_s)
+        if self._session_worker is not None:
+            self._session_worker.join(timeout=timeout_s)
         if self._completer is not None:
             self._completion_q.put(_SENTINEL)
             self._completer.join(timeout=timeout_s)
@@ -796,13 +1071,20 @@ class ContinuousBatcher:
         if self._carry is not None:
             leftovers.append(self._carry)
             self._carry = None
-        while True:
-            try:
-                item = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            if item is not _SENTINEL:
-                leftovers.append(item)
+        if self._session_carry is not None:
+            leftovers.append(self._session_carry)
+            self._session_carry = None
+        drainable = [self._queue]
+        if self._session_q is not None:
+            drainable.append(self._session_q)
+        for q in drainable:
+            while True:
+                try:
+                    item = q.get_nowait()
+                except queue.Empty:
+                    break
+                if item is not _SENTINEL:
+                    leftovers.append(item)
         for r in leftovers:
             r.error = ServingShutdown(
                 "batcher shut down before this request was served")
@@ -812,3 +1094,5 @@ class ContinuousBatcher:
         # leave one more so it can never be parked forever
         if self._worker.is_alive():
             self._queue.put(_SENTINEL)
+        if self._session_worker is not None and self._session_worker.is_alive():
+            self._session_q.put(_SENTINEL)

@@ -291,6 +291,16 @@ class WorkerSpec:
     #: a fleet where every worker KNOWS every model but each is resident
     #: only where traffic placed it
     extra_models: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: session tier (ISSUE 16): spill directory for streaming-session
+    #: carries. The WHOLE fleet must share one directory — migration is a
+    #: new worker rehydrating a spill some other worker wrote. ``None``
+    #: keeps sessions off; ``""`` asks the supervisor for its fleet-shared
+    #: default (``run_dir/sessions``). Needs a recurrent primary model.
+    session_dir: Optional[str] = None
+    #: the one fixed padded batch size every session step executes at
+    session_bucket: int = 8
+    #: SessionStore knobs (idle_ttl_s, byte_budget_bytes, ...)
+    session_kw: Dict[str, Any] = dataclasses.field(default_factory=dict)
     #: which machine this worker lives on (ISSUE 12): the name of a
     #: :class:`HostAdapter` registered with the supervisor ("local" =
     #: this machine; loopback adapters are the tests' multi-host stand-in)
@@ -368,6 +378,15 @@ class FleetSupervisor:
         self._own_run_dir = run_dir is None
         self.run_dir = run_dir or tempfile.mkdtemp(prefix="dl4j-fleet-")
         os.makedirs(self.run_dir, exist_ok=True)
+        for s in specs:
+            # "" = "the fleet-shared default": every worker spilling into
+            # one directory is what makes drain-by-migration work
+            if getattr(s, "session_dir", None) == "":
+                s.session_dir = os.path.join(self.run_dir, "sessions")
+        shared_spills = {s.session_dir for s in specs
+                         if getattr(s, "session_dir", None)}
+        for d in sorted(shared_spills):
+            os.makedirs(d, exist_ok=True)
         self._handles: Dict[str, _WorkerHandle] = {
             s.worker_id: _WorkerHandle(s, self.run_dir) for s in specs}
         self.max_restarts = int(max_restarts)
@@ -899,7 +918,27 @@ def worker_main(spec_path: str) -> int:
             (spec.get("extra_models") or {}).items()):
         registry.load(extra_name, extra_archive, resident=False,
                       **batcher_kw)
-    server = ModelServer(registry, worker_id=spec["worker_id"])
+    session_dir = spec.get("session_dir")
+    if session_dir:
+        # session tier (ISSUE 16): warm the fixed-bucket step program
+        # BEFORE the port file (readiness) is written, from the same
+        # signature the stateless warmup uses — first step never compiles
+        man = WarmupManifest.load_for_archive(spec["archive"])
+        if man is not None and man.inputs:
+            step_example = man.example(rows=1)
+        elif sig:
+            step_example = WarmupManifest(
+                inputs={str(k): dict(v) for k, v in sig.items()},
+                buckets=[], replicas=1, pairs=[]).example(rows=1)
+        else:
+            raise ValueError(
+                "session_dir set but neither a warmup manifest nor a "
+                "warmup_signature describes the step input shape")
+        served.batcher.enable_sessions(
+            step_example, session_bucket=int(spec.get("session_bucket", 8)))
+    server = ModelServer(registry, worker_id=spec["worker_id"],
+                         session_dir=session_dir or None,
+                         session_kw=spec.get("session_kw") or None)
     port = server.start(0)
     # the port file is the readiness signal: written only after the
     # registry is loaded, manifest-warmed and serving — atomic so the

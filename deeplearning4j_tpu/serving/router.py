@@ -103,9 +103,11 @@ class RouterMetrics:
     ``runtime.profiler.router_stats()``."""
 
     def __init__(self):
-        # guards: requests_total, responses_total, errors_total, forwards_total, hedges_total, hedge_wins_total, hedges_discarded_total, failovers_total, shed_skips_total, deploys_total, request_latency, worker_requests
+        # guards: requests_total, responses_total, errors_total, forwards_total, hedges_total, hedge_wins_total, hedges_discarded_total, failovers_total, shed_skips_total, deploys_total, session_requests_total, session_migrations_total, request_latency, worker_requests
         self._lock = threading.Lock()
         self.requests_total = 0
+        self.session_requests_total = 0    # session-tier requests routed
+        self.session_migrations_total = 0  # session repins (failover/drain)
         self.responses_total = 0        # 2xx returned to clients
         self.errors_total = 0           # non-2xx returned to clients
         self.forwards_total = 0         # attempts launched (incl. hedges)
@@ -149,6 +151,8 @@ class RouterMetrics:
                 "failovers_total": self.failovers_total,
                 "shed_skips_total": self.shed_skips_total,
                 "deploys_total": self.deploys_total,
+                "session_requests_total": self.session_requests_total,
+                "session_migrations_total": self.session_migrations_total,
                 "latency_p50_s": self.request_latency.percentile(50),
                 "latency_p99_s": self.request_latency.percentile(99),
                 "worker_requests": dict(self.worker_requests),
@@ -168,6 +172,9 @@ class RouterMetrics:
             f"router_failovers_total {s['failovers_total']}",
             f"router_shed_skips_total {s['shed_skips_total']}",
             f"router_deploys_total {s['deploys_total']}",
+            f"router_session_requests_total {s['session_requests_total']}",
+            f"router_session_migrations_total "
+            f"{s['session_migrations_total']}",
             f'router_latency_seconds{{quantile="0.5"}} '
             f"{s['latency_p50_s']}",
             f'router_latency_seconds{{quantile="0.99"}} '
@@ -420,6 +427,13 @@ class FleetRouter:
         self._last_residency_refresh = 0.0
         self._views: Dict[str, WorkerView] = {}
         self._views_lock = threading.Lock()  # guards: _views
+        # session affinity (ISSUE 16): {f"{model}/{sid}": worker_id}.
+        # Local cache of the pins published through the shared config —
+        # another router (or this one after a restart) adopts a pin from
+        # cfg["sessions"] instead of re-deriving it, so a session never
+        # ping-pongs between workers across router failover.
+        self._session_pins: Dict[str, str] = {}
+        self._pins_lock = threading.Lock()  # guards: _session_pins
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._prober: Optional[threading.Thread] = None
@@ -991,6 +1005,185 @@ class FleetRouter:
                 if rsp.recording:
                     rsp.event("failover", failed_attempts=len(race.failures))
 
+    # --------------------------------------------------------- session tier
+    def _publish_pin(self, key: str, wid: str) -> None:
+        with self._pins_lock:
+            self._session_pins[key] = wid
+        if self._config is not None:
+            try:
+                def fn(cfg):
+                    pins = cfg.setdefault("sessions", {})
+                    if pins.get(key) == wid:
+                        return False  # no-op: don't burn a config version
+                    pins[key] = wid
+                self._config.mutate(fn)
+            except Exception:
+                logger.exception("session pin publication failed for %s",
+                                 key)
+
+    def _drop_pin(self, key: str) -> None:
+        with self._pins_lock:
+            self._session_pins.pop(key, None)
+        if self._config is not None:
+            try:
+                def fn(cfg):
+                    pins = cfg.setdefault("sessions", {})
+                    if key not in pins:
+                        return False
+                    del pins[key]
+                self._config.mutate(fn)
+            except Exception:
+                logger.exception("session pin removal failed for %s", key)
+
+    def _pinned_worker(self, key: str) -> Optional[str]:
+        with self._pins_lock:
+            wid = self._session_pins.get(key)
+        if wid is None and self._config is not None:
+            try:
+                wid = (self._config.snapshot().get("sessions")
+                       or {}).get(key)
+            except Exception:
+                wid = None
+            if wid is not None:
+                with self._pins_lock:  # adopt the published pin
+                    self._session_pins[key] = wid
+        return wid
+
+    def _session_target(self, name: str, sid: str):
+        """The worker this session's traffic goes to: its pin while that
+        worker is admittable, else a REPIN — session-key rendezvous over
+        the admittable workers (deterministic, so two routers repin the
+        same orphan identically), published through the shared config and
+        journaled as ``session.migrate``. The repinned worker rehydrates
+        the carry from the shared spill dir on the next step; nothing is
+        dropped. Returns ``(view, migrated_from)``."""
+        key = f"{name}/{sid}"
+        wid = self._pinned_worker(key)
+        now = time.monotonic()
+        views = self.workers()
+        view = views.get(wid) if wid is not None else None
+        if view is not None and view.admittable(now):
+            return view, None
+        for cand in self.ranked_workers(key):
+            if not cand.admittable(now):
+                continue
+            self._publish_pin(key, cand.worker_id)
+            if wid is not None and cand.worker_id != wid:
+                self.metrics.record("session_migrations_total")
+                journal.emit("session.migrate", model=name, session=sid,
+                             from_worker=wid, to_worker=cand.worker_id,
+                             by=self.router_id)
+            return cand, (wid if wid != cand.worker_id else None)
+        return None, None
+
+    def _route_session(self, method: str, path: str, name: str, sid: str,
+                       op: str, raw: bytes, inbound_headers
+                       ) -> Tuple[int, Dict[str, str], bytes]:
+        """Session-tier routing (ISSUE 16): one pinned attempt at a time,
+        NEVER hedged — a duplicated step would advance the carry twice
+        and corrupt the stream; retries are safe only because the worker
+        dedups by step index, and only after the previous attempt has
+        FAILED, never concurrently with it. Connection-level faults fail
+        over by repinning (the new worker rehydrates from the shared
+        spill dir); everything else is relayed verbatim."""
+        self.metrics.record("session_requests_total")
+        t_start = time.monotonic()
+        inbound = {k: v for k, v in (inbound_headers or {}).items()}
+        timeout_ms = self.default_timeout_ms
+        try:
+            body = json.loads(raw.decode() or "{}")
+            timeout_ms = body.get("timeout_ms", timeout_ms)
+        except Exception:
+            body = None
+        hdr_deadline = inbound.get("X-Deadline-Ms")
+        if hdr_deadline is not None:
+            try:
+                hd = float(hdr_deadline)
+                timeout_ms = hd if timeout_ms is None else min(timeout_ms,
+                                                               hd)
+            except ValueError:
+                pass
+        deadline = (None if timeout_ms is None
+                    else t_start + float(timeout_ms) / 1000.0)
+        rid = inbound.get("X-Request-Id") or uuid.uuid4().hex
+        if op == "create":
+            # the router mints the session id so the pin exists BEFORE
+            # the create reaches any worker — a crash between the two
+            # leaves an unpinned create, never a pinned orphan the
+            # client does not know about
+            if not isinstance(body, dict):
+                return (400, {"Content-Type": "application/json"},
+                        json.dumps({"error": "malformed request body"})
+                        .encode())
+            sid = str(body.get("session_id") or uuid.uuid4().hex[:16])
+            body["session_id"] = sid
+            raw = json.dumps(body).encode()
+
+        def finish(status, headers, data):
+            self.metrics.record_response(status, time.monotonic() - t_start)
+            headers = {k: v for k, v in headers.items()
+                       if k.lower() not in _HOP_BY_HOP}
+            headers["X-Request-Id"] = rid
+            return status, headers, data
+
+        tried: set = set()
+        while True:
+            now = time.monotonic()
+            if deadline is not None and now >= deadline:
+                return finish(504, {"Content-Type": "application/json"},
+                              json.dumps({
+                                  "error": "deadline exceeded",
+                                  "detail": f"session request {rid} expired "
+                                            f"after {len(tried)} "
+                                            f"attempt(s)"}).encode())
+            view, _ = self._session_target(name, sid)
+            if view is None or view.worker_id in tried:
+                return finish(503, {"Content-Type": "application/json"},
+                              json.dumps({
+                                  "error": "unavailable",
+                                  "reason": "no_healthy_workers",
+                                  "detail": f"no admittable worker for "
+                                            f"session {sid!r} "
+                                            f"({len(tried)} tried)"})
+                              .encode())
+            headers = {"Content-Type": "application/json",
+                       "X-Request-Id": rid}
+            remaining = None if deadline is None else deadline - now
+            if remaining is not None:
+                headers["X-Deadline-Ms"] = f"{remaining * 1000.0:.1f}"
+            view.begin()
+            t0 = time.monotonic()
+            try:
+                chaos.inject("serving.router.forward")
+                status, resp_headers, data = self._http(
+                    view.address, method, path, body=raw, headers=headers,
+                    timeout=(self.no_deadline_timeout_s
+                             if remaining is None else remaining + 0.25))
+            except BaseException:
+                # connection fault: the pinned worker is likely gone —
+                # repin and retry (safe: the step never reached the
+                # carry, or its effect is deduped by the step index)
+                view.done(ok=False)
+                if view.ready:
+                    journal.emit("router.worker_unready",
+                                 worker=view.worker_id,
+                                 address=view.address,
+                                 reason="connect_fault")
+                view.ready = False
+                view.breaker.record_failure()
+                tried.add(view.worker_id)
+                continue
+            ok = 200 <= status < 300
+            view.done(ok=ok, latency_s=(time.monotonic() - t0) if ok
+                      else None)
+            if ok:
+                view.breaker.record_success()
+            elif status >= 500 and status != 503:
+                view.breaker.record_failure()
+            if op == "close" and status in (200, 404):
+                self._drop_pin(f"{name}/{sid}")
+            return finish(status, dict(resp_headers), data)
+
     # ------------------------------------------------------------ lifecycle
     def drain(self, worker_id: str, timeout_s: float = 30.0) -> None:
         """Stop routing new requests to ``worker_id`` and wait for its
@@ -1088,6 +1281,22 @@ class FleetRouter:
             for wid in worker_ids:
                 if wid in self.workers():
                     self.drain(wid, timeout_s=drain_timeout_s)
+                    # session fence (ISSUE 16): push every resident carry
+                    # to its spill file BEFORE the kill, so the sessions
+                    # this worker holds migrate (rehydrate elsewhere)
+                    # instead of losing steps. Best-effort: a worker
+                    # without a session store 404s, a dead one refuses.
+                    view = self.workers().get(wid)
+                    if view is not None:
+                        try:
+                            self._http(view.address, "POST",
+                                       "/v1/sessions/drain", body=b"{}",
+                                       headers={"Content-Type":
+                                                "application/json"},
+                                       timeout=drain_timeout_s)
+                        except Exception:
+                            logger.info("session spill fence skipped for "
+                                        "%s (unreachable)", wid)
                     journal.emit("control.deploy_stage", stage="drained",
                                  worker=wid, archive=archive)
                 try:
@@ -1234,7 +1443,37 @@ class FleetRouter:
                          "page_in_rejections_total": 0,
                          "page_in_failures_total": 0,
                          "resident_hits_total": 0, "cold_hits_total": 0}
+        sessions_agg: Optional[Dict[str, Any]] = None
         for wid, payload in sorted(scraped.items()):
+            # session aggregation (ISSUE 16): residency/counters SUMMED;
+            # spilled_files taken as the MAX because the spill dir is
+            # shared fleet-wide — every worker counts the same files
+            ses = payload.get("sessions")
+            if isinstance(ses, dict):
+                try:
+                    inc_tracked = int(ses.get("tracked", 0))
+                    inc_resident = int(ses.get("resident", 0))
+                    inc_bytes = int(ses.get("resident_bytes", 0))
+                    inc_spilled = int(ses.get("spilled_files", 0))
+                    inc_counters = {
+                        k: int(v)
+                        for k, v in sorted((ses.get("counters")
+                                            or {}).items())}
+                except (TypeError, ValueError):
+                    pass  # malformed sessions block: skip, never the scrape
+                else:
+                    if sessions_agg is None:
+                        sessions_agg = {"tracked": 0, "resident": 0,
+                                        "resident_bytes": 0,
+                                        "spilled_files": 0, "counters": {}}
+                    sessions_agg["tracked"] += inc_tracked
+                    sessions_agg["resident"] += inc_resident
+                    sessions_agg["resident_bytes"] += inc_bytes
+                    sessions_agg["spilled_files"] = max(
+                        sessions_agg["spilled_files"], inc_spilled)
+                    for k, v in inc_counters.items():
+                        sessions_agg["counters"][k] = (
+                            sessions_agg["counters"].get(k, 0) + v)
             # residency aggregation (ISSUE 11): budgets/resident bytes
             # summed, per-model worker placement lists, paging counters
             res = payload.get("residency")
@@ -1320,6 +1559,8 @@ class FleetRouter:
                 "models": placement,
                 "paging": paging_totals,
             }
+        if sessions_agg is not None:
+            out["sessions"] = sessions_agg
         return out
 
     def render_fleet_capacity(self) -> str:
@@ -1363,6 +1604,22 @@ class FleetRouter:
                             "page_in_failures_total"):
                 if counter in pg:
                     lines.append(f"fleet_capacity_{counter} {pg[counter]}")
+        ses = agg.get("sessions")
+        if ses:
+            lines.append(f"fleet_capacity_sessions_tracked "
+                         f"{ses.get('tracked', 0)}")
+            lines.append(f"fleet_capacity_sessions_resident "
+                         f"{ses.get('resident', 0)}")
+            lines.append(f"fleet_capacity_sessions_resident_bytes "
+                         f"{ses.get('resident_bytes', 0)}")
+            lines.append(f"fleet_capacity_sessions_spilled_files "
+                         f"{ses.get('spilled_files', 0)}")
+            cs = ses.get("counters") or {}
+            for counter in ("steps_total", "rehydrates_total",
+                            "migrations_total", "lost_total"):
+                if counter in cs:
+                    lines.append(f"fleet_capacity_sessions_{counter} "
+                                 f"{cs[counter]}")
         return "\n".join(lines) + "\n"
 
     def render_fleet_metrics(self) -> str:
@@ -1648,6 +1905,38 @@ class FleetRouter:
                     name = self.path[len("/v1/models/"):-len("/predict")]
                     code, headers, data = router._route_predict(
                         name, raw, self.headers)
+                elif (self.path.startswith("/v1/models/")
+                        and "/sessions" in self.path):
+                    # session tier (ISSUE 16): pinned, never hedged
+                    name, _, tail = (self.path[len("/v1/models/"):]
+                                     .partition("/sessions"))
+                    parts = tail.strip("/").split("/") if tail.strip("/") \
+                        else []
+                    if not parts:
+                        op, sid = "create", ""
+                    elif len(parts) == 2 and parts[1] in ("step", "stream"):
+                        op, sid = parts[1], parts[0]
+                    else:
+                        self._send(404, {"Content-Type": "application/json"},
+                                   json.dumps({"error": f"unknown path "
+                                               f"{self.path!r}"}).encode())
+                        return
+                    code, headers, data = router._route_session(
+                        "POST", self.path, name, sid, op, raw, self.headers)
+                else:
+                    code, headers, data = 404, {
+                        "Content-Type": "application/json"}, json.dumps(
+                        {"error": f"unknown path {self.path!r}"}).encode()
+                self._send(code, headers, data)
+
+            def do_DELETE(self):
+                if (self.path.startswith("/v1/models/")
+                        and "/sessions/" in self.path):
+                    name, _, sid = (self.path[len("/v1/models/"):]
+                                    .partition("/sessions/"))
+                    code, headers, data = router._route_session(
+                        "DELETE", self.path, name, sid.strip("/"), "close",
+                        b"", self.headers)
                 else:
                     code, headers, data = 404, {
                         "Content-Type": "application/json"}, json.dumps(

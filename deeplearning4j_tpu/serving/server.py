@@ -11,6 +11,17 @@ Same dependency-free ``ThreadingHTTPServer`` pattern as ``ui/server.py``
   ``Retry-After`` from the measured page-in cost)
 - ``POST /v1/models/<name>/residency`` — explicit paging lever:
   ``{"state": "resident"|"cold"}`` pages in / evicts (409 while pinned)
+- Session tier (ISSUE 16, requires ``session_dir``): ``POST
+  /v1/models/<name>/sessions`` opens a stream (server-side
+  ``rnnTimeStep`` carry), ``POST /v1/models/<name>/sessions/<id>/step``
+  advances it one chunk (``{"inputs": ..., "step": k}`` — the step index
+  makes failover retries exactly-once; 410 ``session_lost`` when the
+  spilled carry is damaged, 409 ``step_conflict`` on a position
+  mismatch), ``POST /v1/models/<name>/sessions/<id>/stream`` runs many
+  steps over one connection with Server-Sent-Events framing, ``DELETE
+  /v1/models/<name>/sessions/<id>`` closes, and ``POST
+  /v1/sessions/drain`` is the rolling-deploy migration fence (spill all
+  resident carries to the shared spill dir)
 - ``GET  /healthz``                    — liveness (the process serves HTTP)
 - ``GET  /readyz``                     — readiness (every model READY; a
   DEGRADED breaker-open model or an empty registry returns 503 so an
@@ -84,6 +95,8 @@ from deeplearning4j_tpu.serving.admission import (
 )
 from deeplearning4j_tpu.serving.registry import ModelRegistry
 from deeplearning4j_tpu.serving.resilience import CircuitOpen
+from deeplearning4j_tpu.serving.sessions import (SessionLost,
+                                                 SessionStepConflict)
 from deeplearning4j_tpu.serving.slo import SLOMonitor
 
 
@@ -102,12 +115,24 @@ class ModelServer:
 
     def __init__(self, registry: Optional[ModelRegistry] = None,
                  worker_id: Optional[str] = None,
-                 slo: Optional[SLOMonitor] = None):
+                 slo: Optional[SLOMonitor] = None,
+                 session_dir: Optional[str] = None,
+                 session_kw: Optional[dict] = None):
         self.registry = registry or ModelRegistry()
         self.worker_id = worker_id
         # per-worker SLO attainment + burn rates (ISSUE 9); the router
         # keeps its own fleet-wide monitor over the same outcomes
         self.slo = slo or SLOMonitor()
+        # session tier (ISSUE 16): enabled by pointing the worker at the
+        # fleet's SHARED spill directory — sharing it is what makes a
+        # session survive failover and rolling deploys (migration =
+        # rehydrate the spill on the newly pinned worker)
+        self.sessions = None
+        if session_dir is not None:
+            from deeplearning4j_tpu.serving.sessions import SessionStore
+            self.sessions = SessionStore(self.registry, session_dir,
+                                         worker_id=worker_id or "",
+                                         **(session_kw or {}))
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._capacity_provider = None  # our profiler attachment (stop)
@@ -352,8 +377,13 @@ class ModelServer:
             # compile footprint — the ledger the autoscaler's capacity
             # guard consults (aggregated fleet-wide by the router)
             from deeplearning4j_tpu.serving import capacity
-            return 200, {"worker": self.worker_id,
-                         **capacity.registry_capacity(self.registry)}
+            payload = {"worker": self.worker_id,
+                       **capacity.registry_capacity(self.registry)}
+            if self.sessions is not None:
+                # session-tier residency (ISSUE 16): counts/bytes +
+                # rehydrate percentiles, fleet-aggregated by the router
+                payload["sessions"] = self.sessions.snapshot()
+            return 200, payload
         if path == "/v1/metricsz":
             # machine-readable twin of /metrics: summable counters + raw
             # bucket histograms so the router can aggregate fleet-wide
@@ -531,6 +561,246 @@ class ModelServer:
                                   f"in-flight requests or not "
                                   f"archive-backed"}, {}
 
+    # ------------------------------------------------------ session tier
+    def _session_store_or_503(self):
+        if self.sessions is None:
+            return None, (503, {"error": "sessions disabled",
+                                "reason": "sessions_disabled",
+                                "detail": "this worker was started without "
+                                          "a session spill directory"}, {})
+        return self.sessions, None
+
+    def _handle_session_create(self, name: str, raw: bytes, headers=None):
+        """``POST /v1/models/<name>/sessions`` — open a stream. Body
+        ``{"session_id"?: str, "timeout_ms"?: ms}``; the router normally
+        generates the id so it can pin before forwarding."""
+        store, err = self._session_store_or_503()
+        if err is not None:
+            return err
+        h = headers or {}
+        try:
+            body = json.loads(raw.decode() or "{}")
+            timeout_ms = self._effective_timeout_ms(
+                body.get("timeout_ms"), h.get("X-Deadline-Ms"))
+        except Exception as e:
+            return 400, {"error": f"malformed request body: {e}"}, {}
+        try:
+            sess = store.create(name, body.get("session_id"),
+                                timeout_ms=timeout_ms)
+        except KeyError:
+            return 404, {"error": f"model {name!r} not found"}, {}
+        except ValueError as e:
+            # duplicate id, invalid id, or a model without the session
+            # path warmed — a client error either way
+            return 409, {"error": str(e)}, {}
+        except ServingError as e:
+            return 503, {"error": "unavailable", "detail": str(e)}, {}
+        except Exception as e:
+            return 500, {"error": repr(e)}, {}
+        return 200, {"model": name, "session": sess.session_id,
+                     "step": sess.step, "worker": self.worker_id}, {}
+
+    def _session_step_inner(self, name, sid, body, timeout_ms, hdrs):
+        """Shared by the unary step endpoint and the SSE stream: returns
+        ``(status, json_obj)`` for ONE step of session ``sid``."""
+        store = self.sessions
+        try:
+            dtype = body.get("dtype")
+            x = np.asarray(body["inputs"],
+                           dtype=None if dtype is None else np.dtype(dtype))
+        except Exception as e:
+            return 400, {"error": f"malformed request body: {e}"}
+        t0 = time.monotonic()
+        try:
+            out, step, replayed = store.step(
+                name, sid, x, timeout_ms=timeout_ms,
+                client_step=body.get("step"))
+        except KeyError:
+            return 404, {"error": f"unknown session {sid!r} for model "
+                                  f"{name!r}"}
+        except SessionLost as e:
+            # 410 Gone: the stream is unrecoverable — carry was damaged
+            # on disk; the client must open a new session
+            return 410, {"error": "session lost", "reason": "session_lost",
+                         "detail": str(e)}
+        except SessionStepConflict as e:
+            return 409, {"error": "step conflict", "reason": "step_conflict",
+                         "detail": str(e)}
+        except Overloaded as e:
+            retry_ms = getattr(e, "retry_after_ms", None)
+            if retry_ms is not None:
+                hdrs["Retry-After"] = str(int(math.ceil(retry_ms / 1000.0)))
+                hdrs["Retry-After-Ms"] = f"{retry_ms:.0f}"
+            return 503, {"error": "overloaded", "reason": "overloaded",
+                         "retry_after_ms": retry_ms, "detail": str(e)}
+        except DeadlineExceeded as e:
+            return 504, {"error": "deadline exceeded", "detail": str(e)}
+        except ServingError as e:
+            return 503, {"error": "unavailable", "detail": str(e)}
+        except Exception as e:
+            return 500, {"error": repr(e)}
+        self.slo.record(name, ok=True, latency_s=time.monotonic() - t0)
+        return 200, {"model": name, "session": sid, "step": step,
+                     "replayed": replayed, "outputs": _to_jsonable(out)}
+
+    def _handle_session_step(self, name: str, sid: str, raw: bytes,
+                             headers=None):
+        """``POST /v1/models/<name>/sessions/<id>/step`` — advance the
+        stream one input chunk. Body ``{"inputs": [[...]], "step"?: k,
+        "timeout_ms"?: ms, "dtype"?: name}``; ``step`` (the client's
+        0-based index for THIS call) makes failover retries exactly-once —
+        a replay of the last acked step returns its persisted output
+        without advancing the carry."""
+        store, err = self._session_store_or_503()
+        if err is not None:
+            return err
+        h = headers or {}
+        hdrs = {}
+        try:
+            body = json.loads(raw.decode() or "{}")
+            timeout_ms = self._effective_timeout_ms(
+                body.get("timeout_ms"), h.get("X-Deadline-Ms"))
+        except Exception as e:
+            return 400, {"error": f"malformed request body: {e}"}, hdrs
+        status, obj = self._session_step_inner(name, sid, body, timeout_ms,
+                                               hdrs)
+        if status == 200:
+            hdrs["X-Session-Step"] = str(obj["step"])
+        return status, obj, hdrs
+
+    def _handle_session_stream(self, name: str, sid: str, raw: bytes,
+                               handler) -> None:
+        """``POST /v1/models/<name>/sessions/<id>/stream`` — multi-step
+        generation over ONE connection, Server-Sent-Events framing. Body
+        ``{"inputs": [chunk, ...], "step"?: k0, "timeout_ms"?: ms}``:
+        each chunk is one step input; one ``data:`` event per step, then
+        ``event: end`` (or ``event: error`` carrying the same JSON the
+        unary endpoint would have returned). The response is
+        close-delimited (no Content-Length); a writer thread decouples
+        device stepping from a slow client socket and is ALWAYS joined
+        before the handler returns."""
+        import queue as _queue
+        h = handler.headers
+        try:
+            body = json.loads(raw.decode() or "{}")
+            chunks = body["inputs"]
+            if not isinstance(chunks, list) or not chunks:
+                raise ValueError("'inputs' must be a non-empty list of "
+                                 "per-step input chunks")
+            timeout_ms = self._effective_timeout_ms(
+                body.get("timeout_ms"), h.get("X-Deadline-Ms"))
+        except Exception as e:
+            payload = json.dumps(
+                {"error": f"malformed request body: {e}"}).encode()
+            handler._send(400, payload, "application/json")
+            return
+        store, err = self._session_store_or_503()
+        if err is not None:
+            handler._send(err[0], json.dumps(err[1]).encode(),
+                          "application/json")
+            return
+        handler.send_response(200)
+        handler.send_header("Content-Type", "text/event-stream")
+        handler.send_header("Cache-Control", "no-store")
+        handler.send_header("Connection", "close")
+        if self.worker_id is not None:
+            handler.send_header("X-Worker-Id", self.worker_id)
+        handler.end_headers()
+        q: "_queue.Queue" = _queue.Queue()
+
+        def _writer():
+            while True:
+                frame = q.get()
+                if frame is None:
+                    return
+                try:
+                    handler.wfile.write(frame)
+                    handler.wfile.flush()
+                except OSError:
+                    # client went away; keep draining so the stepper
+                    # never blocks on an unbounded queue put
+                    pass
+
+        wt = threading.Thread(target=_writer, daemon=True,
+                              name=f"stream-writer-{sid}")
+        wt.start()
+        deadline = (None if timeout_ms is None
+                    else time.monotonic() + timeout_ms / 1000.0)
+        step0 = body.get("step")
+        try:
+            for i, chunk in enumerate(chunks):
+                remaining_ms = (None if deadline is None
+                                else max(0.0, (deadline - time.monotonic())
+                                         * 1000.0))
+                step_body = {"inputs": chunk, "dtype": body.get("dtype")}
+                if step0 is not None:
+                    step_body["step"] = int(step0) + i
+                status, obj = self._session_step_inner(
+                    name, sid, step_body, remaining_ms, {})
+                if status != 200:
+                    obj["status"] = status
+                    q.put(b"event: error\ndata: "
+                          + json.dumps(obj).encode() + b"\n\n")
+                    return
+                q.put(b"data: " + json.dumps(obj).encode() + b"\n\n")
+            q.put(b"event: end\ndata: "
+                  + json.dumps({"steps": len(chunks)}).encode() + b"\n\n")
+        finally:
+            q.put(None)
+            wt.join()
+
+    def _handle_session_close(self, name: str, sid: str):
+        """``DELETE /v1/models/<name>/sessions/<id>`` — end the stream
+        and delete its spill file."""
+        store, err = self._session_store_or_503()
+        if err is not None:
+            return err
+        try:
+            store.close(name, sid)
+        except KeyError:
+            return 404, {"error": f"unknown session {sid!r} for model "
+                                  f"{name!r}"}, {}
+        except Exception as e:
+            return 500, {"error": repr(e)}, {}
+        return 200, {"model": name, "session": sid, "closed": True}, {}
+
+    def _handle_sessions_drain(self, raw: bytes = b""):
+        """``POST /v1/sessions/drain`` — the rolling-deploy migration
+        fence: push every resident session cold so its state is on the
+        shared spill dir before this worker restarts. Steps arriving
+        after the drain simply rehydrate (here or on the repinned
+        worker); nothing is dropped."""
+        store, err = self._session_store_or_503()
+        if err is not None:
+            return err
+        try:
+            n = store.spill_all(reason="drain")
+        except Exception as e:
+            return 500, {"error": repr(e)}, {}
+        return 200, {"worker": self.worker_id, "spilled": n}, {}
+
+    def _render_sessions(self) -> str:
+        """``/metrics`` session-tier section (ISSUE 16)."""
+        snap = self.sessions.snapshot()
+        c = snap["counters"]
+        reh = snap["rehydrate"]
+        return "\n".join([
+            f"serving_sessions_tracked {snap['tracked']}",
+            f"serving_sessions_resident {snap['resident']}",
+            f"serving_sessions_resident_bytes {snap['resident_bytes']}",
+            f"serving_sessions_spilled_files {snap['spilled_files']}",
+            f"serving_session_steps_total {c['steps_total']}",
+            f"serving_session_replays_total {c['replays_total']}",
+            f"serving_session_rehydrates_total {c['rehydrates_total']}",
+            f"serving_session_migrations_total {c['migrations_total']}",
+            f"serving_session_evictions_total {c['evictions_total']}",
+            f"serving_session_lost_total {c['lost_total']}",
+            "serving_session_rehydrate_seconds{quantile=\"0.5\"} "
+            + f"{reh['p50_s']}",
+            "serving_session_rehydrate_seconds{quantile=\"0.99\"} "
+            + f"{reh['p99_s']}",
+        ])
+
     def _render_metrics(self) -> str:
         parts = ["# TYPE serving_latency_seconds summary",
                  "# TYPE serving_dispatch_to_completion_seconds summary",
@@ -555,6 +825,8 @@ class ModelServer:
                 capacity.registry_capacity(self.registry)).rstrip("\n"))
         except Exception:
             pass  # capacity must never be able to break a scrape
+        if self.sessions is not None:
+            parts.append(self._render_sessions())
         # the black box's ring health (ISSUE 15): journal_* gauges
         parts.append(journal.render_prometheus().rstrip("\n"))
         return "\n".join(parts) + "\n"
@@ -648,6 +920,45 @@ class ModelServer:
                     name = self.path[len("/v1/models/"):-len("/residency")]
                     code, obj, extra = srv._handle_residency(
                         name, raw, headers=self.headers)
+                elif (self.path.startswith("/v1/models/")
+                        and "/sessions" in self.path):
+                    name, _, tail = (self.path[len("/v1/models/"):]
+                                     .partition("/sessions"))
+                    tail = tail.strip("/")
+                    if not tail:
+                        code, obj, extra = srv._handle_session_create(
+                            name, raw, headers=self.headers)
+                    else:
+                        parts = tail.split("/")
+                        if len(parts) == 2 and parts[1] == "step":
+                            code, obj, extra = srv._handle_session_step(
+                                name, parts[0], raw, headers=self.headers)
+                        elif len(parts) == 2 and parts[1] == "stream":
+                            # SSE: the handler writes the (close-
+                            # delimited) response itself
+                            srv._handle_session_stream(
+                                name, parts[0], raw, self)
+                            return
+                        else:
+                            code, obj, extra = (
+                                404, {"error": f"unknown path "
+                                               f"{self.path!r}"}, {})
+                elif self.path == "/v1/sessions/drain":
+                    code, obj, extra = srv._handle_sessions_drain(raw)
+                else:
+                    code, obj, extra = (404,
+                                        {"error": f"unknown path "
+                                                  f"{self.path!r}"}, {})
+                self._send(code, json.dumps(obj).encode(),
+                           "application/json", extra=extra)
+
+            def do_DELETE(self):
+                if (self.path.startswith("/v1/models/")
+                        and "/sessions/" in self.path):
+                    name, _, sid = (self.path[len("/v1/models/"):]
+                                    .partition("/sessions/"))
+                    code, obj, extra = srv._handle_session_close(
+                        name, sid.strip("/"))
                 else:
                     code, obj, extra = (404,
                                         {"error": f"unknown path "
@@ -669,6 +980,10 @@ class ModelServer:
         if self._httpd:
             self._httpd.shutdown()
             self._httpd = None
+        if self.sessions is not None:
+            # spill-at-exit: a graceful stop leaves every stream
+            # resumable from the shared spill dir
+            self.sessions.shutdown(spill=True)
         if self._capacity_provider is not None:
             # detach only OUR provider — a newer server's stays attached
             from deeplearning4j_tpu.runtime import profiler

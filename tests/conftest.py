@@ -19,6 +19,14 @@ if "xla_force_host_platform_device_count" not in _flags:
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+# NOTE on the XLA persistent compilation cache: do NOT enable it here
+# (``jax_compilation_cache_dir``). On this jaxlib/CPU combination,
+# executing a DEserialized executable segfaults nondeterministically —
+# cold populate runs are clean, warm runs crash roughly half the time
+# (reproduced with single-entry caches holding only ``jit_train_step``).
+# The suite instead relies on in-process sharing of compiled programs
+# (module-scoped fixtures, shared oracle nets).
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -1043,3 +1043,88 @@ def test_check_tables_analysis_absent_is_warning(tmp_path):
     msgs = []
     assert bench.check_tables(str(md), str(extra), log=msgs.append) == 0
     assert any("analysis" in m and "WARN" in m for m in msgs)
+
+
+def _sessions_section():
+    """A self-consistent BENCH_EXTRA.json["sessions"] section (the
+    ISSUE 16 session-tier A/B record)."""
+    return {
+        "n_sessions": 8,
+        "steps_per_session": 30,
+        "bucket": 8,
+        "serial": {"qps": 250.0, "bit_identical": True},
+        "batched": {"qps": 2000.0, "bit_identical": True},
+        "speedup": 8.0,
+        "on_traffic_compiles": 0,
+        "spill_p99_s": 0.0001,
+        "rehydrate_p99_s": 0.0005,
+        "rehydrate_count": 8,
+        "lost": 0,
+    }
+
+
+def _extra_with_sessions(section):
+    measured = {k: _mid(*rng) for k, rng in bench.RECORDED_RANGES.items()}
+    measured["sessions"] = section
+    measured["sessions_step_speedup"] = section["speedup"]
+    return measured
+
+
+def test_check_tables_validates_sessions_section(tmp_path):
+    """ISSUE 16 satellite: --check-tables covers the session-tier keys —
+    a self-consistent A/B record passes; a non-bit-identical arm, a
+    speedup the recorded qps rows can't reproduce, a batched arm losing
+    to the serial rnn_time_step loop, on-traffic compiles, lost
+    sessions, a rehydrate cycle that never ran, a negative latency, a
+    missing key, or a stale top-level copy all fail loudly."""
+    md = tmp_path / "BASELINE.md"
+    md.write_text(_table_md(bench.RECORDED_RANGES))
+    extra = tmp_path / "BENCH_EXTRA.json"
+
+    extra.write_text(json.dumps(_extra_with_sessions(_sessions_section())))
+    assert bench.check_tables(str(md), str(extra), log=lambda *a: None) == 0
+
+    def failing(mutate, needle):
+        sec = _sessions_section()
+        mutate(sec)
+        extra.write_text(json.dumps(_extra_with_sessions(sec)))
+        msgs = []
+        assert bench.check_tables(str(md), str(extra),
+                                  log=msgs.append) == 1, needle
+        assert any(needle in m for m in msgs), (needle, msgs)
+
+    failing(lambda s: s["serial"].update(bit_identical=False),
+            "sessions.serial: bit_identical")
+    failing(lambda s: s["batched"].update(bit_identical=False),
+            "sessions.batched: bit_identical")
+    failing(lambda s: s.update(speedup=4.0), "qps rows give")
+    failing(lambda s: (s["batched"].update(qps=200.0),
+                       s.update(speedup=0.8)),
+            "lost to the serial rnn_time_step loop")
+    failing(lambda s: s.update(on_traffic_compiles=2), "must be 0")
+    failing(lambda s: s.update(lost=1), "sessions.lost")
+    failing(lambda s: s.update(rehydrate_count=0), "never ran")
+    failing(lambda s: s.update(spill_p99_s=-1.0),
+            "not a non-negative latency")
+    failing(lambda s: s.pop("rehydrate_p99_s"), "missing from the recorded")
+
+    # stale top-level copy
+    ex = _extra_with_sessions(_sessions_section())
+    ex["sessions_step_speedup"] = 2.0
+    extra.write_text(json.dumps(ex))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("sessions_step_speedup: top-level copy" in m for m in msgs)
+
+
+def test_check_tables_sessions_absent_is_warning(tmp_path):
+    """No --sessions run recorded yet -> warn, don't fail (same contract
+    as the other optional sections)."""
+    md = tmp_path / "BASELINE.md"
+    md.write_text(_table_md(bench.RECORDED_RANGES))
+    measured = {k: _mid(*rng) for k, rng in bench.RECORDED_RANGES.items()}
+    extra = tmp_path / "BENCH_EXTRA.json"
+    extra.write_text(json.dumps(measured))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 0
+    assert any("sessions" in m and "WARN" in m for m in msgs)

@@ -1,0 +1,790 @@
+"""ISSUE 16: the session tier — stateful streaming inference with
+affinity routing, state spill/rehydrate, and drain-by-migration.
+
+Layers:
+
+- **Model carry-state API** (satellite 1) — `rnn_get_state` /
+  `rnn_set_state` / `rnn_clear_previous_state` round-trip bit-exactly;
+  one-call full-sequence `rnn_time_step` is bit-exact against
+  `output`; the pure-functional external step is bit-identical to the
+  stored-state step at equal program shape.
+- **SessionStore** — create/step/close lifecycle, write-through
+  CRC-framed spills, idle-TTL eviction on an injectable clock,
+  byte-budget LRU, rehydrate-on-touch, replay dedup + step conflicts
+  (exactly-once), migration between two stores over a shared spill
+  dir, and the `serving.session.step` / `serving.session.rehydrate`
+  chaos points (corrupt/truncated spill = explicit `SessionLost`,
+  never silently-wrong carry).
+- **Batcher step path** — concurrent streams coalesce into the fixed
+  session bucket and stay bit-identical to a serial `rnn_time_step`
+  loop padded to the same bucket, with zero on-traffic compiles.
+- **ModelServer endpoints** — session create/step/stream/close over
+  HTTP, SSE chunk framing, replay/conflict status mapping, capacity +
+  metrics surfacing, `/v1/sessions/drain`.
+- **Router affinity** — pins published through the shared FleetConfig,
+  session steps never hedged, failover = migrate (spill → rehydrate on
+  the new worker), DELETE drops the pin, fleet capacity aggregation.
+- **The acceptance drill** (slow) — multi-session streaming over a
+  subprocess fleet under seeded stragglers + one worker SIGKILL + one
+  rolling deploy: every surviving session bit-identical to its serial
+  oracle, zero sessions dropped, the journal carrying the full
+  `session.create` / `session.step_miss` / `session.spill` /
+  `session.rehydrate` / `session.migrate` / `session.evict` /
+  `session.close` lifecycle.
+"""
+
+import io
+import json
+import os
+import tarfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn import LSTM, InputType, RnnOutputLayer
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+from deeplearning4j_tpu.runtime import journal
+from deeplearning4j_tpu.runtime.chaos import (ChaosController, ChaosError,
+                                              CorruptBytes, FailNth)
+from deeplearning4j_tpu.serving import (ModelRegistry, ModelServer,
+                                        SessionLost, SessionStepConflict,
+                                        SessionStore)
+from deeplearning4j_tpu.serving.admission import DeadlineExceeded
+
+T, F = 1, 3          # one timestep of 3 features per streamed chunk
+BUCKET = 4           # the one fixed padded step-batch size
+
+
+def _conf(seed=7):
+    return (NeuralNetConfiguration.builder().seed(seed).list()
+            .layer(LSTM(n_out=5))
+            .layer(RnnOutputLayer(n_out=2, activation="softmax"))
+            .set_input_type(InputType.recurrent(F, T))
+            .build())
+
+
+def _net(seed=7):
+    return MultiLayerNetwork(_conf(seed)).init()
+
+
+def _chunks(key, n, rows=1):
+    rng = np.random.default_rng(key)
+    return [rng.standard_normal((rows, T, F)).astype(np.float32)
+            for _ in range(n)]
+
+
+_ORACLE_NET = None
+
+
+def _shared_net():
+    """One module-wide net (same fixed seed as every serving copy), state
+    cleared: the state-API tests and the serial oracle share one compiled
+    instance so each padded-step program compiles once for the file."""
+    global _ORACLE_NET
+    if _ORACLE_NET is None:
+        _ORACLE_NET = _net()
+    _ORACLE_NET.rnn_clear_previous_state()
+    return _ORACLE_NET
+
+
+def _serial_oracle(chunks, bucket=BUCKET):
+    """The contract's reference: a serial ``rnn_time_step`` loop over
+    zeros-padded batches of the SAME bucket size, session in row 0."""
+    net = _shared_net()
+    outs = []
+    for c in chunks:
+        xb = np.zeros((bucket, T, F), np.float32)
+        xb[0] = c[0]
+        outs.append(np.asarray(net.rnn_time_step(xb))[:1])
+    net.rnn_clear_previous_state()
+    return outs
+
+
+@pytest.fixture()
+def fresh_journal():
+    j = journal.enable(capacity=2048)
+    yield j
+    journal.enable(capacity=1024)
+
+
+@pytest.fixture(scope="module")
+def lstm_registry():
+    """One session-enabled registry for the in-process store tests (the
+    LSTM warmup compiles once per module, not once per test)."""
+    reg = ModelRegistry()
+    reg.register("lstm", _net(), max_batch_size=8, replicas=1,
+                 pipeline_depth=0)
+    reg.get("lstm").batcher.enable_sessions(
+        np.zeros((1, T, F), np.float32), session_bucket=BUCKET)
+    yield reg
+    reg.shutdown()
+
+
+def _store(reg, tmp_path, **kw):
+    kw.setdefault("start_evictor", False)
+    return SessionStore(reg, str(tmp_path), worker_id=kw.pop("worker_id",
+                                                             "w-test"), **kw)
+
+
+# ==========================================================================
+# satellite 1: the model-layer carry-state API
+def test_rnn_state_round_trip_is_bit_exact():
+    net = _shared_net()
+    c1, c2 = _chunks(1, 2)
+    import jax
+    net.rnn_time_step(c1)
+    st = net.rnn_get_state()
+    assert st is not None
+    for leaf in jax.tree.leaves(st):
+        assert isinstance(leaf, np.ndarray)  # serializable copy
+    out_a = np.asarray(net.rnn_time_step(c2))
+    # reinstall the captured state: the SAME second step must reproduce
+    # bit-for-bit (this is the contract the spill file relies on)
+    net.rnn_set_state(st)
+    out_b = np.asarray(net.rnn_time_step(c2))
+    assert np.array_equal(out_a, out_b)
+    # get after set round-trips the tree bit-exactly, dtypes preserved
+    net.rnn_set_state(st)
+    st2 = net.rnn_get_state()
+    for a, b in zip(jax.tree.leaves(st), jax.tree.leaves(st2)):
+        assert a.dtype == b.dtype and np.array_equal(a, b)
+    # clear via both spellings
+    net.rnn_clear_previous_state()
+    assert net.rnn_get_state() is None
+    net.rnn_time_step(c1)
+    net.rnn_set_state(None)
+    assert net.rnn_get_state() is None
+
+
+def test_one_call_time_step_matches_full_sequence_output():
+    net = _shared_net()
+    xs = np.random.default_rng(3).standard_normal(
+        (2, T, F)).astype(np.float32)
+    full = np.asarray(net.output(xs))
+    net.rnn_clear_previous_state()
+    stepped = np.asarray(net.rnn_time_step(xs))
+    assert np.array_equal(full, stepped), \
+        "one-call rnn_time_step must be bit-exact vs output"
+
+
+def test_external_step_bit_identical_to_stored_state_step():
+    net = _shared_net()
+    chunks = _chunks(5, 4)
+    net.rnn_clear_previous_state()
+    stored = [np.asarray(net.rnn_time_step(c)) for c in chunks]
+    state = None
+    for i, c in enumerate(chunks):
+        out, state = net.rnn_time_step_external(c, state)
+        assert np.array_equal(np.asarray(out), stored[i]), i
+    # zero state is the documented fresh-stream tree
+    import jax
+    z = net.rnn_zero_state(1, like=chunks[0])
+    for leaf in jax.tree.leaves(z):
+        assert not np.asarray(leaf).any()
+
+
+def test_computation_graph_rnn_state_round_trip():
+    from deeplearning4j_tpu.models import ComputationGraph
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("lstm", LSTM(n_out=5), "in")
+            .add_layer("out", RnnOutputLayer(n_out=2,
+                                             activation="softmax"), "lstm")
+            .set_outputs("out")
+            .set_input_types(InputType.recurrent(F, T))
+            .build())
+    g = ComputationGraph(conf).init()
+    c1, c2 = _chunks(7, 2)
+    g.rnn_time_step(c1)
+    st = g.rnn_get_state()
+    assert st is not None
+    out_a = np.asarray(g.rnn_time_step(c2))
+    g.rnn_set_state(st)
+    out_b = np.asarray(g.rnn_time_step(c2))
+    assert np.array_equal(out_a, out_b)
+    g.rnn_clear_previous_state()
+    assert g.rnn_get_state() is None
+
+
+# ==========================================================================
+# SessionStore: lifecycle, exactly-once, bit-identity
+def test_store_lifecycle_bit_identical_and_exactly_once(
+        lstm_registry, tmp_path, fresh_journal):
+    store = _store(lstm_registry, tmp_path)
+    oracle = _serial_oracle(_chunks(11, 5))
+    chunks = _chunks(11, 5)
+    sess = store.create("lstm", session_id="s-life")
+    assert os.path.exists(store._spill_path("lstm", "s-life"))
+    for i, c in enumerate(chunks):
+        out, step, replayed = store.step("lstm", "s-life", c, client_step=i)
+        assert step == i + 1 and replayed is False
+        assert np.array_equal(np.asarray(out), oracle[i]), i
+    # replay dedup: re-sending the last acked step returns the persisted
+    # output WITHOUT advancing the carry (client retry = exactly-once)
+    out_r, step_r, replayed = store.step("lstm", "s-life", chunks[-1],
+                                         client_step=4)
+    assert replayed is True and step_r == 5
+    assert np.array_equal(np.asarray(out_r), oracle[-1])
+    # a gap is an explicit conflict, never a silent re-execution
+    with pytest.raises(SessionStepConflict):
+        store.step("lstm", "s-life", chunks[-1], client_step=7)
+    snap = store.snapshot()
+    assert snap["counters"]["steps_total"] == 5
+    assert snap["counters"]["replays_total"] == 1
+    types = {e["type"] for e in fresh_journal.events()}
+    assert "session.create" in types
+    store.close("lstm", "s-life")
+    assert not os.path.exists(store._spill_path("lstm", "s-life"))
+    assert any(e["type"] == "session.close" for e in fresh_journal.events())
+    with pytest.raises(KeyError):
+        store.step("lstm", "s-life", chunks[0])
+    store.shutdown()
+
+
+def test_idle_ttl_eviction_spills_and_rehydrates_bit_exact(
+        lstm_registry, tmp_path, fresh_journal):
+    clock = [0.0]
+    store = _store(lstm_registry, tmp_path, idle_ttl_s=10.0,
+                   clock=lambda: clock[0])
+    chunks = _chunks(13, 4)
+    oracle = _serial_oracle(chunks)
+    store.create("lstm", session_id="s-ttl")
+    for i in (0, 1):
+        out, _, _ = store.step("lstm", "s-ttl", chunks[i], client_step=i)
+        assert np.array_equal(np.asarray(out), oracle[i])
+    clock[0] = 11.0  # past the idle TTL: the sweep pushes it cold
+    store._evict_pass()
+    snap = store.snapshot()
+    assert snap["resident"] == 0 and snap["tracked"] == 1
+    evs = fresh_journal.events()
+    assert any(e["type"] == "session.spill" for e in evs)
+    assert any(e["type"] == "session.evict"
+               and e["attrs"]["reason"] == "idle_ttl" for e in evs)
+    # next touch rehydrates from the CRC-framed spill — bit-exact resume
+    out, step, _ = store.step("lstm", "s-ttl", chunks[2], client_step=2)
+    assert step == 3 and np.array_equal(np.asarray(out), oracle[2])
+    evs = fresh_journal.events()
+    assert any(e["type"] == "session.step_miss" for e in evs)
+    assert any(e["type"] == "session.rehydrate" for e in evs)
+    assert store.snapshot()["counters"]["rehydrates_total"] == 1
+    assert store.snapshot()["rehydrate"]["count"] == 1
+    store.shutdown()
+
+
+def test_byte_budget_evicts_least_recently_touched(lstm_registry, tmp_path):
+    clock = [0.0]
+    store = _store(lstm_registry, tmp_path, clock=lambda: clock[0])
+    a = store.create("lstm", session_id="s-old")
+    clock[0] = 1.0
+    store.create("lstm", session_id="s-new")
+    # budget below two carries but above one: only the LRU goes cold
+    store.byte_budget_bytes = a.state_bytes + 1
+    store._evict_pass()
+    snap = store.snapshot()
+    assert snap["resident"] == 1
+    with store._lock:
+        resident = [s.session_id for s in store._sessions.values()
+                    if s.state is not None]
+    assert resident == ["s-new"]
+    store.shutdown()
+
+
+def test_migration_between_stores_over_shared_spill_dir(
+        lstm_registry, tmp_path, fresh_journal):
+    """Drain-by-migration in miniature: worker A spills, worker B adopts
+    the session from the shared dir and continues bit-identically."""
+    chunks = _chunks(17, 4)
+    oracle = _serial_oracle(chunks)
+    a = _store(lstm_registry, tmp_path, worker_id="w-a")
+    b = _store(lstm_registry, tmp_path, worker_id="w-b")
+    a.create("lstm", session_id="s-mig")
+    for i in (0, 1):
+        a.step("lstm", "s-mig", chunks[i], client_step=i)
+    assert a.spill_all(reason="drain") == 1
+    # B has never seen this session: it adopts the spill file
+    out, step, _ = b.step("lstm", "s-mig", chunks[2], client_step=2)
+    assert step == 3 and np.array_equal(np.asarray(out), oracle[2])
+    assert b.snapshot()["counters"]["migrations_total"] == 1
+    mig = [e for e in fresh_journal.events()
+           if e["type"] == "session.migrate"]
+    assert mig and mig[-1]["attrs"]["to_worker"] == "w-b"
+    out, _, _ = b.step("lstm", "s-mig", chunks[3], client_step=3)
+    assert np.array_equal(np.asarray(out), oracle[3])
+    a.shutdown(spill=False)
+    b.shutdown()
+
+
+# ==========================================================================
+# chaos points: damaged spills are SessionLost, never silently wrong
+def test_corrupt_spill_is_explicit_session_lost(lstm_registry, tmp_path,
+                                                fresh_journal):
+    store = _store(lstm_registry, tmp_path)
+    chunks = _chunks(19, 2)
+    store.create("lstm", session_id="s-rot")
+    store.step("lstm", "s-rot", chunks[0], client_step=0)
+    store.spill_all(reason="drain")
+    with ChaosController(seed=3) as c:
+        c.on("serving.session.rehydrate", CorruptBytes(mode="flip"))
+        with pytest.raises(SessionLost):
+            store.step("lstm", "s-rot", chunks[1], client_step=1)
+    assert store.snapshot()["counters"]["lost_total"] == 1
+    # the lost session stays lost (no half-resurrected carry) but its
+    # spill file survives for forensics
+    assert os.path.exists(store._spill_path("lstm", "s-rot"))
+    store.shutdown(spill=False)
+
+
+def test_truncated_spill_is_explicit_session_lost(lstm_registry, tmp_path):
+    store = _store(lstm_registry, tmp_path)
+    chunks = _chunks(23, 2)
+    store.create("lstm", session_id="s-torn")
+    store.step("lstm", "s-torn", chunks[0], client_step=0)
+    store.spill_all(reason="drain")
+    with ChaosController(seed=5) as c:
+        c.on("serving.session.rehydrate", CorruptBytes(mode="truncate"))
+        with pytest.raises(SessionLost):
+            store.step("lstm", "s-torn", chunks[1], client_step=1)
+    store.shutdown(spill=False)
+
+
+def test_step_chaos_point_failure_does_not_advance_the_carry(
+        lstm_registry, tmp_path):
+    store = _store(lstm_registry, tmp_path)
+    chunks = _chunks(29, 3)
+    oracle = _serial_oracle(chunks)
+    store.create("lstm", session_id="s-chaos")
+    store.step("lstm", "s-chaos", chunks[0], client_step=0)
+    with ChaosController(seed=7) as c:
+        c.on("serving.session.step", FailNth(1))
+        with pytest.raises(ChaosError):
+            store.step("lstm", "s-chaos", chunks[1], client_step=1)
+    # the injected fault fired BEFORE the carry moved: the retry of the
+    # same step index executes normally and stays on the oracle path
+    out, step, replayed = store.step("lstm", "s-chaos", chunks[1],
+                                     client_step=1)
+    assert step == 2 and replayed is False
+    assert np.array_equal(np.asarray(out), oracle[1])
+    store.shutdown()
+
+
+# ==========================================================================
+# batcher: concurrent streams coalesce, stay bit-identical, never compile
+def test_concurrent_sessions_bit_identical_to_serial_oracle(
+        lstm_registry, tmp_path):
+    store = _store(lstm_registry, tmp_path)
+    batcher = lstm_registry.get("lstm").batcher
+    n_sessions, n_steps = 5, 6
+    all_chunks = {f"s{i}": _chunks(100 + i, n_steps)
+                  for i in range(n_sessions)}
+    oracles = {sid: _serial_oracle(cs)
+               for sid, cs in all_chunks.items()}
+    for sid in all_chunks:
+        store.create("lstm", session_id=sid)
+    compiles_before = batcher.compile_count()
+    results = {sid: [] for sid in all_chunks}
+    errors = []
+
+    def run(sid):
+        try:
+            for i, c in enumerate(all_chunks[sid]):
+                out, _, _ = store.step("lstm", sid, c, client_step=i)
+                results[sid].append(np.asarray(out))
+        except Exception as e:  # pragma: no cover - failure detail
+            errors.append((sid, repr(e)))
+
+    threads = [threading.Thread(target=run, args=(sid,))
+               for sid in all_chunks]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    for sid, outs in results.items():
+        for i, out in enumerate(outs):
+            assert np.array_equal(out, oracles[sid][i]), (sid, i)
+    assert batcher.compile_count() == compiles_before, \
+        "session traffic compiled after warmup"
+    store.shutdown()
+
+
+def test_step_deadline_is_honoured(lstm_registry, tmp_path):
+    store = _store(lstm_registry, tmp_path)
+    store.create("lstm", session_id="s-dl")
+    with pytest.raises(DeadlineExceeded):
+        store.step("lstm", "s-dl", _chunks(31, 1)[0], timeout_ms=0.0001)
+    store.shutdown()
+
+
+# ==========================================================================
+# ModelServer: the HTTP surface
+@pytest.fixture(scope="module")
+def session_server(tmp_path_factory):
+    """One session-enabled ModelServer for the HTTP tests (module scope:
+    the LSTM warmup compiles once; each test uses its own session ids)."""
+    spill = tmp_path_factory.mktemp("spill")
+    reg = ModelRegistry()
+    reg.register("lstm", _net(), max_batch_size=8, replicas=1,
+                 pipeline_depth=0)
+    reg.get("lstm").batcher.enable_sessions(
+        np.zeros((1, T, F), np.float32), session_bucket=BUCKET)
+    srv = ModelServer(reg, worker_id="w-http",
+                      session_dir=str(spill),
+                      session_kw={"start_evictor": False})
+    port = srv.start(0)
+    try:
+        yield srv, port
+    finally:
+        srv.stop()
+        reg.shutdown()
+
+
+def _req(port, method, path, body=None, timeout=30):
+    raw = None if body is None else json.dumps(body).encode()
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                 data=raw, method=method)
+    resp = urllib.request.urlopen(req, timeout=timeout)
+    return resp.status, dict(resp.headers), json.loads(resp.read())
+
+
+def test_server_session_endpoints_end_to_end(session_server):
+    srv, port = session_server
+    chunks = _chunks(37, 3)
+    oracle = _serial_oracle(chunks)
+    st, hdrs, obj = _req(port, "POST", "/v1/models/lstm/sessions", {})
+    assert st == 200 and obj["step"] == 0
+    sid = obj["session"]
+    for i, c in enumerate(chunks):
+        st, hdrs, obj = _req(port, "POST",
+                             f"/v1/models/lstm/sessions/{sid}/step",
+                             {"inputs": c.tolist(), "step": i})
+        assert st == 200 and obj["step"] == i + 1
+        assert hdrs["X-Session-Step"] == str(i + 1)
+        assert np.array_equal(np.asarray(obj["outputs"], np.float32),
+                              oracle[i].astype(np.float32)), i
+    # retry of the acked step replays the persisted output
+    st, _, obj = _req(port, "POST",
+                      f"/v1/models/lstm/sessions/{sid}/step",
+                      {"inputs": chunks[-1].tolist(), "step": 2})
+    assert st == 200 and obj["replayed"] is True
+    # a stale/forked client gets an explicit 409 step_conflict
+    with pytest.raises(urllib.error.HTTPError) as e409:
+        _req(port, "POST", f"/v1/models/lstm/sessions/{sid}/step",
+             {"inputs": chunks[-1].tolist(), "step": 9})
+    assert e409.value.code == 409
+    assert json.loads(e409.value.read())["reason"] == "step_conflict"
+    # unknown session -> 404
+    with pytest.raises(urllib.error.HTTPError) as e404:
+        _req(port, "POST", "/v1/models/lstm/sessions/nope/step",
+             {"inputs": chunks[0].tolist()})
+    assert e404.value.code == 404
+    # capacity + metrics carry the session ledger
+    st, _, cap = _req(port, "GET", "/v1/capacity")
+    assert cap["sessions"]["tracked"] == 1
+    assert cap["sessions"]["counters"]["steps_total"] == 3
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=30).read().decode()
+    for metric in ("serving_sessions_tracked", "serving_sessions_resident",
+                   "serving_session_steps_total",
+                   "serving_session_replays_total",
+                   "serving_session_rehydrate_seconds"):
+        assert metric in text, metric
+    # the drain fence spills every resident session
+    st, _, obj = _req(port, "POST", "/v1/sessions/drain", {})
+    assert st == 200 and obj["spilled"] == 1
+    # DELETE closes; a second close is 404
+    st, _, obj = _req(port, "DELETE", f"/v1/models/lstm/sessions/{sid}")
+    assert st == 200 and obj["closed"] is True
+    with pytest.raises(urllib.error.HTTPError) as egone:
+        _req(port, "DELETE", f"/v1/models/lstm/sessions/{sid}")
+    assert egone.value.code == 404
+
+
+def test_server_sse_stream_is_bit_identical_and_joins_writer(
+        session_server):
+    srv, port = session_server
+    chunks = _chunks(41, 4)
+    oracle = _serial_oracle(chunks)
+    st, _, obj = _req(port, "POST", "/v1/models/lstm/sessions",
+                      {"session_id": "s-sse"})
+    assert st == 200
+    body = json.dumps({"inputs": [c.tolist() for c in chunks],
+                       "step": 0}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/models/lstm/sessions/s-sse/stream",
+        data=body)
+    resp = urllib.request.urlopen(req, timeout=60)
+    assert resp.headers["Content-Type"].startswith("text/event-stream")
+    raw = resp.read().decode()
+    frames = [f for f in raw.split("\n\n") if f.strip()]
+    data_frames = [f for f in frames if f.startswith("data:")]
+    assert len(data_frames) == len(chunks)
+    for i, frame in enumerate(data_frames):
+        payload = json.loads(frame[len("data:"):])
+        assert payload["step"] == i + 1
+        assert np.array_equal(
+            np.asarray(payload["outputs"], np.float32),
+            oracle[i].astype(np.float32)), i
+    end = [f for f in frames if f.startswith("event: end")]
+    assert end and json.loads(end[0].splitlines()[-1][len("data:"):]) == \
+        {"steps": len(chunks)}
+    # the per-stream writer thread is joined by the handler
+    time.sleep(0.1)
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith("stream-writer")]
+
+
+# ==========================================================================
+# router: affinity, never-hedged, failover-as-migration
+def test_router_affinity_failover_and_fleet_aggregation(tmp_path,
+                                                        fresh_journal):
+    from deeplearning4j_tpu.serving import FleetRouter, StaticFleet
+    from deeplearning4j_tpu.serving.control_plane import FleetConfig
+
+    spill = tmp_path / "spill"
+    spill.mkdir()
+    servers, regs, endpoints = {}, [], {}
+    for wid in ("wa", "wb"):
+        reg = ModelRegistry()
+        reg.register("lstm", _net(), max_batch_size=8, replicas=1,
+                     pipeline_depth=0)
+        reg.get("lstm").batcher.enable_sessions(
+            np.zeros((1, T, F), np.float32), session_bucket=BUCKET)
+        srv = ModelServer(reg, worker_id=wid, session_dir=str(spill),
+                          session_kw={"start_evictor": False})
+        endpoints[wid] = f"127.0.0.1:{srv.start(0)}"
+        servers[wid] = srv
+        regs.append(reg)
+    cfg = FleetConfig(str(tmp_path / "fleet.json"))
+    router = FleetRouter(StaticFleet(endpoints), probe_interval_s=0.05,
+                         hedge_initial_ms=1.0)  # would hedge instantly...
+    router.attach_config(cfg)
+    rport = router.start(0)
+    pinned = None
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not all(
+                v.ready for v in router.workers().values()):
+            time.sleep(0.05)
+        chunks = _chunks(43, 6)
+        oracle = _serial_oracle(chunks)
+        st, hdrs, obj = _req(rport, "POST", "/v1/models/lstm/sessions", {})
+        assert st == 200
+        sid, pinned = obj["session"], obj["worker"]
+        # the pin is published through the shared config
+        assert (cfg.snapshot().get("sessions") or {}) \
+            .get(f"lstm/{sid}") == pinned
+        for i in range(3):
+            st, hdrs, obj = _req(
+                rport, "POST", f"/v1/models/lstm/sessions/{sid}/step",
+                {"inputs": chunks[i].tolist(), "step": i})
+            assert st == 200 and hdrs["X-Worker-Id"] == pinned
+            assert np.array_equal(np.asarray(obj["outputs"], np.float32),
+                                  oracle[i].astype(np.float32)), i
+        snap = router.metrics.snapshot()
+        # ...but session steps are NEVER hedged (duplicates corrupt carry)
+        assert snap["hedges_total"] == 0
+        assert snap["session_requests_total"] == 4
+        # kill the pinned worker: the next step migrates, not drops
+        servers[pinned].stop()
+        other = "wb" if pinned == "wa" else "wa"
+        st, hdrs, obj = _req(
+            rport, "POST", f"/v1/models/lstm/sessions/{sid}/step",
+            {"inputs": chunks[3].tolist(), "step": 3}, timeout=60)
+        assert st == 200 and hdrs["X-Worker-Id"] == other
+        assert np.array_equal(np.asarray(obj["outputs"], np.float32),
+                              oracle[3].astype(np.float32))
+        assert router.metrics.snapshot()["session_migrations_total"] >= 1
+        assert (cfg.snapshot().get("sessions") or {}) \
+            .get(f"lstm/{sid}") == other
+        assert any(e["type"] == "session.migrate"
+                   for e in fresh_journal.events())
+        for i in (4, 5):  # the stream continues bit-identically
+            st, _, obj = _req(
+                rport, "POST", f"/v1/models/lstm/sessions/{sid}/step",
+                {"inputs": chunks[i].tolist(), "step": i})
+            assert st == 200
+            assert np.array_equal(np.asarray(obj["outputs"], np.float32),
+                                  oracle[i].astype(np.float32)), i
+        agg = router.fleet_capacity()
+        assert agg["sessions"]["tracked"] >= 1
+        text = router.render_fleet_capacity()
+        assert "fleet_capacity_sessions_tracked" in text
+        # DELETE through the router closes AND drops the published pin
+        st, _, obj = _req(rport, "DELETE",
+                          f"/v1/models/lstm/sessions/{sid}")
+        assert st == 200
+        assert f"lstm/{sid}" not in (cfg.snapshot().get("sessions") or {})
+    finally:
+        router.stop()
+        for wid, srv in servers.items():
+            if wid != pinned:
+                srv.stop()
+        for reg in regs:
+            reg.shutdown()
+
+
+# ==========================================================================
+# the acceptance drill (slow): subprocess fleet, stragglers, SIGKILL,
+# rolling deploy — zero dropped sessions, everything bit-identical
+@pytest.mark.slow
+def test_streaming_drill_survives_sigkill_and_rolling_deploy(
+        tmp_path, fresh_journal):
+    from deeplearning4j_tpu.runtime.environment import get_environment
+    from deeplearning4j_tpu.serving import FleetRouter
+    from deeplearning4j_tpu.serving.fleet import FleetSupervisor, WorkerSpec
+
+    a1 = str(tmp_path / "model-v1.zip")
+    a2 = str(tmp_path / "model-v2.zip")
+    cache = str(tmp_path / "executable-cache")
+    _net().save(a1)
+    _net().save(a2)  # same seed -> same weights: bit-identity across deploy
+    get_environment().set_compile_cache(cache)
+    sig = {"__single__": {"shape_tail": [T, F], "dtype": "float32"}}
+    kw = dict(max_batch_size=8, buckets=[1, 8], batch_timeout_ms=1.0,
+              pipeline_depth=0)
+    specs = [WorkerSpec(worker_id=f"w{i}", model_name="lstm", archive=a1,
+                        version=1, batcher_kw=dict(kw), cache_dir=cache,
+                        warmup_signature=sig, session_dir="",
+                        session_bucket=BUCKET,
+                        session_kw={"idle_ttl_s": 3600.0},
+                        straggle={"p": 0.15, "ms": 40.0, "seed": 11 + i,
+                                  "point": "serving.session.step"})
+             for i in range(3)]
+    sup = FleetSupervisor(specs, run_dir=str(tmp_path / "run"),
+                          max_restarts=4, heartbeat_timeout_s=60.0).start()
+    router = FleetRouter(sup, probe_interval_s=0.1, hedge_initial_ms=250.0)
+    port = router.start(0)
+
+    n_sessions, n_steps, tail_steps = 6, 24, 4
+    all_chunks = {f"d{i}": _chunks(500 + i, n_steps)
+                  for i in range(n_sessions)}
+    results = {sid: {} for sid in all_chunks}
+    failures = []
+    deploy_done = threading.Event()
+
+    def stream(sid):
+        try:
+            st, _, obj = _req(port, "POST", "/v1/models/lstm/sessions",
+                              {"session_id": sid}, timeout=60)
+            assert st == 200
+            for i, c in enumerate(all_chunks[sid]):
+                if i == n_steps - tail_steps:
+                    # the last few steps of EVERY stream land after the
+                    # rolling deploy: they must rehydrate on the fresh
+                    # worker incarnations (migration, not loss)
+                    assert deploy_done.wait(timeout=600)
+                # exactly-once client loop: retry the SAME step index on
+                # any fault — the worker's replay dedup absorbs retries
+                for attempt in range(60):
+                    try:
+                        st, _, obj = _req(
+                            port, "POST",
+                            f"/v1/models/lstm/sessions/{sid}/step",
+                            {"inputs": c.tolist(), "step": i,
+                             "timeout_ms": 15000}, timeout=30)
+                        if st == 200:
+                            results[sid][i] = np.asarray(
+                                obj["outputs"], np.float32)
+                            break
+                    except urllib.error.HTTPError as e:
+                        if e.code in (404, 410):  # dropped = drill failure
+                            raise
+                    except Exception:
+                        pass
+                    time.sleep(0.2)
+                else:
+                    raise AssertionError(f"step {i} of {sid} never acked")
+                time.sleep(0.04)
+        except Exception as e:
+            failures.append((sid, repr(e)))
+
+    threads = [threading.Thread(target=stream, args=(sid,), daemon=True)
+               for sid in all_chunks]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(1.0)  # streams running under the straggler schedule
+        # leg 1: SIGKILL whichever worker holds the most pins
+        with router._pins_lock:
+            local = dict(router._session_pins)
+        counts = {}
+        for wid in local.values():
+            counts[wid] = counts.get(wid, 0) + 1
+        victim = max(counts, key=counts.get) if counts else "w0"
+        sup.kill_worker(victim)
+        time.sleep(2.0)
+        # leg 2: one rolling deploy to the identical-weights v2 archive
+        # (the drain fence spills every resident carry before each kill)
+        router.rolling_deploy(a2, version=2, drain_timeout_s=30.0,
+                              ready_timeout_s=120.0)
+        deploy_done.set()
+        for t in threads:
+            t.join(timeout=300)
+        assert not any(t.is_alive() for t in threads), "hung stream"
+        # post-deploy epilogue: one full lifecycle on the LIVE worker
+        # incarnations (a journal ring dies with its process, so the
+        # bundle can only carry lifecycle events the current fleet
+        # emitted — this is exactly what an operator's bundle pull after
+        # an incident window sees)
+        ep = _chunks(999, 2)
+        st, _, obj = _req(port, "POST", "/v1/models/lstm/sessions",
+                          {"session_id": "epilogue"}, timeout=60)
+        assert st == 200
+        st, _, _obj = _req(port, "POST",
+                           "/v1/models/lstm/sessions/epilogue/step",
+                           {"inputs": ep[0].tolist(), "step": 0},
+                           timeout=60)
+        assert st == 200
+        for view in router.workers().values():  # spill + evict everywhere
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://{view.address}/v1/sessions/drain", data=b"{}"),
+                timeout=30).read()
+        st, _, _obj = _req(port, "POST",
+                           "/v1/models/lstm/sessions/epilogue/step",
+                           {"inputs": ep[1].tolist(), "step": 1},
+                           timeout=60)
+        assert st == 200  # step_miss -> rehydrate on the drained worker
+        st, _, _obj = _req(port, "DELETE",
+                           "/v1/models/lstm/sessions/epilogue", timeout=60)
+        assert st == 200
+        # the post-deploy tail steps rehydrated on fresh incarnations:
+        # the fleet-aggregated ledger proves spill -> rehydrate -> migrate
+        # actually ran (worker-side journals are per-subprocess, so the
+        # counters on /v1/capacity are the cross-process evidence)
+        agg = router.fleet_capacity()
+        assert agg["sessions"]["counters"]["rehydrates_total"] >= 1, agg
+        assert agg["sessions"]["counters"]["migrations_total"] >= 1, agg
+        assert agg["sessions"]["counters"]["lost_total"] == 0, agg
+        # ONE /v1/debug/bundle pull reconstructs the whole session
+        # lifecycle across every worker process (fleet-merged journal)
+        data = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/debug/bundle",
+            timeout=120).read()
+        with tarfile.open(fileobj=io.BytesIO(data)) as tf:
+            events = json.load(tf.extractfile("journal.json"))["events"]
+        etypes = {e["type"] for e in events}
+        assert {"session.create", "session.spill", "session.evict",
+                "session.step_miss", "session.rehydrate",
+                "session.migrate", "session.close"} <= etypes, sorted(
+                    t for t in etypes if t.startswith("session."))
+    finally:
+        deploy_done.set()
+        router.stop()
+        sup.stop()
+
+    # zero dropped sessions, every step acked
+    assert not failures, failures
+    for sid, outs in results.items():
+        assert len(outs) == n_steps, (sid, sorted(outs))
+    # every surviving session bit-identical to its serial oracle
+    for sid, chunks in all_chunks.items():
+        oracle = _serial_oracle(chunks)
+        for i in range(n_steps):
+            assert np.array_equal(results[sid][i],
+                                  oracle[i].astype(np.float32)), (sid, i)
