@@ -258,6 +258,13 @@ def check_tables(baseline_md=None, bench_extra=None, log=_log):
     if measured is not None:
         check_sessions_section(measured, failures, warnings)
 
+    # ISSUE 17 delivery keys: bad deploys rolled back with the
+    # candidate's served share under the canary cap, good deploys
+    # promoted, zero client errors, bit-identical arms, and a complete
+    # seq-gapless stage history reconstructed from one bundle pull
+    if measured is not None:
+        check_delivery_section(measured, failures, warnings)
+
     for w in warnings:
         log(f"[check-tables] WARN {w}")
     for fmsg in failures:
@@ -4894,6 +4901,487 @@ def check_sessions_section(extra, failures, warnings):
         failures.append(f"sessions: malformed section ({e!r})")
 
 
+def bench_delivery(n_threads=3, bench_extra=None, log=_log):
+    """``bench.py --delivery`` (ISSUE 17): the gated-delivery drill of
+    record.
+
+    A routed 2-worker in-process fleet under closed-loop load runs two
+    order-alternated rounds of ``(bad, good)`` / ``(good, bad)`` gated
+    deploys (``rolling_deploy(strategy="gated")``). Asserted before
+    anything is written (a failing run cannot produce the artifact):
+
+    - the **bad** candidate (output classes permuted — its top-1 is
+      wrong on EVERY input) carries a lax golden sidecar and a tolerant
+      shadow bar, so it deliberately reaches the canary stage, where its
+      own SLO window (an unreachable latency target) burns and the
+      deploy auto-rolls back: the candidate's served share of client
+      traffic never exceeds the configured canary fraction (the
+      blast-radius cap), the rollback records ZERO client-visible
+      errors, and every incumbent response stays bit-identical to the
+      in-process oracle;
+    - the **good** candidate (same weights as the incumbent) passes its
+      strict golden gate, shadows clean, ramps through the canary, and
+      promotes fleet-wide — zero errors, every response bit-identical;
+    - the full stage history of all four deploys reconstructs from ONE
+      ``GET /v1/debug/bundle`` pull, with per-incarnation seq-gapless
+      journal events.
+
+    Results -> ``BENCH_EXTRA.json["delivery"]`` (validated by
+    ``--check-tables``)."""
+    import io
+    import shutil
+    import tarfile
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import jax
+
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.runtime import journal
+    from deeplearning4j_tpu.serving import ModelRegistry, ModelServer
+    from deeplearning4j_tpu.serving.delivery import (DeliveryConfig,
+                                                     GoldenSet)
+    from deeplearning4j_tpu.serving.router import FleetRouter
+    from deeplearning4j_tpu.serving.slo import SLOTarget
+
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(None)
+            .list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=4, activation="softmax"))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(16, 8)).astype(np.float32)
+    batcher_kw = dict(max_batch_size=4, buckets=[1, 4],
+                      batch_timeout_ms=1.0, pipeline_depth=0)
+    canary_cap = 0.25
+
+    td = tempfile.mkdtemp(prefix="dl4j-bench-delivery-")
+    a1 = os.path.join(td, "model-v1.zip")
+    a_good = os.path.join(td, "model-good.zip")
+    a_bad = os.path.join(td, "model-bad.zip")
+    oracle = MultiLayerNetwork(conf).init()
+    oracle.save(a1)
+    MultiLayerNetwork(conf).init().save(a_good)  # same seed, same weights
+    # the seeded-bad candidate: every output-layer leaf rolled by one
+    # class, so its top-1 disagrees with the incumbent on EVERY input
+    bad_net = MultiLayerNetwork(conf).init()
+    bad_net.set_params(jax.tree.map(
+        lambda a: (np.roll(np.asarray(a), 1, -1)
+                   if a.shape[-1] == 4 else a), oracle.params()))
+    bad_net.save(a_bad)
+    # sidecars: the good candidate's bar is strict; the bad one DECLARES
+    # a bar nothing could fail — the gate the canary exists to back up
+    GoldenSet(xs[:4]).save(GoldenSet.sidecar(a_good))
+    GoldenSet(xs[:4], max_delta=1.0).save(GoldenSet.sidecar(a_bad))
+
+    oracle_cache = {}
+
+    def oracle_out(n, ofs):
+        if (n, ofs) not in oracle_cache:
+            outs = []
+            for bucket in (b for b in batcher_kw["buckets"] if b >= n):
+                padded = np.concatenate(
+                    [xs[ofs:ofs + n],
+                     np.zeros((bucket - n, xs.shape[1]), xs.dtype)],
+                    axis=0)
+                outs.append(np.asarray(oracle.output(padded))[:n])
+            oracle_cache[(n, ofs)] = outs
+        return oracle_cache[(n, ofs)]
+
+    class InProcFleet:
+        """Supervisor duck-type over in-process ``ModelServer`` workers
+        — everything ``strategy="gated"`` needs without subprocess
+        launch cost; ``restart_worker`` really rebuilds the worker from
+        the archive (new registry, new port)."""
+
+        def __init__(self, archives_by_wid):
+            self._lock = threading.Lock()  # guards: _workers
+            self._workers = {}
+            for wid, archive in archives_by_wid.items():
+                self._launch(wid, archive, 1)
+
+        def _launch(self, wid, archive, version):
+            reg = ModelRegistry()
+            reg.load("m", archive, warmup_example=xs[:1],
+                     save_manifest=False, version=version, **batcher_kw)
+            srv = ModelServer(reg, worker_id=wid)
+            p = srv.start(0)
+            with self._lock:
+                self._workers[wid] = {"server": srv, "archive": archive,
+                                      "address": f"127.0.0.1:{p}"}
+
+        def endpoints(self):
+            with self._lock:
+                return {w: s["address"] for w, s in self._workers.items()}
+
+        def worker_ids(self):
+            with self._lock:
+                return list(self._workers)
+
+        def worker_archive(self, wid):
+            with self._lock:
+                return self._workers[wid]["archive"]
+
+        def restart_worker(self, wid, archive=None, version=None):
+            with self._lock:
+                old = self._workers[wid]
+            old["server"].stop(shutdown_registry=True)
+            self._launch(wid, archive or old["archive"], version)
+
+        def stop(self):
+            with self._lock:
+                workers = list(self._workers.values())
+            for s in workers:
+                s["server"].stop(shutdown_registry=True)
+
+    def post(port, n, ofs):
+        body = json.dumps({"inputs": xs[ofs:ofs + n].tolist(),
+                           "timeout_ms": 10000}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/m/predict", data=body)
+        resp = urllib.request.urlopen(req, timeout=60)
+        return resp.status, json.loads(resp.read())
+
+    def run_deploy(port, router, archive, version, dcfg):
+        """Closed-loop client threads across one gated deploy; every
+        outcome recorded with the serving version (the candidate's
+        version is how its blast radius is measured)."""
+        outcomes, lock = [], threading.Lock()
+        stop = threading.Event()
+
+        def client(tid):
+            k = 0
+            while not stop.is_set():
+                n, ofs = 1 + (tid + k) % 4, (3 * k + tid) % 8
+                try:
+                    status, out = post(port, n, ofs)
+                    rec = ("ok", status, n, ofs, out["version"],
+                           np.asarray(out["outputs"], np.float32))
+                except urllib.error.HTTPError as e:
+                    rec = ("http_error", e.code, n, ofs, None, None)
+                except Exception as e:
+                    rec = ("error", type(e).__name__, n, ofs, None, None)
+                with lock:
+                    outcomes.append(rec)
+                k += 1
+                time.sleep(0.01)
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        try:
+            report = router.rolling_deploy(
+                archive, version=version, strategy="gated", model="m",
+                delivery_config=dcfg)
+        finally:
+            time.sleep(0.3)
+            stop.set()
+            for t in threads:
+                t.join(timeout=60)
+        return report, outcomes
+
+    def wait_ready(router, want=2, timeout_s=60.0):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if sum(v.ready for v in router.workers().values()) >= want:
+                return True
+            time.sleep(0.05)
+        return False
+
+    # the bad arm's knobs let the candidate REACH the canary (lax gate,
+    # tolerant shadow), where its own SLO window carries an unreachable
+    # latency target — the burn, not the gate, must stop it
+    bad_cfg = DeliveryConfig(
+        shadow_fraction=1.0, shadow_min_samples=4,
+        shadow_max_disagreement=1.0,
+        canary_fractions=(canary_cap,), canary_min_requests=8,
+        canary_target=SLOTarget(availability=0.1, latency_ms=0.1,
+                                latency_target=0.9),
+        canary_window_s=30, stage_timeout_s=60.0)
+    good_cfg = DeliveryConfig(
+        shadow_fraction=1.0, shadow_min_samples=4,
+        canary_fractions=(canary_cap, 1.0), canary_min_requests=6,
+        canary_target=SLOTarget(availability=0.5, latency_ms=5000.0,
+                                latency_target=0.5),
+        canary_window_s=30, stage_timeout_s=60.0)
+
+    journal.enable(capacity=16384)
+    fleet = InProcFleet({"w0": a1, "w1": a1})
+    router = FleetRouter(fleet, probe_interval_s=0.05,
+                         hedge_initial_ms=5000.0)  # no hedging noise
+    port = router.start(0)
+    bad_rec = {"verdicts": [], "causes": [], "candidate_served": [],
+               "candidate_share": [], "requests": 0, "client_errors": 0,
+               "http_errors": 0, "incumbent_bit_identical": True}
+    good_rec = {"verdicts": [], "requests": 0, "client_errors": 0,
+                "http_errors": 0, "bit_identical": True}
+    deploys = []
+    incumbent, version = a1, 1
+    try:
+        assert wait_ready(router), "[delivery] fleet never became ready"
+        for rnd, order in enumerate((("bad", "good"), ("good", "bad"))):
+            for kind in order:
+                version += 1
+                archive = a_bad if kind == "bad" else a_good
+                report, outcomes = run_deploy(
+                    port, router, archive, version,
+                    bad_cfg if kind == "bad" else good_cfg)
+                deploys.append((kind, archive, version))
+                assert outcomes, f"[delivery] {kind} v{version}: no " \
+                                 f"traffic recorded"
+                errs = [o for o in outcomes if o[0] != "ok"]
+                assert not errs, (
+                    f"[delivery] {kind} v{version}: client-visible "
+                    f"failures {errs[:3]} ({len(errs)} total)")
+                cand = [o for o in outcomes if o[4] == version]
+                rest = [o for o in outcomes if o[4] != version]
+                for _, _, n, ofs, _, got in rest:
+                    assert any(np.array_equal(got, ref)
+                               for ref in oracle_out(n, ofs)), (
+                        f"[delivery] {kind} v{version}: incumbent "
+                        f"response (n={n}, ofs={ofs}) not bit-identical")
+                assert report["delivery"]["client_errors"] == 0, (
+                    f"[delivery] {kind} v{version}: controller saw "
+                    f"{report['delivery']['client_errors']} client "
+                    f"error(s)")
+                if kind == "bad":
+                    assert report["verdict"] == "rolled_back", (
+                        f"[delivery] bad v{version}: verdict "
+                        f"{report['verdict']!r}, want rolled_back")
+                    assert report["cause"] == "slo_latency_burn", (
+                        f"[delivery] bad v{version}: cause "
+                        f"{report['cause']!r}, want slo_latency_burn")
+                    # the canary REALLY exposed clients (min-evidence
+                    # picks), and the exposure stayed under the cap
+                    assert cand, (
+                        f"[delivery] bad v{version}: the canary never "
+                        f"served a client — the cap was not exercised")
+                    share = len(cand) / len(outcomes)
+                    assert share <= canary_cap + 1e-9, (
+                        f"[delivery] bad v{version}: candidate served "
+                        f"{share:.3f} of client traffic — over the "
+                        f"{canary_cap} canary cap")
+                    bad_rec["verdicts"].append(report["verdict"])
+                    bad_rec["causes"].append(report["cause"])
+                    bad_rec["candidate_served"].append(len(cand))
+                    bad_rec["candidate_share"].append(round(share, 4))
+                    bad_rec["requests"] += len(outcomes)
+                else:
+                    assert report["verdict"] == "promoted", (
+                        f"[delivery] good v{version}: verdict "
+                        f"{report['verdict']!r}, want promoted")
+                    for _, _, n, ofs, _, got in cand:
+                        assert any(np.array_equal(got, ref)
+                                   for ref in oracle_out(n, ofs)), (
+                            f"[delivery] good v{version}: candidate "
+                            f"response (n={n}, ofs={ofs}) not "
+                            f"bit-identical")
+                    incumbent = archive
+                    good_rec["verdicts"].append(report["verdict"])
+                    good_rec["requests"] += len(outcomes)
+                for wid in fleet.worker_ids():
+                    assert fleet.worker_archive(wid) == incumbent, (
+                        f"[delivery] {kind} v{version}: {wid} on "
+                        f"{fleet.worker_archive(wid)!r}, fleet should "
+                        f"be on {incumbent!r}")
+                assert wait_ready(router), (
+                    f"[delivery] fleet not ready after {kind} "
+                    f"v{version}")
+                log(f"[delivery] {kind} v{version}: "
+                    f"{report['verdict']}"
+                    + (f" ({report['cause']}, candidate served "
+                       f"{bad_rec['candidate_share'][-1]} of traffic, "
+                       f"cap {canary_cap})" if kind == "bad" else "")
+                    + f", 0/{len(outcomes)} client errors")
+
+        # ---- ONE bundle pull reconstructs the whole history ----------
+        data = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/debug/bundle",
+            timeout=60).read()
+        with tarfile.open(fileobj=io.BytesIO(data)) as tf:
+            events = json.load(tf.extractfile("journal.json"))["events"]
+        by_inc = {}
+        for e in events:
+            by_inc.setdefault(e["incarnation"], []).append(e["seq"])
+        gapless = all(
+            seqs == list(range(seqs[0], seqs[0] + len(seqs)))
+            for seqs in (sorted(s) for s in by_inc.values()))
+        assert gapless, "[delivery] seq gap inside an incarnation's " \
+                        "journal stream"
+        histories = {}
+        for kind, archive, ver in deploys:
+            stages = [e["attrs"]["stage"] for e in events
+                      if e["type"] == "delivery.stage"
+                      and e["attrs"].get("archive") == archive
+                      and e["attrs"].get("version") == ver]
+            histories[f"{kind}-v{ver}"] = stages
+            want_last = "rolled_back" if kind == "bad" else "promoted"
+            assert (stages[:1] == ["gate"] and "shadow" in stages
+                    and "canary" in stages
+                    and stages[-1] == want_last), (
+                f"[delivery] bundle stage history for {kind} v{ver} "
+                f"incomplete: {stages}")
+        rollbacks = sum(1 for e in events
+                        if e["type"] == "delivery.rollback")
+        promotes = sum(1 for e in events
+                       if e["type"] == "delivery.promote")
+        assert rollbacks == len(bad_rec["verdicts"]), (
+            f"[delivery] bundle records {rollbacks} rollback(s), want "
+            f"{len(bad_rec['verdicts'])}")
+        assert promotes == len(good_rec["verdicts"]), (
+            f"[delivery] bundle records {promotes} promote(s), want "
+            f"{len(good_rec['verdicts'])}")
+        gate_verdicts = [e["attrs"]["verdict"] for e in events
+                         if e["type"] == "delivery.gate"]
+        assert len(gate_verdicts) == len(deploys) and all(
+            v == "pass" for v in gate_verdicts), (
+            f"[delivery] bundle gate verdicts {gate_verdicts}, want "
+            f"{len(deploys)} passes")
+    finally:
+        router.stop()
+        fleet.stop()
+        shutil.rmtree(td, ignore_errors=True)
+
+    bad_rec["max_candidate_share"] = max(bad_rec["candidate_share"])
+    results = {
+        "rounds": 2,
+        "canary_cap": canary_cap,
+        "bad": bad_rec,
+        "good": good_rec,
+        "bundle": {"stage_histories": histories, "seq_gapless": True,
+                   "rollbacks": rollbacks, "promotes": promotes,
+                   "gate_passes": len(gate_verdicts)},
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    bench_extra = bench_extra or os.path.join(here, "BENCH_EXTRA.json")
+    try:
+        with open(bench_extra) as f:
+            extra = json.load(f)
+    except Exception:
+        extra = {}
+    extra["delivery"] = results
+    extra["delivery_max_bad_share"] = bad_rec["max_candidate_share"]
+    with open(bench_extra, "w") as f:
+        json.dump(extra, f, indent=2)
+    log(f"[delivery] OK: 2 bad deploys rolled back "
+        f"({set(bad_rec['causes'])}, max candidate share "
+        f"{bad_rec['max_candidate_share']} under the {canary_cap} cap), "
+        f"2 good deploys promoted, 0 client errors across "
+        f"{bad_rec['requests'] + good_rec['requests']} requests, full "
+        f"history from one bundle pull (seq-gapless)")
+    return 0
+
+
+def check_delivery_section(extra, failures, warnings):
+    """--check-tables coverage for the ISSUE 17 keys: the ``delivery``
+    section (when present) must record every bad deploy rolled back (by
+    SLO burn, having really exposed canary traffic) with the candidate's
+    served share under the declared canary cap, every good deploy
+    promoted, zero client errors and intact bit-identity on both arms,
+    a complete bundle-reconstructed stage history per deploy with
+    gapless seqs, and an agreeing top-level copy."""
+    if "delivery" not in extra:
+        warnings.append("delivery: not present in BENCH_EXTRA.json "
+                        "(bench --delivery not run?)")
+        return
+    d = extra["delivery"]
+    required = ["rounds", "canary_cap", "bad", "good", "bundle"]
+    for k in required:
+        if k not in d:
+            failures.append(f"delivery.{k}: missing from the recorded "
+                            f"section")
+    if any(k not in d for k in required):
+        return
+    try:
+        bad, good, bundle = d["bad"], d["good"], d["bundle"]
+        if not bad["verdicts"] or any(v != "rolled_back"
+                                      for v in bad["verdicts"]):
+            failures.append(f"delivery.bad.verdicts: {bad['verdicts']!r} "
+                            f"— every bad deploy must roll back")
+        if any(not c for c in bad["causes"]) or \
+                len(bad["causes"]) != len(bad["verdicts"]):
+            failures.append(f"delivery.bad.causes: {bad['causes']!r} — "
+                            f"every rollback must record its cause")
+        if any(n < 1 for n in bad["candidate_served"]):
+            failures.append(
+                "delivery.bad.candidate_served: a drill recorded 0 "
+                "canary responses — the blast-radius cap was never "
+                "exercised")
+        mx = max(bad["candidate_share"])
+        if mx > d["canary_cap"] + 1e-9:
+            failures.append(
+                f"delivery.bad.candidate_share: {mx} exceeds the "
+                f"{d['canary_cap']} canary cap — the bad candidate's "
+                f"blast radius was not bounded")
+        if abs(mx - bad["max_candidate_share"]) > 1e-9:
+            failures.append(
+                f"delivery.bad.max_candidate_share: claims "
+                f"{bad['max_candidate_share']}, recorded shares give "
+                f"{mx}")
+        if not good["verdicts"] or any(v != "promoted"
+                                       for v in good["verdicts"]):
+            failures.append(f"delivery.good.verdicts: "
+                            f"{good['verdicts']!r} — every good deploy "
+                            f"must promote")
+        for arm, rec in (("bad", bad), ("good", good)):
+            for k in ("client_errors", "http_errors"):
+                if rec.get(k) != 0:
+                    failures.append(f"delivery.{arm}.{k}: "
+                                    f"{rec.get(k)!r} (must be 0)")
+            if rec.get("requests", 0) <= 0:
+                failures.append(f"delivery.{arm}: no recorded traffic")
+        if bad.get("incumbent_bit_identical") is not True:
+            failures.append(
+                f"delivery.bad.incumbent_bit_identical: "
+                f"{bad.get('incumbent_bit_identical')!r}")
+        if good.get("bit_identical") is not True:
+            failures.append(f"delivery.good.bit_identical: "
+                            f"{good.get('bit_identical')!r}")
+        if bundle.get("seq_gapless") is not True:
+            failures.append(f"delivery.bundle.seq_gapless: "
+                            f"{bundle.get('seq_gapless')!r}")
+        if bundle.get("rollbacks") != len(bad["verdicts"]):
+            failures.append(
+                f"delivery.bundle.rollbacks: {bundle.get('rollbacks')!r}"
+                f" != {len(bad['verdicts'])} recorded bad deploys")
+        if bundle.get("promotes") != len(good["verdicts"]):
+            failures.append(
+                f"delivery.bundle.promotes: {bundle.get('promotes')!r} "
+                f"!= {len(good['verdicts'])} recorded good deploys")
+        hists = bundle.get("stage_histories") or {}
+        if len(hists) != len(bad["verdicts"]) + len(good["verdicts"]):
+            failures.append(
+                f"delivery.bundle.stage_histories: {len(hists)} "
+                f"histories for "
+                f"{len(bad['verdicts']) + len(good['verdicts'])} "
+                f"deploys")
+        for name, stages in hists.items():
+            want_last = ("rolled_back" if name.startswith("bad")
+                         else "promoted")
+            if not (stages[:1] == ["gate"] and "shadow" in stages
+                    and "canary" in stages and stages
+                    and stages[-1] == want_last):
+                failures.append(
+                    f"delivery.bundle.stage_histories[{name}]: "
+                    f"{stages!r} is not a complete "
+                    f"gate->shadow->canary->{want_last} history")
+        if extra.get("delivery_max_bad_share") != \
+                bad["max_candidate_share"]:
+            failures.append(
+                f"delivery_max_bad_share: top-level copy "
+                f"{extra.get('delivery_max_bad_share')} != delivery "
+                f"section {bad['max_candidate_share']}")
+    except (TypeError, ValueError, AttributeError, KeyError) as e:
+        failures.append(f"delivery: malformed section ({e!r})")
+
+
 def check_trace_section(extra, failures, warnings):
     """--check-tables coverage for the ISSUE 9 keys: the ``trace``
     section (when present) must carry both arms, the claimed overhead
@@ -5373,6 +5861,8 @@ if __name__ == "__main__":
         sys.exit(bench_blackbox())
     if "--sessions" in sys.argv:
         sys.exit(bench_sessions())
+    if "--delivery" in sys.argv:
+        sys.exit(bench_delivery())
     if "--serving" in sys.argv:
         # give the CPU backend multiple virtual devices so the replica arm
         # is real even off-TPU (flag only affects the host platform; must

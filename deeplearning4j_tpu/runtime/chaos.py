@@ -109,6 +109,8 @@ REGISTERED_POINTS: Dict[str, str] = {
     "serving.autoscale.lease": "LeaseElection, before every leader heartbeat",
     "serving.quantize.calibrate": "per calibration batch (call + CRC byte point)",
     "serving.quantize.gate": "top of the deploy_quantized accuracy-gate eval",
+    "serving.delivery.gate": "golden-set gate eval; also a byte point over the CRC-framed golden-set sidecar",
+    "serving.delivery.shadow": "shadow mirror launch; also a byte point over the mirrored response body",
     "train.checkpoint.write": "before each checkpoint archive write",
     "train.checkpoint.bytes": "byte point over the checkpoint archive bytes",
     "train.epoch": "supervised epoch worker, before net.fit",

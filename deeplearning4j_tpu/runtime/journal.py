@@ -101,6 +101,11 @@ EVENT_TYPES: Dict[str, str] = {
     "train.checkpoint": "a checkpoint archive written (atomic + manifested)",
     "train.resume": "a restarted trainer restored from a checkpoint",
     "train.restart": "supervised trainer counted a restart against its budget",
+    "delivery.gate": "a candidate's golden-set gate verdict (pass/fail/refused)",
+    "delivery.stage": "gated-delivery stage transition (shadow/canary/ramp/verdict)",
+    "delivery.shadow_stats": "shadow stage closed: mirror comparison stats + verdict",
+    "delivery.rollback": "gated delivery auto-rolled back to the incumbent (cause)",
+    "delivery.promote": "gated delivery promoted the candidate fleet-wide",
     "chaos.action": "a chaos policy acted (fault/latency/corruption injected)",
     "crash.report": "CrashReportingUtil wrote (or failed to write) a dump",
     "incident.open": "anomaly watchdog opened an incident (rule + evidence)",

@@ -91,6 +91,18 @@ The subsystem that puts traffic on this stack:
   rehydration, drain-by-migration across rolling deploys, and a
   fixed-bucket batched step path in the batcher that stays bit-identical
   to a serial ``rnn_time_step`` loop.
+- ``delivery.py`` (ISSUE 17, ``docs/fleet_serving.md`` "Gated
+  delivery") — staged promotion for every deploy:
+  :class:`GoldenGate`/:class:`GoldenSet` (the one golden-set gate —
+  ``AccuracyGate`` is its quantized face; CRC-framed per-archive
+  sidecars, corrupt = refused), :class:`ShadowComparator` (mirrored
+  traffic compared off-path, never client-visible),
+  :class:`DeliveryController` (shadow -> ramped canary under a
+  per-version SLO window -> promote | auto-rollback, every transition a
+  journal event), and :class:`FeedbackLog` (``POST /v1/feedback``
+  labels joined against the access log into an append-only
+  labeled-example file). Driven fleet-wide by
+  ``FleetRouter.rolling_deploy(strategy="gated")``.
 - :class:`WarmupManifest` (``manifest.py``) — persisted record of every
   compiled (bucket, replica, dtype) pair, written next to model archives
   and replayed by registry load / hot-swap so a restart reaches READY
@@ -151,6 +163,14 @@ _EXPORTS = {
     "WorkerSpec": "fleet",
     "Replica": "replica",
     "ReplicaPool": "replica",
+    "DeliveryConfig": "delivery",
+    "DeliveryController": "delivery",
+    "FeedbackLog": "delivery",
+    "GateFailed": "delivery",
+    "GateRefused": "delivery",
+    "GoldenGate": "delivery",
+    "GoldenSet": "delivery",
+    "ShadowComparator": "delivery",
     "AccuracyGate": "quantize",
     "AccuracyGateFailed": "quantize",
     "CalibrationError": "quantize",

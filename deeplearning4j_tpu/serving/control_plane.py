@@ -270,6 +270,12 @@ class FleetConfig:
     def routers(self) -> Dict[str, str]:
         return dict(self.snapshot()["routers"])
 
+    def deploy_state(self) -> Optional[Dict[str, Any]]:
+        """The last completed deploy's published record (archive,
+        version, strategy, router, action_id) — what a restarted router
+        reads to learn which artifact the fleet is supposed to run."""
+        return self.snapshot().get("deploy")
+
     # -------------------------------------------------------------- writes
     @contextmanager
     def _flock(self):

@@ -554,6 +554,12 @@ class FleetSupervisor:
     def worker_ids(self) -> List[str]:
         return sorted(self._handles)
 
+    def worker_archive(self, worker_id: str) -> str:
+        """The archive ``worker_id`` currently runs (its spec's view) —
+        what a gated deploy's rollback restores the canary onto."""
+        with self._lock:
+            return self._handles[worker_id].spec.archive
+
     def check(self) -> None:
         """Raise the stored escalation (restart budget exhausted), if any."""
         if self._failure is not None:

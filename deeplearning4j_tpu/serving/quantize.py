@@ -61,6 +61,7 @@ from typing import Any, Dict, List, Optional, Tuple, Union
 import numpy as np
 
 from deeplearning4j_tpu.runtime import chaos
+from deeplearning4j_tpu.serving import delivery
 from deeplearning4j_tpu.serving.manifest import atomic_replace
 
 ArrayOrDict = Union[np.ndarray, Dict[str, np.ndarray]]
@@ -90,13 +91,12 @@ class CalibrationError(RuntimeError):
     written."""
 
 
-class AccuracyGateFailed(RuntimeError):
+class AccuracyGateFailed(delivery.GateFailed):
     """A quantized deploy failed its declared accuracy gate; the previous
-    (f32) version keeps serving. ``report`` carries the measured deltas."""
-
-    def __init__(self, msg: str, report: Optional[Dict[str, Any]] = None):
-        super().__init__(msg)
-        self.report = report or {}
+    (f32) version keeps serving. ``report`` carries the measured deltas.
+    (Now a :class:`~deeplearning4j_tpu.serving.delivery.GateFailed`
+    subtype — the quantized gate is one face of the shared
+    :class:`~deeplearning4j_tpu.serving.delivery.GoldenGate`.)"""
 
 
 def policy_path(archive_path: str) -> str:
@@ -697,67 +697,16 @@ class QuantizedModel:
 
 
 # ========================================================= accuracy gate
-class AccuracyGate:
-    """The deploy bar: quantized accuracy may trail the f32 golden by at
-    most ``max_delta`` on the evaluation set, measured with the
-    ``evaluation/`` harness. With explicit ``labels`` the metric is plain
-    accuracy delta; without, labels default to the golden's own top-1
-    predictions, making the metric **top-1 agreement** (golden accuracy
-    1.0 by construction, delta = disagreement rate)."""
+class AccuracyGate(delivery.GoldenGate):
+    """The quantized-deploy bar, now THE ONE
+    :class:`~deeplearning4j_tpu.serving.delivery.GoldenGate`
+    implementation wearing its quantized face (ISSUE 17's "exactly one
+    gate" fix): quantized accuracy may trail the f32 golden by at most
+    ``max_delta`` on the evaluation set, the quantized model sees inputs
+    **through the policy's request quantization** (the real serving
+    path — int8 rows, in-graph dequant — handled by the base class via
+    ``dtype_policy``), and failure raises :class:`AccuracyGateFailed`
+    while the previous version keeps serving."""
 
-    def __init__(self, max_delta: float = 0.02,
-                 metric: str = "top1_agreement"):
-        self.max_delta = float(max_delta)
-        self.metric = metric
-
-    @staticmethod
-    def from_policy(policy: DtypePolicy) -> "AccuracyGate":
-        g = policy.gate or {}
-        return AccuracyGate(max_delta=float(g.get("max_delta", 0.02)),
-                            metric=str(g.get("metric", "top1_agreement")))
-
-    def check(self, golden, quantized: QuantizedModel, inputs,
-              labels=None) -> Dict[str, Any]:
-        """Evaluate both models and enforce the gate. The quantized model
-        sees ``inputs`` **through the policy's request quantization** —
-        the gate measures the real serving path (int8 rows, in-graph
-        dequant), not a flattering f32 one. Raises
-        :class:`AccuracyGateFailed` with the report attached on failure."""
-        from deeplearning4j_tpu.evaluation import Evaluation
-        chaos.inject("serving.quantize.gate")
-        policy = quantized.dtype_policy
-        graph_inputs = list(getattr(quantized.conf, "inputs", []) or [])
-
-        def run(model, x):
-            if graph_inputs:
-                if not isinstance(x, dict):
-                    x = {graph_inputs[0]: x}
-                out = model.output(*[x[n] for n in graph_inputs])
-                return np.asarray(out[0] if isinstance(out, list) else out)
-            return np.asarray(model.output(x))
-
-        golden_probs = run(golden, inputs)
-        if labels is None:
-            labels = golden_probs.argmax(-1)
-        labels = np.asarray(labels)
-        q_inputs = quantize_requests(inputs, policy)
-        quant_probs = run(quantized, q_inputs)
-        ev_g, ev_q = Evaluation(), Evaluation()
-        ev_g.eval(labels, golden_probs)
-        ev_q.eval(labels, quant_probs)
-        delta = ev_g.accuracy() - ev_q.accuracy()
-        report = {"metric": self.metric,
-                  "golden_accuracy": round(ev_g.accuracy(), 6),
-                  "quantized_accuracy": round(ev_q.accuracy(), 6),
-                  "accuracy_delta": round(float(delta), 6),
-                  "max_delta": self.max_delta,
-                  "n_examples": int(ev_g.total),
-                  "passed": bool(delta <= self.max_delta)}
-        if not report["passed"]:
-            raise AccuracyGateFailed(
-                f"quantized deploy failed its accuracy gate: delta "
-                f"{delta:.4f} > max_delta {self.max_delta} "
-                f"(golden {report['golden_accuracy']}, quantized "
-                f"{report['quantized_accuracy']} over "
-                f"{report['n_examples']} examples)", report)
-        return report
+    chaos_point = "serving.quantize.gate"
+    failure_exc = AccuracyGateFailed

@@ -55,6 +55,7 @@ import http.client
 import json
 import logging
 import os
+import struct
 import threading
 import time
 import uuid
@@ -103,7 +104,7 @@ class RouterMetrics:
     ``runtime.profiler.router_stats()``."""
 
     def __init__(self):
-        # guards: requests_total, responses_total, errors_total, forwards_total, hedges_total, hedge_wins_total, hedges_discarded_total, failovers_total, shed_skips_total, deploys_total, session_requests_total, session_migrations_total, request_latency, worker_requests
+        # guards: requests_total, responses_total, errors_total, forwards_total, hedges_total, hedge_wins_total, hedges_discarded_total, failovers_total, shed_skips_total, deploys_total, session_requests_total, session_migrations_total, shadow_mirrors_total, shadow_diverged_total, canary_requests_total, rollbacks_total, request_latency, worker_requests
         self._lock = threading.Lock()
         self.requests_total = 0
         self.session_requests_total = 0    # session-tier requests routed
@@ -117,6 +118,10 @@ class RouterMetrics:
         self.failovers_total = 0        # failed attempts retried elsewhere
         self.shed_skips_total = 0       # workers skipped inside Retry-After
         self.deploys_total = 0
+        self.shadow_mirrors_total = 0   # requests mirrored to a candidate
+        self.shadow_diverged_total = 0  # mirrors that disagreed/corrupted
+        self.canary_requests_total = 0  # requests pinned to a canary
+        self.rollbacks_total = 0        # gated deploys auto-rolled back
         self.request_latency = LatencyHistogram()
         self.worker_requests: Dict[str, int] = {}
 
@@ -153,6 +158,10 @@ class RouterMetrics:
                 "deploys_total": self.deploys_total,
                 "session_requests_total": self.session_requests_total,
                 "session_migrations_total": self.session_migrations_total,
+                "shadow_mirrors_total": self.shadow_mirrors_total,
+                "shadow_diverged_total": self.shadow_diverged_total,
+                "canary_requests_total": self.canary_requests_total,
+                "rollbacks_total": self.rollbacks_total,
                 "latency_p50_s": self.request_latency.percentile(50),
                 "latency_p99_s": self.request_latency.percentile(99),
                 "worker_requests": dict(self.worker_requests),
@@ -175,6 +184,10 @@ class RouterMetrics:
             f"router_session_requests_total {s['session_requests_total']}",
             f"router_session_migrations_total "
             f"{s['session_migrations_total']}",
+            f"router_shadow_mirrors_total {s['shadow_mirrors_total']}",
+            f"router_shadow_diverged_total {s['shadow_diverged_total']}",
+            f"router_canary_requests_total {s['canary_requests_total']}",
+            f"router_rollbacks_total {s['rollbacks_total']}",
             f'router_latency_seconds{{quantile="0.5"}} '
             f"{s['latency_p50_s']}",
             f'router_latency_seconds{{quantile="0.99"}} '
@@ -213,6 +226,10 @@ class WorkerView:
         self.breaker_warmed = False
         self.ready = False
         self.draining = False
+        #: a gated deploy's CANDIDATE (ISSUE 17): excluded from normal
+        #: admission — it receives only the traffic the active
+        #: DeliveryController assigns it (shadow mirrors, canary picks)
+        self.candidate = False
         self.shed_until = 0.0           # monotonic end of the shed window
         self.inflight = 0
         self.requests_total = 0
@@ -225,7 +242,8 @@ class WorkerView:
         """May new requests be routed here right now? (Half-open breaker
         probes are consumed at attempt time, not here.)"""
         now = time.monotonic() if now is None else now
-        return (self.ready and not self.draining and now >= self.shed_until
+        return (self.ready and not self.draining and not self.candidate
+                and now >= self.shed_until
                 and self.breaker.state is not CircuitState.OPEN)
 
     def shedding(self, now: Optional[float] = None) -> bool:
@@ -254,7 +272,8 @@ class WorkerView:
             requests_total = self.requests_total
             failures_total = self.failures_total
         return {"address": self.address, "ready": self.ready,
-                "draining": self.draining, "admittable": self.admittable(now),
+                "draining": self.draining, "candidate": self.candidate,
+                "admittable": self.admittable(now),
                 "shedding_ms": max(0.0, (self.shed_until - now) * 1000.0),
                 "inflight": inflight,
                 "requests_total": requests_total,
@@ -434,6 +453,11 @@ class FleetRouter:
         # ping-pongs between workers across router failover.
         self._session_pins: Dict[str, str] = {}
         self._pins_lock = threading.Lock()  # guards: _session_pins
+        # gated delivery (ISSUE 17): the active per-deploy controller the
+        # request path consults (shadow mirrors, canary picks), plus the
+        # last finished drill's report for /v1/delivery
+        self._delivery = None
+        self._last_delivery_report: Optional[Dict[str, Any]] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._prober: Optional[threading.Thread] = None
@@ -456,6 +480,7 @@ class FleetRouter:
                 elif view.address != addr:
                     fresh = WorkerView(wid, addr)
                     fresh.draining = view.draining
+                    fresh.candidate = view.candidate
                     self._views[wid] = fresh
             for wid in list(self._views):
                 if wid not in endpoints:
@@ -873,6 +898,16 @@ class FleetRouter:
                     else t_start + float(timeout_ms) / 1000.0)
         rid = inbound.get("X-Request-Id") or uuid.uuid4().hex
         ranked = self.ranked_workers(name)
+        # gated delivery (ISSUE 17): the candidate worker never competes
+        # for normal admission — it is pulled out of the ranking and
+        # receives exactly the traffic the controller assigns it
+        dc = self._delivery
+        cand_view = None
+        if dc is not None and dc.matches(name):
+            cand_view = next((v for v in ranked
+                              if v.worker_id == dc.candidate_worker), None)
+            ranked = [v for v in ranked
+                      if v.worker_id != dc.candidate_worker]
         tried: set = set()
         # the request's root span (ISSUE 9): attempt spans are its
         # children; the tail-sampling decision for the router's part of
@@ -916,6 +951,33 @@ class FleetRouter:
             if rsp.recording:
                 rsp.set("model", name)
                 rsp.set("request_id", rid)
+            if (cand_view is not None and cand_view.ready
+                    and dc.take_canary()):
+                # canary pick (ISSUE 17): one synchronous, NEVER-hedged
+                # attempt against the candidate. A 200 serves the client
+                # and feeds the canary's own SLO window; any failure is
+                # absorbed — the request falls through to the incumbent
+                # loop below, so the drill stays client-invisible.
+                self.metrics.record("canary_requests_total")
+                t_c = time.monotonic()
+                race = _Race(self.metrics)
+                race.register_launch()
+                self._forward(race, cand_view, name, raw, rid, deadline,
+                              hedged=False,
+                              span=(rsp.child("router.attempt")
+                                    if rsp.recording else trace.NOOP))
+                latency_c = time.monotonic() - t_c
+                win = race.winner
+                if win is not None and win.status == 200:
+                    dc.observe_canary(ok=True, latency_s=latency_c)
+                    if rsp.recording:
+                        rsp.event("canary", worker=cand_view.worker_id)
+                    return finish(win.status, win.headers, win.data)
+                dc.observe_canary(ok=False, latency_s=latency_c)
+                if rsp.recording:
+                    rsp.event("canary_absorbed",
+                              worker=cand_view.worker_id,
+                              status=None if win is None else win.status)
             while True:
                 now = time.monotonic()
                 if deadline is not None and now >= deadline:
@@ -987,6 +1049,15 @@ class FleetRouter:
                           else max(0.0, deadline - time.monotonic()))
                 if race.winner is not None:
                     win = race.winner
+                    if (cand_view is not None and win.status == 200
+                            and cand_view.ready and dc.take_shadow()):
+                        # shadow mirror (ISSUE 17): an async duplicate to
+                        # the candidate, compared off-path — it is never
+                        # returned, never hedged, and never feeds the
+                        # incumbents' breakers
+                        self._launch_shadow(dc, cand_view, name, raw, rid,
+                                            win.data,
+                                            time.monotonic() - t_start)
                     return finish(win.status, win.headers, win.data)
                 if race.finished < race.launched:
                     # deadline hit with attempts still in flight: their late
@@ -1004,6 +1075,52 @@ class FleetRouter:
                                       for a in race.failures])
                 if rsp.recording:
                     rsp.event("failover", failed_attempts=len(race.failures))
+
+    # ------------------------------------------------------ gated delivery
+    def _launch_shadow(self, dc, view: WorkerView, name: str, body: bytes,
+                       rid: str, incumbent_body: bytes,
+                       incumbent_latency_s: float) -> None:
+        """Mirror one already-served request to the candidate on a
+        detached thread. The comparison (top-1 agreement + latency
+        delta) folds into the controller's :class:`ShadowComparator`;
+        the response bytes ride through the ``serving.delivery.shadow``
+        byte point CRC-framed, so injected wire rot is detected — a
+        corrupt comparison counts against promotion, never silently
+        passes."""
+        self.metrics.record("shadow_mirrors_total")
+
+        def run():
+            t0 = time.monotonic()
+            status, data, corrupt = 0, b"", False
+            try:
+                chaos.inject("serving.delivery.shadow")
+                status, _, data = self._http(
+                    view.address, "POST", f"/v1/models/{name}/predict",
+                    body=body,
+                    headers={"Content-Type": "application/json",
+                             "X-Request-Id": rid, "X-Shadow": "1"},
+                    timeout=self.no_deadline_timeout_s)
+                framed = struct.pack("<I", zlib.crc32(data)) + data
+                out = chaos.transform_bytes("serving.delivery.shadow",
+                                            framed)
+                if out is not framed:
+                    if len(out) < 4:
+                        corrupt = True
+                    else:
+                        (crc,) = struct.unpack("<I", out[:4])
+                        data = out[4:]
+                        corrupt = zlib.crc32(data) != crc
+            except Exception:
+                status = 0  # a connection fault is a candidate error
+            diverged = dc.observe_shadow(
+                incumbent_body, status, data, incumbent_latency_s,
+                time.monotonic() - t0, corrupt=corrupt)
+            if diverged:
+                self.metrics.record("shadow_diverged_total")
+
+        threading.Thread(
+            target=run, daemon=True,
+            name=f"router-forward-shadow-{view.worker_id}").start()
 
     # --------------------------------------------------------- session tier
     def _publish_pin(self, key: str, wid: str) -> None:
@@ -1227,12 +1344,26 @@ class FleetRouter:
 
     def rolling_deploy(self, archive: str, version: Optional[int] = None,
                        drain_timeout_s: float = 30.0,
-                       ready_timeout_s: float = 120.0) -> Dict[str, Any]:
+                       ready_timeout_s: float = 120.0,
+                       strategy: str = "all",
+                       model: Optional[str] = None,
+                       golden_set=None, delivery_config=None,
+                       gate=None) -> Dict[str, Any]:
         """Zero-downtime deploy of ``archive`` across the fleet, one
         worker at a time: drain -> supervisor relaunch on the new archive
         (manifest-prewarmed) -> ``/readyz`` -> readmit. Requires a
         supervisor-backed fleet (``restart_worker``). Returns a per-worker
         report (ready wait, restarts).
+
+        ``strategy`` picks the drill (ISSUE 17): ``"all"`` is the classic
+        every-worker roll above; ``"gated"`` is the staged-promotion
+        pipeline — golden-set gate (cold, before any swap), one candidate
+        worker shadowing then canarying live traffic under its own SLO
+        window, fleet-wide roll only on a promote verdict, automatic
+        drain-back to the incumbent archive on any breach
+        (:meth:`_gated_deploy`; ``model`` is required, ``golden_set`` /
+        ``delivery_config`` / ``gate`` override the archive's sidecar
+        and the stock knobs).
 
         With a shared config attached (ISSUE 12) the deploy is
         IDEMPOTENT and config-versioned: the (archive, version) action is
@@ -1245,6 +1376,15 @@ class FleetRouter:
             raise TypeError(
                 "rolling_deploy needs a supervisor-backed fleet "
                 "(FleetSupervisor); a StaticFleet cannot relaunch workers")
+        if strategy == "gated":
+            return self._gated_deploy(
+                archive, version=version, model=model,
+                golden_set=golden_set, delivery_config=delivery_config,
+                gate=gate, drain_timeout_s=drain_timeout_s,
+                ready_timeout_s=ready_timeout_s)
+        if strategy != "all":
+            raise ValueError(f"unknown deploy strategy {strategy!r} "
+                             f"(expected 'all' or 'gated')")
         # the FULL path keys the claim: two different artifacts that
         # happen to share a filename must be two different actions
         action_id = (f"rolling_deploy:{os.path.abspath(archive)}"
@@ -1279,37 +1419,11 @@ class FleetRouter:
                           if hasattr(self._fleet, "worker_ids")
                           else sorted(self.workers()))
             for wid in worker_ids:
-                if wid in self.workers():
-                    self.drain(wid, timeout_s=drain_timeout_s)
-                    # session fence (ISSUE 16): push every resident carry
-                    # to its spill file BEFORE the kill, so the sessions
-                    # this worker holds migrate (rehydrate elsewhere)
-                    # instead of losing steps. Best-effort: a worker
-                    # without a session store 404s, a dead one refuses.
-                    view = self.workers().get(wid)
-                    if view is not None:
-                        try:
-                            self._http(view.address, "POST",
-                                       "/v1/sessions/drain", body=b"{}",
-                                       headers={"Content-Type":
-                                                "application/json"},
-                                       timeout=drain_timeout_s)
-                        except Exception:
-                            logger.info("session spill fence skipped for "
-                                        "%s (unreachable)", wid)
-                    journal.emit("control.deploy_stage", stage="drained",
-                                 worker=wid, archive=archive)
-                try:
-                    self._fleet.restart_worker(wid, archive=archive,
-                                               version=version)
-                    ready_s = self.await_ready(wid,
-                                               timeout_s=ready_timeout_s)
-                finally:
-                    self.readmit(wid)
-                journal.emit("control.deploy_stage", stage="readmitted",
-                             worker=wid, archive=archive,
-                             ready_s=round(ready_s, 3))
-                report["workers"][wid] = {"ready_s": round(ready_s, 3)}
+                # drain -> session fence (ISSUE 16: resident carries are
+                # pushed to their spill files BEFORE the kill, so sessions
+                # migrate instead of losing steps) -> relaunch -> readmit
+                self._roll_worker(wid, archive, version,
+                                  drain_timeout_s, ready_timeout_s, report)
         except BaseException:
             # a failed deploy must RELEASE its claim, or its own retry
             # (from any router) is skipped forever as "already applied"
@@ -1329,6 +1443,7 @@ class FleetRouter:
             try:
                 def fn(cfg):
                     cfg["deploy"] = {"archive": archive, "version": version,
+                                     "strategy": "all",
                                      "router": self.router_id,
                                      "action_id": action_id,
                                      "completed_at": time.time()}
@@ -1336,6 +1451,233 @@ class FleetRouter:
             except Exception:
                 logger.exception("deploy-state publication failed")
         return report
+
+    def _roll_worker(self, wid: str, archive: str, version,
+                     drain_timeout_s: float, ready_timeout_s: float,
+                     report: Dict[str, Any]) -> None:
+        """One worker's classic roll step (drain -> session fence ->
+        relaunch on ``archive`` -> ready -> readmit), shared by both
+        deploy strategies."""
+        if wid in self.workers():
+            self.drain(wid, timeout_s=drain_timeout_s)
+            view = self.workers().get(wid)
+            if view is not None:
+                try:
+                    self._http(view.address, "POST", "/v1/sessions/drain",
+                               body=b"{}",
+                               headers={"Content-Type": "application/json"},
+                               timeout=drain_timeout_s)
+                except Exception:
+                    logger.info("session spill fence skipped for %s "
+                                "(unreachable)", wid)
+            journal.emit("control.deploy_stage", stage="drained",
+                         worker=wid, archive=archive)
+        try:
+            self._fleet.restart_worker(wid, archive=archive,
+                                       version=version)
+            ready_s = self.await_ready(wid, timeout_s=ready_timeout_s)
+        finally:
+            self.readmit(wid)
+        journal.emit("control.deploy_stage", stage="readmitted",
+                     worker=wid, archive=archive,
+                     ready_s=round(ready_s, 3))
+        report["workers"][wid] = {"ready_s": round(ready_s, 3)}
+
+    def _gated_deploy(self, archive: str, version=None,
+                      model: Optional[str] = None, golden_set=None,
+                      delivery_config=None, gate=None,
+                      drain_timeout_s: float = 30.0,
+                      ready_timeout_s: float = 120.0) -> Dict[str, Any]:
+        """The ``strategy="gated"`` pipeline (ISSUE 17,
+        ``docs/fleet_serving.md``): golden-set gate (candidate loaded
+        COLD through a real batcher, golden side answered by the live
+        incumbents through this router — before any worker is touched),
+        then one candidate worker earning traffic through shadow and
+        ramped canary stages under its own SLO window, then either a
+        fleet-wide roll (promote) or an automatic drain-back to the
+        incumbent archive (rollback — returned as a ``rolled_back``
+        report, not raised: a rollback is the pipeline WORKING). Gate
+        failure raises; the incumbent never stops serving either way."""
+        from deeplearning4j_tpu.serving import delivery as dmod
+        import numpy as np
+        if model is None:
+            raise TypeError("gated deploy needs the model name the "
+                            "archive serves (model=...)")
+        if not hasattr(self._fleet, "worker_archive"):
+            raise TypeError(
+                "gated deploy needs a fleet exposing worker_archive() — "
+                "rollback must know the incumbent artifact to restore")
+        action_id = f"gated_deploy:{os.path.abspath(archive)}:v{version}"
+        if self._config is not None:
+            if not self._config.try_claim(
+                    action_id, {"router": self.router_id,
+                                "archive": archive, "version": version,
+                                "strategy": "gated"}):
+                applied = self._config.applied(action_id)
+                logger.info("gated deploy %s already applied by %s; "
+                            "skipping", action_id,
+                            (applied or {}).get("router"))
+                journal.emit("control.deploy_stage", stage="skipped",
+                             archive=archive, version=version,
+                             applied_by=(applied or {}).get("router"))
+                return {"archive": archive, "version": version,
+                        "skipped": True, "action_id": action_id,
+                        "applied_by": applied}
+            journal.emit("control.deploy_stage", stage="claimed",
+                         archive=archive, version=version,
+                         router=self.router_id, strategy="gated")
+        dc = None
+        try:
+            # ---- stage 1: golden-set gate, before any swap -------------
+            try:
+                gs = golden_set or dmod.GoldenSet.for_archive(archive)
+                if gs is None:
+                    raise dmod.GateRefused(
+                        f"gated deploy of {archive!r} has no golden set: "
+                        f"declare one per-archive "
+                        f"({dmod.GoldenSet.sidecar(archive)!r}) or pass "
+                        f"golden_set= — an ungated swap is refused")
+            except dmod.GateFailed as e:
+                # a sidecar that cannot be trusted is a verdict too
+                journal.emit("delivery.gate", model=model, archive=archive,
+                             version=version, verdict="refused",
+                             report=getattr(e, "report", {}))
+                raise
+            g = gs.gate(default=gate)
+
+            def golden_fn(x):
+                raw = json.dumps(
+                    {"inputs": np.asarray(x).tolist()}).encode()
+                status, _, data = self._route_predict(model, raw, {})
+                if status != 200:
+                    raise dmod.GateRefused(
+                        f"golden side unavailable (incumbent fleet "
+                        f"answered {status}) — the gate cannot run; "
+                        f"deploy refused")
+                return np.asarray(json.loads(data.decode())["outputs"])
+
+            from deeplearning4j_tpu.serving.registry import ModelRegistry
+            cold = ModelRegistry()
+            try:
+                served = cold.load(model, archive, save_manifest=False)
+                report_g = g.check(
+                    None, None, gs.inputs, labels=gs.labels,
+                    golden_fn=golden_fn,
+                    candidate_fn=lambda x: np.asarray(served.predict(x)))
+            except dmod.GateFailed as e:
+                journal.emit(
+                    "delivery.gate", model=model, archive=archive,
+                    version=version,
+                    verdict=("refused" if isinstance(e, dmod.GateRefused)
+                             else "fail"),
+                    report=getattr(e, "report", {}))
+                raise
+            finally:
+                try:
+                    cold.shutdown()
+                except Exception:
+                    pass
+            journal.emit("delivery.gate", model=model, archive=archive,
+                         version=version, verdict="pass", report=report_g)
+
+            # ---- stage 2+3: one candidate worker, shadow then canary ---
+            prewarm = getattr(self._fleet, "prewarm_manifest", None)
+            if prewarm is not None:
+                prewarm(archive)
+            report: Dict[str, Any] = {"archive": archive,
+                                      "version": version,
+                                      "strategy": "gated",
+                                      "action_id": action_id,
+                                      "workers": {}}
+            worker_ids = sorted(self._fleet.worker_ids())
+            cand_wid = worker_ids[0]
+            incumbent_archive = self._fleet.worker_archive(cand_wid)
+            dc = dmod.DeliveryController(
+                model, archive, version, cand_wid,
+                config=delivery_config, gate_report=report_g)
+            # flag BEFORE the roll: _sync_views carries the flag across
+            # the restart's address change and _roll_worker's readmit
+            # then cannot hand the unproven candidate full traffic
+            cv = self.workers().get(cand_wid)
+            if cv is not None:
+                cv.candidate = True
+            self._roll_worker(cand_wid, archive, version,
+                              drain_timeout_s, ready_timeout_s, report)
+            cand_view = self.workers().get(cand_wid)
+            if cand_view is not None:
+                cand_view.candidate = True
+            dc.transition("shadow")
+            self._delivery = dc
+            while not dc.decided:
+                dc.tick()
+                time.sleep(0.005)
+
+            if dc.stage == "promote_ready":
+                # ---- promote: candidate joins, the rest roll ----------
+                self._delivery = None
+                if cand_view is not None:
+                    cand_view.candidate = False
+                for wid in worker_ids[1:]:
+                    self._roll_worker(wid, archive, version,
+                                      drain_timeout_s, ready_timeout_s,
+                                      report)
+                dc.finish_promoted()
+                self.metrics.record("deploys_total")
+                journal.emit("control.deploy_stage", stage="completed",
+                             archive=archive, version=version,
+                             strategy="gated",
+                             workers=sorted(report["workers"]))
+                if self._config is not None:
+                    try:
+                        def fn(cfg):
+                            cfg["deploy"] = {
+                                "archive": archive, "version": version,
+                                "strategy": "gated",
+                                "router": self.router_id,
+                                "action_id": action_id,
+                                "completed_at": time.time()}
+                        self._config.mutate(fn)
+                    except Exception:
+                        logger.exception("deploy-state publication failed")
+                report["verdict"] = "promoted"
+                report["delivery"] = dc.snapshot()
+                return report
+
+            # ---- rollback: drain the canary back to the incumbent -----
+            # (a successful DEFENSE, reported not raised: the claim is
+            # released so a fixed candidate can retry the same action)
+            self._delivery = None
+            self._roll_worker(cand_wid, incumbent_archive, None,
+                              drain_timeout_s, ready_timeout_s, report)
+            cand_view = self.workers().get(cand_wid)
+            if cand_view is not None:
+                cand_view.candidate = False
+            dc.finish_rolled_back()
+            self.metrics.record("rollbacks_total")
+            if self._config is not None:
+                try:
+                    self._config.release_claim(action_id)
+                except Exception:
+                    logger.exception("claim rollback failed for %s",
+                                     action_id)
+            report["verdict"] = "rolled_back"
+            report["cause"] = dc.rollback_cause
+            report["delivery"] = dc.snapshot()
+            return report
+        except BaseException:
+            self._delivery = None
+            for v in self.workers().values():
+                v.candidate = False
+            if self._config is not None:
+                try:
+                    self._config.release_claim(action_id)
+                except Exception:
+                    logger.exception("claim rollback failed for %s",
+                                     action_id)
+            raise
+        finally:
+            if dc is not None:
+                self._last_delivery_report = dc.snapshot()
 
     # ------------------------------------------- fleet scrape + trace merge
     def _fanout(self, fn, views, timeout_s: float,
@@ -1782,6 +2124,16 @@ class FleetRouter:
             # the autoscaler consumes, fleet-wide by construction
             return 200, {"windows_s": list(self.slo.windows_s),
                          "slo": self.slo.report()}
+        if path == "/v1/delivery":
+            # the gated-delivery drill's live view (ISSUE 17): the active
+            # controller's stage/stats, else the last finished verdict
+            dc = self._delivery
+            if dc is not None:
+                return 200, {"active": True, "delivery": dc.snapshot()}
+            if self._last_delivery_report is not None:
+                return 200, {"active": False,
+                             "delivery": self._last_delivery_report}
+            return 404, {"error": "no gated delivery has run here"}
         if path == "/v1/capacity":
             # fleet-wide capacity aggregation (sums + merged histograms)
             return 200, self.fleet_capacity()
@@ -1923,6 +2275,15 @@ class FleetRouter:
                         return
                     code, headers, data = router._route_session(
                         "POST", self.path, name, sid, op, raw, self.headers)
+                elif self.path == "/v1/feedback":
+                    # the flywheel's label intake (ISSUE 17): joined
+                    # against the access log wherever it lives — the
+                    # router accepts labels even when workers wrote the
+                    # log, as long as they share the log file
+                    from deeplearning4j_tpu.serving import delivery
+                    code, obj = delivery.handle_feedback(raw)
+                    headers = {"Content-Type": "application/json"}
+                    data = json.dumps(obj).encode()
                 else:
                     code, headers, data = 404, {
                         "Content-Type": "application/json"}, json.dumps(

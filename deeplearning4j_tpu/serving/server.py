@@ -829,6 +829,12 @@ class ModelServer:
             parts.append(self._render_sessions())
         # the black box's ring health (ISSUE 15): journal_* gauges
         parts.append(journal.render_prometheus().rstrip("\n"))
+        # the flywheel's label-join counters (ISSUE 17)
+        from deeplearning4j_tpu.serving import delivery
+        fb = delivery.feedback_counters()
+        parts.append(
+            f"serving_feedback_joined_total {fb['joined_total']}\n"
+            f"serving_feedback_orphaned_total {fb['orphaned_total']}")
         return "\n".join(parts) + "\n"
 
     @staticmethod
@@ -945,6 +951,13 @@ class ModelServer:
                                                f"{self.path!r}"}, {})
                 elif self.path == "/v1/sessions/drain":
                     code, obj, extra = srv._handle_sessions_drain(raw)
+                elif self.path == "/v1/feedback":
+                    # label intake (ISSUE 17): a client grades an answer
+                    # by trace id; the label joins the access log into
+                    # the append-only labeled-example file
+                    from deeplearning4j_tpu.serving import delivery
+                    code, obj = delivery.handle_feedback(raw)
+                    extra = {}
                 else:
                     code, obj, extra = (404,
                                         {"error": f"unknown path "

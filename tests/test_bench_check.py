@@ -1128,3 +1128,141 @@ def test_check_tables_sessions_absent_is_warning(tmp_path):
     msgs = []
     assert bench.check_tables(str(md), str(extra), log=msgs.append) == 0
     assert any("sessions" in m and "WARN" in m for m in msgs)
+
+
+def _delivery_section():
+    """A self-consistent BENCH_EXTRA.json["delivery"] section (the
+    ISSUE 17 gated-delivery drill record)."""
+    return {
+        "rounds": 2,
+        "canary_cap": 0.25,
+        "bad": {
+            "verdicts": ["rolled_back", "rolled_back"],
+            "causes": ["slo_latency_burn", "slo_latency_burn"],
+            "candidate_served": [4, 5],
+            "candidate_share": [0.006, 0.0056],
+            "max_candidate_share": 0.006,
+            "requests": 1391,
+            "client_errors": 0,
+            "http_errors": 0,
+            "incumbent_bit_identical": True,
+        },
+        "good": {
+            "verdicts": ["promoted", "promoted"],
+            "requests": 1501,
+            "client_errors": 0,
+            "http_errors": 0,
+            "bit_identical": True,
+        },
+        "bundle": {
+            "stage_histories": {
+                "bad-v2": ["gate", "shadow", "canary",
+                           "rollback_pending", "rolled_back"],
+                "good-v3": ["gate", "shadow", "canary", "canary_ramp",
+                            "promote_ready", "promoted"],
+                "good-v4": ["gate", "shadow", "canary", "canary_ramp",
+                            "promote_ready", "promoted"],
+                "bad-v5": ["gate", "shadow", "canary",
+                           "rollback_pending", "rolled_back"],
+            },
+            "seq_gapless": True,
+            "rollbacks": 2,
+            "promotes": 2,
+            "gate_passes": 4,
+        },
+    }
+
+
+def _extra_with_delivery(section):
+    measured = {k: _mid(*rng) for k, rng in bench.RECORDED_RANGES.items()}
+    measured["delivery"] = section
+    measured["delivery_max_bad_share"] = \
+        section["bad"]["max_candidate_share"]
+    return measured
+
+
+def test_check_tables_validates_delivery_section(tmp_path):
+    """ISSUE 17 satellite: --check-tables covers the gated-delivery
+    keys — a self-consistent drill record passes; a bad deploy that did
+    not roll back, a candidate share over the canary cap (or a stale
+    max), a canary that never served, client errors, broken
+    bit-identity, a good deploy that did not promote, a gappy journal,
+    a bundle whose rollback/promote counts or stage histories disagree
+    with the recorded deploys, a missing key, or a stale top-level copy
+    all fail loudly."""
+    md = tmp_path / "BASELINE.md"
+    md.write_text(_table_md(bench.RECORDED_RANGES))
+    extra = tmp_path / "BENCH_EXTRA.json"
+
+    extra.write_text(json.dumps(_extra_with_delivery(_delivery_section())))
+    assert bench.check_tables(str(md), str(extra), log=lambda *a: None) == 0
+
+    def failing(mutate, needle):
+        sec = _delivery_section()
+        mutate(sec)
+        extra.write_text(json.dumps(_extra_with_delivery(sec)))
+        msgs = []
+        assert bench.check_tables(str(md), str(extra),
+                                  log=msgs.append) == 1, needle
+        assert any(needle in m for m in msgs), (needle, msgs)
+
+    failing(lambda s: s["bad"].update(verdicts=["rolled_back",
+                                                "promoted"]),
+            "every bad deploy must roll back")
+    failing(lambda s: s["bad"].update(causes=["slo_latency_burn", ""]),
+            "must record its cause")
+    failing(lambda s: s["bad"].update(candidate_served=[4, 0]),
+            "never exercised")
+    failing(lambda s: s["bad"].update(candidate_share=[0.4, 0.0056],
+                                      max_candidate_share=0.4),
+            "exceeds the 0.25 canary cap")
+    failing(lambda s: s["bad"].update(max_candidate_share=0.001),
+            "recorded shares give")
+    failing(lambda s: s["good"].update(verdicts=["promoted",
+                                                 "rolled_back"]),
+            "every good deploy must promote")
+    failing(lambda s: s["bad"].update(client_errors=3), "must be 0")
+    failing(lambda s: s["good"].update(http_errors=1), "must be 0")
+    failing(lambda s: s["bad"].update(requests=0), "no recorded traffic")
+    failing(lambda s: s["bad"].update(incumbent_bit_identical=False),
+            "incumbent_bit_identical")
+    failing(lambda s: s["good"].update(bit_identical=False),
+            "delivery.good.bit_identical")
+    failing(lambda s: s["bundle"].update(seq_gapless=False),
+            "seq_gapless")
+    failing(lambda s: s["bundle"].update(rollbacks=1),
+            "recorded bad deploys")
+    failing(lambda s: s["bundle"].update(promotes=3),
+            "recorded good deploys")
+    failing(lambda s: s["bundle"]["stage_histories"].pop("bad-v2"),
+            "histories for")
+    failing(lambda s: s["bundle"]["stage_histories"].update(
+        {"bad-v2": ["gate", "shadow", "rolled_back"]}),
+            "not a complete")
+    failing(lambda s: s["bundle"]["stage_histories"].update(
+        {"good-v3": ["gate", "shadow", "canary", "canary_ramp",
+                     "promote_ready"]}),
+            "not a complete")
+    failing(lambda s: s.pop("bundle"), "missing from the recorded")
+
+    # stale top-level copy
+    ex = _extra_with_delivery(_delivery_section())
+    ex["delivery_max_bad_share"] = 0.2
+    extra.write_text(json.dumps(ex))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("delivery_max_bad_share: top-level copy" in m
+               for m in msgs)
+
+
+def test_check_tables_delivery_absent_is_warning(tmp_path):
+    """No --delivery run recorded yet -> warn, don't fail (same contract
+    as the other optional sections)."""
+    md = tmp_path / "BASELINE.md"
+    md.write_text(_table_md(bench.RECORDED_RANGES))
+    measured = {k: _mid(*rng) for k, rng in bench.RECORDED_RANGES.items()}
+    extra = tmp_path / "BENCH_EXTRA.json"
+    extra.write_text(json.dumps(measured))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 0
+    assert any("delivery" in m and "WARN" in m for m in msgs)

@@ -1,0 +1,821 @@
+"""Gated continuous delivery drills (ISSUE 17): golden-set gates,
+shadow traffic, ramped canaries, SLO auto-rollback, and the feedback
+flywheel.
+
+Three layers:
+
+- **Unit** — the :class:`GoldenSet` CRC sidecar (corrupt = refused, never
+  silently passed), the :class:`ShadowComparator` verdict table, the
+  :class:`DeliveryController` state machine on a fake clock, the shared
+  gate lineage (``AccuracyGate`` IS a ``GoldenGate``), and the
+  ``/v1/feedback`` access-log join.
+- **In-process fleet** — a real :class:`FleetRouter` over in-process
+  ``ModelServer`` workers behind a supervisor duck-type, running the
+  full ``rolling_deploy(strategy="gated")`` pipeline: a failed gate
+  leaves the incumbent serving, a wrong-output candidate is caught in
+  shadow, seeded latency chaos trips the canary's SLO window, corrupt
+  golden sets and corrupt shadow comparisons refuse loudly, the deploy
+  is idempotent through the shared-config claim ledger, and the whole
+  history reconstructs from the journal with gapless seqs. The zero
+  client-visible-error contract holds across every rollback.
+- **Subprocess fleet** (slow) — the production topology: a bad candidate
+  under live traffic rolls back, the fixed candidate promotes.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.runtime import journal, trace
+from deeplearning4j_tpu.runtime.chaos import (AddLatency, ChaosController,
+                                              CorruptBytes)
+from deeplearning4j_tpu.serving import ModelRegistry, ModelServer
+from deeplearning4j_tpu.serving.delivery import (DeliveryConfig,
+                                                 DeliveryController,
+                                                 FeedbackLog, GateFailed,
+                                                 GateRefused, GoldenGate,
+                                                 GoldenSet, ShadowComparator,
+                                                 feedback_counters,
+                                                 handle_feedback)
+from deeplearning4j_tpu.serving.router import FleetRouter
+from deeplearning4j_tpu.serving.slo import SLOTarget
+
+
+def _conf(seed=7):
+    return (NeuralNetConfiguration.builder().seed(seed).updater(None)
+            .list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=4, activation="softmax"))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+
+
+RNG = np.random.default_rng(0)
+X = RNG.normal(size=(16, 8)).astype(np.float32)
+BATCHER_KW = dict(max_batch_size=4, buckets=[1, 4], batch_timeout_ms=1.0,
+                  pipeline_depth=0)
+
+
+def _post(port, name="m", n=2, timeout_ms=10000, headers=None, ofs=0):
+    body = json.dumps({"inputs": X[ofs:ofs + n].tolist(),
+                       "timeout_ms": timeout_ms}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/models/{name}/predict", data=body,
+        headers=headers or {})
+    resp = urllib.request.urlopen(req, timeout=30)
+    return resp.status, dict(resp.getheaders()), json.loads(resp.read())
+
+
+def _wait_until(pred, timeout_s=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def _rolled_params(net):
+    """The class-permuted twin of ``net``: every output-layer leaf
+    (last dim = n_classes) rolled by one class, so the twin's top-1 is
+    ``(golden_top1 + 1) % 4`` on EVERY input — guaranteed, deterministic
+    total disagreement (the worst deployable candidate)."""
+    import jax
+    return jax.tree.map(
+        lambda a: np.roll(np.asarray(a), 1, -1) if a.shape[-1] == 4 else a,
+        net.params())
+
+
+@pytest.fixture(scope="module")
+def archives(tmp_path_factory):
+    """v1/v2 archives with identical weights (bit-identity must hold
+    across a promote), plus the pathological candidate whose top-1
+    disagrees with the incumbent on every input. v2 and the bad archive
+    carry golden-set sidecars (v2's strict, the bad one's declared bar
+    nothing could fail — the gate the shadow stage exists to back up)."""
+    td = tmp_path_factory.mktemp("delivery")
+    a1, a2 = str(td / "model-v1.zip"), str(td / "model-v2.zip")
+    abad = str(td / "model-bad.zip")
+    oracle = MultiLayerNetwork(_conf()).init()
+    oracle.save(a1)
+    MultiLayerNetwork(_conf()).init().save(a2)  # same seed -> same weights
+    bad = MultiLayerNetwork(_conf()).init()
+    bad.set_params(_rolled_params(oracle))
+    bad.save(abad)
+    GoldenSet(X[:4]).save(GoldenSet.sidecar(a2))
+    GoldenSet(X[:4], max_delta=1.0).save(GoldenSet.sidecar(abad))
+    return {"a1": a1, "a2": a2, "abad": abad, "oracle": oracle}
+
+
+def _oracle_out(oracle, n, ofs=0):
+    outs = []
+    for bucket in (b for b in BATCHER_KW["buckets"] if b >= n):
+        padded = np.concatenate(
+            [X[ofs:ofs + n],
+             np.zeros((bucket - n, X.shape[1]), X.dtype)], axis=0)
+        outs.append(np.asarray(oracle.output(padded))[:n])
+    return outs
+
+
+# ==========================================================================
+# unit: golden set sidecar + gate lineage
+def test_golden_set_sidecar_roundtrip_and_declared_bar(tmp_path):
+    path = str(tmp_path / "m.zip.golden")
+    gs = GoldenSet(X[:4], labels=[0, 1, 2, 3], max_delta=0.5,
+                   metric="accuracy")
+    gs.save(path)
+    back = GoldenSet.load(path)
+    assert np.array_equal(back.inputs, X[:4])
+    assert back.labels.tolist() == [0, 1, 2, 3]
+    # the sidecar's declared bar overrides the stock gate
+    g = back.gate()
+    assert g.max_delta == 0.5 and g.metric == "accuracy"
+    # ...but an explicit default fills only the UNdeclared knobs
+    g2 = GoldenSet(X[:4]).gate(default=GoldenGate(max_delta=0.25))
+    assert g2.max_delta == 0.25
+    # no sidecar -> None (the caller decides whether ungated is legal)
+    assert GoldenSet.for_archive(str(tmp_path / "other.zip")) is None
+
+
+def test_corrupt_or_truncated_golden_set_is_refused_never_passed(tmp_path):
+    path = str(tmp_path / "m.zip.golden")
+    GoldenSet(X[:4]).save(path)
+    with ChaosController(seed=3) as c:
+        c.on("serving.delivery.gate", CorruptBytes(n_bytes=8, mode="flip"))
+        with pytest.raises(GateRefused):
+            GoldenSet.load(path)
+        assert any(ev[0] == "serving.delivery.gate" for ev in c.events)
+    with ChaosController(seed=5) as c:
+        c.on("serving.delivery.gate", CorruptBytes(mode="truncate"))
+        with pytest.raises(GateRefused):
+            GoldenSet.load(path)
+    # a sidecar truncated below its CRC header on disk is refused too
+    with open(path, "wb") as f:
+        f.write(b"\x01")
+    with pytest.raises(GateRefused):
+        GoldenSet.load(path)
+    # GateRefused IS a GateFailed: every refusal path fails closed
+    assert issubclass(GateRefused, GateFailed)
+    # and a clean sidecar still loads after the chaos scopes closed
+    GoldenSet(X[:4]).save(path)
+    assert GoldenSet.load(path).inputs.shape == (4, 8)
+
+
+def test_accuracy_gate_is_the_golden_gate(archives):
+    """Exactly one gate implementation (ISSUE 17): ``deploy_quantized``'s
+    AccuracyGate is a GoldenGate re-pointed at its own chaos point."""
+    from deeplearning4j_tpu.serving.quantize import (AccuracyGate,
+                                                     AccuracyGateFailed)
+    assert issubclass(AccuracyGate, GoldenGate)
+    assert issubclass(AccuracyGateFailed, GateFailed)
+    assert AccuracyGate.check is GoldenGate.check  # shared, not copied
+    assert AccuracyGate.chaos_point == "serving.quantize.gate"
+    assert GoldenGate.chaos_point == "serving.delivery.gate"
+    # the shared bar passes a bit-identical candidate and fails the
+    # class-rolled twin with the same report schema either way
+    oracle = archives["oracle"]
+    twin = MultiLayerNetwork(_conf()).init()
+    report = GoldenGate(max_delta=0.0).check(oracle, twin, X[:8])
+    assert report["passed"] and report["n_examples"] == 8
+    assert report["quantized_accuracy"] == report["candidate_accuracy"]
+    bad = MultiLayerNetwork(_conf()).init()
+    bad.set_params(_rolled_params(oracle))
+    with pytest.raises(GateFailed) as ei:
+        GoldenGate(max_delta=0.0).check(oracle, bad, X[:8])
+    assert ei.value.report["accuracy_delta"] == 1.0  # disagrees everywhere
+
+
+# ==========================================================================
+# unit: shadow comparator + controller state machine (fake clock)
+def _body(cls=1):
+    out = [[0.0] * 4]
+    out[0][cls] = 1.0
+    return json.dumps({"outputs": out}).encode()
+
+
+def test_shadow_comparator_verdict_table():
+    # agreement accrues to a pass only once min_samples compared
+    s = ShadowComparator(max_disagreement=0.0, min_samples=3)
+    assert s.verdict() is None
+    for _ in range(3):
+        assert not s.observe(_body(1), 200, _body(1), 0.01, 0.02)
+    assert s.verdict() == "pass"
+    snap = s.snapshot()
+    assert snap["compared_total"] == 3 and snap["disagreement_rate"] == 0.0
+    assert snap["latency_delta_ms"] == pytest.approx(10.0, abs=1.0)
+    # one top-1 disagreement over a zero-tolerance bar refuses
+    s = ShadowComparator(max_disagreement=0.0, min_samples=2)
+    assert not s.observe(_body(1), 200, _body(1), 0.01, 0.01)
+    assert s.observe(_body(1), 200, _body(2), 0.01, 0.01)
+    assert s.verdict() == "shadow_divergence"
+    # a candidate error refuses IMMEDIATELY (no averaging away)
+    s = ShadowComparator(min_samples=100)
+    s.observe(_body(1), 500, b"", 0.01, 0.01)
+    assert s.verdict() == "shadow_candidate_errors"
+    # an untrustable (corrupt) comparison refuses immediately too
+    s = ShadowComparator(min_samples=100)
+    assert s.observe(_body(1), 200, _body(1), 0.01, 0.01, corrupt=True)
+    assert s.verdict() == "shadow_corrupt"
+    # an unparsable candidate body counts as corrupt, not as agreement
+    s = ShadowComparator(min_samples=1)
+    assert s.observe(_body(1), 200, b"not json", 0.01, 0.01)
+    assert s.verdict() == "shadow_corrupt"
+
+
+def _fake_clock():
+    t = [1000.0]
+
+    def now():
+        return t[0]
+    return t, now
+
+
+def _controller(**cfg_kw):
+    t, now = _fake_clock()
+    base = dict(shadow_fraction=1.0, shadow_min_samples=2,
+                canary_fractions=(0.5, 1.0), canary_min_requests=4,
+                canary_target=SLOTarget(availability=0.5, latency_ms=100.0,
+                                        latency_target=0.5),
+                canary_window_s=300, stage_timeout_s=60.0, now_fn=now)
+    base.update(cfg_kw)
+    dc = DeliveryController("m", "model-v2.zip", 2, "w0",
+                            config=DeliveryConfig(**base))
+    return t, dc
+
+
+def test_controller_promotes_through_ramped_canary_and_journals(
+        ):
+    j = journal.enable(capacity=2048)
+    t, dc = _controller()
+    dc.transition("shadow")
+    assert dc.take_shadow()  # fraction 1.0
+    assert not dc.take_canary()  # wrong stage
+    for _ in range(2):
+        dc.observe_shadow(_body(1), 200, _body(1), 0.01, 0.01)
+    assert dc.tick() == "canary"
+    assert dc.canary_fraction() == 0.5
+    for _ in range(4):
+        dc.observe_canary(ok=True, latency_s=0.005)
+        t[0] += 0.01
+    assert dc.tick() is None  # ramp, not a verdict
+    assert dc.ramp_index == 1 and dc.canary_fraction() == 1.0
+    for _ in range(4):
+        dc.observe_canary(ok=True, latency_s=0.005)
+    assert dc.tick() == "promote_ready"
+    assert dc.decided
+    dc.finish_promoted()
+    assert [h["stage"] for h in dc.history] == [
+        "gate", "shadow", "canary", "canary_ramp", "promote_ready",
+        "promoted"]
+    # every transition is a typed journal event on THIS deploy's archive
+    stages = [e["attrs"]["stage"] for e in j.events()
+              if e["type"] == "delivery.stage"
+              and e["attrs"]["archive"] == "model-v2.zip"]
+    assert stages == [h["stage"] for h in dc.history]
+    shadow_stats = [e for e in j.events() if e["type"]
+                    == "delivery.shadow_stats"]
+    assert shadow_stats and shadow_stats[-1]["attrs"]["verdict"] == "pass"
+    promo = [e for e in j.events() if e["type"] == "delivery.promote"]
+    assert promo and promo[-1]["attrs"]["client_errors"] == 0
+
+
+def test_controller_rolls_back_on_availability_burn_and_on_timeouts():
+    # availability burn: every canary response failing blows the burn
+    # limit at min_evidence, long before the step's request quota
+    t, dc = _controller()
+    dc.transition("shadow")
+    for _ in range(2):
+        dc.observe_shadow(_body(1), 200, _body(1), 0.01, 0.01)
+    assert dc.tick() == "canary"
+    for _ in range(4):
+        dc.observe_canary(ok=False, latency_s=0.005)
+    assert dc.tick() == "rollback_pending"
+    assert dc.rollback_cause == "slo_availability_burn"
+    dc.finish_rolled_back()
+    assert dc.stage == "rolled_back"
+    # latency burn: all-slow canaries breach the latency window
+    t, dc = _controller()
+    dc.transition("shadow")
+    for _ in range(2):
+        dc.observe_shadow(_body(1), 200, _body(1), 0.01, 0.01)
+    dc.tick()
+    for _ in range(4):
+        dc.observe_canary(ok=True, latency_s=5.0)  # >> 100ms target
+    assert dc.tick() == "rollback_pending"
+    assert dc.rollback_cause == "slo_latency_burn"
+    # shadow stage that never accrues evidence times out to a rollback
+    t, dc = _controller(stage_timeout_s=5.0)
+    dc.transition("shadow")
+    t[0] += 6.0
+    assert dc.tick() == "rollback_pending"
+    assert dc.rollback_cause == "shadow_timeout"
+    # canary stage starved of traffic times out to a rollback as well
+    t, dc = _controller(stage_timeout_s=5.0)
+    dc.transition("shadow")
+    for _ in range(2):
+        dc.observe_shadow(_body(1), 200, _body(1), 0.01, 0.01)
+    dc.tick()
+    t[0] += 6.0
+    assert dc.tick() == "rollback_pending"
+    assert dc.rollback_cause == "canary_timeout"
+
+
+# ==========================================================================
+# unit: the feedback flywheel (/v1/feedback access-log join)
+def test_feedback_joins_access_log_and_counts_orphans(tmp_path,
+                                                      monkeypatch):
+    access = str(tmp_path / "access.log")
+    out = str(tmp_path / "labeled.jsonl")
+    with open(access, "w") as f:
+        f.write(json.dumps({"log": "dl4j_tpu_access", "trace_id": "t-1",
+                            "model": "m", "worker": "w0", "outcome": 200,
+                            "latency_ms": 3.2}) + "\n")
+    monkeypatch.setenv("DL4J_TPU_ACCESS_LOG", access)
+    monkeypatch.delenv("DL4J_TPU_FEEDBACK_FILE", raising=False)
+    before = feedback_counters()
+    log = FeedbackLog(access_log_path=access, out_path=out)
+    ex = log.record("t-1", label=3)
+    assert ex["model"] == "m" and ex["label"] == 3 and ex["feedback"]
+    assert "log" not in ex  # the labeled file is examples, not log lines
+    assert log.record("t-unknown", label=1) is None  # orphan: not written
+    with open(out) as f:
+        lines = [json.loads(ln) for ln in f.read().splitlines()]
+    assert len(lines) == 1 and lines[0]["trace_id"] == "t-1"
+    after = feedback_counters()
+    assert after["joined_total"] == before["joined_total"] + 1
+    assert after["orphaned_total"] == before["orphaned_total"] + 1
+    # the HTTP handler's contract: 400 malformed, 202 orphan, 200 joined
+    monkeypatch.setenv("DL4J_TPU_FEEDBACK_FILE", out)
+    assert handle_feedback(b"not json")[0] == 400
+    assert handle_feedback(b'{"label": 1}')[0] == 400  # no trace_id
+    assert handle_feedback(b'{"trace_id": "t-1"}')[0] == 400  # no label
+    status, obj = handle_feedback(
+        json.dumps({"trace_id": "t-nope", "score": 0.5}).encode())
+    assert status == 202 and obj["joined"] is False
+    status, obj = handle_feedback(
+        json.dumps({"trace_id": "t-1", "score": 0.9}).encode())
+    assert status == 200 and obj["joined"] is True
+    assert obj["example"]["score"] == 0.9
+    # a rotated-away line is still joinable through the keep-1 rollover
+    os.replace(access, access + ".1")
+    with open(access, "w") as f:
+        f.write("")
+    assert FeedbackLog(access_log_path=access,
+                       out_path=out).record("t-1", label=2) is not None
+
+
+def test_feedback_http_route_joins_a_real_served_request(tmp_path,
+                                                         monkeypatch):
+    """End-to-end flywheel: serve a prediction with the access log on,
+    read its trace id off the response, POST /v1/feedback, and find the
+    labeled example (label + serving context) in the output file."""
+    access = str(tmp_path / "access.log")
+    out = str(tmp_path / "labeled.jsonl")
+    monkeypatch.setenv("DL4J_TPU_ACCESS_LOG", access)
+    monkeypatch.setenv("DL4J_TPU_FEEDBACK_FILE", out)
+    trace.enable(rate=1.0, capacity=64, seed=1)
+    reg = ModelRegistry()
+    reg.register("m", MultiLayerNetwork(_conf()).init(),
+                 warmup_example=X[:1], **BATCHER_KW)
+    srv = ModelServer(reg, worker_id="w-fb")
+    port = srv.start(0)
+    try:
+        status, headers, _ = _post(port, n=1)
+        assert status == 200
+        tid = headers.get("X-Trace-Id")
+        assert tid
+        assert _wait_until(lambda: os.path.exists(access), timeout_s=5)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/feedback",
+            data=json.dumps({"trace_id": tid, "label": 2}).encode(),
+            headers={"Content-Type": "application/json"})
+        resp = urllib.request.urlopen(req, timeout=30)
+        obj = json.loads(resp.read())
+        assert resp.status == 200 and obj["joined"] is True
+        assert obj["example"]["model"] == "m"
+        assert obj["example"]["worker"] == "w-fb"
+        assert obj["example"]["label"] == 2
+        with open(out) as f:
+            assert any(json.loads(ln)["trace_id"] == tid
+                       for ln in f.read().splitlines())
+        # the feedback counters render on the worker's /metrics
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30).read().decode()
+        assert "serving_feedback_joined_total" in text
+        assert "serving_feedback_orphaned_total" in text
+    finally:
+        srv.stop(shutdown_registry=True)
+        trace.disable()
+
+
+# ==========================================================================
+# in-process fleet: the full gated pipeline
+class _InProcFleet:
+    """Supervisor duck-type over in-process ``ModelServer`` workers:
+    ``endpoints`` / ``worker_ids`` / ``restart_worker`` /
+    ``worker_archive`` — everything ``strategy="gated"`` needs, without
+    subprocess launch cost. ``restart_worker`` really does tear the
+    worker down and rebuild it from the archive (a new registry, a new
+    port), so drain/readmit/await_ready run against real state."""
+
+    def __init__(self, archives_by_wid):
+        self._lock = threading.Lock()  # guards: _workers
+        self._workers = {}
+        self.restarts = []
+        for wid, archive in archives_by_wid.items():
+            self._launch(wid, archive, 1)
+
+    def _launch(self, wid, archive, version):
+        reg = ModelRegistry()
+        reg.load("m", archive, warmup_example=X[:1], save_manifest=False,
+                 version=version, **BATCHER_KW)
+        srv = ModelServer(reg, worker_id=wid)
+        port = srv.start(0)
+        with self._lock:
+            self._workers[wid] = {"server": srv, "archive": archive,
+                                  "address": f"127.0.0.1:{port}"}
+
+    def endpoints(self):
+        with self._lock:
+            return {w: s["address"] for w, s in self._workers.items()}
+
+    def worker_ids(self):
+        with self._lock:
+            return list(self._workers)
+
+    def worker_archive(self, wid):
+        with self._lock:
+            return self._workers[wid]["archive"]
+
+    def restart_worker(self, wid, archive=None, version=None):
+        with self._lock:
+            old = self._workers[wid]
+        old["server"].stop(shutdown_registry=True)
+        self.restarts.append((wid, archive))
+        self._launch(wid, archive or old["archive"], version)
+
+    def stop(self):
+        with self._lock:
+            workers = list(self._workers.values())
+        for s in workers:
+            s["server"].stop(shutdown_registry=True)
+
+
+@pytest.fixture
+def gated_fleet(archives):
+    fleet = _InProcFleet({"w0": archives["a1"], "w1": archives["a1"]})
+    router = FleetRouter(fleet, probe_interval_s=0.05,
+                         hedge_initial_ms=5000.0)  # no hedging noise
+    port = router.start(0)
+    assert _wait_until(
+        lambda: sum(v.ready for v in router.workers().values()) == 2)
+    try:
+        yield fleet, router, port
+    finally:
+        router.stop()
+        fleet.stop()
+
+
+class _Load:
+    """Closed-loop client threads; every outcome recorded explicitly."""
+
+    def __init__(self, port, n_threads=3):
+        self.port = port
+        self.outcomes = []
+        self.lock = threading.Lock()
+        self._stop = threading.Event()
+        self.threads = [threading.Thread(target=self._run, args=(i,),
+                                         daemon=True)
+                        for i in range(n_threads)]
+
+    def _run(self, tid):
+        k = 0
+        while not self._stop.is_set():
+            n, ofs = 1 + (tid + k) % 4, (3 * k + tid) % 8
+            try:
+                status, _, out = _post(self.port, n=n, ofs=ofs)
+                rec = ("ok", status, n, ofs,
+                       np.asarray(out["outputs"], np.float32))
+            except urllib.error.HTTPError as e:
+                rec = ("http_error", e.code, n, ofs, None)
+            except Exception as e:
+                rec = ("error", type(e).__name__, n, ofs, None)
+            with self.lock:
+                self.outcomes.append(rec)
+            k += 1
+            time.sleep(0.01)
+
+    def __enter__(self):
+        for t in self.threads:
+            t.start()
+        return self
+
+    def __exit__(self, *a):
+        self._stop.set()
+        for t in self.threads:
+            t.join(timeout=30)
+
+
+def _assert_all_ok_and_exact(outcomes, oracle):
+    assert outcomes, "load generator produced no traffic"
+    bad = [o for o in outcomes if o[0] != "ok"]
+    assert not bad, f"client-visible failures: {bad[:5]} ({len(bad)} total)"
+    cache = {}
+    for _, _, n, ofs, got in outcomes:
+        if (n, ofs) not in cache:
+            cache[(n, ofs)] = _oracle_out(oracle, n, ofs)
+        assert any(np.array_equal(got, ref) for ref in cache[(n, ofs)]), \
+            f"response for (n={n}, ofs={ofs}) not bit-identical"
+
+
+def _fast_delivery(**kw):
+    base = dict(shadow_fraction=1.0, shadow_min_samples=4,
+                canary_fractions=(0.5, 1.0), canary_min_requests=6,
+                canary_target=SLOTarget(availability=0.5,
+                                        latency_ms=5000.0,
+                                        latency_target=0.5),
+                canary_window_s=30, stage_timeout_s=60.0)
+    base.update(kw)
+    return DeliveryConfig(**base)
+
+
+def test_failed_and_refused_gates_leave_the_incumbent_serving(
+        gated_fleet, archives):
+    fleet, router, port = gated_fleet
+    journal.enable(capacity=2048)
+    # a corrupted golden-set sidecar refuses the deploy before ANY swap
+    with ChaosController(seed=3) as c:
+        c.on("serving.delivery.gate", CorruptBytes(n_bytes=8, mode="flip"))
+        with pytest.raises(GateRefused):
+            router.rolling_deploy(archives["a2"], version=2,
+                                  strategy="gated", model="m")
+    assert fleet.restarts == []  # no worker was touched
+    # the class-rolled candidate fails a strict golden gate cold
+    with pytest.raises(GateFailed) as ei:
+        router.rolling_deploy(archives["abad"], version=2, strategy="gated",
+                              model="m",
+                              golden_set=GoldenSet(X[:4], max_delta=0.0))
+    assert ei.value.report["accuracy_delta"] == 1.0
+    assert fleet.restarts == []
+    assert fleet.worker_archive("w0") == archives["a1"]
+    assert fleet.worker_archive("w1") == archives["a1"]
+    # both verdicts journaled; the incumbent still serves bit-identically
+    verdicts = [e["attrs"]["verdict"] for e in journal.events(
+        types={"delivery.gate"})]
+    assert verdicts[-2:] == ["refused", "fail"]
+    status, _, out = _post(port, n=2)
+    assert status == 200
+    got = np.asarray(out["outputs"], np.float32)
+    assert any(np.array_equal(got, ref)
+               for ref in _oracle_out(archives["oracle"], 2))
+
+
+def test_gated_promote_is_idempotent_and_reconstructs_from_journal(
+        gated_fleet, archives, tmp_path):
+    from deeplearning4j_tpu.serving.control_plane import FleetConfig
+    fleet, router, port = gated_fleet
+    cfg = FleetConfig(str(tmp_path / "fleet.json"))
+    router.attach_config(cfg)
+    j = journal.enable(capacity=4096)
+    with _Load(port) as load:
+        time.sleep(0.2)
+        report = router.rolling_deploy(
+            archives["a2"], version=2, strategy="gated", model="m",
+            delivery_config=_fast_delivery())
+        time.sleep(0.3)
+    assert report["verdict"] == "promoted"
+    assert report["delivery"]["client_errors"] == 0
+    # the whole fleet rolled to v2; bit-identity held across the drill
+    assert fleet.worker_archive("w0") == archives["a2"]
+    assert fleet.worker_archive("w1") == archives["a2"]
+    _assert_all_ok_and_exact(load.outcomes, archives["oracle"])
+    # canary traffic really flowed, shadow mirrors really compared
+    snap = router.metrics.snapshot()
+    assert snap["shadow_mirrors_total"] >= 4
+    assert snap["canary_requests_total"] >= 12
+    assert snap["shadow_diverged_total"] == 0
+    # full pipeline reconstructs from the journal: gate pass -> shadow ->
+    # canary (ramped) -> promote_ready -> promoted -> delivery.promote
+    gate = [e for e in j.events(types={"delivery.gate"})
+            if e["attrs"]["archive"] == archives["a2"]]
+    assert gate and gate[-1]["attrs"]["verdict"] == "pass"
+    assert gate[-1]["attrs"]["report"]["passed"]
+    stages = [e["attrs"]["stage"] for e in j.events(
+        types={"delivery.stage"})
+        if e["attrs"]["archive"] == archives["a2"]]
+    assert stages[0] == "gate" and stages[-1] == "promoted"
+    assert stages.index("shadow") < stages.index("canary")
+    assert "canary_ramp" in stages and "promote_ready" in stages
+    assert "rollback_pending" not in stages
+    assert j.events(types={"delivery.promote"})
+    assert not j.events(types={"delivery.rollback"})
+    # seq-gapless: the ring's live window is dense (nothing dropped)
+    seqs = [e["seq"] for e in j.events()]
+    assert seqs == list(range(min(seqs), max(seqs) + 1))
+    # the deploy state is published for every router to see
+    assert cfg.snapshot()["deploy"]["strategy"] == "gated"
+    # idempotent: the same action re-issued is claimed already ->
+    # skipped, and NO worker is restarted a second time
+    restarts_before = list(fleet.restarts)
+    report2 = router.rolling_deploy(
+        archives["a2"], version=2, strategy="gated", model="m",
+        delivery_config=_fast_delivery())
+    assert report2.get("skipped") is True
+    assert fleet.restarts == restarts_before
+    # the verdict is queryable after the fact
+    code, obj = router._handle_get("/v1/delivery")
+    assert code == 200 and obj["active"] is False
+    assert obj["delivery"]["stage"] == "promoted"
+
+
+@pytest.mark.slow
+def test_shadow_divergence_rolls_back_with_zero_client_errors(
+        gated_fleet, archives):
+    """The backstop drill: a wrong-output candidate whose own declared
+    golden bar is too lax to fail it (max_delta=1.0 sidecar) reaches the
+    shadow stage — where mirrored live traffic catches the divergence
+    and the deploy drains back to the incumbent. No client ever sees a
+    candidate response."""
+    fleet, router, port = gated_fleet
+    j = journal.enable(capacity=4096)
+    with _Load(port) as load:
+        time.sleep(0.2)
+        report = router.rolling_deploy(
+            archives["abad"], version=2, strategy="gated", model="m",
+            delivery_config=_fast_delivery())
+        time.sleep(0.3)
+    assert report["verdict"] == "rolled_back"
+    assert report["cause"] == "shadow_divergence"
+    assert report["delivery"]["client_errors"] == 0
+    assert report["delivery"]["shadow"]["disagreed_total"] >= 1
+    # the canary worker is back on the incumbent archive
+    assert fleet.worker_archive("w0") == archives["a1"]
+    assert fleet.worker_archive("w1") == archives["a1"]
+    # the bad candidate never served a client: all responses are the
+    # incumbent's, bit-identical to the oracle
+    _assert_all_ok_and_exact(load.outcomes, archives["oracle"])
+    assert router.metrics.snapshot()["shadow_diverged_total"] >= 1
+    assert router.metrics.snapshot()["rollbacks_total"] >= 1
+    # rollback history reconstructs from the journal
+    rb = [e for e in j.events(types={"delivery.rollback"})
+          if e["attrs"]["archive"] == archives["abad"]]
+    assert rb and rb[-1]["attrs"]["cause"] == "shadow_divergence"
+    assert rb[-1]["attrs"]["client_errors"] == 0
+    stages = [e["attrs"]["stage"] for e in j.events(
+        types={"delivery.stage"})
+        if e["attrs"]["archive"] == archives["abad"]]
+    assert "rollback_pending" in stages and stages[-1] == "rolled_back"
+    assert "canary" not in stages  # caught BEFORE any client exposure
+    seqs = [e["seq"] for e in j.events()]
+    assert seqs == list(range(min(seqs), max(seqs) + 1))
+    # after the rollback the same (fixed) action is retryable: the gate
+    # verdict for the incumbent-identical v2 archive still passes
+    code, obj = router._handle_get("/v1/delivery")
+    assert code == 200 and obj["active"] is False
+    assert obj["delivery"]["stage"] == "rolled_back"
+
+
+def test_canary_slo_burn_rolls_back_under_latency_chaos(gated_fleet,
+                                                        archives):
+    """Seeded latency chaos on the serve path + a 10ms canary latency
+    target: the candidate's own SLO window burns, the canary drains back
+    to the incumbent, and no client sees an error."""
+    fleet, router, port = gated_fleet
+    j = journal.enable(capacity=4096)
+    cfg = _fast_delivery(
+        canary_target=SLOTarget(availability=0.5, latency_ms=10.0,
+                                latency_target=0.9))
+    with _Load(port) as load:
+        time.sleep(0.2)
+        with ChaosController(seed=11) as c:
+            c.on("serving.worker.predict", AddLatency(0.05))
+            report = router.rolling_deploy(
+                archives["a2"], version=2, strategy="gated", model="m",
+                delivery_config=cfg)
+        time.sleep(0.3)
+    assert report["verdict"] == "rolled_back"
+    assert report["cause"] == "slo_latency_burn"
+    assert report["delivery"]["client_errors"] == 0
+    assert fleet.worker_archive("w0") == archives["a1"]
+    # slow is not wrong: every client response stayed OK + bit-identical
+    _assert_all_ok_and_exact(load.outcomes, archives["oracle"])
+    rb = j.events(types={"delivery.rollback"})
+    assert rb and rb[-1]["attrs"]["cause"] == "slo_latency_burn"
+    stages = [e["attrs"]["stage"] for e in j.events(
+        types={"delivery.stage"})
+        if e["attrs"]["archive"] == archives["a2"]]
+    assert "canary" in stages  # the breach was caught IN canary
+    assert stages[-1] == "rolled_back"
+
+
+@pytest.mark.slow
+def test_corrupt_shadow_comparison_refuses_promotion(gated_fleet,
+                                                     archives):
+    """Wire rot on the mirror path (the ``serving.delivery.shadow`` byte
+    point corrupting the CRC-framed mirrored response) must refuse the
+    promotion of even a PERFECT candidate: a comparison that cannot be
+    trusted is treated as a failed comparison, loudly."""
+    fleet, router, port = gated_fleet
+    j = journal.enable(capacity=4096)
+    with _Load(port) as load:
+        time.sleep(0.2)
+        with ChaosController(seed=7) as c:
+            c.on("serving.delivery.shadow",
+                 CorruptBytes(n_bytes=8, mode="flip"))
+            report = router.rolling_deploy(
+                archives["a2"], version=2, strategy="gated", model="m",
+                delivery_config=_fast_delivery())
+        time.sleep(0.3)
+    assert report["verdict"] == "rolled_back"
+    assert report["cause"] == "shadow_corrupt"
+    assert report["delivery"]["shadow"]["corrupt_total"] >= 1
+    assert report["delivery"]["client_errors"] == 0
+    assert fleet.worker_archive("w0") == archives["a1"]
+    _assert_all_ok_and_exact(load.outcomes, archives["oracle"])
+    ss = [e for e in j.events(types={"delivery.shadow_stats"})
+          if e["attrs"]["archive"] == archives["a2"]]
+    assert ss and ss[-1]["attrs"]["verdict"] == "shadow_corrupt"
+
+
+# ==========================================================================
+# subprocess fleet: the production topology (slow tier)
+@pytest.mark.slow
+def test_gated_delivery_subprocess_fleet_bad_then_good_candidate(
+        tmp_path):
+    """The full production drill: a supervised subprocess fleet under
+    live closed-loop traffic. The wrong-output candidate (lax declared
+    bar) is caught in shadow and rolled back; the fixed candidate then
+    promotes fleet-wide. Zero client-visible errors, every response
+    bit-identical to the oracle, both verdicts in the journal."""
+    from deeplearning4j_tpu.runtime.environment import get_environment
+    from deeplearning4j_tpu.serving.fleet import FleetSupervisor, WorkerSpec
+
+    a1 = str(tmp_path / "model-v1.zip")
+    a2 = str(tmp_path / "model-v2.zip")
+    abad = str(tmp_path / "model-bad.zip")
+    cache = str(tmp_path / "executable-cache")
+    oracle_net = MultiLayerNetwork(_conf()).init()
+    oracle_net.save(a1)
+    MultiLayerNetwork(_conf()).init().save(a2)
+    bad = MultiLayerNetwork(_conf()).init()
+    bad.set_params(_rolled_params(oracle_net))
+    bad.save(abad)
+    GoldenSet(X[:4]).save(GoldenSet.sidecar(a2))
+    GoldenSet(X[:4], max_delta=1.0).save(GoldenSet.sidecar(abad))
+    get_environment().set_compile_cache(cache)
+    reg = ModelRegistry()
+    reg.load("m", a1, warmup_example=X[:1], **BATCHER_KW)
+    oracle = reg.get("m").model
+    reg.shutdown()
+    j = journal.enable(capacity=8192)
+    sig = {"__single__": {"shape_tail": [8], "dtype": "float32"}}
+    specs = [WorkerSpec(worker_id=f"w{i}", model_name="m", archive=a1,
+                        version=1, batcher_kw=dict(BATCHER_KW),
+                        cache_dir=cache, warmup_signature=sig)
+             for i in range(2)]
+    sup = FleetSupervisor(specs, run_dir=str(tmp_path / "run"),
+                          max_restarts=6, heartbeat_timeout_s=60.0).start()
+    router = FleetRouter(sup, probe_interval_s=0.1,
+                         hedge_initial_ms=5000.0)
+    port = router.start(0)
+    try:
+        assert _wait_until(lambda: len(sup.endpoints()) == 2, timeout_s=90)
+        assert _wait_until(
+            lambda: sum(v.ready for v in router.workers().values()) == 2,
+            timeout_s=90)
+        cfg = _fast_delivery(stage_timeout_s=120.0)
+        with _Load(port) as load:
+            time.sleep(0.5)
+            bad_report = router.rolling_deploy(
+                abad, version=2, strategy="gated", model="m",
+                delivery_config=cfg, ready_timeout_s=120)
+            good_report = router.rolling_deploy(
+                a2, version=2, strategy="gated", model="m",
+                delivery_config=cfg, ready_timeout_s=120)
+            time.sleep(0.5)
+        assert bad_report["verdict"] == "rolled_back"
+        assert bad_report["cause"] == "shadow_divergence"
+        assert good_report["verdict"] == "promoted"
+        assert sup.worker_archive("w0") == a2
+        assert sup.worker_archive("w1") == a2
+        # the zero-error contract held across rollback AND promote,
+        # and bit-identity held (same seed -> same weights for v2)
+        _assert_all_ok_and_exact(load.outcomes, oracle)
+        assert bad_report["delivery"]["client_errors"] == 0
+        assert good_report["delivery"]["client_errors"] == 0
+        causes = [e["attrs"]["cause"]
+                  for e in j.events(types={"delivery.rollback"})]
+        assert "shadow_divergence" in causes
+        assert j.events(types={"delivery.promote"})
+    finally:
+        router.stop()
+        sup.stop()
