@@ -265,6 +265,12 @@ def check_tables(baseline_md=None, bench_extra=None, log=_log):
     if measured is not None:
         check_delivery_section(measured, failures, warnings)
 
+    # ISSUE 18 wire keys: all arms bit-identical, recomputable >= 3x
+    # speedup, keepalive satellite speedup, an actual idle-fraction
+    # reduction, zero protocol errors in the clean arms, top-level copy
+    if measured is not None:
+        check_wire_section(measured, failures, warnings)
+
     for w in warnings:
         log(f"[check-tables] WARN {w}")
     for fmsg in failures:
@@ -5436,6 +5442,267 @@ def check_trace_section(extra, failures, warnings):
         failures.append(f"trace: malformed section ({e!r})")
 
 
+def bench_wire(n_threads=4, per_thread=20, rows=4, feat=4096,
+               bench_extra=None, log=_log):
+    """``bench.py --wire`` (ISSUE 18): the routed transport A/B.
+
+    One wire-enabled worker behind a FleetRouter, driven through
+    ``MultiRouterClient`` with three order-alternated arms at identical
+    wide-f32 payloads (rows x ``feat`` floats, big enough that the
+    binary hop rides the shared-memory fast path):
+
+    - ``json``            fresh TCP connection per request + JSON bodies
+                          (exactly the pre-18 path — the baseline the
+                          0.38-0.41 idle fraction was recorded on)
+    - ``json_keepalive``  the same JSON marshalling over pooled
+                          connections (the satellite arm: isolates the
+                          TCP-setup tax from the marshalling tax)
+    - ``binary``          CRC-framed ndarray payloads, pooled
+                          connections, zero-copy worker ingest, shm hop
+
+    Contract asserted BEFORE the section is written: binary >= 3x json
+    qps at bit-identical responses, zero wire protocol errors in every
+    (clean) arm, and a measured ``device_idle_fraction`` reduction vs
+    the JSON baseline — the headline metric of the PR."""
+    import threading
+
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.serving import ModelRegistry, ModelServer, wire
+    from deeplearning4j_tpu.serving.control_plane import MultiRouterClient
+    from deeplearning4j_tpu.serving.router import FleetRouter, StaticFleet
+
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(None).list()
+            .layer(DenseLayer(n_out=64, activation="tanh"))
+            .layer(OutputLayer(n_out=8, activation="softmax"))
+            .set_input_type(InputType.feed_forward(feat))
+            .build())
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((n_threads * rows, feat)).astype(np.float32)
+
+    reg = ModelRegistry()
+    reg.register("m", MultiLayerNetwork(conf).init(), warmup_example=X[:1],
+                 max_batch_size=rows, buckets=[1, rows],
+                 batch_timeout_ms=1.0, pipeline_depth=0)
+    metrics = reg.get("m").metrics
+    # per-thread oracle through the same batcher (same bucket, same pad)
+    oracle = [np.asarray(reg.predict("m", X[t * rows:(t + 1) * rows]))
+              for t in range(n_threads)]
+
+    srv = ModelServer(reg, worker_id="w0")
+    ep = f"127.0.0.1:{srv.start(0)}"
+    # hedging parked far out: the A/B measures transport, not tail-cutting
+    router = FleetRouter(StaticFleet({"w0": ep}), probe_interval_s=0.1,
+                         hedge_initial_ms=60000.0)
+    raddr = f"127.0.0.1:{router.start(0)}"
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        ws = router.workers()
+        if ws and all(v.ready for v in ws.values()):
+            break
+        time.sleep(0.05)
+    else:
+        log("[wire] FAIL: worker never became ready behind the router")
+        router.stop()
+        srv.stop(shutdown_registry=True)
+        return 1
+
+    mismatches, errors = [], []
+    arms = ("json", "json_keepalive", "binary")
+
+    def run_arm(arm):
+        client = MultiRouterClient(
+            [raddr], keepalive=(arm != "json"),
+            protocol=("binary" if arm == "binary" else "json"))
+        try:
+            for t in range(n_threads):      # warmup + negotiation
+                client.predict("m", X[t * rows:(t + 1) * rows],
+                               timeout_ms=60000)
+
+            def one(t):
+                xb = X[t * rows:(t + 1) * rows]
+                for _ in range(per_thread):
+                    status, payload = client.predict("m", xb,
+                                                     timeout_ms=60000)
+                    if status != 200:
+                        errors.append((arm, t, status))
+                        continue
+                    out = np.asarray(payload["outputs"], np.float32)
+                    if out.tobytes() != oracle[t].tobytes():
+                        mismatches.append((arm, t))
+
+            busy0 = metrics.utilization_snapshot()["busy_s"]
+            t0 = time.perf_counter()
+            ts = [threading.Thread(target=one, args=(t,))
+                  for t in range(n_threads)]
+            for th in ts:
+                th.start()
+            for th in ts:
+                th.join()
+            dt = time.perf_counter() - t0
+            busy = metrics.utilization_snapshot()["busy_s"] - busy0
+        finally:
+            client.close()
+        qps = n_threads * per_thread / dt
+        idle = round(max(0.0, 1.0 - busy / dt), 3)
+        return qps, idle
+
+    rounds = {a: [] for a in arms}   # (qps, idle) per round
+    proto_errors = 0
+    try:
+        # order-alternated rounds: forward then reversed, so no arm
+        # systematically inherits a warmer allocator/page cache
+        for order in (arms, arms[::-1]):
+            wait_for_quiet_host()
+            for arm in order:
+                metrics.reset_window()
+                wire.reset_counters()
+                rounds[arm].append(run_arm(arm))
+                # every arm here is a clean arm: any protocol error is
+                # a real codec/transport bug, not an injected one
+                proto_errors += wire.counters()["protocol_errors_total"]
+        shm_hops = router.metrics.snapshot()["shm_hops_total"]
+        zero_copy = metrics.snapshot()["zero_copy_rows_total"]
+    finally:
+        router.stop()
+        srv.stop(shutdown_registry=True)
+
+    best = {a: max(rounds[a], key=lambda r: r[0]) for a in arms}
+    qps = {a: round(best[a][0], 2) for a in arms}
+    idle = {a: best[a][1] for a in arms}
+    speedup = round(qps["binary"] / max(1e-9, qps["json"]), 2)
+    keepalive_speedup = round(qps["json_keepalive"] / max(1e-9, qps["json"]),
+                              2)
+    idle_delta = round(idle["json"] - idle["binary"], 3)
+
+    # the contract, checked BEFORE the artifact is written: a failing
+    # run must not leave a plausible-looking section behind
+    if mismatches:
+        log(f"[wire] FAIL: {len(mismatches)} response(s) diverged from "
+            f"the oracle, first {mismatches[0]}")
+        return 1
+    if errors:
+        log(f"[wire] FAIL: {len(errors)} non-200 response(s), "
+            f"first {errors[0]}")
+        return 1
+    if proto_errors:
+        log(f"[wire] FAIL: {proto_errors} wire protocol error(s) in "
+            f"clean arms (must be 0)")
+        return 1
+    if speedup < 3.0:
+        log(f"[wire] FAIL: binary {qps['binary']} vs json {qps['json']} "
+            f"qps is only {speedup}x (contract: >= 3x)")
+        return 1
+    if idle_delta <= 0:
+        log(f"[wire] FAIL: device_idle_fraction did not drop (json "
+            f"{idle['json']} -> binary {idle['binary']})")
+        return 1
+
+    results = {
+        "n_threads": n_threads,
+        "per_thread": per_thread,
+        "rows_per_request": rows,
+        "features": feat,
+        "json": {"qps": qps["json"],
+                 "device_idle_fraction": idle["json"],
+                 "bit_identical": True},
+        "json_keepalive": {"qps": qps["json_keepalive"],
+                           "device_idle_fraction": idle["json_keepalive"],
+                           "bit_identical": True},
+        "binary": {"qps": qps["binary"],
+                   "device_idle_fraction": idle["binary"],
+                   "bit_identical": True},
+        "speedup": speedup,
+        "keepalive_speedup": keepalive_speedup,
+        "idle_fraction_delta": idle_delta,
+        "protocol_errors_clean_arms": proto_errors,
+        "shm_hops_total": shm_hops,
+        "zero_copy_rows_total": zero_copy,
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    bench_extra = bench_extra or os.path.join(here, "BENCH_EXTRA.json")
+    try:
+        with open(bench_extra) as f:
+            extra = json.load(f)
+    except Exception:
+        extra = {}
+    extra["wire"] = results
+    extra["wire_routed_speedup"] = speedup
+    with open(bench_extra, "w") as f:
+        json.dump(extra, f, indent=2)
+    log(f"[wire] OK: binary {qps['binary']} vs json {qps['json']} qps "
+        f"({speedup}x; keepalive alone {keepalive_speedup}x), "
+        f"device_idle_fraction {idle['json']} -> {idle['binary']} "
+        f"(-{idle_delta}), {shm_hops} shm hop(s), {zero_copy} zero-copy "
+        f"row(s), all bit-identical, 0 protocol errors")
+    return 0
+
+
+def check_wire_section(extra, failures, warnings):
+    """--check-tables coverage for the ISSUE 18 keys: the ``wire``
+    section (when present) must carry all three arms bit-identical, a
+    claimed speedup recomputable from the recorded arm qps rows AND at
+    least the 3x contract, the keepalive satellite speedup recomputable,
+    an idle-fraction delta that matches the recorded arm fractions and
+    is an actual reduction, zero protocol errors in the clean arms, and
+    an agreeing top-level ``wire_routed_speedup`` copy."""
+    if "wire" not in extra:
+        warnings.append("wire: not present in BENCH_EXTRA.json "
+                        "(bench --wire not run?)")
+        return
+    d = extra["wire"]
+    required = ["json", "json_keepalive", "binary", "speedup",
+                "keepalive_speedup", "idle_fraction_delta",
+                "protocol_errors_clean_arms"]
+    for k in required:
+        if k not in d:
+            failures.append(f"wire.{k}: missing from the recorded section")
+    if any(k not in d for k in required):
+        return
+    try:
+        for arm in ("json", "json_keepalive", "binary"):
+            if d[arm].get("bit_identical") is not True:
+                failures.append(f"wire.{arm}: bit_identical is "
+                                f"{d[arm].get('bit_identical')!r}")
+            fr = d[arm].get("device_idle_fraction")
+            if not (isinstance(fr, (int, float)) and 0.0 <= fr <= 1.0):
+                failures.append(f"wire.{arm}.device_idle_fraction: "
+                                f"{fr!r} is not a fraction in [0, 1]")
+        sp = d["binary"]["qps"] / max(1e-9, d["json"]["qps"])
+        if abs(sp - d["speedup"]) > max(0.01, 0.02 * abs(sp)):
+            failures.append(f"wire.speedup: claims {d['speedup']}, "
+                            f"recorded arm qps rows give {sp:.3f}")
+        if d["speedup"] < 3.0:
+            failures.append(f"wire.speedup: {d['speedup']} — the recorded "
+                            f"run is under the 3x contract")
+        ka = d["json_keepalive"]["qps"] / max(1e-9, d["json"]["qps"])
+        if abs(ka - d["keepalive_speedup"]) > max(0.01, 0.02 * abs(ka)):
+            failures.append(f"wire.keepalive_speedup: claims "
+                            f"{d['keepalive_speedup']}, recorded arm qps "
+                            f"rows give {ka:.3f}")
+        delta = (d["json"]["device_idle_fraction"]
+                 - d["binary"]["device_idle_fraction"])
+        if abs(delta - d["idle_fraction_delta"]) > 0.002:
+            failures.append(f"wire.idle_fraction_delta: claims "
+                            f"{d['idle_fraction_delta']}, recorded arm "
+                            f"fractions give {delta:.3f}")
+        if d["idle_fraction_delta"] <= 0:
+            failures.append(f"wire.idle_fraction_delta: "
+                            f"{d['idle_fraction_delta']} — the binary arm "
+                            f"did not reduce device idle time")
+        if d["protocol_errors_clean_arms"] != 0:
+            failures.append(f"wire.protocol_errors_clean_arms: "
+                            f"{d['protocol_errors_clean_arms']!r} "
+                            f"(must be 0)")
+        if extra.get("wire_routed_speedup") != d["speedup"]:
+            failures.append(f"wire_routed_speedup: top-level copy "
+                            f"{extra.get('wire_routed_speedup')} != wire "
+                            f"section {d['speedup']}")
+    except (TypeError, ValueError, AttributeError, KeyError) as e:
+        failures.append(f"wire: malformed section ({e!r})")
+
+
 # ------------------------------------------------------------------- resnet
 def bench_resnet():
     import jax
@@ -5863,6 +6130,8 @@ if __name__ == "__main__":
         sys.exit(bench_sessions())
     if "--delivery" in sys.argv:
         sys.exit(bench_delivery())
+    if "--wire" in sys.argv:
+        sys.exit(bench_wire())
     if "--serving" in sys.argv:
         # give the CPU backend multiple virtual devices so the replica arm
         # is real even off-TPU (flag only affects the host platform; must

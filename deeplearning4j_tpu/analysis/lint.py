@@ -34,6 +34,11 @@ machine-checked invariant (run as the tier-1 test
   ``# TYPE name`` emission shape) is namespaced per
   ``registry.METRIC_NAMESPACES`` and documented in
   ``docs/observability.md``.
+- **WIRE-UNMAPPED-HEADER / WIRE-STALE-FIELD** — every ``X-*`` control
+  header (plus ``Retry-After``/``Retry-After-Ms``) used in the serving
+  tier has a frame-field mapping in ``serving/wire.py:HEADER_FIELDS``,
+  and every mapped header is still used somewhere (ISSUE 18): a new
+  header can't silently lose its semantics on the binary path.
 - **WALLCLOCK** — no ``time.time()`` / ``time.time_ns()`` and no stdlib
   ``random`` in trajectory-affecting modules
   (``registry.TRAJECTORY_MODULES``): inject a clock/RNG instead. Escape
@@ -389,6 +394,38 @@ def collect_fired_points(ctx: _FileCtx) -> List[Tuple[str, int]]:
     return fired
 
 
+# ------------------------------------------------------------------- wire
+#: control-header literal shape the wire registry diff scans for: the
+#: ``X-*`` family plus the two Retry-After spellings the shed/backoff
+#: path emits (the only non-``X-`` headers the protocol must carry)
+_HEADER_LITERAL = re.compile(r'"(X-[A-Za-z][A-Za-z0-9-]*|Retry-After(?:-Ms)?)"')
+
+
+def parse_header_fields(wire_source: str) -> Dict[str, str]:
+    """The ``HEADER_FIELDS`` dict literal out of ``serving/wire.py``
+    (same AST extraction as :func:`parse_registered_points`): HTTP
+    control header -> binary frame field name."""
+    tree = ast.parse(wire_source)
+    for node in tree.body:
+        if isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        elif isinstance(node, ast.Assign):
+            targets = node.targets
+        else:
+            continue
+        if (len(targets) == 1 and isinstance(targets[0], ast.Name)
+                and targets[0].id == "HEADER_FIELDS"
+                and isinstance(node.value, ast.Dict)):
+            fields = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                if (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)):
+                    fields[k.value] = v.value
+            return fields
+    return {}
+
+
 # ---------------------------------------------------------------- journal
 def parse_event_types(journal_source: str) -> Dict[str, str]:
     """The ``EVENT_TYPES`` dict literal out of ``runtime/journal.py``
@@ -681,6 +718,36 @@ class Linter:
                     "METRIC-UNDOCUMENTED", path, line,
                     f"metric {name!r} not documented in "
                     f"docs/observability.md"))
+
+        # wire header<->frame-field registry diff (ISSUE 18): every X-*
+        # control header the serving tier forwards must have a frame-field
+        # mapping in serving/wire.py:HEADER_FIELDS — a header without one
+        # would silently lose its semantics on the binary path — and every
+        # mapped header must still exist somewhere in serving code
+        wire_src = self._all_sources.get("serving/wire.py", "")
+        header_fields = parse_header_fields(wire_src)
+        serving_headers: Dict[str, Tuple[str, int]] = {}
+        for rel in sorted(self._all_sources):
+            if not rel.startswith("serving/") or rel == "serving/wire.py":
+                continue
+            src = self._all_sources[rel]
+            for m in _HEADER_LITERAL.finditer(src):
+                line = src.count("\n", 0, m.start()) + 1
+                serving_headers.setdefault(m.group(1), (rel, line))
+        for hdr, (path, line) in sorted(serving_headers.items()):
+            if hdr not in header_fields:
+                self.findings.append(Finding(
+                    "WIRE-UNMAPPED-HEADER", path, line,
+                    f"control header {hdr!r} used by the serving tier has "
+                    f"no frame-field mapping in "
+                    f"serving/wire.py:HEADER_FIELDS (the binary protocol "
+                    f"would drop it)"))
+        for hdr in header_fields:
+            if hdr not in serving_headers:
+                self.findings.append(Finding(
+                    "WIRE-STALE-FIELD", "serving/wire.py", 0,
+                    f"HEADER_FIELDS maps header {hdr!r} that no serving "
+                    f"module outside wire.py references"))
 
         for name in PIPELINE_THREAD_NAMES:
             if name not in THREAD_NAME_PREFIXES:

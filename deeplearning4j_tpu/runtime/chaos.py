@@ -69,9 +69,14 @@ fleet drill can slow or fail one worker without touching its peers;
 --fleet`` hedges against), ``serving.router.forward`` (fires in the
 fleet router before each forward attempt — primary, hedge, or failover —
 a fault here is a failed attempt the router must absorb by failing over
-within the deadline) and ``serving.router.hedge`` (fires as a hedge is
+within the deadline), ``serving.router.hedge`` (fires as a hedge is
 launched against a second worker, so a drill can fault or delay exactly
-the hedge path — see ``tests/test_router.py``).
+the hedge path — see ``tests/test_router.py``) and ``serving.wire.frame``
+(fires per binary wire-frame encode, plus a ``transform_bytes`` byte
+point over the finished CRC-framed frame — injected corruption,
+truncation or bit flips must surface as a counted wire protocol error
+and a JSON fallback/retry, never a silently wrong tensor, see
+``tests/test_wire.py``).
 """
 
 from __future__ import annotations
@@ -121,6 +126,7 @@ REGISTERED_POINTS: Dict[str, str] = {
     "runtime.compile_cache.load": "per persistent-executable-cache lookup",
     "serving.session.step": "top of every streaming-session step",
     "serving.session.rehydrate": "session spill read-back; also a byte point over the CRC-framed spill frame",
+    "serving.wire.frame": "binary wire-frame encode; also a byte point over the CRC-framed frame",
 }
 
 

@@ -86,6 +86,7 @@ EVENT_TYPES: Dict[str, str] = {
     "router.shed_window": "router honoring a worker's Retry-After shed hint",
     "router.worker_ready": "router probe readmitted a worker (not-ready -> ready)",
     "router.worker_unready": "router probe lost a worker (ready -> not-ready)",
+    "router.wire_downgrade": "worker answered 415 to a binary frame; router pinned JSON for it",
     "autoscale.decision": "one SLOAutoscaler decision (acted/refused/deferred)",
     "autoscale.election": "lease transition (acquired/takeover/lost/released)",
     "control.config_apply": "a FleetConfig mutation committed (new version)",

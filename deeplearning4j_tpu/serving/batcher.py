@@ -437,6 +437,12 @@ class ContinuousBatcher:
         """
         chaos.inject("serving.batcher.submit")
         xs, rows = self._normalize(x)
+        # read-only rows are the signature of the binary wire path: views
+        # over the request frame (or a shared-memory segment) that land in
+        # the pad buffer with exactly one copy — count them (ISSUE 18)
+        if (any(not v.flags.writeable for v in xs.values())
+                if isinstance(xs, dict) else not xs.flags.writeable):
+            self.metrics.record_zero_copy(rows)
         with self._submit_lock:
             if self._shutdown or self._draining:
                 raise ServingShutdown("batcher is shut down")
@@ -798,12 +804,20 @@ class ContinuousBatcher:
                     buf[ofs:] = 0
                 x[name] = buf
                 held.append((k, buf))
+            for r in live:
+                r.x = None  # release borrowed wire/shm views (ISSUE 18)
             return x, held
         k, buf = self._acquire_buf(bucket, None, template)
         ofs = 0
         for r in live:
             buf[ofs:ofs + r.rows] = r.x
             ofs += r.rows
+            # drop the row reference NOW: binary wire requests hand the
+            # batcher read-only views over the frame (or a shared-memory
+            # segment), and the segment may only be closed once no view
+            # exports its buffer — holding x until the request is GC'd
+            # would keep the mapping alive past the response (ISSUE 18)
+            r.x = None
         if ofs < bucket:
             buf[ofs:] = 0
         return buf, [(k, buf)]

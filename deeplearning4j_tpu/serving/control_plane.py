@@ -68,7 +68,10 @@ from collections import deque
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional, Tuple
 
+import numpy as np
+
 from deeplearning4j_tpu.runtime import chaos, journal
+from deeplearning4j_tpu.serving import wire
 from deeplearning4j_tpu.serving.fleet import FleetSupervisor, PidRegistry
 from deeplearning4j_tpu.serving.manifest import atomic_replace
 
@@ -721,18 +724,32 @@ class MultiRouterClient:
 
     def __init__(self, endpoints: Optional[List[str]] = None,
                  config: Optional[FleetConfig] = None,
-                 timeout_s: float = 60.0):
+                 timeout_s: float = 60.0, keepalive: bool = True,
+                 protocol: str = "binary"):
         if not endpoints and config is None:
             raise ValueError("need endpoints or a FleetConfig")
+        if protocol not in ("binary", "json"):
+            raise ValueError(f"unknown protocol {protocol!r}")
         self._static = list(endpoints or [])
         self._config = config
         self.timeout_s = float(timeout_s)
         self._rr = itertools.count()
-        # guards: requests_total, failovers_total, router_requests
+        #: reuse HTTP/1.1 connections across requests; ``False`` restores
+        #: the one-connection-per-request behaviour (the bench's baseline
+        #: arm measures exactly that TCP-setup tax — ISSUE 18)
+        self.keepalive = bool(keepalive)
+        #: preferred predict encoding; a 415 from a wire-disabled fleet
+        #: downgrades ONCE and is cached (all routers front the same
+        #: workers, so one verdict covers the client)
+        self.protocol = protocol
+        self._wire_ok: Optional[bool] = None
+        self.pool = wire.ConnectionPool()
+        # guards: requests_total, failovers_total, router_requests, wire_downgrades_total
         self._lock = threading.Lock()
         self.requests_total = 0
         self.failovers_total = 0
         self.router_requests: Dict[str, int] = {}
+        self.wire_downgrades_total = 0
 
     def endpoints(self) -> List[str]:
         if self._config is not None:
@@ -742,9 +759,11 @@ class MultiRouterClient:
                 return eps
         return list(self._static)
 
-    @staticmethod
-    def _http(address: str, method: str, path: str, body, headers,
+    def _http(self, address: str, method: str, path: str, body, headers,
               timeout: float) -> Tuple[int, Dict[str, str], bytes]:
+        if self.keepalive:
+            return self.pool.request(address, method, path, body=body,
+                                     headers=headers or {}, timeout=timeout)
         host, port = address.rsplit(":", 1)
         conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
         try:
@@ -790,6 +809,9 @@ class MultiRouterClient:
                 status, hdrs, data = self._http(ep, method, path, body,
                                                 headers, timeout)
             except Exception as e:
+                # a dead router (the SIGKILL drill) poisons every pooled
+                # connection to it — drop them so failback reconnects
+                self.pool.invalidate(ep)
                 last_err = e
                 continue
             with self._lock:
@@ -803,10 +825,53 @@ class MultiRouterClient:
         raise last_err  # every router unreachable
 
     def predict(self, model: str, inputs, timeout_ms: Optional[float] = None,
-                timeout_s: Optional[float] = None
+                timeout_s: Optional[float] = None,
+                protocol: Optional[str] = None
                 ) -> Tuple[int, Dict[str, Any]]:
-        """JSON predict convenience: returns ``(status, payload)``."""
+        """Predict convenience: returns ``(status, payload)``.
+
+        ``protocol`` overrides the client default ("binary"/"json"). The
+        binary path ships inputs as a CRC-framed ndarray frame and gets
+        the response tensor back without JSON marshalling (``outputs`` is
+        an ndarray); a 415 from a wire-disabled fleet falls back to JSON
+        for this request and caches the verdict. Error responses are JSON
+        on both protocols, so the payload shape is identical."""
+        proto = self.protocol if protocol is None else protocol
+        if proto not in ("binary", "json"):
+            raise ValueError(f"unknown protocol {proto!r}")
+        if proto == "binary" and self._wire_ok is not False:
+            frame = wire.encode_predict_request(inputs, timeout_ms=timeout_ms)
+            status, hdrs, data = self.request(
+                "POST", f"/v1/models/{model}/predict", body=frame,
+                headers={"Content-Type": wire.CONTENT_TYPE},
+                timeout_s=timeout_s)
+            if status != 415:
+                if status == 200:
+                    self._wire_ok = True
+                    ctype = next((v for k, v in hdrs.items()
+                                  if k.lower() == "content-type"), "")
+                    if ctype.split(";")[0].strip() == wire.CONTENT_TYPE:
+                        name, version, out, fr = \
+                            wire.decode_predict_response(data)
+                        try:
+                            payload = {"model": name, "version": version,
+                                       "outputs": np.array(out)}
+                        finally:
+                            out = None
+                            fr.close()
+                        return status, payload
+                    # a JSON-only worker behind a wire-capable router:
+                    # the router transcoded — parse as JSON below
+                return status, self._json_payload(data)
+            # 415: the fleet speaks JSON only — cache and fall through
+            with self._lock:
+                if self._wire_ok is not False:
+                    self.wire_downgrades_total += 1
+                self._wire_ok = False
         req: Dict[str, Any] = {"inputs": inputs}
+        if isinstance(inputs, np.ndarray):
+            req["inputs"] = inputs.tolist()
+            req["dtype"] = str(inputs.dtype)
         if timeout_ms is not None:
             req["timeout_ms"] = float(timeout_ms)
         status, _, data = self.request(
@@ -814,17 +879,26 @@ class MultiRouterClient:
             body=json.dumps(req).encode(),
             headers={"Content-Type": "application/json"},
             timeout_s=timeout_s)
+        return status, self._json_payload(data)
+
+    @staticmethod
+    def _json_payload(data: bytes) -> Dict[str, Any]:
         try:
-            payload = json.loads(data.decode())
+            return json.loads(data.decode())
         except Exception:
-            payload = {"raw": data.decode(errors="replace")[:200]}
-        return status, payload
+            return {"raw": data.decode(errors="replace")[:200]}
+
+    def close(self) -> None:
+        """Drop every pooled connection (idempotent)."""
+        self.pool.close()
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
             return {"requests_total": self.requests_total,
                     "failovers_total": self.failovers_total,
-                    "router_requests": dict(self.router_requests)}
+                    "router_requests": dict(self.router_requests),
+                    "wire_downgrades_total": self.wire_downgrades_total,
+                    "pool": self.pool.snapshot()}
 
 
 # ========================================================= router processes

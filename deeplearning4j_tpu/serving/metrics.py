@@ -115,7 +115,7 @@ class ServingMetrics:
     def __init__(self, queue_depth_fn: Optional[Callable[[], int]] = None,
                  compile_count_fn: Optional[Callable[[], int]] = None,
                  inflight_fn: Optional[Callable[[], int]] = None):
-        # guards: requests_total, responses_total, rejected_overload, rejected_deadline, rejected_circuit, retries_total, errors_total, batches_total, rows_real_total, rows_padded_total, request_latency, batch_latency, dispatch_latency, quant_latency, float_latency, quantized_requests_total, dtype_policy_label, replica_batches, warmup_seconds, _qps_slots, _qps_times, _window_started_at
+        # guards: requests_total, responses_total, rejected_overload, rejected_deadline, rejected_circuit, retries_total, errors_total, batches_total, rows_real_total, rows_padded_total, zero_copy_rows_total, request_latency, batch_latency, dispatch_latency, quant_latency, float_latency, quantized_requests_total, dtype_policy_label, replica_batches, warmup_seconds, _qps_slots, _qps_times, _window_started_at
         self._lock = threading.Lock()
         self.started_at = time.monotonic()
         self._window_started_at = self.started_at  # reset_window restarts it
@@ -129,6 +129,10 @@ class ServingMetrics:
         self.batches_total = 0
         self.rows_real_total = 0         # pre-padding rows executed
         self.rows_padded_total = 0       # post-padding rows executed
+        # zero-copy ingest observability (ISSUE 18): rows that arrived as
+        # read-only views over a binary wire frame (or shared-memory
+        # segment) and were copied exactly once — into the pad buffer
+        self.zero_copy_rows_total = 0
         self.request_latency = LatencyHistogram()
         self.batch_latency = LatencyHistogram()
         # quantized-serving observability (ISSUE 8): how much traffic rides
@@ -159,6 +163,11 @@ class ServingMetrics:
             self.requests_total += 1
             if quantized:
                 self.quantized_requests_total += 1
+
+    def record_zero_copy(self, rows: int) -> None:
+        """Count rows ingested as zero-copy wire views (ISSUE 18)."""
+        with self._lock:
+            self.zero_copy_rows_total += int(rows)
 
     def set_dtype_policy(self, label: str) -> None:
         """Attach the served model's dtype-policy label (rendered as the
@@ -272,6 +281,7 @@ class ServingMetrics:
                 "batches_total": self.batches_total,
                 "rows_real_total": self.rows_real_total,
                 "rows_padded_total": self.rows_padded_total,
+                "zero_copy_rows_total": self.zero_copy_rows_total,
                 "batch_occupancy": round(self.batch_occupancy, 4),
                 "latency_p50_s": req_lat.percentile(50),
                 "latency_p99_s": req_lat.percentile(99),
@@ -345,6 +355,7 @@ class ServingMetrics:
                     "batches_total": self.batches_total,
                     "rows_real_total": self.rows_real_total,
                     "rows_padded_total": self.rows_padded_total,
+                    "zero_copy_rows_total": self.zero_copy_rows_total,
                     "quantized_requests_total": self.quantized_requests_total,
                 },
                 "histograms": {
@@ -372,6 +383,7 @@ class ServingMetrics:
             f"serving_errors_total{lbl} {s['errors_total']}",
             f"serving_batches_total{lbl} {s['batches_total']}",
             f"serving_batch_occupancy{lbl} {s['batch_occupancy']}",
+            f"serving_zero_copy_rows_total{lbl} {s['zero_copy_rows_total']}",
             f'serving_latency_seconds{{model="{model}",quantile="0.5"}} '
             f"{s['latency_p50_s']}",
             f'serving_latency_seconds{{model="{model}",quantile="0.99"}} '

@@ -51,7 +51,6 @@ and can front workers from any process.
 from __future__ import annotations
 
 import hashlib
-import http.client
 import json
 import logging
 import os
@@ -65,6 +64,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlsplit
 
 from deeplearning4j_tpu.runtime import chaos, journal, trace
+from deeplearning4j_tpu.serving import wire
 from deeplearning4j_tpu.serving.metrics import LatencyHistogram
 from deeplearning4j_tpu.serving.resilience import CircuitBreaker, CircuitState
 from deeplearning4j_tpu.serving.slo import SLOMonitor
@@ -104,7 +104,7 @@ class RouterMetrics:
     ``runtime.profiler.router_stats()``."""
 
     def __init__(self):
-        # guards: requests_total, responses_total, errors_total, forwards_total, hedges_total, hedge_wins_total, hedges_discarded_total, failovers_total, shed_skips_total, deploys_total, session_requests_total, session_migrations_total, shadow_mirrors_total, shadow_diverged_total, canary_requests_total, rollbacks_total, request_latency, worker_requests
+        # guards: requests_total, responses_total, errors_total, forwards_total, hedges_total, hedge_wins_total, hedges_discarded_total, failovers_total, shed_skips_total, deploys_total, session_requests_total, session_migrations_total, shadow_mirrors_total, shadow_diverged_total, canary_requests_total, rollbacks_total, wire_requests_total, wire_downgrades_total, shm_hops_total, shm_fallbacks_total, request_latency, worker_requests
         self._lock = threading.Lock()
         self.requests_total = 0
         self.session_requests_total = 0    # session-tier requests routed
@@ -122,6 +122,10 @@ class RouterMetrics:
         self.shadow_diverged_total = 0  # mirrors that disagreed/corrupted
         self.canary_requests_total = 0  # requests pinned to a canary
         self.rollbacks_total = 0        # gated deploys auto-rolled back
+        self.wire_requests_total = 0    # binary-framed client requests
+        self.wire_downgrades_total = 0  # 415s that flipped a worker to JSON
+        self.shm_hops_total = 0         # forwards whose payload rode shm
+        self.shm_fallbacks_total = 0    # shm hops resent inline
         self.request_latency = LatencyHistogram()
         self.worker_requests: Dict[str, int] = {}
 
@@ -162,6 +166,10 @@ class RouterMetrics:
                 "shadow_diverged_total": self.shadow_diverged_total,
                 "canary_requests_total": self.canary_requests_total,
                 "rollbacks_total": self.rollbacks_total,
+                "wire_requests_total": self.wire_requests_total,
+                "wire_downgrades_total": self.wire_downgrades_total,
+                "shm_hops_total": self.shm_hops_total,
+                "shm_fallbacks_total": self.shm_fallbacks_total,
                 "latency_p50_s": self.request_latency.percentile(50),
                 "latency_p99_s": self.request_latency.percentile(99),
                 "worker_requests": dict(self.worker_requests),
@@ -188,6 +196,10 @@ class RouterMetrics:
             f"router_shadow_diverged_total {s['shadow_diverged_total']}",
             f"router_canary_requests_total {s['canary_requests_total']}",
             f"router_rollbacks_total {s['rollbacks_total']}",
+            f"router_wire_requests_total {s['wire_requests_total']}",
+            f"router_wire_downgrades_total {s['wire_downgrades_total']}",
+            f"router_shm_hops_total {s['shm_hops_total']}",
+            f"router_shm_fallbacks_total {s['shm_fallbacks_total']}",
             f'router_latency_seconds{{quantile="0.5"}} '
             f"{s['latency_p50_s']}",
             f'router_latency_seconds{{quantile="0.99"}} '
@@ -231,6 +243,11 @@ class WorkerView:
         #: DeliveryController assigns it (shadow mirrors, canary picks)
         self.candidate = False
         self.shed_until = 0.0           # monotonic end of the shed window
+        #: negotiated transport (ISSUE 18): None = untried, True = the
+        #: worker accepted a binary frame, False = it answered 415 and
+        #: every later forward transcodes to JSON.  A restarted worker
+        #: gets a fresh view, so it re-negotiates.
+        self.wire_ok: Optional[bool] = None
         self.inflight = 0
         self.requests_total = 0
         self.failures_total = 0
@@ -403,7 +420,9 @@ class FleetRouter:
                  no_deadline_timeout_s: float = 60.0,
                  residency_refresh_s: float = 1.0,
                  slo: Optional[SLOMonitor] = None,
-                 router_id: str = "router"):
+                 router_id: str = "router",
+                 shm_enabled: Optional[bool] = None,
+                 shm_min_bytes: int = wire.SHM_MIN_BYTES):
         self._fleet = fleet
         #: identity in a replicated router tier (ISSUE 12): the key this
         #: router registers under in the shared config's router roster,
@@ -424,6 +443,19 @@ class FleetRouter:
         self.probe_timeout_s = float(probe_timeout_s)
         self.connect_timeout_s = float(connect_timeout_s)
         self.no_deadline_timeout_s = float(no_deadline_timeout_s)
+        # keep-alive connection pool (ISSUE 18): EVERY router HTTP —
+        # forwards, probes, scrapes, sessions, shadows — reuses sockets
+        # instead of paying TCP setup per hop; invalidated per endpoint
+        # on connection faults, breaker opens, and worker restarts
+        self.pool = wire.ConnectionPool()
+        # colocated shared-memory fast path (ISSUE 18): large binary
+        # payloads to 127.0.0.1 workers ride a shm segment instead of
+        # the loopback socket; DL4J_TPU_NO_SHM (or shm_enabled=False)
+        # forces the socket path
+        if shm_enabled is None:
+            shm_enabled = not os.environ.get("DL4J_TPU_NO_SHM")
+        self.shm_enabled = bool(shm_enabled)
+        self.shm_min_bytes = int(shm_min_bytes)
         self.metrics = RouterMetrics()
         # fleet-wide SLO attainment + burn rates (ISSUE 9): the router
         # sees every client request whichever worker serves it, so ITS
@@ -482,8 +514,12 @@ class FleetRouter:
                     fresh.draining = view.draining
                     fresh.candidate = view.candidate
                     self._views[wid] = fresh
+                    # pooled keep-alives to the old address are dead
+                    # weight at best, a stranger at worst
+                    self.pool.invalidate(view.address)
             for wid in list(self._views):
                 if wid not in endpoints:
+                    self.pool.invalidate(self._views[wid].address)
                     del self._views[wid]
 
     def workers(self) -> Dict[str, WorkerView]:
@@ -705,17 +741,14 @@ class FleetRouter:
               headers: Optional[Dict[str, str]] = None,
               timeout: Optional[float] = None
               ) -> Tuple[int, Dict[str, str], bytes]:
-        host, port = address.rsplit(":", 1)
-        conn = http.client.HTTPConnection(
-            host, int(port),
+        # pooled keep-alive (ISSUE 18): a stale idle connection is
+        # retried once on a fresh one inside the pool; a FRESH
+        # connection's failure propagates exactly as the old
+        # one-connection-per-request path did, so breaker evidence is
+        # unchanged
+        return self.pool.request(
+            address, method, path, body=body, headers=headers,
             timeout=self.connect_timeout_s if timeout is None else timeout)
-        try:
-            conn.request(method, path, body=body, headers=headers or {})
-            resp = conn.getresponse()
-            data = resp.read()
-            return resp.status, dict(resp.getheaders()), data
-        finally:
-            conn.close()
 
     # ------------------------------------------------------------ routing
     @staticmethod
@@ -755,6 +788,9 @@ class FleetRouter:
                              reason="connect_fault")
             view.ready = False
             view.breaker.record_failure()
+            # any pooled keep-alive to this address shares whatever
+            # killed this one — drop them all
+            self.pool.invalidate(view.address)
             return
         if attempt.status == 503:
             # a load/health signal, not a worker fault: honor the shed
@@ -769,12 +805,62 @@ class FleetRouter:
             return
         if attempt.status is not None and attempt.status >= 500:
             view.breaker.record_failure()
+            if view.breaker.state is CircuitState.OPEN:
+                # breaker open = stop talking to this worker; parked
+                # keep-alives would outlive the verdict otherwise
+                self.pool.invalidate(view.address)
             return
         view.breaker.record_success()
 
+    @staticmethod
+    def _error_reason(data: bytes) -> Optional[str]:
+        try:
+            return json.loads(data.decode()).get("reason")
+        except Exception:
+            return None
+
+    def _send_attempt(self, view: WorkerView, name: str, body: bytes,
+                      headers: Dict[str, str], timeout: Optional[float],
+                      is_wire: bool) -> Tuple[int, Dict[str, str], bytes]:
+        """One POST to one worker, choosing the transport: the colocated
+        shared-memory fast path for large binary payloads (transparent
+        inline resend on any shm trouble), else the pooled socket."""
+        path = f"/v1/models/{name}/predict"
+        if (is_wire and self.shm_enabled
+                and view.address.startswith("127.0.0.1:")
+                and len(body) >= self.shm_min_bytes):
+            seg = None
+            try:
+                shm_body, seg = wire.frame_to_shm(
+                    body, min_bytes=self.shm_min_bytes)
+            except Exception:
+                seg = None  # can't stage the segment: socket path
+            if seg is not None:
+                try:
+                    status, h, data = self._http(
+                        view.address, "POST", path, body=shm_body,
+                        headers=headers, timeout=timeout)
+                finally:
+                    wire.release_shm(seg)
+                if (status == 503 and
+                        self._error_reason(data) == "wire_protocol_error"):
+                    # the worker could not attach/validate the segment
+                    # (or chaos rotted the re-framed bytes): resend the
+                    # original, already-validated frame inline — the
+                    # fast path must never cost an answer
+                    self.metrics.record("shm_fallbacks_total")
+                    return self._http(view.address, "POST", path,
+                                      body=body, headers=headers,
+                                      timeout=timeout)
+                self.metrics.record("shm_hops_total")
+                return status, h, data
+        return self._http(view.address, "POST", path, body=body,
+                          headers=headers, timeout=timeout)
+
     def _forward(self, race: _Race, view: WorkerView, name: str,
                  body: bytes, rid: str, deadline: Optional[float],
-                 hedged: bool, span=trace.NOOP) -> None:
+                 hedged: bool, span=trace.NOOP,
+                 ctype: str = "application/json") -> None:
         """One attempt against one worker (runs on its own thread). When
         tracing, ``span`` is the attempt's ``router.attempt`` child span
         of the request's root — created by the CALLER before this thread
@@ -802,7 +888,15 @@ class FleetRouter:
                 remaining = None if deadline is None else deadline - t0
                 if remaining is not None and remaining <= 0:
                     raise TimeoutError("deadline expired before forward")
-                headers = {"Content-Type": "application/json",
+                send_body, send_ctype = body, ctype
+                if ctype == wire.CONTENT_TYPE and view.wire_ok is False:
+                    # cached negotiation verdict: this worker speaks
+                    # JSON only — transcode the (already-validated)
+                    # frame; dtype is pinned in the body so the answer
+                    # stays bit-identical to the binary path
+                    send_body, _tmo = wire.frame_to_json_body(body)
+                    send_ctype = "application/json"
+                headers = {"Content-Type": send_ctype,
                            "X-Request-Id": rid}
                 if sp.recording:
                     headers["X-Trace-Id"] = sp.trace_id
@@ -819,11 +913,28 @@ class FleetRouter:
                 # a deadline-free request's socket timeout must cover a SLOW
                 # predict, not just the connect — 2s here would misread a
                 # healthy-but-busy worker as dead and cascade into 503s
-                status, resp_headers, data = self._http(
-                    view.address, "POST", f"/v1/models/{name}/predict",
-                    body=body, headers=headers,
-                    timeout=(self.no_deadline_timeout_s if remaining is None
-                             else remaining + 0.25))
+                send_timeout = (self.no_deadline_timeout_s
+                                if remaining is None else remaining + 0.25)
+                status, resp_headers, data = self._send_attempt(
+                    view, name, send_body, headers, send_timeout,
+                    is_wire=send_ctype == wire.CONTENT_TYPE)
+                if status == 415 and send_ctype == wire.CONTENT_TYPE:
+                    # mid-stream downgrade: the worker declined binary
+                    # RIGHT NOW (force-JSON restart, older build) —
+                    # remember the verdict, transcode, and retry the
+                    # SAME worker once within this attempt's budget
+                    view.wire_ok = False
+                    self.metrics.record("wire_downgrades_total")
+                    journal.emit("router.wire_downgrade",
+                                 worker=view.worker_id)
+                    send_body, _tmo = wire.frame_to_json_body(body)
+                    headers["Content-Type"] = "application/json"
+                    status, resp_headers, data = self._http(
+                        view.address, "POST",
+                        f"/v1/models/{name}/predict", body=send_body,
+                        headers=headers, timeout=send_timeout)
+                elif status == 200 and send_ctype == wire.CONTENT_TYPE:
+                    view.wire_ok = True
                 attempt.status, attempt.headers, attempt.data = \
                     status, resp_headers, data
             except BaseException as e:
@@ -863,7 +974,8 @@ class FleetRouter:
 
     def _launch(self, race: _Race, view: WorkerView, name: str, body: bytes,
                 rid: str, deadline: Optional[float], hedged: bool,
-                parent_span=trace.NOOP) -> None:
+                parent_span=trace.NOOP,
+                ctype: str = "application/json") -> None:
         race.register_launch()
         # the attempt span is created HERE, on the handler thread, so the
         # request's trace counts it open before this thread even starts —
@@ -872,20 +984,40 @@ class FleetRouter:
               else trace.NOOP)
         threading.Thread(
             target=self._forward,
-            args=(race, view, name, body, rid, deadline, hedged, sp),
+            args=(race, view, name, body, rid, deadline, hedged, sp, ctype),
             daemon=True, name=f"router-forward-{view.worker_id}").start()
 
-    def _route_predict(self, name: str, raw: bytes, inbound_headers
+    def _route_predict(self, name: str, raw: bytes, inbound_headers,
+                       ctype: str = "application/json"
                        ) -> Tuple[int, Dict[str, str], bytes]:
         """The routing engine: ranked candidates -> hedged race ->
         failover loop until a terminal response or the deadline."""
         self.metrics.record("requests_total")
         t_start = time.monotonic()
-        try:
-            body = json.loads(raw.decode() or "{}")
-            timeout_ms = body.get("timeout_ms", self.default_timeout_ms)
-        except Exception:
-            timeout_ms = self.default_timeout_ms
+        ctype = (ctype or "application/json").split(";")[0].strip()
+        if ctype == wire.CONTENT_TYPE:
+            # binary client (ISSUE 18): one full decode validates the
+            # frame AT THE BOUNDARY (CRC over meta+payload — the router
+            # never forwards rot) and yields timeout_ms without the JSON
+            # path's full-body parse
+            self.metrics.record("wire_requests_total")
+            try:
+                fr = wire.decode_frame(raw, expect_kind=wire.KIND_REQUEST)
+                timeout_ms = fr.meta.get("timeout_ms",
+                                         self.default_timeout_ms)
+                fr.close()
+            except wire.WireProtocolError as e:
+                self.metrics.record_response(503, 0.0)
+                return 503, {"Content-Type": "application/json"}, \
+                    json.dumps({"error": "bad wire frame",
+                                "reason": "wire_protocol_error",
+                                "detail": str(e)}).encode()
+        else:
+            try:
+                body = json.loads(raw.decode() or "{}")
+                timeout_ms = body.get("timeout_ms", self.default_timeout_ms)
+            except Exception:
+                timeout_ms = self.default_timeout_ms
         inbound = {k: v for k, v in (inbound_headers or {}).items()}
         hdr_deadline = inbound.get("X-Deadline-Ms")
         if hdr_deadline is not None:
@@ -965,7 +1097,8 @@ class FleetRouter:
                 self._forward(race, cand_view, name, raw, rid, deadline,
                               hedged=False,
                               span=(rsp.child("router.attempt")
-                                    if rsp.recording else trace.NOOP))
+                                    if rsp.recording else trace.NOOP),
+                              ctype=ctype)
                 latency_c = time.monotonic() - t_c
                 win = race.winner
                 if win is not None and win.status == 200:
@@ -1011,7 +1144,7 @@ class FleetRouter:
                 race = _Race(self.metrics)
                 if hedge_possible:
                     self._launch(race, primary, name, raw, rid, deadline,
-                                 hedged=False, parent_span=rsp)
+                                 hedged=False, parent_span=rsp, ctype=ctype)
                 else:
                     # no hedge can fire: run the attempt on the handler
                     # thread itself instead of paying a thread spawn per
@@ -1020,7 +1153,8 @@ class FleetRouter:
                     self._forward(race, primary, name, raw, rid, deadline,
                                   hedged=False,
                                   span=(rsp.child("router.attempt")
-                                        if rsp.recording else trace.NOOP))
+                                        if rsp.recording else trace.NOOP),
+                                  ctype=ctype)
                 tried.add(primary.worker_id)
                 remaining = (None if deadline is None
                              else deadline - time.monotonic())
@@ -1043,7 +1177,8 @@ class FleetRouter:
                                       worker=hedge_view.worker_id,
                                       delay_ms=round(delay * 1e3, 2))
                         self._launch(race, hedge_view, name, raw, rid,
-                                     deadline, hedged=True, parent_span=rsp)
+                                     deadline, hedged=True, parent_span=rsp,
+                                     ctype=ctype)
                         tried.add(hedge_view.worker_id)
                 race.wait(None if deadline is None
                           else max(0.0, deadline - time.monotonic()))
@@ -1057,7 +1192,8 @@ class FleetRouter:
                         # incumbents' breakers
                         self._launch_shadow(dc, cand_view, name, raw, rid,
                                             win.data,
-                                            time.monotonic() - t_start)
+                                            time.monotonic() - t_start,
+                                            ctype=ctype)
                     return finish(win.status, win.headers, win.data)
                 if race.finished < race.launched:
                     # deadline hit with attempts still in flight: their late
@@ -1079,7 +1215,8 @@ class FleetRouter:
     # ------------------------------------------------------ gated delivery
     def _launch_shadow(self, dc, view: WorkerView, name: str, body: bytes,
                        rid: str, incumbent_body: bytes,
-                       incumbent_latency_s: float) -> None:
+                       incumbent_latency_s: float,
+                       ctype: str = "application/json") -> None:
         """Mirror one already-served request to the candidate on a
         detached thread. The comparison (top-1 agreement + latency
         delta) folds into the controller's :class:`ShadowComparator`;
@@ -1092,14 +1229,26 @@ class FleetRouter:
         def run():
             t0 = time.monotonic()
             status, data, corrupt = 0, b"", False
+            incumbent = incumbent_body
             try:
                 chaos.inject("serving.delivery.shadow")
-                status, _, data = self._http(
+                status, resp_headers, data = self._http(
                     view.address, "POST", f"/v1/models/{name}/predict",
                     body=body,
-                    headers={"Content-Type": "application/json",
+                    headers={"Content-Type": ctype,
                              "X-Request-Id": rid, "X-Shadow": "1"},
                     timeout=self.no_deadline_timeout_s)
+                if ctype == wire.CONTENT_TYPE:
+                    # the comparator speaks JSON: decode binary
+                    # responses to the JSON shape so shadow verdicts
+                    # are protocol-invariant (a decode failure is a
+                    # candidate protocol error, held against promotion)
+                    incumbent = json.dumps(
+                        wire.response_to_jsonable(incumbent_body)).encode()
+                    if status == 200 and wire.CONTENT_TYPE in (
+                            resp_headers.get("Content-Type", "")):
+                        data = json.dumps(
+                            wire.response_to_jsonable(data)).encode()
                 framed = struct.pack("<I", zlib.crc32(data)) + data
                 out = chaos.transform_bytes("serving.delivery.shadow",
                                             framed)
@@ -1113,7 +1262,7 @@ class FleetRouter:
             except Exception:
                 status = 0  # a connection fault is a candidate error
             diverged = dc.observe_shadow(
-                incumbent_body, status, data, incumbent_latency_s,
+                incumbent, status, data, incumbent_latency_s,
                 time.monotonic() - t0, corrupt=corrupt)
             if diverged:
                 self.metrics.record("shadow_diverged_total")
@@ -1985,11 +2134,12 @@ class FleetRouter:
                         f'worker="{wid}"}} {v}')
                     key = (model, cname)
                     agg_counters[key] = agg_counters.get(key, 0) + v
-                wire = (snap.get("histograms") or {}).get("request_latency")
-                if not wire:
+                hist_wire = (snap.get("histograms")
+                             or {}).get("request_latency")
+                if not hist_wire:
                     continue
                 try:
-                    h = LatencyHistogram.from_wire(wire)
+                    h = LatencyHistogram.from_wire(hist_wire)
                     if model in agg_hists:
                         agg_hists[model].merge(h)
                     else:
@@ -2016,6 +2166,18 @@ class FleetRouter:
         except Exception:
             pass  # capacity must never be able to break a scrape
         return "\n".join(lines) + "\n"
+
+    def _render_pool_metrics(self) -> str:
+        """Keep-alive pool gauges for the router's ``/metrics``
+        (ISSUE 18): how much TCP setup the pool is actually saving."""
+        s = self.pool.snapshot()
+        return "\n".join([
+            f"router_pool_idle_connections {s['idle_connections']}",
+            f"router_pool_created_total {s['created_total']}",
+            f"router_pool_reused_total {s['reused_total']}",
+            f"router_pool_discarded_total {s['discarded_total']}",
+            f"router_pool_invalidated_total {s['invalidated_total']}",
+        ]) + "\n"
 
     def _render_blackbox_metrics(self) -> str:
         """The ``journal_*`` + ``incident_*`` section of the router's
@@ -2210,6 +2372,15 @@ class FleetRouter:
         self._probe_cycle()  # workers registered+probed before first request
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 keep-alive (ISSUE 18): clients with connection
+            # pools (MultiRouterClient, the bench) reuse this socket;
+            # every _send sets Content-Length, which 1.1 requires
+            protocol_version = "HTTP/1.1"
+            timeout = 20.0
+            # headers and body go out in separate writes; without
+            # NODELAY, Nagle + delayed ACK stalls each response ~40ms
+            disable_nagle_algorithm = True
+
             def _send(self, code: int, headers: Dict[str, str],
                       body: bytes):
                 self.send_response(code)
@@ -2223,6 +2394,7 @@ class FleetRouter:
                 if self.path == "/metrics":
                     text = (router.metrics.render_prometheus(
                                 router.workers())
+                            + router._render_pool_metrics()
                             + router.render_fleet_metrics()
                             + router._render_blackbox_metrics()).encode()
                     self._send(200, {"Content-Type":
@@ -2256,7 +2428,8 @@ class FleetRouter:
                         and self.path.endswith("/predict")):
                     name = self.path[len("/v1/models/"):-len("/predict")]
                     code, headers, data = router._route_predict(
-                        name, raw, self.headers)
+                        name, raw, self.headers,
+                        ctype=self.headers.get("Content-Type"))
                 elif (self.path.startswith("/v1/models/")
                         and "/sessions" in self.path):
                     # session tier (ISSUE 16): pinned, never hedged
@@ -2307,7 +2480,9 @@ class FleetRouter:
             def log_message(self, *a):
                 pass
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        # KeepAliveHTTPServer: stop() must sever parked keep-alive
+        # connections, or pooled clients keep talking to a dead router
+        self._httpd = wire.KeepAliveHTTPServer((host, port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True, name="FleetRouter")
@@ -2324,7 +2499,11 @@ class FleetRouter:
         self._stop.set()
         if self._httpd:
             self._httpd.shutdown()
+            self._httpd.server_close()  # release the listener fd promptly
             self._httpd = None
         if self._prober:
             self._prober.join(timeout=5.0)
             self._prober = None
+        # parked keep-alives hold worker-side handler threads open;
+        # closing the pool releases both ends promptly
+        self.pool.close()

@@ -78,6 +78,7 @@ from __future__ import annotations
 
 import json
 import math
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -87,6 +88,7 @@ from urllib.parse import parse_qs, urlsplit
 import numpy as np
 
 from deeplearning4j_tpu.runtime import chaos, journal, trace
+from deeplearning4j_tpu.serving import wire
 from deeplearning4j_tpu.serving.admission import (
     DeadlineExceeded,
     Overloaded,
@@ -117,9 +119,17 @@ class ModelServer:
                  worker_id: Optional[str] = None,
                  slo: Optional[SLOMonitor] = None,
                  session_dir: Optional[str] = None,
-                 session_kw: Optional[dict] = None):
+                 session_kw: Optional[dict] = None,
+                 wire_enabled: Optional[bool] = None):
         self.registry = registry or ModelRegistry()
         self.worker_id = worker_id
+        # binary wire protocol (ISSUE 18): on by default; the
+        # DL4J_TPU_FORCE_JSON runbook knob (or wire_enabled=False) makes
+        # this worker answer 415 to binary frames so every sender
+        # transcodes to JSON — the negotiated compatibility fallback
+        if wire_enabled is None:
+            wire_enabled = not os.environ.get("DL4J_TPU_FORCE_JSON")
+        self.wire_enabled = bool(wire_enabled)
         # per-worker SLO attainment + burn rates (ISSUE 9); the router
         # keeps its own fleet-wide monitor over the same outcomes
         self.slo = slo or SLOMonitor()
@@ -148,8 +158,12 @@ class ModelServer:
                   if v is not None]
         return min(values) if values else None
 
-    def _handle_predict(self, name: str, raw: bytes, headers=None):
-        """Returns ``(status, json_body, extra_headers)``.
+    def _handle_predict(self, name: str, raw: bytes, headers=None,
+                        wire_proto: bool = False):
+        """Returns ``(status, body, extra_headers)`` — ``body`` is a
+        jsonable dict, or an encoded wire frame (bytes) for a binary
+        request's 200 (errors stay JSON on both protocols so a damaged
+        frame can never masquerade as a tensor).
 
         Tracing (ISSUE 9): when enabled, the whole predict runs inside a
         ``worker.predict`` span continuing the caller's trace off the
@@ -180,7 +194,8 @@ class ModelServer:
                 sp.set("model", name)
                 if self.worker_id is not None:
                     sp.set("worker", self.worker_id)
-            status, obj, hdrs = self._predict_inner(name, raw, h)
+            status, obj, hdrs = self._predict_inner(name, raw, h,
+                                                    wire_proto=wire_proto)
             latency_s = time.monotonic() - t0
             if sp.recording:
                 sp.set("status", status)
@@ -208,8 +223,11 @@ class ModelServer:
             })
         return status, obj, hdrs
 
-    def _predict_inner(self, name: str, raw: bytes, headers):
+    def _predict_inner(self, name: str, raw: bytes, headers,
+                       wire_proto: bool = False):
         chaos.inject("serving.worker.predict")
+        if wire_proto:
+            return self._predict_wire(name, raw, headers)
         hdrs = {}
         try:
             body = json.loads(raw.decode() or "{}")
@@ -245,6 +263,49 @@ class ModelServer:
                 x = np.asarray(inputs, dtype=_dt(None))  # ragged rows -> 400
         except Exception as e:
             return 400, {"error": f"malformed request body: {e}"}, hdrs
+        status, obj, hdrs, out = self._serve(name, x, timeout_ms, hdrs)
+        if status == 200:
+            obj = dict(obj, outputs=_to_jsonable(out))
+        return status, obj, hdrs
+
+    def _predict_wire(self, name: str, raw: bytes, headers):
+        """The binary-frame twin of the JSON parse path.  A frame that
+        fails validation is an EXPLICIT protocol error: 503 with reason
+        ``wire_protocol_error`` (retryable at the router — 400 would be
+        terminal), never a silently wrong tensor."""
+        hdrs = {}
+        try:
+            x, body_timeout_ms, fields, fr = wire.decode_predict_request(raw)
+        except wire.WireProtocolError as e:
+            trace.flag_current("fault")
+            return 503, {"error": "bad wire frame",
+                         "reason": "wire_protocol_error",
+                         "detail": str(e)}, hdrs
+        try:
+            # frame fields carry the control headers 1:1; an ACTUAL HTTP
+            # header wins (the router stamps the per-attempt shrunken
+            # X-Deadline-Ms on the hop itself)
+            eff = wire.fields_to_headers(fields)
+            eff.update({str(k): v for k, v in dict(headers or {}).items()})
+            timeout_ms = self._effective_timeout_ms(
+                body_timeout_ms, eff.get("X-Deadline-Ms"))
+            status, obj, hdrs, out = self._serve(name, x, timeout_ms, hdrs)
+        finally:
+            x = None  # drop tensor views so a shm-backed frame can close
+            fr.close()
+        if status == 200:
+            frame = wire.encode_predict_response(
+                name, obj.get("version"), out,
+                fields=wire.headers_to_fields(
+                    dict(hdrs, **({"X-Worker-Id": self.worker_id}
+                                  if self.worker_id is not None else {}))))
+            return 200, frame, hdrs
+        return status, obj, hdrs
+
+    def _serve(self, name, x, timeout_ms, hdrs):
+        """acquire -> predict -> classify, shared by both protocols.
+        Returns ``(status, obj, hdrs, out)`` where ``out`` is the raw
+        model output on 200 (the caller marshals it per protocol)."""
         # resolve the model OUTSIDE the submit try: a KeyError raised by a
         # multi-input forward (wrong input name) must not read as 404.
         # acquire() also PAGES IN a cold model (ISSUE 11) — the request
@@ -262,7 +323,7 @@ class ModelServer:
                 served = self.registry.get(name)
         except KeyError:
             return 404, {"error": f"model {name!r} not found",
-                         "models": self.registry.names()}, hdrs
+                         "models": self.registry.names()}, hdrs, None
         except PagingInProgress as e:
             # the deadline provably cannot cover the page-in: an HONEST
             # Retry-After from the measured page-in cost, not a generic 503
@@ -273,22 +334,22 @@ class ModelServer:
             trace.flag_current("shed")
             return 503, {"error": "paging in", "reason": "paging_in",
                          "retry_after_ms": retry_ms,
-                         "detail": str(e)}, hdrs
+                         "detail": str(e)}, hdrs, None
         except ServingError as e:
             # e.g. HBMBudgetExceeded mid-page-in: transient, retryable
             return 503, {"error": "unavailable", "reason": "paging_failed",
-                         "detail": str(e)}, hdrs
+                         "detail": str(e)}, hdrs, None
         except Exception as e:
             # a corrupt archive mid-page-in must not read as model fault 500
             return 503, {"error": "unavailable", "reason": "paging_failed",
-                         "detail": repr(e)}, hdrs
+                         "detail": repr(e)}, hdrs, None
         if deadline is not None:
             timeout_ms = max(0.0, (deadline - time.monotonic()) * 1000.0)
         try:
             out = served.predict(x, timeout_ms=timeout_ms)
         except CircuitOpen as e:
             return 503, {"error": "unavailable", "reason": "circuit_open",
-                         "detail": str(e)}, hdrs
+                         "detail": str(e)}, hdrs, None
         except Overloaded as e:
             retry_ms = getattr(e, "retry_after_ms", None)
             if retry_ms is not None:
@@ -298,18 +359,18 @@ class ModelServer:
                 hdrs["Retry-After-Ms"] = f"{retry_ms:.0f}"
             return 503, {"error": "overloaded", "reason": "overloaded",
                          "retry_after_ms": retry_ms,
-                         "detail": str(e)}, hdrs
+                         "detail": str(e)}, hdrs, None
         except DeadlineExceeded as e:
-            return 504, {"error": "deadline exceeded", "detail": str(e)}, hdrs
+            return (504, {"error": "deadline exceeded", "detail": str(e)},
+                    hdrs, None)
         except Exception as e:
-            return 500, {"error": repr(e)}, hdrs
+            return 500, {"error": repr(e)}, hdrs, None
         finally:
             unpin = getattr(served, "unpin", None)
             if unpin is not None:  # stubs have no pin ledger
                 unpin()
         hdrs["X-Model-Version"] = str(served.version)
-        return 200, {"model": name, "version": served.version,
-                     "outputs": _to_jsonable(out)}, hdrs
+        return (200, {"model": name, "version": served.version}, hdrs, out)
 
     def _handle_get(self, path: str):
         if path.startswith("/v1/journal"):
@@ -396,8 +457,10 @@ class ModelServer:
                     pass  # undeployed between listing and snapshot
             return 200, {"worker": self.worker_id, "models": models}
         if path == "/healthz":
-            # liveness only: the process is up and serving HTTP
-            return 200, {"status": "ok", "models": self.registry.names()}
+            # liveness only: the process is up and serving HTTP; "wire"
+            # advertises whether binary frames are accepted (ISSUE 18)
+            return 200, {"status": "ok", "models": self.registry.names(),
+                         "wire": self.wire_enabled}
         if path == "/readyz":
             # one snapshot for both fields so they can never disagree
             health = self.registry.health()
@@ -827,6 +890,8 @@ class ModelServer:
             pass  # capacity must never be able to break a scrape
         if self.sessions is not None:
             parts.append(self._render_sessions())
+        # binary transport frame/error counters (ISSUE 18)
+        parts.append("\n".join(wire.render_prometheus()))
         # the black box's ring health (ISSUE 15): journal_* gauges
         parts.append(journal.render_prometheus().rstrip("\n"))
         # the flywheel's label-join counters (ISSUE 17)
@@ -872,6 +937,18 @@ class ModelServer:
         profiler.attach_capacity(_capacity_provider)
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 keep-alive (ISSUE 18): the router's and client's
+            # connection pools reuse this socket across requests instead
+            # of paying TCP setup per hop (the 1.0 default closes every
+            # time).  Every _send sets Content-Length, which 1.1
+            # requires; ``timeout`` bounds how long an idle keep-alive
+            # connection may pin its handler thread.
+            protocol_version = "HTTP/1.1"
+            timeout = 20.0
+            # headers and body go out in separate writes; without
+            # NODELAY, Nagle + delayed ACK stalls each response ~40ms
+            disable_nagle_algorithm = True
+
             def _send(self, code: int, body: bytes, ctype: str,
                       extra=None):
                 self.send_response(code)
@@ -914,8 +991,18 @@ class ModelServer:
                 if (self.path.startswith("/v1/models/")
                         and self.path.endswith("/predict")):
                     name = self.path[len("/v1/models/"):-len("/predict")]
-                    code, obj, extra = srv._handle_predict(
-                        name, raw, headers=self.headers)
+                    ctype = (self.headers.get("Content-Type") or
+                             "").split(";")[0].strip()
+                    if ctype == wire.CONTENT_TYPE and not srv.wire_enabled:
+                        # negotiation: 415 tells the sender to transcode
+                        # to JSON and downgrade this endpoint
+                        code, obj, extra = 415, {
+                            "error": "binary wire protocol disabled",
+                            "reason": "wire_disabled"}, {}
+                    else:
+                        code, obj, extra = srv._handle_predict(
+                            name, raw, headers=self.headers,
+                            wire_proto=ctype == wire.CONTENT_TYPE)
                 elif (self.path.startswith("/v1/models/")
                         and self.path.endswith("/replicas")):
                     name = self.path[len("/v1/models/"):-len("/replicas")]
@@ -962,8 +1049,11 @@ class ModelServer:
                     code, obj, extra = (404,
                                         {"error": f"unknown path "
                                                   f"{self.path!r}"}, {})
-                self._send(code, json.dumps(obj).encode(),
-                           "application/json", extra=extra)
+                if isinstance(obj, bytes):  # a 200 wire frame
+                    self._send(code, obj, wire.CONTENT_TYPE, extra=extra)
+                else:
+                    self._send(code, json.dumps(obj).encode(),
+                               "application/json", extra=extra)
 
             def do_DELETE(self):
                 if (self.path.startswith("/v1/models/")
@@ -982,7 +1072,9 @@ class ModelServer:
             def log_message(self, *a):
                 pass
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        # KeepAliveHTTPServer: stop() must sever parked keep-alive
+        # connections, or pooled routers keep talking to a dead worker
+        self._httpd = wire.KeepAliveHTTPServer((host, port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True, name="ModelServer")
@@ -992,6 +1084,7 @@ class ModelServer:
     def stop(self, shutdown_registry: bool = False) -> None:
         if self._httpd:
             self._httpd.shutdown()
+            self._httpd.server_close()  # release the listener fd promptly
             self._httpd = None
         if self.sessions is not None:
             # spill-at-exit: a graceful stop leaves every stream

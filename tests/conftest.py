@@ -31,6 +31,7 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import gc  # noqa: E402
 import glob  # noqa: E402
 import json  # noqa: E402
 import re  # noqa: E402
@@ -183,6 +184,29 @@ def _no_stray_pipeline_threads():
         time.sleep(0.05)
         names = stray()
     assert not names, f"stray training-pipeline threads leaked: {names}"
+
+
+@pytest.fixture
+def fd_guard():
+    """ISSUE 18 guard (opt-in by name): the test must not leak file
+    descriptors — keep-alive pools park sockets, and a pool that forgets
+    to close them shows up here. Counts ``/proc/self/fd`` before and
+    after with a grace window (TIME_WAIT teardown, GC of dropped
+    connections) and a small tolerance for allocator noise."""
+    fd_dir = "/proc/self/fd"
+    if not os.path.isdir(fd_dir):          # non-Linux: nothing to count
+        yield
+        return
+    before = len(os.listdir(fd_dir))
+    yield
+    deadline = time.monotonic() + 5.0
+    after = len(os.listdir(fd_dir))
+    while after > before + 4 and time.monotonic() < deadline:
+        gc.collect()
+        time.sleep(0.05)
+        after = len(os.listdir(fd_dir))
+    assert after <= before + 4, \
+        f"fd leak: {before} open before the test, {after} after"
 
 
 def _assert_no_orphaned_workers(module_name: str, kind: str,

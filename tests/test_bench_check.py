@@ -1266,3 +1266,104 @@ def test_check_tables_delivery_absent_is_warning(tmp_path):
     msgs = []
     assert bench.check_tables(str(md), str(extra), log=msgs.append) == 0
     assert any("delivery" in m and "WARN" in m for m in msgs)
+
+
+def _wire_section():
+    """A self-consistent BENCH_EXTRA.json["wire"] section (the ISSUE 18
+    routed transport A/B record)."""
+    return {
+        "n_threads": 4,
+        "per_thread": 20,
+        "rows_per_request": 4,
+        "features": 4096,
+        "json": {"qps": 30.0, "device_idle_fraction": 0.79,
+                 "bit_identical": True},
+        "json_keepalive": {"qps": 33.0, "device_idle_fraction": 0.78,
+                           "bit_identical": True},
+        "binary": {"qps": 240.0, "device_idle_fraction": 0.64,
+                   "bit_identical": True},
+        "speedup": 8.0,
+        "keepalive_speedup": 1.1,
+        "idle_fraction_delta": 0.15,
+        "protocol_errors_clean_arms": 0,
+        "shm_hops_total": 168,
+        "zero_copy_rows_total": 672,
+    }
+
+
+def _extra_with_wire(section):
+    measured = {k: _mid(*rng) for k, rng in bench.RECORDED_RANGES.items()}
+    measured["wire"] = section
+    measured["wire_routed_speedup"] = section["speedup"]
+    return measured
+
+
+def test_check_tables_validates_wire_section(tmp_path):
+    """ISSUE 18 satellite: --check-tables covers the wire-transport keys
+    — a self-consistent A/B record passes; a non-bit-identical arm, a
+    speedup the recorded qps rows can't reproduce, a speedup under the
+    3x contract, a keepalive speedup that doesn't recompute, an
+    idle-fraction delta that disagrees with the arm fractions (or isn't
+    a reduction), protocol errors in the clean arms, an out-of-range
+    idle fraction, a missing key, or a stale top-level copy all fail
+    loudly."""
+    md = tmp_path / "BASELINE.md"
+    md.write_text(_table_md(bench.RECORDED_RANGES))
+    extra = tmp_path / "BENCH_EXTRA.json"
+
+    extra.write_text(json.dumps(_extra_with_wire(_wire_section())))
+    assert bench.check_tables(str(md), str(extra), log=lambda *a: None) == 0
+
+    def failing(mutate, needle):
+        sec = _wire_section()
+        mutate(sec)
+        extra.write_text(json.dumps(_extra_with_wire(sec)))
+        msgs = []
+        assert bench.check_tables(str(md), str(extra),
+                                  log=msgs.append) == 1, needle
+        assert any(needle in m for m in msgs), (needle, msgs)
+
+    failing(lambda s: s["binary"].update(bit_identical=False),
+            "wire.binary: bit_identical")
+    failing(lambda s: s["json"].update(bit_identical=False),
+            "wire.json: bit_identical")
+    failing(lambda s: s.update(speedup=5.0), "qps rows give")
+    failing(lambda s: (s["binary"].update(qps=60.0), s.update(speedup=2.0)),
+            "under the 3x contract")
+    failing(lambda s: s.update(keepalive_speedup=3.0),
+            "wire.keepalive_speedup")
+    failing(lambda s: s.update(idle_fraction_delta=0.4),
+            "recorded arm fractions give")
+    failing(lambda s: (s["binary"].update(device_idle_fraction=0.85),
+                       s.update(idle_fraction_delta=-0.06)),
+            "did not reduce device idle time")
+    failing(lambda s: s.update(protocol_errors_clean_arms=2),
+            "wire.protocol_errors_clean_arms")
+    failing(lambda s: s["json"].update(device_idle_fraction=1.4),
+            "not a fraction in [0, 1]")
+    failing(lambda s: s.pop("idle_fraction_delta"),
+            "missing from the recorded section")
+
+    # a malformed section (arm is not a dict) is a failure, not a crash
+    failing(lambda s: s.update(json=3.0), "wire")
+
+    # stale top-level copy
+    ex = _extra_with_wire(_wire_section())
+    ex["wire_routed_speedup"] = 2.0
+    extra.write_text(json.dumps(ex))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("wire_routed_speedup: top-level copy" in m for m in msgs)
+
+
+def test_check_tables_wire_absent_is_warning(tmp_path):
+    """No --wire run recorded yet -> warn, don't fail (same contract as
+    the other optional sections)."""
+    md = tmp_path / "BASELINE.md"
+    md.write_text(_table_md(bench.RECORDED_RANGES))
+    measured = {k: _mid(*rng) for k, rng in bench.RECORDED_RANGES.items()}
+    extra = tmp_path / "BENCH_EXTRA.json"
+    extra.write_text(json.dumps(measured))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 0
+    assert any("wire" in m and "WARN" in m for m in msgs)

@@ -1,0 +1,505 @@
+"""Binary wire transport (ISSUE 18): codec, negotiation, pools, shm.
+
+Layers:
+
+- **Codec** — frame round-trips (single/multi tensor, int8, fields and
+  timeout carry), and the damage drills: corruption, truncation, and
+  bit flips — manual and via the ``serving.wire.frame`` chaos byte
+  point — are all counted :class:`WireProtocolError`s, never a tensor.
+- **Negotiation matrix** — binary client ↔ JSON-only worker (router
+  transcodes, caches the 415 verdict), JSON client ↔ binary worker,
+  mid-stream downgrade when a worker stops speaking binary, and a
+  hedged request whose two attempts ride different protocols yet the
+  winner is bit-identical.
+- **Pools** — keep-alive reuse, retry-once on a stale parked
+  connection, breaker-open and worker-restart invalidation, and no fd
+  leak under the conftest ``fd_guard``.
+- **Zero-copy + shm** — binary rows land read-only in the batcher
+  (``serving_zero_copy_rows_total``), the shared-memory fast path
+  round-trips and releases its segments, and a chaos-corrupted shm
+  frame is retried inline (``router_shm_fallbacks_total``) with a
+  correct answer.
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.runtime import chaos, journal
+from deeplearning4j_tpu.serving import ModelRegistry, ModelServer, wire
+from deeplearning4j_tpu.serving.resilience import CircuitState
+from deeplearning4j_tpu.serving.router import (FleetRouter, StaticFleet,
+                                               _Attempt)
+
+
+def _conf(seed=7):
+    return (NeuralNetConfiguration.builder().seed(seed).updater(None)
+            .list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=4, activation="softmax"))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+
+
+RNG = np.random.default_rng(0)
+X = RNG.normal(size=(16, 8)).astype(np.float32)
+BATCHER_KW = dict(max_batch_size=4, buckets=[1, 4], batch_timeout_ms=1.0,
+                  pipeline_depth=0)
+
+
+def _wait_ready(router, n, timeout_s=10.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        ws = router.workers()
+        if len(ws) >= n and all(v.ready for v in ws.values()):
+            return
+        time.sleep(0.02)
+    raise AssertionError("workers never became ready")
+
+
+@pytest.fixture(scope="module")
+def duo():
+    """One wire-enabled and one JSON-only worker over identically seeded
+    nets, plus the oracle output for X[:4] (bucket 4 = exact)."""
+    servers, registries, endpoints = [], [], {}
+    for i, wire_enabled in enumerate((True, False)):
+        reg = ModelRegistry()
+        reg.register("m", MultiLayerNetwork(_conf()).init(),
+                     warmup_example=X[:1], **BATCHER_KW)
+        srv = ModelServer(reg, worker_id=f"w{i}", wire_enabled=wire_enabled)
+        endpoints[f"w{i}"] = f"127.0.0.1:{srv.start(0)}"
+        servers.append(srv)
+        registries.append(reg)
+    ref = np.asarray(registries[0].predict("m", X[:4]))
+    yield endpoints, registries, servers, ref
+    for srv in servers:
+        srv.stop(shutdown_registry=True)
+
+
+def _predict_wire(pool, port, frame, timeout=60):
+    return pool.request(f"127.0.0.1:{port}", "POST", "/v1/models/m/predict",
+                        body=frame,
+                        headers={"Content-Type": wire.CONTENT_TYPE},
+                        timeout=timeout)
+
+
+def _decode_any(headers, data):
+    """Decode a predict response on either protocol into an f32 array."""
+    ctype = next((v for k, v in headers.items()
+                  if k.lower() == "content-type"), "")
+    if ctype.split(";")[0].strip() == wire.CONTENT_TYPE:
+        _, _, out, fr = wire.decode_predict_response(data)
+        try:
+            return np.array(out)
+        finally:
+            out = None
+            fr.close()
+    return np.asarray(json.loads(data)["outputs"], dtype=np.float32)
+
+
+# ==========================================================================
+# codec
+def test_frame_roundtrip_single_multi_and_int8():
+    x = X[:3]
+    raw = wire.encode_predict_request(x, timeout_ms=1234,
+                                      headers={"X-Request-Id": "r-1"})
+    got, timeout_ms, fields, fr = wire.decode_predict_request(raw)
+    assert timeout_ms == 1234
+    assert fields["request_id"] == "r-1"
+    assert got.dtype == np.float32 and got.tobytes() == x.tobytes()
+    assert not got.flags.writeable        # zero-copy view over the frame
+    fr.close()
+
+    multi = {"a": X[:2], "b": (X[:2, :4] * 3).astype(np.int8)}
+    raw = wire.encode_predict_request(multi)
+    got, _, _, fr = wire.decode_predict_request(raw)
+    assert set(got) == {"a", "b"}
+    assert got["b"].dtype == np.int8
+    assert got["a"].tobytes() == multi["a"].tobytes()
+    assert got["b"].tobytes() == multi["b"].tobytes()
+    fr.close()
+
+    resp = wire.encode_predict_response("m", 3, X[:2],
+                                        fields={"worker_id": "w9"})
+    name, version, out, fr = wire.decode_predict_response(resp)
+    assert (name, version) == ("m", 3)
+    assert np.array(out).tobytes() == X[:2].tobytes()
+    assert fr.meta["fields"]["worker_id"] == "w9"
+    fr.close()
+
+
+def test_damaged_frames_are_counted_protocol_errors_never_tensors():
+    wire.reset_counters()
+    raw = wire.encode_predict_request(X[:2], timeout_ms=500)
+    cases = []
+    flipped = bytearray(raw)
+    flipped[len(raw) // 2] ^= 0x01        # one bit, mid-payload
+    cases.append(bytes(flipped))
+    cases.append(raw[: len(raw) - 3])     # truncated tail
+    cases.append(b"NOPE" + raw[4:])       # bad magic
+    cases.append(raw[:4] + b"\xff" + raw[5:])  # unknown version
+    bad_meta = bytearray(raw)
+    bad_meta[24] ^= 0xFF                  # corrupt the JSON meta block
+    cases.append(bytes(bad_meta))
+    for bad in cases:
+        with pytest.raises(wire.WireProtocolError):
+            wire.decode_frame(bad)
+    assert wire.counters()["protocol_errors_total"] == len(cases)
+
+
+def test_chaos_byte_point_drills_flip_and_truncate():
+    """The registered ``serving.wire.frame`` point: chaos-mangled frames
+    (bit rot and torn writes) decode to explicit protocol errors."""
+    wire.reset_counters()
+    for policy in (chaos.CorruptBytes(n_bytes=4, mode="flip"),
+                   chaos.CorruptBytes(mode="truncate")):
+        with chaos.ChaosController(seed=3) as c:
+            c.on("serving.wire.frame", policy)
+            raw = wire.encode_predict_request(X[:4])
+            with pytest.raises(wire.WireProtocolError):
+                got, _, _, fr = wire.decode_predict_request(raw)
+                fr.close()                # pragma: no cover (must raise)
+    assert wire.counters()["protocol_errors_total"] == 2
+    # clean arm: no controller, the same encode/decode round-trips
+    raw = wire.encode_predict_request(X[:4])
+    got, _, _, fr = wire.decode_predict_request(raw)
+    assert got.tobytes() == X[:4].tobytes()
+    fr.close()
+    assert wire.counters()["protocol_errors_total"] == 2
+
+
+def test_header_field_mapping_roundtrip_and_case_insensitivity():
+    headers = {k: f"v{i}" for i, k in enumerate(wire.HEADER_FIELDS)}
+    fields = wire.headers_to_fields(headers)
+    assert set(fields) == set(wire.HEADER_FIELDS.values())
+    assert wire.fields_to_headers(fields) == headers
+    # lower-cased spellings map to the canonical header; strangers drop
+    assert wire.headers_to_fields({"x-request-id": "a", "X-Mystery": "b",
+                                   "Content-Type": "c"}) \
+        == {"request_id": "a"}
+    assert wire.fields_to_headers({"request_id": "a", "mystery": "b"}) \
+        == {"X-Request-Id": "a"}
+
+
+def test_shm_frame_roundtrip_and_min_bytes_gate():
+    raw = wire.encode_predict_request(X)   # 16*8*4 = 512 payload bytes
+    small, seg = wire.frame_to_shm(raw, min_bytes=100000)
+    assert small is raw and seg is None    # below the gate: untouched
+    shm_raw, seg = wire.frame_to_shm(raw, min_bytes=128)
+    assert seg is not None and len(shm_raw) < len(raw)
+    try:
+        got, _, _, fr = wire.decode_predict_request(shm_raw)
+        assert got.tobytes() == X.tobytes()
+        got = None
+        fr.close()
+    finally:
+        wire.release_shm(seg)
+
+
+# ==========================================================================
+# connection pool
+def test_pool_reuses_connections_and_bounds_idle(duo):
+    endpoints, _, _, _ = duo
+    address = endpoints["w0"]
+    pool = wire.ConnectionPool(max_idle_per_endpoint=2)
+    try:
+        for _ in range(5):
+            status, _, _ = pool.request(address, "GET", "/healthz",
+                                        body=None, headers={}, timeout=30)
+            assert status == 200
+        snap = pool.snapshot()
+        assert snap["created_total"] == 1
+        assert snap["reused_total"] == 4
+        assert pool.idle_count(address) == 1   # bounded LIFO park
+        pool.invalidate(address)
+        assert pool.idle_count(address) == 0
+        assert pool.snapshot()["invalidated_total"] == 1
+    finally:
+        pool.close()
+
+
+def test_pool_retry_once_on_stale_reused_connection(duo):
+    """A parked keep-alive whose socket died underneath it is discarded
+    and the request transparently retried once on a fresh connection —
+    the caller never sees the stale socket."""
+    endpoints, _, _, _ = duo
+    address = endpoints["w0"]
+    pool = wire.ConnectionPool()
+    try:
+        status, _, _ = pool.request(address, "GET", "/healthz",
+                                    body=None, headers={}, timeout=30)
+        assert status == 200 and pool.idle_count(address) == 1
+        # kill the parked socket out from under the pool (the server-side
+        # idle timeout / a silent peer reset does exactly this in prod)
+        parked, _t = pool._idle[address][-1]
+        parked.sock.close()
+        status, _, _ = pool.request(address, "GET", "/healthz",
+                                    body=None, headers={}, timeout=30)
+        assert status == 200
+        snap = pool.snapshot()
+        assert snap["discarded_total"] == 1    # the stale conn, silently
+        assert snap["created_total"] == 2      # original + the retry
+        assert snap["reused_total"] == 1       # the attempt that failed
+    finally:
+        pool.close()
+
+
+def test_breaker_open_and_restart_drop_pooled_connections(duo):
+    endpoints, _, _, _ = duo
+
+    class MutableFleet:
+        def __init__(self, eps):
+            self.eps = dict(eps)
+
+        def endpoints(self):
+            return dict(self.eps)
+
+    fleet = MutableFleet({"w0": endpoints["w0"]})
+    router = FleetRouter(fleet, probe_interval_s=3600.0)
+    try:
+        router._sync_views()
+        view = router.workers()["w0"]
+        # park a real keep-alive to the worker through the router's pool
+        status, _, _ = router.pool.request(view.address, "GET", "/healthz",
+                                           body=None, headers={},
+                                           timeout=30)
+        assert status == 200 and router.pool.idle_count(view.address) == 1
+        # drive the breaker OPEN, then classify one more 5xx: the parked
+        # connection must not outlive the verdict
+        while view.breaker.state is not CircuitState.OPEN:
+            view.breaker.record_failure()
+        attempt = _Attempt(view, hedged=False)
+        attempt.status = 500
+        router._classify(attempt)
+        assert router.pool.idle_count(view.address) == 0
+        # worker restart = same id, new address: _sync_views drops the
+        # old address's parked connections too
+        status, _, _ = router.pool.request(view.address, "GET", "/healthz",
+                                           body=None, headers={},
+                                           timeout=30)
+        assert router.pool.idle_count(view.address) == 1
+        old_address = view.address
+        fleet.eps["w0"] = endpoints["w1"]
+        router._sync_views()
+        assert router.pool.idle_count(old_address) == 0
+        assert router.pool.snapshot()["invalidated_total"] >= 2
+    finally:
+        router.stop()
+
+
+def test_pool_no_fd_leak(duo, fd_guard):
+    endpoints, _, _, _ = duo
+    pool = wire.ConnectionPool()
+    for _ in range(6):
+        pool.request(endpoints["w0"], "GET", "/healthz",
+                     body=None, headers={}, timeout=30)
+    pool.close()
+
+
+# ==========================================================================
+# negotiation matrix over real workers
+def test_binary_end_to_end_bit_identical_and_zero_copy(duo):
+    endpoints, registries, _, ref = duo
+    router = FleetRouter(StaticFleet({"w0": endpoints["w0"]}),
+                         probe_interval_s=0.05, hedge_initial_ms=2000.0)
+    port = router.start(0)
+    pool = wire.ConnectionPool()
+    wire.reset_counters()
+    zero_before = registries[0].get("m").metrics.snapshot()[
+        "zero_copy_rows_total"]
+    try:
+        _wait_ready(router, 1)
+        frame = wire.encode_predict_request(X[:4], timeout_ms=10000)
+        for _ in range(3):
+            status, headers, data = _predict_wire(pool, port, frame)
+            assert status == 200
+            out = _decode_any(headers, data)
+            assert out.tobytes() == ref.tobytes()
+        snap = router.metrics.snapshot()
+        assert snap["wire_requests_total"] == 3
+        assert snap["wire_downgrades_total"] == 0
+        assert router.workers()["w0"].wire_ok is True
+        assert wire.counters()["protocol_errors_total"] == 0
+        zero_after = registries[0].get("m").metrics.snapshot()[
+            "zero_copy_rows_total"]
+        assert zero_after - zero_before == 3 * 4   # every row zero-copy
+    finally:
+        pool.close()
+        router.stop()
+
+
+def test_binary_client_json_only_worker_downgrades_bit_identical(duo):
+    endpoints, _, _, ref = duo
+    router = FleetRouter(StaticFleet({"w1": endpoints["w1"]}),
+                         probe_interval_s=0.05, hedge_initial_ms=2000.0)
+    port = router.start(0)
+    pool = wire.ConnectionPool()
+    journal.enable(capacity=2048)
+    try:
+        _wait_ready(router, 1)
+        frame = wire.encode_predict_request(X[:4], timeout_ms=10000)
+        for k in range(2):
+            status, headers, data = _predict_wire(pool, port, frame)
+            assert status == 200
+            out = _decode_any(headers, data)    # JSON body: transcoded
+            assert out.tobytes() == ref.tobytes()
+        snap = router.metrics.snapshot()
+        assert snap["wire_downgrades_total"] == 1   # 415 verdict cached
+        assert router.workers()["w1"].wire_ok is False
+        # the downgrade is a black-box event: one typed journal entry
+        downs = journal.events(types=["router.wire_downgrade"])
+        assert len(downs) == 1 and downs[0]["attrs"]["worker"] == "w1"
+    finally:
+        pool.close()
+        router.stop()
+
+
+def test_json_client_through_wire_enabled_fleet_unchanged(duo):
+    endpoints, _, _, ref = duo
+    router = FleetRouter(StaticFleet({"w0": endpoints["w0"]}),
+                         probe_interval_s=0.05, hedge_initial_ms=2000.0)
+    port = router.start(0)
+    try:
+        _wait_ready(router, 1)
+        body = json.dumps({"inputs": X[:4].tolist(), "dtype": "float32",
+                           "timeout_ms": 10000}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/m/predict", data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=60) as r:
+            out = np.asarray(json.loads(r.read())["outputs"], np.float32)
+        assert out.tobytes() == ref.tobytes()
+        assert router.metrics.snapshot()["wire_requests_total"] == 0
+    finally:
+        router.stop()
+
+
+def test_mid_stream_downgrade_when_worker_stops_speaking_binary(duo):
+    endpoints, _, servers, ref = duo
+    wire_srv = servers[0]
+    router = FleetRouter(StaticFleet({"w0": endpoints["w0"]}),
+                         probe_interval_s=0.05, hedge_initial_ms=2000.0)
+    port = router.start(0)
+    pool = wire.ConnectionPool()
+    try:
+        _wait_ready(router, 1)
+        frame = wire.encode_predict_request(X[:4], timeout_ms=10000)
+        status, headers, data = _predict_wire(pool, port, frame)
+        assert status == 200
+        assert router.workers()["w0"].wire_ok is True
+        wire_srv.wire_enabled = False      # ops flipped the force-JSON lever
+        status, headers, data = _predict_wire(pool, port, frame)
+        assert status == 200               # 415 absorbed: transcode + retry
+        out = _decode_any(headers, data)
+        assert out.tobytes() == ref.tobytes()
+        assert router.workers()["w0"].wire_ok is False
+        assert router.metrics.snapshot()["wire_downgrades_total"] == 1
+    finally:
+        wire_srv.wire_enabled = True
+        pool.close()
+        router.stop()
+
+
+def test_hedged_request_mixed_protocols_winner_bit_identical(duo):
+    """Primary straggles; the hedge lands on the other worker. One view
+    speaks binary, the other is JSON-only — whichever wins, the client
+    sees exactly one bit-identical response."""
+    endpoints, _, servers, ref = duo
+    router = FleetRouter(StaticFleet(endpoints), probe_interval_s=0.05,
+                         hedge_initial_ms=50.0)
+    port = router.start(0)
+    pool = wire.ConnectionPool()
+    slowed = None
+    try:
+        _wait_ready(router, 2)
+        primary = router.ranked_workers("m")[0].worker_id
+        slowed = servers[0] if primary == "w0" else servers[1]
+        orig = slowed._handle_predict
+
+        def slow_predict(*args, **kw):
+            time.sleep(0.4)
+            return orig(*args, **kw)
+
+        slowed._handle_predict = slow_predict
+        frame = wire.encode_predict_request(X[:4], timeout_ms=10000)
+        status, headers, data = _predict_wire(pool, port, frame)
+        assert status == 200
+        out = _decode_any(headers, data)
+        assert out.tobytes() == ref.tobytes()
+        snap = router.metrics.snapshot()
+        assert snap["hedges_total"] >= 1
+        assert snap["responses_total"] == 1    # exactly one delivered
+    finally:
+        if slowed is not None:
+            slowed._handle_predict = orig
+        pool.close()
+        router.stop()
+
+
+# ==========================================================================
+# corrupt frames over HTTP + the shm retry drill
+def test_corrupt_frame_is_503_protocol_error_at_router_and_worker(duo):
+    endpoints, _, _, _ = duo
+    router = FleetRouter(StaticFleet({"w0": endpoints["w0"]}),
+                         probe_interval_s=0.05, hedge_initial_ms=2000.0)
+    port = router.start(0)
+    pool = wire.ConnectionPool()
+    try:
+        _wait_ready(router, 1)
+        frame = bytearray(wire.encode_predict_request(X[:4]))
+        frame[30] ^= 0xFF
+        for target_port in (port, int(endpoints["w0"].rsplit(":", 1)[1])):
+            status, headers, data = _predict_wire(pool, target_port,
+                                                  bytes(frame))
+            obj = json.loads(data)            # errors are ALWAYS JSON
+            assert status == 503
+            assert obj["reason"] == "wire_protocol_error"
+    finally:
+        pool.close()
+        router.stop()
+
+
+def test_chaos_corrupted_shm_frame_retries_inline_correct_answer(duo):
+    """Damage on the shm re-encode (the router->worker hop) is a counted
+    protocol error the router absorbs by resending inline — the client
+    still gets the right tensor, never a wrong one."""
+    endpoints, _, _, ref = duo
+    router = FleetRouter(StaticFleet({"w0": endpoints["w0"]}),
+                         probe_interval_s=0.05, hedge_initial_ms=2000.0,
+                         shm_min_bytes=64)
+    port = router.start(0)
+    pool = wire.ConnectionPool()
+    try:
+        _wait_ready(router, 1)
+        # encode the client frame OUTSIDE the controller so the router's
+        # shm re-encode is the first encode the controller sees. Call
+        # indices are 1-based and shared between inject/transform, and
+        # every encode_frame consumes two (fire then transform) — so the
+        # shm re-encode's TRANSFORM is call #2, and the worker's
+        # response encode (#3/#4) stays clean
+        frame = wire.encode_predict_request(X[:4], timeout_ms=10000)
+        wire.reset_counters()
+        with chaos.ChaosController(seed=11) as c:
+            c.on("serving.wire.frame",
+                 chaos.CorruptBytes(n_bytes=4, mode="flip", nth=2))
+            status, headers, data = _predict_wire(pool, port, frame)
+        assert status == 200
+        out = _decode_any(headers, data)
+        assert out.tobytes() == ref.tobytes()
+        snap = router.metrics.snapshot()
+        assert snap["shm_fallbacks_total"] == 1
+        assert wire.counters()["protocol_errors_total"] >= 1
+        # clean follow-up rides shm again
+        status, headers, data = _predict_wire(pool, port, frame)
+        assert status == 200
+        assert _decode_any(headers, data).tobytes() == ref.tobytes()
+        assert router.metrics.snapshot()["shm_hops_total"] >= 1
+    finally:
+        pool.close()
+        router.stop()
