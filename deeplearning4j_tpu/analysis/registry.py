@@ -26,6 +26,7 @@ PIPELINE_THREAD_NAMES = (
     "lease-election",
     "session-evictor",          # SessionStore idle-TTL/byte-budget sweeper
     "stream-writer",            # per-stream SSE writer (joined by handler)
+    "fleet-scheduler",          # background-job control tick + "-job" runner
 )
 
 # Every thread the package spawns must carry a name starting with one of
@@ -61,6 +62,7 @@ METRIC_NAMESPACES = (
     "aot_",                     # AOT dispatch fast-path ledger (ISSUE 5)
     "journal_",                 # event-journal ring health (ISSUE 15)
     "incident_",                # anomaly-watchdog incidents (ISSUE 15)
+    "scheduler_",               # background-job scheduler (ISSUE 19)
 )
 
 # Package directories whose code affects numeric trajectories — the

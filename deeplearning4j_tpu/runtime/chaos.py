@@ -127,6 +127,7 @@ REGISTERED_POINTS: Dict[str, str] = {
     "serving.session.step": "top of every streaming-session step",
     "serving.session.rehydrate": "session spill read-back; also a byte point over the CRC-framed spill frame",
     "serving.wire.frame": "binary wire-frame encode; also a byte point over the CRC-framed frame",
+    "serving.scheduler.claim": "background scheduler, before each exactly-once job claim on the ledger",
 }
 
 

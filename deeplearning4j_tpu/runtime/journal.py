@@ -118,6 +118,14 @@ EVENT_TYPES: Dict[str, str] = {
     "session.migrate": "session moved workers (rehydrated a foreign spill)",
     "session.evict": "session memory copy dropped (idle TTL or byte budget)",
     "session.close": "streaming session closed; spill file deleted",
+    "scheduler.submit": "background job submitted to the shared job store",
+    "scheduler.claim": "scheduler claim attempt on a job (won or lost the ledger race)",
+    "scheduler.start": "claimed job started running on a worker's spare capacity",
+    "scheduler.preempt": "traffic preempted a running job (checkpointed mid-run)",
+    "scheduler.resume": "preempted job resumed from its checkpoint (exact batch-skip)",
+    "scheduler.complete": "background job ran to completion (result recorded)",
+    "scheduler.fail": "background job raised; failure recorded in the job store",
+    "scheduler.cancel": "background job cancelled before completion",
 }
 
 #: per-process-incarnation id: a restarted worker starts a fresh seq

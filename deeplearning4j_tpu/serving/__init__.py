@@ -103,6 +103,14 @@ The subsystem that puts traffic on this stack:
   labels joined against the access log into an append-only
   labeled-example file). Driven fleet-wide by
   ``FleetRouter.rolling_deploy(strategy="gated")``.
+- :class:`Scheduler` / :class:`JobStore` (``scheduler.py``, ISSUE 19,
+  ``docs/fleet_serving.md`` "Background scheduler") — the Arbiter
+  analog: preemptible background fine-tunes / golden-set evals / batch
+  scoring / random-grid sweeps / the feedback flywheel, run on serving
+  workers' measured spare capacity, admission-gated by the live
+  capacity/SLO signals, preempted within one control tick with
+  bit-exact batch-skip resume, exactly-once claimed through the
+  :class:`FleetConfig` ledger, every transition a journal event.
 - :class:`WarmupManifest` (``manifest.py``) — persisted record of every
   compiled (bucket, replica, dtype) pair, written next to model archives
   and replayed by registry load / hot-swap so a restart reaches READY
@@ -159,6 +167,9 @@ _EXPORTS = {
     "FleetRouter": "router",
     "RouterMetrics": "router",
     "StaticFleet": "router",
+    "JobStore": "scheduler",
+    "Scheduler": "scheduler",
+    "SchedulerConfig": "scheduler",
     "FleetSupervisor": "fleet",
     "WorkerSpec": "fleet",
     "Replica": "replica",

@@ -51,7 +51,29 @@ from typing import Any, Dict, Optional
 
 __all__ = ["model_capacity", "process_capacity", "registry_capacity",
            "render_prometheus", "persistent_cache_bytes",
-           "served_device_bytes", "served_device_dtype_bytes"]
+           "served_device_bytes", "served_device_dtype_bytes",
+           "attach_harvest", "detach_harvest", "device_utilization"]
+
+# The background scheduler (ISSUE 19) registers a zero-arg provider here
+# returning ``{"harvested_busy_s": float, ...}`` — the device-seconds its
+# job steps measurably used. ``registry_capacity`` folds that into the
+# idle-fraction headline so ``/v1/capacity`` reports what the devices
+# actually did, not just what traffic did. One scheduler per process, so
+# a single module slot (plain assignment — no lock needed for a swap).
+_HARVEST_PROVIDER = None
+
+
+def attach_harvest(provider) -> None:
+    """Register the process's background-harvest provider (a zero-arg
+    callable returning at least ``harvested_busy_s``); pass ``None`` or
+    call :func:`detach_harvest` to clear it."""
+    global _HARVEST_PROVIDER
+    _HARVEST_PROVIDER = provider
+
+
+def detach_harvest() -> None:
+    global _HARVEST_PROVIDER
+    _HARVEST_PROVIDER = None
 
 
 def _leaf_bytes(tree) -> Dict[str, int]:
@@ -245,6 +267,42 @@ def process_capacity() -> Dict[str, Any]:
     }
 
 
+def device_utilization(models: Dict[str, Any],
+                       harvested_busy_s: float = 0.0) -> Dict[str, Any]:
+    """The worker-level busy-window section (ISSUE 19 satellite): sums
+    the per-model summable ``(busy_s, window_s)`` pairs into device-time
+    terms and derives the ``device_idle_fraction`` headline that was
+    previously computed only inside ``bench.py``.
+
+    ``device_window_s`` is the serving-side proxy for available device
+    time: each model's metrics window multiplied by its replica count.
+    ``harvested_busy_s`` (measured background-job step seconds from the
+    scheduler, when one is attached) joins the busy numerator — both
+    counters run since their last reset, so an aligned measurement
+    resets the serving metrics window and the scheduler's harvest
+    counter together (``bench.py --scheduler`` does). The raw terms are
+    all exported so the fleet aggregation can sum numerators and
+    denominators across workers and divide ONCE at the edge."""
+    busy_s = sum(m["utilization"]["busy_s"] for m in models.values())
+    device_window_s = sum(m["utilization"]["window_s"] * m["replicas"]
+                          for m in models.values())
+    replicas = sum(m["replicas"] for m in models.values())
+    if device_window_s > 0:
+        serving_busy = busy_s / device_window_s
+        idle = max(0.0, 1.0 - (busy_s + harvested_busy_s)
+                   / device_window_s)
+    else:
+        serving_busy, idle = 0.0, 1.0
+    return {
+        "busy_s": round(busy_s, 6),
+        "harvested_busy_s": round(harvested_busy_s, 6),
+        "device_window_s": round(device_window_s, 3),
+        "replicas": replicas,
+        "serving_busy_fraction": round(serving_busy, 6),
+        "device_idle_fraction": round(idle, 6),
+    }
+
+
 def registry_capacity(registry) -> Dict[str, Any]:
     """The full ``/v1/capacity`` payload for one registry: per-model
     accounting plus the process section, summed totals, and — when the
@@ -259,6 +317,14 @@ def registry_capacity(registry) -> Dict[str, Any]:
             models[name] = model_capacity(registry.get(name))
         except KeyError:
             pass  # cold, or undeployed between listing and snapshot
+    harvested = 0.0
+    harvest = None
+    if _HARVEST_PROVIDER is not None:
+        try:
+            harvest = _HARVEST_PROVIDER()
+            harvested = float(harvest.get("harvested_busy_s", 0.0))
+        except Exception:
+            harvest = None  # a dying scheduler must not break a scrape
     out = {
         "models": models,
         "process": process_capacity(),
@@ -268,7 +334,11 @@ def registry_capacity(registry) -> Dict[str, Any]:
                                 for m in models.values()),
             "replicas": sum(m["replicas"] for m in models.values()),
         },
+        "utilization": device_utilization(models,
+                                          harvested_busy_s=harvested),
     }
+    if harvest is not None:
+        out["scheduler"] = harvest
     snap = getattr(registry, "residency_snapshot", None)
     if snap is not None:
         try:
@@ -301,6 +371,19 @@ def render_prometheus(payload: Dict[str, Any],
         for dt, b in sorted(c["param_dtype_bytes"].items()):
             lines.append(f'{prefix}_param_dtype_bytes{{model="{model}",'
                          f'dtype="{dt}"}} {b}')
+    util = payload.get("utilization")
+    if util:
+        # the idle-signal headline (ISSUE 19): raw summable terms first,
+        # then the edge-derived fractions the scheduler admits against
+        lines.append(f"{prefix}_device_busy_s {util['busy_s']}")
+        lines.append(f"{prefix}_harvested_busy_s "
+                     f"{util['harvested_busy_s']}")
+        lines.append(f"{prefix}_device_window_s "
+                     f"{util['device_window_s']}")
+        lines.append(f"{prefix}_serving_busy_fraction "
+                     f"{util['serving_busy_fraction']}")
+        lines.append(f"{prefix}_device_idle_fraction "
+                     f"{util['device_idle_fraction']}")
     proc = payload.get("process") or {}
     if proc.get("device_budget_bytes") is not None:
         lines.append(f"{prefix}_device_budget_bytes "

@@ -55,7 +55,7 @@ from deeplearning4j_tpu.serving.slo import SLOMonitor, SLOTarget
 __all__ = [
     "DeliveryConfig", "DeliveryController", "FeedbackLog", "GateFailed",
     "GateRefused", "GoldenGate", "GoldenSet", "ShadowComparator",
-    "feedback_counters",
+    "feedback_counters", "iter_feedback_examples",
 ]
 
 #: the golden-set gate's chaos point (call at every gate evaluation;
@@ -656,7 +656,25 @@ class FeedbackLog:
 
     A label whose trace id has no access-log line (rotated away, logging
     off, or never served here) is an ORPHAN: counted, not written —
-    a labeled-example file must never contain label-only rows."""
+    a labeled-example file must never contain label-only rows.
+
+    The file rotates like the access log (ISSUE 19 satellite): once an
+    append would push it past ``DL4J_TPU_FEEDBACK_FILE_MAX_BYTES`` it is
+    atomically renamed to ``<path>.1`` (keep-1 rollover) and a fresh
+    file starts — a long-running flywheel can never grow the labeled
+    feed unbounded, and readers (:func:`iter_feedback_examples`, which
+    feeds the scheduler's flywheel job) consult the ``.1`` file too."""
+
+    @staticmethod
+    def max_bytes() -> int:
+        """``DL4J_TPU_FEEDBACK_FILE_MAX_BYTES``: size-based rotation
+        threshold (0 / unset / unparsable = no rotation), mirroring
+        ``DL4J_TPU_ACCESS_LOG_MAX_BYTES``."""
+        try:
+            return max(0, int(os.environ.get(
+                "DL4J_TPU_FEEDBACK_FILE_MAX_BYTES", "0")))
+        except ValueError:
+            return 0
 
     def __init__(self, access_log_path: Optional[str] = None,
                  out_path: Optional[str] = None):
@@ -691,10 +709,13 @@ class FeedbackLog:
                 continue
         return None
 
-    def record(self, trace_id: str, label=None, score=None
+    def record(self, trace_id: str, label=None, score=None, inputs=None
                ) -> Optional[Dict[str, Any]]:
         """Join one label against the access log; returns the appended
-        labeled example, or ``None`` for an orphan."""
+        labeled example, or ``None`` for an orphan. ``inputs`` (the
+        request features, re-sent by the labelling client) rides along
+        when given — that is what turns a labeled line into a training
+        example the flywheel fine-tune can actually fit on."""
         rec = self._lookup(str(trace_id))
         if rec is None or self.out_path is None:
             with _FEEDBACK_LOCK:
@@ -703,13 +724,43 @@ class FeedbackLog:
         example = {k: v for k, v in rec.items() if k != "log"}
         example["label"] = label
         example["score"] = score
+        if inputs is not None:
+            example["inputs"] = inputs
         example["feedback"] = True
         line = json.dumps(example, default=str) + "\n"
+        max_bytes = self.max_bytes()
         with _FEEDBACK_LOCK:
+            if max_bytes:
+                try:
+                    size = os.path.getsize(self.out_path)
+                except OSError:
+                    size = 0
+                if size and size + len(line.encode()) > max_bytes:
+                    # atomic keep-1 rollover, same shape as the access log
+                    os.replace(self.out_path, self.out_path + ".1")
             with open(self.out_path, "a") as f:
                 f.write(line)
             _FEEDBACK_COUNTS["joined_total"] += 1
         return example
+
+
+def iter_feedback_examples(path: str):
+    """Yield labeled examples from a feedback file INCLUDING its keep-1
+    rollover (``<path>.1`` first, so lines come out oldest-first across
+    the rotation boundary). Malformed lines are skipped, missing files
+    are empty — the flywheel's feed must read cleanly mid-rotation."""
+    for p in (path + ".1", path):
+        try:
+            with open(p) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("feedback"):
+                        yield rec
+        except OSError:
+            continue
 
 
 def handle_feedback(raw: bytes) -> Tuple[int, Dict[str, Any]]:
@@ -726,7 +777,8 @@ def handle_feedback(raw: bytes) -> Tuple[int, Dict[str, Any]]:
         return 400, {"error": "feedback requires a trace_id"}
     if label is None and score is None:
         return 400, {"error": "feedback requires a label or a score"}
-    example = FeedbackLog().record(trace_id, label=label, score=score)
+    example = FeedbackLog().record(trace_id, label=label, score=score,
+                                   inputs=body.get("inputs"))
     if example is None:
         return 202, {"joined": False, "trace_id": trace_id,
                      "detail": "no access-log line for this trace id "

@@ -1935,7 +1935,27 @@ class FleetRouter:
                          "page_in_failures_total": 0,
                          "resident_hits_total": 0, "cold_hits_total": 0}
         sessions_agg: Optional[Dict[str, Any]] = None
+        util_agg = {"busy_s": 0.0, "harvested_busy_s": 0.0,
+                    "device_window_s": 0.0, "replicas": 0}
         for wid, payload in sorted(scraped.items()):
+            # idle-signal aggregation (ISSUE 19 satellite): the raw
+            # summable busy/window terms are summed across workers and
+            # the fractions derived ONCE at the edge, never averaged
+            wu = payload.get("utilization")
+            if isinstance(wu, dict):
+                try:
+                    inc_util = {
+                        "busy_s": float(wu.get("busy_s", 0.0)),
+                        "harvested_busy_s":
+                            float(wu.get("harvested_busy_s", 0.0)),
+                        "device_window_s":
+                            float(wu.get("device_window_s", 0.0)),
+                        "replicas": int(wu.get("replicas", 0))}
+                except (TypeError, ValueError):
+                    pass  # malformed utilization: skip, never the scrape
+                else:
+                    for k, v in inc_util.items():
+                        util_agg[k] += v
             # session aggregation (ISSUE 16): residency/counters SUMMED;
             # spilled_files taken as the MAX because the spill dir is
             # shared fleet-wide — every worker counts the same files
@@ -2037,11 +2057,22 @@ class FleetRouter:
                 a["dispatch_p50_s"] = h.percentile(50)
                 a["dispatch_p99_s"] = h.percentile(99)
                 a["dispatch_count"] = h.count
+        dw = util_agg["device_window_s"]
+        util_agg["serving_busy_fraction"] = round(
+            util_agg["busy_s"] / dw, 6) if dw > 0 else 0.0
+        util_agg["device_idle_fraction"] = round(max(
+            0.0, 1.0 - (util_agg["busy_s"] + util_agg["harvested_busy_s"])
+            / dw), 6) if dw > 0 else 1.0
+        util_agg["busy_s"] = round(util_agg["busy_s"], 6)
+        util_agg["harvested_busy_s"] = round(
+            util_agg["harvested_busy_s"], 6)
+        util_agg["device_window_s"] = round(dw, 3)
         out = {
             "workers": scraped,
             "models": models,
             "process": {"device_budget_bytes": budget,
                         "device_in_use_bytes": in_use},
+            "utilization": util_agg,
         }
         if placement or hbm_budget is not None:
             out["residency"] = {
@@ -2074,6 +2105,18 @@ class FleetRouter:
                 lines.append(
                     f'fleet_capacity_dispatch_seconds{{model="{model}",'
                     f'quantile="0.99"}} {a["dispatch_p99_s"]}')
+        util = agg.get("utilization") or {}
+        if util:
+            lines.append(f"fleet_capacity_device_busy_s "
+                         f"{util['busy_s']}")
+            lines.append(f"fleet_capacity_harvested_busy_s "
+                         f"{util['harvested_busy_s']}")
+            lines.append(f"fleet_capacity_device_window_s "
+                         f"{util['device_window_s']}")
+            lines.append(f"fleet_capacity_serving_busy_fraction "
+                         f"{util['serving_busy_fraction']}")
+            lines.append(f"fleet_capacity_device_idle_fraction "
+                         f"{util['device_idle_fraction']}")
         proc = agg["process"]
         if proc.get("device_budget_bytes") is not None:
             lines.append(f"fleet_capacity_device_budget_bytes "

@@ -146,6 +146,10 @@ class ModelServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._capacity_provider = None  # our profiler attachment (stop)
+        # background-job scheduler (ISSUE 19): attach one to surface
+        # GET /v1/scheduler and the scheduler_* /metrics section; the
+        # owner starts/stops it (the server only reads snapshots)
+        self.scheduler = None
         self.port: Optional[int] = None
 
     # ------------------------------------------------------------ handlers
@@ -445,6 +449,15 @@ class ModelServer:
                 # rehydrate percentiles, fleet-aggregated by the router
                 payload["sessions"] = self.sessions.snapshot()
             return 200, payload
+        if path == "/v1/scheduler":
+            # background-job scheduler (ISSUE 19): harvest counters,
+            # admission config and the shared job store's records — the
+            # machine-readable twin of the scheduler_* /metrics section
+            if self.scheduler is None:
+                return 404, {"error": "no scheduler attached"}
+            return 200, {"worker": self.worker_id,
+                         "scheduler": self.scheduler.harvest_snapshot(),
+                         "jobs": self.scheduler.store.jobs()}
         if path == "/v1/metricsz":
             # machine-readable twin of /metrics: summable counters + raw
             # bucket histograms so the router can aggregate fleet-wide
@@ -890,6 +903,14 @@ class ModelServer:
             pass  # capacity must never be able to break a scrape
         if self.sessions is not None:
             parts.append(self._render_sessions())
+        if self.scheduler is not None:
+            # the harvest ledger's /metrics view (ISSUE 19)
+            from deeplearning4j_tpu.serving import scheduler as _sched
+            try:
+                parts.append(_sched.render_prometheus(
+                    self.scheduler.harvest_snapshot()).rstrip("\n"))
+            except Exception:
+                pass  # the scheduler must never break a scrape
         # binary transport frame/error counters (ISSUE 18)
         parts.append("\n".join(wire.render_prometheus()))
         # the black box's ring health (ISSUE 15): journal_* gauges

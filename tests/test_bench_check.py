@@ -1367,3 +1367,143 @@ def test_check_tables_wire_absent_is_warning(tmp_path):
     msgs = []
     assert bench.check_tables(str(md), str(extra), log=msgs.append) == 0
     assert any("wire" in m and "WARN" in m for m in msgs)
+
+
+# ==========================================================================
+# ISSUE 19: the scheduler section
+def _scheduler_section():
+    """A self-consistent BENCH_EXTRA.json["scheduler"] section (the
+    ISSUE 19 idle-harvest drill record)."""
+    return {
+        "tick_s": 0.02,
+        "harvest": {
+            "baseline": {"requests": 3000, "p99_ms": 15.0,
+                         "device_idle_fraction": 0.96,
+                         "serving_busy_fraction": 0.04,
+                         "harvested_busy_s": 0.0,
+                         "bit_identical": True},
+            "harvest": {"requests": 3100, "p99_ms": 15.3,
+                        "device_idle_fraction": 0.80,
+                        "serving_busy_fraction": 0.03,
+                        "harvested_busy_s": 2.5,
+                        "bit_identical": True},
+            "idle_drop": 0.16,
+            "p99_ratio": 1.02,
+        },
+        "preempt": {"ticks_to_preempt": 1, "preempt_join_s": 0.06,
+                    "steps_done_at_preempt": 2, "total_steps": 6,
+                    "losses_match": True, "params_bit_equal": True},
+        "flywheel": {"examples": 16, "epochs": 3, "verdict": "promoted",
+                     "deployed": True, "requests": 900,
+                     "client_errors": 0,
+                     "bundle": {"seq_gapless": True,
+                                "scheduler_events": {
+                                    "scheduler.submit": 1,
+                                    "scheduler.claim": 1,
+                                    "scheduler.start": 1,
+                                    "scheduler.complete": 1},
+                                "stages": ["gate", "shadow", "canary",
+                                           "promote_ready",
+                                           "promoted"]}},
+    }
+
+
+def _extra_with_scheduler(section):
+    measured = {k: _mid(*rng) for k, rng in bench.RECORDED_RANGES.items()}
+    measured["scheduler"] = section
+    measured["scheduler_idle_drop"] = section["harvest"]["idle_drop"]
+    return measured
+
+
+def test_check_tables_validates_scheduler_section(tmp_path):
+    """ISSUE 19 satellite: --check-tables covers the scheduler keys — a
+    self-consistent record passes; a non-bit-identical arm, an idle
+    drop the arm fractions can't reproduce (or under the 0.10
+    contract), a p99 ratio that doesn't recompute (or over 1.05), a
+    baseline arm that somehow harvested, a multi-tick preempt, a
+    non-bit-exact resume, a preempt that didn't land mid-run, an
+    unpromoted flywheel, a gapped bundle, a job life missing an event,
+    a stage history that doesn't end promoted, a missing key, or a
+    stale top-level copy all fail loudly."""
+    md = tmp_path / "BASELINE.md"
+    md.write_text(_table_md(bench.RECORDED_RANGES))
+    extra = tmp_path / "BENCH_EXTRA.json"
+
+    extra.write_text(json.dumps(_extra_with_scheduler(_scheduler_section())))
+    assert bench.check_tables(str(md), str(extra), log=lambda *a: None) == 0
+
+    def failing(mutate, needle):
+        sec = _scheduler_section()
+        mutate(sec)
+        extra.write_text(json.dumps(_extra_with_scheduler(sec)))
+        msgs = []
+        assert bench.check_tables(str(md), str(extra),
+                                  log=msgs.append) == 1, needle
+        assert any(needle in m for m in msgs), (needle, msgs)
+
+    failing(lambda s: s["harvest"]["harvest"].update(bit_identical=False),
+            "scheduler.harvest.harvest: bit_identical")
+    failing(lambda s: s["harvest"].update(idle_drop=0.3),
+            "recorded arm fractions give")
+    failing(lambda s: (s["harvest"]["harvest"].update(
+                           device_idle_fraction=0.88),
+                       s["harvest"].update(idle_drop=0.08)),
+            "under the 0.10 absolute contract")
+    failing(lambda s: s["harvest"].update(p99_ratio=0.9),
+            "recorded arm p99s give")
+    failing(lambda s: (s["harvest"]["harvest"].update(p99_ms=18.0),
+                       s["harvest"].update(p99_ratio=1.2)),
+            "more than 5% of routed p99")
+    failing(lambda s: s["harvest"]["baseline"].update(
+                harvested_busy_s=1.0),
+            "must be 0 — no scheduler was attached")
+    failing(lambda s: s["harvest"]["harvest"].update(
+                harvested_busy_s=0.0),
+            "measured no harvested_busy_s")
+    failing(lambda s: s["harvest"]["harvest"].update(
+                device_idle_fraction=1.4),
+            "not a fraction in [0, 1]")
+    failing(lambda s: s["preempt"].update(ticks_to_preempt=3),
+            "preempt on the next tick")
+    failing(lambda s: s["preempt"].update(params_bit_equal=False),
+            "resume must be bit-exact")
+    failing(lambda s: s["preempt"].update(steps_done_at_preempt=6),
+            "not mid-run")
+    failing(lambda s: s["flywheel"].update(verdict="rolled_back"),
+            "must promote through gated delivery")
+    failing(lambda s: s["flywheel"].update(client_errors=2),
+            "scheduler.flywheel.client_errors")
+    failing(lambda s: s["flywheel"]["bundle"].update(seq_gapless=False),
+            "seq_gapless")
+    failing(lambda s: s["flywheel"]["bundle"]["scheduler_events"].pop(
+                "scheduler.complete"),
+            "missing scheduler.complete")
+    failing(lambda s: s["flywheel"]["bundle"]["stages"].append(
+                "rolled_back"),
+            "does not run gate -> promoted")
+    failing(lambda s: s.pop("preempt"),
+            "missing from the recorded section")
+
+    # a malformed section (arm is not a dict) is a failure, not a crash
+    failing(lambda s: s["harvest"].update(baseline=3.0), "scheduler")
+
+    # stale top-level copy
+    ex = _extra_with_scheduler(_scheduler_section())
+    ex["scheduler_idle_drop"] = 0.5
+    extra.write_text(json.dumps(ex))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("scheduler_idle_drop: top-level copy" in m for m in msgs)
+
+
+def test_check_tables_scheduler_absent_is_warning(tmp_path):
+    """No --scheduler run recorded yet -> warn, don't fail (same
+    contract as the other optional sections)."""
+    md = tmp_path / "BASELINE.md"
+    md.write_text(_table_md(bench.RECORDED_RANGES))
+    measured = {k: _mid(*rng) for k, rng in bench.RECORDED_RANGES.items()}
+    extra = tmp_path / "BENCH_EXTRA.json"
+    extra.write_text(json.dumps(measured))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 0
+    assert any("scheduler" in m and "WARN" in m for m in msgs)

@@ -97,6 +97,7 @@ def test_computation_graph_through_parallel_wrapper():
     np.testing.assert_allclose(w1, w2, rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.slow  # wall-time tier-2 (ISSUE 19): heaviest tier-1 cases demoted so `not slow` finishes inside the 870 s budget
 def test_tensor_parallel_builder_trains():
     """`.strategy("tensor_parallel").build()` must construct a mesh WITH a
     `model` axis itself (round-5 fix: the builder handed the TP strategy a

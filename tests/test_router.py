@@ -589,6 +589,7 @@ def test_sigkill_chaos_drill_zero_client_visible_failures(proc_fleet):
     assert _wait_until(victim_readmitted, timeout_s=30)
 
 
+@pytest.mark.slow  # wall-time tier-2 (ISSUE 19): heaviest tier-1 cases demoted so `not slow` finishes inside the 870 s budget
 def test_rolling_deploy_zero_downtime_no_on_traffic_compiles(proc_fleet):
     sup, router, port, oracle, a2 = proc_fleet
     assert _wait_until(lambda: len(sup.endpoints()) == 3, timeout_s=90)

@@ -32,6 +32,7 @@ def test_resnet50_builds_and_forwards():
     assert out.shape == (2, 10)
 
 
+@pytest.mark.slow  # wall-time tier-2 (ISSUE 19): heaviest tier-1 cases demoted so `not slow` finishes inside the 870 s budget
 def test_resnet50_trains_a_step():
     net = ResNet50(num_classes=4, height=32, width=32).init()
     x = np.random.default_rng(0).normal(0, 1, (4, 32, 32, 3)).astype(np.float32)
@@ -47,6 +48,7 @@ def test_simple_cnn_and_vgg_build():
     assert net.num_params() > 1e7
 
 
+@pytest.mark.slow  # wall-time tier-2 (ISSUE 19): heaviest tier-1 cases demoted so `not slow` finishes inside the 870 s budget
 def test_darknet_and_unet_build():
     net = Darknet19(num_classes=10, height=64, width=64).init()
     x = np.random.default_rng(0).normal(0, 1, (1, 64, 64, 3)).astype(np.float32)
@@ -77,6 +79,7 @@ def test_textgen_lstm_tbptt():
     net.rnn_clear_previous_state()
 
 
+@pytest.mark.slow  # wall-time tier-2 (ISSUE 19): heaviest tier-1 cases demoted so `not slow` finishes inside the 870 s budget
 def test_bert_small_trains_with_mask():
     net = Bert.small().init()
     rng = np.random.default_rng(0)
@@ -93,6 +96,7 @@ def test_bert_small_trains_with_mask():
     np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
 
 
+@pytest.mark.slow  # wall-time tier-2 (ISSUE 19): heaviest tier-1 cases demoted so `not slow` finishes inside the 870 s budget
 def test_vgg19_and_squeezenet_build():
     assert VGG19(num_classes=10, height=32, width=32).init().num_params() > 1e7
     net = SqueezeNet(num_classes=10, height=64, width=64).init()
@@ -103,6 +107,7 @@ def test_vgg19_and_squeezenet_build():
     assert net.num_params() < 3e6
 
 
+@pytest.mark.slow  # wall-time tier-2 (ISSUE 19): heaviest tier-1 cases demoted so `not slow` finishes inside the 870 s budget
 def test_xception_builds_and_forwards():
     net = Xception(num_classes=7, height=64, width=64, middle_blocks=2).init()
     x = np.random.default_rng(0).normal(0, 1, (1, 64, 64, 3)).astype(np.float32)
@@ -110,6 +115,7 @@ def test_xception_builds_and_forwards():
     assert out.shape == (1, 7)
 
 
+@pytest.mark.slow  # wall-time tier-2 (ISSUE 19): heaviest tier-1 cases demoted so `not slow` finishes inside the 870 s budget
 def test_inception_resnet_v1_builds_and_forwards():
     net = InceptionResNetV1(num_classes=5, height=96, width=96,
                             blocks_a=1, blocks_b=1, blocks_c=1).init()
@@ -118,6 +124,7 @@ def test_inception_resnet_v1_builds_and_forwards():
     assert out.shape == (1, 5)
 
 
+@pytest.mark.slow  # wall-time tier-2 (ISSUE 19): heaviest tier-1 cases demoted so `not slow` finishes inside the 870 s budget
 def test_tiny_yolo_and_yolo2():
     net = TinyYOLO(num_classes=3, height=128, width=128).init()
     x = np.random.default_rng(0).normal(0, 1, (1, 128, 128, 3)).astype(np.float32)
