@@ -278,6 +278,13 @@ def check_tables(baseline_md=None, bench_extra=None, log=_log):
     if measured is not None:
         check_scheduler_section(measured, failures, warnings)
 
+    # ISSUE 20 parallel keys: bitwise-equal composed-vs-single-axis train
+    # arms, recomputable speedup with agreeing top-level copy, and the
+    # oversized-model serve drill (flat rejected, sharded bit-identical,
+    # zero on-traffic compiles, per-device budget held at every sample)
+    if measured is not None:
+        check_parallel_section(measured, failures, warnings)
+
     for w in warnings:
         log(f"[check-tables] WARN {w}")
     for fmsg in failures:
@@ -1844,6 +1851,284 @@ def _check_distributed_consistency(extra, d, failures):
         failures.append(
             f"scaling_efficiency: claims {d['scaling_efficiency']}, "
             f"recorded curve gives {eff:.3f}")
+
+
+# ----------------------------------------------------------------- parallel
+def bench_parallel(steps=12, bench_extra=None, log=_log):
+    """``bench.py --parallel`` (ISSUE 20): the one-plan parallelism drill
+    of record, on the 8-virtual-device CPU mesh. Everything is asserted
+    BEFORE the artifact is written (a failing run cannot produce it):
+
+    1. **Train A/B, order-alternated** — the SAME ``ParallelWrapper.fit``
+       call at the same data-parallel degree (data=2), once single-axis
+       and once composed ``data=2 x pipe=4`` (microbatches=1:
+       staged-sequential, the bit-identical schedule). Both arms'
+       trained params must be BITWISE equal; best-of-2 steps/sec per arm
+       recorded, ``parallel_composed_speedup`` = composed / single-axis.
+    2. **Oversized-model serve drill** — ``DL4J_TPU_HBM_BUDGET_BYTES``
+       set BELOW the model's f32 state: flat registration must be
+       REJECTED (``HBMBudgetExceeded``), the same model under a
+       ``pipe=4 x data=2`` plan must admit, serve every request
+       bit-identically to the unsharded single-device oracle with ZERO
+       on-traffic compiles, and the per-device HBM ledger must hold the
+       budget at EVERY capacity sample.
+
+    Writes ``BENCH_EXTRA.json["parallel"]`` + top-level
+    ``parallel_composed_speedup``. Returns a process exit code."""
+    import hashlib
+
+    import jax
+
+    from deeplearning4j_tpu.data import NumpyDataSetIterator
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.parallel import ParallelPlan, ParallelWrapper
+    from deeplearning4j_tpu.runtime.mesh import MeshSpec, create_mesh
+    from deeplearning4j_tpu.train import Sgd
+
+    failures = []
+    results = {"steps_timed": steps, "batch": 64, "devices": 8}
+    if len(jax.devices()) < 8:
+        log(f"[parallel] need 8 devices, have {len(jax.devices())} "
+            f"(XLA_FLAGS not applied?)")
+        return 1
+
+    def conf(seed=7):
+        # 5 equal-width layers: the first maps 32->64 (not
+        # shape-preserving), leaving a 4-layer uniform trunk for pipe=4
+        b = (NeuralNetConfiguration.builder().seed(seed)
+             .updater(Sgd(0.05)).list())
+        for _ in range(5):
+            b = b.layer(DenseLayer(n_out=64, activation="tanh"))
+        return (b.layer(OutputLayer(n_out=8, activation="softmax"))
+                .set_input_type(InputType.feed_forward(32))
+                .build())
+
+    rng = np.random.default_rng(20)
+    n = 64 * steps
+    X = rng.normal(0, 1, (n, 32)).astype(np.float32)
+    Y = np.eye(8, dtype=np.float32)[rng.integers(0, 8, n)]
+
+    def run_arm(plan):
+        net = MultiLayerNetwork(conf()).init()
+        pw = ParallelWrapper(net, plan, prefetch_buffer=0)
+        it = NumpyDataSetIterator(X, Y, batch_size=64)
+        pw.fit(it, epochs=1)           # warm the executable off the clock
+        t0 = time.perf_counter()
+        pw.fit(NumpyDataSetIterator(X, Y, batch_size=64), epochs=1)
+        dt = time.perf_counter() - t0
+        h = hashlib.sha256()
+        for leaf in jax.tree.leaves(net.train_state.params):
+            h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+        return {"steps_per_sec": round(steps / dt, 2),
+                "phash": h.hexdigest()}
+
+    def mk_single():
+        return ParallelPlan.data_parallel(
+            create_mesh(MeshSpec({"data": 2}), devices_=jax.devices()[:2]))
+
+    def mk_composed():
+        return ParallelPlan.compose(data=2, pipe=4, microbatches=1)
+
+    arms = {"single_axis": [], "composed": []}
+    for order in (("single_axis", "composed"), ("composed", "single_axis")):
+        for tag in order:
+            wait_for_quiet_host()
+            arms[tag].append(run_arm(mk_single() if tag == "single_axis"
+                                     else mk_composed()))
+    for tag, runs in arms.items():
+        if any(r["phash"] != runs[0]["phash"] for r in runs[1:]):
+            failures.append(f"{tag}: nondeterministic across repeats")
+        best = max(runs, key=lambda r: r["steps_per_sec"])
+        results[tag] = {"steps_per_sec": best["steps_per_sec"],
+                        "phash": best["phash"]}
+    bit = results["single_axis"]["phash"] == results["composed"]["phash"]
+    results["single_axis"]["bit_identical"] = bit
+    results["composed"]["bit_identical"] = bit
+    if not bit:
+        failures.append("composed pipe x data trained params are NOT "
+                        "bitwise equal to the single-axis arm")
+    speedup = round(results["composed"]["steps_per_sec"]
+                    / max(1e-9, results["single_axis"]["steps_per_sec"]), 3)
+    results["speedup"] = speedup
+    log(f"[parallel] train A/B: single-axis "
+        f"{results['single_axis']['steps_per_sec']} steps/s, composed "
+        f"{results['composed']['steps_per_sec']} steps/s ({speedup}x), "
+        f"bitwise={bit}, load {host_load()}")
+
+    # ---- oversized-model serve drill under a sub-model HBM budget -----
+    results["serve"] = serve = {}
+    from deeplearning4j_tpu.serving import (HBMBudgetExceeded,
+                                            ModelRegistry)
+
+    def serve_conf():
+        b = (NeuralNetConfiguration.builder().seed(42)
+             .updater(Sgd(0.1)).list())
+        for _ in range(5):
+            b = b.layer(DenseLayer(n_out=128, activation="relu"))
+        return (b.layer(OutputLayer(n_out=8, activation="softmax"))
+                .set_input_type(InputType.feed_forward(32))
+                .build())
+
+    net = MultiLayerNetwork(serve_conf()).init()
+    # the unsharded single-device oracle, computed BEFORE serving exists
+    # (its jit entry must not read as an on-traffic compile)
+    qx = rng.normal(0, 1, (32, 32)).astype(np.float32)
+    oracle = np.asarray(net.output(qx))
+    model_bytes = sum(int(np.asarray(l).nbytes)
+                      for l in jax.tree.leaves(net.train_state.params))
+    budget = int(model_bytes * 0.6)
+    serve["model_bytes"] = model_bytes
+    serve["budget_bytes"] = budget
+    old_env = os.environ.get("DL4J_TPU_HBM_BUDGET_BYTES")
+    os.environ["DL4J_TPU_HBM_BUDGET_BYTES"] = str(budget)
+    reg = None
+    try:
+        reg = ModelRegistry()          # budget resolved from the env knob
+        try:
+            reg.register("big-flat", net, max_batch_size=8,
+                         batch_timeout_ms=2,
+                         warmup_example=np.zeros((1, 32), np.float32))
+            serve["flat_rejected"] = False
+            failures.append("flat registration of the oversized model "
+                            "was ADMITTED under the sub-model budget")
+        except HBMBudgetExceeded:
+            serve["flat_rejected"] = True
+        plan = ParallelPlan.compose(data=2, pipe=4, microbatches=1)
+        served = reg.register(
+            "big", net, plan=plan, replicas=2, max_batch_size=8,
+            batch_timeout_ms=2,
+            warmup_example=np.zeros((1, 32), np.float32))
+        warm = served.batcher.compile_count()
+        outs = []
+        held = 0
+        samples = 0
+        for i in range(32):
+            outs.append(np.asarray(served.batcher.submit(qx[i:i + 1]))[0])
+            per_dev = (reg.residency_snapshot()
+                       .get("per_device_bytes") or {})
+            samples += 1
+            if per_dev and max(per_dev.values()) <= budget:
+                held += 1
+        outs = np.stack(outs)
+        serve["requests"] = samples
+        serve["bit_identical"] = bool(np.array_equal(outs, oracle))
+        serve["on_traffic_compiles"] = \
+            served.batcher.compile_count() - warm
+        serve["budget_samples"] = samples
+        serve["budget_held_samples"] = held
+        serve["budget_held"] = held == samples
+        per_dev = reg.residency_snapshot().get("per_device_bytes") or {}
+        serve["per_device_max_bytes"] = max(per_dev.values()) if per_dev \
+            else 0
+        if not serve["bit_identical"]:
+            failures.append("plan-sliced serving diverged from the "
+                            "unsharded oracle")
+        if serve["on_traffic_compiles"] != 0:
+            failures.append(f"{serve['on_traffic_compiles']} compile(s) "
+                            f"on live traffic")
+        if not serve["budget_held"]:
+            failures.append(f"per-device HBM budget held at only "
+                            f"{held}/{samples} capacity samples")
+    finally:
+        if reg is not None:
+            reg.shutdown()
+        if old_env is None:
+            os.environ.pop("DL4J_TPU_HBM_BUDGET_BYTES", None)
+        else:
+            os.environ["DL4J_TPU_HBM_BUDGET_BYTES"] = old_env
+    log(f"[parallel] serve drill: flat_rejected={serve['flat_rejected']}, "
+        f"bitwise={serve.get('bit_identical')}, on-traffic compiles "
+        f"{serve.get('on_traffic_compiles')}, budget held "
+        f"{serve.get('budget_held_samples')}/{serve.get('budget_samples')} "
+        f"(per-device max {serve.get('per_device_max_bytes')} <= "
+        f"{budget} of {model_bytes}-byte model)")
+
+    for fmsg in failures:
+        log(f"[parallel] FAIL {fmsg}")
+    if failures:
+        # never clobber the last good record with a failing run's numbers
+        return 1
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    bench_extra = bench_extra or os.path.join(here, "BENCH_EXTRA.json")
+    try:
+        with open(bench_extra) as f:
+            extra = json.load(f)
+    except Exception:
+        extra = {}
+    extra["parallel"] = results
+    extra["parallel_composed_speedup"] = results["speedup"]
+    with open(bench_extra, "w") as f:
+        json.dump(extra, f, indent=2)
+    log(f"[parallel] OK: composed/single-axis {speedup}x at bitwise-equal "
+        f"trajectories; oversized model served sharded under a "
+        f"{budget}-byte budget, bit-identical, 0 on-traffic compiles")
+    return 0
+
+
+def check_parallel_section(extra, failures, warnings):
+    """--check-tables coverage for the ISSUE 20 keys: the ``parallel``
+    section (when present) must carry bitwise-equal train arms, a
+    speedup recomputable from the recorded steps/sec rows with an
+    agreeing top-level copy, and an oversized-model serve drill that
+    rejected the flat registration, served bit-identically with zero
+    on-traffic compiles, and held the per-device budget at every
+    sample of a genuinely sub-model-size budget."""
+    if "parallel" not in extra:
+        warnings.append("parallel: not present in BENCH_EXTRA.json "
+                        "(bench --parallel not run?)")
+        return
+    d = extra["parallel"]
+    required = ["single_axis", "composed", "speedup", "serve"]
+    for k in required:
+        if k not in d:
+            failures.append(f"parallel.{k}: missing from the recorded "
+                            f"section")
+    if any(k not in d for k in required):
+        return
+    try:
+        for arm in ("single_axis", "composed"):
+            if d[arm].get("bit_identical") is not True:
+                failures.append(f"parallel.{arm}: bit_identical is "
+                                f"{d[arm].get('bit_identical')!r}")
+        sp = (d["composed"]["steps_per_sec"]
+              / max(1e-9, d["single_axis"]["steps_per_sec"]))
+        if abs(sp - d["speedup"]) > max(0.01, 0.02 * abs(sp)):
+            failures.append(f"parallel.speedup: claims {d['speedup']}, "
+                            f"recorded steps/sec rows give {sp:.3f}")
+        if extra.get("parallel_composed_speedup") != d["speedup"]:
+            failures.append(
+                f"parallel_composed_speedup: top-level copy "
+                f"{extra.get('parallel_composed_speedup')} != parallel "
+                f"section {d['speedup']}")
+        s = d["serve"]
+        for k in ("flat_rejected", "bit_identical", "budget_held"):
+            if s.get(k) is not True:
+                failures.append(f"parallel.serve.{k}: {s.get(k)!r} "
+                                f"(must be true)")
+        if s.get("on_traffic_compiles") != 0:
+            failures.append(f"parallel.serve.on_traffic_compiles: "
+                            f"{s.get('on_traffic_compiles')!r} "
+                            f"(must be 0)")
+        if not (0 < s["budget_bytes"] < s["model_bytes"]):
+            failures.append(
+                f"parallel.serve: budget {s['budget_bytes']} is not "
+                f"below the model's {s['model_bytes']} bytes — the "
+                f"\"oversized\" drill did not constrain anything")
+        if s["per_device_max_bytes"] > s["budget_bytes"]:
+            failures.append(
+                f"parallel.serve.per_device_max_bytes: "
+                f"{s['per_device_max_bytes']} exceeds the "
+                f"{s['budget_bytes']}-byte per-device budget")
+        if s.get("budget_held_samples") != s.get("budget_samples"):
+            failures.append(
+                f"parallel.serve: budget held at "
+                f"{s.get('budget_held_samples')}/{s.get('budget_samples')} "
+                f"samples (must be all)")
+    except (TypeError, ValueError, AttributeError, KeyError) as e:
+        failures.append(f"parallel: malformed section ({e!r})")
 
 
 # -------------------------------------------------------------------- fleet
@@ -6878,6 +7163,13 @@ if __name__ == "__main__":
         sys.exit(bench_wire())
     if "--scheduler" in sys.argv:
         sys.exit(bench_scheduler())
+    if "--parallel" in sys.argv:
+        # the composed-plan arms need the 8-virtual-device CPU mesh
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        sys.exit(bench_parallel())
     if "--serving" in sys.argv:
         # give the CPU backend multiple virtual devices so the replica arm
         # is real even off-TPU (flag only affects the host platform; must

@@ -41,6 +41,8 @@ THREAD_NAME_PREFIXES = PIPELINE_THREAD_NAMES + (
     "FaultTolerantTrainer-epoch",
     "router-forward",           # per-attempt forward threads (joined by race)
     "ui-stats-server",          # ui/server.py stats HTTP thread
+    "dist-exchange",            # overlapped gradient allgather (ISSUE 20,
+                                # joined by DistributedTrainer.close)
 )
 
 # Prometheus metric-name namespaces the package may emit. The lint

@@ -115,35 +115,40 @@ class MultiLayerNetwork:
         # Frozen layers keep params but receive zero updates (handled by labels)
         return params
 
-    def _build_tx(self, params) -> optax.GradientTransformation:
+    def _layer_transform(self, layer) -> optax.GradientTransformation:
+        """The optax transform one layer's params train under — shared by
+        the standard per-layer-key multi_transform and the pipe executor's
+        stage-stacked trunk (``parallel/plan_exec.py``), so packed and
+        unpacked updates are the same math."""
         g = self.conf.global_conf
         default_updater: Updater = g.updater if g.updater is not None else Sgd(0.1)
+        if layer.frozen:
+            return optax.set_to_zero()
+        upd = layer.updater if layer.updater is not None else default_updater
+        chain = []
+        gn = gradient_normalization_transform(
+            g.gradient_normalization, g.gradient_normalization_threshold)
+        if gn is not None:
+            chain.append(gn)
+        chain.append(upd.make())
+        wd = layer.weight_decay if layer.weight_decay is not None else g.weight_decay
+        if wd:
+            # Decoupled decay AFTER the updater, scaled by the LR (the
+            # reference's WeightDecay with applyLR=true; AdamW-style).
+            from deeplearning4j_tpu.train.updaters import decoupled_weight_decay
+            reg_keys = set(layer.regularizable_params())
+            chain.append(decoupled_weight_decay(
+                wd, upd._lr(), mask=lambda p, rk=reg_keys: _mask_keys(p, rk)))
+        return optax.chain(*chain) if len(chain) > 1 else chain[0]
+
+    def _build_tx(self, params) -> optax.GradientTransformation:
         transforms: Dict[str, optax.GradientTransformation] = {}
         labels = {}
         for i, layer in enumerate(self.layers):
             k = _layer_key(i, layer)
             if k not in params:
                 continue
-            if layer.frozen:
-                tx = optax.set_to_zero()
-            else:
-                upd = layer.updater if layer.updater is not None else default_updater
-                chain = []
-                gn = gradient_normalization_transform(
-                    g.gradient_normalization, g.gradient_normalization_threshold)
-                if gn is not None:
-                    chain.append(gn)
-                chain.append(upd.make())
-                wd = layer.weight_decay if layer.weight_decay is not None else g.weight_decay
-                if wd:
-                    # Decoupled decay AFTER the updater, scaled by the LR (the
-                    # reference's WeightDecay with applyLR=true; AdamW-style).
-                    from deeplearning4j_tpu.train.updaters import decoupled_weight_decay
-                    reg_keys = set(layer.regularizable_params())
-                    chain.append(decoupled_weight_decay(
-                        wd, upd._lr(), mask=lambda p, rk=reg_keys: _mask_keys(p, rk)))
-                tx = optax.chain(*chain) if len(chain) > 1 else chain[0]
-            transforms[k] = tx
+            transforms[k] = self._layer_transform(layer)
             labels[k] = jax.tree.map(lambda _: k, params[k])
         return optax.multi_transform(transforms, labels)
 

@@ -22,27 +22,35 @@ non-goal on ICI-class interconnects.
 """
 
 from deeplearning4j_tpu.parallel.sharding import (
+    ParallelPlan,
     ShardingStrategy,
     shard_batch,
     shard_train_state,
 )
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
 from deeplearning4j_tpu.parallel.inference import ParallelInference
-from deeplearning4j_tpu.parallel.ring_attention import ring_attention
+from deeplearning4j_tpu.parallel.ring_attention import (
+    ring_attention,
+    sequence_parallel_attention,
+)
 from deeplearning4j_tpu.parallel.pipeline import (
     gpipe,
     sequential_reference,
     stack_stage_params,
 )
+from deeplearning4j_tpu.parallel.plan_exec import PipePlanExecutor
 
 __all__ = [
+    "ParallelPlan",
     "ShardingStrategy",
     "shard_batch",
     "shard_train_state",
     "ParallelWrapper",
     "ParallelInference",
     "ring_attention",
+    "sequence_parallel_attention",
     "gpipe",
     "stack_stage_params",
     "sequential_reference",
+    "PipePlanExecutor",
 ]

@@ -40,13 +40,18 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array],
           *,
           mesh: Mesh,
           n_microbatches: int,
-          axis_name: str = PIPE_AXIS) -> jax.Array:
+          axis_name: str = PIPE_AXIS,
+          batch_axes: Optional[tuple] = None) -> jax.Array:
     """Run ``x`` through ``n_stages`` sequential applications of ``stage_fn``,
     pipelined over the mesh's ``axis_name`` dimension.
 
     stage_fn(params_for_one_stage, microbatch) -> microbatch (same shape).
     stacked_params: every leaf has leading dim n_stages (see
     :func:`stack_stage_params`).
+    batch_axes: mesh axes the per-microbatch batch dim additionally shards
+    over (a composed pipe x data plan) — each data-coordinate runs the same
+    shift-register schedule on its batch slice, so per-row math (and bits)
+    are unchanged by the data fan-out.
     """
     S = mesh.shape[axis_name]
     n_stages = jax.tree.leaves(stacked_params)[0].shape[0]
@@ -89,15 +94,16 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array],
         return lax.psum(jnp.where(idx == S - 1, outputs, 0.0), axis_name)
 
     spec_params = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    spec_mbs = P(None, tuple(batch_axes)) if batch_axes else P()
     # jax.shard_map (>=0.7) spells the replication check check_vma; the
     # experimental one spelled it check_rep
     try:
         fn = shard_map(per_device, mesh=mesh,
-                       in_specs=(spec_params, P()), out_specs=P(),
+                       in_specs=(spec_params, spec_mbs), out_specs=spec_mbs,
                        check_vma=False)
     except TypeError:
         fn = shard_map(per_device, mesh=mesh,
-                       in_specs=(spec_params, P()), out_specs=P(),
+                       in_specs=(spec_params, spec_mbs), out_specs=spec_mbs,
                        check_rep=False)
     out = fn(stacked_params, mbs)
     return out.reshape((B,) + out.shape[2:])

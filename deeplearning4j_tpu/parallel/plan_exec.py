@@ -1,0 +1,417 @@
+"""Plan executors: run a model through a multi-axis :class:`ParallelPlan`.
+
+This is the glue that makes ``pipe`` a *consumable* plan axis (ISSUE 20):
+:class:`PipePlanExecutor` packs a `MultiLayerNetwork`'s uniform trunk into a
+stage-stacked param tree (leading dim = pipe stages, sharded ``P('pipe')`` so
+each pipe device holds 1/S of the trunk), and builds train/forward functions
+that route the trunk through :func:`~deeplearning4j_tpu.parallel.pipeline.gpipe`
+while the head/tail layers run exactly the model's own ``_forward`` math.
+``ParallelWrapper.fit`` and the serving ``ReplicaPool`` both consume it, so an
+oversized model trains and serves through the same pipelined executor with no
+caller changes.
+
+Shape of the thing::
+
+    layers:   [head ...][ trunk: S stages x k layers each ][... tail, output]
+    params:   {head keys..., "__pipe_trunk__": {"t0": stacked, ...}, tail keys}
+    stacked:  every trunk leaf gains a leading stage dim, NamedSharding P(pipe)
+
+Numerics: head/tail layers replay ``MultiLayerNetwork._forward`` line for
+line (same rng fold-in per global layer index, same weight-noise keys, same
+output-layer input-dropout placement), and the trunk's per-row math is
+unchanged by pipelining — gpipe's shift register reorders nothing within a
+microbatch. With ``pipe_microbatches=1`` the whole trained trajectory is the
+oracle's; at M>1 microbatch gradient accumulation reassociates the batch
+contraction (allclose, not bitwise — the same tradeoff every GPipe system
+makes).
+
+Eligibility is checked loudly: the trunk must be a run of shape-preserving,
+stateless, structurally identical layers with no per-layer features that
+couple stages (weight noise, constraints, l1/l2, weight decay, frozen flags,
+global gradient clipping). Everything outside the trunk keeps the model's
+full feature set.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.pipeline import gpipe
+from deeplearning4j_tpu.parallel.sharding import ParallelPlan
+from deeplearning4j_tpu.runtime.mesh import PIPE_AXIS
+
+#: params key holding the stage-stacked trunk subtree
+TRUNK_KEY = "__pipe_trunk__"
+
+
+def _layer_key(i, layer):
+    from deeplearning4j_tpu.models.multi_layer_network import _layer_key as lk
+    return lk(i, layer)
+
+
+class PipePlanExecutor:
+    """Pipe-axis executor for one (MultiLayerNetwork, plan) pair.
+
+    The plan's mesh must carry a ``pipe`` axis; its other axes keep their
+    usual roles (``data`` shards the batch through ``gpipe(batch_axes=...)``).
+    One executor is bound to one mesh — serving builds one per replica device
+    group (shard_map bakes the mesh into the lowered program).
+    """
+
+    def __init__(self, model, plan: ParallelPlan):
+        if plan.pipe_size < 2:
+            raise ValueError("PipePlanExecutor needs a pipe axis of size >= 2; "
+                             f"plan {plan.kind} has {plan.pipe_size}")
+        if not hasattr(model, "layers") or not hasattr(model, "_forward"):
+            raise NotImplementedError(
+                "pipe-axis plans drive MultiLayerNetwork-style layer stacks; "
+                f"{type(model).__name__} has no uniform layer list to stage")
+        self.model = model
+        self.plan = plan
+        self.S = plan.pipe_size
+        if model.train_state is None:
+            model.init()
+        self._find_trunk()
+
+    # ------------------------------------------------------------ eligibility
+    def _find_trunk(self):
+        model, S = self.model, self.S
+        layers = model.layers
+        n = len(layers)
+        params = model.train_state.params
+        state = model.train_state.model_state
+        g = model.conf.global_conf
+
+        def eligible(i):
+            layer = layers[i]
+            k = _layer_key(i, layer)
+            if i == n - 1 and hasattr(layer, "compute_loss"):
+                return False  # the loss head always stays in the tail
+            if i in model.conf.preprocessors:
+                return False
+            if k in state and state[k]:
+                return False  # stateful layers can't stream through the ring
+            if k not in params:
+                return False
+            if getattr(layer, "weight_noise", None) is not None:
+                return False
+            if getattr(layer, "constraints", None) or \
+                    getattr(layer, "bias_constraints", None):
+                return False
+            if layer.frozen:
+                return False
+            l1 = layer.l1 if layer.l1 is not None else g.l1
+            l2 = layer.l2 if layer.l2 is not None else g.l2
+            wd = layer.weight_decay if layer.weight_decay is not None \
+                else g.weight_decay
+            if l1 or l2 or wd:
+                return False  # reg walks per-layer keys; stacked keys would
+                # silently drop the trunk's penalty
+            return True
+
+        def uniform(i, j):
+            a, b = layers[i], layers[j]
+            if type(a) is not type(b):
+                return False
+            if getattr(a, "activation", None) != getattr(b, "activation", None):
+                return False
+            if getattr(a, "updater", None) != getattr(b, "updater", None):
+                return False
+            pa = params[_layer_key(i, a)]
+            pb = params[_layer_key(j, b)]
+            sa = jax.tree.map(lambda x: (x.shape, x.dtype), pa)
+            sb = jax.tree.map(lambda x: (x.shape, x.dtype), pb)
+            return jax.tree.structure(pa) == jax.tree.structure(pb) \
+                and jax.tree.leaves(sa) == jax.tree.leaves(sb)
+
+        best: Tuple[int, int] = (0, 0)  # (start, length)
+        i = 0
+        while i < n:
+            if not eligible(i):
+                i += 1
+                continue
+            j = i + 1
+            while j < n and eligible(j) and uniform(i, j):
+                j += 1
+            if j - i > best[1]:
+                best = (i, j - i)
+            i = j
+        start, length = best
+        length -= length % S  # spare layers stay in the tail
+        if length < S:
+            raise ValueError(
+                f"no uniform trunk of >= {S} shape-preserving stateless "
+                f"layers found for a pipe axis of {S} (longest run: "
+                f"{best[1]}); pipe plans need a transformer-style stack — "
+                "use fsdp/tensor axes for this model instead")
+        if g.gradient_normalization:
+            raise NotImplementedError(
+                "global gradient normalization couples pipe stages through "
+                "the stacked trunk — train this model unpipelined, or drop "
+                "gradient_normalization")
+        self.t0 = start
+        self.n_trunk = length
+        self.k = length // S
+        self.head: List[int] = list(range(start))
+        self.tail: List[int] = list(range(start + length, n))
+        self.trunk_keys = {_layer_key(i, layers[i])
+                           for i in range(start, start + length)}
+
+    # ---------------------------------------------------------- param packing
+    def pack_params(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        """Per-layer tree -> packed tree: trunk keys collapse into
+        ``TRUNK_KEY`` holding, per in-stage position j, the stage-stacked
+        leaves (leading dim S)."""
+        layers = self.model.layers
+        packed = {k: v for k, v in params.items() if k not in self.trunk_keys}
+        sub = {}
+        for j in range(self.k):
+            stage_trees = [params[_layer_key(self.t0 + s * self.k + j,
+                                             layers[self.t0 + s * self.k + j])]
+                           for s in range(self.S)]
+            sub[f"t{j}"] = jax.tree.map(lambda *xs: jnp.stack(xs), *stage_trees)
+        packed[TRUNK_KEY] = sub
+        return packed
+
+    def unpack_params(self, packed: Dict[str, Any]) -> Dict[str, Any]:
+        layers = self.model.layers
+        params = {k: v for k, v in packed.items() if k != TRUNK_KEY}
+        for s in range(self.S):
+            for j in range(self.k):
+                i = self.t0 + s * self.k + j
+                params[_layer_key(i, layers[i])] = jax.tree.map(
+                    lambda a, s=s: a[s], packed[TRUNK_KEY][f"t{j}"])
+        return params
+
+    def pack_sharding(self, packed: Dict[str, Any]) -> Dict[str, Any]:
+        """NamedShardings for a packed tree on this executor's mesh: trunk
+        leaves shard their leading stage dim over ``pipe``; head/tail leaves
+        follow the plan's param rule (fsdp/tensor)."""
+        rest = {k: v for k, v in packed.items() if k != TRUNK_KEY}
+        sh = self.plan.param_sharding(rest) if rest else {}
+        sh[TRUNK_KEY] = jax.tree.map(
+            lambda _: NamedSharding(self.plan.mesh, P(PIPE_AXIS)),
+            packed[TRUNK_KEY])
+        return sh
+
+    def place_packed(self, packed: Dict[str, Any]) -> Dict[str, Any]:
+        return jax.tree.map(jax.device_put, packed, self.pack_sharding(packed))
+
+    # -------------------------------------------------------------- forward
+    def _apply_outer_layer(self, params, model_state, new_state, x, i,
+                           training, rng):
+        """One head/tail layer, replaying MultiLayerNetwork._forward's
+        non-recurrent branch (same fold-in indices, same noise keys, same
+        output-layer input-dropout placement). Returns (x, last_input)."""
+        from deeplearning4j_tpu.nn.constraints import apply_weight_noise
+        model = self.model
+        layer = model.layers[i]
+        n = len(model.layers)
+        k = _layer_key(i, layer)
+        if i in model.conf.preprocessors:
+            x = model.conf.preprocessors[i].pre_process(x, None)
+        p = params.get(k, {})
+        s = model_state.get(k, {})
+        lrng = jax.random.fold_in(rng, i) if rng is not None else None
+        if training and getattr(layer, "weight_noise", None) is not None:
+            p = apply_weight_noise(
+                layer, p,
+                None if lrng is None else jax.random.fold_in(lrng, 7919))
+        last_input = None
+        if i == n - 1 and hasattr(layer, "compute_loss"):
+            x = layer._apply_input_dropout(x, layer._g, training, lrng)
+            last_input = x
+            x = layer.activate(p, x)
+        else:
+            x, s_new = layer.forward(p, s, x, training=training, rng=lrng,
+                                     mask=None)
+            if s:
+                new_state[k] = s_new
+        return x, last_input
+
+    def _stage_fn(self, training: bool, with_rng: bool):
+        t0, k, rep = self.t0, self.k, self.model.layers
+
+        def stage_fn(stage_tree, mb):
+            s_idx = jax.lax.axis_index(PIPE_AXIS)
+            x = mb
+            for j in range(k):
+                lrng = None
+                if with_rng:
+                    # same per-layer fold-in as _forward: global layer index
+                    lrng = jax.random.fold_in(stage_tree["rng"],
+                                              t0 + s_idx * k + j)
+                x, _ = rep[t0 + j].forward(stage_tree["p"][f"t{j}"], {}, x,
+                                           training=training, rng=lrng,
+                                           mask=None)
+            return x
+
+        return stage_fn
+
+    def packed_forward(self, params, model_state, x, *, training: bool, rng):
+        """(out, pre_output_input, new_state) — the packed twin of
+        ``MultiLayerNetwork._forward``."""
+        from deeplearning4j_tpu.nn.base import cast_floating
+        from deeplearning4j_tpu.runtime.environment import get_environment
+        cdt = get_environment().compute_dtype
+        if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != cdt:
+            x = x.astype(cdt)
+        params = cast_floating(params, cdt)
+        new_state = dict(model_state)
+        last_input = x
+        for i in self.head:
+            x, _ = self._apply_outer_layer(params, model_state, new_state, x,
+                                           i, training, rng)
+        trunk: Dict[str, Any] = {"p": params[TRUNK_KEY]}
+        if rng is not None:
+            trunk["rng"] = jnp.stack([rng] * self.S)
+        # the microbatch count must divide this call's batch (a warmup
+        # bucket of 1, say) — clamp to the largest divisor <= the plan's
+        # schedule. Static per traced shape; per-row results don't depend
+        # on the microbatch split, so bucket programs stay bit-identical.
+        m = math.gcd(int(x.shape[0]), self.plan.pipe_microbatches)
+        x = gpipe(self._stage_fn(training, rng is not None), trunk, x,
+                  mesh=self.plan.mesh,
+                  n_microbatches=m,
+                  batch_axes=self.plan.batch_axes())
+        for i in self.tail:
+            x, li = self._apply_outer_layer(params, model_state, new_state, x,
+                                            i, training, rng)
+            if li is not None:
+                last_input = li
+        return x, last_input, new_state
+
+    # ----------------------------------------------------------------- train
+    def packed_tx(self) -> optax.GradientTransformation:
+        """multi_transform over the packed tree: head/tail layers keep their
+        per-layer transform; the trunk trains under the (uniform, checked
+        elementwise-safe) trunk layer's transform applied to stacked leaves
+        — elementwise updaters make stacked and per-layer updates the same
+        bits."""
+        model = self.model
+        transforms, labels = {}, {}
+        params = model.train_state.params
+        for i in self.head + self.tail:
+            layer = model.layers[i]
+            k = _layer_key(i, layer)
+            if k not in params:
+                continue
+            transforms[k] = model._layer_transform(layer)
+            labels[k] = jax.tree.map(lambda _: k, params[k])
+        trunk_layer = model.layers[self.t0]
+        transforms[TRUNK_KEY] = model._layer_transform(trunk_layer)
+        packed = self.pack_params(params)
+        labels[TRUNK_KEY] = jax.tree.map(lambda _: TRUNK_KEY,
+                                         packed[TRUNK_KEY])
+        return optax.multi_transform(transforms, labels)
+
+    def _packed_loss(self, params, model_state, x, y, rng, lmask,
+                     training=True):
+        from deeplearning4j_tpu.nn.base import cast_floating
+        from deeplearning4j_tpu.nn.constraints import apply_weight_noise
+        from deeplearning4j_tpu.runtime.environment import get_environment
+        model = self.model
+        out, last_in, new_state = self.packed_forward(
+            params, model_state, x, training=training, rng=rng)
+        final = model.layers[-1]
+        if not hasattr(final, "compute_loss"):
+            raise ValueError("Last layer must be an output/loss layer")
+        k = _layer_key(len(model.layers) - 1, final)
+        final_p = cast_floating(params.get(k, {}),
+                                get_environment().compute_dtype)
+        if training and getattr(final, "weight_noise", None) is not None \
+                and rng is not None:
+            lrng = jax.random.fold_in(rng, len(model.layers) - 1)
+            final_p = apply_weight_noise(final, final_p,
+                                         jax.random.fold_in(lrng, 7919))
+        loss = final.compute_loss(final_p, last_in, y, mask=lmask,
+                                  state=model_state.get(k, {}))
+        # trunk keys are absent from the packed tree, so _reg_score walks
+        # head/tail only (trunk reg is an eligibility error, never silent)
+        loss = loss + model._reg_score(params)
+        if training:
+            for s2 in new_state.values():
+                if isinstance(s2, dict) and "_aux_loss" in s2:
+                    loss = loss + s2["_aux_loss"]
+        if training and hasattr(final, "update_state_with_labels"):
+            new_state = dict(new_state)
+            new_state[k] = final.update_state_with_labels(
+                model_state.get(k, {}), jax.lax.stop_gradient(last_in), y)
+        return loss, new_state
+
+    def make_train_step(self, tx: optax.GradientTransformation):
+        """(packed_ts, x, y, rng, fmask, lmask) -> (packed_ts, loss); fmask
+        must be structurally None (feature masks don't stream through the
+        ring — the wrapper refuses them loudly)."""
+        from deeplearning4j_tpu.models.multi_layer_network import TrainState
+        model = self.model
+
+        def step(ts, x, y, rng, fmask, lmask):
+            if fmask is not None:
+                raise NotImplementedError(
+                    "feature masks are not supported under pipe-axis plans")
+            (loss, new_state), grads = jax.value_and_grad(
+                self._packed_loss, has_aux=True)(
+                    ts.params, ts.model_state, x, y, rng, lmask)
+            updates, new_opt = tx.update(grads, ts.opt_state, ts.params)
+            new_params = model._apply_constraints(
+                optax.apply_updates(ts.params, updates))
+            return TrainState(params=new_params, model_state=new_state,
+                              opt_state=new_opt, step=ts.step + 1), loss
+
+        return step
+
+    def packed_state(self):
+        """(packed TrainState placed on the plan's mesh, packed tx). Updater
+        slots are freshly initialised for the packed tree — same values as a
+        fresh unpacked init (counts 0, zero moments), so a fit that starts
+        here matches the oracle's fit from the same params."""
+        from deeplearning4j_tpu.models.multi_layer_network import TrainState
+        ts = self.model.train_state
+        packed = self.place_packed(self.pack_params(ts.params))
+        tx = self.packed_tx()
+        opt = tx.init(packed)
+        rep = self.plan.replicated()
+        return TrainState(
+            params=packed,
+            model_state=jax.device_put(ts.model_state, rep),
+            opt_state=opt,
+            step=jax.device_put(ts.step, rep)), tx
+
+    def sync_back(self, packed_ts) -> None:
+        """Write a trained packed state back to the model's unpacked
+        ``train_state`` (params/model_state/step). Updater slot state is
+        re-initialised — stateful updaters (Adam moments) lose accumulation
+        across the pack boundary; SGD-family trajectories are unaffected."""
+        from deeplearning4j_tpu.models.multi_layer_network import TrainState
+        params = jax.tree.map(jnp.asarray,
+                              self.unpack_params(jax.device_get(
+                                  packed_ts.params)))
+        model = self.model
+        model.train_state = TrainState(
+            params=params,
+            model_state=jax.device_get(packed_ts.model_state),
+            opt_state=model._tx.init(model._trainable(params)),
+            step=jnp.asarray(jax.device_get(packed_ts.step)))
+
+    # ----------------------------------------------------------------- serve
+    def make_forward(self):
+        """jit'd (packed_params, model_state, x, mask) -> output — the packed
+        twin of ``MultiLayerNetwork.output``'s inner fwd. The lowered program
+        bakes this executor's mesh (serving builds one executor per replica
+        device group)."""
+        def fwd(params, model_state, x_, m_):
+            if m_ is not None:
+                raise NotImplementedError(
+                    "feature masks are not supported under pipe-axis plans")
+            out, _, _ = self.packed_forward(params, model_state, x_,
+                                            training=False, rng=None)
+            return out
+
+        return jax.jit(fwd)

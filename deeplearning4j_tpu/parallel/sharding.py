@@ -1,11 +1,13 @@
-"""Sharding strategies: how params/optimizer state/batches map onto a Mesh.
+"""Parallel plans: how params/optimizer state/batches map onto a Mesh.
 
 This module is where the reference's parallelism *configuration* surface
 (``ParallelWrapper.Builder``, ``SharedTrainingMaster.Builder``) becomes
-TPU-native: a :class:`ShardingStrategy` names the mesh axes and produces
+TPU-native: a :class:`ParallelPlan` names the mesh axes and produces
 `jax.sharding.NamedSharding`s for every leaf of the train state and batch.
 
-Strategies (reference → here):
+A plan is a named-axis mesh (any subset of ``data`` / ``fsdp`` / ``model``
+/ ``pipe`` / ``seq``, each sized 1..N) plus per-leaf placement rules.  The
+classic single-axis strategies are degenerate plans:
 
 - ``data_parallel``   — replicate params, shard batch on ``data``: the analog
   of every DP mode the reference has (param averaging, shared gradients,
@@ -14,84 +16,115 @@ Strategies (reference → here):
   (ZeRO-3-style; the reference has nothing comparable — parity-plus).
 - ``tensor_parallel`` — shard weight matrices on ``model`` (Megatron-style
   alternating column/row split for attention+FFN; parity-plus).
+- ``expert_parallel`` — shard MoE expert tables on ``expert``.
 
-All strategies produce plain NamedShardings consumed by ``jax.jit`` /
+and :meth:`ParallelPlan.compose` builds the Megatron-LM-style multi-axis
+composition (data x fsdp x tensor x pipe [x seq]) on ONE mesh: the batch
+dim shards over the tuple of data-carrying axes (``data`` and ``fsdp`` —
+HSDP style, total DP degree = data*fsdp), weights shard over ``model``
+(tensor rule) then ``fsdp`` (first divisible dim), and a ``pipe`` axis
+selects the GPipe shift-register executor (``parallel/plan_exec.py``) for
+the model's uniform trunk. ``seq`` selects ring attention for the
+sequence dimension (``parallel/ring_attention.py``).
+
+All plans produce plain NamedShardings consumed by ``jax.jit`` /
 ``jax.device_put``; the same code path runs on a simulated CPU mesh and a
-real TPU pod slice (SURVEY.md §7.5 item 5).
+real TPU pod slice (SURVEY.md §7.5 item 5). ``plan.signature()`` is the
+hashable identity executors mix into AOT-cache keys so a plan change can
+never serve a stale executable (it misses the cache and recompiles — or
+falls back to jit — instead).
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from deeplearning4j_tpu.runtime.mesh import DATA_AXIS, EXPERT_AXIS, MODEL_AXIS
+from deeplearning4j_tpu.runtime.mesh import (DATA_AXIS, EXPERT_AXIS, FSDP_AXIS,
+                                             MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
+                                             MeshSpec, create_mesh)
+
+
+def _fsdp_rule(axis: str, axis_size: int, min_size: int):
+    """Shard every large param's first divisible dim over ``axis`` (ZeRO-3
+    style). Small params stay replicated."""
+    def rule(path, shape):
+        if int(np.prod(shape)) < min_size:
+            return P()
+        for dim, s in enumerate(shape):
+            if s % axis_size == 0 and s >= axis_size:
+                spec = [None] * len(shape)
+                spec[dim] = axis
+                return P(*spec)
+        return P()
+    return rule
+
+
+def _tensor_rule(tp: int):
+    """Megatron-style TP over the ``model`` axis: column-split the
+    first/expanding matmul of a block (W_q/W_k/W_v, FFN in), row-split the
+    contracting one (W_o, FFN out)."""
+    COL = ("W_q", "W_k", "W_v", "b_q", "b_k", "b_v", "W_ff1", "b_ff1")
+    ROW = ("W_o", "W_ff2")
+
+    def rule(path, shape):
+        keys = [getattr(p, "key", None) for p in path]
+        leaf = keys[-1] if keys else None
+        if leaf in COL:
+            if shape[-1] % tp == 0:
+                return P(*([None] * (len(shape) - 1) + [MODEL_AXIS]))
+        if leaf in ROW and len(shape) >= 2:
+            if shape[-2] % tp == 0:
+                return P(*([None] * (len(shape) - 2) + [MODEL_AXIS, None]))
+        return P()
+    return rule
 
 
 @dataclasses.dataclass
-class ShardingStrategy:
-    """Produces shardings for state/batch pytrees over a mesh.
+class ParallelPlan:
+    """Produces shardings for state/batch pytrees over a named-axis mesh.
 
     ``param_rule(path, shape) -> PartitionSpec`` decides weight placement;
-    the default replicates everything (pure DP).
+    the default replicates everything (pure DP). ``batch_axis`` is the mesh
+    axis (or tuple of axes) the batch dim shards over. ``kind`` names the
+    plan for signatures/manifests; ``pipe_microbatches`` is the GPipe
+    schedule depth used by the pipe-axis executors (1 = staged-sequential:
+    still distributed/memory-sharded, and the setting at which trained
+    trajectories are bit-identical to the unpipelined oracle — microbatch
+    splits only reorder gradient accumulation, like any DP resharding).
     """
 
     mesh: Mesh
-    param_rule: Optional[Callable[[Tuple[str, ...], Tuple[int, ...]], P]] = None
-    batch_axis: str = DATA_AXIS
+    param_rule: Optional[Callable[[Tuple[Any, ...], Tuple[int, ...]], P]] = None
+    batch_axis: Union[str, Tuple[str, ...]] = DATA_AXIS
+    kind: str = "data_parallel"
+    pipe_microbatches: int = 1
 
-    # ---- factories ----
+    # ---- degenerate single-axis plans (the PR-3 strategy surface) ----
     @staticmethod
-    def data_parallel(mesh: Mesh) -> "ShardingStrategy":
-        return ShardingStrategy(mesh=mesh, param_rule=None)
-
-    @staticmethod
-    def fsdp(mesh: Mesh, min_size: int = 1024) -> "ShardingStrategy":
-        """Shard every large param's first divisible axis over the data axis
-        (ZeRO-3 style). Small params stay replicated."""
-        axis_size = mesh.shape[DATA_AXIS]
-
-        def rule(path, shape):
-            if int(np.prod(shape)) < min_size:
-                return P()
-            for dim, s in enumerate(shape):
-                if s % axis_size == 0 and s >= axis_size:
-                    spec = [None] * len(shape)
-                    spec[dim] = DATA_AXIS
-                    return P(*spec)
-            return P()
-
-        return ShardingStrategy(mesh=mesh, param_rule=rule)
+    def data_parallel(mesh: Mesh) -> "ParallelPlan":
+        return ParallelPlan(mesh=mesh, param_rule=None, kind="data_parallel")
 
     @staticmethod
-    def tensor_parallel(mesh: Mesh) -> "ShardingStrategy":
-        """Megatron-style TP over the ``model`` axis: column-split the
-        first/expanding matmul of a block (W_q/W_k/W_v, FFN in), row-split the
-        contracting one (W_o, FFN out); embedding tables split on vocab."""
-        tp = mesh.shape[MODEL_AXIS]
-
-        COL = ("W_q", "W_k", "W_v", "b_q", "b_k", "b_v", "W_ff1", "b_ff1")
-        ROW = ("W_o", "W_ff2")
-
-        def rule(path, shape):
-            keys = [getattr(p, "key", None) for p in path]
-            leaf = keys[-1] if keys else None
-            if leaf in COL:
-                if shape[-1] % tp == 0:
-                    return P(*([None] * (len(shape) - 1) + [MODEL_AXIS]))
-            if leaf in ROW and len(shape) >= 2:
-                if shape[-2] % tp == 0:
-                    return P(*([None] * (len(shape) - 2) + [MODEL_AXIS, None]))
-            return P()
-
-        return ShardingStrategy(mesh=mesh, param_rule=rule)
+    def fsdp(mesh: Mesh, min_size: int = 1024) -> "ParallelPlan":
+        """Single-axis FSDP: batch AND params shard over ``data``."""
+        return ParallelPlan(
+            mesh=mesh,
+            param_rule=_fsdp_rule(DATA_AXIS, mesh.shape[DATA_AXIS], min_size),
+            kind="fsdp")
 
     @staticmethod
-    def expert_parallel(mesh: Mesh) -> "ShardingStrategy":
+    def tensor_parallel(mesh: Mesh) -> "ParallelPlan":
+        return ParallelPlan(mesh=mesh,
+                            param_rule=_tensor_rule(mesh.shape[MODEL_AXIS]),
+                            kind="tensor_parallel")
+
+    @staticmethod
+    def expert_parallel(mesh: Mesh) -> "ParallelPlan":
         """Shard MoE expert tables (leading expert dim: ``W_e1``, ``W_e2``,
         ``b_e1``, ``b_e2``) over the ``expert`` axis; GSPMD partitions the
         per-expert einsums across devices (no hand-written all-to-all)."""
@@ -110,7 +143,135 @@ class ShardingStrategy:
                 return P(*([EXPERT_AXIS] + [None] * (len(shape) - 1)))
             return P()
 
-        return ShardingStrategy(mesh=mesh, param_rule=rule)
+        return ParallelPlan(mesh=mesh, param_rule=rule, kind="expert_parallel")
+
+    # -------------------------------------------------------------- compose
+    @staticmethod
+    def compose(data: int = 1, fsdp: int = 1, tensor: int = 1,
+                pipe: int = 1, seq: int = 1, *,
+                devices_: Optional[Sequence] = None,
+                min_size: int = 1024,
+                microbatches: int = 1) -> "ParallelPlan":
+        """One mesh carrying every requested axis (sizes 1..N; exactly one
+        may be -1 to mean "whatever is left over"), with the composed
+        placement rules:
+
+        - batch dim over ``(data, fsdp)`` — both are data-parallel axes
+          (HSDP: total DP degree = data*fsdp); ``fsdp`` additionally
+          shards params/updater state (first divisible dim, ZeRO-3),
+        - tensor keys over ``model`` (checked first — a W_ff1 leaf must
+          land on the tensor split, not the fsdp split),
+        - ``pipe`` > 1 selects the GPipe executors for the model's uniform
+          trunk (``parallel/plan_exec.py``); the pipe axis never appears
+          in the per-leaf rule — trunk params are stage-stacked by the
+          executor and sharded ``P(pipe)`` on their leading stage dim,
+        - ``seq`` > 1 selects ring attention over the sequence axis.
+
+        Axis order is ``pipe, data, fsdp, model, seq`` so pipe stages are
+        the outermost (slowest-varying, ICI-farthest) placement, matching
+        the usual Megatron/GPipe topology.
+        """
+        sizes = {PIPE_AXIS: pipe, DATA_AXIS: data, FSDP_AXIS: fsdp,
+                 MODEL_AXIS: tensor, SEQ_AXIS: seq}
+        if sum(1 for v in sizes.values() if v == -1) > 1:
+            raise ValueError("at most one composed axis may be -1")
+        spec = {k: int(v) for k, v in sizes.items() if v == -1 or int(v) > 1}
+        if not spec:
+            spec = {DATA_AXIS: 1}
+        mesh = create_mesh(MeshSpec(spec), devices_=devices_)
+        shp = mesh.shape
+        rules = []
+        if shp.get(MODEL_AXIS, 1) > 1:
+            rules.append(_tensor_rule(shp[MODEL_AXIS]))
+        if shp.get(FSDP_AXIS, 1) > 1:
+            rules.append(_fsdp_rule(FSDP_AXIS, shp[FSDP_AXIS], min_size))
+
+        def rule(path, shape):
+            for r in rules:
+                spec_ = r(path, shape)
+                if tuple(spec_) != ():
+                    return spec_
+            return P()
+
+        batch_axes = tuple(a for a in (DATA_AXIS, FSDP_AXIS) if a in shp)
+        kind = "compose(" + ",".join(
+            f"{a}={shp[a]}" for a in mesh.axis_names) + ")"
+        return ParallelPlan(mesh=mesh,
+                            param_rule=rule if rules else None,
+                            batch_axis=batch_axes or DATA_AXIS,
+                            kind=kind,
+                            pipe_microbatches=max(1, int(microbatches)))
+
+    # ---------------------------------------------------------- introspection
+    def batch_axes(self) -> Tuple[str, ...]:
+        """The batch-sharding axes as a tuple (single-axis plans included),
+        filtered to axes the mesh actually carries."""
+        axes = (self.batch_axis if isinstance(self.batch_axis, tuple)
+                else (self.batch_axis,))
+        return tuple(a for a in axes if a in self.mesh.shape)
+
+    def batch_divisor(self) -> int:
+        """Total data-parallel degree: the batch size must divide by this."""
+        n = 1
+        for a in self.batch_axes():
+            n *= self.mesh.shape[a]
+        return max(1, n)
+
+    def axis_size(self, axis: str) -> int:
+        return int(self.mesh.shape.get(axis, 1))
+
+    @property
+    def pipe_size(self) -> int:
+        return self.axis_size(PIPE_AXIS)
+
+    @property
+    def seq_size(self) -> int:
+        return self.axis_size(SEQ_AXIS)
+
+    def devices_per_replica(self) -> int:
+        """Serving view: devices consumed by ONE plan-slice replica — every
+        axis except ``data`` (the data axis of a serving plan IS the
+        replica fan-out)."""
+        n = 1
+        for a, s in self.mesh.shape.items():
+            if a != DATA_AXIS:
+                n *= int(s)
+        return max(1, n)
+
+    def signature(self) -> Tuple:
+        """Hashable plan identity for AOT-cache keys and warmup manifests:
+        kind + ordered (axis, size) pairs + batch axes + the pipe schedule.
+        Any drift (axis added/resized, executor knob changed) produces a
+        different key, so a changed plan can never hit a stale executable
+        — it misses and recompiles, or the AOT layer falls back to jit."""
+        return ("plan", self.kind,
+                tuple((a, int(self.mesh.shape[a]))
+                      for a in self.mesh.axis_names),
+                self.batch_axes(), int(self.pipe_microbatches))
+
+    def describe(self) -> Dict[str, Any]:
+        """JSON-friendly twin of :meth:`signature` for manifests/capacity."""
+        return {"kind": self.kind,
+                "axes": {a: int(self.mesh.shape[a])
+                         for a in self.mesh.axis_names},
+                "batch_axes": list(self.batch_axes()),
+                "pipe_microbatches": int(self.pipe_microbatches)}
+
+    def replica_slice(self, devices) -> "ParallelPlan":
+        """The per-replica sub-plan over one replica's device group: the
+        same axes minus ``data`` (sized to ``devices``). Used by the
+        serving tier, where a "replica" generalizes from one device to one
+        plan-slice."""
+        axes = {a: int(s) for a, s in self.mesh.shape.items()
+                if a != DATA_AXIS and int(s) > 1}
+        if not axes:
+            axes = {DATA_AXIS: 1}
+        mesh = create_mesh(MeshSpec(axes), devices_=list(devices))
+        batch = tuple(a for a in self.batch_axes() if a in mesh.shape)
+        return ParallelPlan(mesh=mesh, param_rule=self.param_rule,
+                            batch_axis=batch or DATA_AXIS,
+                            kind=self.kind + "/slice",
+                            pipe_microbatches=self.pipe_microbatches)
 
     # ---- application ----
     def param_sharding(self, tree) -> Any:
@@ -129,13 +290,18 @@ class ShardingStrategy:
         return NamedSharding(self.mesh, P())
 
     def batch_sharding(self, ndim: int) -> NamedSharding:
-        return NamedSharding(self.mesh, P(self.batch_axis, *([None] * (ndim - 1))))
+        axes = self.batch_axes()
+        lead = axes if len(axes) > 1 else (axes[0] if axes else None)
+        return NamedSharding(self.mesh, P(lead, *([None] * (ndim - 1))))
 
 
-def shard_train_state(state, strategy: ShardingStrategy):
+#: Backward-compatible name: a "strategy" has always been a degenerate plan.
+ShardingStrategy = ParallelPlan
+
+
+def shard_train_state(state, strategy: ParallelPlan):
     """Place a TrainState onto the mesh. Params/opt state follow the param
     rule; scalars (step counters) replicate."""
-    import dataclasses as dc
     from deeplearning4j_tpu.models.multi_layer_network import TrainState
 
     params_sh = strategy.param_sharding(state.params)
@@ -148,11 +314,12 @@ def shard_train_state(state, strategy: ShardingStrategy):
                       opt_state=opt_state, step=step)
 
 
-def shard_batch(strategy: ShardingStrategy, *arrays):
-    """Shard batch arrays along the data axis (pad-free: batch must divide
-    by the data-axis size, as in the reference's even data distribution)."""
+def shard_batch(strategy: ParallelPlan, *arrays):
+    """Shard batch arrays along the plan's data axes (pad-free: batch must
+    divide by the total DP degree, as in the reference's even data
+    distribution)."""
     out = []
-    n = strategy.mesh.shape[strategy.batch_axis]
+    n = strategy.batch_divisor()
     for a in arrays:
         if a is None:
             out.append(None)
@@ -164,7 +331,7 @@ def shard_batch(strategy: ShardingStrategy, *arrays):
     return out if len(out) > 1 else out[0]
 
 
-def shard_batch_tree(strategy: ShardingStrategy, tree):
+def shard_batch_tree(strategy: ParallelPlan, tree):
     """:func:`shard_batch` over an arbitrary pytree of batch arrays — the
     dict inputs / list labels / optional-mask dicts of a ComputationGraph
     batch. ``None`` leaves (absent masks) pass through unsharded."""

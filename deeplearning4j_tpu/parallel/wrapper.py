@@ -197,7 +197,8 @@ class ParallelWrapper:
             f"pw_unrolled@k={k}",
             lambda: make_unrolled_step(model._train_step_fn(), k))
         model.train_state, losses = self._aot().call(
-            ("pw-group", k, step_args_signature(group[0][0])),
+            ("pw-group", self.strategy.signature(), k,
+             step_args_signature(group[0][0])),
             fn, model.train_state, [args for args, _n in group])
         return [losses[i] for i in range(k)]
 
@@ -210,12 +211,81 @@ class ParallelWrapper:
         return self.model._jit_cache.setdefault(
             "__aot_pw__", AotCache("pw-step"))
 
+    def _fit_pipe(self, iterator, epochs: int, profiler=None):
+        """Pipe-axis fit: the model's uniform trunk is stage-stacked and
+        streamed through the GPipe shift register (``plan_exec``); each pipe
+        device holds 1/S of the trunk, the ``data`` axis (if present) shards
+        the batch. Same listener/epoch semantics as the SPMD path; the
+        trained params are written back to ``model.train_state``."""
+        from deeplearning4j_tpu.parallel.plan_exec import PipePlanExecutor
+        from deeplearning4j_tpu.runtime.state_packing import (
+            step_args_signature)
+        from deeplearning4j_tpu.train.prefetch import batch_source
+        self._check_supported()
+        model = self.model
+        if hasattr(model, "_coerce_batch"):
+            raise NotImplementedError(
+                "pipe-axis plans drive MultiLayerNetwork layer stacks; "
+                "ComputationGraph topologies have no linear trunk to stage")
+        if model.train_state is None:
+            model.init()
+        if getattr(self, "_pipe_exec", None) is None:
+            self._pipe_exec = PipePlanExecutor(model, self.strategy)
+        ex = self._pipe_exec
+        packed_ts, tx = ex.packed_state()
+        step_fn = jax.jit(ex.make_train_step(tx), donate_argnums=(0,))
+        aot = self._aot()
+        plan_sig = self.strategy.signature()
+        if profiler is not None:
+            profiler.start()
+
+        try:
+            with self.strategy.mesh:
+                for _ in range(int(epochs)):
+                    for lst in model._listeners:
+                        lst.on_epoch_start(model, model._epoch)
+                    src = batch_source(iterator, self._prepare_batch,
+                                       self.prefetch_buffer, profiler)
+                    try:
+                        for args, n in src:
+                            args = self._insert_rng(args)
+                            if args[3] is not None:
+                                raise NotImplementedError(
+                                    "feature masks are not supported under "
+                                    "pipe-axis plans")
+                            packed_ts, loss = aot.call(
+                                ("pw-pipe", plan_sig,
+                                 step_args_signature(args)),
+                                step_fn, packed_ts, *args)
+                            model._score = loss
+                            model._iteration += 1
+                            for lst in model._listeners:
+                                if isinstance(lst, PerformanceListener):
+                                    lst.record_batch(n)
+                                lst.iteration_done(model, model._iteration,
+                                                   model._epoch, loss)
+                    finally:
+                        src.close()
+                    for lst in model._listeners:
+                        lst.on_epoch_end(model, model._epoch)
+                    model._epoch += 1
+        finally:
+            if profiler is not None:
+                profiler.stop()
+        ex.sync_back(packed_ts)
+        return model
+
     def fit(self, iterator, epochs: int = 1, profiler=None):
         """Distributed fit: same listener/epoch semantics (and bit-identical
         trajectory) as the wrapped model's own ``fit``, with batches sharded
         across the mesh, prefetched ``prefetch_buffer`` deep, and losses
         delivered on the async completion path. ``profiler`` takes a
-        :class:`~deeplearning4j_tpu.train.profiler.TrainingProfiler`."""
+        :class:`~deeplearning4j_tpu.train.profiler.TrainingProfiler`.
+
+        Plans with a ``pipe`` axis route through the GPipe executor
+        (:meth:`_fit_pipe`) — same call, pipelined execution."""
+        if self.strategy.pipe_size > 1:
+            return self._fit_pipe(iterator, epochs, profiler)
         from deeplearning4j_tpu.runtime.state_packing import GroupedDispatch
         from deeplearning4j_tpu.train.prefetch import (AsyncLossDelivery,
                                                        batch_source,
@@ -240,7 +310,11 @@ class ParallelWrapper:
 
         def run_single(item):
             args, _n = item
-            out = aot.call(("pw", step_args_signature(args)),
+            # the plan signature joins the key: plan drift (axis added or
+            # resized, schedule knob changed) misses the cache and
+            # recompiles — never a stale executable for the wrong mesh
+            out = aot.call(("pw", self.strategy.signature(),
+                            step_args_signature(args)),
                            step_fn, model.train_state, *args)
             model.train_state, loss = out
             return loss

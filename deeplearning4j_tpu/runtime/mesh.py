@@ -9,6 +9,8 @@ the collectives. This module is the single place the rest of the framework asks
 Mesh axis conventions used throughout the framework:
 
 - ``data``   — data parallelism (batch sharding; psum of grads over ICI)
+- ``fsdp``   — parameter/optimizer sharding (ZeRO-3) in a composed plan;
+  single-axis FSDP reuses ``data`` (batch AND params shard together there)
 - ``model``  — tensor parallelism (weight sharding)
 - ``pipe``   — pipeline stage axis
 - ``seq``    — sequence/context parallelism (ring attention)
@@ -25,6 +27,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
 MODEL_AXIS = "model"
 PIPE_AXIS = "pipe"
 SEQ_AXIS = "seq"

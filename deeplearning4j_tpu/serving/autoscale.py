@@ -513,9 +513,21 @@ class SLOAutoscaler:
           latency burn just has not caught up yet;
         - **forecast** — the short-horizon linear trend over the SLO
           ring's per-second request counts exceeds the estimated
-          serveable rate (current rate / busy fraction) by
-          ``forecast_margin``: the 10x step is scaled for BEFORE the
-          burn-rate breach it would otherwise become."""
+          serveable rate by ``forecast_margin``: the 10x step is scaled
+          for BEFORE the burn-rate breach it would otherwise become.
+
+        The forecast comparison is a *blend* (ISSUE 20 satellite), not
+        two independent triggers: the serveable rate averages the
+        utilization-implied estimate (current rate / busy fraction)
+        with the fleet's admission-queue drain-rate capacity
+        (``drain_rate_rps`` — summed ``1000 / drain_ms_per_request``
+        across workers), and the predicted demand folds the standing
+        queue backlog in as ``depth / horizon`` — a ramp arriving on
+        top of an already-backed-up queue trips the signal earlier than
+        either series would alone. When only one serveable estimate is
+        available (near-idle fleet, or no drain sample yet) the blend
+        degrades to that one; with neither there is no honest capacity
+        estimate and no forecast signal."""
         cfg = self.config
         now_wall = time.time()
         for sched in (cfg.schedules or []):
@@ -556,16 +568,39 @@ class SLOAutoscaler:
             busy = float(entry.get("busy_fraction", 0.0))
         except (TypeError, ValueError):
             busy = 0.0
-        if busy <= 0.01:
-            return None  # near-idle: no honest capacity estimate
-        serveable = rate_now / min(1.0, max(busy, 1e-6))
-        if pred > serveable * cfg.forecast_margin:
-            return {"signal": "forecast",
-                    "rate_now": round(rate_now, 3),
-                    "predicted_rate": round(pred, 3),
-                    "serveable_rate": round(serveable, 3),
-                    "slope_per_s": round(slope, 4),
-                    "horizon_s": cfg.forecast_horizon_s}
+        try:
+            drain_rps = float(entry.get("drain_rate_rps", 0.0))
+        except (TypeError, ValueError):
+            drain_rps = 0.0
+        util_serveable = (rate_now / min(1.0, max(busy, 1e-6))
+                          if busy > 0.01 else None)
+        if util_serveable is None and drain_rps <= 0:
+            return None  # near-idle, no drain sample: nothing honest
+        if util_serveable is not None and drain_rps > 0:
+            serveable = (util_serveable + drain_rps) / 2.0
+        elif util_serveable is not None:
+            serveable = util_serveable
+        else:
+            serveable = drain_rps
+        # the standing backlog must ALSO clear within the horizon: fold
+        # it into demand so ramp-onto-backlog trips earlier than the
+        # traffic trend alone would
+        horizon = max(cfg.forecast_horizon_s, 1e-6)
+        backlog_rate = depth / horizon if depth > 0 else 0.0
+        demand = pred + backlog_rate
+        if demand > serveable * cfg.forecast_margin:
+            out = {"signal": "forecast",
+                   "rate_now": round(rate_now, 3),
+                   "predicted_rate": round(pred, 3),
+                   "serveable_rate": round(serveable, 3),
+                   "slope_per_s": round(slope, 4),
+                   "horizon_s": cfg.forecast_horizon_s}
+            if backlog_rate > 0:
+                out["backlog_rate"] = round(backlog_rate, 3)
+                out["predicted_demand"] = round(demand, 3)
+            if drain_rps > 0:
+                out["drain_rate_rps"] = round(drain_rps, 3)
+            return out
         return None
 
     # ----------------------------------------------------------- decisions

@@ -178,10 +178,14 @@ class ContinuousBatcher:
                  warmup_example: Optional[ArrayOrDict] = None,
                  replicas: int = 1, pipeline_depth: int = 2,
                  devices: Optional[Sequence] = None,
-                 dtype_policy=None):
+                 dtype_policy=None, plan=None):
         self.model = model
         if model.train_state is None:
             model.init()
+        # multi-axis ParallelPlan (ISSUE 20): a "replica" becomes one
+        # plan-slice (pipe/tensor device group); recorded in the warmup
+        # manifest so a replayed warmup rebuilds the same slicing
+        self.plan = plan
         # per-model/per-bucket serving dtype policy (ISSUE 8): warmup
         # pre-warms the policy's quantized (bucket, replica, dtype) pairs
         # alongside the float ones, quantized requests are counted and
@@ -195,7 +199,8 @@ class ContinuousBatcher:
         self.pipeline_depth = max(0, int(pipeline_depth))
         self.admission = admission or AdmissionController(queue_limit=queue_limit)
         self._queue: "queue.Queue[_Request]" = queue.Queue()
-        self._pool = ReplicaPool(model, n_replicas=replicas, devices=devices)
+        self._pool = ReplicaPool(model, n_replicas=replicas, devices=devices,
+                                 plan=plan)
         self.metrics = metrics or ServingMetrics(
             queue_depth_fn=self._queue.qsize,
             compile_count_fn=self.compile_count,
@@ -374,7 +379,8 @@ class ContinuousBatcher:
             max_batch_size=self.max_batch_size,
             model=type(self.model).__name__,
             policy=(self.dtype_policy.to_dict()
-                    if self.dtype_policy is not None else None))
+                    if self.dtype_policy is not None else None),
+            plan=(self.plan.describe() if self.plan is not None else None))
 
     @staticmethod
     def _zeros_with_rows(x: ArrayOrDict, rows: int) -> ArrayOrDict:

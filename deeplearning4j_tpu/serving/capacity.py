@@ -52,6 +52,7 @@ from typing import Any, Dict, Optional
 __all__ = ["model_capacity", "process_capacity", "registry_capacity",
            "render_prometheus", "persistent_cache_bytes",
            "served_device_bytes", "served_device_dtype_bytes",
+           "served_per_device_bytes",
            "attach_harvest", "detach_harvest", "device_utilization"]
 
 # The background scheduler (ISSUE 19) registers a zero-arg provider here
@@ -77,7 +78,12 @@ def detach_harvest() -> None:
 
 
 def _leaf_bytes(tree) -> Dict[str, int]:
-    """Per-dtype byte totals over a pytree of arrays (device or host)."""
+    """Per-dtype byte totals over a pytree of arrays (device or host).
+
+    GLOBAL logical bytes — a sharded array counts its full size once. Use
+    :func:`_leaf_device_bytes` for the allocation-true per-device view
+    (ISSUE 20: the two differ exactly when a plan shards or replicates a
+    tree across a replica's device group)."""
     import jax
     out: Dict[str, int] = {}
     for leaf in jax.tree_util.tree_leaves(tree):
@@ -88,6 +94,56 @@ def _leaf_bytes(tree) -> Dict[str, int]:
         nbytes = int(size) * int(dt.itemsize)
         key = str(dt)
         out[key] = out.get(key, 0) + nbytes
+    return out
+
+
+def _leaf_device_bytes(tree) -> Dict[str, Dict[str, int]]:
+    """Allocation-true accounting (ISSUE 20): ``device -> dtype -> bytes``
+    over a pytree, from each jax array's actual shards. A plan-sharded
+    leaf charges each device only its LOCAL shard; a leaf replicated over
+    a replica group charges every copy. Host arrays (numpy fallbacks)
+    land under the pseudo-device ``"host"`` at their full size."""
+    import jax
+    out: Dict[str, Dict[str, int]] = {}
+
+    def charge(dev: str, dt: str, nbytes: int) -> None:
+        slot = out.setdefault(dev, {})
+        slot[dt] = slot.get(dt, 0) + nbytes
+
+    for leaf in jax.tree_util.tree_leaves(tree):
+        dt = getattr(leaf, "dtype", None)
+        if dt is None or not hasattr(leaf, "size"):
+            continue
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            import numpy as _np
+            for sh in shards:
+                n = int(_np.prod(sh.data.shape)) if sh.data.ndim else 1
+                charge(str(sh.device), str(dt), n * int(dt.itemsize))
+        else:
+            charge("host", str(dt), int(leaf.size) * int(dt.itemsize))
+    return out
+
+
+def _merge_device_bytes(dst: Dict[str, Dict[str, int]],
+                        src: Dict[str, Dict[str, int]]) -> None:
+    for dev, dts in src.items():
+        slot = dst.setdefault(dev, {})
+        for dt, b in dts.items():
+            slot[dt] = slot.get(dt, 0) + b
+
+
+def served_per_device_bytes(served) -> Dict[str, int]:
+    """Per-device byte map of one served model — the shard-aware ledger
+    view (ISSUE 20). Each replica charges each of its devices only the
+    bytes that device actually holds (its param shards plus its copy of
+    anything replicated over the slice), so a plan-sliced replica of an
+    oversized model reads as N small per-device charges instead of the
+    full tree on every device. This is the number the per-device HBM
+    budget is held against."""
+    out: Dict[str, int] = {}
+    for dev, dts in _served_device_map(served).items():
+        out[dev] = sum(dts.values())
     return out
 
 
@@ -110,6 +166,19 @@ def served_device_dtype_bytes(served) -> Dict[str, int]:
     ACTUAL device dtypes — an int8-resident quantized model shows its
     4x-smaller footprint, which is exactly what makes it 4x cheaper to
     keep resident under ``paging.retention_weight``."""
+    out: Dict[str, int] = {}
+    for dts in _served_device_map(served).values():
+        for dt, b in dts.items():
+            out[dt] = out.get(dt, 0) + b
+    return out
+
+
+def _served_device_map(served) -> Dict[str, Dict[str, int]]:
+    """Shared traversal behind :func:`served_device_dtype_bytes` and
+    :func:`served_per_device_bytes`: ``device -> dtype -> bytes`` over
+    every replica's actual allocations (shard-aware, ISSUE 20). The
+    fallback pseudo-replica charges the model's host state under its
+    nominal device — the host state IS what executes there."""
     pool = served.batcher._pool
     ts = getattr(served.model, "train_state", None)
     host: Dict[str, int] = {}
@@ -117,17 +186,13 @@ def served_device_dtype_bytes(served) -> Dict[str, int]:
                  getattr(ts, "model_state", None)):
         for dt, b in _leaf_bytes(part).items():
             host[dt] = host.get(dt, 0) + b
-    out: Dict[str, int] = {}
+    out: Dict[str, Dict[str, int]] = {}
     for rep in list(pool.replicas):
         if rep.params is not None:
-            src: Dict[str, int] = {}
             for part in (rep.params, rep.model_state):
-                for dt, b in _leaf_bytes(part).items():
-                    src[dt] = src.get(dt, 0) + b
+                _merge_device_bytes(out, _leaf_device_bytes(part))
         else:
-            src = host
-        for dt, b in src.items():
-            out[dt] = out.get(dt, 0) + b
+            _merge_device_bytes(out, {str(rep.device): dict(host)})
     return out
 
 
@@ -157,8 +222,14 @@ def model_capacity(served) -> Dict[str, Any]:
     device_bytes_total = 0
     for rep in list(pool.replicas):
         if rep.params is not None:
-            rb = (sum(_leaf_bytes(rep.params).values())
-                  + sum(_leaf_bytes(rep.model_state).values()))
+            # shard-aware (ISSUE 20): sum of what the replica's devices
+            # actually hold — equals the old whole-tree math for classic
+            # single-device replicas, and the true allocation for
+            # plan-sliced ones (shards once, replication per copy)
+            dm: Dict[str, Dict[str, int]] = {}
+            for part in (rep.params, rep.model_state):
+                _merge_device_bytes(dm, _leaf_device_bytes(part))
+            rb = sum(b for dts in dm.values() for b in dts.values())
         else:
             # fallback pseudo-replica: no device_put copy of its own, the
             # model's host state IS what executes
@@ -187,6 +258,9 @@ def model_capacity(served) -> Dict[str, Any]:
         "model_state_bytes": state_bytes,
         "replicas": len(pool),
         "device_bytes_total": device_bytes_total,
+        # shard-aware per-device charges (ISSUE 20) — what the per-device
+        # HBM budget is held against for plan-sliced replicas
+        "per_device_bytes": served_per_device_bytes(served),
         "per_replica": per_replica,
         "utilization": {
             # (busy_s, window_s) pair, NOT a pre-divided fraction: the

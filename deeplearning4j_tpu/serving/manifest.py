@@ -108,6 +108,11 @@ class WarmupManifest:
     #: measured page-in wall seconds (ISSUE 11): seeds the honest
     #: ``Retry-After`` estimate before this process has paged it in once
     page_in_s: float = 0.0
+    #: ParallelPlan of the recording batcher (ISSUE 20,
+    #: ``ParallelPlan.describe()``): a plan-sliced warmup replayed under a
+    #: DIFFERENT plan would mint different executables, so the replayer
+    #: rebuilds the same slicing (or treats the manifest as cold)
+    plan: Optional[dict] = None
 
     # ------------------------------------------------------------ construct
     @staticmethod
@@ -115,7 +120,8 @@ class WarmupManifest:
                      pairs: List[Tuple[int, int, str]],
                      max_batch_size: int = 0,
                      model: str = "",
-                     policy: Optional[dict] = None) -> "WarmupManifest":
+                     policy: Optional[dict] = None,
+                     plan: Optional[dict] = None) -> "WarmupManifest":
         if isinstance(example, dict):
             inputs = {str(k): {"shape_tail": list(v.shape[1:]),
                                "dtype": str(np.asarray(v).dtype)}
@@ -131,7 +137,7 @@ class WarmupManifest:
                                      for b, r, d in pairs],
                               max_batch_size=int(max_batch_size),
                               model=model, created_at=time.time(),
-                              policy=policy)
+                              policy=policy, plan=plan)
 
     def example(self, rows: int = 1) -> ArrayOrDict:
         """A ``rows``-row zeros warmup example matching the recorded input
@@ -154,6 +160,8 @@ class WarmupManifest:
              "pairs": [list(p) for p in self.pairs]}
         if self.policy is not None:
             d["policy"] = self.policy
+        if self.plan is not None:
+            d["plan"] = self.plan
         if self.device_bytes:
             d["device_bytes"] = int(self.device_bytes)
         if self.page_in_s:
@@ -176,7 +184,8 @@ class WarmupManifest:
             created_at=float(d.get("created_at", 0.0)),
             policy=d.get("policy"),
             device_bytes=int(d.get("device_bytes", 0)),
-            page_in_s=float(d.get("page_in_s", 0.0)))
+            page_in_s=float(d.get("page_in_s", 0.0)),
+            plan=d.get("plan"))
 
     def save(self, path: str) -> None:
         """Atomic write (tmp + rename) — a crash mid-save must leave either

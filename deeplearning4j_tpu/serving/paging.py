@@ -225,8 +225,8 @@ class Residency:
 
     __slots__ = ("name", "state", "evictable", "archive_path", "version",
                  "load_kwargs", "gate_report", "bytes", "bytes_estimated",
-                 "dtype_bytes", "last_used", "ewma", "page_in_s",
-                 "page_ins", "evictions", "risk")
+                 "dtype_bytes", "device_map", "last_used", "ewma",
+                 "page_in_s", "page_ins", "evictions", "risk")
 
     def __init__(self, name: str, halflife_s: float = 60.0):
         self.name = name
@@ -247,6 +247,11 @@ class Residency:
         #: shows {"int8": ...} 4x smaller than its f32 twin — feeding
         #: dtype-aware eviction scoring and the residency snapshot
         self.dtype_bytes: Dict[str, int] = {}
+        #: measured per-device byte map (ISSUE 20, shard-aware): what each
+        #: device actually holds for this model — a plan-sliced replica
+        #: charges each device only its local shards, so the per-device
+        #: budget check never sees the full tree on every device
+        self.device_map: Dict[str, int] = {}
         self.last_used = 0.0
         self.ewma = TrafficEWMA(halflife_s)
         self.page_in_s = 0.0            # decayed page-in cost estimate
@@ -280,6 +285,7 @@ class Residency:
             "bytes": int(self.bytes or 0),
             "bytes_estimated": bool(self.bytes_estimated),
             "dtype_bytes": dict(self.dtype_bytes),
+            "device_map": dict(self.device_map),
             "retention_weight": self.retention(now),
             "evictable": bool(self.evictable),
             "traffic_ewma": round(self.ewma.rate(now), 4),

@@ -232,6 +232,9 @@ class ModelRegistry:
         self._budget: Optional[int] = None
         self._residency: Dict[str, paging.Residency] = {}
         self._reserved: Dict[str, int] = {}  # in-build byte reservations
+        # per-device reservation maps (ISSUE 20): the shard-aware twin of
+        # _reserved, so the per-device budget check covers in-build loads
+        self._reserved_maps: Dict[str, Dict[str, int]] = {}
         self._flights: Dict[str, _PageFlight] = {}
         self._flight_lock = threading.Lock()  # guards: _flights
         self.paging = paging.PagingMetrics()
@@ -327,7 +330,8 @@ class ModelRegistry:
         # budget, even transiently under concurrent page-ins. Evicts
         # cost-weighted-LRU victims as needed.
         est = self._estimate_device_bytes(model, batcher_kw, manifest)
-        self._reserve_room(name, est)
+        est_map = self._estimate_per_device(model, batcher_kw, manifest)
+        self._reserve_room(name, est, est_map=est_map)
         # recompile risk cached OUTSIDE the lock (it stats the manifest
         # path) so victim selection never touches the filesystem
         risk = (paging.recompile_risk(_archive_info[0])
@@ -342,6 +346,7 @@ class ModelRegistry:
         except BaseException:
             with self._lock:
                 self._reserved.pop(name, None)
+                self._reserved_maps.pop(name, None)
             logger.warning(
                 "register(%r): replacement build/warmup failed; previous "
                 "version (if any) keeps serving", name)
@@ -351,13 +356,17 @@ class ModelRegistry:
         served.metrics.set_warmup_seconds(time.monotonic() - t0)
         from deeplearning4j_tpu.serving import capacity
         dtype_bytes: Dict[str, int] = {}
+        device_map: Dict[str, int] = {}
         try:
             dtype_bytes = capacity.served_device_dtype_bytes(served)
             served.device_bytes = sum(dtype_bytes.values())
+            device_map = capacity.served_per_device_bytes(served)
         except Exception:
             served.device_bytes = est  # never let accounting fail a deploy
+            device_map = dict(est_map)
         with self._lock:
             self._reserved.pop(name, None)
+            self._reserved_maps.pop(name, None)
             prev = self._models.get(name)
             if version is None:
                 version = prev.version + 1 if prev else 1
@@ -375,6 +384,9 @@ class ModelRegistry:
             # makes eviction scoring dtype-aware — int8-resident models
             # carry their actual 4x-smaller footprint into retention()
             res.dtype_bytes = dict(dtype_bytes)
+            # shard-aware per-device charges (ISSUE 20): what the
+            # per-device HBM budget check holds each device to
+            res.device_map = dict(device_map)
             res.version = served.version
             res.last_used = time.monotonic()
             if _archive_info is not None:
@@ -857,13 +869,83 @@ class ModelRegistry:
             replicas = manifest.replicas
         return host * max(1, int(replicas or 1))
 
-    def _reserve_room(self, name: str, est: int) -> None:
-        """Block until ``est`` bytes fit under the HBM budget (evicting
-        cost-weighted-LRU victims), then reserve them under ``name`` so a
-        concurrent load cannot double-book the same headroom. No-op
+    def _estimate_per_device(self, model, batcher_kw: Dict[str, Any],
+                             manifest) -> Dict[str, int]:
+        """Shard-aware reservation estimate (ISSUE 20): the per-device
+        charges registering ``model`` will place. A classic pool puts one
+        whole copy per replica on one device each (round-robin, mirroring
+        ``ReplicaPool``); a plan-sliced pool spreads each replica group's
+        copy across its slice devices, so an oversized model reserves
+        small per-device shards instead of its full tree on one device.
+        Approximate by construction — the post-build measurement
+        (``capacity.served_per_device_bytes``) replaces it."""
+        from deeplearning4j_tpu.serving.capacity import _leaf_bytes
+        ts = getattr(model, "train_state", None)
+        host = (sum(_leaf_bytes(getattr(ts, "params", None)).values())
+                + sum(_leaf_bytes(getattr(ts, "model_state", None)).values()))
+        replicas = batcher_kw.get("replicas")
+        if not replicas and manifest is not None:
+            replicas = manifest.replicas
+        replicas = max(1, int(replicas or 1))
+        plan = batcher_kw.get("plan")
+        devices = batcher_kw.get("devices")
+        if devices is None:
+            import jax
+            devices = jax.devices()
+        out: Dict[str, int] = {}
+        if plan is None:
+            for i in range(replicas):
+                d = str(devices[i % len(devices)])
+                out[d] = out.get(d, 0) + host
+            return out
+        gs = max(1, plan.devices_per_replica())
+        n_groups = max(1, len(devices) // gs)
+        per_dev = -(-host // gs)  # even-shard approximation, rounded up
+        for i in range(replicas):
+            g = i % n_groups
+            for d in devices[g * gs:(g + 1) * gs]:
+                out[str(d)] = out.get(str(d), 0) + per_dev
+        return out
+
+    def _resident_per_device_locked(self, exclude: str = ""
+                                    ) -> Optional[Dict[str, int]]:  # holds: _lock
+        """Per-device resident charges (measured maps + in-build
+        reservation maps), or ``None`` when any counted entry lacks a
+        map — the caller then falls back to the summed-total check, so
+        accounting gaps degrade to the conservative pre-plan behavior."""
+        out: Dict[str, int] = {}
+        for n, r in self._residency.items():
+            if r.state != paging.RESIDENT or n == exclude:
+                continue
+            if not r.device_map:
+                if int(r.bytes or 0) > 0:
+                    return None
+                continue
+            for d, b in r.device_map.items():
+                out[d] = out.get(d, 0) + int(b)
+        for n, m in self._reserved_maps.items():
+            if n == exclude:
+                continue
+            for d, b in m.items():
+                out[d] = out.get(d, 0) + int(b)
+        return out
+
+    def _reserve_room(self, name: str, est: int,
+                      est_map: Optional[Dict[str, int]] = None) -> None:
+        """Block until the load fits under the HBM budget (evicting
+        cost-weighted-LRU victims), then reserve the bytes under ``name``
+        so a concurrent load cannot double-book the same headroom. No-op
         without a budget. Raises :class:`HBMBudgetExceeded` when no
         victim frees enough room within a bounded wait (every candidate
-        pinned or non-evictable)."""
+        pinned or non-evictable).
+
+        The budget is held PER DEVICE (ISSUE 20): with per-device charge
+        maps available for every counted entry, the check is
+        ``max_d(in_use_d + est_d) <= budget`` — a plan-sliced replica's
+        small per-device shards fit where its summed tree would not.
+        When maps are missing (legacy entries, failed measurement) the
+        check degrades to the summed-total comparison, which can only be
+        more conservative."""
         budget = self.hbm_budget_bytes
         if budget is None:
             return
@@ -871,7 +953,14 @@ class ModelRegistry:
         while True:
             with self._lock:
                 in_use = self._resident_bytes_locked(exclude=name)
-                if in_use + est <= budget:
+                in_use_map = (self._resident_per_device_locked(exclude=name)
+                              if est_map else None)
+                if in_use_map is not None:
+                    fits = all(in_use_map.get(d, 0) + b <= budget
+                               for d, b in est_map.items())
+                else:
+                    fits = in_use + est <= budget
+                if fits:
                     # a hot-swap replaces the OLD version's bytes, which
                     # stay counted (and loaded) until the swap: reserve
                     # only the DELTA so the ledger (old + reservation)
@@ -882,6 +971,12 @@ class ModelRegistry:
                     old = (int(res.bytes or 0) if res is not None
                            and res.state == paging.RESIDENT else 0)
                     self._reserved[name] = max(0, int(est) - old)
+                    if est_map:
+                        oldm = (res.device_map if res is not None
+                                and res.state == paging.RESIDENT else {})
+                        self._reserved_maps[name] = {
+                            d: max(0, int(b) - int((oldm or {}).get(d, 0)))
+                            for d, b in est_map.items()}
                     return
                 victim = self._pick_victim_locked(exclude=name)
                 # can waiting ever help? yes while something evictable is
@@ -943,6 +1038,7 @@ class ModelRegistry:
         try:
             dtype_bytes = capacity.served_device_dtype_bytes(served)
             measured = sum(dtype_bytes.values())
+            device_map = capacity.served_per_device_bytes(served)
         except Exception:
             return served.device_bytes
         with self._lock:
@@ -952,6 +1048,7 @@ class ModelRegistry:
                 res.bytes = measured
                 res.bytes_estimated = False
                 res.dtype_bytes = dict(dtype_bytes)
+                res.device_map = dict(device_map)
         budget = self.hbm_budget_bytes
         if budget is not None:
             while True:
@@ -982,9 +1079,13 @@ class ModelRegistry:
             models = {n: r.snapshot(now)
                       for n, r in sorted(self._residency.items())}
             resident = self._resident_bytes_locked()
+            per_device = self._resident_per_device_locked()
         return {
             "hbm_budget_bytes": budget,
             "resident_bytes": resident,
+            # shard-aware per-device charges (ISSUE 20): the paging drill
+            # asserts max(per_device_bytes) <= budget at every sample
+            "per_device_bytes": per_device or {},
             "models": models,
             "paging": self.paging.snapshot(),
         }
@@ -1104,6 +1205,7 @@ class ModelRegistry:
             self._models.clear()
             self._residency.clear()
             self._reserved.clear()
+            self._reserved_maps.clear()
         from deeplearning4j_tpu.runtime import profiler
         for s in served:
             s._draining = True

@@ -53,18 +53,29 @@ def _request_signature(x: ArrayOrDict):
 
 
 class Replica:
-    """One device-resident copy of the served parameters. (Per-replica
-    batch counts live in :class:`ServingMetrics.replica_batches` — the
-    single source the snapshot and Prometheus rendering read.)"""
+    """One plan-slice-resident copy of the served parameters: one device in
+    the classic pool, a device GROUP under a multi-axis
+    :class:`~deeplearning4j_tpu.parallel.sharding.ParallelPlan` (pipe/tensor
+    slice — ``devices`` lists the group, ``device`` stays its primary for
+    single-device consumers). ``fn`` overrides the pool's shared forward for
+    replicas whose executable is mesh-bound (the GPipe executor bakes the
+    slice mesh into the lowered program). (Per-replica batch counts live in
+    :class:`ServingMetrics.replica_batches` — the single source the snapshot
+    and Prometheus rendering read.)"""
 
-    __slots__ = ("index", "device", "params", "model_state", "in_flight")
+    __slots__ = ("index", "device", "params", "model_state", "in_flight",
+                 "devices", "plan", "fn")
 
-    def __init__(self, index: int, device, params, model_state):
+    def __init__(self, index: int, device, params, model_state,
+                 devices=None, plan=None, fn=None):
         self.index = int(index)
         self.device = device
         self.params = params
         self.model_state = model_state
         self.in_flight = 0        # dispatched, readback not yet complete
+        self.devices = list(devices) if devices is not None else [device]
+        self.plan = plan          # per-replica slice plan (None = classic)
+        self.fn = fn              # mesh-bound forward (None = pool's shared)
 
 
 class ReplicaPool:
@@ -78,17 +89,29 @@ class ReplicaPool:
     """
 
     def __init__(self, model, n_replicas: int = 1,
-                 devices: Optional[Sequence] = None):
+                 devices: Optional[Sequence] = None, plan=None):
         if getattr(model, "train_state", None) is None:
             model.init()
         self.model = model
         devs = list(devices) if devices else list(jax.local_devices())
         n = max(1, int(n_replicas or 1))
-        if n > len(devs):
+        # a plan that spans >1 device per replica (pipe/tensor/fsdp axes)
+        # generalizes "replica" to "plan-slice": disjoint device groups of
+        # devices_per_replica() each, the plan's ``data`` axis IS the
+        # replica fan-out
+        self.plan = plan
+        self._group_size = plan.devices_per_replica() if plan is not None else 1
+        if self._group_size > len(devs):
+            raise ValueError(
+                f"plan {plan.kind} needs {self._group_size} devices per "
+                f"replica, have {len(devs)}")
+        max_n = len(devs) // self._group_size
+        if n > max_n:
             logger.warning(
                 "ReplicaPool: %d replicas requested but only %d local "
-                "device(s); clamping", n, len(devs))
-            n = len(devs)
+                "device(s) (%d per plan-slice); clamping", n, len(devs),
+                self._group_size)
+            n = max_n
         self._devs = devs
         self._graph_inputs = list(getattr(model.conf, "inputs", []) or [])
         self._fn = self._output_fn(model)
@@ -112,16 +135,49 @@ class ReplicaPool:
             self._next_index = 1
             return
         for i in range(n):
-            ts = model.train_state
-            self.replicas.append(Replica(
-                i, devs[i],
-                jax.device_put(ts.params, devs[i]),
-                jax.device_put(ts.model_state, devs[i])))
+            self.replicas.append(self._mint_replica(i))
         # runtime resize (ISSUE 10) hands out indices from here on; an
         # index is NEVER reused — the AOT cache keys on (index, signature)
         # and a recycled index could hand a new replica an executable
         # compiled for a device its parameters do not live on
         self._next_index = n
+
+    def _replica_group(self, idx: int) -> List:
+        """The device group replica ``idx`` lives on: disjoint slices of
+        ``_group_size`` while they last, then reuse round-robin (two
+        replicas may share a group on a small box, as before)."""
+        gs = self._group_size
+        n_groups = max(1, len(self._devs) // gs)
+        g = idx % n_groups
+        return self._devs[g * gs:(g + 1) * gs]
+
+    def _mint_replica(self, idx: int) -> Replica:
+        """One plan-slice parameter copy: classic single-device
+        ``device_put`` when no plan spans devices; otherwise the slice
+        plan's NamedShardings (pipe slices additionally stage-stack the
+        trunk through the GPipe executor, whose mesh-bound forward rides
+        on the replica)."""
+        ts = self.model.train_state
+        group = self._replica_group(idx)
+        if self._group_size == 1 and self.plan is None:
+            dev = group[0]
+            return Replica(idx, dev,
+                           jax.device_put(ts.params, dev),
+                           jax.device_put(ts.model_state, dev))
+        slice_plan = self.plan.replica_slice(group)
+        if slice_plan.pipe_size > 1:
+            from deeplearning4j_tpu.parallel.plan_exec import PipePlanExecutor
+            ex = PipePlanExecutor(self.model, slice_plan)
+            params = ex.place_packed(ex.pack_params(ts.params))
+            fn = ex.make_forward()
+        else:
+            params = jax.tree.map(jax.device_put, ts.params,
+                                  slice_plan.param_sharding(ts.params))
+            fn = None  # the pool's shared jit handles committed shardings
+        return Replica(idx, group[0], params,
+                       jax.device_put(ts.model_state,
+                                      slice_plan.replicated()),
+                       devices=group, plan=slice_plan, fn=fn)
 
     def __len__(self) -> int:
         return len(self.replicas)
@@ -204,11 +260,12 @@ class ReplicaPool:
         with self._lock:
             idx = self._next_index
             self._next_index += 1
-        dev = device if device is not None else self._devs[idx % len(self._devs)]
-        ts = self.model.train_state
-        return Replica(idx, dev,
-                       jax.device_put(ts.params, dev),
-                       jax.device_put(ts.model_state, dev))
+        if device is not None and self._group_size == 1 and self.plan is None:
+            ts = self.model.train_state
+            return Replica(idx, device,
+                           jax.device_put(ts.params, device),
+                           jax.device_put(ts.model_state, device))
+        return self._mint_replica(idx)
 
     def publish_replica(self, replica: Replica) -> int:
         """Make a warmed replica routable; returns the new pool size."""
@@ -244,6 +301,14 @@ class ReplicaPool:
                                        (self._graph_inputs or sorted(x))])
                    if isinstance(x, dict) else self.model.output(x))
             return out
+        if replica.fn is not None:
+            # mesh-bound plan-slice executable (GPipe trunk): the plan
+            # signature joins the AOT key, so a replica minted under a
+            # different plan can never be served a stale executable
+            return self._aot.call(
+                (replica.index, replica.plan.signature(),
+                 _request_signature(x)),
+                replica.fn, replica.params, replica.model_state, x, None)
         if self._graph_inputs:
             if not isinstance(x, dict):
                 x = {self._graph_inputs[0]: x}
@@ -252,9 +317,11 @@ class ReplicaPool:
                 (replica.index, _request_signature(inputs_)),
                 self._fn, replica.params, replica.model_state, inputs_)
             return outs[0] if len(outs) == 1 else outs
+        key = ((replica.index, replica.plan.signature(), _request_signature(x))
+               if replica.plan is not None
+               else (replica.index, _request_signature(x)))
         return self._aot.call(
-            (replica.index, _request_signature(x)),
-            self._fn, replica.params, replica.model_state, x, None)
+            key, self._fn, replica.params, replica.model_state, x, None)
 
     def forward_blocking(self, replica: Replica, x: ArrayOrDict):
         """Dispatch + full readback on one replica (warmup path — forces

@@ -2031,6 +2031,20 @@ class FleetRouter:
                             int(c["queue"]["headroom_requests"]),
                         "aot_executables": int(c["aot_executables"]),
                     }
+                    # drain-rate flatten (ISSUE 20 satellite): each
+                    # worker's measured admission-queue drain estimate
+                    # becomes a fleet-summed requests/s capacity figure
+                    # the autoscaler's forecast blends with the
+                    # utilization-implied serveable rate. Optional field
+                    # (older payloads / no drain sample yet): missing or
+                    # non-positive contributes 0, never skips the entry.
+                    dm = c["queue"].get("drain_ms_per_request")
+                    try:
+                        inc["drain_rate_rps"] = (
+                            1000.0 / float(dm)
+                            if dm is not None and float(dm) > 0 else 0.0)
+                    except (TypeError, ValueError):
+                        inc["drain_rate_rps"] = 0.0
                     wire = c.get("dispatch_latency")
                     h = LatencyHistogram.from_wire(wire) if wire else None
                     if h is not None:
@@ -2046,12 +2060,14 @@ class FleetRouter:
                     "param_bytes": 0, "device_bytes_total": 0,
                     "replicas": 0, "workers": 0, "busy_s": 0.0,
                     "window_s": 0.0, "queue_depth": 0,
-                    "queue_headroom_requests": 0, "aot_executables": 0})
+                    "queue_headroom_requests": 0, "aot_executables": 0,
+                    "drain_rate_rps": 0.0})
                 for k, v in inc.items():
                     a[k] += v
         for model, a in models.items():
             a["busy_fraction"] = round(
                 a["busy_s"] / a["window_s"], 6) if a["window_s"] else 0.0
+            a["drain_rate_rps"] = round(a["drain_rate_rps"], 4)
             h = hists.get(model)
             if h is not None:
                 a["dispatch_p50_s"] = h.percentile(50)
@@ -2101,6 +2117,8 @@ class FleetRouter:
                          f"{a['busy_fraction']}")
             lines.append(f"fleet_capacity_queue_headroom_requests{lbl} "
                          f"{a['queue_headroom_requests']}")
+            lines.append(f"fleet_capacity_drain_rate_rps{lbl} "
+                         f"{a['drain_rate_rps']}")
             if "dispatch_p99_s" in a:
                 lines.append(
                     f'fleet_capacity_dispatch_seconds{{model="{model}",'

@@ -319,6 +319,15 @@ class DistributedConfig:
     checkpoint_every: int = 0
     keep_last: int = 3
     heartbeat_file: Optional[str] = None
+    #: ISSUE 20 — encode/exchange vs compute overlap. 0 (default) is the
+    #: fully synchronous schedule. 1 enables a one-deep in-flight window:
+    #: step k+1's local gradients are computed (and encoded) BEFORE step
+    #: k's allgather result is combined and applied, hiding the wire
+    #: latency behind the next step's compute. This is an explicit
+    #: staleness-1 delayed-update schedule — a DIFFERENT trajectory from
+    #: window 0 — and the loopback oracle runs the exact same schedule,
+    #: so worker-vs-oracle bit-identity holds at any window setting.
+    overlap_window: int = 0
 
 
 class DistributedTrainer:
@@ -345,11 +354,14 @@ class DistributedTrainer:
 
     def __init__(self, net, config: Optional[DistributedConfig] = None,
                  world: Optional[int] = None, rank: Optional[int] = -1,
-                 profiler=None):
+                 profiler=None, plan=None):
         import jax
         self._jax = jax
         self.net = net
         self.config = config or DistributedConfig()
+        if self.config.overlap_window not in (0, 1):
+            raise ValueError("overlap_window supports 0 (synchronous) or 1 "
+                             "(one-deep in-flight exchange window)")
         self.stats = ExchangeStats()
         self.profiler = profiler
         if profiler is not None:
@@ -371,6 +383,20 @@ class DistributedTrainer:
                     f"{self.transport.world}")
         if net.train_state is None:
             net.init()
+        # ISSUE 20: an optional ParallelPlan shards the LOCAL step across
+        # this process's devices (fsdp/tensor — the cross-process data
+        # axis stays the threshold-encoded host exchange, so the combined
+        # update is still exchanged ONLY over the data dimension). Pipe
+        # plans belong to ParallelWrapper.fit / serving, not here.
+        self.plan = plan
+        if plan is not None:
+            if getattr(plan, "pipe_size", 1) > 1:
+                raise NotImplementedError(
+                    "DistributedTrainer shards the local step with "
+                    "fsdp/tensor axes; pipeline plans train through "
+                    "ParallelWrapper.fit")
+            from deeplearning4j_tpu.parallel.sharding import shard_train_state
+            net.train_state = shard_train_state(net.train_state, plan)
         self._leaves, self._treedef = jax.tree.flatten(net.train_state.params)
         template = [np.asarray(l) for l in self._leaves]
         n_rank_states = self.world if self.loopback else 1
@@ -387,6 +413,12 @@ class DistributedTrainer:
         self._grad_fn = None
         self._apply_fn = None
         self.losses: List[float] = []
+        # one-deep in-flight exchange window (ISSUE 20, overlap_window=1)
+        self._inflight = None
+        self._last_mean_loss: Optional[float] = None
+        self._xchg_thread: Optional[threading.Thread] = None
+        self._xchg_req = None
+        self._xchg_res = None
         self._epoch_start_iters: Dict[int, int] = {}
         if self.config.checkpoint_dir:
             os.makedirs(self.config.checkpoint_dir, exist_ok=True)
@@ -434,9 +466,19 @@ class DistributedTrainer:
         through the AOT dispatch path."""
         if self._grad_fn is None:
             self._grad_fn = self._make_grad_fn()
-        jnp_x = self._jax.numpy.asarray(x)
-        jnp_y = self._jax.numpy.asarray(y)
-        key = (tuple(jnp_x.shape), str(jnp_x.dtype), tuple(jnp_y.shape))
+        if self.plan is not None:
+            # commit the local shard to the plan's batch axes so the grad
+            # step runs plan-sharded; XLA's psum over those axes IS the
+            # within-process reduction, the host exchange stays data-only
+            jnp_x = self._jax.device_put(
+                np.asarray(x), self.plan.batch_sharding(np.ndim(x)))
+            jnp_y = self._jax.device_put(
+                np.asarray(y), self.plan.batch_sharding(np.ndim(y)))
+        else:
+            jnp_x = self._jax.numpy.asarray(x)
+            jnp_y = self._jax.numpy.asarray(y)
+        key = (tuple(jnp_x.shape), str(jnp_x.dtype), tuple(jnp_y.shape),
+               self.plan.signature() if self.plan is not None else None)
         loss, grads, new_state = self._grad_aot.call(
             key, self._grad_fn, self.net.train_state.params,
             self._rank_model_states[rank_ix], jnp_x, jnp_y, rng)
@@ -453,9 +495,19 @@ class DistributedTrainer:
         if self._apply_fn is None:
             self._apply_fn = self._make_apply_fn()
         t0 = time.perf_counter()
-        self.net.train_state = self._apply_aot.call(
-            (), self._apply_fn, self.net.train_state,
+        ts = self._apply_aot.call(
+            (self.plan.signature() if self.plan is not None else None,),
+            self._apply_fn, self.net.train_state,
             self._rank_model_states[0], combined)
+        if self.plan is not None:
+            # re-commit the plan's parameter placement: the combined
+            # update arrives replicated, and GSPMD's output choice for
+            # params must not drift step over step (the AOT grad
+            # executable was compiled against the plan layout)
+            ts = dataclasses.replace(
+                ts, params=self._jax.device_put(
+                    ts.params, self.plan.param_sharding(ts.params)))
+        self.net.train_state = ts
         self.stats.record("apply", time.perf_counter() - t0)
 
     # ----------------------------------------------------------------- step
@@ -484,45 +536,117 @@ class DistributedTrainer:
         rng = self.net.rng.next_key()
         chaos.inject("train.distributed.exchange")
         if self.loopback:
-            frames = []
+            send = []
+            lsum = 0.0
             for r in range(self.world):
                 lo = r * n_local
                 loss, flat, ex = self._local_grad(
                     r, x[lo:lo + n_local], y[lo:lo + n_local], rng)
-                frames.append(ex.make_payload(flat, loss))
-            t0 = time.perf_counter()
-            frames = self.transport.gather_bytes(frames)
-            self.stats.record("exchange", time.perf_counter() - t0)
-            dense_bytes = 4 * self._exchanges[0].codec.size
-            wire = max(len(f) for f in frames)
-            self.stats.record_bytes(dense_bytes, wire, len(frames[0]))
+                send.append(ex.make_payload(flat, loss))
+                lsum += loss
+            loss = lsum / self.world
         else:
             lo = self.rank * n_local
             loss, flat, ex = self._local_grad(
                 0, x[lo:lo + n_local], y[lo:lo + n_local], rng)
-            frame = ex.make_payload(flat, loss)
-            t0 = time.perf_counter()
-            frames = self.transport.gather_bytes(frame)
-            self.stats.record("exchange", time.perf_counter() - t0)
-            dense_bytes = 4 * ex.codec.size
-            # the two-phase gather pads every rank's send to the round max
-            wire = max(len(f) for f in frames)
-            self.stats.record_bytes(dense_bytes, wire, len(frame))
-        combined, mean_loss = self._exchanges[0].combine(frames)
-        self._apply(combined)
+            send = ex.make_payload(flat, loss)
+        handle = self._begin_gather(send)
+        if self.config.overlap_window:
+            # staleness-1 schedule (ISSUE 20): this step's allgather
+            # drains behind the NEXT step's compute; what gets combined
+            # and applied here is the PREVIOUS step's exchange. The first
+            # step has nothing to apply yet — it returns the local loss
+            # (in loopback, the mean over simulated ranks, which is the
+            # exact value the eventual combine will report).
+            prev, self._inflight = self._inflight, handle
+            mean_loss = (self._complete_exchange(prev)
+                         if prev is not None else float(loss))
+        else:
+            mean_loss = self._complete_exchange(handle)
         step_no = int(self.net._iteration) + 1
         self.net._iteration = step_no
         self.net._score = mean_loss
-        self.losses.append(mean_loss)
         if (self.config.resync_every
                 and step_no % self.config.resync_every == 0):
+            self.flush()
             self.resync_params()
         if (self.config.checkpoint_every and self.config.checkpoint_dir
                 and step_no % self.config.checkpoint_every == 0):
+            self.flush()
             self._checkpoint(step_no)
         if self.config.heartbeat_file:
             self._beat(step_no)
         return mean_loss
+
+    # --------------------------------------------------- overlapped exchange
+    def _exchange_worker(self) -> None:
+        while True:
+            item = self._xchg_req.get()
+            if item is None:
+                return
+            try:
+                self._xchg_res.put(("ok", self.transport.gather_bytes(item)))
+            except BaseException as e:
+                self._xchg_res.put(("err", e))
+
+    def _begin_gather(self, send):
+        """Dispatch one step's allgather. Loopback's gather is a list op —
+        it completes inline; worker mode hands the frame to the exchange
+        thread so the collective drains behind the next step's compute."""
+        sent = len(send[0]) if isinstance(send, list) else len(send)
+        if self.loopback or not self.config.overlap_window:
+            t0 = time.perf_counter()
+            frames = self.transport.gather_bytes(send)
+            self.stats.record("exchange", time.perf_counter() - t0)
+            return {"frames": frames, "sent": sent}
+        if self._xchg_thread is None:
+            import queue
+            self._xchg_req = queue.Queue()
+            self._xchg_res = queue.Queue()
+            self._xchg_thread = threading.Thread(
+                target=self._exchange_worker, name="dist-exchange",
+                daemon=True)
+            self._xchg_thread.start()
+        self._xchg_req.put(send)
+        return {"frames": None, "sent": sent}
+
+    def _complete_exchange(self, handle) -> float:
+        frames = handle["frames"]
+        if frames is None:
+            t0 = time.perf_counter()
+            status, payload = self._xchg_res.get()
+            # the recorded exchange time is the WAIT, not the wire time —
+            # the overlap benefit shows up as this going to ~0
+            self.stats.record("exchange", time.perf_counter() - t0)
+            if status == "err":
+                raise payload
+            frames = payload
+        dense_bytes = 4 * self._exchanges[0].codec.size
+        # the two-phase gather pads every rank's send to the round max
+        wire = max(len(f) for f in frames)
+        self.stats.record_bytes(dense_bytes, wire, handle["sent"])
+        combined, mean_loss = self._exchanges[0].combine(frames)
+        self._apply(combined)
+        self.losses.append(mean_loss)
+        self._last_mean_loss = mean_loss
+        return mean_loss
+
+    def flush(self) -> Optional[float]:
+        """Combine + apply any in-flight exchange (``overlap_window`` > 0).
+        Runs before every checkpoint/resync and at fit end, so persisted
+        or broadcast state never straddles a pending update. Returns the
+        applied mean loss, or ``None`` when nothing was pending."""
+        if self._inflight is None:
+            return None
+        handle, self._inflight = self._inflight, None
+        return self._complete_exchange(handle)
+
+    def close(self) -> None:
+        """Join the overlap exchange thread (no-op when never started)."""
+        if self._xchg_thread is not None:
+            self._xchg_req.put(None)
+            self._xchg_thread.join(timeout=10)
+            self._xchg_thread = None
 
     def resync_params(self) -> None:
         """Re-broadcast rank 0's parameters to every rank — the periodic
@@ -590,7 +714,9 @@ class DistributedTrainer:
                         lst.iteration_done(self.net, self.net._iteration,
                                            self.net._epoch, loss)
                 self.net._epoch = e + 1
+                self.flush()
         finally:
+            self.close()
             if self.profiler is not None:
                 self.profiler.stop()
         return self.net
@@ -685,7 +811,14 @@ class DistributedTrainer:
                         f"rank {r}: no residual state for checkpoint step "
                         f"{step_no} — cannot exact-resume the encoded "
                         f"stream") from None
-        # model state of record is the restored archive's
+        # model state of record is the restored archive's; a restart can
+        # never inherit an in-flight exchange window
+        self._inflight = None
+        self._last_mean_loss = None
+        if self.plan is not None:
+            from deeplearning4j_tpu.parallel.sharding import shard_train_state
+            self.net.train_state = shard_train_state(self.net.train_state,
+                                                     self.plan)
         self._rank_model_states = [self.net.train_state.model_state
                                    for _ in self._rank_model_states]
         self._epoch_start_iters = self._load_epoch_starts()

@@ -1507,3 +1507,108 @@ def test_check_tables_scheduler_absent_is_warning(tmp_path):
     msgs = []
     assert bench.check_tables(str(md), str(extra), log=msgs.append) == 0
     assert any("scheduler" in m and "WARN" in m for m in msgs)
+
+
+# ==========================================================================
+# ISSUE 20: the parallel section
+def _parallel_section():
+    """A self-consistent BENCH_EXTRA.json["parallel"] record (the ISSUE 20
+    one-plan parallelism drill)."""
+    h = "ab" * 32
+    return {
+        "steps_timed": 12,
+        "batch": 64,
+        "devices": 8,
+        "single_axis": {"steps_per_sec": 40.0, "phash": h,
+                        "bit_identical": True},
+        "composed": {"steps_per_sec": 36.0, "phash": h,
+                     "bit_identical": True},
+        "speedup": 0.9,
+        "serve": {
+            "model_bytes": 400000,
+            "budget_bytes": 240000,
+            "flat_rejected": True,
+            "requests": 32,
+            "bit_identical": True,
+            "on_traffic_compiles": 0,
+            "budget_samples": 32,
+            "budget_held_samples": 32,
+            "budget_held": True,
+            "per_device_max_bytes": 120000,
+        },
+    }
+
+
+def _extra_with_parallel(section):
+    measured = {k: _mid(*rng) for k, rng in bench.RECORDED_RANGES.items()}
+    measured["parallel"] = section
+    measured["parallel_composed_speedup"] = section["speedup"]
+    return measured
+
+
+def test_check_tables_validates_parallel_section(tmp_path):
+    """ISSUE 20 satellite: --check-tables covers the parallel keys — a
+    self-consistent record passes; a non-bitwise train arm, a speedup
+    the recorded steps/sec rows can't reproduce, an admitted flat
+    registration, a diverged or compiling serve drill, a budget that
+    isn't actually sub-model-size, a per-device charge over budget, a
+    partially-held budget, a missing key, or a stale top-level copy
+    all fail loudly."""
+    md = tmp_path / "BASELINE.md"
+    md.write_text(_table_md(bench.RECORDED_RANGES))
+    extra = tmp_path / "BENCH_EXTRA.json"
+
+    extra.write_text(json.dumps(_extra_with_parallel(_parallel_section())))
+    assert bench.check_tables(str(md), str(extra), log=lambda *a: None) == 0
+
+    def failing(mutate, needle):
+        sec = _parallel_section()
+        mutate(sec)
+        extra.write_text(json.dumps(_extra_with_parallel(sec)))
+        msgs = []
+        assert bench.check_tables(str(md), str(extra),
+                                  log=msgs.append) == 1, needle
+        assert any(needle in m for m in msgs), (needle, msgs)
+
+    failing(lambda s: s["composed"].update(bit_identical=False),
+            "parallel.composed: bit_identical")
+    failing(lambda s: s.update(speedup=2.0), "steps/sec rows give")
+    failing(lambda s: s["serve"].update(flat_rejected=False),
+            "parallel.serve.flat_rejected")
+    failing(lambda s: s["serve"].update(bit_identical=False),
+            "parallel.serve.bit_identical")
+    failing(lambda s: s["serve"].update(on_traffic_compiles=3),
+            "parallel.serve.on_traffic_compiles")
+    failing(lambda s: s["serve"].update(budget_bytes=500000),
+            "did not constrain anything")
+    failing(lambda s: s["serve"].update(per_device_max_bytes=300000),
+            "exceeds the")
+    failing(lambda s: s["serve"].update(budget_held_samples=30,
+                                        budget_held=False),
+            "parallel.serve.budget_held")
+    failing(lambda s: s.pop("serve"), "missing from the recorded section")
+
+    # a malformed section (arm is not a dict) is a failure, not a crash
+    failing(lambda s: s.update(single_axis=3.0), "parallel")
+
+    # stale top-level copy
+    ex = _extra_with_parallel(_parallel_section())
+    ex["parallel_composed_speedup"] = 2.0
+    extra.write_text(json.dumps(ex))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 1
+    assert any("parallel_composed_speedup: top-level copy" in m
+               for m in msgs)
+
+
+def test_check_tables_parallel_absent_is_warning(tmp_path):
+    """No --parallel run recorded yet -> warn, don't fail (same contract
+    as the other optional sections)."""
+    md = tmp_path / "BASELINE.md"
+    md.write_text(_table_md(bench.RECORDED_RANGES))
+    measured = {k: _mid(*rng) for k, rng in bench.RECORDED_RANGES.items()}
+    extra = tmp_path / "BENCH_EXTRA.json"
+    extra.write_text(json.dumps(measured))
+    msgs = []
+    assert bench.check_tables(str(md), str(extra), log=msgs.append) == 0
+    assert any("parallel" in m and "WARN" in m for m in msgs)

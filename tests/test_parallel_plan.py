@@ -1,0 +1,367 @@
+"""ISSUE 20 — one-plan parallelism: ``ParallelPlan.compose`` consumed
+uniformly by train (ParallelWrapper, DistributedTrainer), serve
+(ReplicaPool / ContinuousBatcher / ModelRegistry) and the AOT cache.
+
+Bit-identity policy (measured, not aspirational):
+
+- *degenerate* composed plans (one non-trivial axis) run the SAME XLA
+  program as their single-axis factory — asserted BITWISE;
+- pipe x data with ``microbatches=1`` is staged-sequential — the same
+  contraction order as the unpipelined oracle — asserted BITWISE;
+- serving through a pipe plan-slice is forward-only — BITWISE at any
+  microbatch count;
+- cross-topology pairs (HSDP data x fsdp vs flat fsdp) reduce
+  hierarchically (reduce-scatter inside the slice + all-reduce across)
+  vs flat all-reduce — ~1-ulp float drift, asserted with tight allclose.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import NumpyDataSetIterator
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.parallel import ParallelPlan, ParallelWrapper
+from deeplearning4j_tpu.runtime.compile_cache import AotCache
+from deeplearning4j_tpu.runtime.mesh import MeshSpec, create_mesh
+from deeplearning4j_tpu.serving.batcher import ContinuousBatcher
+from deeplearning4j_tpu.serving.manifest import WarmupManifest
+from deeplearning4j_tpu.train import Sgd
+from deeplearning4j_tpu.train.distributed import (DistributedConfig,
+                                                  DistributedTrainer)
+
+
+def _conf(seed=7, layers=1, width=16):
+    b = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.1)).list())
+    for _ in range(layers):
+        b = b.layer(DenseLayer(n_out=width, activation="tanh"))
+    return (b.layer(OutputLayer(n_out=4, activation="softmax"))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+
+
+def _data(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0, 1, (n, 8)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, n)]
+    return x, y
+
+
+def _flat_params(net):
+    return np.concatenate([np.asarray(l).ravel()
+                           for l in jax.tree.leaves(
+                               net.train_state.params)])
+
+
+def _pw_fit(plan, epochs=2, layers=1, seed=7):
+    x, y = _data()
+    net = MultiLayerNetwork(_conf(seed=seed, layers=layers)).init()
+    pw = ParallelWrapper(net, plan, prefetch_buffer=0)
+    pw.fit(NumpyDataSetIterator(x, y, batch_size=16), epochs=epochs)
+    return _flat_params(net)
+
+
+# ===================================================================
+# plan identity: signatures, describe, AOT-key drift
+def test_plan_signature_drift_and_stability():
+    p1 = ParallelPlan.compose(data=2, pipe=4, microbatches=2)
+    p1b = ParallelPlan.compose(data=2, pipe=4, microbatches=2)
+    p2 = ParallelPlan.compose(data=2, pipe=4, microbatches=4)
+    p3 = ParallelPlan.compose(data=4, fsdp=2)
+    # stable across re-construction (manifest replay depends on it) ...
+    assert p1.signature() == p1b.signature()
+    assert p1.describe() == p1b.describe()
+    # ... and ANY drift (schedule knob, axis layout) changes the key
+    assert p1.signature() != p2.signature()
+    assert p1.signature() != p3.signature()
+    assert p2.signature() != p3.signature()
+    # describe() is the JSON twin the warmup manifest records
+    import json
+    assert json.loads(json.dumps(p1.describe())) == p1.describe()
+
+
+def test_plan_drift_mints_fresh_executable_never_stale():
+    """Two plans, same arg shapes: the plan signature in the AOT key
+    forces a second executable — a changed plan can never be served the
+    first plan's compiled program."""
+    p1 = ParallelPlan.compose(data=2, fsdp=4)
+    p2 = ParallelPlan.compose(data=2, fsdp=2, tensor=2)
+    f = jax.jit(lambda a: a * 2.0)
+    cache = AotCache("test-plan-drift")
+    x = jnp.ones((4,), jnp.float32)
+    sig = (x.shape, str(x.dtype))
+    cache.call((p1.signature(), sig), f, x)
+    assert len(cache) == 1
+    cache.call((p1.signature(), sig), f, x)   # hit, no second entry
+    assert len(cache) == 1
+    cache.call((p2.signature(), sig), f, x)   # drift -> fresh mint
+    assert len(cache) == 2
+
+
+def test_compose_batch_divisor_and_devices_per_replica():
+    p = ParallelPlan.compose(data=2, pipe=4, microbatches=2)
+    assert p.batch_axes() == ("data",)
+    assert p.batch_divisor() == 2
+    assert p.pipe_size == 4
+    assert p.devices_per_replica() == 4     # pipe slice; data = fan-out
+    h = ParallelPlan.compose(data=2, fsdp=4)
+    assert h.batch_axes() == ("data", "fsdp")
+    assert h.batch_divisor() == 8
+
+
+# ===================================================================
+# degenerate composed plans == single-axis factories (BITWISE)
+def test_compose_degenerate_data_parallel_bitwise():
+    ref = _pw_fit(ParallelPlan.data_parallel(create_mesh()))
+    got = _pw_fit(ParallelPlan.compose(data=8))
+    assert np.array_equal(ref, got)
+
+
+def test_compose_degenerate_fsdp_bitwise():
+    devs = jax.devices()[:4]
+    mesh4 = create_mesh(MeshSpec({"data": 4}), devices_=devs)
+    ref = _pw_fit(ParallelPlan.fsdp(mesh4, min_size=64))
+    got = _pw_fit(ParallelPlan.compose(fsdp=4, devices_=devs, min_size=64))
+    assert np.array_equal(ref, got)
+
+
+def test_compose_degenerate_tensor_bitwise():
+    mesh = create_mesh(MeshSpec({"data": 1, "model": 8}))
+    ref = _pw_fit(ParallelPlan.tensor_parallel(mesh))
+    got = _pw_fit(ParallelPlan.compose(tensor=8))
+    assert np.array_equal(ref, got)
+
+
+def test_compose_hsdp_matches_flat_fsdp_allclose():
+    """data x fsdp reduces hierarchically (reduce-scatter inside the
+    fsdp slice, all-reduce over data) where flat fsdp reduces once —
+    ~1-ulp contraction-order drift, NOT bitwise. Documented in
+    docs/parallelism.md; held to tight allclose here."""
+    devs = jax.devices()[:4]
+    mesh4 = create_mesh(MeshSpec({"data": 4}), devices_=devs)
+    ref = _pw_fit(ParallelPlan.fsdp(mesh4, min_size=64))
+    got = _pw_fit(ParallelPlan.compose(data=2, fsdp=2, devices_=devs,
+                                       min_size=64))
+    assert not np.isnan(got).any()
+    np.testing.assert_allclose(ref, got, rtol=2e-5, atol=1e-6)
+
+
+# ===================================================================
+# pipe axis folded in as an execution path (GPipe trunk)
+def test_pipe_data_train_bitwise_at_microbatches_one():
+    """pipe x data with microbatches=1 is staged-sequential: the same
+    per-step contraction order as plain DP — bit-identical trained
+    params through the SAME ParallelWrapper.fit call."""
+    devs = jax.devices()
+    dp2 = create_mesh(MeshSpec({"data": 2}), devices_=devs[:2])
+    ref = _pw_fit(ParallelPlan.data_parallel(dp2), layers=5)
+    got = _pw_fit(ParallelPlan.compose(data=2, pipe=4, microbatches=1),
+                  layers=5)
+    assert np.array_equal(ref, got)
+
+
+def test_pipe_microbatched_train_allclose():
+    """microbatches>1 re-orders gradient accumulation (like any DP
+    resharding) — same trajectory to float tolerance."""
+    devs = jax.devices()
+    dp2 = create_mesh(MeshSpec({"data": 2}), devices_=devs[:2])
+    ref = _pw_fit(ParallelPlan.data_parallel(dp2), layers=5)
+    got = _pw_fit(ParallelPlan.compose(data=2, pipe=4, microbatches=4),
+                  layers=5)
+    assert not np.isnan(got).any()
+    np.testing.assert_allclose(ref, got, rtol=2e-5, atol=1e-6)
+
+
+# ===================================================================
+# DistributedTrainer consumes the same plan
+def _dist_run(cfg=None, plan=None, steps=4, seed=11):
+    rng = np.random.RandomState(3)
+    X = rng.randn(steps, 16, 8).astype(np.float32)
+    Y = np.eye(4, dtype=np.float32)[rng.randint(0, 4, (steps, 16))]
+    net = MultiLayerNetwork(_conf(seed=seed)).init()
+    tr = DistributedTrainer(net, cfg or DistributedConfig(threshold=1e-3),
+                            world=2, rank=None, plan=plan)
+    try:
+        for i in range(steps):
+            tr.step(X[i], Y[i])
+        tr.flush()
+    finally:
+        tr.close()
+    return _flat_params(net), list(tr.losses)
+
+
+def test_distributed_trainer_composed_plan_bitwise():
+    devs = jax.devices()[:4]
+    mesh4 = create_mesh(MeshSpec({"data": 4}), devices_=devs)
+    ref, ref_losses = _dist_run(plan=ParallelPlan.fsdp(mesh4, min_size=64))
+    got, got_losses = _dist_run(plan=ParallelPlan.compose(
+        fsdp=4, devices_=devs, min_size=64))
+    assert np.array_equal(ref, got)
+    assert ref_losses == got_losses
+
+
+def test_distributed_trainer_overlap_window_deterministic():
+    """overlap_window=1 is an explicit staleness-1 schedule: a different
+    trajectory from sync (by design), but deterministic run-to-run, all
+    steps applied by flush(), and the exchange thread joined."""
+    sync, _ = _dist_run()
+    cfg = DistributedConfig(threshold=1e-3, overlap_window=1)
+    ov1, l1 = _dist_run(cfg)
+    ov2, l2 = _dist_run(cfg)
+    assert np.array_equal(ov1, ov2)
+    assert l1 == l2
+    assert len(l1) == 4                     # every step's update landed
+    assert not np.array_equal(sync, ov1)    # staleness-1 != sync
+    assert not [t.name for t in threading.enumerate()
+                if t.name.startswith("dist-")]
+
+
+def test_distributed_trainer_overlap_with_plan_bitwise():
+    devs = jax.devices()[:4]
+    mesh4 = create_mesh(MeshSpec({"data": 4}), devices_=devs)
+    cfg = DistributedConfig(threshold=1e-3, overlap_window=1)
+    ref, _ = _dist_run(cfg, plan=ParallelPlan.fsdp(mesh4, min_size=64))
+    got, _ = _dist_run(cfg, plan=ParallelPlan.compose(
+        fsdp=4, devices_=devs, min_size=64))
+    assert np.array_equal(ref, got)
+
+
+def test_distributed_trainer_rejects_pipe_plan():
+    net = MultiLayerNetwork(_conf()).init()
+    with pytest.raises(NotImplementedError):
+        DistributedTrainer(net, DistributedConfig(),
+                           world=2, rank=None,
+                           plan=ParallelPlan.compose(data=2, pipe=4))
+
+
+# ===================================================================
+# serving: replica = one plan-slice, manifest records the plan
+def _serve_net(seed=42):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.1))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=4, activation="softmax"))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_plan_sliced_batcher_bitwise_zero_traffic_compiles():
+    """The drill of record, in miniature: a pipe x data plan-sliced pool
+    serves BITWISE what the unsharded single-device ``net.output`` oracle
+    computes, with zero compiles on live traffic, and the warmup manifest
+    records the plan for replay."""
+    net = _serve_net()
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 8).astype(np.float32)
+    oracle = np.asarray(net.output(x))
+    plan = ParallelPlan.compose(data=2, pipe=4, microbatches=2)
+    cb = ContinuousBatcher(net, max_batch_size=8, batch_timeout_ms=2,
+                           replicas=2, plan=plan, warmup_example=x[:1])
+    try:
+        warm = cb.compile_count()
+        outs = np.stack([np.asarray(cb.submit(x[i:i + 1]))[0]
+                         for i in range(16)])
+        assert np.array_equal(outs, oracle)
+        assert cb.compile_count() == warm   # zero on-traffic compiles
+        m = cb.warmup_manifest()
+        assert m.plan == plan.describe()
+        # serde roundtrip: the replayer reads the SAME plan back
+        assert WarmupManifest.from_dict(m.to_dict()).plan == plan.describe()
+    finally:
+        cb.shutdown()
+
+
+def test_plan_sliced_pool_spreads_bytes_per_device():
+    """Shard-aware capacity (ISSUE 20 satellite): each device is charged
+    only its local shard bytes, so the per-device ledger reads N small
+    charges — not the full tree on every device."""
+    from types import SimpleNamespace
+    from deeplearning4j_tpu.serving import capacity
+    net = _serve_net()
+    x = np.zeros((1, 8), np.float32)
+    plan = ParallelPlan.compose(data=2, pipe=4, microbatches=1)
+    cb = ContinuousBatcher(net, max_batch_size=8, batch_timeout_ms=2,
+                           replicas=2, plan=plan, warmup_example=x)
+    try:
+        served = SimpleNamespace(batcher=cb, model=net)
+        per_dev = capacity.served_per_device_bytes(served)
+        total = capacity.served_device_bytes(served)
+        # 2 replica groups x 4 pipe devices = all 8 devices charged
+        assert len(per_dev) == 8
+        assert sum(per_dev.values()) == total
+        # the trunk is stage-sharded: no device holds a full replica
+        per_replica = total / 2
+        assert max(per_dev.values()) < per_replica
+    finally:
+        cb.shutdown()
+
+
+def test_manifest_replay_of_plan_sliced_warmup_zero_traffic_compiles():
+    """A second batcher replayed from the recorded manifest (same plan)
+    reaches READY with its warmup compiles only — live traffic then
+    compiles nothing."""
+    net = _serve_net()
+    rng = np.random.RandomState(1)
+    x = rng.randn(8, 8).astype(np.float32)
+    oracle = np.asarray(net.output(x))
+    plan = ParallelPlan.compose(data=2, pipe=4, microbatches=2)
+    cb1 = ContinuousBatcher(net, max_batch_size=8, batch_timeout_ms=2,
+                            replicas=2, plan=plan, warmup_example=x[:1])
+    m = cb1.warmup_manifest()
+    cb1.shutdown()
+    assert m.plan == plan.describe()
+    cb2 = ContinuousBatcher(net, max_batch_size=m.max_batch_size or 8,
+                            batch_timeout_ms=2, replicas=m.replicas,
+                            buckets=list(m.buckets), plan=plan,
+                            warmup_example=m.example())
+    try:
+        warm = cb2.compile_count()
+        outs = np.stack([np.asarray(cb2.submit(x[i:i + 1]))[0]
+                         for i in range(8)])
+        assert np.array_equal(outs, oracle)
+        assert cb2.compile_count() == warm
+    finally:
+        cb2.shutdown()
+
+
+def test_registry_admits_oversized_model_only_when_plan_sliced():
+    """Per-device HBM budgeting end-to-end: a model whose full f32 state
+    exceeds the per-device budget is REJECTED unsharded but ADMITTED
+    through a pipe-sliced plan (each device holds ~1/4 of the trunk) —
+    and every per-device ledger entry stays under the budget."""
+    from deeplearning4j_tpu.serving import HBMBudgetExceeded, ModelRegistry
+    from deeplearning4j_tpu.serving import capacity
+    net = _serve_net()
+    host = sum(int(np.asarray(l).nbytes)
+               for l in jax.tree.leaves(net.train_state.params))
+    budget = int(host * 0.6)                # < one full copy, > a 1/4 slice
+    x = np.zeros((1, 8), np.float32)
+    reg = ModelRegistry(hbm_budget_bytes=budget)
+    try:
+        with pytest.raises(HBMBudgetExceeded):
+            reg.register("m-flat", net, warmup_example=x,
+                         max_batch_size=8, batch_timeout_ms=2)
+        plan = ParallelPlan.compose(data=2, pipe=4, microbatches=1)
+        served = reg.register("m", net, warmup_example=x, plan=plan,
+                              replicas=2, max_batch_size=8,
+                              batch_timeout_ms=2)
+        rng = np.random.RandomState(2)
+        q = rng.randn(4, 8).astype(np.float32)
+        assert np.array_equal(np.asarray(served.batcher.submit(q)),
+                              np.asarray(net.output(q)))
+        snap = reg.residency_snapshot()
+        per_dev = snap.get("per_device_bytes") or {}
+        assert per_dev, "shard-aware ledger must be populated"
+        assert max(per_dev.values()) <= budget
+    finally:
+        reg.shutdown()
